@@ -1,0 +1,309 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new design with the capability surface of the reference (PaddlePaddle, mounted at
+/root/reference — see SURVEY.md): eager tensors with tape autograd, a jit/compile path,
+nn/optimizer/amp/io stacks, and a first-class distributed story (DP/TP/PP/SP/EP, ZeRO,
+DTensor-style semi-auto sharding, sharded checkpoints) — all riding JAX/XLA/Pallas/pjit
+instead of CUDA/NCCL.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# float64/int64 parity with the reference (paddle supports fp64; indices are int64).
+# TPU code paths use fp32/bf16 throughout; fp64 arrays are CPU-only like the reference's
+# CPU-only kernels.
+_jax.config.update("jax_enable_x64", True)
+
+import numpy as _np  # noqa: E402
+
+from .core import dtype as _dtype_mod  # noqa: E402
+from .core.dtype import (  # noqa: E402,F401
+    bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype, finfo, iinfo,
+)
+from .core.tensor import (  # noqa: E402,F401
+    Tensor, no_grad, enable_grad, is_grad_enabled, set_grad_enabled, dispatch,
+    register_op,
+)
+from .core.device import (  # noqa: E402,F401
+    CPUPlace, TPUPlace, CUDAPlace, XPUPlace, CustomPlace, Place,
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+)
+from .core.random import seed, get_rng_state, set_rng_state, Generator  # noqa: E402,F401
+from .core.flags import get_flags, set_flags  # noqa: E402,F401
+
+from .ops import *  # noqa: E402,F401,F403
+from . import ops as _ops  # noqa: E402
+from .autograd import grad, PyLayer  # noqa: E402,F401
+from .ops.logic import is_tensor  # noqa: E402,F401
+
+__version__ = "0.1.0"
+
+# ---------------------------------------------------------------------------
+# lazy subpackages (keeps import light and cycle-free)
+# ---------------------------------------------------------------------------
+_LAZY_SUBMODULES = (
+    "nn", "optimizer", "autograd", "amp", "jit", "io", "distributed", "vision",
+    "static", "device", "profiler", "metric", "hapi", "incubate", "utils", "text",
+    "sparse", "linalg", "fft", "signal", "distribution", "audio", "geometric",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# framework io (paddle.save / paddle.load)
+# ---------------------------------------------------------------------------
+
+def save(obj, path, protocol=4):
+    from .framework_io import save as _save
+    return _save(obj, path, protocol)
+
+
+def load(path, **kwargs):
+    from .framework_io import load as _load
+    return _load(path, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Tensor method surface
+# ---------------------------------------------------------------------------
+
+def _to_t(v):
+    return v if isinstance(v, Tensor) else _ops.to_tensor(v)
+
+
+def _bind(name, fn):
+    setattr(Tensor, name, fn)
+
+
+def _method(op_fn):
+    def m(self, *args, **kwargs):
+        return op_fn(self, *args, **kwargs)
+    return m
+
+
+def _inplace(op_fn):
+    def m(self, *args, **kwargs):
+        out = op_fn(self, *args, **kwargs)
+        self._value = out._value
+        self._node = out._node
+        self._out_index = out._out_index
+        if not out.stop_gradient:
+            self.stop_gradient = False
+        return self
+    return m
+
+
+_METHOD_NAMES = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+    "pow", "maximum", "minimum", "fmax", "fmin", "abs", "neg", "sign", "floor",
+    "ceil", "round", "trunc", "frac", "exp", "expm1", "log", "log2", "log10",
+    "log1p", "sqrt", "rsqrt", "sin", "cos", "tan", "asin", "acos", "atan", "sinh",
+    "cosh", "tanh", "asinh", "acosh", "atanh", "reciprocal", "square", "erf",
+    "erfinv", "lgamma", "digamma", "angle", "conj", "rad2deg", "deg2rad", "lerp",
+    "clip", "scale", "stanh", "atan2", "heaviside", "hypot", "isnan", "isinf",
+    "isfinite", "nan_to_num", "sigmoid", "logaddexp",
+    # reductions
+    "sum", "mean", "prod", "max", "min", "amax", "amin", "std", "var", "median",
+    "nanmedian", "nansum", "nanmean", "quantile", "logsumexp", "all", "any",
+    "count_nonzero", "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp",
+    # linalg
+    "matmul", "mm", "bmm", "mv", "dot", "norm", "dist", "cross", "cholesky",
+    "inverse", "det", "t", "trace", "diagonal",
+    # manipulation
+    "reshape", "flatten", "squeeze", "unsqueeze", "transpose", "moveaxis",
+    "swapaxes", "split", "chunk", "unbind", "tile", "expand", "expand_as",
+    "broadcast_to", "flip", "rot90", "roll", "repeat_interleave", "gather",
+    "gather_nd", "take_along_axis", "put_along_axis", "index_select",
+    "index_sample", "index_add", "masked_select", "masked_fill", "scatter",
+    "scatter_nd_add", "cast", "astype", "tensor_split", "as_strided",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+    "searchsorted", "bucketize", "unique", "unique_consecutive", "bincount",
+    "tril", "triu", "where", "nonzero",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "isclose",
+    "allclose", "equal_all",
+]
+
+for _name in _METHOD_NAMES:
+    if hasattr(_ops, _name):
+        _bind(_name, _method(getattr(_ops, _name)))
+
+_INPLACE_NAMES = [
+    "add", "subtract", "multiply", "divide", "clip", "scale", "floor", "ceil",
+    "round", "exp", "sqrt", "rsqrt", "reciprocal", "tanh", "sigmoid", "abs",
+    "remainder", "pow", "cast", "squeeze", "unsqueeze", "reshape", "flatten",
+    "tril", "triu", "masked_fill", "scatter", "index_add", "index_put", "lerp",
+    "put_along_axis",
+]
+for _name in _INPLACE_NAMES:
+    if hasattr(_ops, _name):
+        _bind(_name + "_", _inplace(getattr(_ops, _name)))
+
+
+def _fill_(self, value):
+    import jax.numpy as jnp
+    self._value = jnp.full_like(self._value, value)
+    return self
+
+
+def _zero_(self):
+    return _fill_(self, 0)
+
+
+def _uniform_(self, min=-1.0, max=1.0):
+    import jax.numpy as jnp
+    from .core import random as _random
+    self._value = _jax.random.uniform(_random.next_key(), self._value.shape,
+                                      dtype=self._value.dtype, minval=min, maxval=max)
+    return self
+
+
+def _normal_(self, mean=0.0, std=1.0):
+    from .core import random as _random
+    self._value = (mean + std * _jax.random.normal(
+        _random.next_key(), self._value.shape, dtype=self._value.dtype))
+    return self
+
+
+_bind("fill_", _fill_)
+_bind("zero_", _zero_)
+_bind("uniform_", _uniform_)
+_bind("normal_", _normal_)
+
+
+# operators -----------------------------------------------------------------
+def _binop(fn, swap=False):
+    def m(self, other):
+        if swap:
+            return fn(_to_t(other), self)
+        return fn(self, other)
+    return m
+
+
+_bind("__add__", _binop(_ops.add))
+_bind("__radd__", _binop(_ops.add, swap=True))
+_bind("__sub__", _binop(_ops.subtract))
+_bind("__rsub__", _binop(_ops.subtract, swap=True))
+_bind("__mul__", _binop(_ops.multiply))
+_bind("__rmul__", _binop(_ops.multiply, swap=True))
+_bind("__truediv__", _binop(_ops.divide))
+_bind("__rtruediv__", _binop(_ops.divide, swap=True))
+_bind("__floordiv__", _binop(_ops.floor_divide))
+_bind("__rfloordiv__", _binop(_ops.floor_divide, swap=True))
+_bind("__mod__", _binop(_ops.remainder))
+_bind("__rmod__", _binop(_ops.remainder, swap=True))
+_bind("__pow__", _binop(_ops.pow))
+_bind("__rpow__", _binop(_ops.pow, swap=True))
+_bind("__matmul__", _binop(_ops.matmul))
+_bind("__rmatmul__", _binop(_ops.matmul, swap=True))
+_bind("__neg__", lambda self: _ops.neg(self))
+_bind("__abs__", lambda self: _ops.abs(self))
+_bind("__invert__", lambda self: _ops.logical_not(self)
+      if self.dtype == _np.dtype(_np.bool_) else _ops.bitwise_not(self))
+_bind("__eq__", _binop(_ops.equal))
+_bind("__ne__", _binop(_ops.not_equal))
+_bind("__lt__", _binop(_ops.less_than))
+_bind("__le__", _binop(_ops.less_equal))
+_bind("__gt__", _binop(_ops.greater_than))
+_bind("__ge__", _binop(_ops.greater_equal))
+
+
+def _and(self, other):
+    if self.dtype == _np.dtype(_np.bool_):
+        return _ops.logical_and(self, other)
+    return _ops.bitwise_and(self, other)
+
+
+def _or(self, other):
+    if self.dtype == _np.dtype(_np.bool_):
+        return _ops.logical_or(self, other)
+    return _ops.bitwise_or(self, other)
+
+
+def _xor(self, other):
+    if self.dtype == _np.dtype(_np.bool_):
+        return _ops.logical_xor(self, other)
+    return _ops.bitwise_xor(self, other)
+
+
+_bind("__and__", _and)
+_bind("__or__", _or)
+_bind("__xor__", _xor)
+Tensor.__hash__ = lambda self: id(self)
+
+
+def _norm_index(idx):
+    """lists → arrays (fancy indexing); keep slices/Ellipsis/None/ints as-is."""
+    import jax.numpy as jnp
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(e) for e in idx)
+    return idx
+
+
+def _getitem(self, idx):
+    idx = _norm_index(idx)
+    return dispatch(lambda v, i: v[i], (self, idx), {}, name="getitem")
+
+
+def _setitem(self, idx, value):
+    import jax.numpy as jnp
+    idx = _norm_index(idx)
+
+    def fn(v, i, val):
+        val = jnp.asarray(val)
+        return v.at[i].set(val.astype(v.dtype))
+    out = dispatch(fn, (self, idx, value), {}, name="setitem")
+    self._value = out._value
+    self._node = out._node
+    self._out_index = out._out_index
+    if not out.stop_gradient:
+        self.stop_gradient = False
+
+
+_bind("__getitem__", _getitem)
+_bind("__setitem__", _setitem)
+
+
+def _tensor_backward(self, grad_tensor=None, retain_graph=False):
+    from .autograd.backward import run_backward
+    run_backward([self], [grad_tensor] if grad_tensor is not None else None,
+                 retain_graph)
+
+
+_bind("backward", _tensor_backward)
+
+
+def _tensor_to(self, *args, **kwargs):
+    """.to(dtype) / .to(place) / .to('tpu')"""
+    out = self
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, (str, _np.dtype)) and (
+                isinstance(a, _np.dtype) or a in _dtype_mod._NAME_TO_DTYPE):
+            out = _ops.cast(out, a)
+        elif isinstance(a, type) or hasattr(a, "kind"):
+            pass  # place moves are no-ops under a single default device
+    return out
+
+
+_bind("to", _tensor_to)
+_bind("cpu", lambda self: self)
+_bind("cuda", lambda self, *a, **k: self)
+_bind("tpu", lambda self, *a, **k: self)
+_bind("pin_memory", lambda self: self)

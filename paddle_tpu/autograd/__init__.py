@@ -1,0 +1,149 @@
+"""Autograd API — paddle.autograd analog.
+
+backward / grad ride the tape engine (backward.py); PyLayer lets users define custom
+forward/backward pairs (reference: python/paddle/autograd/py_layer.py); the functional
+jacobian/hessian ride jax.jacfwd/jacrev directly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import (
+    Tensor, Node, dispatch, no_grad, enable_grad, is_grad_enabled,
+    set_grad_enabled, functional_mode,
+)
+from .backward import run_backward, grad
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom op with user-defined backward.
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle_tpu.exp(x)
+            ctx.save_for_backward(y)
+            return y
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor()
+            return dy * y
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(out, (list, tuple))
+        outs = [out] if single else list(out)
+        tensor_outs = [o for o in outs if isinstance(o, Tensor)]
+
+        diff_inputs = [a for a in jax.tree_util.tree_leaves(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+            if isinstance(a, Tensor) and not a.stop_gradient]
+
+        if not is_grad_enabled() or not diff_inputs:
+            return out
+
+        out_avals = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
+                     for o in tensor_outs]
+        import jax.tree_util as jtu
+        _, out_treedef = jtu.tree_flatten([0] * len(tensor_outs))
+
+        def vjp_fn(out_cts):
+            with no_grad():
+                ct_tensors = [Tensor(c) for c in out_cts]
+                res = cls.backward(ctx, *ct_tensors)
+                if not isinstance(res, (list, tuple)):
+                    res = (res,)
+                if len(res) != len(diff_inputs):
+                    raise RuntimeError(
+                        f"PyLayer.backward returned {len(res)} grads for "
+                        f"{len(diff_inputs)} differentiable inputs")
+                vals = []
+                for r, inp in zip(res, diff_inputs):
+                    if r is None:
+                        vals.append(jnp.zeros(tuple(inp.shape), inp._value.dtype))
+                    else:
+                        vals.append(r._value if isinstance(r, Tensor) else jnp.asarray(r))
+                return tuple(vals)
+
+        node = Node(vjp_fn, diff_inputs, out_treedef, out_avals, cls.__name__)
+        import weakref
+        for i, o in enumerate(tensor_outs):
+            o.stop_gradient = False
+            o._node = node
+            o._out_index = i
+            node.outputs.append(weakref.ref(o))
+        return out
+
+
+def jacobian(func, xs, create_graph=False):
+    """Functional jacobian via jax.jacrev on the value level."""
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    vals = [x._value for x in xs_list]
+
+    def fn(*vs):
+        with functional_mode():
+            ts = [Tensor(v, stop_gradient=False) for v in vs]
+            out = func(*ts) if len(ts) > 1 else func(ts[0])
+            return out._value if isinstance(out, Tensor) else out
+
+    jac = jax.jacrev(fn, argnums=tuple(range(len(vals))))(*vals)
+    if isinstance(xs, (list, tuple)):
+        return jax.tree_util.tree_map(Tensor, jac)
+    return Tensor(jac[0]) if isinstance(jac, tuple) else Tensor(jac)
+
+
+def hessian(func, xs, create_graph=False):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    vals = [x._value for x in xs_list]
+
+    def fn(*vs):
+        with functional_mode():
+            ts = [Tensor(v, stop_gradient=False) for v in vs]
+            out = func(*ts) if len(ts) > 1 else func(ts[0])
+            return (out._value if isinstance(out, Tensor) else out).sum()
+
+    hes = jax.hessian(fn, argnums=tuple(range(len(vals))))(*vals)
+    if isinstance(xs, (list, tuple)):
+        return jax.tree_util.tree_map(Tensor, hes)
+    h = hes[0][0] if isinstance(hes, tuple) else hes
+    return Tensor(h)
+
+
+__all__ = [
+    "backward", "grad", "PyLayer", "PyLayerContext", "no_grad", "enable_grad",
+    "is_grad_enabled", "set_grad_enabled", "jacobian", "hessian",
+]

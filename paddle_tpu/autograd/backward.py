@@ -1,0 +1,244 @@
+"""Reverse-mode tape execution.
+
+Reference analog: ``egr::RunBackward`` (paddle/fluid/eager/backward.cc:106) — build an
+in-degree map over the GradNode DAG, then queue-driven topological execution with
+GradTensorHolder accumulation; ``general_grad.h`` drives the partial-graph
+``paddle.grad()`` variant. Here a "grad node" is a ``jax.vjp`` closure recorded at
+forward time (core/tensor.py), so executing a node is one call.
+
+``create_graph=True`` routes the vjp calls and cotangent adds back through
+:func:`~paddle_tpu.core.tensor.dispatch`, so the backward pass itself is recorded on the
+tape — that is how double grad works (the analog of the reference's generated
+double-grad ops).
+"""
+from __future__ import annotations
+
+from collections import defaultdict, deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Node, Tensor, dispatch, no_grad
+
+
+def _zero_ct(aval):
+    if jnp.issubdtype(aval.dtype, jnp.inexact):
+        return jnp.zeros(aval.shape, aval.dtype)
+    return np.zeros(aval.shape, jax.dtypes.float0)
+
+
+def _is_float0(x):
+    return isinstance(x, np.ndarray) and x.dtype == jax.dtypes.float0
+
+
+class _Engine:
+    def __init__(self, retain_graph: bool, create_graph: bool, sink):
+        self.retain_graph = retain_graph or create_graph
+        self.create_graph = create_graph
+        self.sink = sink  # sink(tensor, cotangent) — receives raw value or Tensor
+        self.node_cts: dict[int, list] = {}
+        self.pending: dict[int, int] = defaultdict(int)
+        self.nodes: dict[int, Node] = {}
+        self.ready: deque = deque()
+
+    # -- cotangent algebra (raw arrays fast path; Tensors when create_graph) --
+    def _add(self, a, b):
+        if self.create_graph:
+            a = a if isinstance(a, Tensor) else Tensor(a)
+            b = b if isinstance(b, Tensor) else Tensor(b)
+            a.stop_gradient = a.stop_gradient and a._node is None
+            return dispatch(jnp.add, (a, b), {}, name="grad_accumulate")
+        return a + b
+
+    def _call_vjp(self, node: Node, out_ct):
+        if self.create_graph and node.fwd_fn is not None:
+            # Re-derive the vjp with the original inputs as live tape tensors, so the
+            # backward computation itself is differentiable (double grad). This is the
+            # analog of the reference's generated double-grad ops referencing forward
+            # inputs through the autograd graph rather than through saved residuals.
+            out_ct = jax.tree_util.tree_map(
+                lambda c: c if isinstance(c, Tensor) or _is_float0(c)
+                else Tensor(c, stop_gradient=False),
+                out_ct, is_leaf=lambda x: isinstance(x, Tensor) or _is_float0(x))
+
+            def grad_fn(inputs, ct):
+                _, vjp = jax.vjp(node.fwd_fn, *inputs)
+                return vjp(ct)
+
+            return dispatch(grad_fn, (tuple(node.parents), out_ct), {},
+                            name=f"{node.name}_grad")
+        if self.create_graph:
+            def run(ct):
+                return node.vjp_fn(ct)
+            out_ct2 = jax.tree_util.tree_map(
+                lambda c: c if isinstance(c, Tensor) or _is_float0(c)
+                else Tensor(c, stop_gradient=False),
+                out_ct, is_leaf=lambda x: isinstance(x, Tensor) or _is_float0(x))
+            return dispatch(run, (out_ct2,), {}, name=f"{node.name}_grad")
+        return node.vjp_fn(out_ct)
+
+    def seed(self, node: Node, idx: int, ct):
+        nid = id(node)
+        self.nodes[nid] = node
+        cts = self.node_cts.setdefault(nid, [None] * len(node.out_avals))
+        cts[idx] = ct if cts[idx] is None else self._add(cts[idx], ct)
+
+    def count_edges(self):
+        seen = set()
+        stack = [self.nodes[nid] for nid in self.node_cts]
+        reach = []
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            reach.append(n)
+            for p in n.parents:
+                if p._node is not None:
+                    self.pending[id(p._node)] += 1
+                    stack.append(p._node)
+        for n in reach:
+            self.nodes[id(n)] = n
+        self.ready = deque(
+            n for n in reach if self.pending[id(n)] == 0 and id(n) in self.node_cts)
+
+    def run(self):
+        processed = set()
+        while self.ready:
+            node = self.ready.popleft()
+            if id(node) in processed:
+                continue
+            processed.add(id(node))
+            cts = self.node_cts.pop(id(node), None)
+            if cts is None:
+                continue
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    "trying to run backward through the graph a second time; "
+                    "use backward(retain_graph=True)")
+            full = [c if c is not None else _zero_ct(a)
+                    for c, a in zip(cts, node.out_avals)]
+            out_ct = jax.tree_util.tree_unflatten(node.out_treedef, full)
+            in_cts = self._call_vjp(node, out_ct)
+            if not self.retain_graph:
+                node.vjp_fn = None
+            for ref, aval, c in zip(node.outputs, node.out_avals, full):
+                t = ref() if ref is not None else None
+                if (t is not None and t._retain_grads
+                        and jnp.issubdtype(aval.dtype, jnp.inexact)):
+                    self.sink(t, c)
+            for parent, ct in zip(node.parents, in_cts):
+                if _is_float0(ct):
+                    continue
+                for hook in parent._hooks:
+                    res = hook(ct if isinstance(ct, Tensor) else Tensor(ct))
+                    if res is not None:
+                        ct = res
+                if parent._node is None:
+                    self.sink(parent, ct)
+                else:
+                    self.seed(parent._node, parent._out_index, ct)
+                    self.pending[id(parent._node)] -= 1
+                    if self.pending[id(parent._node)] == 0:
+                        self.ready.append(parent._node)
+
+
+def _as_value(ct):
+    return ct._value if isinstance(ct, Tensor) else ct
+
+
+def _accumulate_grad(t: Tensor, ct):
+    ct = _as_value(ct)
+    if t.grad is None:
+        t.grad = Tensor(ct)
+    else:
+        t.grad._value = t.grad._value + ct
+
+
+def _seed_roots(engine: _Engine, tensors, grad_tensors):
+    for t, g in zip(tensors, grad_tensors or [None] * len(tensors)):
+        if not isinstance(t, Tensor):
+            raise TypeError(f"backward root must be Tensor, got {type(t)}")
+        if t.stop_gradient:
+            raise RuntimeError("backward() on a tensor with stop_gradient=True")
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            ct = jnp.ones(t._value.shape, t._value.dtype)
+        else:
+            ct = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._node is None:
+            engine.sink(t, ct)
+        else:
+            engine.seed(t._node, t._out_index, ct)
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph: bool = False):
+    """``Tensor.backward()`` entry: accumulate ``.grad`` on leaf tensors."""
+    engine = _Engine(retain_graph, False, _accumulate_grad)
+    with no_grad():
+        _seed_roots(engine, tensors, grad_tensors)
+        engine.count_edges()
+        engine.run()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph: bool = False, only_inputs: bool = True,
+         allow_unused: bool = False):
+    """paddle.grad — gradients of ``outputs`` w.r.t. ``inputs`` without touching .grad."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    captured: dict[int, object] = {}
+    want = {id(t): t for t in inputs}
+    # Seed capture through distinct proxy leaves: mark inputs so the engine sink
+    # collects their cotangents. Non-leaf inputs are captured via retain_grads plumbing.
+    saved_retain = [(t, t._retain_grads) for t in inputs]
+    for t in inputs:
+        t._retain_grads = True
+
+    def sink(t, ct):
+        if id(t) in want:
+            prev = captured.get(id(t))
+            if prev is None:
+                captured[id(t)] = ct
+            else:
+                captured[id(t)] = engine._add(prev, ct)
+        # deliberately do NOT touch .grad
+
+    engine = _Engine(bool(retain_graph), create_graph, sink)
+    try:
+        if create_graph:
+            _seed_roots(engine, outputs, grad_outputs)
+            engine.count_edges()
+            engine.run()
+        else:
+            with no_grad():
+                _seed_roots(engine, outputs, grad_outputs)
+                engine.count_edges()
+                engine.run()
+    finally:
+        for t, r in saved_retain:
+            t._retain_grads = r
+
+    results = []
+    for t in inputs:
+        ct = captured.get(id(t))
+        if ct is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the inputs was not used in the graph; "
+                    "set allow_unused=True to return None for it")
+            results.append(None)
+        elif isinstance(ct, Tensor):
+            results.append(ct)
+        else:
+            results.append(Tensor(ct))
+    return results

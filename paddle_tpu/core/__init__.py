@@ -1,0 +1,1 @@
+from . import dtype, device, flags, random, tensor  # noqa: F401

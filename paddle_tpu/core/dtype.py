@@ -1,0 +1,111 @@
+"""Dtype model.
+
+Paddle exposes a fixed dtype vocabulary (reference: paddle/phi/common/data_type.h,
+python/paddle/framework/dtype.py). Here dtypes ARE numpy/ml_dtypes dtypes — the same
+objects jax.numpy uses — so there is zero conversion cost at dispatch time. We keep
+paddle's names and a string registry for `astype("float32")`-style calls.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects (np.dtype instances).
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # paddle aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+_DEFAULT_DTYPE = float32
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any user-provided dtype spec (str, np dtype, python type) to np.dtype."""
+    if dtype is None:
+        raise ValueError("dtype must not be None")
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"unsupported dtype string: {dtype!r}") from None
+    if dtype is bool:
+        return bool_
+    if dtype is int:
+        return int64
+    if dtype is float:
+        return _DEFAULT_DTYPE
+    if dtype is complex:
+        return complex64
+    return np.dtype(dtype)
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype — default float dtype for python-float tensor creation."""
+    global _DEFAULT_DTYPE
+    d = convert_dtype(d)
+    if not np.issubdtype(d, np.floating) and d != bfloat16:
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _DEFAULT_DTYPE = d
+
+
+def get_default_dtype() -> np.dtype:
+    return _DEFAULT_DTYPE
+
+
+def is_floating_point_dtype(d) -> bool:
+    d = np.dtype(d)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer_dtype(d) -> bool:
+    d = np.dtype(d)
+    return jnp.issubdtype(d, jnp.integer) or d == bool_
+
+
+def is_complex_dtype(d) -> bool:
+    return jnp.issubdtype(np.dtype(d), jnp.complexfloating)
+
+
+def is_inexact_dtype(d) -> bool:
+    return jnp.issubdtype(np.dtype(d), jnp.inexact)
+
+
+def finfo(d):
+    return jnp.finfo(convert_dtype(d))
+
+
+def iinfo(d):
+    return jnp.iinfo(convert_dtype(d))

@@ -1,0 +1,99 @@
+"""Global flag system.
+
+The reference defines ~185 env-overridable global flags via PHI_DEFINE_EXPORTED_*
+(reference: paddle/common/flags.cc, flags.h:242) surfaced in python as
+paddle.set_flags/get_flags (python/paddle/base/framework.py:132/:157).
+
+Here flags are a plain process-global registry. Each flag has a type, default, and
+doc; the environment variable ``FLAGS_<name>`` overrides the default at first read.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable
+
+_lock = threading.Lock()
+_REGISTRY: dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "type", "default", "doc", "_value", "_resolved", "on_change")
+
+    def __init__(self, name, type_, default, doc, on_change=None):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+        self._value = default
+        self._resolved = False
+        self.on_change = on_change
+
+    def _parse(self, s: str):
+        if self.type is bool:
+            return s.lower() in ("1", "true", "yes", "on")
+        return self.type(s)
+
+    def get(self):
+        if not self._resolved:
+            with _lock:
+                if not self._resolved:
+                    env = os.environ.get(f"FLAGS_{self.name}")
+                    if env is not None:
+                        self._value = self._parse(env)
+                    self._resolved = True
+        return self._value
+
+    def set(self, value):
+        with _lock:
+            self._value = self.type(value) if not isinstance(value, self.type) else value
+            self._resolved = True
+        if self.on_change is not None:
+            self.on_change(self._value)
+
+
+def define_flag(name: str, default: Any, doc: str = "", type_: type | None = None,
+                on_change: Callable | None = None):
+    if type_ is None:
+        type_ = type(default)
+    flag = _Flag(name, type_, default, doc, on_change)
+    _REGISTRY[name] = flag
+    return flag
+
+
+def get_flags(flags):
+    """paddle.get_flags — accepts a name or list of names, returns {name: value}."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {name!r}")
+        out[name] = _REGISTRY[key].get()
+    return out
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags — {name: value} (names may carry the FLAGS_ prefix)."""
+    for name, value in flags.items():
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {name!r}")
+        _REGISTRY[key].set(value)
+
+
+def flag_value(name: str):
+    return _REGISTRY[name].get()
+
+
+# --- core flags (analogs of the reference's most-used ones) ---
+define_flag("check_nan_inf", False, "check every op output for nan/inf (numeric sanitizer)")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; 1: warn; 3: report fp16 overflow too")
+define_flag("benchmark", False, "synchronize after every op dispatch (op-level timing)")
+define_flag("eager_op_jit", True, "route eager op dispatch through a cached jax.jit per op signature")
+define_flag("log_level", 0, "vlog-style verbosity for framework internals")
+define_flag("use_stride_kernel", True, "kept for API parity; views are always zero-copy under XLA")
+define_flag("cudnn_deterministic", False, "kept for API parity; XLA:TPU is deterministic by default")
+define_flag("embedding_deterministic", 0, "kept for API parity")
+define_flag("collective_timeout_s", 600.0, "watchdog timeout for host-side collective ops")

@@ -1,0 +1,166 @@
+"""RNG state model.
+
+The reference keeps a per-device Philox ``phi::Generator`` (paddle/phi/core/generator.h:32)
+plus a tensor-parallel ``RNGStatesTracker`` for deterministic parallel dropout
+(python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py).
+
+TPU-native design: state is a jax PRNG key (threefry), advanced functionally. Eager ops
+draw subkeys from the global Generator; named substates (the RNGStatesTracker analog)
+are derived with ``jax.random.fold_in`` so e.g. the "local_seed" stream used inside a
+model-parallel region differs per mesh coordinate while the "global_seed" stream does not.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Stateful key holder. ``next_key()`` splits off a fresh subkey."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.key(int(seed))
+            self._counter = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._counter += 1
+            return jax.random.fold_in(self._key, self._counter)
+
+    def get_state(self):
+        with self._lock:
+            return (self._seed, self._counter)
+
+    def set_state(self, state):
+        seed, counter = state
+        with self._lock:
+            self._seed = int(seed)
+            self._key = jax.random.key(int(seed))
+            self._counter = int(counter)
+
+
+default_generator = Generator(0)
+
+# --- traced-key plumbing -----------------------------------------------------
+# Inside a jit-traced train step, drawing from the stateful Generator would bake a
+# constant key into the compiled program. A KeyProvider scope makes `next_key()`
+# derive keys from a *traced* base key instead (fold_in with a per-trace counter),
+# so randomness varies with the step key input. The jit/to_static layer installs one.
+
+import contextlib
+
+_key_providers: list = []
+
+
+class _KeyProvider:
+    __slots__ = ("key", "counter")
+
+    def __init__(self, key):
+        self.key = key
+        self.counter = 0
+
+
+@contextlib.contextmanager
+def provide_key(key):
+    _key_providers.append(_KeyProvider(key))
+    try:
+        yield
+    finally:
+        _key_providers.pop()
+
+
+def seed(value: int):
+    """paddle.seed — reseed the global generator (and all named trackers)."""
+    default_generator.manual_seed(value)
+    _tracker.reset_from(value)
+    return default_generator
+
+
+def next_key():
+    if _key_providers:
+        p = _key_providers[-1]
+        p.counter += 1
+        return jax.random.fold_in(p.key, p.counter)
+    return default_generator.next_key()
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)
+
+
+class RNGStatesTracker:
+    """Named RNG streams (reference: fleet/meta_parallel/parallel_layers/random.py).
+
+    Tensor-parallel dropout must be identical across TP ranks for replicated
+    activations ("global_seed") but different per rank for partitioned activations
+    ("local_seed"). Streams are independent Generators derived from a base seed.
+    """
+
+    def __init__(self):
+        self._states: dict[str, Generator] = {}
+        self._base = 0
+
+    def reset_from(self, base_seed: int):
+        self._base = int(base_seed)
+        for i, name in enumerate(sorted(self._states)):
+            self._states[name].manual_seed(self._mix(name))
+
+    def _mix(self, name: str) -> int:
+        h = np.uint64(14695981039346656037)
+        for b in name.encode():
+            h = (h ^ np.uint64(b)) * np.uint64(1099511628211)
+        return int((np.uint64(self._base) ^ h) % np.uint64(2**63))
+
+    def add(self, name: str, seed: int | None = None):
+        if name in self._states:
+            raise ValueError(f"rng state {name!r} already exists")
+        self._states[name] = Generator(self._mix(name) if seed is None else seed)
+
+    def states(self):
+        return dict(self._states)
+
+    class _Scope:
+        def __init__(self, tracker, name):
+            self._tracker, self._name = tracker, name
+
+        def __enter__(self):
+            self._saved = default_generator
+            _swap_default(self._tracker._states[self._name])
+            return self
+
+        def __exit__(self, *exc):
+            _swap_default(self._saved)
+            return False
+
+    def rng_state(self, name: str = "global_seed"):
+        if name not in self._states:
+            self.add(name)
+        return RNGStatesTracker._Scope(self, name)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def _swap_default(gen: Generator):
+    global default_generator
+    default_generator = gen

@@ -1,0 +1,433 @@
+"""Eager Tensor + tape autograd.
+
+Reference analog: the dygraph stack — AutogradMeta (paddle/fluid/eager/autograd_meta.h:61),
+GradNodeBase (grad_node_info.h:197), TensorWrapper (tensor_wrapper.h:39), and the
+generated per-op ad_func (eager_gen.py:372) that records grad nodes at forward time.
+
+TPU-native design: every eager op goes through :func:`dispatch`. Forward compute is a
+pure jax function; when gradients are required we call ``jax.vjp`` at forward time, so
+the returned closure *is* the grad node — it owns the residuals (the TensorWrapper
+analog) and jax derives the backward rule (no hand-written GradNode per op). The tape is
+the DAG of ``Node`` objects linked through their input tensors; ``.backward()`` executes
+it in reverse topological order (autograd/backward.py).
+
+Inside ``jit``-traced (functional) code the same ops run tape-free on tracers, so one op
+library serves both the eager and the compiled path — the analog of the reference's
+single YAML op set feeding both eager and PIR engines.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .device import Place, get_place
+from .flags import flag_value
+
+
+# ---------------------------------------------------------------------------
+# grad / functional mode state
+# ---------------------------------------------------------------------------
+
+class _ModeState(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.functional = 0  # >0 while tracing inside jit (tape disabled)
+
+
+_mode = _ModeState()
+
+
+def is_grad_enabled() -> bool:
+    return _mode.grad_enabled and _mode.functional == 0
+
+
+def set_grad_enabled(value: bool):
+    _mode.grad_enabled = bool(value)
+
+
+class _GradModeCtx:
+    def __init__(self, target: bool):
+        self._target = target
+
+    def __enter__(self):
+        self._saved = _mode.grad_enabled
+        _mode.grad_enabled = self._target
+        return self
+
+    def __exit__(self, *exc):
+        _mode.grad_enabled = self._saved
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with type(self)(self._target) if False else _GradModeCtx(self._target):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def no_grad():
+    return _GradModeCtx(False)
+
+
+def enable_grad():
+    return _GradModeCtx(True)
+
+
+class functional_mode:
+    """Disables the tape while a jax transform traces through our ops."""
+
+    def __enter__(self):
+        _mode.functional += 1
+        return self
+
+    def __exit__(self, *exc):
+        _mode.functional -= 1
+        return False
+
+
+def in_functional_mode() -> bool:
+    return _mode.functional > 0
+
+
+# ---------------------------------------------------------------------------
+# tape node
+# ---------------------------------------------------------------------------
+
+class Node:
+    """One recorded op. ``vjp_fn`` maps output cotangents -> input cotangents."""
+
+    __slots__ = (
+        "vjp_fn", "parents", "out_treedef", "out_avals", "outputs", "name", "fwd_fn",
+        "__weakref__",
+    )
+
+    def __init__(self, vjp_fn, parents, out_treedef, out_avals, name, fwd_fn=None):
+        self.vjp_fn = vjp_fn
+        self.parents = parents          # list[Tensor] — differentiable inputs, vjp order
+        self.out_treedef = out_treedef  # treedef of the op's full output pytree
+        self.out_avals = out_avals      # ShapeDtypeStruct per output leaf
+        self.outputs = []               # list[weakref to output Tensors | None] per leaf
+        self.name = name
+        # pure fn of the diff input *values* — used by create_graph (double grad) to
+        # re-derive a vjp whose inputs are live tape tensors rather than baked residuals
+        self.fwd_fn = fwd_fn
+
+    def __repr__(self):
+        return f"<Node {self.name} n_in={len(self.parents)} n_out={len(self.out_avals)}>"
+
+
+# ---------------------------------------------------------------------------
+# Tensor
+# ---------------------------------------------------------------------------
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+class Tensor:
+    """Eager tensor facade over ``jax.Array``.
+
+    Reference analog: paddle::Tensor (paddle/phi/api/include/tensor.h:82) +
+    AutogradMeta. ``stop_gradient`` defaults True like paddle's non-parameter tensors.
+    """
+
+    __slots__ = (
+        "_value", "stop_gradient", "grad", "name", "_node", "_out_index",
+        "_retain_grads", "_hooks", "persistable", "is_leaf_override", "__weakref__",
+        "_dist_meta",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: str | None = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self.name = name
+        self._node = None
+        self._out_index = 0
+        self._retain_grads = False
+        self._hooks = []
+        self.persistable = False
+        self.is_leaf_override = None
+        self._dist_meta = None  # set by paddle_tpu.distributed for DistTensor semantics
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self) -> list:
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    ndimension = ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self) -> Place:
+        try:
+            dev = next(iter(self._value.devices()))
+            kind = "tpu" if dev.platform in ("tpu", "axon") else dev.platform
+            return Place(kind, dev.id)
+        except Exception:
+            return get_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        if self.is_leaf_override is not None:
+            return self.is_leaf_override
+        return self.stop_gradient or self._node is None
+
+    def numel(self) -> int:
+        return self.size
+
+    def element_size(self) -> int:
+        return self.dtype.itemsize
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from ..autograd.backward import run_backward
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook: Callable):
+        self._hooks.append(hook)
+
+        class _Removable:
+            def remove(_self):
+                if hook in self._hooks:
+                    self._hooks.remove(hook)
+
+        return _Removable()
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        t._dist_meta = self._dist_meta
+        return t
+
+    def clone(self) -> "Tensor":
+        from .. import ops
+        return ops.assign(self)
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # -- in-place value rebinding (optimizer updates, __setitem__) ----------
+    def _replace_value(self, new_value):
+        self._value = new_value
+        return self
+
+    def copy_(self, other, blocking: bool = True):
+        src = other._value if isinstance(other, Tensor) else jnp.asarray(other)
+        self._value = jnp.asarray(src, dtype=self._value.dtype)
+        return self
+
+    def set_value(self, other):
+        return self.copy_(other)
+
+    # -- repr ---------------------------------------------------------------
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data = np.array2string(self.numpy(), precision=6, threshold=64)
+        except Exception:
+            data = "<traced>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"place={self.place}{grad_info},\n       {data})")
+
+    # arithmetic/method surface is attached in paddle_tpu/__init__.py via
+    # _bind_tensor_methods() once the ops library is importable (avoids an
+    # import cycle ops -> tensor -> ops).
+
+
+# Register Tensor as a pytree node so jax transforms can carry it transparently
+# (values only; autograd metadata does not survive a tree round-trip on purpose).
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._value,), (t.stop_gradient, t.name)),
+    lambda aux, children: Tensor(children[0], stop_gradient=aux[0], name=aux[1]),
+)
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _check_numerics(name, leaves):
+    level = flag_value("check_nan_inf_level")
+    for v in leaves:
+        if isinstance(v, jax.Array) and jnp.issubdtype(v.dtype, jnp.inexact):
+            bad = bool(jnp.any(~jnp.isfinite(v)))
+            if bad:
+                msg = f"[check_nan_inf] op {name!r} produced nan/inf in output {v.shape} {v.dtype}"
+                if level >= 1:
+                    import logging
+                    logging.getLogger("paddle_tpu").warning(msg)
+                else:
+                    raise FloatingPointError(msg)
+
+
+def dispatch(fn: Callable, args: tuple, kwargs: dict, name: str | None = None):
+    """Run one op eagerly, recording a tape node when gradients are required.
+
+    ``fn`` must be a pure jax function of the *values* inside any Tensor leaves of
+    (args, kwargs). Non-tensor leaves are closed over (static from autograd's view).
+    """
+    name = name or getattr(fn, "__name__", "op")
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+
+    tensor_pos = [i for i, leaf in enumerate(leaves) if isinstance(leaf, Tensor)]
+    record = (
+        is_grad_enabled()
+        and any(not leaves[i].stop_gradient for i in tensor_pos)
+    )
+
+    if not record:
+        vals = [_unwrap(x) for x in leaves]
+        a, k = jax.tree_util.tree_unflatten(treedef, vals)
+        out = fn(*a, **k)
+        return _wrap_outputs(out, node=None, name=name)
+
+    diff_pos = [i for i in tensor_pos if not leaves[i].stop_gradient]
+    diff_tensors = [leaves[i] for i in diff_pos]
+    base_vals = [_unwrap(x) for x in leaves]
+
+    def closed(*diff_vals):
+        vals = list(base_vals)
+        for p, v in zip(diff_pos, diff_vals):
+            vals[p] = v
+        a, k = jax.tree_util.tree_unflatten(treedef, vals)
+        return fn(*a, **k)
+
+    out, vjp_fn = jax.vjp(closed, *[base_vals[i] for i in diff_pos])
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]
+    node = Node(vjp_fn, diff_tensors, out_treedef, out_avals, name, fwd_fn=closed)
+    return _wrap_outputs(out, node=node, name=name)
+
+
+def _wrap_outputs(out, node: Node | None, name: str):
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    if flag_value("check_nan_inf"):
+        _check_numerics(name, out_leaves)
+    wrapped = []
+    for i, leaf in enumerate(out_leaves):
+        if not isinstance(leaf, (jax.Array, np.ndarray)) and not hasattr(leaf, "dtype"):
+            wrapped.append(leaf)
+            if node is not None:
+                node.outputs.append(None)
+            continue
+        diff_out = node is not None and jnp.issubdtype(leaf.dtype, jnp.inexact)
+        t = Tensor(leaf, stop_gradient=not diff_out)
+        if node is not None:
+            t._node = node
+            t._out_index = i
+            node.outputs.append(weakref.ref(t))
+        wrapped.append(t)
+    result = jax.tree_util.tree_unflatten(out_treedef, wrapped)
+    return result
+
+
+class OpDef:
+    """Registered op: a named pure function invokable on Tensors via dispatch."""
+
+    __slots__ = ("fn", "name", "__wrapped__")
+
+    def __init__(self, fn, name=None):
+        self.fn = fn
+        self.name = name or fn.__name__
+        self.__wrapped__ = fn
+
+    def __call__(self, *args, **kwargs):
+        return dispatch(self.fn, args, kwargs, name=self.name)
+
+    def __repr__(self):
+        return f"<op {self.name}>"
+
+
+_OP_REGISTRY: dict[str, OpDef] = {}
+
+
+def register_op(fn=None, *, name: str | None = None):
+    """Decorator: make a pure jax function an eager-dispatchable op.
+
+    The registry is the analog of the reference KernelFactory
+    (paddle/phi/core/kernel_factory.h:316) — a flat name->callable map; backend
+    selection is XLA's job, not ours.
+    """
+    def deco(f):
+        op = OpDef(f, name)
+        _OP_REGISTRY[op.name] = op
+        return op
+
+    return deco(fn) if fn is not None else deco
+
+
+def get_op(name: str) -> OpDef:
+    return _OP_REGISTRY[name]
+
+
+def all_ops() -> dict:
+    return dict(_OP_REGISTRY)

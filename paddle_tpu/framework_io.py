@@ -1,0 +1,60 @@
+"""paddle.save / paddle.load analog.
+
+Reference: python/paddle/framework/io.py:773/:1020 — pickled state_dicts with tensors
+converted to numpy. Same wire idea here: tensors serialize as (numpy array, dtype name);
+bfloat16/fp8 round-trip through ml_dtypes views.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+
+_SENTINEL = "__paddle_tpu_tensor__"
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._value)
+        return {_SENTINEL: True, "data": arr, "stop_gradient": obj.stop_gradient,
+                "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get(_SENTINEL):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(jnp.asarray(obj["data"]), stop_gradient=obj["stop_gradient"])
+            t.name = obj.get("name")
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **kwargs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,14 @@
+"""Op library — the phi-kernel analog, one flat namespace.
+
+Reference: paddle/phi/kernels (605 public kernels) exposed through
+python/paddle/tensor/*. All ops are pure jax functions dispatched through the eager
+tape (core/tensor.py); under jit they trace into the compiled program.
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+from .creation import assign, to_tensor  # noqa: F401

@@ -1,0 +1,297 @@
+"""Tensor creation ops (paddle.tensor.creation analog).
+
+Reference: python/paddle/tensor/creation.py; kernels in paddle/phi/kernels
+(full_kernel.h, arange, eye, ...). Here every creation lowers to one jnp call; device
+placement is XLA's default-device behavior (Place model in core/device.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, dispatch, register_op
+from ..core import random as _random
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default
+    return dtypes.convert_dtype(dtype)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor — python/paddle/tensor/creation.py:to_tensor analog."""
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(dtypes.convert_dtype(dtype))
+        return Tensor(v, stop_gradient=stop_gradient)
+    if dtype is None:
+        # match paddle: python floats -> default float dtype, ints -> int64
+        if isinstance(data, bool):
+            dtype = dtypes.bool_
+        elif isinstance(data, int):
+            dtype = dtypes.int64
+        elif isinstance(data, float):
+            dtype = dtypes.get_default_dtype()
+        elif isinstance(data, (list, tuple)):
+            arr = np.asarray(data)
+            if arr.dtype == np.float64:
+                dtype = dtypes.get_default_dtype()
+            elif arr.dtype == np.int32 or arr.dtype == np.int64:
+                dtype = dtypes.int64
+        v = jnp.asarray(data, dtype=_dt(dtype))
+    else:
+        v = jnp.asarray(data, dtype=dtypes.convert_dtype(dtype))
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def _shape_tuple(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value if isinstance(s, Tensor) else s) for s in shape)
+
+
+def zeros(shape, dtype=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape_tuple(shape), _dt(dtype, dtypes.get_default_dtype())))
+
+
+def ones(shape, dtype=None) -> Tensor:
+    return Tensor(jnp.ones(_shape_tuple(shape), _dt(dtype, dtypes.get_default_dtype())))
+
+
+def full(shape, fill_value, dtype=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = dtypes.bool_
+        elif isinstance(fill_value, int):
+            dtype = dtypes.int64
+        else:
+            dtype = dtypes.get_default_dtype()
+    return Tensor(jnp.full(_shape_tuple(shape), fill_value, dtypes.convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None) -> Tensor:
+    return Tensor(jnp.zeros_like(x._value if isinstance(x, Tensor) else x, dtype=_dt(dtype)))
+
+
+def ones_like(x, dtype=None) -> Tensor:
+    return Tensor(jnp.ones_like(x._value if isinstance(x, Tensor) else x, dtype=_dt(dtype)))
+
+
+def full_like(x, fill_value, dtype=None) -> Tensor:
+    return Tensor(jnp.full_like(x._value if isinstance(x, Tensor) else x,
+                                fill_value, dtype=_dt(dtype)))
+
+
+def empty_like(x, dtype=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None) -> Tensor:
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _scalar(start), _scalar(end), _scalar(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (dtypes.int64 if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+                 else dtypes.get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None) -> Tensor:
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(_scalar(start), _scalar(stop), int(_scalar(num)),
+                               dtype=_dt(dtype, dtypes.get_default_dtype())))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None) -> Tensor:
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=_dt(dtype, dtypes.get_default_dtype())))
+
+
+def eye(num_rows, num_columns=None, dtype=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype, dtypes.get_default_dtype())))
+
+
+@register_op
+def _tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@register_op
+def _triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril(x, diagonal=0):
+    return _tril(x, diagonal=int(diagonal))
+
+
+def triu(x, diagonal=0):
+    return _triu(x, diagonal=int(diagonal))
+
+
+@register_op
+def _diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+        return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+    return jnp.diag(x, k=offset)
+
+
+def diag(x, offset=0, padding_value=0):
+    return _diag(x, offset=int(offset), padding_value=padding_value)
+
+
+def diagflat(x, offset=0):
+    return dispatch(lambda v: jnp.diagflat(v, k=int(offset)), (x,), {}, name="diagflat")
+
+
+@register_op
+def assign(x):
+    """paddle.assign — copy (identity with new buffer semantics)."""
+    return jnp.copy(x)
+
+
+def clone(x):
+    return assign(x)
+
+
+def meshgrid(*args):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    return dispatch(lambda *vs: jnp.meshgrid(*vs, indexing="ij"), tuple(tensors), {},
+                    name="meshgrid")
+
+
+def numel(x) -> Tensor:
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def clone_detached(x):
+    return x.detach()
+
+
+def one_hot(x, num_classes) -> Tensor:
+    return dispatch(lambda v: jax.nn.one_hot(v, int(num_classes),
+                                             dtype=dtypes.get_default_dtype()),
+                    (x,), {}, name="one_hot")
+
+
+def complex(real, imag):
+    return dispatch(lambda r, i: jax.lax.complex(r, i), (real, imag), {}, name="complex")
+
+
+def polar(abs_t, angle_t):
+    return dispatch(lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)),
+                    (abs_t, angle_t), {}, name="polar")
+
+
+def tril_indices(row, col, offset=0, dtype=None):
+    r, c = np.tril_indices(int(row), int(offset), int(col))
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, dtypes.int64)))
+
+
+def triu_indices(row, col=None, offset=0, dtype=None):
+    col = row if col is None else col
+    r, c = np.triu_indices(int(row), int(offset), int(col))
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, dtypes.int64)))
+
+
+# --- random creation (paddle.tensor.random analog) --------------------------
+
+def rand(shape, dtype=None) -> Tensor:
+    d = _dt(dtype, dtypes.get_default_dtype())
+    return Tensor(jax.random.uniform(_random.next_key(), _shape_tuple(shape), dtype=d))
+
+
+def randn(shape, dtype=None) -> Tensor:
+    d = _dt(dtype, dtypes.get_default_dtype())
+    return Tensor(jax.random.normal(_random.next_key(), _shape_tuple(shape), dtype=d))
+
+
+def standard_normal(shape, dtype=None) -> Tensor:
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        key = _random.next_key()
+        return Tensor(m + s * jax.random.normal(key, shp, dtype=dtypes.get_default_dtype()))
+    shp = _shape_tuple(shape if shape is not None else [1])
+    key = _random.next_key()
+    return Tensor(mean + std * jax.random.normal(key, shp, dtype=dtypes.get_default_dtype()))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0) -> Tensor:
+    d = _dt(dtype, dtypes.get_default_dtype())
+    return Tensor(jax.random.uniform(_random.next_key(), _shape_tuple(shape), dtype=d,
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    d = _dt(dtype, dtypes.int64)
+    return Tensor(jax.random.randint(_random.next_key(), _shape_tuple(shape), low, high,
+                                     dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None) -> Tensor:
+    return randint(low, high, tuple(x.shape), dtype or x.dtype)
+
+
+def randperm(n, dtype=None) -> Tensor:
+    d = _dt(dtype, dtypes.int64)
+    return Tensor(jax.random.permutation(_random.next_key(), int(n)).astype(d))
+
+
+def bernoulli(x) -> Tensor:
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(_random.next_key(), v).astype(v.dtype))
+
+
+def poisson(x) -> Tensor:
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(_random.next_key(), v).astype(v.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False) -> Tensor:
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    key = _random.next_key()
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(*v.shape[:-1], int(num_samples)))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, v.shape, dtype=jnp.float32)
+        _, out = jax.lax.top_k(logits + g, int(num_samples))
+    return Tensor(out.astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    s = jax.random.exponential(_random.next_key(), v.shape, dtype=v.dtype) / lam
+    if isinstance(x, Tensor):
+        x._value = s
+        return x
+    return Tensor(s)

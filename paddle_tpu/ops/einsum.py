@@ -1,0 +1,16 @@
+"""einsum (paddle.einsum analog — reference: python/paddle/tensor/einsum.py).
+
+Lowers directly to jnp.einsum: XLA maps contractions onto the MXU, which supersedes the
+reference's hand-rolled plan builder + matmul decomposition.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import dispatch
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return dispatch(lambda *vs: jnp.einsum(equation, *vs), operands, {}, name="einsum")

@@ -1,0 +1,259 @@
+"""Linear algebra ops (paddle.tensor.linalg + paddle.linalg analog).
+
+Reference: python/paddle/tensor/linalg.py (matmul at :220) → phi kernels → cuBLAS/
+cuSOLVER. TPU-native: matmul lowers straight to the MXU via jnp; decompositions ride
+jax.numpy.linalg/jax.scipy (XLA custom calls or QR-based algorithms on TPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """paddle.matmul (reference: python/paddle/tensor/linalg.py:220)."""
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return dispatch(fn, (x, y), {}, name="matmul")
+
+
+def mm(x, y):
+    return matmul(x, y)
+
+
+def bmm(x, y):
+    return dispatch(jnp.matmul, (x, y), {}, name="bmm")
+
+
+def mv(x, vec):
+    return dispatch(jnp.matmul, (x, vec), {}, name="mv")
+
+
+def dot(x, y):
+    def fn(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return dispatch(fn, (x, y), {}, name="dot")
+
+
+def cross(x, y, axis=9):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=int(ax))
+    return dispatch(fn, (x, y), {}, name="cross")
+
+
+def norm(x, p=None, axis=None, keepdim=False):
+    def fn(v):
+        pp = p
+        if pp is None:
+            pp = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+        if axis is None:
+            flat = v.reshape(-1)
+            if pp == "fro" or pp == 2:
+                return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(flat))))
+            if pp == np.inf or pp == "inf":
+                return jnp.max(jnp.abs(flat))
+            if pp == -np.inf:
+                return jnp.min(jnp.abs(flat))
+            if pp == 0:
+                return jnp.sum(flat != 0).astype(v.dtype)
+            if pp == 1:
+                return jnp.sum(jnp.abs(flat))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), pp)), 1.0 / pp)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else int(axis)
+        if pp == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(v)), axis=ax, keepdims=keepdim))
+        if pp == "nuc":
+            return jnp.linalg.norm(v, ord="nuc", axis=ax, keepdims=keepdim)
+        if pp == np.inf or pp == "inf":
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if pp == -np.inf:
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        if pp == 1:
+            return jnp.sum(jnp.abs(v), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(v), pp), axis=ax, keepdims=keepdim),
+                         1.0 / pp)
+    return dispatch(fn, (x,), {}, name="norm")
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return dispatch(lambda v: jnp.linalg.norm(v, ord=p, axis=tuple(axis),
+                                              keepdims=keepdim), (x,), {},
+                    name="matrix_norm")
+
+
+def dist(x, y, p=2):
+    def fn(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if p == np.inf:
+            return jnp.max(jnp.abs(d))
+        if p == -np.inf:
+            return jnp.min(jnp.abs(d))
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+    return dispatch(fn, (x, y), {}, name="dist")
+
+
+def cholesky(x, upper=False):
+    def fn(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return dispatch(fn, (x,), {}, name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False):
+    def fn(b, L):
+        Lm = jnp.swapaxes(L, -1, -2).conj() if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(Lm, -1, -2).conj(), z,
+                                                 lower=False)
+    return dispatch(fn, (x, y), {}, name="cholesky_solve")
+
+
+def inverse(x):
+    return dispatch(jnp.linalg.inv, (x,), {}, name="inverse")
+
+
+inv = inverse
+
+
+def det(x):
+    return dispatch(jnp.linalg.det, (x,), {}, name="det")
+
+
+def slogdet(x):
+    def fn(v):
+        sign, logabs = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logabs])
+    return dispatch(fn, (x,), {}, name="slogdet")
+
+
+def svd(x, full_matrices=False):
+    def fn(v):
+        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+    return dispatch(fn, (x,), {}, name="svd")
+
+
+def qr(x, mode="reduced"):
+    return dispatch(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), (x,), {}, name="qr")
+
+
+def eig(x):
+    # general eig is CPU-only in XLA; run via numpy (eager-only, like reference CPU fallback)
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    w, vec = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+def eigh(x, UPLO="L"):
+    return dispatch(lambda v: tuple(jnp.linalg.eigh(v, symmetrize_input=True)),
+                    (x,), {}, name="eigh")
+
+
+def eigvals(x):
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(v)))
+
+
+def eigvalsh(x, UPLO="L"):
+    return dispatch(jnp.linalg.eigvalsh, (x,), {}, name="eigvalsh")
+
+
+def matrix_power(x, n):
+    return dispatch(lambda v: jnp.linalg.matrix_power(v, int(n)), (x,), {},
+                    name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return dispatch(lambda v: jnp.linalg.matrix_rank(v, tol=tol), (x,), {},
+                    name="matrix_rank")
+
+
+def solve(x, y):
+    def fn(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+    return dispatch(fn, (x, y), {}, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return dispatch(fn, (x, y), {}, name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return dispatch(fn, (x, y), {}, name="lstsq")
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return dispatch(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian),
+                    (x,), {}, name="pinv")
+
+
+def lu(x, pivot=True):
+    def fn(v):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_mat, (piv + 1).astype(jnp.int32)
+    return dispatch(fn, (x,), {}, name="lu")
+
+
+def cond(x, p=None):
+    return dispatch(lambda v: jnp.linalg.cond(v, p=p), (x,), {}, name="cond")
+
+
+def multi_dot(tensors):
+    return dispatch(lambda *vs: jnp.linalg.multi_dot(vs), tuple(tensors), {},
+                    name="multi_dot")
+
+
+def corrcoef(x, rowvar=True):
+    return dispatch(lambda v: jnp.corrcoef(v, rowvar=rowvar), (x,), {}, name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    def fn(v):
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0)
+    return dispatch(fn, (x,), {}, name="cov")
+
+
+def householder_product(x, tau):
+    def fn(a, t):
+        *batch, m, n = a.shape
+        def one(a2, t2):
+            q = jnp.eye(m, dtype=a2.dtype)
+            for i in range(t2.shape[0]):
+                v = jnp.concatenate([jnp.zeros(i, a2.dtype), jnp.ones(1, a2.dtype),
+                                     a2[i + 1:, i]])
+                q = q - t2[i] * (q @ jnp.outer(v, v))
+            return q[:, :n]
+        if batch:
+            flat_a = a.reshape((-1, m, n))
+            flat_t = t.reshape((-1, t.shape[-1]))
+            outs = jax.vmap(one)(flat_a, flat_t)
+            return outs.reshape(*batch, m, n)
+        return one(a, t)
+    return dispatch(fn, (x, tau), {}, name="householder_product")

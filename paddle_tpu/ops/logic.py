@@ -1,0 +1,65 @@
+"""Comparison / logical / bitwise ops (paddle.tensor.logic analog).
+
+Reference: python/paddle/tensor/logic.py → phi compare/logical/bitwise kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+
+
+def _binary(name, fn):
+    def op(x, y, name_arg=None):
+        return dispatch(fn, (x, y), {}, name=name)
+    op.__name__ = name
+    return op
+
+
+equal = _binary("equal", jnp.equal)
+not_equal = _binary("not_equal", jnp.not_equal)
+greater_than = _binary("greater_than", jnp.greater)
+greater_equal = _binary("greater_equal", jnp.greater_equal)
+less_than = _binary("less_than", jnp.less)
+less_equal = _binary("less_equal", jnp.less_equal)
+logical_and = _binary("logical_and", jnp.logical_and)
+logical_or = _binary("logical_or", jnp.logical_or)
+logical_xor = _binary("logical_xor", jnp.logical_xor)
+bitwise_and = _binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binary("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _binary("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _binary("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x):
+    return dispatch(jnp.logical_not, (x,), {}, name="logical_not")
+
+
+def bitwise_not(x):
+    return dispatch(jnp.bitwise_not, (x,), {}, name="bitwise_not")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return dispatch(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan),
+                    (x, y), {}, name="isclose")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return dispatch(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                              equal_nan=equal_nan),
+                    (x, y), {}, name="allclose")
+
+
+def equal_all(x, y):
+    return dispatch(lambda a, b: jnp.array_equal(a, b), (x, y), {}, name="equal_all")
+
+
+def is_empty(x):
+    v = x._value if isinstance(x, Tensor) else x
+    return Tensor(jnp.asarray(v.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,539 @@
+"""Shape/layout/index manipulation ops (paddle.tensor.manipulation + search analog).
+
+Reference: python/paddle/tensor/manipulation.py, search.py; view kernels in
+paddle/phi/kernels/stride/ (as_strided, slice — zero-copy). Under XLA all reshapes/
+slices are logical ops the compiler folds, so "stride kernels" need no analog.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, dispatch
+
+
+def _ints(x):
+    if isinstance(x, Tensor):
+        x = x.tolist()
+    if isinstance(x, (int, np.integer)):
+        return int(x)
+    return [int(v._value if isinstance(v, Tensor) else v) for v in x]
+
+
+def cast(x, dtype):
+    d = dtypes.convert_dtype(dtype)
+    return dispatch(lambda v: v.astype(d), (x,), {}, name="cast")
+
+
+astype = cast
+
+
+def reshape(x, shape):
+    shape = _ints(shape)
+    return dispatch(lambda v: jnp.reshape(v, shape), (x,), {}, name="reshape")
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    def fn(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, new_shape)
+    return dispatch(fn, (x,), {}, name="flatten")
+
+
+def squeeze(x, axis=None):
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        ax = _ints(axis)
+        ax = [ax] if isinstance(ax, int) else ax
+        ax = tuple(a % v.ndim for a in ax if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=ax) if ax else v
+    return dispatch(fn, (x,), {}, name="squeeze")
+
+
+def unsqueeze(x, axis):
+    ax = _ints(axis)
+    ax = [ax] if isinstance(ax, int) else ax
+    return dispatch(lambda v: jnp.expand_dims(v, tuple(ax)), (x,), {}, name="unsqueeze")
+
+
+def transpose(x, perm):
+    perm = _ints(perm)
+    return dispatch(lambda v: jnp.transpose(v, perm), (x,), {}, name="transpose")
+
+
+def t(x):
+    return dispatch(lambda v: v.T, (x,), {}, name="t")
+
+
+def moveaxis(x, source, destination):
+    return dispatch(lambda v: jnp.moveaxis(v, _ints(source), _ints(destination)),
+                    (x,), {}, name="moveaxis")
+
+
+def swapaxes(x, axis1, axis2):
+    return dispatch(lambda v: jnp.swapaxes(v, int(axis1), int(axis2)), (x,), {},
+                    name="swapaxes")
+
+
+def concat(x, axis=0):
+    tensors = tuple(x)
+    ax = int(axis._value if isinstance(axis, Tensor) else axis)
+    return dispatch(lambda *vs: jnp.concatenate(vs, axis=ax), tensors, {}, name="concat")
+
+
+def stack(x, axis=0):
+    tensors = tuple(x)
+    return dispatch(lambda *vs: jnp.stack(vs, axis=int(axis)), tensors, {}, name="stack")
+
+
+def split(x, num_or_sections, axis=0):
+    ax = int(axis._value if isinstance(axis, Tensor) else axis)
+
+    def fn(v):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=ax))
+        secs = _ints(num_or_sections)
+        total = v.shape[ax]
+        known = builtins_sum(s for s in secs if s != -1)
+        secs = [s if s != -1 else total - known for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(v, idx, axis=ax))
+    return list(dispatch(fn, (x,), {}, name="split"))
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, int(chunks), axis)
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    def fn(v):
+        return tuple(jnp.array_split(v, num_or_indices if isinstance(num_or_indices, int)
+                                     else _ints(num_or_indices), axis=int(axis)))
+    return list(dispatch(fn, (x,), {}, name="tensor_split"))
+
+
+def unbind(x, axis=0):
+    def fn(v):
+        return tuple(jnp.moveaxis(v, int(axis), 0))
+    return list(dispatch(fn, (x,), {}, name="unbind"))
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times):
+    return dispatch(lambda v: jnp.tile(v, tuple(_ints(repeat_times))), (x,), {},
+                    name="tile")
+
+
+def expand(x, shape):
+    shape = _ints(shape)
+
+    def fn(v):
+        tgt = list(shape)
+        # paddle: -1 keeps the original dim
+        off = len(tgt) - v.ndim
+        for i in range(v.ndim):
+            if tgt[off + i] == -1:
+                tgt[off + i] = v.shape[i]
+        return jnp.broadcast_to(v, tuple(tgt))
+    return dispatch(fn, (x,), {}, name="expand")
+
+
+def expand_as(x, y):
+    return dispatch(lambda v, w: jnp.broadcast_to(v, w.shape), (x, y), {},
+                    name="expand_as")
+
+
+def broadcast_to(x, shape):
+    return dispatch(lambda v: jnp.broadcast_to(v, tuple(_ints(shape))), (x,), {},
+                    name="broadcast_to")
+
+
+def broadcast_tensors(inputs):
+    return list(dispatch(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), tuple(inputs), {},
+                         name="broadcast_tensors"))
+
+
+def flip(x, axis):
+    ax = _ints(axis)
+    ax = [ax] if isinstance(ax, int) else ax
+    return dispatch(lambda v: jnp.flip(v, tuple(ax)), (x,), {}, name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return dispatch(lambda v: jnp.rot90(v, k=int(k), axes=tuple(_ints(axes))), (x,), {},
+                    name="rot90")
+
+
+def roll(x, shifts, axis=None):
+    def fn(v):
+        ax = None if axis is None else _ints(axis)
+        return jnp.roll(v, _ints(shifts), axis=tuple(ax) if isinstance(ax, list) else ax)
+    return dispatch(fn, (x,), {}, name="roll")
+
+
+def repeat_interleave(x, repeats, axis=None):
+    def fn(v, r):
+        return jnp.repeat(v, r, axis=None if axis is None else int(axis))
+    return dispatch(fn, (x, repeats), {}, name="repeat_interleave")
+
+
+def pad_nd(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    """Low-level jnp.pad wrapper; paddle.nn.functional.pad builds on this."""
+    def fn(v):
+        return jnp.pad(v, pad, mode=mode, constant_values=value) \
+            if mode == "constant" else jnp.pad(v, pad, mode=mode)
+    return dispatch(fn, (x,), {}, name="pad")
+
+
+# -- indexing -----------------------------------------------------------------
+
+def gather(x, index, axis=0):
+    def fn(v, idx):
+        return jnp.take(v, idx.reshape(-1) if idx.ndim > 1 else idx, axis=int(axis))
+    return dispatch(fn, (x, index), {}, name="gather")
+
+
+def gather_nd(x, index):
+    def fn(v, idx):
+        # idx [..., k] indexes the first k dims of v
+        k = idx.shape[-1]
+        out = v[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+    return dispatch(fn, (x, index), {}, name="gather_nd")
+
+
+def take_along_axis(x, indices, axis, broadcast=True):
+    def fn(v, idx):
+        if broadcast:
+            tgt = list(v.shape)
+            tgt[int(axis)] = idx.shape[int(axis)]
+            idx = jnp.broadcast_to(idx, tuple(tgt))
+        return jnp.take_along_axis(v, idx, axis=int(axis))
+    return dispatch(fn, (x, indices), {}, name="take_along_axis")
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True):
+    def fn(v, idx, val):
+        if broadcast:
+            tgt = list(v.shape)
+            tgt[int(axis)] = idx.shape[int(axis)]
+            idx = jnp.broadcast_to(idx, tuple(tgt))
+        val = jnp.broadcast_to(jnp.asarray(val, v.dtype), idx.shape)
+        dims = list(range(v.ndim))
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+        full_idx = tuple(grids[d] if d != int(axis) % v.ndim else idx for d in dims)
+        at = v.at[full_idx]
+        if reduce == "assign":
+            return at.set(val)
+        if reduce == "add":
+            return at.add(val)
+        if reduce in ("mul", "multiply"):
+            return at.multiply(val)
+        if reduce == "amax":
+            return at.max(val)
+        if reduce == "amin":
+            return at.min(val)
+        raise ValueError(f"unknown reduce {reduce}")
+    return dispatch(fn, (x, indices, values), {}, name="put_along_axis")
+
+
+def index_select(x, index, axis=0):
+    return dispatch(lambda v, i: jnp.take(v, i, axis=int(axis)), (x, index), {},
+                    name="index_select")
+
+
+def index_sample(x, index):
+    def fn(v, idx):
+        rows = jnp.arange(v.shape[0])[:, None]
+        return v[rows, idx]
+    return dispatch(fn, (x, index), {}, name="index_sample")
+
+
+def index_add(x, index, axis, value):
+    def fn(v, i, val):
+        v_m = jnp.moveaxis(v, int(axis), 0)
+        val_m = jnp.moveaxis(val, int(axis), 0)
+        out = v_m.at[i].add(val_m.astype(v.dtype))
+        return jnp.moveaxis(out, 0, int(axis))
+    return dispatch(fn, (x, index, value), {}, name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False):
+    def fn(v, idx_tuple, val):
+        at = v.at[tuple(idx_tuple)]
+        return at.add(val) if accumulate else at.set(val)
+    return dispatch(fn, (x, tuple(indices), value), {}, name="index_put")
+
+
+def masked_select(x, mask):
+    # dynamic-shape output: eager-only (not jittable) — same caveat as reference's
+    # dynamic ops under CINN.
+    v = x._value if isinstance(x, Tensor) else x
+    m = mask._value if isinstance(mask, Tensor) else mask
+    out = np.asarray(v)[np.asarray(m)]
+    return dispatch(lambda _: jnp.asarray(out), (x,), {}, name="masked_select") \
+        if False else Tensor(jnp.asarray(out))
+
+
+def masked_fill(x, mask, value):
+    return dispatch(lambda v, m, val: jnp.where(m, jnp.asarray(val, v.dtype), v),
+                    (x, mask, value), {}, name="masked_fill")
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return dispatch(lambda c, a, b: jnp.where(c, a, b), (condition, x, y), {},
+                    name="where")
+
+
+def nonzero(x, as_tuple=False):
+    v = x._value if isinstance(x, Tensor) else x
+    nz = np.nonzero(np.asarray(v))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None], dtype=jnp.int64)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int64))
+
+
+def scatter(x, index, updates, overwrite=True):
+    def fn(v, i, u):
+        i = i.reshape(-1) if i.ndim > 1 else i
+        if overwrite:
+            return v.at[i].set(u.astype(v.dtype))
+        # paddle: overwrite=False sums duplicates after zeroing target rows
+        zeroed = v.at[i].set(jnp.zeros_like(u, v.dtype))
+        return zeroed.at[i].add(u.astype(v.dtype))
+    return dispatch(fn, (x, index, updates), {}, name="scatter")
+
+
+def scatter_nd_add(x, index, updates):
+    def fn(v, idx, u):
+        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u.astype(v.dtype))
+    return dispatch(fn, (x, index, updates), {}, name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape):
+    def fn(idx, u):
+        z = jnp.zeros(tuple(_ints(shape)), u.dtype)
+        return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+    return dispatch(fn, (index, updates), {}, name="scatter_nd")
+
+
+def slice(x, axes, starts, ends):
+    axes_l, starts_l, ends_l = _ints(axes), _ints(starts), _ints(ends)
+
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e in zip(axes_l, starts_l, ends_l):
+            idx[a] = builtins_slice(s, e)
+        return v[tuple(idx)]
+    return dispatch(fn, (x,), {}, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    axes_l, starts_l, ends_l, strides_l = map(_ints, (axes, starts, ends, strides))
+
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes_l, starts_l, ends_l, strides_l):
+            idx[a] = builtins_slice(s, e, st)
+        return v[tuple(idx)]
+    return dispatch(fn, (x,), {}, name="strided_slice")
+
+
+def as_strided(x, shape, stride, offset=0):
+    def fn(v):
+        flat = v.reshape(-1)
+        idx = jnp.full(tuple(_ints(shape)), int(offset))
+        for d, (s, st) in enumerate(zip(_ints(shape), _ints(stride))):
+            r = jnp.arange(s) * st
+            br = r.reshape([-1 if i == d else 1 for i in range(len(_ints(shape)))])
+            idx = idx + br
+        return flat[idx]
+    return dispatch(fn, (x,), {}, name="as_strided")
+
+
+# -- search / sort ------------------------------------------------------------
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    d = dtypes.convert_dtype(dtype)
+
+    def fn(v):
+        out = jnp.argmax(v, axis=None if axis is None else int(axis), keepdims=keepdim)
+        return out.astype(d)
+    return dispatch(fn, (x,), {}, name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    d = dtypes.convert_dtype(dtype)
+
+    def fn(v):
+        out = jnp.argmin(v, axis=None if axis is None else int(axis), keepdims=keepdim)
+        return out.astype(d)
+    return dispatch(fn, (x,), {}, name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=True):
+    def fn(v):
+        out = jnp.argsort(v, axis=int(axis), stable=stable, descending=descending)
+        return out.astype(jnp.int64)
+    return dispatch(fn, (x,), {}, name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=True):
+    def fn(v):
+        out = jnp.sort(v, axis=int(axis), stable=stable, descending=descending)
+        return out
+    return dispatch(fn, (x,), {}, name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    kk = int(k._value if isinstance(k, Tensor) else k)
+
+    def fn(v):
+        ax = int(axis) % v.ndim
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, kk)
+        else:
+            vals, idx = jax.lax.top_k(-vv, kk)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+    return dispatch(fn, (x,), {}, name="topk")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    def fn(v):
+        ax = int(axis) % v.ndim
+        sv = jnp.sort(v, axis=ax)
+        si = jnp.argsort(v, axis=ax, stable=True)
+        vals = jnp.take(sv, int(k) - 1, axis=ax)
+        idx = jnp.take(si, int(k) - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, ax), jnp.expand_dims(idx, ax)
+        return vals, idx
+    return dispatch(fn, (x,), {}, name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False):
+    def fn(v):
+        ax = int(axis) % v.ndim
+        vm = jnp.moveaxis(v, ax, -1)
+        # O(n^2) pairwise count along the axis — exact and jit-friendly
+        counts = jnp.sum(vm[..., :, None] == vm[..., None, :], axis=-1)
+        # prefer the largest value among equally-frequent candidates (paddle semantics)
+        order = jnp.lexsort((vm, counts))  # ascending by count, then value
+        best = order[..., -1:]
+        vals = jnp.take_along_axis(vm, best, axis=-1)
+        idx = best.astype(jnp.int64)
+        vals = jnp.moveaxis(vals, -1, ax)
+        idx = jnp.moveaxis(idx, -1, ax)
+        if not keepdim:
+            vals, idx = jnp.squeeze(vals, ax), jnp.squeeze(idx, ax)
+        return vals, idx
+    return dispatch(fn, (x,), {}, name="mode")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    def fn(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+                s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return dispatch(fn, (sorted_sequence, values), {}, name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64"):
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    if axis is not None:
+        raise NotImplementedError("unique_consecutive with axis")
+    flat = v.reshape(-1)
+    keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+    out = [Tensor(jnp.asarray(flat[keep]))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, flat.size))
+        out.append(Tensor(jnp.asarray(counts)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    lo, hi = (float(min), float(max)) if (min != 0 or max != 0) else (v.min(), v.max())
+    w = np.asarray(weight._value) if isinstance(weight, Tensor) else weight
+    hist, _ = np.histogram(v, bins=int(bins), range=(lo, hi), weights=w, density=density)
+    return Tensor(jnp.asarray(hist if density or w is not None else hist.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0):
+    def fn(v, w):
+        length = builtins_max(int(minlength), int(np.asarray(v).max()) + 1 if v.size else 0)
+        return jnp.bincount(v, weights=w, length=length)
+    v = x._value if isinstance(x, Tensor) else x
+    w = weights._value if isinstance(weights, Tensor) else weights
+    return Tensor(fn(v, w))
+
+
+def atleast_1d(*xs):
+    outs = [dispatch(jnp.atleast_1d, (x,), {}, name="atleast_1d") for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*xs):
+    outs = [dispatch(jnp.atleast_2d, (x,), {}, name="atleast_2d") for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*xs):
+    outs = [dispatch(jnp.atleast_3d, (x,), {}, name="atleast_3d") for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensordot(x, y, axes=2):
+    return dispatch(lambda a, b: jnp.tensordot(a, b, axes=axes), (x, y), {},
+                    name="tensordot")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(v):
+        shard_size = (int(index_num) + int(nshards) - 1) // int(nshards)
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        in_shard = (v >= lo) & (v < hi)
+        return jnp.where(in_shard, v - lo, ignore_value)
+    return dispatch(fn, (input,), {}, name="shard_index")
+
+
+import builtins
+builtins_slice = builtins.slice
+builtins_sum = builtins.sum
+builtins_max = builtins.max

@@ -1,0 +1,383 @@
+"""Elementwise + reduction math ops (paddle.tensor.math analog).
+
+Reference: python/paddle/tensor/math.py dispatching _C_ops.* into phi kernels
+(paddle/phi/kernels/elementwise_*.h, reduce_*.h). Every op here is one jnp/lax
+expression; XLA fuses chains of them into single TPU kernels, which replaces the
+reference's hand-fused elementwise CUDA kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, dispatch, register_op
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _make_binary(name, fn):
+    def op(x, y, name_arg=None):
+        return dispatch(fn, (x, y), {}, name=name)
+    op.__name__ = name
+    return op
+
+
+def _make_unary(name, fn):
+    def op(x, name_arg=None):
+        return dispatch(fn, (x,), {}, name=name)
+    op.__name__ = name
+    return op
+
+
+# -- binary elementwise -------------------------------------------------------
+add = _make_binary("add", jnp.add)
+subtract = _make_binary("subtract", jnp.subtract)
+multiply = _make_binary("multiply", jnp.multiply)
+divide = _make_binary("divide", jnp.true_divide)
+floor_divide = _make_binary("floor_divide", jnp.floor_divide)
+remainder = _make_binary("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow = _make_binary("pow", jnp.power)
+maximum = _make_binary("maximum", jnp.maximum)
+minimum = _make_binary("minimum", jnp.minimum)
+fmax = _make_binary("fmax", jnp.fmax)
+fmin = _make_binary("fmin", jnp.fmin)
+atan2 = _make_binary("atan2", jnp.arctan2)
+logaddexp = _make_binary("logaddexp", jnp.logaddexp)
+hypot = _make_binary("hypot", lambda x, y: jnp.sqrt(x * x + y * y))
+copysign = _make_binary("copysign", jnp.copysign)
+heaviside = _make_binary("heaviside", jnp.heaviside)
+gcd = _make_binary("gcd", jnp.gcd)
+lcm = _make_binary("lcm", jnp.lcm)
+ldexp = _make_binary("ldexp", jnp.ldexp)
+nextafter = _make_binary("nextafter", jnp.nextafter)
+inner = _make_binary("inner", jnp.inner)
+outer = _make_binary("outer", jnp.outer)
+kron = _make_binary("kron", jnp.kron)
+
+multiply_ = multiply  # inplace aliases rebind via Tensor method layer
+
+# -- unary elementwise --------------------------------------------------------
+exp = _make_unary("exp", jnp.exp)
+expm1 = _make_unary("expm1", jnp.expm1)
+log = _make_unary("log", jnp.log)
+log2 = _make_unary("log2", jnp.log2)
+log10 = _make_unary("log10", jnp.log10)
+log1p = _make_unary("log1p", jnp.log1p)
+sqrt = _make_unary("sqrt", jnp.sqrt)
+rsqrt = _make_unary("rsqrt", jax.lax.rsqrt)
+abs = _make_unary("abs", jnp.abs)
+neg = _make_unary("neg", jnp.negative)
+sign = _make_unary("sign", jnp.sign)
+floor = _make_unary("floor", jnp.floor)
+ceil = _make_unary("ceil", jnp.ceil)
+round = _make_unary("round", jnp.round)
+trunc = _make_unary("trunc", jnp.trunc)
+frac = _make_unary("frac", lambda x: x - jnp.trunc(x))
+sin = _make_unary("sin", jnp.sin)
+cos = _make_unary("cos", jnp.cos)
+tan = _make_unary("tan", jnp.tan)
+asin = _make_unary("asin", jnp.arcsin)
+acos = _make_unary("acos", jnp.arccos)
+atan = _make_unary("atan", jnp.arctan)
+sinh = _make_unary("sinh", jnp.sinh)
+cosh = _make_unary("cosh", jnp.cosh)
+tanh = _make_unary("tanh", jnp.tanh)
+asinh = _make_unary("asinh", jnp.arcsinh)
+acosh = _make_unary("acosh", jnp.arccosh)
+atanh = _make_unary("atanh", jnp.arctanh)
+reciprocal = _make_unary("reciprocal", jnp.reciprocal)
+square = _make_unary("square", jnp.square)
+erf = _make_unary("erf", jax.scipy.special.erf)
+erfinv = _make_unary("erfinv", jax.scipy.special.erfinv)
+lgamma = _make_unary("lgamma", jax.scipy.special.gammaln)
+digamma = _make_unary("digamma", jax.scipy.special.digamma)
+i0 = _make_unary("i0", jax.scipy.special.i0)
+i0e = _make_unary("i0e", jax.scipy.special.i0e)
+i1 = _make_unary("i1", jax.scipy.special.i1)
+i1e = _make_unary("i1e", jax.scipy.special.i1e)
+angle = _make_unary("angle", jnp.angle)
+conj = _make_unary("conj", jnp.conj)
+real = _make_unary("real", jnp.real)
+imag = _make_unary("imag", jnp.imag)
+rad2deg = _make_unary("rad2deg", jnp.rad2deg)
+deg2rad = _make_unary("deg2rad", jnp.deg2rad)
+sigmoid = _make_unary("sigmoid", jax.nn.sigmoid)
+isnan = _make_unary("isnan", jnp.isnan)
+isinf = _make_unary("isinf", jnp.isinf)
+isfinite = _make_unary("isfinite", jnp.isfinite)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return dispatch(lambda v: scale_b * jnp.tanh(scale_a * v), (x,), {}, name="stanh")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    def fn(v, s, b):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out.astype(v.dtype)
+    return dispatch(fn, (x, scale, bias), {}, name="scale")
+
+
+def clip(x, min=None, max=None):
+    def fn(v, lo, hi):
+        return jnp.clip(v, lo, hi)
+    return dispatch(fn, (x, min, max), {}, name="clip")
+
+
+def lerp(x, y, weight):
+    return dispatch(lambda a, b, w: a + w * (b - a), (x, y, weight), {}, name="lerp")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return dispatch(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf),
+                    (x,), {}, name="nan_to_num")
+
+
+def increment(x, value=1.0):
+    x._value = x._value + jnp.asarray(value, x._value.dtype)
+    return x
+
+
+def add_n(inputs):
+    return dispatch(lambda *vs: sum_arrays(vs), tuple(inputs), {}, name="add_n")
+
+
+def sum_arrays(vs):
+    out = vs[0]
+    for v in vs[1:]:
+        out = out + v
+    return out
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    args = (x,) + ((prepend,) if prepend is not None else ()) + \
+        ((append,) if append is not None else ())
+
+    def fn(v, *rest):
+        p = rest[0] if prepend is not None else None
+        a = rest[-1] if append is not None else None
+        return jnp.diff(v, n=int(n), axis=int(axis), prepend=p, append=a)
+    return dispatch(fn, args, {}, name="diff")
+
+
+# -- reductions ---------------------------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    d = dtypes.convert_dtype(dtype) if dtype is not None else None
+
+    def fn(v):
+        dd = d
+        if dd is None and (v.dtype == jnp.bool_ or jnp.issubdtype(v.dtype, jnp.integer)):
+            dd = jnp.int64
+        return jnp.sum(v, axis=_axis(axis), dtype=dd, keepdims=keepdim)
+    return dispatch(fn, (x,), {}, name="sum")
+
+
+def mean(x, axis=None, keepdim=False):
+    return dispatch(lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {}, name="mean")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    d = dtypes.convert_dtype(dtype) if dtype is not None else None
+    return dispatch(lambda v: jnp.prod(v, axis=_axis(axis), dtype=d, keepdims=keepdim),
+                    (x,), {}, name="prod")
+
+
+def max(x, axis=None, keepdim=False):
+    return dispatch(lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {}, name="max")
+
+
+def min(x, axis=None, keepdim=False):
+    return dispatch(lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {}, name="min")
+
+
+amax = max
+amin = min
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return dispatch(lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), (x,), {}, name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return dispatch(lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), (x,), {}, name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg"):
+    def fn(v):
+        if mode == "min" and axis is not None:
+            # paddle's 'min' mode returns lower median
+            n = v.shape[_axis(axis)]
+            sorted_v = jnp.sort(v, axis=_axis(axis))
+            idx = (n - 1) // 2
+            out = jnp.take(sorted_v, idx, axis=_axis(axis))
+            return jnp.expand_dims(out, _axis(axis)) if keepdim else out
+        return jnp.median(v, axis=_axis(axis), keepdims=keepdim)
+    return dispatch(fn, (x,), {}, name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return dispatch(lambda v: jnp.nanmedian(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {}, name="nanmedian")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    d = dtypes.convert_dtype(dtype) if dtype is not None else None
+    return dispatch(lambda v: jnp.nansum(v, axis=_axis(axis), dtype=d, keepdims=keepdim),
+                    (x,), {}, name="nansum")
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return dispatch(lambda v: jnp.nanmean(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {}, name="nanmean")
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return dispatch(lambda v: jnp.quantile(v, jnp.asarray(q), axis=_axis(axis),
+                                           keepdims=keepdim), (x,), {}, name="quantile")
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return dispatch(lambda v: jax.scipy.special.logsumexp(v, axis=_axis(axis),
+                                                          keepdims=keepdim),
+                    (x,), {}, name="logsumexp")
+
+
+def all(x, axis=None, keepdim=False):
+    return dispatch(lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {}, name="all")
+
+
+def any(x, axis=None, keepdim=False):
+    return dispatch(lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), {}, name="any")
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return dispatch(lambda v: jnp.count_nonzero(v, axis=_axis(axis), keepdims=keepdim)
+                    .astype(jnp.int64), (x,), {}, name="count_nonzero")
+
+
+# -- scans --------------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None):
+    d = dtypes.convert_dtype(dtype) if dtype is not None else None
+
+    def fn(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=d)
+        return jnp.cumsum(v, axis=int(axis), dtype=d)
+    return dispatch(fn, (x,), {}, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None):
+    d = dtypes.convert_dtype(dtype) if dtype is not None else None
+
+    def fn(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1), dtype=d)
+        return jnp.cumprod(v, axis=int(dim), dtype=d)
+    return dispatch(fn, (x,), {}, name="cumprod")
+
+
+def cummax(x, axis=None):
+    def fn(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        out = jax.lax.associative_scan(jnp.maximum, vv, axis=a)
+        idx_in = jnp.arange(vv.shape[a])
+        shape = [1] * vv.ndim
+        shape[a] = vv.shape[a]
+        idx_b = jnp.broadcast_to(idx_in.reshape(shape), vv.shape)
+
+        def take_max(p, q):
+            pv, pi = p
+            qv, qi = q
+            keep = qv >= pv
+            return jnp.where(keep, qv, pv), jnp.where(keep, qi, pi)
+        mv, mi = jax.lax.associative_scan(take_max, (vv, idx_b), axis=a)
+        return mv, mi.astype(jnp.int64)
+    return dispatch(fn, (x,), {}, name="cummax")
+
+
+def cummin(x, axis=None):
+    def fn(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        idx_in = jnp.arange(vv.shape[a])
+        shape = [1] * vv.ndim
+        shape[a] = vv.shape[a]
+        idx_b = jnp.broadcast_to(idx_in.reshape(shape), vv.shape)
+
+        def take_min(p, q):
+            pv, pi = p
+            qv, qi = q
+            keep = qv <= pv
+            return jnp.where(keep, qv, pv), jnp.where(keep, qi, pi)
+        mv, mi = jax.lax.associative_scan(take_min, (vv, idx_b), axis=a)
+        return mv, mi.astype(jnp.int64)
+    return dispatch(fn, (x,), {}, name="cummin")
+
+
+def logcumsumexp(x, axis=None):
+    def fn(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+
+        def comb(p, q):
+            return jnp.logaddexp(p, q)
+        return jax.lax.associative_scan(comb, vv, axis=a)
+    return dispatch(fn, (x,), {}, name="logcumsumexp")
+
+
+# -- matrix-ish helpers in paddle.tensor.math --------------------------------
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return dispatch(lambda i, a, b: beta * i + alpha * (a @ b), (input, x, y), {},
+                    name="addmm")
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return dispatch(lambda v: jnp.trace(v, offset=int(offset), axis1=int(axis1),
+                                        axis2=int(axis2)), (x,), {}, name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return dispatch(lambda v: jnp.diagonal(v, offset=int(offset), axis1=int(axis1),
+                                           axis2=int(axis2)), (x,), {}, name="diagonal")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def fn(v):
+        n = v.shape[-1] + builtins_abs(int(offset))
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        if offset >= 0:
+            out = out.at[..., idx, idx + offset].set(v)
+        else:
+            out = out.at[..., idx - offset, idx].set(v)
+        if (int(dim1), int(dim2)) not in ((-2, -1), (v.ndim - 1, v.ndim)):
+            out = jnp.moveaxis(out, (-2, -1), (int(dim1), int(dim2)))
+        return out
+    return dispatch(fn, (x,), {}, name="diag_embed")
+
+
+import builtins
+builtins_abs = builtins.abs

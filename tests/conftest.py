@@ -1,0 +1,28 @@
+"""Test env: force an 8-device virtual CPU mesh before any jax computation.
+
+This mirrors the reference's trick of testing multi-rank semantics without a cluster
+(reference: test/legacy_test/test_parallel_dygraph_dataparallel.py — local subprocess
+"clusters" on Gloo). Here XLA gives us 8 virtual CPU devices in one process.
+
+Note: the runtime image's sitecustomize imports jax at interpreter start (axon TPU
+tunnel), so env vars are already baked — we must override via jax.config.update.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# full-precision matmuls for numeric comparisons (prod default stays MXU bf16-friendly)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

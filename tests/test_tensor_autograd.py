@@ -1,0 +1,294 @@
+"""Core tensor + tape autograd tests.
+
+Modeled on the reference's OpTest discipline (test/legacy_test/op_test.py:418):
+outputs vs numpy references, grads vs numeric/known-analytic gradients.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_dtypes():
+    t = paddle.to_tensor(1.0)
+    assert t.dtype == paddle.float32
+    t = paddle.to_tensor(3)
+    assert t.dtype == paddle.int64
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype == paddle.int64
+    t = paddle.to_tensor(np.zeros((2, 3), np.float64))
+    assert t.dtype == paddle.float64
+    t = paddle.to_tensor([1.0, 2.0], dtype="bfloat16")
+    assert t.dtype == paddle.bfloat16
+
+
+def test_basic_arithmetic():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    y = paddle.to_tensor([[5.0, 6.0], [7.0, 8.0]])
+    np.testing.assert_allclose((x + y).numpy(), [[6, 8], [10, 12]])
+    np.testing.assert_allclose((x * y).numpy(), [[5, 12], [21, 32]])
+    np.testing.assert_allclose((x @ y).numpy(), np.array([[1., 2], [3, 4]]) @ np.array([[5., 6], [7, 8]]))
+    np.testing.assert_allclose((2.0 - x).numpy(), [[1, 0], [-1, -2]])
+    np.testing.assert_allclose((x ** 2).numpy(), [[1, 4], [9, 16]])
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_backward_chain_and_accumulation():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x      # 4
+    z = y * x      # x^3 -> dz/dx = 3x^2 = 12
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+    # second backward accumulates
+    z2 = x * 3.0
+    z2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 15.0)
+
+
+def test_backward_diamond():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = x * 2.0
+    b = x * 3.0
+    c = (a * b).sum()   # 6x^2 -> grad 12x
+    c.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0, 24.0])
+
+
+def test_backward_matmul():
+    xn = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    yn = np.random.RandomState(1).randn(4, 5).astype(np.float32)
+    x = paddle.to_tensor(xn, stop_gradient=False)
+    y = paddle.to_tensor(yn, stop_gradient=False)
+    out = paddle.matmul(x, y).sum()
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 5)) @ yn.T, rtol=1e-5)
+    np.testing.assert_allclose(y.grad.numpy(), xn.T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0])  # stop_gradient=True default
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 4.0])
+    assert y.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2.0
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = x.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+
+def test_retain_grads():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2.0
+    y.retain_grads()
+    z = (y * y).sum()
+    z.backward()
+    np.testing.assert_allclose(y.grad.numpy(), [4.0, 8.0])
+
+
+def test_double_backward_retain_graph():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_grad_api():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), 12.0)
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_grad_create_graph_double_grad():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    (ggx,) = paddle.grad(gx, x)
+    np.testing.assert_allclose(ggx.numpy(), 12.0)  # d2/dx2 x^3 = 6x
+
+
+def test_backward_hook():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3.0).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+
+def test_pylayer():
+    class Exp(paddle.PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            y = paddle.exp(a)
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor()
+            return dy * y
+
+    x = paddle.to_tensor([0.0, 1.0], stop_gradient=False)
+    y = Exp.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.exp([0.0, 1.0]), rtol=1e-6)
+
+
+def test_indexing_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0, 4.0], stop_gradient=False)
+    y = x[1:3].sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 1, 1, 0])
+
+
+def test_setitem():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    x[1] = 9.0
+    np.testing.assert_allclose(x.numpy(), [1, 9, 3])
+    x[0:2] = paddle.to_tensor([5.0, 6.0])
+    np.testing.assert_allclose(x.numpy(), [5, 6, 3])
+
+
+def test_inplace_ops():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.numpy(), [2, 3])
+    x.scale_(2.0)
+    np.testing.assert_allclose(x.numpy(), [4, 6])
+
+
+def test_reductions_match_numpy(rng):
+    a = rng.standard_normal((3, 4, 5)).astype(np.float32)
+    t = paddle.to_tensor(a)
+    np.testing.assert_allclose(t.sum().numpy(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(t.mean(axis=1).numpy(), a.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(t.max(axis=[0, 2]).numpy(), a.max((0, 2)), rtol=1e-6)
+    np.testing.assert_allclose(t.std(axis=0, unbiased=False).numpy(), a.std(0), rtol=1e-4)
+    np.testing.assert_allclose(
+        paddle.logsumexp(t, axis=-1).numpy(),
+        np.log(np.exp(a).sum(-1)), rtol=1e-4)
+
+
+def test_manipulation_roundtrip(rng):
+    a = rng.standard_normal((2, 3, 4)).astype(np.float32)
+    t = paddle.to_tensor(a)
+    assert paddle.reshape(t, [4, 6]).shape == [4, 6]
+    assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(t, 1, 2).shape == [2, 12]
+    assert paddle.unsqueeze(t, [0, 2]).shape == [1, 2, 1, 3, 4]
+    s = paddle.split(t, 3, axis=1)
+    assert len(s) == 3 and s[0].shape == [2, 1, 4]
+    s = paddle.split(t, [1, -1], axis=2)
+    assert s[1].shape == [2, 3, 3]
+    c = paddle.concat([t, t], axis=0)
+    assert c.shape == [4, 3, 4]
+    st = paddle.stack([t, t], axis=1)
+    assert st.shape == [2, 2, 3, 4]
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(paddle.gather(x, idx).numpy(), [[1, 2], [5, 6]])
+    upd = paddle.to_tensor([[9.0, 9.0]])
+    out = paddle.scatter(x, paddle.to_tensor([1]), upd)
+    np.testing.assert_allclose(out.numpy(), [[1, 2], [9, 9], [5, 6]])
+
+
+def test_where_topk_argsort():
+    x = paddle.to_tensor([3.0, 1.0, 2.0])
+    v, i = paddle.topk(x, 2)
+    np.testing.assert_allclose(v.numpy(), [3, 2])
+    np.testing.assert_allclose(i.numpy(), [0, 2])
+    np.testing.assert_allclose(paddle.argsort(x).numpy(), [1, 2, 0])
+    out = paddle.where(x > 1.5, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(out.numpy(), [3, 0, 2])
+
+
+def test_einsum():
+    a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    out = paddle.einsum("ij,jk->ik", a, b)
+    np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy())
+
+
+def test_linalg_svd_solve(rng):
+    a = rng.standard_normal((4, 4)).astype(np.float32)
+    a = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    t = paddle.to_tensor(a)
+    u, s, v = paddle.svd(t)
+    rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(rec, a, rtol=1e-3, atol=1e-3)
+    b = rng.standard_normal((4,)).astype(np.float32)
+    x = paddle.solve(t, paddle.to_tensor(b))
+    np.testing.assert_allclose(a @ x.numpy(), b, rtol=1e-3, atol=1e-3)
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.rand([4])
+    paddle.seed(42)
+    b = paddle.rand([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    c = paddle.rand([4])
+    assert not np.allclose(b.numpy(), c.numpy())
+
+
+def test_save_load(tmp_path):
+    obj = {"w": paddle.to_tensor([1.0, 2.0]), "step": 3,
+           "nested": [paddle.to_tensor([[1, 2]], dtype="int32")]}
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    np.testing.assert_allclose(loaded["w"].numpy(), [1, 2])
+    assert loaded["step"] == 3
+    assert loaded["nested"][0].dtype == paddle.int32
+
+
+def test_check_nan_inf_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([1.0, 0.0])
+        with pytest.raises(FloatingPointError):
+            _ = paddle.log(x * 0.0 - 1.0)  # log(-1) = nan
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_cast_astype():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == paddle.int32
+    z = x.astype(paddle.bfloat16)
+    assert z.dtype == paddle.bfloat16
