@@ -1,0 +1,46 @@
+"""paddle.nn analog."""
+from .layer_base import Layer, Parameter, ParamAttr  # noqa: F401
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.common import (  # noqa: F401
+    Identity, Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
+    Embedding, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle,
+    PixelUnshuffle, ChannelShuffle, Bilinear, Pad1D, Pad2D, Pad3D, ZeroPad2D,
+    CosineSimilarity, Unfold, Fold,
+)
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+)
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
+    RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm,
+)
+from .layer.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+)
+from .layer.activation import (  # noqa: F401
+    ReLU, ReLU6, Sigmoid, Tanh, Silu, Swish, Mish, GELU, ELU, CELU, SELU, LeakyReLU,
+    Hardtanh, Hardshrink, Softshrink, Hardsigmoid, Hardswish, Softplus, Softsign,
+    Tanhshrink, ThresholdedReLU, LogSigmoid, Softmax, LogSoftmax, GLU, Maxout, PReLU,
+    RReLU,
+)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, HuberLoss, KLDivLoss, MarginRankingLoss, CTCLoss,
+    CosineEmbeddingLoss, TripletMarginLoss,
+)
+from .layer.containers import (  # noqa: F401
+    Sequential, LayerList, LayerDict, ParameterList,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layer.rnn import (  # noqa: F401
+    SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU, RNNCellBase,
+)
+
+from . import utils  # noqa: F401

@@ -1,0 +1,15 @@
+"""paddle.nn.functional analog."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose, conv3d_transpose,
+)
+from .pooling import *  # noqa: F401,F403
+from .norm import (  # noqa: F401
+    layer_norm, rms_norm, batch_norm, instance_norm, group_norm, local_response_norm,
+)
+from .loss import *  # noqa: F401,F403
+from .attention import (  # noqa: F401
+    scaled_dot_product_attention, flash_attention, flash_attn_unpadded,
+    flashmask_attention,
+)

@@ -1,0 +1,171 @@
+"""Activation functionals (paddle.nn.functional activation analog).
+
+Reference: python/paddle/nn/functional/activation.py → phi activation kernels.
+All are single jnp/jax.nn expressions; XLA fuses them into neighboring ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import dispatch
+
+
+def _unary(name, fn):
+    def op(x, name_arg=None):
+        return dispatch(fn, (x,), {}, name=name)
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+silu = _unary("silu", jax.nn.silu)
+swish = silu
+mish = _unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+tanhshrink = _unary("tanhshrink", lambda x: x - jnp.tanh(x))
+softsign = _unary("softsign", jax.nn.soft_sign)
+
+
+def gelu(x, approximate=False, name=None):
+    return dispatch(lambda v: jax.nn.gelu(v, approximate=approximate), (x,), {},
+                    name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return dispatch(lambda v: jax.nn.leaky_relu(v, negative_slope), (x,), {},
+                    name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+    return dispatch(fn, (x, weight), {}, name="prelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return dispatch(lambda v: jax.nn.elu(v, alpha), (x,), {}, name="elu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return dispatch(lambda v: jax.nn.celu(v, alpha), (x,), {}, name="celu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return dispatch(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+                    (x,), {}, name="selu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return dispatch(lambda v: jnp.clip(v, min, max), (x,), {}, name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return dispatch(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0).astype(v.dtype),
+                    (x,), {}, name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    def fn(v):
+        return jnp.where(v > threshold, v - threshold,
+                         jnp.where(v < -threshold, v + threshold, 0.0)).astype(v.dtype)
+    return dispatch(fn, (x,), {}, name="softshrink")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return dispatch(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0).astype(v.dtype),
+                    (x,), {}, name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return dispatch(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, (x,), {},
+                    name="hardswish")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def fn(v):
+        bv = beta * v
+        return jnp.where(bv > threshold, v, jnp.log1p(jnp.exp(bv)) / beta)
+    return dispatch(fn, (x,), {}, name="softplus")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return dispatch(lambda v: jnp.where(v > threshold, v, value).astype(v.dtype),
+                    (x,), {}, name="thresholded_relu")
+
+
+def log_sigmoid(x, name=None):
+    return dispatch(jax.nn.log_sigmoid, (x,), {}, name="log_sigmoid")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return dispatch(fn, (x,), {}, name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            import numpy as np
+            v = v.astype(np.dtype(dtype) if not isinstance(dtype, str) else dtype)
+        return jax.nn.softmax(v, axis=int(axis))
+    return dispatch(fn, (x,), {}, name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            import numpy as np
+            v = v.astype(np.dtype(dtype) if not isinstance(dtype, str) else dtype)
+        return jax.nn.log_softmax(v, axis=int(axis))
+    return dispatch(fn, (x,), {}, name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as _random
+
+    def fn(v):
+        g = jax.random.gumbel(_random.next_key(), v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            y_hard = jax.nn.one_hot(idx, v.shape[axis], axis=axis, dtype=y.dtype)
+            y = jax.lax.stop_gradient(y_hard - y) + y  # straight-through estimator
+        return y
+    return dispatch(fn, (x,), {}, name="gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    return dispatch(lambda v: jax.nn.glu(v, axis=int(axis)), (x,), {}, name="glu")
+
+
+def swiglu(x, y=None, name=None):
+    """paddle.incubate.nn.functional.swiglu analog: silu(x) * y (or split last dim)."""
+    if y is None:
+        return dispatch(lambda v: (lambda a, b: jax.nn.silu(a) * b)(
+            *jnp.split(v, 2, axis=-1)), (x,), {}, name="swiglu")
+    return dispatch(lambda a, b: jax.nn.silu(a) * b, (x, y), {}, name="swiglu")
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=False, name=None):
+    from ...core import random as _random
+
+    def fn(v):
+        if training:
+            a = jax.random.uniform(_random.next_key(), v.shape, jnp.float32,
+                                   lower, upper).astype(v.dtype)
+        else:
+            a = jnp.asarray((lower + upper) / 2.0, v.dtype)
+        return jnp.where(v >= 0, v, a * v)
+    return dispatch(fn, (x,), {}, name="rrelu")

@@ -1,0 +1,285 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate, etc.
+
+Reference: python/paddle/nn/functional/common.py, input.py →
+phi kernels (matmul+bias epilogue, dropout, embedding lookup). On TPU the
+linear+bias+activation chain fuses in XLA; dropout keys ride core/random.py so eager
+and jit-traced paths are both reproducible.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import random as _random
+from ...core.tensor import Tensor, dispatch
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b. Weight layout [in, out] (paddle convention)."""
+    if bias is None:
+        return dispatch(lambda v, w: v @ w, (x, weight), {}, name="linear")
+    return dispatch(lambda v, w, b: v @ w + b, (x, weight, bias), {}, name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    key = _random.next_key()
+
+    def fn(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in [a % v.ndim for a in axes] else 1
+                     for i, s in enumerate(v.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+    return dispatch(fn, (x,), {}, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = _random.next_key()
+
+    def fn(v):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2)))
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+    return dispatch(fn, (x,), {}, name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def fn(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return dispatch(fn, (x, weight), {}, name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return dispatch(lambda v: jax.nn.one_hot(v, int(num_classes), dtype=jnp.float32),
+                    (x,), {}, name="one_hot")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l, *rest):
+        n = l.shape[-1]
+        if rest:
+            return (1 - epsilon) * l + epsilon * rest[0]
+        return (1 - epsilon) * l + epsilon / n
+    args = (label,) + ((prior_dist,) if prior_dist is not None else ())
+    return dispatch(fn, args, {}, name="label_smooth")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True,
+        name=None):
+    """paddle.nn.functional.pad — int-list pad in reversed-last-dims order for the
+    NCHW/NCL/NCDHW forms, or full per-dim pairs when len(pad) == 2*ndim."""
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+
+    def fn(v):
+        nd = v.ndim
+        width = [(0, 0)] * nd
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if len(pad) == 2 * nd:
+            if pad_from_left_axis:
+                width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+            else:
+                width = [(pad[2 * (nd - 1 - i)], pad[2 * (nd - 1 - i) + 1])
+                         for i in range(nd)]
+        else:
+            # data_format form: pad applies to spatial dims, last-dim-first pairs
+            n_spatial = len(pad) // 2
+            if data_format.endswith("C"):  # NLC / NHWC / NDHWC
+                spatial = list(range(1, 1 + n_spatial))
+            else:  # NCL / NCHW / NCDHW
+                spatial = list(range(2, 2 + n_spatial))
+            for i, d in enumerate(reversed(spatial)):
+                width[d] = (pad[2 * i], pad[2 * i + 1])
+        if jmode == "constant":
+            return jnp.pad(v, width, mode="constant", constant_values=value)
+        return jnp.pad(v, width, mode=jmode)
+    return dispatch(fn, (x,), {}, name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: phi/kernels/unfold_kernel). NCHW only."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings) if not (isinstance(paddings, (list, tuple))
+                                     and len(paddings) == 4) else (None, None)
+    dh, dw = _pair(dilations)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        if ph is not None:
+            vp = jnp.pad(v, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+        else:
+            pt, pl, pb, pr = paddings
+            vp = jnp.pad(v, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+        hh, ww = vp.shape[2], vp.shape[3]
+        out_h = (hh - (dh * (kh - 1) + 1)) // sh + 1
+        out_w = (ww - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            vp, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * kh * kw, out_h * out_w)
+    return dispatch(fn, (x,), {}, name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+
+    def fn(v):
+        n, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        out_h = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        out_w = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        cols = v.reshape(n, c, kh, kw, out_h, out_w)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), v.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wi = j * dw
+                out = out.at[:, :, hi:hi + sh * out_h:sh, wi:wi + sw * out_w:sw].add(
+                    cols[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+    return dispatch(fn, (x,), {}, name="fold")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    """paddle.nn.functional.interpolate via jax.image.resize."""
+    def fn(v):
+        channel_last = data_format.endswith("C")
+        nd = v.ndim - 2
+        spatial = v.shape[1:-1] if channel_last else v.shape[2:]
+        if size is not None:
+            tgt = [int(s._value) if isinstance(s, Tensor) else int(s)
+                   for s in (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * nd
+            tgt = [int(round(s * f)) for s, f in zip(spatial, sf)]
+        method = {"nearest": "nearest", "bilinear": "bilinear", "trilinear": "trilinear",
+                  "bicubic": "bicubic", "linear": "linear", "area": "linear"}[mode]
+        if channel_last:
+            new_shape = (v.shape[0], *tgt, v.shape[-1])
+        else:
+            new_shape = (v.shape[0], v.shape[1], *tgt)
+        return jax.image.resize(v, new_shape, method=method).astype(v.dtype)
+    return dispatch(fn, (x,), {}, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return dispatch(fn, (x,), {}, name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return dispatch(fn, (x,), {}, name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            return v.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4) \
+                .reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        return v.reshape(n, h, w, g, c // g).transpose(0, 1, 2, 4, 3) \
+            .reshape(n, h, w, c)
+    return dispatch(fn, (x,), {}, name="channel_shuffle")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return dispatch(fn, args, {}, name="bilinear")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return dispatch(fn, (x1, x2), {}, name="cosine_similarity")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        norm = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis, keepdims=True),
+                         1.0 / p)
+        return v / jnp.maximum(norm, epsilon)
+    return dispatch(fn, (x,), {}, name="normalize")

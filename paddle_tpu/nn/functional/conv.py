@@ -1,0 +1,169 @@
+"""Convolution functionals.
+
+Reference: python/paddle/nn/functional/conv.py → phi conv kernels → cuDNN.
+TPU-native: one lowering to lax.conv_general_dilated, which XLA maps onto the MXU
+(convs are reshaped into large matmuls by the compiler). Paddle weight layout
+[out_c, in_c/groups, *k] is kept so state_dicts match the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import dispatch
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _padding(padding, n):
+    """Returns lax padding config: 'SAME'/'VALID' or [(lo,hi)]*n."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # paddle also allows [[0,0],[0,0],[lo,hi],...] including batch/channel dims
+    if len(padding) == n + 2:
+        return [tuple(p) for p in padding[2:]]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last):
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    if channel_last:
+        lhs_spec = "N" + "DHW"[3 - n:] + "C"
+    else:
+        lhs_spec = "NC" + "DHW"[3 - n:]
+    out_spec = lhs_spec
+    rhs_spec = "OI" + "DHW"[3 - n:]
+
+    def fn(v, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+            feature_group_count=int(groups),
+            preferred_element_type=None)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[lhs_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return dispatch(fn, args, {}, name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format.endswith("C"))
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format.endswith("C") and data_format != "NCHW")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format.endswith("C") and data_format != "NCDHW")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, channel_last, output_size=None):
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    opad = _tuple(output_padding, n)
+    if channel_last:
+        lhs_spec = "N" + "DHW"[3 - n:] + "C"
+    else:
+        lhs_spec = "NC" + "DHW"[3 - n:]
+    rhs_spec = "IO" + "DHW"[3 - n:]  # paddle transpose-conv weight: [in_c, out_c/g, *k]
+
+    def fn(v, w, *rest):
+        # Transposed conv == gradient of conv w.r.t. its input: dilate the input by
+        # `stride` (lhs_dilation), pad by k_eff-1-p, correlate with the spatially
+        # flipped kernel. Paddle's weight layout [in_c, out_c/g, *k] already has the
+        # channel transpose, so rhs_spec "IO" + spatial flip completes it.
+        k_spatial = w.shape[2:]
+        if isinstance(padding, str):
+            if padding.upper() == "VALID":
+                pad_base = [(0, 0)] * n
+            else:  # SAME: output spatial = input * stride
+                pad_base = []
+                for i in range(n):
+                    k_eff = (k_spatial[i] - 1) * dil[i] + 1
+                    total = k_eff - strides[i]
+                    pad_base.append((total // 2, total - total // 2))
+        else:
+            pad_base = _padding(padding, n)
+        pads = []
+        for i in range(n):
+            k_eff = (k_spatial[i] - 1) * dil[i] + 1
+            lo, hi = pad_base[i]
+            pads.append((k_eff - 1 - lo, k_eff - 1 - hi + opad[i]))
+        out = jax.lax.conv_general_dilated(
+            v, jnp.flip(w, axis=tuple(range(2, 2 + n))),
+            window_strides=(1,) * n, padding=pads, lhs_dilation=strides,
+            rhs_dilation=dil,
+            dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
+            feature_group_count=1)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[lhs_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    def fn_grouped(v, w, *rest):
+        # lax conv_transpose has no groups; emulate by splitting
+        if groups == 1:
+            return fn(v, w, *rest)
+        c_axis = lhs_spec.index("C")
+        vs = jnp.split(v, groups, axis=c_axis)
+        ws = jnp.split(w, groups, axis=0)
+        outs = [fn(vv, ww) for vv, ww in zip(vs, ws)]
+        out = jnp.concatenate(outs, axis=c_axis)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[c_axis] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return dispatch(fn_grouped, args, {}, name=f"conv{n}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 1, data_format.endswith("C"), output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 2, data_format == "NHWC", output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 3, data_format == "NDHWC", output_size)

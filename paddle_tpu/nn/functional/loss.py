@@ -1,0 +1,285 @@
+"""Loss functionals.
+
+Reference: python/paddle/nn/functional/loss.py → phi cross_entropy/bce/... kernels.
+cross_entropy fuses log_softmax+gather the way the reference's
+softmax_with_cross_entropy kernel does (one pass, no [N, C] probability
+materialization in the backward).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch
+
+
+def _reduce(out, reduction, weight_sum=None):
+    if reduction == "mean":
+        if weight_sum is not None:
+            return jnp.sum(out) / weight_sum
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """Fused softmax+CE (reference: phi softmax_with_cross_entropy kernel)."""
+    def fn(logits, lbl, *w):
+        ax = int(axis) % logits.ndim
+        n_classes = logits.shape[ax]
+        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax \
+            else jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            loss = -jnp.sum(lbl * logp, axis=ax)
+            if w:
+                loss = loss * w[0]
+            return _reduce(loss, reduction)
+        lbl_int = lbl.astype(jnp.int32)
+        if lbl_int.ndim == logits.ndim:
+            lbl_int = jnp.squeeze(lbl_int, axis=ax)
+        if label_smoothing > 0.0:
+            eps = label_smoothing
+            nll = -jnp.take_along_axis(logp, jnp.expand_dims(
+                jnp.clip(lbl_int, 0, n_classes - 1), ax), axis=ax).squeeze(ax)
+            smooth = -jnp.mean(logp, axis=ax)
+            loss = (1 - eps) * nll + eps * smooth
+        else:
+            loss = -jnp.take_along_axis(logp, jnp.expand_dims(
+                jnp.clip(lbl_int, 0, n_classes - 1), ax), axis=ax).squeeze(ax)
+        valid = (lbl_int != ignore_index)
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            cw = jnp.take(w[0], jnp.clip(lbl_int, 0, n_classes - 1))
+            cw = jnp.where(valid, cw, 0.0)
+            loss = loss * cw
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(cw), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return dispatch(fn, args, {}, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = dispatch(lambda l: jnp.expand_dims(l, int(axis)), (loss,), {},
+                    name="unsqueeze")
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def fn(logp, lbl, *w):
+        lbl_int = lbl.astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, jnp.expand_dims(
+            jnp.clip(lbl_int, 0, logp.shape[1] - 1), 1), axis=1).squeeze(1)
+        valid = lbl_int != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            cw = jnp.take(w[0], jnp.clip(lbl_int, 0, logp.shape[1] - 1))
+            cw = jnp.where(valid, cw, 0.0)
+            loss = loss * cw
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(cw), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return dispatch(fn, args, {}, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return dispatch(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                    (input, label), {}, name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return dispatch(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    (input, label), {}, name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss * delta, reduction)
+    return dispatch(fn, (input, label), {}, name="smooth_l1_loss")
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return dispatch(fn, (input, label), {}, name="huber_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, l, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(l * jnp.log(p) + (1 - l) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return dispatch(fn, args, {}, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, l, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        # stable: max(z,0) - z*l + log(1+exp(-|z|)), with pos_weight on the positive term
+        if pw is not None:
+            log_sig = jax.nn.log_sigmoid(z)
+            log_sig_neg = jax.nn.log_sigmoid(-z)
+            loss = -(pw * l * log_sig + (1 - l) * log_sig_neg)
+        else:
+            loss = jnp.maximum(z, 0) - z * l + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = (logit, label) + tuple(t for t in (weight, pos_weight) if t is not None)
+    return dispatch(fn, args, {}, name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(logp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - logp)
+        else:
+            loss = t * (jnp.log(jnp.maximum(t, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return dispatch(fn, (input, label), {}, name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, l):
+        loss = jnp.maximum(0.0, -l * (a - b) + margin)
+        return _reduce(loss, reduction)
+    return dispatch(fn, (input, other, label), {}, name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(a, l):
+        loss = jnp.where(l == 1.0, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return dispatch(fn, (input, label), {}, name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def fn(a, b, l):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(l == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return dispatch(fn, (input1, input2, label), {}, name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p), axis=-1),
+                             1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+    return dispatch(fn, (input, positive, negative), {}, name="triplet_margin_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(p, l):
+        return -l * jnp.log(p + epsilon) - (1 - l) * jnp.log(1 - p + epsilon)
+    return dispatch(fn, (input, label), {}, name="log_loss")
+
+
+def square_error_cost(input, label):
+    return dispatch(lambda a, b: jnp.square(a - b), (input, label), {},
+                    name="square_error_cost")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, l, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * l + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * l + (1 - p) * (1 - l)
+        a_t = alpha * l + (1 - alpha) * (1 - l)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return dispatch(fn, args, {}, name="sigmoid_focal_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward-alpha recursion in log space (lax.scan over time).
+    Reference analog: warpctc (third_party) behind phi ctc kernels."""
+    def fn(lp, lbl, in_len, lbl_len):
+        # lp: [T, B, C] log-probs (paddle convention), lbl: [B, S]
+        T, B, C = lp.shape
+        S = lbl.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=lbl.dtype)
+        ext = ext.at[:, 1::2].set(lbl)  # blank a1 blank a2 ... blank
+        L = 2 * S + 1
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+
+        def gather_probs(lp_t):
+            return jnp.take_along_axis(lp_t, ext, axis=1)  # [B, L]
+
+        alpha0 = jnp.full((B, L), neg_inf, lp.dtype)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lbl = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(first_lbl)
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.zeros((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            probs = gather_probs(lp_t)
+            shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf, lp.dtype),
+                                      alpha[:, :-1]], axis=1)
+            shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf, lp.dtype),
+                                      alpha[:, :-2]], axis=1)
+            shift2 = jnp.where(same_as_prev2, neg_inf, shift2)
+            new = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2) + probs
+            return new, new
+
+        alpha_T, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, L]
+        t_idx = jnp.clip(in_len - 1, 0, T - 1)
+        alpha_final = all_alphas[t_idx, jnp.arange(B)]  # [B, L]
+        end1 = jnp.take_along_axis(alpha_final, (2 * lbl_len)[:, None], axis=1)[:, 0]
+        end2 = jnp.take_along_axis(alpha_final,
+                                   jnp.maximum(2 * lbl_len - 1, 0)[:, None],
+                                   axis=1)[:, 0]
+        ll = jnp.logaddexp(end1, end2)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len, 1).astype(loss.dtype))
+        return _reduce(loss, reduction)
+    return dispatch(fn, (log_probs, labels, input_lengths, label_lengths), {},
+                    name="ctc_loss")

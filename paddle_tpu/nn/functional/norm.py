@@ -1,0 +1,166 @@
+"""Normalization functionals.
+
+Reference: python/paddle/nn/functional/norm.py → phi layer_norm/batch_norm/group_norm
+kernels (hand-written Welford/CUB reductions). TPU-native: plain jnp reductions — XLA
+fuses mean/var/normalize into one kernel; rms_norm additionally has a Pallas fast path
+(ops/kernels/rms_norm.py) used on TPU for the fused residual+cast cases.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    ndims = len(tuple(normalized_shape))
+
+    def fn(v, *wb):
+        axes = tuple(range(v.ndim - ndims, v.ndim))
+        # reduce in fp32 for bf16 inputs (matches reference's fp32 accumulators)
+        compute = v.astype(jnp.float32) if v.dtype in (jnp.bfloat16, jnp.float16) else v
+        mean = jnp.mean(compute, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(compute - mean), axis=axes, keepdims=True)
+        out = (compute - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch(fn, args, {}, name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference: incubate/nn/functional/fused_rms_norm.py)."""
+    def fn(v, *w):
+        compute = v.astype(jnp.float32) if v.dtype in (jnp.bfloat16, jnp.float16) else v
+        ms = jnp.mean(jnp.square(compute), axis=-1, keepdims=True)
+        out = (compute * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
+        if w:
+            out = out * w[0]
+        return out
+    args = (x,) + ((weight,) if weight is not None else ())
+    return dispatch(fn, args, {}, name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    """Batch normalization with running-stat updates.
+
+    In eager mode the running stats (buffers) are updated in place; under a jit trace
+    the updated values are traced arrays captured by the functional-state machinery
+    (jit/functional_call.py) — the analog of the reference's in-kernel stat writes.
+    """
+    channel_axis = 1 if not data_format.endswith("C") or data_format == "NCHW" else -1
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+
+    rm = running_mean._value if isinstance(running_mean, Tensor) else running_mean
+    rv = running_var._value if isinstance(running_var, Tensor) else running_var
+
+    def fn(v, *wb):
+        c_ax = channel_axis % v.ndim
+        axes = tuple(i for i in range(v.ndim) if i != c_ax)
+        shape = [1] * v.ndim
+        shape[c_ax] = v.shape[c_ax]
+        compute = v.astype(jnp.float32) if v.dtype in (jnp.bfloat16, jnp.float16) else v
+        if use_stats:
+            mean, var = rm, rv
+        else:
+            mean = jnp.mean(compute, axis=axes)
+            var = jnp.var(compute, axis=axes)
+        out = (compute - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + epsilon)
+        out = out.astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out, mean, var
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    out, batch_mean, batch_var = dispatch(fn, args, {}, name="batch_norm")
+
+    if training and not use_stats and isinstance(running_mean, Tensor):
+        from ..layer_base import Layer  # noqa: F401 (doc anchor)
+        m = momentum
+        running_mean._value = (m * rm + (1 - m) * batch_mean._value).astype(rm.dtype)
+        running_var._value = (m * rv + (1 - m) * batch_var._value).astype(rv.dtype)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    def fn(v, *wb):
+        axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch(fn, args, {}, name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    g = int(num_groups)
+
+    def fn(v, *wb):
+        if data_format.endswith("C") and data_format != "NCHW":
+            v_ = jnp.moveaxis(v, -1, 1)
+        else:
+            v_ = v
+        n, c = v_.shape[0], v_.shape[1]
+        spatial = v_.shape[2:]
+        r = v_.reshape(n, g, c // g, *spatial)
+        axes = tuple(range(2, r.ndim))
+        compute = r.astype(jnp.float32) if r.dtype in (jnp.bfloat16, jnp.float16) else r
+        mean = jnp.mean(compute, axis=axes, keepdims=True)
+        var = jnp.var(compute, axis=axes, keepdims=True)
+        out = ((compute - mean) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        out = out.reshape(v_.shape)
+        shape = [1, c] + [1] * (v_.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if data_format.endswith("C") and data_format != "NCHW":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return dispatch(fn, args, {}, name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    def fn(v):
+        c_ax = 1 if data_format == "NCHW" or not data_format.endswith("C") else v.ndim - 1
+        sq = jnp.square(v)
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[c_ax] = (half, size - half - 1)
+        window = [1] * v.ndim
+        window[c_ax] = size
+        summed = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window),
+                                       (1,) * v.ndim, pads)
+        return v / jnp.power(k + alpha * summed / size, beta)
+    return dispatch(fn, (x,), {}, name="local_response_norm")

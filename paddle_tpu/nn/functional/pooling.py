@@ -1,0 +1,226 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py →
+phi pool kernels). TPU-native: lax.reduce_window, which XLA lowers to fused
+windowed reductions.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import dispatch
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, channel_last,
+          ceil_mode=False, count_include_pad=True, divisor_override=None,
+          is_avg=False, exclusive=True):
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        p = [(0, 0)] * n
+    else:
+        pad_mode = None
+        p = [(pp, pp) for pp in _tuple(padding, n)]
+
+    def fn(v):
+        nd = v.ndim
+        if channel_last:
+            window = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            pads = [(0, 0)] + p + [(0, 0)]
+        else:
+            window = (1, 1) + k
+            strides = (1, 1) + s
+            pads = [(0, 0), (0, 0)] + p
+        if pad_mode == "SAME":
+            spatial = v.shape[1:-1] if channel_last else v.shape[2:]
+            pads2 = []
+            for i in range(n):
+                out_sz = -(-spatial[i] // s[i])
+                total = max(0, (out_sz - 1) * s[i] + k[i] - spatial[i])
+                pads2.append((total // 2, total - total // 2))
+            pads = ([(0, 0)] + pads2 + [(0, 0)]) if channel_last \
+                else [(0, 0), (0, 0)] + pads2
+        if ceil_mode:
+            spatial_axes = range(1, 1 + n) if channel_last else range(2, 2 + n)
+            for i, ax in enumerate(spatial_axes):
+                size = v.shape[ax] + pads[ax][0] + pads[ax][1]
+                rem = (size - k[i]) % s[i]
+                if rem != 0:
+                    pads[ax] = (pads[ax][0], pads[ax][1] + (s[i] - rem))
+        if is_avg:
+            summed = jax.lax.reduce_window(v, 0.0 if v.dtype != jnp.bfloat16 else
+                                           jnp.asarray(0.0, v.dtype),
+                                           jax.lax.add, window, strides, pads)
+            if divisor_override:
+                return summed / divisor_override
+            if exclusive and any(pp != (0, 0) for pp in pads):
+                ones = jnp.ones_like(v)
+                counts = jax.lax.reduce_window(ones, jnp.asarray(0.0, v.dtype),
+                                               jax.lax.add, window, strides, pads)
+                return summed / counts
+            return summed / float(np.prod(k))
+        return jax.lax.reduce_window(v, init(v.dtype), reducer, window, strides, pads)
+    return fn
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    fn = _pool(x, kernel_size, stride, padding, 1, jax.lax.max,
+               lambda d: jnp.asarray(-jnp.inf, d), data_format.endswith("C") and
+               data_format != "NCL", ceil_mode)
+    return dispatch(fn, (x,), {}, name="max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    fn = _pool(x, kernel_size, stride, padding, 2, jax.lax.max,
+               lambda d: jnp.asarray(-jnp.inf, d), data_format == "NHWC", ceil_mode)
+    out = dispatch(fn, (x,), {}, name="max_pool2d")
+    if return_mask:
+        idx = _max_pool_mask(x, kernel_size, stride, padding, data_format)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    fn = _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
+               lambda d: jnp.asarray(-jnp.inf, d), data_format == "NDHWC", ceil_mode)
+    return dispatch(fn, (x,), {}, name="max_pool3d")
+
+
+def _max_pool_mask(x, kernel_size, stride, padding, data_format):
+    from ...core.tensor import Tensor
+    k = _tuple(kernel_size, 2)
+    s = _tuple(stride if stride is not None else kernel_size, 2)
+    p = _tuple(padding, 2)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        hw = h * w
+        idx = jnp.arange(hw, dtype=jnp.float32).reshape(1, 1, h, w)
+        idx = jnp.broadcast_to(idx, v.shape)
+        # select argmax index via reduce_window over (value, index) pairs
+        def red(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+        init = (jnp.asarray(-jnp.inf, v.dtype), jnp.asarray(-1.0))
+        vv, ii = jax.lax.reduce_window((v, idx), init, red,
+                                       (1, 1) + k, (1, 1) + s,
+                                       [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        return ii.astype(jnp.int32)
+    return dispatch(fn, (x,), {}, name="max_pool2d_mask")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    fn = _pool(x, kernel_size, stride, padding, 1, jax.lax.add,
+               lambda d: jnp.asarray(0.0, d), False, ceil_mode, is_avg=True,
+               exclusive=exclusive)
+    return dispatch(fn, (x,), {}, name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    fn = _pool(x, kernel_size, stride, padding, 2, jax.lax.add,
+               lambda d: jnp.asarray(0.0, d), data_format == "NHWC", ceil_mode,
+               is_avg=True, divisor_override=divisor_override, exclusive=exclusive)
+    return dispatch(fn, (x,), {}, name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    fn = _pool(x, kernel_size, stride, padding, 3, jax.lax.add,
+               lambda d: jnp.asarray(0.0, d), data_format == "NDHWC", ceil_mode,
+               is_avg=True, divisor_override=divisor_override, exclusive=exclusive)
+    return dispatch(fn, (x,), {}, name="avg_pool3d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", name=None):
+    pw = float(norm_type)
+
+    def fn(v):
+        powed = jnp.power(jnp.abs(v), pw)
+        pool = _pool(None, kernel_size, stride, padding, 2, jax.lax.add,
+                     lambda d: jnp.asarray(0.0, d), data_format == "NHWC", ceil_mode,
+                     is_avg=False)(powed)
+        return jnp.power(pool, 1.0 / pw)
+    return dispatch(fn, (x,), {}, name="lp_pool2d")
+
+
+def _adaptive_axes(in_sz, out_sz):
+    # exact adaptive pooling: split with variable windows via cumulative segments
+    starts = (np.arange(out_sz) * in_sz) // out_sz
+    ends = -(-((np.arange(out_sz) + 1) * in_sz) // out_sz)
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, mode, channel_last):
+    def fn(v):
+        spatial_axes = list(range(1, 1 + n)) if channel_last else list(range(2, 2 + n))
+        out_szs = _tuple(output_size, n)
+        out = v
+        for dim_i, ax in enumerate(spatial_axes):
+            in_sz = out.shape[ax]
+            o = out_szs[dim_i]
+            if o is None:
+                continue
+            if in_sz % o == 0:
+                # uniform window: reshape+reduce (fast path)
+                kshape = list(out.shape)
+                kshape[ax] = o
+                kshape.insert(ax + 1, in_sz // o)
+                r = out.reshape(kshape)
+                out = (jnp.max(r, axis=ax + 1) if mode == "max"
+                       else jnp.mean(r, axis=ax + 1))
+            else:
+                starts, ends = _adaptive_axes(in_sz, o)
+                segs = []
+                for si, ei in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(si), int(ei), axis=ax)
+                    segs.append(jnp.max(seg, axis=ax, keepdims=True) if mode == "max"
+                                else jnp.mean(seg, axis=ax, keepdims=True))
+                out = jnp.concatenate(segs, axis=ax)
+        return out
+    return fn
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return dispatch(_adaptive_pool(x, output_size, 1, "avg", False), (x,), {},
+                    name="adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return dispatch(_adaptive_pool(x, output_size, 2, "avg", data_format == "NHWC"),
+                    (x,), {}, name="adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return dispatch(_adaptive_pool(x, output_size, 3, "avg", data_format == "NDHWC"),
+                    (x,), {}, name="adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return dispatch(_adaptive_pool(x, output_size, 1, "max", False), (x,), {},
+                    name="adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return dispatch(_adaptive_pool(x, output_size, 2, "max", False), (x,), {},
+                    name="adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return dispatch(_adaptive_pool(x, output_size, 3, "max", False), (x,), {},
+                    name="adaptive_max_pool3d")

@@ -1,0 +1,64 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from ..layer_base import Layer
+from ..initializer import Constant
+from .. import functional as F
+
+
+def _simple(name, fn_name=None, **fixed):
+    fn = getattr(F, fn_name or name.lower())
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._args, self._kwargs = args, {**fixed, **kwargs}
+
+        def forward(self, x):
+            return fn(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _simple("ReLU")
+ReLU6 = _simple("ReLU6")
+Sigmoid = _simple("Sigmoid")
+Tanh = _simple("Tanh")
+Silu = _simple("Silu")
+Swish = _simple("Swish")
+Mish = _simple("Mish")
+GELU = _simple("GELU")
+ELU = _simple("ELU")
+CELU = _simple("CELU")
+SELU = _simple("SELU")
+LeakyReLU = _simple("LeakyReLU", "leaky_relu")
+Hardtanh = _simple("Hardtanh")
+Hardshrink = _simple("Hardshrink")
+Softshrink = _simple("Softshrink")
+Hardsigmoid = _simple("Hardsigmoid")
+Hardswish = _simple("Hardswish")
+Softplus = _simple("Softplus")
+Softsign = _simple("Softsign")
+Tanhshrink = _simple("Tanhshrink")
+ThresholdedReLU = _simple("ThresholdedReLU", "thresholded_relu")
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+Softmax = _simple("Softmax")
+LogSoftmax = _simple("LogSoftmax", "log_softmax")
+GLU = _simple("GLU")
+Maxout = _simple("Maxout")
+RReLU = _simple("RReLU", "rrelu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

@@ -1,0 +1,51 @@
+"""Pooling layers (reference: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from ..layer_base import Layer
+from .. import functional as F
+
+
+def _pool_layer(name, fn_name, arg_names):
+    fn = getattr(F, fn_name)
+
+    class _Pool(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            merged = dict(zip(arg_names, args))
+            merged.update(kwargs)
+            merged.pop("name", None)
+            self._kwargs = merged
+
+        def forward(self, x):
+            return fn(x, **self._kwargs)
+
+    _Pool.__name__ = name
+    _Pool.__qualname__ = name
+    return _Pool
+
+
+_MAX_ARGS = ["kernel_size", "stride", "padding", "return_mask", "ceil_mode",
+             "data_format"]
+_AVG1_ARGS = ["kernel_size", "stride", "padding", "exclusive", "ceil_mode",
+              "data_format"]
+_AVG_ARGS = ["kernel_size", "stride", "padding", "ceil_mode", "exclusive",
+             "divisor_override", "data_format"]
+
+MaxPool1D = _pool_layer("MaxPool1D", "max_pool1d", _MAX_ARGS)
+MaxPool2D = _pool_layer("MaxPool2D", "max_pool2d", _MAX_ARGS)
+MaxPool3D = _pool_layer("MaxPool3D", "max_pool3d", _MAX_ARGS)
+AvgPool1D = _pool_layer("AvgPool1D", "avg_pool1d", _AVG1_ARGS)
+AvgPool2D = _pool_layer("AvgPool2D", "avg_pool2d", _AVG_ARGS)
+AvgPool3D = _pool_layer("AvgPool3D", "avg_pool3d", _AVG_ARGS)
+AdaptiveAvgPool1D = _pool_layer("AdaptiveAvgPool1D", "adaptive_avg_pool1d",
+                                ["output_size"])
+AdaptiveAvgPool2D = _pool_layer("AdaptiveAvgPool2D", "adaptive_avg_pool2d",
+                                ["output_size", "data_format"])
+AdaptiveAvgPool3D = _pool_layer("AdaptiveAvgPool3D", "adaptive_avg_pool3d",
+                                ["output_size", "data_format"])
+AdaptiveMaxPool1D = _pool_layer("AdaptiveMaxPool1D", "adaptive_max_pool1d",
+                                ["output_size", "return_mask"])
+AdaptiveMaxPool2D = _pool_layer("AdaptiveMaxPool2D", "adaptive_max_pool2d",
+                                ["output_size", "return_mask"])
+AdaptiveMaxPool3D = _pool_layer("AdaptiveMaxPool3D", "adaptive_max_pool3d",
+                                ["output_size", "return_mask"])

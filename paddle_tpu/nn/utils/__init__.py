@@ -1,0 +1,118 @@
+"""nn.utils (reference: python/paddle/nn/utils/) — clip_grad helpers, param vector
+conversion, weight/spectral norm wrappers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ... import ops
+
+
+def parameters_to_vector(parameters, name=None):
+    return ops.concat([ops.reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        chunk = vec[offset:offset + n]
+        p._value = chunk._value.reshape(tuple(p.shape)).astype(p._value.dtype)
+        offset += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0))
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(g._value)) for g in grads)) \
+        if norm_type == 2.0 else \
+        jnp.power(sum(jnp.sum(jnp.power(jnp.abs(g._value), norm_type))
+                      for g in grads), 1.0 / norm_type)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._value = g._value * clip_coef
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -clip_value, clip_value)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Re-parameterize weight = g * v/||v||. Applied lazily via a forward-pre hook."""
+    import numpy as np
+    from ..layer_base import Parameter
+    w = getattr(layer, name)
+    axes = tuple(i for i in range(w.ndim) if i != (dim % w.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(w._value), axis=axes, keepdims=True))
+    g = Parameter(norm)
+    v = Parameter(w._value)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        vv = getattr(l, name + "_v")
+        gg = getattr(l, name + "_g")
+        nrm = ops.sqrt(ops.sum(vv * vv, axis=list(axes), keepdim=True))
+        object.__setattr__(l, "_wn_cache", gg * vv / nrm)
+        l.__dict__[name] = l._wn_cache
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    from ..layer_base import Parameter
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    axes = tuple(i for i in range(v.ndim))
+    w = g._value * v._value / jnp.sqrt(
+        jnp.sum(jnp.square(v._value),
+                axis=tuple(i for i in range(v.ndim) if g._value.shape[i] == 1),
+                keepdims=True))
+    del layer._parameters[name + "_v"]
+    del layer._parameters[name + "_g"]
+    layer.add_parameter(name, Parameter(w))
+    layer.__dict__.pop(name, None)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=0):
+    import jax
+    from ...core import random as _random
+    from ..layer_base import Parameter
+    w = getattr(layer, name)
+    wv = w._value
+    d = dim % wv.ndim
+    w2d = jnp.moveaxis(wv, d, 0).reshape(wv.shape[d], -1)
+    u0 = jax.random.normal(_random.next_key(), (w2d.shape[0],), jnp.float32)
+    layer.register_buffer(name + "_u", Tensor(u0 / jnp.linalg.norm(u0)), persistable=True)
+    orig = Parameter(wv)
+    layer.add_parameter(name + "_orig", orig)
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        wv_ = getattr(l, name + "_orig")._value
+        u = l._buffers[name + "_u"]._value
+        mat = jnp.moveaxis(wv_, d, 0).reshape(wv_.shape[d], -1)
+        for _ in range(n_power_iterations):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ mat @ v
+        l._buffers[name + "_u"]._value = u
+        l.__dict__[name] = Tensor(wv_ / sigma, stop_gradient=False)
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer

@@ -1,0 +1,9 @@
+"""paddle.optimizer analog."""
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Adadelta, Adamax, Lamb,
+    L2Decay, L1Decay,
+)
+from . import lr  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)

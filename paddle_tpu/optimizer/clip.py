@@ -1,0 +1,62 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py — ClipGradByValue/
+ByNorm/ByGlobalNorm; hybrid-parallel variant in fleet HybridParallelClipGrad).
+
+Clips are pure functions over grad pytrees so they run inside the jitted optimizer
+update (one fused kernel chain) in both eager and compiled training.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def apply(self, params, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def apply(self, params, grads):
+        return [jnp.clip(g, self.min, self.max) if g is not None else None
+                for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor L2 norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, params, grads):
+        out = []
+        for g in grads:
+            if g is None:
+                out.append(None)
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global L2 norm clip across all grads (fp32 accumulation)."""
+
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def global_norm(self, grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in grads if g is not None]
+        if not sq:
+            return jnp.asarray(0.0, jnp.float32)
+        return jnp.sqrt(sum(sq))
+
+    def apply(self, params, grads):
+        norm = self.global_norm(grads)
+        scale = self.clip_norm / jnp.maximum(norm, self.clip_norm)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype)
+                if g is not None else None for g in grads]

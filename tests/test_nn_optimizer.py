@@ -1,0 +1,296 @@
+"""nn layers + optimizer tests (OpTest-style parity vs numpy / analytic results)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_forward_backward():
+    paddle.seed(0)
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = layer(x)
+    assert y.shape == [2, 3]
+    loss = y.sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+    np.testing.assert_allclose(
+        layer.weight.grad.numpy(),
+        x.numpy().T @ np.ones((2, 3)), rtol=1e-5)
+    np.testing.assert_allclose(layer.bias.grad.numpy(), [2, 2, 2], rtol=1e-6)
+
+
+def test_layer_registry_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = dict(net.named_parameters())
+    assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    sd = net.state_dict()
+    net2 = Net()
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net2.fc1.weight.numpy(), net.fc1.weight.numpy())
+    x = paddle.randn([3, 4])
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_conv2d_matches_torch():
+    torch = pytest.importorskip("torch")
+    paddle.seed(0)
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    out = conv(x)
+    tconv = torch.nn.Conv2d(3, 8, 3, stride=2, padding=1)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(conv.weight.numpy()))
+        tconv.bias.copy_(torch.from_numpy(conv.bias.numpy()))
+    tout = tconv(torch.from_numpy(x.numpy()))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_conv2d_transpose_matches_torch():
+    torch = pytest.importorskip("torch")
+    paddle.seed(0)
+    conv = nn.Conv2DTranspose(4, 6, 3, stride=2, padding=1, output_padding=1)
+    x = paddle.randn([2, 4, 8, 8])
+    out = conv(x)
+    tconv = torch.nn.ConvTranspose2d(4, 6, 3, stride=2, padding=1, output_padding=1)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(conv.weight.numpy()))
+        tconv.bias.copy_(torch.from_numpy(conv.bias.numpy()))
+    tout = tconv(torch.from_numpy(x.numpy()))
+    assert out.shape == list(tout.shape)
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 5, 5])
+    bn.train()
+    y = bn(x)
+    # output is normalized per-channel
+    yn = y.numpy()
+    np.testing.assert_allclose(yn.mean(axis=(0, 2, 3)), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(yn.std(axis=(0, 2, 3)), np.ones(4), atol=1e-2)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), np.zeros(4))
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == y.shape
+
+
+def test_layernorm_matches_torch():
+    torch = pytest.importorskip("torch")
+    ln = nn.LayerNorm(16)
+    x = paddle.randn([4, 10, 16])
+    y = ln(x)
+    tln = torch.nn.LayerNorm(16)
+    tout = tln(torch.from_numpy(x.numpy()))
+    np.testing.assert_allclose(y.numpy(), tout.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_maxpool_avgpool():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    y = F.max_pool2d(x, 2, 2)
+    np.testing.assert_allclose(y.numpy().reshape(2, 2), [[5, 7], [13, 15]])
+    y = F.avg_pool2d(x, 2, 2)
+    np.testing.assert_allclose(y.numpy().reshape(2, 2), [[2.5, 4.5], [10.5, 12.5]])
+    y = F.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(y.numpy().reshape(()), 7.5)
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor([[0, 1], [2, 0]], dtype="int64")
+    out = emb(idx)
+    np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4))
+    np.testing.assert_allclose(out.numpy()[1, 1], np.zeros(4))
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_dropout_train_eval():
+    x = paddle.ones([1000])
+    paddle.seed(7)
+    d = nn.Dropout(0.5)
+    y = d(x)
+    frac_zero = float((y.numpy() == 0).mean())
+    assert 0.4 < frac_zero < 0.6
+    # kept values upscaled
+    kept = y.numpy()[y.numpy() != 0]
+    np.testing.assert_allclose(kept, np.full_like(kept, 2.0))
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_cross_entropy_matches_torch():
+    torch = pytest.importorskip("torch")
+    logits = paddle.randn([8, 5])
+    labels = paddle.to_tensor(np.random.RandomState(0).randint(0, 5, (8,)),
+                              dtype="int64")
+    loss = F.cross_entropy(logits, labels)
+    tloss = torch.nn.functional.cross_entropy(
+        torch.from_numpy(logits.numpy()), torch.from_numpy(labels.numpy()))
+    np.testing.assert_allclose(loss.numpy(), tloss.numpy(), rtol=1e-5)
+    # grad check
+    logits2 = paddle.to_tensor(logits.numpy(), stop_gradient=False)
+    F.cross_entropy(logits2, labels).backward()
+    tl = torch.from_numpy(logits.numpy()).requires_grad_(True)
+    torch.nn.functional.cross_entropy(tl, torch.from_numpy(labels.numpy())).backward()
+    np.testing.assert_allclose(logits2.grad.numpy(), tl.grad.numpy(), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_sdpa_matches_reference():
+    torch = pytest.importorskip("torch")
+    paddle.seed(0)
+    q = paddle.randn([2, 6, 4, 8])
+    k = paddle.randn([2, 6, 4, 8])
+    v = paddle.randn([2, 6, 4, 8])
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    tq = torch.from_numpy(q.numpy()).transpose(1, 2)
+    tk = torch.from_numpy(k.numpy()).transpose(1, 2)
+    tv = torch.from_numpy(v.numpy()).transpose(1, 2)
+    tout = torch.nn.functional.scaled_dot_product_attention(
+        tq, tk, tv, is_causal=True).transpose(1, 2)
+    np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_multihead_attention_and_transformer():
+    paddle.seed(0)
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    out = mha(x, x, x)
+    assert out.shape == [2, 5, 16]
+    enc_layer = nn.TransformerEncoderLayer(16, 4, 32)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+    out.sum().backward()
+    assert enc.layers[0].linear1.weight.grad is not None
+
+
+def test_lstm_gru():
+    paddle.seed(0)
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.randn([4, 6, 8])
+    y, (h, c) = lstm(x)
+    assert y.shape == [4, 6, 16]
+    assert h.shape == [2, 4, 16]
+    gru = nn.GRU(8, 16, direction="bidirect")
+    y, h = gru(x)
+    assert y.shape == [4, 6, 32]
+
+
+def test_sgd_momentum_adam_converge():
+    # fit y = 2x + 1 with each optimizer
+    for opt_cls, kwargs in [
+        (paddle.optimizer.SGD, dict(learning_rate=0.1)),
+        (paddle.optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+        (paddle.optimizer.Adam, dict(learning_rate=0.1)),
+        (paddle.optimizer.AdamW, dict(learning_rate=0.1, weight_decay=0.0)),
+    ]:
+        paddle.seed(0)
+        layer = nn.Linear(1, 1)
+        opt = opt_cls(parameters=layer.parameters(), **kwargs)
+        xs = paddle.to_tensor(np.linspace(-1, 1, 32, dtype=np.float32)[:, None])
+        ys = xs * 2.0 + 1.0
+        for _ in range(120):
+            loss = F.mse_loss(layer(xs), ys)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        w = layer.weight.numpy().item()
+        b = layer.bias.numpy().item()
+        assert abs(w - 2.0) < 0.15, (opt_cls.__name__, w)
+        assert abs(b - 1.0) < 0.15, (opt_cls.__name__, b)
+
+
+def test_adam_matches_torch_trajectory():
+    torch = pytest.importorskip("torch")
+    w0 = np.random.RandomState(0).randn(3, 2).astype(np.float32)
+    g = np.random.RandomState(1).randn(3, 2).astype(np.float32)
+
+    p = paddle.Tensor(__import__("jax.numpy", fromlist=["asarray"]).asarray(w0))
+    p.stop_gradient = False
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+
+    tp = torch.from_numpy(w0.copy()).requires_grad_(True)
+    topt = torch.optim.Adam([tp], lr=0.01)
+    for _ in range(5):
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        tp.grad = torch.from_numpy(g.copy())
+        topt.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_grad_clip_global_norm():
+    p = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    clip = paddle.optimizer.ClipGradByGlobalNorm(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+    p.grad = paddle.to_tensor(np.full(4, 10.0, np.float32))
+    opt.step()
+    # grad norm was 20 -> clipped to 1.0 -> update = grad/20
+    np.testing.assert_allclose(p.numpy(), 1.0 - 10.0 / 20.0, rtol=1e-5)
+
+
+def test_lr_schedulers():
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(sched.last_lr)
+        sched.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    warm = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0,
+                                            end_lr=0.1)
+    vals = [warm.last_lr]
+    for _ in range(4):
+        warm.step()
+        vals.append(warm.last_lr)
+    np.testing.assert_allclose(vals, [0.0, 0.025, 0.05, 0.075, 0.1])
+
+    cos = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(cos.last_lr - 1.0) < 1e-6
+
+
+def test_bf16_master_weights():
+    p = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False).astype("bfloat16")
+    p.stop_gradient = False
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=[p])
+    for _ in range(10):
+        p.grad = paddle.to_tensor(np.full(4, 1e-3, np.float32)).astype("bfloat16")
+        opt.step()
+    # master fp32 accumulates tiny updates that bf16 alone would lose
+    slots = opt._slots[id(p)]
+    assert "master_weight" in slots
+    assert slots["master_weight"].dtype == np.float32
+
+
+def test_optimizer_state_dict_roundtrip():
+    layer = nn.Linear(2, 2)
+    for i, (n, p) in enumerate(layer.named_parameters()):
+        p.name = n
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=layer.parameters())
+    x = paddle.randn([4, 2])
+    F.mse_loss(layer(x), paddle.zeros([4, 2])).backward()
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=layer.parameters())
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    k = id(layer.parameters()[0])
+    np.testing.assert_allclose(np.asarray(opt2._slots[k]["moment1"]),
+                               np.asarray(opt._slots[k]["moment1"]))
