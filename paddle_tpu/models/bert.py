@@ -1,0 +1,112 @@
+"""BERT (BASELINE config 2: BERT-base MLM pretraining, DP-only).
+
+Reference analog: PaddleNLP BERT on paddle.nn.TransformerEncoder.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..nn import (
+    Layer, Linear, Embedding, LayerNorm, Dropout, TransformerEncoder,
+    TransformerEncoderLayer, Tanh, GELU,
+)
+from ..nn import functional as F
+from ..core.tensor import Tensor
+from .. import ops
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def base(**over):
+        return BertConfig(**over)
+
+    @staticmethod
+    def tiny(**over):
+        return BertConfig(**{**dict(vocab_size=1024, hidden_size=128,
+                                    num_hidden_layers=2, num_attention_heads=4,
+                                    intermediate_size=256,
+                                    max_position_embeddings=128), **over})
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size)
+        self.position_embeddings = Embedding(c.max_position_embeddings, c.hidden_size)
+        self.token_type_embeddings = Embedding(c.type_vocab_size, c.hidden_size)
+        self.layer_norm = LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = ops.arange(s, dtype="int64")
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.embeddings = BertEmbeddings(c)
+        enc_layer = TransformerEncoderLayer(
+            c.hidden_size, c.num_attention_heads, c.intermediate_size,
+            dropout=c.hidden_dropout_prob, activation="gelu",
+            attn_dropout=c.attention_probs_dropout_prob,
+            act_dropout=0.0, layer_norm_eps=c.layer_norm_eps)
+        self.encoder = TransformerEncoder(enc_layer, c.num_hidden_layers)
+        self.pooler = Linear(c.hidden_size, c.hidden_size)
+        self.pooler_act = Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            am = ops.unsqueeze(attention_mask, [1, 2])
+            am = (1.0 - am.astype("float32")) * -1e9
+        else:
+            am = None
+        x = self.encoder(x, am)
+        pooled = self.pooler_act(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.bert = BertModel(c)
+        self.transform = Linear(c.hidden_size, c.hidden_size)
+        self.transform_act = GELU()
+        self.transform_norm = LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.decoder = Linear(c.hidden_size, c.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_norm(self.transform_act(self.transform(seq)))
+        logits = self.decoder(h)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            ops.reshape(logits, [-1, self.config.vocab_size]).astype("float32"),
+            ops.reshape(labels, [-1]), ignore_index=-100)
+        return loss, logits

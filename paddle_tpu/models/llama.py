@@ -1,0 +1,201 @@
+"""Llama-family decoder LM — the flagship/north-star model.
+
+Reference analog: the reference trains Llama through PaddleNLP on top of fleet TP
+layers + flash-attn + fused rope/rms kernels
+(test/auto_parallel/hybrid_strategy/semi_auto_llama.py is the in-tree config).
+
+TPU-first design decisions:
+- bf16 weights + fp32 RMSNorm accumulation (MXU-native dtypes)
+- attention through F.scaled_dot_product_attention → Pallas flash kernel on TPU
+- rope applied in fp32 with precomputed cos/sin cache (fused by XLA)
+- mesh sharding annotations live OUTSIDE the model (distributed.shard_llama applies
+  GSPMD NamedShardings over a dp/tp mesh) so the same module runs 1-chip or pod.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import Layer, Linear, Embedding, RMSNorm, LayerList
+from ..nn import functional as F
+from ..core.tensor import Tensor, dispatch
+from .. import ops
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    dtype: str = "float32"
+
+    @staticmethod
+    def llama2_7b(**over):
+        return LlamaConfig(**{**dict(hidden_size=4096, intermediate_size=11008,
+                                     num_hidden_layers=32, num_attention_heads=32),
+                              **over})
+
+    @staticmethod
+    def tiny(**over):
+        return LlamaConfig(**{**dict(vocab_size=1024, hidden_size=128,
+                                     intermediate_size=352, num_hidden_layers=2,
+                                     num_attention_heads=4, num_key_value_heads=4,
+                                     max_position_embeddings=256), **over})
+
+
+def precompute_rope(head_dim, max_len, theta=10000.0):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                                / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)                      # [T, D/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)      # [T, D]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def apply_rope(q, k, cos, sin, position_offset=0):
+    """q,k: [B, S, H, D]; rotate-half formulation in fp32."""
+    s = q.shape[1]
+    cos_t = jax.lax.dynamic_slice_in_dim(cos, position_offset, s, 0)[None, :, None, :]
+    sin_t = jax.lax.dynamic_slice_in_dim(sin, position_offset, s, 0)[None, :, None, :]
+
+    def rot(x):
+        x32 = x.astype(jnp.float32)
+        half = x.shape[-1] // 2
+        x1, x2 = x32[..., :half], x32[..., half:]
+        rotated = jnp.concatenate([-x2, x1], axis=-1)
+        return (x32 * cos_t + rotated * sin_t).astype(x.dtype)
+    return rot(q), rot(k)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_attention_heads
+        self.num_kv_heads = c.num_key_value_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.q_proj = Linear(c.hidden_size, self.num_heads * self.head_dim,
+                             bias_attr=False)
+        self.k_proj = Linear(c.hidden_size, self.num_kv_heads * self.head_dim,
+                             bias_attr=False)
+        self.v_proj = Linear(c.hidden_size, self.num_kv_heads * self.head_dim,
+                             bias_attr=False)
+        self.o_proj = Linear(self.num_heads * self.head_dim, c.hidden_size,
+                             bias_attr=False)
+        self.config = c
+
+    def forward(self, x, rope_cache, attn_mask=None, kv_cache=None, position_offset=0):
+        b, s = x.shape[0], x.shape[1]
+        q = ops.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
+        k = ops.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        v = ops.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        cos, sin = rope_cache
+        q, k = dispatch(lambda qq, kk: apply_rope(qq, kk, cos, sin, position_offset),
+                        (q, k), {}, name="rope")
+        if kv_cache is not None:
+            k = ops.concat([kv_cache[0], k], axis=1)
+            v = ops.concat([kv_cache[1], v], axis=1)
+            kv_cache = (k, v)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=(attn_mask is None),
+            training=self.training)
+        out = ops.reshape(out, [b, s, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        return (out, kv_cache) if kv_cache is not None else out
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.gate_proj = Linear(c.hidden_size, c.intermediate_size, bias_attr=False)
+        self.up_proj = Linear(c.hidden_size, c.intermediate_size, bias_attr=False)
+        self.down_proj = Linear(c.intermediate_size, c.hidden_size, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+
+    def forward(self, x, rope_cache, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), rope_cache, attn_mask)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        head_dim = config.hidden_size // config.num_attention_heads
+        cos, sin = precompute_rope(head_dim, config.max_position_embeddings,
+                                   config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        rope = (self.rope_cos._value, self.rope_sin._value)
+        for layer in self.layers:
+            x = layer(x, rope, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        hidden = self.llama(input_ids, attn_mask)
+        if self.config.tie_word_embeddings:
+            logits = ops.matmul(hidden, self.llama.embed_tokens.weight,
+                                transpose_y=True)
+        else:
+            logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            ops.reshape(logits, [-1, self.config.vocab_size]).astype("float32"),
+            ops.reshape(labels, [-1]), ignore_index=-100)
+        return loss, logits
+
+    def flops_per_token(self, seq_len):
+        """Model FLOPs per token (fwd+bwd 3x fwd) for MFU accounting."""
+        c = self.config
+        d, L = c.hidden_size, c.num_hidden_layers
+        ff = c.intermediate_size
+        per_layer = (
+            2 * d * d * (1 + 2 * c.num_key_value_heads / c.num_attention_heads + 1)
+            + 2 * 2 * d * seq_len / 2  # attention scores+values (causal half)
+            + 2 * 3 * d * ff
+        )
+        embed = 2 * d * c.vocab_size
+        return 3 * (L * per_layer + embed)

@@ -17,7 +17,7 @@ def _tuple(v, n):
     return tuple(int(x) for x in v)
 
 
-def _pool(x, kernel, stride, padding, n, reducer, init, channel_last,
+def _pool(x, kernel, stride, padding, n, reducer, init_scalar, channel_last,
           ceil_mode=False, count_include_pad=True, divisor_override=None,
           is_avg=False, exclusive=True):
     k = _tuple(kernel, n)
@@ -56,25 +56,23 @@ def _pool(x, kernel, stride, padding, n, reducer, init, channel_last,
                 if rem != 0:
                     pads[ax] = (pads[ax][0], pads[ax][1] + (s[i] - rem))
         if is_avg:
-            summed = jax.lax.reduce_window(v, 0.0 if v.dtype != jnp.bfloat16 else
-                                           jnp.asarray(0.0, v.dtype),
-                                           jax.lax.add, window, strides, pads)
+            summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides, pads)
             if divisor_override:
                 return summed / divisor_override
             if exclusive and any(pp != (0, 0) for pp in pads):
                 ones = jnp.ones_like(v)
-                counts = jax.lax.reduce_window(ones, jnp.asarray(0.0, v.dtype),
-                                               jax.lax.add, window, strides, pads)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                               window, strides, pads)
                 return summed / counts
             return summed / float(np.prod(k))
-        return jax.lax.reduce_window(v, init(v.dtype), reducer, window, strides, pads)
+        return jax.lax.reduce_window(v, init_scalar, reducer, window, strides, pads)
     return fn
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     fn = _pool(x, kernel_size, stride, padding, 1, jax.lax.max,
-               lambda d: jnp.asarray(-jnp.inf, d), data_format.endswith("C") and
+               -jnp.inf, data_format.endswith("C") and
                data_format != "NCL", ceil_mode)
     return dispatch(fn, (x,), {}, name="max_pool1d")
 
@@ -82,7 +80,7 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     fn = _pool(x, kernel_size, stride, padding, 2, jax.lax.max,
-               lambda d: jnp.asarray(-jnp.inf, d), data_format == "NHWC", ceil_mode)
+               -jnp.inf, data_format == "NHWC", ceil_mode)
     out = dispatch(fn, (x,), {}, name="max_pool2d")
     if return_mask:
         idx = _max_pool_mask(x, kernel_size, stride, padding, data_format)
@@ -93,7 +91,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     fn = _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
-               lambda d: jnp.asarray(-jnp.inf, d), data_format == "NDHWC", ceil_mode)
+               -jnp.inf, data_format == "NDHWC", ceil_mode)
     return dispatch(fn, (x,), {}, name="max_pool3d")
 
 
@@ -125,7 +123,7 @@ def _max_pool_mask(x, kernel_size, stride, padding, data_format):
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
                ceil_mode=False, data_format="NCL", name=None):
     fn = _pool(x, kernel_size, stride, padding, 1, jax.lax.add,
-               lambda d: jnp.asarray(0.0, d), False, ceil_mode, is_avg=True,
+               0.0, False, ceil_mode, is_avg=True,
                exclusive=exclusive)
     return dispatch(fn, (x,), {}, name="avg_pool1d")
 
@@ -133,7 +131,7 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW", name=None):
     fn = _pool(x, kernel_size, stride, padding, 2, jax.lax.add,
-               lambda d: jnp.asarray(0.0, d), data_format == "NHWC", ceil_mode,
+               0.0, data_format == "NHWC", ceil_mode,
                is_avg=True, divisor_override=divisor_override, exclusive=exclusive)
     return dispatch(fn, (x,), {}, name="avg_pool2d")
 
@@ -141,7 +139,7 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
     fn = _pool(x, kernel_size, stride, padding, 3, jax.lax.add,
-               lambda d: jnp.asarray(0.0, d), data_format == "NDHWC", ceil_mode,
+               0.0, data_format == "NDHWC", ceil_mode,
                is_avg=True, divisor_override=divisor_override, exclusive=exclusive)
     return dispatch(fn, (x,), {}, name="avg_pool3d")
 
@@ -153,7 +151,7 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False
     def fn(v):
         powed = jnp.power(jnp.abs(v), pw)
         pool = _pool(None, kernel_size, stride, padding, 2, jax.lax.add,
-                     lambda d: jnp.asarray(0.0, d), data_format == "NHWC", ceil_mode,
+                     0.0, data_format == "NHWC", ceil_mode,
                      is_avg=False)(powed)
         return jnp.power(pool, 1.0 / pw)
     return dispatch(fn, (x,), {}, name="lp_pool2d")

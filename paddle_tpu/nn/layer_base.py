@@ -254,7 +254,9 @@ class Layer:
                 raise ValueError(
                     f"shape mismatch for {name}: got {tuple(v.shape)}, "
                     f"expected {tuple(target.shape)}")
-            target._value = v.astype(target._value.dtype)
+            # explicit copy: the source may belong to another live model whose
+            # buffers get donated by a compiled train step
+            target._value = jnp.array(v, dtype=target._value.dtype, copy=True)
         for name in own:
             if name not in state_dict:
                 missing.append(name)

@@ -1,0 +1,50 @@
+"""Synthetic/stub datasets (the reference downloads MNIST/Cifar; zero-egress here).
+
+FakeImageDataset stands in for ImageNet-style loaders in benchmarks and tests.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class FakeImageDataset(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, num_samples=1024, image_shape=(3, 224, 224), num_classes=1000,
+                 seed=0, dtype="float32"):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.seed = seed
+        self.dtype = dtype
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self.seed + idx)
+        img = rng.standard_normal(self.image_shape).astype(self.dtype)
+        label = np.int64(rng.integers(0, self.num_classes))
+        return img, label
+
+    def __len__(self):
+        return self.num_samples
+
+
+class FakeTextDataset(Dataset):
+    """Deterministic synthetic LM token data (input_ids, labels)."""
+
+    def __init__(self, num_samples=1024, seq_len=512, vocab_size=32000, seed=0):
+        self.num_samples, self.seq_len = num_samples, seq_len
+        self.vocab_size, self.seed = vocab_size, seed
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self.seed + idx)
+        ids = rng.integers(0, self.vocab_size, self.seq_len + 1, dtype=np.int64)
+        return ids[:-1], ids[1:]
+
+    def __len__(self):
+        return self.num_samples
+
+
+MNIST = None  # requires download; out of scope in a zero-egress environment
+Cifar10 = None

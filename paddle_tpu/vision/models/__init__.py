@@ -1,0 +1,5 @@
+from .resnet import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152, resnext50_32x4d,
+    wide_resnet50_2, BasicBlock, BottleneckBlock,
+)
+from .vit import VisionTransformer, vit_base_patch16, vit_large_patch16  # noqa: F401

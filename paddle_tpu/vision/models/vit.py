@@ -1,0 +1,108 @@
+"""Vision Transformer (BASELINE config 4: ViT-L semi-auto sharding).
+
+Reference analog: ViT lives in PaddleClas on top of paddle.nn; here it is in-tree
+since it is a named baseline workload.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn import (
+    Layer, Linear, LayerNorm, Dropout, Conv2D, LayerList, GELU, Sequential,
+)
+from ...nn.layer_base import Parameter
+from ...nn import functional as F
+from ...core.tensor import Tensor
+from ... import ops
+
+
+class PatchEmbed(Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = Conv2D(in_chans, embed_dim, patch_size, stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)                       # [B, E, H/p, W/p]
+        b, e = x.shape[0], x.shape[1]
+        x = ops.reshape(x, [b, e, -1])
+        return ops.transpose(x, [0, 2, 1])     # [B, N, E]
+
+
+class ViTAttention(Layer):
+    def __init__(self, dim, num_heads, qkv_bias=True, attn_drop=0.0, proj_drop=0.0):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.qkv = Linear(dim, dim * 3, bias_attr=None if qkv_bias else False)
+        self.proj = Linear(dim, dim)
+        self.attn_drop = attn_drop
+        self.proj_dropout = Dropout(proj_drop)
+
+    def forward(self, x):
+        b, n, c = x.shape
+        qkv = ops.reshape(self.qkv(x), [b, n, 3, self.num_heads, self.head_dim])
+        q, k, v = ops.unbind(ops.transpose(qkv, [2, 0, 1, 3, 4]), axis=0)
+        out = F.scaled_dot_product_attention(q, k, v, dropout_p=self.attn_drop,
+                                             training=self.training)
+        out = ops.reshape(out, [b, n, c])
+        return self.proj_dropout(self.proj(out))
+
+
+class ViTBlock(Layer):
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, qkv_bias=True, drop=0.0,
+                 attn_drop=0.0):
+        super().__init__()
+        self.norm1 = LayerNorm(dim, 1e-6)
+        self.attn = ViTAttention(dim, num_heads, qkv_bias, attn_drop, drop)
+        self.norm2 = LayerNorm(dim, 1e-6)
+        hidden = int(dim * mlp_ratio)
+        self.mlp = Sequential(Linear(dim, hidden), GELU(), Dropout(drop),
+                              Linear(hidden, dim), Dropout(drop))
+
+    def forward(self, x):
+        x = x + self.attn(self.norm1(x))
+        x = x + self.mlp(self.norm2(x))
+        return x
+
+
+class VisionTransformer(Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, num_classes=1000,
+                 embed_dim=768, depth=12, num_heads=12, mlp_ratio=4.0, qkv_bias=True,
+                 drop_rate=0.0, attn_drop_rate=0.0, **kwargs):
+        super().__init__()
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans, embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = Parameter(jnp.zeros((1, 1, embed_dim), jnp.float32))
+        from ...core import random as _random
+        import jax
+        self.pos_embed = Parameter(
+            0.02 * jax.random.normal(_random.next_key(), (1, n + 1, embed_dim),
+                                     jnp.float32))
+        self.pos_drop = Dropout(drop_rate)
+        self.blocks = LayerList([
+            ViTBlock(embed_dim, num_heads, mlp_ratio, qkv_bias, drop_rate,
+                     attn_drop_rate) for _ in range(depth)])
+        self.norm = LayerNorm(embed_dim, 1e-6)
+        self.head = Linear(embed_dim, num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.patch_embed(x)
+        b = x.shape[0]
+        cls = ops.expand(self.cls_token, [b, -1, -1])
+        x = ops.concat([cls, x], axis=1)
+        x = self.pos_drop(x + self.pos_embed)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        if self.head is not None:
+            return self.head(x[:, 0])
+        return x[:, 0]
+
+
+def vit_base_patch16(**kwargs):
+    return VisionTransformer(embed_dim=768, depth=12, num_heads=12, **kwargs)
+
+
+def vit_large_patch16(**kwargs):
+    return VisionTransformer(embed_dim=1024, depth=24, num_heads=16, **kwargs)

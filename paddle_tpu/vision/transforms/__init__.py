@@ -1,0 +1,128 @@
+"""Minimal vision transforms (reference: python/paddle/vision/transforms/).
+
+Numpy-based host-side preprocessing for DataLoader pipelines.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class BaseTransform:
+    def __call__(self, x):
+        return self._apply_image(x)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return Tensor(arr)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img, np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        out = (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        import jax
+        import jax.numpy as jnp
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img, np.float32)
+        hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        if hwc:
+            new_shape = (*self.size, arr.shape[-1])
+        else:
+            new_shape = (arr.shape[0], *self.size)
+        out = np.asarray(jax.image.resize(jnp.asarray(arr), new_shape, "bilinear"))
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+        h, w = arr.shape[-3:-1] if arr.shape[-1] in (1, 3, 4) else arr.shape[-2:]
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        if arr.ndim == 3 and arr.shape[-1] in (1, 3, 4):
+            out = arr[i:i + th, j:j + tw, :]
+        else:
+            out = arr[..., i:i + th, j:j + tw]
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+            if arr.ndim == 3 and arr.shape[-1] in (1, 3, 4):
+                out = arr[:, ::-1].copy()
+            else:
+                out = arr[..., ::-1].copy()
+            return Tensor(out) if isinstance(img, Tensor) else out
+        return img
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+        chw = not (arr.ndim == 3 and arr.shape[-1] in (1, 3, 4))
+        h, w = arr.shape[-2:] if chw else arr.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        out = arr[..., i:i + th, j:j + tw] if chw else arr[i:i + th, j:j + tw, :]
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)

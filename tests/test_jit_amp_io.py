@@ -1,0 +1,222 @@
+"""jit/to_static, TrainStep, AMP, DataLoader, and model end-to-end tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import to_static, TrainStep
+from paddle_tpu import io
+
+
+def test_to_static_matches_eager():
+    paddle.seed(0)
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    eager = layer(x)
+
+    @to_static
+    def fwd(inp):
+        return layer(inp)
+
+    out = fwd(x)
+    np.testing.assert_allclose(out.numpy(), eager.numpy(), rtol=1e-6)
+    # second call hits the cache
+    out2 = fwd(x * 2)
+    np.testing.assert_allclose(out2.numpy(), layer(x * 2).numpy(), rtol=1e-6)
+    assert len(fwd._cache) == 1
+
+
+def test_to_static_weight_update_no_recompile():
+    layer = nn.Linear(2, 2)
+    fwd = to_static(lambda inp: layer(inp))
+    x = paddle.randn([1, 2])
+    out1 = fwd(x)
+    layer.weight._value = layer.weight._value * 2
+    out2 = fwd(x)
+    assert len(fwd._cache) == 1
+    assert not np.allclose(out1.numpy(), out2.numpy())
+
+
+def test_to_static_buffer_mutation_batchnorm():
+    bn = nn.BatchNorm1D(4)
+    bn.train()
+    fwd = to_static(lambda inp: bn(inp))
+    x = paddle.randn([16, 4])
+    before = bn._mean.numpy().copy()
+    fwd(x)
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after)
+
+
+def test_train_step_resnet_tiny():
+    paddle.seed(0)
+    from paddle_tpu.vision.models import resnet18
+    model = resnet18(num_classes=10)
+    model.train()
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                    parameters=model.parameters())
+
+    def loss_fn(m, images, labels):
+        return F.cross_entropy(m(images), labels)
+
+    step = TrainStep(model, loss_fn, opt)
+    x = paddle.randn([2, 3, 32, 32])
+    y = paddle.to_tensor(np.array([1, 2]), dtype="int64")
+    losses = [float(step(x, y).numpy()) for _ in range(3)]
+    assert losses[2] < losses[0]
+    assert len(step._cache) == 1
+
+
+def test_train_step_matches_eager_loop():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    model2 = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+    model2.set_state_dict(model.state_dict())
+
+    opt1 = paddle.optimizer.Adam(learning_rate=0.01, parameters=model.parameters())
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=model2.parameters())
+
+    def loss_fn(m, x, y):
+        return F.mse_loss(m(x), y)
+
+    step = TrainStep(model, loss_fn, opt1)
+    xs = paddle.to_tensor(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    ys = paddle.to_tensor(np.random.RandomState(1).randn(8, 2).astype(np.float32))
+    for _ in range(5):
+        jl = step(xs, ys)
+        el = loss_fn(model2, xs, ys)
+        el.backward()
+        opt2.step()
+        opt2.clear_grad()
+        np.testing.assert_allclose(jl.numpy(), el.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(model[0].weight.numpy(), model2[0].weight.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_amp_autocast_casts_matmul():
+    import paddle_tpu.amp as amp
+    x = paddle.randn([4, 4])
+    y = paddle.randn([4, 4])
+    with amp.auto_cast(dtype="bfloat16"):
+        out = paddle.matmul(x, y)
+    assert out.dtype == paddle.bfloat16
+    out2 = paddle.matmul(x, y)
+    assert out2.dtype == paddle.float32
+
+
+def test_amp_grad_scaler():
+    import paddle_tpu.amp as amp
+    layer = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=layer.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=128.0)
+    x = paddle.randn([2, 4])
+    loss = layer(x).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    assert opt._step_count == 1
+
+
+def test_jit_save_load(tmp_path):
+    layer = nn.Linear(3, 3)
+    path = str(tmp_path / "model")
+    paddle.jit.save(layer, path)
+    state = paddle.jit.load(path)
+    np.testing.assert_allclose(state["weight"].numpy(), layer.weight.numpy())
+
+
+def test_dataloader_batching():
+    ds = io.TensorDataset([np.arange(20, dtype=np.float32).reshape(10, 2),
+                           np.arange(10, dtype=np.int64)])
+    loader = io.DataLoader(ds, batch_size=4, shuffle=False, drop_last=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    x0, y0 = batches[0]
+    assert x0.shape == [4, 2]
+    assert y0.dtype == paddle.int64
+    np.testing.assert_allclose(y0.numpy(), [0, 1, 2, 3])
+
+
+def test_dataloader_workers_and_shuffle():
+    ds = io.TensorDataset([np.arange(64, dtype=np.float32)[:, None]])
+    loader = io.DataLoader(ds, batch_size=8, shuffle=True, num_workers=2)
+    seen = np.concatenate([b[0].numpy().ravel() for b in loader])
+    assert sorted(seen.tolist()) == list(range(64))
+
+
+def test_distributed_batch_sampler():
+    ds = io.TensorDataset([np.arange(20, dtype=np.float32)[:, None]])
+    s0 = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    s1 = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 10
+    assert set(i0) & set(i1) == set()
+
+
+def test_llama_tiny_forward_and_loss():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16)), dtype="int64")
+    logits = model(ids)
+    assert logits.shape == [2, 16, cfg.vocab_size]
+    loss, _ = model(ids, labels=ids)
+    assert loss.size == 1
+    loss.backward()
+    assert model.llama.layers[0].self_attn.q_proj.weight.grad is not None
+
+
+def test_llama_tiny_train_step_loss_decreases():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    model.train()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+
+    def loss_fn(m, ids, labels):
+        loss, _ = m(ids, labels=labels)
+        return loss
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 32)), dtype="int64")
+    losses = [float(step(ids, ids).numpy()) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_bert_tiny_mlm():
+    from paddle_tpu.models import BertConfig, BertForMaskedLM
+    paddle.seed(0)
+    cfg = BertConfig.tiny()
+    model = BertForMaskedLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 12)), dtype="int64")
+    loss, logits = model(ids, labels=ids)
+    assert logits.shape == [2, 12, cfg.vocab_size]
+    assert np.isfinite(loss.numpy())
+
+
+def test_vit_tiny_forward():
+    from paddle_tpu.vision.models import VisionTransformer
+    paddle.seed(0)
+    model = VisionTransformer(img_size=32, patch_size=8, embed_dim=64, depth=2,
+                              num_heads=4, num_classes=10)
+    x = paddle.randn([2, 3, 32, 32])
+    out = model(x)
+    assert out.shape == [2, 10]
+
+
+def test_rng_in_jit_varies_per_step():
+    drop = nn.Dropout(0.5)
+    drop.train()
+    fwd = to_static(lambda x: drop(x))
+    x = paddle.ones([64])
+    a = fwd(x).numpy()
+    b = fwd(x).numpy()
+    assert not np.allclose(a, b)  # dropout mask must differ across compiled calls
