@@ -1,0 +1,31 @@
+"""paddle.distributed analog — TPU-native distributed stack.
+
+Map (SURVEY §5.8, §2.7): rendezvous = TCPStore + jax.distributed.initialize;
+device collectives = compiled XLA ops over ICI/DCN; DistTensor = mesh-placed
+jax.Array + DistMeta; fleet = hybrid-parallel orchestration (TP/PP/ZeRO/SP/EP)
+over GSPMD + shard_map.
+"""
+from .mesh import (  # noqa: F401
+    ProcessMesh, Placement, Shard, Replicate, Partial,
+)
+from .api import (  # noqa: F401
+    shard_tensor, reshard, shard_layer, shard_optimizer, dtensor_from_local,
+    dtensor_to_local, is_dist_tensor, full_value, logical_shape, DistMeta,
+    ShardingStage1, ShardingStage2, ShardingStage3,
+)
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, is_initialized, new_group,
+    get_group, barrier, Group, get_backend, destroy_process_group,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, all_reduce, all_gather, all_gather_object, broadcast,
+    broadcast_object_list, reduce, reduce_scatter, all_to_all, scatter, send, recv,
+    isend, irecv, P2POp, batch_isend_irecv, functional,
+)
+from .store import TCPStore, create_or_get_global_tcp_store  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .auto_parallel_intermediate import parallelize  # noqa: F401
+from .sharding import group_sharded_parallel  # noqa: F401
+from .launch_utils import spawn  # noqa: F401

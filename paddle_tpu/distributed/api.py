@@ -1,0 +1,268 @@
+"""Semi-auto (DTensor) API: shard_tensor / reshard / shard_layer / shard_optimizer.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (shard_tensor:220,
+reshard:797, shard_layer:908, shard_optimizer:1735) + the C++ reshard engine
+(phi/core/distributed/auto_parallel/reshard/*_reshard_function.cc — the full
+{r,s,p} x {r,s,p} transition matrix, nd-mesh and cross-mesh functions).
+
+TPU-native: a DistTensor is a normal Tensor whose `_value` is a jax.Array with a
+NamedSharding, plus `_dist_meta = DistMeta(mesh, placements)`. Partial placements
+carry an explicit leading reduction dim (see mesh.py docstring), so EVERY transition
+in the reference's reshard matrix lowers to one jnp expression + device_put, with
+XLA emitting the actual collectives (all_gather for s->r, all_reduce for p->r,
+reduce_scatter for p->s, all_to_all for s->s dim moves, send/recv for cross-mesh).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer, Parameter
+from .mesh import (
+    ProcessMesh, Placement, Shard, Replicate, Partial, placements_to_spec,
+    sharding_for,
+)
+
+
+@dataclass
+class DistMeta:
+    mesh: ProcessMesh
+    placements: tuple  # one per mesh axis; Partial axes have leading dims in _value
+
+    @property
+    def partial_axes(self):
+        return [i for i, p in enumerate(self.placements) if p.is_partial()]
+
+
+def _spec_with_partials(meta: DistMeta, logical_ndim: int) -> PartitionSpec:
+    """PartitionSpec for the STORED value (leading partial dims + logical dims)."""
+    names = meta.mesh.dim_names
+    partial_axes = meta.partial_axes
+    lead = [names[i] for i in partial_axes]
+    body_spec = placements_to_spec(meta.placements, logical_ndim, names)
+    return PartitionSpec(*lead, *body_spec)
+
+
+def _stored_sharding(meta: DistMeta, logical_ndim: int) -> NamedSharding:
+    return NamedSharding(meta.mesh.jax_mesh(), _spec_with_partials(meta, logical_ndim))
+
+
+def is_dist_tensor(t) -> bool:
+    return isinstance(t, Tensor) and t._dist_meta is not None
+
+
+def logical_shape(t: Tensor):
+    if not is_dist_tensor(t):
+        return tuple(t._value.shape)
+    k = len(t._dist_meta.partial_axes)
+    return tuple(t._value.shape[k:])
+
+
+def shard_tensor(x, mesh: ProcessMesh, placements, dtype=None, place=None,
+                 stop_gradient=None):
+    """paddle.distributed.shard_tensor (api.py:220 analog)."""
+    t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    placements = tuple(placements)
+    assert len(placements) == mesh.ndim, \
+        f"need {mesh.ndim} placements (one per mesh dim), got {len(placements)}"
+    val = t._value
+    meta = DistMeta(mesh, placements)
+    if meta.partial_axes:
+        # materialize leading partial dims: slot 0 owns the value, rest zero
+        # (reference r_to_p semantics: non-owner ranks hold zeros)
+        for ax in reversed(meta.partial_axes):
+            n = mesh.shape[ax]
+            val = jnp.concatenate(
+                [val[None], jnp.zeros((n - 1,) + val.shape, val.dtype)], axis=0)
+    sharded = jax.device_put(val, _stored_sharding(meta, t._value.ndim))
+    out = Tensor(sharded, stop_gradient=t.stop_gradient if stop_gradient is None
+                 else stop_gradient, name=t.name)
+    out._dist_meta = meta
+    if isinstance(t, Parameter):
+        p = Parameter(sharded, trainable=not t.stop_gradient, name=t.name)
+        p._dist_meta = meta
+        return p
+    return out
+
+
+def dtensor_from_local(local, mesh, placements):
+    """Construct from per-rank locals — single-controller: local IS global shard."""
+    return shard_tensor(local, mesh, placements)
+
+
+def dtensor_to_local(t, mesh=None, placements=None):
+    return Tensor(t._value, stop_gradient=t.stop_gradient)
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:
+    """paddle.distributed.reshard (api.py:797 analog) — full transition matrix."""
+    placements = tuple(placements)
+    if not is_dist_tensor(x):
+        return shard_tensor(x, mesh, placements)
+    src = x._dist_meta
+    dst = DistMeta(mesh, placements)
+    if src.mesh == dst.mesh and tuple(src.placements) == placements:
+        return x
+
+    val = x._value
+    src_partials = src.partial_axes
+    logical_nd = val.ndim - len(src_partials)
+
+    same_mesh = src.mesh == dst.mesh
+
+    # 1) collapse partial axes that are no longer partial in dst (p->r / p->s):
+    #    sum over their leading dims — XLA emits all_reduce/reduce_scatter once we
+    #    constrain the output sharding below.
+    keep_lead = []  # mesh-axis indices kept partial (ascending = leading dim order)
+    sum_dims = []
+    for pos, ax in enumerate(src_partials):
+        if same_mesh and placements[ax].is_partial():
+            keep_lead.append(ax)
+        else:
+            sum_dims.append(pos)
+    if sum_dims:
+        # leading dims are ordered by mesh-axis index; sum the dropped ones
+        val = jnp.sum(val, axis=tuple(sum_dims))
+
+    # 2) cross-mesh: value now carries only kept partial leading dims
+    if not same_mesh:
+        # cross-mesh reshard (same_status / global_and_sub_mesh analog):
+        # materialize fully (sum remaining partials) then place on the new mesh
+        if keep_lead:
+            val = jnp.sum(val, axis=tuple(range(len(keep_lead))))
+            keep_lead = []
+        new_meta = DistMeta(dst.mesh, placements)
+        if new_meta.partial_axes:
+            for ax in reversed(new_meta.partial_axes):
+                n = dst.mesh.shape[ax]
+                val = jnp.concatenate(
+                    [val[None], jnp.zeros((n - 1,) + val.shape, val.dtype)], axis=0)
+        out_val = jax.device_put(val, _stored_sharding(new_meta, logical_nd))
+        out = Tensor(out_val, stop_gradient=x.stop_gradient, name=x.name)
+        out._dist_meta = new_meta
+        return out
+
+    # 3) same mesh: add new partial leading dims (r->p, s->p) at their sorted slot
+    new_partials = DistMeta(dst.mesh, placements).partial_axes
+    import bisect
+    for ax in [a for a in new_partials if a not in keep_lead]:
+        n = mesh.shape[ax]
+        pos = bisect.bisect_left(keep_lead, ax)
+        expanded = jnp.concatenate(
+            [val[None], jnp.zeros((n - 1,) + val.shape, val.dtype)], axis=0)
+        val = jnp.moveaxis(expanded, 0, pos)
+        keep_lead.insert(pos, ax)
+
+    new_meta = DistMeta(dst.mesh, placements)
+    out_val = jax.device_put(val, _stored_sharding(new_meta, logical_nd))
+    out = Tensor(out_val, stop_gradient=x.stop_gradient, name=x.name)
+    out._dist_meta = new_meta
+    return out
+
+
+def full_value(x: Tensor):
+    """Materialize the logical (replicated) value of any DistTensor."""
+    if not is_dist_tensor(x):
+        return x._value
+    k = len(x._dist_meta.partial_axes)
+    v = x._value
+    if k:
+        v = jnp.sum(v, axis=tuple(range(k)))
+    return v
+
+
+def shard_layer(layer: Layer, process_mesh: ProcessMesh, shard_fn: Callable = None,
+                input_fn=None, output_fn=None) -> Layer:
+    """paddle.distributed.shard_layer (api.py:908 analog).
+
+    shard_fn(sublayer_name, sublayer, process_mesh) annotates parameters in place
+    (typically via shard_tensor on .weight/.bias). Default: replicate everything.
+    """
+    def default_shard(name, sub, mesh):
+        for pname, p in list(sub._parameters.items()):
+            if p is None or p._dist_meta is not None:
+                continue
+            sub._parameters[pname] = shard_tensor(
+                p, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+    fn = shard_fn or default_shard
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """paddle.distributed.shard_optimizer (api.py:1735 analog).
+
+    Wraps slot creation so optimizer states inherit (or override via shard_fn) the
+    parameter shardings — ZeRO-style state partitioning is `shard_fn=ShardingStage1`.
+    """
+    orig_ensure = optimizer._ensure_slots
+
+    def ensure(params):
+        orig_ensure(params)
+        for p in params:
+            if p._dist_meta is None:
+                continue
+            slots = optimizer._slots[id(p)]
+            for k, v in slots.items():
+                if not isinstance(v, jax.Array) or v.ndim != len(logical_shape(p)):
+                    continue
+                if shard_fn is not None:
+                    slots[k] = shard_fn(k, p, v)
+                else:
+                    slots[k] = jax.device_put(
+                        v, sharding_for(p._dist_meta.mesh, p._dist_meta.placements,
+                                        v.ndim))
+
+    optimizer._ensure_slots = ensure
+    return optimizer
+
+
+class ShardingStage1:
+    """ZeRO-1: shard optimizer states over the data axis (reference:
+    auto_parallel/api.py:1430 ShardingStage1 + dygraph_sharding_optimizer.py:54)."""
+
+    def __init__(self, axis_name="dp", mesh=None):
+        self.axis = axis_name
+        self.mesh = mesh
+
+    def __call__(self, slot_name, param, slot_value):
+        mesh = self.mesh or (param._dist_meta.mesh if param._dist_meta else None)
+        if mesh is None or self.axis not in mesh.dim_names:
+            return slot_value
+        # shard the largest dim of the state over the data axis when divisible
+        ax_size = mesh.get_dim_size(self.axis)
+        # prefer the first-largest dim (stable) so the choice is deterministic
+        for d in np.argsort([-s for s in slot_value.shape], kind="stable"):
+            if slot_value.shape[int(d)] % ax_size == 0 and slot_value.shape[int(d)] > 1:
+                spec = [None] * slot_value.ndim
+                spec[int(d)] = self.axis
+                # keep existing param sharding on other dims
+                if param._dist_meta is not None:
+                    base = placements_to_spec(param._dist_meta.placements,
+                                              slot_value.ndim, mesh.dim_names)
+                    for i, s in enumerate(base):
+                        if s is not None and i != int(d):
+                            spec[i] = s
+                        if s is not None and i == int(d):
+                            spec[i] = (self.axis, s) if s != self.axis else s
+                return jax.device_put(
+                    slot_value, NamedSharding(mesh.jax_mesh(), PartitionSpec(*spec)))
+        return slot_value
+
+
+ShardingStage2 = ShardingStage1  # grads shard implicitly under GSPMD; states same
+ShardingStage3 = ShardingStage1  # param sharding handled via shard_tensor(Shard(0))

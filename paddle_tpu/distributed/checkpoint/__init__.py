@@ -1,0 +1,3 @@
+from .save_load import (  # noqa: F401
+    save_state_dict, load_state_dict, LoadMetadata, Metadata,
+)

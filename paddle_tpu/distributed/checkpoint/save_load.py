@@ -1,0 +1,144 @@
+"""Distributed sharded checkpoint (reference:
+distributed/checkpoint/save_state_dict.py:135, load_state_dict.py:526,
+metadata.py — per-rank shard files + a global metadata index, dedup of replicated
+shards, reshard-on-load across different meshes/placements).
+
+TPU-native: each host process writes the shards it owns (addressable shards of the
+sharded jax.Array), keyed by global offset; the metadata JSON maps tensor -> shard
+files+offsets. Load reassembles the global value (reading only needed shards) and
+re-places it under the *target* tensor's sharding — reshard-on-load for free.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..env import global_rank, get_world_size
+
+
+class Metadata(dict):
+    pass
+
+
+class LoadMetadata(dict):
+    pass
+
+
+def _tensor_items(state_dict, prefix=""):
+    for k, v in state_dict.items():
+        name = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            yield from _tensor_items(v, name)
+        elif isinstance(v, Tensor):
+            yield name, v
+        elif isinstance(v, (jax.Array, np.ndarray)):
+            yield name, Tensor(jnp.asarray(v))
+        else:
+            yield name, v
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    unique_id=None, async_save=False):
+    os.makedirs(path, exist_ok=True)
+    rank = global_rank()
+    meta = {"tensors": {}, "nonb": {}, "world_size": get_world_size()}
+    shard_file = os.path.join(path, f"{rank}_0.distcp")
+    shards_out = {}
+    for name, t in _tensor_items(state_dict):
+        if not isinstance(t, Tensor):
+            meta["nonb"][name] = t
+            continue
+        v = t._value
+        entry = {"shape": list(v.shape), "dtype": str(np.dtype(v.dtype)),
+                 "shards": []}
+        seen_offsets = set()
+        if isinstance(v, jax.Array) and v.sharding is not None \
+                and len(v.addressable_shards) > 0:
+            for s in v.addressable_shards:
+                idx = s.index
+                offset = tuple(sl.start or 0 for sl in idx)
+                lengths = tuple((sl.stop if sl.stop is not None else dim) -
+                                (sl.start or 0)
+                                for sl, dim in zip(idx, v.shape)) if idx else \
+                    tuple(v.shape)
+                if offset in seen_offsets:
+                    continue  # dedup replicated shards (reference dedup pass)
+                seen_offsets.add(offset)
+                skey = f"{name}@{offset}"
+                shards_out[skey] = np.asarray(s.data)
+                entry["shards"].append({"offset": list(offset),
+                                        "lengths": list(lengths),
+                                        "file": os.path.basename(shard_file),
+                                        "key": skey})
+        else:
+            skey = f"{name}@full"
+            shards_out[skey] = np.asarray(v)
+            entry["shards"].append({"offset": [0] * v.ndim,
+                                    "lengths": list(v.shape),
+                                    "file": os.path.basename(shard_file),
+                                    "key": skey})
+        meta["tensors"][name] = entry
+    with open(shard_file, "wb") as f:  # file handle: keep the .distcp name verbatim
+        np.savez(f, **shards_out)
+    # every rank writes its own piece of metadata; rank0's file carries the merge
+    if get_world_size() > 1:
+        from ..collective import all_gather_object
+        metas = []
+        all_gather_object(metas, meta)
+        if rank == coordinator_rank:
+            merged = {"tensors": {}, "nonb": {}}
+            for m in metas:
+                merged["nonb"].update(m["nonb"])
+                for name, entry in m["tensors"].items():
+                    tgt = merged["tensors"].setdefault(
+                        name, {"shape": entry["shape"], "dtype": entry["dtype"],
+                               "shards": []})
+                    have = {tuple(s["offset"]) for s in tgt["shards"]}
+                    for s in entry["shards"]:
+                        if tuple(s["offset"]) not in have:
+                            tgt["shards"].append(s)
+            meta = merged
+    if rank == coordinator_rank:
+        with open(os.path.join(path, "0.metadata"), "w") as f:
+            json.dump(meta, f, default=str)
+
+
+def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    unique_id=None, offload=False):
+    """Fill `state_dict`'s tensors in place; placements of the *targets* decide the
+    final sharding (reshard-on-load)."""
+    with open(os.path.join(path, "0.metadata")) as f:
+        meta = json.load(f)
+    cache = {}
+
+    def shard_data(fname, key):
+        if fname not in cache:
+            cache[fname] = np.load(os.path.join(path, fname), allow_pickle=False)
+        return cache[fname][key]
+
+    for name, t in _tensor_items(state_dict):
+        if not isinstance(t, Tensor):
+            continue
+        entry = meta["tensors"].get(name)
+        if entry is None:
+            raise KeyError(f"checkpoint missing tensor {name}")
+        full = np.zeros(entry["shape"], np.dtype(entry["dtype"]))
+        for s in entry["shards"]:
+            sl = tuple(slice(o, o + l) for o, l in zip(s["offset"], s["lengths"]))
+            full[sl] = shard_data(s["file"], s["key"])
+        target_sharding = None
+        if isinstance(t._value, jax.Array):
+            try:
+                target_sharding = t._value.sharding
+            except Exception:
+                target_sharding = None
+        arr = jnp.asarray(full, dtype=t._value.dtype)
+        if target_sharding is not None:
+            arr = jax.device_put(arr, target_sharding)
+        t._value = arr
+    return state_dict

@@ -1,0 +1,268 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/communication/* (one module per op) over
+ProcessGroupNCCL. TPU-native split (SURVEY §5.8):
+- Device collectives are COMPILED programs: the eager API below operates on
+  DistTensors (mesh-placed jax.Arrays) and lowers each op to a reshard whose
+  XLA lowering IS the collective (p->r = all_reduce, s->r = all_gather,
+  p->s = reduce_scatter, s->s' = all_to_all).
+- `paddle_tpu.distributed.functional` exposes the in-graph primitives
+  (psum/all_gather/ppermute/all_to_all) for shard_map-authored parallel code —
+  what fleet TP/PP/ring-attention use.
+- Host-side object collectives ride the TCPStore (Gloo analog) for multi-process
+  coordination.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from .mesh import ProcessMesh, Shard, Replicate, Partial
+from .api import is_dist_tensor, reshard, shard_tensor, full_value, DistMeta
+from .env import Group, get_world_size, global_rank
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _axis_of(tensor, group):
+    """Resolve which mesh axis a collective runs over."""
+    if group is not None and group.axis is not None and group.mesh is not None:
+        return group.mesh, group.axis
+    if is_dist_tensor(tensor):
+        meta = tensor._dist_meta
+        # default to the first axis with a non-replicate placement, else axis 0
+        for i, p in enumerate(meta.placements):
+            if not p.is_replicate():
+                return meta.mesh, meta.mesh.dim_names[i]
+        return meta.mesh, meta.mesh.dim_names[0]
+    return None, None
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Partial -> Replicate over the group axis (compiles to one all_reduce)."""
+    if not is_dist_tensor(tensor):
+        return tensor  # single logical copy: already reduced in global view
+    mesh, axis = _axis_of(tensor, group)
+    ax_idx = mesh.dim_names.index(axis)
+    placements = list(tensor._dist_meta.placements)
+    if not placements[ax_idx].is_partial():
+        return tensor
+    if op == ReduceOp.AVG:
+        out = reshard(tensor, mesh, [Replicate() if i == ax_idx else p
+                                     for i, p in enumerate(placements)])
+        res = dispatch(lambda v: v / mesh.shape[ax_idx], (out,), {}, name="avg")
+        res._dist_meta = out._dist_meta
+        tensor._value = res._value
+        tensor._dist_meta = res._dist_meta
+        return tensor
+    placements[ax_idx] = Replicate()
+    out = reshard(tensor, mesh, placements)
+    tensor._value = out._value
+    tensor._dist_meta = out._dist_meta
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """Shard(d) -> Replicate; fills tensor_list with per-rank slices."""
+    if not is_dist_tensor(tensor):
+        n = group.nranks if group is not None else 1
+        tensor_list.extend([tensor for _ in range(n)])
+        return
+    mesh, axis = _axis_of(tensor, group)
+    ax_idx = mesh.dim_names.index(axis)
+    placements = list(tensor._dist_meta.placements)
+    p = placements[ax_idx]
+    placements[ax_idx] = Replicate()
+    out = reshard(tensor, mesh, placements)
+    n = mesh.shape[ax_idx]
+    if p.is_shard():
+        d = p.get_dim()
+        chunks = jnp.split(out._value, n, axis=d)
+        tensor_list.extend([Tensor(c) for c in chunks])
+    else:
+        tensor_list.extend([Tensor(out._value) for _ in range(n)])
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Host-side gather over processes via TCPStore (Gloo analog)."""
+    world = get_world_size()
+    if world == 1:
+        object_list.append(obj)
+        return
+    from .store import create_or_get_global_tcp_store
+    store = create_or_get_global_tcp_store()
+    rank = global_rank()
+    store.set(f"__ag/{rank}", obj)
+    store.barrier("all_gather_object", world_size=world)
+    for r in range(world):
+        object_list.append(store.wait(f"__ag/{r}"))
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Global-view broadcast: value of logical rank src becomes everyone's value.
+    For DistTensors this is reshard-to-Replicate."""
+    if is_dist_tensor(tensor):
+        mesh = tensor._dist_meta.mesh
+        out = reshard(tensor, mesh, [Replicate()] * mesh.ndim)
+        tensor._value = out._value
+        tensor._dist_meta = out._dist_meta
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    world = get_world_size()
+    if world == 1:
+        return
+    from .store import create_or_get_global_tcp_store
+    store = create_or_get_global_tcp_store()
+    if global_rank() == src:
+        store.set("__bcast", object_list)
+    received = store.wait("__bcast")
+    object_list.clear()
+    object_list.extend(received)
+    store.barrier("bcast_done", world_size=world)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group)
+
+
+def reduce_scatter(tensor_out, tensor_list_or_tensor, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """Partial -> Shard(0): one reduce_scatter in XLA."""
+    t = tensor_list_or_tensor
+    if isinstance(t, (list, tuple)):
+        stacked = dispatch(lambda *vs: jnp.concatenate(vs, axis=0), tuple(t), {},
+                           name="concat")
+        t = stacked
+    if not is_dist_tensor(t):
+        tensor_out._value = t._value
+        return tensor_out
+    mesh, axis = _axis_of(t, group)
+    ax_idx = mesh.dim_names.index(axis)
+    placements = list(t._dist_meta.placements)
+    placements[ax_idx] = Shard(0)
+    out = reshard(t, mesh, placements)
+    tensor_out._value = out._value
+    tensor_out._dist_meta = out._dist_meta
+    return tensor_out
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Shard(d0) -> Shard(d1) transposition (XLA all_to_all) on stacked input."""
+    stacked = dispatch(lambda *vs: jnp.stack(vs, axis=0), tuple(in_tensor_list), {},
+                       name="stack")
+    n = len(in_tensor_list)
+    # global view: out[j] = in[j] chunk-swapped; single-controller = transpose chunks
+    chunks = jnp.split(stacked._value, n, axis=1) if stacked._value.ndim > 1 else None
+    for j in range(n):
+        if chunks is not None:
+            out_tensor_list.append(Tensor(jnp.concatenate(
+                [jnp.split(in_tensor_list[i]._value, n, axis=0)[j]
+                 for i in range(n)], axis=0)))
+        else:
+            out_tensor_list.append(in_tensor_list[j])
+    return out_tensor_list
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor._value = tensor_list[global_rank() if get_world_size() > 1 else 0]._value
+    return tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "eager send/recv across compiled-collective ranks is not meaningful on a "
+        "single controller; use fleet pipeline parallel (ppermute) or "
+        "distributed.functional inside shard_map")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "eager send/recv across compiled-collective ranks is not meaningful on a "
+        "single controller; use fleet pipeline parallel (ppermute) or "
+        "distributed.functional inside shard_map")
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+@dataclass
+class P2POp:
+    op: object
+    tensor: object
+    peer: int
+    group: object = None
+
+
+def batch_isend_irecv(p2p_op_list):
+    raise RuntimeError("use fleet pipeline parallel for p2p schedules on TPU")
+
+
+def stream_all_reduce(*a, **k):  # communication.stream.* parity aliases
+    return all_reduce(*a, **k)
+
+
+# ---------------------------------------------------------------------------
+# In-graph functional collectives (for shard_map-authored parallel code)
+# ---------------------------------------------------------------------------
+
+class functional:
+    """lax collectives under their paddle-ish names; use inside shard_map bodies."""
+
+    @staticmethod
+    def all_reduce(x, axis_name, op=ReduceOp.SUM):
+        if op == ReduceOp.SUM:
+            return jax.lax.psum(x, axis_name)
+        if op == ReduceOp.MAX:
+            return jax.lax.pmax(x, axis_name)
+        if op == ReduceOp.MIN:
+            return jax.lax.pmin(x, axis_name)
+        if op == ReduceOp.AVG:
+            return jax.lax.pmean(x, axis_name)
+        raise ValueError(op)
+
+    psum = staticmethod(jax.lax.psum)
+    pmean = staticmethod(jax.lax.pmean)
+    pmax = staticmethod(jax.lax.pmax)
+    ppermute = staticmethod(jax.lax.ppermute)
+
+    @staticmethod
+    def all_gather(x, axis_name, axis=0, tiled=True):
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+    @staticmethod
+    def reduce_scatter(x, axis_name, axis=0, tiled=True):
+        return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=tiled)
+
+    @staticmethod
+    def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+        return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=tiled)
+
+    @staticmethod
+    def axis_index(axis_name):
+        return jax.lax.axis_index(axis_name)
+
+    @staticmethod
+    def shift(x, axis_name, offset=1):
+        """Ring shift by `offset` (pipeline/ring-attention building block)."""
+        n = jax.lax.axis_size(axis_name)
+        perm = [(i, (i + offset) % n) for i in range(n)]
+        return jax.lax.ppermute(x, axis_name, perm)

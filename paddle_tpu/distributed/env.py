@@ -1,0 +1,140 @@
+"""Process/bootstrap environment + communication groups.
+
+Reference: python/paddle/distributed/parallel.py:978 init_parallel_env (TCPStore ->
+ProcessGroupNCCL), communication/group.py:29 Group.
+
+TPU-native: multi-host init rides jax.distributed.initialize (the coordination
+service is the TCPStore+NCCL-id-exchange analog); ranks are host processes; each
+process addresses its local TPU chips. Groups name mesh axes rather than wrap a
+comm library — a Group is a view over a ProcessMesh axis whose collectives compile
+to XLA ops.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+
+from .mesh import ProcessMesh
+
+_initialized = False
+_default_group = None
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(global_rank())
+    return global_rank()
+
+
+def global_rank() -> int:
+    if _initialized:
+        return jax.process_index()
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    if _initialized:
+        return jax.process_count()
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env():
+    """paddle.distributed.init_parallel_env analog.
+
+    Multi-host: expects PADDLE_MASTER/PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM (set by
+    paddle_tpu.distributed.launch) and calls jax.distributed.initialize so all hosts
+    join one PJRT runtime. Single host: no-op (all local devices already visible).
+    """
+    global _initialized, _default_group
+    if _initialized:
+        return _default_group
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if world > 1:
+        master = os.environ.get("PADDLE_MASTER")
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        jax.distributed.initialize(coordinator_address=master,
+                                   num_processes=world, process_id=rank)
+    _initialized = True
+    _default_group = _build_default_group()
+    return _default_group
+
+
+def _build_default_group():
+    n = len(jax.devices())
+    mesh = ProcessMesh(np.arange(n), ["world"])
+    return Group(list(range(n)), mesh=mesh, axis="world")
+
+
+class Group:
+    """Communication group = ranks + (mesh, axis) naming for compiled collectives."""
+
+    _next_id = [0]
+
+    def __init__(self, ranks, pg=None, name=None, mesh=None, axis=None):
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        Group._next_id[0] += 1
+        self.id = Group._next_id[0]
+        self.name = name or f"group_{self.id}"
+        self.mesh = mesh
+        self.axis = axis
+
+    def get_group_rank(self, global_rank):
+        return self.ranks.index(global_rank) if global_rank in self.ranks else -1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.axis})"
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """paddle.distributed.new_group — a 1-d mesh over the given device ids."""
+    if ranks is None:
+        ranks = list(range(len(jax.devices())))
+    mesh = ProcessMesh(np.asarray(ranks), ["g"])
+    return Group(ranks, mesh=mesh, axis="g")
+
+
+def get_group(gid=None):
+    return _default_group
+
+
+def _group_from_mesh_axis(mesh: ProcessMesh, dim_name=None):
+    if dim_name is None:
+        return Group(mesh.process_ids, mesh=mesh, axis=None)
+    ax = mesh.dim_names.index(dim_name)
+    ids = np.moveaxis(np.asarray(mesh.mesh), ax, 0).reshape(mesh.shape[ax], -1)
+    return Group(ids[:, 0].tolist(), mesh=mesh, axis=dim_name)
+
+
+def barrier(group=None):
+    """Host barrier: block until all processes sync (store-based when multi-proc)."""
+    if get_world_size() > 1:
+        from .store import create_or_get_global_tcp_store
+        create_or_get_global_tcp_store().barrier("dist_barrier",
+                                                 world_size=get_world_size())
+
+
+def get_backend(group=None) -> str:
+    return "xla"
+
+
+def destroy_process_group(group=None):
+    global _initialized, _default_group
+    _initialized = False
+    _default_group = None

@@ -1,0 +1,24 @@
+"""Global fleet state (the reference keeps this on the Fleet singleton,
+fleet/fleet.py)."""
+from __future__ import annotations
+
+_hcg = None
+_strategy = None
+
+
+def set_hcg(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def hcg():
+    return _hcg
+
+
+def set_strategy(s):
+    global _strategy
+    _strategy = s
+
+
+def strategy():
+    return _strategy

@@ -1,0 +1,167 @@
+"""Tensor-parallel layers (reference: fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding:49, ColumnParallelLinear:336, RowParallelLinear:543,
+ParallelCrossEntropy:744; mp_ops.py _c_identity/_c_split/_mp_allreduce).
+
+TPU-native: weights carry NamedShardings over the 'mp' mesh axis and the math is
+ordinary matmul — GSPMD partitions it and inserts the identity/allreduce/allgather
+collectives the reference hand-writes. Megatron semantics preserved:
+- ColumnParallelLinear: W [in, out] sharded on out; output sharded (gather_output
+  optionally materializes the full output = all_gather).
+- RowParallelLinear: W [in, out] sharded on in; input expected sharded on features;
+  output needs reduction = XLA inserts the psum.
+- VocabParallelEmbedding: table sharded on vocab; out-of-shard lookups masked and
+  psum'd by the partitioner.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor, dispatch
+from ...nn.layer_base import Layer, Parameter
+from ...nn.initializer import XavierNormal, Constant
+from ...nn import functional as F
+from ... import ops
+from ..mesh import ProcessMesh, Shard, Replicate
+from ..api import shard_tensor
+from . import fleet_state
+
+
+def _mp_mesh():
+    hcg = fleet_state.hcg()
+    if hcg is None:
+        raise RuntimeError("call fleet.init(is_collective=True, strategy) first")
+    return hcg.mesh
+
+
+def _put(value, mesh, spec):
+    return jax.device_put(value, NamedSharding(mesh.jax_mesh(),
+                                               PartitionSpec(*spec)))
+
+
+def _constraint(x, mesh, spec):
+    """with_sharding_constraint that works eager (device_put) and traced."""
+    def fn(v):
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh.jax_mesh(), PartitionSpec(*spec)))
+    return dispatch(fn, (x,), {}, name="sharding_constraint")
+
+
+class VocabParallelEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        mesh = _mp_mesh()
+        w = self.create_parameter((num_embeddings, embedding_dim), attr=weight_attr,
+                                  default_initializer=XavierNormal())
+        w._value = _put(w._value, mesh, ("mp", None) if "mp" in mesh.dim_names
+                        else (None, None))
+        self.weight = w
+        self._mesh = mesh
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return out
+
+
+class ColumnParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.gather_output = gather_output
+        mesh = _mp_mesh()
+        self._mesh = mesh
+        w = self.create_parameter((in_features, out_features), attr=weight_attr,
+                                  default_initializer=XavierNormal())
+        w._value = _put(w._value, mesh, (None, "mp"))
+        self.weight = w
+        if has_bias:
+            b = self.create_parameter((out_features,), is_bias=True,
+                                      default_initializer=Constant(0.0))
+            b._value = _put(b._value, mesh, ("mp",))
+            self.bias = b
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = _constraint(out, self._mesh, (None,) * out.ndim)
+        else:
+            spec = [None] * out.ndim
+            spec[-1] = "mp"
+            out = _constraint(out, self._mesh, tuple(spec))
+        return out
+
+
+class RowParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.input_is_parallel = input_is_parallel
+        mesh = _mp_mesh()
+        self._mesh = mesh
+        w = self.create_parameter((in_features, out_features), attr=weight_attr,
+                                  default_initializer=XavierNormal())
+        w._value = _put(w._value, mesh, ("mp", None))
+        self.weight = w
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True,
+                                              default_initializer=Constant(0.0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            spec = [None] * x.ndim
+            spec[-1] = "mp"
+            x = _constraint(x, self._mesh, tuple(spec))
+        out = ops.matmul(x, self.weight)  # contraction over sharded dim -> psum
+        out = _constraint(out, self._mesh, (None,) * out.ndim)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """CE over vocab-sharded logits (reference: mp_layers.py:744 →
+    c_softmax_with_cross_entropy op). The take_along_axis + logsumexp over the
+    sharded vocab dim lowers to the same masked-local + allreduce pattern."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        loss = F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+        return ops.unsqueeze(loss, -1)
+
+
+# mp_ops parity (reference: fleet/layers/mpu/mp_ops.py)
+def _c_identity(tensor, group=None):
+    return tensor
+
+
+def _c_concat(tensor, group=None):
+    mesh = _mp_mesh()
+    return _constraint(tensor, mesh, (None,) * tensor.ndim)
+
+
+def _c_split(tensor, group=None):
+    mesh = _mp_mesh()
+    spec = [None] * tensor.ndim
+    spec[-1] = "mp"
+    return _constraint(tensor, mesh, tuple(spec))
+
+
+def _mp_allreduce(tensor, group=None, use_calc_stream=True, use_model_parallel=True):
+    mesh = _mp_mesh()
+    return _constraint(tensor, mesh, (None,) * tensor.ndim)

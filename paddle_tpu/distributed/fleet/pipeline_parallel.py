@@ -1,0 +1,295 @@
+"""PipelineParallel runtime (reference: fleet/meta_parallel/pipeline_parallel.py:242
+— train_batch drives the 1F1B/interleave schedules over NCCL p2p).
+
+TPU-native: train_batch compiles ONE program per batch shape containing
+prefix (embed) -> SPMD ring pipeline over the repeating blocks -> suffix (head+loss)
+-> backward (autodiff reverse pipeline) -> optimizer update. Stage p2p is ppermute
+over ICI inside the compiled program; there is no host-side schedule loop to drive.
+
+The repeating block structure is detected from the built layers: the longest
+contiguous run of structurally-identical layers is the pipeline body (must divide
+evenly by pp degree x virtual chunks); everything before/after runs replicated on
+all pp ranks (the reference instead places them on first/last stage — on TPU the
+redundant embed/head compute is cheaper than idling the ring).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, functional_mode
+from ...core import random as _random
+from ...nn.layer_base import Layer, Parameter
+from ...jit.functional_call import bind_state, collect_state, read_values
+from ..pipeline import spmd_pipeline, interleaved_pipeline
+from .pp_layers import PipelineLayer
+
+
+def _signature(layer: Layer):
+    return (type(layer).__name__,
+            tuple((n, tuple(p.shape), str(p.dtype))
+                  for n, p in layer.named_parameters()))
+
+
+class PipelineParallel:
+    def __init__(self, layers: PipelineLayer, hcg, strategy):
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._S = hcg.get_pipe_parallel_world_size()
+        self._V = layers._num_virtual_pipeline_stages
+        self._dp = hcg.get_data_parallel_world_size()
+        self._mesh = hcg.mesh
+        self._accumulate_steps = (strategy.pipeline_configs.get("accumulate_steps", 1)
+                                  if strategy else 1)
+        self._remat = layers._recompute_interval > 0
+        self._cache = {}
+        self._opt_remapped = False
+        self._split_layers()
+        self._stack_body()
+
+    # -- structure ------------------------------------------------------------
+    def _split_layers(self):
+        entries = self._layers._forward_funcs
+        sigs = []
+        for layer, fwd in entries:
+            if isinstance(layer, Layer) and fwd is None:
+                sigs.append(_signature(layer))
+            else:
+                sigs.append(("<fn>",))
+        # longest run of identical signatures with parameters
+        best = (0, 0)
+        i = 0
+        while i < len(sigs):
+            j = i
+            while j < len(sigs) and sigs[j] == sigs[i] and sigs[i][0] != "<fn>" \
+                    and len(sigs[i][1]) > 0:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = max(j, i + 1)
+        start, end = best
+        n_body = end - start
+        total = self._S * self._V
+        if n_body < total or n_body % total != 0:
+            raise ValueError(
+                f"pipeline body of {n_body} identical layers cannot be divided "
+                f"across {self._S} stages x {self._V} chunks")
+        self._prefix = entries[:start]
+        self._body = [e[0] for e in entries[start:end]]
+        self._suffix = entries[end:]
+        self._L = n_body // total  # layers per (stage x chunk)
+
+    def _stack_body(self):
+        template = self._body[0]
+        names = [n for n, _ in template.named_parameters()]
+        self._body_template = template
+        self._body_param_names = names
+        stacked = {}
+        for n in names:
+            leaves = []
+            for layer in self._body:
+                p = dict(layer.named_parameters())[n]
+                leaves.append(p._value)
+            arr = jnp.stack(leaves)  # [S*V*L, ...]
+            arr = arr.reshape((self._S * self._V, self._L) + arr.shape[1:])
+            # shard leading stage dim over pp
+            from jax.sharding import NamedSharding, PartitionSpec
+            spec = [None] * arr.ndim
+            spec[0] = "pp"
+            arr = jax.device_put(arr, NamedSharding(self._mesh.jax_mesh(),
+                                                    PartitionSpec(*spec)))
+            p0 = dict(template.named_parameters())[n]
+            sp = Parameter(arr, trainable=not p0.stop_gradient,
+                           name=f"pipeline_body.{n}")
+            stacked[n] = sp
+        self._stacked = stacked
+
+    def sync_to_layers(self):
+        """Unstack trained body params back into the per-layer Parameters."""
+        for n, sp in self._stacked.items():
+            flat = np.asarray(sp._value).reshape(
+                (len(self._body),) + tuple(sp._value.shape[2:]))
+            for i, layer in enumerate(self._body):
+                dict(layer.named_parameters())[n]._value = jnp.asarray(flat[i])
+
+    # -- parameters -----------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        params = []
+        seen = set()
+        for layer, _ in self._prefix + self._suffix:
+            if isinstance(layer, Layer):
+                for p in layer.parameters():
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        params.append(p)
+        params.extend(self._stacked.values())
+        return params
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        for i, (layer, _) in enumerate(self._prefix + self._suffix):
+            if isinstance(layer, Layer):
+                yield from layer.named_parameters(f"stagefix{i}")
+        for n, p in self._stacked.items():
+            yield f"pipeline_body.{n}", p
+
+    def state_dict(self, *a, **k):
+        self.sync_to_layers()
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, state, *a, **k):
+        res = self._layers.set_state_dict(state, *a, **k)
+        self._stack_body()
+        self._opt_remapped = False
+        return res
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def forward(self, x):
+        return self._layers.forward(x)
+
+    __call__ = forward
+
+    # -- training -------------------------------------------------------------
+    def _remap_optimizer(self, optimizer):
+        if self._opt_remapped:
+            return
+        optimizer._parameter_list = self.parameters()
+        optimizer._slots.clear()
+        optimizer._jit_update = None
+        self._opt_remapped = True
+
+    def _stage_fn(self):
+        template = self._body_template
+        names = self._body_param_names
+        L = self._L
+
+        def unit(param_leaves, x):
+            tensors = [dict(template.named_parameters())[n] for n in names]
+            with functional_mode(), bind_state(tensors, list(param_leaves)):
+                out = template(Tensor(x))
+            return out._value
+
+        def stage(params, x):
+            # params: dict name -> [L, ...]
+            def body(h, l):
+                leaves = [jax.lax.dynamic_index_in_dim(params[n], l, 0,
+                                                       keepdims=False)
+                          for n in names]
+                return unit(leaves, h), None
+            h, _ = jax.lax.scan(body, x, jnp.arange(L))
+            return h
+        return stage
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        self._remap_optimizer(optimizer)
+        x, y = data if isinstance(data, (list, tuple)) else (data, None)
+        x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        y = y if y is None or isinstance(y, Tensor) else Tensor(jnp.asarray(y))
+
+        params = self.parameters()
+        trainable = [p for p in params if not p.stop_gradient]
+        optimizer._ensure_slots(trainable)
+
+        key = (tuple(x.shape), str(x.dtype),
+               tuple(y.shape) if y is not None else None)
+        if key not in self._cache:
+            self._cache[key] = self._build_step(trainable, optimizer,
+                                                y is not None)
+        step_fn = self._cache[key]
+
+        param_vals = read_values(trainable)
+        slot_vals = [optimizer._slots[id(p)] for p in trainable]
+        optimizer._step_count += 1
+        lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+        step_i = jnp.asarray(optimizer._step_count, jnp.int32)
+        rng = _random.next_key()
+        args = (param_vals, slot_vals, lr, step_i, rng, x._value) + \
+            ((y._value,) if y is not None else ())
+        loss_val, new_pv, new_slots = step_fn(*args)
+        for p, nv in zip(trainable, new_pv):
+            p._value = nv
+        for p, ns in zip(trainable, new_slots):
+            optimizer._slots[id(p)] = ns
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(loss_val)
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data if isinstance(data, (list, tuple)) else (data, None)
+        out = self._forward_full(x)
+        if compute_loss and y is not None:
+            return self._layers.loss(out, y)
+        return out
+
+    def _forward_full(self, x):
+        self.sync_to_layers()
+        return self._layers.forward(x)
+
+    def _build_step(self, trainable, optimizer, has_labels):
+        M = self._accumulate_steps
+        mesh = self._mesh
+        stage = self._stage_fn()
+        stacked_names = list(self._stacked.keys())
+        stacked_ids = {id(self._stacked[n]): n for n in stacked_names}
+        prefix_entries, suffix_entries = self._prefix, self._suffix
+        layers_obj = self._layers
+        dp_axis = "dp" if self._dp > 1 else None
+        V, remat = self._V, self._remat
+        decay_flags = tuple(bool(optimizer._decay_mask(p)) for p in trainable)
+
+        def run_fix(entries, h):
+            for layer, fwd in entries:
+                if fwd is not None:
+                    h = fwd(layer, h)
+                else:
+                    h = layer(h) if isinstance(layer, Layer) else layer(h)
+            return h
+
+        def step_fn(param_vals, slot_vals, lr, step_i, rng, xv, *yv):
+            def loss_of(pv):
+                stacked_vals = {}
+                fix_tensors, fix_vals = [], []
+                for p, v in zip(trainable, pv):
+                    if id(p) in stacked_ids:
+                        stacked_vals[stacked_ids[id(p)]] = v
+                    else:
+                        fix_tensors.append(p)
+                        fix_vals.append(v)
+                with functional_mode(), bind_state(fix_tensors, fix_vals), \
+                        _random.provide_key(rng):
+                    h = run_fix(prefix_entries, Tensor(xv))
+                    hv = h._value
+                    B = hv.shape[0]
+                    mb = B // M
+                    h_mb = hv.reshape((M, mb) + hv.shape[1:])
+                    if V > 1:
+                        y_mb = interleaved_pipeline(stage, stacked_vals, h_mb, mesh,
+                                                    "pp", num_chunks=V,
+                                                    data_axis=dp_axis, remat=remat)
+                    else:
+                        y_mb = spmd_pipeline(stage, stacked_vals, h_mb, mesh, "pp",
+                                             data_axis=dp_axis, remat=remat)
+                    out = Tensor(y_mb.reshape((B,) + y_mb.shape[2:]))
+                    out = run_fix(suffix_entries, out)
+                    if has_labels:
+                        loss = layers_obj.loss(out, Tensor(yv[0]))
+                    else:
+                        loss = out
+                return loss._value
+
+            loss_val, grads = jax.value_and_grad(loss_of)(list(param_vals))
+            new_pv, new_slots = optimizer.apply_updates(
+                list(param_vals), grads, list(slot_vals), lr, step_i, decay_flags)
+            return loss_val, new_pv, new_slots
+
+        return jax.jit(step_fn, donate_argnums=(0, 1))

@@ -1,0 +1,160 @@
+"""PipelineLayer model description (reference:
+fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc:57,
+SharedLayerDesc:77, SegmentLayers:93, PipelineLayer:258).
+
+Single-controller twist: ALL stages' layers are built in this process (devices, not
+processes, are the stage executors). SegmentLayers keeps the reference's
+cost-balanced partition API; PipelineParallel (pipeline_parallel.py) consumes the
+stage structure and stacks the repeating blocks for the SPMD pipeline.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ...nn.layer_base import Layer
+from ...nn.layer.containers import LayerList
+from ... import ops
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Cost-balanced stage partition (reference: pp_layers.py:93)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform", num_virtual_pipeline_stage=None):
+        self._layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+        assert len(layers_desc) >= num_parts, \
+            f"{len(layers_desc)} layers cannot fill {num_parts} stages"
+
+    def do_segment(self):
+        if self.method == "uniform":
+            return self.uniform(len(self._layers_desc), self.num_parts)
+        if self.method.startswith("layer:"):
+            cls_name = self.method.split(":", 1)[1]
+            weights = [1 if self._match(d, cls_name) else 0
+                       for d in self._layers_desc]
+            assert sum(weights) % self.num_parts == 0, \
+                f"{sum(weights)} {cls_name} layers not divisible by {self.num_parts}"
+            return self._segment_by_weights(weights)
+        raise ValueError(f"unknown seg_method {self.method}")
+
+    @staticmethod
+    def _match(desc, cls_name):
+        name = desc.layer_func.__name__ if isinstance(desc, LayerDesc) \
+            else type(desc).__name__
+        return re.search(cls_name, name) is not None
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        base = num_items // num_parts
+        rem = num_items % num_parts
+        result = [0]
+        for i in range(num_parts):
+            result.append(result[-1] + base + (1 if i < rem else 0))
+        return result
+
+    def _segment_by_weights(self, weights):
+        per = sum(weights) // self.num_parts
+        result = [0]
+        acc = 0
+        for i, w in enumerate(weights):
+            acc += w
+            if acc == per and len(result) < self.num_parts:
+                result.append(i + 1)
+                acc = 0
+        result.append(len(weights))
+        while len(result) < self.num_parts + 1:
+            result.append(len(weights))
+        return result
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        from . import fleet_state
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._num_virtual_pipeline_stages = num_virtual_pipeline_stages or 1
+        hcg = fleet_state.hcg()
+        if num_stages is None:
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._num_stages = num_stages
+        self._layers_desc = list(layers)
+        self._shared_layers = {}
+
+        built = []
+        for d in self._layers_desc:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared_layers:
+                    self._shared_layers[d.layer_name] = d.build_layer()
+                built.append((self._shared_layers[d.layer_name], d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"bad pipeline entry {d}")
+        self.run_function = LayerList([l for l, _ in built
+                                       if isinstance(l, Layer)])
+        self._forward_funcs = built
+
+        seg_parts = self._num_stages * self._num_virtual_pipeline_stages
+        self.segment_parts = SegmentLayers(
+            self._layers_desc, seg_parts, seg_method).do_segment()
+
+    @property
+    def parameters_desc(self):
+        return self._layers_desc
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def get_stage_layer_indices(self, stage):
+        return list(range(self.segment_parts[stage], self.segment_parts[stage + 1]))
+
+    def forward(self, input):
+        """Sequential execution (eval / 1-stage / fallback path)."""
+        x = input
+        for layer, fwd in self._forward_funcs:
+            if fwd is not None:
+                x = fwd(layer, x)
+            elif isinstance(layer, Layer):
+                x = layer(x)
+            else:
+                x = layer(x)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            raise RuntimeError("PipelineLayer needs loss_fn for training")
+        return self._loss_fn(output, label)

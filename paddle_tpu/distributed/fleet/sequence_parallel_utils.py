@@ -1,0 +1,157 @@
+"""Megatron-style sequence parallelism (reference:
+fleet/utils/sequence_parallel_utils.py — ScatterOp:85, AllGatherOp:111,
+ReduceScatterOp:127, ColumnSequenceParallelLinear:429, RowSequenceParallelLinear:564).
+
+TPU-native: the scatter/gather boundary ops are sharding constraints on the
+sequence dim over the 'mp' axis; GSPMD turns Column(all-gather before GEMM) /
+Row(reduce-scatter after) into the exact collective pair the reference hand-codes,
+and XLA's collective-matmul pass overlaps them with the GEMM (the reference's
+SPInnerOverlapLinear:257 analog, for free).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor, dispatch
+from ...nn.layer_base import Layer
+from ...nn.initializer import XavierNormal, Constant
+from ...nn import functional as F
+from ... import ops
+from . import fleet_state
+
+
+def _mesh():
+    hcg = fleet_state.hcg()
+    if hcg is None:
+        raise RuntimeError("fleet.init first")
+    return hcg.mesh
+
+
+def _constrain(x, spec):
+    mesh = _mesh()
+
+    def fn(v):
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh.jax_mesh(), PartitionSpec(*spec)))
+    return dispatch(fn, (x,), {}, name="sp_constraint")
+
+
+def _seq_spec(ndim, seq_dim=0):
+    spec = [None] * ndim
+    spec[seq_dim] = "mp"
+    return tuple(spec)
+
+
+class ScatterOp:
+    """Full seq -> seq sharded over mp (forward scatter, backward all-gather)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        return _constrain(x, _seq_spec(x.ndim, axis))
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, axis=0):
+        return _constrain(x, (None,) * x.ndim)
+
+
+class AllGatherOp:
+    """seq-sharded -> full (forward all_gather, backward reduce_scatter)."""
+
+    @staticmethod
+    def apply(x):
+        return _constrain(x, (None,) * x.ndim)
+
+
+class ReduceScatterOp:
+    """partial-sum full seq -> reduced seq-shard (forward reduce_scatter)."""
+
+    @staticmethod
+    def apply(x):
+        return _constrain(x, _seq_spec(x.ndim, 0))
+
+
+def scatter(x, axis=0):
+    return ScatterOp.apply(x, axis)
+
+
+def all_gather(x):
+    return AllGatherOp.apply(x)
+
+
+def reduce_scatter(x):
+    return ReduceScatterOp.apply(x)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter._sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "_sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               use_dp=False):
+    pass  # grads of seq-parallel params sync through GSPMD automatically
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """[s/mp, b, h] -> all-gather seq -> GEMM with col-sharded W -> [s, b, out/mp]."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        mesh = _mesh()
+        w = self.create_parameter((in_features, out_features), attr=weight_attr,
+                                  default_initializer=XavierNormal())
+        w._value = jax.device_put(w._value, NamedSharding(
+            mesh.jax_mesh(), PartitionSpec(None, "mp")))
+        self.weight = w
+        self.bias = None
+        if has_bias:
+            b = self.create_parameter((out_features,), is_bias=True,
+                                      default_initializer=Constant(0.0))
+            b._value = jax.device_put(b._value, NamedSharding(
+                mesh.jax_mesh(), PartitionSpec("mp")))
+            self.bias = b
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x)           # seq gather (GSPMD overlaps with GEMM)
+        out = F.linear(x, self.weight, self.bias)
+        spec = [None] * out.ndim
+        if not self.gather_output:
+            spec[-1] = "mp"
+        return _constrain(out, tuple(spec))
+
+
+class RowSequenceParallelLinear(Layer):
+    """[s, b, in/mp] GEMM row-sharded W -> partial sums -> reduce-scatter seq."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        mesh = _mesh()
+        w = self.create_parameter((in_features, out_features), attr=weight_attr,
+                                  default_initializer=XavierNormal())
+        w._value = jax.device_put(w._value, NamedSharding(
+            mesh.jax_mesh(), PartitionSpec("mp", None)))
+        self.weight = w
+        self.bias = self.create_parameter((out_features,), is_bias=True,
+                                          default_initializer=Constant(0.0)) \
+            if has_bias else None
+
+    def forward(self, x):
+        spec_in = [None] * x.ndim
+        spec_in[-1] = "mp"
+        x = _constrain(x, tuple(spec_in))
+        out = ops.matmul(x, self.weight)
+        out = ReduceScatterOp.apply(out)   # reduce over mp + scatter seq dim
+        if self.bias is not None:
+            out = out + self.bias
+        return out

@@ -1,0 +1,57 @@
+"""spawn + launch helpers (reference: python/paddle/distributed/spawn.py and
+launch/ module — builds per-process env: PADDLE_TRAINER_ID/ENDPOINTS/MASTER)."""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker(fn, rank, nprocs, master, args, env):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_MASTER"] = master
+    fn(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn — launches nprocs host processes.
+
+    On TPU pods there is normally ONE process per host (all local chips addressed
+    by that process); nprocs>1 on one host is for CPU-backed multi-process tests.
+    """
+    master = f"127.0.0.1:{_free_port()}"
+    ctx = mp.get_context("spawn")
+    procs = []
+    env = {k: v for k, v in os.environ.items()}
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, master, args, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"spawned rank failed with {p.exitcode}")
+    return procs
+
+
+def get_cluster_from_args(args=None):
+    return {
+        "rank": int(os.environ.get("PADDLE_TRAINER_ID", 0)),
+        "world_size": int(os.environ.get("PADDLE_TRAINERS_NUM", 1)),
+        "master": os.environ.get("PADDLE_MASTER", ""),
+    }

@@ -1,0 +1,179 @@
+"""ProcessMesh + placements — the DistTensor metadata model.
+
+Reference: ProcessMesh (paddle/phi/core/distributed/auto_parallel/process_mesh.h,
+python/paddle/distributed/auto_parallel/process_mesh.py:85) and Placements
+(placement_types.h: Shard/Replicate/Partial).
+
+TPU-native: a ProcessMesh wraps a jax.sharding.Mesh over PJRT devices; placements
+translate to NamedSharding PartitionSpecs. Partial is represented EXPLICITLY (see
+api.py) since jax's logical arrays cannot carry pending-reduction state: a tensor
+that is Partial over axis `a` stores an extra leading dim of size |a|, sharded over
+`a`; the logical value is the sum over that dim. Reshard transitions then lower to
+XLA collectives (sum -> all_reduce/reduce_scatter; expand -> zero-pad placement).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def get_dim(self):
+        return self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """N-d logical view over devices (reference: process_mesh.py:85)."""
+
+    _unique_counter = [0]
+
+    def __init__(self, mesh, dim_names=None, devices=None):
+        arr = np.asarray(mesh)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        self._mesh_ids = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._devices = devices  # optional explicit jax devices
+        self._jax_mesh = None
+
+    @property
+    def mesh(self):
+        return self._mesh_ids.tolist()
+
+    @property
+    def shape(self):
+        return list(self._mesh_ids.shape)
+
+    @property
+    def ndim(self):
+        return self._mesh_ids.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._mesh_ids.reshape(-1).tolist()
+
+    def get_dim_size(self, name):
+        return self._mesh_ids.shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, dim, pid):
+        idx = np.argwhere(self._mesh_ids == pid)
+        if idx.size == 0:
+            return -1
+        return int(idx[0][self._dim_names.index(dim) if isinstance(dim, str) else dim])
+
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            if self._devices is not None:
+                devs = np.asarray(self._devices, dtype=object).reshape(
+                    self._mesh_ids.shape)
+            else:
+                all_devs = jax.devices()
+                flat = [all_devs[i % len(all_devs)]
+                        for i in self._mesh_ids.reshape(-1)]
+                devs = np.asarray(flat, dtype=object).reshape(self._mesh_ids.shape)
+            self._jax_mesh = Mesh(devs, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh_ids, other._mesh_ids)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh_ids.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    def get_group(self, dim_name=None):
+        from .env import _group_from_mesh_axis
+        return _group_from_mesh_axis(self, dim_name)
+
+
+def placements_to_spec(placements, ndim: int, dim_names) -> PartitionSpec:
+    """placements (one per mesh axis) -> PartitionSpec over tensor dims.
+
+    The analog of the reference's dist_attr dims_mapping (auto_parallel.proto).
+    Partial axes contribute nothing to the spec (handled by the explicit leading
+    dims in api.py).
+    """
+    spec = [None] * ndim
+    for axis_name, p in zip(dim_names, placements):
+        if isinstance(p, Shard):
+            d = p.dim % ndim
+            if spec[d] is None:
+                spec[d] = axis_name
+            elif isinstance(spec[d], tuple):
+                spec[d] = spec[d] + (axis_name,)
+            else:
+                spec[d] = (spec[d], axis_name)
+    return PartitionSpec(*spec)
+
+
+def sharding_for(mesh: ProcessMesh, placements, ndim: int) -> NamedSharding:
+    return NamedSharding(mesh.jax_mesh(),
+                         placements_to_spec(placements, ndim, mesh.dim_names))

@@ -1,0 +1,119 @@
+"""SPMD collective pipeline — the compute core of pipeline parallelism.
+
+Reference analog: fleet/meta_parallel/pipeline_parallel.py (1F1B at
+forward_backward_pipeline:684) + p2p_communication.py over NCCL send/recv.
+
+TPU-native design: the pipeline is ONE compiled program. Stages are structurally
+identical (transformer repeat blocks); per-stage params carry a leading [S] dim
+sharded over the 'pp' mesh axis. A lax.scan steps microbatches through the ring:
+each tick every stage runs its block, then activations ppermute to the next stage
+over ICI. Backward is jax autodiff of the scan — XLA schedules it as the reverse
+pipeline (the 1F1B-equivalent interleave emerges from the dependence structure
+rather than a hand-written schedule); `remat` trades activation memory like the
+reference's recompute_interval.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+
+def spmd_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp", data_axis=None,
+                  remat=False):
+    """Run microbatches through a ring of identical stages.
+
+    stage_fn(params, x) -> y, with y.shape == x.shape (inter-stage activation).
+    stacked_params: pytree, each leaf [S, ...] (S = #stages), sharded over `axis`.
+    x_mb: [M, microbatch, ...] inputs for stage 0 (replicated over `axis`;
+          optionally sharded over `data_axis` on the microbatch dim).
+    Returns y_mb [M, microbatch, ...] — last stage's outputs, replicated over axis.
+    """
+    jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+    S = jmesh.shape[axis]
+    M = x_mb.shape[0]
+    assert M >= 1
+    T = M + S - 1
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    batch_spec = P(None, data_axis) if data_axis else P()
+
+    def per_device(params_l, x):
+        params = jax.tree_util.tree_map(lambda a: a[0], params_l)
+        idx = jax.lax.axis_index(axis)
+
+        def step(state, t):
+            mb = jax.lax.dynamic_index_in_dim(x, jnp.clip(t, 0, M - 1), 0,
+                                              keepdims=False)
+            cur = jnp.where(idx == 0, mb, state)
+            out = fn(params, cur)
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            nxt = jax.lax.ppermute(out, axis, perm)
+            return nxt, out
+
+        _, outs = jax.lax.scan(step, jnp.zeros_like(x[0]), jnp.arange(T))
+        y = outs[S - 1:]                       # [M, mb, ...] valid on last stage
+        y = jnp.where(idx == S - 1, y, jnp.zeros_like(y))
+        return jax.lax.psum(y, axis)           # replicate last stage's outputs
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    return shard_map(per_device, mesh=jmesh,
+                     in_specs=(spec_params, batch_spec),
+                     out_specs=batch_spec,
+                     check_vma=False)(stacked_params, x_mb)
+
+
+def interleaved_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp",
+                         num_chunks=2, data_axis=None, remat=False):
+    """Interleaved (VPP) schedule: each device owns `num_chunks` non-adjacent model
+    chunks (reference: PipelineParallelWithInterleave, pipeline_parallel.py:1308).
+    Param leaves are [S*num_chunks, ...] in ring order; the ring is traversed
+    num_chunks times per microbatch."""
+    jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+    S = jmesh.shape[axis]
+    V = num_chunks
+    M = x_mb.shape[0]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    batch_spec = P(None, data_axis) if data_axis else P()
+
+    def per_device(params_l, x):
+        # leaf [V, ...]: chunk v on this device is global stage (v*S + idx)
+        idx = jax.lax.axis_index(axis)
+
+        def run_ring(carry_x, v):
+            # leaf local shape [V, 1(pp-local), L, ...]: pick chunk v, drop pp dim
+            chunk_params = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, v, 0, keepdims=False)[0],
+                params_l)
+            T = M + S - 1
+
+            def step(state, t):
+                mb = jax.lax.dynamic_index_in_dim(carry_x, jnp.clip(t, 0, M - 1), 0,
+                                                  keepdims=False)
+                cur = jnp.where(idx == 0, mb, state)
+                out = fn(chunk_params, cur)
+                perm = [(i, (i + 1) % S) for i in range(S)]
+                return jax.lax.ppermute(out, axis, perm), out
+
+            _, outs = jax.lax.scan(step, jnp.zeros_like(carry_x[0]), jnp.arange(T))
+            y = outs[S - 1:]
+            y = jnp.where(idx == S - 1, y, jnp.zeros_like(y))
+            return jax.lax.psum(y, axis), None
+
+        y, _ = jax.lax.scan(run_ring, x, jnp.arange(V))
+        return y
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(None, axis), stacked_params)
+
+    # reshape leaves [S*V, ...] -> [V, S, ...] so chunk-major scan + pp shard works
+    def reshape_leaf(a):
+        return a.reshape((V, S) + a.shape[1:])
+
+    stacked_vs = jax.tree_util.tree_map(reshape_leaf, stacked_params)
+    return shard_map(per_device, mesh=jmesh,
+                     in_specs=(spec_params, batch_spec),
+                     out_specs=batch_spec,
+                     check_vma=False)(stacked_vs, x_mb)

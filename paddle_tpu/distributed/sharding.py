@@ -1,0 +1,39 @@
+"""paddle.distributed.sharding — group_sharded_parallel API (reference:
+python/paddle/distributed/sharding/group_sharded.py)."""
+from __future__ import annotations
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=None,
+                           segment_size=None, sync_comm=False,
+                           dp_group=None, exclude_layer=None):
+    """level: 'os' (ZeRO-1), 'os_g' (ZeRO-2), 'p_g_os' (ZeRO-3)."""
+    from .fleet.sharding_optimizer import (
+        DygraphShardingOptimizer, GroupShardedStage2, GroupShardedStage3)
+    from .fleet import fleet_state
+    if fleet_state.hcg() is None or \
+            fleet_state.hcg().get_sharding_parallel_world_size() == 1:
+        from . import fleet
+        strategy = fleet.DistributedStrategy()
+        import jax
+        strategy.hybrid_configs["sharding_degree"] = len(jax.devices())
+        fleet.init(is_collective=True, strategy=strategy)
+    if level == "os":
+        opt = DygraphShardingOptimizer(optimizer)
+        return model, opt, scaler
+    if level == "os_g":
+        opt = GroupShardedStage2(optimizer)
+        return model, opt, scaler
+    if level == "p_g_os":
+        wrapped = GroupShardedStage3(model, optimizer)
+        return wrapped, wrapped._optimizer, scaler
+    raise ValueError(f"unknown sharding level {level!r}")
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    import os
+    from ..framework_io import save
+    os.makedirs(output, exist_ok=True)
+    save(model.state_dict(), os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

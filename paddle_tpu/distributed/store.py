@@ -1,0 +1,173 @@
+"""TCPStore — socket KV rendezvous (reference: phi/core/distributed/store/
+tcp_store.h:121, CreateOrGetGlobalTCPStore at store_utils.h:33).
+
+Rank 0 hosts a tiny length-prefixed protocol server; all ranks connect as clients.
+Used for multi-process bootstrap metadata, barriers, and host-side object
+collectives (the Gloo-analog for small host tensors/objects). Device-side
+collectives never touch this — they compile to XLA ICI/DCN ops.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def _recv_msg(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        hdr += chunk
+    (n,) = struct.unpack("!I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        buf += chunk
+    return buf
+
+
+class _StoreServer(threading.Thread):
+    def __init__(self, host, port):
+        super().__init__(daemon=True)
+        self._kv = {}
+        self._cv = threading.Condition()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self.port = self._srv.getsockname()[1]
+        self._srv.listen(128)
+
+    def run(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                req = pickle.loads(_recv_msg(conn))
+                op = req["op"]
+                if op == "set":
+                    with self._cv:
+                        self._kv[req["key"]] = req["value"]
+                        self._cv.notify_all()
+                    _send_msg(conn, pickle.dumps({"ok": True}))
+                elif op == "get":
+                    with self._cv:
+                        _send_msg(conn, pickle.dumps(
+                            {"ok": True, "value": self._kv.get(req["key"])}))
+                elif op == "wait":
+                    deadline = time.time() + req.get("timeout", 300)
+                    with self._cv:
+                        while req["key"] not in self._kv:
+                            remaining = deadline - time.time()
+                            if remaining <= 0:
+                                _send_msg(conn, pickle.dumps(
+                                    {"ok": False, "error": "timeout"}))
+                                break
+                            self._cv.wait(timeout=min(remaining, 1.0))
+                        else:
+                            _send_msg(conn, pickle.dumps(
+                                {"ok": True, "value": self._kv[req["key"]]}))
+                elif op == "add":
+                    with self._cv:
+                        cur = self._kv.get(req["key"], 0) + req["value"]
+                        self._kv[req["key"]] = cur
+                        self._cv.notify_all()
+                    _send_msg(conn, pickle.dumps({"ok": True, "value": cur}))
+                elif op == "delete":
+                    with self._cv:
+                        self._kv.pop(req["key"], None)
+                        self._cv.notify_all()
+                    _send_msg(conn, pickle.dumps({"ok": True}))
+        except (ConnectionError, EOFError):
+            return
+
+
+class TCPStore:
+    def __init__(self, host="127.0.0.1", port=0, is_master=False, world_size=1,
+                 timeout=300):
+        self.world_size = world_size
+        self.timeout = timeout
+        self._server = None
+        if is_master:
+            self._server = _StoreServer(host, port)
+            self._server.start()
+            port = self._server.port
+        self.host, self.port = host, port
+        self._lock = threading.Lock()
+        self._sock = None
+        deadline = time.time() + timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError(f"cannot reach TCPStore at {host}:{port}")
+                time.sleep(0.2)
+
+    def _rpc(self, req):
+        with self._lock:
+            _send_msg(self._sock, pickle.dumps(req))
+            resp = pickle.loads(_recv_msg(self._sock))
+        if not resp.get("ok"):
+            raise TimeoutError(resp.get("error", "store error"))
+        return resp.get("value")
+
+    def set(self, key, value):
+        self._rpc({"op": "set", "key": key, "value": value})
+
+    def get(self, key):
+        return self._rpc({"op": "get", "key": key})
+
+    def wait(self, key, timeout=None):
+        return self._rpc({"op": "wait", "key": key,
+                          "timeout": timeout or self.timeout})
+
+    def add(self, key, value=1):
+        return self._rpc({"op": "add", "key": key, "value": value})
+
+    def delete(self, key):
+        self._rpc({"op": "delete", "key": key})
+
+    def barrier(self, name="default", world_size=None, timeout=None):
+        n = world_size or self.world_size
+        count = self.add(f"__barrier/{name}/count", 1)
+        gen = (count - 1) // n
+        target = (gen + 1) * n
+        deadline = time.time() + (timeout or self.timeout)
+        while self.get(f"__barrier/{name}/count") < target:
+            if time.time() > deadline:
+                raise TimeoutError(f"barrier {name} timed out")
+            time.sleep(0.01)
+
+
+_global_store: TCPStore | None = None
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    global _global_store
+    if _global_store is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        master = os.environ.get("PADDLE_MASTER", os.environ.get("MASTER_ENDPOINT",
+                                                                "127.0.0.1:0"))
+        host, _, port = master.partition(":")
+        _global_store = TCPStore(host or "127.0.0.1", int(port or 0),
+                                 is_master=(rank == 0), world_size=world)
+    return _global_store

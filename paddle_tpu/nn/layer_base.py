@@ -95,9 +95,11 @@ class Layer:
             for d in (self._parameters, self._sub_layers, self._buffers):
                 d.pop(name, None)
             if isinstance(value, Parameter):
+                self.__dict__.pop(name, None)  # drop any shadowing plain attr
                 params[name] = value
                 return
             if isinstance(value, Layer):
+                self.__dict__.pop(name, None)
                 self._sub_layers[name] = value
                 return
         object.__setattr__(self, name, value)
