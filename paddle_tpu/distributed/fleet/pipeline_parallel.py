@@ -96,10 +96,17 @@ class PipelineParallel:
                 leaves.append(p._value)
             arr = jnp.stack(leaves)  # [S*V*L, ...]
             arr = arr.reshape((self._S * self._V, self._L) + arr.shape[1:])
-            # shard leading stage dim over pp
+            # shard leading stage dim over pp; preserve any TP sharding the
+            # template layer put on the weight dims (TP-inside-PP composition)
             from jax.sharding import NamedSharding, PartitionSpec
-            spec = [None] * arr.ndim
-            spec[0] = "pp"
+            p0_val = leaves[0]
+            base = [None] * (arr.ndim - 2)
+            if isinstance(getattr(p0_val, "sharding", None), NamedSharding) \
+                    and p0_val.sharding.spec is not None:
+                for i, s in enumerate(tuple(p0_val.sharding.spec)):
+                    if i < len(base):
+                        base[i] = s
+            spec = ["pp", None] + base
             arr = jax.device_put(arr, NamedSharding(self._mesh.jax_mesh(),
                                                     PartitionSpec(*spec)))
             p0 = dict(template.named_parameters())[n]

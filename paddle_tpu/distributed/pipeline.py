@@ -39,7 +39,9 @@ def spmd_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp", data_axis=Non
     T = M + S - 1
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
-    batch_spec = P(None, data_axis) if data_axis else P()
+    # dp (and any other non-pp axis) is automatic: the input batch keeps its own
+    # sharding and GSPMD partitions the body; specs only name the manual pp axis.
+    batch_spec = P()
 
     def per_device(params_l, x):
         params = jax.tree_util.tree_map(lambda a: a[0], params_l)
@@ -60,9 +62,11 @@ def spmd_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp", data_axis=Non
         return jax.lax.psum(y, axis)           # replicate last stage's outputs
 
     spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    # manual over the pipeline axis only: dp/mp/sharding axes stay automatic, so
+    # GSPMD partitions the stage body (TP matmuls, dp batch) inside the ring.
     return shard_map(per_device, mesh=jmesh,
                      in_specs=(spec_params, batch_spec),
-                     out_specs=batch_spec,
+                     out_specs=batch_spec, axis_names={axis},
                      check_vma=False)(stacked_params, x_mb)
 
 
@@ -77,7 +81,7 @@ def interleaved_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp",
     V = num_chunks
     M = x_mb.shape[0]
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
-    batch_spec = P(None, data_axis) if data_axis else P()
+    batch_spec = P()
 
     def per_device(params_l, x):
         # leaf [V, ...]: chunk v on this device is global stage (v*S + idx)
@@ -115,5 +119,5 @@ def interleaved_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp",
     stacked_vs = jax.tree_util.tree_map(reshape_leaf, stacked_params)
     return shard_map(per_device, mesh=jmesh,
                      in_specs=(spec_params, batch_spec),
-                     out_specs=batch_spec,
+                     out_specs=batch_spec, axis_names={axis},
                      check_vma=False)(stacked_vs, x_mb)

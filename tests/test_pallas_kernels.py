@@ -1,0 +1,90 @@
+"""Pallas kernel tests (run in interpret mode on the CPU test mesh; identical code
+executes compiled on TPU)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.kernels.flash_attention import flash_attention_fwd
+
+
+def _reference(q, k, v, causal):
+    d = q.shape[-1]
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    if kt.shape[1] != qt.shape[1]:
+        rep = qt.shape[1] // kt.shape[1]
+        kt = jnp.repeat(kt, rep, axis=1)
+        vt = jnp.repeat(vt, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(d)
+    if causal:
+        sq, sk = logits.shape[-2:]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(1, 128, 2, 64), (2, 256, 4, 64)])
+def test_flash_forward_matches_reference(causal, shape):
+    b, s, h, d = shape
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    out = flash_attention_fwd(q, k, v, causal)
+    ref = _reference(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3,
+                               atol=2e-3)
+
+
+def test_flash_gqa():
+    b, s, hq, hkv, d = 1, 128, 4, 2, 64
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((b, s, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    out = flash_attention_fwd(q, k, v, True)
+    ref = _reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3,
+                               atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_reference(causal):
+    b, s, h, d = 1, 128, 2, 64
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention_fwd(q, k, v, causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference(q, k, v, causal) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=5e-3,
+                                   atol=5e-3)
+
+
+def test_flash_backward_gqa():
+    b, s, hq, hkv, d = 1, 128, 4, 2, 64
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((b, s, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+
+    g1 = jax.grad(lambda *a: jnp.sum(flash_attention_fwd(*a, True) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: jnp.sum(_reference(*a, True) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=5e-3,
+                                   atol=5e-3)
