@@ -250,9 +250,21 @@ class PipelineParallel:
         stacked_ids = {id(self._stacked[n]): n for n in stacked_names}
         prefix_entries, suffix_entries = self._prefix, self._suffix
         layers_obj = self._layers
-        dp_axis = "dp" if self._dp > 1 else None
         V, remat = self._V, self._remat
+        dp = self._dp
         decay_flags = tuple(bool(optimizer._decay_mask(p)) for p in trainable)
+
+        def dp_shard(a, dim):
+            """Pin a batch-like dim to the dp axis so each dp group computes its
+            slice (GSPMD would otherwise keep replicated inputs replicated and
+            every dp replica would redo the full batch)."""
+            if dp <= 1 or a.shape[dim] % dp != 0:
+                return a
+            from jax.sharding import NamedSharding, PartitionSpec
+            spec = [None] * a.ndim
+            spec[dim] = "dp"
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh.jax_mesh(), PartitionSpec(*spec)))
 
         def run_fix(entries, h):
             for layer, fwd in entries:
@@ -274,22 +286,22 @@ class PipelineParallel:
                         fix_vals.append(v)
                 with functional_mode(), bind_state(fix_tensors, fix_vals), \
                         _random.provide_key(rng):
-                    h = run_fix(prefix_entries, Tensor(xv))
+                    h = run_fix(prefix_entries, Tensor(dp_shard(xv, 0)))
                     hv = h._value
                     B = hv.shape[0]
                     mb = B // M
-                    h_mb = hv.reshape((M, mb) + hv.shape[1:])
+                    h_mb = dp_shard(hv.reshape((M, mb) + hv.shape[1:]), 1)
                     if V > 1:
                         y_mb = interleaved_pipeline(stage, stacked_vals, h_mb, mesh,
                                                     "pp", num_chunks=V,
-                                                    data_axis=dp_axis, remat=remat)
+                                                    remat=remat)
                     else:
                         y_mb = spmd_pipeline(stage, stacked_vals, h_mb, mesh, "pp",
-                                             data_axis=dp_axis, remat=remat)
+                                             remat=remat)
                     out = Tensor(y_mb.reshape((B,) + y_mb.shape[2:]))
                     out = run_fix(suffix_entries, out)
                     if has_labels:
-                        loss = layers_obj.loss(out, Tensor(yv[0]))
+                        loss = layers_obj.loss(out, Tensor(dp_shard(yv[0], 0)))
                     else:
                         loss = out
                 return loss._value

@@ -22,14 +22,13 @@ from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 
-def spmd_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp", data_axis=None,
-                  remat=False):
+def spmd_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp", remat=False):
     """Run microbatches through a ring of identical stages.
 
     stage_fn(params, x) -> y, with y.shape == x.shape (inter-stage activation).
     stacked_params: pytree, each leaf [S, ...] (S = #stages), sharded over `axis`.
-    x_mb: [M, microbatch, ...] inputs for stage 0 (replicated over `axis`;
-          optionally sharded over `data_axis` on the microbatch dim).
+    x_mb: [M, microbatch, ...] inputs for stage 0, replicated over `axis`; any
+          dp/mp sharding on the microbatch dims stays automatic under GSPMD.
     Returns y_mb [M, microbatch, ...] — last stage's outputs, replicated over axis.
     """
     jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
@@ -71,7 +70,7 @@ def spmd_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp", data_axis=Non
 
 
 def interleaved_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp",
-                         num_chunks=2, data_axis=None, remat=False):
+                         num_chunks=2, remat=False):
     """Interleaved (VPP) schedule: each device owns `num_chunks` non-adjacent model
     chunks (reference: PipelineParallelWithInterleave, pipeline_parallel.py:1308).
     Param leaves are [S*num_chunks, ...] in ring order; the ring is traversed

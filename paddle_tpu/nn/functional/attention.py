@@ -71,7 +71,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     dropout_key = _random.next_key() if dropout_p > 0.0 else None
 
     q_val = query._value if isinstance(query, Tensor) else query
-    if (_use_pallas(q_val) and attn_mask is None and dropout_p == 0.0):
+    k_val = key._value if isinstance(key, Tensor) else key
+    # Pallas kernel masks top-left aligned (rows >= cols); the reference
+    # semantics are bottom-right aligned (tril k=sk-sq), which only coincide
+    # when sq == sk — route unequal lengths (e.g. kv-cache decode) to the
+    # XLA path.
+    if (_use_pallas(q_val) and attn_mask is None and dropout_p == 0.0
+            and (not is_causal or q_val.shape[1] == k_val.shape[1])):
         from ...ops.kernels.flash_attention import flash_attention_fwd
         def fn(q, k, v):
             return flash_attention_fwd(q, k, v, causal=is_causal)

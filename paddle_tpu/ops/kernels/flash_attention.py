@@ -294,6 +294,11 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None):
 
 
 def _flash_fwd_res(q, k, v, causal, scale):
+    # kernel masks top-left aligned; bottom-right (paddle) semantics only
+    # coincide for equal lengths — hard error beats silent corruption.
+    assert not causal or q.shape[1] == k.shape[1], \
+        "flash_attention_fwd: causal requires seq_q == seq_k (decode goes " \
+        "through scaled_dot_product_attention's XLA path)"
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     q3, bhq = _to_bhsd(q)
