@@ -152,3 +152,7 @@ from .sequence_parallel_utils import (  # noqa: E402,F401
     RowSequenceParallelLinear, mark_as_sequence_parallel_parameter,
 )
 from ...core.random import get_rng_state_tracker  # noqa: E402,F401
+from .context_parallel import (  # noqa: E402,F401
+    ring_flash_attention, ulysses_flash_attention, ContextParallelAttention,
+    shard_zigzag, unshard_zigzag,
+)

@@ -1,0 +1,158 @@
+"""Ring attention — blockwise context parallelism over a mesh axis.
+
+The reference ships no in-core ring attention (SURVEY.md §5.7: PaddleNLP implements
+"RingFlashAttention" out-of-tree on top of the `sep` hybrid axis,
+fleet/meta_parallel/segment_parallel.py:26). Here it is first-class and TPU-native:
+K/V shards rotate around the ring with `jax.lax.ppermute` (ICI neighbor exchange),
+each step computes one attention block and merges it into the running output with a
+numerically-stable log-sum-exp combine. The whole loop is a `lax.scan`, so XLA
+overlaps the ppermute with the block matmuls, and `jax.checkpoint` on the per-step
+body keeps backward memory at one block of logits.
+
+Causal load balancing uses the zigzag layout: rank r holds sequence chunks
+(r, 2N-1-r), so every rank does the same causal work. Masking is driven by global
+position indices, so contiguous and zigzag layouts share one code path.
+
+All functions here operate on raw jax arrays INSIDE shard_map (one shard per rank),
+layout [B, S_local, H, D]. User-facing wrappers live in
+paddle_tpu/distributed/fleet/context_parallel.py.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30  # finite stand-in for -inf: keeps exp/where arithmetic NaN-free
+
+
+def _block_attn(q, k, v, mask, scale):
+    """One attention block, returning (normalized out, lse) in fp32 stats.
+
+    q: [B, Lq, H, D]; k, v: [B, Lk, KVH, D]; mask: [Lq, Lk] bool (True = attend).
+    Handles GQA by repeating KV heads. Rows with no visible keys produce
+    out = 0, lse = ~-inf, so they contribute nothing to the ring merge.
+    """
+    h, kvh = q.shape[2], k.shape[2]
+    if kvh != h:
+        k = jnp.repeat(k, h // kvh, axis=2)
+        v = jnp.repeat(v, h // kvh, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(mask[None, None], logits.astype(jnp.float32), _NEG_INF)
+    m = jnp.max(logits, axis=-1)                      # [B,H,Lq]
+    row_dead = m <= _NEG_INF / 2
+    m_safe = jnp.where(row_dead, 0.0, m)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)                           # [B,H,Lq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = o / jnp.swapaxes(l_safe, 1, 2)[..., None].astype(o.dtype)
+    lse = jnp.where(row_dead, _NEG_INF, m_safe + jnp.log(l_safe))
+    return o, lse                                     # o: [B,Lq,H,D], lse: [B,H,Lq]
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Combine two normalized partial-softmax results (flash-attention merge)."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    w1 = jnp.where(lse1 <= _NEG_INF / 2, 0.0, jnp.exp(lse1 - m_safe))
+    w2 = jnp.where(lse2 <= _NEG_INF / 2, 0.0, jnp.exp(lse2 - m_safe))
+    tot = w1 + w2
+    tot_safe = jnp.where(tot == 0.0, 1.0, tot)
+    wb1 = jnp.swapaxes(w1 / tot_safe, 1, 2)[..., None]   # [B,Lq,H,1]
+    wb2 = jnp.swapaxes(w2 / tot_safe, 1, 2)[..., None]
+    o = o1 * wb1.astype(o1.dtype) + o2 * wb2.astype(o2.dtype)
+    lse = jnp.where(tot == 0.0, _NEG_INF, m_safe + jnp.log(tot_safe))
+    return o, lse
+
+
+def zigzag_positions(axis_index, n_ranks, local_len):
+    """Global positions of this rank's rows under the zigzag (balanced) layout.
+
+    Rank r holds chunks (r, 2N-1-r) of size local_len//2 each, so causal work is
+    uniform across ranks. local_len must be even.
+    """
+    c = local_len // 2
+    lo = axis_index * c + jnp.arange(c)
+    hi = (2 * n_ranks - 1 - axis_index) * c + jnp.arange(c)
+    return jnp.concatenate([lo, hi])
+
+
+def contiguous_positions(axis_index, n_ranks, local_len):
+    return axis_index * local_len + jnp.arange(local_len)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None, balanced=False):
+    """Ring attention over mesh axis `axis_name`; call inside shard_map.
+
+    q: [B, S_local, H, D]; k, v: [B, S_local, KVH, D] — each rank's sequence shard.
+    `balanced=True` expects inputs in the zigzag layout (see shard_zigzag) and only
+    matters for causal masking. Fully differentiable (scan + ppermute transpose).
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    lq, lk = q.shape[1], k.shape[1]
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    pos_fn = zigzag_positions if balanced else contiguous_positions
+    qpos = pos_fn(my, n, lq)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @jax.checkpoint
+    def block(q, k, v, kv_idx):
+        if causal:
+            kvpos = pos_fn(kv_idx, n, lk)
+            mask = qpos[:, None] >= kvpos[None, :]
+        else:
+            mask = jnp.ones((lq, lk), bool)
+        return _block_attn(q, k, v, mask, scale)
+
+    def step(carry, s):
+        kc, vc, o_acc, lse_acc = carry
+        kv_idx = (my - s) % n
+        o_b, lse_b = block(q, kc, vc, kv_idx)
+        o_acc, lse_acc = _merge(o_acc, lse_acc, o_b, lse_b)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, o_acc, lse_acc), None
+
+    o0 = jnp.zeros(q.shape[:2] + (q.shape[2], v.shape[-1]), q.dtype)
+    lse0 = jnp.full((q.shape[0], q.shape[2], lq), _NEG_INF, jnp.float32)
+    if hasattr(jax.lax, "pcast"):
+        # constants enter the scan carry as device-invariant; the body makes them
+        # device-varying over the ring axis — align the types up front
+        o0 = jax.lax.pcast(o0, (axis_name,), to="varying")
+        lse0 = jax.lax.pcast(lse0, (axis_name,), to="varying")
+    (_, _, o, _), _ = jax.lax.scan(step, (k, v, o0, lse0), jnp.arange(n))
+    return o
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
+                      attn_fn=None):
+    """Ulysses (DeepSpeed-style) all-to-all attention; call inside shard_map.
+
+    Swaps the sequence shard for a head shard with `lax.all_to_all`, runs FULL
+    attention locally on n_heads/N heads (flash kernel on TPU), and swaps back.
+    Requires H (and KVH) divisible by the axis size.
+    """
+    n = jax.lax.psum(1, axis_name)
+    # [B, S/N, H, D] -> [B, S, H/N, D]
+    qh = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kh = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vh = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    if attn_fn is None:
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        lq = qh.shape[1]
+        mask = (jnp.tril(jnp.ones((lq, kh.shape[1]), bool)) if causal
+                else jnp.ones((lq, kh.shape[1]), bool))
+        o, _ = _block_attn(qh, kh, vh, mask, s)
+    else:
+        o = attn_fn(qh, kh, vh)
+    # [B, S, H/N, D] -> [B, S/N, H, D]
+    return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)

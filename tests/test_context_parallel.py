@@ -1,0 +1,80 @@
+"""Context parallelism: ring + Ulysses attention vs exact single-device SDPA.
+
+Runs on the 8-virtual-CPU-device mesh (conftest.py), mirroring the reference's
+local-subprocess cluster trick for multi-rank semantics (SURVEY.md §4).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.functional.attention import _sdpa_reference
+from paddle_tpu.distributed.fleet.context_parallel import (
+    ring_flash_attention, ulysses_flash_attention, shard_zigzag, unshard_zigzag,
+)
+
+
+def _qkv(rng, b=2, s=64, h=4, kvh=None, d=16):
+    kvh = kvh or h
+    q = rng.standard_normal((b, s, h, d), dtype=np.float32)
+    k = rng.standard_normal((b, s, kvh, d), dtype=np.float32)
+    v = rng.standard_normal((b, s, kvh, d), dtype=np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_reference(rng, causal):
+    q, k, v = _qkv(rng)
+    ref = _sdpa_reference(q, k, v, None, causal, 0.0, None)
+    out = ring_flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gqa(rng):
+    q, k, v = _qkv(rng, h=8, kvh=2)
+    ref = _sdpa_reference(q, k, v, None, True, 0.0, None)
+    out = ring_flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_unbalanced_contiguous(rng):
+    q, k, v = _qkv(rng)
+    ref = _sdpa_reference(q, k, v, None, True, 0.0, None)
+    out = ring_flash_attention(q, k, v, causal=True, balanced=False)
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gradients(rng):
+    q, k, v = _qkv(rng, b=1, s=32, h=2, d=8)
+
+    def loss_ring(q, k, v):
+        o = ring_flash_attention(q, k, v, causal=True)
+        return jnp.sum(o._value ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_sdpa_reference(q, k, v, None, True, 0.0, None) ** 2)
+
+    g_ring = jax.grad(lambda t: loss_ring(*t))((q, k, v))
+    g_ref = jax.grad(lambda t: loss_ref(*t))((q, k, v))
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_zigzag_roundtrip(rng):
+    x = jnp.asarray(rng.standard_normal((2, 64, 4, 8), dtype=np.float32))
+    y = unshard_zigzag(shard_zigzag(x, 8), 8)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_reference(rng, causal):
+    q, k, v = _qkv(rng, h=8)
+    ref = _sdpa_reference(q, k, v, None, causal, 0.0, None)
+    out = ulysses_flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
