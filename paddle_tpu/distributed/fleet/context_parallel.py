@@ -39,35 +39,44 @@ def shard_zigzag(x, n_ranks, seq_axis=1):
     """Reorder the full sequence into the zigzag layout: rank r gets chunks
     (r, 2N-1-r). Apply BEFORE sharding the sequence axis; invert with
     unshard_zigzag after gathering."""
-    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-    s = v.shape[seq_axis]
-    c = s // (2 * n_ranks)
-    chunks = jnp.split(v, 2 * n_ranks, axis=seq_axis)
-    order = []
-    for r in range(n_ranks):
-        order += [chunks[r], chunks[2 * n_ranks - 1 - r]]
-    out = jnp.concatenate(order, axis=seq_axis)
-    return Tensor(out) if isinstance(x, Tensor) else out
+    def fn(v):
+        s = v.shape[seq_axis]
+        if s % (2 * n_ranks) != 0:
+            raise ValueError(
+                f"zigzag layout needs seq len divisible by 2*n_ranks "
+                f"({s} vs 2*{n_ranks})")
+        chunks = jnp.split(v, 2 * n_ranks, axis=seq_axis)
+        order = []
+        for r in range(n_ranks):
+            order += [chunks[r], chunks[2 * n_ranks - 1 - r]]
+        return jnp.concatenate(order, axis=seq_axis)
+    if isinstance(x, Tensor):
+        return dispatch(fn, (x,), {}, name="shard_zigzag")
+    return fn(jnp.asarray(x))
 
 
 def unshard_zigzag(x, n_ranks, seq_axis=1):
     """Inverse of shard_zigzag on the gathered (full-sequence) tensor."""
-    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-    chunks = jnp.split(v, 2 * n_ranks, axis=seq_axis)
-    inv = [None] * (2 * n_ranks)
-    j = 0
-    for r in range(n_ranks):
-        inv[r] = chunks[j]; j += 1
-        inv[2 * n_ranks - 1 - r] = chunks[j]; j += 1
-    out = jnp.concatenate(inv, axis=seq_axis)
-    return Tensor(out) if isinstance(x, Tensor) else out
+    def fn(v):
+        chunks = jnp.split(v, 2 * n_ranks, axis=seq_axis)
+        inv = [None] * (2 * n_ranks)
+        j = 0
+        for r in range(n_ranks):
+            inv[r] = chunks[j]; j += 1
+            inv[2 * n_ranks - 1 - r] = chunks[j]; j += 1
+        return jnp.concatenate(inv, axis=seq_axis)
+    if isinstance(x, Tensor):
+        return dispatch(fn, (x,), {}, name="unshard_zigzag")
+    return fn(jnp.asarray(x))
 
 
 def ring_flash_attention(query, key, value, mesh=None, axis_name="sep",
                          causal=False, scale=None, balanced=None):
-    """Ring attention on [B, S, H, D] tensors whose S axis is (to be) sharded
-    over `axis_name`. Inputs may be full-size (sharded by shard_map here) on a
-    single host, or already per-shard when called inside an outer shard_map.
+    """Ring attention on FULL-SIZE [B, S, H, D] tensors; this wrapper owns the
+    shard_map over `axis_name`. From inside an existing shard_map (e.g. a fused
+    hybrid-parallel step), call ops.kernels.ring_attention.ring_attention on the
+    per-shard arrays instead — nesting this wrapper raises a mesh-context error,
+    and per-shard inputs here would be silently re-sharded to 1/N of the sequence.
 
     balanced=None → auto: zigzag layout for causal (uniform per-rank work).
     """
@@ -94,16 +103,23 @@ def ring_flash_attention(query, key, value, mesh=None, axis_name="sep",
 
 
 def ulysses_flash_attention(query, key, value, mesh=None, axis_name="sep",
-                            causal=False, scale=None):
-    """Ulysses all-to-all attention on [B, S, H, D]; H must divide by axis size."""
+                            causal=False, scale=None, attn_fn=None):
+    """Ulysses all-to-all attention on [B, S, H, D]; H must divide by axis size.
+
+    attn_fn overrides the local (post-all-to-all) attention; the default is the
+    Pallas flash kernel on TPU, exact fp32-softmax attention elsewhere.
+    """
     mesh = _resolve_mesh(mesh, axis_name)
     spec = P(None, axis_name, None, None)
 
     def fn(q, k, v):
         f = shard_map(
             lambda a, b, c: ulysses_attention(a, b, c, axis_name, causal=causal,
-                                              scale=scale),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                                              scale=scale, attn_fn=attn_fn),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            # pallas_call out_shapes carry no vma info; the flash-kernel local
+            # step would fail shard_map's vma check
+            check_vma=False)
         return f(q, k, v)
 
     return dispatch(fn, (query, key, value), {}, name="ulysses_flash_attention")
@@ -117,6 +133,9 @@ class ContextParallelAttention:
     """
 
     def __init__(self, mesh=None, axis_name="sep", mode="ring", causal=True):
+        if mode not in ("ring", "ulysses"):
+            raise ValueError(f"unknown context-parallel mode {mode!r} "
+                             "(expected 'ring' or 'ulysses')")
         self.mesh = mesh
         self.axis_name = axis_name
         self.mode = mode

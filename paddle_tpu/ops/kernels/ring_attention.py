@@ -112,23 +112,20 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None, balanced=False)
             mask = jnp.ones((lq, lk), bool)
         return _block_attn(q, k, v, mask, scale)
 
+    # step 0 on the local KV shard, then n-1 rotate-and-accumulate steps —
+    # exactly n-1 ppermutes, none wasted on a discarded final rotation
+    o0, lse0 = block(q, k, v, my)
+
     def step(carry, s):
         kc, vc, o_acc, lse_acc = carry
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
         kv_idx = (my - s) % n
         o_b, lse_b = block(q, kc, vc, kv_idx)
         o_acc, lse_acc = _merge(o_acc, lse_acc, o_b, lse_b)
-        kc = jax.lax.ppermute(kc, axis_name, perm)
-        vc = jax.lax.ppermute(vc, axis_name, perm)
         return (kc, vc, o_acc, lse_acc), None
 
-    o0 = jnp.zeros(q.shape[:2] + (q.shape[2], v.shape[-1]), q.dtype)
-    lse0 = jnp.full((q.shape[0], q.shape[2], lq), _NEG_INF, jnp.float32)
-    if hasattr(jax.lax, "pcast"):
-        # constants enter the scan carry as device-invariant; the body makes them
-        # device-varying over the ring axis — align the types up front
-        o0 = jax.lax.pcast(o0, (axis_name,), to="varying")
-        lse0 = jax.lax.pcast(lse0, (axis_name,), to="varying")
-    (_, _, o, _), _ = jax.lax.scan(step, (k, v, o0, lse0), jnp.arange(n))
+    (_, _, o, _), _ = jax.lax.scan(step, (k, v, o0, lse0), jnp.arange(1, n))
     return o
 
 
@@ -140,18 +137,21 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
     attention locally on n_heads/N heads (flash kernel on TPU), and swaps back.
     Requires H (and KVH) divisible by the axis size.
     """
-    n = jax.lax.psum(1, axis_name)
     # [B, S/N, H, D] -> [B, S, H/N, D]
     qh = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kh = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vh = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
     if attn_fn is None:
-        d = q.shape[-1]
-        s = scale if scale is not None else 1.0 / math.sqrt(d)
-        lq = qh.shape[1]
-        mask = (jnp.tril(jnp.ones((lq, kh.shape[1]), bool)) if causal
-                else jnp.ones((lq, kh.shape[1]), bool))
-        o, _ = _block_attn(qh, kh, vh, mask, s)
+        if jax.default_backend() == "tpu":
+            from .flash_attention import flash_attention_fwd
+            o = flash_attention_fwd(qh, kh, vh, causal=causal, scale=scale)
+        else:
+            d = q.shape[-1]
+            s = scale if scale is not None else 1.0 / math.sqrt(d)
+            lq = qh.shape[1]
+            mask = (jnp.tril(jnp.ones((lq, kh.shape[1]), bool)) if causal
+                    else jnp.ones((lq, kh.shape[1]), bool))
+            o, _ = _block_attn(qh, kh, vh, mask, s)
     else:
         o = attn_fn(qh, kh, vh)
     # [B, S, H/N, D] -> [B, S/N, H, D]
