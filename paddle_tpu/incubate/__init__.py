@@ -1,0 +1,7 @@
+"""paddle.incubate analog — experimental APIs (fused ops, MoE, …).
+
+Reference: python/paddle/incubate/ (SURVEY.md §2.6: fused NN functionals,
+MoE layers, asp sparsity).
+"""
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401

@@ -1,0 +1,2 @@
+from .gates import NaiveGate, GShardGate, SwitchGate, BaseGate  # noqa: F401
+from .moe_layer import MoELayer  # noqa: F401

@@ -1,0 +1,55 @@
+"""MoE router gates (reference: incubate/distributed/models/moe/gate/
+{naive,gshard,switch}_gate.py).
+
+A gate maps tokens [T, D] to router logits [T, E] and declares its top_k and
+capacity policy; the dispatch/combine math itself lives in
+ops/kernels/moe.py (static-shape GShard algorithm)."""
+from __future__ import annotations
+
+import math
+
+from .....nn.layer_base import Layer
+from .....nn.initializer import XavierUniform
+from .....nn import functional as F
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=2.0,
+                 eval_capacity_factor=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor or capacity_factor
+        self.weight = self.create_parameter(
+            (d_model, num_experts), default_initializer=XavierUniform())
+
+    def forward(self, x):
+        """Token features [T, D] -> router logits [T, E]."""
+        return F.linear(x, self.weight)
+
+    def effective_capacity_factor(self):
+        return self.capacity_factor if self.training else self.eval_capacity_factor
+
+
+class NaiveGate(BaseGate):
+    """Plain linear router, top-k softmax weighting (reference naive_gate.py)."""
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with load-balance aux loss (reference gshard_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=2.0,
+                 eval_capacity_factor=None):
+        super().__init__(d_model, num_experts, top_k, capacity_factor,
+                         eval_capacity_factor)
+
+
+class SwitchGate(BaseGate):
+    """Top-1 (Switch Transformer) gate (reference switch_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=1, capacity_factor=1.25,
+                 eval_capacity_factor=2.0):
+        super().__init__(d_model, num_experts, 1, capacity_factor,
+                         eval_capacity_factor)

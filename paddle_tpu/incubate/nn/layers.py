@@ -1,0 +1,120 @@
+"""Fused transformer layers (reference: python/paddle/incubate/nn/layer/
+fused_transformer.py — FusedMultiHeadAttention, FusedFeedForward).
+
+On TPU these are compositions XLA fuses; the flash path comes from
+scaled_dot_product_attention's Pallas routing."""
+from __future__ import annotations
+
+import math
+
+from ...nn.layer_base import Layer
+from ...nn.initializer import XavierUniform, Constant
+from ...nn import functional as F
+from ...nn.functional.attention import scaled_dot_product_attention
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN multi-head self-attention with fused QKV projection."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None, normalize_before=False,
+                 need_weights=False, qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None, ln_scale_attr=None,
+                 ln_bias_attr=None, epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        self.qkv_weight = self.create_parameter(
+            (embed_dim, 3 * embed_dim), attr=qkv_weight_attr,
+            default_initializer=XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            (3 * embed_dim,), attr=qkv_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), attr=linear_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=linear_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=ln_scale_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), attr=ln_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, query, attn_mask=None, cache=None):
+        x = query
+        if self.normalize_before:
+            x = F.layer_norm(x, (self.embed_dim,), self.ln_scale, self.ln_bias,
+                             self.epsilon)
+        b, s, _ = x.shape
+        qkv = F.linear(x, self.qkv_weight, self.qkv_bias)
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = (qkv[:, :, i] for i in range(3))
+        out = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate,
+            training=self.training)
+        out = out.reshape([b, s, self.embed_dim])
+        out = F.linear(out, self.linear_weight, self.linear_bias)
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        out = query + out
+        if not self.normalize_before:
+            out = F.layer_norm(out, (self.embed_dim,), self.ln_scale,
+                               self.ln_bias, self.epsilon)
+        return out
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-5,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.activation = activation
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), attr=linear1_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear1_bias = self.create_parameter(
+            (dim_feedforward,), attr=linear1_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), attr=linear2_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear2_bias = self.create_parameter(
+            (d_model,), attr=linear2_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            (d_model,), attr=ln1_scale_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (d_model,), attr=ln1_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, src):
+        x = src
+        if self.normalize_before:
+            x = F.layer_norm(x, (self.d_model,), self.ln_scale, self.ln_bias,
+                             self.epsilon)
+        h = F.linear(x, self.linear1_weight, self.linear1_bias)
+        h = getattr(F, self.activation)(h)
+        h = F.dropout(h, self.act_dropout_rate, training=self.training)
+        h = F.linear(h, self.linear2_weight, self.linear2_bias)
+        h = F.dropout(h, self.dropout_rate, training=self.training)
+        out = src + h
+        if not self.normalize_before:
+            out = F.layer_norm(out, (self.d_model,), self.ln_scale, self.ln_bias,
+                               self.epsilon)
+        return out

@@ -1,0 +1,138 @@
+"""Mixture-of-Experts core: static-shape gating, dispatch/combine, EP all_to_all.
+
+Reference: incubate/distributed/models/moe/moe_layer.py:261 (MoELayer with
+global_scatter/global_gather alltoall ops) and gate/{gshard,switch,naive}_gate.py.
+
+TPU-native redesign: instead of the reference's ragged scatter/gather CUDA ops,
+tokens are routed with the GShard capacity algorithm at STATIC shapes — dispatch
+and combine are [T, E, C] einsum masks, so the whole layer is dense matmuls the
+MXU tiles well, and expert parallelism is one `lax.all_to_all` over the `ep`
+mesh axis inside shard_map. Everything here operates on raw jax arrays.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_gating(logits, top_k, capacity, *, jitter_key=None, jitter_eps=0.0,
+                 norm_topk=True):
+    """GShard/Switch gating at static shapes.
+
+    logits: [T, E] router scores. Returns (dispatch [T,E,C] bool,
+    combine [T,E,C] float, aux_loss scalar, router_probs [T,E]).
+
+    top_k=1 → Switch; top_k=2 → GShard top-2 with renormalized weights.
+    Tokens overflowing an expert's capacity C are dropped (contribute 0),
+    matching the reference's capacity semantics.
+    """
+    t, e = logits.shape
+    if jitter_key is not None and jitter_eps > 0.0:
+        noise = jax.random.uniform(jitter_key, logits.shape,
+                                   minval=1.0 - jitter_eps, maxval=1.0 + jitter_eps)
+        logits = logits * noise
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    dispatch = jnp.zeros((t, e, capacity), bool)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    masks = []
+    gates = []
+    p = probs
+    for k in range(top_k):
+        idx = jnp.argmax(p, axis=-1)                     # [T]
+        m = jax.nn.one_hot(idx, e, dtype=jnp.float32)    # [T,E]
+        gates.append(jnp.sum(probs * m, axis=-1))        # gate prob of choice k
+        masks.append(m)
+        p = p * (1.0 - m)                                # exclude chosen expert
+
+    # positions within each expert's buffer, counting all k-levels in order
+    # (k=0 choices fill first, like the reference's prioritized dispatch)
+    prev_counts = jnp.zeros((e,), jnp.float32)
+    positions = []
+    for m in masks:
+        pos = jnp.cumsum(m, axis=0) - m + prev_counts[None, :]   # [T,E]
+        positions.append(jnp.sum(pos * m, axis=-1))              # [T]
+        prev_counts = prev_counts + jnp.sum(m, axis=0)
+
+    # normalize top-k gate weights over the kept experts
+    denom = sum(gates) if (top_k > 1 and norm_topk) else None
+    for k, (m, g, pos) in enumerate(zip(masks, gates, positions)):
+        keep = (pos < capacity) & (jnp.sum(m, axis=-1) > 0)
+        w = g / jnp.maximum(denom, 1e-9) if denom is not None else g
+        pos_c = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
+        oh_pos = jax.nn.one_hot(pos_c, capacity, dtype=jnp.float32)  # [T,C]
+        contrib = m[:, :, None] * oh_pos[:, None, :]                  # [T,E,C]
+        contrib = contrib * keep[:, None, None]
+        dispatch = dispatch | (contrib > 0)
+        combine = combine + contrib * w[:, None, None]
+
+    # GShard load-balancing loss: E * mean_e(frac_tokens_e * mean_prob_e)
+    me = jnp.mean(probs, axis=0)                          # [E]
+    ce = jnp.mean(masks[0], axis=0)                       # fraction routed (k=0)
+    aux_loss = e * jnp.sum(me * ce)
+    return dispatch, combine, aux_loss, probs
+
+
+def moe_ffn(dispatched, w_gate, w_up, w_down, activation="swiglu"):
+    """Stacked-expert FFN: dispatched [E, C, D] -> [E, C, D].
+
+    w_gate/w_up: [E, D, F]; w_down: [E, F, D]. swiglu (llama-style) or gelu
+    (w_gate unused for gelu).
+    """
+    if activation == "swiglu":
+        g = jnp.einsum("ecd,edf->ecf", dispatched, w_gate)
+        u = jnp.einsum("ecd,edf->ecf", dispatched, w_up)
+        h = jax.nn.silu(g) * u
+    else:
+        u = jnp.einsum("ecd,edf->ecf", dispatched, w_up)
+        h = jax.nn.gelu(u)
+    return jnp.einsum("ecf,efd->ecd", h, w_down)
+
+
+def moe_forward_dense(x, router_w, w_gate, w_up, w_down, *, top_k=2,
+                      capacity_factor=2.0, activation="swiglu"):
+    """Single-device MoE on [T, D] tokens; returns (y [T,D], aux_loss)."""
+    t, d = x.shape
+    e = router_w.shape[1]
+    capacity = max(int(capacity_factor * t / e), top_k)
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
+                        router_w.astype(jnp.float32))
+    dispatch, combine, aux, _ = top_k_gating(logits, top_k, capacity)
+    dispatched = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    h = moe_ffn(dispatched, w_gate, w_up, w_down, activation)
+    y = jnp.einsum("tec,ecd->td", combine.astype(h.dtype), h)
+    return y, aux
+
+
+def moe_forward_ep(x, router_w, w_gate, w_up, w_down, axis_name, *, top_k=2,
+                   capacity_factor=2.0, activation="swiglu"):
+    """Expert-parallel MoE inside shard_map.
+
+    x: [T_local, D] local token shard; w_*: [E_local, ...] local expert shard
+    (E = E_local * ep_size). Dispatch goes through one all_to_all each way:
+    [E, C, D] -> (exchange) -> [E_local, ep*C, D] so each rank runs only its
+    experts over every rank's tokens (reference: global_scatter/global_gather).
+    """
+    n = jax.lax.psum(1, axis_name)
+    t = x.shape[0]
+    e_local = w_up.shape[0]
+    e = e_local * n
+    capacity = max(int(capacity_factor * t / e), top_k)
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
+                        router_w.astype(jnp.float32))
+    dispatch, combine, aux, _ = top_k_gating(logits, top_k, capacity)
+    dispatched = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    # [E, C, D] -> expert-block j to rank j, buffers concat along capacity:
+    # rank j ends up with [E_local, N*C, D] (its experts, every rank's tokens)
+    recv = jax.lax.all_to_all(dispatched, axis_name, split_axis=0,
+                              concat_axis=1, tiled=True)
+    h = moe_ffn(recv, w_gate, w_up, w_down, activation)
+    # reverse: capacity chunk r back to token-owner r, expert blocks re-stack
+    h_home = jax.lax.all_to_all(h, axis_name, split_axis=1, concat_axis=0,
+                                tiled=True)
+    y = jnp.einsum("tec,ecd->td", combine.astype(h_home.dtype), h_home)
+    # aux loss averaged over ranks (each rank computed it on its local tokens)
+    aux = jax.lax.pmean(aux, axis_name)
+    return y, aux

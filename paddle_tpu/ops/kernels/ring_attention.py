@@ -142,15 +142,24 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
     kh = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vh = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
     if attn_fn is None:
-        if jax.default_backend() == "tpu":
+        from ...core.flags import flag_value
+        from ...nn.functional import attention as _  # registers the flag
+        # same routing rules as scaled_dot_product_attention: Pallas only on
+        # TPU, only when the flag allows it, and causal sq!=sk (top-left vs
+        # bottom-right alignment mismatch) goes to the exact path
+        use_pallas = (jax.default_backend() == "tpu"
+                      and flag_value("use_pallas_flash_attention")
+                      and (not causal or qh.shape[1] == kh.shape[1]))
+        if use_pallas:
             from .flash_attention import flash_attention_fwd
             o = flash_attention_fwd(qh, kh, vh, causal=causal, scale=scale)
         else:
             d = q.shape[-1]
             s = scale if scale is not None else 1.0 / math.sqrt(d)
-            lq = qh.shape[1]
-            mask = (jnp.tril(jnp.ones((lq, kh.shape[1]), bool)) if causal
-                    else jnp.ones((lq, kh.shape[1]), bool))
+            lq, lk = qh.shape[1], kh.shape[1]
+            # bottom-right aligned causal (paddle semantics, _sdpa_reference)
+            mask = (jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq) if causal
+                    else jnp.ones((lq, lk), bool))
             o, _ = _block_attn(qh, kh, vh, mask, s)
     else:
         o = attn_fn(qh, kh, vh)
