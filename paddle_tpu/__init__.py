@@ -51,12 +51,21 @@ _LAZY_SUBMODULES = (
 )
 
 
+_LAZY_ATTRS = {"Model": ("hapi", "Model"), "summary": ("hapi", "summary")}
+
+
 def __getattr__(name):
     if name in _LAZY_SUBMODULES:
         import importlib
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name in _LAZY_ATTRS:
+        import importlib
+        mod_name, attr = _LAZY_ATTRS[name]
+        val = getattr(importlib.import_module(f".{mod_name}", __name__), attr)
+        globals()[name] = val
+        return val
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 

@@ -213,3 +213,6 @@ def is_bfloat16_supported(place=None):
 
 def is_float16_supported(place=None):
     return True
+
+
+from . import debugging  # noqa: E402,F401  (paddle.amp.debugging parity)

@@ -1,0 +1,158 @@
+"""Numeric debugging tools (reference: python/paddle/amp/debugging.py —
+TensorCheckerConfig, enable_tensor_checker, operator stats collection,
+accuracy comparison; kernel twin: phi/kernels/check_numerics_kernel.h).
+
+TPU-native: the nan/inf sanitizer rides the dispatch-level check_nan_inf flag
+(core/tensor.py), and operator stats ride the _OP_OBSERVERS dispatch hook —
+no per-kernel instrumentation needed since every op funnels through dispatch.
+"""
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.flags import set_flags, get_flags
+from ..core import tensor as _tensor_mod
+from ..core.tensor import Tensor
+
+__all__ = [
+    "DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+    "disable_tensor_checker", "check_numerics", "enable_operator_stats_collection",
+    "disable_operator_stats_collection", "collect_operator_stats",
+    "compare_accuracy",
+]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+class TensorCheckerConfig:
+    """Reference: amp/debugging.py TensorCheckerConfig."""
+
+    def __init__(self, enable, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+        self.debug_step = debug_step
+
+    def _level(self):
+        return 0 if self.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT else 1
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    if checker_config.enable:
+        set_flags({"check_nan_inf": True,
+                   "check_nan_inf_level": checker_config._level()})
+
+
+def disable_tensor_checker():
+    set_flags({"check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Count nan/inf/zero and min/max/mean of one tensor (reference:
+    amp/debugging.py check_numerics -> check_numerics kernel).
+
+    Returns (stats, values): stats = [num_nan, num_inf, num_zero] int64 Tensor,
+    values = [max, min, mean] float32 Tensor."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    vf = v.astype(jnp.float32)
+    finite = jnp.isfinite(vf)
+    stats = jnp.stack([jnp.sum(jnp.isnan(vf)).astype(jnp.int64),
+                       jnp.sum(jnp.isinf(vf)).astype(jnp.int64),
+                       jnp.sum(vf == 0).astype(jnp.int64)])
+    safe = jnp.where(finite, vf, jnp.nan)
+    values = jnp.stack([jnp.nanmax(safe), jnp.nanmin(safe), jnp.nanmean(safe)])
+    return Tensor(stats, stop_gradient=True), Tensor(values, stop_gradient=True)
+
+
+class _OpStatsCollector:
+    def __init__(self):
+        self.stats = {}
+
+    def __call__(self, name, leaves):
+        for v in leaves:
+            if not hasattr(v, "dtype"):
+                continue
+            key = f"{name}-{np.dtype(v.dtype).name}"
+            ent = self.stats.setdefault(key, {"calls": 0, "num_nan": 0,
+                                              "num_inf": 0})
+            ent["calls"] += 1
+            if (jnp.issubdtype(v.dtype, jnp.inexact)
+                    and not isinstance(v, jax.core.Tracer)):
+                # tracers (ops inside a jit trace) are counted but not
+                # inspected — forcing them concrete would abort the trace
+                ent["num_nan"] += int(jnp.sum(jnp.isnan(v)))
+                ent["num_inf"] += int(jnp.sum(jnp.isinf(v)))
+
+
+_ACTIVE: list[_OpStatsCollector] = []
+
+
+def enable_operator_stats_collection():
+    """Start collecting per-op call/nan/inf stats (reference:
+    amp/debugging.py enable_operator_stats_collection)."""
+    c = _OpStatsCollector()
+    _ACTIVE.append(c)
+    _tensor_mod._OP_OBSERVERS.append(c)
+
+
+def disable_operator_stats_collection():
+    if not _ACTIVE:
+        return
+    c = _ACTIVE.pop()
+    _tensor_mod._OP_OBSERVERS.remove(c)
+    _print_operator_stats(c.stats)
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def _print_operator_stats(stats):
+    print(f"{'op-dtype':<48} {'calls':>8} {'nan':>8} {'inf':>8}")
+    for key in sorted(stats):
+        s = stats[key]
+        print(f"{key:<48} {s['calls']:>8} {s['num_nan']:>8} {s['num_inf']:>8}")
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Diff two operator-stats .npz dumps (reference: amp/debugging.py
+    compare_accuracy over check_nan_inf dump dirs); writes a CSV report."""
+    import csv
+    a = np.load(dump_path, allow_pickle=True)
+    b = np.load(another_dump_path, allow_pickle=True)
+    keys = sorted(set(a.files) | set(b.files))
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["tensor", "max_abs_diff", "mean_abs_diff", "shape_a",
+                    "shape_b"])
+        for k in keys:
+            if k not in a.files or k not in b.files:
+                w.writerow([k, "missing", "", k in a.files, k in b.files])
+                continue
+            va, vb = np.asarray(a[k], np.float64), np.asarray(b[k], np.float64)
+            if va.shape != vb.shape:
+                w.writerow([k, "shape-mismatch", "", va.shape, vb.shape])
+                continue
+            d = np.abs(va - vb)
+            w.writerow([k, float(d.max()), float(d.mean()), va.shape, vb.shape])
+    return output_filename

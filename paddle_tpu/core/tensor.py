@@ -315,6 +315,11 @@ def _unwrap(x):
     return x._value if isinstance(x, Tensor) else x
 
 
+# observers called as (op_name, out_leaves) after every dispatch — used by
+# amp.debugging operator-stats collection; empty in the hot path
+_OP_OBSERVERS: list = []
+
+
 def _check_numerics(name, leaves):
     level = flag_value("check_nan_inf_level")
     for v in leaves:
@@ -389,6 +394,8 @@ def _wrap_outputs(out, node: Node | None, name: str):
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
     if flag_value("check_nan_inf"):
         _check_numerics(name, out_leaves)
+    for _obs in _OP_OBSERVERS:
+        _obs(name, out_leaves)
     wrapped = []
     for i, leaf in enumerate(out_leaves):
         if not isinstance(leaf, (jax.Array, np.ndarray)) and not hasattr(leaf, "dtype"):

@@ -1,0 +1,6 @@
+"""paddle.hapi analog — high-level Model API (reference: python/paddle/hapi/)."""
+from .model import Model  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
+)
+from .summary import summary  # noqa: F401

@@ -1,0 +1,257 @@
+"""paddle.Model — Keras-like high-level training API (reference:
+python/paddle/hapi/model.py:1472, fit at :2200).
+
+TPU-native: train/eval batches run through the fused-jit TrainStep path when the
+model+loss are jit-friendly (the default), falling back to eager tape autograd
+on trace failure — the analog of the reference's dynamic/static dual engine.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+
+from ..core.tensor import Tensor, no_grad
+from ..nn.layer_base import Layer
+from ..metric import Metric
+from .. import framework_io
+from ..io import DataLoader, Dataset
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_tensor(x):
+    from ..ops.creation import to_tensor
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+class Model:
+    """Wraps a Layer with prepare/fit/evaluate/predict/save/load."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        for m in _to_list(metrics):
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be paddle_tpu.metric.Metric, got {m}")
+        self._metrics = _to_list(metrics)
+        self._train_step = None
+
+    # ------------------------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        labs = _to_list(labels)
+        if self._loss is None:
+            raise RuntimeError("call prepare(loss=...) before training")
+        loss = self._loss(*(outs + labs))
+        if isinstance(loss, (list, tuple)):
+            loss = sum(loss[1:], loss[0])
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """One optimization step; returns [loss] (+ metric results).
+
+        The fused-jit TrainStep path always applies the optimizer update, so
+        gradient accumulation (update=False) and metric computation (which
+        needs the forward outputs) route through the eager tape instead.
+        """
+        self.network.train()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(x) for x in _to_list(labels)]
+        if not update or self._metrics or getattr(self, "_accum", 1) > 1:
+            self._train_step = False
+
+        if self._train_step is None:
+            from ..jit.api import TrainStep
+
+            def loss_fn(net, *batch):
+                n_in = len(inputs)
+                outs = net(*batch[:n_in])
+                return self._compute_loss(outs, list(batch[n_in:]))
+            try:
+                self._train_step = TrainStep(self.network, loss_fn,
+                                             self._optimizer)
+            except Exception:  # pragma: no cover - fallback path
+                self._train_step = False
+        if self._train_step:
+            try:
+                loss = self._train_step(*(inputs + labels))
+                return self._finish_batch(loss, inputs, labels, None)
+            except Exception as e:
+                warnings.warn(f"jit train step failed ({e}); falling back to eager")
+                self._train_step = False
+        # eager fallback
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return self._finish_batch(loss, inputs, labels, outputs)
+
+    def _finish_batch(self, loss, inputs, labels, outputs=None):
+        logs = [float(np.asarray(loss._value if isinstance(loss, Tensor) else loss))]
+        for m in self._metrics:
+            m.update(*m.compute(*(_to_list(outputs) + labels)))
+        return logs
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(x) for x in _to_list(labels)]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels) if self._loss else None
+        for m in self._metrics:
+            m.update(*m.compute(*(_to_list(outputs) + labels)))
+        return [float(np.asarray(loss._value))] if loss is not None else []
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        outs = self.network(*inputs)
+        return [np.asarray(o._value) for o in _to_list(outs)]
+
+    # ------------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        return data  # assume iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """Reference: hapi/model.py fit:2200."""
+        self._accum = accumulate_grad_batches
+        loader = self._make_loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs, steps=steps,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir, metrics=self._metrics)
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                outs = self.train_batch(ins, labs, update=update)
+                logs = {"loss": outs[0]}
+                for m in self._metrics:
+                    for n, v in zip(_to_list(m.name()), _to_list(m.accumulate())):
+                        logs[n] = v
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if (num_iters and it >= num_iters) or self.stop_training:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, verbose=verbose, callbacks=cbks,
+                              _inner=True)
+            if (num_iters and it >= num_iters) or self.stop_training:
+                break
+        cbks.on_train_end(logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None, _inner=False):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbks = callbacks if _inner else config_callbacks(
+            callbacks, model=self, epochs=1, steps=None, verbose=verbose,
+            metrics=self._metrics)
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, labs = self._split_batch(batch)
+            outs = self.eval_batch(ins, labs)
+            if outs:
+                losses.append(outs[0])
+            cbks.on_eval_batch_end(step, {"loss": outs[0] if outs else None})
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            for n, v in zip(_to_list(m.name()), _to_list(m.accumulate())):
+                logs[n] = v
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            if self._inputs is not None or self._labels is not None:
+                # explicit input/label specs (reference: Model(net, inputs, labels))
+                n_in = len(_to_list(self._inputs)) or (
+                    len(batch) - len(_to_list(self._labels)))
+                return batch[:n_in], batch[n_in:]
+            if has_labels and len(batch) >= 2:
+                return batch[:-1], [batch[-1]]
+            return batch, []
+        return [batch], []
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        """state_dict(s) under <path>.pdparams/.pdopt (reference: model.py save)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        framework_io.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework_io.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = framework_io.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(framework_io.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network)

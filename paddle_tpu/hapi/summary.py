@@ -1,0 +1,30 @@
+"""Model summary table (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def summary(net, input_size=None, dtypes=None):
+    """Print a per-layer parameter table; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, layer in net.named_sublayers(include_self=True):
+        own = [(n, p) for n, p in layer.named_parameters(include_sublayers=False)]
+        if not own:
+            continue
+        n_params = sum(int(np.prod(p.shape)) for _, p in own)
+        total += n_params
+        trainable += sum(int(np.prod(p.shape)) for _, p in own
+                         if not p.stop_gradient)
+        rows.append((name or layer.__class__.__name__,
+                     layer.__class__.__name__, n_params))
+    width = max([len(r[0]) for r in rows] + [10])
+    print(f"{'Layer':<{width}}  {'Type':<24}  {'Params':>12}")
+    print("-" * (width + 40))
+    for name, cls, n in rows:
+        print(f"{name:<{width}}  {cls:<24}  {n:>12,}")
+    print("-" * (width + 40))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

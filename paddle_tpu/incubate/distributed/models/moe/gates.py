@@ -21,7 +21,9 @@ class BaseGate(Layer):
         self.num_experts = num_experts
         self.top_k = top_k
         self.capacity_factor = capacity_factor
-        self.eval_capacity_factor = eval_capacity_factor or capacity_factor
+        self.eval_capacity_factor = (eval_capacity_factor
+                                     if eval_capacity_factor is not None
+                                     else capacity_factor)
         self.weight = self.create_parameter(
             (d_model, num_experts), default_initializer=XavierUniform())
 
@@ -39,11 +41,6 @@ class NaiveGate(BaseGate):
 
 class GShardGate(BaseGate):
     """Top-2 gate with load-balance aux loss (reference gshard_gate.py)."""
-
-    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=2.0,
-                 eval_capacity_factor=None):
-        super().__init__(d_model, num_experts, top_k, capacity_factor,
-                         eval_capacity_factor)
 
 
 class SwitchGate(BaseGate):

@@ -248,10 +248,18 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sc
         q_idx = jnp.arange(s)
         k_idx = jnp.arange(k.shape[2])
-        valid = (q_idx[None, :, None] < sl.reshape(-1, 1, 1)) & \
-                (k_idx[None, None, :] < kl.reshape(-1, 1, 1))
+        sl = sl.reshape(-1)
+        kl = kl.reshape(-1)
+        valid = (q_idx[None, :, None] < sl[:, None, None]) & \
+                (k_idx[None, None, :] < kl[:, None, None])
         if causal:
-            valid = valid & (q_idx[:, None] >= k_idx[None, :])[None]
+            # bottom-right aligned (paddle semantics): query i of the sl valid
+            # rows sits at global position offset+i among the kl valid keys,
+            # where offset = pre_cache_length (explicit cache) or kl - sl
+            off = (jnp.full_like(kl, pre_cache_length) if pre_cache_length > 0
+                   else kl - sl)
+            valid = valid & (q_idx[None, :, None] + off[:, None, None]
+                             >= k_idx[None, None, :])
         logits = jnp.where(valid[:, None], logits, -jnp.inf)
         if m:
             logits = logits + m[0].astype(jnp.float32)

@@ -1,0 +1,199 @@
+"""paddle.metric analog (reference: python/paddle/metric/metrics.py —
+Metric base, Accuracy, Precision, Recall, Auc).
+
+Metrics accumulate on host in numpy (cheap scalar state); inputs may be
+paddle_tpu Tensors or arrays."""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Reference: metric/metrics.py Metric — reset/update/accumulate/name."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+    def compute(self, *args):
+        """Optional pre-processing hook run on device outputs (identity here)."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference: metric/metrics.py Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = topk if isinstance(topk, (tuple, list)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = _np(pred)
+        label = _np(label)
+        idx = np.argsort(-pred, axis=-1)[..., : self.maxk]
+        if label.ndim == pred.ndim:
+            if label.shape[-1] == 1:     # paddle-convention [N, 1] class ids
+                label = label[..., 0]
+            else:                        # one-hot / soft labels
+                label = np.argmax(label, axis=-1)
+        correct = idx == label[..., None]
+        return correct
+
+    def update(self, correct, *args):
+        correct = _np(correct)
+        accs = []
+        num = int(np.prod(correct.shape[:-1]))
+        for k in self.topk:
+            c = correct[..., :k].any(-1).sum()
+            self.total[self.topk.index(k)] += int(c)
+        self.count += num
+        for i, k in enumerate(self.topk):
+            accs.append(self.total[i] / max(self.count, 1))
+        return np.asarray(accs[0] if len(self.topk) == 1 else accs)
+
+    def reset(self):
+        self.total = [0] * len(self.topk)
+        self.count = 0
+
+    def accumulate(self):
+        res = [t / max(self.count, 1) for t in self.total]
+        return res[0] if len(self.topk) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision (reference: metric/metrics.py Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+        self._name = name or "precision"
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.rint(_np(preds)).astype(int).reshape(-1)
+        labels = _np(labels).astype(int).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        den = self.tp + self.fp
+        return self.tp / den if den else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall (reference: metric/metrics.py Recall)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+        self._name = name or "recall"
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.rint(_np(preds)).astype(int).reshape(-1)
+        labels = _np(labels).astype(int).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        den = self.tp + self.fn
+        return self.tp / den if den else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via threshold buckets (reference: metric/metrics.py Auc)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__()
+        self.num_thresholds = num_thresholds
+        self._name = name or "auc"
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        if preds.ndim == 2:              # [N, 2] probs -> positive-class prob
+            preds = preds[:, 1]
+        labels = _np(labels).reshape(-1)
+        idx = np.clip((preds.reshape(-1) * self.num_thresholds).astype(int), 0,
+                      self.num_thresholds)
+        for i, lab in zip(idx, labels):
+            if lab:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def accumulate(self):
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        # sweep thresholds from high to low, trapezoid rule
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_pos + tot_pos) * (new_neg - tot_neg) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        den = tot_pos * tot_neg
+        return float(auc / den) if den else 0.0
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference: metric/metrics.py accuracy)."""
+    pred = _np(input)
+    lab = _np(label).reshape(-1)
+    idx = np.argsort(-pred, axis=-1)[..., :k].reshape(len(lab), k)
+    correct_n = (idx == lab[:, None]).any(-1).sum()
+    from ..ops.creation import to_tensor
+    return to_tensor(np.asarray(correct_n / max(len(lab), 1), np.float32))

@@ -1,0 +1,272 @@
+"""paddle.profiler analog (reference: python/paddle/profiler/profiler.py:358,
+utils.py:47 RecordEvent, profiler_statistic.py, timer.py).
+
+Two coordinated layers, like the reference (SURVEY.md §5.1):
+1. Host events: RecordEvent context manager -> in-process buffer ->
+   export_chrome_tracing writes a chrome://tracing JSON.
+2. Device profile: jax.profiler start/stop trace (xplane -> TensorBoard /
+   Perfetto), the TPU-native replacement for the CUPTI tracer.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+
+from .timer import benchmark  # noqa: F401
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+    "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+    "SummaryView", "benchmark",
+]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class _EventBuffer:
+    def __init__(self):
+        self.events = []
+        self.enabled = False
+        self.lock = threading.Lock()
+
+    def add(self, name, ts, dur, tid):
+        if self.enabled:
+            with self.lock:
+                self.events.append({"name": name, "ts": ts, "dur": dur,
+                                    "tid": tid})
+
+
+_BUFFER = _EventBuffer()
+
+
+class RecordEvent:
+    """Host-side scope event (reference: profiler/utils.py:47). Also enters a
+    jax named_scope so the span shows up inside device traces under jit."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._scope = None
+
+    def begin(self):
+        self.__enter__()
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        try:
+            import jax
+            self._scope = jax.named_scope(self.name)
+            self._scope.__enter__()
+        except Exception:
+            self._scope = None
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        if self._scope is not None:
+            self._scope.__exit__(*exc)
+        _BUFFER.add(self.name, self._t0 / 1e3, (t1 - self._t0) / 1e3,
+                    threading.get_ident())
+        return False
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """Step-state schedule closure (reference: profiler.py make_scheduler)."""
+    period = closed + ready + record
+
+    def schedule(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready callback factory (reference: profiler.py:227)."""
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}"
+                                      ".paddle_trace.json")
+        prof._export_chrome(path)
+        return path
+    return handler
+
+
+def export_protobuf(dir_name, worker_name=None):  # parity stub -> chrome json
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+class Profiler:
+    """Reference: profiler/profiler.py:358. step()-driven scheduler states;
+    on_trace_ready fires at RECORD_AND_RETURN boundaries.
+
+    When `timer_only=False` and a TPU/devices are present, a jax.profiler trace
+    (xplane) is captured alongside host events into `trace_dir`."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 trace_dir=None, emit_nvtx=False, custom_device_types=None):
+        if scheduler is None:
+            self._schedule = lambda step: ProfilerState.RECORD
+        elif callable(scheduler):
+            self._schedule = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            start, end = scheduler
+            self._schedule = make_scheduler(closed=max(start, 0), ready=0,
+                                            record=end - start, repeat=1)
+        else:
+            raise TypeError(f"bad scheduler: {scheduler!r}")
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.trace_dir = trace_dir
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._device_trace_on = False
+        self._events_snapshot = []
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        self.current_state = self._schedule(self.step_num)
+        self._apply_state()
+
+    def stop(self):
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._finish_record()
+        _BUFFER.enabled = False
+        self._stop_device_trace()
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples=None):
+        benchmark().step(num_samples)
+        old = self.current_state
+        if old == ProfilerState.RECORD_AND_RETURN:
+            self._finish_record()
+        self.step_num += 1
+        self.current_state = self._schedule(self.step_num)
+        self._apply_state()
+
+    def step_info(self, unit=None):
+        return benchmark().step_info(unit)
+
+    def _apply_state(self):
+        st = self.current_state
+        _BUFFER.enabled = st in (ProfilerState.RECORD,
+                                 ProfilerState.RECORD_AND_RETURN)
+        if _BUFFER.enabled and not self.timer_only:
+            self._start_device_trace()
+
+    def _start_device_trace(self):
+        if self._device_trace_on or self.trace_dir is None:
+            return
+        try:
+            import jax
+            jax.profiler.start_trace(self.trace_dir)
+            self._device_trace_on = True
+        except Exception:
+            self._device_trace_on = False
+
+    def _stop_device_trace(self):
+        if self._device_trace_on:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_trace_on = False
+
+    def _finish_record(self):
+        with _BUFFER.lock:
+            self._events_snapshot = list(_BUFFER.events)
+            _BUFFER.events.clear()
+        self._stop_device_trace()
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- export/summary -------------------------------------------------
+    def _export_chrome(self, path):
+        events = [{"ph": "X", "cat": "host", "pid": os.getpid(),
+                   "tid": e["tid"], "name": e["name"], "ts": e["ts"],
+                   "dur": e["dur"]} for e in self._events_snapshot]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def export(self, path, format="json"):
+        self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Aggregated host-event table (reference: profiler_statistic.py)."""
+        agg = {}
+        for e in self._events_snapshot:
+            a = agg.setdefault(e["name"], [0, 0.0, 0.0])
+            a[0] += 1
+            a[1] += e["dur"]
+            a[2] = max(a[2], e["dur"])
+        div = {"ms": 1e3, "us": 1.0, "s": 1e6}[time_unit]
+        lines = [f"{'Name':<40} {'Calls':>8} {'Total(' + time_unit + ')':>14} "
+                 f"{'Avg':>10} {'Max':>10}"]
+        for name, (cnt, tot, mx) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(f"{name[:40]:<40} {cnt:>8} {tot / div:>14.4f} "
+                         f"{tot / cnt / div:>10.4f} {mx / div:>10.4f}")
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def load_profiler_result(filename):
+    """Load an exported chrome-trace JSON back as a list of events."""
+    with open(filename) as f:
+        return json.load(f).get("traceEvents", [])

@@ -1,0 +1,119 @@
+"""hapi Model.fit/evaluate/predict + paddle.metric (reference: test/legacy_test
+hapi tests; metric unit tests vs sklearn-style references)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.io import TensorDataset, DataLoader
+from paddle_tpu.metric import Accuracy, Precision, Recall, Auc
+from paddle_tpu.hapi import EarlyStopping
+
+
+def _toy_data(rng, n=64, d=8, classes=4):
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal((d, classes)).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.standard_normal((n, classes)), -1)
+    return x, y.astype(np.int64)
+
+
+def _model(d=8, classes=4):
+    return nn.Sequential(nn.Linear(d, 32), nn.ReLU(), nn.Linear(32, classes))
+
+
+def test_model_fit_reduces_loss(rng, capsys):
+    x, y = _toy_data(rng)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    net = _model()
+    model = paddle.Model(net)
+    model.prepare(opt.Adam(learning_rate=0.01, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    first = model.train_batch([x[:16]], [y[:16]])[0]
+    model.fit(ds, batch_size=16, epochs=8, verbose=0)
+    last = model.train_batch([x[:16]], [y[:16]])[0]
+    assert last < first * 0.7, (first, last)
+
+
+def test_model_evaluate_predict(rng):
+    x, y = _toy_data(rng)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    net = _model()
+    model = paddle.Model(net)
+    model.prepare(opt.SGD(learning_rate=0.01, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy(topk=(1, 2)))
+    logs = model.evaluate(ds, batch_size=32, verbose=0)
+    assert "loss" in logs and "acc_top1" in logs and "acc_top2" in logs
+    assert logs["acc_top2"] >= logs["acc_top1"]
+    test_ds = TensorDataset([paddle.to_tensor(x)])  # unlabeled
+    preds = model.predict(test_ds, batch_size=32, stack_outputs=True)
+    assert preds[0].shape == (64, 4)
+
+
+def test_model_save_load(rng, tmp_path):
+    x, y = _toy_data(rng)
+    net = _model()
+    model = paddle.Model(net)
+    model.prepare(opt.Adam(learning_rate=0.01, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    model.train_batch([x[:8]], [y[:8]])
+    path = str(tmp_path / "ckpt" / "model")
+    model.save(path)
+
+    net2 = _model()
+    model2 = paddle.Model(net2)
+    model2.prepare(opt.Adam(learning_rate=0.01, parameters=net2.parameters()),
+                   nn.CrossEntropyLoss())
+    model2.load(path)
+    p1 = net.state_dict()
+    p2 = net2.state_dict()
+    for k in p1:
+        np.testing.assert_array_equal(np.asarray(p1[k]._value),
+                                      np.asarray(p2[k]._value))
+
+
+def test_early_stopping(rng):
+    x, y = _toy_data(rng, n=32)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    net = _model()
+    model = paddle.Model(net)
+    model.prepare(opt.SGD(learning_rate=0.0, parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    es = EarlyStopping(monitor="loss", patience=1, verbose=0)
+    model.fit(ds, eval_data=ds, batch_size=16, epochs=10, verbose=0,
+              callbacks=[es])
+    assert model.stop_training  # lr=0 → no improvement → stopped early
+
+
+def test_summary(capsys):
+    net = _model()
+    info = paddle.summary(net)
+    out = capsys.readouterr().out
+    assert "Total params" in out
+    assert info["total_params"] == 8 * 32 + 32 + 32 * 4 + 4
+
+
+def test_accuracy_metric():
+    m = Accuracy(topk=(1,))
+    pred = np.asarray([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])
+    label = np.asarray([0, 1, 1])
+    m.update(m.compute(pred, label))
+    assert abs(m.accumulate() - 2 / 3) < 1e-9
+
+
+def test_precision_recall():
+    p, r = Precision(), Recall()
+    preds = np.asarray([1, 1, 0, 1, 0])
+    labels = np.asarray([1, 0, 1, 1, 0])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert abs(p.accumulate() - 2 / 3) < 1e-9
+    assert abs(r.accumulate() - 2 / 3) < 1e-9
+
+
+def test_auc_perfect_classifier():
+    m = Auc()
+    scores = np.asarray([0.9, 0.8, 0.2, 0.1])
+    labels = np.asarray([1, 1, 0, 0])
+    m.update(scores, labels)
+    assert abs(m.accumulate() - 1.0) < 1e-6
