@@ -144,7 +144,7 @@ class Tensor:
     __slots__ = (
         "_value", "stop_gradient", "grad", "name", "_node", "_out_index",
         "_retain_grads", "_hooks", "persistable", "is_leaf_override", "__weakref__",
-        "_dist_meta",
+        "_dist_meta", "_feed_name",
     )
 
     def __init__(self, value, stop_gradient: bool = True, name: str | None = None):

@@ -1,0 +1,106 @@
+"""paddle.device analog (reference: python/paddle/device/ — set_device,
+device queries, cuda.* memory stats, streams/events, Stream synchronize).
+
+TPU-native: devices are PJRT devices; memory stats come from
+jax Device.memory_stats(); streams are XLA-managed, so stream/event APIs are
+ordering no-ops that exist for parity (everything on one device is already
+program-ordered by XLA)."""
+from __future__ import annotations
+
+import jax
+
+from ..core.device import (  # noqa: F401
+    Place, CPUPlace, TPUPlace, CUDAPlace, XPUPlace, CustomPlace,
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+)
+from . import cuda  # noqa: F401
+from . import tpu  # noqa: F401
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return [p for p in get_all_device_type() if p not in ("cpu", "tpu", "gpu")]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if d.split(":")[0] not in ("cpu", "tpu", "gpu")]
+
+
+def device_count():
+    return len(jax.devices())
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return any(d.platform not in ("cpu", "tpu", "gpu") for d in jax.devices())
+
+
+def synchronize(device=None):
+    """Block until all queued work on the device finished."""
+    for d in jax.devices():
+        try:
+            d.synchronize_all_activity()
+        except Exception:
+            pass
+
+
+class Stream:
+    """Parity shim: XLA orders all work on a device; streams are implicit."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None): ...
+    def query(self):
+        return True
+
+    def synchronize(self): ...
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def set_stream(stream):
+    return stream
+
+
+def stream_guard(stream):
+    import contextlib
+    return contextlib.nullcontext()

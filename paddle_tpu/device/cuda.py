@@ -1,0 +1,77 @@
+"""paddle.device.cuda parity surface mapped onto the PJRT accelerator
+(reference: python/paddle/device/cuda/__init__.py). On TPU builds, "cuda"
+queries report the TPU accelerator — same trick the reference uses for
+CUDAPlace-on-XPU compatibility shims."""
+from __future__ import annotations
+
+import jax
+
+
+def _accel():
+    for d in jax.devices():
+        if d.platform != "cpu":
+            return d
+    return jax.devices()[0]
+
+
+def device_count():
+    return len([d for d in jax.devices() if d.platform != "cpu"])
+
+
+def _stats(device=None):
+    d = _accel() if device is None else device
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def max_memory_allocated(device=None):
+    return int(_stats(device).get("peak_bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None):
+    s = _stats(device)
+    return int(s.get("peak_pool_bytes", s.get("peak_bytes_in_use", 0)))
+
+
+def memory_allocated(device=None):
+    return int(_stats(device).get("bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    s = _stats(device)
+    return int(s.get("pool_bytes", s.get("bytes_in_use", 0)))
+
+
+def empty_cache():
+    pass  # PJRT owns the allocator
+
+
+def synchronize(device=None):
+    d = _accel() if device is None else device
+    try:
+        d.synchronize_all_activity()
+    except Exception:
+        pass
+
+
+def get_device_properties(device=None):
+    d = _accel() if device is None else device
+
+    class _Props:
+        name = d.device_kind
+        major = 0
+        minor = 0
+        total_memory = int(_stats(d).get("bytes_limit", 0))
+        multi_processor_count = getattr(d, "core_count", 1) or 1
+
+    return _Props()
+
+
+def get_device_name(device=None):
+    return (_accel() if device is None else device).device_kind
+
+
+def get_device_capability(device=None):
+    return (0, 0)

@@ -1,0 +1,833 @@
+"""paddle.distribution analog (reference: python/paddle/distribution/ —
+Distribution base, Normal/Uniform/Categorical/Bernoulli/Beta/Dirichlet/Gamma/
+Exponential/Laplace/LogNormal/Multinomial/Gumbel/Geometric/Cauchy/StudentT,
+TransformedDistribution + transforms, kl_divergence registry).
+
+TPU-native: sampling rides jax.random with the framework's global RNG stream
+(core/random.py), log_prob/entropy are jnp expressions flowing through
+dispatch so they differentiate like any other op."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from ..core import random as _random
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
+    "Dirichlet", "Gamma", "Exponential", "Laplace", "LogNormal", "Multinomial",
+    "Gumbel", "Geometric", "Cauchy", "StudentT", "Poisson", "ExponentialFamily",
+    "TransformedDistribution", "Independent", "kl_divergence", "register_kl",
+]
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jax.Array) else x
+
+
+def _wrap(fn, args, name):
+    return dispatch(fn, args, {}, name=name)
+
+
+class Distribution:
+    """Reference: distribution/distribution.py Distribution base."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        def fn(lp):
+            return jnp.exp(lp)
+        return _wrap(fn, (self.log_prob(value),), "prob")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        # keep the caller's Tensors so rsample/log_prob gradients flow to them
+        self._loc_t = loc if isinstance(loc, Tensor) else None
+        self._scale_t = scale if isinstance(scale, Tensor) else None
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        eps = jax.random.normal(key, self._extend_shape(shape),
+                                dtype=jnp.result_type(self.loc.dtype, jnp.float32))
+        return Tensor(self.loc + self.scale * eps, stop_gradient=True)
+
+    def rsample(self, shape=()):
+        key = _random.next_key()
+        eps = jax.random.normal(key, self._extend_shape(shape))
+        loc = self._loc_t if self._loc_t is not None else Tensor(self.loc)
+        scale = (self._scale_t if self._scale_t is not None
+                 else Tensor(self.scale))
+
+        def fn(l, s):
+            return l + s * eps
+        return _wrap(fn, (loc, scale), "normal_rsample")
+
+    def log_prob(self, value):
+        loc = self._loc_t if self._loc_t is not None else Tensor(self.loc)
+        scale = (self._scale_t if self._scale_t is not None
+                 else Tensor(self.scale))
+
+        def fn(v, l, s):
+            return (-((v - l) ** 2) / (2 * s ** 2)
+                    - jnp.log(s) - 0.5 * math.log(2 * math.pi))
+        return _wrap(fn, (value, loc, scale), "normal_log_prob")
+
+    def entropy(self):
+        def fn():
+            return jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+                self.batch_shape)
+        return Tensor(fn())
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, self._extend_shape(shape))
+        return Tensor(self.low + (self.high - self.low) * u, stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            inside = (v >= self.low) & (v < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        return _wrap(fn, (value,), "uniform_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _val(probs)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return Tensor(jax.random.bernoulli(
+            key, self.probs_, self._extend_shape(shape)).astype(jnp.float32),
+            stop_gradient=True)
+
+    def rsample(self, shape=(), temperature=1.0):
+        key = _random.next_key()
+        u = jax.random.uniform(key, self._extend_shape(shape), minval=1e-7,
+                               maxval=1 - 1e-7)
+        logits = jnp.log(self.probs_) - jnp.log1p(-self.probs_)
+        g = jnp.log(u) - jnp.log1p(-u)
+        return Tensor(jax.nn.sigmoid((logits + g) / temperature))
+
+    def log_prob(self, value):
+        def fn(v):
+            p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return _wrap(fn, (value,), "bernoulli_log_prob")
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _val(logits)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs_normalized(self):
+        return jax.nn.softmax(self.logits, -1)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return Tensor(jax.random.categorical(
+            key, self.logits, shape=tuple(shape) + self.batch_shape),
+            stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v):
+            logp = jax.nn.log_softmax(self.logits, -1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], -1)[..., 0]
+        return _wrap(fn, (value,), "categorical_log_prob")
+
+    def probs(self, value):
+        def fn(v):
+            p = jax.nn.softmax(self.logits, -1)
+            return jnp.take_along_axis(p, v.astype(jnp.int32)[..., None],
+                                       -1)[..., 0]
+        return _wrap(fn, (value,), "categorical_probs")
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, -1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _val(probs)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        logits = jnp.log(jnp.clip(self.probs_, 1e-12))
+        draws = jax.random.categorical(
+            key, logits, shape=(self.total_count,) + tuple(shape)
+            + self.batch_shape)
+        k = self.probs_.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(0)
+        return Tensor(counts, stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v):
+            logp = jnp.log(jnp.clip(self.probs_, 1e-12))
+            return (jax.scipy.special.gammaln(self.total_count + 1.0)
+                    - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1)
+                    + jnp.sum(v * logp, -1))
+        return _wrap(fn, (value,), "multinomial_log_prob")
+
+    def entropy(self):
+        # Monte-Carlo-free upper-bound style approximation is out of scope;
+        # exact sum over support is exponential — match reference by raising
+        raise NotImplementedError
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        tot = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (tot ** 2 * (tot + 1)))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return Tensor(jax.random.beta(key, self.alpha, self.beta,
+                                      self._extend_shape(shape)),
+                      stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            return ((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v)
+                    - _betaln(self.alpha, self.beta))
+        return _wrap(fn, (value,), "beta_log_prob")
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        return Tensor(_betaln(a, b) - (a - 1) * dg(a) - (b - 1) * dg(b)
+                      + (a + b - 2) * dg(a + b))
+
+
+def _betaln(a, b):
+    g = jax.scipy.special.gammaln
+    return g(a) + g(b) - g(a + b)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / jnp.sum(self.concentration, -1, keepdims=True))
+
+    @property
+    def variance(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1, keepdims=True)
+        return Tensor(a * (a0 - a) / (a0 ** 2 * (a0 + 1)))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return Tensor(jax.random.dirichlet(key, self.concentration,
+                                           tuple(shape) + self.batch_shape),
+                      stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            a = self.concentration
+            g = jax.scipy.special.gammaln
+            return (jnp.sum((a - 1) * jnp.log(v), -1)
+                    + g(jnp.sum(a, -1)) - jnp.sum(g(a), -1))
+        return _wrap(fn, (value,), "dirichlet_log_prob")
+
+    def entropy(self):
+        a = self.concentration
+        g, dg = jax.scipy.special.gammaln, jax.scipy.special.digamma
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        return Tensor(jnp.sum(g(a), -1) - g(a0) + (a0 - k) * dg(a0)
+                      - jnp.sum((a - 1) * dg(a), -1))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _val(concentration)
+        self.rate = _val(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        g = jax.random.gamma(key, self.concentration, self._extend_shape(shape))
+        return Tensor(g / self.rate, stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            a, r = self.concentration, self.rate
+            return (a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                    - jax.scipy.special.gammaln(a))
+        return _wrap(fn, (value,), "gamma_log_prob")
+
+    def entropy(self):
+        a, r = self.concentration, self.rate
+        g, dg = jax.scipy.special.gammaln, jax.scipy.special.digamma
+        return Tensor(a - jnp.log(r) + g(a) + (1 - a) * dg(a))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / self.rate ** 2)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return Tensor(jax.random.exponential(
+            key, self._extend_shape(shape)) / self.rate, stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            return jnp.log(self.rate) - self.rate * v
+        return _wrap(fn, (value,), "exponential_log_prob")
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return Tensor(self.loc + self.scale * jax.random.laplace(
+            key, self._extend_shape(shape)), stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            return (-jnp.abs(v - self.loc) / self.scale
+                    - jnp.log(2 * self.scale))
+        return _wrap(fn, (value,), "laplace_log_prob")
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal.batch_shape)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        return Tensor((jnp.exp(self.scale ** 2) - 1)
+                      * jnp.exp(2 * self.loc + self.scale ** 2))
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(self._normal.sample(shape)._value),
+                      stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v):
+            logv = jnp.log(v)
+            var = self.scale ** 2
+            return (-((logv - self.loc) ** 2) / (2 * var) - logv
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        return _wrap(fn, (value,), "lognormal_log_prob")
+
+    def entropy(self):
+        return Tensor(self.loc + 0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * np.euler_gamma)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi ** 2 / 6) * self.scale ** 2)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return Tensor(self.loc + self.scale * jax.random.gumbel(
+            key, self._extend_shape(shape)), stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            z = (v - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+        return _wrap(fn, (value,), "gumbel_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + np.euler_gamma)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k >= 0 (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_ = _val(probs)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs_) / self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs_) / self.probs_ ** 2)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, self._extend_shape(shape), minval=1e-7,
+                               maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_)),
+                      stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v):
+            return v * jnp.log1p(-self.probs_) + jnp.log(self.probs_)
+        return _wrap(fn, (value,), "geometric_log_prob")
+
+    def entropy(self):
+        p = self.probs_
+        q = 1 - p
+        return Tensor(-(q * jnp.log(q) + p * jnp.log(p)) / p)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return Tensor(self.loc + self.scale * jax.random.cauchy(
+            key, self._extend_shape(shape)), stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            z = (v - self.loc) / self.scale
+            return -jnp.log(math.pi * self.scale * (1 + z ** 2))
+        return _wrap(fn, (value,), "cauchy_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _val(df)
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        v = jnp.where(self.df > 2, self.scale ** 2 * self.df / (self.df - 2),
+                      jnp.inf)
+        return Tensor(jnp.where(self.df > 1, v, jnp.nan))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        t = jax.random.t(key, self.df, self._extend_shape(shape))
+        return Tensor(self.loc + self.scale * t, stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v):
+            g = jax.scipy.special.gammaln
+            d = self.df
+            z = (v - self.loc) / self.scale
+            return (g((d + 1) / 2) - g(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                    - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+        return _wrap(fn, (value,), "studentt_log_prob")
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return Tensor(jax.random.poisson(key, self.rate,
+                                         self._extend_shape(shape)).astype(
+            jnp.float32), stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v):
+            return (v * jnp.log(self.rate) - self.rate
+                    - jax.scipy.special.gammaln(v + 1))
+        return _wrap(fn, (value,), "poisson_log_prob")
+
+
+class ExponentialFamily(Distribution):
+    """Parity base class (reference distribution/exponential_family.py)."""
+
+
+class Independent(Distribution):
+    """Reinterprets batch dims as event dims (reference
+    distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        b = base.batch_shape
+        super().__init__(b[:len(b) - self.rank],
+                         b[len(b) - self.rank:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+
+        def fn(l):
+            return jnp.sum(l, axis=tuple(range(-self.rank, 0)))
+        return _wrap(fn, (lp,), "independent_log_prob")
+
+    def entropy(self):
+        ent = self.base.entropy()
+
+        def fn(e):
+            return jnp.sum(e, axis=tuple(range(-self.rank, 0)))
+        return _wrap(fn, (ent,), "independent_entropy")
+
+
+# ---------------------------------------------------------------------------
+# transforms + TransformedDistribution
+# ---------------------------------------------------------------------------
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = (transforms if isinstance(transforms, (list, tuple))
+                           else [transforms])
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)._value
+        for t in self.transforms:
+            x = t.forward(x)
+        return Tensor(x, stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v):
+            lp = 0.0
+            y = v
+            for t in reversed(self.transforms):
+                x = t.inverse(y)
+                lp = lp - t.forward_log_det_jacobian(x)
+                y = x
+            return lp + _val(self.base.log_prob(Tensor(y)))
+        return _wrap(fn, (value,), "transformed_log_prob")
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Reference: distribution/kl.py register_kl."""
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    return Tensor(0.5 * (var_p / var_q + (q.loc - p.loc) ** 2 / var_q
+                         - 1 + jnp.log(var_q / var_p)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    lp = jax.nn.log_softmax(p.logits, -1)
+    lq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+    b = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+    return Tensor(a * (jnp.log(a) - jnp.log(b))
+                  + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    dg = jax.scipy.special.digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    t1 = _betaln(a2, b2) - _betaln(a1, b1)
+    return Tensor(t1 + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                  + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    g, dg = jax.scipy.special.gammaln, jax.scipy.special.digamma
+    a1, r1, a2, r2 = p.concentration, p.rate, q.concentration, q.rate
+    return Tensor((a1 - a2) * dg(a1) - g(a1) + g(a2)
+                  + a2 * (jnp.log(r1) - jnp.log(r2)) + a1 * (r2 - r1) / r1)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    g, dg = jax.scipy.special.gammaln, jax.scipy.special.digamma
+    a, b = p.concentration, q.concentration
+    a0 = jnp.sum(a, -1)
+    return Tensor(g(a0) - jnp.sum(g(a), -1) - g(jnp.sum(b, -1))
+                  + jnp.sum(g(b), -1)
+                  + jnp.sum((a - b) * (dg(a) - dg(a0)[..., None]), -1))

@@ -142,6 +142,10 @@ class EarlyStopping(Callback):
         if self._better(cur):
             self.best = cur
             self.wait = 0
+            save_dir = self.params.get("save_dir") if hasattr(self, "params") \
+                else None
+            if self.save_best_model and save_dir:
+                self.model.save(os.path.join(save_dir, "best_model"))
         else:
             self.wait += 1
             if self.wait >= self.patience:
@@ -186,5 +190,5 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     cl = CallbackList(cbks)
     cl.set_model(model)
     cl.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
-                   "metrics": metrics or []})
+                   "save_dir": save_dir, "metrics": metrics or []})
     return cl

@@ -77,17 +77,21 @@ class Model:
         labels = [_to_tensor(x) for x in _to_list(labels)]
         if not update or self._metrics or getattr(self, "_accum", 1) > 1:
             self._train_step = False
+        arity = (len(inputs), len(labels))
+        if self._train_step and self._train_step_arity != arity:
+            self._train_step = None  # rebuild: the split is baked into loss_fn
 
         if self._train_step is None:
             from ..jit.api import TrainStep
+            n_in = len(inputs)
 
             def loss_fn(net, *batch):
-                n_in = len(inputs)
                 outs = net(*batch[:n_in])
                 return self._compute_loss(outs, list(batch[n_in:]))
             try:
                 self._train_step = TrainStep(self.network, loss_fn,
                                              self._optimizer)
+                self._train_step_arity = arity
             except Exception:  # pragma: no cover - fallback path
                 self._train_step = False
         if self._train_step:
@@ -137,7 +141,10 @@ class Model:
         if isinstance(data, Dataset):
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                               num_workers=num_workers)
-        return data  # assume iterable of batches
+        if hasattr(data, "__next__"):
+            # one-shot iterator: materialize so every epoch sees the batches
+            return list(data)
+        return data  # assume re-iterable of batches
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,

@@ -70,16 +70,16 @@ class Accuracy(Metric):
         return correct
 
     def update(self, correct, *args):
+        """Accumulates and returns the CURRENT batch's accuracy (paddle contract)."""
         correct = _np(correct)
-        accs = []
         num = int(np.prod(correct.shape[:-1]))
-        for k in self.topk:
-            c = correct[..., :k].any(-1).sum()
-            self.total[self.topk.index(k)] += int(c)
-        self.count += num
+        batch = []
         for i, k in enumerate(self.topk):
-            accs.append(self.total[i] / max(self.count, 1))
-        return np.asarray(accs[0] if len(self.topk) == 1 else accs)
+            c = int(correct[..., :k].any(-1).sum())
+            self.total[i] += c
+            batch.append(c / max(num, 1))
+        self.count += num
+        return np.asarray(batch[0] if len(self.topk) == 1 else batch)
 
     def reset(self):
         self.total = [0] * len(self.topk)
@@ -160,14 +160,11 @@ class Auc(Metric):
         preds = _np(preds)
         if preds.ndim == 2:              # [N, 2] probs -> positive-class prob
             preds = preds[:, 1]
-        labels = _np(labels).reshape(-1)
+        labels = _np(labels).reshape(-1).astype(bool)
         idx = np.clip((preds.reshape(-1) * self.num_thresholds).astype(int), 0,
                       self.num_thresholds)
-        for i, lab in zip(idx, labels):
-            if lab:
-                self._stat_pos[i] += 1
-            else:
-                self._stat_neg[i] += 1
+        np.add.at(self._stat_pos, idx[labels], 1)
+        np.add.at(self._stat_neg, idx[~labels], 1)
 
     def reset(self):
         self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)

@@ -172,10 +172,13 @@ class Profiler:
     def step(self, num_samples=None):
         benchmark().step(num_samples)
         old = self.current_state
-        if old == ProfilerState.RECORD_AND_RETURN:
-            self._finish_record()
         self.step_num += 1
         self.current_state = self._schedule(self.step_num)
+        recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        # finish on the scheduled boundary OR any transition out of recording
+        if old == ProfilerState.RECORD_AND_RETURN or (
+                old in recording and self.current_state not in recording):
+            self._finish_record()
         self._apply_state()
 
     def step_info(self, unit=None):
@@ -187,6 +190,8 @@ class Profiler:
                                  ProfilerState.RECORD_AND_RETURN)
         if _BUFFER.enabled and not self.timer_only:
             self._start_device_trace()
+        elif not _BUFFER.enabled:
+            self._stop_device_trace()
 
     def _start_device_trace(self):
         if self._device_trace_on or self.trace_dir is None:

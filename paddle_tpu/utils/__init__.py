@@ -1,0 +1,76 @@
+"""paddle.utils analog (reference: python/paddle/utils/ — unique_name,
+deprecated, try_import, flops, dlpack)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import threading
+import warnings
+
+from . import unique_name  # noqa: F401
+from .flops import flops  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    """Reference: utils/lazy_import.py try_import."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"{module_name} is required but not installed")
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Reference: utils/deprecated.py — warn-once decorator."""
+    def wrap(fn):
+        warned = []
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            if level >= 2:           # hard-deprecated: always raise
+                raise RuntimeError(msg)
+            if not warned:           # soft: warn once
+                warned.append(True)
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap
+
+
+def run_check():
+    """Sanity-check the install (reference: utils/install_check.py run_check)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.ones([2, 2])
+    y = (x @ x).sum()
+    assert float(np.asarray(y._value)) == 8.0
+    import jax
+    devs = jax.devices()
+    print(f"paddle_tpu is installed successfully! "
+          f"{len(devs)} device(s): {[d.device_kind for d in devs]}")
+
+
+class dlpack:
+    """paddle.utils.dlpack parity namespace."""
+
+    @staticmethod
+    def to_dlpack(x):
+        from ..core.tensor import Tensor
+        v = x._value if isinstance(x, Tensor) else x
+        return v.__dlpack__()
+
+    @staticmethod
+    def from_dlpack(capsule):
+        import jax
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        return Tensor(jnp.from_dlpack(capsule))

@@ -1,0 +1,69 @@
+"""Per-op FLOPs accounting (reference: python/paddle/utils/flops.py — per-op
+formulas used by profiler reports)."""
+from __future__ import annotations
+
+import numpy as np
+
+_FLOP_FNS = {}
+
+
+def register_flops(name):
+    def deco(fn):
+        _FLOP_FNS[name] = fn
+        return fn
+    return deco
+
+
+def flops(op_type, input_shapes, attrs=None):
+    """FLOPs for one op given {'X': [shape,...]}-style input shapes."""
+    fn = _FLOP_FNS.get(op_type)
+    if fn is None:
+        return 0
+    return int(fn(input_shapes, attrs or {}))
+
+
+def _prod(s):
+    return int(np.prod(s)) if len(s) else 1
+
+
+@register_flops("matmul")
+@register_flops("matmul_v2")
+def _matmul_flops(shapes, attrs):
+    x = list(shapes.get("X", shapes.get("x"))[0])
+    y = list(shapes.get("Y", shapes.get("y"))[0])
+    if attrs.get("transpose_X") or attrs.get("trans_x"):
+        x[-1], x[-2] = x[-2], x[-1]
+    if attrs.get("transpose_Y") or attrs.get("trans_y"):
+        y[-1], y[-2] = y[-2], y[-1]
+    batch = _prod(x[:-2])
+    return 2 * batch * x[-2] * x[-1] * y[-1]
+
+
+@register_flops("conv2d")
+def _conv2d_flops(shapes, attrs):
+    x = shapes.get("Input", shapes.get("x"))[0]      # NCHW
+    w = shapes.get("Filter", shapes.get("weight"))[0]  # OIHW
+    n, _, h, wd = x
+    co, ci, kh, kw = w
+    stride = attrs.get("strides", [1, 1])
+    pad = attrs.get("paddings", [0, 0])
+    oh = (h + 2 * pad[0] - kh) // stride[0] + 1
+    ow = (wd + 2 * pad[1] - kw) // stride[1] + 1
+    return 2 * n * co * oh * ow * ci * kh * kw
+
+
+@register_flops("relu")
+@register_flops("gelu")
+@register_flops("silu")
+@register_flops("softmax")
+@register_flops("dropout")
+def _elementwise_flops(shapes, attrs):
+    key = next(iter(shapes))
+    return _prod(shapes[key][0])
+
+
+@register_flops("layer_norm")
+@register_flops("rms_norm")
+def _norm_flops(shapes, attrs):
+    key = next(iter(shapes))
+    return 5 * _prod(shapes[key][0])

@@ -1,0 +1,38 @@
+"""Unique name generator (reference: python/paddle/utils/unique_name.py —
+generate/guard/switch over thread-local counter namespaces)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_local = threading.local()
+
+
+def _gens():
+    if not hasattr(_local, "stack"):
+        _local.stack = [{}]
+    return _local.stack
+
+
+def generate(key):
+    counters = _gens()[-1]
+    n = counters.get(key, 0)
+    counters[key] = n + 1
+    return f"{key}_{n}"
+
+
+def switch(new_generator=None):
+    stack = _gens()
+    old = stack[-1]
+    stack[-1] = new_generator if new_generator is not None else {}
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    stack = _gens()
+    stack.append({} if new_generator is None else dict())
+    try:
+        yield
+    finally:
+        stack.pop()

@@ -1,0 +1,187 @@
+"""device / utils / distribution / static packages (reference test model:
+test/legacy_test/test_distribution_*.py, test_executor*, device API tests)."""
+import numpy as np
+import pytest
+import scipy.stats as sps
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------------------
+# device + utils
+# ---------------------------------------------------------------------------
+
+def test_device_queries():
+    import paddle_tpu.device as device
+    assert device.device_count() >= 1
+    assert isinstance(device.get_all_device_type(), list)
+    device.synchronize()
+    s = device.current_stream()
+    e = s.record_event()
+    assert e.query()
+
+
+def test_unique_name():
+    from paddle_tpu.utils import unique_name
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b
+    with unique_name.guard():
+        c = unique_name.generate("fc")
+    assert c.endswith("_0")
+
+
+def test_flops():
+    from paddle_tpu.utils import flops
+    n = flops("matmul", {"X": [[4, 8]], "Y": [[8, 16]]}, {})
+    assert n == 2 * 4 * 8 * 16
+
+
+def test_run_check(capsys):
+    paddle.utils.run_check()
+    assert "successfully" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# distribution
+# ---------------------------------------------------------------------------
+
+def test_normal_log_prob_entropy():
+    from paddle_tpu.distribution import Normal
+    d = Normal(np.float32(1.0), np.float32(2.0))
+    x = np.float32(0.5)
+    lp = float(d.log_prob(paddle.to_tensor(x))._value)
+    assert abs(lp - sps.norm.logpdf(0.5, 1.0, 2.0)) < 1e-5
+    assert abs(float(d.entropy()._value) - sps.norm.entropy(1.0, 2.0)) < 1e-5
+
+
+def test_normal_kl():
+    from paddle_tpu.distribution import Normal, kl_divergence
+    p = Normal(np.float32(0.0), np.float32(1.0))
+    q = Normal(np.float32(1.0), np.float32(2.0))
+    kl = float(kl_divergence(p, q)._value)
+    # closed form: log(s2/s1) + (s1^2 + (m1-m2)^2) / (2 s2^2) - 1/2
+    ref = np.log(2.0) + (1.0 + 1.0) / 8.0 - 0.5
+    assert abs(kl - ref) < 1e-6
+
+
+@pytest.mark.parametrize("dist,scipy_dist,params,x", [
+    ("Beta", sps.beta, {"alpha": 2.0, "beta": 3.0}, 0.4),
+    ("Gamma", None, {"concentration": 2.0, "rate": 3.0}, 1.5),
+    ("Laplace", sps.laplace, {"loc": 0.5, "scale": 1.5}, 1.0),
+    ("Exponential", None, {"rate": 2.0}, 0.7),
+    ("Gumbel", sps.gumbel_r, {"loc": 0.0, "scale": 1.0}, 0.3),
+])
+def test_log_prob_vs_scipy(dist, scipy_dist, params, x):
+    import paddle_tpu.distribution as D
+    d = getattr(D, dist)(*[np.float32(v) for v in params.values()])
+    lp = float(d.log_prob(paddle.to_tensor(np.float32(x)))._value)
+    if dist == "Beta":
+        ref = sps.beta.logpdf(x, params["alpha"], params["beta"])
+    elif dist == "Gamma":
+        ref = sps.gamma.logpdf(x, params["concentration"],
+                               scale=1 / params["rate"])
+    elif dist == "Exponential":
+        ref = sps.expon.logpdf(x, scale=1 / params["rate"])
+    else:
+        ref = scipy_dist.logpdf(x, *params.values())
+    assert abs(lp - ref) < 1e-5, (lp, ref)
+
+
+def test_categorical_sample_logprob():
+    from paddle_tpu.distribution import Categorical
+    logits = np.log(np.asarray([0.2, 0.3, 0.5], np.float32))
+    d = Categorical(logits)
+    s = d.sample([1000])
+    counts = np.bincount(np.asarray(s._value).reshape(-1), minlength=3) / 1000
+    assert abs(counts[2] - 0.5) < 0.08
+    lp = float(d.log_prob(paddle.to_tensor(np.int64(2)))._value)
+    assert abs(lp - np.log(0.5)) < 1e-5
+    ent = float(d.entropy()._value)
+    assert abs(ent - sps.entropy([0.2, 0.3, 0.5])) < 1e-5
+
+
+def test_dirichlet_and_multinomial():
+    from paddle_tpu.distribution import Dirichlet, Multinomial
+    d = Dirichlet(np.asarray([2.0, 3.0, 5.0], np.float32))
+    s = np.asarray(d.sample([100])._value)
+    assert s.shape == (100, 3)
+    np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(d.mean._value), [0.2, 0.3, 0.5],
+                               rtol=1e-5)
+    m = Multinomial(10, np.asarray([0.3, 0.7], np.float32))
+    sm = np.asarray(m.sample([50])._value)
+    assert sm.shape == (50, 2)
+    np.testing.assert_allclose(sm.sum(-1), 10.0)
+
+
+def test_rsample_differentiable():
+    import jax
+    from paddle_tpu.distribution import Normal
+    loc = paddle.to_tensor(np.float32(0.0), stop_gradient=False)
+    scale = paddle.to_tensor(np.float32(1.0), stop_gradient=False)
+    d = Normal(loc, scale)
+    y = d.rsample([16])
+    loss = (y * y).sum()
+    loss.backward()
+    assert scale.grad is not None
+
+
+def test_transformed_distribution():
+    from paddle_tpu.distribution import (Normal, TransformedDistribution,
+                                         ExpTransform)
+    base = Normal(np.float32(0.0), np.float32(1.0))
+    d = TransformedDistribution(base, [ExpTransform()])
+    lp = float(d.log_prob(paddle.to_tensor(np.float32(2.0)))._value)
+    assert abs(lp - sps.lognorm.logpdf(2.0, 1.0)) < 1e-5
+
+
+def test_independent():
+    from paddle_tpu.distribution import Normal, Independent
+    d = Independent(Normal(np.zeros(3, np.float32), np.ones(3, np.float32)), 1)
+    lp = d.log_prob(paddle.to_tensor(np.zeros(3, np.float32)))
+    assert lp.shape == []
+    assert abs(float(lp._value) - 3 * sps.norm.logpdf(0.0)) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# static
+# ---------------------------------------------------------------------------
+
+def test_static_program_feed_fetch(rng):
+    import paddle_tpu.static as static
+    import paddle_tpu.nn as nn
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 8])
+        lin = nn.Linear(8, 2)
+        y = lin(x)
+        z = (y * y).sum()
+    exe = static.Executor()
+    xv = rng.standard_normal((4, 8)).astype(np.float32)
+    out_y, out_z = exe.run(main, feed={"x": xv}, fetch_list=[y, z])
+    ref = xv @ np.asarray(lin.weight._value) + np.asarray(lin.bias._value)
+    np.testing.assert_allclose(out_y, ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(out_z, (ref * ref).sum(), rtol=1e-5)
+    # second run with different data reuses the compiled executable
+    xv2 = rng.standard_normal((4, 8)).astype(np.float32)
+    out2, _ = exe.run(main, feed={"x": xv2}, fetch_list=[y, z])
+    ref2 = xv2 @ np.asarray(lin.weight._value) + np.asarray(lin.bias._value)
+    np.testing.assert_allclose(out2, ref2, rtol=1e-5, atol=1e-5)
+
+
+def test_static_save_load_inference_model(rng, tmp_path):
+    import paddle_tpu.static as static
+    import paddle_tpu.nn as nn
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 4])
+        y = nn.Linear(4, 3)(x)
+    path = str(tmp_path / "inf" / "model")
+    static.save_inference_model(path, [x], [y])
+    _, names, fetch_fn = static.load_inference_model(path)
+    assert names == ["x"]
+    xv = rng.standard_normal((2, 4)).astype(np.float32)
+    out = fetch_fn(xv)
+    ref = static.Executor().run(main, feed={"x": xv}, fetch_list=[y])[0]
+    np.testing.assert_allclose(np.asarray(out[0]), ref, rtol=1e-5, atol=1e-5)
