@@ -147,6 +147,7 @@ def barrier_worker():
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer, SegmentLayers  # noqa: E402,F401
 from .pipeline_parallel import PipelineParallel  # noqa: E402,F401
 from .sharding_optimizer import DygraphShardingOptimizer  # noqa: E402,F401
+from .recompute import recompute, recompute_sequential, recompute_hybrid  # noqa: E402,F401
 from .sequence_parallel_utils import (  # noqa: E402,F401
     ScatterOp, AllGatherOp, ReduceScatterOp, ColumnSequenceParallelLinear,
     RowSequenceParallelLinear, mark_as_sequence_parallel_parameter,

@@ -1,0 +1,148 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference analog: python/paddle/distributed/fleet/recompute/recompute.py:128
+(RecomputeFunction PyLayer — replays the forward under saved RNG state during
+backward) and the user API at recompute.py:463; recompute_sequential/_hybrid in
+the same package.
+
+TPU-first design: instead of a hand-written replay PyLayer, the wrapped segment
+is run through ``jax.checkpoint`` (remat). XLA then materialises only the
+segment *inputs* as residuals and re-traces the forward inside the backward
+pass — the same FLOPs-for-HBM trade the reference makes, but expressed to the
+compiler so it can still fuse the recomputed forward with the backward ops.
+RNG determinism (the reference's ``preserve_rng_state``) is free: the segment
+consumes an explicit key captured at forward time, so the replay sees the same
+randomness by construction.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core import random as _random
+from ...core.tensor import Tensor, dispatch, functional_mode, is_grad_enabled
+from ...jit.functional_call import collect_state, bind_state
+
+
+#: name → jax.checkpoint_policies member. ``None``/'full' = save nothing
+#: (recompute everything); the others selectively keep MXU-expensive results.
+POLICIES = {
+    None: None,
+    "full": None,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _find_layers(fn, args):
+    from ...jit.api import _find_layers as find
+    return find(fn, args)
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args, **kwargs)`` without saving its intermediates.
+
+    Drop-in analog of ``paddle.distributed.fleet.utils.recompute``. Accepted
+    keyword-only extras (all others are forwarded to ``function``):
+
+    - ``use_reentrant`` (ignored — remat has one semantics here)
+    - ``preserve_rng_state`` (default True; False draws a fresh key anyway,
+      determinism is still guaranteed within one call)
+    - ``checkpoint_policy``: name in :data:`POLICIES` or a jax policy callable.
+    """
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+    policy = kwargs.pop("checkpoint_policy", None)
+    if isinstance(policy, str) or policy is None:
+        policy = POLICIES[policy]
+
+    if not is_grad_enabled():
+        return function(*args, **kwargs)
+
+    layers = _find_layers(function, (args, kwargs))
+    from ...nn.layer_base import Layer
+    for extra in getattr(function, "_recompute_layers", ()):
+        if isinstance(extra, Layer) and all(extra is not l for l in layers):
+            layers.append(extra)
+    _, params, _, buffers = collect_state(layers) if layers else ([], [], [], [])
+    state = list(params) + list(buffers)
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs),
+                                                 is_leaf=_is_tensor)
+    tpos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    # one key drawn eagerly; the remat replay folds in the same key, giving the
+    # reference's preserve_rng_state semantics without saving generator state
+    rng = _random.next_key()
+
+    def segment(state_vals, rng_key, *tvals):
+        rebuilt = list(leaves)
+        for p, v in zip(tpos, tvals):
+            rebuilt[p] = Tensor(v)
+        a, k = jax.tree_util.tree_unflatten(treedef, rebuilt)
+        with functional_mode(), bind_state(state, state_vals), \
+                _random.provide_key(rng_key):
+            out = function(*a, **k)
+            # buffers mutated in-place during the forward (e.g. BatchNorm
+            # running stats) must leave the traced segment as outputs
+            new_bufs = [b._value for b in buffers]
+        out_vals = jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=_is_tensor)
+        return out_vals, new_bufs
+
+    ckpt = jax.checkpoint(segment, policy=policy)
+    out, new_bufs = dispatch(ckpt, (state, rng, *[leaves[i] for i in tpos]), {},
+                             name="recompute")
+    for b, nb in zip(buffers, new_bufs):
+        b._value = nb._value if isinstance(nb, Tensor) else nb
+    return out
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Checkpoint a Sequential-like container in segments.
+
+    Reference analog: recompute_sequential (fleet/recompute/recompute.py) —
+    splits ``functions`` (a LayerList/Sequential or list of callables) into
+    ``ctx['segments']`` chunks and recomputes each chunk as one unit.
+    """
+    segments = int(ctx.get("segments", 1)) if isinstance(ctx, dict) else int(ctx)
+    policy = ctx.get("checkpoint_policy") if isinstance(ctx, dict) else None
+    fns = list(functions)
+    if not fns:
+        raise ValueError("recompute_sequential needs at least one function")
+    segments = max(1, min(segments, len(fns)))
+    per = (len(fns) + segments - 1) // segments
+
+    def run_chunk(chunk, *xs, **kw):
+        out = xs
+        for f in chunk:
+            out = f(*out, **kw) if isinstance(out, tuple) else f(out, **kw)
+            if not isinstance(out, tuple):
+                out = (out,)
+        return out[0] if len(out) == 1 else out
+
+    out = args
+    for s in range(0, len(fns), per):
+        chunk = fns[s:s + per]
+        if not isinstance(out, tuple):
+            out = (out,)
+        # bind the chunk's layers so their params flow through the remat segment
+        def chunk_fn(*xs, _chunk=tuple(chunk), **kw):
+            return run_chunk(_chunk, *xs, **kw)
+        chunk_fn._recompute_layers = chunk  # discovered inside recompute()
+        out = recompute(chunk_fn, *out, checkpoint_policy=policy, **kwargs)
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Hybrid-parallel recompute (reference: recompute_hybrid.py). Offload is a
+    no-op on TPU (remat already avoids persisting activations in HBM)."""
+    if isinstance(ctx, dict):
+        kwargs.setdefault("checkpoint_policy", ctx.get("checkpoint_policy"))
+    return recompute(function, *args, **kwargs)

@@ -32,27 +32,29 @@ def _find_layers(fn, args):
     def add(obj):
         if isinstance(obj, Layer) and all(obj is not l for l in layers):
             layers.append(obj)
+        elif isinstance(obj, (list, tuple)):
+            for item in obj:
+                add(item)
 
-    add(fn)
-    if hasattr(fn, "__self__"):
-        add(fn.__self__)
-    if isinstance(fn, functools.partial):
-        for a in fn.args:
-            add(a)
-        add(fn.func)
-        if hasattr(fn.func, "__self__"):
-            add(fn.func.__self__)
-    closure = getattr(fn, "__closure__", None)
-    if closure:
-        for cell in closure:
+    def scan_callable(f):
+        add(f)
+        if hasattr(f, "__self__"):
+            add(f.__self__)
+        for cell in getattr(f, "__closure__", None) or ():
             try:
-                v = cell.cell_contents
+                add(cell.cell_contents)
             except ValueError:
                 continue
-            add(v)
-            if isinstance(v, (list, tuple)):
-                for item in v:
-                    add(item)
+        for d in getattr(f, "__defaults__", None) or ():
+            add(d)
+        for d in (getattr(f, "__kwdefaults__", None) or {}).values():
+            add(d)
+
+    scan_callable(fn)
+    if isinstance(fn, functools.partial):
+        add(list(fn.args))
+        add(list(fn.keywords.values()))
+        scan_callable(fn.func)
     for a in jax.tree_util.tree_leaves(args, is_leaf=lambda x: isinstance(x, Layer)):
         add(a)
     return layers

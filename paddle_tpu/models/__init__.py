@@ -3,3 +3,6 @@ from .llama import (  # noqa: F401
     LlamaMLP, precompute_rope, apply_rope,
 )
 from .bert import BertConfig, BertModel, BertForMaskedLM  # noqa: F401
+from .unet import (  # noqa: F401
+    UNetConfig, UNetModel, sd_unet, diffusion_loss, timestep_embedding,
+)

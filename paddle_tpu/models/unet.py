@@ -1,0 +1,325 @@
+"""Stable-Diffusion-style conditional UNet (BASELINE config 5 workload).
+
+Reference analog: the reference trains SD-UNet through PaddleMIX/ppdiffusers on
+top of fleet recompute (fleet/recompute/recompute.py:463) + ZeRO-1 sharding
+(dygraph_sharding_optimizer.py:54); the in-tree pieces it exercises are Conv2D,
+GroupNorm, Silu, MultiHeadAttention and the recompute API.
+
+TPU-first design decisions:
+- NHWC layout throughout (TPU conv kernels want channels-last; XLA lowers
+  NHWC convs straight onto the MXU without transposes).
+- GroupNorm in fp32, convs/matmuls in the model dtype (bf16 on TPU).
+- attention over flattened spatial tokens goes through
+  F.scaled_dot_product_attention → the Pallas flash kernel.
+- per-block ``recompute`` (jax.checkpoint) instead of a replay PyLayer.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+from ..nn import (Layer, LayerList, Linear, Silu, GroupNorm, Conv2D, Dropout,
+                  LayerNorm, Embedding)
+from ..nn import functional as F
+from ..core.tensor import Tensor, dispatch
+from .. import ops
+
+
+@dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    base_channels: int = 320
+    channel_mult: tuple = (1, 2, 4, 4)
+    layers_per_block: int = 2
+    # levels (by index) with transformer blocks; SD-1.x puts cross-attention at
+    # the three highest-resolution levels and none at the deepest (mid keeps it)
+    attention_levels: tuple = (0, 1, 2)
+    num_heads: int = 8
+    context_dim: int = 768                # text-encoder hidden size
+    transformer_depth: int = 1
+    dropout: float = 0.0
+    use_recompute: bool = False
+    dtype: str = "float32"
+
+    @staticmethod
+    def sd_unet(**over):
+        """SD-1.x UNet: 859M params."""
+        return UNetConfig(**over)
+
+    @staticmethod
+    def tiny(**over):
+        return UNetConfig(**{**dict(base_channels=32, channel_mult=(1, 2),
+                                    layers_per_block=1, attention_levels=(1,),
+                                    num_heads=2, context_dim=32), **over})
+
+
+def timestep_embedding(t, dim, max_period=10000.0):
+    """Sinusoidal timestep embedding, fp32 (matches DDPM/SD)."""
+    def fn(tv):
+        half = dim // 2
+        freqs = jnp.exp(-math.log(max_period)
+                        * jnp.arange(half, dtype=jnp.float32) / half)
+        ang = tv.astype(jnp.float32)[:, None] * freqs[None, :]
+        emb = jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
+        if dim % 2:
+            emb = jnp.pad(emb, ((0, 0), (0, 1)))
+        return emb
+    return dispatch(fn, (t,), {}, name="timestep_embedding")
+
+
+class ResBlock(Layer):
+    """GN→SiLU→conv ×2 with a time-embedding shift injected between them."""
+
+    def __init__(self, in_ch, out_ch, temb_ch, dropout=0.0):
+        super().__init__()
+        self.norm1 = GroupNorm(32 if in_ch % 32 == 0 else in_ch, in_ch, data_format="NHWC")
+        self.conv1 = Conv2D(in_ch, out_ch, 3, padding=1, data_format="NHWC")
+        self.temb_proj = Linear(temb_ch, out_ch)
+        self.norm2 = GroupNorm(32 if out_ch % 32 == 0 else out_ch, out_ch, data_format="NHWC")
+        self.dropout = Dropout(dropout)
+        self.conv2 = Conv2D(out_ch, out_ch, 3, padding=1, data_format="NHWC")
+        self.skip = (Conv2D(in_ch, out_ch, 1, data_format="NHWC")
+                     if in_ch != out_ch else None)
+        self.act = Silu()
+
+    def forward(self, x, temb):
+        h = self.conv1(self.act(self.norm1(x)))
+        h = h + self.temb_proj(self.act(temb)).unsqueeze(1).unsqueeze(1)
+        h = self.conv2(self.dropout(self.act(self.norm2(h))))
+        return h + (self.skip(x) if self.skip is not None else x)
+
+
+class CrossAttention(Layer):
+    def __init__(self, query_dim, context_dim, num_heads):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = query_dim // num_heads
+        self.to_q = Linear(query_dim, query_dim, bias_attr=False)
+        self.to_k = Linear(context_dim, query_dim, bias_attr=False)
+        self.to_v = Linear(context_dim, query_dim, bias_attr=False)
+        self.to_out = Linear(query_dim, query_dim)
+
+    def forward(self, x, context=None):
+        context = x if context is None else context
+        b, n, _ = x.shape
+        m = context.shape[1]
+        q = self.to_q(x).reshape([b, n, self.num_heads, self.head_dim])
+        k = self.to_k(context).reshape([b, m, self.num_heads, self.head_dim])
+        v = self.to_v(context).reshape([b, m, self.num_heads, self.head_dim])
+        o = F.scaled_dot_product_attention(q, k, v, is_causal=False)
+        return self.to_out(o.reshape([b, n, self.num_heads * self.head_dim]))
+
+
+class GEGLU(Layer):
+    def __init__(self, dim, inner):
+        super().__init__()
+        self.proj = Linear(dim, inner * 2)
+
+    def forward(self, x):
+        h = self.proj(x)
+        a, g = ops.chunk(h, 2, axis=-1)
+        return a * F.gelu(g)
+
+
+class TransformerBlock(Layer):
+    """Self-attn → cross-attn(context) → GEGLU FF, pre-LN (SD BasicTransformerBlock)."""
+
+    def __init__(self, dim, context_dim, num_heads):
+        super().__init__()
+        self.norm1 = LayerNorm(dim)
+        self.attn1 = CrossAttention(dim, dim, num_heads)
+        self.norm2 = LayerNorm(dim)
+        self.attn2 = CrossAttention(dim, context_dim, num_heads)
+        self.norm3 = LayerNorm(dim)
+        self.ff = GEGLU(dim, dim * 4)
+        self.ff_out = Linear(dim * 4, dim)
+
+    def forward(self, x, context):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), context)
+        x = x + self.ff_out(self.ff(self.norm3(x)))
+        return x
+
+
+class SpatialTransformer(Layer):
+    """GN → 1x1 in-proj → transformer over HW tokens → 1x1 out-proj + residual."""
+
+    def __init__(self, channels, context_dim, num_heads, depth=1):
+        super().__init__()
+        self.norm = GroupNorm(32 if channels % 32 == 0 else channels, channels, data_format="NHWC")
+        self.proj_in = Linear(channels, channels)
+        self.blocks = LayerList([TransformerBlock(channels, context_dim, num_heads)
+                                 for _ in range(depth)])
+        self.proj_out = Linear(channels, channels)
+
+    def forward(self, x, context):
+        b, h, w, c = x.shape
+        t = self.proj_in(self.norm(x).reshape([b, h * w, c]))
+        for blk in self.blocks:
+            t = blk(t, context)
+        return x + self.proj_out(t).reshape([b, h, w, c])
+
+
+class Downsample(Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = Conv2D(ch, ch, 3, stride=2, padding=1, data_format="NHWC")
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample2x(Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = Conv2D(ch, ch, 3, padding=1, data_format="NHWC")
+
+    def forward(self, x):
+        b, h, w, c = x.shape
+        x = F.interpolate(x, size=(h * 2, w * 2), mode="nearest",
+                          data_format="NHWC")
+        return self.conv(x)
+
+
+class UNetModel(Layer):
+    """Conditional UNet ε-predictor. Input NHWC latents + timestep + context."""
+
+    def __init__(self, config: UNetConfig):
+        super().__init__()
+        self.config = cfg = config
+        ch = cfg.base_channels
+        temb_ch = ch * 4
+        self.time_mlp1 = Linear(ch, temb_ch)
+        self.time_mlp2 = Linear(temb_ch, temb_ch)
+        self.act = Silu()
+        self.conv_in = Conv2D(cfg.in_channels, ch, 3, padding=1,
+                              data_format="NHWC")
+
+        # --- down path
+        self.down_res = LayerList()
+        self.down_attn = LayerList()
+        self.downsamplers = LayerList()
+        self._down_plan = []            # (n_res, has_attn, has_down) per level
+        skip_chs = [ch]
+        cur = ch
+        n_levels = len(cfg.channel_mult)
+        for lvl, mult in enumerate(cfg.channel_mult):
+            out_ch = ch * mult
+            has_attn = lvl in cfg.attention_levels
+            for _ in range(cfg.layers_per_block):
+                self.down_res.append(ResBlock(cur, out_ch, temb_ch, cfg.dropout))
+                if has_attn:
+                    self.down_attn.append(SpatialTransformer(
+                        out_ch, cfg.context_dim, cfg.num_heads,
+                        cfg.transformer_depth))
+                cur = out_ch
+                skip_chs.append(cur)
+            has_down = lvl != n_levels - 1
+            if has_down:
+                self.downsamplers.append(Downsample(cur))
+                skip_chs.append(cur)
+            self._down_plan.append((cfg.layers_per_block, has_attn, has_down))
+
+        # --- middle
+        self.mid_res1 = ResBlock(cur, cur, temb_ch, cfg.dropout)
+        self.mid_attn = SpatialTransformer(cur, cfg.context_dim, cfg.num_heads,
+                                           cfg.transformer_depth)
+        self.mid_res2 = ResBlock(cur, cur, temb_ch, cfg.dropout)
+
+        # --- up path (mirror, consumes skips)
+        self.up_res = LayerList()
+        self.up_attn = LayerList()
+        self.upsamplers = LayerList()
+        self._up_plan = []
+        for lvl in reversed(range(n_levels)):
+            out_ch = ch * cfg.channel_mult[lvl]
+            has_attn = lvl in cfg.attention_levels
+            for _ in range(cfg.layers_per_block + 1):
+                self.up_res.append(
+                    ResBlock(cur + skip_chs.pop(), out_ch, temb_ch, cfg.dropout))
+                if has_attn:
+                    self.up_attn.append(SpatialTransformer(
+                        out_ch, cfg.context_dim, cfg.num_heads,
+                        cfg.transformer_depth))
+                cur = out_ch
+            has_up = lvl != 0
+            if has_up:
+                self.upsamplers.append(Upsample2x(cur))
+            self._up_plan.append((cfg.layers_per_block + 1, has_attn, has_up))
+
+        self.norm_out = GroupNorm(32 if cur % 32 == 0 else cur, cur, data_format="NHWC")
+        self.conv_out = Conv2D(cur, cfg.out_channels, 3, padding=1,
+                               data_format="NHWC")
+
+    def _maybe_recompute(self, fn, *args):
+        if self.config.use_recompute and self.training:
+            from ..distributed.fleet.recompute import recompute
+            return recompute(fn, *args)
+        return fn(*args)
+
+    def forward(self, x, timesteps, context):
+        """x: (B,H,W,Cin) latents; timesteps: (B,); context: (B,L,context_dim)."""
+        temb = timestep_embedding(timesteps, self.config.base_channels)
+        temb = self.time_mlp2(self.act(self.time_mlp1(temb)))
+
+        h = self.conv_in(x)
+        skips = [h]
+        ri = ai = di = 0
+        for (n_res, has_attn, has_down) in self._down_plan:
+            for _ in range(n_res):
+                res, ri = self.down_res[ri], ri + 1
+                if has_attn:
+                    attn, ai = self.down_attn[ai], ai + 1
+                    h = self._maybe_recompute(
+                        lambda hh, tt, cc, _r=res, _a=attn:
+                            _a(_r(hh, tt), cc), h, temb, context)
+                else:
+                    h = self._maybe_recompute(
+                        lambda hh, tt, _r=res: _r(hh, tt), h, temb)
+                skips.append(h)
+            if has_down:
+                ds, di = self.downsamplers[di], di + 1
+                h = ds(h)
+                skips.append(h)
+
+        h = self._maybe_recompute(
+            lambda hh, tt, cc: self.mid_res2(
+                self.mid_attn(self.mid_res1(hh, tt), cc), tt),
+            h, temb, context)
+
+        ri = ai = ui = 0
+        for (n_res, has_attn, has_up) in self._up_plan:
+            for _ in range(n_res):
+                res, ri = self.up_res[ri], ri + 1
+                h = ops.concat([h, skips.pop()], axis=-1)
+                if has_attn:
+                    attn, ai = self.up_attn[ai], ai + 1
+                    h = self._maybe_recompute(
+                        lambda hh, tt, cc, _r=res, _a=attn:
+                            _a(_r(hh, tt), cc), h, temb, context)
+                else:
+                    h = self._maybe_recompute(
+                        lambda hh, tt, _r=res: _r(hh, tt), h, temb)
+            if has_up:
+                up, ui = self.upsamplers[ui], ui + 1
+                h = up(h)
+
+        return self.conv_out(self.act(self.norm_out(h)))
+
+
+def sd_unet(**over):
+    return UNetModel(UNetConfig.sd_unet(**over))
+
+
+def diffusion_loss(model, latents, timesteps, context, noise, alphas_cumprod):
+    """ε-prediction MSE: noise the latents with the closed-form q(x_t|x_0) and
+    regress the added noise (DDPM objective used for SD training)."""
+    a = ops.gather(alphas_cumprod, timesteps)
+    sqrt_a = ops.sqrt(a).reshape([-1, 1, 1, 1])
+    sqrt_1ma = ops.sqrt(1.0 - a).reshape([-1, 1, 1, 1])
+    noisy = latents * sqrt_a + noise * sqrt_1ma
+    pred = model(noisy, timesteps, context)
+    return ((pred - noise) ** 2).mean()
