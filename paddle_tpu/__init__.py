@@ -48,6 +48,7 @@ _LAZY_SUBMODULES = (
     "nn", "optimizer", "autograd", "amp", "jit", "io", "distributed", "vision",
     "static", "device", "profiler", "metric", "hapi", "incubate", "utils", "text",
     "sparse", "linalg", "fft", "signal", "distribution", "audio", "geometric",
+    "tensor", "regularizer", "quantization", "inference", "onnx",
 )
 
 
@@ -57,7 +58,14 @@ _LAZY_ATTRS = {"Model": ("hapi", "Model"), "summary": ("hapi", "summary")}
 def __getattr__(name):
     if name in _LAZY_SUBMODULES:
         import importlib
-        mod = importlib.import_module(f".{name}", __name__)
+        try:
+            mod = importlib.import_module(f".{name}", __name__)
+        except ModuleNotFoundError as e:
+            # keep hasattr() probes working when an optional subpackage is absent
+            if e.name == f"{__name__}.{name}":
+                raise AttributeError(
+                    f"module 'paddle_tpu' has no attribute {name!r}") from None
+            raise
         globals()[name] = mod
         return mod
     if name in _LAZY_ATTRS:

@@ -1,0 +1,160 @@
+"""paddle.fft analog — discrete Fourier transform family.
+
+Reference: python/paddle/fft.py (fft/ifft/rfft/... wrapping phi fft kernels, which on
+GPU ride cuFFT and on CPU ride pocketfft — SURVEY.md §2.10). TPU-native: every
+transform lowers to ``jnp.fft`` (XLA FFT HLO), dispatched through the eager tape so
+gradients and jit both work from the same definitions.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import dispatch
+from ..ops.creation import to_tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = (None, "backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {_NORMS}, got {norm!r}")
+    return norm or "backward"
+
+
+def _unary(jfn, name):
+    def op(x, n=None, axis=-1, norm="backward", name_arg=None):
+        norm_ = _check_norm(norm)
+
+        def fn(v):
+            return jfn(v, n=n, axis=axis, norm=norm_)
+
+        return dispatch(fn, (x,), {}, name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _nary(jfn, name):
+    def op(x, s=None, axes=None, norm="backward", name_arg=None):
+        norm_ = _check_norm(norm)
+
+        def fn(v):
+            return jfn(v, s=s, axes=axes, norm=norm_)
+
+        return dispatch(fn, (x,), {}, name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _binary_axes(jfn, name, default_axes=(-2, -1)):
+    def op(x, s=None, axes=default_axes, norm="backward", name_arg=None):
+        norm_ = _check_norm(norm)
+
+        def fn(v):
+            return jfn(v, s=s, axes=axes, norm=norm_)
+
+        return dispatch(fn, (x,), {}, name=name)
+
+    op.__name__ = name
+    return op
+
+
+fft = _unary(jnp.fft.fft, "fft")
+ifft = _unary(jnp.fft.ifft, "ifft")
+rfft = _unary(jnp.fft.rfft, "rfft")
+irfft = _unary(jnp.fft.irfft, "irfft")
+hfft = _unary(jnp.fft.hfft, "hfft")
+ihfft = _unary(jnp.fft.ihfft, "ihfft")
+
+fft2 = _binary_axes(jnp.fft.fft2, "fft2")
+ifft2 = _binary_axes(jnp.fft.ifft2, "ifft2")
+rfft2 = _binary_axes(jnp.fft.rfft2, "rfft2")
+irfft2 = _binary_axes(jnp.fft.irfft2, "irfft2")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    # scipy/paddle semantics: forward FFT over the leading axes, then a
+    # Hermitian-to-real transform along the last axis
+    norm_ = _check_norm(norm)
+
+    def fn(v):
+        n = None if s is None else s[-1]
+        inner = jnp.fft.fftn(v, s=None if s is None else s[:-1], axes=axes[:-1],
+                             norm=norm_)
+        return jnp.fft.hfft(inner, n=n, axis=axes[-1], norm=norm_)
+
+    return dispatch(fn, (x,), {}, name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    norm_ = _check_norm(norm)
+
+    def fn(v):
+        n = None if s is None else s[-1]
+        inner = jnp.fft.ihfft(v, n=n, axis=axes[-1], norm=norm_)
+        return jnp.fft.ifftn(inner, s=None if s is None else s[:-1], axes=axes[:-1],
+                             norm=norm_)
+
+    return dispatch(fn, (x,), {}, name="ihfft2")
+
+
+fftn = _nary(jnp.fft.fftn, "fftn")
+ifftn = _nary(jnp.fft.ifftn, "ifftn")
+rfftn = _nary(jnp.fft.rfftn, "rfftn")
+irfftn = _nary(jnp.fft.irfftn, "irfftn")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    norm_ = _check_norm(norm)
+
+    def fn(v):
+        ax = axes if axes is not None else tuple(range(v.ndim))
+        n = None if s is None else s[-1]
+        inner = jnp.fft.fftn(v, s=None if s is None else s[:-1], axes=ax[:-1],
+                             norm=norm_)
+        return jnp.fft.hfft(inner, n=n, axis=ax[-1], norm=norm_)
+
+    return dispatch(fn, (x,), {}, name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    norm_ = _check_norm(norm)
+
+    def fn(v):
+        ax = axes if axes is not None else tuple(range(v.ndim))
+        n = None if s is None else s[-1]
+        inner = jnp.fft.ihfft(v, n=n, axis=ax[-1], norm=norm_)
+        return jnp.fft.ifftn(inner, s=None if s is None else s[:-1], axes=ax[:-1],
+                             norm=norm_)
+
+    return dispatch(fn, (x,), {}, name="ihfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return to_tensor(jnp.fft.fftfreq(n, d=d), dtype=dtype)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return to_tensor(jnp.fft.rfftfreq(n, d=d), dtype=dtype)
+
+
+def fftshift(x, axes=None, name=None):
+    def fn(v):
+        return jnp.fft.fftshift(v, axes=axes)
+
+    return dispatch(fn, (x,), {}, name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    def fn(v):
+        return jnp.fft.ifftshift(v, axes=axes)
+
+    return dispatch(fn, (x,), {}, name="ifftshift")

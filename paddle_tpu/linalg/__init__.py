@@ -1,0 +1,22 @@
+"""paddle.linalg namespace — re-export of the linear-algebra op family.
+
+Reference: python/paddle/linalg.py (a namespace module re-exporting tensor/linalg.py
+ops). The implementations live in ops/linalg.py and lower to XLA's decomposition HLOs
+(QR/SVD/Eigh/Cholesky/TriangularSolve run on the MXU where possible).
+"""
+from ..ops.linalg import (  # noqa: F401
+    matmul, mm, bmm, mv, dot, cross, norm, vector_norm, matrix_norm, dist,
+    cholesky, cholesky_solve, inverse, det, slogdet, svd, qr, eig, eigh,
+    eigvals, eigvalsh, matrix_power, matrix_rank, solve, triangular_solve,
+    lstsq, pinv, lu, cond, multi_dot, corrcoef, cov, householder_product,
+)
+
+inv = inverse
+
+__all__ = [
+    "matmul", "mm", "bmm", "mv", "dot", "cross", "norm", "vector_norm",
+    "matrix_norm", "dist", "cholesky", "cholesky_solve", "inverse", "inv", "det",
+    "slogdet", "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh", "matrix_power",
+    "matrix_rank", "solve", "triangular_solve", "lstsq", "pinv", "lu", "cond",
+    "multi_dot", "corrcoef", "cov", "householder_product",
+]

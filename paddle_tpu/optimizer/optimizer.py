@@ -38,15 +38,18 @@ class Optimizer:
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
-        # paddle: float weight_decay == L2Decay coupled regularization
+        # paddle: float weight_decay == L2Decay coupled regularization;
+        # regularizer objects carry _kind ("l1"/"l2", regularizer/__init__.py)
+        self._wd_kind = "l2"
         if weight_decay is None:
             self._wd = 0.0
             self._decoupled_wd = False
         elif isinstance(weight_decay, (int, float)):
             self._wd = float(weight_decay)
             self._decoupled_wd = False
-        else:  # L2Decay object
+        else:  # L1Decay/L2Decay object
             self._wd = float(getattr(weight_decay, "_coeff", 0.0))
+            self._wd_kind = getattr(weight_decay, "_kind", "l2")
             self._decoupled_wd = False
         self._slots: dict[int, dict] = {}
         self._step_count = 0
@@ -90,7 +93,10 @@ class Optimizer:
             work_p = master if master is not None else p
             g32 = g.astype(work_p.dtype)
             if self._wd and not self._decoupled_wd:
-                g32 = g32 + self._wd * work_p
+                if self._wd_kind == "l1":
+                    g32 = g32 + self._wd * jnp.sign(work_p)
+                else:
+                    g32 = g32 + self._wd * work_p
             np_, ns = self._apply(work_p, g32, s, lr, step)
             if self._decoupled_wd and self._wd and dm:
                 np_ = np_ - lr * self._wd * work_p
@@ -392,11 +398,6 @@ class Lamb(Optimizer):
         return p - lr.astype(p.dtype) * trust * r, {"moment1": m, "moment2": v}
 
 
-class L2Decay:
-    def __init__(self, coeff=0.0):
-        self._coeff = coeff
-
-
-class L1Decay:
-    def __init__(self, coeff=0.0):
-        self._coeff = coeff
+# canonical definitions live in paddle_tpu.regularizer; re-exported here for the
+# paddle.optimizer.L1Decay/L2Decay call sites
+from ..regularizer import L1Decay, L2Decay  # noqa: E402,F401

@@ -1,0 +1,35 @@
+"""paddle.regularizer analog — weight-decay policies consumed by optimizers.
+
+Reference: python/paddle/regularizer.py (L1Decay/L2Decay appended to the grad during
+the optimizer update). The optimizer base reads ``_coeff`` / ``_kind`` and applies the
+decay inside its jit'd update (optimizer/optimizer.py).
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    _kind = "none"
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 weight decay: grad += coeff * sign(param)."""
+
+    _kind = "l1"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay: grad += coeff * param (coupled decay)."""
+
+    _kind = "l2"

@@ -1,0 +1,136 @@
+"""paddle.signal analog — frame / overlap_add / stft / istft.
+
+Reference: python/paddle/signal.py (frame:33, overlap_add:177, stft:296, istft:442,
+lowering to phi frame/overlap_add kernels + fft). TPU-native: framing is a gather with
+static frame indices (XLA turns it into a strided slice loop fused with the FFT); all
+four functions are pure jax and dispatch through the tape.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_val(v, frame_length, hop_length, axis=-1):
+    if axis not in (-1, 0):
+        raise ValueError("axis must be 0 or -1")
+    n = v.shape[axis]
+    if frame_length > n:
+        raise ValueError(f"frame_length ({frame_length}) > signal length ({n})")
+    num_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # (F, L)
+    if axis == -1:
+        out = jnp.take(v, idx, axis=-1)              # (..., F, L)
+        return jnp.swapaxes(out, -1, -2)             # (..., L, F) — paddle layout
+    out = jnp.take(v, idx.T, axis=0)                 # (L, F, ...)
+    return out
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def fn(v):
+        return _frame_val(v, frame_length, hop_length, axis)
+
+    return dispatch(fn, (x,), {}, name="frame")
+
+
+def _overlap_add_val(v, hop_length, axis=-1):
+    if axis not in (-1, 0):
+        raise ValueError("axis must be 0 or -1")
+    if axis == 0:
+        v = jnp.moveaxis(v, 1, -1)
+        v = jnp.moveaxis(v, 0, -2)  # (..., L, F) view with leading batch at the end
+        res = _overlap_add_val(v, hop_length, axis=-1)
+        return jnp.moveaxis(res, -1, 0)
+    # v: (..., frame_length, num_frames)
+    frame_length, num_frames = v.shape[-2], v.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = starts[None, :] + jnp.arange(frame_length)[:, None]  # (L, F)
+    flat_idx = idx.reshape(-1)
+    batch = v.shape[:-2]
+    vf = v.reshape(batch + (frame_length * num_frames,))
+    out = jnp.zeros(batch + (out_len,), dtype=v.dtype)
+    return out.at[..., flat_idx].add(vf)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def fn(v):
+        return _overlap_add_val(v, hop_length, axis)
+
+    return dispatch(fn, (x,), {}, name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform, paddle.signal.stft parity (signal.py:296)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win_val = window._value if isinstance(window, Tensor) else window
+
+    def fn(v, w):
+        if w is None:
+            w = jnp.ones((win_length,), dtype=v.dtype)
+        pad = (n_fft - win_length) // 2
+        if pad:
+            w = jnp.pad(w, (pad, n_fft - win_length - pad))
+        sig = v
+        if center:
+            widths = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            sig = jnp.pad(sig, widths, mode=pad_mode)
+        frames = _frame_val(sig, n_fft, hop_length, axis=-1)   # (..., n_fft, F)
+        frames = frames * w[:, None]
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-2)
+        else:
+            spec = jnp.fft.fft(frames, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, dtype=spec.real.dtype))
+        return spec
+
+    return dispatch(fn, (x, win_val), {}, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with window-envelope normalization (signal.py:442)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win_val = window._value if isinstance(window, Tensor) else window
+
+    def fn(spec, w):
+        rdtype = jnp.real(spec).dtype
+        if w is None:
+            w = jnp.ones((win_length,), dtype=rdtype)
+        pad = (n_fft - win_length) // 2
+        if pad:
+            w = jnp.pad(w, (pad, n_fft - win_length - pad))
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, dtype=rdtype))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-2)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-2)
+            if not return_complex:
+                frames = jnp.real(frames)
+        frames = frames * w[:, None]
+        sig = _overlap_add_val(frames, hop_length, axis=-1)
+        env = _overlap_add_val(
+            jnp.broadcast_to((w * w)[:, None], frames.shape[-2:]).astype(rdtype),
+            hop_length, axis=-1)
+        sig = sig / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            sig = sig[..., n_fft // 2: sig.shape[-1] - n_fft // 2]
+        if length is not None:
+            if sig.shape[-1] >= length:
+                sig = sig[..., :length]
+            else:
+                widths = [(0, 0)] * (sig.ndim - 1) + [(0, length - sig.shape[-1])]
+                sig = jnp.pad(sig, widths)
+        return sig
+
+    return dispatch(fn, (x, win_val), {}, name="istft")

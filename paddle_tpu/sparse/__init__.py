@@ -1,0 +1,604 @@
+"""paddle.sparse analog — COO/CSR sparse tensors and ops.
+
+Reference: python/paddle/sparse/ (creation.py sparse_coo_tensor/sparse_csr_tensor,
+unary/binary/matmul ops lowering to phi/kernels/sparse/, 51 sparse op YAML entries —
+SURVEY.md §2.2). TPU-native design: a sparse tensor is (static index arrays + a dense
+``values`` Tensor). Compute lowers to gather / segment-sum HLO — XLA's sort/scatter on
+TPU — instead of cuSPARSE; ``values`` rides the eager tape so every op here is
+differentiable w.r.t. values, and the same functions trace under jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from ..ops.creation import to_tensor
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+    "is_same_shape", "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "mv", "addmm", "transpose", "reshape", "sum", "coalesce",
+    "relu", "relu6", "leaky_relu", "sigmoid", "tanh", "softmax", "sqrt", "square",
+    "sin", "sinh", "tan", "asin", "asinh", "atan", "atanh", "abs", "pow",
+    "cast", "neg", "expm1", "log1p", "rad2deg", "deg2rad", "is_sparse_coo",
+    "is_sparse_csr", "nn",
+]
+
+
+def _as_value(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor: ``indices`` (sparse_dim, nnz) int64 + ``values``.
+
+    Values may carry trailing dense dims (hybrid tensors), matching the reference's
+    SparseCooTensor (paddle/phi/core/sparse_coo_tensor.h).
+    """
+
+    def __init__(self, indices, values: Tensor, shape, coalesced=False):
+        self._indices = jnp.asarray(_as_value(indices), dtype=jnp.int64)
+        self._values = values if isinstance(values, Tensor) else to_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = bool(coalesced)
+
+    # -- metadata -----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    def sparse_dim(self):
+        return int(self._indices.shape[0])
+
+    def dense_dim(self):
+        return len(self._shape) - self.sparse_dim()
+
+    def indices(self) -> Tensor:
+        return to_tensor(self._indices)
+
+    def values(self) -> Tensor:
+        return self._values
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def is_sparse(self):
+        return True
+
+    # -- conversions --------------------------------------------------------
+    def to_dense(self) -> Tensor:
+        idx = self._indices
+        shape = self._shape
+        sd = self.sparse_dim()
+
+        def fn(v):
+            out = jnp.zeros(shape, dtype=v.dtype)
+            return out.at[tuple(idx[d] for d in range(sd))].add(v)
+
+        return dispatch(fn, (self._values,), {}, name="sparse_to_dense")
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if self.sparse_dim() != 2:
+            raise ValueError("to_sparse_csr requires a 2-sparse-dim COO tensor")
+        st = self.coalesce()
+        rows = np.asarray(st._indices[0])
+        cols = jnp.asarray(st._indices[1])
+        nrows = st._shape[0]
+        crows = jnp.asarray(
+            np.concatenate([[0], np.cumsum(np.bincount(rows, minlength=nrows))]),
+            dtype=jnp.int64)
+        return SparseCsrTensor(crows, cols, st._values, st._shape)
+
+    def coalesce(self) -> "SparseCooTensor":
+        if self._coalesced:
+            return self
+        idx = np.asarray(self._indices)
+        sd = idx.shape[0]
+        flat = np.ravel_multi_index(tuple(idx), self._shape[:sd])
+        order = np.argsort(flat, kind="stable")
+        sorted_flat = flat[order]
+        uniq, first = np.unique(sorted_flat, return_index=True)
+        seg_ids = jnp.asarray(np.searchsorted(uniq, sorted_flat))
+        n_uniq = len(uniq)
+        order_j = jnp.asarray(order)
+
+        def fn(v):
+            return jax.ops.segment_sum(v[order_j], seg_ids, num_segments=n_uniq)
+
+        new_vals = dispatch(fn, (self._values,), {}, name="sparse_coalesce")
+        new_idx = np.stack(np.unravel_index(uniq, self._shape[:sd]))
+        return SparseCooTensor(new_idx, new_vals, self._shape, coalesced=True)
+
+    # -- operators ----------------------------------------------------------
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __truediv__(self, other):
+        return divide(self, other)
+
+    def __neg__(self):
+        return neg(self)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def numpy(self):
+        return self.to_dense().numpy()
+
+    def backward(self, grad=None):
+        raise RuntimeError("call .backward() on a dense result, not the sparse leaf")
+
+    def T(self):
+        return transpose(self, list(range(self.ndim))[::-1])
+
+    def astype(self, dtype):
+        return cast(self, dtype)
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix (optionally batched): crows, cols, values.
+
+    Reference: paddle/phi/core/sparse_csr_tensor.h.
+    """
+
+    def __init__(self, crows, cols, values: Tensor, shape):
+        self._crows = jnp.asarray(_as_value(crows), dtype=jnp.int64)
+        self._cols = jnp.asarray(_as_value(cols), dtype=jnp.int64)
+        self._values = values if isinstance(values, Tensor) else to_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    def nnz(self):
+        return int(self._cols.shape[-1])
+
+    def crows(self) -> Tensor:
+        return to_tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return to_tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return self._values
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def is_sparse(self):
+        return True
+
+    def _row_ids(self):
+        crows = np.asarray(self._crows)
+        counts = np.diff(crows)
+        return np.repeat(np.arange(len(counts)), counts)
+
+    def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
+        rows = jnp.asarray(self._row_ids(), dtype=jnp.int64)
+        idx = jnp.stack([rows, self._cols])
+        return SparseCooTensor(idx, self._values, self._shape, coalesced=True)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def numpy(self):
+        return self.to_dense().numpy()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """paddle.sparse.sparse_coo_tensor (reference: python/paddle/sparse/creation.py)."""
+    idx = np.asarray(_as_value(indices), dtype=np.int64)
+    vals = values if isinstance(values, Tensor) else to_tensor(values, dtype=dtype)
+    if dtype is not None and isinstance(values, Tensor):
+        from ..core.dtype import convert_dtype
+        jd = convert_dtype(dtype)
+        vals = dispatch(lambda v: v.astype(jd), (vals,), {},
+                        name="sparse_values_cast")
+    if shape is None:
+        sparse_shape = tuple((idx.max(axis=1) + 1).tolist()) if idx.size else ()
+        shape = sparse_shape + tuple(vals.shape[1:])
+    if not isinstance(values, Tensor):
+        vals.stop_gradient = stop_gradient
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    vals = values if isinstance(values, Tensor) else to_tensor(values, dtype=dtype)
+    if not isinstance(values, Tensor):
+        vals.stop_gradient = stop_gradient
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _coo(x) -> SparseCooTensor:
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+# ---------------------------------------------------------------------------
+# unary ops (apply to values, sparsity preserved)
+# ---------------------------------------------------------------------------
+
+def _unary(jfn, name, needs_coalesce=False):
+    def op(x, name_arg=None):
+        csr = isinstance(x, SparseCsrTensor)
+        xc = _coo(x)
+
+        def fn(v):
+            return jfn(v)
+
+        out_vals = dispatch(fn, (xc._values,), {}, name=f"sparse_{name}")
+        out = SparseCooTensor(xc._indices, out_vals, xc._shape, xc._coalesced)
+        return out.to_sparse_csr() if csr else out
+
+    op.__name__ = name
+    return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0), "relu")
+relu6 = _unary(lambda v: jnp.clip(v, 0, 6), "relu6")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+tanh = _unary(jnp.tanh, "tanh")
+sqrt = _unary(jnp.sqrt, "sqrt")
+square = _unary(jnp.square, "square")
+sin = _unary(jnp.sin, "sin")
+sinh = _unary(jnp.sinh, "sinh")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+asinh = _unary(jnp.arcsinh, "asinh")
+atan = _unary(jnp.arctan, "atan")
+atanh = _unary(jnp.arctanh, "atanh")
+abs = _unary(jnp.abs, "abs")
+neg = _unary(jnp.negative, "neg")
+expm1 = _unary(jnp.expm1, "expm1")
+log1p = _unary(jnp.log1p, "log1p")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _unary(lambda v: jnp.where(v >= 0, v, negative_slope * v), "leaky_relu")(x)
+
+
+def pow(x, factor):
+    return _unary(lambda v: jnp.power(v, factor), "pow")(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    """paddle.sparse.cast(x, index_dtype, value_dtype) — argument order matches
+    the reference (python/paddle/sparse/unary.py)."""
+    from ..core import dtype as dtypes
+    jd = dtypes.convert_dtype(value_dtype) if value_dtype is not None else None
+    ji = dtypes.convert_dtype(index_dtype) if index_dtype is not None else None
+
+    def conv(s):
+        vals = s._values if jd is None else dispatch(
+            lambda v: v.astype(jd), (s._values,), {}, name="sparse_cast")
+        return vals
+
+    if isinstance(x, SparseCsrTensor):
+        out = SparseCsrTensor(x._crows, x._cols, conv(x), x._shape)
+        if ji is not None:
+            out._crows = out._crows.astype(ji)
+            out._cols = out._cols.astype(ji)
+        return out
+    out = SparseCooTensor(x._indices, conv(x), x._shape, x._coalesced)
+    if ji is not None:
+        out._indices = out._indices.astype(ji)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise (union of sparsity patterns)
+# ---------------------------------------------------------------------------
+
+def _binary(jfn, name):
+    def op(x, y, name_arg=None):
+        csr = isinstance(x, SparseCsrTensor)
+        if isinstance(y, Tensor) or np.isscalar(y):
+            raise TypeError(
+                f"sparse.{name} requires two sparse tensors; use dense ops for mixed")
+        xc, yc = _coo(x).coalesce(), _coo(y).coalesce()
+        if xc._shape != yc._shape:
+            raise ValueError(f"shape mismatch: {xc._shape} vs {yc._shape}")
+        sd = xc.sparse_dim()
+        xi = np.asarray(xc._indices)
+        yi = np.asarray(yc._indices)
+        xf = np.ravel_multi_index(tuple(xi), xc._shape[:sd])
+        yf = np.ravel_multi_index(tuple(yi), yc._shape[:sd])
+        union = np.union1d(xf, yf)
+        xpos = jnp.asarray(np.searchsorted(union, xf))
+        ypos = jnp.asarray(np.searchsorted(union, yf))
+        n = len(union)
+        dense_shape = tuple(xc._values.shape[1:])
+
+        def fn(vx, vy):
+            ax = jnp.zeros((n,) + dense_shape, dtype=vx.dtype).at[xpos].set(vx)
+            ay = jnp.zeros((n,) + dense_shape, dtype=vy.dtype).at[ypos].set(vy)
+            return jfn(ax, ay)
+
+        out_vals = dispatch(fn, (xc._values, yc._values), {}, name=f"sparse_{name}")
+        new_idx = np.stack(np.unravel_index(union, xc._shape[:sd]))
+        out = SparseCooTensor(new_idx, out_vals, xc._shape, coalesced=True)
+        return out.to_sparse_csr() if csr else out
+
+    op.__name__ = name
+    return op
+
+
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+divide = _binary(jnp.divide, "divide")
+
+
+# ---------------------------------------------------------------------------
+# matmul family
+# ---------------------------------------------------------------------------
+
+def matmul(x, y, name=None):
+    """Sparse @ dense (spmm) or sparse @ sparse → dense.
+
+    Reference: python/paddle/sparse/binary.py matmul → phi sparse matmul kernels
+    (cuSPARSE on GPU). Here: gather rows of the dense operand by the sparse column
+    ids, scale by values, segment-sum into output rows — sort/scatter HLO on TPU.
+    """
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        xc = _coo(x).coalesce()
+        if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+            y = y.to_dense()
+        if xc.ndim != 2:
+            raise ValueError("sparse matmul currently supports 2-D sparse operands")
+        rows = jnp.asarray(xc._indices[0])
+        cols = jnp.asarray(xc._indices[1])
+        n_rows = xc._shape[0]
+
+        def fn(v, d):
+            gathered = d[cols] * v[(...,) + (None,) * (d.ndim - 1)]
+            return jax.ops.segment_sum(gathered, rows, num_segments=n_rows)
+
+        return dispatch(fn, (xc._values, y), {}, name="sparse_matmul")
+    raise TypeError("matmul: first operand must be sparse")
+
+
+def mv(x, vec, name=None):
+    xc = _coo(x).coalesce()
+    rows = jnp.asarray(xc._indices[0])
+    cols = jnp.asarray(xc._indices[1])
+    n_rows = xc._shape[0]
+
+    def fn(v, d):
+        return jax.ops.segment_sum(v * d[cols], rows, num_segments=n_rows)
+
+    return dispatch(fn, (xc._values, vec), {}, name="sparse_mv")
+
+
+def masked_matmul(x, y, mask, name=None):
+    """SDDMM: (x @ y) sampled at mask's sparsity (reference: sparse/binary.py)."""
+    mc = _coo(mask)
+    rows = jnp.asarray(mc._indices[0])
+    cols = jnp.asarray(mc._indices[1])
+
+    def fn(a, b):
+        return jnp.einsum("nk,nk->n", a[rows, :], jnp.swapaxes(b, -1, -2)[cols, :])
+
+    vals = dispatch(fn, (x, y), {}, name="sparse_masked_matmul")
+    out = SparseCooTensor(mc._indices, vals, (x.shape[0], y.shape[-1]),
+                          coalesced=mc._coalesced)
+    return out.to_sparse_csr() if isinstance(mask, SparseCsrTensor) else out
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) with sparse x (reference: sparse/binary.py)."""
+    prod = matmul(x, y)
+
+    def fn(inp, p):
+        return beta * inp + alpha * p
+
+    return dispatch(fn, (input, prod), {}, name="sparse_addmm")
+
+
+# ---------------------------------------------------------------------------
+# structure ops
+# ---------------------------------------------------------------------------
+
+def transpose(x, perm, name=None):
+    csr = isinstance(x, SparseCsrTensor)
+    xc = _coo(x)
+    sd = xc.sparse_dim()
+    if sorted(perm) != list(range(xc.ndim)):
+        raise ValueError(f"invalid perm {perm}")
+    if sorted(perm[:sd]) != list(range(sd)):
+        raise ValueError("transpose across sparse/dense boundary is not supported")
+    new_idx = xc._indices[jnp.asarray(perm[:sd])]
+    dense_perm = [0] + [p - sd + 1 for p in perm[sd:]]
+    vals = xc._values
+    if dense_perm != list(range(len(dense_perm))):
+        vals = dispatch(lambda v: jnp.transpose(v, dense_perm), (vals,), {},
+                        name="sparse_transpose_vals")
+    new_shape = tuple(xc._shape[p] for p in perm)
+    out = SparseCooTensor(new_idx, vals, new_shape, coalesced=False)
+    return out.to_sparse_csr() if csr else out
+
+
+def reshape(x, shape, name=None):
+    csr = isinstance(x, SparseCsrTensor)
+    xc = _coo(x).coalesce()
+    sd = xc.sparse_dim()
+    if xc.dense_dim():
+        raise ValueError("reshape of hybrid sparse tensors is not supported")
+    shape = list(shape)
+    numel = int(np.prod(xc._shape))
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = numel // known
+    flat = np.ravel_multi_index(tuple(np.asarray(xc._indices)), xc._shape)
+    new_idx = np.stack(np.unravel_index(flat, shape))
+    out = SparseCooTensor(new_idx, xc._values, shape, coalesced=True)
+    return out.to_sparse_csr() if csr else out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    xc = _coo(x).coalesce()
+    if axis is None:
+        def fn(v):
+            out = jnp.sum(v)
+            return out if dtype is None else out.astype(dtype)
+
+        return dispatch(fn, (xc._values,), {}, name="sparse_sum")
+    if isinstance(axis, (list, tuple)):
+        raise ValueError("sparse.sum supports a single axis or None")
+    axis = axis % xc.ndim
+    sd = xc.sparse_dim()
+    if axis >= sd:
+        vals = dispatch(lambda v: jnp.sum(v, axis=axis - sd + 1, keepdims=keepdim),
+                        (xc._values,), {}, name="sparse_sum")
+        new_shape = [s for i, s in enumerate(xc._shape) if i != axis or keepdim]
+        if keepdim:
+            new_shape = list(xc._shape)
+            new_shape[axis] = 1
+        return SparseCooTensor(xc._indices, vals, new_shape, coalesced=True)
+    keep_dims = [d for d in range(sd) if d != axis]
+    new_sparse_shape = tuple(xc._shape[d] for d in keep_dims)
+    if keepdim:
+        full_shape = list(xc._shape)
+        full_shape[axis] = 1
+    else:
+        full_shape = [s for i, s in enumerate(xc._shape) if i != axis]
+    idx = np.asarray(xc._indices)
+    if keep_dims:
+        flat = np.ravel_multi_index(tuple(idx[keep_dims]), new_sparse_shape)
+    else:
+        flat = np.zeros(idx.shape[1], dtype=np.int64)
+    uniq = np.unique(flat)
+    seg = jnp.asarray(np.searchsorted(uniq, flat))
+    n = len(uniq)
+
+    def fn(v):
+        return jax.ops.segment_sum(v, seg, num_segments=n)
+
+    vals = dispatch(fn, (xc._values,), {}, name="sparse_sum")
+    if keep_dims:
+        new_idx = np.stack(np.unravel_index(uniq, new_sparse_shape))
+    else:
+        new_idx = np.zeros((0, len(uniq)), dtype=np.int64)
+    if keepdim:
+        ins_row = np.zeros((1, new_idx.shape[1]), dtype=np.int64)
+        new_idx = np.concatenate(
+            [new_idx[:axis], ins_row, new_idx[axis:]], axis=0)
+    return SparseCooTensor(new_idx, vals, full_shape, coalesced=True)
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def softmax(x, axis=-1, name=None):
+    """Row softmax over the sparsity pattern (reference: sparse/nn/functional).
+
+    Rows are identified by ALL sparse dims except the last, so batched (B, M, N)
+    COO inputs normalize per true row, matching the reference.
+    """
+    csr = isinstance(x, SparseCsrTensor)
+    xc = _coo(x).coalesce()
+    sd = xc.sparse_dim()
+    if axis not in (-1, sd - 1):
+        raise ValueError("sparse softmax supports the last (column) axis")
+    idx = np.asarray(xc._indices)
+    if sd == 1:
+        row_ids = np.zeros(idx.shape[1], dtype=np.int64)
+        n_rows = 1
+    else:
+        row_shape = xc._shape[:sd - 1]
+        row_ids = np.ravel_multi_index(tuple(idx[:sd - 1]), row_shape)
+        n_rows = int(np.prod(row_shape))
+    rows = jnp.asarray(row_ids)
+
+    def fn(v):
+        row_max = jax.ops.segment_max(v, rows, num_segments=n_rows)
+        e = jnp.exp(v - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        return e / denom[rows]
+
+    vals = dispatch(fn, (xc._values,), {}, name="sparse_softmax")
+    out = SparseCooTensor(xc._indices, vals, xc._shape, coalesced=True)
+    return out.to_sparse_csr() if csr else out
+
+
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,345 @@
+"""paddle.sparse.nn analog — layers over sparse tensors.
+
+Reference: python/paddle/sparse/nn/ (Conv3D/SubmConv3D riding phi sparse conv kernels,
+BatchNorm on nnz values, activations). TPU-native: activations/norms act on the dense
+``values`` tensor; 3-D convolutions compute densely through XLA's conv HLO and
+re-sparsify at the (statically known) active output sites — on TPU the conv is the
+MXU-friendly part, and active-site bookkeeping is host-side index arithmetic since
+sparsity patterns are static per tensor in this design.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from ..nn.layer_base import Layer
+from ..nn import initializer as I
+from . import (
+    SparseCooTensor, SparseCsrTensor, relu as _relu, relu6 as _relu6,
+    leaky_relu as _leaky_relu, softmax as _softmax, _coo,
+)
+
+__all__ = [
+    "ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm", "SyncBatchNorm",
+    "Conv3D", "SubmConv3D", "MaxPool3D", "functional",
+]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return _leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return _softmax(x, self._axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) dim of a sparse tensor's values.
+
+    Reference: python/paddle/sparse/nn/layer/norm.py — stats are computed over nnz
+    entries only, exactly as the reference's sparse BN does.
+    """
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NDHWC", name=None):
+        super().__init__()
+        self._momentum = momentum
+        self._eps = epsilon
+        self.weight = self.create_parameter([num_features], attr=weight_attr,
+                                            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros(num_features, jnp.float32)))
+        self.register_buffer(
+            "_variance", Tensor(jnp.ones(num_features, jnp.float32)))
+
+    def forward(self, x):
+        xc = _coo(x)
+        vals = xc._values
+        training = self.training
+        mom = self._momentum
+        eps = self._eps
+
+        if training:
+            def fn(v, w, b, rm, rv):
+                axes = tuple(range(v.ndim - 1))
+                mean = jnp.mean(v, axis=axes)
+                var = jnp.var(v, axis=axes)
+                out = (v - mean) / jnp.sqrt(var + eps) * w + b
+                return out, mom * rm + (1 - mom) * mean, mom * rv + (1 - mom) * var
+
+            out, new_m, new_v = dispatch(
+                fn, (vals, self.weight, self.bias, self._mean, self._variance), {},
+                name="sparse_batch_norm")
+            self._mean._value = new_m._value
+            self._variance._value = new_v._value
+        else:
+            def fn(v, w, b, rm, rv):
+                return (v - rm) / jnp.sqrt(rv + eps) * w + b
+
+            out = dispatch(fn, (vals, self.weight, self.bias, self._mean,
+                                self._variance), {}, name="sparse_batch_norm_infer")
+        res = SparseCooTensor(xc._indices, out, xc._shape, xc._coalesced)
+        return res.to_sparse_csr() if isinstance(x, SparseCsrTensor) else res
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica sparse BN; under pjit the mean/var reductions are global when the
+    batch dim is sharded (XLA inserts the psum), so the single-program form suffices.
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+def _dense_conv3d(v_dense, w, stride, padding, dilation, groups):
+    # v_dense: (N, D, H, W, C) NDHWC; w: (kd, kh, kw, Cin/g, Cout)
+    dn = jax.lax.conv_dimension_numbers(
+        v_dense.shape, w.shape, ("NDHWC", "DHWIO", "NDHWC"))
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        p = padding if isinstance(padding, (list, tuple)) else [padding] * 3
+        pad = [(int(x), int(x)) for x in p]
+    return jax.lax.conv_general_dilated(
+        v_dense, w, window_strides=tuple(stride), padding=pad,
+        rhs_dilation=tuple(dilation), dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+class Conv3D(Layer):
+    """Sparse 3-D convolution (NDHWC), reference sparse/nn/layer/conv.py.
+
+    Computes through the dense conv HLO and gathers the statically-derived active
+    output sites. Output sites = dilation of input sites by the kernel footprint
+    (computed host-side from the static index set).
+    """
+
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else [kernel_size] * 3
+        self._ks = tuple(int(k) for k in ks)
+        st = stride if isinstance(stride, (list, tuple)) else [stride] * 3
+        self._stride = tuple(int(s) for s in st)
+        if isinstance(padding, str):
+            mode = padding.upper()
+            if mode == "VALID":
+                padding = 0
+            elif mode == "SAME":
+                if any(s != 1 for s in self._stride):
+                    raise ValueError("padding='SAME' requires stride 1")
+                padding = tuple((k - 1) // 2 for k in self._ks)
+            else:
+                raise ValueError(f"unknown padding mode {padding!r}")
+        self._padding = padding
+        dl = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 3
+        self._dilation = tuple(int(d) for d in dl)
+        self._groups = groups
+        self.weight = self.create_parameter(
+            list(self._ks) + [in_channels // groups, out_channels], attr=weight_attr)
+        self.bias = (self.create_parameter([out_channels], attr=bias_attr, is_bias=True)
+                     if bias_attr is not False else None)
+
+    def _out_sites(self, xc):
+        """Active output coordinates (np arrays) given input coordinates."""
+        idx = np.asarray(xc._indices)  # (4, nnz): n, d, h, w
+        N = xc._shape[0]
+        spatial_in = xc._shape[1:4]
+        pad = self._padding if isinstance(self._padding, (list, tuple)) \
+            else [self._padding] * 3
+        out_spatial = []
+        for i in range(3):
+            eff_k = (self._ks[i] - 1) * self._dilation[i] + 1
+            out_spatial.append(
+                (spatial_in[i] + 2 * int(pad[i]) - eff_k) // self._stride[i] + 1)
+        if self._subm:
+            return idx, tuple(spatial_in)
+        # dilate each input site by the kernel footprint, keep valid strided sites
+        offs = np.stack(np.meshgrid(
+            *[np.arange(k) * d for k, d in zip(self._ks, self._dilation)],
+            indexing="ij"), axis=-1).reshape(-1, 3)
+        coords = idx[1:4].T  # (nnz, 3)
+        pad_arr = np.asarray([int(p) for p in pad])
+        expanded = (coords[:, None, :] + pad_arr - offs[None, :, :])
+        batch = np.repeat(idx[0], offs.shape[0])
+        expanded = expanded.reshape(-1, 3)
+        stride_arr = np.asarray(self._stride)
+        valid = np.all(expanded % stride_arr == 0, axis=1)
+        outc = expanded // stride_arr
+        for i in range(3):
+            valid &= (outc[:, i] >= 0) & (outc[:, i] < out_spatial[i])
+        outc = outc[valid]
+        batch = batch[valid]
+        full = np.concatenate([batch[:, None], outc], axis=1)
+        flat = np.ravel_multi_index(full.T, (N,) + tuple(out_spatial))
+        uniq = np.unique(flat)
+        out_idx = np.stack(np.unravel_index(uniq, (N,) + tuple(out_spatial)))
+        return out_idx, tuple(out_spatial)
+
+    def forward(self, x):
+        xc = _coo(x)
+        out_idx, out_spatial = self._out_sites(xc)
+        shape = tuple(xc._shape)
+        stride, padding, dilation, groups = (
+            self._stride, self._padding, self._dilation, self._groups)
+        idx = jnp.asarray(xc._indices)
+        oidx = jnp.asarray(out_idx)
+        out_ch = int(self.weight.shape[-1])
+        bias = self.bias
+
+        def fn(v, w, b):
+            dense = jnp.zeros(shape[:4] + (v.shape[-1],), dtype=v.dtype)
+            dense = dense.at[idx[0], idx[1], idx[2], idx[3]].add(v)
+            out = _dense_conv3d(dense, w, stride, padding, dilation, groups)
+            vals = out[oidx[0], oidx[1], oidx[2], oidx[3]]
+            if b is not None:
+                vals = vals + b
+            return vals
+
+        args = (xc._values, self.weight, bias)
+        vals = dispatch(fn, args, {}, name="sparse_conv3d")
+        out_shape = (shape[0],) + out_spatial + (out_ch,)
+        return SparseCooTensor(out_idx, vals, out_shape, coalesced=True)
+
+
+class SubmConv3D(Conv3D):
+    """Submanifold sparse conv: output sparsity == input sparsity."""
+
+    _subm = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self._stride != (1, 1, 1):
+            raise ValueError("SubmConv3D requires stride 1")
+        # 'same' padding so sites map onto themselves
+        self._padding = tuple(((k - 1) * d) // 2
+                              for k, d in zip(self._ks, self._dilation))
+
+
+class MaxPool3D(Layer):
+    """Sparse max pool (NDHWC), dense window-reduce + active-site gather."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else [kernel_size] * 3
+        self._ks = tuple(int(k) for k in ks)
+        st = stride if stride is not None else kernel_size
+        st = st if isinstance(st, (list, tuple)) else [st] * 3
+        self._stride = tuple(int(s) for s in st)
+        self._padding = padding if isinstance(padding, (list, tuple)) else [padding] * 3
+
+    def forward(self, x):
+        xc = _coo(x)
+        shape = tuple(xc._shape)
+        N, spatial_in, C = shape[0], shape[1:4], shape[4]
+        pad = [int(p) for p in self._padding]
+        out_spatial = tuple(
+            (spatial_in[i] + 2 * pad[i] - self._ks[i]) // self._stride[i] + 1
+            for i in range(3))
+        idx_np = np.asarray(xc._indices)
+        coords = idx_np[1:4].T
+        site = (coords + np.asarray(pad)) // np.asarray(self._stride)
+        within = np.all((coords + np.asarray(pad)) <
+                        (site * np.asarray(self._stride) + np.asarray(self._ks)),
+                        axis=1)
+        for i in range(3):
+            within &= site[:, i] < out_spatial[i]
+        full = np.concatenate([idx_np[0][within, None], site[within]], axis=1)
+        flat = np.ravel_multi_index(full.T, (N,) + out_spatial)
+        uniq = np.unique(flat)
+        out_idx = np.stack(np.unravel_index(uniq, (N,) + out_spatial))
+        idx = jnp.asarray(xc._indices)
+        oidx = jnp.asarray(out_idx)
+        ks, stride = self._ks, self._stride
+
+        def fn(v):
+            neg = jnp.asarray(-jnp.inf, dtype=v.dtype)
+            dense = jnp.full(shape, neg)
+            dense = dense.at[idx[0], idx[1], idx[2], idx[3]].max(v)
+            pooled = jax.lax.reduce_window(
+                dense, neg, jax.lax.max,
+                window_dimensions=(1,) + ks + (1,),
+                window_strides=(1,) + stride + (1,),
+                padding=((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),))
+            return pooled[oidx[0], oidx[1], oidx[2], oidx[3]]
+
+        vals = dispatch(fn, (xc._values,), {}, name="sparse_max_pool3d")
+        return SparseCooTensor(out_idx, vals, (N,) + out_spatial + (C,),
+                               coalesced=True)
+
+
+class functional:
+    """paddle.sparse.nn.functional namespace."""
+    from . import (  # noqa: F401
+        relu, relu6, leaky_relu, softmax,
+    )
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask, key_padding_mask=None,
+                  attn_mask=None, name=None):
+        """Sparse-pattern attention (reference sparse/nn/functional/transformer.py):
+        scores computed only at mask sites via SDDMM, row-softmax, then spmm."""
+        from . import masked_matmul, matmul as sp_matmul, softmax as sp_softmax
+        import math as _math
+        if len(query.shape) != 2:
+            raise ValueError(
+                "sparse attention operates on 2-D (seq, head_dim) operands; vmap or "
+                "loop per head for batched input")
+        d = query.shape[-1]
+
+        def scale_fn(q):
+            return q / _math.sqrt(d)
+
+        q_scaled = dispatch(scale_fn, (query,), {}, name="attn_scale")
+        k_t = dispatch(lambda k: jnp.swapaxes(k, -1, -2), (key,), {}, name="attn_kT")
+        scores = masked_matmul(q_scaled, k_t, sparse_mask)
+        if attn_mask is not None or key_padding_mask is not None:
+            rows = jnp.asarray(scores._indices[0])
+            cols = jnp.asarray(scores._indices[1])
+
+            def add_masks(v, am, kpm):
+                if am is not None:
+                    v = v + am[rows, cols]
+                if kpm is not None:
+                    if jnp.issubdtype(kpm.dtype, jnp.floating):
+                        v = v + kpm[cols]  # additive float mask
+                    else:
+                        # 0/False at padded keys → -inf score
+                        v = jnp.where(kpm[cols] > 0, v,
+                                      jnp.asarray(-jnp.inf, v.dtype))
+                return v
+
+            vals = dispatch(add_masks, (scores._values, attn_mask,
+                                        key_padding_mask), {}, name="attn_masks")
+            from . import SparseCooTensor as _Coo
+            scores = _Coo(scores._indices, vals, scores._shape, scores._coalesced)
+        probs = sp_softmax(scores)
+        return sp_matmul(probs, value)

@@ -1,0 +1,14 @@
+"""paddle.tensor namespace — the flat tensor-op API.
+
+Reference: python/paddle/tensor/ (creation/math/manipulation/linalg/logic/einsum
+modules re-exported at paddle top level). Implementations live in paddle_tpu/ops/;
+this module mirrors the reference's namespace so `paddle.tensor.xxx` call sites port
+directly.
+"""
+from ..ops.creation import *  # noqa: F401,F403
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.linalg import *  # noqa: F401,F403
+from ..ops.logic import *  # noqa: F401,F403
+from ..ops.einsum import einsum  # noqa: F401
+from ..ops.creation import to_tensor, assign  # noqa: F401
