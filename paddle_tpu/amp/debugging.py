@@ -66,8 +66,26 @@ def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
     amp/debugging.py check_numerics -> check_numerics kernel).
 
     Returns (stats, values): stats = [num_nan, num_inf, num_zero] int64 Tensor,
-    values = [max, min, mean] float32 Tensor."""
+    values = [max, min, mean] float32 Tensor.
+
+    Host-resident (numpy) tensors audit through the native multithreaded scanner
+    (csrc/numeric.cc — the FLAGS_check_nan_inf host path); device arrays audit
+    on-device so no transfer is forced."""
+    import numpy as _np
     v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if isinstance(v, _np.ndarray):
+        from ..core.native import scan_array
+        r = scan_array(v)
+        if r is not None:
+            stats = _np.asarray([r["nan_count"], r["inf_count"],
+                                 r["zero_count"]], dtype=_np.int64)
+            nf = r["finite_count"]
+            values = _np.asarray(
+                [r["max"] if nf else _np.nan,
+                 r["min"] if nf else _np.nan,
+                 (r["sum"] / nf) if nf else _np.nan], dtype=_np.float32)
+            return Tensor(stats, stop_gradient=True), Tensor(values,
+                                                             stop_gradient=True)
     vf = v.astype(jnp.float32)
     finite = jnp.isfinite(vf)
     stats = jnp.stack([jnp.sum(jnp.isnan(vf)).astype(jnp.int64),

@@ -1,0 +1,188 @@
+"""ctypes bindings for the native runtime layer (paddle_tpu/csrc).
+
+Reference analog: the pybind layer (paddle/fluid/pybind) — except the TPU build
+binds a small C ABI (csrc/pt_native.h) via ctypes, so there is no compiled
+Python-extension coupling. The library auto-builds from source on first use
+(`make -C paddle_tpu/csrc`) and every consumer has a pure-Python fallback, so
+the framework works even without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_CSRC_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                         "csrc")
+_LIB_PATH = os.path.join(_CSRC_DIR, "libpaddle_tpu_rt.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+class ScanResult(ctypes.Structure):
+    _fields_ = [
+        ("nan_count", ctypes.c_longlong),
+        ("inf_count", ctypes.c_longlong),
+        ("zero_count", ctypes.c_longlong),
+        ("finite_count", ctypes.c_longlong),
+        ("abs_max", ctypes.c_double),
+        ("min", ctypes.c_double),
+        ("max", ctypes.c_double),
+        ("sum", ctypes.c_double),
+    ]
+
+
+def _configure(lib):
+    lib.pt_store_server_start.restype = ctypes.c_void_p
+    lib.pt_store_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.POINTER(ctypes.c_int)]
+    lib.pt_store_server_stop.argtypes = [ctypes.c_void_p]
+    lib.pt_store_server_num_keys.restype = ctypes.c_uint64
+    lib.pt_store_server_num_keys.argtypes = [ctypes.c_void_p]
+
+    lib.pt_shm_create.restype = ctypes.c_void_p
+    lib.pt_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.pt_shm_open.restype = ctypes.c_void_p
+    lib.pt_shm_open.argtypes = [ctypes.c_char_p]
+    lib.pt_shm_push.restype = ctypes.c_int
+    lib.pt_shm_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_size_t, ctypes.c_int]
+    lib.pt_shm_pop.restype = ctypes.c_int
+    lib.pt_shm_pop.argtypes = [ctypes.c_void_p,
+                               ctypes.POINTER(ctypes.c_void_p),
+                               ctypes.POINTER(ctypes.c_size_t), ctypes.c_int]
+    lib.pt_shm_close.argtypes = [ctypes.c_void_p]
+    lib.pt_shm_destroy.argtypes = [ctypes.c_void_p]
+    lib.pt_shm_capacity.restype = ctypes.c_size_t
+    lib.pt_shm_capacity.argtypes = [ctypes.c_void_p]
+    lib.pt_buf_free.argtypes = [ctypes.c_void_p]
+
+    lib.pt_scan_floats.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                   ctypes.c_int, ctypes.c_int,
+                                   ctypes.POINTER(ScanResult)]
+    return lib
+
+
+def load():
+    """Load (building if necessary) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(["make", "-C", _CSRC_DIR],
+                               capture_output=True, timeout=120, check=True)
+            except Exception:
+                return None
+        try:
+            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# ---------------------------------------------------------------------------
+# ShmChannel wrapper
+# ---------------------------------------------------------------------------
+
+class ShmChannel:
+    """MPSC shared-memory byte channel (creator = consumer side)."""
+
+    def __init__(self, name: str, capacity: int | None = None, create=True):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native runtime library unavailable")
+        self._lib = lib
+        self.name = name
+        if create:
+            self._h = lib.pt_shm_create(name.encode(), int(capacity or 64 << 20))
+        else:
+            self._h = lib.pt_shm_open(name.encode())
+        if not self._h:
+            raise OSError(f"cannot {'create' if create else 'open'} shm {name}")
+        self._owner = create
+
+    def push(self, data: bytes, timeout_ms=-1):
+        rc = self._lib.pt_shm_push(self._h, data, len(data), timeout_ms)
+        if rc == -1:
+            raise TimeoutError("shm push timed out")
+        if rc == -2:
+            raise BrokenPipeError("shm channel closed")
+        if rc == -3:
+            raise ValueError(f"message of {len(data)} bytes exceeds channel "
+                             f"capacity {self.capacity}")
+
+    def pop(self, timeout_ms=-1) -> bytes:
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_size_t()
+        rc = self._lib.pt_shm_pop(self._h, ctypes.byref(out),
+                                  ctypes.byref(out_len), timeout_ms)
+        if rc == -1:
+            raise TimeoutError("shm pop timed out")
+        if rc == -2:
+            raise BrokenPipeError("shm channel closed")
+        try:
+            return ctypes.string_at(out.value, out_len.value)
+        finally:
+            self._lib.pt_buf_free(out)
+
+    @property
+    def capacity(self):
+        return int(self._lib.pt_shm_capacity(self._h))
+
+    def close(self):
+        if self._h:
+            self._lib.pt_shm_close(self._h)
+
+    def destroy(self):
+        if self._h:
+            self._lib.pt_shm_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None) and self._owner:
+                self.destroy()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# numeric scan
+# ---------------------------------------------------------------------------
+
+_KIND = {"float32": 0, "float64": 1, "bfloat16": 2, "float16": 3}
+
+
+def scan_array(arr, num_threads=0):
+    """nan/inf/absmax/sum audit of a numpy (or numpy-convertible) array.
+
+    Returns dict(nan_count, inf_count, abs_max, sum) or None when the dtype is
+    unsupported or the native lib is missing (caller falls back to numpy).
+    """
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(arr)
+    name = str(a.dtype)
+    if name not in _KIND:
+        return None
+    res = ScanResult()
+    lib.pt_scan_floats(a.ctypes.data_as(ctypes.c_void_p), a.size, _KIND[name],
+                       num_threads, ctypes.byref(res))
+    return {"nan_count": int(res.nan_count), "inf_count": int(res.inf_count),
+            "zero_count": int(res.zero_count),
+            "finite_count": int(res.finite_count),
+            "abs_max": float(res.abs_max), "min": float(res.min),
+            "max": float(res.max), "sum": float(res.sum)}

@@ -1,0 +1,165 @@
+// Numeric audit — multithreaded nan/inf/absmax/sum scan over host buffers.
+// Reference analog: FLAGS_check_nan_inf -> CheckTensorHasNanOrInf
+// (paddle/fluid/eager/nan_inf_utils.h:38, phi check_numerics kernel). Device
+// tensors are audited inside the compiled program (jnp.isnan under jit); this
+// path audits HOST staging buffers (dataloader output, checkpoints) where
+// python-loop scanning would be orders of magnitude too slow.
+#include "pt_native.h"
+
+#include <cmath>
+#include <cstring>
+#include <limits>
+#include <thread>
+#include <vector>
+
+namespace {
+
+float bf16_to_f32(uint16_t v) {
+  uint32_t bits = static_cast<uint32_t>(v) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+float f16_to_f32(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t mant = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {  // subnormal
+      int e = -1;
+      do {
+        e++;
+        mant <<= 1;
+      } while ((mant & 0x400) == 0);
+      bits = sign | ((127 - 15 - e) << 23) | ((mant & 0x3FF) << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000u | (mant << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+template <typename Load>
+void scan_chunk(const uint8_t* base, size_t elem_size, size_t begin, size_t end,
+                Load load, pt_scan_result* r) {
+  long long nans = 0, infs = 0, zeros = 0, finites = 0;
+  double amax = 0.0, sum = 0.0;
+  double vmin = std::numeric_limits<double>::infinity();
+  double vmax = -std::numeric_limits<double>::infinity();
+  for (size_t i = begin; i < end; ++i) {
+    double v = load(base + i * elem_size);
+    if (std::isnan(v)) {
+      ++nans;
+    } else if (std::isinf(v)) {
+      ++infs;
+    } else {
+      ++finites;
+      if (v == 0.0) ++zeros;
+      double a = std::fabs(v);
+      if (a > amax) amax = a;
+      if (v < vmin) vmin = v;
+      if (v > vmax) vmax = v;
+      sum += v;
+    }
+  }
+  r->nan_count = nans;
+  r->inf_count = infs;
+  r->zero_count = zeros;
+  r->finite_count = finites;
+  r->abs_max = amax;
+  r->min = vmin;
+  r->max = vmax;
+  r->sum = sum;
+}
+
+}  // namespace
+
+extern "C" void pt_scan_floats(const void* data, size_t n, int kind,
+                               int num_threads, pt_scan_result* out) {
+  out->nan_count = out->inf_count = 0;
+  out->zero_count = out->finite_count = 0;
+  out->abs_max = 0.0;
+  out->min = std::numeric_limits<double>::infinity();
+  out->max = -std::numeric_limits<double>::infinity();
+  out->sum = 0.0;
+  if (!data || n == 0) return;
+
+  auto load_f32 = [](const uint8_t* p) {
+    float f;
+    std::memcpy(&f, p, 4);
+    return static_cast<double>(f);
+  };
+  auto load_f64 = [](const uint8_t* p) {
+    double d;
+    std::memcpy(&d, p, 8);
+    return d;
+  };
+  auto load_bf16 = [](const uint8_t* p) {
+    uint16_t v;
+    std::memcpy(&v, p, 2);
+    return static_cast<double>(bf16_to_f32(v));
+  };
+  auto load_f16 = [](const uint8_t* p) {
+    uint16_t v;
+    std::memcpy(&v, p, 2);
+    return static_cast<double>(f16_to_f32(v));
+  };
+
+  size_t elem = kind == 1 ? 8 : (kind == 0 ? 4 : 2);
+  unsigned hw = std::thread::hardware_concurrency();
+  size_t nt = num_threads > 0 ? static_cast<size_t>(num_threads)
+                              : (hw ? hw : 4);
+  if (n < (1 << 16)) nt = 1;
+  if (nt > n) nt = 1;
+
+  std::vector<pt_scan_result> partial(nt);
+  std::vector<std::thread> threads;
+  const uint8_t* base = static_cast<const uint8_t*>(data);
+  size_t per = n / nt;
+  for (size_t t = 0; t < nt; ++t) {
+    size_t b = t * per;
+    size_t e = (t == nt - 1) ? n : b + per;
+    auto run = [&, b, e, t] {
+      switch (kind) {
+        case 0:
+          scan_chunk(base, 4, b, e, load_f32, &partial[t]);
+          break;
+        case 1:
+          scan_chunk(base, 8, b, e, load_f64, &partial[t]);
+          break;
+        case 2:
+          scan_chunk(base, 2, b, e, load_bf16, &partial[t]);
+          break;
+        case 3:
+          scan_chunk(base, 2, b, e, load_f16, &partial[t]);
+          break;
+      }
+    };
+    if (nt == 1) {
+      run();
+    } else {
+      threads.emplace_back(run);
+    }
+  }
+  for (auto& th : threads) th.join();
+  for (size_t t = 0; t < nt; ++t) {
+    const auto& p = partial[t];
+    out->nan_count += p.nan_count;
+    out->inf_count += p.inf_count;
+    out->zero_count += p.zero_count;
+    out->finite_count += p.finite_count;
+    if (p.abs_max > out->abs_max) out->abs_max = p.abs_max;
+    if (p.min < out->min) out->min = p.min;
+    if (p.max > out->max) out->max = p.max;
+    out->sum += p.sum;
+  }
+  (void)elem;
+}

@@ -1,0 +1,92 @@
+// Native runtime layer for paddle_tpu — C ABI consumed via ctypes.
+//
+// Reference analogs:
+//   TCPStore        -> paddle/phi/core/distributed/store/tcp_store.h:121
+//   ShmChannel      -> fluid DataLoader shared-mem queues
+//                      (python/paddle/io/dataloader/dataloader_iter.py:368,
+//                       paddle/fluid/memory/allocation/mmap_allocator.cc)
+//   numeric scan    -> FLAGS_check_nan_inf path
+//                      (phi/kernels/check_numerics_kernel.h)
+//
+// TPU-native rationale: device-side compute and collectives live in XLA; the
+// native layer owns the HOST runtime around it — rendezvous, IO staging, and
+// numeric auditing of host buffers — exactly the parts the reference implements
+// in C++ because the GIL would serialize them.
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---------------------------------------------------------------------------
+// TCPStore server.  Binary length-prefixed protocol (all big-endian):
+//   request : u8 op | u32 klen | key bytes | op-specific payload
+//   SET(1)  : u8 tag | u32 vlen | value        -> reply u8 ok
+//   GET(2)  :                                  -> u8 ok | u8 has | u8 tag |
+//                                                 u32 vlen | value
+//   WAIT(3) : f64 timeout_sec (as u64 bits)    -> u8 ok(1)/timeout(0) | u8 tag |
+//                                                 u32 vlen | value
+//   ADD(4)  : i64 delta                        -> u8 ok | i64 new_value
+//   DEL(5)  :                                  -> u8 ok
+//   NUM(6)  : (klen==0)                        -> u8 ok | u64 num_keys
+// Tags: 0 = opaque bytes (pickle), 1 = i64 counter.
+// ---------------------------------------------------------------------------
+
+typedef struct pt_store_server pt_store_server;
+
+// Binds host:port (port 0 = ephemeral), starts accept thread. Returns NULL on
+// failure. *bound_port receives the actual port.
+pt_store_server* pt_store_server_start(const char* host, int port,
+                                       int* bound_port);
+void pt_store_server_stop(pt_store_server* s);
+uint64_t pt_store_server_num_keys(pt_store_server* s);
+
+// ---------------------------------------------------------------------------
+// ShmChannel: multi-producer single-consumer ring buffer in POSIX shared
+// memory, for DataLoader worker -> main-process batch transport.
+// ---------------------------------------------------------------------------
+
+typedef struct pt_shm_channel pt_shm_channel;
+
+// create: allocates /dev/shm segment `name` with `capacity` payload bytes.
+pt_shm_channel* pt_shm_create(const char* name, size_t capacity);
+// open: attach to an existing segment (worker side).
+pt_shm_channel* pt_shm_open(const char* name);
+// push: blocks until space (timeout_ms < 0 = forever). Returns 0 ok, -1 timeout,
+// -2 channel closed.
+int pt_shm_push(pt_shm_channel* c, const void* data, size_t len, int timeout_ms);
+// pop: blocks until a message (timeout semantics as push). On success *out is a
+// malloc'd buffer the caller frees with pt_buf_free, *out_len its size.
+int pt_shm_pop(pt_shm_channel* c, void** out, size_t* out_len, int timeout_ms);
+// mark closed (consumers/producers wake up and see -2).
+void pt_shm_close(pt_shm_channel* c);
+// detach mapping (and on the creator, unlink the segment).
+void pt_shm_destroy(pt_shm_channel* c);
+size_t pt_shm_capacity(pt_shm_channel* c);
+void pt_buf_free(void* p);
+
+// ---------------------------------------------------------------------------
+// Numeric audit: multithreaded nan/inf/absmax scan over host buffers.
+// kind: 0=f32 1=f64 2=bf16 3=f16
+// ---------------------------------------------------------------------------
+
+typedef struct {
+  long long nan_count;
+  long long inf_count;
+  long long zero_count;
+  long long finite_count;
+  double abs_max;
+  double min;  // over finite values; +inf when none
+  double max;  // over finite values; -inf when none
+  double sum;  // finite values only
+} pt_scan_result;
+
+void pt_scan_floats(const void* data, size_t n, int kind, int num_threads,
+                    pt_scan_result* out);
+
+#ifdef __cplusplus
+}
+#endif

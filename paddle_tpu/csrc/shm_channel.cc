@@ -1,0 +1,264 @@
+// ShmChannel — multi-producer/single-consumer ring buffer in POSIX shared
+// memory, the transport between DataLoader worker processes and the trainer.
+// Reference analog: the shared-memory queues of the multiprocess DataLoader
+// (python/paddle/io/dataloader/dataloader_iter.py:368 + fluid mmap_allocator).
+//
+// Layout: [Header | payload ring of `capacity` bytes]. Records are
+// u32 length + bytes, contiguous — a record never wraps; if it doesn't fit in
+// the tail space we write a SKIP marker (0xFFFFFFFF) and continue at offset 0.
+// Process-shared pthread mutex + condvars give blocking push/pop without
+// spinning, surviving fork() naturally.
+#include "pt_native.h"
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <new>
+#include <string>
+
+namespace {
+
+constexpr uint32_t kSkip = 0xFFFFFFFFu;
+
+struct Header {
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t capacity;
+  uint64_t head;  // consumer offset into ring
+  uint64_t tail;  // producer offset into ring
+  uint64_t used;  // bytes occupied (records + skip markers)
+  uint32_t closed;
+  uint32_t magic;
+};
+
+constexpr uint32_t kMagic = 0x50545348;  // "PTSH"
+
+timespec deadline_from_ms(int timeout_ms) {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += static_cast<long>(timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) {
+    ts.tv_sec += 1;
+    ts.tv_nsec -= 1000000000L;
+  }
+  return ts;
+}
+
+}  // namespace
+
+struct pt_shm_channel {
+  Header* h = nullptr;
+  char* ring = nullptr;
+  size_t map_len = 0;
+  std::string name;
+  bool owner = false;
+};
+
+extern "C" {
+
+pt_shm_channel* pt_shm_create(const char* name, size_t capacity) {
+  if (capacity < (1 << 12)) capacity = 1 << 12;
+  size_t total = sizeof(Header) + capacity;
+  ::shm_unlink(name);
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (::ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  auto* h = new (mem) Header();
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->not_empty, &ca);
+  pthread_cond_init(&h->not_full, &ca);
+  h->capacity = capacity;
+  h->head = h->tail = h->used = 0;
+  h->closed = 0;
+  h->magic = kMagic;
+
+  auto* c = new pt_shm_channel();
+  c->h = h;
+  c->ring = static_cast<char*>(mem) + sizeof(Header);
+  c->map_len = total;
+  c->name = name;
+  c->owner = true;
+  return c;
+}
+
+pt_shm_channel* pt_shm_open(const char* name) {
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, static_cast<size_t>(st.st_size),
+                     PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* h = static_cast<Header*>(mem);
+  if (h->magic != kMagic) {
+    ::munmap(mem, static_cast<size_t>(st.st_size));
+    return nullptr;
+  }
+  auto* c = new pt_shm_channel();
+  c->h = h;
+  c->ring = static_cast<char*>(mem) + sizeof(Header);
+  c->map_len = static_cast<size_t>(st.st_size);
+  c->name = name;
+  c->owner = false;
+  return c;
+}
+
+static int lock_robust(Header* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {
+    // a worker died holding the lock; state is still consistent enough for a
+    // rendezvous-style teardown — mark consistent and carry on
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+int pt_shm_push(pt_shm_channel* c, const void* data, size_t len,
+                int timeout_ms) {
+  Header* h = c->h;
+  size_t need = 4 + len;
+  if (need + 4 > h->capacity) return -3;  // can never fit
+  if (lock_robust(h) != 0) return -2;
+  for (;;) {
+    if (h->closed) {
+      pthread_mutex_unlock(&h->mu);
+      return -2;
+    }
+    uint64_t cap = h->capacity;
+    uint64_t tail = h->tail;
+    uint64_t space_to_end = cap - tail;
+    uint64_t free_total = cap - h->used;
+    bool fits_contig = space_to_end >= need;
+    // if the record can't sit contiguously at the tail we must also burn the
+    // tail gap with a skip marker
+    uint64_t need_total = fits_contig ? need : space_to_end + need;
+    if (free_total >= need_total && (fits_contig || cap >= need)) {
+      if (!fits_contig) {
+        if (space_to_end >= 4) {
+          uint32_t skip = kSkip;
+          std::memcpy(c->ring + tail, &skip, 4);
+        }
+        h->used += space_to_end;
+        tail = 0;
+      }
+      uint32_t len32 = static_cast<uint32_t>(len);
+      std::memcpy(c->ring + tail, &len32, 4);
+      std::memcpy(c->ring + tail + 4, data, len);
+      h->tail = (tail + need) % cap;
+      h->used += need;
+      pthread_cond_signal(&h->not_empty);
+      pthread_mutex_unlock(&h->mu);
+      return 0;
+    }
+    int rc;
+    if (timeout_ms < 0) {
+      rc = pthread_cond_wait(&h->not_full, &h->mu);
+    } else {
+      timespec ts = deadline_from_ms(timeout_ms);
+      rc = pthread_cond_timedwait(&h->not_full, &h->mu, &ts);
+    }
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+}
+
+int pt_shm_pop(pt_shm_channel* c, void** out, size_t* out_len, int timeout_ms) {
+  Header* h = c->h;
+  if (lock_robust(h) != 0) return -2;
+  for (;;) {
+    if (h->used > 0) {
+      uint64_t cap = h->capacity;
+      uint64_t head = h->head;
+      uint64_t space_to_end = cap - head;
+      uint32_t len32 = kSkip;
+      if (space_to_end >= 4) {
+        std::memcpy(&len32, c->ring + head, 4);
+      }
+      if (space_to_end < 4 || len32 == kSkip) {
+        h->used -= space_to_end;
+        h->head = 0;
+        continue;
+      }
+      void* buf = ::malloc(len32 ? len32 : 1);
+      std::memcpy(buf, c->ring + head + 4, len32);
+      h->head = (head + 4 + len32) % cap;
+      h->used -= 4 + len32;
+      pthread_cond_broadcast(&h->not_full);
+      pthread_mutex_unlock(&h->mu);
+      *out = buf;
+      *out_len = len32;
+      return 0;
+    }
+    if (h->closed) {
+      pthread_mutex_unlock(&h->mu);
+      return -2;
+    }
+    int rc;
+    if (timeout_ms < 0) {
+      rc = pthread_cond_wait(&h->not_empty, &h->mu);
+    } else {
+      timespec ts = deadline_from_ms(timeout_ms);
+      rc = pthread_cond_timedwait(&h->not_empty, &h->mu, &ts);
+    }
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+}
+
+void pt_shm_close(pt_shm_channel* c) {
+  Header* h = c->h;
+  if (lock_robust(h) == 0) {
+    h->closed = 1;
+    pthread_cond_broadcast(&h->not_empty);
+    pthread_cond_broadcast(&h->not_full);
+    pthread_mutex_unlock(&h->mu);
+  }
+}
+
+void pt_shm_destroy(pt_shm_channel* c) {
+  if (!c) return;
+  ::munmap(c->h, c->map_len);
+  if (c->owner) ::shm_unlink(c->name.c_str());
+  delete c;
+}
+
+size_t pt_shm_capacity(pt_shm_channel* c) { return c->h->capacity; }
+
+void pt_buf_free(void* p) { ::free(p); }
+
+}  // extern "C"

@@ -1,0 +1,294 @@
+// TCPStore server — the rendezvous KV that bootstraps multi-process jobs.
+// Reference: paddle/phi/core/distributed/store/tcp_store.h:121 (MasterDaemon
+// thread + per-connection service, wait/add/get/set semantics).
+//
+// Thread-per-connection is deliberate: rendezvous traffic is O(world_size)
+// small messages at startup/teardown, not a throughput path, and blocking
+// reads keep WAIT trivial (condition_variable with deadline).
+#include "pt_native.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { kSet = 1, kGet = 2, kWait = 3, kAdd = 4, kDel = 5, kNum = 6 };
+
+struct Value {
+  uint8_t tag = 0;  // 0 opaque, 1 i64 counter
+  std::string bytes;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+uint32_t load_u32(const char* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return ntohl(v);
+}
+
+void push_u32(std::string* s, uint32_t v) {
+  v = htonl(v);
+  s->append(reinterpret_cast<const char*>(&v), 4);
+}
+
+uint64_t ntoh64(uint64_t v) {
+  const uint16_t probe = 1;
+  if (*reinterpret_cast<const uint8_t*>(&probe) == 1) {  // little-endian host
+    v = (static_cast<uint64_t>(ntohl(static_cast<uint32_t>(v))) << 32) |
+        ntohl(static_cast<uint32_t>(v >> 32));
+  }
+  return v;
+}
+
+void push_u64(std::string* s, uint64_t v) {
+  v = ntoh64(v);  // involutive
+  s->append(reinterpret_cast<const char*>(&v), 8);
+}
+
+}  // namespace
+
+struct pt_store_server {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::atomic<bool> stopping{false};
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<std::string, Value> kv;
+
+  std::mutex conn_mu;
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;
+
+  void Serve(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t op;
+      if (!read_full(fd, &op, 1)) break;
+      char klen_buf[4];
+      if (!read_full(fd, klen_buf, 4)) break;
+      uint32_t klen = load_u32(klen_buf);
+      if (klen > (64u << 20)) break;
+      std::string key(klen, '\0');
+      if (klen && !read_full(fd, key.data(), klen)) break;
+
+      std::string reply;
+      switch (op) {
+        case kSet: {
+          uint8_t tag;
+          char vlen_buf[4];
+          if (!read_full(fd, &tag, 1) || !read_full(fd, vlen_buf, 4)) goto done;
+          {
+            uint32_t vlen = load_u32(vlen_buf);
+            if (vlen > (256u << 20)) goto done;
+            std::string val(vlen, '\0');
+            if (vlen && !read_full(fd, val.data(), vlen)) goto done;
+            {
+              std::lock_guard<std::mutex> lk(mu);
+              kv[key] = Value{tag, std::move(val)};
+            }
+            cv.notify_all();
+          }
+          reply.push_back(1);
+          break;
+        }
+        case kGet: {
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = kv.find(key);
+          reply.push_back(1);
+          if (it == kv.end()) {
+            reply.push_back(0);
+            reply.push_back(0);
+            push_u32(&reply, 0);
+          } else {
+            reply.push_back(1);
+            reply.push_back(it->second.tag);
+            push_u32(&reply, static_cast<uint32_t>(it->second.bytes.size()));
+            reply += it->second.bytes;
+          }
+          break;
+        }
+        case kWait: {
+          char t_buf[8];
+          if (!read_full(fd, t_buf, 8)) goto done;
+          {
+            uint64_t bits;
+            std::memcpy(&bits, t_buf, 8);
+            bits = ntoh64(bits);
+            double timeout_s;
+            std::memcpy(&timeout_s, &bits, 8);
+            auto deadline = std::chrono::steady_clock::now() +
+                            std::chrono::duration_cast<
+                                std::chrono::steady_clock::duration>(
+                                std::chrono::duration<double>(timeout_s));
+            std::unique_lock<std::mutex> lk(mu);
+            bool found = cv.wait_until(lk, deadline, [&] {
+              return stopping.load() || kv.count(key) > 0;
+            });
+            if (found && !stopping.load()) {
+              const Value& v = kv[key];
+              reply.push_back(1);
+              reply.push_back(v.tag);
+              push_u32(&reply, static_cast<uint32_t>(v.bytes.size()));
+              reply += v.bytes;
+            } else {
+              reply.push_back(0);
+              reply.push_back(0);
+              push_u32(&reply, 0);
+            }
+          }
+          break;
+        }
+        case kAdd: {
+          char d_buf[8];
+          if (!read_full(fd, d_buf, 8)) goto done;
+          {
+            uint64_t bits;
+            std::memcpy(&bits, d_buf, 8);
+            int64_t delta = static_cast<int64_t>(ntoh64(bits));
+            int64_t cur = 0;
+            {
+              std::lock_guard<std::mutex> lk(mu);
+              Value& v = kv[key];
+              if (v.tag == 1 && v.bytes.size() == 8) {
+                std::memcpy(&cur, v.bytes.data(), 8);
+              }
+              cur += delta;
+              v.tag = 1;
+              v.bytes.assign(reinterpret_cast<const char*>(&cur), 8);
+            }
+            cv.notify_all();
+            reply.push_back(1);
+            uint64_t out;
+            std::memcpy(&out, &cur, 8);
+            push_u64(&reply, out);
+          }
+          break;
+        }
+        case kDel: {
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            kv.erase(key);
+          }
+          cv.notify_all();
+          reply.push_back(1);
+          break;
+        }
+        case kNum: {
+          std::lock_guard<std::mutex> lk(mu);
+          reply.push_back(1);
+          push_u64(&reply, kv.size());
+          break;
+        }
+        default:
+          goto done;
+      }
+      if (!write_full(fd, reply.data(), reply.size())) break;
+    }
+  done:
+    ::close(fd);
+  }
+
+  void AcceptLoop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping.load()) return;
+        continue;
+      }
+      std::lock_guard<std::mutex> lk(conn_mu);
+      conn_fds.push_back(fd);
+      conn_threads.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+};
+
+extern "C" {
+
+pt_store_server* pt_store_server_start(const char* host, int port,
+                                       int* bound_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host && *host ? host : "0.0.0.0", &addr.sin_addr) !=
+      1) {
+    addr.sin_addr.s_addr = INADDR_ANY;
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 512) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  if (bound_port) *bound_port = ntohs(addr.sin_port);
+
+  auto* s = new pt_store_server();
+  s->listen_fd = fd;
+  s->accept_thread = std::thread([s] { s->AcceptLoop(); });
+  return s;
+}
+
+void pt_store_server_stop(pt_store_server* s) {
+  if (!s) return;
+  s->stopping.store(true);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  s->cv.notify_all();  // unblock WAITers so their threads can exit
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // wake connection threads blocked in read(), then join them — they must
+    // not outlive the server state they reference
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->conn_threads) {
+    if (t.joinable()) t.join();
+  }
+  delete s;
+}
+
+uint64_t pt_store_server_num_keys(pt_store_server* s) {
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->kv.size();
+}
+
+}  // extern "C"

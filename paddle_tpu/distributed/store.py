@@ -1,10 +1,15 @@
 """TCPStore — socket KV rendezvous (reference: phi/core/distributed/store/
 tcp_store.h:121, CreateOrGetGlobalTCPStore at store_utils.h:33).
 
-Rank 0 hosts a tiny length-prefixed protocol server; all ranks connect as clients.
-Used for multi-process bootstrap metadata, barriers, and host-side object
-collectives (the Gloo-analog for small host tensors/objects). Device-side
-collectives never touch this — they compile to XLA ICI/DCN ops.
+Rank 0 hosts the store server; all ranks connect as clients. Used for
+multi-process bootstrap metadata, barriers, and host-side object collectives
+(the Gloo-analog for small host tensors/objects). Device-side collectives never
+touch this — they compile to XLA ICI/DCN ops.
+
+The server is the native C++ one (csrc/tcp_store.cc — GIL-free thread-per-conn
+daemon, like the reference's MasterDaemon) when the runtime library is
+available, with a pure-Python thread fallback speaking the identical binary
+protocol (csrc/pt_native.h documents it).
 """
 from __future__ import annotations
 
@@ -15,19 +20,11 @@ import struct
 import threading
 import time
 
+_OP_SET, _OP_GET, _OP_WAIT, _OP_ADD, _OP_DEL, _OP_NUM = 1, 2, 3, 4, 5, 6
+_TAG_BYTES, _TAG_I64 = 0, 1
 
-def _send_msg(sock, payload: bytes):
-    sock.sendall(struct.pack("!I", len(payload)) + payload)
 
-
-def _recv_msg(sock) -> bytes:
-    hdr = b""
-    while len(hdr) < 4:
-        chunk = sock.recv(4 - len(hdr))
-        if not chunk:
-            raise ConnectionError("store connection closed")
-        hdr += chunk
-    (n,) = struct.unpack("!I", hdr)
+def _recv_full(sock, n) -> bytes:
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
@@ -37,16 +34,28 @@ def _recv_msg(sock) -> bytes:
     return buf
 
 
-class _StoreServer(threading.Thread):
+class _PyStoreServer(threading.Thread):
+    """Fallback server — same wire protocol as csrc/tcp_store.cc."""
+
     def __init__(self, host, port):
         super().__init__(daemon=True)
-        self._kv = {}
+        self._kv: dict[str, tuple[int, bytes]] = {}
         self._cv = threading.Condition()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
         self.port = self._srv.getsockname()[1]
         self._srv.listen(128)
+
+    def stop(self):
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def num_keys(self):
+        with self._cv:
+            return len(self._kv)
 
     def run(self):
         while True:
@@ -57,55 +66,131 @@ class _StoreServer(threading.Thread):
             threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
 
     def _serve(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             while True:
-                req = pickle.loads(_recv_msg(conn))
-                op = req["op"]
-                if op == "set":
+                op = _recv_full(conn, 1)[0]
+                (klen,) = struct.unpack("!I", _recv_full(conn, 4))
+                key = _recv_full(conn, klen).decode() if klen else ""
+                if op == _OP_SET:
+                    tag = _recv_full(conn, 1)[0]
+                    (vlen,) = struct.unpack("!I", _recv_full(conn, 4))
+                    val = _recv_full(conn, vlen)
                     with self._cv:
-                        self._kv[req["key"]] = req["value"]
+                        self._kv[key] = (tag, val)
                         self._cv.notify_all()
-                    _send_msg(conn, pickle.dumps({"ok": True}))
-                elif op == "get":
+                    conn.sendall(b"\x01")
+                elif op == _OP_GET:
                     with self._cv:
-                        _send_msg(conn, pickle.dumps(
-                            {"ok": True, "value": self._kv.get(req["key"])}))
-                elif op == "wait":
-                    deadline = time.time() + req.get("timeout", 300)
+                        entry = self._kv.get(key)
+                    if entry is None:
+                        conn.sendall(b"\x01\x00\x00" + struct.pack("!I", 0))
+                    else:
+                        tag, val = entry
+                        conn.sendall(b"\x01\x01" + bytes([tag])
+                                     + struct.pack("!I", len(val)) + val)
+                elif op == _OP_WAIT:
+                    (timeout_s,) = struct.unpack("!d", _recv_full(conn, 8))
+                    deadline = time.time() + timeout_s
                     with self._cv:
-                        while req["key"] not in self._kv:
+                        while key not in self._kv:
                             remaining = deadline - time.time()
                             if remaining <= 0:
-                                _send_msg(conn, pickle.dumps(
-                                    {"ok": False, "error": "timeout"}))
                                 break
                             self._cv.wait(timeout=min(remaining, 1.0))
-                        else:
-                            _send_msg(conn, pickle.dumps(
-                                {"ok": True, "value": self._kv[req["key"]]}))
-                elif op == "add":
+                        entry = self._kv.get(key)
+                    if entry is None:
+                        conn.sendall(b"\x00\x00" + struct.pack("!I", 0))
+                    else:
+                        tag, val = entry
+                        conn.sendall(b"\x01" + bytes([tag])
+                                     + struct.pack("!I", len(val)) + val)
+                elif op == _OP_ADD:
+                    (delta,) = struct.unpack("!q", _recv_full(conn, 8))
                     with self._cv:
-                        cur = self._kv.get(req["key"], 0) + req["value"]
-                        self._kv[req["key"]] = cur
+                        tag, val = self._kv.get(key, (_TAG_I64, b"\0" * 8))
+                        cur = struct.unpack("<q", val)[0] if tag == _TAG_I64 \
+                            and len(val) == 8 else 0
+                        cur += delta
+                        self._kv[key] = (_TAG_I64, struct.pack("<q", cur))
                         self._cv.notify_all()
-                    _send_msg(conn, pickle.dumps({"ok": True, "value": cur}))
-                elif op == "delete":
+                    conn.sendall(b"\x01" + struct.pack("!q", cur))
+                elif op == _OP_DEL:
                     with self._cv:
-                        self._kv.pop(req["key"], None)
+                        self._kv.pop(key, None)
                         self._cv.notify_all()
-                    _send_msg(conn, pickle.dumps({"ok": True}))
-        except (ConnectionError, EOFError):
+                    conn.sendall(b"\x01")
+                elif op == _OP_NUM:
+                    with self._cv:
+                        n = len(self._kv)
+                    conn.sendall(b"\x01" + struct.pack("!Q", n))
+                else:
+                    return
+        except (ConnectionError, OSError):
             return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class _NativeServer:
+    """C++ store daemon (csrc/tcp_store.cc) via ctypes."""
+
+    def __init__(self, host, port):
+        import ctypes
+        from ..core import native
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        bound = ctypes.c_int(0)
+        self._h = lib.pt_store_server_start(host.encode(), port,
+                                            ctypes.byref(bound))
+        if not self._h:
+            raise OSError(f"cannot bind native store at {host}:{port}")
+        self.port = bound.value
+
+    def start(self):
+        pass  # accept thread already running in C++
+
+    def stop(self):
+        if self._h:
+            self._lib.pt_store_server_stop(self._h)
+            self._h = None
+
+    def num_keys(self):
+        return int(self._lib.pt_store_server_num_keys(self._h))
+
+
+def _decode(tag, val):
+    if tag == _TAG_I64 and len(val) == 8:
+        return struct.unpack("<q", val)[0]
+    if not val:
+        return None
+    return pickle.loads(val)
 
 
 class TCPStore:
+    """Client (+ optionally server) handle. Values are arbitrary picklable
+    objects; counter keys (touched by add()) are i64."""
+
     def __init__(self, host="127.0.0.1", port=0, is_master=False, world_size=1,
-                 timeout=300):
+                 timeout=300, use_native=None):
         self.world_size = world_size
         self.timeout = timeout
         self._server = None
         if is_master:
-            self._server = _StoreServer(host, port)
+            if use_native is None:
+                use_native = os.environ.get("PT_STORE_NATIVE", "1") == "1"
+            if use_native:
+                try:
+                    self._server = _NativeServer(host, port)
+                except (RuntimeError, OSError):
+                    self._server = None
+            if self._server is None:
+                self._server = _PyStoreServer(host, port)
             self._server.start()
             port = self._server.port
         self.host, self.port = host, port
@@ -115,35 +200,88 @@ class TCPStore:
         while True:
             try:
                 self._sock = socket.create_connection((host, port), timeout=5)
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 break
             except OSError:
                 if time.time() > deadline:
                     raise TimeoutError(f"cannot reach TCPStore at {host}:{port}")
                 time.sleep(0.2)
 
-    def _rpc(self, req):
-        with self._lock:
-            _send_msg(self._sock, pickle.dumps(req))
-            resp = pickle.loads(_recv_msg(self._sock))
-        if not resp.get("ok"):
-            raise TimeoutError(resp.get("error", "store error"))
-        return resp.get("value")
+    @property
+    def is_native_server(self):
+        return isinstance(self._server, _NativeServer)
 
+    # -- wire helpers ------------------------------------------------------
+    def _req(self, op, key, payload=b""):
+        kb = key.encode()
+        return bytes([op]) + struct.pack("!I", len(kb)) + kb + payload
+
+    # -- API ----------------------------------------------------------------
     def set(self, key, value):
-        self._rpc({"op": "set", "key": key, "value": value})
+        # plain ints store as i64 counters so set()+add() compose (the server's
+        # ADD does integer arithmetic on TAG_I64 entries only)
+        if type(value) is int and -(2 ** 63) <= value < 2 ** 63:
+            tag, data = _TAG_I64, struct.pack("<q", value)
+        else:
+            tag, data = _TAG_BYTES, pickle.dumps(value)
+        msg = self._req(_OP_SET, key,
+                        bytes([tag]) + struct.pack("!I", len(data)) + data)
+        with self._lock:
+            self._sock.sendall(msg)
+            ok = _recv_full(self._sock, 1)[0]
+        if not ok:
+            raise RuntimeError("store set failed")
 
     def get(self, key):
-        return self._rpc({"op": "get", "key": key})
+        with self._lock:
+            self._sock.sendall(self._req(_OP_GET, key))
+            ok = _recv_full(self._sock, 1)[0]
+            has = _recv_full(self._sock, 1)[0]
+            tag = _recv_full(self._sock, 1)[0]
+            (vlen,) = struct.unpack("!I", _recv_full(self._sock, 4))
+            val = _recv_full(self._sock, vlen) if vlen else b""
+        if not ok or not has:
+            return None
+        return _decode(tag, val)
 
     def wait(self, key, timeout=None):
-        return self._rpc({"op": "wait", "key": key,
-                          "timeout": timeout or self.timeout})
+        t = float(timeout or self.timeout)
+        with self._lock:
+            self._sock.sendall(self._req(_OP_WAIT, key, struct.pack("!d", t)))
+            # server blocks up to t; widen the socket timeout accordingly
+            old = self._sock.gettimeout()
+            self._sock.settimeout(t + 10)
+            try:
+                ok = _recv_full(self._sock, 1)[0]
+                tag = _recv_full(self._sock, 1)[0]
+                (vlen,) = struct.unpack("!I", _recv_full(self._sock, 4))
+                val = _recv_full(self._sock, vlen) if vlen else b""
+            finally:
+                self._sock.settimeout(old)
+        if not ok:
+            raise TimeoutError(f"wait({key!r}) timed out after {t}s")
+        return _decode(tag, val)
 
     def add(self, key, value=1):
-        return self._rpc({"op": "add", "key": key, "value": value})
+        with self._lock:
+            self._sock.sendall(self._req(_OP_ADD, key, struct.pack("!q", value)))
+            ok = _recv_full(self._sock, 1)[0]
+            (new,) = struct.unpack("!q", _recv_full(self._sock, 8))
+        if not ok:
+            raise RuntimeError("store add failed")
+        return new
 
     def delete(self, key):
-        self._rpc({"op": "delete", "key": key})
+        with self._lock:
+            self._sock.sendall(self._req(_OP_DEL, key))
+            _recv_full(self._sock, 1)
+
+    def num_keys(self):
+        with self._lock:
+            self._sock.sendall(self._req(_OP_NUM, ""))
+            _recv_full(self._sock, 1)
+            (n,) = struct.unpack("!Q", _recv_full(self._sock, 8))
+        return n
 
     def barrier(self, name="default", world_size=None, timeout=None):
         n = world_size or self.world_size

@@ -28,42 +28,29 @@ def _check_norm(norm):
     return norm or "backward"
 
 
-def _unary(jfn, name):
-    def op(x, n=None, axis=-1, norm="backward", name_arg=None):
+def _unary(jfn, op_name):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
         norm_ = _check_norm(norm)
 
         def fn(v):
             return jfn(v, n=n, axis=axis, norm=norm_)
 
-        return dispatch(fn, (x,), {}, name=name)
+        return dispatch(fn, (x,), {}, name=op_name)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
-def _nary(jfn, name):
-    def op(x, s=None, axes=None, norm="backward", name_arg=None):
+def _axes_op(jfn, op_name, default_axes=None):
+    def op(x, s=None, axes=default_axes, norm="backward", name=None):
         norm_ = _check_norm(norm)
 
         def fn(v):
             return jfn(v, s=s, axes=axes, norm=norm_)
 
-        return dispatch(fn, (x,), {}, name=name)
+        return dispatch(fn, (x,), {}, name=op_name)
 
-    op.__name__ = name
-    return op
-
-
-def _binary_axes(jfn, name, default_axes=(-2, -1)):
-    def op(x, s=None, axes=default_axes, norm="backward", name_arg=None):
-        norm_ = _check_norm(norm)
-
-        def fn(v):
-            return jfn(v, s=s, axes=axes, norm=norm_)
-
-        return dispatch(fn, (x,), {}, name=name)
-
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
@@ -74,10 +61,10 @@ irfft = _unary(jnp.fft.irfft, "irfft")
 hfft = _unary(jnp.fft.hfft, "hfft")
 ihfft = _unary(jnp.fft.ihfft, "ihfft")
 
-fft2 = _binary_axes(jnp.fft.fft2, "fft2")
-ifft2 = _binary_axes(jnp.fft.ifft2, "ifft2")
-rfft2 = _binary_axes(jnp.fft.rfft2, "rfft2")
-irfft2 = _binary_axes(jnp.fft.irfft2, "irfft2")
+fft2 = _axes_op(jnp.fft.fft2, "fft2", default_axes=(-2, -1))
+ifft2 = _axes_op(jnp.fft.ifft2, "ifft2", default_axes=(-2, -1))
+rfft2 = _axes_op(jnp.fft.rfft2, "rfft2", default_axes=(-2, -1))
+irfft2 = _axes_op(jnp.fft.irfft2, "irfft2", default_axes=(-2, -1))
 
 
 def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
@@ -106,10 +93,10 @@ def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
     return dispatch(fn, (x,), {}, name="ihfft2")
 
 
-fftn = _nary(jnp.fft.fftn, "fftn")
-ifftn = _nary(jnp.fft.ifftn, "ifftn")
-rfftn = _nary(jnp.fft.rfftn, "rfftn")
-irfftn = _nary(jnp.fft.irfftn, "irfftn")
+fftn = _axes_op(jnp.fft.fftn, "fftn")
+ifftn = _axes_op(jnp.fft.ifftn, "ifftn")
+rfftn = _axes_op(jnp.fft.rfftn, "rfftn")
+irfftn = _axes_op(jnp.fft.irfftn, "irfftn")
 
 
 def hfftn(x, s=None, axes=None, norm="backward", name=None):

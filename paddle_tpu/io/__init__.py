@@ -289,6 +289,9 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
+        self._use_shared_memory = use_shared_memory
+        self._worker_init_fn = worker_init_fn
+        self._timeout = timeout
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if self._iterable_ds:
             self.batch_sampler = None
@@ -327,10 +330,115 @@ class DataLoader:
                 yield self.dataset[i]
             return
         if self.num_workers > 0:
-            yield from self._gen_parallel()
+            if self._use_shared_memory and _shm_available():
+                yield from self._gen_workers()
+            else:
+                yield from self._gen_parallel()
             return
         for batch_idx in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in batch_idx])
+
+    def _gen_workers(self):
+        """Forked worker processes + native shared-memory ring transport.
+
+        Reference: the multiprocess DataLoader (dataloader_iter.py:368 — worker
+        subprocesses pushing batches through shared-memory queues). Workers
+        collate batches and push pickled host trees through one ShmChannel
+        (csrc/shm_channel.cc, MPSC with process-shared condvars); the trainer
+        pops, reorders by batch id, and rehydrates numpy leaves as Tensors.
+        """
+        import os
+        import pickle
+        import time
+        import traceback
+
+        from ..core.native import ShmChannel
+
+        batches = list(self.batch_sampler)
+        total = len(batches)
+        if total == 0:
+            return
+        nw = min(self.num_workers, total)
+        name = f"/pt_dl_{os.getpid()}_{id(self)}"
+        chan = ShmChannel(name, capacity=256 << 20)
+        pids = []
+        import warnings
+        try:
+            for w in range(nw):
+                with warnings.catch_warnings():
+                    # workers run pure numpy/pickle/libc — they never touch the
+                    # (multithreaded) jax runtime, so fork is safe here
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    pid = os.fork()
+                if pid == 0:  # worker
+                    code = 0
+                    try:
+                        wchan = ShmChannel(name, create=False)
+                        _set_worker_info(w, nw, self.dataset)
+                        if self._worker_init_fn is not None:
+                            self._worker_init_fn(w)
+                        for b in range(w, total, nw):
+                            samples = [self.dataset[i] for i in batches[b]]
+                            for s in samples:
+                                _assert_host_sample(s)
+                            data = self.collate_fn(samples)
+                            payload = pickle.dumps((b, _to_host(data)),
+                                                   protocol=4)
+                            wchan.push(payload)
+                    except BaseException:
+                        try:
+                            wchan.push(pickle.dumps(
+                                ("error", traceback.format_exc()), protocol=4))
+                        except BaseException:
+                            pass
+                        code = 1
+                    finally:
+                        os._exit(code)
+                pids.append(pid)
+
+            deadline = (time.monotonic() + self._timeout) if self._timeout \
+                else None  # timeout=0: wait forever (reference semantics)
+            pending = {}
+            next_id = 0
+            received = 0
+            while received < total:
+                # bounded pops so a SIGKILLed worker is noticed instead of a
+                # silent infinite wait
+                try:
+                    raw = chan.pop(timeout_ms=5000)
+                except TimeoutError:
+                    if deadline is not None and time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"DataLoader timed out after {self._timeout}s")
+                    alive = False
+                    for pid in list(pids):
+                        wpid, _ = os.waitpid(pid, os.WNOHANG)
+                        if wpid == 0:
+                            alive = True
+                        else:
+                            pids.remove(pid)
+                    if not alive and received < total:
+                        raise RuntimeError(
+                            "DataLoader workers exited without delivering all "
+                            f"batches ({received}/{total})")
+                    continue
+                obj = pickle.loads(raw)
+                if obj[0] == "error":
+                    raise RuntimeError(f"DataLoader worker failed:\n{obj[1]}")
+                bid, data = obj
+                received += 1
+                pending[bid] = data
+                while next_id in pending:
+                    yield _from_host(pending.pop(next_id))
+                    next_id += 1
+        finally:
+            chan.close()
+            for pid in pids:
+                try:
+                    os.waitpid(pid, 0)
+                except ChildProcessError:
+                    pass
+            chan.destroy()
 
     def _gen_parallel(self):
         """Thread-pool sample fetch (datasets in python are usually IO/np-bound, so
@@ -355,5 +463,70 @@ class DataLoader:
         return self._gen()
 
 
+def _shm_available():
+    try:
+        from ..core import native
+        return native.available()
+    except Exception:
+        return False
+
+
+def _assert_host_sample(obj):
+    """Forked workers must not touch device-backed values (XLA threads/locks
+    don't survive fork — materializing could deadlock); raise before collate
+    gets a chance to convert them."""
+    import jax
+    v = obj._value if isinstance(obj, Tensor) else obj
+    if isinstance(v, jax.Array):
+        raise RuntimeError(
+            "dataset __getitem__ returned a device-backed array; forked "
+            "DataLoader workers cannot touch the device — return numpy "
+            "arrays, or pass use_shared_memory=False to use threads")
+    if isinstance(obj, (list, tuple)):
+        for item in obj:
+            _assert_host_sample(item)
+    elif isinstance(obj, dict):
+        for item in obj.values():
+            _assert_host_sample(item)
+
+
+def _to_host(obj):
+    """Tensor leaves -> tagged numpy for cross-process pickling."""
+    if isinstance(obj, Tensor):
+        return ("__pt_tensor__", obj.numpy())
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_host(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    return obj
+
+
+def _from_host(obj):
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__pt_tensor__":
+        return Tensor(obj[1])
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_host(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _from_host(v) for k, v in obj.items()}
+    return obj
+
+
+class WorkerInfo:
+    def __init__(self, wid, num_workers, dataset):
+        self.id = wid
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info: WorkerInfo | None = None
+
+
+def _set_worker_info(wid, num_workers, dataset):
+    global _worker_info
+    _worker_info = WorkerInfo(wid, num_workers, dataset)
+
+
 def get_worker_info():
-    return None
+    """Inside a worker process: (id, num_workers, dataset); None in the trainer.
+    Reference: python/paddle/io/dataloader/worker.py get_worker_info."""
+    return _worker_info

@@ -224,13 +224,32 @@ class SparseCsrTensor:
 
     def _row_ids(self):
         crows = np.asarray(self._crows)
-        counts = np.diff(crows)
-        return np.repeat(np.arange(len(counts)), counts)
+        if crows.ndim == 1:
+            counts = np.diff(crows)
+            return np.repeat(np.arange(len(counts)), counts)
+        # batched CSR: crows (B, R+1), uniform nnz per batch (reference layout)
+        counts = np.diff(crows, axis=-1)  # (B, R)
+        per_batch = counts.sum(axis=1)
+        if not (per_batch == per_batch[0]).all():
+            raise ValueError("batched CSR requires equal nnz per batch")
+        nrows = counts.shape[1]
+        return np.stack([np.repeat(np.arange(nrows), c) for c in counts])
 
     def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
-        rows = jnp.asarray(self._row_ids(), dtype=jnp.int64)
-        idx = jnp.stack([rows, self._cols])
-        return SparseCooTensor(idx, self._values, self._shape, coalesced=True)
+        rows_np = self._row_ids()
+        cols = np.asarray(self._cols)
+        if rows_np.ndim == 1:
+            idx = np.stack([rows_np, cols])
+        else:
+            # (B, nnz_b) rows/cols -> 3-sparse-dim COO with a batch row
+            nb, nnz_b = rows_np.shape
+            batch = np.repeat(np.arange(nb), nnz_b)
+            idx = np.stack([batch, rows_np.reshape(-1), cols.reshape(-1)])
+        vals = self._values
+        if len(vals.shape) > 1 and rows_np.ndim > 1:
+            vals = dispatch(lambda v: v.reshape((-1,) + v.shape[2:]), (vals,), {},
+                            name="csr_batch_flatten")
+        return SparseCooTensor(idx, vals, self._shape, coalesced=True)
 
     def to_dense(self) -> Tensor:
         return self.to_sparse_coo().to_dense()
@@ -295,19 +314,19 @@ def _coo(x) -> SparseCooTensor:
 # unary ops (apply to values, sparsity preserved)
 # ---------------------------------------------------------------------------
 
-def _unary(jfn, name, needs_coalesce=False):
-    def op(x, name_arg=None):
+def _unary(jfn, op_name):
+    def op(x, name=None):
         csr = isinstance(x, SparseCsrTensor)
         xc = _coo(x)
 
         def fn(v):
             return jfn(v)
 
-        out_vals = dispatch(fn, (xc._values,), {}, name=f"sparse_{name}")
+        out_vals = dispatch(fn, (xc._values,), {}, name=f"sparse_{op_name}")
         out = SparseCooTensor(xc._indices, out_vals, xc._shape, xc._coalesced)
         return out.to_sparse_csr() if csr else out
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
@@ -368,12 +387,13 @@ def cast(x, index_dtype=None, value_dtype=None):
 # binary elementwise (union of sparsity patterns)
 # ---------------------------------------------------------------------------
 
-def _binary(jfn, name):
-    def op(x, y, name_arg=None):
+def _binary(jfn, op_name):
+    def op(x, y, name=None):
         csr = isinstance(x, SparseCsrTensor)
         if isinstance(y, Tensor) or np.isscalar(y):
             raise TypeError(
-                f"sparse.{name} requires two sparse tensors; use dense ops for mixed")
+                f"sparse.{op_name} requires two sparse tensors; "
+                "use dense ops for mixed")
         xc, yc = _coo(x).coalesce(), _coo(y).coalesce()
         if xc._shape != yc._shape:
             raise ValueError(f"shape mismatch: {xc._shape} vs {yc._shape}")
@@ -393,12 +413,13 @@ def _binary(jfn, name):
             ay = jnp.zeros((n,) + dense_shape, dtype=vy.dtype).at[ypos].set(vy)
             return jfn(ax, ay)
 
-        out_vals = dispatch(fn, (xc._values, yc._values), {}, name=f"sparse_{name}")
+        out_vals = dispatch(fn, (xc._values, yc._values), {},
+                            name=f"sparse_{op_name}")
         new_idx = np.stack(np.unravel_index(union, xc._shape[:sd]))
         out = SparseCooTensor(new_idx, out_vals, xc._shape, coalesced=True)
         return out.to_sparse_csr() if csr else out
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

@@ -115,6 +115,40 @@ class SyncBatchNorm(BatchNorm):
         return layer
 
 
+def _footprint_out_sites(idx, N, spatial_in, ks, stride, pad, dilation):
+    """All output sites whose window covers ≥1 active input site.
+
+    Shared by Conv3D and MaxPool3D: an output site o covers input c when
+    o*stride + off*dilation - pad == c for some off in [0, k); enumerate all
+    (site, off) pairs and keep in-range strided solutions.
+    """
+    out_spatial = []
+    for i in range(3):
+        eff_k = (ks[i] - 1) * dilation[i] + 1
+        out_spatial.append(
+            (spatial_in[i] + 2 * int(pad[i]) - eff_k) // stride[i] + 1)
+    offs = np.stack(np.meshgrid(
+        *[np.arange(k) * d for k, d in zip(ks, dilation)],
+        indexing="ij"), axis=-1).reshape(-1, 3)
+    coords = idx[1:4].T  # (nnz, 3)
+    pad_arr = np.asarray([int(p) for p in pad])
+    expanded = (coords[:, None, :] + pad_arr - offs[None, :, :])
+    batch = np.repeat(idx[0], offs.shape[0])
+    expanded = expanded.reshape(-1, 3)
+    stride_arr = np.asarray(stride)
+    valid = np.all(expanded % stride_arr == 0, axis=1)
+    outc = expanded // stride_arr
+    for i in range(3):
+        valid &= (outc[:, i] >= 0) & (outc[:, i] < out_spatial[i])
+    outc = outc[valid]
+    batch = batch[valid]
+    full = np.concatenate([batch[:, None], outc], axis=1)
+    flat = np.ravel_multi_index(full.T, (N,) + tuple(out_spatial))
+    uniq = np.unique(flat)
+    out_idx = np.stack(np.unravel_index(uniq, (N,) + tuple(out_spatial)))
+    return out_idx, tuple(out_spatial)
+
+
 def _dense_conv3d(v_dense, w, stride, padding, dilation, groups):
     # v_dense: (N, D, H, W, C) NDHWC; w: (kd, kh, kw, Cin/g, Cout)
     dn = jax.lax.conv_dimension_numbers(
@@ -172,36 +206,12 @@ class Conv3D(Layer):
         idx = np.asarray(xc._indices)  # (4, nnz): n, d, h, w
         N = xc._shape[0]
         spatial_in = xc._shape[1:4]
-        pad = self._padding if isinstance(self._padding, (list, tuple)) \
-            else [self._padding] * 3
-        out_spatial = []
-        for i in range(3):
-            eff_k = (self._ks[i] - 1) * self._dilation[i] + 1
-            out_spatial.append(
-                (spatial_in[i] + 2 * int(pad[i]) - eff_k) // self._stride[i] + 1)
         if self._subm:
             return idx, tuple(spatial_in)
-        # dilate each input site by the kernel footprint, keep valid strided sites
-        offs = np.stack(np.meshgrid(
-            *[np.arange(k) * d for k, d in zip(self._ks, self._dilation)],
-            indexing="ij"), axis=-1).reshape(-1, 3)
-        coords = idx[1:4].T  # (nnz, 3)
-        pad_arr = np.asarray([int(p) for p in pad])
-        expanded = (coords[:, None, :] + pad_arr - offs[None, :, :])
-        batch = np.repeat(idx[0], offs.shape[0])
-        expanded = expanded.reshape(-1, 3)
-        stride_arr = np.asarray(self._stride)
-        valid = np.all(expanded % stride_arr == 0, axis=1)
-        outc = expanded // stride_arr
-        for i in range(3):
-            valid &= (outc[:, i] >= 0) & (outc[:, i] < out_spatial[i])
-        outc = outc[valid]
-        batch = batch[valid]
-        full = np.concatenate([batch[:, None], outc], axis=1)
-        flat = np.ravel_multi_index(full.T, (N,) + tuple(out_spatial))
-        uniq = np.unique(flat)
-        out_idx = np.stack(np.unravel_index(uniq, (N,) + tuple(out_spatial)))
-        return out_idx, tuple(out_spatial)
+        pad = self._padding if isinstance(self._padding, (list, tuple)) \
+            else [self._padding] * 3
+        return _footprint_out_sites(idx, N, spatial_in, self._ks, self._stride,
+                                    pad, self._dilation)
 
     def forward(self, x):
         xc = _coo(x)
@@ -261,21 +271,9 @@ class MaxPool3D(Layer):
         shape = tuple(xc._shape)
         N, spatial_in, C = shape[0], shape[1:4], shape[4]
         pad = [int(p) for p in self._padding]
-        out_spatial = tuple(
-            (spatial_in[i] + 2 * pad[i] - self._ks[i]) // self._stride[i] + 1
-            for i in range(3))
         idx_np = np.asarray(xc._indices)
-        coords = idx_np[1:4].T
-        site = (coords + np.asarray(pad)) // np.asarray(self._stride)
-        within = np.all((coords + np.asarray(pad)) <
-                        (site * np.asarray(self._stride) + np.asarray(self._ks)),
-                        axis=1)
-        for i in range(3):
-            within &= site[:, i] < out_spatial[i]
-        full = np.concatenate([idx_np[0][within, None], site[within]], axis=1)
-        flat = np.ravel_multi_index(full.T, (N,) + out_spatial)
-        uniq = np.unique(flat)
-        out_idx = np.stack(np.unravel_index(uniq, (N,) + out_spatial))
+        out_idx, out_spatial = _footprint_out_sites(
+            idx_np, N, spatial_in, self._ks, self._stride, pad, (1, 1, 1))
         idx = jnp.asarray(xc._indices)
         oidx = jnp.asarray(out_idx)
         ks, stride = self._ks, self._stride

@@ -166,6 +166,27 @@ class TestSparseNN:
         np.testing.assert_array_equal(np.asarray(out._indices),
                                       np.asarray(st.coalesce()._indices))
 
+    def test_maxpool_overlapping_windows(self):
+        dense = np.zeros((1, 5, 5, 5, 2), "float32")
+        dense[0, 2, 2, 2] = [3., -1.]
+        nz = np.nonzero(dense.any(-1))
+        st = sp.sparse_coo_tensor(np.stack(nz), dense[nz], dense.shape)
+        out = sp.nn.MaxPool3D(kernel_size=3, stride=1)(st)
+        # every window covering the single voxel is active: 3^3
+        assert out.nnz() == 27
+        od = out.to_dense().numpy()
+        np.testing.assert_allclose(od[0, 0, 0, 0], [3., -1.])
+        np.testing.assert_allclose(od[0, 2, 2, 2], [3., -1.])
+
+    def test_batched_csr_roundtrip(self):
+        crows = np.array([[0, 1, 2], [0, 0, 2]])
+        cols = np.array([[1, 0], [0, 1]])
+        vals = np.array([[1., 2.], [3., 4.]], "float32")
+        c = sp.sparse_csr_tensor(crows, cols, vals, [2, 2, 2])
+        ref = np.zeros((2, 2, 2), "float32")
+        ref[0, 0, 1], ref[0, 1, 0], ref[1, 1, 0], ref[1, 1, 1] = 1, 2, 3, 4
+        np.testing.assert_allclose(c.to_dense().numpy(), ref)
+
     def test_maxpool_active_sites_only(self, rng):
         st, dense = _rand_sparse_ndhwc(rng)
         mp = sp.nn.MaxPool3D(kernel_size=2, stride=2)
