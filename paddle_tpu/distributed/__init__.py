@@ -29,3 +29,6 @@ from . import checkpoint  # noqa: F401
 from .auto_parallel_intermediate import parallelize  # noqa: F401
 from .sharding import group_sharded_parallel  # noqa: F401
 from .launch_utils import spawn  # noqa: F401
+from .watchdog import Watchdog, ErrorHandlingMode  # noqa: F401
+from .auto_tuner import AutoTuner  # noqa: F401
+from . import launch  # noqa: F401

@@ -1,0 +1,159 @@
+"""Auto-tuner — search over hybrid-parallel configurations.
+
+Reference: distributed/auto_tuner/tuner.py:21 AutoTuner (+ search.py grid,
+prune.py validity/memory pruning, cost_model.py) — searches (dp, mp, pp,
+sharding, micro-batch, recompute) by launching short profiling jobs.
+
+TPU-native: candidates are mesh factorizations; pruning uses an HBM model
+(sharded params/grads/optimizer state + activation working set); ranking uses
+an analytic step-time model (MXU compute + ICI collective traffic). A user
+`run_fn(cfg) -> seconds` measures the short-listed candidates for the final
+pick — on TPU a "profiling job" is one compiled step, no process launch needed.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+
+# v5e-ish defaults; overridable per Tuner
+DEFAULT_HW = {
+    "flops_per_chip": 197e12,      # bf16 peak
+    "hbm_bytes": 16e9,
+    "ici_bw": 4.5e10,              # bytes/s per link, one direction
+    "mfu_guess": 0.4,
+}
+
+
+class Candidate(dict):
+    @property
+    def degree(self):
+        return self["dp"] * self["mp"] * self["pp"]
+
+    def __repr__(self):
+        keys = ("dp", "mp", "pp", "sharding_stage", "micro_batch_size",
+                "use_recompute")
+        return "Candidate(" + ", ".join(f"{k}={self[k]}" for k in keys) + ")"
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class AutoTuner:
+    def __init__(self, num_devices, model_config, hw=None,
+                 tuner_cfg=None):
+        """model_config: dict with n_params, flops_per_sample (fwd),
+        bytes_per_param (2 bf16 / 4 fp32), activation_bytes_per_sample,
+        global_batch_size, n_layers."""
+        self.num_devices = num_devices
+        self.model = dict(model_config)
+        self.hw = {**DEFAULT_HW, **(hw or {})}
+        cfg = tuner_cfg or {}
+        self.candidate_space = {
+            "mp": cfg.get("mp_degree") or _divisors(num_devices),
+            "pp": cfg.get("pp_degree") or _divisors(num_devices),
+            "sharding_stage": cfg.get("sharding_stage") or [0, 1, 2, 3],
+            "micro_batch_size": cfg.get("micro_batch_size") or
+                [1, 2, 4, 8, 16],
+            "use_recompute": cfg.get("use_recompute")
+                if cfg.get("use_recompute") is not None else [False, True],
+        }
+
+    # -- enumeration (search.py analog) -------------------------------------
+    def enumerate(self):
+        out = []
+        gbs = self.model["global_batch_size"]
+        for mp, pp in itertools.product(self.candidate_space["mp"],
+                                        self.candidate_space["pp"]):
+            if self.num_devices % (mp * pp):
+                continue
+            dp = self.num_devices // (mp * pp)
+            if gbs % dp:
+                continue
+            per_dp = gbs // dp
+            for mbs, st, rc in itertools.product(
+                    self.candidate_space["micro_batch_size"],
+                    self.candidate_space["sharding_stage"],
+                    self.candidate_space["use_recompute"]):
+                if per_dp % mbs:
+                    continue
+                if pp > 1 and per_dp // mbs < pp:
+                    continue  # too few micro-batches to fill the pipeline
+                if st > 0 and dp == 1:
+                    continue  # nothing to shard over
+                out.append(Candidate(
+                    dp=dp, mp=mp, pp=pp, sharding_stage=st,
+                    micro_batch_size=mbs, use_recompute=rc,
+                    acc_steps=per_dp // mbs))
+        return out
+
+    # -- memory model (prune.py analog) --------------------------------------
+    def memory_bytes(self, c):
+        m = self.model
+        p_shard = m["n_params"] / (c["mp"] * c["pp"])
+        bpp = m.get("bytes_per_param", 2)
+        # params + grads (+ fp32 master/moments = 12B/param for adam)
+        params = p_shard * bpp
+        grads = p_shard * bpp
+        opt = p_shard * 12.0
+        if c["sharding_stage"] >= 1:
+            opt /= c["dp"]
+        if c["sharding_stage"] >= 2:
+            grads /= c["dp"]
+        if c["sharding_stage"] >= 3:
+            params /= c["dp"]
+        act = m.get("activation_bytes_per_sample", 0) * c["micro_batch_size"] \
+            / (c["mp"] * c["pp"])
+        if c["use_recompute"]:
+            act /= max(math.sqrt(m.get("n_layers", 1)), 1.0)
+        if c["pp"] > 1:
+            act *= min(c["pp"], c["acc_steps"])  # in-flight micro-batches
+        return params + grads + opt + act
+
+    def prune(self, candidates=None):
+        cands = candidates if candidates is not None else self.enumerate()
+        cap = self.hw["hbm_bytes"] * 0.9
+        return [c for c in cands if self.memory_bytes(c) <= cap]
+
+    # -- analytic cost model (cost_model.py analog) ---------------------------
+    def step_time(self, c):
+        m, hw = self.model, self.hw
+        samples = m["global_batch_size"] / c["dp"]  # per DP replica
+        flops = 3.0 * m["flops_per_sample"] * samples  # fwd + 2x bwd
+        if c["use_recompute"]:
+            flops *= 4.0 / 3.0
+        # the replica's flops are spread over its mp*pp chips
+        compute = flops / (c["mp"] * c["pp"] *
+                           hw["flops_per_chip"] * hw["mfu_guess"])
+        bpp = m.get("bytes_per_param", 2)
+        p_shard = m["n_params"] / (c["mp"] * c["pp"])
+        comm = 0.0
+        if c["dp"] > 1:  # grad allreduce (ring): 2(n-1)/n
+            comm += 2 * (c["dp"] - 1) / c["dp"] * p_shard * bpp / hw["ici_bw"]
+        if c["mp"] > 1:  # TP activation collectives ~ 4 allgathers/layer
+            act = m.get("activation_bytes_per_sample", 0) * \
+                c["micro_batch_size"] / c["mp"]
+            comm += 4 * m.get("n_layers", 1) * act * \
+                (c["mp"] - 1) / c["mp"] / hw["ici_bw"] * c.get("acc_steps", 1)
+        bubble = 0.0
+        if c["pp"] > 1:  # 1F1B bubble fraction
+            bubble = (c["pp"] - 1) / max(c["acc_steps"], 1) * compute
+        return compute + comm + bubble
+
+    # -- search (tuner.py analog) --------------------------------------------
+    def tune(self, run_fn=None, top_k=3):
+        """Rank pruned candidates by the cost model; if run_fn is given,
+        measure the top_k and return the fastest measured config."""
+        cands = self.prune()
+        if not cands:
+            raise RuntimeError("no candidate fits in HBM — reduce model or "
+                               "batch, or add devices")
+        ranked = sorted(cands, key=self.step_time)
+        if run_fn is None:
+            return ranked[0], ranked[:top_k]
+        best, best_t = None, float("inf")
+        for c in ranked[:top_k]:
+            t = run_fn(c)
+            if t < best_t:
+                best, best_t = c, t
+        return best, ranked[:top_k]

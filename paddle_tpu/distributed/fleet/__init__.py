@@ -157,3 +157,4 @@ from .context_parallel import (  # noqa: E402,F401
     ring_flash_attention, ulysses_flash_attention, ContextParallelAttention,
     shard_zigzag, unshard_zigzag,
 )
+from .elastic import ElasticManager, ElasticStatus  # noqa: E402,F401

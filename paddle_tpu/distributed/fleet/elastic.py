@@ -1,0 +1,116 @@
+"""Elastic training manager — node membership + relaunch policy.
+
+Reference: ElasticManager (fleet/elastic/manager.py:125) — etcd leases/watches
+for node registry (manager.py:234-261), fault-tolerant same-size restarts and
+scale-in/out, relaunching the local trainer with re-ranked env.
+
+TPU-native: the registry rides the TCPStore (native daemon) instead of etcd —
+each node heartbeats a lease key; the manager watches membership, and on change
+computes the new (nnodes, node_rank) and invokes the relaunch callback. Actual
+device-mesh reshaping is the trainer's job on restart (jax.distributed picks up
+the new env).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+
+class ElasticStatus:
+    HOLD = "hold"        # membership stable, job running
+    RESTART = "restart"  # membership changed, relaunch with new ranks
+    EXIT = "exit"        # scaled below min, stop
+
+
+class ElasticManager:
+    def __init__(self, store, node_id=None, lease_ttl=10.0, min_nodes=1,
+                 max_nodes=None, on_change=None, prefix="__elastic"):
+        self.store = store
+        self.node_id = node_id or uuid.uuid4().hex[:12]
+        self.lease_ttl = lease_ttl
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.on_change = on_change
+        self.prefix = prefix
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._watch_thread = None
+        self.status = ElasticStatus.HOLD
+        self._members: list[str] = []
+
+    # -- registry -----------------------------------------------------------
+    def _register(self):
+        # registration order comes from the store's atomic counter; each node
+        # owns its private slot key, so concurrent joins cannot clobber each
+        # other (no list read-modify-write)
+        self.store.set(f"{self.prefix}/node/{self.node_id}", time.time())
+        slot = self.store.add(f"{self.prefix}/seq", 1) - 1
+        self.store.set(f"{self.prefix}/slot/{slot}", self.node_id)
+
+    def _heartbeat(self):
+        while not self._stop.wait(self.lease_ttl / 3):
+            self.store.set(f"{self.prefix}/node/{self.node_id}", time.time())
+
+    def alive_nodes(self) -> list[str]:
+        """Registered nodes with a fresh lease, in stable registration order."""
+        now = time.time()
+        n_slots = self.store.get(f"{self.prefix}/seq") or 0
+        alive = []
+        for slot in range(n_slots):
+            nid = self.store.get(f"{self.prefix}/slot/{slot}")
+            if nid is None or nid in alive:
+                continue
+            ts = self.store.get(f"{self.prefix}/node/{nid}")
+            if ts is not None and now - ts <= self.lease_ttl:
+                alive.append(nid)
+        return alive
+
+    def node_rank(self) -> int:
+        """Rank among live nodes, or -1 when this node's own lease has lapsed
+        (matches the -1 the on_change payload uses for an evicted node)."""
+        alive = self.alive_nodes()
+        return alive.index(self.node_id) if self.node_id in alive else -1
+
+    # -- watch loop ---------------------------------------------------------
+    def _watch(self):
+        while not self._stop.wait(self.lease_ttl / 2):
+            alive = self.alive_nodes()
+            if alive != self._members:
+                old, self._members = self._members, alive
+                if len(alive) < self.min_nodes:
+                    self.status = ElasticStatus.EXIT
+                else:
+                    self.status = ElasticStatus.RESTART
+                if self.on_change is not None:
+                    self.on_change({"old": old, "new": alive,
+                                    "status": self.status,
+                                    "node_rank": (alive.index(self.node_id)
+                                                  if self.node_id in alive
+                                                  else -1)})
+
+    def start(self):
+        self._register()
+        self._members = self.alive_nodes()
+        self._hb_thread = threading.Thread(target=self._heartbeat, daemon=True)
+        self._watch_thread = threading.Thread(target=self._watch, daemon=True)
+        self._hb_thread.start()
+        self._watch_thread.start()
+        return self
+
+    def stop(self, deregister=True):
+        self._stop.set()
+        for t in (self._hb_thread, self._watch_thread):
+            if t:
+                t.join(timeout=5)
+        if deregister:
+            # dropping the lease is enough — alive_nodes() filters dead leases;
+            # the slot entry stays (stable ordering for any rejoin history)
+            self.store.delete(f"{self.prefix}/node/{self.node_id}")
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,10 @@
+"""paddle.distributed.launch analog — multi-process/multi-node job launcher.
+
+Reference: python/paddle/distributed/launch/ (main.py:23 entry,
+controllers/collective.py:37 build_pod, controllers/master.py rank-0 KV master,
+job/{job,pod,container}.py process model). TPU-native: the master is the native
+TCPStore daemon (csrc/tcp_store.cc) instead of an HTTP/etcd service; on TPU pods
+the normal topology is ONE process per host addressing all local chips, with
+`jax.distributed.initialize` driven by the env this launcher fabricates.
+"""
+from .controller import Controller, launch  # noqa: F401

@@ -1,0 +1,39 @@
+"""CLI: python -m paddle_tpu.distributed.launch [opts] script.py [script args].
+
+Reference: python -m paddle.distributed.launch (launch/main.py:23).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .controller import Controller
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a multi-process (multi-node) training job.")
+    parser.add_argument("--nproc_per_node", type=int, default=1,
+                        help="trainer processes on this node (TPU: usually 1)")
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--node_rank", type=int, default=None,
+                        help="this node's rank; omit for store-assigned")
+    parser.add_argument("--master", type=str, default=None,
+                        help="host:port of the rank-0 store master")
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("--max_restarts", type=int, default=0)
+    parser.add_argument("training_script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    ctl = Controller(
+        args.training_script, args.script_args,
+        nproc_per_node=args.nproc_per_node, nnodes=args.nnodes,
+        node_rank=args.node_rank, master=args.master, log_dir=args.log_dir,
+        max_restarts=args.max_restarts)
+    sys.exit(ctl.run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,180 @@
+"""Launcher controller — pod/container process model over the TCPStore master.
+
+Reference mapping (SURVEY.md §2.7 Launcher):
+  build_pod (launch/controllers/collective.py:37)  -> Controller._build_pod
+  HTTPMaster/ETCDMaster (controllers/master.py)    -> TCPStore master
+  Container/Pod (launch/job/)                      -> _Container / Controller
+  watcher (controllers/watcher.py)                 -> Controller._monitor
+Per-rank env contract matches the reference: PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_MASTER (+ PADDLE_LOCAL_RANK, PADDLE_NNODES).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ..store import TCPStore
+from ..launch_utils import _free_port
+
+
+class _Container:
+    """One trainer process (reference: launch/job/container.py)."""
+
+    def __init__(self, cmd, env, log_path):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc = None
+        self._log_f = None
+
+    def start(self):
+        if self.log_path:
+            os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+            self._log_f = open(self.log_path, "ab")
+            out = self._log_f
+        else:
+            out = None
+        self.proc = subprocess.Popen(self.cmd, env=self.env, stdout=out,
+                                     stderr=subprocess.STDOUT if out else None)
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def exit_code(self):
+        return None if self.proc is None else self.proc.poll()
+
+    def terminate(self, grace=10.0):
+        if self.alive():
+            self.proc.send_signal(signal.SIGTERM)
+            deadline = time.time() + grace
+            while self.alive() and time.time() < deadline:
+                time.sleep(0.1)
+            if self.alive():
+                self.proc.kill()
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None
+
+
+class Controller:
+    """Builds the pod for this node and supervises its containers."""
+
+    def __init__(self, training_script, script_args=(), nproc_per_node=1,
+                 nnodes=1, node_rank=None, master=None, log_dir=None,
+                 max_restarts=0, python_exec=None):
+        self.training_script = training_script
+        self.script_args = list(script_args)
+        self.nproc_per_node = nproc_per_node
+        self.nnodes = nnodes
+        self.log_dir = log_dir
+        self.max_restarts = max_restarts
+        self.python = python_exec or sys.executable
+        self.containers: list[_Container] = []
+        self._restarts = 0
+
+        if master is None:
+            master = f"127.0.0.1:{_free_port()}"
+            self._is_master_node = True
+            self.node_rank = 0 if node_rank is None else node_rank
+        elif node_rank is not None:
+            self._is_master_node = (node_rank == 0)
+            self.node_rank = node_rank
+        else:
+            # dynamic ranks: whichever node can bind the master address hosts
+            # the store (first-wins, like the reference's HTTPMaster on rank 0)
+            self._is_master_node = None
+            self.node_rank = None
+        self.master = master
+
+        host, _, port = master.partition(":")
+        if self._is_master_node is None:
+            try:
+                self.store = TCPStore(host, int(port), is_master=True,
+                                      world_size=nnodes)
+                self._is_master_node = True
+            except OSError:
+                self.store = TCPStore(host, int(port), is_master=False,
+                                      world_size=nnodes)
+                self._is_master_node = False
+        else:
+            self.store = TCPStore(host, int(port),
+                                  is_master=self._is_master_node,
+                                  world_size=nnodes)
+        if self.node_rank is None:
+            # dynamic rank assignment through the store (ETCDMaster analog)
+            self.node_rank = self.store.add("__launch/node_seq", 1) - 1
+
+    # -- pod construction ---------------------------------------------------
+    def _build_pod(self):
+        world = self.nnodes * self.nproc_per_node
+        self.containers = []
+        for local in range(self.nproc_per_node):
+            rank = self.node_rank * self.nproc_per_node + local
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_LOCAL_RANK": str(local),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_NNODES": str(self.nnodes),
+                "PADDLE_MASTER": self.master,
+            })
+            # scripts outside the framework checkout must still import it:
+            # prepend the launcher's import root to the workers' PYTHONPATH
+            pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+            parts = [pkg_root, env.get("PYTHONPATH", "")]
+            env["PYTHONPATH"] = os.pathsep.join(p for p in parts if p)
+            log = (os.path.join(self.log_dir, f"workerlog.{rank}")
+                   if self.log_dir else None)
+            cmd = [self.python, self.training_script] + self.script_args
+            self.containers.append(_Container(cmd, env, log))
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self._build_pod()
+        for c in self.containers:
+            c.start()
+
+    def stop(self):
+        for c in self.containers:
+            c.terminate()
+
+    def _monitor(self, poll_interval=0.5):
+        """Supervise until success, failure (kill pod), or restart budget."""
+        while True:
+            codes = [c.exit_code for c in self.containers]
+            if all(code == 0 for code in codes):
+                return 0
+            failed = [i for i, code in enumerate(codes)
+                      if code not in (None, 0)]
+            if failed:
+                self.stop()
+                if self._restarts < self.max_restarts:
+                    self._restarts += 1
+                    self.start()
+                    continue
+                first = self.containers[failed[0]]
+                tail = ""
+                if first.log_path and os.path.exists(first.log_path):
+                    with open(first.log_path, "rb") as f:
+                        tail = f.read()[-4096:].decode(errors="replace")
+                raise RuntimeError(
+                    f"rank {failed[0]} exited with code {codes[failed[0]]}\n"
+                    f"--- log tail ---\n{tail}")
+            time.sleep(poll_interval)
+
+    def run(self):
+        self.start()
+        try:
+            return self._monitor()
+        finally:
+            self.stop()
+
+
+def launch(training_script, script_args=(), **kwargs):
+    """Programmatic entry — returns the exit status (0 on success)."""
+    return Controller(training_script, script_args, **kwargs).run()

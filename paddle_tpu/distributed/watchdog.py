@@ -1,0 +1,100 @@
+"""Collective watchdog — cluster-wide hang detection.
+
+Reference: CommTaskManager (phi/core/distributed/comm_task_manager.h:37) —
+background threads track in-flight collective progress, time out hung ops
+(comm_task_manager.cc:273), publish per-rank traces into the Store so the
+slowest/hung rank is identifiable cluster-wide, with ErrorHandlingMode
+{NoHandling, TearDown}.
+
+TPU-native: XLA collectives are compiled into the step, so per-op tracking
+becomes per-STEP tracking — each rank ticks a step counter into the TCPStore;
+the watchdog thread compares all ranks' progress and ages, flags ranks whose
+heartbeat stalls past `timeout`, and (TearDown mode) aborts the process so the
+launcher/elastic layer can relaunch.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class ErrorHandlingMode:
+    NoHandling = "no_handling"
+    TearDown = "tear_down"
+
+
+class Watchdog:
+    def __init__(self, store, rank, world_size, timeout=300.0,
+                 mode=ErrorHandlingMode.NoHandling, on_hang=None,
+                 poll_interval=None, prefix="__watchdog"):
+        self.store = store
+        self.rank = rank
+        self.world_size = world_size
+        self.timeout = timeout
+        self.mode = mode
+        self.on_hang = on_hang
+        self.prefix = prefix
+        self._poll = poll_interval or max(min(timeout / 4, 10.0), 0.05)
+        self._step = 0
+        self._stop = threading.Event()
+        self._thread = None
+        self.hung_ranks: list[int] = []
+
+    # -- producer side ------------------------------------------------------
+    def tick(self, step=None):
+        """Call once per train step (cheap: one store write)."""
+        self._step = self._step + 1 if step is None else step
+        self.store.set(f"{self.prefix}/{self.rank}",
+                       {"step": self._step, "ts": time.time()})
+
+    # -- monitor side -------------------------------------------------------
+    def _scan(self):
+        now = time.time()
+        hung = []
+        progress = {}
+        for r in range(self.world_size):
+            ent = self.store.get(f"{self.prefix}/{r}")
+            if ent is None:
+                continue  # not started yet
+            progress[r] = ent["step"]
+            if now - ent["ts"] > self.timeout:
+                hung.append(r)
+        return hung, progress
+
+    def _run(self):
+        reported: set[int] = set()
+        while not self._stop.wait(self._poll):
+            hung, progress = self._scan()
+            self.hung_ranks = hung  # cleared automatically on recovery
+            new = [r for r in hung if r not in reported]
+            reported = set(hung)
+            if new:  # edge-triggered: fire once per incident, not per poll
+                trace = {"hung": hung, "progress": progress,
+                         "reporter": self.rank, "ts": time.time()}
+                self.store.set(f"{self.prefix}/report", trace)
+                if self.on_hang is not None:
+                    self.on_hang(trace)
+                if self.mode == ErrorHandlingMode.TearDown:
+                    os._exit(124)  # launcher sees the failure and relaunches
+
+    def start(self):
+        self.tick(0)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def last_report(self):
+        return self.store.get(f"{self.prefix}/report")
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,162 @@
+"""paddle.inference analog — deployment Predictor API.
+
+Reference: paddle/fluid/inference AnalysisPredictor
+(api/analysis_predictor.h:101 — load saved model → IR pass pipeline → executor,
+zero-copy input/output handles, Config with optimization toggles).
+
+TPU-native: "analysis passes + engine" is XLA — a saved `jax.export` artifact
+(paddle_tpu.static.save_inference_model) deserializes to an AOT-compiled
+callable; the Predictor owns input binding, device placement, and compiled-call
+reuse. No interpreter, no pass pipeline to maintain: the serialized StableHLO
+IS the optimized program.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "float16"
+    Int8 = "int8"
+
+
+class Config:
+    """Reference: paddle_infer::Config (api/paddle_analysis_config.h)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # paddle packs both into one artifact; we accept either arg as prefix
+        self.model_path = prog_file or params_file
+        self._device = "tpu"
+        self._precision = PrecisionType.Float32
+        self._enable_profile = False
+        self._memory_optim = True
+
+    def set_model(self, prog_file, params_file=None):
+        self.model_path = prog_file
+
+    def model_dir(self):
+        return self.model_path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        # GPU knob maps to the accelerator backend (TPU here)
+        self._device = "tpu"
+        self._precision = precision
+
+    def enable_tpu(self, precision=PrecisionType.Bfloat16):
+        self._device = "tpu"
+        self._precision = precision
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "tpu"
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes; kept for API parity
+
+    def summary(self):
+        return (f"Config(model={self.model_path}, device={self._device}, "
+                f"precision={self._precision})")
+
+
+class _IOHandle:
+    """Zero-copy-style tensor handle (reference: paddle_infer::Tensor,
+    api/paddle_tensor.h)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(np.asarray(self._value).shape)
+
+
+class Predictor:
+    """Reference: paddle_infer::Predictor (AnalysisPredictor)."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        path = config.model_path
+        if path is None or not (os.path.exists(path)
+                                or os.path.exists(path + ".pdmodel")):
+            raise FileNotFoundError(f"inference model not found: {path}")
+        from ..static import load_inference_model
+        self._fn, self._meta = load_inference_model(path, _return_meta=True)
+        self._input_names = list(self._meta.get("feed_names", []))
+        self._output_names = list(self._meta.get("fetch_names", []))
+        self._inputs = {n: _IOHandle(n) for n in self._input_names}
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        """Either positional (list of arrays → list of arrays, the modern
+        paddle_infer.Predictor.run) or via bound handles."""
+        if inputs is not None:
+            args = [a.numpy() if isinstance(a, Tensor) else np.asarray(a)
+                    for a in inputs]
+        else:
+            args = [self._inputs[n]._value for n in self._input_names]
+            missing = [n for n, a in zip(self._input_names, args) if a is None]
+            if missing:
+                raise RuntimeError(f"inputs not bound: {missing}")
+        outs = self._fn(*args)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        outs = [np.asarray(o) for o in outs]
+        names = self._output_names or [f"fetch_{i}" for i in range(len(outs))]
+        self._outputs = {}
+        for n, o in zip(names, outs):
+            h = _IOHandle(n)
+            h._value = o
+            self._outputs[n] = h
+        if inputs is not None:
+            return outs
+        return True
+
+    def try_shrink_memory(self):
+        jax.clear_caches()
+
+    def clone(self):
+        return Predictor(self.config)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

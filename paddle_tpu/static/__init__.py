@@ -198,7 +198,9 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
 
     args = [jnp.zeros(v.shape, v._value.dtype) for v in feed_vars]
     payload = {"feed_names": names,
-               "feed_specs": [(v.shape, str(np.dtype(v.dtype))) for v in feed_vars]}
+               "feed_specs": [(v.shape, str(np.dtype(v.dtype))) for v in feed_vars],
+               "fetch_names": [getattr(v, "name", None) or f"fetch_{i}"
+                               for i, v in enumerate(fetch_vars)]}
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
     try:
         from jax import export as jax_export
@@ -214,9 +216,14 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     return path_prefix + ".pdmodel"
 
 
-def load_inference_model(path_prefix, executor=None, **kwargs):
-    """Load a saved inference graph; returns (program, feed_names, fetch_fn)."""
-    with open(path_prefix + ".pdmodel", "rb") as f:
+def load_inference_model(path_prefix, executor=None, _return_meta=False,
+                         **kwargs):
+    """Load a saved inference graph; returns (program, feed_names, fetch_fn),
+    or (fetch_fn, payload_meta) when _return_meta=True (paddle.inference path)."""
+    path = path_prefix
+    if not path.endswith(".pdmodel"):
+        path = path_prefix + ".pdmodel"
+    with open(path, "rb") as f:
         payload = pickle.load(f)
     names = payload["feed_names"]
     if payload.get("format") == "jax_export":
@@ -225,6 +232,9 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 
         def fetch_fn(*vals):
             return exported.call(*[jnp.asarray(v) for v in vals])
+
+        if _return_meta:
+            return fetch_fn, payload
         return Program(), names, fetch_fn
     raise RuntimeError("model was saved without jax.export support")
 

@@ -1,0 +1,176 @@
+"""Launcher / elastic / watchdog / auto-tuner tests.
+
+Mirrors the reference's local-subprocess cluster trick (SURVEY.md §4) for the
+launcher, and pure-metadata tests for the tuner's prune/cost layers (like the
+reference's spmd-rule unit tests that never touch comm).
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.launch import Controller
+from paddle_tpu.distributed.watchdog import Watchdog, ErrorHandlingMode
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.auto_tuner import AutoTuner
+
+
+@pytest.fixture
+def script(tmp_path):
+    p = tmp_path / "train.py"
+    p.write_text(
+        "import os, sys\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "world = os.environ['PADDLE_TRAINERS_NUM']\n"
+        "print(f'hello from rank {rank}/{world}', flush=True)\n"
+        "if '--fail' in sys.argv and rank == '1':\n"
+        "    sys.exit(3)\n")
+    return str(p)
+
+
+class TestLauncher:
+    def test_single_node_two_procs(self, script, tmp_path):
+        log_dir = str(tmp_path / "logs")
+        ctl = Controller(script, nproc_per_node=2, log_dir=log_dir)
+        assert ctl.run() == 0
+        logs = sorted(os.listdir(log_dir))
+        assert logs == ["workerlog.0", "workerlog.1"]
+        text0 = open(os.path.join(log_dir, "workerlog.0")).read()
+        assert "hello from rank 0/2" in text0
+
+    def test_failure_surfaces_log_tail(self, script, tmp_path):
+        ctl = Controller(script, script_args=["--fail"], nproc_per_node=2,
+                         log_dir=str(tmp_path / "logs"))
+        with pytest.raises(RuntimeError, match="exited with code 3"):
+            ctl.run()
+
+    def test_restart_budget(self, script, tmp_path):
+        ctl = Controller(script, script_args=["--fail"], nproc_per_node=2,
+                         log_dir=str(tmp_path / "logs"), max_restarts=1)
+        with pytest.raises(RuntimeError):
+            ctl.run()
+        assert ctl._restarts == 1
+
+    def test_cli_module(self, script, tmp_path):
+        import subprocess
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", str(tmp_path / "l"), script],
+            cwd="/root/repo", capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr
+
+
+@pytest.mark.skipif(not native.available(), reason="native runtime unavailable")
+class TestWatchdog:
+    def test_detects_hung_rank(self):
+        st = TCPStore(is_master=True)
+        try:
+            events = []
+            wd0 = Watchdog(st, rank=0, world_size=2, timeout=0.5,
+                           on_hang=events.append, poll_interval=0.1)
+            # rank 1 ticks once then goes silent
+            st.set("__watchdog/1", {"step": 3, "ts": time.time()})
+            with wd0:
+                for step in range(12):
+                    wd0.tick(step)
+                    time.sleep(0.1)
+            assert events and 1 in events[0]["hung"]
+            assert events[0]["progress"][1] == 3
+            assert st.get("__watchdog/report")["hung"] == [1]
+        finally:
+            st._server.stop()
+
+    def test_healthy_ranks_no_report(self):
+        st = TCPStore(is_master=True)
+        try:
+            wd = Watchdog(st, rank=0, world_size=1, timeout=5.0,
+                          poll_interval=0.1)
+            with wd:
+                for step in range(5):
+                    wd.tick(step)
+                    time.sleep(0.05)
+            assert wd.last_report() is None
+        finally:
+            st._server.stop()
+
+
+@pytest.mark.skipif(not native.available(), reason="native runtime unavailable")
+class TestElastic:
+    def test_membership_and_restart_signal(self):
+        st = TCPStore(is_master=True)
+        try:
+            events_a = []
+            a = ElasticManager(st, node_id="nodeA", lease_ttl=0.6,
+                               on_change=events_a.append).start()
+            assert a.alive_nodes() == ["nodeA"]
+            b = ElasticManager(st, node_id="nodeB", lease_ttl=0.6).start()
+            deadline = time.time() + 10
+            while not events_a and time.time() < deadline:
+                time.sleep(0.05)
+            assert events_a and events_a[0]["new"] == ["nodeA", "nodeB"]
+            assert events_a[0]["status"] == ElasticStatus.RESTART
+            assert a.node_rank() == 0 and b.node_rank() == 1
+            # node B dies (stop heartbeating) -> membership shrinks
+            b.stop(deregister=False)
+            deadline = time.time() + 10
+            while len(events_a) < 2 and time.time() < deadline:
+                time.sleep(0.05)
+            assert events_a[-1]["new"] == ["nodeA"]
+            a.stop()
+        finally:
+            st._server.stop()
+
+
+class TestAutoTuner:
+    def _tuner(self, n_dev=8):
+        # ~1B-param model
+        return AutoTuner(
+            n_dev,
+            model_config=dict(
+                n_params=1e9, flops_per_sample=2 * 1e9 * 2048,
+                bytes_per_param=2, activation_bytes_per_sample=64e6,
+                global_batch_size=64, n_layers=24))
+
+    def test_enumerate_respects_divisibility(self):
+        cands = self._tuner().enumerate()
+        assert cands
+        for c in cands:
+            assert c.degree == 8
+            assert 64 % c["dp"] == 0
+            assert (64 // c["dp"]) % c["micro_batch_size"] == 0
+
+    def test_prune_memory(self):
+        t = self._tuner()
+        kept = t.prune()
+        assert kept
+        cap = t.hw["hbm_bytes"] * 0.9
+        for c in kept:
+            assert t.memory_bytes(c) <= cap
+        # pure-DP unsharded 1B-param adam (16 GB of state) must be pruned
+        assert not any(c["dp"] == 8 and c["sharding_stage"] == 0
+                       for c in kept)
+
+    def test_cost_model_prefers_fewer_bubbles(self):
+        t = self._tuner()
+        base = dict(dp=1, mp=8, pp=1, sharding_stage=0, micro_batch_size=1,
+                    use_recompute=False, acc_steps=64)
+        from paddle_tpu.distributed.auto_tuner import Candidate
+        no_pp = Candidate(**base)
+        deep_pp = Candidate(**{**base, "mp": 1, "pp": 8, "acc_steps": 2})
+        assert t.step_time(no_pp) < t.step_time(deep_pp)
+
+    def test_tune_with_run_fn(self):
+        t = self._tuner()
+        measured = []
+
+        def run_fn(c):
+            measured.append(c)
+            return 1.0 if c["mp"] == 1 else 2.0
+
+        best, short = t.tune(run_fn=run_fn, top_k=3)
+        assert len(measured) == 3
+        assert best in short
