@@ -1,0 +1,15 @@
+"""paddle.audio analog — windows, spectral features, feature layers.
+
+Reference: python/paddle/audio/ (functional/window.py get_window,
+functional/functional.py hz_to_mel/mel_to_hz/compute_fbank_matrix/power_to_db/
+create_dct, features/layers.py Spectrogram/MelSpectrogram/LogMelSpectrogram/
+MFCC). TPU-native: everything lowers to the stft in paddle_tpu.signal (XLA FFT)
+plus dense matmuls for the mel filterbank / DCT — MXU-friendly by construction.
+"""
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC,
+)
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
+           "MFCC"]

@@ -1,0 +1,101 @@
+"""paddle.audio.features analog — Spectrogram / MelSpectrogram / MFCC layers.
+
+Reference: python/paddle/audio/features/layers.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import dispatch
+from ..nn.layer_base import Layer
+from ..signal import stft
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer("window",
+                             AF.get_window(window, self.win_length, dtype=dtype))
+
+    def forward(self, x):
+        spec = stft(x, self.n_fft, self.hop_length, self.win_length,
+                    window=self.window, center=self.center,
+                    pad_mode=self.pad_mode)
+        power = self.power
+
+        def fn(s):
+            return jnp.abs(s) ** power
+
+        return dispatch(fn, (spec,), {}, name="spec_power")
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode, dtype)
+        self.n_mels = n_mels
+        self.register_buffer("fbank_matrix", AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype))
+
+    def forward(self, x):
+        spec = self._spectrogram(x)  # (..., freq, time)
+
+        def fn(fb, s):
+            return jnp.matmul(fb, s)
+
+        return dispatch(fn, (self.fbank_matrix, spec), {}, name="mel_project")
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db, dtype)
+        self.register_buffer("dct_matrix",
+                             AF.create_dct(n_mfcc, n_mels, dtype=dtype))
+
+    def forward(self, x):
+        log_mel = self._log_melspectrogram(x)  # (..., n_mels, time)
+
+        def fn(d, m):
+            return jnp.einsum("mk,...mt->...kt", d, m)
+
+        return dispatch(fn, (self.dct_matrix, log_mel), {}, name="mfcc_dct")

@@ -1,0 +1,170 @@
+"""paddle.audio.functional analog.
+
+Reference: python/paddle/audio/functional/{window.py,functional.py}.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from ..ops.creation import to_tensor
+
+__all__ = [
+    "get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+    "fft_frequencies", "compute_fbank_matrix", "power_to_db", "create_dct",
+]
+
+
+def _np_window(name, win_length, fftbins=True):
+    n = win_length
+    sym = not fftbins
+    if name in ("hann", "hanning"):
+        return np.hanning(n + 1)[:-1] if not sym else np.hanning(n)
+    if name == "hamming":
+        return np.hamming(n + 1)[:-1] if not sym else np.hamming(n)
+    if name == "blackman":
+        return np.blackman(n + 1)[:-1] if not sym else np.blackman(n)
+    if name == "bartlett":
+        return np.bartlett(n + 1)[:-1] if not sym else np.bartlett(n)
+    if name in ("rect", "rectangular", "boxcar", "ones"):
+        return np.ones(n)
+    if name == "bohman":
+        m = n + 1 if fftbins else n
+        fac = np.abs(np.linspace(-1, 1, m))
+        w = (1 - fac) * np.cos(np.pi * fac) + np.sin(np.pi * fac) / np.pi
+        return w[:-1] if fftbins else w
+    if name == "cosine":
+        m = n + 1 if fftbins else n
+        w = np.sin(np.pi / m * (np.arange(m) + 0.5))
+        return w[:-1] if fftbins else w
+    if name == "triang":
+        m = n + 1 if fftbins else n
+        k = np.arange(1, (m + 1) // 2 + 1)
+        if m % 2 == 0:
+            w = (2 * k - 1.0) / m
+            w = np.concatenate([w, w[::-1]])
+        else:
+            w = 2 * k / (m + 1.0)
+            w = np.concatenate([w, w[-2::-1]])
+        return w[:-1] if fftbins else w
+    raise ValueError(f"unsupported window {name!r}")
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Reference: audio/functional/window.py get_window."""
+    if isinstance(window, tuple):
+        name, *params = window
+        if name == "gaussian":
+            std = params[0]
+            m = win_length + 1 if fftbins else win_length
+            k = np.arange(m) - (m - 1) / 2
+            w = np.exp(-0.5 * (k / std) ** 2)
+            w = w[:-1] if fftbins else w
+        elif name in ("exponential", "exp"):
+            tau = params[-1] if params else 1.0
+            m = win_length + 1 if fftbins else win_length
+            k = np.abs(np.arange(m) - (m - 1) / 2)
+            w = np.exp(-k / tau)
+            w = w[:-1] if fftbins else w
+        elif name == "taylor":
+            raise NotImplementedError("taylor window")
+        else:
+            raise ValueError(f"unsupported window {window!r}")
+    else:
+        w = _np_window(window, win_length, fftbins)
+    return to_tensor(w.astype(dtype))
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not isinstance(freq, Tensor)
+    f = np.asarray(freq._value if isinstance(freq, Tensor) else freq,
+                   dtype=np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz)
+                       / logstep, mel)
+    return float(mel) if scalar and mel.ndim == 0 else to_tensor(mel)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, Tensor)
+    m = np.asarray(mel._value if isinstance(mel, Tensor) else mel,
+                   dtype=np.float64)
+    if htk:
+        f = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        f = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        f = np.where(m >= min_log_mel,
+                     min_log_hz * np.exp(logstep * (m - min_log_mel)), f)
+    return float(f) if scalar and f.ndim == 0 else to_tensor(f)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    mels = np.linspace(low, high, n_mels)
+    return to_tensor(np.asarray(mel_to_hz(to_tensor(mels), htk)._value,
+                                dtype=dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return to_tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Mel filterbank (n_mels, 1 + n_fft//2). Reference:
+    audio/functional/functional.py compute_fbank_matrix (librosa-compatible)."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(mel_frequencies(n_mels + 2, f_min, f_max, htk)._value,
+                       dtype=np.float64)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return to_tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """Reference: audio/functional/functional.py power_to_db."""
+    def fn(s):
+        log_spec = 10.0 * (jnp.log10(jnp.maximum(amin, s))
+                           - jnp.log10(jnp.maximum(amin, ref_value)))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return dispatch(fn, (spect,), {}, name="power_to_db")
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II basis (n_mels, n_mfcc). Reference: functional.py create_dct."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return to_tensor(dct.astype(dtype))

@@ -39,6 +39,13 @@ class Generator:
             self._counter += 1
             return jax.random.fold_in(self._key, self._counter)
 
+    def next_seed(self):
+        """Host-side draw: a fresh (seed, counter) pair for numpy RNGs (no
+        device work). Used by host-resident samplers (e.g. graph sampling)."""
+        with self._lock:
+            self._counter += 1
+            return (self._seed, self._counter)
+
     def get_state(self):
         with self._lock:
             return (self._seed, self._counter)

@@ -88,6 +88,10 @@ class ElasticManager:
                                     "node_rank": (alive.index(self.node_id)
                                                   if self.node_id in alive
                                                   else -1)})
+            elif self.status == ElasticStatus.RESTART:
+                # membership held steady for a full poll after the change —
+                # the relaunch was (or can be) absorbed; back to steady state
+                self.status = ElasticStatus.HOLD
 
     def start(self):
         self._register()

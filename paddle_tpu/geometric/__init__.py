@@ -1,0 +1,221 @@
+"""paddle.geometric analog — graph message passing + sampling.
+
+Reference: python/paddle/geometric/ (message_passing/send_recv.py send_u_recv /
+send_ue_recv / send_uv, math.py segment_* ops, sampling/neighbors.py,
+reindex.py). TPU-native: message passing lowers to gather + segment-reduce HLO
+(sort-based scatter on TPU — the XLA analog of the reference's fused
+graph_send_recv CUDA kernels); neighbor sampling is host-side numpy since graph
+topology lives on host.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from ..ops.creation import to_tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+    "sample_neighbors", "weighted_sample_neighbors",
+]
+
+
+def _ids_np(t):
+    return np.asarray(t._value if isinstance(t, Tensor) else t)
+
+
+def _np_rng():
+    """numpy RNG derived from the framework Generator so paddle_tpu.seed()
+    makes sampling reproducible (and rank-deterministic)."""
+    from ..core import random as _random
+    return np.random.default_rng(_random.default_generator.next_seed())
+
+
+def _segment(reduce_op, data, segment_ids, num_segments):
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                                  segment_ids, num_segments=num_segments)
+        shape = (num_segments,) + (1,) * (data.ndim - 1)
+        return s / jnp.maximum(cnt, 1).reshape(shape)
+    if reduce_op == "max":
+        return jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+    if reduce_op == "min":
+        return jax.ops.segment_min(data, segment_ids, num_segments=num_segments)
+    raise ValueError(f"unknown reduce_op {reduce_op!r}")
+
+
+def _finalize_minmax(out, reduce_op):
+    # XLA segment_max/min fill empty segments with ∓inf; reference uses 0
+    if reduce_op in ("max", "min"):
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    return out
+
+
+def _make_segment(reduce_op):
+    def op(data, segment_ids, name=None):
+        ids = jnp.asarray(_ids_np(segment_ids), dtype=jnp.int32)
+        n = int(_ids_np(segment_ids).max()) + 1 if ids.shape[0] else 0
+
+        def fn(d):
+            return _finalize_minmax(_segment(reduce_op, d, ids, n), reduce_op)
+
+        return dispatch(fn, (data,), {}, name=f"segment_{reduce_op}")
+
+    op.__name__ = f"segment_{reduce_op}"
+    return op
+
+
+segment_sum = _make_segment("sum")
+segment_mean = _make_segment("mean")
+segment_max = _make_segment("max")
+segment_min = _make_segment("min")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather source-node features along edges, reduce at destinations.
+    Reference: message_passing/send_recv.py send_u_recv."""
+    src = jnp.asarray(_ids_np(src_index), dtype=jnp.int32)
+    dst = jnp.asarray(_ids_np(dst_index), dtype=jnp.int32)
+    n_out = int(out_size) if out_size is not None else int(x.shape[0])
+
+    def fn(v):
+        return _finalize_minmax(_segment(reduce_op, v[src], dst, n_out),
+                                reduce_op)
+
+    return dispatch(fn, (x,), {}, name="send_u_recv")
+
+
+_MESSAGE_OPS = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine source-node features with edge features, reduce at dst.
+    Reference: send_recv.py send_ue_recv (y = per-edge feature)."""
+    if message_op not in _MESSAGE_OPS:
+        raise ValueError(f"unknown message_op {message_op!r}")
+    src = jnp.asarray(_ids_np(src_index), dtype=jnp.int32)
+    dst = jnp.asarray(_ids_np(dst_index), dtype=jnp.int32)
+    n_out = int(out_size) if out_size is not None else int(x.shape[0])
+    mfn = _MESSAGE_OPS[message_op]
+
+    def fn(v, e):
+        msg = mfn(v[src], e)
+        return _finalize_minmax(_segment(reduce_op, msg, dst, n_out),
+                                reduce_op)
+
+    return dispatch(fn, (x, y), {}, name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints (no reduction).
+    Reference: send_recv.py send_uv."""
+    if message_op not in _MESSAGE_OPS:
+        raise ValueError(f"unknown message_op {message_op!r}")
+    src = jnp.asarray(_ids_np(src_index), dtype=jnp.int32)
+    dst = jnp.asarray(_ids_np(dst_index), dtype=jnp.int32)
+    mfn = _MESSAGE_OPS[message_op]
+
+    def fn(xv, yv):
+        return mfn(xv[src], yv[dst])
+
+    return dispatch(fn, (x, y), {}, name="send_uv")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (reference: reindex.py
+    reindex_graph). Returns (reindex_src, reindex_dst, out_nodes)."""
+    xv = _ids_np(x).astype(np.int64)
+    nb = _ids_np(neighbors).astype(np.int64)
+    cnt = _ids_np(count).astype(np.int64)
+    out_nodes = list(xv.tolist())
+    mapping = {int(n): i for i, n in enumerate(xv.tolist())}
+    for n in nb.tolist():
+        if int(n) not in mapping:
+            mapping[int(n)] = len(out_nodes)
+            out_nodes.append(int(n))
+    reindex_src = np.asarray([mapping[int(n)] for n in nb.tolist()],
+                             dtype=np.int64)
+    reindex_dst = np.repeat(np.arange(len(xv), dtype=np.int64), cnt)
+    return (to_tensor(reindex_src), to_tensor(reindex_dst),
+            to_tensor(np.asarray(out_nodes, dtype=np.int64)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling over a CSC graph (reference:
+    sampling/neighbors.py sample_neighbors). Host-side numpy."""
+    r = _ids_np(row).astype(np.int64)
+    cp = _ids_np(colptr).astype(np.int64)
+    nodes = _ids_np(input_nodes).astype(np.int64)
+    rng = _np_rng()
+    out_neighbors, out_count, out_eids = [], [], []
+    for n in nodes.tolist():
+        beg, end = int(cp[n]), int(cp[n + 1])
+        neigh = r[beg:end]
+        idx = np.arange(beg, end)
+        if sample_size != -1 and len(neigh) > sample_size:
+            pick = rng.choice(len(neigh), size=sample_size, replace=False)
+            neigh = neigh[pick]
+            idx = idx[pick]
+        out_neighbors.append(neigh)
+        out_count.append(len(neigh))
+        out_eids.append(idx)
+    neighbors = to_tensor(np.concatenate(out_neighbors)
+                          if out_neighbors else np.zeros(0, np.int64))
+    count = to_tensor(np.asarray(out_count, dtype=np.int64))
+    if return_eids:
+        if eids is None:
+            raise ValueError("return_eids=True requires eids")
+        e = _ids_np(eids)[np.concatenate(out_eids).astype(np.int64)] \
+            if out_eids else np.zeros(0, np.int64)
+        return neighbors, count, to_tensor(e)
+    return neighbors, count
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted neighbor sampling (reference: sampling/neighbors.py
+    weighted_sample_neighbors)."""
+    r = _ids_np(row).astype(np.int64)
+    cp = _ids_np(colptr).astype(np.int64)
+    w = _ids_np(edge_weight).astype(np.float64)
+    nodes = _ids_np(input_nodes).astype(np.int64)
+    rng = _np_rng()
+    out_neighbors, out_count, out_eids = [], [], []
+    for n in nodes.tolist():
+        beg, end = int(cp[n]), int(cp[n + 1])
+        neigh = r[beg:end]
+        idx = np.arange(beg, end)
+        if sample_size != -1 and len(neigh) > sample_size:
+            p = w[beg:end]
+            p = p / p.sum()
+            pick = rng.choice(len(neigh), size=sample_size, replace=False, p=p)
+            neigh = neigh[pick]
+            idx = idx[pick]
+        out_neighbors.append(neigh)
+        out_count.append(len(neigh))
+        out_eids.append(idx)
+    neighbors = to_tensor(np.concatenate(out_neighbors)
+                          if out_neighbors else np.zeros(0, np.int64))
+    count = to_tensor(np.asarray(out_count, dtype=np.int64))
+    if return_eids:
+        if eids is None:
+            raise ValueError("return_eids=True requires eids")
+        e = _ids_np(eids)[np.concatenate(out_eids).astype(np.int64)] \
+            if out_eids else np.zeros(0, np.int64)
+        return neighbors, count, to_tensor(e)
+    return neighbors, count

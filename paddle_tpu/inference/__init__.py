@@ -112,7 +112,8 @@ class Predictor:
         self._input_names = list(self._meta.get("feed_names", []))
         self._output_names = list(self._meta.get("fetch_names", []))
         self._inputs = {n: _IOHandle(n) for n in self._input_names}
-        self._outputs = {}
+        # persistent handles: users bind them once and read after each run()
+        self._outputs = {n: _IOHandle(n) for n in self._output_names}
 
     def get_input_names(self):
         return list(self._input_names)
@@ -142,11 +143,8 @@ class Predictor:
             outs = [outs]
         outs = [np.asarray(o) for o in outs]
         names = self._output_names or [f"fetch_{i}" for i in range(len(outs))]
-        self._outputs = {}
         for n, o in zip(names, outs):
-            h = _IOHandle(n)
-            h._value = o
-            self._outputs[n] = h
+            self._outputs.setdefault(n, _IOHandle(n))._value = o
         if inputs is not None:
             return outs
         return True

@@ -1,0 +1,91 @@
+"""paddle.nn.quant analog — quantized layers + weight-only helpers.
+
+Reference: python/paddle/nn/quant/ (qat layer wrappers, and the weight-only
+GEMM helpers weight_quantize/weight_only_linear used for LLM inference).
+TPU-native: weight-only int8 keeps weights in HBM at half the bytes and
+dequantizes inline — XLA fuses the scale-multiply into the matmul, which is the
+memory-bandwidth win the reference gets from its cutlass weight-only kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch
+from ...quantization import (  # noqa: F401
+    QuantedLinear, QuantedConv2D, QuantizedLinearInfer,
+    FakeQuanterWithAbsMaxObserver, FakeQuanterChannelWiseAbsMaxObserver,
+    quantize_linear, dequantize_linear, fake_quantize,
+)
+
+__all__ = [
+    "QuantedLinear", "QuantedConv2D", "QuantizedLinearInfer",
+    "FakeQuanterWithAbsMaxObserver", "FakeQuanterChannelWiseAbsMaxObserver",
+    "quantize_linear", "dequantize_linear", "fake_quantize",
+    "weight_quantize", "weight_dequantize", "weight_only_linear", "llm_int8_linear",
+]
+
+
+def weight_quantize(weight, algo="weight_only_int8", group_size=-1):
+    """Per-out-channel int8 weight quantization.
+
+    Returns (quantized int8 Tensor [in, out], scales float Tensor [out]).
+    Reference: nn/quant/quantized_linear.py weight_quantize."""
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise NotImplementedError(f"algo {algo!r} (int4 needs packed storage)")
+    w = weight.numpy() if isinstance(weight, Tensor) else np.asarray(weight)
+    scales = np.maximum(np.abs(w).max(axis=0), 1e-9).astype(np.float32) / 127.0
+    q = np.clip(np.round(w / scales[None, :]), -127, 127).astype(np.int8)
+    return Tensor(q), Tensor(scales)
+
+
+def weight_dequantize(quant_weight, scale, algo="weight_only_int8"):
+    def fn(q, s):
+        return q.astype(s.dtype) * s[None, :]
+
+    return dispatch(fn, (quant_weight, scale), {}, name="weight_dequantize")
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", group_size=-1):
+    """y = x @ dequant(w_int8) + b; the dequant fuses into the matmul operand.
+    Reference: nn/quant/quantized_linear.py weight_only_linear."""
+    def fn(xv, q, s, b):
+        w = q.astype(xv.dtype) * s.astype(xv.dtype)[None, :]
+        y = jnp.matmul(xv, w)
+        if b is not None:
+            y = y + b
+        return y
+
+    return dispatch(fn, (x, weight, weight_scale, bias), {},
+                    name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
+    """LLM.int8 decomposition (reference: nn/quant/quantized_linear.py
+    llm_int8_linear): inlier activation columns are themselves quantized to
+    int8 (per-row dynamic scale) and multiplied against the int8 weights —
+    the int8×int8 path — while outlier columns (|x| > threshold) run in full
+    precision against the dequantized weights."""
+    def fn(xv, q, s, b):
+        w = q.astype(xv.dtype) * s.astype(xv.dtype)[None, :]
+        absx = jnp.max(jnp.abs(xv), axis=tuple(range(xv.ndim - 1)))
+        outlier = absx > threshold
+        x_main = jnp.where(outlier, 0.0, xv)
+        x_out = jnp.where(outlier, xv, 0.0)
+        # dynamic per-row int8 quantization of the inlier activations
+        row_scale = jnp.maximum(
+            jnp.max(jnp.abs(x_main), axis=-1, keepdims=True), 1e-9) / 127.0
+        xq = jnp.clip(jnp.round(x_main / row_scale), -127, 127)
+        # int8 x int8 accumulated in int32, then rescaled (XLA lowers this to
+        # the TPU int matmul path); outliers take the fp route
+        y_main = jnp.matmul(xq.astype(jnp.int32),
+                            q.astype(jnp.int32)).astype(xv.dtype)
+        y_main = y_main * row_scale * s.astype(xv.dtype)[None, :]
+        y = y_main + jnp.matmul(x_out, w)
+        if b is not None:
+            y = y + b
+        return y
+
+    return dispatch(fn, (x, weight, weight_scale, bias), {},
+                    name="llm_int8_linear")

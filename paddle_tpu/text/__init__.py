@@ -1,0 +1,112 @@
+"""paddle.text analog — ViterbiDecoder + dataset registry.
+
+Reference: python/paddle/text/ (viterbi_decode.py ViterbiDecoder/viterbi_decode,
+datasets/ — Imdb, Imikolov, Movielens, Conll05st, UCIHousing, WMT14, WMT16).
+Datasets require network downloads; this environment has no egress, so they
+raise a clear gating error unless the files are already cached locally.
+TPU-native: the Viterbi recursion is a lax.scan over time steps — compiled,
+batched, differentiable through the score.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from ..nn.layer_base import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Batched Viterbi decode (reference: text/viterbi_decode.py:24).
+
+    potentials: (B, T, N) emission scores; transition_params: (N, N);
+    lengths: (B,) valid lengths. Returns (scores (B,), paths (B, T))."""
+    lens = jnp.asarray(lengths._value if isinstance(lengths, Tensor)
+                       else lengths, dtype=jnp.int32)
+
+    def fn(emis, trans):
+        B, T, N = emis.shape
+        if include_bos_eos_tag:
+            # reference semantics: tag N-2 = BOS, N-1 = EOS
+            bos_idx, eos_idx = N - 2, N - 1
+            init = emis[:, 0] + trans[bos_idx][None, :]
+        else:
+            init = emis[:, 0]
+
+        def step(alpha, t):
+            # alpha: (B, N); candidate scores (B, from N, to N)
+            cand = alpha[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(cand, axis=1).astype(jnp.int32)  # (B, N)
+            alpha_new = jnp.max(cand, axis=1) + emis[:, t]
+            # freeze past the sequence end
+            active = (t < lens)[:, None]
+            alpha_new = jnp.where(active, alpha_new, alpha)
+            best_prev = jnp.where(active, best_prev,
+                                  jnp.arange(N, dtype=jnp.int32)[None, :])
+            return alpha_new, best_prev
+
+        alpha, backptrs = jax.lax.scan(step, init, jnp.arange(1, T))
+        # backptrs: (T-1, B, N)
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, eos_idx][None, :]
+        scores = jnp.max(alpha, axis=1)
+        last_tag = jnp.argmax(alpha, axis=1).astype(jnp.int32)  # (B,)
+
+        def back_step(tag, ptr_t):
+            # ptr_t: (B, N) for step t; identity pointers past sequence end
+            prev = jnp.take_along_axis(ptr_t, tag[:, None], axis=1)[:, 0]
+            return prev.astype(jnp.int32), tag
+
+        first, path_rev = jax.lax.scan(back_step, last_tag, backptrs[::-1])
+        # path_rev: (T-1, B) tags for t = T-1 .. 1; carry out = tag at t=0
+        paths = jnp.concatenate([first[None, :], path_rev[::-1]], axis=0).T
+        return scores, paths.astype(jnp.int64)
+
+    return dispatch(fn, (potentials, transition_params), {},
+                    name="viterbi_decode")
+
+
+class ViterbiDecoder(Layer):
+    """Reference: text/viterbi_decode.py ViterbiDecoder layer."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+# ---------------------------------------------------------------------------
+# dataset registry — gated (no egress in this environment)
+# ---------------------------------------------------------------------------
+
+_DATASET_NAMES = ("Imdb", "Imikolov", "Movielens", "Conll05st", "UCIHousing",
+                  "WMT14", "WMT16", "ViterbiDataset")
+
+
+def _gated_dataset(name):
+    class _Gated:
+        def __init__(self, *args, data_file=None, **kwargs):
+            if data_file is None or not os.path.exists(data_file):
+                raise RuntimeError(
+                    f"paddle.text dataset {name} needs its archive on disk "
+                    "(downloads are disabled in this environment); pass "
+                    "data_file=<local path>")
+            self.data_file = data_file
+
+    _Gated.__name__ = name
+    return _Gated
+
+
+for _n in _DATASET_NAMES[:-1]:
+    globals()[_n] = _gated_dataset(_n)
+    __all__.append(_n)

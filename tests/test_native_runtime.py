@@ -96,7 +96,8 @@ class TestTCPStore:
                 "c.set('from_child', 123)\n"
                 "print(c.add('cnt', 1))\n"
             )
-            out = subprocess.run([sys.executable, "-c", code], cwd="/root/repo",
+            repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            out = subprocess.run([sys.executable, "-c", code], cwd=repo_root,
                                  capture_output=True, text=True, timeout=120)
             assert out.returncode == 0, out.stderr
             assert st.wait("from_child", timeout=10) == 123

@@ -1,0 +1,219 @@
+"""Quantization framework tests (observers, quanters, QAT, PTQ, weight-only).
+
+Reference strategy: quantization tests check observer scales against numpy,
+QAT round-trips (train a step through fake-quant), and converted-model output
+closeness (test/quantization/)."""
+import copy
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import quantization as q
+from paddle_tpu.nn import quant as nq
+
+
+class TestObservers:
+    def test_absmax(self, rng):
+        ob = q.AbsmaxObserver()
+        a = rng.standard_normal(100).astype("float32")
+        ob.observe(P.to_tensor(a))
+        np.testing.assert_allclose(ob.scale(), np.abs(a).max() / 127.0,
+                                   rtol=1e-6)
+        # monotone under more data
+        b = 10 * np.ones(4, "float32")
+        ob.observe(P.to_tensor(b))
+        np.testing.assert_allclose(ob.scale(), 10.0 / 127.0, rtol=1e-6)
+
+    def test_ema(self, rng):
+        ob = q.EMAObserver(moving_rate=0.5)
+        ob.observe(P.to_tensor(np.asarray([4.0], "float32")))
+        ob.observe(P.to_tensor(np.asarray([8.0], "float32")))
+        np.testing.assert_allclose(ob.scale(), 6.0 / 127.0, rtol=1e-6)
+
+    def test_avg(self):
+        ob = q.AVGObserver()
+        for v in (2.0, 4.0):
+            ob.observe(P.to_tensor(np.asarray([v], "float32")))
+        np.testing.assert_allclose(ob.scale(), 3.0 / 127.0, rtol=1e-6)
+
+    def test_mse_minimizes_error(self, rng):
+        a = rng.standard_normal(8192).astype("float32")
+        a[0] = 100.0  # huge outlier
+        ob = q.MSEObserver()
+        ob.observe(P.to_tensor(a))
+
+        def quant_mse(clip):
+            s = clip / 127.0
+            qv = np.clip(np.round(a / s), -127, 127) * s
+            return ((a - qv) ** 2).mean()
+
+        # the chosen clip must beat plain absmax clipping (or tie)
+        assert quant_mse(ob._scale) <= quant_mse(np.abs(a).max()) + 1e-9
+
+    def test_hist_percentile(self, rng):
+        a = rng.standard_normal(1 << 16).astype("float32")
+        ob = q.HistObserver(percent=0.99)
+        ob.observe(P.to_tensor(a))
+        ref = np.quantile(np.abs(a), 0.99)
+        assert abs(ob._scale - ref) / ref < 0.2
+
+    def test_per_channel(self, rng):
+        a = rng.standard_normal((16, 4)).astype("float32")
+        ob = q.PerChannelAbsmaxObserver(quant_axis=-1)
+        ob.observe(P.to_tensor(a))
+        np.testing.assert_allclose(ob.scale(), np.abs(a).max(0) / 127.0,
+                                   rtol=1e-6)
+
+
+class TestFakeQuant:
+    def test_roundtrip_error_bounded(self, rng):
+        x = P.to_tensor(rng.standard_normal(512).astype("float32"))
+        scale = P.to_tensor(np.float32(np.abs(x.numpy()).max() / 127.0))
+        y = q.fake_quantize(x, scale)
+        assert abs(y.numpy() - x.numpy()).max() <= float(scale.numpy()) * 0.51
+
+    def test_ste_gradient(self, rng):
+        xv = np.asarray([-300.0, -1.0, 0.5, 1.0, 300.0], "float32")
+        x = P.to_tensor(xv, stop_gradient=False)
+        y = q.fake_quantize(x, P.to_tensor(np.float32(1.0)))  # clip at ±127
+        y.sum().backward()
+        # STE: unit grad inside the clip range, zero outside
+        np.testing.assert_allclose(x.grad.numpy(), [0., 1., 1., 1., 0.])
+
+    def test_quantize_dequantize_linear(self, rng):
+        w = rng.standard_normal((8, 4)).astype("float32")
+        scales = np.maximum(np.abs(w).max(0), 1e-9) / 127.0
+        qw = q.quantize_linear(P.to_tensor(w), P.to_tensor(scales), axis=-1)
+        assert qw.numpy().dtype == np.int8
+        back = q.dequantize_linear(qw, P.to_tensor(scales), axis=-1)
+        assert abs(back.numpy() - w).max() <= scales.max() * 0.51
+
+
+class TestQAT:
+    def _model(self):
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+    def test_quantize_swaps_layers(self):
+        model = self._model()
+        qat = q.QAT(q.QuantConfig(
+            activation=q.FakeQuanterWithAbsMaxObserver,
+            weight=q.FakeQuanterChannelWiseAbsMaxObserver))
+        qmodel = qat.quantize(model)
+        kinds = [type(l).__name__ for l in qmodel]
+        assert kinds == ["QuantedLinear", "ReLU", "QuantedLinear"]
+        # original untouched (inplace=False)
+        assert type(model[0]).__name__ == "Linear"
+
+    def test_qat_trains(self, rng):
+        model = self._model()
+        qat = q.QAT(q.QuantConfig(
+            activation=q.FakeQuanterWithAbsMaxObserver,
+            weight=q.FakeQuanterChannelWiseAbsMaxObserver))
+        qmodel = qat.quantize(model, inplace=True)
+        o = opt.SGD(0.1, parameters=qmodel.parameters())
+        x = P.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+        w_before = qmodel[0]._inner.weight.numpy().copy()
+        loss = (qmodel(x) ** 2).mean()
+        loss.backward()
+        o.step()
+        assert not np.allclose(qmodel[0]._inner.weight.numpy(), w_before)
+
+    def test_convert_produces_int8_close_output(self, rng):
+        model = self._model()
+        qat = q.QAT(q.QuantConfig(
+            activation=None,
+            weight=q.FakeQuanterChannelWiseAbsMaxObserver))
+        qmodel = qat.quantize(model)
+        x = P.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+        _ = qmodel(x)  # populate weight observers
+        deploy = qat.convert(qmodel)
+        assert type(deploy[0]).__name__ == "QuantizedLinearInfer"
+        assert deploy[0].w_int8.numpy().dtype == np.int8
+        ref = model(x).numpy()
+        got = deploy(x).numpy()
+        assert abs(got - ref).max() < 0.1 * abs(ref).max() + 0.05
+
+    def test_type_config_selective(self):
+        model = self._model()
+        cfg = q.QuantConfig()
+        cfg.add_type_config(nn.Linear,
+                            weight=q.FakeQuanterChannelWiseAbsMaxObserver)
+        qmodel = q.QAT(cfg).quantize(model)
+        assert type(qmodel[0]).__name__ == "QuantedLinear"
+
+
+class TestPTQ:
+    def test_calibration_affects_deploy(self, rng):
+        # the converted layer must carry the observer's activation scale (W8A8)
+        model = nn.Sequential(nn.Linear(4, 4))
+        ptq = q.PTQ(q.QuantConfig(activation=q.AbsmaxObserver))
+        obs = ptq.quantize(model)
+        obs(P.to_tensor(8.0 * np.ones((1, 4), "float32")))
+        deploy = ptq.convert(obs)
+        assert deploy[0].act_scale == pytest.approx(8.0 / 127.0, rel=1e-5)
+        x = P.to_tensor(rng.standard_normal((3, 4)).astype("float32"))
+        ref = model(x).numpy()
+        got = deploy(x).numpy()
+        assert abs(got - ref).max() < 0.15 * abs(ref).max() + 0.1
+
+    def test_observe_then_convert(self, rng):
+        model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+        ptq = q.PTQ(q.QuantConfig(activation=q.AbsmaxObserver))
+        obs_model = ptq.quantize(model)
+        for _ in range(3):
+            obs_model(P.to_tensor(
+                rng.standard_normal((4, 8)).astype("float32")))
+        deploy = ptq.convert(obs_model)
+        names = [type(l).__name__ for l in deploy]
+        assert names == ["QuantizedLinearInfer", "ReLU", "QuantizedLinearInfer"]
+        x = P.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+        ref, got = model(x).numpy(), deploy(x).numpy()
+        assert abs(got - ref).max() < 0.1 * abs(ref).max() + 0.05
+
+
+class TestWeightOnly:
+    def test_weight_only_linear_matches(self, rng):
+        w = rng.standard_normal((64, 32)).astype("float32")
+        x = P.to_tensor(rng.standard_normal((4, 64)).astype("float32"))
+        qw, scales = nq.weight_quantize(P.to_tensor(w))
+        assert qw.numpy().dtype == np.int8
+        y = nq.weight_only_linear(x, qw, weight_scale=scales)
+        ref = x.numpy() @ w
+        assert abs(y.numpy() - ref).max() < 0.05 * abs(ref).max() + 0.05
+        back = nq.weight_dequantize(qw, scales)
+        assert abs(back.numpy() - w).max() <= scales.numpy().max() * 0.51
+
+    def test_llm_int8_linear(self, rng):
+        w = rng.standard_normal((16, 8)).astype("float32")
+        x = rng.standard_normal((2, 16)).astype("float32")
+        x[:, 3] = 50.0  # outlier channel
+        qw, scales = nq.weight_quantize(P.to_tensor(w), algo="llm.int8")
+        y = nq.llm_int8_linear(P.to_tensor(x), qw, weight_scale=scales)
+        ref = x @ w
+        assert abs(y.numpy() - ref).max() < 0.1 * abs(ref).max() + 0.1
+        # the decomposition must differ from plain weight-only (x got
+        # quantized on the inlier path) but stay closer to fp32 than fully
+        # quantizing the outlier column would be
+        y_wo = nq.weight_only_linear(P.to_tensor(x), qw, weight_scale=scales)
+        assert not np.allclose(y.numpy(), y_wo.numpy())
+
+    def test_qat_conv_converts_to_int8(self, rng):
+        model = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1), nn.ReLU())
+        qat = q.QAT(q.QuantConfig(
+            activation=None,
+            weight=lambda: q.FakeQuanterChannelWiseAbsMaxObserver(
+                quant_axis=0)))
+        qm = qat.quantize(model)
+        x = P.to_tensor(rng.standard_normal((1, 3, 8, 8)).astype("float32"))
+        _ = qm(x)
+        deploy = qat.convert(qm)
+        assert type(deploy[0]).__name__ == "QuantizedConv2DInfer"
+        assert deploy[0].w_int8.numpy().dtype == np.int8
+        # fp32 weight dropped from the deploy layer's parameters
+        names = [n for n, _ in deploy[0].named_parameters()]
+        ref = model(x).numpy()
+        got = deploy(x).numpy()
+        assert abs(got - ref).max() < 0.1 * abs(ref).max() + 0.05
