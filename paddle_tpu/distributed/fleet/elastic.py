@@ -88,10 +88,13 @@ class ElasticManager:
                                     "node_rank": (alive.index(self.node_id)
                                                   if self.node_id in alive
                                                   else -1)})
-            elif self.status == ElasticStatus.RESTART:
-                # membership held steady for a full poll after the change —
-                # the relaunch was (or can be) absorbed; back to steady state
-                self.status = ElasticStatus.HOLD
+
+    def acknowledge(self):
+        """Consumer handled the pending RESTART — return to steady state.
+        Status stays latched until acknowledged so polling drivers cannot miss
+        a membership change between polls."""
+        if self.status == ElasticStatus.RESTART:
+            self.status = ElasticStatus.HOLD
 
     def start(self):
         self._register()

@@ -184,27 +184,47 @@ class MSEObserver(BaseObserver):
         super().__init__(quant_bits)
         self.candidates = candidates
         self._samples = []
+        self._n_stored = 0
+        self._dirty = True
+
+    _MAX_STORED = 1 << 20
 
     def observe(self, x):
+        # cheap per-batch: subsample and stash; the clip search runs lazily in
+        # scale(), so calibration is O(n_batches), not O(n^2)
         v = np.asarray(x._value if isinstance(x, Tensor) else x).ravel()
         if v.size > 65536:
             v = v[:: v.size // 65536]
         self._samples.append(v.astype(np.float32))
-        absmax = max(float(np.abs(s).max()) for s in self._samples)
+        self._n_stored += v.size
+        if self._n_stored > self._MAX_STORED:
+            data = np.concatenate(self._samples)
+            data = data[:: max(data.size // (self._MAX_STORED // 2), 1)]
+            self._samples = [data]
+            self._n_stored = data.size
+        self._dirty = True
+        self._scale = self._scale or 1.0  # mark "has data"
+
+    def _search(self):
         data = np.concatenate(self._samples)
+        absmax = float(np.abs(data).max())
         qmax = self._qmax()
         best, best_err = absmax, np.inf
         for frac in np.linspace(0.3, 1.0, self.candidates):
-            clip = absmax * frac
+            clip = max(absmax * frac, 1e-9)
             s = clip / qmax
             q = np.clip(np.round(data / s), -qmax, qmax) * s
             err = float(((data - q) ** 2).mean())
             if err < best_err:
                 best, best_err = clip, err
         self._scale = max(best, 1e-9)
+        self._dirty = False
 
     def scale(self):
-        super().scale()
+        if not self._samples:
+            super().scale()  # raises "observed no data yet"
+        if self._dirty:
+            self._search()
         return self._scale / self._qmax()
 
 
@@ -278,7 +298,10 @@ class FakeQuanterWithAbsMaxObserver(Layer):
         self.bit_length = bit_length
 
     def forward(self, x):
-        if self.training:
+        # eval before any training step still needs a scale: bootstrap the
+        # observer from the first tensor it sees (reference initializes the
+        # scale buffer similarly)
+        if self.training or self._observer._scale is None:
             self._observer.observe(x)
         from ..ops.creation import to_tensor
         return fake_quantize(x, to_tensor(np.float32(self._observer.scale())),

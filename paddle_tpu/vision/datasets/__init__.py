@@ -46,5 +46,146 @@ class FakeTextDataset(Dataset):
         return self.num_samples
 
 
-MNIST = None  # requires download; out of scope in a zero-egress environment
-Cifar10 = None
+
+
+def _require(path, name, hint):
+    import os
+    if path is None or not os.path.exists(path):
+        raise RuntimeError(
+            f"{name} needs its data on disk (downloads are disabled in this "
+            f"environment); pass {hint}")
+    return path
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files (reference: vision/datasets/mnist.py, minus
+    the downloader). Pass image_path/label_path to the raw (optionally .gz)
+    idx files."""
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        import gzip
+        import struct
+        _require(image_path, self.NAME, "image_path=")
+        _require(label_path, self.NAME, "label_path=")
+        opener = gzip.open if str(image_path).endswith(".gz") else open
+        with opener(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, "not an idx3 image file"
+            self.images = np.frombuffer(f.read(n * rows * cols),
+                                        dtype=np.uint8).reshape(n, rows, cols)
+        opener = gzip.open if str(label_path).endswith(".gz") else open
+        with opener(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, "not an idx1 label file"
+            self.labels = np.frombuffer(f.read(n), dtype=np.uint8)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype("float32")[..., None]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "FashionMNIST"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-version tar.gz (reference:
+    vision/datasets/cifar.py minus download)."""
+
+    _n_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        import pickle
+        import tarfile
+        _require(data_file, type(self).__name__, "data_file=")
+        imgs, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            names = [m.name for m in tf.getmembers()]
+            for name in sorted(names):
+                base = name.rsplit("/", 1)[-1]
+                if self._n_classes == 10 and not base.startswith(
+                        "data_batch" if mode == "train" else "test_batch"):
+                    continue
+                if self._n_classes == 100 and base != mode:
+                    continue
+                entry = pickle.loads(tf.extractfile(name).read(),
+                                     encoding="bytes")
+                imgs.append(np.asarray(entry[b"data"]))
+                key = b"labels" if b"labels" in entry else b"fine_labels"
+                labels.extend(entry[key])
+        data = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.images = data.transpose(0, 2, 3, 1)  # HWC like the reference
+        self.labels = np.asarray(labels, dtype=np.int64)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype("float32")
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _n_classes = 100
+
+
+class Flowers(Dataset):
+    """Flowers-102 from local files (gated)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        _require(data_file, "Flowers", "data_file=")
+        raise NotImplementedError(
+            "Flowers parsing requires scipy.io + image decoding; provide "
+            "pre-extracted arrays or use FakeImageDataset")
+
+
+class DatasetFolder(Dataset):
+    """Generic folder-of-class-subfolders dataset (reference:
+    vision/datasets/folder.py). Requires an image loader; numpy .npy files
+    load natively, other formats need a user-provided loader."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        _require(root, "DatasetFolder", "root=")
+        extensions = extensions or (".npy",)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                if is_valid_file is not None:
+                    if not is_valid_file(path):
+                        continue
+                elif not fname.lower().endswith(tuple(extensions)):
+                    continue
+                self.samples.append((path, self.class_to_idx[c]))
+        self.loader = loader or (lambda p: np.load(p))
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+    def __len__(self):
+        return len(self.samples)

@@ -3,3 +3,30 @@ from .resnet import (  # noqa: F401
     wide_resnet50_2, BasicBlock, BottleneckBlock,
 )
 from .vit import VisionTransformer, vit_base_patch16, vit_large_patch16  # noqa: F401
+from .small_nets import (  # noqa: F401
+    LeNet, AlexNet, alexnet, SqueezeNet, squeezenet1_0, squeezenet1_1,
+    VGG, vgg11, vgg13, vgg16, vgg19,
+)
+from .mobilenet import (  # noqa: F401
+    MobileNetV1, mobilenet_v1, MobileNetV2, mobilenet_v2,
+    MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small, mobilenet_v3_large,
+)
+from .densenet_inception import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264,
+    GoogLeNet, googlenet, InceptionV3, inception_v3,
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+)
+from .resnet import _resnet as _resnet_factory  # noqa: F401
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnet_factory(BottleneckBlock, 101, groups=32, width=4, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnet_factory(BottleneckBlock, 152, groups=32, width=4, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    return _resnet_factory(BottleneckBlock, 101, width=128, **kwargs)

@@ -1,0 +1,422 @@
+"""DenseNet / GoogLeNet / InceptionV3 / ShuffleNetV2 (reference:
+python/paddle/vision/models/{densenet.py,googlenet.py,inceptionv3.py,
+shufflenetv2.py})."""
+from __future__ import annotations
+
+from ...nn import (
+    Layer, Conv2D, BatchNorm2D, ReLU, MaxPool2D, AvgPool2D,
+    AdaptiveAvgPool2D, Linear, Sequential, Dropout,
+)
+from ... import ops
+
+__all__ = [
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "densenet264", "GoogLeNet", "googlenet", "InceptionV3", "inception_v3",
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+    "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+]
+
+
+# ---------------------------------------------------------------------------
+# DenseNet
+# ---------------------------------------------------------------------------
+
+class _DenseLayer(Layer):
+    def __init__(self, in_ch, growth_rate, bn_size):
+        super().__init__()
+        self.bn1 = BatchNorm2D(in_ch)
+        self.conv1 = Conv2D(in_ch, bn_size * growth_rate, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                            bias_attr=False)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        return ops.concat([x, out], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.bn = BatchNorm2D(in_ch)
+        self.conv = Conv2D(in_ch, out_ch, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, 2)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+_DENSE_CFG = {
+    121: (6, 12, 24, 16), 161: (6, 12, 36, 24), 169: (6, 12, 32, 32),
+    201: (6, 12, 48, 32), 264: (6, 12, 64, 48),
+}
+
+
+class DenseNet(Layer):
+    """Reference: vision/models/densenet.py."""
+
+    def __init__(self, layers=121, growth_rate=32, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        if layers == 161:
+            growth_rate, init_ch = 48, 96
+        else:
+            init_ch = 64
+        block_cfg = _DENSE_CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        feats = [Sequential(
+            Conv2D(3, init_ch, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(init_ch), ReLU(), MaxPool2D(3, 2, 1))]
+        ch = init_ch
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth_rate, bn_size))
+                ch += growth_rate
+            if i != len(block_cfg) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats.append(BatchNorm2D(ch))
+        feats.append(ReLU())
+        self.features = Sequential(*feats)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(ops.flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet (Inception v1)
+# ---------------------------------------------------------------------------
+
+def _bn_conv(in_ch, out_ch, k, stride=1, padding=0):
+    return Sequential(
+        Conv2D(in_ch, out_ch, k, stride=stride, padding=padding,
+               bias_attr=False),
+        BatchNorm2D(out_ch), ReLU())
+
+
+class _Inception(Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, pool_proj):
+        super().__init__()
+        self.b1 = _bn_conv(in_ch, c1, 1)
+        self.b2 = Sequential(_bn_conv(in_ch, c3r, 1), _bn_conv(c3r, c3, 3,
+                                                               padding=1))
+        self.b3 = Sequential(_bn_conv(in_ch, c5r, 1), _bn_conv(c5r, c5, 5,
+                                                               padding=2))
+        self.b4 = Sequential(MaxPool2D(3, 1, 1), _bn_conv(in_ch, pool_proj, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                          axis=1)
+
+
+class GoogLeNet(Layer):
+    """Reference: vision/models/googlenet.py (returns main + 2 aux logits in
+    train, like the reference)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _bn_conv(3, 64, 7, stride=2, padding=3), MaxPool2D(3, 2, 1),
+            _bn_conv(64, 64, 1), _bn_conv(64, 192, 3, padding=1),
+            MaxPool2D(3, 2, 1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, 2, 1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, 2, 1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x)))))
+        x = self.pool4(x)
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(ops.flatten(x, 1)))
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3 (compact faithful structure)
+# ---------------------------------------------------------------------------
+
+class _IncA(Layer):
+    def __init__(self, in_ch, pool_feat):
+        super().__init__()
+        self.b1 = _bn_conv(in_ch, 64, 1)
+        self.b5 = Sequential(_bn_conv(in_ch, 48, 1),
+                             _bn_conv(48, 64, 5, padding=2))
+        self.b3 = Sequential(_bn_conv(in_ch, 64, 1),
+                             _bn_conv(64, 96, 3, padding=1),
+                             _bn_conv(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, 1, 1), _bn_conv(in_ch, pool_feat, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)],
+                          axis=1)
+
+
+class _IncB(Layer):  # grid reduction
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = _bn_conv(in_ch, 384, 3, stride=2)
+        self.b3d = Sequential(_bn_conv(in_ch, 64, 1),
+                              _bn_conv(64, 96, 3, padding=1),
+                              _bn_conv(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return ops.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _IncC(Layer):
+    def __init__(self, in_ch, c7):
+        super().__init__()
+        self.b1 = _bn_conv(in_ch, 192, 1)
+        self.b7 = Sequential(
+            _bn_conv(in_ch, c7, 1), _bn_conv(c7, c7, (1, 7), padding=(0, 3)),
+            _bn_conv(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(
+            _bn_conv(in_ch, c7, 1), _bn_conv(c7, c7, (7, 1), padding=(3, 0)),
+            _bn_conv(c7, c7, (1, 7), padding=(0, 3)),
+            _bn_conv(c7, c7, (7, 1), padding=(3, 0)),
+            _bn_conv(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = Sequential(AvgPool2D(3, 1, 1), _bn_conv(in_ch, 192, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)],
+                          axis=1)
+
+
+class _IncD(Layer):  # grid reduction
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = Sequential(_bn_conv(in_ch, 192, 1),
+                             _bn_conv(192, 320, 3, stride=2))
+        self.b7 = Sequential(
+            _bn_conv(in_ch, 192, 1),
+            _bn_conv(192, 192, (1, 7), padding=(0, 3)),
+            _bn_conv(192, 192, (7, 1), padding=(3, 0)),
+            _bn_conv(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return ops.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _IncE(Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1 = _bn_conv(in_ch, 320, 1)
+        self.b3_stem = _bn_conv(in_ch, 384, 1)
+        self.b3_a = _bn_conv(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _bn_conv(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = Sequential(_bn_conv(in_ch, 448, 1),
+                                   _bn_conv(448, 384, 3, padding=1))
+        self.b3d_a = _bn_conv(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _bn_conv(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, 1, 1), _bn_conv(in_ch, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return ops.concat(
+            [self.b1(x), self.b3_a(s), self.b3_b(s), self.b3d_a(d),
+             self.b3d_b(d), self.bp(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    """Reference: vision/models/inceptionv3.py (299x299 input)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _bn_conv(3, 32, 3, stride=2), _bn_conv(32, 32, 3),
+            _bn_conv(32, 64, 3, padding=1), MaxPool2D(3, 2),
+            _bn_conv(64, 80, 1), _bn_conv(80, 192, 3), MaxPool2D(3, 2))
+        self.blocks = Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160), _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048))
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(ops.flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2
+# ---------------------------------------------------------------------------
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = ops.reshape(x, [n, groups, c // groups, h, w])
+    x = ops.transpose(x, [0, 2, 1, 3, 4])
+    return ops.reshape(x, [n, c, h, w])
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.stride = stride
+        branch = out_ch // 2
+        if stride == 1:
+            self.b2 = Sequential(
+                _bn_conv(branch, branch, 1),
+                Sequential(Conv2D(branch, branch, 3, stride=1, padding=1,
+                                  groups=branch, bias_attr=False),
+                           BatchNorm2D(branch)),
+                _bn_conv(branch, branch, 1))
+        else:
+            self.b1 = Sequential(
+                Sequential(Conv2D(in_ch, in_ch, 3, stride=stride, padding=1,
+                                  groups=in_ch, bias_attr=False),
+                           BatchNorm2D(in_ch)),
+                _bn_conv(in_ch, branch, 1))
+            self.b2 = Sequential(
+                _bn_conv(in_ch, branch, 1),
+                Sequential(Conv2D(branch, branch, 3, stride=stride, padding=1,
+                                  groups=branch, bias_attr=False),
+                           BatchNorm2D(branch)),
+                _bn_conv(branch, branch, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1 = x[:, :half]
+            x2 = x[:, half:]
+            out = ops.concat([x1, self.b2(x2)], axis=1)
+        else:
+            out = ops.concat([self.b1(x), self.b2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_SHUFFLE_CFG = {
+    0.25: (24, (24, 48, 96), 512), 0.5: (24, (48, 96, 192), 1024),
+    1.0: (24, (116, 232, 464), 1024), 1.5: (24, (176, 352, 704), 1024),
+    2.0: (24, (244, 488, 976), 2048),
+}
+
+
+class ShuffleNetV2(Layer):
+    """Reference: vision/models/shufflenetv2.py."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        stem_ch, stage_chs, last_ch = _SHUFFLE_CFG[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(_bn_conv(3, stem_ch, 3, stride=2, padding=1),
+                               MaxPool2D(3, 2, 1))
+        stages = []
+        in_ch = stem_ch
+        for out_ch, repeat in zip(stage_chs, (4, 8, 4)):
+            units = [_ShuffleUnit(in_ch, out_ch, 2)]
+            for _ in range(repeat - 1):
+                units.append(_ShuffleUnit(out_ch, out_ch, 1))
+            stages.append(Sequential(*units))
+            in_ch = out_ch
+        self.stages = Sequential(*stages)
+        self.last_conv = _bn_conv(in_ch, last_ch, 1)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(last_ch, num_classes)
+
+    def forward(self, x):
+        x = self.last_conv(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(2.0, **kw)

@@ -1,0 +1,238 @@
+"""MobileNet v1/v2/v3 (reference: python/paddle/vision/models/
+{mobilenetv1.py,mobilenetv2.py,mobilenetv3.py}).
+
+Depthwise convs map to XLA's feature_group_count path — on TPU they lower to
+the dedicated depthwise conv HLO rather than grouped MXU matmuls.
+"""
+from __future__ import annotations
+
+from ...nn import (
+    Layer, Conv2D, BatchNorm2D, ReLU, ReLU6, Hardswish, Hardsigmoid,
+    AdaptiveAvgPool2D, Linear, Sequential, Dropout,
+)
+from ... import ops
+
+__all__ = ["MobileNetV1", "mobilenet_v1", "MobileNetV2", "mobilenet_v2",
+           "MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _conv_bn(in_ch, out_ch, k, stride=1, groups=1, act=ReLU):
+    pad = (k - 1) // 2
+    layers = [Conv2D(in_ch, out_ch, k, stride=stride, padding=pad,
+                     groups=groups, bias_attr=False), BatchNorm2D(out_ch)]
+    if act is not None:
+        layers.append(act())
+    return Sequential(*layers)
+
+
+class MobileNetV1(Layer):
+    """Reference: mobilenetv1.py (depthwise-separable stacks)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # (out, stride)
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1),
+        ]
+        ch = int(32 * scale)
+        layers = [_conv_bn(3, ch, 3, stride=2)]
+        for out, s in cfg:
+            out = int(out * scale)
+            layers.append(_conv_bn(ch, ch, 3, stride=s, groups=ch))  # dw
+            layers.append(_conv_bn(ch, out, 1))                      # pw
+            ch = out
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, in_ch, out_ch, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_ch * expand_ratio))
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_conv_bn(in_ch, hidden, 1, act=ReLU6))
+        layers += [
+            _conv_bn(hidden, hidden, 3, stride=stride, groups=hidden,
+                     act=ReLU6),
+            _conv_bn(hidden, out_ch, 1, act=None),
+        ]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    """Reference: mobilenetv2.py."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        in_ch = _make_divisible(32 * scale)
+        layers = [_conv_bn(3, in_ch, 3, stride=2, act=ReLU6)]
+        for t, c, n, s in cfg:
+            out_ch = _make_divisible(c * scale)
+            for i in range(n):
+                layers.append(_InvertedResidual(
+                    in_ch, out_ch, s if i == 0 else 1, t))
+                in_ch = out_ch
+        self.last_ch = _make_divisible(1280 * max(1.0, scale))
+        layers.append(_conv_bn(in_ch, self.last_ch, 1, act=ReLU6))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(self.last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(ops.flatten(x, 1))
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+class _SEModule(Layer):
+    def __init__(self, ch, reduction=4):
+        super().__init__()
+        squeeze = _make_divisible(ch // reduction)
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        self.fc = Sequential(
+            Conv2D(ch, squeeze, 1), ReLU(),
+            Conv2D(squeeze, ch, 1), Hardsigmoid())
+
+    def forward(self, x):
+        return x * self.fc(self.pool(x))
+
+
+class _V3Block(Layer):
+    def __init__(self, in_ch, exp, out_ch, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if exp != in_ch:
+            layers.append(_conv_bn(in_ch, exp, 1, act=act))
+        layers.append(_conv_bn(exp, exp, k, stride=stride, groups=exp,
+                               act=act))
+        if use_se:
+            layers.append(_SEModule(exp))
+        layers.append(_conv_bn(exp, out_ch, 1, act=None))
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+_V3_SMALL = [  # k, exp, out, se, act, s
+    (3, 16, 16, True, ReLU, 2), (3, 72, 24, False, ReLU, 2),
+    (3, 88, 24, False, ReLU, 1), (5, 96, 40, True, Hardswish, 2),
+    (5, 240, 40, True, Hardswish, 1), (5, 240, 40, True, Hardswish, 1),
+    (5, 120, 48, True, Hardswish, 1), (5, 144, 48, True, Hardswish, 1),
+    (5, 288, 96, True, Hardswish, 2), (5, 576, 96, True, Hardswish, 1),
+    (5, 576, 96, True, Hardswish, 1),
+]
+
+_V3_LARGE = [
+    (3, 16, 16, False, ReLU, 1), (3, 64, 24, False, ReLU, 2),
+    (3, 72, 24, False, ReLU, 1), (5, 72, 40, True, ReLU, 2),
+    (5, 120, 40, True, ReLU, 1), (5, 120, 40, True, ReLU, 1),
+    (3, 240, 80, False, Hardswish, 2), (3, 200, 80, False, Hardswish, 1),
+    (3, 184, 80, False, Hardswish, 1), (3, 184, 80, False, Hardswish, 1),
+    (3, 480, 112, True, Hardswish, 1), (3, 672, 112, True, Hardswish, 1),
+    (5, 672, 160, True, Hardswish, 2), (5, 960, 160, True, Hardswish, 1),
+    (5, 960, 160, True, Hardswish, 1),
+]
+
+
+class _MobileNetV3(Layer):
+    def __init__(self, cfg, last_exp, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_ch = _make_divisible(16 * scale)
+        layers = [_conv_bn(3, in_ch, 3, stride=2, act=Hardswish)]
+        for k, exp, out, se, act, s in cfg:
+            exp_ch = _make_divisible(exp * scale)
+            out_ch = _make_divisible(out * scale)
+            layers.append(_V3Block(in_ch, exp_ch, out_ch, k, s, se, act))
+            in_ch = out_ch
+        last_conv = _make_divisible(last_exp * scale)
+        layers.append(_conv_bn(in_ch, last_conv, 1, act=Hardswish))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            head = _make_divisible(1280 * scale) if cfg is _V3_LARGE \
+                else _make_divisible(1024 * scale)
+            self.classifier = Sequential(
+                Linear(last_conv, head), Hardswish(), Dropout(0.2),
+                Linear(head, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(ops.flatten(x, 1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 576, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 960, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)

@@ -126,3 +126,345 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
+
+
+def _to_np(img):
+    return img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+
+
+def _wrap_like(img, out):
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def _is_hwc(arr):
+    return arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            arr = _to_np(img)
+            out = arr[::-1].copy() if _is_hwc(arr) else arr[..., ::-1, :].copy()
+            return _wrap_like(img, out)
+        return img
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4  # left, top, right, bottom
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.mode = {"constant": "constant", "edge": "edge",
+                     "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        l, t, r, b = self.padding
+        if _is_hwc(arr):
+            widths = [(t, b), (l, r), (0, 0)]
+        else:
+            widths = [(0, 0)] * (arr.ndim - 2) + [(t, b), (l, r)]
+        kw = {"constant_values": self.fill} if self.mode == "constant" else {}
+        return _wrap_like(img, np.pad(arr, widths, mode=self.mode, **kw))
+
+
+class RandomResizedCrop(BaseTransform):
+    """Crop a random area/aspect patch, resize to `size` (reference:
+    transforms/transforms.py RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        hwc = _is_hwc(arr)
+        h, w = (arr.shape[0], arr.shape[1]) if hwc else arr.shape[-2:]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                break
+        else:
+            ch, cw = min(h, w), min(h, w)
+            i, j = (h - ch) // 2, (w - cw) // 2
+        patch = arr[i:i + ch, j:j + cw] if hwc else arr[..., i:i + ch, j:j + cw]
+        return _wrap_like(img, np.asarray(
+            Resize(self.size, self.interpolation)._apply_image(
+                patch.astype(np.float32))))
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        arr = _to_np(img).astype(np.float32)
+        hwc = _is_hwc(arr)
+        weights = np.asarray([0.299, 0.587, 0.114], np.float32)
+        # luminance from the RGB channels; an alpha channel (RGBA) is dropped
+        rgb = arr[..., :3] if hwc else arr[..., :3, :, :]
+        if (rgb.shape[-1] if hwc else rgb.shape[-3]) == 1:
+            gray = rgb
+        elif hwc:
+            gray = (rgb * weights[None, None, :]).sum(-1, keepdims=True)
+        else:
+            gray = (rgb * weights[:, None, None]).sum(-3, keepdims=True)
+        reps = [1] * gray.ndim
+        reps[-1 if hwc else -3] = self.num_output_channels
+        return _wrap_like(img, np.tile(gray, reps))
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        arr = _to_np(img).astype(np.float32)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return _wrap_like(img, arr * factor)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        arr = _to_np(img).astype(np.float32)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = arr.mean()
+        return _wrap_like(img, (arr - mean) * factor + mean)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        arr = _to_np(img).astype(np.float32)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = np.asarray(Grayscale(3)._apply_image(arr))
+        return _wrap_like(img, gray + factor * (arr - gray))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        assert 0 <= value <= 0.5
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        arr = _to_np(img).astype(np.float32)
+        hwc = _is_hwc(arr)
+        x = arr if hwc else np.moveaxis(arr, -3, -1)
+        scaled = x.max() > 1.5
+        xf = x / 255.0 if scaled else x
+        mx, mn = xf.max(-1), xf.min(-1)
+        diff = mx - mn + 1e-10
+        r, g, b = xf[..., 0], xf[..., 1], xf[..., 2]
+        h = np.where(mx == r, (g - b) / diff % 6,
+                     np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4))
+        h = h / 6.0
+        shift = np.random.uniform(-self.value, self.value)
+        h = (h + shift) % 1.0
+        s = np.where(mx > 0, diff / (mx + 1e-10), 0)
+        v = mx
+        i = np.floor(h * 6.0)
+        f = h * 6.0 - i
+        p, q, t = v * (1 - s), v * (1 - s * f), v * (1 - s * (1 - f))
+        i = (i.astype(int) % 6)[..., None]  # broadcast over the channel dim
+        out = np.select(
+            [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+            [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+             np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+             np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+        if scaled:
+            out = out * 255.0
+        out = out if hwc else np.moveaxis(out, -1, -3)
+        return _wrap_like(img, out.astype(np.float32))
+
+
+class ColorJitter(BaseTransform):
+    """Reference: transforms/transforms.py ColorJitter — randomized order of
+    brightness/contrast/saturation/hue adjustments."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.tfms = [BrightnessTransform(brightness),
+                     ContrastTransform(contrast),
+                     SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.tfms))
+        for k in order:
+            img = self.tfms[k]._apply_image(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    """Random rotation via inverse-mapped sampling (reference:
+    transforms/transforms.py RandomRotation). Supports expand (output canvas
+    grows to hold the whole rotated image), a custom rotation center, and
+    nearest/bilinear interpolation."""
+
+    def __init__(self, degrees, interpolation="bilinear", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _to_np(img).astype(np.float32)
+        hwc = _is_hwc(arr)
+        x = arr if hwc else np.moveaxis(arr, -3, -1)
+        h, w = x.shape[:2]
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        if self.center is not None:
+            cx, cy = self.center
+        else:
+            cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        if self.expand:
+            oh = int(np.ceil(abs(h * np.cos(angle)) + abs(w * np.sin(angle))))
+            ow = int(np.ceil(abs(h * np.sin(angle)) + abs(w * np.cos(angle))))
+        else:
+            oh, ow = h, w
+        # output-pixel centers, shifted so the rotation center stays centered
+        ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
+        yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+        dy = yy - (ocy if self.expand else cy)
+        dx = xx - (ocx if self.expand else cx)
+        ys = dy * np.cos(angle) - dx * np.sin(angle) + cy
+        xs = dy * np.sin(angle) + dx * np.cos(angle) + cx
+
+        if self.interpolation == "nearest":
+            yi = np.round(ys).astype(int)
+            xi = np.round(xs).astype(int)
+            valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            out = x[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)]
+            out = np.where(valid[..., None], out, self.fill)
+        else:
+            y0 = np.floor(ys).astype(int)
+            x0 = np.floor(xs).astype(int)
+            wy = (ys - y0)[..., None]
+            wx = (xs - x0)[..., None]
+
+            def take(yi, xi):
+                valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                v = x[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)]
+                return np.where(valid[..., None], v, self.fill)
+
+            out = (take(y0, x0) * (1 - wy) * (1 - wx)
+                   + take(y0, x0 + 1) * (1 - wy) * wx
+                   + take(y0 + 1, x0) * wy * (1 - wx)
+                   + take(y0 + 1, x0 + 1) * wy * wx)
+        out = out if hwc else np.moveaxis(out, -1, -3)
+        return _wrap_like(img, out.astype(np.float32))
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, keys=None):
+        self.prob, self.scale, self.ratio, self.value = prob, scale, ratio, value
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = _to_np(img).astype(np.float32).copy()
+        hwc = _is_hwc(arr)
+        h, w = (arr.shape[0], arr.shape[1]) if hwc else arr.shape[-2:]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh, ew = int(round(np.sqrt(target * ar))), \
+                int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                value = self.value
+                if not isinstance(value, numbers.Number):
+                    value = np.asarray(value, np.float32)
+                    # per-channel fill broadcasts along the channel axis
+                    value = value[None, None, :] if hwc \
+                        else value[:, None, None]
+                if hwc:
+                    arr[i:i + eh, j:j + ew] = value
+                else:
+                    arr[..., i:i + eh, j:j + ew] = value
+                break
+        return _wrap_like(img, arr)
+
+
+def hflip(img):
+    arr = _to_np(img)
+    out = arr[:, ::-1].copy() if _is_hwc(arr) else arr[..., ::-1].copy()
+    return _wrap_like(img, out)
+
+
+def vflip(img):
+    arr = _to_np(img)
+    out = arr[::-1].copy() if _is_hwc(arr) else arr[..., ::-1, :].copy()
+    return _wrap_like(img, out)
+
+
+def crop(img, top, left, height, width):
+    arr = _to_np(img)
+    out = arr[top:top + height, left:left + width] if _is_hwc(arr) \
+        else arr[..., top:top + height, left:left + width]
+    return _wrap_like(img, out)
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)._apply_image(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)._apply_image(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _to_np(img).astype(np.float32)
+    return _wrap_like(img, arr * brightness_factor)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _to_np(img).astype(np.float32)
+    mean = arr.mean()
+    return _wrap_like(img, (arr - mean) * contrast_factor + mean)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    t = RandomRotation((angle, angle), interpolation=interpolation,
+                       expand=expand, center=center, fill=fill)
+    return t._apply_image(img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)._apply_image(img)

@@ -44,6 +44,7 @@ class TestObservers:
         a[0] = 100.0  # huge outlier
         ob = q.MSEObserver()
         ob.observe(P.to_tensor(a))
+        ob.scale()  # triggers the lazy clip search
 
         def quant_mse(clip):
             s = clip / 127.0

@@ -1,0 +1,282 @@
+"""Vision breadth tests: model zoo forwards, vision.ops vs references,
+transforms, local-file datasets."""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.vision.models as M
+import paddle_tpu.vision.ops as VO
+import paddle_tpu.vision.transforms as T
+import paddle_tpu.vision.datasets as D
+
+
+def _fwd(model, size=64, in_ch=3):
+    x = P.to_tensor(np.random.default_rng(0).standard_normal(
+        (1, in_ch, size, size)).astype("float32"))
+    model.eval()
+    return model(x)
+
+
+class TestModelZoo:
+    def test_lenet(self):
+        out = _fwd(M.LeNet(), size=28, in_ch=1)
+        assert out.shape == [1, 10]
+
+    @pytest.mark.parametrize("ctor,size", [
+        (M.alexnet, 224), (M.squeezenet1_0, 64), (M.squeezenet1_1, 64),
+        (lambda: M.vgg11(num_classes=7), 32),
+        (lambda: M.mobilenet_v1(num_classes=7), 64),
+        (lambda: M.mobilenet_v2(num_classes=7), 64),
+        (lambda: M.mobilenet_v3_small(num_classes=7), 64),
+        (lambda: M.mobilenet_v3_large(num_classes=7), 64),
+        (lambda: M.densenet121(num_classes=7), 64),
+        (lambda: M.googlenet(num_classes=7), 64),
+        (lambda: M.shufflenet_v2_x0_25(num_classes=7), 64),
+    ])
+    def test_forward_shapes(self, ctor, size):
+        model = ctor()
+        out = _fwd(model, size=size)
+        expected = model.num_classes if hasattr(model, "num_classes") else 7
+        assert out.shape[0] == 1 and out.shape[-1] in (7, 1000)
+
+    def test_inception_v3(self):
+        out = _fwd(M.inception_v3(num_classes=5), size=299)
+        assert out.shape == [1, 5]
+
+    def test_resnext_wide_factories(self):
+        assert _fwd(M.resnext50_32x4d(num_classes=4), 64).shape == [1, 4]
+        assert _fwd(M.wide_resnet50_2(num_classes=4), 64).shape == [1, 4]
+
+    def test_param_counts_plausible(self):
+        def count(m):
+            return sum(int(np.prod(p.shape)) for p in m.parameters())
+        # well-known parameter counts (±1%)
+        assert abs(count(M.alexnet()) - 61.1e6) / 61.1e6 < 0.02
+        assert abs(count(M.mobilenet_v2()) - 3.5e6) / 3.5e6 < 0.05
+        assert abs(count(M.densenet121()) - 7.98e6) / 7.98e6 < 0.02
+        assert abs(count(M.vgg16()) - 138.4e6) / 138.4e6 < 0.01
+        assert abs(count(M.inception_v3()) - 23.8e6) / 23.8e6 < 0.05
+
+    def test_vgg_train_step(self):
+        import paddle_tpu.optimizer as opt
+        model = M.vgg11(num_classes=4)
+        o = opt.SGD(0.01, parameters=model.parameters())
+        x = P.to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 3, 32, 32)).astype("float32"))
+        loss = nn.functional.cross_entropy(
+            model(x), P.to_tensor(np.asarray([0, 1], dtype="int64")))
+        loss.backward()
+        o.step()
+
+
+class TestVisionOps:
+    def test_nms_matches_greedy_numpy(self, rng):
+        n = 40
+        boxes = rng.uniform(0, 80, (n, 2))
+        boxes = np.concatenate([boxes, boxes + rng.uniform(8, 40, (n, 2))],
+                               axis=1).astype("float32")
+        scores = rng.random(n).astype("float32")
+
+        def ref_nms(bx, sc, thr):
+            order = np.argsort(-sc)
+            keep = []
+            while len(order):
+                i = order[0]
+                keep.append(i)
+                if len(order) == 1:
+                    break
+                rest = order[1:]
+                ious = np.asarray(
+                    VO.box_iou(P.to_tensor(bx[i:i + 1]),
+                               P.to_tensor(bx[rest])).numpy())[0]
+                order = rest[ious <= thr]
+            return keep
+
+        got = VO.nms(P.to_tensor(boxes), 0.4,
+                     scores=P.to_tensor(scores)).numpy().tolist()
+        assert got == ref_nms(boxes, scores, 0.4)
+
+    def test_nms_categories(self, rng):
+        boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], "float32")
+        scores = np.asarray([0.9, 0.8], "float32")
+        cats = np.asarray([0, 1])
+        got = VO.nms(P.to_tensor(boxes), 0.3, scores=P.to_tensor(scores),
+                     category_idxs=P.to_tensor(cats),
+                     categories=[0, 1]).numpy()
+        assert len(got) == 2  # different categories never suppress
+
+    def test_roi_align_integer_samples(self, rng):
+        feat = rng.standard_normal((1, 2, 8, 8)).astype("float32")
+        boxes = np.asarray([[0, 0, 8, 8]], "float32")
+        # sampling_ratio=1 on 2-px bins samples exactly at (2i+1, 2j+1)
+        out = VO.roi_align(P.to_tensor(feat), P.to_tensor(boxes),
+                           P.to_tensor(np.asarray([1])), output_size=4,
+                           sampling_ratio=1, aligned=False).numpy()
+        assert out.shape == (1, 2, 4, 4)
+        ref = feat[0][:, 1::2, 1::2]
+        np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-4)
+
+    def test_roi_align_matches_numpy_bilinear(self, rng):
+        feat = rng.standard_normal((1, 1, 6, 6)).astype("float32")
+        box = np.asarray([[0.7, 1.1, 4.9, 5.3]], "float32")
+        out = VO.roi_align(P.to_tensor(feat), P.to_tensor(box),
+                           P.to_tensor(np.asarray([1])), output_size=2,
+                           sampling_ratio=2, aligned=True).numpy()
+
+        def bilin(f, y, x):
+            y0, x0 = int(np.floor(y)), int(np.floor(x))
+            H, W = f.shape
+            total = 0.0
+            for yy, wy in ((y0, 1 - (y - y0)), (y0 + 1, y - y0)):
+                for xx, wx in ((x0, 1 - (x - x0)), (x0 + 1, x - x0)):
+                    v = f[min(max(yy, 0), H - 1), min(max(xx, 0), W - 1)] \
+                        if 0 <= yy < H and 0 <= xx < W else 0.0
+                    total += wy * wx * v
+            return total
+
+        x1, y1, x2, y2 = box[0] - np.asarray([0.5, 0.5, 0.5, 0.5])
+        bh, bw = (y2 - y1) / 2, (x2 - x1) / 2
+        ref = np.zeros((2, 2))
+        for i in range(2):
+            for j in range(2):
+                acc = []
+                for sy in range(2):
+                    for sx in range(2):
+                        yy = y1 + (i + (sy + 0.5) / 2) * bh
+                        xx = x1 + (j + (sx + 0.5) / 2) * bw
+                        acc.append(bilin(feat[0, 0], yy, xx))
+                ref[i, j] = np.mean(acc)
+        np.testing.assert_allclose(out[0, 0], ref, rtol=1e-4, atol=1e-4)
+
+    def test_roi_align_grad(self, rng):
+        feat = P.to_tensor(rng.standard_normal((1, 2, 8, 8)).astype("float32"),
+                           stop_gradient=False)
+        out = VO.roi_align(feat, P.to_tensor(
+            np.asarray([[1, 1, 6, 6]], "float32")),
+            P.to_tensor(np.asarray([1])), 2)
+        out.sum().backward()
+        assert feat.grad is not None and abs(feat.grad.numpy()).sum() > 0
+
+    def test_roi_pool_max_semantics(self):
+        feat = np.zeros((1, 1, 8, 8), "float32")
+        feat[0, 0, 2, 2] = 5.0
+        feat[0, 0, 6, 6] = 7.0
+        out = VO.roi_pool(P.to_tensor(feat), P.to_tensor(
+            np.asarray([[0, 0, 7, 7]], "float32")),
+            P.to_tensor(np.asarray([1])), 2).numpy()
+        assert out[0, 0, 0, 0] == 5.0
+        assert out[0, 0, 1, 1] == 7.0
+
+    def test_deform_conv_zero_offset_equals_conv(self, rng):
+        x = rng.standard_normal((1, 4, 10, 10)).astype("float32")
+        w = rng.standard_normal((6, 4, 3, 3)).astype("float32") * 0.2
+        off = np.zeros((1, 2 * 9, 8, 8), "float32")
+        got = VO.deform_conv2d(P.to_tensor(x), P.to_tensor(off),
+                               P.to_tensor(w)).numpy()
+        import jax
+        dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+        ref = np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), "VALID",
+            dimension_numbers=dn))
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_deform_conv_layer_and_mask(self, rng):
+        layer = VO.DeformConv2D(3, 5, 3, padding=1)
+        x = P.to_tensor(rng.standard_normal((2, 3, 8, 8)).astype("float32"))
+        off = P.to_tensor(
+            0.1 * rng.standard_normal((2, 18, 8, 8)).astype("float32"))
+        mask = P.to_tensor(np.ones((2, 9, 8, 8), "float32"))
+        out = layer(x, off, mask)
+        assert out.shape == [2, 5, 8, 8]
+
+    def test_psroi_pool(self, rng):
+        feat = rng.standard_normal((1, 2 * 4, 8, 8)).astype("float32")
+        out = VO.psroi_pool(P.to_tensor(feat), P.to_tensor(
+            np.asarray([[0, 0, 8, 8]], "float32")),
+            P.to_tensor(np.asarray([1])), 2).numpy()
+        assert out.shape == (1, 2, 2, 2)
+
+
+class TestTransforms:
+    def test_pipeline(self):
+        img = (np.random.rand(32, 32, 3) * 255).astype("float32")
+        pipe = T.Compose([
+            T.RandomResizedCrop(16), T.ColorJitter(0.2, 0.2, 0.2, 0.1),
+            T.RandomRotation(10), T.RandomErasing(prob=1.0),
+            T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3)])
+        out = pipe(img)
+        assert out.shape == [3, 16, 16]
+
+    def test_functional(self):
+        img = np.arange(48, dtype="float32").reshape(4, 4, 3)
+        np.testing.assert_allclose(T.hflip(img), img[:, ::-1])
+        np.testing.assert_allclose(T.vflip(img), img[::-1])
+        np.testing.assert_allclose(T.crop(img, 1, 1, 2, 2), img[1:3, 1:3])
+        assert T.pad(img, 2).shape == (8, 8, 3)
+        np.testing.assert_allclose(T.adjust_brightness(img, 2.0), img * 2)
+        g = T.to_grayscale(img)
+        assert g.shape == (4, 4, 1)
+
+    def test_hue_identity(self):
+        x = np.random.rand(8, 8, 3).astype("float32")
+        out = np.asarray(T.HueTransform(1e-9)._apply_image(x))
+        np.testing.assert_allclose(out, x, atol=1e-5)
+
+    def test_rotation_90(self):
+        img = np.zeros((5, 5, 1), "float32")
+        img[0, 2] = 1.0
+        out = np.asarray(T.rotate(img, 90))
+        # inverse-map rotation by 90° sends the top-center pixel to a side
+        assert out.sum() > 0.5
+
+
+class TestDatasets:
+    def test_mnist_local(self, tmp_path):
+        imgs = (np.random.rand(5, 28, 28) * 255).astype("uint8")
+        labels = np.arange(5, dtype="uint8")
+        with gzip.open(tmp_path / "im.gz", "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 5, 28, 28) + imgs.tobytes())
+        with open(tmp_path / "lb", "wb") as f:
+            f.write(struct.pack(">II", 2049, 5) + labels.tobytes())
+        ds = D.MNIST(image_path=str(tmp_path / "im.gz"),
+                     label_path=str(tmp_path / "lb"))
+        x, y = ds[2]
+        assert x.shape == (28, 28, 1) and y == 2 and len(ds) == 5
+
+    def test_cifar_local(self, tmp_path):
+        batch = {b"data": (np.random.rand(4, 3072) * 255).astype("uint8"),
+                 b"labels": [0, 1, 2, 3]}
+        os.makedirs(tmp_path / "cifar-10-batches-py")
+        with open(tmp_path / "cifar-10-batches-py" / "data_batch_1",
+                  "wb") as f:
+            pickle.dump(batch, f)
+        with tarfile.open(tmp_path / "c10.tar.gz", "w:gz") as tf:
+            tf.add(tmp_path / "cifar-10-batches-py",
+                   arcname="cifar-10-batches-py")
+        ds = D.Cifar10(data_file=str(tmp_path / "c10.tar.gz"), mode="train")
+        x, y = ds[1]
+        assert x.shape == (32, 32, 3) and y == 1
+
+    def test_dataset_folder(self, tmp_path):
+        for cls in ("cat", "dog"):
+            os.makedirs(tmp_path / "root" / cls)
+            for i in range(2):
+                np.save(tmp_path / "root" / cls / f"{i}.npy",
+                        np.zeros((3, 4, 4), "float32"))
+        ds = D.DatasetFolder(str(tmp_path / "root"))
+        assert len(ds) == 4
+        img, label = ds[3]
+        assert img.shape == (3, 4, 4) and label == 1
+
+    def test_gated_error(self):
+        with pytest.raises(RuntimeError, match="downloads are disabled"):
+            D.MNIST()
