@@ -41,11 +41,14 @@ def main():
     S = int(os.environ.get("BENCH_SEQ", "2048"))
     n_layers = int(os.environ.get("BENCH_LAYERS", "8"))
     steps = int(os.environ.get("BENCH_STEPS", "5"))
+    hidden = int(os.environ.get("BENCH_HIDDEN", "2048"))
+    ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+    heads = max(hidden // 128, 1)
 
     cfg = LlamaConfig(
-        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-        num_hidden_layers=n_layers, num_attention_heads=16,
-        num_key_value_heads=16, max_position_embeddings=S,
+        vocab_size=32000, hidden_size=hidden, intermediate_size=ff,
+        num_hidden_layers=n_layers, num_attention_heads=heads,
+        num_key_value_heads=heads, max_position_embeddings=S,
     )
     paddle.seed(0)
     model = LlamaForCausalLM(cfg).bfloat16()

@@ -18,7 +18,11 @@ import numpy as np
 
 
 class Generator:
-    """Stateful key holder. ``next_key()`` splits off a fresh subkey."""
+    """Stateful key holder. ``next_key()`` splits off a fresh subkey.
+
+    The device key is created LAZILY: ``jax.random.key`` initializes the
+    backend, and importing the framework must not touch the device (host-only
+    tools — launcher, store, data pipeline — run without one)."""
 
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
@@ -27,7 +31,7 @@ class Generator:
     def manual_seed(self, seed: int):
         with getattr(self, "_lock", threading.Lock()):
             self._seed = int(seed)
-            self._key = jax.random.key(int(seed))
+            self._key = None  # materialized on first device use
             self._counter = 0
         return self
 
@@ -36,6 +40,8 @@ class Generator:
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._counter += 1
             return jax.random.fold_in(self._key, self._counter)
 
@@ -54,7 +60,7 @@ class Generator:
         seed, counter = state
         with self._lock:
             self._seed = int(seed)
-            self._key = jax.random.key(int(seed))
+            self._key = None
             self._counter = int(counter)
 
 

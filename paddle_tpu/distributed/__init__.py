@@ -32,3 +32,4 @@ from .launch_utils import spawn  # noqa: F401
 from .watchdog import Watchdog, ErrorHandlingMode  # noqa: F401
 from .auto_tuner import AutoTuner  # noqa: F401
 from . import launch  # noqa: F401
+from . import rpc  # noqa: F401

@@ -1,0 +1,184 @@
+"""paddle.distributed.rpc analog — simple cross-worker RPC.
+
+Reference: paddle/fluid/distributed/rpc/ + python/paddle/distributed/rpc/
+(brpc-based: init_rpc/rpc_sync/rpc_async/shutdown, WorkerInfo registry).
+TPU-native: device traffic never uses RPC (collectives compile into programs);
+this is the host-side control-plane analog — each worker runs a socket server
+thread, the worker registry lives in the TCPStore, payloads are pickled
+callables + args (callables must be importable in the callee, same contract as
+the reference).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import pickle
+import socket
+import struct
+import threading
+
+from .store import TCPStore, _recv_full, create_or_get_global_tcp_store
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, host, port):
+        self.name = name
+        self.rank = rank
+        self.host = host
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.host}, port={self.port})")
+
+
+class _RpcGlobal:
+    store: TCPStore | None = None
+    server: socket.socket | None = None
+    server_thread: threading.Thread | None = None
+    pool: concurrent.futures.ThreadPoolExecutor | None = None
+    name: str | None = None
+    rank: int = -1
+    world_size: int = 0
+    stopping = False
+
+
+_g = _RpcGlobal()
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def _serve_conn(conn):
+    try:
+        while True:
+            (n,) = struct.unpack("!I", _recv_full(conn, 4))
+            fn, args, kwargs = pickle.loads(_recv_full(conn, n))
+            try:
+                result = ("ok", fn(*args, **kwargs))
+            except Exception as e:  # ship the exception back to the caller
+                result = ("err", e)
+            try:
+                payload = pickle.dumps(result)
+            except Exception as e:
+                # unpicklable result/exception: ship a serializable summary so
+                # the caller sees the real failure, not a ConnectionError
+                import traceback
+                payload = pickle.dumps(
+                    ("err", RuntimeError(
+                        f"rpc result not picklable ({e!r}); original "
+                        f"result/exception: {result[1]!r}\n"
+                        f"{traceback.format_exc()}")))
+            _send_msg(conn, payload)
+    except (ConnectionError, struct.error, OSError):
+        pass
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def _server_loop(srv):
+    while not _g.stopping:
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return
+        threading.Thread(target=_serve_conn, args=(conn,), daemon=True).start()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC server and register it (reference:
+    python/paddle/distributed/rpc/rpc.py init_rpc)."""
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if world_size is None:
+        world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if master_endpoint is not None:
+        host, _, port = master_endpoint.partition(":")
+        _g.store = TCPStore(host, int(port), is_master=(rank == 0),
+                            world_size=world_size)
+    else:
+        _g.store = create_or_get_global_tcp_store()
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", 0))
+    srv.listen(64)
+    port = srv.getsockname()[1]
+    host = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
+    _g.server = srv
+    _g.stopping = False
+    _g.server_thread = threading.Thread(target=_server_loop, args=(srv,),
+                                        daemon=True)
+    _g.server_thread.start()
+    _g.pool = concurrent.futures.ThreadPoolExecutor(max_workers=16)
+    _g.name = name
+    _g.rank = rank
+    _g.world_size = world_size
+    _g.store.set(f"__rpc/worker/{name}",
+                 {"rank": rank, "host": host, "port": port})
+    _g.store.set(f"__rpc/name_by_rank/{rank}", name)
+    # barrier: all workers registered before anyone issues calls
+    _g.store.barrier("__rpc_init", world_size=world_size)
+
+
+def get_worker_info(name=None) -> WorkerInfo:
+    ent = _g.store.wait(f"__rpc/worker/{name or _g.name}", timeout=60)
+    return WorkerInfo(name or _g.name, ent["rank"], ent["host"], ent["port"])
+
+
+def get_all_worker_infos():
+    infos = []
+    for r in range(_g.world_size):
+        nm = _g.store.get(f"__rpc/name_by_rank/{r}")
+        if nm is not None:
+            infos.append(get_worker_info(nm))
+    return infos
+
+
+def _call(to_name, fn, args, kwargs, timeout):
+    info = get_worker_info(to_name)
+    with socket.create_connection((info.host, info.port),
+                                  timeout=timeout or 120) as sock:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_msg(sock, pickle.dumps((fn, args, kwargs)))
+        (n,) = struct.unpack("!I", _recv_full(sock, 4))
+        status, payload = pickle.loads(_recv_full(sock, n))
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to, fn, args=(), kwargs=None, timeout=None):
+    """Blocking remote call (reference: rpc.py rpc_sync)."""
+    return _call(to, fn, tuple(args), kwargs or {}, timeout)
+
+
+def rpc_async(to, fn, args=(), kwargs=None, timeout=None):
+    """Returns a concurrent.futures.Future (reference: rpc.py rpc_async,
+    which returns a FutureWrapper with .wait())."""
+    fut = _g.pool.submit(_call, to, fn, tuple(args), kwargs or {}, timeout)
+    fut.wait = fut.result  # paddle calls .wait()
+    return fut
+
+
+def shutdown():
+    """Graceful teardown: barrier so in-flight peers finish, then stop."""
+    if _g.store is not None:
+        try:
+            _g.store.barrier("__rpc_shutdown", world_size=_g.world_size)
+        except Exception:
+            pass
+    _g.stopping = True
+    if _g.server is not None:
+        try:
+            _g.server.close()
+        except OSError:
+            pass
+    if _g.pool is not None:
+        _g.pool.shutdown(wait=False)
+    _g.server = None
+    _g.store = None

@@ -5,3 +5,4 @@ MoE layers, asp sparsity).
 """
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401

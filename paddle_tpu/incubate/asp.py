@@ -1,0 +1,130 @@
+"""paddle.incubate.asp analog — Automatic SParsity (2:4 structured pruning).
+
+Reference: python/paddle/incubate/asp/ (decorate wraps the optimizer so masks
+re-apply after each step; prune_model computes n:m masks per supported layer;
+check_sparsity validates). TPU-native: masks are plain multiplicative buffers
+applied to weight values — XLA folds the elementwise mask into the consumer
+matmul; there's no sparse-tensor-core path to target, so the win is model
+compression/regularization parity with the reference API.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["decorate", "prune_model", "check_sparsity", "reset_excluded_layers",
+           "set_excluded_layers"]
+
+_excluded: set[str] = set()
+_masks: dict[int, np.ndarray] = {}
+
+
+def set_excluded_layers(layer_names, main_program=None):
+    for n in (layer_names if isinstance(layer_names, (list, tuple))
+              else [layer_names]):
+        _excluded.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _nm_mask_2d(w, n=2, m=4):
+    """Keep the n largest-magnitude entries of every m along the input dim."""
+    rows, cols = w.shape
+    pad = (-cols) % m
+    wp = np.pad(np.abs(w), ((0, 0), (0, pad)))
+    groups = wp.reshape(rows, -1, m)
+    order = np.argsort(-groups, axis=-1)
+    mask = np.zeros_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[..., :n], True, axis=-1)
+    mask = mask.reshape(rows, -1)[:, :cols]
+    return mask
+
+
+def _supported(layer):
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+    return isinstance(layer, (Linear, Conv2D))
+
+
+def _iter_prunable(model):
+    for name, sub in model.named_sublayers():
+        if name in _excluded or not _supported(sub):
+            continue
+        yield name, sub
+
+
+def _to_out_in(w):
+    """View the weight as (out, in*): Linear stores (in, out) → transpose;
+    Conv stores (out, in/g, kh, kw) → flatten trailing dims."""
+    if w.ndim == 2:
+        return w.T, "T"
+    return w.reshape(w.shape[0], -1), "flat"
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute and apply n:m masks (groups run along the INPUT dim) to every
+    supported layer's weight (reference: asp/asp.py prune_model)."""
+    pruned = {}
+    for name, sub in _iter_prunable(model):
+        w = sub.weight.numpy()
+        w2, kind = _to_out_in(w)
+        mask2 = _nm_mask_2d(w2, n, m)
+        mask = mask2.T if kind == "T" else mask2.reshape(w.shape)
+        sub.weight._value = np.asarray(w * mask, dtype=w.dtype)
+        if with_mask:
+            _masks[id(sub.weight)] = mask
+        pruned[name] = mask
+    return pruned
+
+
+def check_sparsity(weight, n=2, m=4):
+    """True iff every m-group along the input dim has ≤ n nonzeros."""
+    w = weight.numpy() if isinstance(weight, Tensor) else np.asarray(weight)
+    w2, _ = _to_out_in(w)
+    rows, cols = w2.shape
+    pad = (-cols) % m
+    wp = np.pad(w2 != 0, ((0, 0), (0, pad)))
+    return bool((wp.reshape(rows, -1, m).sum(-1) <= n).all())
+
+
+class _MaskedOptimizer:
+    """Wraps an optimizer so the sparsity masks re-apply after each step
+    (the reference's OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def step(self):
+        self._inner.step()
+        from ..core.tensor import dispatch, no_grad
+        import jax.numpy as jnp
+        with no_grad():
+            for p in self._inner._parameter_list:
+                mask = _masks.get(id(p))
+                if mask is None:
+                    continue
+                # on-device multiply: the mask uploads once and XLA folds the
+                # product into the next consumer; no per-step host round trip
+                dev_key = ("dev", id(p))
+                if dev_key not in _masks:
+                    _masks[dev_key] = jnp.asarray(
+                        mask, dtype=jnp.asarray(p._value).dtype)
+                dmask = _masks[dev_key]
+                masked = dispatch(lambda v: v * dmask, (p,), {},
+                                  name="asp_mask")
+                p._value = masked._value
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)
+
+
+def decorate(optimizer):
+    """Reference: asp/asp.py decorate."""
+    return _MaskedOptimizer(optimizer)

@@ -87,6 +87,31 @@ class ChainDataset(IterableDataset):
             yield from d
 
 
+class ComposeDataset(Dataset):
+    """Zip map-style datasets: sample i = concatenated fields of each dataset's
+    sample i (reference: io/dataloader/dataset.py ComposeDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ComposeDataset needs at least one dataset")
+        n = len(self.datasets[0])
+        for d in self.datasets[1:]:
+            if len(d) != n:
+                raise ValueError("ComposeDataset requires equal lengths")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            sample = d[idx]
+            out.extend(sample if isinstance(sample, (tuple, list))
+                       else [sample])
+        return tuple(out)
+
+
 def random_split(dataset, lengths, generator=None):
     if all(isinstance(l, float) for l in lengths):
         n = len(dataset)
@@ -137,6 +162,23 @@ class RandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """Random permutation over a fixed index subset (reference:
+    io/dataloader/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        perm = _random.default_generator.next_seed()
+        rng = np.random.default_rng(perm)
+        return iter(np.asarray(self.indices)[
+            rng.permutation(len(self.indices))].tolist())
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class WeightedRandomSampler(Sampler):

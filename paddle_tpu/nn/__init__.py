@@ -6,7 +6,8 @@ from .layer.common import (  # noqa: F401
     Identity, Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
     Embedding, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle,
     PixelUnshuffle, ChannelShuffle, Bilinear, Pad1D, Pad2D, Pad3D, ZeroPad2D,
-    CosineSimilarity, Unfold, Fold,
+    CosineSimilarity, Unfold, Fold, PairwiseDistance, Unflatten,
+    FeatureAlphaDropout,
 )
 from .layer.conv import (  # noqa: F401
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
@@ -14,7 +15,7 @@ from .layer.conv import (  # noqa: F401
 from .layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
     RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
-    LocalResponseNorm,
+    LocalResponseNorm, SpectralNorm,
 )
 from .layer.pooling import (  # noqa: F401
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
@@ -30,7 +31,9 @@ from .layer.activation import (  # noqa: F401
 from .layer.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     SmoothL1Loss, HuberLoss, KLDivLoss, MarginRankingLoss, CTCLoss,
-    CosineEmbeddingLoss, TripletMarginLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, HingeEmbeddingLoss, SoftMarginLoss,
+    MultiLabelSoftMarginLoss, PoissonNLLLoss, GaussianNLLLoss,
+    TripletMarginWithDistanceLoss,
 )
 from .layer.containers import (  # noqa: F401
     Sequential, LayerList, LayerDict, ParameterList,

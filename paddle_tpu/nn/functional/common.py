@@ -50,20 +50,37 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return dropout(x, p=p, axis=ax, training=training)
 
 
-def alpha_dropout(x, p=0.5, training=True, name=None):
-    if not training or p == 0.0:
-        return x
+def _alpha_dropout_impl(x, p, mask_shape_fn, op_name):
+    """Shared SELU-preserving dropout math; mask_shape_fn(v) picks element- vs
+    channel-wise masking."""
     key = _random.next_key()
 
     def fn(v):
         alpha = 1.6732632423543772
         scale = 1.0507009873554805
         alpha_p = -alpha * scale
-        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape_fn(v))
         a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2)))
         b = -a * alpha_p * p
         return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
-    return dispatch(fn, (x,), {}, name="alpha_dropout")
+
+    return dispatch(fn, (x,), {}, name=op_name)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    return _alpha_dropout_impl(x, p, lambda v: v.shape, "alpha_dropout")
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout zeroing whole (N, C) channels (reference:
+    nn/functional/common.py feature_alpha_dropout)."""
+    if not training or p == 0.0:
+        return x
+    return _alpha_dropout_impl(
+        x, p, lambda v: v.shape[:2] + (1,) * (v.ndim - 2),
+        "feature_alpha_dropout")
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
@@ -283,3 +300,12 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
                          1.0 / p)
         return v / jnp.maximum(norm, epsilon)
     return dispatch(fn, (x,), {}, name="normalize")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """||x - y + eps||_p along the last axis (reference: functional/distance.py)."""
+    def fn(a, b):
+        d = jnp.abs(a - b) + epsilon
+        return jnp.power(jnp.sum(jnp.power(d, p), axis=-1, keepdims=keepdim),
+                         1.0 / p)
+    return dispatch(fn, (x, y), {}, name="pairwise_distance")

@@ -283,3 +283,72 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(loss, reduction)
     return dispatch(fn, (log_probs, labels, input_lengths, label_lengths), {},
                     name="ctc_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label*input)) (reference: nn/functional/loss.py);
+    softplus form keeps large logits finite."""
+    def fn(a, l):
+        return _reduce(jax.nn.softplus(-l * a), reduction)
+    return dispatch(fn, (input, label), {}, name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def fn(a, l, w):
+        loss = -(l * jax.nn.log_sigmoid(a)
+                 + (1 - l) * jax.nn.log_sigmoid(-a))
+        if w is not None:
+            loss = loss * w
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+    return dispatch(fn, (input, label, weight), {},
+                    name="multi_label_soft_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(a, l):
+        if log_input:
+            loss = jnp.exp(a) - l * a
+        else:
+            loss = a - l * jnp.log(a + epsilon)
+        if full:
+            # Stirling approximation for the label factorial term
+            stirling = l * jnp.log(jnp.maximum(l, 1.0)) - l \
+                + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(l, 1.0))
+            loss = loss + jnp.where(l > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return dispatch(fn, (input, label), {}, name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, l, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(l - mu) / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(loss, reduction)
+    return dispatch(fn, (input, label, variance), {}, name="gaussian_nll_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    # user distance_function operates on framework Tensors
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_swap = distance_function(positive, negative)
+        d_neg_v = dispatch(lambda a, b: jnp.minimum(a, b),
+                           (d_neg, d_swap), {}, name="tmwd_min")
+    else:
+        d_neg_v = d_neg
+
+    def fn(dp, dn):
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return dispatch(fn, (d_pos, d_neg_v), {},
+                    name="triplet_margin_with_distance_loss")

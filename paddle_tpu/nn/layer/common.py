@@ -245,3 +245,39 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, *self.args)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.args = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        p, e, k = self.args
+        return F.pairwise_distance(x, y, p, e, k)
+
+
+class Unflatten(Layer):
+    """Reference: nn/layer/common.py Unflatten — expand one axis to a shape."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, list(shape)
+
+    def forward(self, x):
+        from ... import ops
+        new_shape = list(x.shape)
+        axis = self.axis % len(new_shape)
+        new_shape[axis:axis + 1] = self.shape
+        return ops.reshape(x, new_shape)
+
+
+class FeatureAlphaDropout(Layer):
+    """Alpha dropout zeroing whole channels (reference: nn/layer/common.py)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)

@@ -131,3 +131,64 @@ class TripletMarginLoss(Layer):
     def forward(self, input, positive, negative):
         m, p, e, s, r = self.args
         return F.triplet_margin_loss(input, positive, negative, m, p, e, s, r)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, label):
+        return F.hinge_embedding_loss(input, label, self.margin, self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.args = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        li, fl, e, r = self.args
+        return F.poisson_nll_loss(input, label, li, fl, e, r)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self.args = (full, epsilon, reduction)
+
+    def forward(self, input, label, variance):
+        fl, e, r = self.args
+        return F.gaussian_nll_loss(input, label, variance, fl, e, r)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.args = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        d, m, s, r = self.args
+        return F.triplet_margin_with_distance_loss(input, positive, negative,
+                                                   d, m, s, r)

@@ -165,6 +165,53 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12, name=None):
+    """Normalizes an input weight tensor by its largest singular value via
+    power iteration (reference: nn/layer/norm.py SpectralNorm — the layer form
+    that takes the weight as forward input; the wrapper form is
+    nn.utils.spectral_norm)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm layer: use nn.utils.spectral_norm")
+        import numpy as np
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = epsilon
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=None)
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=None)
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+        from ...core.tensor import dispatch
+        dim, iters, eps = self.dim, self.power_iters, self.eps
+
+        def fn(w, u, v):
+            import jax as _jax
+            perm = [dim] + [i for i in range(w.ndim) if i != dim]
+            mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+            # power iteration runs under stop_gradient: u/v are constants in
+            # the backward pass, matching the reference (only sigma = uᵀWv is
+            # differentiated)
+            mat_ng = _jax.lax.stop_gradient(mat)
+            for _ in range(iters):
+                v = mat_ng.T @ u
+                v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+                u = mat_ng @ v
+                u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+            u = _jax.lax.stop_gradient(u)
+            v = _jax.lax.stop_gradient(v)
+            sigma = u @ mat @ v
+            return w / sigma, u, v
+
+        out, new_u, new_v = dispatch(
+            fn, (weight, self.weight_u, self.weight_v), {},
+            name="spectral_norm")
+        self.weight_u._value = new_u._value
+        self.weight_v._value = new_v._value
+        return out

@@ -1,0 +1,25 @@
+"""paddle.onnx analog — ONNX export (gated).
+
+Reference: python/paddle/onnx/export.py (delegates to the external paddle2onnx
+converter). This environment has no ONNX toolchain; the TPU-native deployment
+path is paddle_tpu.static.save_inference_model (serialized StableHLO via
+jax.export) + paddle_tpu.inference.Predictor. export() raises with that
+guidance unless the `onnx` package is importable.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise RuntimeError(
+            "ONNX export needs the `onnx` package, which is not available in "
+            "this environment. Use paddle_tpu.static.save_inference_model "
+            "(StableHLO via jax.export) + paddle_tpu.inference.Predictor for "
+            "deployment.") from None
+    raise NotImplementedError(
+        "onnx conversion from jaxpr is not implemented; use "
+        "paddle_tpu.static.save_inference_model for deployment")

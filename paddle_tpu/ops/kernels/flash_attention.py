@@ -21,8 +21,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 512
+import os
+
+DEFAULT_BLOCK_Q = int(os.environ.get("PT_FLASH_BLOCK_Q", "256"))
+DEFAULT_BLOCK_K = int(os.environ.get("PT_FLASH_BLOCK_K", "512"))
 NEG_INF = np.float32(-1e30)
 # Index-map literals MUST be i32: python ints become i64 constants under the
 # framework's jax_enable_x64 and Mosaic then fails to legalize the index-map

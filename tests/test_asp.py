@@ -1,0 +1,61 @@
+"""incubate.asp (2:4 automatic sparsity) tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate import asp
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    asp.reset_excluded_layers()
+    yield
+    asp.reset_excluded_layers()
+
+
+def test_prune_gives_2_4_sparsity(rng):
+    model = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+    masks = asp.prune_model(model)
+    assert len(masks) == 2
+    for layer in (model[0], model[2]):
+        assert asp.check_sparsity(layer.weight)
+        w = layer.weight.numpy()
+        # exactly half the entries survive in each full group of 4
+        assert (w != 0).sum() <= w.size // 2 + w.shape[0]
+
+
+def test_prune_keeps_largest_magnitude():
+    lin = nn.Linear(4, 1)
+    lin.weight._value = np.asarray([[0.1], [0.9], [0.2], [0.8]], "float32")
+    model = nn.Sequential(lin)
+    asp.prune_model(model)
+    w = lin.weight.numpy().ravel()
+    # mask groups along the input dim of the (in, out) weight
+    np.testing.assert_allclose(w, [0.0, 0.9, 0.0, 0.8])
+
+
+def test_decorated_optimizer_reapplies_mask(rng):
+    model = nn.Sequential(nn.Linear(16, 8))
+    asp.prune_model(model)
+    o = asp.decorate(opt.SGD(0.5, parameters=model.parameters()))
+    for _ in range(3):
+        x = P.to_tensor(rng.standard_normal((4, 16)).astype("float32"))
+        (model(x) ** 2).mean().backward()
+        o.step()
+        o.clear_grad()
+        assert asp.check_sparsity(model[0].weight)
+
+
+def test_excluded_layers():
+    model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    asp.set_excluded_layers(["0"])
+    masks = asp.prune_model(model)
+    assert "0" not in masks and "1" in masks
+
+
+def test_conv_weight_sparsity(rng):
+    model = nn.Sequential(nn.Conv2D(8, 4, 3))
+    asp.prune_model(model)
+    assert asp.check_sparsity(model[0].weight)

@@ -1,0 +1,121 @@
+"""nn/io surface completion tests (losses vs torch, SpectralNorm, samplers,
+asp + rpc covered in their own files)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.io as io
+
+torch = pytest.importorskip("torch")
+F = nn.functional
+
+
+class TestNewLosses:
+    def test_soft_margin(self, rng):
+        a = rng.standard_normal(8).astype("float32")
+        l = np.where(rng.random(8) > 0.5, 1.0, -1.0).astype("float32")
+        got = F.soft_margin_loss(P.to_tensor(a), P.to_tensor(l)).numpy()
+        ref = torch.nn.functional.soft_margin_loss(
+            torch.tensor(a), torch.tensor(l)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_multi_label_soft_margin(self, rng):
+        a = rng.standard_normal((6, 5)).astype("float32")
+        l = rng.integers(0, 2, (6, 5)).astype("float32")
+        got = F.multi_label_soft_margin_loss(P.to_tensor(a),
+                                             P.to_tensor(l)).numpy()
+        ref = torch.nn.functional.multilabel_soft_margin_loss(
+            torch.tensor(a), torch.tensor(l)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)
+
+    def test_poisson_nll(self, rng):
+        a = rng.standard_normal(10).astype("float32")
+        l = (rng.random(10) * 3).astype("float32")
+        for full in (False, True):
+            got = F.poisson_nll_loss(P.to_tensor(a), P.to_tensor(l),
+                                     full=full).numpy()
+            ref = torch.nn.functional.poisson_nll_loss(
+                torch.tensor(a), torch.tensor(l), full=full).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_gaussian_nll(self, rng):
+        mu = rng.standard_normal(10).astype("float32")
+        l = rng.standard_normal(10).astype("float32")
+        var = (rng.random(10) + 0.5).astype("float32")
+        got = F.gaussian_nll_loss(P.to_tensor(mu), P.to_tensor(l),
+                                  P.to_tensor(var)).numpy()
+        ref = torch.nn.functional.gaussian_nll_loss(
+            torch.tensor(mu), torch.tensor(l), torch.tensor(var)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_pairwise_distance(self, rng):
+        x1 = rng.standard_normal((4, 8)).astype("float32")
+        x2 = rng.standard_normal((4, 8)).astype("float32")
+        got = F.pairwise_distance(P.to_tensor(x1), P.to_tensor(x2)).numpy()
+        ref = torch.nn.functional.pairwise_distance(
+            torch.tensor(x1), torch.tensor(x2)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_triplet_with_distance_fn(self, rng):
+        a, p, n = (P.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+                   for _ in range(3))
+        loss = F.triplet_margin_with_distance_loss(
+            a, p, n, distance_function=lambda u, v: F.pairwise_distance(u, v))
+        base = F.triplet_margin_loss(a, p, n)
+        np.testing.assert_allclose(loss.numpy(), base.numpy(), rtol=1e-4)
+
+    def test_loss_layers_exist(self):
+        for cls in (nn.HingeEmbeddingLoss, nn.SoftMarginLoss,
+                    nn.MultiLabelSoftMarginLoss, nn.PoissonNLLLoss,
+                    nn.GaussianNLLLoss, nn.TripletMarginWithDistanceLoss):
+            cls()
+
+
+class TestLayers:
+    def test_spectral_norm_unit_sigma(self, rng):
+        w = P.to_tensor(rng.standard_normal((6, 4)).astype("float32"))
+        sn = nn.SpectralNorm([6, 4], power_iters=20)
+        out = sn(w)
+        s = np.linalg.svd(out.numpy(), compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, atol=1e-3)
+
+    def test_unflatten(self):
+        out = nn.Unflatten(1, [2, 3])(P.to_tensor(np.zeros((4, 6), "float32")))
+        assert out.shape == [4, 2, 3]
+
+    def test_feature_alpha_dropout_channels(self, rng):
+        layer = nn.FeatureAlphaDropout(0.5)
+        layer.train()
+        x = P.to_tensor(rng.standard_normal((2, 16, 4, 4)).astype("float32"))
+        out = layer(x).numpy()
+        # whole channels share the dropout decision: within a (n, c) slice the
+        # affine transform is uniform, so dropped channels are constant
+        flat = out.reshape(2, 16, -1)
+        dropped = np.isclose(flat.std(-1), 0.0)
+        assert dropped.any()  # p=0.5 on 32 channels: overwhelmingly likely
+        layer.eval()
+        np.testing.assert_allclose(layer(x).numpy(), x.numpy())
+
+
+class TestIO:
+    def test_compose_dataset(self):
+        d1 = io.TensorDataset([P.to_tensor(np.arange(4, dtype="float32"))])
+        d2 = io.TensorDataset([P.to_tensor(np.arange(4, 8, dtype="float32"))])
+        comp = io.ComposeDataset([d1, d2])
+        assert len(comp) == 4
+        s = comp[1]
+        assert float(s[0]) == 1.0 and float(s[1]) == 5.0
+        with pytest.raises(ValueError):
+            io.ComposeDataset([d1, io.TensorDataset(
+                [P.to_tensor(np.zeros(3, "float32"))])])
+
+    def test_subset_random_sampler(self):
+        srs = io.SubsetRandomSampler([3, 5, 7, 9])
+        got = list(iter(srs))
+        assert sorted(got) == [3, 5, 7, 9] and len(srs) == 4
+        P.seed(7)
+        a = list(iter(srs))
+        P.seed(7)
+        b = list(iter(srs))
+        assert a == b  # framework seed controls the permutation
