@@ -34,6 +34,7 @@ class WorkerInfo:
 
 class _RpcGlobal:
     store: TCPStore | None = None
+    owns_store: bool = False
     server: socket.socket | None = None
     server_thread: threading.Thread | None = None
     pool: concurrent.futures.ThreadPoolExecutor | None = None
@@ -41,6 +42,7 @@ class _RpcGlobal:
     rank: int = -1
     world_size: int = 0
     stopping = False
+    info_cache: dict | None = None
 
 
 _g = _RpcGlobal()
@@ -100,15 +102,20 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         host, _, port = master_endpoint.partition(":")
         _g.store = TCPStore(host, int(port), is_master=(rank == 0),
                             world_size=world_size)
+        _g.owns_store = True
     else:
         _g.store = create_or_get_global_tcp_store()
+        _g.owns_store = False
 
+    # bind only the advertised interface (loopback by default): the payload is
+    # pickled callables, so exposure beyond the training cluster's interface
+    # would be remote code execution for any network peer
+    host = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("0.0.0.0", 0))
+    srv.bind((host, 0))
     srv.listen(64)
     port = srv.getsockname()[1]
-    host = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
     _g.server = srv
     _g.stopping = False
     _g.server_thread = threading.Thread(target=_server_loop, args=(srv,),
@@ -126,8 +133,16 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
 
 
 def get_worker_info(name=None) -> WorkerInfo:
-    ent = _g.store.wait(f"__rpc/worker/{name or _g.name}", timeout=60)
-    return WorkerInfo(name or _g.name, ent["rank"], ent["host"], ent["port"])
+    # the registry is immutable after the init barrier — cache per process
+    name = name or _g.name
+    if _g.info_cache is None:
+        _g.info_cache = {}
+    info = _g.info_cache.get(name)
+    if info is None:
+        ent = _g.store.wait(f"__rpc/worker/{name}", timeout=60)
+        info = WorkerInfo(name, ent["rank"], ent["host"], ent["port"])
+        _g.info_cache[name] = info
+    return info
 
 
 def get_all_worker_infos():
@@ -180,5 +195,10 @@ def shutdown():
             pass
     if _g.pool is not None:
         _g.pool.shutdown(wait=False)
+    if _g.owns_store and _g.store is not None \
+            and getattr(_g.store, "_server", None) is not None:
+        _g.store._server.stop()
     _g.server = None
     _g.store = None
+    _g.owns_store = False
+    _g.info_cache = None

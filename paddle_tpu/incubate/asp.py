@@ -76,7 +76,11 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         mask = mask2.T if kind == "T" else mask2.reshape(w.shape)
         sub.weight._value = np.asarray(w * mask, dtype=w.dtype)
         if with_mask:
-            _masks[id(sub.weight)] = mask
+            import weakref
+            # weakref guards against id() reuse after GC; re-pruning must also
+            # drop the stale device-side copy
+            _masks[id(sub.weight)] = (mask, weakref.ref(sub.weight))
+            _masks.pop(("dev", id(sub.weight)), None)
         pruned[name] = mask
     return pruned
 
@@ -107,8 +111,11 @@ class _MaskedOptimizer:
         import jax.numpy as jnp
         with no_grad():
             for p in self._inner._parameter_list:
-                mask = _masks.get(id(p))
-                if mask is None:
+                entry = _masks.get(id(p))
+                if entry is None:
+                    continue
+                mask, ref = entry
+                if ref() is not p:  # id() reuse after GC — not our parameter
                     continue
                 # on-device multiply: the mask uploads once and XLA folds the
                 # product into the next consumer; no per-step host round trip
@@ -120,9 +127,6 @@ class _MaskedOptimizer:
                 masked = dispatch(lambda v: v * dmask, (p,), {},
                                   name="asp_mask")
                 p._value = masked._value
-
-    def clear_grad(self, *a, **k):
-        return self._inner.clear_grad(*a, **k)
 
 
 def decorate(optimizer):

@@ -1,0 +1,121 @@
+"""Loss-curve parity: our Llama training loop vs a weight-matched HuggingFace
+torch reference (BASELINE.md measurement plan — matched init, data, and
+hyperparameters; reference analog: test/auto_parallel/hybrid_strategy/
+semi_auto_llama.py asserting parity against single-rank baselines).
+
+fp32 end-to-end, plain SGD, identical token stream: per-step losses must track
+to ~1e-3 relative over several steps — this exercises embedding, rope,
+attention, swiglu, RMSNorm, cross-entropy, backward, and the optimizer as one
+numerical system.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.optimizer as opt
+from paddle_tpu.jit.api import TrainStep
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _build_pair():
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=176,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=128,
+                      use_flash_attention=False)
+    P.seed(0)
+    ours = LlamaForCausalLM(cfg)
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=128, rms_norm_eps=cfg.rms_norm_eps,
+        rope_theta=cfg.rope_theta, attention_bias=False, mlp_bias=False,
+        tie_word_embeddings=False, attn_implementation="eager",
+        use_cache=False)
+    theirs = transformers.LlamaForCausalLM(hf_cfg)
+
+    # copy our weights into the torch model (Linear stores (in, out) -> .T)
+    with torch.no_grad():
+        sd = theirs.state_dict()
+
+        def put(key, arr, transpose=False):
+            t = torch.from_numpy(np.asarray(arr, dtype=np.float32))
+            sd[key].copy_(t.T if transpose else t)
+
+        put("model.embed_tokens.weight", ours.llama.embed_tokens.weight.numpy())
+        put("model.norm.weight", ours.llama.norm.weight.numpy())
+        put("lm_head.weight", ours.lm_head.weight.numpy(), transpose=True)
+        for i, layer in enumerate(ours.llama.layers):
+            pre = f"model.layers.{i}."
+            put(pre + "input_layernorm.weight",
+                layer.input_layernorm.weight.numpy())
+            put(pre + "post_attention_layernorm.weight",
+                layer.post_attention_layernorm.weight.numpy())
+            put(pre + "self_attn.q_proj.weight",
+                layer.self_attn.q_proj.weight.numpy(), transpose=True)
+            put(pre + "self_attn.k_proj.weight",
+                layer.self_attn.k_proj.weight.numpy(), transpose=True)
+            put(pre + "self_attn.v_proj.weight",
+                layer.self_attn.v_proj.weight.numpy(), transpose=True)
+            put(pre + "self_attn.o_proj.weight",
+                layer.self_attn.o_proj.weight.numpy(), transpose=True)
+            put(pre + "mlp.gate_proj.weight",
+                layer.mlp.gate_proj.weight.numpy(), transpose=True)
+            put(pre + "mlp.up_proj.weight",
+                layer.mlp.up_proj.weight.numpy(), transpose=True)
+            put(pre + "mlp.down_proj.weight",
+                layer.mlp.down_proj.weight.numpy(), transpose=True)
+        theirs.load_state_dict(sd)
+    return cfg, ours, theirs
+
+
+def _token_stream(steps, batch, seq, vocab):
+    rng = np.random.default_rng(42)
+    return [rng.integers(1, vocab, size=(batch, seq)).astype(np.int64)
+            for _ in range(steps)]
+
+
+class TestLossParity:
+    def test_forward_loss_matches(self):
+        cfg, ours, theirs = _build_pair()
+        ids = _token_stream(1, 2, 32, cfg.vocab_size)[0]
+        shifted = np.concatenate(
+            [ids[:, 1:], np.full((ids.shape[0], 1), -100)], axis=1)
+        our_loss, _ = ours(P.to_tensor(ids.astype(np.int32)),
+                           labels=P.to_tensor(shifted.astype(np.int32)))
+        with torch.no_grad():
+            hf_loss = theirs(input_ids=torch.from_numpy(ids),
+                             labels=torch.from_numpy(ids)).loss
+        np.testing.assert_allclose(float(our_loss.numpy()),
+                                   float(hf_loss), rtol=2e-4)
+
+    def test_five_step_sgd_curve_matches(self):
+        cfg, ours, theirs = _build_pair()
+        lr = 0.05
+        o = opt.SGD(learning_rate=lr, parameters=ours.parameters())
+        step = TrainStep(ours, lambda m, i, l: m(i, labels=l)[0], o)
+        topt = torch.optim.SGD(theirs.parameters(), lr=lr)
+
+        # one fixed batch repeated: losses must both track AND descend
+        batches = _token_stream(1, 2, 32, cfg.vocab_size) * 5
+        our_losses, hf_losses = [], []
+        for ids in batches:
+            shifted = np.concatenate(
+                [ids[:, 1:], np.full((ids.shape[0], 1), -100)], axis=1)
+            loss = step(P.to_tensor(ids.astype(np.int32)),
+                        P.to_tensor(shifted.astype(np.int32)))
+            our_losses.append(float(np.asarray(loss._value)))
+
+            topt.zero_grad()
+            out = theirs(input_ids=torch.from_numpy(ids),
+                         labels=torch.from_numpy(ids))
+            out.loss.backward()
+            topt.step()
+            hf_losses.append(float(out.loss))
+
+        np.testing.assert_allclose(our_losses, hf_losses, rtol=2e-3)
+        # the curves must actually descend (sanity on the comparison itself)
+        assert our_losses[-1] < our_losses[0]
