@@ -831,3 +831,431 @@ def _kl_dirichlet(p, q):
     return Tensor(g(a0) - jnp.sum(g(a), -1) - g(jnp.sum(b, -1))
                   + jnp.sum(g(b), -1)
                   + jnp.sum((a - b) * (dg(a) - dg(a0)[..., None]), -1))
+
+
+# ---------------------------------------------------------------------------
+# additional distributions (reference: python/paddle/distribution/)
+# ---------------------------------------------------------------------------
+
+class Chi2(Gamma):
+    """Chi-squared: Gamma(df/2, 1/2) (reference: distribution/chi2.py)."""
+
+    def __init__(self, df, name=None):
+        self.df = _val(df)
+        super().__init__(self.df / 2.0, 0.5)
+
+
+class Binomial(Distribution):
+    """Reference: distribution/binomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _val(total_count)
+        self.probs_ = _val(probs)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(self.total_count),
+                                              self.probs_.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        out = jax.random.binomial(key, self.total_count, self.probs_,
+                                  shape=self._extend_shape(shape))
+        return Tensor(out, stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(v):
+            n, p = self.total_count, jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+            logc = (jax.scipy.special.gammaln(n + 1)
+                    - jax.scipy.special.gammaln(v + 1)
+                    - jax.scipy.special.gammaln(n - v + 1))
+            return logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+        return _wrap(fn, (value,), "binomial_log_prob")
+
+    def entropy(self):
+        # sum over the support (exact; support is static)
+        n = int(np.max(self.total_count))
+        ks = jnp.arange(n + 1, dtype=jnp.float32)
+        lp = _val(self.log_prob(Tensor(
+            jnp.broadcast_to(ks.reshape((-1,) + (1,) * len(self.batch_shape)),
+                             (n + 1,) + tuple(self.batch_shape)))))
+        valid = ks.reshape((-1,) + (1,) * len(self.batch_shape)) \
+            <= self.total_count
+        p = jnp.where(valid, jnp.exp(lp), 0.0)
+        return Tensor(-jnp.sum(jnp.where(valid, p * lp, 0.0), axis=0))
+
+
+class ContinuousBernoulli(Distribution):
+    """Reference: distribution/continuous_bernoulli.py."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs_ = jnp.clip(_val(probs), 1e-6, 1 - 1e-6)
+        self._lims = lims
+        super().__init__(self.probs_.shape)
+
+    def _log_norm_const(self):
+        lam = self.probs_
+        lo, hi = self._lims
+        # C(λ) = 2 atanh(1-2λ) / (1-2λ), with the λ→1/2 limit = 2
+        safe = jnp.where((lam < lo) | (lam > hi), lam, 0.4)
+        c = 2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe)
+        # 2nd-order Taylor around 1/2 for the unstable band
+        x = lam - 0.5
+        taylor = 2.0 + (16.0 / 3.0) * x ** 2
+        return jnp.log(jnp.where((lam < lo) | (lam > hi), c, taylor))
+
+    @property
+    def mean(self):
+        lam = self.probs_
+        lo, hi = self._lims
+        safe = jnp.where((lam < lo) | (lam > hi), lam, 0.4)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        return Tensor(jnp.where((lam < lo) | (lam > hi), m, 0.5))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, self._extend_shape(shape))
+        return Tensor(self.icdf(Tensor(u))._value, stop_gradient=True)
+
+    rsample = sample
+
+    def icdf(self, value):
+        def fn(u):
+            lam = self.probs_
+            lo, hi = self._lims
+            safe = jnp.where((lam < lo) | (lam > hi), lam, 0.4)
+            num = jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+            den = jnp.log(safe) - jnp.log1p(-safe)
+            return jnp.where((lam < lo) | (lam > hi), num / den, u)
+        return _wrap(fn, (value,), "cb_icdf")
+
+    def log_prob(self, value):
+        def fn(v):
+            lam = self.probs_
+            return (v * jnp.log(lam) + (1 - v) * jnp.log1p(-lam)
+                    + self._log_norm_const())
+        return _wrap(fn, (value,), "cb_log_prob")
+
+
+class MultivariateNormal(Distribution):
+    """Reference: distribution/multivariate_normal.py. Parameterize with
+    covariance_matrix, precision_matrix, or scale_tril."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _val(loc)
+        given = [x is not None
+                 for x in (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError("give exactly one of covariance_matrix, "
+                             "precision_matrix, scale_tril")
+        if scale_tril is not None:
+            self._tril = _val(scale_tril)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_val(covariance_matrix))
+        else:
+            prec = _val(precision_matrix)
+            self._tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        # batch shape broadcasts loc against the matrix batch (torch semantics)
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1],
+                                     self._tril.shape[:-2])
+        self.loc = jnp.broadcast_to(self.loc, batch + self.loc.shape[-1:])
+        super().__init__(batch, self.loc.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.sum(self._tril ** 2, axis=-1))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        eps = jax.random.normal(
+            key, shape + tuple(self.batch_shape) + tuple(self.event_shape))
+        out = self.loc + jnp.einsum("...ij,...j->...i", self._tril, eps)
+        return Tensor(out, stop_gradient=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            d = v.shape[-1]
+            diff = v - self.loc
+            tril = jnp.broadcast_to(self._tril, diff.shape[:-1] + (d, d))
+            sol = jax.scipy.linalg.solve_triangular(
+                tril, diff[..., None], lower=True)[..., 0]
+            maha = jnp.sum(sol ** 2, -1)
+            logdet = jnp.sum(
+                jnp.log(jnp.diagonal(self._tril, axis1=-2, axis2=-1)), -1)
+            return -0.5 * (maha + d * jnp.log(2 * jnp.pi)) - logdet
+        return _wrap(fn, (value,), "mvn_log_prob")
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        logdet = jnp.sum(
+            jnp.log(jnp.diagonal(self._tril, axis1=-2, axis2=-1)), -1)
+        return Tensor(0.5 * d * (1 + jnp.log(2 * jnp.pi)) + logdet)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    d = p.loc.shape[-1]
+    qt, pt = q._tril, p._tril
+    sol = jax.scipy.linalg.solve_triangular(
+        qt, pt, lower=True)
+    tr = jnp.sum(sol ** 2, axis=(-2, -1))
+    diff = q.loc - p.loc
+    m = jax.scipy.linalg.solve_triangular(qt, diff[..., None],
+                                          lower=True)[..., 0]
+    maha = jnp.sum(m ** 2, -1)
+    logdet_q = jnp.sum(jnp.log(jnp.diagonal(qt, axis1=-2, axis2=-1)), -1)
+    logdet_p = jnp.sum(jnp.log(jnp.diagonal(pt, axis1=-2, axis2=-1)), -1)
+    return Tensor(0.5 * (tr + maha - d) + logdet_q - logdet_p)
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices (reference:
+    distribution/lkj_cholesky.py). Sampling via the onion method."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        if sample_method != "onion":
+            raise NotImplementedError(
+                f"sample_method={sample_method!r}: only 'onion' is "
+                "implemented (cvine draws a different — equally valid — "
+                "parameterization)")
+        self.dim = int(dim)
+        self.sample_method = sample_method
+        self.concentration = jnp.asarray(_val(concentration), jnp.float32)
+        super().__init__(jnp.shape(self.concentration),
+                         (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        d, eta = self.dim, self.concentration
+        batch = shape + tuple(self.batch_shape)
+        k1, k2 = jax.random.split(key)
+        # onion: beta marginals for the norms, spherical directions
+        L = jnp.zeros(batch + (d, d))
+        L = L.at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            beta_a = (d - 1 - i) / 2.0 + eta
+            y = jax.random.beta(jax.random.fold_in(k1, i), i / 2.0, beta_a,
+                                batch)
+            u = jax.random.normal(jax.random.fold_in(k2, i), batch + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            L = L.at[..., i, :i].set(jnp.sqrt(y)[..., None] * u)
+            L = L.at[..., i, i].set(jnp.sqrt(1 - y))
+        return Tensor(L, stop_gradient=True)
+
+    def log_prob(self, value):
+        def fn(L):
+            d, eta = self.dim, self.concentration
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            orders = jnp.arange(2, d + 1, dtype=jnp.float32)
+            exps = 2 * (eta - 1)[..., None] + (d - orders)[None, :] \
+                if jnp.ndim(eta) else 2 * (eta - 1) + (d - orders)
+            unnorm = jnp.sum(exps * jnp.log(diag), -1)
+            # normalizer: ½(d-1)logπ + logΓ_{d-1}(α-½) - (d-1)logΓ(α),
+            # α = η + (d-1)/2, with Γ_p the multivariate gamma
+            dm1 = d - 1
+            alpha = eta + 0.5 * dm1
+            js = jnp.arange(1, dm1 + 1, dtype=jnp.float32)
+            mvlgamma = (dm1 * (dm1 - 1) / 4.0) * jnp.log(jnp.pi) + jnp.sum(
+                jax.scipy.special.gammaln(
+                    (alpha - 0.5)[..., None] + (1.0 - js) / 2.0
+                    if jnp.ndim(alpha) else (alpha - 0.5) + (1.0 - js) / 2.0),
+                -1)
+            logc = (0.5 * dm1 * jnp.log(jnp.pi) + mvlgamma
+                    - dm1 * jax.scipy.special.gammaln(alpha))
+            return unnorm - logc
+        return _wrap(fn, (value,), "lkj_log_prob")
+
+
+# ---------------------------------------------------------------------------
+# additional transforms (reference: python/paddle/distribution/transform.py)
+# ---------------------------------------------------------------------------
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return jnp.abs(x)
+
+    def inverse(self, y):
+        return y  # positive branch, matching the reference
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _val(power)
+
+    def forward(self, x):
+        return jnp.power(x, self.power)
+
+    def inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return jnp.tanh(x)
+
+    def inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-7, 1 - 1e-7))
+
+    def forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2), numerically stable form
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t.forward_log_det_jacobian(x)
+            x = t.forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    """Sums the log-det over the trailing `reinterpreted_batch_rank` dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(x)
+        return jnp.sum(ld, axis=tuple(range(-self.rank, 0)))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.reshape(x, batch + self.out_event_shape)
+
+    def inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return jnp.reshape(y, batch + self.in_event_shape)
+
+    def forward_log_det_jacobian(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch)
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x); inverse is log(y) (defined up to an additive const)."""
+
+    def forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+
+class StackTransform(Transform):
+    """Applies a list of transforms to slices along `axis`."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, x, method):
+        parts = [getattr(t, method)(s) for t, s in zip(
+            self.transforms,
+            jnp.moveaxis(x, self.axis, 0))]
+        return jnp.moveaxis(jnp.stack(parts), 0, self.axis)
+
+    def forward(self, x):
+        return self._map(x, "forward")
+
+    def inverse(self, y):
+        return self._map(y, "inverse")
+
+    def forward_log_det_jacobian(self, x):
+        return self._map(x, "forward_log_det_jacobian")
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^k -> (k+1)-simplex (reference:
+    distribution/transform.py StickBreakingTransform)."""
+
+    def forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate([z, jnp.ones(x.shape[:-1] + (1,), x.dtype)],
+                               -1)
+        cum = jnp.cumprod(1 - z, axis=-1)
+        cpad = jnp.concatenate([jnp.ones(x.shape[:-1] + (1,), x.dtype), cum],
+                               -1)
+        return zpad * cpad
+
+    def inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = jnp.cumsum(y[..., :-1], -1)
+        rem = 1 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), cum[..., :-1]], -1)
+        z = y[..., :-1] / rem
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def forward_log_det_jacobian(self, x):
+        # y_i = z_i * prod_{j<i}(1-z_j) with z = sigmoid(x - offset);
+        # |J| = prod_i z_i(1-z_i) * prod_{j<i}(1-z_j)
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        xo = x - offset
+        z = jax.nn.sigmoid(xo)
+        cum = jnp.cumprod(1 - z, axis=-1)
+        cpad = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), cum[..., :-1]], -1)
+        log_dz = -jax.nn.softplus(xo) - jax.nn.softplus(-xo)  # log z(1-z)
+        return jnp.sum(log_dz + jnp.log(cpad), -1)
+
+
+__all__ += [
+    "Chi2", "Binomial", "ContinuousBernoulli", "MultivariateNormal",
+    "LKJCholesky", "AbsTransform", "PowerTransform", "TanhTransform",
+    "ChainTransform", "IndependentTransform", "ReshapeTransform",
+    "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+    "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
+]

@@ -192,3 +192,88 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     cl.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
                    "save_dir": save_dir, "metrics": metrics or []})
     return cl
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer LR when a monitored metric plateaus (reference:
+    hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "min" if "loss" in monitor or "err" in monitor else "max"
+        self.mode = mode
+        self._best = None
+        self._wait = 0
+        self._cooldown_counter = 0
+        self._saw_eval = False
+
+    def _better(self, cur, best):
+        if self.mode == "min":
+            return cur < best - self.min_delta
+        return cur > best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        # when eval runs, the eval metric is the signal; epoch-end train
+        # metrics are then ignored so one epoch = one plateau check
+        self._saw_eval = True
+        self._check(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self._saw_eval:
+            self._check(logs)
+
+    def _check(self, logs):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._cooldown_counter > 0:
+            self._cooldown_counter -= 1
+            self._wait = 0
+        if self._best is None or self._better(cur, self._best):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience and self._cooldown_counter == 0:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                old = opt.get_lr()
+                new = max(old * self.factor, self.min_lr)
+                if new < old:
+                    try:
+                        opt.set_lr(new)
+                    except RuntimeError:
+                        return  # LRScheduler-driven optimizer: not ours to set
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {old:.3g} -> {new:.3g}")
+            self._cooldown_counter = self.cooldown
+            self._wait = 0
+
+
+class VisualDL(Callback):
+    """VisualDL scalar logging (gated: the visualdl package is not available
+    in this environment; reference: hapi/callbacks.py VisualDL)."""
+
+    def __init__(self, log_dir="./log"):
+        try:
+            import visualdl  # noqa: F401
+        except ImportError:
+            raise RuntimeError(
+                "VisualDL callback requires the visualdl package, which is "
+                "unavailable here; use ProgBarLogger or the profiler's chrome "
+                "trace export instead") from None
+        # visualdl importable but the writer bridge is not implemented — fail
+        # loudly rather than silently logging nothing
+        raise NotImplementedError(
+            "VisualDL writer bridge is not implemented in paddle_tpu; use "
+            "ProgBarLogger or profiler.export_chrome_tracing")
