@@ -965,6 +965,8 @@ class MultivariateNormal(Distribution):
         batch = jnp.broadcast_shapes(self.loc.shape[:-1],
                                      self._tril.shape[:-2])
         self.loc = jnp.broadcast_to(self.loc, batch + self.loc.shape[-1:])
+        self._tril = jnp.broadcast_to(self._tril,
+                                      batch + self._tril.shape[-2:])
         super().__init__(batch, self.loc.shape[-1:])
 
     @property
@@ -1199,9 +1201,13 @@ class StackTransform(Transform):
         self.axis = axis
 
     def _map(self, x, method):
-        parts = [getattr(t, method)(s) for t, s in zip(
-            self.transforms,
-            jnp.moveaxis(x, self.axis, 0))]
+        slices = jnp.moveaxis(x, self.axis, 0)
+        if slices.shape[0] != len(self.transforms):
+            raise ValueError(
+                f"StackTransform: input has {slices.shape[0]} slices along "
+                f"axis {self.axis} but {len(self.transforms)} transforms")
+        parts = [getattr(t, method)(s)
+                 for t, s in zip(self.transforms, slices)]
         return jnp.moveaxis(jnp.stack(parts), 0, self.axis)
 
     def forward(self, x):

@@ -214,6 +214,7 @@ class ReduceLROnPlateau(Callback):
         self._wait = 0
         self._cooldown_counter = 0
         self._saw_eval = False
+        self._pending = None
 
     def _better(self, cur, best):
         if self.mode == "min":
@@ -221,14 +222,28 @@ class ReduceLROnPlateau(Callback):
         return cur > best + self.min_delta
 
     def on_eval_end(self, logs=None):
-        # when eval runs, the eval metric is the signal; epoch-end train
-        # metrics are then ignored so one epoch = one plateau check
+        # when eval runs, the eval metric is the signal for this epoch; the
+        # pending train-metric check from on_epoch_end is discarded so one
+        # epoch = one plateau check on one metric stream
         self._saw_eval = True
+        self._pending = None
         self._check(logs)
 
     def on_epoch_end(self, epoch, logs=None):
+        # fit() fires on_epoch_end BEFORE the per-epoch evaluate, so defer:
+        # the pending train check only counts if no eval follows this epoch
+        self._flush_pending()
         if not self._saw_eval:
-            self._check(logs)
+            self._pending = dict(logs or {})
+
+    def on_train_end(self, logs=None):
+        self._flush_pending()
+
+    def _flush_pending(self):
+        pending = getattr(self, "_pending", None)
+        self._pending = None
+        if pending is not None:
+            self._check(pending)
 
     def _check(self, logs):
         logs = logs or {}
@@ -236,15 +251,18 @@ class ReduceLROnPlateau(Callback):
         if cur is None:
             return
         cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
-        if self._cooldown_counter > 0:
+        in_cooldown = self._cooldown_counter > 0
+        if in_cooldown:
             self._cooldown_counter -= 1
             self._wait = 0
         if self._best is None or self._better(cur, self._best):
             self._best = cur
             self._wait = 0
             return
+        if in_cooldown:
+            return  # cooldown epochs never accumulate wait
         self._wait += 1
-        if self._wait >= self.patience and self._cooldown_counter == 0:
+        if self._wait >= self.patience:
             opt = getattr(self.model, "_optimizer", None)
             if opt is not None:
                 old = opt.get_lr()

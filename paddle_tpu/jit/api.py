@@ -78,6 +78,9 @@ def _split_leaves(tree):
     return dyn, tuple(static_key), tuple(layout), treedef
 
 
+_EAGER_FALLBACK = object()  # cache sentinel: this signature runs eagerly
+
+
 class StaticFunction:
     """Traced+compiled callable with a guard cache keyed on static structure."""
 
@@ -131,9 +134,23 @@ class StaticFunction:
 
             self._cache[key] = jax.jit(compiled)
 
+        if self._cache[key] is _EAGER_FALLBACK:
+            return self._fn(*args, **kwargs)
+
         state_vals = read_values(params) + read_values(buffers)
         rng_key = _random.next_key()
-        out_vals, new_buf_vals = self._cache[key](state_vals, dyn, rng_key)
+        try:
+            out_vals, new_buf_vals = self._cache[key](state_vals, dyn, rng_key)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.NonConcreteBooleanIndexError):
+            # graph break: data-dependent python control flow cannot trace —
+            # run this call signature eagerly from now on (the SOT-fallback
+            # analog; reference: jit/sot graph breaks -> eager frames)
+            self._cache[key] = _EAGER_FALLBACK
+            return self._fn(*args, **kwargs)
         for b, nv in zip(buffers, new_buf_vals):
             b._value = nv
         return jax.tree_util.tree_map(

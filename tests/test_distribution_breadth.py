@@ -168,12 +168,59 @@ class TestCallbacks:
                                  parameters=nn.Linear(2, 2).parameters())
 
         cb.set_model(FakeModel())
+        # epoch-end checks are deferred one hook (fit fires on_epoch_end
+        # before a possible eval); on_train_end flushes the last one
         cb.on_epoch_end(0, {"loss": 1.0})
-        cb.on_epoch_end(1, {"loss": 1.0})  # wait 1 -> reduce
+        cb.on_epoch_end(1, {"loss": 1.0})  # flushes epoch-0: seeds best
+        cb.on_epoch_end(2, {"loss": 1.0})  # flushes epoch-1: wait 1 -> reduce
         assert FakeModel._optimizer.get_lr() == pytest.approx(0.5)
-        cb.on_epoch_end(2, {"loss": 0.2})  # improvement resets
-        cb.on_epoch_end(3, {"loss": 0.2})
+        cb.on_epoch_end(3, {"loss": 0.2})  # flushes epoch-2: flat -> reduce
         assert FakeModel._optimizer.get_lr() == pytest.approx(0.25)
+        cb.on_epoch_end(4, {"loss": 0.2})  # flushes epoch-3: improvement
+        cb.on_train_end()                  # flushes epoch-4: flat -> reduce
+        assert FakeModel._optimizer.get_lr() == pytest.approx(0.125)
+
+    def test_reduce_lr_eval_stream_wins(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               verbose=0)
+
+        class FakeModel:
+            _optimizer = opt.SGD(learning_rate=1.0,
+                                 parameters=nn.Linear(2, 2).parameters())
+
+        cb.set_model(FakeModel())
+        # fit() order per epoch: on_epoch_end(train logs) then on_eval_end
+        cb.on_epoch_end(0, {"loss": 0.5})
+        cb.on_eval_end({"loss": 0.8})  # seeds best from EVAL, not train
+        assert FakeModel._optimizer.get_lr() == pytest.approx(1.0)
+        cb.on_epoch_end(1, {"loss": 0.4})
+        cb.on_eval_end({"loss": 0.8})  # one flat eval epoch -> reduce
+        assert FakeModel._optimizer.get_lr() == pytest.approx(0.5)
+
+    def test_reduce_lr_cooldown_holds(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               cooldown=1, verbose=0)
+
+        class FakeModel:
+            _optimizer = opt.SGD(learning_rate=1.0,
+                                 parameters=nn.Linear(2, 2).parameters())
+
+        cb.set_model(FakeModel())
+        lrs = []
+        for epoch in range(7):
+            cb.on_eval_end({"loss": 1.0})  # eval stream: immediate checks
+            lrs.append(FakeModel._optimizer.get_lr())
+        # flat loss with patience=1, cooldown=1: reduce every 2 epochs, and the
+        # cooldown epoch never accumulates wait
+        assert lrs == pytest.approx([1.0, 0.5, 0.5, 0.25, 0.25, 0.125, 0.125])
 
     def test_visualdl_gated(self):
         from paddle_tpu.hapi.callbacks import VisualDL

@@ -220,3 +220,37 @@ def test_rng_in_jit_varies_per_step():
     a = fwd(x).numpy()
     b = fwd(x).numpy()
     assert not np.allclose(a, b)  # dropout mask must differ across compiled calls
+
+
+def test_to_static_graph_break_falls_back_to_eager():
+    """Data-dependent python control flow cannot trace; the call signature
+    must fall back to eager (the SOT graph-break analog, SURVEY §2.6)."""
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        if float(np.asarray(x.sum()._value)) > 0:  # concretizes a tracer
+            return x * 2
+        return x - 1
+
+    a = paddle.to_tensor(np.ones(4, "float32"))
+    b = paddle.to_tensor(-np.ones(4, "float32"))
+    np.testing.assert_allclose(f(a).numpy(), 2 * np.ones(4))
+    np.testing.assert_allclose(f(b).numpy(), -2 * np.ones(4))
+    np.testing.assert_allclose(f(a).numpy(), 2 * np.ones(4))
+
+
+def test_to_static_traceable_compiles_once():
+    from paddle_tpu.jit import to_static
+    traces = {"n": 0}
+
+    @to_static
+    def g(x):
+        traces["n"] += 1
+        return x * 3
+
+    a = paddle.to_tensor(np.ones(4, "float32"))
+    for _ in range(3):
+        out = g(a)
+    assert traces["n"] == 1
+    np.testing.assert_allclose(out.numpy(), 3 * np.ones(4))
