@@ -158,3 +158,4 @@ from .context_parallel import (  # noqa: E402,F401
     shard_zigzag, unshard_zigzag,
 )
 from .elastic import ElasticManager, ElasticStatus  # noqa: E402,F401
+from . import metrics  # noqa: E402,F401

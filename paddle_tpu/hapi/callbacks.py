@@ -200,7 +200,13 @@ class ReduceLROnPlateau(Callback):
 
     def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
                  mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        # monitor picks ONE metric stream explicitly: "eval_<key>" checks the
+        # eval logs (on_eval_end), a bare key checks the train logs
+        # (on_epoch_end). Streams never mix, so eval_freq > 1 and
+        # train/eval key collisions cannot corrupt the plateau state.
         self.monitor = monitor
+        self._eval_stream = monitor.startswith("eval_")
+        self._key = monitor[5:] if self._eval_stream else monitor
         self.factor = factor
         self.patience = patience
         self.verbose = verbose
@@ -213,54 +219,42 @@ class ReduceLROnPlateau(Callback):
         self._best = None
         self._wait = 0
         self._cooldown_counter = 0
-        self._saw_eval = False
-        self._pending = None
 
     def _better(self, cur, best):
         if self.mode == "min":
             return cur < best - self.min_delta
         return cur > best + self.min_delta
 
+    def on_train_begin(self, logs=None):
+        # fresh state per fit() on a reused callback instance
+        self._best = None
+        self._wait = 0
+        self._cooldown_counter = 0
+
     def on_eval_end(self, logs=None):
-        # when eval runs, the eval metric is the signal for this epoch; the
-        # pending train-metric check from on_epoch_end is discarded so one
-        # epoch = one plateau check on one metric stream
-        self._saw_eval = True
-        self._pending = None
-        self._check(logs)
+        if self._eval_stream:
+            self._check(logs)
 
     def on_epoch_end(self, epoch, logs=None):
-        # fit() fires on_epoch_end BEFORE the per-epoch evaluate, so defer:
-        # the pending train check only counts if no eval follows this epoch
-        self._flush_pending()
-        if not self._saw_eval:
-            self._pending = dict(logs or {})
-
-    def on_train_end(self, logs=None):
-        self._flush_pending()
-
-    def _flush_pending(self):
-        pending = getattr(self, "_pending", None)
-        self._pending = None
-        if pending is not None:
-            self._check(pending)
+        if not self._eval_stream:
+            self._check(logs)
 
     def _check(self, logs):
         logs = logs or {}
-        cur = logs.get(self.monitor)
+        cur = logs.get(self._key)
         if cur is None:
             return
         cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
-        in_cooldown = self._cooldown_counter > 0
-        if in_cooldown:
+        # Keras-exact ordering: decrement cooldown first, then re-test it
+        if self._cooldown_counter > 0:
             self._cooldown_counter -= 1
             self._wait = 0
         if self._best is None or self._better(cur, self._best):
             self._best = cur
             self._wait = 0
             return
-        if in_cooldown:
-            return  # cooldown epochs never accumulate wait
+        if self._cooldown_counter > 0:
+            return
         self._wait += 1
         if self._wait >= self.patience:
             opt = getattr(self.model, "_optimizer", None)

@@ -1,0 +1,173 @@
+"""Parameter-server analog — sharded sparse embedding tables over RPC.
+
+Reference: paddle/fluid/distributed/ps/ (brpc services + sharded embedding
+tables in ps/table/, pull/push sparse) and python/paddle/distributed/ps/.
+TPU-native positioning: dense training state lives in device HBM under
+jit/pjit; the PS pattern survives for HOST-side huge sparse embeddings
+(recommendation workloads) that cannot fit a chip. Tables shard rows across
+server workers by id hash; clients pull rows before the device step and push
+gradients after — transport is paddle_tpu.distributed.rpc, bootstrap the
+TCPStore.
+
+This is the capability analog of the reference's PS (lazy row init, sparse
+SGD/Adagrad update rules, save/load), not its brpc implementation.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ... import distributed as dist
+from ...distributed import rpc
+
+__all__ = ["SparseTable", "start_server", "PSClient", "shutdown"]
+
+_TABLES: dict[str, "SparseTable"] = {}
+
+
+class SparseTable:
+    """One server's shard of a sparse embedding table (reference:
+    ps/table/memory_sparse_table.cc — lazy rows + sparse optimizer)."""
+
+    def __init__(self, name, dim, init_std=0.01, optimizer="sgd", lr=0.01,
+                 seed=0):
+        self.name = name
+        self.dim = dim
+        self.init_std = init_std
+        self.optimizer = optimizer
+        self.lr = lr
+        self.rows: dict[int, np.ndarray] = {}
+        self._accum: dict[int, np.ndarray] = {}  # adagrad state
+        self._rng = np.random.default_rng(seed)
+
+    def _row(self, rid: int) -> np.ndarray:
+        row = self.rows.get(rid)
+        if row is None:
+            row = (self._rng.standard_normal(self.dim) * self.init_std) \
+                .astype(np.float32)
+            self.rows[rid] = row
+        return row
+
+    def pull(self, ids):
+        return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids, grads):
+        grads = np.asarray(grads, dtype=np.float32)
+        for i, g in zip(ids, grads):
+            rid = int(i)
+            row = self._row(rid)
+            if self.optimizer == "adagrad":
+                acc = self._accum.setdefault(
+                    rid, np.zeros(self.dim, np.float32))
+                acc += g * g
+                row -= self.lr * g / (np.sqrt(acc) + 1e-10)
+            else:  # sgd
+                row -= self.lr * g
+        return len(ids)
+
+    def save(self, dirname):
+        os.makedirs(dirname, exist_ok=True)
+        ids = np.asarray(sorted(self.rows), dtype=np.int64)
+        vals = np.stack([self.rows[int(i)] for i in ids]) if len(ids) \
+            else np.zeros((0, self.dim), np.float32)
+        np.savez(os.path.join(dirname, f"{self.name}.npz"), ids=ids,
+                 vals=vals)
+
+    def load(self, dirname):
+        data = np.load(os.path.join(dirname, f"{self.name}.npz"))
+        self.rows = {int(i): v.copy()
+                     for i, v in zip(data["ids"], data["vals"])}
+
+
+# -- server-side RPC entry points (executed in the server worker) -----------
+
+def _srv_create(name, dim, kwargs):
+    _TABLES[name] = SparseTable(name, dim, **kwargs)
+    return True
+
+
+def _srv_pull(name, ids):
+    return _TABLES[name].pull(ids)
+
+
+def _srv_push(name, ids, grads):
+    return _TABLES[name].push(ids, grads)
+
+
+def _srv_save(name, dirname):
+    _TABLES[name].save(dirname)
+    return True
+
+
+def _srv_load(name, dirname):
+    _TABLES[name].load(dirname)
+    return True
+
+
+def start_server(name=None, rank=None, world_size=None, master_endpoint=None):
+    """Run this process as a PS server worker (reference: fleet runtime
+    the_one_ps server init). Registers under `name` and serves until
+    rpc.shutdown()."""
+    rpc.init_rpc(name or f"ps_server_{rank or 0}", rank=rank,
+                 world_size=world_size, master_endpoint=master_endpoint)
+
+
+def shutdown():
+    rpc.shutdown()
+
+
+class PSClient:
+    """Client view: shards rows over server workers by id hash (reference:
+    ps/service client + fleet pull_sparse/push_sparse)."""
+
+    def __init__(self, server_names):
+        self.servers = list(server_names)
+
+    def _shard(self, ids):
+        ids = np.asarray(ids, dtype=np.int64).ravel()
+        owner = ids % len(self.servers)
+        return ids, owner
+
+    def create_table(self, name, dim, **kwargs):
+        for s in self.servers:
+            rpc.rpc_sync(s, _srv_create, args=(name, dim, kwargs))
+
+    def pull_sparse(self, name, ids):
+        ids_flat, owner = self._shard(ids)
+        out = np.zeros((len(ids_flat), 0), np.float32)
+        rows = None
+        for si, s in enumerate(self.servers):
+            sel = np.nonzero(owner == si)[0]
+            if not len(sel):
+                continue
+            part = rpc.rpc_sync(s, _srv_pull, args=(name, ids_flat[sel]))
+            if rows is None:
+                rows = np.zeros((len(ids_flat), part.shape[1]), np.float32)
+            rows[sel] = part
+        if rows is None:
+            rows = out
+        return rows.reshape(tuple(np.shape(ids)) + (-1,))
+
+    def push_sparse(self, name, ids, grads):
+        ids_flat, owner = self._shard(ids)
+        grads = np.asarray(grads, np.float32).reshape(len(ids_flat), -1)
+        futures = []
+        for si, s in enumerate(self.servers):
+            sel = np.nonzero(owner == si)[0]
+            if not len(sel):
+                continue
+            futures.append(rpc.rpc_async(
+                s, _srv_push, args=(name, ids_flat[sel], grads[sel])))
+        for f in futures:
+            f.wait()
+
+    def save(self, name, dirname):
+        for s in self.servers:
+            rpc.rpc_sync(s, _srv_save, args=(name, os.path.join(
+                dirname, s)))
+
+    def load(self, name, dirname):
+        for s in self.servers:
+            rpc.rpc_sync(s, _srv_load, args=(name, os.path.join(
+                dirname, s)))

@@ -141,14 +141,22 @@ class StaticFunction:
         rng_key = _random.next_key()
         try:
             out_vals, new_buf_vals = self._cache[key](state_vals, dyn, rng_key)
-        except (jax.errors.TracerBoolConversionError,
-                jax.errors.ConcretizationTypeError,
+        except (jax.errors.ConcretizationTypeError,
                 jax.errors.TracerIntegerConversionError,
                 jax.errors.TracerArrayConversionError,
-                jax.errors.NonConcreteBooleanIndexError):
-            # graph break: data-dependent python control flow cannot trace —
+                jax.errors.NonConcreteBooleanIndexError) as e:
+            # NOTE: in this jax version only TracerBoolConversionError is a
+            # ConcretizationTypeError subclass — the others must be listed.
+            # Graph break: data-dependent python control flow cannot trace —
             # run this call signature eagerly from now on (the SOT-fallback
-            # analog; reference: jit/sot graph breaks -> eager frames)
+            # analog; reference: jit/sot graph breaks -> eager frames).
+            # Caveat: python side effects before the break ran once during
+            # the failed trace and run again eagerly.
+            import warnings
+            warnings.warn(
+                f"to_static: graph break in {getattr(self._fn, '__name__', '?')} "
+                f"({type(e).__name__}); this call signature now runs eagerly",
+                RuntimeWarning, stacklevel=2)
             self._cache[key] = _EAGER_FALLBACK
             return self._fn(*args, **kwargs)
         for b, nv in zip(buffers, new_buf_vals):

@@ -74,3 +74,4 @@ class dlpack:
         from ..core.tensor import Tensor
         import jax.numpy as jnp
         return Tensor(jnp.from_dlpack(capsule))
+from . import cpp_extension  # noqa: E402,F401

@@ -1,0 +1,153 @@
+"""paddle.utils.cpp_extension analog — JIT-compile custom C++ ops.
+
+Reference: python/paddle/utils/cpp_extension/ (load/setup compile user C++
+into an op library; PD_BUILD_OP registers kernels). TPU-native: the device
+compute path is XLA — custom HOST ops compile with g++ into a shared library
+bound via ctypes, and ``to_op`` lifts a C function into a framework op through
+``jax.pure_callback`` (runs on host, composes with jit; supply ``vjp`` to make
+it differentiable). This is the same native-extension story as the rest of the
+runtime (csrc/): no pybind11, plain C ABI.
+
+The C function contract: ``void f(const T* in0, const T* in1, ..., T* out,
+int64_t n)`` with all buffers contiguous and n = element count of the output.
+More elaborate signatures can be bound manually via ``load(...).lib``.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+__all__ = ["load", "get_build_directory", "CppExtension", "BuildExtension",
+           "setup"]
+
+_CACHE_DIR = os.environ.get(
+    "PT_EXTENSIONS_DIR",
+    os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions"))
+
+
+def get_build_directory():
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    return _CACHE_DIR
+
+
+class ExtensionModule:
+    """Handle over a compiled user library."""
+
+    def __init__(self, name, lib_path):
+        self.name = name
+        self.lib_path = lib_path
+        self.lib = ctypes.CDLL(lib_path)
+
+    def to_op(self, fn_name, num_inputs=1, dtype="float32", vjp=None,
+              out_shape=None):
+        """Lift ``void fn(const T* in..., T* out, int64_t n)`` into a
+        framework op (host callback under jit; differentiable if vjp given).
+
+        out_shape: fn(input_shapes...) -> output shape; defaults to the first
+        input's shape."""
+        import jax
+        import jax.numpy as jnp
+        from ..core.tensor import dispatch
+
+        cfn = getattr(self.lib, fn_name)
+        cfn.restype = None
+        np_dt = np.dtype(dtype)
+
+        def host_impl(*arrays):
+            arrays = [np.ascontiguousarray(a, dtype=np_dt) for a in arrays]
+            shape = (out_shape(*[a.shape for a in arrays])
+                     if out_shape is not None else arrays[0].shape)
+            out = np.empty(shape, dtype=np_dt)
+            argv = [a.ctypes.data_as(ctypes.c_void_p) for a in arrays]
+            cfn(*argv, out.ctypes.data_as(ctypes.c_void_p),
+                ctypes.c_int64(out.size))
+            return out
+
+        def compute(*vals):
+            shape = (out_shape(*[v.shape for v in vals])
+                     if out_shape is not None else vals[0].shape)
+            result = jax.pure_callback(
+                host_impl, jax.ShapeDtypeStruct(shape, np_dt), *vals)
+            return result
+
+        if vjp is not None:
+            compute_vjp = jax.custom_vjp(compute)
+
+            def fwd(*vals):
+                return compute(*vals), vals
+
+            def bwd(res, g):
+                grads = vjp(res, g)
+                return tuple(jnp.asarray(gr) for gr in grads)
+
+            compute_vjp.defvjp(fwd, bwd)
+            inner = compute_vjp
+        else:
+            inner = compute
+
+        def op(*tensors, name=None):
+            return dispatch(lambda *v: inner(*v), tensors, {},
+                            name=f"custom_{fn_name}")
+
+        op.__name__ = fn_name
+        return op
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
+         extra_ldflags=None, build_directory=None, verbose=False):
+    """Compile + load a custom op library (reference: cpp_extension.load).
+
+    Returns an ExtensionModule; recompiles only when sources change."""
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    blobs = []
+    for src in sources:
+        with open(src, "rb") as f:
+            blobs.append(f.read())
+    digest = hashlib.sha256(b"\0".join(blobs)).hexdigest()[:16]
+    lib_path = os.path.join(build_dir, f"lib{name}_{digest}.so")
+    if not os.path.exists(lib_path):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+        for inc in (extra_include_paths or []):
+            cmd += ["-I", inc]
+        cmd += (extra_cxx_cflags or [])
+        cmd += list(sources) + (extra_ldflags or []) + ["-o", lib_path]
+        if verbose:
+            print(" ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"custom op build failed:\n{proc.stderr[-4000:]}")
+    return ExtensionModule(name, lib_path)
+
+
+# -- setup()-style API (reference: cpp_extension.setup) ----------------------
+
+class CppExtension:
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+class BuildExtension:
+    """Placeholder command class for setup() parity."""
+
+    @staticmethod
+    def with_options(**kwargs):
+        return BuildExtension
+
+
+def setup(name, ext_modules, **kwargs):
+    """Build-at-install parity shim: compiles immediately and returns the
+    module handle (the reference integrates with setuptools; here the JIT
+    `load` path is canonical)."""
+    if isinstance(ext_modules, (list, tuple)):
+        ext = ext_modules[0]
+    else:
+        ext = ext_modules
+    return load(name, ext.sources, **ext.kwargs)

@@ -1,0 +1,102 @@
+"""utils.cpp_extension (custom C++ ops) and fleet.metrics tests."""
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.utils import cpp_extension
+from paddle_tpu.distributed.fleet import metrics as fm
+
+gxx = shutil.which("g++")
+needs_gxx = pytest.mark.skipif(gxx is None, reason="g++ unavailable")
+
+
+@pytest.fixture
+def ext(tmp_path):
+    src = tmp_path / "myops.cc"
+    src.write_text(r"""
+#include <cstdint>
+extern "C" void relu_fwd(const float* x, float* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = x[i] > 0 ? x[i] : 0;
+}
+extern "C" void scaled_add(const float* a, const float* b, float* out,
+                           int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = 2 * a[i] + b[i];
+}
+""")
+    return cpp_extension.load("myops", [str(src)],
+                              build_directory=str(tmp_path / "build"))
+
+
+@needs_gxx
+class TestCppExtension:
+    def test_forward(self, ext):
+        relu = ext.to_op("relu_fwd")
+        y = relu(P.to_tensor(np.asarray([-1., 2., -3., 4.], "float32")))
+        np.testing.assert_allclose(y.numpy(), [0., 2., 0., 4.])
+
+    def test_custom_vjp(self, ext):
+        relu = ext.to_op(
+            "relu_fwd",
+            vjp=lambda res, g: ((g * (res[0] > 0)),))
+        x = P.to_tensor(np.asarray([-1., 2., -3., 4.], "float32"),
+                        stop_gradient=False)
+        relu(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0., 1., 0., 1.])
+
+    def test_two_inputs_and_jit(self, ext):
+        sa = ext.to_op("scaled_add", num_inputs=2)
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(a, b):
+            return sa(a, b) * 2
+
+        out = f(P.to_tensor(np.ones(3, "float32")),
+                P.to_tensor(np.full(3, 5.0, "float32")))
+        np.testing.assert_allclose(out.numpy(), [14., 14., 14.])
+
+    def test_rebuild_cache(self, ext, tmp_path):
+        # same sources -> same lib file reused
+        src = tmp_path / "myops.cc"
+        again = cpp_extension.load("myops", [str(src)],
+                                   build_directory=str(tmp_path / "build"))
+        assert again.lib_path == ext.lib_path
+
+    def test_build_error_surfaces(self, tmp_path):
+        bad = tmp_path / "bad.cc"
+        bad.write_text("this is not C++")
+        with pytest.raises(RuntimeError, match="build failed"):
+            cpp_extension.load("bad", [str(bad)],
+                               build_directory=str(tmp_path / "b2"))
+
+
+class TestFleetMetrics:
+    def test_scalar_aggregation_single_worker(self):
+        np.testing.assert_allclose(fm.sum(np.asarray([1.0, 2.0])), [1.0, 2.0])
+        assert fm.acc(8, 10) == pytest.approx(0.8)
+        np.testing.assert_allclose(fm.mean(np.asarray(3.0)), 3.0)
+
+    def test_auc_perfect_and_random(self):
+        pos = np.zeros(10)
+        pos[9] = 100
+        neg = np.zeros(10)
+        neg[0] = 100
+        assert fm.auc(pos, neg) == 1.0
+        assert fm.auc(np.full(10, 10.0), np.full(10, 10.0)) == 0.5
+        assert fm.auc(np.zeros(10), np.zeros(10)) == 0.5
+
+    def test_auc_matches_exact_pairwise(self, rng):
+        scores = rng.random(2000)
+        labels = (rng.random(2000) < scores).astype(int)
+        bins = np.clip((scores * 10).astype(int), 0, 9)
+        pos = np.bincount(bins[labels == 1], minlength=10)
+        neg = np.bincount(bins[labels == 0], minlength=10)
+        ps, ns = bins[labels == 1], bins[labels == 0]
+        wins = (ps[:, None] > ns[None, :]).sum() \
+            + 0.5 * (ps[:, None] == ns[None, :]).sum()
+        ref = wins / (len(ps) * len(ns))
+        assert fm.auc(pos, neg) == pytest.approx(float(ref), abs=1e-9)

@@ -168,24 +168,21 @@ class TestCallbacks:
                                  parameters=nn.Linear(2, 2).parameters())
 
         cb.set_model(FakeModel())
-        # epoch-end checks are deferred one hook (fit fires on_epoch_end
-        # before a possible eval); on_train_end flushes the last one
-        cb.on_epoch_end(0, {"loss": 1.0})
-        cb.on_epoch_end(1, {"loss": 1.0})  # flushes epoch-0: seeds best
-        cb.on_epoch_end(2, {"loss": 1.0})  # flushes epoch-1: wait 1 -> reduce
+        # monitor="loss" = the TRAIN stream, checked at each epoch end
+        cb.on_epoch_end(0, {"loss": 1.0})  # seeds best
+        cb.on_eval_end({"loss": 99.0})     # eval stream ignored entirely
+        cb.on_epoch_end(1, {"loss": 1.0})  # wait 1 -> reduce
         assert FakeModel._optimizer.get_lr() == pytest.approx(0.5)
-        cb.on_epoch_end(3, {"loss": 0.2})  # flushes epoch-2: flat -> reduce
+        cb.on_epoch_end(2, {"loss": 0.2})  # improvement resets
+        cb.on_epoch_end(3, {"loss": 0.2})  # flat -> reduce
         assert FakeModel._optimizer.get_lr() == pytest.approx(0.25)
-        cb.on_epoch_end(4, {"loss": 0.2})  # flushes epoch-3: improvement
-        cb.on_train_end()                  # flushes epoch-4: flat -> reduce
-        assert FakeModel._optimizer.get_lr() == pytest.approx(0.125)
 
     def test_reduce_lr_eval_stream_wins(self):
         import paddle_tpu.nn as nn
         import paddle_tpu.optimizer as opt
         from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
 
-        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+        cb = ReduceLROnPlateau(monitor="eval_loss", factor=0.5, patience=1,
                                verbose=0)
 
         class FakeModel:
@@ -193,7 +190,7 @@ class TestCallbacks:
                                  parameters=nn.Linear(2, 2).parameters())
 
         cb.set_model(FakeModel())
-        # fit() order per epoch: on_epoch_end(train logs) then on_eval_end
+        # monitor="eval_loss" = the EVAL stream only; train logs are ignored
         cb.on_epoch_end(0, {"loss": 0.5})
         cb.on_eval_end({"loss": 0.8})  # seeds best from EVAL, not train
         assert FakeModel._optimizer.get_lr() == pytest.approx(1.0)
@@ -206,8 +203,8 @@ class TestCallbacks:
         import paddle_tpu.optimizer as opt
         from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
 
-        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
-                               cooldown=1, verbose=0)
+        cb = ReduceLROnPlateau(monitor="eval_loss", factor=0.5, patience=1,
+                               cooldown=2, verbose=0)
 
         class FakeModel:
             _optimizer = opt.SGD(learning_rate=1.0,
@@ -216,11 +213,32 @@ class TestCallbacks:
         cb.set_model(FakeModel())
         lrs = []
         for epoch in range(7):
-            cb.on_eval_end({"loss": 1.0})  # eval stream: immediate checks
+            cb.on_eval_end({"loss": 1.0})
             lrs.append(FakeModel._optimizer.get_lr())
-        # flat loss with patience=1, cooldown=1: reduce every 2 epochs, and the
-        # cooldown epoch never accumulates wait
+        # Keras semantics: the epoch that exits cooldown DOES count toward
+        # wait, so cooldown=2 + patience=1 holds each LR for two epochs
         assert lrs == pytest.approx([1.0, 0.5, 0.5, 0.25, 0.25, 0.125, 0.125])
+
+    def test_reduce_lr_resets_between_fits(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                               verbose=0)
+
+        class FakeModel:
+            _optimizer = opt.SGD(learning_rate=1.0,
+                                 parameters=nn.Linear(2, 2).parameters())
+
+        cb.set_model(FakeModel())
+        cb.on_train_begin()
+        cb.on_epoch_end(0, {"loss": 1.0})
+        cb.on_epoch_end(1, {"loss": 1.0})  # wait 1
+        cb.on_train_begin()                # new fit(): state resets
+        cb.on_epoch_end(0, {"loss": 1.0})
+        cb.on_epoch_end(1, {"loss": 1.0})  # wait 1 again, still no reduce
+        assert FakeModel._optimizer.get_lr() == pytest.approx(1.0)
 
     def test_visualdl_gated(self):
         from paddle_tpu.hapi.callbacks import VisualDL

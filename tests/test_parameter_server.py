@@ -1,0 +1,118 @@
+"""Parameter-server tests: single-worker semantics + 2-server subprocess shard.
+Reference strategy: PS tests spin local servers (test/ps in the reference)."""
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+from paddle_tpu.distributed import rpc
+from paddle_tpu.incubate.distributed import ps
+
+
+@pytest.fixture
+def single_node():
+    rpc.init_rpc("ps0", rank=0, world_size=1, master_endpoint="127.0.0.1:0")
+    yield ps.PSClient(["ps0"])
+    ps.shutdown()
+
+
+class TestSingleServer:
+    def test_lazy_init_and_dedup(self, single_node):
+        client = single_node
+        client.create_table("emb", 8, lr=0.5)
+        ids = np.asarray([3, 7, 3, 100])
+        rows = client.pull_sparse("emb", ids)
+        assert rows.shape == (4, 8)
+        np.testing.assert_allclose(rows[0], rows[2])  # same row, same init
+
+    def test_push_updates_rows(self, single_node):
+        client = single_node
+        client.create_table("emb", 8, lr=0.5)
+        ids = np.asarray([3, 7, 3])
+        before = client.pull_sparse("emb", ids)
+        client.push_sparse("emb", ids, np.ones((3, 8), np.float32))
+        after = client.pull_sparse("emb", ids)
+        # id 3 appears twice -> two SGD updates of lr*1
+        np.testing.assert_allclose((before[0] - after[0]).mean(), 1.0,
+                                   rtol=1e-6)
+        np.testing.assert_allclose((before[1] - after[1]).mean(), 0.5,
+                                   rtol=1e-6)
+
+    def test_adagrad_rule(self, single_node):
+        client = single_node
+        client.create_table("ada", 4, optimizer="adagrad", lr=1.0)
+        ids = np.asarray([1])
+        before = client.pull_sparse("ada", ids)
+        client.push_sparse("ada", ids, np.full((1, 4), 2.0, np.float32))
+        after = client.pull_sparse("ada", ids)
+        # adagrad first step: lr * g / sqrt(g^2) = lr
+        np.testing.assert_allclose(before[0] - after[0], 1.0, rtol=1e-5)
+
+    def test_save_load_roundtrip(self, single_node, tmp_path):
+        client = single_node
+        client.create_table("emb", 8)
+        ids = np.asarray([1, 2, 3])
+        snap = client.pull_sparse("emb", ids)
+        client.save("emb", str(tmp_path))
+        client.push_sparse("emb", ids, np.ones((3, 8), np.float32))
+        client.load("emb", str(tmp_path))
+        np.testing.assert_allclose(client.pull_sparse("emb", ids), snap)
+
+    def test_nested_id_shape(self, single_node):
+        client = single_node
+        client.create_table("emb", 4)
+        out = client.pull_sparse("emb", np.asarray([[1, 2], [3, 4]]))
+        assert out.shape == (2, 2, 4)
+
+
+_SERVER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from paddle_tpu.incubate.distributed import ps
+from paddle_tpu.distributed import rpc
+
+ps.start_server(name=sys.argv[2], rank=int(sys.argv[3]), world_size=3,
+                master_endpoint=sys.argv[1])
+# serve until the client triggers the shutdown barrier
+rpc.shutdown()
+print("server done", flush=True)
+"""
+
+
+@pytest.mark.skipif(not native.available(), reason="native runtime unavailable")
+def test_two_server_sharding(tmp_path):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "server.py"
+    script.write_text(_SERVER)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    endpoint = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), endpoint, f"srv{i}", str(i + 1)],
+        cwd=repo_root, env=env) for i in range(2)]
+    try:
+        rpc.init_rpc("client", rank=0, world_size=3,
+                     master_endpoint=endpoint)
+        client = ps.PSClient(["srv0", "srv1"])
+        client.create_table("emb", 6, lr=1.0)
+        ids = np.arange(10)
+        rows = client.pull_sparse("emb", ids)
+        assert rows.shape == (10, 6)
+        client.push_sparse("emb", ids, np.ones((10, 6), np.float32))
+        after = client.pull_sparse("emb", ids)
+        np.testing.assert_allclose(rows - after, 1.0, rtol=1e-6)
+    finally:
+        rpc.shutdown()  # barrier releases the servers
+        for p in procs:
+            p.wait(timeout=120)
+    assert all(p.returncode == 0 for p in procs)
