@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from ....core.tensor import Tensor, dispatch
+from ....core import random as _random
 from ....nn.functional.activation import swiglu  # noqa: F401  (parity re-export)
 from ....nn.functional.attention import (
     scaled_dot_product_attention, flash_attn_unpadded,
@@ -365,3 +366,239 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
     args = (x, gate_weight, ffn1_weight, ffn2_weight) + tuple(
         a for a in (ffn1_bias, ffn2_bias) if a is not None)
     return dispatch(fn, args, {}, name="fused_moe")
+
+
+def _dropout_val(v, rate, key, mode):
+    """Shared dropout-on-values helper (None key = inference/no-op)."""
+    if key is None or rate == 0.0:
+        return v
+    keep = jax.random.bernoulli(key, 1.0 - rate, v.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, v / (1.0 - rate), 0.0)
+    return jnp.where(keep, v, 0.0)
+
+
+def _layer_norm_val(v, scale, bias, eps):
+    """Shared LN-on-values helper; statistics accumulate in fp32 like the
+    canonical nn.functional.layer_norm."""
+    v32 = v.astype(jnp.float32)
+    mu = jnp.mean(v32, -1, keepdims=True)
+    var = jnp.var(v32, -1, keepdims=True)
+    out = ((v32 - mu) / jnp.sqrt(var + eps)).astype(v.dtype)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _add_attn_mask(logits, mask):
+    """bool mask = keep-where-True; numeric mask = additive (same convention
+    as nn/functional/attention.py)."""
+    if mask.dtype == jnp.bool_:
+        return jnp.where(mask, logits, jnp.float32(-1e30))
+    return logits + mask.astype(jnp.float32)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one op (reference:
+    incubate/nn/functional/fused_dropout_add.py); XLA fuses the mask multiply
+    into the add."""
+    key = _random.next_key() if training and p > 0.0 else None
+
+    def fn(a, b):
+        return _dropout_val(a, p, key, mode) + b
+
+    return dispatch(fn, (x, y), {}, name="fused_dropout_add")
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method="gelu", compute_dtype="default",
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0, name=None):
+    """bias-add + activation epilogue (reference:
+    incubate/nn/functional/fused_bias_act.py). The int8/fp8 quant epilogue
+    parameters are not implemented — pass them and you get a loud error, not
+    silently-unquantized output."""
+    if any(p is not None for p in (dequant_scales, shift, smooth)) \
+            or quant_scale != -1:
+        raise NotImplementedError(
+            "fused_bias_act quantization epilogue (dequant_scales/shift/"
+            "smooth/quant_scale) is not implemented; use paddle_tpu.nn.quant "
+            "for quantized linears")
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
+            "swiglu": None, "geglu": None}
+    if act_method not in acts:
+        raise ValueError(f"unsupported act_method {act_method!r}")
+
+    def fn(xv, bv):
+        if bv is not None:
+            xv = xv + bv
+        if act_method in ("swiglu", "geglu"):
+            a, b = jnp.split(xv, 2, axis=-1)
+            gate = jax.nn.silu(a) if act_method == "swiglu" else jax.nn.gelu(a)
+            return gate * b
+        return acts[act_method](xv)
+
+    return dispatch(fn, (x, bias), {}, name="fused_bias_act")
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", name=None):
+    """Transformer FFN block in one op (reference:
+    incubate/nn/functional/fused_transformer.py fused_feedforward):
+    residual + LN( x + dropout2( linear2( dropout1( act( linear1(x) ) ) ) ) ),
+    with pre-LN variant."""
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[activation]
+    key1 = _random.next_key() if training and dropout1_rate > 0 else None
+    key2 = _random.next_key() if training and dropout2_rate > 0 else None
+
+    def fn(xv, w1, w2, b1, b2, s1, bb1, s2, bb2):
+        residual = xv
+        h = xv
+        if pre_layer_norm:
+            h = _layer_norm_val(h, s1, bb1, ln1_epsilon)
+        h = jnp.matmul(h, w1)
+        if b1 is not None:
+            h = h + b1
+        h = _dropout_val(act(h), dropout1_rate, key1, mode)
+        h = jnp.matmul(h, w2)
+        if b2 is not None:
+            h = h + b2
+        out = residual + _dropout_val(h, dropout2_rate, key2, mode)
+        if not pre_layer_norm:
+            out = _layer_norm_val(out, s2, bb2, ln2_epsilon)
+        return out
+
+    return dispatch(fn, (x, linear1_weight, linear2_weight, linear1_bias,
+                         linear2_bias, ln1_scale, ln1_bias, ln2_scale,
+                         ln2_bias), {}, name="fused_feedforward")
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, name=None):
+    """Full MHA block in one op (reference: fused_transformer.py
+    fused_multi_head_attention): optional pre-LN, fused QKV GEMM, SDPA,
+    out-proj, dropout, residual, post-LN.
+
+    qkv_weight: [3, H, D, hidden]; linear_weight: [hidden, hidden]."""
+    key_attn = _random.next_key() if training and attn_dropout_rate > 0 \
+        else None
+    key_out = _random.next_key() if training and dropout_rate > 0 else None
+
+    def fn(xv, wqkv, wo, pls, plb, lns, lnb, bqkv, bo, mask, cache):
+        residual = xv
+        h = _layer_norm_val(xv, pls, plb, pre_ln_epsilon) \
+            if pre_layer_norm else xv
+        three, H, D, hidden = wqkv.shape
+        # wqkv [3, H, D, hidden]: contract the hidden dim of the input
+        qkv = jnp.einsum("bsx,thdx->tbshd", h, wqkv)
+        if bqkv is not None:
+            qkv = qkv + bqkv.reshape(3, 1, 1, H, D)
+        q, k, v = qkv[0], qkv[1], qkv[2]              # [B, S, H, D]
+        new_cache = None
+        if cache is not None:
+            # cache [2, B, H, T, D]: append this call's K/V (reference
+            # returns cache_kv_out alongside out)
+            k_hist = jnp.moveaxis(cache[0], 2, 1)     # [B, T, H, D]
+            v_hist = jnp.moveaxis(cache[1], 2, 1)
+            k = jnp.concatenate([k_hist, k], axis=1)
+            v = jnp.concatenate([v_hist, v], axis=1)
+            new_cache = jnp.stack([jnp.moveaxis(k, 1, 2),
+                                   jnp.moveaxis(v, 1, 2)])
+        sc = 1.0 / math.sqrt(D)
+        logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * sc
+        if mask is not None:
+            logits = _add_attn_mask(logits, mask)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        probs = _dropout_val(probs, attn_dropout_rate, key_attn, mode)
+        ctx = jnp.einsum("bhst,bthd->bshd", probs, v)
+        ctx = ctx.reshape(ctx.shape[0], ctx.shape[1], H * D)
+        out = jnp.matmul(ctx, wo)
+        if bo is not None:
+            out = out + bo
+        out = _dropout_val(out, dropout_rate, key_out, mode)
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            out = _layer_norm_val(out, lns, lnb, ln_epsilon)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+    return dispatch(fn, (x, qkv_weight, linear_weight, pre_ln_scale,
+                         pre_ln_bias, ln_scale, ln_bias, qkv_bias, linear_bias,
+                         attn_mask, cache_kv), {},
+                    name="fused_multi_head_attention")
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets=None, cum_offsets=None,
+                              cu_seqlens_q=None, cu_seqlens_k=None,
+                              block_tables=None, pre_key_cache=None,
+                              pre_value_cache=None, cache_k_quant_scales=None,
+                              cache_v_quant_scales=None, max_seq_len=None,
+                              block_size=None, use_neox_style=False,
+                              name=None):
+    """Paged-KV-cache decode attention (reference:
+    incubate/nn/functional/block_multihead_attention.py, phi
+    block_multi_head_attention_kernel.cu — the vLLM-style paged attention).
+
+    Decode-step form: qkv [B, 3*H*D] (one new token per sequence);
+    key_cache/value_cache [num_blocks, H, block_size, D]; block_tables
+    [B, max_blocks_per_seq] maps logical KV block i of each sequence to a
+    physical cache block (-1 = unused); seq_lens_decoder [B] = tokens already
+    cached. Returns (out [B, H*D], key_cache, value_cache) with the new token
+    written into its block — functional cache update, TPU-style.
+    """
+    if block_tables is None:
+        raise ValueError("block_mha requires block_tables")
+
+    def fn(qkv_v, kc, vc, lens, tables):
+        nb, H, bs, D = kc.shape
+        b = qkv_v.shape[0]
+        max_blocks = tables.shape[1]
+        qkv3 = qkv_v.reshape(b, 3, H, D)
+        q, knew, vnew = qkv3[:, 0], qkv3[:, 1], qkv3[:, 2]
+
+        # write the new token at position lens[i] of sequence i; a -1 table
+        # entry (no block allocated) must NOT wrap to the last physical block
+        blk_idx = tables[jnp.arange(b), lens // bs]       # [B] physical block
+        slot = lens % bs                                  # [B]
+        valid = (blk_idx >= 0)[:, None, None]
+        safe_blk = jnp.maximum(blk_idx, 0)
+        kc = kc.at[safe_blk, :, slot].set(
+            jnp.where(valid, knew, kc[safe_blk, :, slot]))
+        vc = vc.at[safe_blk, :, slot].set(
+            jnp.where(valid, vnew, vc[safe_blk, :, slot]))
+
+        # gather each sequence's logical KV [B, max_blocks*bs, H, D]
+        safe_tables = jnp.maximum(tables, 0)
+        kseq = kc[safe_tables]                            # [B, MB, H, bs, D]
+        vseq = vc[safe_tables]
+        kseq = jnp.moveaxis(kseq, 3, 2).reshape(b, max_blocks * bs, H, D)
+        vseq = jnp.moveaxis(vseq, 3, 2).reshape(b, max_blocks * bs, H, D)
+
+        sc = 1.0 / math.sqrt(D)
+        logits = jnp.einsum("bhd,bthd->bht", q, kseq).astype(jnp.float32) * sc
+        t_idx = jnp.arange(max_blocks * bs)
+        visible = t_idx[None, :] <= lens[:, None]         # include new token
+        logits = jnp.where(visible[:, None, :], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(vseq.dtype)
+        out = jnp.einsum("bht,bthd->bhd", probs, vseq)
+        return out.reshape(b, H * D), kc, vc
+
+    return dispatch(fn, (qkv, key_cache, value_cache, seq_lens_decoder,
+                         block_tables), {}, name="block_multihead_attention")

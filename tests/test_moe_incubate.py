@@ -200,3 +200,107 @@ def test_fused_transformer_layers(rng):
     assert y.shape == [2, 8, 32]
     (y * y).sum().backward()
     assert attn.qkv_weight.grad is not None
+
+
+class TestNewFusedOps:
+    def test_fused_dropout_add_eval(self, rng):
+        x = paddle.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+        y = paddle.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+        out = IF.fused_dropout_add(x, y, p=0.5, training=False)
+        np.testing.assert_allclose(out.numpy(), x.numpy() + y.numpy())
+
+    def test_fused_dropout_add_train_scale(self, rng):
+        paddle.seed(0)
+        x = paddle.to_tensor(np.ones((512, 64), "float32"))
+        y = paddle.to_tensor(np.zeros((512, 64), "float32"))
+        out = IF.fused_dropout_add(x, y, p=0.5, training=True).numpy()
+        # upscale_in_train: surviving entries are 1/(1-p)=2, mean stays ~1
+        assert set(np.unique(out)).issubset({0.0, 2.0})
+        assert abs(out.mean() - 1.0) < 0.05
+
+    def test_fused_bias_act(self, rng):
+        x = paddle.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+        b = paddle.to_tensor(np.ones(8, "float32"))
+        out = IF.fused_bias_act(x, b, act_method="relu")
+        np.testing.assert_allclose(out.numpy(),
+                                   np.maximum(x.numpy() + 1, 0))
+        sw = IF.fused_bias_act(x, None, act_method="swiglu").numpy()
+        a_, b_ = np.split(x.numpy(), 2, -1)
+        np.testing.assert_allclose(sw, (a_ / (1 + np.exp(-a_))) * b_,
+                                   rtol=1e-5)
+
+    def test_fused_feedforward_matches_manual(self, rng):
+        H, FF = 8, 16
+        x = paddle.to_tensor(rng.standard_normal((4, H)).astype("float32"))
+        w1 = rng.standard_normal((H, FF)).astype("float32")
+        w2 = rng.standard_normal((FF, H)).astype("float32")
+        out = IF.fused_feedforward(
+            x, paddle.to_tensor(w1), paddle.to_tensor(w2),
+            ln2_scale=paddle.to_tensor(np.ones(H, "float32")),
+            ln2_bias=paddle.to_tensor(np.zeros(H, "float32")),
+            dropout1_rate=0.0, dropout2_rate=0.0, activation="relu")
+        h = np.maximum(x.numpy() @ w1, 0) @ w2
+        o = x.numpy() + h
+        ref = (o - o.mean(-1, keepdims=True)) \
+            / np.sqrt(o.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_mha_matches_manual(self, rng):
+        import math
+        B, S, Hh, D = 2, 5, 2, 4
+        hidden = Hh * D
+        xs = paddle.to_tensor(
+            rng.standard_normal((B, S, hidden)).astype("float32"))
+        wqkv = rng.standard_normal((3, Hh, D, hidden)).astype("float32")
+        wo = rng.standard_normal((hidden, hidden)).astype("float32")
+        out = IF.fused_multi_head_attention(
+            xs, paddle.to_tensor(wqkv), paddle.to_tensor(wo),
+            dropout_rate=0.0, attn_dropout_rate=0.0,
+            ln_scale=paddle.to_tensor(np.ones(hidden, "float32")),
+            ln_bias=paddle.to_tensor(np.zeros(hidden, "float32")))
+        xv = xs.numpy()
+        qkv = np.einsum("bsx,thdx->tbshd", xv, wqkv)
+        q, k, v = qkv
+        lg = np.einsum("bshd,bthd->bhst", q, k) / math.sqrt(D)
+        pr = np.exp(lg - lg.max(-1, keepdims=True))
+        pr /= pr.sum(-1, keepdims=True)
+        ctx = np.einsum("bhst,bthd->bshd", pr, v).reshape(B, S, hidden)
+        o = xv + ctx @ wo
+        ref = (o - o.mean(-1, keepdims=True)) \
+            / np.sqrt(o.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+    def test_block_mha_matches_naive(self, rng):
+        import math
+        B, Hh, D, bs_, nb = 2, 2, 4, 4, 6
+        kc = np.zeros((nb, Hh, bs_, D), "float32")
+        vc = np.zeros_like(kc)
+        tables = np.asarray([[0, 1, -1], [2, 3, 4]])
+        lens = np.asarray([2, 5])
+        hist_k = rng.standard_normal((B, 6, Hh, D)).astype("float32")
+        hist_v = rng.standard_normal((B, 6, Hh, D)).astype("float32")
+        for i in range(B):
+            for t in range(lens[i]):
+                blk, slot = tables[i][t // bs_], t % bs_
+                kc[blk, :, slot] = hist_k[i, t]
+                vc[blk, :, slot] = hist_v[i, t]
+        qkv = rng.standard_normal((B, 3 * Hh * D)).astype("float32")
+        out, kc2, vc2 = IF.block_multihead_attention(
+            paddle.to_tensor(qkv), paddle.to_tensor(kc), paddle.to_tensor(vc),
+            None, paddle.to_tensor(lens), None,
+            block_tables=paddle.to_tensor(tables))
+        q3 = qkv.reshape(B, 3, Hh, D)
+        for i in range(B):
+            q, kn, vn = q3[i, 0], q3[i, 1], q3[i, 2]
+            ks = np.concatenate([hist_k[i, :lens[i]], kn[None]], 0)
+            vs = np.concatenate([hist_v[i, :lens[i]], vn[None]], 0)
+            lg = np.einsum("hd,thd->ht", q, ks) / math.sqrt(D)
+            pr = np.exp(lg - lg.max(-1, keepdims=True))
+            pr /= pr.sum(-1, keepdims=True)
+            ref = np.einsum("ht,thd->hd", pr, vs).reshape(-1)
+            np.testing.assert_allclose(out.numpy()[i], ref, rtol=1e-4,
+                                       atol=1e-5)
+        # new token landed in its block slot
+        blk, slot = tables[0][lens[0] // bs_], lens[0] % bs_
+        np.testing.assert_allclose(np.asarray(kc2._value)[blk, :, slot],
+                                   q3[0, 1], rtol=1e-6)
