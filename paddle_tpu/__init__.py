@@ -324,3 +324,5 @@ _bind("cpu", lambda self: self)
 _bind("cuda", lambda self, *a, **k: self)
 _bind("tpu", lambda self, *a, **k: self)
 _bind("pin_memory", lambda self: self)
+
+from . import version  # noqa: E402,F401

@@ -240,14 +240,70 @@ def load_inference_model(path_prefix, executor=None, _return_meta=False,
 
 
 class nn:
-    """paddle.static.nn parity namespace: static layers are the same layers."""
+    """paddle.static.nn parity namespace: static layers are the same layers
+    (the program tape records whatever ops they dispatch)."""
 
     @staticmethod
     def fc(x, size, num_flatten_dims=1, activation=None, name=None):
         from ..nn.layer.common import Linear
         from ..nn import functional as F
+        from .. import ops
+        # paddle semantics: flatten dims [num_flatten_dims:] into the
+        # projected axis (base/layers fc)
+        if num_flatten_dims != len(x.shape) - 1:
+            x = ops.flatten(x, start_axis=num_flatten_dims)
         lin = Linear(x.shape[-1], size)
         out = lin(x)
         if activation:
             out = getattr(F, activation)(out)
         return out
+
+    @staticmethod
+    def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+               dilation=1, groups=1, act=None, name=None, **kwargs):
+        from ..nn.layer.conv import Conv2D
+        from ..nn import functional as F
+        conv = Conv2D(input.shape[1], num_filters, filter_size, stride,
+                      padding, dilation, groups)
+        out = conv(input)
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+                   data_layout="NCHW", name=None, **kwargs):
+        from ..nn.layer.norm import BatchNorm2D
+        from ..nn import functional as F
+        ch_axis = 1 if data_layout == "NCHW" else -1
+        bn = BatchNorm2D(input.shape[ch_axis], momentum=momentum,
+                         epsilon=epsilon, data_format=data_layout)
+        if is_test:
+            bn.eval()
+        out = bn(input)
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def embedding(input, size, is_sparse=False, is_distributed=False,
+                  padding_idx=None, name=None, **kwargs):
+        from ..nn.layer.common import Embedding
+        return Embedding(size[0], size[1], padding_idx=padding_idx)(input)
+
+    @staticmethod
+    def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+                   epsilon=1e-5, act=None, name=None, **kwargs):
+        from ..nn import functional as F
+        shape = input.shape[begin_norm_axis:]
+        # affine-less LN equals ones/zeros affine — skip the constant tensors
+        out = F.layer_norm(input, shape, weight=None, bias=None,
+                           epsilon=epsilon)
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def dropout(x, dropout_prob=0.5, is_test=False, name=None, **kwargs):
+        from ..nn import functional as F
+        return F.dropout(x, p=dropout_prob, training=not is_test)

@@ -1,0 +1,24 @@
+"""paddle.version analog (reference: generated python/paddle/version/__init__.py)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "tpu-native"
+with_gpu = "OFF"   # device story is TPU via PJRT
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+istaged = True
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit}); backend: JAX/XLA TPU")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -185,3 +185,30 @@ def test_static_save_load_inference_model(rng, tmp_path):
     out = fetch_fn(xv)
     ref = static.Executor().run(main, feed={"x": xv}, fetch_list=[y])[0]
     np.testing.assert_allclose(np.asarray(out[0]), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_static_nn_layers(rng):
+    import paddle_tpu.static as static
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 3, 8, 8])
+        conv = static.nn.conv2d(x, 4, 3, padding=1, act="relu")
+        y = static.nn.batch_norm(conv)
+        ids = static.data("ids", [2, 5], dtype="int64")
+        emb = static.nn.embedding(ids, [100, 16])
+        ln = static.nn.layer_norm(emb, begin_norm_axis=2)
+        dr = static.nn.dropout(ln, 0.5, is_test=True)
+    outs = static.Executor().run(
+        main,
+        feed={"x": rng.standard_normal((2, 3, 8, 8)).astype("float32"),
+              "ids": np.zeros((2, 5), "int64")},
+        fetch_list=[y, dr, conv])
+    assert outs[0].shape == (2, 4, 8, 8)
+    assert outs[1].shape == (2, 5, 16)
+    assert (outs[2] >= 0).all()  # relu applied before BN
+
+
+def test_version_module():
+    import paddle_tpu as P
+    assert P.version.full_version == P.__version__
+    P.version.show()
