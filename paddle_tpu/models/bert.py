@@ -55,7 +55,11 @@ class BertEmbeddings(Layer):
         s = input_ids.shape[1]
         pos = ops.arange(s, dtype="int64")
         x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
-        if token_type_ids is not None:
+        if token_type_ids is None:
+            # reference semantics (HF/paddle): segment ids default to 0 — add
+            # the broadcast type-0 row rather than gathering a [B,S] zeros map
+            x = x + self.token_type_embeddings.weight[0]
+        else:
             x = x + self.token_type_embeddings(token_type_ids)
         return self.dropout(self.layer_norm(x))
 

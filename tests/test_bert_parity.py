@@ -1,0 +1,134 @@
+"""BERT MLM loss parity vs a weight-matched HuggingFace torch reference
+(BASELINE config 2: BERT-base MLM pretraining — here the numerical core on a
+tiny config; the DP scaling path is covered by the distributed tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.optimizer as opt
+from paddle_tpu.jit.api import TrainStep
+from paddle_tpu.models import BertConfig, BertForMaskedLM
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _build_pair():
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=64, type_vocab_size=2,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    P.seed(0)
+    ours = BertForMaskedLM(cfg)
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu", layer_norm_eps=cfg.layer_norm_eps,
+        attn_implementation="eager",
+        tie_word_embeddings=False)  # ours has an independent decoder
+    theirs = transformers.BertForMaskedLM(hf_cfg)
+
+    with torch.no_grad():
+        sd = theirs.state_dict()
+
+        def put(key, arr, transpose=False):
+            t = torch.from_numpy(np.asarray(arr, dtype=np.float32))
+            sd[key].copy_(t.T if transpose else t)
+
+        emb = ours.bert.embeddings
+        put("bert.embeddings.word_embeddings.weight",
+            emb.word_embeddings.weight.numpy())
+        put("bert.embeddings.position_embeddings.weight",
+            emb.position_embeddings.weight.numpy())
+        put("bert.embeddings.token_type_embeddings.weight",
+            emb.token_type_embeddings.weight.numpy())
+        put("bert.embeddings.LayerNorm.weight", emb.layer_norm.weight.numpy())
+        put("bert.embeddings.LayerNorm.bias", emb.layer_norm.bias.numpy())
+        for i, layer in enumerate(ours.bert.encoder.layers):
+            pre = f"bert.encoder.layer.{i}."
+            att = layer.self_attn
+            for hf_name, lin in (("query", att.q_proj), ("key", att.k_proj),
+                                 ("value", att.v_proj)):
+                put(pre + f"attention.self.{hf_name}.weight",
+                    lin.weight.numpy(), transpose=True)
+                put(pre + f"attention.self.{hf_name}.bias", lin.bias.numpy())
+            put(pre + "attention.output.dense.weight",
+                att.out_proj.weight.numpy(), transpose=True)
+            put(pre + "attention.output.dense.bias", att.out_proj.bias.numpy())
+            put(pre + "attention.output.LayerNorm.weight",
+                layer.norm1.weight.numpy())
+            put(pre + "attention.output.LayerNorm.bias",
+                layer.norm1.bias.numpy())
+            put(pre + "intermediate.dense.weight",
+                layer.linear1.weight.numpy(), transpose=True)
+            put(pre + "intermediate.dense.bias", layer.linear1.bias.numpy())
+            put(pre + "output.dense.weight", layer.linear2.weight.numpy(),
+                transpose=True)
+            put(pre + "output.dense.bias", layer.linear2.bias.numpy())
+            put(pre + "output.LayerNorm.weight", layer.norm2.weight.numpy())
+            put(pre + "output.LayerNorm.bias", layer.norm2.bias.numpy())
+        put("cls.predictions.transform.dense.weight",
+            ours.transform.weight.numpy(), transpose=True)
+        put("cls.predictions.transform.dense.bias",
+            ours.transform.bias.numpy())
+        put("cls.predictions.transform.LayerNorm.weight",
+            ours.transform_norm.weight.numpy())
+        put("cls.predictions.transform.LayerNorm.bias",
+            ours.transform_norm.bias.numpy())
+        put("cls.predictions.decoder.weight", ours.decoder.weight.numpy(),
+            transpose=True)
+        put("cls.predictions.decoder.bias", ours.decoder.bias.numpy())
+        put("cls.predictions.bias", ours.decoder.bias.numpy())
+    theirs.eval()
+    return cfg, ours, theirs
+
+
+def _mlm_batch(rng, cfg, batch=2, seq=24, mask_frac=0.25):
+    ids = rng.integers(4, cfg.vocab_size, size=(batch, seq)).astype(np.int64)
+    labels = np.full_like(ids, -100)
+    mask = rng.random((batch, seq)) < mask_frac
+    mask[:, 0] = True  # ensure at least one masked position
+    labels[mask] = ids[mask]
+    corrupted = ids.copy()
+    corrupted[mask] = 3  # [MASK]
+    return corrupted, labels
+
+
+class TestBertParity:
+    def test_mlm_loss_matches(self, rng):
+        cfg, ours, theirs = _build_pair()
+        ours.eval()
+        ids, labels = _mlm_batch(rng, cfg)
+        our_loss, _ = ours(P.to_tensor(ids.astype(np.int32)),
+                           labels=P.to_tensor(labels.astype(np.int32)))
+        with torch.no_grad():
+            hf = theirs(input_ids=torch.from_numpy(ids),
+                        labels=torch.from_numpy(labels))
+        np.testing.assert_allclose(float(our_loss.numpy()), float(hf.loss),
+                                   rtol=3e-4)
+
+    def test_three_step_sgd_curve(self, rng):
+        cfg, ours, theirs = _build_pair()
+        lr = 0.05
+        o = opt.SGD(learning_rate=lr, parameters=ours.parameters())
+        step = TrainStep(ours, lambda m, i, l: m(i, labels=l)[0], o)
+        topt = torch.optim.SGD(theirs.parameters(), lr=lr)
+        theirs.train()
+
+        ids, labels = _mlm_batch(rng, cfg)
+        ours_l, hf_l = [], []
+        for _ in range(3):
+            loss = step(P.to_tensor(ids.astype(np.int32)),
+                        P.to_tensor(labels.astype(np.int32)))
+            ours_l.append(float(np.asarray(loss._value)))
+            topt.zero_grad()
+            out = theirs(input_ids=torch.from_numpy(ids),
+                         labels=torch.from_numpy(labels))
+            out.loss.backward()
+            topt.step()
+            hf_l.append(float(out.loss.detach()))
+        np.testing.assert_allclose(ours_l, hf_l, rtol=3e-3)
+        assert ours_l[-1] < ours_l[0]

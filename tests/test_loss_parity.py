@@ -68,7 +68,6 @@ def _build_pair():
                 layer.mlp.up_proj.weight.numpy(), transpose=True)
             put(pre + "mlp.down_proj.weight",
                 layer.mlp.down_proj.weight.numpy(), transpose=True)
-        theirs.load_state_dict(sd)
     return cfg, ours, theirs
 
 
