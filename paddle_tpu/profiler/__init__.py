@@ -21,7 +21,7 @@ from .timer import benchmark  # noqa: F401
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-    "SummaryView", "benchmark",
+    "SummaryView", "benchmark", "merge_profile",
 ]
 
 
@@ -275,3 +275,57 @@ def load_profiler_result(filename):
     """Load an exported chrome-trace JSON back as a list of events."""
     with open(filename) as f:
         return json.load(f).get("traceEvents", [])
+
+
+def merge_profile(rank_dirs_or_files, output_path, align_start=True):
+    """Merge per-rank chrome traces into one cluster-wide timeline.
+
+    Reference: tools/CrossStackProfiler/ (merges per-rank profiles into a
+    single view for cluster-wide hang/straggler diagnosis — SURVEY.md §5.1).
+    Each rank's events land in their own process lane (pid = rank index, with
+    a process_name metadata row); with align_start, per-rank clocks are
+    shifted so every rank's first event starts at t=0, compensating unsynced
+    host clocks.
+    """
+    import glob
+    import re
+
+    def _natural(s):
+        return [int(t) if t.isdigit() else t
+                for t in re.split(r"(\d+)", os.path.basename(s))]
+
+    files = []
+    for entry in rank_dirs_or_files:
+        if os.path.isdir(entry):
+            # natural sort so rank10 sorts after rank9, not after rank1
+            files.extend(sorted(glob.glob(os.path.join(entry, "*.json")),
+                                key=_natural))
+        else:
+            files.append(entry)
+    if not files:
+        raise ValueError("no trace files to merge")
+
+    merged = []
+    for rank, path in enumerate(files):
+        with open(path) as f:
+            trace = json.load(f)
+        events = trace["traceEvents"] if isinstance(trace, dict) else trace
+        t0 = min((e["ts"] for e in events
+                  if e.get("ph") != "M" and "ts" in e), default=0)
+        shift = -t0 if align_start else 0
+        label = os.path.splitext(os.path.basename(path))[0]
+        merged.append({"ph": "M", "pid": rank, "name": "process_name",
+                       "args": {"name": f"rank{rank}:{label}"}})
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                continue  # replaced by the rank lane name
+            e = dict(e)
+            e["pid"] = rank
+            if "ts" in e:
+                e["ts"] = e["ts"] + shift
+            merged.append(e)
+
+    os.makedirs(os.path.dirname(output_path) or ".", exist_ok=True)
+    with open(output_path, "w") as f:
+        json.dump({"traceEvents": merged}, f)
+    return output_path

@@ -101,3 +101,53 @@ def test_tensor_checker_flags():
     disable_tensor_checker()
     y = x / paddle.zeros([2])  # no raise once disabled
     assert not np.isfinite(np.asarray(y._value)).all()
+
+
+def test_merge_profile_cross_rank(tmp_path):
+    import json
+    from paddle_tpu.profiler import merge_profile
+
+    # fabricate two per-rank traces with different clock bases
+    for rank, base in ((0, 1_000_000), (1, 5_000_000)):
+        events = [
+            {"ph": "M", "pid": 1234, "name": "process_name",
+             "args": {"name": "host"}},
+            {"ph": "X", "pid": 1234, "tid": 1, "name": f"step{rank}",
+             "ts": base + 10, "dur": 100},
+            {"ph": "X", "pid": 1234, "tid": 1, "name": "allreduce",
+             "ts": base + 150, "dur": 50},
+        ]
+        with open(tmp_path / f"rank{rank}.json", "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    out = merge_profile([str(tmp_path / "rank0.json"),
+                         str(tmp_path / "rank1.json")],
+                        str(tmp_path / "merged.json"))
+    merged = json.load(open(out))["traceEvents"]
+    lanes = {e["args"]["name"] for e in merged
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert lanes == {"rank0:rank0", "rank1:rank1"}
+    xs = [e for e in merged if e.get("ph") == "X"]
+    assert {e["pid"] for e in xs} == {0, 1}
+    # clocks aligned: each rank's earliest event shifts to ts=0, and the
+    # relative in-rank spacing survives
+    starts = sorted(e["ts"] for e in xs if e["name"].startswith("step"))
+    assert starts == [0, 0]
+    gaps = sorted(e["ts"] for e in xs if e["name"] == "allreduce")
+    assert gaps == [140, 140]
+
+
+def test_merge_profile_from_dir(tmp_path):
+    import json
+    from paddle_tpu.profiler import merge_profile
+
+    d = tmp_path / "traces"
+    d.mkdir()
+    for i in range(2):
+        with open(d / f"w{i}.json", "w") as f:
+            json.dump({"traceEvents": [
+                {"ph": "X", "pid": 9, "tid": 0, "name": "op", "ts": 5,
+                 "dur": 1}]}, f)
+    out = merge_profile([str(d)], str(tmp_path / "m.json"))
+    merged = json.load(open(out))["traceEvents"]
+    assert len([e for e in merged if e.get("ph") == "X"]) == 2

@@ -1,0 +1,100 @@
+"""ViT classification parity vs a weight-matched HF torch reference
+(BASELINE config 4: ViT-L semi-auto — here the numerical core on a tiny
+config; the semi-auto sharding path is covered by the distributed tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.vision.models import VisionTransformer
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _build_pair():
+    D, H, depth, patch, img = 32, 2, 2, 8, 32
+    P.seed(0)
+    ours = VisionTransformer(img_size=img, patch_size=patch, num_classes=5,
+                             embed_dim=D, depth=depth, num_heads=H,
+                             drop_rate=0.0, attn_drop_rate=0.0)
+    hf_cfg = transformers.ViTConfig(
+        hidden_size=D, num_hidden_layers=depth, num_attention_heads=H,
+        intermediate_size=4 * D, image_size=img, patch_size=patch,
+        num_labels=5, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, layer_norm_eps=1e-6,
+        attn_implementation="eager", hidden_act="gelu")
+    theirs = transformers.ViTForImageClassification(hf_cfg)
+
+    with torch.no_grad():
+        sd = theirs.state_dict()
+
+        def put(key, arr, transpose=False):
+            t = torch.from_numpy(np.asarray(arr, dtype=np.float32))
+            sd[key].copy_(t.T if transpose else t)
+
+        put("vit.embeddings.cls_token", ours.cls_token.numpy())
+        put("vit.embeddings.position_embeddings", ours.pos_embed.numpy())
+        put("vit.embeddings.patch_embeddings.projection.weight",
+            ours.patch_embed.proj.weight.numpy())
+        put("vit.embeddings.patch_embeddings.projection.bias",
+            ours.patch_embed.proj.bias.numpy())
+        for i, blk in enumerate(ours.blocks):
+            pre = f"vit.encoder.layer.{i}."
+            wqkv = blk.attn.qkv.weight.numpy()       # (D, 3D): [q | k | v]
+            bqkv = blk.attn.qkv.bias.numpy()
+            for j, nm in enumerate(("query", "key", "value")):
+                put(pre + f"attention.attention.{nm}.weight",
+                    wqkv[:, j * D:(j + 1) * D], transpose=True)
+                put(pre + f"attention.attention.{nm}.bias",
+                    bqkv[j * D:(j + 1) * D])
+            put(pre + "attention.output.dense.weight",
+                blk.attn.proj.weight.numpy(), transpose=True)
+            put(pre + "attention.output.dense.bias",
+                blk.attn.proj.bias.numpy())
+            put(pre + "layernorm_before.weight", blk.norm1.weight.numpy())
+            put(pre + "layernorm_before.bias", blk.norm1.bias.numpy())
+            put(pre + "layernorm_after.weight", blk.norm2.weight.numpy())
+            put(pre + "layernorm_after.bias", blk.norm2.bias.numpy())
+            put(pre + "intermediate.dense.weight", blk.mlp[0].weight.numpy(),
+                transpose=True)
+            put(pre + "intermediate.dense.bias", blk.mlp[0].bias.numpy())
+            put(pre + "output.dense.weight", blk.mlp[3].weight.numpy(),
+                transpose=True)
+            put(pre + "output.dense.bias", blk.mlp[3].bias.numpy())
+        put("vit.layernorm.weight", ours.norm.weight.numpy())
+        put("vit.layernorm.bias", ours.norm.bias.numpy())
+        put("classifier.weight", ours.head.weight.numpy(), transpose=True)
+        put("classifier.bias", ours.head.bias.numpy())
+    theirs.eval()
+    return ours, theirs
+
+
+def test_vit_logits_match(rng):
+    ours, theirs = _build_pair()
+    ours.eval()
+    x = rng.standard_normal((2, 3, 32, 32)).astype("float32")
+    got = ours(P.to_tensor(x)).numpy()
+    with torch.no_grad():
+        ref = theirs(pixel_values=torch.from_numpy(x)).logits.numpy()
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+
+
+def test_vit_grad_direction_matches(rng):
+    ours, theirs = _build_pair()
+    ours.eval()
+    x = rng.standard_normal((2, 3, 32, 32)).astype("float32")
+    labels = np.asarray([1, 3], dtype="int64")
+
+    import paddle_tpu.nn.functional as F
+    xt = P.to_tensor(x)
+    loss = F.cross_entropy(ours(xt), P.to_tensor(labels))
+    loss.backward()
+    g_ours = ours.head.weight.grad.numpy()
+
+    out = theirs(pixel_values=torch.from_numpy(x),
+                 labels=torch.from_numpy(labels))
+    out.loss.backward()
+    g_hf = theirs.classifier.weight.grad.numpy().T
+    np.testing.assert_allclose(float(loss.numpy()), float(out.loss.detach()),
+                               rtol=1e-3)
+    np.testing.assert_allclose(g_ours, g_hf, rtol=5e-3, atol=1e-5)
