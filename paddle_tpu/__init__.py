@@ -326,3 +326,184 @@ _bind("tpu", lambda self, *a, **k: self)
 _bind("pin_memory", lambda self: self)
 
 from . import version  # noqa: E402,F401
+
+# ---------------------------------------------------------------------------
+# top-level API long tail (constants, aliases, in-place wrappers) — closes the
+# reference's paddle.__all__ surface (python/paddle/__init__.py)
+# ---------------------------------------------------------------------------
+import math as _math  # noqa: E402
+
+inf = float("inf")
+nan = float("nan")
+pi = _math.pi
+e = _math.e
+newaxis = None
+dtype = _np.dtype  # paddle.dtype is the dtype type object
+
+# ParamAttr / flops resolve lazily (importing nn eagerly would defeat the
+# lazy-submodule design above)
+_LAZY_ATTRS.update({
+    "ParamAttr": ("nn", "ParamAttr"),
+    "flops": ("utils", "flops"),
+})
+
+
+_TOPLEVEL_INPLACE = [
+    "abs", "acos", "addmm", "asin", "atan", "cast", "ceil", "clip", "cos",
+    "cumsum", "cumprod", "digamma", "divide", "equal", "erf", "exp", "expm1",
+    "flatten", "floor", "floor_divide", "frac", "gcd", "lcm", "lgamma", "log",
+    "log2", "log10", "log1p", "logical_and", "logical_or", "logical_not",
+    "logit", "masked_fill", "mod", "multiply", "nan_to_num", "neg", "pow",
+    "reciprocal", "remainder", "renorm", "reshape", "round", "rsqrt",
+    "scatter", "sigmoid", "sin", "sinc", "sinh", "sqrt", "square", "squeeze",
+    "subtract", "t", "tan", "tanh", "transpose", "tril", "triu", "trunc",
+    "unsqueeze", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not", "bitwise_invert", "copysign", "gammainc", "gammaincc",
+    "gammaln", "hypot", "i0", "ldexp", "less_equal", "less_than", "less",
+    "greater_equal", "greater_than", "multigammaln", "polygamma", "not_equal",
+    "floor_mod",
+]
+_TOPLEVEL_INPLACE += ["bitwise_left_shift", "bitwise_right_shift",
+                      "masked_scatter"]
+for _n in _TOPLEVEL_INPLACE:
+    if hasattr(_ops, _n) and not hasattr(_ops, _n + "_"):
+        # _inplace (Tensor-method factory above) writes back into the first
+        # argument AND propagates stop_gradient — reuse it for the top level
+        _fn = _inplace(getattr(_ops, _n))
+        _fn.__name__ = _n + "_"
+        globals()[_n + "_"] = _fn
+
+
+def where_(condition, x=None, y=None, name=None):
+    """In-place on x (reference: paddle.where_ mutates x, not the mask)."""
+    out = _ops.where(condition, x, y)
+    x._value = out._value
+    x._node = out._node
+    x._out_index = out._out_index
+    if not out.stop_gradient:
+        x.stop_gradient = False
+    return x
+
+
+def rank(x):
+    return _ops.to_tensor(len(x.shape))
+
+
+def shape(x):
+    return _ops.to_tensor(_np.asarray(x.shape, dtype="int32"))
+
+
+def tolist(x):
+    return x.numpy().tolist()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def disable_signal_handler():
+    pass  # no native signal handlers are installed
+
+
+class LazyGuard:
+    """Parity shim: parameters here are created eagerly but cheaply (jax
+    arrays materialize on first use)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from .nn.layer_base import Parameter
+    from .nn.initializer import Constant, XavierNormal
+    init = default_initializer or (Constant(0.0) if is_bias
+                                   else XavierNormal())
+    from .core.dtype import convert_dtype
+    return Parameter(init(list(shape), convert_dtype(dtype)), name=name)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Deprecated reader-batching helper (reference: paddle.batch)."""
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return gen
+
+
+def check_shape(shape):
+    for s in shape:
+        if s is not None and s < -1:
+            raise ValueError(f"invalid dim {s} in shape {shape}")
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)
+
+
+def from_dlpack(capsule):
+    from .utils import dlpack as _dl
+    return _dl.from_dlpack(capsule)
+
+
+def to_dlpack(x):
+    from .utils import dlpack as _dl
+    return _dl.to_dlpack(x)
+
+
+class CUDAPinnedPlace:
+    """Pinned host memory place (no CUDA here; host arrays are the analog)."""
+
+    def __repr__(self):
+        return "CUDAPinnedPlace"
+
+
+_LAZY_ATTRS.update({
+    "DataParallel": ("distributed", "DataParallel"),
+})
+
+# pstring/raw (prototype string-tensor dtypes) are intentionally absent: the
+# TPU build has no StringTensor analog (SURVEY.md §2.2 marks them niche).

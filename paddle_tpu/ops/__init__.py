@@ -12,3 +12,4 @@ from .logic import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 
 from .creation import assign, to_tensor  # noqa: F401
+from .extras import *  # noqa: F401,F403

@@ -1,0 +1,567 @@
+"""Long-tail tensor ops closing the top-level API gap vs the reference
+(python/paddle/__init__.py __all__). Everything lowers to jnp/lax through
+dispatch; host-side combinatorics (combinations, vander sizes) stay static.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from ..core import random as _random
+from .creation import to_tensor
+
+__all__ = [
+    "sinc", "signbit", "isin", "isneginf", "isposinf", "isreal", "is_complex",
+    "is_integer", "is_floating_point", "cdist", "pdist", "histogram_bin_edges",
+    "histogramdd", "frexp", "trapezoid", "cumulative_trapezoid",
+    "vander", "polygamma", "gammaln", "gammainc", "gammaincc", "multigammaln",
+    "take", "combinations", "block_diag", "logit", "slice_scatter",
+    "select_scatter", "diagonal_scatter", "renorm", "sgn", "log_normal",
+    "standard_gamma", "binomial", "vecdot", "unflatten", "view", "view_as",
+    "unfold", "crop", "multiplex", "reduce_as", "broadcast_shape", "hsplit",
+    "vsplit", "dsplit", "hstack", "vstack", "dstack", "column_stack",
+    "row_stack", "bitwise_invert", "less", "negative", "positive",
+    "matrix_transpose", "index_fill", "masked_scatter", "cartesian_prod",
+    "reverse", "cauchy_", "geometric_", "log_normal_", "bernoulli_", "normal_",
+]
+
+
+def _u(jfn, op_name):
+    def op(x, name=None):
+        return dispatch(lambda v: jfn(v), (x,), {}, name=op_name)
+
+    op.__name__ = op_name
+    return op
+
+
+sinc = _u(jnp.sinc, "sinc")
+signbit = _u(jnp.signbit, "signbit")
+isneginf = _u(jnp.isneginf, "isneginf")
+isposinf = _u(jnp.isposinf, "isposinf")
+isreal = _u(jnp.isreal, "isreal")
+negative = _u(jnp.negative, "negative")
+positive = _u(lambda v: v, "positive")
+gammaln = _u(jax.scipy.special.gammaln, "gammaln")
+
+
+def is_complex(x):
+    return jnp.issubdtype(jnp.asarray(x._value).dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x._value).dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x._value).dtype, jnp.floating)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    def fn(a, t):
+        return jnp.isin(a, t, invert=invert)
+
+    return dispatch(fn, (x, test_x), {}, name="isin")
+
+
+def _safe_sqrt(s):
+    # double-where keeps the backward pass NaN-free at s == 0 (the gradient
+    # there is 0, matching torch.cdist's subgradient convention)
+    pos = s > 0
+    return jnp.where(pos, jnp.sqrt(jnp.where(pos, s, 1.0)), 0.0)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-norm distances (reference: tensor/linalg.py cdist)."""
+    def fn(a, b):
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 2.0:
+            return _safe_sqrt(jnp.sum(diff * diff, -1))
+        if p == float("inf"):
+            return jnp.max(diff, -1)
+        return jnp.power(jnp.sum(jnp.power(diff, p), -1), 1.0 / p)
+
+    return dispatch(fn, (x, y), {}, name="cdist")
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of one point set."""
+    n = x.shape[-2]
+    iu = np.triu_indices(n, k=1)
+
+    def fn(a):
+        full = jnp.abs(a[..., :, None, :] - a[..., None, :, :])
+        if p == 2.0:
+            d = _safe_sqrt(jnp.sum(full * full, -1))
+        elif p == float("inf"):
+            d = jnp.max(full, -1)
+        else:
+            d = jnp.power(jnp.sum(jnp.power(full, p), -1), 1.0 / p)
+        return d[..., iu[0], iu[1]]
+
+    return dispatch(fn, (x,), {}, name="pdist")
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    def fn(v):
+        lo, hi = (jnp.min(v), jnp.max(v)) if min == 0 and max == 0 \
+            else (min, max)
+        return jnp.linspace(lo, hi, bins + 1)
+
+    return dispatch(fn, (x,), {}, name="histogram_bin_edges")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    xv = np.asarray(x._value)
+    wv = None if weights is None else np.asarray(weights._value)
+    hist, edges = np.histogramdd(xv, bins=bins, range=ranges, density=density,
+                                 weights=wv)
+    return to_tensor(hist), [to_tensor(e) for e in edges]
+
+
+def frexp(x, name=None):
+    def fn(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(jnp.int32)
+
+    return dispatch(fn, (x,), {}, name="frexp")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def fn(yv, xv):
+        return jnp.trapezoid(yv, x=xv, dx=dx if dx is not None else 1.0,
+                             axis=axis)
+
+    return dispatch(fn, (y, x), {}, name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    import scipy.integrate as si
+    yv = np.asarray(y._value)
+    xv = None if x is None else np.asarray(x._value)
+    out = si.cumulative_trapezoid(yv, x=xv, dx=dx if dx is not None else 1.0,
+                                  axis=axis)
+    return to_tensor(np.asarray(out, dtype=yv.dtype))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    cols = n if n is not None else int(x.shape[0])
+
+    def fn(v):
+        return jnp.vander(v, N=cols, increasing=increasing)
+
+    return dispatch(fn, (x,), {}, name="vander")
+
+
+def polygamma(x, n, name=None):
+    def fn(v):
+        return jax.scipy.special.polygamma(n, v)
+
+    return dispatch(fn, (x,), {}, name="polygamma")
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) (paddle arg order)."""
+    def fn(a, b):
+        return jax.scipy.special.gammainc(a, b)
+
+    return dispatch(fn, (x, y), {}, name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    def fn(a, b):
+        return jax.scipy.special.gammaincc(a, b)
+
+    return dispatch(fn, (x, y), {}, name="gammaincc")
+
+
+def multigammaln(x, p, name=None):
+    def fn(v):
+        js = jnp.arange(1, p + 1, dtype=v.dtype)
+        return (p * (p - 1) / 4.0) * math.log(math.pi) + jnp.sum(
+            jax.scipy.special.gammaln(v[..., None] + (1.0 - js) / 2.0), -1)
+
+    return dispatch(fn, (x,), {}, name="multigammaln")
+
+
+def take(x, index, mode="raise", name=None):
+    def fn(v, idx):
+        flat = v.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            idx = idx % n
+        elif mode == "clip":
+            idx = jnp.clip(idx, -n, n - 1)
+        return flat[idx]
+
+    return dispatch(fn, (x, index), {}, name="take")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    n = int(x.shape[0])
+    combos = list(itertools.combinations_with_replacement(range(n), r)
+                  if with_replacement else itertools.combinations(range(n), r))
+    idx = jnp.asarray(np.asarray(combos, dtype=np.int64).reshape(-1, r)
+                      if combos else np.zeros((0, r), np.int64))
+
+    def fn(v):
+        return v[idx]
+
+    return dispatch(fn, (x,), {}, name="combinations")
+
+
+def block_diag(inputs, name=None):
+    def fn(*vals):
+        return jax.scipy.linalg.block_diag(*vals)
+
+    return dispatch(lambda *v: fn(*v), tuple(inputs), {}, name="block_diag")
+
+
+def logit(x, eps=None, name=None):
+    def fn(v):
+        z = v if eps is None else jnp.clip(v, eps, 1 - eps)
+        out = jnp.log(z) - jnp.log1p(-z)
+        if eps is None:
+            out = jnp.where((v < 0) | (v > 1), jnp.nan, out)
+        return out
+
+    return dispatch(fn, (x,), {}, name="logit")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def fn(v, val):
+        idx = [slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = slice(st, en, sd)
+        return v.at[tuple(idx)].set(val)
+
+    return dispatch(fn, (x, value), {}, name="slice_scatter")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def fn(v, val):
+        idx = [slice(None)] * v.ndim
+        idx[axis] = index
+        return v.at[tuple(idx)].set(val)
+
+    return dispatch(fn, (x, values), {}, name="select_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def fn(v, val):
+        # route the diagonal to the last two axes, scatter, route back
+        perm = [d for d in range(v.ndim) if d not in (axis1 % v.ndim,
+                                                      axis2 % v.ndim)]
+        perm += [axis1 % v.ndim, axis2 % v.ndim]
+        inv = np.argsort(perm)
+        vp = jnp.transpose(v, perm)
+        n, m = vp.shape[-2], vp.shape[-1]
+        rows = jnp.arange(max(n, m))
+        if offset >= 0:
+            r, c = rows[: min(n, m - offset)], rows[: min(n, m - offset)] + offset
+        else:
+            r, c = rows[: min(n + offset, m)] - offset, rows[: min(n + offset, m)]
+        vp = vp.at[..., r, c].set(val)
+        return jnp.transpose(vp, inv)
+
+    return dispatch(fn, (x, y), {}, name="diagonal_scatter")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(v):
+        axes = tuple(d for d in range(v.ndim) if d != axis % v.ndim)
+        norms = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axes,
+                                  keepdims=True), 1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+
+    return dispatch(fn, (x,), {}, name="renorm")
+
+
+def sgn(x, name=None):
+    def fn(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.maximum(mag, 1e-38))
+        return jnp.sign(v)
+
+    return dispatch(fn, (x,), {}, name="sgn")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    def fn(a, b):
+        return jnp.sum(a * b, axis=axis)
+
+    return dispatch(fn, (x, y), {}, name="vecdot")
+
+
+def unflatten(x, axis, shape, name=None):
+    def fn(v):
+        new_shape = list(v.shape)
+        ax = axis % v.ndim
+        new_shape[ax:ax + 1] = list(shape)
+        return v.reshape(new_shape)
+
+    return dispatch(fn, (x,), {}, name="unflatten")
+
+
+def view(x, shape_or_dtype, name=None):
+    from ..core.dtype import convert_dtype
+    if isinstance(shape_or_dtype, (list, tuple)):
+        def fn(v):
+            return v.reshape([int(s) for s in shape_or_dtype])
+        return dispatch(fn, (x,), {}, name="view")
+
+    dt = convert_dtype(shape_or_dtype)
+
+    def fn(v):
+        return jax.lax.bitcast_convert_type(v, dt)
+
+    return dispatch(fn, (x,), {}, name="view")
+
+
+def view_as(x, other, name=None):
+    return view(x, other.shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along an axis (reference: tensor/manipulation.py
+    unfold — the torch.Tensor.unfold analog)."""
+    n = int(x.shape[axis])
+    num = (n - size) // step + 1
+    starts = np.arange(num) * step
+    idx = starts[:, None] + np.arange(size)[None, :]
+    jidx = jnp.asarray(idx)
+
+    def fn(v):
+        out = jnp.take(v, jidx.reshape(-1), axis=axis)
+        ax = axis % v.ndim
+        new_shape = list(v.shape)
+        new_shape[ax:ax + 1] = [num, size]
+        out = out.reshape(new_shape)
+        # windows dim goes where the axis was; window content to the end
+        return jnp.moveaxis(out, ax + 1, -1)
+
+    return dispatch(fn, (x,), {}, name="unfold")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def fn(v):
+        offs = offsets or [0] * v.ndim
+        shp = [v.shape[i] - offs[i] if s in (-1, None) else s
+               for i, s in enumerate(shape or list(v.shape))]
+        idx = tuple(slice(o, o + s) for o, s in zip(offs, shp))
+        return v[idx]
+
+    return dispatch(fn, (x,), {}, name="crop")
+
+
+def multiplex(inputs, index, name=None):
+    def fn(idx, *vals):
+        stacked = jnp.stack(vals)                      # [K, B, ...]
+        rows = idx.reshape(-1).astype(jnp.int32)
+        return stacked[rows, jnp.arange(stacked.shape[1])]
+
+    return dispatch(lambda idx, *v: fn(idx, *v), (index,) + tuple(inputs), {},
+                    name="multiplex")
+
+
+def reduce_as(x, target, name=None):
+    def fn(v, t):
+        extra = v.ndim - t.ndim
+        axes = tuple(range(extra)) + tuple(
+            extra + i for i in range(t.ndim) if t.shape[i] == 1 and
+            v.shape[extra + i] != 1)
+        out = jnp.sum(v, axis=axes, keepdims=False)
+        return out.reshape(t.shape)
+
+    return dispatch(fn, (x, target), {}, name="reduce_as")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def _split_like(np_like, op_name):
+    def op(x, num_or_indices, name=None):
+        def fn(v):
+            return tuple(np_like(v, num_or_indices))
+
+        return dispatch(fn, (x,), {}, name=op_name)
+
+    op.__name__ = op_name
+    return op
+
+
+hsplit = _split_like(jnp.hsplit, "hsplit")
+vsplit = _split_like(jnp.vsplit, "vsplit")
+dsplit = _split_like(jnp.dsplit, "dsplit")
+
+
+def _stack_like(jfn, op_name):
+    def op(x, name=None):
+        return dispatch(lambda *v: jfn(v), tuple(x), {}, name=op_name)
+
+    op.__name__ = op_name
+    return op
+
+
+hstack = _stack_like(jnp.hstack, "hstack")
+vstack = _stack_like(jnp.vstack, "vstack")
+dstack = _stack_like(jnp.dstack, "dstack")
+column_stack = _stack_like(jnp.column_stack, "column_stack")
+row_stack = vstack
+
+
+def bitwise_invert(x, out=None, name=None):
+    return dispatch(lambda v: jnp.invert(v), (x,), {}, name="bitwise_invert")
+
+
+def less(x, y, name=None):
+    def fn(a, b):
+        return a < b
+
+    return dispatch(fn, (x, y), {}, name="less")
+
+
+def matrix_transpose(x, name=None):
+    return dispatch(lambda v: jnp.swapaxes(v, -1, -2), (x,), {},
+                    name="matrix_transpose")
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(v, idx):
+        sl = [slice(None)] * v.ndim
+        sl[axis % v.ndim] = idx
+        return v.at[tuple(sl)].set(value)
+
+    return dispatch(fn, (x, index), {}, name="index_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    xv = np.asarray(x._value)
+    mv = np.asarray(mask._value, dtype=bool)
+    vv = np.asarray(value._value).reshape(-1)
+    n = int(mv.sum())
+    # static gather plan from the (host-resident) mask
+    order = jnp.asarray(np.cumsum(mv.reshape(-1)) - 1)
+    jm = jnp.asarray(mv)
+
+    def fn(v, val):
+        flat = v.reshape(-1)
+        picked = val.reshape(-1)[order]
+        return jnp.where(jm.reshape(-1), picked, flat).reshape(v.shape)
+
+    return dispatch(fn, (x, value), {}, name="masked_scatter")
+
+
+def cartesian_prod(x, name=None):
+    def fn(*vals):
+        grids = jnp.meshgrid(*vals, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return dispatch(lambda *v: fn(*v), tuple(x), {}, name="cartesian_prod")
+
+
+def reverse(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return dispatch(lambda v: jnp.flip(v, axes), (x,), {}, name="reverse")
+
+
+# -- in-place random fills (reference: tensor/random.py *_ methods) ---------
+
+def _inplace_random(fill_name):
+    def op(x, *args, **kwargs):
+        key = _random.next_key()
+        v = jnp.asarray(x._value)
+        if fill_name == "cauchy":
+            loc = kwargs.get("loc", args[0] if args else 0.0)
+            scale = kwargs.get("scale", args[1] if len(args) > 1 else 1.0)
+            u = jax.random.uniform(key, v.shape, jnp.float32, 1e-6, 1 - 1e-6)
+            out = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+        elif fill_name == "geometric":
+            p = kwargs.get("probs", args[0] if args else 0.5)
+            u = jax.random.uniform(key, v.shape, jnp.float32, 1e-6, 1 - 1e-6)
+            out = jnp.floor(jnp.log(u) / jnp.log1p(-p)) + 1
+        elif fill_name == "log_normal":
+            mean = kwargs.get("mean", args[0] if args else 1.0)
+            std = kwargs.get("std", args[1] if len(args) > 1 else 2.0)
+            out = jnp.exp(mean + std * jax.random.normal(key, v.shape))
+        elif fill_name == "bernoulli":
+            p = kwargs.get("p", args[0] if args else 0.5)
+            out = jax.random.bernoulli(key, p, v.shape)
+        else:  # normal
+            mean = kwargs.get("mean", args[0] if args else 0.0)
+            std = kwargs.get("std", args[1] if len(args) > 1 else 1.0)
+            out = mean + std * jax.random.normal(key, v.shape)
+        x._value = out.astype(v.dtype)
+        return x
+
+    op.__name__ = fill_name + "_"
+    return op
+
+
+cauchy_ = _inplace_random("cauchy")
+geometric_ = _inplace_random("geometric")
+log_normal_ = _inplace_random("log_normal")
+bernoulli_ = _inplace_random("bernoulli")
+normal_ = _inplace_random("normal")
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    key = _random.next_key()
+    out = jnp.exp(mean + std * jax.random.normal(key, tuple(shape or ())))
+    return to_tensor(out)
+
+
+def standard_gamma(x, name=None):
+    key = _random.next_key()
+
+    def fn(v):
+        return jax.random.gamma(key, v)
+
+    out = dispatch(fn, (x,), {}, name="standard_gamma")
+    out.stop_gradient = True
+    return out
+
+
+def binomial(count, prob, name=None):
+    key = _random.next_key()
+
+    def fn(n, p):
+        return jax.random.binomial(key, n, p).astype(jnp.int64)
+
+    out = dispatch(fn, (count, prob), {}, name="binomial")
+    out.stop_gradient = True
+    return out
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    def fn(v):
+        return jnp.nanquantile(v, q, axis=axis, keepdims=keepdim)
+
+    return dispatch(fn, (x,), {}, name="nanquantile")
+
+
+def as_complex(x, name=None):
+    """(..., 2) real pairs -> complex (reference: tensor/manipulation.py)."""
+    def fn(v):
+        return jax.lax.complex(v[..., 0], v[..., 1])
+
+    return dispatch(fn, (x,), {}, name="as_complex")
+
+
+def as_real(x, name=None):
+    def fn(v):
+        return jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1)
+
+    return dispatch(fn, (x,), {}, name="as_real")
+
+
+__all__ += ["nanquantile", "as_complex", "as_real"]

@@ -66,7 +66,7 @@ inner = _make_binary("inner", jnp.inner)
 outer = _make_binary("outer", jnp.outer)
 kron = _make_binary("kron", jnp.kron)
 
-multiply_ = multiply  # inplace aliases rebind via Tensor method layer
+
 
 # -- unary elementwise --------------------------------------------------------
 exp = _make_unary("exp", jnp.exp)

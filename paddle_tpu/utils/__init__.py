@@ -60,18 +60,29 @@ def run_check():
 
 
 class dlpack:
-    """paddle.utils.dlpack parity namespace."""
+    """paddle.utils.dlpack parity namespace.
+
+    Modern DLPack exchanges the protocol-carrying ARRAY (implements
+    __dlpack__/__dlpack_device__), not a bare capsule — torch/numpy/jax
+    from_dlpack all consume it directly."""
 
     @staticmethod
     def to_dlpack(x):
         from ..core.tensor import Tensor
+        import jax.numpy as jnp
         v = x._value if isinstance(x, Tensor) else x
-        return v.__dlpack__()
+        return jnp.asarray(v)
 
     @staticmethod
-    def from_dlpack(capsule):
-        import jax
+    def from_dlpack(ext_array):
         from ..core.tensor import Tensor
         import jax.numpy as jnp
-        return Tensor(jnp.from_dlpack(capsule))
+        if isinstance(ext_array, Tensor):
+            return ext_array
+        if not hasattr(ext_array, "__dlpack__"):
+            raise TypeError(
+                "from_dlpack expects an object implementing the DLPack "
+                "protocol (__dlpack__); legacy PyCapsules are not supported "
+                "by this jax version")
+        return Tensor(jnp.from_dlpack(ext_array))
 from . import cpp_extension  # noqa: E402,F401

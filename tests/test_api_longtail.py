@@ -1,0 +1,154 @@
+"""Top-level API long-tail: new ops vs numpy/torch, in-place wrappers,
+constants — closes paddle.__all__ parity (only pstring/raw excluded)."""
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+torch = pytest.importorskip("torch")
+
+
+def test_reference_all_coverage():
+    src = open("/root/reference/python/paddle/__init__.py").read()
+    m = re.search(r"__all__ = \[(.*?)\]", src, re.S)
+    names = re.findall(r"'([A-Za-z_0-9]+)'", m.group(1))
+    missing = [n for n in names if not hasattr(P, n)]
+    # string-tensor prototypes are the documented exception
+    assert set(missing) <= {"pstring", "raw"}, missing
+
+
+class TestExtrasVsTorch:
+    def test_distance_ops(self, rng):
+        x = P.to_tensor(rng.standard_normal((5, 3)).astype("float32"))
+        y = P.to_tensor(rng.standard_normal((4, 3)).astype("float32"))
+        np.testing.assert_allclose(
+            P.cdist(x, y).numpy(),
+            torch.cdist(torch.tensor(x.numpy()),
+                        torch.tensor(y.numpy())).numpy(), rtol=1e-4,
+            atol=1e-5)
+        np.testing.assert_allclose(
+            P.pdist(x).numpy(),
+            torch.pdist(torch.tensor(x.numpy())).numpy(), rtol=1e-4,
+            atol=1e-5)
+
+    def test_structure_ops(self, rng):
+        np.testing.assert_allclose(
+            P.combinations(P.to_tensor(np.arange(4.0, dtype="float32")),
+                           2).numpy(),
+            torch.combinations(torch.arange(4.0), 2).numpy())
+        assert P.block_diag([P.ones([2, 2]), P.ones([1, 3])]).shape == [3, 5]
+        u = P.unfold(P.to_tensor(np.arange(10.0, dtype="float32")), 0, 4, 2)
+        np.testing.assert_allclose(u.numpy(),
+                                   torch.arange(10.0).unfold(0, 4, 2).numpy())
+        ds = P.diagonal_scatter(P.zeros([3, 3]), P.ones([3]))
+        np.testing.assert_allclose(ds.numpy(), np.eye(3))
+        ss = P.select_scatter(P.zeros([3, 3]), P.ones([3]), axis=0, index=1)
+        assert ss.numpy()[1].sum() == 3
+
+    def test_masked_scatter(self):
+        mask = np.asarray([[True, False, True], [False, True, False]])
+        got = P.masked_scatter(
+            P.zeros([2, 3]), P.to_tensor(mask),
+            P.to_tensor(np.asarray([1., 2., 3.], "float32"))).numpy()
+        ref = torch.zeros(2, 3).masked_scatter(
+            torch.tensor(mask), torch.tensor([1., 2., 3.])).numpy()
+        np.testing.assert_allclose(got, ref)
+
+    def test_special_fns(self, rng):
+        x = np.abs(rng.standard_normal(8)).astype("float32") + 0.5
+        np.testing.assert_allclose(P.gammaln(P.to_tensor(x)).numpy(),
+                                   torch.lgamma(torch.tensor(x)).numpy(),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(
+            P.multigammaln(P.to_tensor(x + 2), 3).numpy(),
+            torch.special.multigammaln(torch.tensor(x + 2), 3).numpy(),
+            rtol=1e-4)
+        p = rng.random(6).astype("float32")
+        np.testing.assert_allclose(P.logit(P.to_tensor(p)).numpy(),
+                                   torch.logit(torch.tensor(p)).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(P.sinc(P.to_tensor(x)).numpy(),
+                                   np.sinc(x), rtol=1e-5)
+
+    def test_frexp_ldexp_roundtrip(self, rng):
+        x = P.to_tensor(rng.standard_normal(16).astype("float32"))
+        m, e = P.frexp(x)
+        back = P.ldexp(m, e)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-6)
+
+    def test_stacks_and_splits(self, rng):
+        a = P.ones([2, 3])
+        np.testing.assert_allclose(P.hstack([a, a]).numpy().shape, (2, 6))
+        np.testing.assert_allclose(P.vstack([a, a]).numpy().shape, (4, 3))
+        np.testing.assert_allclose(P.column_stack([a, a]).numpy().shape,
+                                   (2, 6))
+        parts = P.hsplit(P.ones([2, 6]), 3)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+
+    def test_trapezoid_and_vander(self):
+        y = P.to_tensor(np.asarray([1., 2., 3.], "float32"))
+        np.testing.assert_allclose(P.trapezoid(y).numpy(), 4.0)
+        v = P.vander(P.to_tensor(np.asarray([1., 2., 3.], "float32")))
+        np.testing.assert_allclose(v.numpy(), np.vander([1., 2., 3.]))
+
+    def test_view_and_as_complex(self, rng):
+        x = P.to_tensor(rng.standard_normal((4, 2)).astype("float32"))
+        c = P.as_complex(x)
+        assert c.numpy().dtype == np.complex64
+        np.testing.assert_allclose(P.as_real(c).numpy(), x.numpy())
+        v = P.view(P.to_tensor(np.zeros((2, 6), "float32")), [3, 4])
+        assert v.shape == [3, 4]
+
+    def test_take_and_isin(self):
+        x = P.to_tensor(np.arange(12.0, dtype="float32").reshape(3, 4))
+        np.testing.assert_allclose(
+            P.take(x, P.to_tensor(np.asarray([0, 5, 11]))).numpy(),
+            [0., 5., 11.])
+        got = P.isin(P.to_tensor(np.asarray([1, 2, 3])),
+                     P.to_tensor(np.asarray([2, 4]))).numpy()
+        np.testing.assert_array_equal(got, [False, True, False])
+
+
+class TestTopLevelGlue:
+    def test_constants(self):
+        assert P.pi == np.pi and P.inf == float("inf") and P.newaxis is None
+        assert np.isnan(P.nan)
+
+    def test_inplace_wrappers(self):
+        t = P.to_tensor(np.asarray([4.0], "float32"))
+        out = P.sqrt_(t)
+        assert out is t and float(t.numpy()) == 2.0
+        P.clip_(t, 0.0, 1.5)
+        assert float(t.numpy()) == 1.5
+
+    def test_random_inplace(self):
+        t = P.to_tensor(np.zeros(512, "float32"))
+        P.seed(0)
+        P.normal_(t, 0.0, 1.0)
+        assert 0.8 < t.numpy().std() < 1.2
+        P.bernoulli_(t, 0.3)
+        assert set(np.unique(t.numpy())) <= {0.0, 1.0}
+
+    def test_misc_helpers(self):
+        x = P.ones([2, 3])
+        assert int(P.rank(x).numpy()) == 2
+        np.testing.assert_array_equal(P.shape(x).numpy(), [2, 3])
+        assert P.tolist(x) == [[1.0, 1.0, 1.0], [1.0, 1.0, 1.0]]
+        assert P.in_dynamic_mode()
+        P.enable_static()
+        assert not P.in_dynamic_mode()
+        P.disable_static()
+        assert P.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        param = P.create_parameter([3, 4])
+        assert param.shape == [3, 4] and not param.stop_gradient
+
+    def test_batch_reader(self):
+        def reader():
+            yield from range(7)
+
+        batches = list(P.batch(reader, 3)())
+        assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+        batches = list(P.batch(reader, 3, drop_last=True)())
+        assert batches == [[0, 1, 2], [3, 4, 5]]
