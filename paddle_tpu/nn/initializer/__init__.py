@@ -189,3 +189,22 @@ def set_global_initializer(weight_init, bias_init=None):
     from .. import layer_base
     layer_base._GLOBAL_WEIGHT_INIT = weight_init
     layer_base._GLOBAL_BIAS_INIT = bias_init
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init for transposed convs (reference:
+    nn/initializer/Bilinear — used to initialize deconv weights so the layer
+    starts as bilinear interpolation)."""
+
+    def __call__(self, shape, dtype):
+        # shape: (C_in, C_out/g, kh, kw) for conv-transpose or (out, in, kh, kw)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        kh, kw = shape[2], shape[3]
+        # reference derives ONE factor from shape[3] for both axes
+        f = int(np.ceil(kw / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        yy, xx = np.meshgrid(np.arange(kh), np.arange(kw), indexing="ij")
+        filt = (1 - np.abs(yy / f - c)) * (1 - np.abs(xx / f - c))
+        w = np.broadcast_to(filt, tuple(shape)).astype(np.float32)
+        return jnp.asarray(w, dtype)

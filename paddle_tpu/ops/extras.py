@@ -107,9 +107,18 @@ def pdist(x, p=2.0, name=None):
 
 
 def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    if max < min:
+        raise ValueError(f"max ({max}) must be >= min ({min})")
+
     def fn(v):
-        lo, hi = (jnp.min(v), jnp.max(v)) if min == 0 and max == 0 \
-            else (min, max)
+        if min == 0 and max == 0:
+            lo, hi = jnp.min(v), jnp.max(v)
+        else:
+            lo, hi = jnp.asarray(min, v.dtype), jnp.asarray(max, v.dtype)
+        # degenerate range widens by ±0.5 (reference histogram semantics)
+        same = lo == hi
+        lo = jnp.where(same, lo - 0.5, lo)
+        hi = jnp.where(same, hi + 0.5, hi)
         return jnp.linspace(lo, hi, bins + 1)
 
     return dispatch(fn, (x,), {}, name="histogram_bin_edges")
@@ -127,7 +136,7 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
 def frexp(x, name=None):
     def fn(v):
         m, e = jnp.frexp(v)
-        return m, e.astype(jnp.int32)
+        return m, e.astype(v.dtype)  # reference returns exponent in x's dtype
 
     return dispatch(fn, (x,), {}, name="frexp")
 
@@ -196,7 +205,8 @@ def take(x, index, mode="raise", name=None):
         if mode == "wrap":
             idx = idx % n
         elif mode == "clip":
-            idx = jnp.clip(idx, -n, n - 1)
+            # reference: clip mode disables negative indexing entirely
+            idx = jnp.clip(idx, 0, n - 1)
         return flat[idx]
 
     return dispatch(fn, (x, index), {}, name="take")
@@ -321,7 +331,25 @@ def view(x, shape_or_dtype, name=None):
     dt = convert_dtype(shape_or_dtype)
 
     def fn(v):
-        return jax.lax.bitcast_convert_type(v, dt)
+        out = jax.lax.bitcast_convert_type(v, dt)
+        # fold the reinterpretation into the LAST dim (reference view
+        # semantics: [.., D] fp32 -> [.., 4D] uint8, fp32 pairs -> fp64 halves)
+        if out.ndim == v.ndim + 1:          # narrowing appended a dim
+            return out.reshape(v.shape[:-1] + (v.shape[-1] * out.shape[-1],))
+        if out.ndim == v.ndim - 1:          # widening consumed the last dim
+            return out
+        return out
+
+    if dt.itemsize > np.dtype(x._value.dtype).itemsize:
+        ratio = dt.itemsize // np.dtype(x._value.dtype).itemsize
+        if int(x.shape[-1]) % ratio:
+            raise ValueError(
+                f"view to wider dtype needs last dim divisible by {ratio}")
+
+        def fn(v):
+            grouped = v.reshape(v.shape[:-1] + (v.shape[-1] // ratio, ratio))
+            return jax.lax.bitcast_convert_type(grouped, dt).reshape(
+                v.shape[:-1] + (v.shape[-1] // ratio,))
 
     return dispatch(fn, (x,), {}, name="view")
 
@@ -445,10 +473,12 @@ def index_fill(x, index, axis, value, name=None):
 
 
 def masked_scatter(x, mask, value, name=None):
-    xv = np.asarray(x._value)
     mv = np.asarray(mask._value, dtype=bool)
-    vv = np.asarray(value._value).reshape(-1)
     n = int(mv.sum())
+    if int(np.prod(value.shape)) < n:
+        raise ValueError(
+            f"masked_scatter: value has {int(np.prod(value.shape))} elements "
+            f"but the mask selects {n}")
     # static gather plan from the (host-resident) mask
     order = jnp.asarray(np.cumsum(mv.reshape(-1)) - 1)
     jm = jnp.asarray(mv)
@@ -486,9 +516,11 @@ def _inplace_random(fill_name):
             u = jax.random.uniform(key, v.shape, jnp.float32, 1e-6, 1 - 1e-6)
             out = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
         elif fill_name == "geometric":
+            # reference fills the CONTINUOUS value log(u)/log1p(-p)
+            # (tensor/creation.py geometric_), not torch's floored variant
             p = kwargs.get("probs", args[0] if args else 0.5)
             u = jax.random.uniform(key, v.shape, jnp.float32, 1e-6, 1 - 1e-6)
-            out = jnp.floor(jnp.log(u) / jnp.log1p(-p)) + 1
+            out = jnp.log(u) / jnp.log1p(-p)
         elif fill_name == "log_normal":
             mean = kwargs.get("mean", args[0] if args else 1.0)
             std = kwargs.get("std", args[1] if len(args) > 1 else 2.0)

@@ -60,7 +60,9 @@ copysign = _make_binary("copysign", jnp.copysign)
 heaviside = _make_binary("heaviside", jnp.heaviside)
 gcd = _make_binary("gcd", jnp.gcd)
 lcm = _make_binary("lcm", jnp.lcm)
-ldexp = _make_binary("ldexp", jnp.ldexp)
+# paddle accepts a float exponent tensor (frexp returns one); jnp needs int
+ldexp = _make_binary("ldexp",
+                     lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)))
 nextafter = _make_binary("nextafter", jnp.nextafter)
 inner = _make_binary("inner", jnp.inner)
 outer = _make_binary("outer", jnp.outer)
