@@ -11,7 +11,12 @@ from .mesh import (  # noqa: F401
 from .api import (  # noqa: F401
     shard_tensor, reshard, shard_layer, shard_optimizer, dtensor_from_local,
     dtensor_to_local, is_dist_tensor, full_value, logical_shape, DistMeta,
-    ShardingStage1, ShardingStage2, ShardingStage3,
+    ShardingStage1, ShardingStage2, ShardingStage3, split,
+)
+from .auto_parallel_static import (  # noqa: F401
+    Strategy, DistModel, to_static, LocalLayer, shard_dataloader, shard_scaler,
+    dtensor_from_fn, unshard_dtensor, set_mesh, get_mesh, DistAttr,
+    ShardDataloader,
 )
 from .env import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, is_initialized, new_group,
@@ -20,13 +25,28 @@ from .env import (  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp, all_reduce, all_gather, all_gather_object, broadcast,
     broadcast_object_list, reduce, reduce_scatter, all_to_all, scatter, send, recv,
-    isend, irecv, P2POp, batch_isend_irecv, functional,
+    isend, irecv, P2POp, batch_isend_irecv, functional, alltoall,
+    alltoall_single, gather, scatter_object_list, wait,
 )
+from .parallel_env import (  # noqa: F401
+    ParallelEnv, ParallelMode, ReduceType, is_available,
+    gloo_init_parallel_env, gloo_barrier, gloo_release,
+)
+from .entry_attr import (  # noqa: F401
+    ProbabilityEntry, CountFilterEntry, ShowClickEntry,
+)
+from .fleet_dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from . import io  # noqa: F401
 from .store import TCPStore, create_or_get_global_tcp_store  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
-from .auto_parallel_intermediate import parallelize  # noqa: F401
+from .auto_parallel_intermediate import (  # noqa: F401
+    parallelize, ColWiseParallel, RowWiseParallel, SequenceParallelBegin,
+    SequenceParallelEnd, SequenceParallelEnable, SequenceParallelDisable,
+    PrepareLayerInput, PrepareLayerOutput, SplitPoint, to_distributed,
+)
 from .sharding import group_sharded_parallel  # noqa: F401
 from .launch_utils import spawn  # noqa: F401
 from .watchdog import Watchdog, ErrorHandlingMode  # noqa: F401

@@ -266,3 +266,40 @@ class ShardingStage1:
 
 ShardingStage2 = ShardingStage1  # grads shard implicitly under GSPMD; states same
 ShardingStage3 = ShardingStage1  # param sharding handled via shard_tensor(Shard(0))
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel embedding/linear in one call (reference:
+    distributed/collective.py split — builds the mp layer and applies it).
+    Creates the fleet mp layer on first use; hold the returned layer via
+    split.last_layer to train its parameters.
+    """
+    from .fleet import mp_layers as mp
+
+    if operation == "embedding":
+        if axis != 0:
+            raise ValueError("the axis for embedding split must be 0")
+        layer = mp.VocabParallelEmbedding(size[0], size[1],
+                                          weight_attr=weight_attr)
+    elif operation == "linear":
+        if axis == 0:
+            layer = mp.RowParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         input_is_parallel=not gather_out)
+        elif axis == 1:
+            layer = mp.ColumnParallelLinear(size[0], size[1],
+                                            weight_attr=weight_attr,
+                                            has_bias=bias_attr is not False,
+                                            gather_output=gather_out)
+        else:
+            raise ValueError("axis must be 0 (row) or 1 (column) for linear")
+    else:
+        raise ValueError(
+            f"operation must be 'linear' or 'embedding', got {operation}")
+    split.last_layer = layer
+    return layer(x)
+
+
+split.last_layer = None

@@ -80,3 +80,98 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
     if optimizer is None:
         return model
     return model, optimizer
+
+
+class SequenceParallelEnable:
+    """Mark a layer as fully sequence-parallel (reference:
+    intermediate/sequence_parallel.py SequenceParallelEnable): activations
+    shard the sequence dim over 'mp' between the Begin/End boundaries. Under
+    GSPMD this is a with_sharding_constraint on the layer output."""
+
+    def apply(self, layer, mesh):
+        spec = PartitionSpec(None, "mp")
+
+        def hook(l, inputs, outputs):
+            from ..core.tensor import Tensor as _T
+            if isinstance(outputs, _T) and outputs._value.ndim >= 2:
+                outputs._value = jax.lax.with_sharding_constraint(
+                    outputs._value, NamedSharding(mesh.jax_mesh(), spec))
+            return outputs
+        layer.register_forward_post_hook(hook)
+
+
+class SequenceParallelDisable:
+    """Opt a sub-layer out of sequence parallelism (reference:
+    intermediate/sequence_parallel.py SequenceParallelDisable)."""
+
+    def __init__(self, need_transpose=True):
+        self.need_transpose = need_transpose
+
+    def apply(self, layer, mesh):
+        def pre(l, inputs):
+            from ..core.tensor import Tensor as _T
+            out = []
+            for x in inputs:
+                if isinstance(x, _T) and x._value.ndim >= 2:
+                    x._value = jax.lax.with_sharding_constraint(
+                        x._value,
+                        NamedSharding(mesh.jax_mesh(),
+                                      PartitionSpec(*([None] * x._value.ndim))))
+                out.append(x)
+            return tuple(out)
+        layer.register_forward_pre_hook(pre)
+
+
+class PrepareLayerInput:
+    """Run a user fn on layer inputs (reference: intermediate/parallel_base.py
+    PrepareLayerInput — used to insert reshard/redistribute points)."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh):
+        if self.fn is not None:
+            layer.register_forward_pre_hook(self.fn(mesh))
+
+
+class PrepareLayerOutput:
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh):
+        if self.fn is not None:
+            layer.register_forward_post_hook(self.fn(mesh))
+
+
+class SplitPoint:
+    """Pipeline split markers (reference: intermediate/pipeline_parallel.py
+    SplitPoint): BEGINNING splits before the marked layer, END after."""
+    BEGINNING = "BEGINNING"
+    END = "END"
+
+
+def to_distributed(model, optimizer, dataloader, device_num, node_num=1,
+                   config=None):
+    """reference: distributed/auto_parallel/high_level_api.py to_distributed —
+    pick a parallel strategy automatically from the hardware shape. Heuristic
+    here (the reference's is a cost-model search): prefer dp; add mp when the
+    model is too large for one device's HBM."""
+    n = device_num * node_num
+    params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    bytes_needed = params * 4 * 3  # weights + grads + adam states
+    try:
+        hbm = jax.devices()[0].memory_stats().get("bytes_limit", 16e9)
+    except Exception:
+        hbm = 16e9
+    mp = 1
+    while bytes_needed / mp > hbm * 0.6 and mp < n:
+        mp *= 2
+    dp = max(1, n // mp)
+    mesh = ProcessMesh(np.arange(dp * mp).reshape(dp, mp), ["dp", "mp"])
+    cfg = dict(config or {})
+    cfg.setdefault("dp_config", {"sharding_level": 1})
+    out = parallelize(model, optimizer, mesh, cfg)
+    if optimizer is None:
+        return out, None, dataloader
+    model, optimizer = out
+    return model, optimizer, dataloader

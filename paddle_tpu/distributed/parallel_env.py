@@ -1,0 +1,118 @@
+"""ParallelEnv / ParallelMode / gloo_* compatibility surface.
+
+Reference: python/paddle/distributed/parallel.py:757 (ParallelEnv properties
+over PADDLE_TRAINER_* env) and fleet/base/topology.py:42 (ParallelMode).
+TPU-native: the same env contract is produced by our launcher
+(distributed/launch), so ParallelEnv just reads it; the "gloo" CPU barrier
+maps to the TCPStore-based host barrier (XLA owns device collectives).
+"""
+from __future__ import annotations
+
+import os
+
+
+class ParallelMode:
+    """reference: fleet/base/topology.py:42."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ParallelEnv:
+    """reference: distributed/parallel.py ParallelEnv — env-derived process
+    coordinates (PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS / ...)."""
+
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._device_id = int(os.getenv("FLAGS_selected_tpus",
+                                        os.getenv("FLAGS_selected_gpus", "0")))
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        self._nrings = int(os.getenv("FLAGS_nccl_nrings", "1"))
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def device_type(self):
+        return os.getenv("PADDLE_XCCL_BACKEND", "tpu")
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    @property
+    def nrings(self):
+        return self._nrings
+
+    # legacy aliases (reference keeps both spellings)
+    local_rank = rank
+    nranks = world_size
+    dev_id = device_id
+
+
+def is_available():
+    """reference: distributed/__init__.py is_available — whether the
+    distributed stack can run. Always true here: XLA collectives compile on
+    any backend (single-process meshes included)."""
+    return True
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-only (host) parallel context (reference: parallel.py
+    gloo_init_parallel_env → gloo). Maps to the TCPStore host barrier."""
+    from .store import TCPStore
+    host, port = server_endpoint.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank_id == 0),
+                     world_size=rank_num)
+    global _GLOO_STORE, _GLOO_RANKS
+    _GLOO_STORE = store
+    _GLOO_RANKS = (rank_id, rank_num)
+
+
+_GLOO_STORE = None
+_GLOO_RANKS = (0, 1)
+
+
+def gloo_barrier():
+    if _GLOO_STORE is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    _GLOO_STORE.barrier(f"gloo_barrier_{_GLOO_RANKS[0]}")
+
+
+def gloo_release():
+    global _GLOO_STORE
+    if _GLOO_STORE is not None:
+        close = getattr(_GLOO_STORE, "close", None)
+        if close:
+            close()
+        _GLOO_STORE = None
+
+
+class ReduceType:
+    """Partial-placement reduce kinds (reference: pybind auto_parallel
+    ReduceType enum used by dist.Partial(reduce_type))."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6

@@ -5,9 +5,9 @@ from . import initializer  # noqa: F401
 from .layer.common import (  # noqa: F401
     Identity, Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
     Embedding, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle,
-    PixelUnshuffle, ChannelShuffle, Bilinear, Pad1D, Pad2D, Pad3D, ZeroPad2D,
-    CosineSimilarity, Unfold, Fold, PairwiseDistance, Unflatten,
-    FeatureAlphaDropout,
+    PixelUnshuffle, ChannelShuffle, Bilinear, Pad1D, Pad2D, Pad3D, ZeroPad1D,
+    ZeroPad2D, ZeroPad3D, CosineSimilarity, Unfold, Fold, PairwiseDistance,
+    Unflatten, FeatureAlphaDropout,
 )
 from .layer.conv import (  # noqa: F401
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
@@ -20,23 +20,26 @@ from .layer.norm import (  # noqa: F401
 from .layer.pooling import (  # noqa: F401
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
-    AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+    AdaptiveMaxPool2D, AdaptiveMaxPool3D, LPPool1D, LPPool2D,
+    FractionalMaxPool2D, FractionalMaxPool3D, MaxUnPool1D, MaxUnPool2D,
+    MaxUnPool3D,
 )
 from .layer.activation import (  # noqa: F401
     ReLU, ReLU6, Sigmoid, Tanh, Silu, Swish, Mish, GELU, ELU, CELU, SELU, LeakyReLU,
     Hardtanh, Hardshrink, Softshrink, Hardsigmoid, Hardswish, Softplus, Softsign,
     Tanhshrink, ThresholdedReLU, LogSigmoid, Softmax, LogSoftmax, GLU, Maxout, PReLU,
-    RReLU,
+    RReLU, Softmax2D,
 )
 from .layer.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     SmoothL1Loss, HuberLoss, KLDivLoss, MarginRankingLoss, CTCLoss,
     CosineEmbeddingLoss, TripletMarginLoss, HingeEmbeddingLoss, SoftMarginLoss,
     MultiLabelSoftMarginLoss, PoissonNLLLoss, GaussianNLLLoss,
-    TripletMarginWithDistanceLoss,
+    TripletMarginWithDistanceLoss, HSigmoidLoss, MultiMarginLoss, RNNTLoss,
+    AdaptiveLogSoftmaxWithLoss,
 )
 from .layer.containers import (  # noqa: F401
-    Sequential, LayerList, LayerDict, ParameterList,
+    Sequential, LayerList, LayerDict, ParameterList, ParameterDict,
 )
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
@@ -44,6 +47,12 @@ from .layer.transformer import (  # noqa: F401
 )
 from .layer.rnn import (  # noqa: F401
     SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU, RNNCellBase,
+)
+
+from .decode import BeamSearchDecoder, dynamic_decode, Decoder  # noqa: F401
+# gradient-clip strategies live with the optimizers; paddle exposes them on nn too
+from ..optimizer.clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
 )
 
 from . import utils  # noqa: F401

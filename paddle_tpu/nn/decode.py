@@ -1,0 +1,214 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference: python/paddle/nn/decode.py — a port of the tf.contrib.seq2seq
+decoder contract: Decoder.initialize/step/finalize driven by a host loop.
+Eager host loop here (decode lengths are data-dependent); each step's compute
+is jitted op dispatch; the backtrace is nn.functional.gather_tree.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .functional.extension import gather_tree
+from . import functional as F
+
+
+class Decoder:
+    """Abstract decode contract (reference: nn/decode.py Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell (reference: nn/decode.py
+    BeamSearchDecoder): states tiled to batch*beam, per-step top-k over
+    beam*vocab, finished beams frozen onto end_token."""
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+
+    # -- beam/batch reshaping helpers (reference names preserved) ------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        def tile(t):
+            v = t._value if isinstance(t, Tensor) else jnp.asarray(t)
+            v = jnp.repeat(v[:, None], beam_size, axis=1)
+            return Tensor(v.reshape((-1,) + v.shape[2:]))
+        return jax.tree_util.tree_map(
+            tile, x, is_leaf=lambda t: isinstance(t, Tensor))
+
+    def _merge_batch_beams(self, x):
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        return Tensor(v.reshape((-1,) + v.shape[2:]))
+
+    def _split_batch_beams(self, x):
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        return Tensor(v.reshape((-1, self.beam_size) + v.shape[1:]))
+
+    def _expand_to_beam_size(self, x):
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        return Tensor(jnp.repeat(v[:, None], self.beam_size, axis=1))
+
+    def _tree(self, fn, tree):
+        return jax.tree_util.tree_map(
+            fn, tree, is_leaf=lambda t: isinstance(t, Tensor))
+
+    def initialize(self, initial_cell_states):
+        states = self._tree(self._expand_to_beam_size, initial_cell_states)
+        sample = jax.tree_util.tree_leaves(states)[0]
+        batch = sample.shape[0] if isinstance(sample, Tensor) else \
+            sample._value.shape[0]
+        self.batch_size = batch
+        # beam 0 live, others -inf so the first top-k picks distinct tokens
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1), jnp.float32),
+            (batch, 1))
+        init_ids = jnp.full((batch, self.beam_size), self.start_token,
+                            jnp.int64)
+        init_inputs = Tensor(init_ids)
+        if self.embedding_fn is not None:
+            init_inputs = self.embedding_fn(init_inputs)
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int64)
+        state = self.StateWrapper(states, Tensor(log_probs), Tensor(finished),
+                                  Tensor(lengths))
+        return init_inputs, state, Tensor(finished)
+
+    def _beam_search_step(self, logits, beam_state):
+        batch, beam = self.batch_size, self.beam_size
+        vocab = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(
+            jnp.asarray(logits._value, jnp.float32), axis=-1)
+        step_lp = step_lp.reshape(batch, beam, vocab)
+        finished = beam_state.finished._value
+        # finished beams emit only end_token with log-prob 0
+        noend = jnp.full((vocab,), -1e9, jnp.float32).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[:, :, None], noend[None, None, :], step_lp)
+        total = beam_state.log_probs._value[:, :, None] + step_lp
+        flat = total.reshape(batch, beam * vocab)
+        topv, topi = jax.lax.top_k(flat, beam)
+        parent = (topi // vocab).astype(jnp.int64)
+        token = (topi % vocab).astype(jnp.int64)
+        prev_fin = jnp.take_along_axis(finished, parent, axis=1)
+        next_fin = prev_fin | (token == self.end_token)
+        prev_len = jnp.take_along_axis(beam_state.lengths._value, parent, axis=1)
+        next_len = prev_len + (~prev_fin).astype(jnp.int64)
+
+        def gather_state(t):
+            # cell states arrive merged (batch*beam, ...) from the cell call;
+            # store them split (batch, beam, ...) so the next step's merge works
+            v = t._value if isinstance(t, Tensor) else jnp.asarray(t)
+            v = v.reshape((batch, beam) + v.shape[1:])
+            g = jnp.take_along_axis(
+                v, parent.reshape((batch, beam) + (1,) * (v.ndim - 2)), axis=1)
+            return Tensor(g)
+
+        next_cell = self._tree(gather_state, beam_state.cell_states)
+        next_state = self.StateWrapper(next_cell, Tensor(topv),
+                                       Tensor(next_fin), Tensor(next_len))
+        output = self.OutputWrapper(Tensor(topv), Tensor(token), Tensor(parent))
+        return output, next_state, Tensor(next_fin)
+
+    def step(self, time, inputs, states, **kwargs):
+        merged_inputs = self._tree(self._merge_batch_beams, inputs)
+        merged_states = self._tree(self._merge_batch_beams, states.cell_states)
+        cell_out, next_cell = self.cell(merged_inputs, merged_states, **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        split_out = self._split_batch_beams(cell_out)
+        beam_state = self.StateWrapper(next_cell, states.log_probs,
+                                       states.finished, states.lengths)
+        output, next_state, finished = self._beam_search_step(
+            split_out, beam_state)
+        next_inputs = Tensor(output.predicted_ids._value)
+        if self.embedding_fn is not None:
+            next_inputs = self.embedding_fn(next_inputs)
+        return output, next_state, next_inputs, finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        # outputs fields stacked (T, batch, beam) — backtrace parent pointers
+        predicted = gather_tree(outputs.predicted_ids, outputs.parent_ids)
+        return self.OutputWrapper(outputs.scores, predicted,
+                                  outputs.parent_ids), final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Drive a Decoder until every sequence finishes (reference: nn/decode.py
+    dynamic_decode)."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    time = 0
+    max_steps = max_step_num if max_step_num is not None else 10 ** 9
+    seq_len = None
+    while time < max_steps:
+        outputs, next_states, inputs, finished = decoder.step(
+            time, inputs, states, **kwargs)
+        if seq_len is None:
+            seq_len = getattr(next_states, "lengths", None)
+        if not decoder.tracks_own_finished:
+            fin = np.asarray(finished._value)
+        else:
+            fin = np.asarray(finished._value)
+        step_outputs.append(outputs)
+        states = next_states
+        time += 1
+        if fin.all():
+            break
+
+    def stack(field):
+        vals = [getattr(o, field)._value for o in step_outputs]
+        return Tensor(jnp.stack(vals, axis=0))
+
+    if hasattr(step_outputs[0], "_fields"):
+        stacked = type(step_outputs[0])(
+            *[stack(f) for f in step_outputs[0]._fields])
+    else:
+        stacked = Tensor(jnp.stack([o._value for o in step_outputs], axis=0))
+    lengths = getattr(states, "lengths", seq_len)
+    final_outputs, final_states = decoder.finalize(stacked, states, lengths)
+    if not output_time_major:
+        def to_batch_major(t):
+            v = t._value
+            return Tensor(jnp.moveaxis(v, 0, 1))
+        if hasattr(final_outputs, "_fields"):
+            final_outputs = type(final_outputs)(
+                *[to_batch_major(getattr(final_outputs, f))
+                  for f in final_outputs._fields])
+        else:
+            final_outputs = to_batch_major(final_outputs)
+    if return_length:
+        return final_outputs, final_states, lengths
+    return final_outputs, final_states

@@ -11,5 +11,9 @@ from .norm import (  # noqa: F401
 from .loss import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, flash_attn_unpadded,
-    flashmask_attention,
+    flashmask_attention, flash_attn_qkvpacked, flash_attn_varlen_qkvpacked,
+)
+from .extension import (  # noqa: F401
+    sequence_mask, temporal_shift, affine_grid, grid_sample, gather_tree,
+    class_center_sample, sparse_attention,
 )

@@ -169,3 +169,29 @@ def rrelu(x, lower=0.125, upper=0.3333333, training=False, name=None):
             a = jnp.asarray((lower + upper) / 2.0, v.dtype)
         return jnp.where(v >= 0, v, a * v)
     return dispatch(fn, (x,), {}, name="rrelu")
+
+
+def _inplace_variant(fn, op_name):
+    """paddle's `<act>_` in-place forms: write the result back into x's buffer
+    (our Tensors are jax.Array façades, so "in place" = rebind the value and
+    keep the autograd linkage, same as the top-level paddle_tpu._inplace)."""
+    def op(x, *args, **kwargs):
+        kwargs.pop("name", None)
+        out = fn(x, *args, **kwargs)
+        x._value = out._value
+        x._node = out._node
+        x._out_index = out._out_index
+        if not out.stop_gradient:
+            x.stop_gradient = False
+        return x
+    op.__name__ = op_name
+    return op
+
+
+relu_ = _inplace_variant(relu, "relu_")
+tanh_ = _inplace_variant(tanh, "tanh_")
+elu_ = _inplace_variant(elu, "elu_")
+hardtanh_ = _inplace_variant(hardtanh, "hardtanh_")
+leaky_relu_ = _inplace_variant(leaky_relu, "leaky_relu_")
+softmax_ = _inplace_variant(softmax, "softmax_")
+thresholded_relu_ = _inplace_variant(thresholded_relu, "thresholded_relu_")

@@ -198,3 +198,38 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
                      key_padding_mask=None, attn_mask=None, name=None):
     raise NotImplementedError(
         "sparse_attention: use flashmask_attention or scaled_dot_product_attention")
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         fixed_seed_offset=None, rng_name="", training=True,
+                         name=None):
+    """Packed-QKV flash attention (reference: flash_attention.py
+    flash_attn_qkvpacked): qkv [B, S, G + 2, Hk, D] — the first G slots along
+    axis 2 are Q head-groups, the LAST two are K and V (the FA2 packing).
+    Flattened q head j = g*Hk + h attends kv head j // G, which is exactly the
+    repeat-broadcast rule in _sdpa_reference."""
+    num_g = qkv.shape[2] - 2
+    q = qkv[:, :, :-2]
+    k = qkv[:, :, -2]
+    v = qkv[:, :, -1]
+    B, S = q.shape[0], q.shape[1]
+    q = q.reshape([B, S, num_g * qkv.shape[3], qkv.shape[4]])
+    return flash_attention(q, k, v, dropout, causal, return_softmax,
+                           fixed_seed_offset, rng_name, training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale=None, dropout=0.0,
+                                causal=False, return_softmax=False,
+                                fixed_seed_offset=None, rng_name="",
+                                varlen_padded=True, training=True, name=None):
+    """Varlen packed-QKV flash attention (reference: flash_attention.py
+    flash_attn_varlen_qkvpacked): qkv [total, G + 2, Hk, D] — Q groups first,
+    K and V in the last two slots."""
+    num_g = qkv.shape[1] - 2
+    q = qkv[:, :-2].reshape([qkv.shape[0], num_g * qkv.shape[2], qkv.shape[3]])
+    k = qkv[:, -2]
+    v = qkv[:, -1]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale, dropout,
+                               causal, return_softmax, training)

@@ -1,0 +1,286 @@
+"""Extension functionals: sequence_mask, temporal_shift, affine_grid,
+grid_sample, gather_tree, class_center_sample, sparse_attention.
+
+Reference: python/paddle/nn/functional/{extension,vision,input}.py → phi
+kernels (temporal_shift_kernel, affine_grid_kernel, grid_sample_kernel,
+gather_tree_kernel, class_center_sample_kernel, sparse_attention GPU kernel).
+TPU-native: pure gather/where formulations that XLA fuses; sparse_attention
+lowers the CSR pattern to a dense additive mask (TPU has no CSR gather unit —
+the flash/splash Pallas kernels in ops/kernels are the perf path, this op is
+the API-parity path).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch
+from ...core import random as _random
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """mask[i, j] = j < x[i] (reference: extension.py:56)."""
+    from ...core.dtype import convert_dtype
+    jdt = convert_dtype(dtype)
+
+    def fn(v):
+        ml = maxlen
+        if ml is None:
+            ml = int(jnp.max(v)) if v.size else 0
+        ar = jnp.arange(ml, dtype=v.dtype)
+        return (ar < v[..., None]).astype(jdt)
+    return dispatch(fn, (x,), {}, name="sequence_mask")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """TSM channel shift (reference: extension.py:247 → phi temporal_shift):
+    first c1 channels take t-1, next c1 take t+1, rest pass through."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(
+            f"Attr(data_format) should be 'NCHW' or 'NHWC'. Received "
+            f"Attr(data_format): {data_format}.")
+
+    def fn(v):
+        chan_last = data_format == "NHWC"
+        if chan_last:
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        fwd = jnp.pad(v5[:, :-1, :c1], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+        bwd = jnp.pad(v5[:, 1:, c1:c2], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+        out = jnp.concatenate([fwd, bwd, v5[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if chan_last:
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return dispatch(fn, (x,), {}, name="temporal_shift")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D/3D affine sampling grid (reference: vision.py affine_grid)."""
+    shape = [int(s) for s in (out_shape.tolist() if isinstance(out_shape, Tensor)
+                              else out_shape)]
+
+    def base_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size) if size > 1 else jnp.zeros((1,))
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    def fn(th):
+        if len(shape) == 4:
+            n, _, h, w = shape
+            xs = base_coords(w)
+            ys = base_coords(h)
+            gx, gy = jnp.meshgrid(xs, ys)  # (h, w)
+            ones = jnp.ones_like(gx)
+            base = jnp.stack([gx, gy, ones], axis=-1)  # (h, w, 3)
+            # theta: (n, 2, 3); grid = base @ theta^T
+            return jnp.einsum("hwk,nck->nhwc", base, th.astype(jnp.float32)) \
+                .astype(th.dtype)
+        n, _, d, h, w = shape
+        xs = base_coords(w)
+        ys = base_coords(h)
+        zs = base_coords(d)
+        gz, gy, gx = jnp.meshgrid(zs, ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, gz, ones], axis=-1)  # (d, h, w, 4)
+        return jnp.einsum("dhwk,nck->ndhwc", base, th.astype(jnp.float32)) \
+            .astype(th.dtype)
+    return dispatch(fn, (theta,), {}, name="affine_grid")
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _reflect(coord, size, align_corners):
+    if size <= 1:
+        return jnp.zeros_like(coord)
+    if align_corners:
+        span = 2.0 * (size - 1)
+        c = jnp.abs(jnp.mod(coord, span))
+        return jnp.where(c > size - 1, span - c, c)
+    span = 2.0 * size
+    c = jnp.mod(coord + 0.5, span)
+    c = jnp.abs(c)
+    c = jnp.where(c > size, span - c, c) - 0.5
+    return jnp.clip(c, 0, size - 1)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x at normalized grid locations (reference: vision.py grid_sample
+    → phi grid_sample kernel). Supports 4-D (NCHW + NHW2 grid) and 5-D."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"mode should be 'bilinear' or 'nearest', got {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(
+            f"padding_mode should be 'zeros'/'border'/'reflection', got "
+            f"{padding_mode}")
+
+    def sample_2d(v, g):
+        n, c, h, w = v.shape
+        gx = _unnormalize(g[..., 0].astype(jnp.float32), w, align_corners)
+        gy = _unnormalize(g[..., 1].astype(jnp.float32), h, align_corners)
+        if padding_mode == "border":
+            gx = jnp.clip(gx, 0, w - 1)
+            gy = jnp.clip(gy, 0, h - 1)
+        elif padding_mode == "reflection":
+            gx = _reflect(gx, w, align_corners)
+            gy = _reflect(gy, h, align_corners)
+
+        def gather(iy, ix):
+            iyc = jnp.clip(iy, 0, h - 1)
+            ixc = jnp.clip(ix, 0, w - 1)
+            # v: (n, c, h, w); indices: (n, oh, ow)
+            out = jax.vmap(lambda vb, iyb, ixb: vb[:, iyb, ixb])(v, iyc, ixc)
+            if padding_mode == "zeros":
+                valid = ((iy >= 0) & (iy <= h - 1) & (ix >= 0) &
+                         (ix <= w - 1))[:, None]
+                out = jnp.where(valid, out, 0.0)
+            return out
+
+        if mode == "nearest":
+            return gather(jnp.round(gy).astype(jnp.int32),
+                          jnp.round(gx).astype(jnp.int32)).astype(v.dtype)
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = (gx - x0)[:, None]
+        wy = (gy - y0)[:, None]
+        x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+        v00 = gather(y0i, x0i)
+        v01 = gather(y0i, x0i + 1)
+        v10 = gather(y0i + 1, x0i)
+        v11 = gather(y0i + 1, x0i + 1)
+        top = v00 * (1 - wx) + v01 * wx
+        bot = v10 * (1 - wx) + v11 * wx
+        return (top * (1 - wy) + bot * wy).astype(v.dtype)
+
+    def sample_3d(v, g):
+        n, c, d, h, w = v.shape
+        gx = _unnormalize(g[..., 0].astype(jnp.float32), w, align_corners)
+        gy = _unnormalize(g[..., 1].astype(jnp.float32), h, align_corners)
+        gz = _unnormalize(g[..., 2].astype(jnp.float32), d, align_corners)
+        if padding_mode == "border":
+            gx, gy, gz = (jnp.clip(gx, 0, w - 1), jnp.clip(gy, 0, h - 1),
+                          jnp.clip(gz, 0, d - 1))
+        elif padding_mode == "reflection":
+            gx = _reflect(gx, w, align_corners)
+            gy = _reflect(gy, h, align_corners)
+            gz = _reflect(gz, d, align_corners)
+
+        def gather(iz, iy, ix):
+            izc = jnp.clip(iz, 0, d - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            ixc = jnp.clip(ix, 0, w - 1)
+            out = jax.vmap(lambda vb, izb, iyb, ixb: vb[:, izb, iyb, ixb])(
+                v, izc, iyc, ixc)
+            if padding_mode == "zeros":
+                valid = ((iz >= 0) & (iz <= d - 1) & (iy >= 0) & (iy <= h - 1) &
+                         (ix >= 0) & (ix <= w - 1))[:, None]
+                out = jnp.where(valid, out, 0.0)
+            return out
+
+        if mode == "nearest":
+            return gather(jnp.round(gz).astype(jnp.int32),
+                          jnp.round(gy).astype(jnp.int32),
+                          jnp.round(gx).astype(jnp.int32)).astype(v.dtype)
+        x0, y0, z0 = jnp.floor(gx), jnp.floor(gy), jnp.floor(gz)
+        wx, wy, wz = ((gx - x0)[:, None], (gy - y0)[:, None], (gz - z0)[:, None])
+        xi, yi, zi = (x0.astype(jnp.int32), y0.astype(jnp.int32),
+                      z0.astype(jnp.int32))
+        out = 0.0
+        for dz, fz in ((0, 1 - wz), (1, wz)):
+            for dy, fy in ((0, 1 - wy), (1, wy)):
+                for dx, fx in ((0, 1 - wx), (1, wx)):
+                    out = out + gather(zi + dz, yi + dy, xi + dx) * fz * fy * fx
+        return out.astype(v.dtype)
+
+    def fn(v, g):
+        return sample_2d(v, g) if v.ndim == 4 else sample_3d(v, g)
+    return dispatch(fn, (x, grid), {}, name="grid_sample")
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference: extension.py gather_tree → phi
+    gather_tree kernel): walk parent pointers from the last step backwards."""
+
+    def fn(idv, parv):
+        # (max_time, batch, beam)
+        T = idv.shape[0]
+
+        def step(beam_sel, t):
+            # beam_sel: (batch, beam) — beams chosen at step t+1
+            par = parv[t]  # (batch, beam)
+            sel = jnp.take_along_axis(par, beam_sel, axis=-1)
+            out = jnp.take_along_axis(idv[t], beam_sel, axis=-1)
+            return sel, out
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2], dtype=idv.dtype),
+                                idv.shape[1:])
+        _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return outs[::-1]
+    return dispatch(fn, (ids, parents), {}, name="gather_tree")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers for partial-FC style training
+    (reference: nn/functional/common.py class_center_sample → phi kernel).
+    Returns (remapped_label, sampled_class_center). Positive classes always
+    kept; negatives uniformly sampled to reach num_samples unique classes."""
+    lab = np.asarray(label._value if isinstance(label, Tensor) else label)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = np.sort(pos)
+    else:
+        key = _random.next_key()
+        perm = np.asarray(jax.random.permutation(key, num_classes))
+        neg = perm[~np.isin(perm, pos)][: num_samples - len(pos)]
+        sampled = np.sort(np.concatenate([pos, neg]))
+    remap = np.full((num_classes,), -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    remapped = remap[lab]
+    return (Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled)))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention over a CSR connectivity pattern (reference:
+    nn/functional/sparse_attention.py → GPU-only sparse_attention kernel).
+    Lowered to attention with a dense additive mask built from the CSR
+    pattern — correct for any pattern; use ops.kernels.flash_attention for the
+    TPU perf path."""
+
+    def fn(q, k, v, offs, cols, kpm, am):
+        # q/k/v: (B, H, S, D); offs: (B, H, S+1); cols: (B, H, nnz)
+        B, H, S, D = q.shape
+        scale = 1.0 / np.sqrt(D)
+        scores = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale
+        # dense mask from CSR: row i attends to cols[offs[i]:offs[i+1]]
+        nnz = cols.shape[-1]
+        ar = jnp.arange(nnz)
+        row_of = jnp.sum(ar[None, None, :, None] >=
+                         offs[:, :, None, 1:], axis=-1)  # (B,H,nnz)
+        allowed = jnp.zeros((B, H, S, S), bool)
+        bidx = jnp.arange(B)[:, None, None]
+        hidx = jnp.arange(H)[None, :, None]
+        allowed = allowed.at[bidx, hidx, row_of, cols].set(True)
+        neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+        scores = jnp.where(allowed, scores, neg)
+        if kpm is not None:
+            scores = jnp.where(kpm[:, None, None, :] != 0, scores, neg)
+        if am is not None:
+            scores = scores + am
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = jnp.where(allowed, probs, 0.0)
+        return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+    return dispatch(fn, (query, key, value, sparse_csr_offset,
+                         sparse_csr_columns, key_padding_mask, attn_mask), {},
+                    name="sparse_attention")

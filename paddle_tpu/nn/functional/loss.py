@@ -7,6 +7,7 @@ materialization in the backward).
 """
 from __future__ import annotations
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -352,3 +353,210 @@ def triplet_margin_with_distance_loss(input, positive, negative,
         return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
     return dispatch(fn, (d_pos, d_neg_v), {},
                     name="triplet_margin_with_distance_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference: loss.py:50 — 1 - 2|X∩Y| / (|X|+|Y|), mean over batch."""
+
+    def fn(inp, lbl):
+        lbl = jnp.squeeze(lbl, -1)
+        oh = jax.nn.one_hot(lbl, inp.shape[-1], dtype=inp.dtype)
+        axes = tuple(range(1, inp.ndim))
+        inse = jnp.sum(inp * oh, axis=axes)
+        denom = jnp.sum(inp, axis=axes) + jnp.sum(oh, axis=axes)
+        return jnp.mean(1 - 2 * inse / (denom + epsilon))
+    return dispatch(fn, (input, label), {}, name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """reference: loss.py:346 — similarity-matrix soft-label CE + L2 term."""
+
+    def fn(a, p, lab):
+        bs = lab.shape[0]
+        lab2 = jnp.tile(lab.reshape(bs, 1), (1, bs))
+        eq = (lab2 == lab2.T).astype(jnp.float32)
+        soft = eq / jnp.sum(eq, axis=1, keepdims=True)
+        l2 = (jnp.mean(jnp.sum(jnp.square(a), 1)) +
+              jnp.mean(jnp.sum(jnp.square(p), 1))) * 0.25 * l2_reg
+        sim = a @ p.T
+        logp = jax.nn.log_softmax(sim, axis=-1)
+        ce_rows = -jnp.sum(soft * logp, axis=-1, keepdims=True)
+        ce = jnp.mean(jnp.sum(soft * ce_rows, 0))
+        return l2.astype(a.dtype) + ce
+    return dispatch(fn, (anchor, positive, labels), {}, name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
+                  path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid over a complete binary tree (reference:
+    loss.py hsigmoid_loss → phi hsigmoid_loss kernel; code scheme from
+    funcs/matrix_bit_code.h SimpleCode: c = label + num_classes,
+    index(b) = (c >> (b+1)) - 1, bit(b) = (c >> b) & 1)."""
+    max_bits = max(1, int(np.ceil(np.log2(max(2, num_classes)))) + 1)
+
+    def fn(x, lbl, w, b, ptab, pcode):
+        lbl = lbl.reshape(-1)
+        if ptab is not None:
+            idx = ptab.astype(jnp.int32)           # (N, L)
+            bits = pcode.astype(jnp.float32)       # (N, L)
+            valid = idx >= 0
+            idx = jnp.maximum(idx, 0)
+        else:
+            c = (lbl + num_classes).astype(jnp.int32)[:, None]  # (N, 1)
+            brange = jnp.arange(max_bits, dtype=jnp.int32)[None, :]
+            length = jnp.floor(
+                jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)
+            valid = brange < length
+            idx = jnp.clip((c >> (brange + 1)) - 1, 0, num_classes - 2)
+            bits = ((c >> brange) & 1).astype(jnp.float32)
+        wsel = w[idx]                              # (N, L, F)
+        logits = jnp.einsum("nf,nlf->nl", x, wsel)
+        if b is not None:
+            logits = logits + b.reshape(-1)[idx]
+        # sigmoid cross entropy: log(1+e^z) - t*z, summed over the code path
+        per_bit = jnp.logaddexp(0.0, logits) - bits * logits
+        loss = jnp.sum(jnp.where(valid, per_bit, 0.0), axis=1, keepdims=True)
+        return loss.astype(x.dtype)
+    return dispatch(fn, (input, label, weight, bias, path_table, path_code), {},
+                    name="hsigmoid_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family margin softmax (reference: loss.py:2223 →
+    margin_cross_entropy kernel): target logit cos(m1·θ + m2) - m3, scaled."""
+
+    def fn(lg, lbl):
+        lbl_flat = lbl.reshape(-1)
+        oh = jax.nn.one_hot(lbl_flat, lg.shape[-1], dtype=lg.dtype)
+        cos_t = jnp.sum(lg * oh, axis=-1)
+        theta = jnp.arccos(jnp.clip(cos_t.astype(jnp.float32), -1.0, 1.0))
+        mod = jnp.cos(margin1 * theta + margin2) - margin3
+        lg2 = lg.astype(jnp.float32) * (1 - oh) + mod[:, None] * oh
+        lg2 = lg2 * scale
+        logp = jax.nn.log_softmax(lg2, axis=-1)
+        loss = -jnp.sum(oh * logp, axis=-1, keepdims=True).astype(lg.dtype)
+        sm = jnp.exp(logp).astype(lg.dtype)
+        return _reduce(loss, reduction), sm
+    loss, sm = dispatch(fn, (logits, label), {}, name="margin_cross_entropy")
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """reference: loss.py multi_margin_loss — hinge over wrong classes."""
+
+    def fn(x, lbl, w):
+        lbl = lbl.reshape(-1)
+        C = x.shape[1]
+        oh = jax.nn.one_hot(lbl, C, dtype=x.dtype)
+        target = jnp.sum(x * oh, axis=1, keepdims=True)
+        hinge = jnp.maximum(0.0, margin - target + x) ** p
+        if w is not None:
+            hinge = hinge * w[lbl][:, None]
+        loss = jnp.sum(hinge * (1 - oh), axis=1) / C
+        return _reduce(loss, reduction)
+    return dispatch(fn, (input, label, weight), {}, name="multi_margin_loss")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference: loss.py adaptive_log_softmax_with_loss):
+    frequent classes in the head, rare classes in projected tail clusters.
+    Returns (per-sample target log-prob, mean NLL)."""
+    n_clusters = len(cutoffs) - 1 if cutoffs[-1] is not None else len(cutoffs)
+    shortlist = int(cutoffs[0])
+    cut = [shortlist] + [int(c) for c in cutoffs[1:]]
+
+    def fn(x, lbl, hw, hb, *tails):
+        lbl = lbl.reshape(-1)
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_logp = jax.nn.log_softmax(head_logits, axis=-1)
+        in_head = lbl < shortlist
+        out = jnp.take_along_axis(
+            head_logp, jnp.clip(lbl, 0, shortlist - 1)[:, None], axis=1)[:, 0]
+        out = jnp.where(in_head, out, 0.0)
+        for i in range(len(cut) - 1):
+            proj, cls_w = tails[2 * i], tails[2 * i + 1]
+            lo, hi = cut[i], cut[i + 1]
+            tail_logp = jax.nn.log_softmax((x @ proj) @ cls_w, axis=-1)
+            rel = jnp.clip(lbl - lo, 0, hi - lo - 1)
+            cluster_lp = head_logp[:, shortlist + i] + \
+                jnp.take_along_axis(tail_logp, rel[:, None], axis=1)[:, 0]
+            out = jnp.where((lbl >= lo) & (lbl < hi), cluster_lp, out)
+        return out, -jnp.mean(out)
+    tails_flat = []
+    for pair in tail_weights:
+        tails_flat.extend(pair)
+    return dispatch(fn, (input, label, head_weight, head_bias, *tails_flat), {},
+                    name="adaptive_log_softmax_with_loss")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference: loss.py rnnt_loss → warprnnt). Forward
+    log-alpha DP over the (T, U) lattice with lax.scan; gradients come from
+    autodiff through the DP (the analytic beta recursion the CUDA lib uses is
+    exactly the adjoint of this scan). fastemit_lambda only reweights warprnnt
+    gradients, not the loss value."""
+
+    def fn(logits, lbl, in_len, lbl_len):
+        if logits.ndim == 3:
+            logits_b = logits[None]
+            lbl_b = lbl[None]
+            in_len_b = in_len.reshape(1)
+            lbl_len_b = lbl_len.reshape(1)
+        else:
+            logits_b, lbl_b = logits, lbl
+            in_len_b, lbl_len_b = in_len.reshape(-1), lbl_len.reshape(-1)
+        B, T, U, V = logits_b.shape
+        logp = jax.nn.log_softmax(logits_b.astype(jnp.float32), axis=-1)
+        blank_lp = logp[..., blank]                      # (B, T, U)
+        NEG = jnp.asarray(-1e30, jnp.float32)
+        if U > 1:
+            lbl_idx = jnp.clip(lbl_b, 0, V - 1)          # (B, U-1)
+            yp = jnp.take_along_axis(
+                logp[:, :, : U - 1, :],
+                jnp.broadcast_to(lbl_idx[:, None, :, None], (B, T, U - 1, 1)),
+                axis=-1)[..., 0]                         # (B, T, U-1) label emission
+        else:
+            # empty transcript: no label emissions, only the blank path
+            yp = jnp.full((B, T, 1), NEG)
+
+        def t_step(alpha_prev, t):
+            # alpha over u for fixed t; scan emission over u via prefix DP
+            # alpha[t, u] = logaddexp(alpha[t-1, u] + blank[t-1, u],
+            #                          alpha[t, u-1] + y[t, u-1])
+            from_blank = jnp.where(
+                t > 0, alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :], NEG)
+            from_blank = jnp.where(t > 0, from_blank,
+                                   jnp.where(jnp.arange(U) == 0, 0.0, NEG))
+
+            def u_step(carry, u):
+                horiz = jnp.where(
+                    u > 0,
+                    carry + yp[:, t, jnp.clip(u - 1, 0, yp.shape[2] - 1)], NEG)
+                a = jnp.logaddexp(from_blank[:, u], horiz)
+                a = jnp.where((t == 0) & (u == 0), 0.0, a)
+                return a, a
+            _, cols = jax.lax.scan(u_step, jnp.full((B,), NEG), jnp.arange(U))
+            alpha_t = jnp.moveaxis(cols, 0, 1)           # (B, U)
+            return alpha_t, alpha_t
+
+        _, alphas = jax.lax.scan(t_step, jnp.full((B, U), NEG), jnp.arange(T))
+        alphas = jnp.moveaxis(alphas, 0, 1)              # (B, T, U)
+        bidx = jnp.arange(B)
+        t_last = jnp.clip(in_len_b - 1, 0, T - 1)
+        u_last = jnp.clip(lbl_len_b, 0, U - 1)
+        total = alphas[bidx, t_last, u_last] + blank_lp[bidx, t_last, u_last]
+        loss = -total
+        if logits.ndim == 3:
+            loss = loss[0]
+        return _reduce(loss, reduction)
+    return dispatch(fn, (input, label, input_lengths, label_lengths), {},
+                    name="rnnt_loss")

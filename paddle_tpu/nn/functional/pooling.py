@@ -112,7 +112,7 @@ def _max_pool_mask(x, kernel_size, stride, padding, data_format):
             bv, bi = b
             take_b = bv > av
             return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
-        init = (jnp.asarray(-jnp.inf, v.dtype), jnp.asarray(-1.0))
+        init = (jnp.asarray(-jnp.inf, v.dtype), jnp.asarray(-1.0, jnp.float32))
         vv, ii = jax.lax.reduce_window((v, idx), init, red,
                                        (1, 1) + k, (1, 1) + s,
                                        [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
@@ -222,3 +222,155 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return dispatch(_adaptive_pool(x, output_size, 3, "max", False), (x,), {},
                     name="adaptive_max_pool3d")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCL", name=None):
+    """reference: nn/functional/pooling.py lp_pool1d → phi lp_pool (funcs/pooling.h
+    LPPool): (sum |x|^p)^(1/p) over each window."""
+    pw = float(norm_type)
+
+    def fn(v):
+        powed = jnp.power(jnp.abs(v), pw)
+        pool = _pool(None, kernel_size, stride, padding, 1, jax.lax.add,
+                     0.0, data_format == "NLC", ceil_mode, is_avg=False)(powed)
+        return jnp.power(pool, 1.0 / pw)
+    return dispatch(fn, (x,), {}, name="lp_pool1d")
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size, n,
+                data_format, op_name):
+    """Shared unpool: scatter x into zeros at the flat spatial `indices`
+    recorded by max_pool(return_mask=True) (reference: phi unpool kernels)."""
+    k = _tuple(kernel_size, n)
+    s = _tuple(stride if stride is not None else kernel_size, n)
+    p = _tuple(padding, n)
+    in_spatial = tuple(int(d) for d in x.shape[2:])
+    if output_size is None:
+        out_spatial = tuple((in_spatial[i] - 1) * s[i] - 2 * p[i] + k[i]
+                            for i in range(n))
+    else:
+        out_spatial = tuple(int(v) for v in output_size[-n:])
+
+    def fn(v, idx):
+        N, C = v.shape[0], v.shape[1]
+        flat_out = 1
+        for d in out_spatial:
+            flat_out *= d
+        vflat = v.reshape(N, C, -1)
+        iflat = idx.reshape(N, C, -1).astype(jnp.int32)
+        zeros = jnp.zeros((N, C, flat_out), v.dtype)
+        out = jax.vmap(jax.vmap(lambda z, i, src: z.at[i].set(src)))(
+            zeros, iflat, vflat)
+        return out.reshape((N, C) + out_spatial)
+    return dispatch(fn, (x, indices), {}, name=op_name)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 1,
+                       data_format, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 2,
+                       data_format, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 3,
+                       data_format, "max_unpool3d")
+
+
+def _fractional_bounds(in_sz, out_sz, u, pool_size):
+    """Start/end indices per output cell (reference: funcs/pooling.h
+    FractionalRationalU/StartIndex/EndIndex)."""
+    alpha = in_sz / out_sz
+    if pool_size <= 0:
+        base = in_sz // out_sz
+        u_max1 = (base + 2) / alpha - 1
+        u_max2 = (in_sz + 1 - base) / alpha - (out_sz - 1)
+        u = u * min(u_max1, u_max2)
+    starts, ends = [], []
+    for i in range(out_sz):
+        st = int((i + u) * alpha) - int(u * alpha)
+        if pool_size > 0:
+            en = st + pool_size
+        else:
+            en = int((i + 1 + u) * alpha) - int(u * alpha)
+        starts.append(max(0, st))
+        ends.append(min(in_sz, en))
+    return starts, ends
+
+
+def _fractional_max_pool(x, output_size, kernel_size, random_u, return_mask, n,
+                         op_name):
+    from ...core import random as _random
+    out_sz = [int(v) for v in
+              (output_size if not isinstance(output_size, int)
+               else (output_size,) * n)]
+    ksz = [0] * n if kernel_size is None else list(_tuple(kernel_size, n))
+    if random_u is None:
+        key = _random.next_key()
+        random_u = float(jax.random.uniform(key, ()))
+    u = float(random_u)
+    in_spatial = [int(d) for d in x.shape[2:]]
+    bounds = [_fractional_bounds(in_spatial[i], out_sz[i], u, ksz[i])
+              for i in range(n)]
+    kmax = [max(e - s for s, e in zip(*bounds[i])) for i in range(n)]
+
+    def fn(v):
+        N, C = v.shape[0], v.shape[1]
+        vals = v
+        # per dim: gather windows then fold the window axis to the end
+        sel_idx = []  # per-dim (out, kmax) gather indices + mask
+        for d in range(n):
+            starts = np.asarray(bounds[d][0])
+            ends = np.asarray(bounds[d][1])
+            gather = starts[:, None] + np.arange(kmax[d])[None, :]
+            mask = gather < ends[:, None]
+            gather = np.minimum(gather, in_spatial[d] - 1)
+            sel_idx.append((jnp.asarray(gather), jnp.asarray(mask)))
+        # flat index tracking for the mask output
+        flat = None
+        if return_mask:
+            flat = jnp.arange(int(np.prod(in_spatial)), dtype=jnp.int32)
+            flat = jnp.broadcast_to(
+                flat.reshape((1, 1) + tuple(in_spatial)), v.shape)
+        for d in range(n):
+            axis = 2 + d  # current dim position (earlier dims already pooled)
+            gather, mask = sel_idx[d]
+            vals = jnp.take(vals, gather.reshape(-1), axis=axis)
+            new_shape = vals.shape[:axis] + (out_sz[d], kmax[d]) + \
+                vals.shape[axis + 1:]
+            vals = vals.reshape(new_shape)
+            mshape = [1] * len(new_shape)
+            mshape[axis], mshape[axis + 1] = out_sz[d], kmax[d]
+            neg = jnp.where(mask.reshape(mshape), 0.0, -jnp.inf).astype(v.dtype)
+            vals = vals + neg
+            if return_mask:
+                flat = jnp.take(flat, gather.reshape(-1), axis=axis)
+                flat = flat.reshape(new_shape)
+                am = jnp.argmax(vals, axis=axis + 1, keepdims=True)
+                flat = jnp.take_along_axis(flat, am, axis=axis + 1)
+                flat = jnp.squeeze(flat, axis=axis + 1)
+            vals = jnp.max(vals, axis=axis + 1)
+        if return_mask:
+            return vals, flat
+        return vals
+
+    return dispatch(fn, (x,), {}, name=op_name)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 2, "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 3, "fractional_max_pool3d")

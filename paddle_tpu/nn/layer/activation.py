@@ -62,3 +62,16 @@ class PReLU(Layer):
 
     def forward(self, x):
         return F.prelu(x, self.weight, self._data_format)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (reference:
+    nn/layer/activation.py Softmax2D — softmax at axis=-3)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        assert x.ndim in (3, 4), \
+            f"Softmax2D requires a 3D or 4D tensor as input. Received: {x.ndim}D."
+        return F.softmax(x, axis=-3)

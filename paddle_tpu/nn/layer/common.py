@@ -281,3 +281,31 @@ class FeatureAlphaDropout(Layer):
 
     def forward(self, x):
         return F.feature_alpha_dropout(x, self.p, training=self.training)
+
+
+class ZeroPad1D(Layer):
+    """reference: nn/layer/common.py ZeroPad1D — constant-0 pad on the L dim."""
+
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self._pad = padding if not isinstance(padding, int) \
+            else [padding, padding]
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self._pad, mode="constant", value=0.0,
+                     data_format=self._data_format)
+
+
+class ZeroPad3D(Layer):
+    """reference: nn/layer/common.py ZeroPad3D."""
+
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self._pad = padding if not isinstance(padding, int) \
+            else [padding] * 6
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self._pad, mode="constant", value=0.0,
+                     data_format=self._data_format)

@@ -192,3 +192,113 @@ class TripletMarginWithDistanceLoss(Layer):
         d, m, s, r = self.args
         return F.triplet_margin_with_distance_loss(input, positive, negative,
                                                    d, m, s, r)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (reference: nn/layer/loss.py HSigmoidLoss):
+    complete-binary-tree hierarchical softmax; weight [num_classes-1, F],
+    bias [num_classes-1, 1]."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if (num_classes < 2) and (not is_custom):
+            raise ValueError(
+                "num_classes must not be less than 2 with default tree")
+        self._feature_size = feature_size
+        self._num_classes = num_classes
+        self._is_custom = is_custom
+        C = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter([C, feature_size], weight_attr)
+        self.bias = self.create_parameter([C, 1], bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin, self.weight = p, margin, weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank, self.fastemit_lambda = blank, fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax head (reference: nn/layer/loss.py
+    AdaptiveLogSoftmaxWithLoss): shortlist head + projected tail clusters with
+    div_value^i shrinking projections."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (cutoffs != sorted(cutoffs) or min(cutoffs) <= 0
+                or max(cutoffs) > (n_classes - 1)
+                or len(set(cutoffs)) != len(cutoffs)
+                or any(int(c) != c for c in cutoffs)):
+            raise ValueError(
+                "cutoffs should be a sequence of unique, positive integers "
+                "sorted in an increasing order, where each value is between "
+                "1 and n_classes-1")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.shortlist_size = self.cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.shortlist_size + self.n_clusters
+        self.head_weight = self.create_parameter(
+            [in_features, self.head_size], weight_attr)
+        self.head_bias = self.create_parameter(
+            [self.head_size], bias_attr, is_bias=True) if head_bias else None
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = int(in_features // (div_value ** (i + 1)))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = self.create_parameter([in_features, hsz], weight_attr)
+            cls_w = self.create_parameter([hsz, osz], weight_attr)
+            self.add_parameter(f"tail_proj_{i}", proj)
+            self.add_parameter(f"tail_cls_{i}", cls_w)
+            self.tail_weights.append([proj, cls_w])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, self.head_bias)
+
+    def _full_log_prob(self, input):
+        import paddle_tpu as _paddle
+        head = input @ self.head_weight
+        if self.head_bias is not None:
+            head = head + self.head_bias
+        head_lp = F.log_softmax(head, axis=-1)
+        parts = [head_lp[:, : self.shortlist_size]]
+        for i, (proj, cls_w) in enumerate(self.tail_weights):
+            tail_lp = F.log_softmax((input @ proj) @ cls_w, axis=-1)
+            parts.append(tail_lp + head_lp[:, self.shortlist_size + i
+                                           : self.shortlist_size + i + 1])
+        return _paddle.concat(parts, axis=-1)
+
+    def log_prob(self, input):
+        return self._full_log_prob(input)
+
+    def predict(self, input):
+        return self._full_log_prob(input).argmax(axis=-1)

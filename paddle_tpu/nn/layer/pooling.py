@@ -49,3 +49,41 @@ AdaptiveMaxPool2D = _pool_layer("AdaptiveMaxPool2D", "adaptive_max_pool2d",
                                 ["output_size", "return_mask"])
 AdaptiveMaxPool3D = _pool_layer("AdaptiveMaxPool3D", "adaptive_max_pool3d",
                                 ["output_size", "return_mask"])
+
+LPPool1D = _pool_layer("LPPool1D", "lp_pool1d",
+                       ["norm_type", "kernel_size", "stride", "padding",
+                        "ceil_mode", "data_format"])
+LPPool2D = _pool_layer("LPPool2D", "lp_pool2d",
+                       ["norm_type", "kernel_size", "stride", "padding",
+                        "ceil_mode", "data_format"])
+FractionalMaxPool2D = _pool_layer(
+    "FractionalMaxPool2D", "fractional_max_pool2d",
+    ["output_size", "kernel_size", "random_u", "return_mask"])
+FractionalMaxPool3D = _pool_layer(
+    "FractionalMaxPool3D", "fractional_max_pool3d",
+    ["output_size", "kernel_size", "random_u", "return_mask"])
+
+
+def _unpool_layer(name, fn_name):
+    fn = getattr(F, fn_name)
+
+    class _Unpool(Layer):
+        def __init__(self, kernel_size, stride=None, padding=0,
+                     data_format=None, output_size=None, name=None):
+            super().__init__()
+            self._args = dict(kernel_size=kernel_size, stride=stride,
+                              padding=padding, output_size=output_size)
+            if data_format is not None:
+                self._args["data_format"] = data_format
+
+        def forward(self, x, indices):
+            return fn(x, indices, **self._args)
+
+    _Unpool.__name__ = name
+    _Unpool.__qualname__ = name
+    return _Unpool
+
+
+MaxUnPool1D = _unpool_layer("MaxUnPool1D", "max_unpool1d")
+MaxUnPool2D = _unpool_layer("MaxUnPool2D", "max_unpool2d")
+MaxUnPool3D = _unpool_layer("MaxUnPool3D", "max_unpool3d")

@@ -3,6 +3,8 @@ from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Adadelta, Adamax, Lamb,
     L2Decay, L1Decay,
 )
+from .extra import ASGD, Rprop, RAdam, NAdam  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from . import lr  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,

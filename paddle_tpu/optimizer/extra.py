@@ -1,0 +1,157 @@
+"""The long-tail optimizer family: ASGD, Rprop, RAdam, NAdam.
+
+Reference semantics: python/paddle/optimizer/{asgd,rprop,radam,nadam}.py with the
+authoritative update rules in phi kernels (paddle/phi/kernels/cpu/asgd_kernel.cc,
+rprop_kernel.cc, impl/nadam_kernel_impl.h, impl/radam_kernel_impl.h). Each is a
+pure `_apply` rule on the shared Optimizer base, so they fuse into the jitted
+multi-tensor update like the rest of the family.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient (reference asgd.py — SAG, Schmidt et al.).
+
+    Keeps a running sum ``d`` of the most recent gradient per batch slot
+    (``ys[i]``, i = step % batch_num) so the update uses the average of the
+    last ``batch_num`` gradients: ``p -= lr * d / min(step+1, n)``.
+    """
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        if batch_num is None or batch_num <= 0:
+            raise ValueError("batch_num should be greater than 0")
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._n = int(batch_num)
+
+    def _init_slots(self, v):
+        return {"d": jnp.zeros_like(v),
+                "ys": jnp.zeros((self._n,) + v.shape, v.dtype),
+                "m": jnp.zeros((), jnp.int64)}
+
+    def _apply(self, p, g, slots, lr, step):
+        m = slots["m"]
+        idx = (m % self._n).astype(jnp.int32)
+        y = slots["ys"][idx]
+        d = slots["d"] - y + g
+        ys = slots["ys"].at[idx].set(g)
+        n_eff = jnp.minimum(m + 1, self._n).astype(p.dtype)
+        new_p = p - lr.astype(p.dtype) * d / n_eff
+        return new_p, {"d": d, "ys": ys, "m": m + 1}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference rprop.py; kernel rprop_kernel.cc).
+
+    Per-element learning rates adapted by the sign of grad*prev_grad:
+    agree -> lr*eta+, disagree -> lr*eta- and the step is skipped (grad zeroed),
+    then ``p -= sign(grad) * lr`` with lr clipped to learning_rate_range.
+    """
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        if not (0.0 < learning_rate_range[0] <= learning_rate
+                <= learning_rate_range[1]):
+            raise ValueError(
+                "'0.0 < learning_rate_range[0] <= learning_rate <= "
+                "learning_rate_range[1]' must be true")
+        if not 0.0 < etas[0] < 1.0 < etas[1]:
+            raise ValueError("'0.0 < etas[0] < 1.0 < etas[1]' must be true")
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_min, self._lr_max = float(learning_rate_range[0]), \
+            float(learning_rate_range[1])
+        self._eta_neg, self._eta_pos = float(etas[0]), float(etas[1])
+        self._lr0 = float(learning_rate)
+
+    def _init_slots(self, v):
+        return {"prevs": jnp.zeros_like(v),
+                "learning_rates": jnp.full_like(v, self._lr0)}
+
+    def _apply(self, p, g, slots, lr, step):
+        prod = g * slots["prevs"]
+        eta = jnp.where(prod > 0, self._eta_pos,
+                        jnp.where(prod < 0, self._eta_neg, 1.0)).astype(p.dtype)
+        g = jnp.where(prod < 0, jnp.zeros_like(g), g)
+        lrs = jnp.clip(slots["learning_rates"] * eta, self._lr_min, self._lr_max)
+        new_p = p - jnp.sign(g) * lrs
+        return new_p, {"prevs": g, "learning_rates": lrs}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference radam.py / radam_kernel_impl.h)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_slots(self, v):
+        return {"moment1": jnp.zeros_like(v), "moment2": jnp.zeros_like(v)}
+
+    def _apply(self, p, g, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        t = step.astype(jnp.float32)
+        b1t = jnp.power(b1, t)
+        b2t = jnp.power(b2, t)
+        m_hat = m / (1 - b1t)
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2t / (1 - b2t)
+        # rectification term (defined where rho_t > 4; guarded for the tracer)
+        safe_rho = jnp.maximum(rho_t, 4.0 + 1e-3)
+        r = jnp.sqrt(((safe_rho - 4) * (safe_rho - 2) * rho_inf)
+                     / ((rho_inf - 4) * (rho_inf - 2) * safe_rho))
+        adaptive = r * m_hat * jnp.sqrt(1 - b2t) / (jnp.sqrt(v) + self._eps)
+        sgd_like = m_hat
+        update = jnp.where(rho_t > 5.0, adaptive, sgd_like).astype(p.dtype)
+        return p - lr.astype(p.dtype) * update, {"moment1": m, "moment2": v}
+
+
+class NAdam(Optimizer):
+    """Nesterov-momentum Adam (reference nadam.py / nadam_kernel_impl.h)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        if momentum_decay < 0:
+            raise ValueError(
+                f"Invalid momentum_decay value: {momentum_decay}, expect "
+                "momentum_decay >= 0.")
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _init_slots(self, v):
+        return {"moment1": jnp.zeros_like(v), "moment2": jnp.zeros_like(v),
+                "momentum_decay_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def _apply(self, p, g, slots, lr, step):
+        b1, b2, psi = self._beta1, self._beta2, self._psi
+        mdp = slots["momentum_decay_pow"] * 0.96
+        b2p = slots["beta2_pow"] * b2
+        mu_t = b1 * (1 - 0.5 * jnp.power(mdp, psi))
+        mu_t1 = b1 * (1 - 0.5 * jnp.power(mdp, psi) * (0.96 ** psi))
+        mu_prod = slots["mu_product"] * mu_t
+        mu_prod_t1 = mu_prod * mu_t1
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        m_hat = mu_t1 * m / (1 - mu_prod_t1) + (1 - mu_t) * g / (1 - mu_prod)
+        v_hat = v / (1 - b2p)
+        new_p = p - lr.astype(p.dtype) * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return new_p, {"moment1": m, "moment2": v, "momentum_decay_pow": mdp,
+                       "beta2_pow": b2p, "mu_product": mu_prod}

@@ -1,0 +1,302 @@
+"""L-BFGS optimizer (closure-based full-batch quasi-Newton).
+
+Reference: python/paddle/optimizer/lbfgs.py — limited-memory BFGS with two-loop
+recursion over (s, y) history and optional strong-Wolfe cubic line search;
+`step(closure)` re-evaluates the loss/gradients as the line search probes points.
+Host-side driver logic (the search is inherently sequential); the closure itself
+runs whatever jitted compute the model uses.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import enable_grad, no_grad
+from .optimizer import Optimizer
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    if bounds is not None:
+        xmin_bound, xmax_bound = bounds
+    else:
+        xmin_bound, xmax_bound = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_square = d1**2 - g1 * g2
+    if d2_square >= 0:
+        d2 = d2_square**0.5
+        if x1 <= x2:
+            min_pos = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            min_pos = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return min(max(min_pos, xmin_bound), xmax_bound)
+    return (xmin_bound + xmax_bound) / 2.0
+
+
+def _strong_wolfe(obj_func, x, t, d, f, g, gtd, c1=1e-4, c2=0.9,
+                  tolerance_change=1e-9, max_ls=25):
+    d_norm = float(np.abs(d).max())
+    g = g.copy()
+    f_new, g_new = obj_func(x, t, d)
+    ls_func_evals = 1
+    gtd_new = float(np.dot(g_new, d))
+
+    t_prev, f_prev, g_prev, gtd_prev = 0.0, f, g, gtd
+    done = False
+    ls_iter = 0
+    bracket = bracket_f = bracket_g = bracket_gtd = None
+    while ls_iter < max_ls:
+        if f_new > (f + c1 * t * gtd) or (ls_iter > 1 and f_new >= f_prev):
+            bracket = [t_prev, t]
+            bracket_f = [f_prev, f_new]
+            bracket_g = [g_prev, g_new.copy()]
+            bracket_gtd = [gtd_prev, gtd_new]
+            break
+        if abs(gtd_new) <= -c2 * gtd:
+            bracket = [t, t]
+            bracket_f = [f_new, f_new]
+            bracket_g = [g_new, g_new]
+            bracket_gtd = [gtd_new, gtd_new]
+            done = True
+            break
+        if gtd_new >= 0:
+            bracket = [t_prev, t]
+            bracket_f = [f_prev, f_new]
+            bracket_g = [g_prev, g_new.copy()]
+            bracket_gtd = [gtd_prev, gtd_new]
+            break
+        min_step = t + 0.01 * (t - t_prev)
+        max_step = t * 10
+        tmp = t
+        t = _cubic_interpolate(t_prev, f_prev, gtd_prev, t, f_new, gtd_new,
+                               bounds=(min_step, max_step))
+        t_prev, f_prev, g_prev, gtd_prev = tmp, f_new, g_new.copy(), gtd_new
+        f_new, g_new = obj_func(x, t, d)
+        ls_func_evals += 1
+        gtd_new = float(np.dot(g_new, d))
+        ls_iter += 1
+
+    if ls_iter == max_ls:
+        bracket = [0.0, t]
+        bracket_f = [f, f_new]
+        bracket_g = [g, g_new]
+        bracket_gtd = [gtd, gtd_new]
+
+    # zoom phase
+    insuf_progress = False
+    low_pos, high_pos = (0, 1) if bracket_f[0] <= bracket_f[-1] else (1, 0)
+    while not done and ls_iter < max_ls:
+        if abs(bracket[1] - bracket[0]) * d_norm < tolerance_change:
+            break
+        t = _cubic_interpolate(bracket[0], bracket_f[0], bracket_gtd[0],
+                               bracket[1], bracket_f[1], bracket_gtd[1])
+        eps = 0.1 * (max(bracket) - min(bracket))
+        if min(max(bracket) - t, t - min(bracket)) < eps:
+            if insuf_progress or t >= max(bracket) or t <= min(bracket):
+                if abs(t - max(bracket)) < abs(t - min(bracket)):
+                    t = max(bracket) - eps
+                else:
+                    t = min(bracket) + eps
+                insuf_progress = False
+            else:
+                insuf_progress = True
+        else:
+            insuf_progress = False
+        f_new, g_new = obj_func(x, t, d)
+        ls_func_evals += 1
+        gtd_new = float(np.dot(g_new, d))
+        ls_iter += 1
+        if f_new > (f + c1 * t * gtd) or f_new >= bracket_f[low_pos]:
+            bracket[high_pos] = t
+            bracket_f[high_pos] = f_new
+            bracket_g[high_pos] = g_new.copy()
+            bracket_gtd[high_pos] = gtd_new
+            low_pos, high_pos = (0, 1) if bracket_f[0] <= bracket_f[1] else (1, 0)
+        else:
+            if abs(gtd_new) <= -c2 * gtd:
+                done = True
+            elif gtd_new * (bracket[high_pos] - bracket[low_pos]) >= 0:
+                bracket[high_pos] = bracket[low_pos]
+                bracket_f[high_pos] = bracket_f[low_pos]
+                bracket_g[high_pos] = bracket_g[low_pos]
+                bracket_gtd[high_pos] = bracket_gtd[low_pos]
+            bracket[low_pos] = t
+            bracket_f[low_pos] = f_new
+            bracket_g[low_pos] = g_new.copy()
+            bracket_gtd[low_pos] = gtd_new
+
+    t = bracket[low_pos]
+    f_new = bracket_f[low_pos]
+    g_new = bracket_g[low_pos]
+    return f_new, g_new, t, ls_func_evals
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if max_eval is None:
+            max_eval = max_iter * 5 // 4
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=False, name=name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._state = {"func_evals": 0, "n_iter": 0}
+
+    # flat host-side views ----------------------------------------------------
+    def _params(self):
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _gather_flat_grad(self):
+        views = []
+        for p in self._params():
+            g = p.grad
+            views.append(np.zeros(int(np.prod(p.shape)), np.float64)
+                         if g is None else
+                         np.asarray(g._value, np.float64).ravel())
+        return np.concatenate(views) if views else np.zeros(0)
+
+    def _flat_params(self):
+        return np.concatenate(
+            [np.asarray(p._value, np.float64).ravel() for p in self._params()])
+
+    def _set_flat_params(self, flat):
+        offset = 0
+        for p in self._params():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            chunk = flat[offset:offset + n].reshape(p.shape)
+            p._value = jnp.asarray(chunk, p._value.dtype)
+            offset += n
+
+    def _directional_evaluate(self, closure, x, t, d):
+        self._set_flat_params(x + t * d)
+        loss = float(closure())
+        flat_grad = self._gather_flat_grad()
+        self._set_flat_params(x)
+        return loss, flat_grad
+
+    @no_grad()
+    def step(self, closure):
+        state = self._state
+
+        def with_grad_closure():
+            with enable_grad():
+                return closure()
+
+        orig_loss = with_grad_closure()
+        loss = float(orig_loss)
+        current_evals = 1
+        state["func_evals"] += 1
+
+        flat_grad = self._gather_flat_grad()
+        if float(np.abs(flat_grad).max() if flat_grad.size else 0.0) \
+                <= self.tolerance_grad:
+            return orig_loss
+
+        d = state.get("d")
+        t = state.get("t")
+        old_sk = state.get("old_sk", [])
+        old_yk = state.get("old_yk", [])
+        ro = state.get("ro", [])
+        H_diag = state.get("H_diag")
+        prev_flat_grad = state.get("prev_flat_grad")
+        prev_loss = state.get("prev_loss")
+
+        n_iter = 0
+        lr = self.get_lr()
+        while n_iter < self.max_iter:
+            n_iter += 1
+            state["n_iter"] += 1
+            if state["n_iter"] == 1:
+                d = -flat_grad
+                old_sk, old_yk, ro = [], [], []
+                H_diag = 1.0
+            else:
+                y = flat_grad - prev_flat_grad
+                s = d * t
+                ys = float(np.dot(y, s))
+                if ys > 1e-10:
+                    if len(old_yk) == self.history_size:
+                        old_yk.pop(0)
+                        old_sk.pop(0)
+                        ro.pop(0)
+                    old_yk.append(y)
+                    old_sk.append(s)
+                    ro.append(1.0 / ys)
+                    H_diag = ys / float(np.dot(y, y))
+                num_old = len(old_yk)
+                al = [0.0] * num_old
+                q = -flat_grad
+                for i in range(num_old - 1, -1, -1):
+                    al[i] = float(np.dot(old_sk[i], q)) * ro[i]
+                    q = q - al[i] * old_yk[i]
+                d = q * H_diag
+                for i in range(num_old):
+                    be_i = float(np.dot(old_yk[i], d)) * ro[i]
+                    d = d + old_sk[i] * (al[i] - be_i)
+
+            if prev_flat_grad is None:
+                prev_flat_grad = flat_grad.copy()
+            else:
+                prev_flat_grad = flat_grad.copy()
+            prev_loss = loss
+
+            # learning-rate selection
+            if state["n_iter"] == 1:
+                t = min(1.0, 1.0 / float(np.abs(flat_grad).sum())) * lr
+            else:
+                t = lr
+
+            gtd = float(np.dot(flat_grad, d))
+            if gtd > -self.tolerance_change:
+                break
+
+            ls_func_evals = 0
+            if self.line_search_fn is not None:
+                if self.line_search_fn != "strong_wolfe":
+                    raise RuntimeError(
+                        "only 'strong_wolfe' is supported for line_search_fn")
+                x_init = self._flat_params()
+
+                def obj_func(x, t, d):
+                    return self._directional_evaluate(
+                        with_grad_closure, x, t, d)
+
+                loss, flat_grad, t, ls_func_evals = _strong_wolfe(
+                    obj_func, x_init, t, d, loss, flat_grad, gtd,
+                    tolerance_change=self.tolerance_change)
+                self._set_flat_params(x_init + t * d)
+            else:
+                self._set_flat_params(self._flat_params() + t * d)
+                if n_iter != self.max_iter:
+                    loss = float(with_grad_closure())
+                    flat_grad = self._gather_flat_grad()
+                    ls_func_evals = 1
+
+            current_evals += ls_func_evals
+            state["func_evals"] += ls_func_evals
+            if n_iter == self.max_iter or current_evals >= self.max_eval:
+                break
+            if float(np.abs(flat_grad).max() if flat_grad.size else 0.0) \
+                    <= self.tolerance_grad:
+                break
+            if float(np.abs(d * t).max()) <= self.tolerance_change:
+                break
+            if abs(loss - prev_loss) < self.tolerance_change:
+                break
+
+        state.update({"d": d, "t": t, "old_sk": old_sk, "old_yk": old_yk,
+                      "ro": ro, "H_diag": H_diag,
+                      "prev_flat_grad": prev_flat_grad, "prev_loss": prev_loss})
+        return orig_loss
+
+    def state_dict(self):
+        return {"state": dict(self._state)}
+
+    def set_state_dict(self, state):
+        if "state" in state:
+            self._state.update(state["state"])

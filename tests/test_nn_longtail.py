@@ -1,0 +1,450 @@
+"""nn/nn.functional long tail: unpool, fractional pool, grid_sample,
+adaptive softmax, hsigmoid, rnnt, margin losses, beam search decode.
+
+torch (CPU) is the numeric ground truth where the op follows a published
+formulation shared by the reference's phi kernels.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+
+def t2n(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+# -- pooling ------------------------------------------------------------------
+
+def test_max_unpool2d_matches_torch(rng):
+    x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    pooled, idx = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)
+    tp, ti = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, 2, return_indices=True)
+    np.testing.assert_allclose(t2n(pooled), tp.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(t2n(idx), ti.numpy())
+    un = F.max_unpool2d(pooled, idx, 2, 2)
+    tun = torch.nn.functional.max_unpool2d(tp, ti, 2, 2)
+    np.testing.assert_allclose(t2n(un), tun.numpy(), rtol=1e-6)
+
+
+def test_max_unpool_layer_and_output_size(rng):
+    x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    pooled, idx = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)
+    out = nn.MaxUnPool2D(2, 2, output_size=[1, 2, 6, 6])(pooled, idx)
+    assert out.shape == [1, 2, 6, 6]
+
+
+def test_lp_pool1d_is_p_norm_pool(rng):
+    x = rng.standard_normal((2, 3, 10)).astype(np.float32)
+    ours = t2n(F.lp_pool1d(paddle.to_tensor(x), 2.0, 2, 2))
+    ref = torch.nn.functional.lp_pool1d(torch.tensor(x), 2.0, 2, 2).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+
+def test_fractional_max_pool2d_windows(rng):
+    x = rng.standard_normal((1, 1, 9, 9)).astype(np.float32)
+    out, mask = F.fractional_max_pool2d(paddle.to_tensor(x), 4, random_u=0.3,
+                                        return_mask=True)
+    assert t2n(out).shape == (1, 1, 4, 4)
+    # every output must be the max of SOME contiguous window, and the mask
+    # must point at exactly that element
+    ov, mv = t2n(out), t2n(mask)
+    flat = x[0, 0].ravel()
+    np.testing.assert_allclose(ov[0, 0].ravel(), flat[mv[0, 0].ravel()])
+
+
+def test_fractional_max_pool3d_shape(rng):
+    x = rng.standard_normal((1, 2, 8, 8, 8)).astype(np.float32)
+    out = F.fractional_max_pool3d(paddle.to_tensor(x), 3, random_u=0.7)
+    assert t2n(out).shape == (1, 2, 3, 3, 3)
+
+
+# -- vision ops ---------------------------------------------------------------
+
+@pytest.mark.parametrize("align", [True, False])
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+def test_grid_sample_matches_torch(rng, align, mode, pad):
+    x = rng.standard_normal((2, 3, 5, 7)).astype(np.float32)
+    grid = (rng.random((2, 4, 6, 2)).astype(np.float32) * 2.4 - 1.2)
+    ours = t2n(F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                             mode=mode, padding_mode=pad, align_corners=align))
+    ref = torch.nn.functional.grid_sample(
+        torch.tensor(x), torch.tensor(grid), mode=mode, padding_mode=pad,
+        align_corners=align).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_grid_sample_3d_matches_torch(rng):
+    x = rng.standard_normal((1, 2, 4, 5, 6)).astype(np.float32)
+    grid = (rng.random((1, 3, 4, 5, 3)).astype(np.float32) * 2 - 1)
+    ours = t2n(F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                             align_corners=True))
+    ref = torch.nn.functional.grid_sample(
+        torch.tensor(x), torch.tensor(grid), align_corners=True).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("align", [True, False])
+def test_affine_grid_matches_torch(rng, align):
+    theta = rng.standard_normal((2, 2, 3)).astype(np.float32)
+    ours = t2n(F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5],
+                             align_corners=align))
+    ref = torch.nn.functional.affine_grid(
+        torch.tensor(theta), [2, 3, 4, 5], align_corners=align).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_grid_sample_gradient_flows(rng):
+    x = paddle.to_tensor(rng.standard_normal((1, 1, 4, 4)).astype(np.float32),
+                         stop_gradient=False)
+    g = paddle.to_tensor((rng.random((1, 2, 2, 2)).astype(np.float32) - 0.5),
+                         stop_gradient=False)
+    out = F.grid_sample(x, g)
+    out.sum().backward()
+    assert x.grad is not None and np.isfinite(t2n(x.grad)).all()
+    assert g.grad is not None and np.isfinite(t2n(g.grad)).all()
+
+
+# -- extension ops ------------------------------------------------------------
+
+def test_sequence_mask():
+    m = F.sequence_mask(paddle.to_tensor(np.array([1, 3, 2])), maxlen=4)
+    np.testing.assert_array_equal(
+        t2n(m), [[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+
+
+def test_temporal_shift_semantics():
+    # N=1, T=2 segments, C=4, 1x1 spatial; shift_ratio=0.25 → 1 fwd, 1 bwd chan
+    x = np.arange(8, dtype=np.float32).reshape(2, 4, 1, 1)
+    out = t2n(F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25))
+    # channel 0: shifted from t-1 (t0 gets 0, t1 gets t0's value)
+    assert out[0, 0, 0, 0] == 0.0 and out[1, 0, 0, 0] == x[0, 0, 0, 0]
+    # channel 1: shifted from t+1
+    assert out[0, 1, 0, 0] == x[1, 1, 0, 0] and out[1, 1, 0, 0] == 0.0
+    # channels 2-3 unchanged
+    np.testing.assert_array_equal(out[:, 2:], x[:, 2:])
+
+
+def test_gather_tree_reference_example():
+    ids = paddle.to_tensor(np.array(
+        [[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]], np.int64))
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]], np.int64))
+    out = t2n(F.gather_tree(ids, parents))
+    np.testing.assert_array_equal(
+        out, [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]])
+
+
+def test_class_center_sample():
+    label = paddle.to_tensor(np.array([0, 5, 5, 9], np.int64))
+    remapped, sampled = F.class_center_sample(label, 20, 6)
+    sv, rv = t2n(sampled), t2n(remapped)
+    assert len(sv) == 6 and set([0, 5, 9]) <= set(sv.tolist())
+    # remapped labels index into sampled
+    np.testing.assert_array_equal(sv[rv], [0, 5, 5, 9])
+
+
+def test_sparse_attention_matches_dense(rng):
+    B, H, S, D = 1, 2, 4, 8
+    q = rng.standard_normal((B, H, S, D)).astype(np.float32)
+    k = rng.standard_normal((B, H, S, D)).astype(np.float32)
+    v = rng.standard_normal((B, H, S, D)).astype(np.float32)
+    # full (dense) CSR pattern → must equal plain softmax attention
+    offs = np.tile(np.arange(0, S * S + 1, S, dtype=np.int32), (B, H, 1))
+    cols = np.tile(np.tile(np.arange(S, dtype=np.int32), S), (B, H, 1))
+    out = t2n(F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                 paddle.to_tensor(v), paddle.to_tensor(offs),
+                                 paddle.to_tensor(cols)))
+    qt, kt, vt = map(torch.tensor, (q, k, v))
+    ref = torch.nn.functional.scaled_dot_product_attention(qt, kt, vt).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+# -- losses -------------------------------------------------------------------
+
+def test_multi_margin_loss_matches_torch(rng):
+    x = rng.standard_normal((5, 7)).astype(np.float32)
+    y = rng.integers(0, 7, 5)
+    w = rng.random(7).astype(np.float32)
+    for p in (1, 2):
+        ours = t2n(F.multi_margin_loss(paddle.to_tensor(x),
+                                       paddle.to_tensor(y), p=p, margin=0.8,
+                                       weight=paddle.to_tensor(w)))
+        ref = torch.nn.functional.multi_margin_loss(
+            torch.tensor(x), torch.tensor(y), p=p, margin=0.8,
+            weight=torch.tensor(w)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+
+def test_dice_loss_formula(rng):
+    probs = rng.random((3, 4, 5)).astype(np.float32)
+    lbl = rng.integers(0, 5, (3, 4, 1))
+    ours = float(t2n(F.dice_loss(paddle.to_tensor(probs),
+                                 paddle.to_tensor(lbl))))
+    oh = np.eye(5, dtype=np.float32)[lbl[..., 0]]
+    inse = (probs * oh).sum(axis=(1, 2))
+    denom = probs.sum(axis=(1, 2)) + oh.sum(axis=(1, 2))
+    exp = float(np.mean(1 - 2 * inse / (denom + 1e-5)))
+    assert abs(ours - exp) < 1e-6
+
+
+def test_npair_loss_runs(rng):
+    a = rng.random((6, 4)).astype(np.float32)
+    p = rng.random((6, 4)).astype(np.float32)
+    lab = rng.integers(0, 3, 6).astype(np.float32)
+    out = float(t2n(F.npair_loss(paddle.to_tensor(a), paddle.to_tensor(p),
+                                 paddle.to_tensor(lab))))
+    assert np.isfinite(out) and out > 0
+
+
+def test_hsigmoid_loss_matches_bitcode_reference(rng):
+    # brute-force SimpleCode reimplementation (matrix_bit_code.h)
+    N, feat, C = 4, 6, 7
+    x = rng.standard_normal((N, feat)).astype(np.float32)
+    y = rng.integers(0, C, N)
+    w = rng.standard_normal((C - 1, feat)).astype(np.float32)
+    b = rng.standard_normal((C - 1, 1)).astype(np.float32)
+    ours = t2n(F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(y), C,
+                               paddle.to_tensor(w), paddle.to_tensor(b)))
+    exp = np.zeros((N, 1), np.float32)
+    for i in range(N):
+        c = int(y[i]) + C
+        length = c.bit_length() - 1
+        for bit in range(length):
+            idx = (c >> (bit + 1)) - 1
+            tgt = (c >> bit) & 1
+            z = float(w[idx] @ x[i] + b[idx, 0])
+            exp[i, 0] += np.log1p(np.exp(z)) - tgt * z
+    np.testing.assert_allclose(ours, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_margin_cross_entropy_arcface(rng):
+    N, C = 4, 6
+    logits = np.clip(rng.standard_normal((N, C)), -0.99, 0.99).astype(np.float32)
+    y = rng.integers(0, C, N)
+    loss, sm = F.margin_cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(y), margin1=1.0, margin2=0.5,
+        margin3=0.0, scale=64.0, return_softmax=True, reduction=None)
+    # manual
+    mod = logits.copy().astype(np.float64)
+    for i in range(N):
+        th = np.arccos(np.clip(logits[i, y[i]], -1, 1))
+        mod[i, y[i]] = np.cos(th + 0.5)
+    mod *= 64.0
+    p = np.exp(mod - mod.max(axis=1, keepdims=True))
+    p /= p.sum(axis=1, keepdims=True)
+    exp = -np.log(p[np.arange(N), y])[:, None]
+    np.testing.assert_allclose(t2n(loss), exp, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(t2n(sm), p, rtol=1e-4, atol=1e-5)
+
+
+def test_adaptive_log_softmax_matches_torch(rng):
+    N, in_f, C = 6, 8, 12
+    cutoffs = [4, 8]
+    x = rng.standard_normal((N, in_f)).astype(np.float32)
+    y = rng.integers(0, C, N)
+    layer = nn.AdaptiveLogSoftmaxWithLoss(in_f, C, cutoffs, div_value=2.0,
+                                          head_bias=True)
+    tl = torch.nn.AdaptiveLogSoftmaxWithLoss(in_f, C, cutoffs, div_value=2.0,
+                                             head_bias=True)
+    # copy our params into torch (torch Linear stores [out, in])
+    with torch.no_grad():
+        tl.head.weight.copy_(torch.tensor(t2n(layer.head_weight).T))
+        tl.head.bias.copy_(torch.tensor(t2n(layer.head_bias)))
+        for i, (proj, cls_w) in enumerate(layer.tail_weights):
+            tl.tail[i][0].weight.copy_(torch.tensor(t2n(proj).T))
+            tl.tail[i][1].weight.copy_(torch.tensor(t2n(cls_w).T))
+    out, loss = layer(paddle.to_tensor(x), paddle.to_tensor(y))
+    with torch.no_grad():
+        tout = tl(torch.tensor(x), torch.tensor(y))
+    np.testing.assert_allclose(t2n(out), tout.output.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(float(t2n(loss)), float(tout.loss), rtol=1e-4)
+    # full log-prob path
+    np.testing.assert_allclose(t2n(layer.log_prob(paddle.to_tensor(x))),
+                               tl.log_prob(torch.tensor(x)).detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def _brute_force_rnnt(logp, labels, blank):
+    # enumerate all monotonic alignments by DP in plain python (ground truth)
+    T, U, V = logp.shape
+    import functools
+
+    @functools.lru_cache(None)
+    def alpha(t, u):
+        if t == 0 and u == 0:
+            return 0.0
+        terms = []
+        if t > 0:
+            terms.append(alpha(t - 1, u) + logp[t - 1, u, blank])
+        if u > 0:
+            terms.append(alpha(t, u - 1) + logp[t, u - 1, labels[u - 1]])
+        m = max(terms)
+        return m + np.log(sum(np.exp(x - m) for x in terms))
+
+    return -(alpha(T - 1, U - 1) + logp[T - 1, U - 1, blank])
+
+
+def test_rnnt_loss_matches_bruteforce(rng):
+    B, T, U, V = 2, 4, 3, 5
+    logits = rng.standard_normal((B, T, U, V)).astype(np.float32)
+    labels = rng.integers(1, V, (B, U - 1))
+    in_len = np.array([T, T - 1])
+    lbl_len = np.array([U - 1, U - 2])
+    ours = t2n(F.rnnt_loss(paddle.to_tensor(logits),
+                           paddle.to_tensor(labels.astype(np.int32)),
+                           paddle.to_tensor(in_len.astype(np.int32)),
+                           paddle.to_tensor(lbl_len.astype(np.int32)),
+                           blank=0, reduction="none"))
+    logp = np.asarray(jnp.log(jnp.asarray(
+        np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))))
+    for b in range(B):
+        Tb, Ub = in_len[b], lbl_len[b] + 1
+        exp = _brute_force_rnnt(logp[b, :Tb, :Ub], labels[b], 0)
+        assert abs(float(ours[b]) - exp) < 1e-4
+
+
+def test_rnnt_loss_layer_gradient(rng):
+    logits = paddle.to_tensor(
+        rng.standard_normal((1, 3, 3, 4)).astype(np.float32),
+        stop_gradient=False)
+    loss = nn.RNNTLoss()(logits, paddle.to_tensor(np.array([[1, 2]], np.int32)),
+                         paddle.to_tensor(np.array([3], np.int32)),
+                         paddle.to_tensor(np.array([2], np.int32)))
+    loss.backward()
+    assert np.isfinite(t2n(logits.grad)).all()
+
+
+# -- in-place activations -----------------------------------------------------
+
+def test_inplace_activations(rng):
+    x = paddle.to_tensor(rng.standard_normal(5).astype(np.float32))
+    before = t2n(x).copy()
+    r = F.relu_(x)
+    assert r is x
+    np.testing.assert_allclose(t2n(x), np.maximum(before, 0))
+    y = paddle.to_tensor(np.array([-2.0, 0.5, 3.0], np.float32))
+    F.hardtanh_(y)
+    np.testing.assert_allclose(t2n(y), [-1.0, 0.5, 1.0])
+
+
+# -- beam search --------------------------------------------------------------
+
+def test_beam_search_decoder_greedy_consistency(rng):
+    # beam_size=1 must reproduce the greedy argmax rollout
+    vocab, hidden, batch = 7, 8, 2
+    cell = nn.GRUCell(hidden, hidden)
+    emb_w = paddle.to_tensor(rng.standard_normal((vocab, hidden))
+                             .astype(np.float32))
+    out_w = paddle.to_tensor(rng.standard_normal((hidden, vocab))
+                             .astype(np.float32))
+
+    def embedding_fn(ids):
+        return paddle.to_tensor(jnp.take(emb_w._value, ids._value, axis=0))
+
+    def output_fn(h):
+        return h @ paddle.to_tensor(out_w._value)
+
+    decoder = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=1, embedding_fn=embedding_fn,
+                                   output_fn=output_fn)
+    h0 = paddle.to_tensor(rng.standard_normal((batch, hidden))
+                          .astype(np.float32))
+    outs, final_states = nn.dynamic_decode(decoder, inits=h0, max_step_num=5)
+    ids = t2n(outs.predicted_ids)  # (batch, T, beam)
+    # greedy rollout
+    h = np.asarray(h0._value)
+    tok = np.zeros((batch,), np.int64)
+    for step in range(ids.shape[1]):
+        inp = paddle.to_tensor(np.asarray(emb_w._value)[tok])
+        hout, hnew = cell(inp, paddle.to_tensor(h))
+        logits = t2n(hout @ paddle.to_tensor(out_w._value))
+        nxt = logits.argmax(-1)
+        # finished sequences emit end_token forever
+        done = tok == 1
+        nxt = np.where(done, 1, nxt)
+        np.testing.assert_array_equal(ids[:, step, 0], nxt)
+        h = np.where(done[:, None], h, t2n(hnew))
+        tok = nxt
+
+
+def test_beam_search_beam2_scores_sorted(rng):
+    vocab, hidden = 5, 6
+    cell = nn.GRUCell(hidden, hidden)
+    emb_w = paddle.to_tensor(rng.standard_normal((vocab, hidden))
+                             .astype(np.float32))
+    out_w = paddle.to_tensor(rng.standard_normal((hidden, vocab))
+                             .astype(np.float32))
+    decoder = nn.BeamSearchDecoder(
+        cell, start_token=0, end_token=1, beam_size=2,
+        embedding_fn=lambda ids: paddle.to_tensor(
+            jnp.take(emb_w._value, ids._value, axis=0)),
+        output_fn=lambda h: h @ paddle.to_tensor(out_w._value))
+    h0 = paddle.to_tensor(rng.standard_normal((1, hidden)).astype(np.float32))
+    outs, _, lengths = nn.dynamic_decode(decoder, inits=h0, max_step_num=4,
+                                         return_length=True)
+    scores = t2n(outs.scores)  # (batch, T, beam)
+    assert (scores[:, -1, 0] >= scores[:, -1, 1]).all()
+    assert t2n(lengths).max() <= 5
+
+
+def test_flash_attn_qkvpacked_matches_flash_attention(rng):
+    # MHA packing: [B, S, 3, H, D] with q in slot 0, k/v in the LAST two
+    B, S, H, D = 2, 6, 2, 8
+    q = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    qkv = np.stack([q, k, v], axis=2)
+    packed, _ = F.flash_attn_qkvpacked(paddle.to_tensor(qkv))
+    plain, _ = F.flash_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                 paddle.to_tensor(v))
+    np.testing.assert_allclose(t2n(packed), t2n(plain), rtol=1e-5, atol=1e-6)
+
+
+def test_flash_attn_qkvpacked_gqa_head_mapping(rng):
+    # GQA: G=2 groups, Hk=2 kv heads → 4 q heads; flattened q head j attends
+    # kv head j // G (FA semantics)
+    B, S, G, Hk, D = 1, 5, 2, 2, 4
+    qkv = rng.standard_normal((B, S, G + 2, Hk, D)).astype(np.float32)
+    out, _ = F.flash_attn_qkvpacked(paddle.to_tensor(qkv))
+    q = qkv[:, :, :G].reshape(B, S, G * Hk, D)
+    k, v = qkv[:, :, -2], qkv[:, :, -1]
+    for j in range(G * Hk):
+        kv = j // G
+        ref = torch.nn.functional.scaled_dot_product_attention(
+            torch.tensor(q[:, :, j]).unsqueeze(1),
+            torch.tensor(k[:, :, kv]).unsqueeze(1),
+            torch.tensor(v[:, :, kv]).unsqueeze(1)).squeeze(1).numpy()
+        np.testing.assert_allclose(t2n(out)[:, :, j], ref, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_rnnt_loss_empty_transcript(rng):
+    # U=1 (label_lengths=0): loss = -sum of blank log-probs along t
+    logits = rng.standard_normal((1, 3, 1, 4)).astype(np.float32)
+    loss = F.rnnt_loss(paddle.to_tensor(logits),
+                       paddle.to_tensor(np.zeros((1, 0), np.int32)),
+                       paddle.to_tensor(np.array([3], np.int32)),
+                       paddle.to_tensor(np.array([0], np.int32)),
+                       blank=0, reduction="none")
+    logp = np.log(np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))
+    exp = -logp[0, :, 0, 0].sum()
+    assert abs(float(t2n(loss)[0]) - exp) < 1e-4
+
+
+def test_lp_pool1d_nlc_data_format(rng):
+    x = rng.standard_normal((1, 6, 3)).astype(np.float32)  # N, L, C
+    out = F.lp_pool1d(paddle.to_tensor(x), 2.0, 2, 2, data_format="NLC")
+    assert t2n(out).shape == (1, 3, 3)
+    ref = torch.nn.functional.lp_pool1d(
+        torch.tensor(x.transpose(0, 2, 1)), 2.0, 2, 2).numpy()
+    np.testing.assert_allclose(t2n(out), ref.transpose(0, 2, 1), rtol=1e-5)

@@ -1,0 +1,138 @@
+"""Long-tail optimizers: ASGD / Rprop / RAdam / NAdam (torch parity) + LBFGS.
+
+torch.optim implements the same published algorithms the reference's phi kernels
+do (paddle's lbfgs.py/nadam/radam are ports of the torch formulations), so the
+CPU torch trajectories are the ground truth where hyperparameter semantics
+coincide.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+torch = pytest.importorskip("torch")
+
+
+def _run_paddle(opt_cls, kwargs, w0, grads, **extra):
+    p = paddle.create_parameter(w0.shape, "float32",
+                                default_initializer=None)
+    p._value = jnp.asarray(w0)
+    opt = opt_cls(parameters=[p], **kwargs, **extra)
+    for g in grads:
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+    return np.asarray(p._value)
+
+
+def _run_torch(opt_cls, kwargs, w0, grads):
+    p = torch.nn.Parameter(torch.tensor(w0))
+    opt = opt_cls([p], **kwargs)
+    for g in grads:
+        p.grad = torch.tensor(g)
+        opt.step()
+    return p.detach().numpy()
+
+
+@pytest.fixture
+def traj(rng):
+    w0 = rng.standard_normal((4, 3)).astype(np.float32)
+    grads = [rng.standard_normal((4, 3)).astype(np.float32) for _ in range(6)]
+    return w0, grads
+
+
+def test_radam_matches_torch(traj):
+    w0, grads = traj
+    ours = _run_paddle(paddle.optimizer.RAdam,
+                       dict(learning_rate=0.01, beta1=0.9, beta2=0.999,
+                            epsilon=1e-8), w0, grads)
+    ref = _run_torch(torch.optim.RAdam,
+                     dict(lr=0.01, betas=(0.9, 0.999), eps=1e-8), w0, grads)
+    np.testing.assert_allclose(ours, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_nadam_matches_torch(traj):
+    w0, grads = traj
+    ours = _run_paddle(paddle.optimizer.NAdam,
+                       dict(learning_rate=0.01, momentum_decay=0.004),
+                       w0, grads)
+    ref = _run_torch(torch.optim.NAdam,
+                     dict(lr=0.01, momentum_decay=0.004), w0, grads)
+    np.testing.assert_allclose(ours, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_rprop_matches_torch(traj):
+    w0, grads = traj
+    ours = _run_paddle(paddle.optimizer.Rprop,
+                       dict(learning_rate=0.01,
+                            learning_rate_range=(1e-6, 50), etas=(0.5, 1.2)),
+                       w0, grads)
+    ref = _run_torch(torch.optim.Rprop,
+                     dict(lr=0.01, etas=(0.5, 1.2), step_sizes=(1e-6, 50)),
+                     w0, grads)
+    np.testing.assert_allclose(ours, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_asgd_sag_semantics():
+    # paddle ASGD = stochastic average gradient over batch_num slots
+    # (asgd_kernel.cc: d = d - y_i + g; y_i = g; p -= lr * d / min(m+1, n))
+    w0 = np.zeros((2,), np.float32)
+    g1 = np.array([1.0, 2.0], np.float32)
+    g2 = np.array([3.0, -2.0], np.float32)
+    g3 = np.array([-1.0, 0.0], np.float32)
+    p = _run_paddle(paddle.optimizer.ASGD, dict(learning_rate=0.1, batch_num=2),
+                    w0, [g1, g2, g3])
+    # step1: d=g1, p=-0.1*g1/1 ; step2: d=g1+g2, p-=0.1*(g1+g2)/2
+    # step3 (i=0 again): d=g1+g2-g1+g3=g2+g3, p-=0.1*(g2+g3)/2
+    exp = -0.1 * g1 - 0.1 * (g1 + g2) / 2 - 0.1 * (g2 + g3) / 2
+    np.testing.assert_allclose(p, exp, rtol=1e-6)
+
+
+def test_asgd_averages_recent_gradients(traj):
+    w0, grads = traj
+    out = _run_paddle(paddle.optimizer.ASGD,
+                      dict(learning_rate=0.05, batch_num=3), w0, grads)
+    assert np.isfinite(out).all() and not np.allclose(out, w0)
+
+
+@pytest.mark.parametrize("line_search", [None, "strong_wolfe"])
+def test_lbfgs_quadratic_convergence(line_search):
+    # minimize ||Aw - b||^2 — LBFGS should reach the lstsq solution
+    rng = np.random.default_rng(3)
+    A = rng.standard_normal((8, 5)).astype(np.float32)
+    b = rng.standard_normal((8,)).astype(np.float32)
+    w = paddle.create_parameter([5], "float32")
+    w._value = jnp.zeros((5,), jnp.float32)
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=30,
+                                 line_search_fn=line_search, parameters=[w])
+    Aj, bj = jnp.asarray(A), jnp.asarray(b)
+
+    def closure():
+        opt.clear_grad()
+        r = paddle.to_tensor(Aj) @ w - paddle.to_tensor(bj)
+        loss = (r * r).sum()
+        loss.backward()
+        return loss
+
+    for _ in range(5):
+        opt.step(closure)
+    expected = np.linalg.lstsq(A, b, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(w._value), expected, atol=1e-4)
+
+
+def test_lbfgs_state_reuse_across_steps():
+    w = paddle.create_parameter([2], "float32")
+    w._value = jnp.asarray([3.0, -2.0])
+    opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=4,
+                                 parameters=[w])
+
+    def closure():
+        opt.clear_grad()
+        loss = (w * w).sum()
+        loss.backward()
+        return loss
+
+    l0 = float(closure())
+    for _ in range(6):
+        opt.step(closure)
+    assert float(closure()) < l0 * 1e-3
