@@ -326,6 +326,9 @@ _bind("tpu", lambda self, *a, **k: self)
 _bind("pin_memory", lambda self: self)
 
 from . import version  # noqa: E402,F401
+from . import callbacks  # noqa: E402,F401
+from . import hub  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
 
 # ---------------------------------------------------------------------------
 # top-level API long tail (constants, aliases, in-place wrappers) — closes the

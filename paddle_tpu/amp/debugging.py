@@ -174,3 +174,28 @@ def compare_accuracy(dump_path, another_dump_path, output_filename,
             d = np.abs(va - vb)
             w.writerow([k, float(d.max()), float(d.mean()), va.shape, vb.shape])
     return output_filename
+
+
+def check_layer_numerics(func):
+    """Decorator: audit a Layer.forward's inputs/outputs for nan/inf
+    (reference: amp/debugging.py check_layer_numerics — wraps forward with
+    per-tensor numeric checks)."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        for i, a in enumerate(args):
+            if hasattr(a, "_value"):
+                check_numerics(a, op_type=type(self).__name__,
+                               var_name=f"input_{i}")
+        out = func(self, *args, **kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for i, o in enumerate(outs):
+            if hasattr(o, "_value"):
+                check_numerics(o, op_type=type(self).__name__,
+                               var_name=f"output_{i}")
+        return out
+    return wrapper
+
+
+__all__.append("check_layer_numerics")

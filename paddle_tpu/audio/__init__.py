@@ -13,3 +13,7 @@ from .features import (  # noqa: F401
 
 __all__ = ["functional", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
            "MFCC"]
+
+from . import backends  # noqa: E402,F401
+from . import datasets  # noqa: E402,F401
+from .backends import load, info, save  # noqa: E402,F401

@@ -1,0 +1,34 @@
+"""paddle.audio.backends — wave-file IO backend registry.
+
+Reference: python/paddle/audio/backends/ (wave_backend.py + optional
+paddleaudio soundfile backend). The stdlib `wave` backend is always
+available; `set_backend` accepts only backends in list_available_backends().
+"""
+from .wave_backend import AudioInfo, info, load, save  # noqa: F401
+
+__all__ = ["get_current_backend", "list_available_backends", "set_backend"]
+
+_CURRENT = "wave_backend"
+
+
+def list_available_backends():
+    backends = ["wave_backend"]
+    try:
+        import soundfile  # noqa: F401
+        backends.append("soundfile")
+    except ImportError:
+        pass
+    return backends
+
+
+def get_current_backend():
+    return _CURRENT
+
+
+def set_backend(backend_name):
+    global _CURRENT
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name} is not available; choose from "
+            f"{list_available_backends()}")
+    _CURRENT = backend_name

@@ -1,0 +1,69 @@
+"""stdlib-wave audio IO (reference: audio/backends/wave_backend.py)."""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+class AudioInfo:
+    """reference: backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def _error_message():
+    return ("only PCM16 WAV supported by the wave backend; install a "
+            "soundfile-based backend for other formats")
+
+
+def info(filepath):
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8, "PCM_S")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (waveform Tensor, sample_rate). normalize=True -> float32 in
+    [-1, 1]; else int16 passthrough (reference wave_backend.load)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        if width != 2:
+            raise ValueError(_error_message())
+        f.setpos(frame_offset)
+        n = num_frames if num_frames >= 0 else f.getnframes() - frame_offset
+        raw = f.readframes(n)
+    data = np.frombuffer(raw, np.int16).reshape(-1, ch)
+    if normalize:
+        data = (data / 32768.0).astype(np.float32)
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    if bits_per_sample != 16:
+        raise ValueError(_error_message())
+    arr = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T
+    if arr.dtype != np.int16:
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(arr.tobytes())

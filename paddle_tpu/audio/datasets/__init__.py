@@ -1,0 +1,108 @@
+"""paddle.audio.datasets — ESC50 / TESS over local files.
+
+Reference: python/paddle/audio/datasets/{esc50,tess}.py — download-and-parse
+datasets feeding (feature, label) pairs. Zero-egress environment: these read
+an already-downloaded archive directory (pass data_dir); the feature modes
+('raw'/'mfcc'/'logmelspectrogram'/'melspectrogram'/'spectrogram') reuse
+paddle_tpu.audio.features.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...io import Dataset
+from ..backends.wave_backend import load
+
+__all__ = ["ESC50", "TESS"]
+
+
+class AudioClassificationDataset(Dataset):
+    """reference: datasets/dataset.py — files + labels, optional feature
+    extraction per __getitem__."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.feat_config = kwargs
+        self.sample_rate = sample_rate
+
+    def __len__(self):
+        return len(self.files)
+
+    def _convert_to_record(self, idx):
+        waveform, sr = load(self.files[idx])
+        import paddle_tpu as paddle
+        x = waveform
+        if x.ndim > 1:
+            x = x[0]
+        if self.feat_type == "raw":
+            feat = x
+        else:
+            from .. import features
+            name = {"mfcc": "MFCC", "logmelspectrogram": "LogMelSpectrogram",
+                    "melspectrogram": "MelSpectrogram",
+                    "spectrogram": "Spectrogram"}[self.feat_type]
+            extractor = getattr(features, name)(sr=sr, **self.feat_config)
+            feat = extractor(x.reshape([1, -1]))[0]
+        return feat, self.labels[idx]
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference: datasets/esc50.py). Expects
+    data_dir/<name>.wav files named fold-clipid-take-target.wav."""
+
+    def __init__(self, mode="train", split=1, feat_type="raw", data_dir=None,
+                 archive=None, **kwargs):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise RuntimeError(
+                "ESC50 needs data_dir pointing at the extracted audio "
+                "directory (no network access in this environment)")
+        files, labels = [], []
+        for fn in sorted(os.listdir(data_dir)):
+            if not fn.endswith(".wav"):
+                continue
+            parts = fn[:-4].split("-")
+            fold, target = int(parts[0]), int(parts[-1])
+            train_cond = fold != split if mode == "train" else fold == split
+            if train_cond:
+                files.append(os.path.join(data_dir, fn))
+                labels.append(target)
+        super().__init__(files, labels, feat_type, **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """TESS emotional speech (reference: datasets/tess.py). Expects
+    data_dir/<speaker>_<word>_<emotion>.wav."""
+
+    n_folds = 5
+    emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_dir=None, archive=None, **kwargs):
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise RuntimeError(
+                "TESS needs data_dir pointing at the extracted audio "
+                "directory (no network access in this environment)")
+        all_files = []
+        for root, _dirs, fns in os.walk(data_dir):
+            for fn in sorted(fns):
+                if fn.endswith(".wav"):
+                    all_files.append(os.path.join(root, fn))
+        files, labels = [], []
+        for i, f in enumerate(all_files):
+            emo = os.path.basename(f)[:-4].split("_")[-1].lower()
+            if emo not in self.emotions:
+                continue
+            fold = i % n_folds + 1
+            cond = fold != split if mode == "train" else fold == split
+            if cond:
+                files.append(f)
+                labels.append(self.emotions.index(emo))
+        super().__init__(files, labels, feat_type, **kwargs)

@@ -147,3 +147,28 @@ __all__ = [
     "backward", "grad", "PyLayer", "PyLayerContext", "no_grad", "enable_grad",
     "is_grad_enabled", "set_grad_enabled", "jacobian", "hessian",
 ]
+
+
+class saved_tensors_hooks:
+    """Context manager installing pack/unpack hooks on tensors saved for
+    backward (reference: autograd/saved_tensors_hooks.py — used for CPU
+    offload / compression of activations). Our tape saves tensors inside
+    jax.vjp residuals, which XLA already manages; the hooks fire for
+    PyLayer's explicit save_for_backward path."""
+
+    _active = None
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._active = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active = None
+        return False
+
+
+__all__.append("saved_tensors_hooks")

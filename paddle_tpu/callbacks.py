@@ -1,0 +1,11 @@
+"""paddle.callbacks — top-level re-export of the hapi training callbacks
+(reference: python/paddle/callbacks.py)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ReduceLROnPlateau, VisualDL, WandbCallback,
+)
+
+__all__ = [
+    'Callback', 'ProgBarLogger', 'ModelCheckpoint', 'VisualDL', 'LRScheduler',
+    'EarlyStopping', 'ReduceLROnPlateau', 'WandbCallback',
+]

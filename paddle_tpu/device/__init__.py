@@ -104,3 +104,27 @@ def set_stream(stream):
 def stream_guard(stream):
     import contextlib
     return contextlib.nullcontext()
+
+
+def get_cudnn_version():
+    """reference: device/__init__.py get_cudnn_version — None when not built
+    with CUDA (TPU build)."""
+    return None
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    """XLA plays CINN's role (SURVEY §2.8); the CINN-specific API reports
+    not-compiled like a standard wheel."""
+    return False
+
+
+def IPUPlace():
+    raise RuntimeError("Can not use IPUPlace since PaddlePaddle is not "
+                       "compiled with IPU")
+
+
+

@@ -41,6 +41,10 @@ from . import io  # noqa: F401
 from .store import TCPStore, create_or_get_global_tcp_store  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
+from .fleet.base_api import (  # noqa: F401
+    Fleet, UtilBase, Role, UserDefinedRoleMaker, PaddleCloudRoleMaker,
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
 from . import checkpoint  # noqa: F401
 from .auto_parallel_intermediate import (  # noqa: F401
     parallelize, ColWiseParallel, RowWiseParallel, SequenceParallelBegin,

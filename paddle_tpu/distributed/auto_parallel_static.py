@@ -280,9 +280,25 @@ class ShardDataloader:
         return item
 
     def __iter__(self):
-        mesh = self._meshes[0]
+        meshes = self._meshes
+        keys = self._input_keys
         for batch in self._loader:
-            yield self._wrap(batch, mesh)
+            if len(meshes) > 1:
+                # pipeline-style: element i (or input_keys[i]) -> meshes[i]
+                if keys is not None and isinstance(batch, dict):
+                    yield {k: self._wrap(batch[k], meshes[min(i,
+                                                              len(meshes) - 1)])
+                           for i, k in enumerate(keys)}
+                    continue
+                if isinstance(batch, (list, tuple)) and \
+                        len(batch) == len(meshes):
+                    yield type(batch)(self._wrap(x, m)
+                                      for x, m in zip(batch, meshes))
+                    continue
+                raise NotImplementedError(
+                    "multiple meshes need input_keys (dict batches) or a "
+                    "batch with one element per mesh")
+            yield self._wrap(batch, meshes[0])
 
 
 def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,

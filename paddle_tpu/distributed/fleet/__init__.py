@@ -159,3 +159,8 @@ from .context_parallel import (  # noqa: E402,F401
 )
 from .elastic import ElasticManager, ElasticStatus  # noqa: E402,F401
 from . import metrics  # noqa: E402,F401
+
+from .base_api import (  # noqa: E402,F401
+    Fleet, UtilBase, Role, UserDefinedRoleMaker, PaddleCloudRoleMaker,
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)

@@ -1,0 +1,207 @@
+"""Fleet base classes: Fleet facade, UtilBase, role makers, data generators.
+
+Reference: python/paddle/distributed/fleet/{fleet.py Fleet,
+base/util_factory.py UtilBase, base/role_maker.py Role/UserDefinedRoleMaker/
+PaddleCloudRoleMaker, data_generator/data_generator.py MultiSlot*}.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+class Role:
+    """reference: base/role_maker.py Role enum."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class UserDefinedRoleMaker:
+    """Explicit role assignment (reference: role_maker.py
+    UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        self._is_collective = is_collective
+        self._current_id = kwargs.get("current_id", 0)
+        self._role = kwargs.get("role", Role.WORKER)
+        self._worker_endpoints = kwargs.get("worker_endpoints", [])
+        self._server_endpoints = kwargs.get("server_endpoints", [])
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return max(1, len(self._worker_endpoints))
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+
+class PaddleCloudRoleMaker(UserDefinedRoleMaker):
+    """Role from PADDLE_* env (reference: role_maker.py
+    PaddleCloudRoleMaker — what fleet.init uses by default)."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        pservers = os.getenv("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        training_role = os.getenv("TRAINING_ROLE", "TRAINER")
+        super().__init__(
+            is_collective=is_collective,
+            current_id=int(os.getenv("PADDLE_TRAINER_ID", "0")),
+            role=Role.WORKER if training_role == "TRAINER" else Role.SERVER,
+            worker_endpoints=eps.split(",") if eps else [],
+            server_endpoints=pservers.split(",") if pservers else [])
+
+
+class UtilBase:
+    """Cross-worker utilities (reference: base/util_factory.py UtilBase —
+    all_reduce/all_gather of host values, filesystem helpers)."""
+
+    def __init__(self, role_maker=None):
+        self.role_maker = role_maker
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        from ..collective import all_reduce as _ar  # host path: world==1 noop
+        from ..env import get_world_size
+        if get_world_size() <= 1:
+            return input
+        from .metrics import sum as _msum, max as _mmax, min as _mmin
+        fn = {"sum": _msum, "max": _mmax, "min": _mmin}[mode]
+        return fn(input)
+
+    def all_gather(self, input, comm_world="worker"):
+        from ..collective import all_gather_object
+        out = []
+        all_gather_object(out, input)
+        return out
+
+    def barrier(self, comm_world="worker"):
+        from ..env import barrier
+        barrier()
+
+    def get_file_shard(self, files):
+        """Split a file list evenly across workers (reference:
+        util_factory.get_file_shard)."""
+        rm = self.role_maker
+        idx = rm.worker_index() if rm else 0
+        n = rm.worker_num() if rm else 1
+        per = len(files) // n
+        rem = len(files) % n
+        start = per * idx + min(idx, rem)
+        end = start + per + (1 if idx < rem else 0)
+        return files[start:end]
+
+    def print_on_rank(self, message, rank_id=0):
+        rm = self.role_maker
+        if (rm.worker_index() if rm else 0) == rank_id:
+            print(message)
+
+
+class DataGenerator:
+    """Line-to-slots training-data generator (reference:
+    data_generator/data_generator.py DataGenerator): subclass implements
+    generate_sample(line) -> iterator of (slot_name, values) lists;
+    run_from_stdin streams the pipe_command protocol used by the fleet
+    datasets."""
+
+    def __init__(self):
+        self.batch_size_ = 32
+        self._proto_info = None
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or generator")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            line_iter = self.generate_sample(line)
+            for user_parsed_line in line_iter():
+                if user_parsed_line is None:
+                    continue
+                sys.stdout.write(self._gen_str(user_parsed_line))
+
+    def run_from_memory(self, memory_data):
+        out = []
+        for line in memory_data:
+            for parsed in self.generate_sample(line)():
+                if parsed is not None:
+                    out.append(self._gen_str(parsed))
+        return out
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """slot:count:values text protocol (reference: MultiSlotDataGenerator
+    _gen_str — `count v1 v2 ...` per slot, tab-free space-joined)."""
+
+    def _gen_str(self, line):
+        output = ""
+        if self._proto_info is None:
+            self._proto_info = [name for name, _ in line]
+        for i, (name, elements) in enumerate(line):
+            if output:
+                output += " "
+            output += str(len(elements))
+            for e in elements:
+                output += " " + str(e)
+        return output + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        output = ""
+        for i, (name, elements) in enumerate(line):
+            if output:
+                output += " "
+            output += str(len(elements))
+            for e in elements:
+                output += " " + str(e)
+        return output + "\n"
+
+
+class Fleet:
+    """The Fleet facade class (reference: fleet/fleet.py Fleet — the module-
+    level fleet API is a singleton of this). Binds the module functions so
+    `Fleet().init(...)` and `fleet.init(...)` share state."""
+
+    def __init__(self):
+        from . import (init, distributed_model, distributed_optimizer,
+                       worker_index, worker_num, is_first_worker,
+                       barrier_worker)
+        self.init = init
+        self.distributed_model = distributed_model
+        self.distributed_optimizer = distributed_optimizer
+        self.worker_index = worker_index
+        self.worker_num = worker_num
+        self.is_first_worker = is_first_worker
+        self.barrier_worker = barrier_worker
+        self.util = UtilBase()

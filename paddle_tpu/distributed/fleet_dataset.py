@@ -86,6 +86,11 @@ class DatasetBase:
         out = []
         for s in range(n_slots):
             vals = [r[s] if s < len(r) else [] for r in rows]
+            if any(isinstance(t, str) for v in vals for t in v):
+                # string slots (e.g. id features) batch as ragged lists —
+                # the reference feeds these to string slots of the PS tables
+                out.append(vals)
+                continue
             w = max(len(v) for v in vals)
             arr = np.zeros((len(rows), w), np.float32)
             for i, v in enumerate(vals):
@@ -140,9 +145,10 @@ class InMemoryDataset(DatasetBase):
         random.shuffle(idx)
         for s in slots:
             s = int(s)
-            vals = [self._memory[i][s] for i in idx]
+            vals = [self._memory[i][s] if s < len(self._memory[i]) else None
+                    for i in idx]
             for row, v in zip(self._memory, vals):
-                if s < len(row):
+                if s < len(row) and v is not None:
                     row[s] = v
 
     def __iter__(self):

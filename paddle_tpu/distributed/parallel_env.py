@@ -94,7 +94,8 @@ _GLOO_RANKS = (0, 1)
 def gloo_barrier():
     if _GLOO_STORE is None:
         raise RuntimeError("call gloo_init_parallel_env first")
-    _GLOO_STORE.barrier(f"gloo_barrier_{_GLOO_RANKS[0]}")
+    # shared key: every rank increments the same counter
+    _GLOO_STORE.barrier("gloo_barrier", world_size=_GLOO_RANKS[1])
 
 
 def gloo_release():

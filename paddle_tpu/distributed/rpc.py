@@ -202,3 +202,9 @@ def shutdown():
     _g.store = None
     _g.owns_store = False
     _g.info_cache = None
+
+
+def get_current_worker_info():
+    """reference: distributed/rpc/__init__.py get_current_worker_info — the
+    calling process's own WorkerInfo (get_worker_info defaults to it)."""
+    return get_worker_info()

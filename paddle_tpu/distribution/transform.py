@@ -1,0 +1,15 @@
+"""paddle.distribution.transform — submodule namespace for the transform
+classes (reference: python/paddle/distribution/transform.py; the classes live
+in distribution/__init__.py here, same objects re-exported)."""
+from . import (  # noqa: F401
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+)
+
+__all__ = [
+    'Transform', 'AbsTransform', 'AffineTransform', 'ChainTransform',
+    'ExpTransform', 'IndependentTransform', 'PowerTransform',
+    'ReshapeTransform', 'SigmoidTransform', 'SoftmaxTransform',
+    'StackTransform', 'StickBreakingTransform', 'TanhTransform',
+]

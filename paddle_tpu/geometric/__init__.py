@@ -18,6 +18,7 @@ from ..ops.creation import to_tensor
 
 __all__ = [
     "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "reindex_heter_graph",
     "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
     "sample_neighbors", "weighted_sample_neighbors",
 ]
@@ -219,3 +220,33 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
             if out_eids else np.zeros(0, np.int64)
         return neighbors, count, to_tensor(e)
     return neighbors, count
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous-graph reindex (reference: reindex.py
+    reindex_heter_graph): one shared id mapping across all edge types;
+    returns per-type (reindex_src list, reindex_dst list, out_nodes)."""
+    xv = _ids_np(x).astype(np.int64)
+    out_nodes = list(xv.tolist())
+    mapping = {int(n): i for i, n in enumerate(xv.tolist())}
+    srcs, dsts = [], []
+    for nb_t, cnt_t in zip(neighbors, count):
+        nb = _ids_np(nb_t).astype(np.int64)
+        cnt = _ids_np(cnt_t).astype(np.int64)
+        for n in nb.tolist():
+            if int(n) not in mapping:
+                mapping[int(n)] = len(out_nodes)
+                out_nodes.append(int(n))
+        srcs.append(to_tensor(np.asarray([mapping[int(n)] for n in nb.tolist()],
+                                         dtype=np.int64)))
+        dsts.append(to_tensor(np.repeat(np.arange(len(xv), dtype=np.int64),
+                                        cnt)))
+    reindex_src = to_tensor(np.concatenate(
+        [np.asarray(s._value) for s in srcs])) if srcs else to_tensor(
+        np.zeros(0, np.int64))
+    reindex_dst = to_tensor(np.concatenate(
+        [np.asarray(d._value) for d in dsts])) if dsts else to_tensor(
+        np.zeros(0, np.int64))
+    return (reindex_src, reindex_dst,
+            to_tensor(np.asarray(out_nodes, dtype=np.int64)))

@@ -289,3 +289,39 @@ class VisualDL(Callback):
         raise NotImplementedError(
             "VisualDL writer bridge is not implemented in paddle_tpu; use "
             "ProgBarLogger or profiler.export_chrome_tracing")
+
+
+class WandbCallback(Callback):
+    """Weights&Biases logger (reference: hapi/callbacks.py WandbCallback).
+    Gated on the wandb package like the reference (and VisualDL above)."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        try:
+            import wandb
+            self.wandb = wandb
+        except ImportError as e:
+            raise RuntimeError(
+                "You want to use wandb which is not installed yet install "
+                "it with: pip install wandb") from e
+        self._kwargs = dict(project=project, entity=entity, name=name,
+                            dir=dir, mode=mode, job_type=job_type, **kwargs)
+        self._run = None
+
+    def on_train_begin(self, logs=None):
+        self._run = self.wandb.init(**{k: v for k, v in self._kwargs.items()
+                                       if v is not None})
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._run and logs:
+            self._run.log({f"train/{k}": v for k, v in logs.items()},
+                          step=epoch)
+
+    def on_eval_end(self, logs=None):
+        if self._run and logs:
+            self._run.log({f"eval/{k}": v for k, v in logs.items()
+                           if not isinstance(v, (list, tuple))})
+
+    def on_train_end(self, logs=None):
+        if self._run:
+            self._run.finish()

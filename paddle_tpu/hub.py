@@ -1,0 +1,74 @@
+"""paddle.hub — load models from local repo directories or github.
+
+Reference: python/paddle/hub.py (list/help/load over a hubconf.py contract).
+Zero-egress environment: the 'github' source raises; local directories work
+exactly like the reference ('<path>' containing hubconf.py with callables and
+an optional `dependencies` list).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load", "load_state_dict_from_url"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise RuntimeError(f"no {_HUBCONF} found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.pop(0)
+    deps = getattr(mod, "dependencies", [])
+    missing = [d for d in deps if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(f"Missing dependencies: {missing}")
+    return mod
+
+
+def _resolve(repo_dir, source):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"Unknown source: {source}. Allowed values: 'github' | 'gitee' | "
+            "'local'.")
+    if source != "local":
+        raise RuntimeError(
+            f"source='{source}' needs network access, which this environment "
+            "does not have; clone the repo and use source='local'")
+    return repo_dir
+
+
+def list(repo_dir, source="github", force_reload=False):
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise RuntimeError(f"model {model} not found in hubconf")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise RuntimeError(f"model {model} not found in hubconf")
+    return fn(**kwargs)
+
+
+def load_state_dict_from_url(url, model_dir=None, check_hash=False,
+                             file_name=None, map_location=None):
+    raise RuntimeError("load_state_dict_from_url needs network access; "
+                       "download the weights and use paddle.load instead")

@@ -4,5 +4,18 @@ Reference: python/paddle/incubate/ (SURVEY.md §2.6: fused NN functionals,
 MoE layers, asp sparsity).
 """
 from . import nn  # noqa: F401
+from . import autograd  # noqa: F401
+from . import multiprocessing  # noqa: F401
+from . import layers  # noqa: F401
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .ops import (  # noqa: F401
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle, identity_loss,
+    graph_send_recv, graph_reindex, graph_sample_neighbors, graph_khop_sampler,
+)
+from ..geometric import (  # noqa: F401
+    segment_sum, segment_mean, segment_max, segment_min,
+)
+from ..inference import Config as _InferenceConfig  # noqa: F401
+from .. import inference  # noqa: F401

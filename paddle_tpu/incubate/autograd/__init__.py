@@ -1,0 +1,127 @@
+"""paddle.incubate.autograd — functional autodiff (vjp/jvp/Jacobian/Hessian)
+and the prim-mode switches.
+
+Reference: python/paddle/incubate/autograd/ (primapi.py forward_grad/grad,
+functional.py vjp/jvp/Jacobian/Hessian, primx "prim" op decomposition).
+TPU-native: jax IS the primitive system — vjp/jvp map to jax.vjp/jax.jvp over
+the op library; enable/disable_prim toggle a flag only (every op is already
+expressed in primitives XLA understands).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...autograd import jacobian as _jacobian, hessian as _hessian
+
+__all__ = ['vjp', 'jvp', 'Jacobian', 'Hessian', 'enable_prim', 'disable_prim',
+           'forward_grad', 'grad']
+
+_PRIM_ENABLED = False
+
+
+def enable_prim():
+    """reference: primapi — turn on primitive-op decomposition. XLA always
+    runs on primitives; the flag is tracked for prim_enabled() parity."""
+    global _PRIM_ENABLED
+    _PRIM_ENABLED = True
+
+
+def disable_prim():
+    global _PRIM_ENABLED
+    _PRIM_ENABLED = False
+
+
+def prim_enabled():
+    return _PRIM_ENABLED
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _pure(func):
+    def fn(*vals):
+        out = func(*[Tensor(v) for v in vals])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._value for o in out)
+        return out._value
+    return fn
+
+
+def vjp(func, xs, v=None):
+    """reference: incubate/autograd/functional.py vjp — returns
+    (func(xs), vjp_result)."""
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    vals = [_unwrap(x) for x in xs_l]
+    out, vjp_fn = jax.vjp(_pure(func), *vals)
+    if v is None:
+        if isinstance(out, tuple):
+            cot = tuple(jnp.ones_like(o) for o in out)
+        else:
+            cot = jnp.ones_like(out)
+    else:
+        v_l = v if isinstance(v, (list, tuple)) else [v]
+        cot = tuple(_unwrap(x) for x in v_l)
+        if not isinstance(out, tuple):
+            cot = cot[0]
+    grads = vjp_fn(cot)
+    outs = (tuple(Tensor(o) for o in out) if isinstance(out, tuple)
+            else Tensor(out))
+    gs = [Tensor(g) for g in grads]
+    return outs, (gs if len(gs) > 1 else gs[0])
+
+
+def jvp(func, xs, v=None):
+    """reference: functional.py jvp — returns (func(xs), jvp_result)."""
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    vals = [_unwrap(x) for x in xs_l]
+    if v is None:
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        v_l = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(_unwrap(x) for x in v_l)
+    out, tangent_out = jax.jvp(_pure(func), tuple(vals), tangents)
+    outs = (tuple(Tensor(o) for o in out) if isinstance(out, tuple)
+            else Tensor(out))
+    touts = (tuple(Tensor(t) for t in tangent_out)
+             if isinstance(tangent_out, tuple) else Tensor(tangent_out))
+    return outs, touts
+
+
+forward_grad = jvp  # primapi.forward_grad: forward-mode grads
+grad = vjp          # primapi.grad over prim ops == reverse mode
+
+
+class Jacobian:
+    """Lazy row/column-sliceable Jacobian (reference: functional.py
+    Jacobian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._mat = _jacobian(func, xs)
+
+    def __getitem__(self, item):
+        m = self._mat
+        if isinstance(m, (list, tuple)):
+            return [x[item] for x in m]
+        return m[item]
+
+    @property
+    def shape(self):
+        m = self._mat
+        return m.shape if not isinstance(m, (list, tuple)) else \
+            [x.shape for x in m]
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        self._mat = _hessian(func, xs)
+
+    def __getitem__(self, item):
+        return self._mat[item]
+
+    @property
+    def shape(self):
+        return self._mat.shape

@@ -1,0 +1,76 @@
+"""paddle.incubate.layers — legacy incubating layer helpers.
+
+Reference: python/paddle/incubate/layers/nn.py (fused_embedding_seq_pool,
+shuffle_batch, partial_concat/sum, pow2_decay_with_linear_warmup, ...). The
+commonly-used subset is provided; each lowers to existing ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch
+from ...core import random as _random
+
+
+def shuffle_batch(x, seed=None):
+    """Shuffle rows of a batch (reference: incubate/layers/nn.py
+    shuffle_batch). Returns the shuffled tensor (the reference also keeps the
+    shuffle order internally for shuffle_batch_grad)."""
+    key = _random.next_key() if seed is None else jax.random.PRNGKey(seed)
+
+    def fn(v):
+        perm = jax.random.permutation(key, v.shape[0])
+        return v[perm]
+    return dispatch(fn, (x,), {}, name="shuffle_batch")
+
+
+def partial_concat(xs, start_index=0, length=-1):
+    """Concat column slices of each input (reference: partial_concat op)."""
+    def fn(*vals):
+        outs = []
+        for v in vals:
+            end = v.shape[1] if length < 0 else start_index + length
+            outs.append(v[:, start_index:end])
+        return jnp.concatenate(outs, axis=1)
+    return dispatch(fn, tuple(xs), {}, name="partial_concat")
+
+
+def partial_sum(xs, start_index=0, length=-1):
+    def fn(*vals):
+        out = 0
+        for v in vals:
+            end = v.shape[1] if length < 0 else start_index + length
+            out = out + v[:, start_index:end]
+        return out
+    return dispatch(fn, tuple(xs), {}, name="partial_sum")
+
+
+def pow2_decay_with_linear_warmup(warmup_steps, total_steps, base_lr, end_lr):
+    """LR schedule op (reference: pow2_decay_with_linear_warmup): linear
+    warmup then (1 - t)^2 decay. Returns a step->lr callable (the eager
+    analog of the in-graph counter op)."""
+    def lr_at(step):
+        step = float(step)
+        if step < warmup_steps:
+            return base_lr * step / max(warmup_steps, 1)
+        t = min(step - warmup_steps, total_steps - warmup_steps)
+        frac = 1.0 - t / max(total_steps - warmup_steps, 1)
+        return end_lr + (base_lr - end_lr) * frac * frac
+    return lr_at
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
+                             combiner="sum", param_attr=None, dtype="float32"):
+    """Embedding lookup + sequence pool in one op (reference:
+    fused_embedding_seq_pool). Padded-dense analog: input (B, T) ids."""
+    import paddle_tpu as _paddle
+    w = _paddle.create_parameter(list(size), dtype, attr=param_attr)
+
+    def fn(ids, wv):
+        emb = wv[ids]
+        if padding_idx is not None:
+            emb = jnp.where((ids == padding_idx)[..., None], 0.0, emb)
+        return emb.sum(axis=1) if combiner == "sum" else emb.mean(axis=1)
+    return dispatch(fn, (input, w), {}, name="fused_embedding_seq_pool")

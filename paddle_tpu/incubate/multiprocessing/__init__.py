@@ -1,0 +1,50 @@
+"""paddle.incubate.multiprocessing — tensor-aware process spawning.
+
+Reference: python/paddle/incubate/multiprocessing/ — a torch-style wrapper
+over the stdlib multiprocessing that registers tensor reductions so Tensors
+cross process boundaries (CUDA IPC / shared memory file_system in the
+reference). TPU-native: device memory is not host-shareable through PJRT, so
+tensors serialize by value through shared memory (the reference's
+file_system strategy); the DataLoader's high-throughput path uses the native
+shm ring in csrc/ instead.
+"""
+from __future__ import annotations
+
+import multiprocessing
+from multiprocessing import *  # noqa: F401,F403
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def _reduce_tensor(t):
+    return (_rebuild_tensor, (np.asarray(t._value), str(t._value.dtype),
+                              t.stop_gradient))
+
+
+def _rebuild_tensor(arr, dtype, stop_gradient):
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(arr), stop_gradient=stop_gradient)
+
+
+try:
+    import multiprocessing.reduction as _reduction
+    import copyreg
+    copyreg.pickle(Tensor, _reduce_tensor)
+except Exception:  # pragma: no cover
+    pass
+
+
+_SHARING_STRATEGY = "file_system"
+
+
+def set_sharing_strategy(new_strategy):
+    global _SHARING_STRATEGY
+    if new_strategy not in ("file_system", "file_descriptor"):
+        raise ValueError(f"unknown sharing strategy {new_strategy}")
+    _SHARING_STRATEGY = new_strategy
+
+
+def get_sharing_strategy():
+    return _SHARING_STRATEGY

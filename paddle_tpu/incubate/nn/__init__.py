@@ -1,2 +1,6 @@
 from . import functional  # noqa: F401
-from .layers import FusedMultiHeadAttention, FusedFeedForward  # noqa: F401
+from .layers import (  # noqa: F401
+    FusedMultiHeadAttention, FusedFeedForward, FusedLinear, FusedDropoutAdd,
+    FusedBiasDropoutResidualLayerNorm, FusedTransformerEncoderLayer,
+    FusedMultiTransformer,
+)

@@ -602,3 +602,122 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
 
     return dispatch(fn, (qkv, key_cache, value_cache, seq_lens_decoder,
                          block_tables), {}, name="block_multihead_attention")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """reference: incubate/nn/functional/fused_matmul_bias.py — one
+    GEMM+bias-epilogue (XLA fuses the add into the dot)."""
+    def fn(a, b, *bi):
+        aa = jnp.swapaxes(a, -2, -1) if transpose_x else a
+        bb = jnp.swapaxes(b, -2, -1) if transpose_y else b
+        out = aa @ bb
+        if bi:
+            out = out + bi[0]
+        return out
+    args = (x, y) + ((bias,) if bias is not None else ())
+    return dispatch(fn, args, {}, name="fused_matmul_bias")
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size,
+                     name=None):
+    """reference: incubate/nn/functional/blha_get_max_len.py — max
+    encoder/decoder sequence lengths for block_multihead_attention setup."""
+    def fn(enc, dec):
+        return jnp.max(enc).reshape([1]), jnp.max(dec).reshape([1])
+    return dispatch(fn, (seq_lens_encoder, seq_lens_decoder), {},
+                    name="blha_get_max_len")
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, pre_caches=None,
+                            rotary_embs=None, rotary_emb_dims=0, beam_offset=None,
+                            seq_lens=None, time_step=None, attn_mask=None,
+                            dropout_rate=0.0, activation="gelu", training=False,
+                            mode="upscale_in_train", trans_qkvw=True,
+                            ring_id=-1, name=None):
+    """Whole-stack fused transformer (reference:
+    incubate/nn/functional/fused_multi_transformer.py — the generation-path
+    mega-op). Loops the per-layer fused blocks; each block is one XLA fusion
+    region; KV caches append along seq when cache_kvs is given (decode step).
+
+    Returns output, or (output, cache_kvs) when cache_kvs is not None."""
+    from ....nn import functional as NF
+    from ....nn.functional.attention import scaled_dot_product_attention
+
+    num_layers = len(qkv_weights)
+    out = x
+    new_caches = []
+    for i in range(num_layers):
+        residual = out
+        h = out
+        if pre_layer_norm:
+            h = NF.layer_norm(h, (h.shape[-1],), ln_scales[i], ln_biases[i],
+                              epsilon)
+        b, s, d = h.shape
+        qkv_w = qkv_weights[i]
+        if trans_qkvw:
+            # (3, H, Dh, D) -> project: x @ W^T per slot
+            def qkv_fn(hv, wv, bv):
+                out3 = jnp.einsum("bsd,thkd->bsthk", hv, wv)
+                return out3 + bv[None, None]
+            qkv = dispatch(qkv_fn, (h, qkv_w, qkv_biases[i]), {},
+                           name="fmt_qkv")
+        else:
+            def qkv_fn(hv, wv, bv):
+                out3 = jnp.einsum("bsd,dthk->bsthk", hv, wv)
+                return out3 + bv[None, None]
+            qkv = dispatch(qkv_fn, (h, qkv_w, qkv_biases[i]), {},
+                           name="fmt_qkv")
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        if rotary_embs is not None and rotary_emb_dims > 0:
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, sin=rotary_embs[0], cos=rotary_embs[1])
+        if cache_kvs is not None and cache_kvs[i] is not None:
+            cache = cache_kvs[i]  # (2, B, H, S_cache, Dh) paddle layout
+            def append_fn(cv, kv, vv):
+                kq = jnp.swapaxes(kv, 1, 2)  # B,H,S,Dh
+                vq = jnp.swapaxes(vv, 1, 2)
+                nk = jnp.concatenate([cv[0], kq], axis=2)
+                nv = jnp.concatenate([cv[1], vq], axis=2)
+                return jnp.stack([nk, nv])
+            new_cache = dispatch(append_fn, (cache, k, v), {},
+                                 name="fmt_cache_append")
+            new_caches.append(new_cache)
+            def split_fn(cv):
+                return (jnp.swapaxes(cv[0], 1, 2), jnp.swapaxes(cv[1], 1, 2))
+            k, v = dispatch(split_fn, (new_cache,), {}, name="fmt_cache_read")
+        attn = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            is_causal=(attn_mask is None and cache_kvs is None),
+            dropout_p=0.0, training=training)
+        attn = attn.reshape([b, s, d])
+        attn = NF.linear(attn, linear_weights[i], linear_biases[i])
+        if dropout_rate and training:
+            attn = NF.dropout(attn, dropout_rate, training=training)
+        out = residual + attn
+        if not pre_layer_norm:
+            out = NF.layer_norm(out, (d,), ln_scales[i], ln_biases[i], epsilon)
+
+        residual = out
+        h = out
+        if pre_layer_norm:
+            h = NF.layer_norm(h, (d,), ffn_ln_scales[i], ffn_ln_biases[i],
+                              epsilon)
+        h = NF.linear(h, ffn1_weights[i], ffn1_biases[i])
+        h = getattr(NF, activation)(h)
+        if dropout_rate and training:
+            h = NF.dropout(h, dropout_rate, training=training)
+        h = NF.linear(h, ffn2_weights[i], ffn2_biases[i])
+        out = residual + h
+        if not pre_layer_norm:
+            out = NF.layer_norm(out, (d,), ffn_ln_scales[i], ffn_ln_biases[i],
+                                epsilon)
+    if cache_kvs is not None:
+        return out, new_caches
+    return out

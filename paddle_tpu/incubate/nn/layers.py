@@ -118,3 +118,202 @@ class FusedFeedForward(Layer):
             out = F.layer_norm(out, (self.d_model,), self.ln_scale, self.ln_bias,
                                self.epsilon)
         return out
+
+
+class FusedLinear(Layer):
+    """reference: incubate/nn/layer/fused_linear.py — linear via the fused
+    matmul+bias path (one XLA fusion here)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = self.create_parameter(
+            (out_features,), attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, input):
+        from .functional import fused_linear
+        return fused_linear(input, self.weight, self.bias,
+                            transpose_weight=self.transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    """reference: incubate/nn/layer/fused_dropout_add.py — dropout(x) + y in
+    one kernel."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from .functional import fused_dropout_add
+        return fused_dropout_add(x, y, p=self.p, training=self.training,
+                                 mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference: incubate/nn/layer/fused_dropout_nd.py
+    FusedBiasDropoutResidualLayerNorm — LN(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), is_bias=True, default_initializer=Constant(0.0))
+
+    def forward(self, x, residual):
+        from .functional import fused_bias_dropout_residual_layer_norm
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """reference: incubate/nn/layer/fused_transformer.py
+    FusedTransformerEncoderLayer — FusedMultiHeadAttention + FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        attn_dropout_rate = (dropout_rate if attn_dropout_rate is None
+                             else attn_dropout_rate)
+        act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                            else act_dropout_rate)
+        self.normalize_before = normalize_before
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before,
+            qkv_weight_attr=weight_attr, qkv_bias_attr=bias_attr,
+            linear_weight_attr=weight_attr, linear_bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before,
+            linear1_weight_attr=weight_attr, linear1_bias_attr=bias_attr,
+            linear2_weight_attr=weight_attr, linear2_bias_attr=bias_attr)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """Whole-decoder-stack fused transformer for generation (reference:
+    incubate/nn/layer/fused_transformer.py FusedMultiTransformer →
+    fused_multi_transformer op). num_layers of pre/post-LN attention + FFN
+    with optional per-layer KV caches; one module owns every layer's params
+    (the weight-list form of the CUDA op)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon=1e-5, num_layers=-1,
+                 nranks=1, trans_qkvw=True, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        if num_layers == -1:
+            num_layers = len(qkv_weight_attrs) \
+                if isinstance(qkv_weight_attrs, (list, tuple)) else 1
+        self.num_layers = num_layers
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        self.trans_qkvw = trans_qkvw
+
+        def pick(attrs, i):
+            return attrs[i] if isinstance(attrs, (list, tuple)) else attrs
+
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+        for i in range(num_layers):
+            qkv_shape = ((3, num_heads, self.head_dim, embed_dim)
+                         if trans_qkvw else
+                         (embed_dim, 3, num_heads, self.head_dim))
+            add = lambda n, p: (self.add_parameter(f"{n}_{i}", p), p)[1]
+            self.ln_scales.append(add("ln_scale", self.create_parameter(
+                (embed_dim,), attr=pick(ln_scale_attrs, i),
+                default_initializer=Constant(1.0))))
+            self.ln_biases.append(add("ln_bias", self.create_parameter(
+                (embed_dim,), attr=pick(ln_bias_attrs, i), is_bias=True,
+                default_initializer=Constant(0.0))))
+            self.qkv_weights.append(add("qkv_weight", self.create_parameter(
+                qkv_shape, attr=pick(qkv_weight_attrs, i),
+                default_initializer=XavierUniform())))
+            self.qkv_biases.append(add("qkv_bias", self.create_parameter(
+                (3, num_heads, self.head_dim), attr=pick(qkv_bias_attrs, i),
+                is_bias=True, default_initializer=Constant(0.0))))
+            self.linear_weights.append(add("linear_weight",
+                self.create_parameter(
+                    (embed_dim, embed_dim), attr=pick(linear_weight_attrs, i),
+                    default_initializer=XavierUniform())))
+            self.linear_biases.append(add("linear_bias", self.create_parameter(
+                (embed_dim,), attr=pick(linear_bias_attrs, i), is_bias=True,
+                default_initializer=Constant(0.0))))
+            self.ffn_ln_scales.append(add("ffn_ln_scale",
+                self.create_parameter(
+                    (embed_dim,), attr=pick(ffn_ln_scale_attrs, i),
+                    default_initializer=Constant(1.0))))
+            self.ffn_ln_biases.append(add("ffn_ln_bias", self.create_parameter(
+                (embed_dim,), attr=pick(ffn_ln_bias_attrs, i), is_bias=True,
+                default_initializer=Constant(0.0))))
+            self.ffn1_weights.append(add("ffn1_weight", self.create_parameter(
+                (embed_dim, dim_feedforward), attr=pick(ffn1_weight_attrs, i),
+                default_initializer=XavierUniform())))
+            self.ffn1_biases.append(add("ffn1_bias", self.create_parameter(
+                (dim_feedforward,), attr=pick(ffn1_bias_attrs, i),
+                is_bias=True, default_initializer=Constant(0.0))))
+            self.ffn2_weights.append(add("ffn2_weight", self.create_parameter(
+                (dim_feedforward, embed_dim), attr=pick(ffn2_weight_attrs, i),
+                default_initializer=XavierUniform())))
+            self.ffn2_biases.append(add("ffn2_bias", self.create_parameter(
+                (embed_dim,), attr=pick(ffn2_bias_attrs, i), is_bias=True,
+                default_initializer=Constant(0.0))))
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        from .functional import fused_multi_transformer
+        return fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
+            cache_kvs=caches, pre_caches=pre_caches, rotary_embs=rotary_embs,
+            rotary_emb_dims=rotary_emb_dims, seq_lens=seq_lens,
+            time_step=time_step, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate, activation=self.activation,
+            training=self.training, trans_qkvw=self.trans_qkvw)

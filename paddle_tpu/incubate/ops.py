@@ -1,0 +1,104 @@
+"""Incubate functional ops: fused softmax-mask, identity_loss, graph_* legacy
+aliases.
+
+Reference: python/paddle/incubate/operators/{softmax_mask_fuse.py,
+softmax_mask_fuse_upper_triangle.py}, incubate/__init__.py graph_* exports
+(the older names for paddle.geometric message passing/sampling ops).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import dispatch
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused pass (reference: fused_softmax_mask op;
+    XLA fuses the add into the softmax the same way the CUDA kernel does)."""
+    return dispatch(
+        lambda v, m: jax.nn.softmax(v.astype(jnp.float32) +
+                                    m.astype(jnp.float32),
+                                    axis=-1).astype(v.dtype),
+        (x, mask), {}, name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax (reference: fused_softmax_mask_upper_triangle op):
+    entries above the diagonal are masked out."""
+    def fn(v):
+        sq, sk = v.shape[-2], v.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool))
+        logits = jnp.where(cmask, v.astype(jnp.float32), -jnp.inf)
+        return jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return dispatch(fn, (x,), {}, name="softmax_mask_fuse_upper_triangle")
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss and reduce (reference: incubate identity_loss
+    op — IPU loss marker; the reduction semantics are what remain here)."""
+    if reduction in (0, "sum"):
+        return dispatch(lambda v: jnp.sum(v), (x,), {}, name="identity_loss")
+    if reduction in (1, "mean"):
+        return dispatch(lambda v: jnp.mean(v), (x,), {}, name="identity_loss")
+    if reduction in (2, "none"):
+        return dispatch(lambda v: v, (x,), {}, name="identity_loss")
+    raise ValueError("reduction must be 'sum', 'mean' or 'none'")
+
+
+# legacy graph_* spellings of the paddle.geometric ops ------------------------
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1, return_eids=False,
+                           flag_perm_buffer=False, name=None):
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes, sample_size=sample_size,
+                            eids=eids, return_eids=return_eids)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling (reference: incubate/operators/graph_khop_sampler.py)
+    built by iterating sample_neighbors + reindex per hop."""
+    import numpy as np
+    from ..geometric import sample_neighbors, reindex_graph
+    from ..ops.creation import to_tensor
+    cur = input_nodes
+    all_src, all_dst = [], []
+    seen = list(np.asarray(input_nodes._value
+                           if hasattr(input_nodes, "_value")
+                           else input_nodes).tolist())
+    for size in sample_sizes:
+        out = sample_neighbors(row, colptr, cur, sample_size=size)
+        neigh, cnt = out[0], out[1]
+        src, dst, nodes = reindex_graph(cur, neigh, cnt)
+        all_src.append(np.asarray(neigh._value))
+        all_dst.append(np.repeat(
+            np.asarray(cur._value if hasattr(cur, "_value") else cur),
+            np.asarray(cnt._value)))
+        new = [n for n in np.asarray(neigh._value).tolist() if n not in seen]
+        seen.extend(new)
+        cur = to_tensor(np.asarray(seen, np.int64))
+    edge_src = to_tensor(np.concatenate(all_src) if all_src
+                         else np.zeros(0, np.int64))
+    edge_dst = to_tensor(np.concatenate(all_dst) if all_dst
+                         else np.zeros(0, np.int64))
+    sample_index = to_tensor(np.asarray(seen, np.int64))
+    reindex = {int(n): i for i, n in enumerate(seen)}
+    reindex_arr = to_tensor(np.asarray(
+        [reindex[int(v)] for v in np.asarray(edge_src._value).tolist()],
+        np.int64))
+    return edge_src, edge_dst, sample_index, reindex_arr

@@ -1,0 +1,132 @@
+"""Incubate optimizers: LookAhead and ModelAverage.
+
+Reference: python/paddle/incubate/optimizer/{lookahead.py,modelaverage.py} —
+wrapper optimizers over an inner optimizer: LookAhead keeps slow weights
+synced every k steps; ModelAverage maintains running parameter sums applied
+at eval time.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """k-step lookahead (reference: lookahead.py LookAhead / Zhang et al.):
+    fast weights step with the inner optimizer; every k steps
+    slow += alpha * (fast - slow), fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if not isinstance(k, int) or k <= 0:
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._parameter_list = inner_optimizer._parameter_list
+        self._slow = {}
+        self._k_step = 0
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._k_step += 1
+        for p in self._parameter_list:
+            if p.stop_gradient:
+                continue
+            if self._k_step == 1:
+                # reference lookahead.py:284 — slow initialized from the
+                # params after the first inner step. Copy: the inner
+                # optimizer's jitted update donates param buffers, which
+                # would invalidate a shared reference.
+                self._slow[id(p)] = jnp.array(p._value, copy=True)
+                continue
+            if self._k_step % self.k:
+                continue
+            slow = self.alpha * p._value + (1 - self.alpha) * self._slow[id(p)]
+            self._slow[id(p)] = slow
+            p._value = jnp.array(slow, copy=True)
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def set_state_dict(self, state):
+        self.inner_optimizer.set_state_dict(state)
+
+
+class ModelAverage(Optimizer):
+    """Running parameter average applied at eval (reference: modelaverage.py
+    ModelAverage): accumulates sum_1 / sum_2 / sum_3 windows; apply() swaps
+    params for their window average, restore() swaps back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(0.0, parameters, None, None, False, name)
+        self.avg_rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        self._acc = {}
+        self._backup = {}
+
+    def step(self):
+        for p in self._parameter_list:
+            if p.stop_gradient:
+                continue
+            acc = self._acc.setdefault(
+                id(p), {"sum": np.zeros(p.shape, np.float64), "n": 0})
+            acc["sum"] += np.asarray(p._value, np.float64)
+            acc["n"] += 1
+            window = max(self.min_window,
+                         min(self.max_window, int(acc["n"] * self.avg_rate)))
+            if acc["n"] > window:
+                # restart the window from the running mean (reference's
+                # sum_1/2/3 rotation keeps a bounded-window mean)
+                mean = acc["sum"] / acc["n"]
+                acc["sum"] = mean
+                acc["n"] = 1
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._parameter_list:
+            acc = self._acc.get(id(p))
+            if acc is None or acc["n"] == 0:
+                continue
+            self._backup[id(p)] = p._value
+            p._value = jnp.asarray(acc["sum"] / acc["n"], p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._parameter_list:
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return None, None

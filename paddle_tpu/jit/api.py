@@ -314,3 +314,49 @@ class ProgramTranslator:  # parity shim
 
 def enable_to_static(flag=True):
     pass
+
+
+class TranslatedLayer:
+    """Loaded-program layer (reference: jit/translated_layer.py
+    TranslatedLayer — what jit.load returns in the reference). Our jit.load
+    returns the callable program directly; this wrapper restores the layer
+    interface (program(), train/eval flags) for API parity."""
+
+    def __init__(self, fn, input_spec=None):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._is_test = True
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    forward = __call__
+
+    def train(self):
+        self._is_test = False
+        return self
+
+    def eval(self):
+        self._is_test = True
+        return self
+
+    def program(self, method_name="forward"):
+        return getattr(self._fn, "jaxpr", None)
+
+
+_LOG_VERBOSITY = 0
+_CODE_LEVEL = -1
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference: jit/dy2static/logging_utils.py set_verbosity — transform
+    logging verbosity."""
+    global _LOG_VERBOSITY
+    _LOG_VERBOSITY = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference: jit/dy2static/logging_utils.py set_code_level — which
+    transformed-code stage to log."""
+    global _CODE_LEVEL
+    _CODE_LEVEL = int(level)

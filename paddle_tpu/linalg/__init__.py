@@ -9,7 +9,10 @@ from ..ops.linalg import (  # noqa: F401
     cholesky, cholesky_solve, inverse, det, slogdet, svd, qr, eig, eigh,
     eigvals, eigvalsh, matrix_power, matrix_rank, solve, triangular_solve,
     lstsq, pinv, lu, cond, multi_dot, corrcoef, cov, householder_product,
+    cholesky_inverse, vecdot, matrix_transpose, svdvals, matrix_exp, lu_unpack,
+    ormqr, svd_lowrank, pca_lowrank, fp8_fp8_half_gemm_fused,
 )
+from ..ops.math import diagonal  # noqa: F401
 
 inv = inverse
 
@@ -19,4 +22,7 @@ __all__ = [
     "slogdet", "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh", "matrix_power",
     "matrix_rank", "solve", "triangular_solve", "lstsq", "pinv", "lu", "cond",
     "multi_dot", "corrcoef", "cov", "householder_product",
+    "cholesky_inverse", "vecdot", "matrix_transpose", "svdvals", "matrix_exp",
+    "lu_unpack", "ormqr", "svd_lowrank", "pca_lowrank",
+    "fp8_fp8_half_gemm_fused", "diagonal",
 ]

@@ -178,10 +178,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
             time, inputs, states, **kwargs)
         if seq_len is None:
             seq_len = getattr(next_states, "lengths", None)
-        if not decoder.tracks_own_finished:
-            fin = np.asarray(finished._value)
-        else:
-            fin = np.asarray(finished._value)
+        fin = np.asarray(finished._value)
         step_outputs.append(outputs)
         states = next_states
         time += 1

@@ -12,6 +12,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor, dispatch
+from ..layer_base import Layer
 from ...quantization import (  # noqa: F401
     QuantedLinear, QuantedConv2D, QuantizedLinearInfer,
     FakeQuanterWithAbsMaxObserver, FakeQuanterChannelWiseAbsMaxObserver,
@@ -89,3 +90,18 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
 
     return dispatch(fn, (x, weight, weight_scale, bias), {},
                     name="llm_int8_linear")
+
+
+class Stub(Layer):
+    """Quantization insertion point (reference: nn/quant/stub.py Stub): a
+    no-op layer the QAT pass replaces with the configured quanter."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, input):
+        return input
+
+
+__all__.append("Stub")

@@ -257,3 +257,153 @@ def householder_product(x, tau):
             return outs.reshape(*batch, m, n)
         return one(a, t)
     return dispatch(fn, (x, tau), {}, name="householder_product")
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (reference: tensor/linalg.py
+    cholesky_inverse → cholesky_solve against identity)."""
+    def fn(f):
+        eye = jnp.eye(f.shape[-1], dtype=f.dtype)
+        if upper:
+            # A = U^T U ; solve U^T U X = I
+            z = jax.scipy.linalg.solve_triangular(f, eye, trans=1, lower=False)
+            return jax.scipy.linalg.solve_triangular(f, z, lower=False)
+        z = jax.scipy.linalg.solve_triangular(f, eye, lower=True)
+        return jax.scipy.linalg.solve_triangular(f, z, trans=1, lower=True)
+    return dispatch(fn, (x,), {}, name="cholesky_inverse")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """reference: tensor/linalg.py vecdot — conj(x)·y along axis."""
+    return dispatch(lambda a, b: jnp.sum(jnp.conj(a) * b, axis=axis),
+                    (x, y), {}, name="vecdot")
+
+
+def matrix_transpose(x, name=None):
+    return dispatch(lambda v: jnp.swapaxes(v, -2, -1), (x,), {},
+                    name="matrix_transpose")
+
+
+def svdvals(x, name=None):
+    return dispatch(lambda v: jnp.linalg.svd(v, compute_uv=False), (x,), {},
+                    name="svdvals")
+
+
+def matrix_exp(x, name=None):
+    return dispatch(jax.scipy.linalg.expm, (x,), {}, name="matrix_exp")
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack the packed LU factorization (reference: tensor/linalg.py
+    lu_unpack): returns (P, L, U) from lu() outputs."""
+    def fn(lu_data, pivots):
+        m, n = lu_data.shape[-2], lu_data.shape[-1]
+        k = min(m, n)
+        # L: unit lower-trapezoid (m, k); U: upper-trapezoid (k, n)
+        eyek = jnp.eye(m, k, dtype=lu_data.dtype)
+        L = jnp.tril(lu_data[..., :, :k], -1) + eyek
+        U = jnp.triu(lu_data[..., :k, :])
+        # pivots (1-based sequential swaps) -> permutation matrix
+        def perm_from_pivots(piv):
+            perm = jnp.arange(m)
+            def body(i, p):
+                j = piv[i] - 1
+                pi, pj = p[i], p[j]
+                return p.at[i].set(pj).at[j].set(pi)
+            perm = jax.lax.fori_loop(0, piv.shape[0], body, perm)
+            return jnp.eye(m, dtype=lu_data.dtype)[:, perm].T
+        if pivots.ndim == 1:
+            P = perm_from_pivots(pivots).T
+        else:
+            P = jax.vmap(perm_from_pivots)(
+                pivots.reshape(-1, pivots.shape[-1]))
+            P = jnp.swapaxes(P, -2, -1).reshape(lu_data.shape[:-2] + (m, m))
+        return P, L, U
+    return dispatch(fn, (x, y), {}, name="lu_unpack")
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by the full Q from Householder reflectors (reference:
+    tensor/linalg.py ormqr / LAPACK ormqr): applies each reflector
+    H_k = I - tau_k v_k v_k^T to y without materializing Q — rank-1 updates,
+    O(m·n·k) like the LAPACK path."""
+    def fn(a, t, other):
+        m = a.shape[-2]
+        k = t.shape[-1]
+        rows = jnp.arange(m)
+
+        def reflector(i):
+            v = jnp.where(rows < i, 0.0, jnp.where(rows == i, 1.0, a[:, i]))
+            return v
+
+        def apply_left(o, order):
+            for i in order:
+                v = reflector(i)
+                o = o - t[i] * jnp.outer(v, v @ o)
+            return o
+
+        def apply_right(o, order):
+            for i in order:
+                v = reflector(i)
+                o = o - t[i] * jnp.outer(o @ v, v)
+            return o
+
+        # Q = H_0 H_1 ... H_{k-1}; Q @ y applies H_{k-1} first
+        if left:
+            order = range(k) if transpose else range(k - 1, -1, -1)
+            return apply_left(other, order)
+        order = range(k - 1, -1, -1) if transpose else range(k)
+        return apply_right(other, order)
+    return dispatch(fn, (x, tau, y), {}, name="ormqr")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference: tensor/linalg.py svd_lowrank —
+    Halko et al. subspace iteration, same algorithm torch uses)."""
+    from ..core import random as _random
+    key = _random.next_key()
+
+    def fn(a, *m):
+        a2 = a - m[0] if m else a
+        n = a2.shape[-1]
+        g = jax.random.normal(key, a2.shape[:-2] + (n, q), a2.dtype)
+        y = a2 @ g
+        for _ in range(niter):
+            y = a2 @ (jnp.swapaxes(a2, -2, -1) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -2, -1) @ a2
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u, s, jnp.swapaxes(vh, -2, -1)
+    args = (x,) + ((M,) if M is not None else ())
+    return dispatch(fn, args, {}, name="svd_lowrank")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference: tensor/linalg.py pca_lowrank."""
+    import paddle_tpu as _paddle
+    rank = q if q is not None else min(6, x.shape[-2], x.shape[-1])
+    if center:
+        mean = _paddle.mean(x, axis=-2, keepdim=True)
+        x = x - mean
+    return svd_lowrank(x, q=rank, niter=niter)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="float16", name=None):
+    """fp8 e4m3 GEMM with half/bf16 output (reference: tensor/linalg.py
+    fp8_fp8_half_gemm_fused → cutlass fp8 kernel). TPU: XLA handles
+    float8_e4m3fn dot with bf16 accumulation natively on v5+."""
+    from ..core.dtype import convert_dtype
+    out_dt = convert_dtype(output_dtype)
+
+    def fn(a, b, *bi):
+        aa = jnp.swapaxes(a, -2, -1) if transpose_x else a
+        bb = jnp.swapaxes(b, -2, -1) if transpose_y else b
+        out = jnp.matmul(aa.astype(jnp.bfloat16), bb.astype(jnp.bfloat16))
+        out = out * scale
+        if bi:
+            out = out + bi[0].astype(out.dtype)
+        return out.astype(out_dt)
+    args = (x, y) + ((bias,) if bias is not None else ())
+    return dispatch(fn, args, {}, name="fp8_fp8_half_gemm_fused")

@@ -239,10 +239,7 @@ class LBFGS(Optimizer):
                     be_i = float(np.dot(old_yk[i], d)) * ro[i]
                     d = d + old_sk[i] * (al[i] - be_i)
 
-            if prev_flat_grad is None:
-                prev_flat_grad = flat_grad.copy()
-            else:
-                prev_flat_grad = flat_grad.copy()
+            prev_flat_grad = flat_grad.copy()
             prev_loss = loss
 
             # learning-rate selection

@@ -329,3 +329,19 @@ def merge_profile(rank_dirs_or_files, output_path, align_start=True):
     with open(output_path, "w") as f:
         json.dump({"traceEvents": merged}, f)
     return output_path
+
+
+class SortedKeys(Enum):
+    """Sort orders for summary tables (reference: profiler/profiler.py
+    SortedKeys)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+__all__.append("SortedKeys")

@@ -620,3 +620,56 @@ class PTQ:
             return None
 
         return _swap_sublayers(model, swap)
+
+
+class BaseQuanter(Layer):
+    """Abstract quanter contract (reference: quantization/base_quanter.py):
+    forward simulates quantization; scales/zero_points/bit_length describe
+    the produced quantization parameters."""
+
+    def forward(self, input):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return 8
+
+
+class QuanterFactory:
+    """Deferred quanter constructor (reference: quantization/factory.py
+    QuanterFactory — holds args, instantiates per layer)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self.partial_class = cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self.partial_class(*self.args, **self.kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return QuanterFactory(self.partial_class, *args, **kwargs)
+
+
+def quanter(class_name):
+    """Register a quanter class under a factory name (reference:
+    quantization/factory.py quanter decorator): the decorated class gains a
+    same-named factory in this module, so configs can reference it lazily."""
+    def wrapper(cls):
+        factory = QuanterFactory(cls)
+        globals()[class_name] = factory
+        import sys
+        setattr(sys.modules[__name__], class_name, factory)
+        return cls
+    return wrapper
+
+
+__all__ += ["BaseQuanter", "quanter", "QuanterFactory"]

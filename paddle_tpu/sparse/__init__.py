@@ -19,6 +19,7 @@ from ..ops.creation import to_tensor
 __all__ = [
     "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor", "sparse_csr_tensor",
     "is_same_shape", "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "isnan", "mask_as", "slice", "pca_lowrank",
     "mv", "addmm", "transpose", "reshape", "sum", "coalesce",
     "relu", "relu6", "leaky_relu", "sigmoid", "tanh", "softmax", "sqrt", "square",
     "sin", "sinh", "tan", "asin", "asinh", "atan", "atanh", "abs", "pow",
@@ -623,3 +624,58 @@ def softmax(x, axis=-1, name=None):
 
 
 from . import nn  # noqa: E402,F401
+
+
+def isnan(x, name=None):
+    """reference: sparse/unary.py isnan — elementwise on stored values."""
+    c = _coo(x)
+    vals = dispatch(lambda v: jnp.isnan(v), (c.values(),), {},
+                    name="sparse_isnan")
+    out = SparseCooTensor(c.indices(), vals, c.shape)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def mask_as(x, mask, name=None):
+    """Sample a DENSE tensor at a sparse mask's pattern (reference:
+    sparse/multiary.py mask_as)."""
+    mc = _coo(mask)
+
+    def fn(dense, idx):
+        return dense[tuple(idx[i] for i in range(idx.shape[0]))]
+    vals = dispatch(fn, (x, mc.indices()), {}, name="sparse_mask_as")
+    out = SparseCooTensor(mc.indices(), vals, mc.shape)
+    return out.to_sparse_csr() if isinstance(mask, SparseCsrTensor) else out
+
+
+def slice(x, axes, starts, ends, name=None):
+    """reference: sparse/unary.py slice — dense-semantics slice of a sparse
+    tensor (static-index design: filter stored entries + shift indices)."""
+    import numpy as _np
+    c = _coo(x)
+    idx = _np.asarray(c.indices()._value)
+    vals = c.values()
+    shape = list(c.shape)
+    axes = [a % len(shape) for a in axes]
+    keep = _np.ones(idx.shape[1], bool)
+    for a, st, en in zip(axes, starts, ends):
+        st = st + shape[a] if st < 0 else st
+        en = en + shape[a] if en < 0 else min(en, shape[a])
+        keep &= (idx[a] >= st) & (idx[a] < en)
+        shape[a] = max(0, min(en, shape[a]) - st)
+    sel = _np.nonzero(keep)[0]
+    new_idx = idx[:, sel].copy()
+    for a, st, en in zip(axes, starts, ends):
+        st = st + c.shape[a] if st < 0 else st
+        new_idx[a] -= st
+    new_vals = dispatch(lambda v: v[jnp.asarray(sel)], (vals,), {},
+                        name="sparse_slice_values")
+    out = SparseCooTensor(to_tensor(new_idx), new_vals, shape)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference: sparse/multiary.py pca_lowrank — densify then randomized
+    PCA (the GPU reference also materializes for the power iteration)."""
+    from ..ops.linalg import pca_lowrank as _dense_pca
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    return _dense_pca(dense, q=q, center=center, niter=niter)

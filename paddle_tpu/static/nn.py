@@ -1,0 +1,412 @@
+"""paddle.static.nn — static-graph layer functions.
+
+Reference: python/paddle/static/nn/__init__.py — the static layer API is
+the same compute as the dygraph layers; the program tape records whatever
+ops they dispatch (see static/__init__.py design note).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+    from ..nn.layer.common import Linear
+    from ..nn import functional as F
+    from .. import ops
+    # paddle semantics: flatten dims [num_flatten_dims:] into the
+    # projected axis (base/layers fc)
+    if num_flatten_dims != len(x.shape) - 1:
+        x = ops.flatten(x, start_axis=num_flatten_dims)
+    lin = Linear(x.shape[-1], size)
+    out = lin(x)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, act=None, name=None, **kwargs):
+    from ..nn.layer.conv import Conv2D
+    from ..nn import functional as F
+    conv = Conv2D(input.shape[1], num_filters, filter_size, stride,
+                  padding, dilation, groups)
+    out = conv(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               data_layout="NCHW", name=None, **kwargs):
+    from ..nn.layer.norm import BatchNorm2D
+    from ..nn import functional as F
+    ch_axis = 1 if data_layout == "NCHW" else -1
+    bn = BatchNorm2D(input.shape[ch_axis], momentum=momentum,
+                     epsilon=epsilon, data_format=data_layout)
+    if is_test:
+        bn.eval()
+    out = bn(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, name=None, **kwargs):
+    from ..nn.layer.common import Embedding
+    return Embedding(size[0], size[1], padding_idx=padding_idx)(input)
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, act=None, name=None, **kwargs):
+    from ..nn import functional as F
+    shape = input.shape[begin_norm_axis:]
+    # affine-less LN equals ones/zeros affine — skip the constant tensors
+    out = F.layer_norm(input, shape, weight=None, bias=None,
+                       epsilon=epsilon)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None, **kwargs):
+    from ..nn import functional as F
+    return F.dropout(x, p=dropout_prob, training=not is_test)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, act=None, name=None, **kwargs):
+    from ..nn.layer.conv import Conv3D
+    from ..nn import functional as F
+    out = Conv3D(input.shape[1], num_filters, filter_size, stride, padding,
+                 dilation, groups)(input)
+    return getattr(F, act)(out) if act else out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1, act=None,
+                     name=None, **kwargs):
+    from ..nn.layer.conv import Conv2DTranspose
+    from ..nn import functional as F
+    out = Conv2DTranspose(input.shape[1], num_filters, filter_size, stride,
+                          padding, dilation=dilation, groups=groups)(input)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1, act=None,
+                     name=None, **kwargs):
+    from ..nn.layer.conv import Conv3DTranspose
+    from ..nn import functional as F
+    out = Conv3DTranspose(input.shape[1], num_filters, filter_size, stride,
+                          padding, dilation=dilation, groups=groups)(input)
+    return getattr(F, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from ..nn.layer.norm import GroupNorm
+    from ..nn import functional as F
+    out = GroupNorm(groups, input.shape[1], epsilon=epsilon)(input)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn.layer.norm import InstanceNorm2D
+    return InstanceNorm2D(input.shape[1], epsilon=epsilon)(input)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """reference: static/nn/common.py prelu — alpha shape by mode
+    (all/channel/element)."""
+    import paddle_tpu as _paddle
+    from ..nn import functional as F
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1] if data_format == "NCHW" else x.shape[-1]]
+    elif mode == "element":
+        shape = list(x.shape[1:])
+    else:
+        raise ValueError("mode should be one of 'all', 'channel', 'element'")
+    from ..nn.initializer import Constant
+    alpha = _paddle.create_parameter(shape, "float32", attr=param_attr,
+                                     default_initializer=Constant(0.25))
+    return F.prelu(x, alpha, data_format=data_format)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    from ..nn.layer.common import Bilinear
+    from ..nn import functional as F
+    out = Bilinear(x.shape[-1], y.shape[-1], size)(x, y)
+    return getattr(F, act)(out) if act else out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn.layer.norm import SpectralNorm
+    return SpectralNorm(list(weight.shape), dim=dim, power_iters=power_iters,
+                        epsilon=eps)(weight)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    import paddle_tpu as _paddle
+    from ..vision.ops import deform_conv2d as _dc
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = _paddle.create_parameter(
+        [num_filters, x.shape[1] // groups, k[0], k[1]], "float32",
+        attr=param_attr)
+    return _dc(x, offset, w, mask=mask, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """PS sparse table embedding (reference: static/nn/common.py
+    sparse_embedding). Dense fallback on TPU; the PS path lives in
+    incubate.distributed.ps."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_rate=0.9999999, sync_stats=False,
+              enable_scale_and_shift=False):
+    """CTR data normalization (reference: static/nn/common.py data_norm → phi
+    data_norm kernel): normalize by accumulated batch summaries
+    mean = batch_sum/batch_size, scale = rsqrt(batch_square_sum/batch_size)."""
+    import paddle_tpu as _paddle
+    from ..nn.initializer import Constant
+    C = input.shape[-1] if data_layout == "NHWC" else input.shape[1]
+    batch_size = _paddle.create_parameter([C], "float32",
+                                          default_initializer=Constant(1e4))
+    batch_sum = _paddle.create_parameter([C], "float32",
+                                         default_initializer=Constant(0.0))
+    batch_square_sum = _paddle.create_parameter(
+        [C], "float32", default_initializer=Constant(1e4))
+    mean = batch_sum / batch_size
+    scale = (batch_size / batch_square_sum) ** 0.5
+    out = (input - mean) * scale
+    from ..nn import functional as F
+    return getattr(F, act)(out) if act else out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=5, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference: static/nn/common.py nce →
+    nce op): binary logistic on the true class vs sampled noise classes.
+    Returns per-sample loss [N, 1]."""
+    import paddle_tpu as _paddle
+    import numpy as _np
+    from ..core import random as _random
+    dim = input.shape[-1]
+    w = _paddle.create_parameter([num_total_classes, dim], "float32",
+                                 attr=param_attr)
+    b = _paddle.create_parameter([num_total_classes], "float32",
+                                 attr=bias_attr, is_bias=True)
+    key = _random.next_key()
+    if sampler == "uniform":
+        noise = jax.random.randint(key, (num_neg_samples,), 0,
+                                   num_total_classes)
+        logq = jnp.full((num_neg_samples,),
+                        -_np.log(num_total_classes), jnp.float32)
+    elif sampler == "custom_dist":
+        probs = jnp.asarray(custom_dist, jnp.float32)
+        noise = jax.random.categorical(
+            key, jnp.log(probs + 1e-20), shape=(num_neg_samples,))
+        logq = jnp.log(probs[noise] + 1e-20)
+    else:  # log_uniform
+        u = jax.random.uniform(key, (num_neg_samples,))
+        noise = (jnp.exp(u * _np.log(num_total_classes + 1)) - 1).astype(
+            jnp.int32)
+        noise = jnp.clip(noise, 0, num_total_classes - 1)
+        logq = jnp.log((jnp.log(noise + 2.0) - jnp.log(noise + 1.0))
+                       / _np.log(num_total_classes + 1))
+
+    def fn(x, lbl, wv, bv):
+        lbl = lbl.reshape(-1)
+        pos_logit = jnp.sum(x * wv[lbl], -1) + bv[lbl]
+        pos_loss = jnp.logaddexp(0.0, -pos_logit)  # -log sigmoid(s)
+        neg_logit = x @ wv[noise].T + bv[noise]    # (N, k)
+        neg_loss = jnp.sum(jnp.logaddexp(0.0, neg_logit), -1)
+        return (pos_loss + neg_loss)[:, None]
+    from ..core.tensor import dispatch as _dispatch
+    return _dispatch(fn, (input, label, w, b), {}, name="nce")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference: static/nn/common.py row_conv →
+    phi row_conv kernel): out[t] = sum_{i=0..k} x[t+i] * w[i], per feature."""
+    import paddle_tpu as _paddle
+    from ..nn import functional as F
+    D = input.shape[-1]
+    k = future_context_size
+    w = _paddle.create_parameter([k + 1, D], "float32", attr=param_attr)
+
+    def fn(x, wv):
+        pad = [(0, 0)] * x.ndim
+        pad[-2] = (0, k)
+        xp = jnp.pad(x, pad)
+        out = 0.0
+        for i in range(k + 1):
+            sl = [slice(None)] * x.ndim
+            sl[-2] = slice(i, i + x.shape[-2])
+            out = out + xp[tuple(sl)] * wv[i]
+        return out
+    from ..core.tensor import dispatch as _dispatch
+    out = _dispatch(fn, (input, w), {}, name="row_conv")
+    return getattr(F, act)(out) if act else out
+
+
+# -- control flow (host-evaluated in the eager-tape model) -------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """reference: static/nn/control_flow.py cond. Eager: pred is concrete, so
+    this is host branching (the jit path uses lax.cond via paddle_tpu.jit)."""
+    import numpy as _np
+    taken = bool(_np.asarray(pred._value if hasattr(pred, "_value") else pred))
+    if taken:
+        return true_fn() if true_fn is not None else None
+    return false_fn() if false_fn is not None else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: control_flow.py case — first true predicate wins."""
+    import numpy as _np
+    for pred, fn in pred_fn_pairs:
+        if bool(_np.asarray(pred._value if hasattr(pred, "_value") else pred)):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: control_flow.py switch_case."""
+    import numpy as _np
+    idx = int(_np.asarray(branch_index._value
+                          if hasattr(branch_index, "_value") else branch_index))
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    return fns[max(fns)]()
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """reference: control_flow.py while_loop. Eager host loop; the traced path
+    is lax.while_loop inside jit."""
+    import numpy as _np
+    vars_ = list(loop_vars)
+    while bool(_np.asarray(cond(*vars_)._value)):
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """reference: control_flow.py static_pylayer — custom fwd/bwd pair."""
+    from ..autograd import PyLayer
+    from ..core.tensor import Tensor as _T
+
+    if backward_fn is None:
+        outs = forward_fn(*inputs)
+        return outs
+
+    class _SP(PyLayer):
+        @staticmethod
+        def forward(ctx, *xs):
+            return forward_fn(*xs)
+
+        @staticmethod
+        def backward(ctx, *gs):
+            return backward_fn(*gs)
+
+    return _SP.apply(*inputs)
+
+
+# -- sequence ops on padded [B, T, D] tensors --------------------------------
+# The reference operates on LoD (ragged) tensors; the TPU-native layout is
+# padded-dense (static shapes for XLA), so these reduce over the time axis.
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    from ..nn import functional as F
+    return F.softmax(input, axis=1)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    import paddle_tpu as _paddle
+    pt = pool_type.lower()
+    if pt == "max":
+        return _paddle.max(input, axis=1)
+    if pt in ("average", "avg"):
+        return _paddle.mean(input, axis=1)
+    if pt == "sum":
+        return _paddle.sum(input, axis=1)
+    if pt == "sqrt":
+        T = input.shape[1]
+        return _paddle.sum(input, axis=1) / float(T) ** 0.5
+    if pt == "first":
+        return input[:, 0]
+    if pt == "last":
+        return input[:, -1]
+    raise ValueError(f"unsupported pool_type {pool_type}")
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Padded-dense analog: broadcast x rows to y's time length."""
+    import paddle_tpu as _paddle
+    reps = y.shape[1] if y.ndim > 1 else 1
+    return _paddle.concat([x] * reps, axis=0) if x.ndim == 2 else x
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window conv over time (reference: sequence_conv op): for each
+    t, concat the window rows and project."""
+    import paddle_tpu as _paddle
+    from ..nn import functional as F
+    D = input.shape[-1]
+    w = _paddle.create_parameter([filter_size * D, num_filters], "float32",
+                                 attr=param_attr)
+
+    def fn(x, wv):
+        start = padding_start if padding_start is not None \
+            else -(filter_size // 2)
+        cols = []
+        T = x.shape[1]
+        for i in range(filter_size):
+            shift = start + i
+            if shift < 0:
+                seg = jnp.pad(x[:, :T + shift], ((0, 0), (-shift, 0), (0, 0)))
+            elif shift > 0:
+                seg = jnp.pad(x[:, shift:], ((0, 0), (0, shift), (0, 0)))
+            else:
+                seg = x
+            cols.append(seg)
+        ctx = jnp.concatenate(cols, axis=-1)
+        return ctx @ wv
+    from ..core.tensor import dispatch as _dispatch
+    out = _dispatch(fn, (input, w), {}, name="sequence_conv")
+    return getattr(F, act)(out) if act else out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: static/nn/common.py py_func — re-exported from static."""
+    from . import py_func as _py_func
+    return _py_func(func, x, out, backward_func, skip_vars_in_backward_input)

@@ -1,0 +1,15 @@
+"""paddle.sysconfig — header/library paths for extension builds
+(reference: python/paddle/sysconfig.py)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    import paddle_tpu
+    return os.path.join(os.path.dirname(paddle_tpu.__file__), "include")
+
+
+def get_lib():
+    import paddle_tpu
+    return os.path.join(os.path.dirname(paddle_tpu.__file__), "libs")

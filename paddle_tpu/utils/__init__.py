@@ -86,3 +86,23 @@ class dlpack:
                 "by this jax version")
         return Tensor(jnp.from_dlpack(ext_array))
 from . import cpp_extension  # noqa: E402,F401
+
+
+def require_version(min_version, max_version=None):
+    """reference: utils/install_check.py require_version — assert the
+    installed framework version is in [min_version, max_version]."""
+    from .. import version as _version
+
+    def parts(v):
+        return [int(x.split("-")[0]) for x in str(v).split(".")[:3]
+                if x.split("-")[0].isdigit()]
+
+    cur = parts(_version.full_version)
+    if min_version and parts(min_version) > cur:
+        raise Exception(
+            f"VersionError: paddle version {_version.full_version} is below "
+            f"the required minimum {min_version}")
+    if max_version and parts(max_version) < cur:
+        raise Exception(
+            f"VersionError: paddle version {_version.full_version} is above "
+            f"the required maximum {max_version}")

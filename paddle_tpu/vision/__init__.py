@@ -2,3 +2,39 @@ from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from . import ops  # noqa: F401
+
+
+_IMAGE_BACKEND = "pil"
+
+
+def set_image_backend(backend):
+    """reference: vision/image.py set_image_backend — 'pil' or 'cv2'."""
+    global _IMAGE_BACKEND
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], but got "
+            f"{backend}")
+    _IMAGE_BACKEND = backend
+
+
+def get_image_backend():
+    return _IMAGE_BACKEND
+
+
+def image_load(path, backend=None):
+    """reference: vision/image.py image_load."""
+    backend = backend or _IMAGE_BACKEND
+    if backend == "cv2":
+        try:
+            import cv2
+            return cv2.imread(path)
+        except ImportError as e:
+            raise RuntimeError("cv2 backend requires opencv-python") from e
+    from PIL import Image
+    img = Image.open(path)
+    if backend == "tensor":
+        import numpy as np
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        return Tensor(jnp.asarray(np.asarray(img)))
+    return img

@@ -189,3 +189,77 @@ class DatasetFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+def _default_image_loader(path):
+    if path.lower().endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    return Image.open(path).convert("RGB")
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive folder of images, no labels (reference:
+    vision/datasets/folder.py ImageFolder — yields [img], unlike
+    DatasetFolder's (img, label))."""
+
+    IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        _require(root, "ImageFolder", "root=")
+        extensions = extensions or self.IMG_EXTENSIONS
+        self.samples = []
+        for base, _dirs, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(base, fname)
+                if is_valid_file is not None:
+                    if not is_valid_file(path):
+                        continue
+                elif not fname.lower().endswith(tuple(extensions)):
+                    continue
+                self.samples.append(path)
+        self.loader = loader or _default_image_loader
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference: vision/datasets/voc2012.py)
+    over a local extracted VOCdevkit directory: yields (image, label-mask)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend=None):
+        import os
+        _require(data_file, "VOC2012", "data_file= (extracted VOCdevkit root)")
+        root = data_file
+        seg_dir = os.path.join(root, "VOC2012", "ImageSets", "Segmentation")
+        list_file = {"train": "train.txt", "valid": "val.txt",
+                     "test": "val.txt"}[mode]
+        with open(os.path.join(seg_dir, list_file)) as f:
+            names = [ln.strip() for ln in f if ln.strip()]
+        self.images = [os.path.join(root, "VOC2012", "JPEGImages",
+                                    n + ".jpg") for n in names]
+        self.labels = [os.path.join(root, "VOC2012", "SegmentationClass",
+                                    n + ".png") for n in names]
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        img = np.asarray(Image.open(self.images[idx]).convert("RGB"))
+        lbl = np.asarray(Image.open(self.labels[idx]))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self.images)

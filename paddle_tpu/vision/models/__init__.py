@@ -15,7 +15,8 @@ from .densenet_inception import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264,
     GoogLeNet, googlenet, InceptionV3, inception_v3,
     ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5, shufflenet_v2_x1_0,
-    shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    shufflenet_v2_x1_5, shufflenet_v2_x2_0, shufflenet_v2_x0_33,
+    shufflenet_v2_swish,
 )
 from .resnet import _resnet as _resnet_factory  # noqa: F401
 
@@ -30,3 +31,15 @@ def resnext152_32x4d(pretrained=False, **kwargs):
 
 def wide_resnet101_2(pretrained=False, **kwargs):
     return _resnet_factory(BottleneckBlock, 101, width=128, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnet_factory(BottleneckBlock, 50, groups=64, width=4, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnet_factory(BottleneckBlock, 101, groups=64, width=4, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnet_factory(BottleneckBlock, 152, groups=64, width=4, **kwargs)

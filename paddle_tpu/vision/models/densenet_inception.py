@@ -361,7 +361,8 @@ class _ShuffleUnit(Layer):
 
 
 _SHUFFLE_CFG = {
-    0.25: (24, (24, 48, 96), 512), 0.5: (24, (48, 96, 192), 1024),
+    0.25: (24, (24, 48, 96), 512), 0.33: (24, (32, 64, 128), 512),
+    0.5: (24, (48, 96, 192), 1024),
     1.0: (24, (116, 232, 464), 1024), 1.5: (24, (176, 352, 704), 1024),
     2.0: (24, (244, 488, 976), 2048),
 }
@@ -420,3 +421,13 @@ def shufflenet_v2_x1_5(pretrained=False, **kw):
 
 def shufflenet_v2_x2_0(pretrained=False, **kw):
     return ShuffleNetV2(2.0, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    """reference: vision/models/shufflenetv2.py shufflenet_v2_swish — the
+    1.0x net with swish activations."""
+    return ShuffleNetV2(1.0, act="swish", **kw)

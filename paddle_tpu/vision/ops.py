@@ -359,3 +359,409 @@ class DeformConv2D(Layer):
         stride, padding, dilation, dg, groups = self._cfg
         return deform_conv2d(x, offset, self.weight, self.bias, stride,
                              padding, dilation, dg, groups, mask)
+
+
+# ---------------------------------------------------------------------------
+# Detection ops (reference: python/paddle/vision/ops.py → phi detection
+# kernels). Box post-processing (prior/coder/nms/proposals) is host-side
+# numpy — it is control-flow heavy and gradient-free; yolo_loss keeps its
+# compute on device (dispatch) so the head gets gradients.
+# ---------------------------------------------------------------------------
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (reference: vision/ops.py prior_box → prior_box op)."""
+    H, W = int(input.shape[2]), int(input.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            cell = []
+            for i, ms in enumerate(min_sizes):
+                if min_max_aspect_ratios_order:
+                    cell.append((cx, cy, ms, ms))
+                    if max_sizes:
+                        bs = float(np.sqrt(ms * max_sizes[i]))
+                        cell.append((cx, cy, bs, bs))
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        cell.append((cx, cy, ms * np.sqrt(ar),
+                                     ms / np.sqrt(ar)))
+                else:
+                    for ar in ars:
+                        cell.append((cx, cy, ms * np.sqrt(ar),
+                                     ms / np.sqrt(ar)))
+                    if max_sizes:
+                        bs = float(np.sqrt(ms * max_sizes[i]))
+                        cell.append((cx, cy, bs, bs))
+            for cx_, cy_, bw, bh in cell:
+                box = [(cx_ - bw / 2) / img_w, (cy_ - bh / 2) / img_h,
+                       (cx_ + bw / 2) / img_w, (cy_ + bh / 2) / img_h]
+                if clip:
+                    box = [min(max(v, 0.0), 1.0) for v in box]
+                boxes.append(box)
+    num_priors = len(boxes) // (H * W)
+    out = np.asarray(boxes, np.float32).reshape(H, W, num_priors, 4)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (reference: vision/ops.py box_coder
+    → phi box_coder kernel)."""
+    pb = np.asarray(prior_box._value, np.float32)
+    pbv = None if prior_box_var is None else np.asarray(
+        prior_box_var._value if hasattr(prior_box_var, "_value")
+        else prior_box_var, np.float32)
+    tb = np.asarray(target_box._value, np.float32)
+    norm = 0 if box_normalized else 1
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw / 2
+        tcy = tb[:, 1] + th / 2
+        # every target against every prior
+        out = np.zeros((tb.shape[0], pb.shape[0], 4), np.float32)
+        out[..., 0] = (tcx[:, None] - pcx[None]) / pw[None]
+        out[..., 1] = (tcy[:, None] - pcy[None]) / ph[None]
+        out[..., 2] = np.log(np.abs(tw[:, None] / pw[None]))
+        out[..., 3] = np.log(np.abs(th[:, None] / ph[None]))
+        if pbv is not None:
+            out = out / (pbv.reshape(1, -1, 4) if pbv.ndim == 2
+                         else pbv.reshape(1, 1, 4))
+    else:  # decode_center_size
+        # target_box (N, M, 4) deltas decoded against priors along `axis`
+        if pbv is not None and pbv.ndim == 1:
+            pbv = np.broadcast_to(pbv, pb.shape).copy()
+        deltas = tb
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (v[None, :] for v in (pw, ph, pcx, pcy))
+            var = pbv[None] if pbv is not None else 1.0
+        else:
+            pw_, ph_, pcx_, pcy_ = (v[:, None] for v in (pw, ph, pcx, pcy))
+            var = pbv[:, None] if pbv is not None else 1.0
+        d = deltas * var if pbv is not None else deltas
+        dcx = d[..., 0] * pw_ + pcx_
+        dcy = d[..., 1] * ph_ + pcy_
+        dw = np.exp(d[..., 2]) * pw_
+        dh = np.exp(d[..., 3]) * ph_
+        out = np.stack([dcx - dw / 2, dcy - dh / 2,
+                        dcx + dw / 2 - norm, dcy + dh / 2 - norm], axis=-1)
+    return Tensor(jnp.asarray(out))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode a YOLOv3 head (reference: vision/ops.py yolo_box → yolo_box op):
+    returns (boxes [N, H*W*na, 4], scores [N, H*W*na, class_num])."""
+    xv = np.asarray(x._value, np.float32)
+    imgs = np.asarray(img_size._value if hasattr(img_size, "_value")
+                      else img_size)
+    N, C, H, W = xv.shape
+    na = len(anchors) // 2
+    an = np.asarray(anchors, np.float32).reshape(na, 2)
+    if iou_aware:
+        ioup = 1 / (1 + np.exp(-xv[:, :na]))
+        xv = xv[:, na:]
+    feat = xv.reshape(N, na, 5 + class_num, H, W)
+    gx, gy = np.meshgrid(np.arange(W), np.arange(H))
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    bx = (sig(feat[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1) + gx) / W
+    by = (sig(feat[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1) + gy) / H
+    input_size = downsample_ratio * H
+    bw = np.exp(feat[:, :, 2]) * an[None, :, 0, None, None] / input_size
+    bh = np.exp(feat[:, :, 3]) * an[None, :, 1, None, None] / input_size
+    conf = sig(feat[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) * \
+            ioup.reshape(N, na, H, W) ** iou_aware_factor
+    cls = sig(feat[:, :, 5:]) * conf[:, :, None]
+    boxes = np.zeros((N, na, H, W, 4), np.float32)
+    for n in range(N):
+        ih, iw = imgs[n, 0], imgs[n, 1]
+        boxes[n, ..., 0] = (bx[n] - bw[n] / 2) * iw
+        boxes[n, ..., 1] = (by[n] - bh[n] / 2) * ih
+        boxes[n, ..., 2] = (bx[n] + bw[n] / 2) * iw
+        boxes[n, ..., 3] = (by[n] + bh[n] / 2) * ih
+        if clip_bbox:
+            boxes[n, ..., 0::2] = boxes[n, ..., 0::2].clip(0, iw - 1)
+            boxes[n, ..., 1::2] = boxes[n, ..., 1::2].clip(0, ih - 1)
+    mask = conf > conf_thresh
+    cls = np.where(mask[:, :, None], cls, 0.0)
+    boxes = boxes.transpose(0, 1, 2, 3, 4).reshape(N, na * H * W, 4)
+    scores = cls.transpose(0, 1, 3, 4, 2).reshape(N, na * H * W, class_num)
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(scores))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference: vision/ops.py yolo_loss → yolov3_loss kernel):
+    best-anchor assignment on host (data-dependent), box/obj/class losses on
+    device so x gets gradients."""
+    gt_b = np.asarray(gt_box._value, np.float32)       # (N, B, 4) cx cy w h (normalized)
+    gt_l = np.asarray(gt_label._value, np.int64)       # (N, B)
+    gt_s = (np.asarray(gt_score._value, np.float32) if gt_score is not None
+            else (gt_b[..., 2] > 0).astype(np.float32))
+    N, C, H, W = x.shape
+    na = len(anchor_mask)
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an = an_all[np.asarray(anchor_mask)]
+    input_size = downsample_ratio * H
+
+    # host: assign each gt to (best masked anchor, grid cell)
+    tx = np.zeros((N, na, H, W), np.float32)
+    ty = np.zeros_like(tx)
+    tw = np.zeros_like(tx)
+    th = np.zeros_like(tx)
+    tobj = np.zeros_like(tx)
+    tscale = np.zeros_like(tx)
+    tcls = np.zeros((N, na, H, W, class_num), np.float32)
+    for n in range(N):
+        for b in range(gt_b.shape[1]):
+            if gt_b[n, b, 2] <= 0 or gt_b[n, b, 3] <= 0:
+                continue
+            gw = gt_b[n, b, 2] * input_size
+            gh = gt_b[n, b, 3] * input_size
+            inter = np.minimum(gw, an_all[:, 0]) * np.minimum(gh, an_all[:, 1])
+            iou = inter / (gw * gh + an_all[:, 0] * an_all[:, 1] - inter)
+            best = int(np.argmax(iou))
+            if best not in list(anchor_mask):
+                continue
+            k = list(anchor_mask).index(best)
+            gi = min(int(gt_b[n, b, 0] * W), W - 1)
+            gj = min(int(gt_b[n, b, 1] * H), H - 1)
+            tx[n, k, gj, gi] = gt_b[n, b, 0] * W - gi
+            ty[n, k, gj, gi] = gt_b[n, b, 1] * H - gj
+            tw[n, k, gj, gi] = np.log(gw / an[k, 0])
+            th[n, k, gj, gi] = np.log(gh / an[k, 1])
+            tscale[n, k, gj, gi] = (2.0 - gt_b[n, b, 2] * gt_b[n, b, 3]) * \
+                gt_s[n, b]
+            tobj[n, k, gj, gi] = gt_s[n, b]
+            lbl = int(gt_l[n, b])
+            if use_label_smooth:
+                # kernel semantics: on-class 1-δ, off-class δ/(C-1), δ=1/C
+                delta = 1.0 / max(class_num, 1)
+                if class_num > 1:
+                    tcls[n, k, gj, gi, :] = delta / (class_num - 1)
+                tcls[n, k, gj, gi, lbl] = 1.0 - delta
+            else:
+                tcls[n, k, gj, gi, lbl] = 1.0
+
+    targets = [jnp.asarray(t) for t in
+               (tx, ty, tw, th, tobj, tscale, tcls)]
+
+    def fn(xv):
+        feat = xv.reshape(N, na, 5 + class_num, H, W)
+        px, py = feat[:, :, 0], feat[:, :, 1]
+        pw, ph = feat[:, :, 2], feat[:, :, 3]
+        pobj = feat[:, :, 4]
+        pcls = jnp.moveaxis(feat[:, :, 5:], 2, -1)
+        txv, tyv, twv, thv, tobjv, tscalev, tclsv = targets
+        bce = lambda z, t: jnp.logaddexp(0.0, z) - t * z
+        pos = tobjv > 0
+        loss_xy = jnp.where(pos, tscalev * (bce(px, txv) + bce(py, tyv)), 0.0)
+        loss_wh = jnp.where(
+            pos, 0.5 * tscalev * ((pw - twv) ** 2 + (ph - thv) ** 2), 0.0)
+        loss_obj = bce(pobj, tobjv)
+        # ignore predictions overlapping any gt above ignore_thresh:
+        # approximated by not penalizing positive cells twice (the kernel
+        # computes pred-gt IoU; positives dominate that set)
+        loss_cls = jnp.where(pos[..., None], bce(pcls, tclsv), 0.0)
+        per_img = (loss_xy.sum(axis=(1, 2, 3)) + loss_wh.sum(axis=(1, 2, 3)) +
+                   loss_obj.sum(axis=(1, 2, 3)) +
+                   loss_cls.sum(axis=(1, 2, 3, 4)))
+        return per_img
+    return dispatch(fn, (x,), {}, name="yolo_loss")
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """SOLOv2 matrix NMS (reference: vision/ops.py matrix_nms → matrix_nms
+    kernel): decay each box's score by its IoU with higher-scored peers."""
+    bb = np.asarray(bboxes._value, np.float32)   # (N, M, 4)
+    sc = np.asarray(scores._value, np.float32)   # (N, C, M)
+    all_out, all_idx, rois_num = [], [], []
+    N, C, M = sc.shape
+    for n in range(N):
+        dets = []
+        idxs = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            keep = np.nonzero(sc[n, c] > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[n, c, keep])][:nms_top_k]
+            boxes_c = bb[n, order]
+            scores_c = sc[n, c, order]
+            ious = _box_iou_matrix(boxes_c, boxes_c)
+            ious = np.triu(ious, 1)
+            ious_cmax = ious.max(0)
+            if use_gaussian:
+                decay = np.exp(-(ious ** 2 - ious_cmax[None] ** 2)
+                               / gaussian_sigma).min(0)
+            else:
+                decay = ((1 - ious) / (1 - ious_cmax[None] + 1e-10)).min(0)
+            dec_scores = scores_c * decay
+            m = dec_scores > post_threshold
+            for i in np.nonzero(m)[0]:
+                dets.append([c, dec_scores[i], *boxes_c[i]])
+                idxs.append(n * M + order[i])
+        if dets:
+            dets = np.asarray(dets, np.float32)
+            take = np.argsort(-dets[:, 1])
+            if keep_top_k > 0:
+                take = take[:keep_top_k]
+            dets = dets[take]
+            idxs = np.asarray(idxs)[take]
+        else:
+            dets = np.zeros((0, 6), np.float32)
+            idxs = np.zeros((0,), np.int64)
+        all_out.append(dets)
+        all_idx.append(idxs)
+        rois_num.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(all_out, 0)))
+    ret = [out]
+    if return_index:
+        ret.append(Tensor(jnp.asarray(np.concatenate(all_idx, 0))))
+    if return_rois_num:
+        ret.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32))))
+    return tuple(ret) if len(ret) > 1 else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by scale (reference: vision/ops.py
+    distribute_fpn_proposals kernel: level = floor(log2(sqrt(area)/refer_scale
+    + eps)) + refer_level)."""
+    rois = np.asarray(fpn_rois._value, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    num_lvl = max_level - min_level + 1
+    multi_rois, restore = [], np.zeros(len(rois), np.int64)
+    rois_num_per = []
+    cursor = 0
+    for L in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == L)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[sel])))
+        restore[sel] = np.arange(cursor, cursor + len(sel))
+        rois_num_per.append(Tensor(jnp.asarray(
+            np.asarray([len(sel)], np.int32))))
+        cursor += len(sel)
+    restore_ind = Tensor(jnp.asarray(restore.reshape(-1, 1)))
+    if rois_num is not None:
+        return multi_rois, restore_ind, rois_num_per
+    return multi_rois, restore_ind
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference: vision/ops.py generate_proposals
+    kernel): decode → clip → filter → NMS, per image."""
+    sc = np.asarray(scores._value, np.float32)        # (N, A, H, W)
+    bd = np.asarray(bbox_deltas._value, np.float32)   # (N, 4A, H, W)
+    ims = np.asarray(img_size._value, np.float32)     # (N, 2)
+    anc = np.asarray(anchors._value if hasattr(anchors, "_value")
+                     else anchors, np.float32).reshape(-1, 4)
+    var = np.asarray(variances._value if hasattr(variances, "_value")
+                     else variances, np.float32).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    out_rois, out_probs, out_num = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).ravel()
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], anc[order % len(anc)], \
+            var[order % len(var)]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        dcx = v[:, 0] * d[:, 0] * aw + acx
+        dcy = v[:, 1] * d[:, 1] * ah + acy
+        dw = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        dh = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        props = np.stack([dcx - dw / 2, dcy - dh / 2,
+                          dcx + dw / 2 - off, dcy + dh / 2 - off], -1)
+        ih, iw = ims[n]
+        props[:, 0::2] = props[:, 0::2].clip(0, iw - off)
+        props[:, 1::2] = props[:, 1::2].clip(0, ih - off)
+        keep = np.nonzero((props[:, 2] - props[:, 0] + off >= min_size) &
+                          (props[:, 3] - props[:, 1] + off >= min_size))[0]
+        props, s = props[keep], s[keep]
+        # nms
+        sel = []
+        order2 = np.argsort(-s)
+        while order2.size and len(sel) < post_nms_top_n:
+            i = order2[0]
+            sel.append(i)
+            if order2.size == 1:
+                break
+            ious = _box_iou_matrix(props[i:i + 1], props[order2[1:]])[0]
+            order2 = order2[1:][ious <= nms_thresh]
+        out_rois.append(props[sel])
+        out_probs.append(s[sel])
+        out_num.append(len(sel))
+    rois = Tensor(jnp.asarray(np.concatenate(out_rois, 0)))
+    probs = Tensor(jnp.asarray(np.concatenate(out_probs, 0)[:, None]))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(out_num, np.int32)))
+    return rois, probs
+
+
+def read_file(filename, name=None):
+    """reference: vision/ops.py read_file — file bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference: vision/ops.py decode_jpeg (nvjpeg) — host PIL decode to a
+    CHW uint8 tensor."""
+    import io
+    from PIL import Image
+    data = bytes(np.asarray(x._value, np.uint8).tobytes())
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -468,3 +468,229 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
 
 def to_grayscale(img, num_output_channels=1):
     return Grayscale(num_output_channels)._apply_image(img)
+
+
+def _inverse_sample(x, ys, xs, interpolation, fill):
+    """Sample HWC image x at source coords (ys, xs); out-of-bounds -> fill."""
+    h, w = x.shape[:2]
+    if interpolation == "nearest":
+        yi = np.round(ys).astype(int)
+        xi = np.round(xs).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        out = np.full(ys.shape + (x.shape[2],), float(fill), np.float32)
+        out[valid] = x[yi[valid], xi[valid]]
+        return out
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    wy = (ys - y0)[..., None]
+    wx = (xs - x0)[..., None]
+
+    def take(yi, xi):
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        out = np.full(yi.shape + (x.shape[2],), float(fill), np.float32)
+        out[valid] = x[yi[valid], xi[valid]]
+        return out
+    top = take(y0, x0) * (1 - wx) + take(y0, x0 + 1) * wx
+    bot = take(y0 + 1, x0) * (1 - wx) + take(y0 + 1, x0 + 1) * wx
+    return top * (1 - wy) + bot * wy
+
+
+def adjust_hue(img, hue_factor):
+    """Functional hue shift (reference: transforms/functional.py adjust_hue).
+    hue_factor in [-0.5, 0.5]."""
+    t = HueTransform(abs(hue_factor) if hue_factor else 0.0)
+    if hue_factor == 0:
+        return img
+    # reuse the HSV round-trip with a fixed shift
+    arr = _to_np(img).astype(np.float32)
+    hwc = _is_hwc(arr)
+    x = arr if hwc else np.moveaxis(arr, -3, -1)
+    scaled = x.max() > 1.5
+    xf = x / 255.0 if scaled else x
+    mx, mn = xf.max(-1), xf.min(-1)
+    diff = mx - mn + 1e-10
+    r, g, b = xf[..., 0], xf[..., 1], xf[..., 2]
+    hch = np.where(mx == r, (g - b) / diff % 6,
+                   np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4))
+    hch = (hch / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-10), 0)
+    v = mx
+    i = np.floor(hch * 6.0)
+    f = hch * 6.0 - i
+    p, q, tt = v * (1 - s), v * (1 - s * f), v * (1 - s * (1 - f))
+    i = (i.astype(int) % 6)[..., None]
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, tt, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, tt], -1), np.stack([p, q, v], -1),
+         np.stack([tt, p, v], -1), np.stack([v, p, q], -1)])
+    if scaled:
+        out = out * 255.0
+    out = out if hwc else np.moveaxis(out, -1, -3)
+    return _wrap_like(img, out.astype(np.float32))
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase a region with value v (reference: transforms/functional.py
+    erase)."""
+    arr = _to_np(img).astype(np.float32)
+    hwc = _is_hwc(arr)
+    x = arr if hwc else np.moveaxis(arr, -3, -1)
+    if not inplace:
+        x = x.copy()
+    x[i:i + h, j:j + w] = np.asarray(v, np.float32).reshape(
+        (1, 1, -1)) if np.ndim(v) else float(np.asarray(v))
+    out = x if hwc else np.moveaxis(x, -1, -3)
+    return _wrap_like(img, out)
+
+
+def _affine_inverse_coords(h, w, angle, translate, scale, shear, center):
+    """Inverse affine map: output pixel -> source pixel (torch/paddle
+    parameterization: rotate+shear+scale about center, then translate)."""
+    cy, cx = center
+    a = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    # forward 2x2: rotation composed with x/y shear, scaled
+    R = np.array([[np.cos(a), -np.sin(a)], [np.sin(a), np.cos(a)]])
+    Sh = np.array([[1, -np.tan(sx)], [0, 1]]) @ np.array(
+        [[1, 0], [-np.tan(sy), 1]])
+    M = scale * (R @ Sh)
+    Minv = np.linalg.inv(M)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    # output coords relative to center+translate
+    dx = xx - cx - translate[0]
+    dy = yy - cy - translate[1]
+    xs = Minv[0, 0] * dx + Minv[0, 1] * dy + cx
+    ys = Minv[1, 0] * dx + Minv[1, 1] * dy + cy
+    return ys, xs
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine warp (reference: transforms/functional.py affine)."""
+    arr = _to_np(img).astype(np.float32)
+    hwc = _is_hwc(arr)
+    x = arr if hwc else np.moveaxis(arr, -3, -1)
+    h, w = x.shape[:2]
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    if center is None:
+        center = ((h - 1) / 2.0, (w - 1) / 2.0)
+    else:
+        center = (center[1], center[0])
+    ys, xs = _affine_inverse_coords(h, w, angle, translate, scale, shear,
+                                    center)
+    out = _inverse_sample(x, ys, xs, interpolation, fill)
+    out = out if hwc else np.moveaxis(out, -1, -3)
+    return _wrap_like(img, out)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Homography mapping endpoints -> startpoints (inverse warp)."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.extend([sx, sy])
+    return np.linalg.solve(np.asarray(a, np.float64),
+                           np.asarray(b, np.float64))
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Perspective warp by 4 point pairs (reference: transforms/functional.py
+    perspective)."""
+    arr = _to_np(img).astype(np.float32)
+    hwc = _is_hwc(arr)
+    x = arr if hwc else np.moveaxis(arr, -3, -1)
+    h, w = x.shape[:2]
+    c = _perspective_coeffs(startpoints, endpoints)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    denom = c[6] * xx + c[7] * yy + 1.0
+    xs = (c[0] * xx + c[1] * yy + c[2]) / denom
+    ys = (c[3] * xx + c[4] * yy + c[5]) / denom
+    out = _inverse_sample(x, ys, xs, interpolation, fill)
+    out = out if hwc else np.moveaxis(out, -1, -3)
+    return _wrap_like(img, out)
+
+
+class Transpose(BaseTransform):
+    """HWC -> CHW (reference: transforms/transforms.py Transpose)."""
+
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return _wrap_like(img, np.transpose(arr, self.order))
+
+
+class RandomAffine(BaseTransform):
+    """reference: transforms/transforms.py RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        h, w = (arr.shape[:2] if _is_hwc(arr) else arr.shape[-2:])
+        angle = np.random.uniform(*self.degrees)
+        translate = (0.0, 0.0)
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+            translate = (tx, ty)
+        scale = np.random.uniform(*self.scale) if self.scale else 1.0
+        shear = (0.0, 0.0)
+        if self.shear is not None:
+            sh = self.shear
+            if isinstance(sh, numbers.Number):
+                sh = (-sh, sh)
+            if len(sh) == 2:
+                shear = (np.random.uniform(sh[0], sh[1]), 0.0)
+            else:
+                shear = (np.random.uniform(sh[0], sh[1]),
+                         np.random.uniform(sh[2], sh[3]))
+        return affine(img, angle, translate, scale, shear,
+                      self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """reference: transforms/transforms.py RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = _to_np(img)
+        h, w = (arr.shape[:2] if _is_hwc(arr) else arr.shape[-2:])
+        d = self.distortion_scale
+        half_h, half_w = h // 2, w // 2
+        tl = (np.random.randint(0, int(d * half_w) + 1),
+              np.random.randint(0, int(d * half_h) + 1))
+        tr = (w - 1 - np.random.randint(0, int(d * half_w) + 1),
+              np.random.randint(0, int(d * half_h) + 1))
+        br = (w - 1 - np.random.randint(0, int(d * half_w) + 1),
+              h - 1 - np.random.randint(0, int(d * half_h) + 1))
+        bl = (np.random.randint(0, int(d * half_w) + 1),
+              h - 1 - np.random.randint(0, int(d * half_h) + 1))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [tl, tr, br, bl]
+        return perspective(img, start, end, self.interpolation, self.fill)

@@ -1,0 +1,268 @@
+"""linalg/sparse/geometric/incubate long tail."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.linalg as L
+import paddle_tpu.sparse as sparse
+import paddle_tpu.geometric as geo
+import paddle_tpu.incubate as incubate
+
+torch = pytest.importorskip("torch")
+
+
+def t2n(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+# -- linalg -------------------------------------------------------------------
+
+def test_cholesky_inverse_matches_torch(rng):
+    a = rng.standard_normal((4, 4)).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    lo = np.linalg.cholesky(spd).astype(np.float32)
+    ours = t2n(L.cholesky_inverse(paddle.to_tensor(lo)))
+    ref = torch.cholesky_inverse(torch.tensor(lo)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-5)
+    up = lo.T.copy()
+    ours_u = t2n(L.cholesky_inverse(paddle.to_tensor(up), upper=True))
+    np.testing.assert_allclose(ours_u, ref, rtol=1e-3, atol=1e-5)
+
+
+def test_vecdot_matrix_transpose_svdvals(rng):
+    x = rng.standard_normal((3, 5)).astype(np.float32)
+    y = rng.standard_normal((3, 5)).astype(np.float32)
+    np.testing.assert_allclose(t2n(L.vecdot(paddle.to_tensor(x),
+                                            paddle.to_tensor(y))),
+                               (x * y).sum(-1), rtol=1e-5)
+    np.testing.assert_allclose(t2n(L.matrix_transpose(paddle.to_tensor(x))),
+                               x.T)
+    np.testing.assert_allclose(t2n(L.svdvals(paddle.to_tensor(x))),
+                               np.linalg.svd(x, compute_uv=False), rtol=1e-4)
+
+
+def test_matrix_exp_matches_scipy(rng):
+    from scipy.linalg import expm
+    a = rng.standard_normal((4, 4)).astype(np.float32) * 0.3
+    np.testing.assert_allclose(t2n(L.matrix_exp(paddle.to_tensor(a))),
+                               expm(a), rtol=1e-4, atol=1e-5)
+
+
+def test_lu_unpack_reconstructs(rng):
+    a = rng.standard_normal((5, 5)).astype(np.float32)
+    lu_data, pivots = L.lu(paddle.to_tensor(a))[:2]
+    P, Lo, U = L.lu_unpack(lu_data, pivots)
+    recon = t2n(P) @ t2n(Lo) @ t2n(U)
+    np.testing.assert_allclose(recon, a, rtol=1e-4, atol=1e-5)
+
+
+def test_ormqr_matches_torch(rng):
+    a = rng.standard_normal((5, 3)).astype(np.float32)
+    h, tau = torch.geqrf(torch.tensor(a))
+    y = rng.standard_normal((5, 2)).astype(np.float32)
+    out = t2n(L.ormqr(paddle.to_tensor(h.numpy()),
+                      paddle.to_tensor(tau.numpy()),
+                      paddle.to_tensor(y)))
+    ref = torch.ormqr(h, tau, torch.tensor(y)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    out_t = t2n(L.ormqr(paddle.to_tensor(h.numpy()),
+                        paddle.to_tensor(tau.numpy()),
+                        paddle.to_tensor(y), transpose=True))
+    ref_t = torch.ormqr(h, tau, torch.tensor(y), transpose=True).numpy()
+    np.testing.assert_allclose(out_t, ref_t, rtol=1e-4, atol=1e-5)
+
+
+def test_svd_lowrank_and_pca(rng):
+    # low-rank matrix: randomized SVD must recover it accurately
+    u = rng.standard_normal((20, 3)).astype(np.float32)
+    v = rng.standard_normal((3, 15)).astype(np.float32)
+    a = u @ v
+    U, S, V = L.svd_lowrank(paddle.to_tensor(a), q=5, niter=3)
+    recon = t2n(U) @ np.diag(t2n(S)) @ t2n(V).T
+    np.testing.assert_allclose(recon, a, rtol=1e-3, atol=1e-3)
+    U2, S2, V2 = L.pca_lowrank(paddle.to_tensor(a), q=4)
+    assert t2n(S2).shape == (4,)
+
+
+def test_fp8_gemm(rng):
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    y = rng.standard_normal((8, 6)).astype(np.float32)
+    x8 = jnp.asarray(x).astype(jnp.float8_e4m3fn)
+    y8 = jnp.asarray(y).astype(jnp.float8_e4m3fn)
+    out = L.fp8_fp8_half_gemm_fused(paddle.to_tensor(x8),
+                                    paddle.to_tensor(y8),
+                                    output_dtype="bfloat16")
+    ref = np.asarray(x8, np.float32) @ np.asarray(y8, np.float32)
+    np.testing.assert_allclose(t2n(out).astype(np.float32), ref,
+                               rtol=0.1, atol=0.5)
+
+
+def test_linalg_diagonal(rng):
+    x = rng.standard_normal((4, 4)).astype(np.float32)
+    np.testing.assert_allclose(t2n(L.diagonal(paddle.to_tensor(x))),
+                               np.diagonal(x))
+
+
+# -- sparse -------------------------------------------------------------------
+
+def _coo_from_dense(d):
+    idx = np.stack(np.nonzero(d))
+    vals = d[tuple(idx)]
+    return sparse.sparse_coo_tensor(idx, vals, d.shape)
+
+
+def test_sparse_isnan_mask_as_slice(rng):
+    d = np.zeros((4, 5), np.float32)
+    d[0, 1], d[2, 3], d[3, 0] = 1.5, np.nan, -2.0
+    s = _coo_from_dense(np.nan_to_num(d, nan=7.0))
+    # isnan on stored values
+    sn = sparse.isnan(_coo_from_dense(np.where(np.isnan(d), np.nan,
+                                               np.nan_to_num(d))))
+    assert t2n(sn.values()).dtype == bool
+    # mask_as: dense sampled at mask pattern
+    dense = paddle.to_tensor(rng.standard_normal((4, 5)).astype(np.float32))
+    m = sparse.mask_as(dense, s)
+    np.testing.assert_allclose(t2n(m.values()),
+                               t2n(dense)[tuple(np.asarray(
+                                   t2n(s.indices()), int))])
+    # slice
+    sl = sparse.slice(s, [0, 1], [1, 0], [4, 4])
+    sd = t2n(sl.to_dense())
+    np.testing.assert_allclose(sd, np.nan_to_num(d, nan=7.0)[1:4, 0:4])
+
+
+def test_sparse_pca_lowrank():
+    d = np.zeros((10, 8), np.float32)
+    d[0, 1], d[3, 4] = 2.0, -1.0
+    s = _coo_from_dense(d)
+    U, S, V = sparse.pca_lowrank(s, q=2)
+    assert t2n(S).shape == (2,)
+
+
+# -- geometric ----------------------------------------------------------------
+
+def test_reindex_heter_graph():
+    x = paddle.to_tensor(np.array([0, 5, 9], np.int64))
+    nb1 = paddle.to_tensor(np.array([5, 7], np.int64))
+    cnt1 = paddle.to_tensor(np.array([1, 1, 0], np.int64))
+    nb2 = paddle.to_tensor(np.array([9, 0, 11], np.int64))
+    cnt2 = paddle.to_tensor(np.array([1, 1, 1], np.int64))
+    src, dst, nodes = geo.reindex_heter_graph(x, [nb1, nb2], [cnt1, cnt2])
+    nd = t2n(nodes).tolist()
+    assert nd[:3] == [0, 5, 9] and set(nd) == {0, 5, 9, 7, 11}
+    # src ids are local indices into nodes
+    orig = [5, 7, 9, 0, 11]
+    np.testing.assert_array_equal([nd[i] for i in t2n(src)], orig)
+
+
+# -- incubate -----------------------------------------------------------------
+
+def test_lookahead_syncs_slow_weights():
+    w = paddle.create_parameter([2], "float32")
+    w._value = jnp.zeros(2)
+    inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    opt = incubate.LookAhead(inner, alpha=0.5, k=2)
+    g = paddle.to_tensor(np.ones(2, np.float32))
+    w.grad = g
+    opt.step()  # fast: -1; slow initialized to -1 (reference lookahead.py:284)
+    np.testing.assert_allclose(t2n(w), -1.0)
+    w.grad = g
+    opt.step()  # fast: -2; k hit: slow = 0.5*(-2) + 0.5*(-1) = -1.5
+    np.testing.assert_allclose(t2n(w), -1.5)
+
+
+def test_model_average_apply_restore():
+    w = paddle.create_parameter([2], "float32")
+    opt = incubate.ModelAverage(0.15, parameters=[w])
+    w._value = jnp.ones(2) * 2.0
+    opt.step()
+    w._value = jnp.ones(2) * 4.0
+    opt.step()
+    with opt.apply():
+        np.testing.assert_allclose(t2n(w), 3.0)
+    np.testing.assert_allclose(t2n(w), 4.0)
+
+
+def test_softmax_mask_fuse(rng):
+    x = rng.standard_normal((2, 2, 4, 4)).astype(np.float32)
+    mask = np.where(rng.random((2, 1, 4, 4)) > 0.5, 0.0, -1e9).astype(np.float32)
+    out = t2n(incubate.softmax_mask_fuse(paddle.to_tensor(x),
+                                         paddle.to_tensor(mask)))
+    ref = torch.softmax(torch.tensor(x + mask), -1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    ut = t2n(incubate.softmax_mask_fuse_upper_triangle(paddle.to_tensor(x)))
+    tri = np.triu(np.ones((4, 4)), 1) * -1e30
+    ref2 = torch.softmax(torch.tensor(x + tri.astype(np.float32)), -1).numpy()
+    np.testing.assert_allclose(ut, ref2, rtol=1e-5, atol=1e-6)
+
+
+def test_identity_loss_and_segment_reexports(rng):
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    assert float(t2n(incubate.identity_loss(x, "mean"))) == 2.0
+    assert float(t2n(incubate.identity_loss(x, "sum"))) == 6.0
+    data = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    seg = paddle.to_tensor(np.array([0, 0, 1], np.int64))
+    np.testing.assert_allclose(t2n(incubate.segment_sum(data, seg)),
+                               [[3.0], [3.0]])
+
+
+def test_graph_legacy_aliases():
+    x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+    dst = paddle.to_tensor(np.array([1, 2, 1], np.int64))
+    out = incubate.graph_send_recv(x, src, dst, pool_type="sum")
+    np.testing.assert_allclose(t2n(out), [[0.0], [4.0], [2.0]])
+
+
+def test_fused_linear_and_dropout_add_layers(rng):
+    import paddle_tpu.incubate.nn as inn
+    lin = inn.FusedLinear(4, 3)
+    x = paddle.to_tensor(rng.standard_normal((2, 4)).astype(np.float32))
+    np.testing.assert_allclose(
+        t2n(lin(x)), t2n(x) @ t2n(lin.weight) + t2n(lin.bias), rtol=1e-5)
+    da = inn.FusedDropoutAdd(p=0.0)
+    y = paddle.to_tensor(rng.standard_normal((2, 4)).astype(np.float32))
+    np.testing.assert_allclose(t2n(da(x, y)), t2n(x) + t2n(y), rtol=1e-6)
+    bd = inn.FusedBiasDropoutResidualLayerNorm(4, dropout_rate=0.0)
+    out = bd(x, y)
+    assert t2n(out).shape == (2, 4)
+
+
+def test_fused_transformer_encoder_layer(rng):
+    import paddle_tpu.incubate.nn as inn
+    layer = inn.FusedTransformerEncoderLayer(8, 2, 16, dropout_rate=0.0)
+    layer.eval()
+    x = paddle.to_tensor(rng.standard_normal((2, 5, 8)).astype(np.float32))
+    out = layer(x)
+    assert t2n(out).shape == (2, 5, 8) and np.isfinite(t2n(out)).all()
+
+
+def test_fused_multi_transformer_with_cache(rng):
+    import paddle_tpu.incubate.nn as inn
+    m = inn.FusedMultiTransformer(8, 2, 16, num_layers=2, trans_qkvw=False)
+    m.eval()
+    x = paddle.to_tensor(rng.standard_normal((1, 4, 8)).astype(np.float32))
+    out = m(x)
+    assert t2n(out).shape == (1, 4, 8) and np.isfinite(t2n(out)).all()
+    # decode with kv cache
+    caches = [paddle.to_tensor(np.zeros((2, 1, 2, 0, 4), np.float32))
+              for _ in range(2)]
+    tok = paddle.to_tensor(rng.standard_normal((1, 1, 8)).astype(np.float32))
+    out2, new_caches = m(tok, caches=caches)
+    assert t2n(out2).shape == (1, 1, 8)
+    assert t2n(new_caches[0]).shape == (2, 1, 2, 1, 4)
+
+
+def test_fused_matmul_bias_and_blha(rng):
+    import paddle_tpu.incubate.nn.functional as innf
+    x = rng.standard_normal((3, 4)).astype(np.float32)
+    y = rng.standard_normal((4, 5)).astype(np.float32)
+    b = rng.standard_normal((5,)).astype(np.float32)
+    out = innf.fused_matmul_bias(paddle.to_tensor(x), paddle.to_tensor(y),
+                                 paddle.to_tensor(b))
+    np.testing.assert_allclose(t2n(out), x @ y + b, rtol=1e-5)
+    enc = paddle.to_tensor(np.array([3, 7, 2], np.int32))
+    dec = paddle.to_tensor(np.array([1, 0, 5], np.int32))
+    me, md = innf.blha_get_max_len(enc, dec, 3)
+    assert int(t2n(me)[0]) == 7 and int(t2n(md)[0]) == 5

@@ -1,0 +1,249 @@
+"""Second-tier namespaces: callbacks, hub, sysconfig, incubate.autograd/
+multiprocessing/layers, fleet base classes, nn.quant.Stub, ImageFolder/VOC,
+amp.debugging.check_layer_numerics, inference enums, rpc worker info."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def t2n(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+def test_callbacks_module():
+    import paddle_tpu.callbacks as cb
+    assert cb.EarlyStopping is not None and cb.Callback is not None
+    with pytest.raises(RuntimeError, match="wandb"):
+        cb.WandbCallback(project="x")
+
+
+def test_hub_local_repo(tmp_path):
+    import paddle_tpu.hub as hub
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def tiny_model(scale=1.0):\n"
+        "    'builds a tiny model'\n"
+        "    return ('model', scale)\n")
+    assert hub.list(str(tmp_path), source="local") == ["tiny_model"]
+    assert "tiny" in hub.help(str(tmp_path), "tiny_model", source="local")
+    assert hub.load(str(tmp_path), "tiny_model", source="local",
+                    scale=2.0) == ("model", 2.0)
+    with pytest.raises(RuntimeError, match="network"):
+        hub.load("o/r", "m", source="github")
+    with pytest.raises(ValueError):
+        hub.list(str(tmp_path), source="bogus")
+
+
+def test_sysconfig_paths():
+    import paddle_tpu.sysconfig as sc
+    assert sc.get_include().endswith("include")
+    assert sc.get_lib().endswith("libs")
+
+
+def test_incubate_autograd_vjp_jvp():
+    import paddle_tpu.incubate.autograd as ag
+
+    def f(x):
+        return x * x
+
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    out, g = ag.vjp(f, x)
+    np.testing.assert_allclose(t2n(out), [4.0, 9.0])
+    np.testing.assert_allclose(t2n(g), [4.0, 6.0])  # 2x * ones
+    out2, tang = ag.jvp(f, x, v=paddle.to_tensor(
+        np.array([1.0, 0.0], np.float32)))
+    np.testing.assert_allclose(t2n(tang), [4.0, 0.0])
+    ag.enable_prim()
+    assert ag.prim_enabled()
+    ag.disable_prim()
+    assert not ag.prim_enabled()
+
+
+def test_incubate_autograd_jacobian():
+    import paddle_tpu.incubate.autograd as ag
+
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    h = ag.Hessian(f, x)
+    np.testing.assert_allclose(np.asarray(h[:]), 2 * np.eye(3), atol=1e-5)
+
+
+def test_incubate_multiprocessing_tensor_pickle():
+    import pickle
+    import paddle_tpu.incubate.multiprocessing as mp
+    t = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    t2 = pickle.loads(pickle.dumps(t))
+    np.testing.assert_allclose(t2n(t2), t2n(t))
+    assert mp.get_sharing_strategy() == "file_system"
+    mp.set_sharing_strategy("file_descriptor")
+    mp.set_sharing_strategy("file_system")
+
+
+def test_incubate_layers(rng):
+    import paddle_tpu.incubate.layers as il
+    x = paddle.to_tensor(rng.standard_normal((4, 6)).astype(np.float32))
+    out = il.shuffle_batch(x, seed=0)
+    assert sorted(t2n(out)[:, 0].tolist()) == sorted(t2n(x)[:, 0].tolist())
+    pc = il.partial_concat([x, x], start_index=1, length=2)
+    assert t2n(pc).shape == (4, 4)
+    ps = il.partial_sum([x, x], start_index=0, length=3)
+    np.testing.assert_allclose(t2n(ps), 2 * t2n(x)[:, :3], rtol=1e-6)
+    lr = il.pow2_decay_with_linear_warmup(10, 100, 0.1, 0.0)
+    assert lr(5) == pytest.approx(0.05)
+    assert lr(100) == pytest.approx(0.0, abs=1e-6)
+    ids = paddle.to_tensor(np.array([[1, 2, 0]], np.int64))
+    emb = il.fused_embedding_seq_pool(ids, (5, 4), padding_idx=0)
+    assert t2n(emb).shape == (1, 4)
+
+
+def test_fleet_base_classes(monkeypatch):
+    import paddle_tpu.distributed as dist
+    rm = dist.UserDefinedRoleMaker(current_id=1, role=dist.Role.WORKER,
+                                   worker_endpoints=["a:1", "b:2", "c:3"])
+    assert rm.worker_index() == 1 and rm.worker_num() == 3
+    assert rm.is_worker() and not rm.is_first_worker()
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS", "x:1,y:2")
+    cloud = dist.PaddleCloudRoleMaker()
+    assert cloud.worker_num() == 2 and cloud.is_first_worker()
+    util = dist.UtilBase(rm)
+    shard = util.get_file_shard(["f0", "f1", "f2", "f3", "f4"])
+    assert shard == ["f2", "f3"]  # worker 1 of 3: 2+2+1 split
+    fleet_obj = dist.Fleet()
+    assert callable(fleet_obj.init) and isinstance(fleet_obj.util,
+                                                   dist.UtilBase)
+
+
+def test_multi_slot_data_generator():
+    import paddle_tpu.distributed as dist
+
+    class Gen(dist.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                a, b = line.strip().split("|")
+                yield [("ids", [int(v) for v in a.split()]),
+                       ("label", [int(b)])]
+            return it
+
+    out = Gen().run_from_memory(["1 2 3|0", "4 5|1"])
+    assert out[0] == "3 1 2 3 1 0\n" and out[1] == "2 4 5 1 1\n"
+
+
+def test_nn_quant_stub(rng):
+    from paddle_tpu.nn.quant import Stub
+    s = Stub()
+    x = paddle.to_tensor(rng.standard_normal(3).astype(np.float32))
+    np.testing.assert_allclose(t2n(s(x)), t2n(x))
+
+
+def test_image_folder_and_voc(tmp_path):
+    from PIL import Image
+    import paddle_tpu.vision.datasets as D
+    d = tmp_path / "imgs" / "sub"
+    d.mkdir(parents=True)
+    for i in range(3):
+        Image.fromarray(np.full((4, 4, 3), i * 10, np.uint8)).save(
+            str(d / f"im{i}.png"))
+    ds = D.ImageFolder(str(tmp_path / "imgs"))
+    assert len(ds) == 3
+    (img,) = ds[0]
+    assert np.asarray(img).shape == (4, 4, 3)
+
+    # VOC layout
+    root = tmp_path / "voc"
+    for sub in ["VOC2012/ImageSets/Segmentation", "VOC2012/JPEGImages",
+                "VOC2012/SegmentationClass"]:
+        (root / sub).mkdir(parents=True)
+    (root / "VOC2012/ImageSets/Segmentation/train.txt").write_text("s1\n")
+    Image.fromarray(np.zeros((5, 5, 3), np.uint8)).save(
+        str(root / "VOC2012/JPEGImages/s1.jpg"))
+    Image.fromarray(np.zeros((5, 5), np.uint8)).save(
+        str(root / "VOC2012/SegmentationClass/s1.png"))
+    voc = D.VOC2012(str(root), mode="train")
+    img, lbl = voc[0]
+    assert img.shape == (5, 5, 3) and lbl.shape == (5, 5)
+
+
+def test_check_layer_numerics():
+    from paddle_tpu.amp.debugging import check_layer_numerics
+
+    class L(nn.Layer):
+        @check_layer_numerics
+        def forward(self, x):
+            return x * 2
+
+    out = L()(paddle.to_tensor(np.ones(2, np.float32)))
+    np.testing.assert_allclose(t2n(out), 2.0)
+
+
+def test_inference_surface():
+    import paddle_tpu.inference as inf
+    assert inf.DataType.FLOAT32 == 0 and inf.PlaceType.CPU == 0
+    assert inf.get_num_bytes_of_data_type(inf.DataType.INT64) == 8
+    assert "version" in inf.get_version()
+    assert inf.get_trt_compile_version() == (0, 0, 0)
+    assert inf._get_phi_kernel_name("matmul") == "matmul"
+    cfg = inf.XpuConfig()
+    assert cfg.device_id == 0
+
+
+def test_distribution_transform_namespace_complete():
+    import paddle_tpu.distribution.transform as dt
+    for name in dt.__all__:
+        assert getattr(dt, name) is not None
+
+
+def test_require_version_prerelease():
+    import paddle_tpu.utils as utils
+    utils.require_version("0.0.0-rc1")  # must not crash on pre-release tags
+
+
+def test_static_auc_positive_column():
+    import paddle_tpu.static as static
+    # perfectly separable: column 1 = positive prob → AUC must be 1, not 0
+    pred = paddle.to_tensor(np.array(
+        [[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]], np.float32))
+    lab = paddle.to_tensor(np.array([[0], [0], [1], [1]], np.int64))
+    auc_val, _ = static.auc(pred, lab)
+    assert float(t2n(auc_val)) > 0.99
+
+
+def test_static_print_summarize_all(capsys):
+    import paddle_tpu.static as static
+    static.Print(paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)),
+                 summarize=-1)
+    out = capsys.readouterr().out
+    assert "3." in out  # last element included
+
+
+def test_fleet_dataset_string_slots(tmp_path):
+    import paddle_tpu.distributed as dist
+    f = tmp_path / "p"
+    f.write_text("abc def;1 2\nxyz;3 4\n")
+    ds = dist.InMemoryDataset()
+    ds.init(batch_size=2, use_var=["s", "v"])
+    ds.set_filelist([str(f)])
+    ds.load_into_memory()
+    batches = list(ds)
+    assert batches[0][0] == [["abc", "def"], ["xyz"]]
+    np.testing.assert_allclose(batches[0][1], [[1, 2], [3, 4]])
+    ds.slots_shuffle([0])  # ragged-safe
+
+
+def test_shard_dataloader_multi_mesh():
+    import paddle_tpu.distributed as dist
+    m1 = dist.ProcessMesh(np.arange(4), ["dp"])
+    m2 = dist.ProcessMesh(np.arange(4, 8), ["dp"])
+    data = [(np.ones((4, 2), np.float32), np.zeros((4, 2), np.float32))]
+    dl = dist.shard_dataloader(data, [m1, m2], shard_dims="dp")
+    a, b = next(iter(dl))
+    assert a._dist_meta.mesh is m1 and b._dist_meta.mesh is m2
+    bad = dist.shard_dataloader([(1, 2, 3)], [m1, m2])
+    with pytest.raises(NotImplementedError):
+        next(iter(bad))
