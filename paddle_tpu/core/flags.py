@@ -89,6 +89,9 @@ def flag_value(name: str):
 
 # --- core flags (analogs of the reference's most-used ones) ---
 define_flag("check_nan_inf", False, "check every op output for nan/inf (numeric sanitizer)")
+define_flag("use_fused_adamw", True,
+            "route multi-precision Adam/AdamW updates to the fused Pallas "
+            "single-pass kernel")
 define_flag("check_nan_inf_level", 0, "0: error on nan/inf; 1: warn; 3: report fp16 overflow too")
 define_flag("benchmark", False, "synchronize after every op dispatch (op-level timing)")
 define_flag("eager_op_jit", True, "route eager op dispatch through a cached jax.jit per op signature")

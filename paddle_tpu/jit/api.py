@@ -188,6 +188,36 @@ def not_to_static(fn):
     return fn
 
 
+
+def _make_loss_of(model, loss_fn, params, frozen, buffers, static_key, layout,
+                  treedef):
+    """Build the pure loss closure shared by the single-step and
+    gradient-accumulation paths: re-interleaves dynamic/static batch leaves,
+    binds param/buffer values, and captures updated buffers as aux."""
+
+    def loss_of(pv, frozen_vals, buf_vals, rng_key, dyn_vals):
+        it = iter(dyn_vals)
+        statics = iter(static_key)
+        leaves = []
+        for tag in layout:
+            if tag == "S":
+                leaves.append(next(statics))
+            elif tag == "T":
+                leaves.append(Tensor(next(it)))
+            else:
+                leaves.append(next(it))
+        (b,) = (jax.tree_util.tree_unflatten(treedef, leaves),)
+        with functional_mode(), \
+                bind_state(params + frozen + buffers,
+                           list(pv) + list(frozen_vals) + list(buf_vals)), \
+                _random.provide_key(rng_key):
+            loss = loss_fn(model, *b)
+            new_bufs = [bf._value for bf in buffers]
+        return loss._value, new_bufs
+
+    return loss_of
+
+
 class TrainStep:
     """One fused compiled training iteration: fwd + bwd + optimizer + buffer updates.
 
@@ -196,7 +226,8 @@ class TrainStep:
     (no 2x weight footprint) — the analog of the reference executor's inplace pass.
     """
 
-    def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate=True):
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate=True,
+                 accumulate_steps=1):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -206,40 +237,37 @@ class TrainStep:
         self.frozen = [p for p in params if p.stop_gradient]
         self.buffers = buffers
         self.donate = donate
+        # gradient accumulation (reference: gradient_merge pass /
+        # fleet accumulate_steps): K-1 grad-only microsteps into fp32
+        # accumulators, optimizer-state traffic only on the K-th
+        self.accumulate_steps = int(accumulate_steps)
+        self._acc = None
+        self._acc_count = 0
+        self._grad_cache = {}
+        self._update_fn = None
         optimizer._ensure_slots(self.params)
 
     def __call__(self, *batch):
+        if self.accumulate_steps > 1:
+            return self._call_accumulate(*batch)
         opt = self.optimizer
         dyn, static_key, layout, treedef = _split_leaves(batch)
+        from ..core.flags import flag_value
         key = (static_key, layout, treedef,
-               tuple((tuple(v.shape), str(v.dtype)) for v in dyn))
+               tuple((tuple(v.shape), str(v.dtype)) for v in dyn),
+               bool(flag_value("use_fused_adamw")))
 
         if key not in self._cache:
-            params, frozen, buffers = self.params, self.frozen, self.buffers
-            model, loss_fn = self.model, self.loss_fn
-            decay_flags = tuple(bool(opt._decay_mask(p)) for p in params)
+            decay_flags = tuple(bool(opt._decay_mask(p)) for p in self.params)
+            loss_of_full = _make_loss_of(self.model, self.loss_fn, self.params,
+                                         self.frozen, self.buffers, static_key,
+                                         layout, treedef)
 
             def step_fn(param_vals, slot_vals, buf_vals, frozen_vals, lr, step_i,
                         rng_key, dyn_vals):
                 def loss_of(pv):
-                    it = iter(dyn_vals)
-                    statics = iter(static_key)
-                    leaves = []
-                    for tag in layout:
-                        if tag == "S":
-                            leaves.append(next(statics))
-                        elif tag == "T":
-                            leaves.append(Tensor(next(it)))
-                        else:
-                            leaves.append(next(it))
-                    (b,) = (jax.tree_util.tree_unflatten(treedef, leaves),)
-                    with functional_mode(), \
-                            bind_state(params + frozen + buffers,
-                                       list(pv) + list(frozen_vals) + list(buf_vals)), \
-                            _random.provide_key(rng_key):
-                        loss = loss_fn(model, *b)
-                        new_bufs = [bf._value for bf in buffers]
-                    return loss._value, new_bufs
+                    return loss_of_full(pv, frozen_vals, buf_vals, rng_key,
+                                        dyn_vals)
 
                 (loss_val, new_bufs), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(param_vals)
@@ -267,6 +295,76 @@ class TrainStep:
             opt._slots[id(p)] = ns
         for b, nv in zip(self.buffers, new_bufs):
             b._value = nv
+        return Tensor(loss_val)
+
+    # -- gradient-accumulation path ------------------------------------------
+    def _call_accumulate(self, *batch):
+        opt = self.optimizer
+        dyn, static_key, layout, treedef = _split_leaves(batch)
+        key = (static_key, layout, treedef,
+               tuple((tuple(v.shape), str(v.dtype)) for v in dyn))
+
+        if key not in self._grad_cache:
+            loss_of_full = _make_loss_of(self.model, self.loss_fn, self.params,
+                                         self.frozen, self.buffers, static_key,
+                                         layout, treedef)
+
+            def grad_fn(param_vals, acc_vals, buf_vals, frozen_vals, rng_key,
+                        dyn_vals):
+                def loss_of(pv):
+                    return loss_of_full(pv, frozen_vals, buf_vals, rng_key,
+                                        dyn_vals)
+
+                (loss_val, new_bufs), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(param_vals)
+                new_acc = [a + g.astype(jnp.float32)
+                           for a, g in zip(acc_vals, grads)]
+                return loss_val, new_acc, new_bufs
+
+            # acc buffers are internal (never user-visible) — always donated
+            self._grad_cache[key] = jax.jit(grad_fn, donate_argnums=(1,))
+
+        from ..core.flags import flag_value
+        update_key = bool(flag_value("use_fused_adamw"))
+        if self._update_fn is None or getattr(self, "_update_key", None) \
+                != update_key:
+            self._update_key = update_key
+            decay_flags = tuple(bool(opt._decay_mask(p)) for p in self.params)
+            K = self.accumulate_steps
+
+            def update_fn(param_vals, slot_vals, acc_vals, lr, step_i):
+                grads = [(a / K).astype(p.dtype)
+                         for a, p in zip(acc_vals, param_vals)]
+                return opt.apply_updates(param_vals, grads, slot_vals, lr,
+                                         step_i, decay_flags)
+
+            donate = (0, 1, 2) if self.donate else (2,)
+            self._update_fn = jax.jit(update_fn, donate_argnums=donate)
+
+        param_vals = read_values(self.params)
+        if self._acc is None:
+            self._acc = [jnp.zeros(p.shape, jnp.float32) for p in self.params]
+        buf_vals = read_values(self.buffers)
+        frozen_vals = read_values(self.frozen)
+        rng_key = _random.next_key()
+        loss_val, self._acc, new_bufs = self._grad_cache[key](
+            param_vals, self._acc, buf_vals, frozen_vals, rng_key, dyn)
+        for b, nv in zip(self.buffers, new_bufs):
+            b._value = nv
+        self._acc_count += 1
+        if self._acc_count >= self.accumulate_steps:
+            slot_vals = [opt._slots[id(p)] for p in self.params]
+            opt._step_count += 1
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step_i = jnp.asarray(opt._step_count, jnp.int32)
+            new_pv, new_slots = self._update_fn(
+                param_vals, slot_vals, self._acc, lr, step_i)
+            for p, nv in zip(self.params, new_pv):
+                p._value = nv
+            for p, ns in zip(self.params, new_slots):
+                opt._slots[id(p)] = ns
+            self._acc = None
+            self._acc_count = 0
         return Tensor(loss_val)
 
 

@@ -38,6 +38,10 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
+    # PaddleNLP-style horizontal fusion: one QKV GEMM / one gate+up GEMM so
+    # the layer input is read once per block instead of 3x/2x (HBM win)
+    fuse_attention_qkv: bool = False
+    fuse_swiglu: bool = False
     dtype: str = "float32"
 
     @staticmethod
@@ -85,21 +89,44 @@ class LlamaAttention(Layer):
         self.num_heads = c.num_attention_heads
         self.num_kv_heads = c.num_key_value_heads
         self.head_dim = c.hidden_size // c.num_attention_heads
-        self.q_proj = Linear(c.hidden_size, self.num_heads * self.head_dim,
-                             bias_attr=False)
-        self.k_proj = Linear(c.hidden_size, self.num_kv_heads * self.head_dim,
-                             bias_attr=False)
-        self.v_proj = Linear(c.hidden_size, self.num_kv_heads * self.head_dim,
-                             bias_attr=False)
+        self.fused = bool(getattr(c, "fuse_attention_qkv", False))
+        if self.fused:
+            self.qkv_proj = Linear(
+                c.hidden_size,
+                (self.num_heads + 2 * self.num_kv_heads) * self.head_dim,
+                bias_attr=False)
+        else:
+            self.q_proj = Linear(c.hidden_size, self.num_heads * self.head_dim,
+                                 bias_attr=False)
+            self.k_proj = Linear(c.hidden_size,
+                                 self.num_kv_heads * self.head_dim,
+                                 bias_attr=False)
+            self.v_proj = Linear(c.hidden_size,
+                                 self.num_kv_heads * self.head_dim,
+                                 bias_attr=False)
         self.o_proj = Linear(self.num_heads * self.head_dim, c.hidden_size,
                              bias_attr=False)
         self.config = c
 
     def forward(self, x, rope_cache, attn_mask=None, kv_cache=None, position_offset=0):
         b, s = x.shape[0], x.shape[1]
-        q = ops.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
-        k = ops.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
-        v = ops.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        if self.fused:
+            qkv = self.qkv_proj(x)
+            nq = self.num_heads * self.head_dim
+            nkv = self.num_kv_heads * self.head_dim
+            q = ops.reshape(qkv[:, :, :nq],
+                            [b, s, self.num_heads, self.head_dim])
+            k = ops.reshape(qkv[:, :, nq:nq + nkv],
+                            [b, s, self.num_kv_heads, self.head_dim])
+            v = ops.reshape(qkv[:, :, nq + nkv:],
+                            [b, s, self.num_kv_heads, self.head_dim])
+        else:
+            q = ops.reshape(self.q_proj(x),
+                            [b, s, self.num_heads, self.head_dim])
+            k = ops.reshape(self.k_proj(x),
+                            [b, s, self.num_kv_heads, self.head_dim])
+            v = ops.reshape(self.v_proj(x),
+                            [b, s, self.num_kv_heads, self.head_dim])
         cos, sin = rope_cache
         q, k = dispatch(lambda qq, kk: apply_rope(qq, kk, cos, sin, position_offset),
                         (q, k), {}, name="rope")
@@ -119,11 +146,23 @@ class LlamaMLP(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         c = config
-        self.gate_proj = Linear(c.hidden_size, c.intermediate_size, bias_attr=False)
-        self.up_proj = Linear(c.hidden_size, c.intermediate_size, bias_attr=False)
+        self.fused = bool(getattr(c, "fuse_swiglu", False))
+        if self.fused:
+            self.gate_up_proj = Linear(c.hidden_size, 2 * c.intermediate_size,
+                                       bias_attr=False)
+        else:
+            self.gate_proj = Linear(c.hidden_size, c.intermediate_size,
+                                    bias_attr=False)
+            self.up_proj = Linear(c.hidden_size, c.intermediate_size,
+                                  bias_attr=False)
         self.down_proj = Linear(c.intermediate_size, c.hidden_size, bias_attr=False)
+        self._ff = c.intermediate_size
 
     def forward(self, x):
+        if self.fused:
+            gu = self.gate_up_proj(x)
+            return self.down_proj(F.swiglu(gu[:, :, :self._ff],
+                                           gu[:, :, self._ff:]))
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
 
 

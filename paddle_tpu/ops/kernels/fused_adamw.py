@@ -1,0 +1,156 @@
+"""Pallas TPU fused AdamW update — single-pass multi-precision step.
+
+Reference analog: phi's fused_adam / multi_tensor adam kernels
+(paddle/phi/kernels/fused_adam_kernel.h) that the reference optimizer uses to
+avoid per-tensor kernel-launch and read-modify-write traffic. On TPU the
+bottleneck is HBM bandwidth: the XLA lowering of the update chain re-reads the
+fp32 moment/master buffers across fusion boundaries, sustaining only ~½ of
+peak bandwidth. This kernel does the whole update in ONE pass per block —
+read g(bf16), m, v, master(fp32); write m, v, master, p(bf16) — which is the
+minimum possible traffic (~24.5 GB for a 880M-param model vs ~45 GB observed
+from the XLA path).
+
+Math (AdamW, decoupled weight decay, bias-corrected):
+    m = b1*m + (1-b1)*g
+    v = b2*v + (1-b2)*g^2
+    update = (m/bc1) / (sqrt(v)/sqrt(bc2) + eps)
+    master = master - lr*update - lr*wd*master
+    p_bf16 = cast(master)
+Scalars alpha=lr/bc1, c2=1/sqrt(bc2), lr, lr*wd arrive via SMEM so one
+compiled kernel serves every step.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret():
+    return jax.default_backend() not in ("tpu",)
+
+
+def _adamw_kernel(scal_ref, g_ref, m_ref, v_ref, mw_ref,
+                  om_ref, ov_ref, omw_ref, op_ref, *, beta1, beta2, eps):
+    alpha = scal_ref[0, 0]  # lr / bias_correction1
+    c2 = scal_ref[0, 1]     # 1 / sqrt(bias_correction2)
+    lrwd = scal_ref[0, 2]   # lr * weight_decay (0 when decay masked off)
+    g = g_ref[...].astype(jnp.float32)
+    m = beta1 * m_ref[...] + (1.0 - beta1) * g
+    v = beta2 * v_ref[...] + (1.0 - beta2) * (g * g)
+    denom = jnp.sqrt(v) * c2 + eps
+    mw = mw_ref[...]
+    new_mw = mw - alpha * (m / denom) - lrwd * mw
+    om_ref[...] = m
+    ov_ref[...] = v
+    omw_ref[...] = new_mw
+    op_ref[...] = new_mw.astype(op_ref.dtype)
+
+
+def _pick_block(rows, cols):
+    """Rows per block: 9 live fp32-sized buffers of (block_r, cols) must fit
+    the ~16 MB scoped-VMEM budget; stay a multiple of 8 (f32 sublane)."""
+    # pallas double-buffers every in/out block, so the scoped-VMEM footprint
+    # is ~2x the 9 live fp32-sized buffers — budget 4 MB of logical blocks
+    target = 4 * 1024 * 1024 // (9 * 4 * max(cols, 1))
+    br = max(8, min(rows, (target // 8) * 8))
+    while rows % br:
+        br -= 8
+        if br <= 0:
+            return rows
+    return br
+
+
+def _fused_adamw_2d(scalars, g, m, v, mw, *, beta1, beta2, eps, out_dtype):
+    rows, cols = m.shape
+    br = _pick_block(rows, cols)
+    grid = (rows // br,)
+
+    Z = np.int32(0)
+
+    def idx(i):
+        return (i, Z)
+
+    bs = lambda: pl.BlockSpec((br, cols), idx)
+    scal_spec = pl.BlockSpec((1, 3), lambda i: (Z, Z))
+    out_shapes = (
+        jax.ShapeDtypeStruct((rows, cols), jnp.float32),  # m
+        jax.ShapeDtypeStruct((rows, cols), jnp.float32),  # v
+        jax.ShapeDtypeStruct((rows, cols), jnp.float32),  # master
+        jax.ShapeDtypeStruct((rows, cols), out_dtype),    # bf16/low param
+    )
+    kernel = functools.partial(_adamw_kernel, beta1=float(beta1),
+                               beta2=float(beta2), eps=float(eps))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[scal_spec, bs(), bs(), bs(), bs()],
+        out_specs=(bs(), bs(), bs(), bs()),
+        out_shape=out_shapes,
+        # m/v/master update in place — no state copies in HBM (the outer
+        # train step donates these buffers)
+        input_output_aliases={2: 0, 3: 1, 4: 2},
+        interpret=_interpret(),
+    )(scalars, g, m, v, mw)
+
+
+def fused_adamw_update(p_low, g, m, v, master, lr, step, *, beta1=0.9,
+                       beta2=0.999, eps=1e-8, weight_decay=0.0,
+                       apply_decay=True):
+    """One fused AdamW step for a low-precision param with fp32 master/moments.
+
+    Returns (new_p_low, new_m, new_v, new_master), or None when the shape
+    cannot be tiled within the VMEM budget (caller falls back to the generic
+    XLA update). All tensors keep their logical shape; internally flattened
+    to 2-D blocks.
+    """
+    shape = m.shape
+    n = int(np.prod(shape)) if shape else 1
+    # factor into (rows, cols) with cols a multiple of 128 when possible
+    if len(shape) >= 2:
+        rows = int(shape[0])
+        cols = n // rows
+    else:
+        cols = min(n, 131072)
+        while n % cols:
+            cols //= 2
+        cols = max(cols, 1)
+        rows = n // cols
+    stepf = step.astype(jnp.float32)
+    bc1 = 1.0 - jnp.power(beta1, stepf)
+    bc2 = 1.0 - jnp.power(beta2, stepf)
+    lr32 = lr.astype(jnp.float32)
+    wd = lr32 * weight_decay if (weight_decay and apply_decay) else \
+        jnp.zeros((), jnp.float32)
+    scalars = jnp.stack([lr32 / bc1, 1.0 / jnp.sqrt(bc2), wd]) \
+        .astype(jnp.float32).reshape(1, 3)
+
+    if rows * cols != n or (rows % 8 != 0 and rows != 1):
+        # odd leading dim: try to refactor n into tileable (rows, cols)
+        cols = 1
+        for c in (131072, 65536, 32768, 16384, 8192, 4096, 2048, 1024, 512,
+                  256, 128):
+            if n % c == 0 and (n // c) % 8 == 0:
+                cols = c
+                break
+        if cols > 1:
+            rows = n // cols
+        else:
+            rows, cols = 1, n
+    if rows == 1 and cols > 65536:
+        # a single (1, n) block would blow the scoped-VMEM budget — let the
+        # generic XLA update handle this tensor
+        return None
+    g2 = g.reshape(rows, cols)
+    m2 = m.reshape(rows, cols)
+    v2 = v.reshape(rows, cols)
+    mw2 = master.reshape(rows, cols)
+    nm, nv, nmw, np_low = _fused_adamw_2d(
+        scalars, g2, m2, v2, mw2, beta1=beta1, beta2=beta2, eps=eps,
+        out_dtype=p_low.dtype)
+    return (np_low.reshape(shape), nm.reshape(shape), nv.reshape(shape),
+            nmw.reshape(shape))

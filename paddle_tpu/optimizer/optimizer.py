@@ -83,12 +83,19 @@ class Optimizer:
         """Pure: lists of arrays -> (new_vals, new_slots). Used under jit."""
         if self._grad_clip is not None:
             grads = self._grad_clip.apply(vals, grads)
+        fused = getattr(self, "_apply_fused", None)
         new_vals, new_slots = [], []
         for p, g, s, dm in zip(vals, grads, slots, decay_flags):
             if g is None:
                 new_vals.append(p)
                 new_slots.append(s)
                 continue
+            if fused is not None and s.get("master_weight") is not None:
+                out = fused(p, g, s, lr, step, dm)
+                if out is not None:
+                    new_vals.append(out[0])
+                    new_slots.append(out[1])
+                    continue
             master = s.get("master_weight")
             work_p = master if master is not None else p
             g32 = g.astype(work_p.dtype)
@@ -139,7 +146,11 @@ class Optimizer:
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         step = jnp.asarray(self._step_count, jnp.int32)
 
-        shape_key = tuple((v.shape, str(v.dtype)) for v in vals) + (decay_flags,)
+        from ..core.flags import flag_value
+        # the fused-update flag is read at trace time — key the jit cache on
+        # it so set_flags toggles take effect on the next step
+        shape_key = tuple((v.shape, str(v.dtype)) for v in vals) + \
+            (decay_flags, bool(flag_value("use_fused_adamw")))
         if self._jit_update is None or self._jit_shape_key != shape_key:
             fn = functools.partial(self._traced_update, decay_flags=decay_flags)
             self._jit_update = jax.jit(fn, donate_argnums=(0, 2))
@@ -261,6 +272,27 @@ class Adam(Optimizer):
             denom = jnp.sqrt(v / bc2) + self._eps
         update = (m / bc1) / denom
         return p - lr.astype(p.dtype) * update, ns
+
+    def _apply_fused(self, p, g, slots, lr, step, decay_mask):
+        """Single-pass Pallas update for the multi-precision path (the
+        reference's fused_adam/multi_tensor analog). Covers plain Adam with
+        no coupled decay and AdamW's decoupled decay; anything else falls
+        back to the generic chain."""
+        if self._amsgrad or (self._wd and not self._decoupled_wd):
+            return None
+        from ..core.flags import flag_value
+        if not flag_value("use_fused_adamw"):
+            return None
+        from ..ops.kernels.fused_adamw import fused_adamw_update
+        out = fused_adamw_update(
+            p, g, slots["moment1"], slots["moment2"], slots["master_weight"],
+            lr, step, beta1=self._beta1, beta2=self._beta2, eps=self._eps,
+            weight_decay=self._wd if self._decoupled_wd else 0.0,
+            apply_decay=bool(decay_mask))
+        if out is None:  # untileable shape — generic path
+            return None
+        new_p, nm, nv, nmw = out
+        return new_p, {"moment1": nm, "moment2": nv, "master_weight": nmw}
 
 
 class AdamW(Adam):

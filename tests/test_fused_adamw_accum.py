@@ -1,0 +1,167 @@
+"""Fused Pallas AdamW kernel + TrainStep gradient accumulation + fused
+Llama projection modes — the single-chip MFU work."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.api import TrainStep
+
+
+def t2n(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+def _train(flag, shapes, steps=3, wd=0.01):
+    rng = np.random.default_rng(0)
+    paddle.set_flags({"use_fused_adamw": flag})
+    ps = []
+    for sh in shapes:
+        p = paddle.create_parameter(list(sh), "bfloat16")
+        p._value = jnp.asarray(rng.standard_normal(sh), jnp.bfloat16)
+        ps.append(p)
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=ps,
+                                 weight_decay=wd, multi_precision=True)
+    for i in range(steps):
+        for p in ps:
+            p.grad = paddle.to_tensor(jnp.asarray(
+                rng.standard_normal(p.shape) * (i + 1), jnp.bfloat16))
+        opt.step()
+    masters = [np.asarray(opt._slots[id(p)]["master_weight"]) for p in ps]
+    return [np.asarray(p._value, np.float32) for p in ps], masters
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.01])
+def test_fused_adamw_matches_generic(wd):
+    shapes = [(16, 256), (256,), (8, 8, 4)]  # 2-D, 1-D, odd-rank
+    try:
+        pf, mf = _train(True, shapes, wd=wd)
+        pg, mg = _train(False, shapes, wd=wd)
+    finally:
+        paddle.set_flags({"use_fused_adamw": True})
+    for a, b in zip(pf, pg):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(mf, mg):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_fused_adamw_skips_unsupported():
+    # coupled L2 (plain Adam with float weight_decay) must use the generic path
+    paddle.set_flags({"use_fused_adamw": True})
+    w = paddle.create_parameter([8, 128], "bfloat16")
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[w],
+                                weight_decay=0.1, multi_precision=True)
+    assert opt._apply_fused(w, None, {"master_weight": 1}, None, None,
+                            True) is None
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=[w],
+                                 amsgrad=True, multi_precision=True)
+    assert opt2._apply_fused(w, None, {"master_weight": 1}, None, None,
+                             True) is None
+
+
+def test_trainstep_accumulation_equals_mean_grad():
+    def build():
+        paddle.seed(0)
+        m = nn.Linear(8, 4)
+        opt = paddle.optimizer.AdamW(learning_rate=0.1,
+                                     parameters=m.parameters(),
+                                     weight_decay=0.0)
+        return m, opt
+
+    rng = np.random.default_rng(0)
+    xs = [paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+          for _ in range(4)]
+    y = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    loss_fn = lambda m, a, b: nn.MSELoss()(m(a), b)
+
+    m1, o1 = build()
+    s1 = TrainStep(m1, loss_fn, o1, accumulate_steps=4)
+    for x in xs:
+        s1(x, y)
+    # exactly one optimizer step happened
+    assert o1._step_count == 1
+
+    m2, o2 = build()
+    loss = sum((loss_fn(m2, x, y) for x in xs), paddle.to_tensor(0.0)) / 4.0
+    loss.backward()
+    o2.step()
+    np.testing.assert_allclose(t2n(m1.weight), t2n(m2.weight), atol=1e-6)
+    np.testing.assert_allclose(t2n(m1.bias), t2n(m2.bias), atol=1e-6)
+
+
+def test_trainstep_accumulation_multiple_cycles():
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    step = TrainStep(m, lambda mm, a: (mm(a) ** 2).sum(), opt,
+                     accumulate_steps=2)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32))
+    losses = [float(t2n(step(x))) for _ in range(6)]
+    assert opt._step_count == 3
+    assert losses[-1] < losses[0]
+
+
+def test_llama_fused_projection_modes_match():
+    import jax
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    rng = np.random.default_rng(0)
+    paddle.seed(0)
+    m_u = LlamaForCausalLM(LlamaConfig.tiny())
+    m_f = LlamaForCausalLM(LlamaConfig.tiny(fuse_attention_qkv=True,
+                                            fuse_swiglu=True))
+    sd = dict(m_u.named_parameters())
+    for name, p in m_f.named_parameters():
+        if "qkv_proj" in name:
+            base = name.replace("qkv_proj", "{}")
+            p._value = jnp.concatenate(
+                [sd[base.format(k)]._value
+                 for k in ("q_proj", "k_proj", "v_proj")], axis=1)
+        elif "gate_up_proj" in name:
+            base = name.replace("gate_up_proj", "{}")
+            p._value = jnp.concatenate(
+                [sd[base.format(k)]._value
+                 for k in ("gate_proj", "up_proj")], axis=1)
+        elif name in sd:
+            p._value = sd[name]._value
+    ids = paddle.to_tensor(rng.integers(0, 1024, (2, 16)), dtype="int32")
+    np.testing.assert_allclose(t2n(m_u(ids)), t2n(m_f(ids)), atol=5e-5)
+
+
+def test_fused_adamw_untileable_shape_falls_back():
+    # vocab padded to 32003 (odd leading dim, huge n): the kernel must refuse
+    # (return None) and the generic XLA path must still train the tensor
+    from paddle_tpu.ops.kernels.fused_adamw import fused_adamw_update
+    m = jnp.zeros((32003, 64), jnp.float32)
+    out = fused_adamw_update(jnp.zeros((32003, 64), jnp.bfloat16),
+                             jnp.ones((32003, 64), jnp.bfloat16), m, m, m,
+                             jnp.asarray(0.01), jnp.asarray(1, jnp.int32))
+    assert out is None
+    paddle.set_flags({"use_fused_adamw": True})
+    w = paddle.create_parameter([1003, 8], "bfloat16")
+    before = t2n(w).copy()
+    opt = paddle.optimizer.AdamW(learning_rate=0.05, parameters=[w],
+                                 multi_precision=True)
+    w.grad = paddle.to_tensor(jnp.ones((1003, 8), jnp.bfloat16))
+    opt.step()
+    assert not np.allclose(t2n(w), before)
+
+
+def test_fused_flag_toggle_takes_effect():
+    # toggling the flag between steps must not be silently ignored by the
+    # cached jit (cache is keyed on the flag)
+    paddle.set_flags({"use_fused_adamw": True})
+    w = paddle.create_parameter([8, 128], "bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=[w],
+                                 multi_precision=True)
+    w.grad = paddle.to_tensor(jnp.ones((8, 128), jnp.bfloat16))
+    opt.step()
+    k1 = opt._jit_shape_key
+    paddle.set_flags({"use_fused_adamw": False})
+    try:
+        w.grad = paddle.to_tensor(jnp.ones((8, 128), jnp.bfloat16))
+        opt.step()
+        assert opt._jit_shape_key != k1
+    finally:
+        paddle.set_flags({"use_fused_adamw": True})
