@@ -510,3 +510,157 @@ _LAZY_ATTRS.update({
 
 # pstring/raw (prototype string-tensor dtypes) are intentionally absent: the
 # TPU build has no StringTensor analog (SURVEY.md §2.2 marks them niche).
+
+
+# ---------------------------------------------------------------------------
+# Tensor method parity: the reference monkey-patches ~394 functions onto
+# Tensor (python/paddle/tensor/__init__.py tensor_method_func). Bind every
+# top-level op that is not yet a method; `_`-suffixed names write back into
+# self via the _inplace factory above.
+# ---------------------------------------------------------------------------
+_TENSOR_METHOD_PARITY = [
+    'create_parameter', 'ormqr', 'cov', 'corrcoef', 'cond', 'cauchy_',
+    'geometric_', 'lstsq', 't_', 'cholesky_inverse', 'histogram',
+    'histogram_bin_edges', 'histogramdd', 'matrix_power',
+    'matrix_transpose', 'qr', 'householder_product', 'pca_lowrank',
+    'svd_lowrank', 'eigvals', 'eigvalsh', 'asin_', 'cumsum_', 'cumprod_',
+    'logit', 'logit_', 'increment', 'log_', 'log2_', 'log10_', 'multiplex',
+    'sinc', 'square_', 'reduce_as', 'multigammaln', 'multigammaln_',
+    'nan_to_num_', 'hypot_', 'block_diag', 'add_n', 'inner', 'outer',
+    'floor_divide_', 'mod_', 'floor_mod', 'floor_mod_', 'log1p_', 'addmm',
+    'addmm_', 'kron', 'isin', 'isneginf', 'isposinf', 'isreal',
+    'broadcast_shape', 'neg_', 'negative', 'lgamma_', 'gammaincc',
+    'gammaincc_', 'gammainc', 'gammainc_', 'equal_', 'greater_equal_',
+    'greater_than_', 'is_empty', 'less_equal_', 'less_than_', 'less',
+    'less_', 'logical_and_', 'logical_not_', 'logical_or_', 'not_equal_',
+    'is_tensor', 'concat', 'reverse', 'scatter_nd', 'shard_index', 'slice',
+    'slice_scatter', 'hsplit', 'dsplit', 'vsplit', 'tensordot', 'stack',
+    'strided_slice', 'transpose_', 'tan_', 'unstack', 'where_',
+    'nanquantile', 'is_complex', 'is_integer', 'rank', 'real', 'imag',
+    'is_floating_point', 'gammaln', 'gammaln_', 'digamma_', 'trunc_',
+    'frac_', 'bitwise_and_', 'bitwise_or_', 'bitwise_xor_', 'bitwise_not_',
+    'bitwise_invert', 'bitwise_invert_', 'broadcast_tensors', 'eig',
+    'multi_dot', 'solve', 'cholesky_solve', 'triangular_solve', 'lu',
+    'lu_unpack', 'cdist', 'as_complex', 'as_real', 'gcd', 'gcd_', 'lcm',
+    'lcm_', 'diff', 'select_scatter', 'bernoulli_', 'exponential_',
+    'index_put', 'take', 'sgn', 'frexp', 'ldexp', 'ldexp_', 'trapezoid',
+    'cumulative_trapezoid', 'polar', 'vander', 'nextafter', 'unflatten',
+    'view', 'view_as', 'unfold', 'i0', 'i0_', 'i0e', 'i1', 'i1e',
+    'polygamma', 'polygamma_', 'diag_embed', 'diagflat', 'multinomial',
+    'pinv', 'renorm', 'renorm_', 'acos_', 'atan_', 'cos_', 'sin_', 'sinc_',
+    'sinh_', 'diag', 'copysign', 'copysign_', 'bitwise_left_shift',
+    'bitwise_left_shift_', 'bitwise_right_shift', 'bitwise_right_shift_',
+    'index_fill', 'atleast_1d', 'atleast_2d', 'atleast_3d',
+    'diagonal_scatter', 'masked_scatter', 'masked_scatter_', 'combinations',
+    'signbit', 'log_normal_'
+]
+
+for _n in _TENSOR_METHOD_PARITY:
+    if hasattr(Tensor, _n):
+        continue
+    _fn = globals().get(_n)
+    if _fn is None or not callable(_fn):
+        continue
+    _bind(_n, _method(_fn))
+
+# in-place variants whose base op exists but had no eager wrapper yet
+for _n in ["logical_xor", "atanh", "erfinv", "cosh", "acosh", "asinh",
+           "index_fill"]:
+    if hasattr(Tensor, _n) and not hasattr(Tensor, _n + "_"):
+        _base = globals().get(_n) or getattr(_ops, _n, None)
+        if _base is not None:
+            _ip = _inplace(_base)
+            _ip.__name__ = _n + "_"
+            _bind(_n + "_", _ip)
+            globals()[_n + "_"] = _ip
+
+def _stft_method(self, *a, **k):
+    from .signal import stft as _stft
+    return _stft(self, *a, **k)
+
+
+def _istft_method(self, *a, **k):
+    from .signal import istft as _istft
+    return _istft(self, *a, **k)
+
+
+_bind("stft", _stft_method)
+_bind("istft", _istft_method)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """reference: tensor/creation.py create_tensor — an empty typed tensor."""
+    import jax.numpy as _jnp
+    from .core.dtype import convert_dtype as _cd
+    t = Tensor(_jnp.zeros((0,), _cd(dtype)), stop_gradient=True)
+    t.name = name
+    t.persistable = persistable
+    return t
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
+                   mode="truncated", return_top=False, name=None):
+    """Nucleus sampling (reference: tensor/random.py top_p_sampling — GPU
+    kernel): keep the smallest prefix of sorted probs with mass >= ps,
+    renormalize, sample one id per row. Returns (values, ids)."""
+    import jax as _jax
+    import jax.numpy as _jnp
+    from .core import random as _random
+    if threshold is not None or k not in (0, None) or \
+            mode not in ("truncated", None) or return_top:
+        raise NotImplementedError(
+            "top_p_sampling: threshold/k/mode/return_top are not supported "
+            "on this backend; only plain nucleus sampling")
+    key = _jax.random.PRNGKey(seed) if seed >= 0 else _random.next_key()
+
+    def fn(probs, psv):
+        order = _jnp.argsort(-probs, axis=-1)
+        sp = _jnp.take_along_axis(probs, order, axis=-1)
+        cum = _jnp.cumsum(sp, axis=-1)
+        keep = (cum - sp) < psv.reshape(-1, 1)  # first index crossing ps kept
+        masked = _jnp.where(keep, sp, 0.0)
+        masked = masked / _jnp.sum(masked, axis=-1, keepdims=True)
+        idx_sorted = _jax.random.categorical(key, _jnp.log(masked + 1e-20),
+                                             axis=-1)
+        ids = _jnp.take_along_axis(order, idx_sorted[:, None], axis=-1)
+        vals = _jnp.take_along_axis(probs, ids, axis=-1)
+        return vals, ids
+    from .core.tensor import dispatch as _dispatch
+    return _dispatch(fn, (x, ps), {}, name="top_p_sampling")
+
+
+def _tensor_set_(self, source=None, shape=None, dtype=None):
+    """reference: Tensor.set_ — re-point this tensor at source's data."""
+    if source is not None:
+        src = source._value if isinstance(source, Tensor) else source
+        self._value = src if shape is None else src.reshape(shape)
+    elif shape is not None:
+        import jax.numpy as _jnp
+        self._value = _jnp.zeros(shape, self._value.dtype)
+    self._node = None
+    return self
+
+
+def _tensor_resize_(self, shape, fill_zero=False):
+    """reference: Tensor.resize_ — keep the flat prefix; growing beyond the
+    current size requires fill_zero=True (reference raises otherwise)."""
+    import numpy as _np
+    import jax.numpy as _jnp
+    n_new = int(_np.prod(shape)) if len(shape) else 1
+    flat = self._value.reshape(-1)
+    if n_new <= flat.shape[0]:
+        self._value = flat[:n_new].reshape(shape)
+    else:
+        if not fill_zero:
+            raise ValueError(
+                "resize_: growing the tensor requires fill_zero=True")
+        pad = _jnp.zeros((n_new - flat.shape[0],), flat.dtype)
+        self._value = _jnp.concatenate([flat, pad]).reshape(shape)
+    self._node = None
+    return self
+
+
+_bind("set_", _tensor_set_)
+_bind("resize_", _tensor_resize_)
+_bind("create_tensor", _method(lambda self, *a, **k: create_tensor(*a, **k)))
+_bind("top_p_sampling", _method(top_p_sampling))

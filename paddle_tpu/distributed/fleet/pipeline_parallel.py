@@ -46,6 +46,34 @@ class PipelineParallel:
         self._accumulate_steps = (strategy.pipeline_configs.get("accumulate_steps", 1)
                                   if strategy else 1)
         self._remat = layers._recompute_interval > 0
+        # schedule_mode (reference: passes/pipeline_scheduler_pass/
+        # pipeline_{fthenb,1f1b,eager_1f1b,vpp,zero_bubble}.py). In the
+        # SPMD-compiled pipeline the schedules differ only in activation
+        # residency: FThenB keeps every microbatch's activations (no remat),
+        # 1F1B bounds them via per-microbatch remat, VPP adds virtual chunks,
+        # ZBH1 has no XLA analog for its W-grad split and maps to 1F1B.
+        raw_mode = (strategy.pipeline_configs.get("schedule_mode")
+                    if strategy else None)
+        self._schedule_mode = (raw_mode or "1F1B").upper().replace("-", "")
+        if raw_mode is not None:
+            mode = self._schedule_mode
+            known = {"FTHENB", "1F1B", "EAGER1F1B", "VPP", "ZBH1", "ZBVPP",
+                     "ZEROBUBBLE"}
+            if mode not in known:
+                raise ValueError(
+                    f"unknown pipeline schedule_mode {raw_mode!r}; expected "
+                    f"one of {sorted(known)}")
+            if mode == "FTHENB":
+                # keep-all-activations schedule; a model-configured recompute
+                # interval still wins (it was set to fit HBM)
+                self._remat = False if layers._recompute_interval == 0 \
+                    else self._remat
+            elif mode in ("1F1B", "EAGER1F1B", "ZBH1", "ZEROBUBBLE"):
+                # bounded-activation schedules: remat every microbatch
+                self._remat = True
+            elif mode in ("VPP", "ZBVPP") and self._V <= 1:
+                raise ValueError(
+                    "schedule_mode VPP needs num_virtual_pipeline_stages > 1")
         self._cache = {}
         self._opt_remapped = False
         self._split_layers()

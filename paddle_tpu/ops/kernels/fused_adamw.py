@@ -141,9 +141,12 @@ def fused_adamw_update(p_low, g, m, v, master, lr, step, *, beta1=0.9,
             rows = n // cols
         else:
             rows, cols = 1, n
-    if rows == 1 and cols > 65536:
-        # a single (1, n) block would blow the scoped-VMEM budget — let the
-        # generic XLA update handle this tensor
+    # unified VMEM guard: 9 live fp32-sized buffers, double-buffered by
+    # pallas, must stay within the ~16 MB scoped budget. _pick_block can't go
+    # below 8 rows, so wide-column tensors can still exceed it — refuse and
+    # let the generic XLA update handle those.
+    br = _pick_block(rows, cols)
+    if br * cols > (4 * 1024 * 1024) // (9 * 4):
         return None
     g2 = g.reshape(rows, cols)
     m2 = m.reshape(rows, cols)
