@@ -606,11 +606,13 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
     import jax as _jax
     import jax.numpy as _jnp
     from .core import random as _random
-    if threshold is not None or k not in (0, None) or \
-            mode not in ("truncated", None) or return_top:
+    if threshold is not None or topp_seed is not None or \
+            k not in (0, None) or mode not in ("truncated", None) or \
+            return_top:
         raise NotImplementedError(
-            "top_p_sampling: threshold/k/mode/return_top are not supported "
-            "on this backend; only plain nucleus sampling")
+            "top_p_sampling: threshold/topp_seed/k/mode/return_top are not "
+            "supported on this backend; only plain nucleus sampling (use "
+            "seed= for reproducibility)")
     key = _jax.random.PRNGKey(seed) if seed >= 0 else _random.next_key()
 
     def fn(probs, psv):
@@ -631,12 +633,16 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
 
 def _tensor_set_(self, source=None, shape=None, dtype=None):
     """reference: Tensor.set_ — re-point this tensor at source's data."""
+    from .core.dtype import convert_dtype as _cd
     if source is not None:
         src = source._value if isinstance(source, Tensor) else source
-        self._value = src if shape is None else src.reshape(shape)
+        if shape is not None:
+            src = src.reshape(shape)
+        self._value = src.astype(_cd(dtype)) if dtype is not None else src
     elif shape is not None:
         import jax.numpy as _jnp
-        self._value = _jnp.zeros(shape, self._value.dtype)
+        self._value = _jnp.zeros(
+            shape, _cd(dtype) if dtype is not None else self._value.dtype)
     self._node = None
     return self
 

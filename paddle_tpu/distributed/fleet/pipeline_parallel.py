@@ -64,10 +64,10 @@ class PipelineParallel:
                     f"unknown pipeline schedule_mode {raw_mode!r}; expected "
                     f"one of {sorted(known)}")
             if mode == "FTHENB":
-                # keep-all-activations schedule; a model-configured recompute
-                # interval still wins (it was set to fit HBM)
-                self._remat = False if layers._recompute_interval == 0 \
-                    else self._remat
+                # keep-all-activations schedule — _remat already reflects the
+                # model's own recompute config (which wins; it was set to fit
+                # HBM), so nothing to change
+                pass
             elif mode in ("1F1B", "EAGER1F1B", "ZBH1", "ZEROBUBBLE"):
                 # bounded-activation schedules: remat every microbatch
                 self._remat = True
