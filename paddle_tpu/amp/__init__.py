@@ -27,8 +27,12 @@ WHITE_LIST = {
 # ops kept in fp32 (numerically sensitive)
 BLACK_LIST = {
     "exp", "log", "log2", "log10", "log1p", "softmax", "log_softmax",
-    "cross_entropy", "nll_loss", "layer_norm", "batch_norm", "group_norm",
-    "rms_norm", "mean", "sum", "logsumexp", "softmax_with_cross_entropy",
+    # cross_entropy / softmax_with_cross_entropy are NOT black-listed: the
+    # fused CE kernel accumulates its lse in fp32 internally, and an O1
+    # upcast here would materialize the (tokens, vocab) fp32 logits copy the
+    # kernel exists to avoid
+    "nll_loss", "layer_norm", "batch_norm", "group_norm",
+    "rms_norm", "mean", "sum", "logsumexp",
     "cosine_similarity", "erf", "erfinv", "pow", "rsqrt",
 }
 

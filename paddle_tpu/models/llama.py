@@ -222,7 +222,9 @@ class LlamaForCausalLM(Layer):
         if labels is None:
             return logits
         loss = F.cross_entropy(
-            ops.reshape(logits, [-1, self.config.vocab_size]).astype("float32"),
+            # no fp32 pre-cast: cross_entropy's fused path accumulates
+            # the lse in fp32 internally without copying the logits
+            ops.reshape(logits, [-1, self.config.vocab_size]),
             ops.reshape(labels, [-1]), ignore_index=-100)
         return loss, logits
 

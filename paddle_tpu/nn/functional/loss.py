@@ -31,25 +31,49 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     def fn(logits, lbl, *w):
         ax = int(axis) % logits.ndim
         n_classes = logits.shape[ax]
-        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax \
-            else jnp.log(jnp.maximum(logits, 1e-30))
+        # the generic branches compute their log-probs in fp32 (the AMP
+        # black-list no longer upcasts cross_entropy — the fused fast path
+        # below owns its fp32 accumulation, these own theirs)
         if soft_label:
-            loss = -jnp.sum(lbl * logp, axis=ax)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax) \
+                if use_softmax \
+                else jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+            loss = -jnp.sum(lbl * logp, axis=ax).astype(logits.dtype)
             if w:
                 loss = loss * w[0]
             return _reduce(loss, reduction)
         lbl_int = lbl.astype(jnp.int32)
         if lbl_int.ndim == logits.ndim:
             lbl_int = jnp.squeeze(lbl_int, axis=ax)
-        if label_smoothing > 0.0:
-            eps = label_smoothing
-            nll = -jnp.take_along_axis(logp, jnp.expand_dims(
-                jnp.clip(lbl_int, 0, n_classes - 1), ax), axis=ax).squeeze(ax)
-            smooth = -jnp.mean(logp, axis=ax)
-            loss = (1 - eps) * nll + eps * smooth
+        if (use_softmax and label_smoothing == 0.0 and not w
+                and ax == logits.ndim - 1
+                and jnp.issubdtype(jnp.asarray(lbl).dtype, jnp.integer)):
+            # hot path (LLM loss): hard labels over the last dim with no
+            # weights/smoothing — the memory-lean custom-vjp CE
+            # (ops/kernels/fused_ce.py) avoids materializing any fp32
+            # logits/softmax copy for backward; loss cast back to the logits
+            # dtype to match the generic branch, then falls through to the
+            # shared masking/reduction tail below
+            from ...ops.kernels.fused_ce import fused_softmax_ce
+            flat = fused_softmax_ce(logits.reshape(-1, n_classes),
+                                    lbl_int.reshape(-1), ignore_index)
+            loss = flat.reshape(lbl_int.shape).astype(logits.dtype)
         else:
-            loss = -jnp.take_along_axis(logp, jnp.expand_dims(
-                jnp.clip(lbl_int, 0, n_classes - 1), ax), axis=ax).squeeze(ax)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax) \
+                if use_softmax \
+                else jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+            if label_smoothing > 0.0:
+                eps = label_smoothing
+                nll = -jnp.take_along_axis(logp, jnp.expand_dims(
+                    jnp.clip(lbl_int, 0, n_classes - 1), ax),
+                    axis=ax).squeeze(ax)
+                smooth = -jnp.mean(logp, axis=ax)
+                loss = (1 - eps) * nll + eps * smooth
+            else:
+                loss = -jnp.take_along_axis(logp, jnp.expand_dims(
+                    jnp.clip(lbl_int, 0, n_classes - 1), ax),
+                    axis=ax).squeeze(ax)
+            loss = loss.astype(logits.dtype)
         valid = (lbl_int != ignore_index)
         loss = jnp.where(valid, loss, 0.0)
         if w:

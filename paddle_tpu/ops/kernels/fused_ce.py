@@ -1,0 +1,60 @@
+"""Memory-lean fused softmax cross-entropy (custom VJP).
+
+Reference analog: phi's softmax_with_cross_entropy kernel, which never
+materializes a separate fp32 log-probability tensor. The naive jax path costs
+~3 extra full passes over the (tokens, vocab) logits in HBM: an fp32 upcast
+copy, the saved fp32 softmax for backward, and the backward read of it — at
+LLM vocab sizes (tokens x 32000) that is GBs of traffic per step.
+
+This version keeps residuals to {bf16 logits (already live), fp32 lse (one
+scalar per token), labels}: forward computes lse with fp32 accumulation
+directly from the low-precision logits; backward reconstructs
+softmax = exp(l - lse) on the fly and fuses the one-hot subtraction, so the
+whole backward is ONE read + ONE write of the logits-sized buffer.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fused_softmax_ce(logits, labels, ignore_index=-100):
+    """Per-token CE loss. logits (T, V) any float dtype; labels (T,) int.
+    Returns fp32 loss (T,) with ignored positions zeroed."""
+    loss, _ = _ce_fwd_impl(logits, labels, ignore_index)
+    return loss
+
+
+def _ce_fwd_impl(logits, labels, ignore_index):
+    l32 = logits.astype(jnp.float32)  # XLA fuses the cast into the reductions
+    m = jnp.max(l32, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(l32 - m[:, None]), axis=-1))
+    idx = jnp.clip(labels.astype(jnp.int32), 0, logits.shape[-1] - 1)
+    tgt = jnp.take_along_axis(l32, idx[:, None], axis=-1)[:, 0]
+    valid = labels != ignore_index
+    loss = jnp.where(valid, lse - tgt, 0.0)
+    return loss, lse
+
+
+def _ce_vjp_fwd(logits, labels, ignore_index):
+    loss, lse = _ce_fwd_impl(logits, labels, ignore_index)
+    return loss, (logits, labels, lse)
+
+
+def _ce_vjp_bwd(ignore_index, res, g):
+    logits, labels, lse = res
+    idx = jnp.clip(labels.astype(jnp.int32), 0, logits.shape[-1] - 1)
+    valid = (labels != ignore_index)
+    scale = jnp.where(valid, g, 0.0).astype(jnp.float32)  # (T,)
+    # softmax reconstructed from the saved bf16 logits + fp32 lse; the one-hot
+    # subtraction folds into the same elementwise pass
+    probs = jnp.exp(logits.astype(jnp.float32) - lse[:, None])
+    onehot = jax.nn.one_hot(idx, logits.shape[-1], dtype=jnp.float32)
+    grad = (probs - onehot) * scale[:, None]
+    return grad.astype(logits.dtype), None
+
+
+fused_softmax_ce.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)
