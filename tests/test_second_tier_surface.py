@@ -247,3 +247,44 @@ def test_shard_dataloader_multi_mesh():
     bad = dist.shard_dataloader([(1, 2, 3)], [m1, m2])
     with pytest.raises(NotImplementedError):
         next(iter(bad))
+
+
+def test_fleet_fs_clients(tmp_path):
+    import paddle_tpu.distributed.fleet as fleet
+    fs = fleet.LocalFS()
+    d = str(tmp_path / "fsroot")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = str(tmp_path / "fsroot" / "a.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(d)
+    assert files == ["a.txt"]
+    fs.upload(f, str(tmp_path / "fsroot" / "b.txt"))
+    with pytest.raises(fleet.ExecuteError):
+        # hadoop CLI absent in this environment
+        fleet.HDFSClient("/nonexistent-hadoop").is_exist("/x") or \
+            fleet.HDFSClient("/nonexistent-hadoop").mkdirs("/x")
+    fs.delete(d)
+    assert not fs.is_exist(d)
+    di = fleet.DistributedInfer()
+    assert di.get_dist_infer_program() is None
+
+
+def test_download_cache_only(tmp_path, monkeypatch):
+    import paddle_tpu.utils as utils
+    monkeypatch.setenv("PADDLE_HOME", str(tmp_path))
+    import os
+    wdir = os.path.join(str(tmp_path), "hapi", "weights")
+    os.makedirs(wdir)
+    open(os.path.join(wdir, "m.pdparams"), "w").write("x")
+    p = utils.get_weights_path_from_url("https://x.test/m.pdparams")
+    assert p.endswith("m.pdparams")
+    with pytest.raises(RuntimeError, match="no network"):
+        utils.get_weights_path_from_url("https://x.test/missing.pdparams")
+
+
+def test_cuda_extension_descriptor():
+    from paddle_tpu.utils.cpp_extension import CUDAExtension, CppExtension
+    ext = CUDAExtension(sources=["a.cc"])
+    assert isinstance(ext, CppExtension) and ext.cuda
