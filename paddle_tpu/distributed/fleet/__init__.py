@@ -88,26 +88,36 @@ def distributed_model(model):
 
 
 class _HybridShardedModel:
-    """Shards the input batch over dp and passes through (TP layers carry their own
-    shardings). Grad sync emerges from GSPMD."""
+    """Shards the input batch over the data-like mesh axes and passes through
+    (TP layers carry their own shardings). Grad sync emerges from GSPMD.
 
-    def __init__(self, model, hcg):
+    ``axes`` lists every mesh axis the batch dim splits over — plain dp, and
+    for group-sharded (ZeRO) training also 'sharding': the reference's
+    group_sharded stages ARE data parallelism over the sharding group, which
+    is what makes grads partial along it (so stage2 can reduce-scatter them).
+    """
+
+    def __init__(self, model, hcg, axes=("dp",)):
         self._model = model
         self._hcg = hcg
+        self._axes = tuple(axes)
 
     def __call__(self, *args, **kwargs):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
         from ...core.tensor import Tensor
-        mesh = self._hcg.mesh
-        dp = self._hcg.get_data_parallel_world_size()
+        mesh = self._hcg.mesh.jax_mesh()
+        axes = [a for a in self._axes if mesh.shape.get(a, 1) > 1]
+        n = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
+        if n <= 1:
+            return self._model(*args, **kwargs)
         new_args = []
         for a in args:
-            if isinstance(a, Tensor) and a.ndim >= 1 and a.shape[0] % dp == 0:
+            if isinstance(a, Tensor) and a.ndim >= 1 and a.shape[0] % n == 0:
                 spec = [None] * a.ndim
-                spec[0] = "dp"
+                spec[0] = tuple(axes) if len(axes) > 1 else axes[0]
                 v = jax.device_put(a._value, NamedSharding(
-                    mesh.jax_mesh(), PartitionSpec(*spec)))
+                    mesh, PartitionSpec(*spec)))
                 new_args.append(Tensor(v, stop_gradient=a.stop_gradient))
             else:
                 new_args.append(a)
@@ -120,9 +130,14 @@ class _HybridShardedModel:
 def distributed_optimizer(optimizer, strategy=None):
     hcg = fleet_state.hcg()
     strategy = strategy or fleet_state.strategy()
+    if getattr(optimizer, "_IS_SHARDING_WRAPPER", False):
+        return optimizer  # already wrapped (e.g. via group_sharded_parallel)
     if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
         from .sharding_optimizer import DygraphShardingOptimizer
-        return DygraphShardingOptimizer(optimizer, hcg)
+        stage = 1
+        if strategy is not None:
+            stage = int((strategy.sharding_configs or {}).get("stage", 1))
+        return DygraphShardingOptimizer(optimizer, hcg, stage=stage)
     return optimizer
 
 

@@ -19,7 +19,8 @@ from __future__ import annotations
 import jax
 
 from ...core import random as _random
-from ...core.tensor import Tensor, dispatch, functional_mode, is_grad_enabled
+from ...core.tensor import (Tensor, dispatch, functional_mode,
+                            in_functional_mode, is_grad_enabled)
 from ...jit.functional_call import collect_state, bind_state
 
 
@@ -62,7 +63,11 @@ def recompute(function, *args, **kwargs):
     if isinstance(policy, str) or policy is None:
         policy = POLICIES[policy]
 
-    if not is_grad_enabled():
+    # Skip only in *eager* no-grad mode. Under functional_mode the tape is off
+    # but an outer jax.grad/value_and_grad may be differentiating this very
+    # trace (TrainStep, pipeline step) — jax.checkpoint must still apply there
+    # or remat silently degrades to keep-all-activations.
+    if not is_grad_enabled() and not in_functional_mode():
         return function(*args, **kwargs)
 
     layers = _find_layers(function, (args, kwargs))

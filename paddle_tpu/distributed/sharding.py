@@ -18,15 +18,24 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
         import jax
         strategy.hybrid_configs["sharding_degree"] = len(jax.devices())
         fleet.init(is_collective=True, strategy=strategy)
+    # group-sharded training is data-parallel over the sharding group: the
+    # batch splits along it so grads are partial there (stage2's
+    # reduce-scatter needs this); the wrapper is a no-op for non-dist inputs
+    from .fleet import _HybridShardedModel
+    from .fleet import fleet_state as _fs
     if level == "os":
         opt = DygraphShardingOptimizer(optimizer)
-        return model, opt, scaler
+        return _HybridShardedModel(model, _fs.hcg(), axes=("dp", "sharding")), \
+            opt, scaler
     if level == "os_g":
         opt = GroupShardedStage2(optimizer)
-        return model, opt, scaler
+        return _HybridShardedModel(model, _fs.hcg(), axes=("dp", "sharding")), \
+            opt, scaler
     if level == "p_g_os":
         wrapped = GroupShardedStage3(model, optimizer)
-        return wrapped, wrapped._optimizer, scaler
+        sharded = _HybridShardedModel(wrapped, _fs.hcg(),
+                                      axes=("dp", "sharding"))
+        return sharded, wrapped._optimizer, scaler
     raise ValueError(f"unknown sharding level {level!r}")
 
 

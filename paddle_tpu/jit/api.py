@@ -297,6 +297,14 @@ class TrainStep:
             b._value = nv
         return Tensor(loss_val)
 
+    def _acc_shardings(self):
+        """Per-param NamedSharding for grad accumulators, from a ZeRO-2+
+        sharding optimizer wrapper (None = keep replicated)."""
+        placement = getattr(self.optimizer, "_grad_placement", None)
+        if placement is None:
+            return [None] * len(self.params)
+        return [placement(i) for i in range(len(self.params))]
+
     # -- gradient-accumulation path ------------------------------------------
     def _call_accumulate(self, *batch):
         opt = self.optimizer
@@ -308,6 +316,10 @@ class TrainStep:
             loss_of_full = _make_loss_of(self.model, self.loss_fn, self.params,
                                          self.frozen, self.buffers, static_key,
                                          layout, treedef)
+            # ZeRO-2 (sharding wrapper): persistent fp32 accumulators live
+            # sharded at 1/N per device; constraining each microstep's grad to
+            # that placement reduce-scatters it straight into the shard
+            acc_shardings = self._acc_shardings()
 
             def grad_fn(param_vals, acc_vals, buf_vals, frozen_vals, rng_key,
                         dyn_vals):
@@ -317,8 +329,12 @@ class TrainStep:
 
                 (loss_val, new_bufs), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(param_vals)
-                new_acc = [a + g.astype(jnp.float32)
-                           for a, g in zip(acc_vals, grads)]
+                new_acc = []
+                for a, g, sh in zip(acc_vals, grads, acc_shardings):
+                    g = g.astype(jnp.float32)
+                    if sh is not None:
+                        g = jax.lax.with_sharding_constraint(g, sh)
+                    new_acc.append(a + g)
                 return loss_val, new_acc, new_bufs
 
             # acc buffers are internal (never user-visible) — always donated
@@ -333,8 +349,10 @@ class TrainStep:
             K = self.accumulate_steps
 
             def update_fn(param_vals, slot_vals, acc_vals, lr, step_i):
-                grads = [(a / K).astype(p.dtype)
-                         for a, p in zip(acc_vals, param_vals)]
+                # keep the fp32 mean — both the generic multi-precision path
+                # and the fused kernel upcast anyway, so downcasting here
+                # would only discard the accumulated precision
+                grads = [a / K for a in acc_vals]
                 return opt.apply_updates(param_vals, grads, slot_vals, lr,
                                          step_i, decay_flags)
 
@@ -343,7 +361,10 @@ class TrainStep:
 
         param_vals = read_values(self.params)
         if self._acc is None:
-            self._acc = [jnp.zeros(p.shape, jnp.float32) for p in self.params]
+            self._acc = []
+            for z, sh in zip((jnp.zeros(p.shape, jnp.float32)
+                              for p in self.params), self._acc_shardings()):
+                self._acc.append(jax.device_put(z, sh) if sh is not None else z)
         buf_vals = read_values(self.buffers)
         frozen_vals = read_values(self.frozen)
         rng_key = _random.next_key()

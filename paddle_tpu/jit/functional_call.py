@@ -19,6 +19,11 @@ from ..nn.layer_base import Layer
 
 def collect_state(layers) -> tuple[list[str], list[Tensor], list[str], list[Tensor]]:
     """Gather (param_names, params, buffer_names, buffers) across layers, deduped."""
+    # unwrap delegating model wrappers (DataParallel/_HybridShardedModel/
+    # GroupShardedStage3 all proxy a real Layer behind `_model`)
+    while not isinstance(layers, (Layer, list, tuple)) \
+            and getattr(layers, "_model", None) is not None:
+        layers = layers._model
     if isinstance(layers, Layer):
         layers = [layers]
     pnames, params, bnames, buffers = [], [], [], []

@@ -42,6 +42,11 @@ class LlamaConfig:
     # the layer input is read once per block instead of 3x/2x (HBM win)
     fuse_attention_qkv: bool = False
     fuse_swiglu: bool = False
+    # per-decoder-layer activation recompute (reference: PaddleNLP llama
+    # use_recompute → fleet recompute per block). Saves only each block's
+    # input; XLA re-traces the block inside the backward.
+    use_recompute: bool = False
+    recompute_policy: str | None = None
     dtype: str = "float32"
 
     @staticmethod
@@ -198,8 +203,15 @@ class LlamaModel(Layer):
     def forward(self, input_ids, attn_mask=None):
         x = self.embed_tokens(input_ids)
         rope = (self.rope_cos._value, self.rope_sin._value)
+        remat = self.config.use_recompute and self.training
+        if remat:
+            from ..distributed.fleet.recompute import recompute
         for layer in self.layers:
-            x = layer(x, rope, attn_mask)
+            if remat:
+                x = recompute(layer, x, rope, attn_mask,
+                              checkpoint_policy=self.config.recompute_policy)
+            else:
+                x = layer(x, rope, attn_mask)
         return self.norm(x)
 
 

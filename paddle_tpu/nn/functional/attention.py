@@ -60,7 +60,10 @@ def _use_pallas(q_val):
         dev = next(iter(q_val.devices()))
         return dev.platform in ("tpu", "axon")
     except Exception:
-        return False
+        # tracer (jit/checkpoint/vmap): no device on the value — decide from
+        # the backend. Returning False here would silently downgrade remat'd
+        # attention to the O(S^2)-memory einsum path.
+        return jax.default_backend() in ("tpu", "axon")
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
