@@ -40,10 +40,15 @@ class Generator:
 
     def next_key(self):
         with self._lock:
-            if self._key is None:
-                self._key = jax.random.key(self._seed)
+            key = self._key
+            if key is None:
+                key = jax.random.key(self._seed)
+                # don't cache a key materialized during a trace — it would
+                # leak the tracer into later eager calls
+                if not isinstance(key, jax.core.Tracer):
+                    self._key = key
             self._counter += 1
-            return jax.random.fold_in(self._key, self._counter)
+            return jax.random.fold_in(key, self._counter)
 
     def next_seed(self):
         """Host-side draw: a fresh (seed, counter) pair for numpy RNGs (no

@@ -24,7 +24,8 @@ from ...core.tensor import Tensor, functional_mode
 from ...core import random as _random
 from ...nn.layer_base import Layer, Parameter
 from ...jit.functional_call import bind_state, collect_state, read_values
-from ..pipeline import spmd_pipeline, interleaved_pipeline
+from ..pipeline import (spmd_pipeline, interleaved_pipeline,
+                        scheduled_pipeline)
 from .pp_layers import PipelineLayer
 
 
@@ -47,33 +48,42 @@ class PipelineParallel:
                                   if strategy else 1)
         self._remat = layers._recompute_interval > 0
         # schedule_mode (reference: passes/pipeline_scheduler_pass/
-        # pipeline_{fthenb,1f1b,eager_1f1b,vpp,zero_bubble}.py). In the
-        # SPMD-compiled pipeline the schedules differ only in activation
-        # residency: FThenB keeps every microbatch's activations (no remat),
-        # 1F1B bounds them via per-microbatch remat, VPP adds virtual chunks,
-        # ZBH1 has no XLA analog for its W-grad split and maps to 1F1B.
+        # pipeline_{fthenb,1f1b,eager_1f1b,vpp,zero_bubble}.py). Distinct
+        # compiled runtimes, not aliases:
+        # - FTHENB (and the no-mode default): whole-scan autodiff
+        #   (distributed/pipeline.py spmd_pipeline) — every microbatch's
+        #   intermediates stay live; optional remat per the model's own
+        #   recompute config.
+        # - 1F1B / EAGER1F1B: scheduled_pipeline — hand-scheduled reverse
+        #   ring via custom_vjp; per-device residency = M stage-boundary
+        #   activations + ONE microbatch's recompute, the 1F1B bound.
+        # - ZBH1 / ZEROBUBBLE: scheduled_pipeline(zero_bubble=True) — the
+        #   W-split: dx-only on the serial ring chain, dw in a ring-free
+        #   deferred pass (memory-for-bubble trade, like the reference).
+        # - VPP: interleaved_pipeline virtual chunks (needs V > 1).
         raw_mode = (strategy.pipeline_configs.get("schedule_mode")
                     if strategy else None)
-        self._schedule_mode = (raw_mode or "1F1B").upper().replace("-", "")
-        if raw_mode is not None:
-            mode = self._schedule_mode
-            known = {"FTHENB", "1F1B", "EAGER1F1B", "VPP", "ZBH1", "ZBVPP",
-                     "ZEROBUBBLE"}
-            if mode not in known:
-                raise ValueError(
-                    f"unknown pipeline schedule_mode {raw_mode!r}; expected "
-                    f"one of {sorted(known)}")
-            if mode == "FTHENB":
-                # keep-all-activations schedule — _remat already reflects the
-                # model's own recompute config (which wins; it was set to fit
-                # HBM), so nothing to change
-                pass
-            elif mode in ("1F1B", "EAGER1F1B", "ZBH1", "ZEROBUBBLE"):
-                # bounded-activation schedules: remat every microbatch
-                self._remat = True
-            elif mode in ("VPP", "ZBVPP") and self._V <= 1:
-                raise ValueError(
-                    "schedule_mode VPP needs num_virtual_pipeline_stages > 1")
+        self._schedule_mode = (raw_mode or "FTHENB").upper().replace("-", "")
+        mode = self._schedule_mode
+        known = {"FTHENB", "1F1B", "EAGER1F1B", "VPP", "ZBH1", "ZBVPP",
+                 "ZEROBUBBLE"}
+        if mode not in known:
+            raise ValueError(
+                f"unknown pipeline schedule_mode {raw_mode!r}; expected "
+                f"one of {sorted(known)}")
+        if mode == "ZBVPP":
+            # zero-bubble + virtual chunks is not implemented; failing loudly
+            # beats silently running plain VPP without the W-split
+            raise NotImplementedError(
+                "schedule_mode ZBVPP (zero-bubble interleaved) is not "
+                "implemented; use VPP (interleaved) or ZBH1 (zero-bubble)")
+        if mode == "VPP" and self._V <= 1:
+            raise ValueError(
+                "schedule_mode VPP needs num_virtual_pipeline_stages > 1")
+        if mode in ("1F1B", "EAGER1F1B", "ZBH1", "ZEROBUBBLE") \
+                and self._V > 1:
+            raise ValueError(
+                f"schedule_mode {mode} runs V=1; use VPP for virtual chunks")
         self._cache = {}
         self._opt_remapped = False
         self._split_layers()
@@ -279,6 +289,7 @@ class PipelineParallel:
         prefix_entries, suffix_entries = self._prefix, self._suffix
         layers_obj = self._layers
         V, remat = self._V, self._remat
+        mode = self._schedule_mode
         dp = self._dp
         decay_flags = tuple(bool(optimizer._decay_mask(p)) for p in trainable)
 
@@ -323,6 +334,12 @@ class PipelineParallel:
                         y_mb = interleaved_pipeline(stage, stacked_vals, h_mb, mesh,
                                                     "pp", num_chunks=V,
                                                     remat=remat)
+                    elif mode in ("1F1B", "EAGER1F1B"):
+                        y_mb = scheduled_pipeline(stage, stacked_vals, h_mb,
+                                                  mesh, "pp")
+                    elif mode in ("ZBH1", "ZEROBUBBLE"):
+                        y_mb = scheduled_pipeline(stage, stacked_vals, h_mb,
+                                                  mesh, "pp", zero_bubble=True)
                     else:
                         y_mb = spmd_pipeline(stage, stacked_vals, h_mb, mesh, "pp",
                                              remat=remat)

@@ -69,6 +69,182 @@ def spmd_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp", remat=False):
                      check_vma=False)(stacked_params, x_mb)
 
 
+def scheduled_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp",
+                       zero_bubble=False):
+    """Explicit micro-batch schedule: 1F1B / ZBH1 (reference:
+    fleet/meta_parallel/pipeline_parallel.py:684 forward_backward_pipeline,
+    passes/pipeline_scheduler_pass/pipeline_zero_bubble.py).
+
+    Unlike :func:`spmd_pipeline` (whole-scan autodiff — the FThenB residency
+    policy: XLA keeps every microbatch's intermediates), this runtime owns the
+    backward schedule via ``jax.custom_vjp``:
+
+    - **forward**: ring scan; each stage stores ONLY its M stage-boundary
+      inputs, sharded over `axis` (per-device boundary memory = M x microbatch,
+      the 1F1B residency bound with recompute — nothing else survives).
+    - **backward (1F1B)**: reverse ring scan; at each tick a stage recomputes
+      one microbatch's block from its saved boundary and applies its vjp —
+      at most one microbatch's intermediates are ever live per device; dx
+      ppermutes upstream; dw accumulates into the stage's param-grad shard.
+    - **backward (ZBH1, zero_bubble=True)**: the reference's W-split, the
+      TPU-native way: the reverse scan computes ONLY dx (XLA dead-code
+      eliminates the dw GEMMs), so the serial cross-stage dependency chain —
+      the thing that makes the bubble — contains just the dx work; dw for all
+      stages/microbatches is computed afterwards in a scan with NO ppermute,
+      i.e. completely off the ring's critical path, free for XLA's
+      latency-hiding scheduler to overlap. Costs one extra forward recompute
+      and an M-deep dy buffer per stage — the same memory-for-bubble trade
+      zero-bubble makes.
+
+    Micro-timing within a tick is XLA's prerogative (there is no host schedule
+    loop to drive on TPU); what each mode pins is the *residency policy* and
+    the *dependency structure*, which is what the schedules differ by.
+
+    RNG: one base key is drawn per call and folded with (stage, microbatch),
+    so the backward recompute sees the forward's randomness by construction.
+    """
+    from ..core import random as _random
+
+    jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+    S = jmesh.shape[axis]
+    M = x_mb.shape[0]
+    T = M + S - 1
+    batch_spec = P()
+    key_base = _random.next_key()
+
+    def run_stage(params, x, stage_i, mb_i):
+        k = jax.random.fold_in(jax.random.fold_in(key_base, stage_i), mb_i)
+        with _random.provide_key(k):
+            return stage_fn(params, x)
+
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def _masked_row_write(buf, row_i, value, valid):
+        """Write `value` into buf[row_i] only when valid (read-modify-write —
+        keeps the scan carry at exactly M rows instead of stacking T ticks)."""
+        old = jax.lax.dynamic_index_in_dim(buf, row_i, 0, keepdims=False)
+        new = jnp.where(valid, value, old)
+        return jax.lax.dynamic_update_slice_in_dim(buf, new[None], row_i, 0)
+
+    def fwd_device(params_l, x):
+        params = jax.tree_util.tree_map(lambda a: a[0], params_l)
+        idx = jax.lax.axis_index(axis)
+
+        def step(carry, t):
+            state, y_buf, resid_buf = carry
+            mb = jax.lax.dynamic_index_in_dim(x, jnp.clip(t, 0, M - 1), 0,
+                                              keepdims=False)
+            cur = jnp.where(idx == 0, mb, state)
+            f = t - idx                       # this stage's microbatch number
+            fc = jnp.clip(f, 0, M - 1)
+            valid = (f >= 0) & (f < M)
+            resid_buf = _masked_row_write(resid_buf, fc, cur, valid)
+            out = run_stage(params, cur, idx, fc)
+            yf = t - (S - 1)                  # last stage's microbatch number
+            y_buf = _masked_row_write(y_buf, jnp.clip(yf, 0, M - 1), out,
+                                      (yf >= 0) & (yf < M))
+            return (jax.lax.ppermute(out, axis, fwd_perm), y_buf,
+                    resid_buf), None
+
+        zero_mb = jnp.zeros_like(x[0])
+        (_, y_buf, resid), _ = jax.lax.scan(
+            step, (zero_mb, jnp.zeros_like(x), jnp.zeros_like(x)),
+            jnp.arange(T))
+        y = jnp.where(idx == S - 1, y_buf, jnp.zeros_like(y_buf))
+        return jax.lax.psum(y, axis), resid[None]  # [1(pp), M, mb...]
+
+    def bwd_device(params_l, resid_l, dy_mb):
+        params = jax.tree_util.tree_map(lambda a: a[0], params_l)
+        resid = resid_l[0]                        # [M, mb...]
+        idx = jax.lax.axis_index(axis)
+        U = M + S - 1
+
+        def tick(carry, u):
+            state, dw_acc, dx_buf, dy_buf = carry
+            b = u - (S - 1 - idx)                 # this stage's microbatch
+            bc = jnp.clip(b, 0, M - 1)
+            valid = (b >= 0) & (b < M)
+            dy_last = jax.lax.dynamic_index_in_dim(dy_mb, bc, 0,
+                                                   keepdims=False)
+            dy = jnp.where(idx == S - 1, dy_last, state)
+            x_b = jax.lax.dynamic_index_in_dim(resid, bc, 0, keepdims=False)
+            if zero_bubble:
+                # dx-only chain: dw GEMMs are dead code here (W-split); dy is
+                # buffered (microbatch-aligned) for the deferred W pass
+                _, vjp_x = jax.vjp(
+                    lambda xx: run_stage(params, xx, idx, bc), x_b)
+                (dx,) = vjp_x(dy)
+                dy_buf = _masked_row_write(dy_buf, bc, dy, valid)
+            else:
+                _, vjp_fn = jax.vjp(
+                    lambda pp, xx: run_stage(pp, xx, idx, bc), params, x_b)
+                dw, dx = vjp_fn(dy)
+                dw_acc = jax.tree_util.tree_map(
+                    lambda acc, g: acc + jnp.where(valid, g, 0), dw_acc, dw)
+            dx = jnp.where(valid, dx, jnp.zeros_like(dx))
+            dx_buf = _masked_row_write(dx_buf, bc, dx, valid)
+            nxt = jax.lax.ppermute(dx, axis, bwd_perm)
+            return (nxt, dw_acc, dx_buf, dy_buf), None
+
+        dw0 = jax.tree_util.tree_map(lambda a: jnp.zeros_like(a), params)
+        zero_buf = jnp.zeros((M,) + dy_mb.shape[1:], dy_mb.dtype)
+        (_, dw_acc, dx_buf, dy_buf), _ = jax.lax.scan(
+            tick, (jnp.zeros_like(dy_mb[0]), dw0, zero_buf,
+                   zero_buf if zero_bubble else jnp.zeros((), dy_mb.dtype)),
+            jnp.arange(U))
+
+        if zero_bubble:
+            # deferred W pass: per-stage, no ppermute — off the ring's
+            # critical path (dy_buf is already microbatch-aligned)
+
+            def w_tick(dw_acc, bm):
+                x_b = jax.lax.dynamic_index_in_dim(resid, bm, 0,
+                                                   keepdims=False)
+                dy_b = jax.lax.dynamic_index_in_dim(dy_buf, bm, 0,
+                                                    keepdims=False)
+                _, vjp_p = jax.vjp(
+                    lambda pp: run_stage(pp, x_b, idx, bm), params)
+                (dw,) = vjp_p(dy_b)
+                return jax.tree_util.tree_map(lambda a, g: a + g,
+                                              dw_acc, dw), None
+
+            dw_acc, _ = jax.lax.scan(w_tick, dw0, jnp.arange(M))
+
+        dx_mb = jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf))
+        dparams = jax.tree_util.tree_map(lambda a: a[None], dw_acc)
+        return dparams, jax.lax.psum(dx_mb, axis)
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    resid_spec = P(axis)
+
+    fwd_sm = shard_map(fwd_device, mesh=jmesh,
+                       in_specs=(spec_params, batch_spec),
+                       out_specs=(batch_spec, resid_spec), axis_names={axis},
+                       check_vma=False)
+    bwd_sm = shard_map(bwd_device, mesh=jmesh,
+                       in_specs=(spec_params, resid_spec, batch_spec),
+                       out_specs=(spec_params, batch_spec), axis_names={axis},
+                       check_vma=False)
+
+    @jax.custom_vjp
+    def pipe(params, x):
+        y, _ = fwd_sm(params, x)
+        return y
+
+    def pipe_fwd(params, x):
+        y, resid = fwd_sm(params, x)
+        return y, (params, resid)
+
+    def pipe_bwd(res, dy):
+        params, resid = res
+        dparams, dx = bwd_sm(params, resid, dy)
+        return dparams, dx
+
+    pipe.defvjp(pipe_fwd, pipe_bwd)
+    return pipe(stacked_params, x_mb)
+
+
 def interleaved_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp",
                          num_chunks=2, remat=False):
     """Interleaved (VPP) schedule: each device owns `num_chunks` non-adjacent model

@@ -1,0 +1,221 @@
+"""Pipeline micro-batch schedule contracts (reference:
+fleet/meta_parallel/pipeline_parallel.py:684 1F1B,
+distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py ZBH1).
+
+Pins, on the virtual 8-device CPU mesh:
+- value+grad parity of scheduled_pipeline (1F1B / ZBH1) against the
+  whole-scan-autodiff spmd_pipeline (FThenB),
+- the residency contracts: FThenB keeps every microbatch's intermediates
+  alive, 1F1B keeps only stage boundaries + one live recompute — measurably
+  different peak temp bytes in the compiled program; ZBH1 pays an extra
+  dy-buffer over 1F1B (the zero-bubble memory-for-bubble trade),
+- ZBH1's W-split structure: its backward carries the same ring
+  collective-permute count as 1F1B while the deferred dw pass adds none,
+- schedule_mode selection through fleet PipelineParallel.train_batch,
+  including mode validation.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.core.random as _random
+from paddle_tpu.distributed.pipeline import spmd_pipeline, scheduled_pipeline
+from paddle_tpu.utils.hlo_check import compile_report
+
+S, L, D, M, MB = 4, 2, 64, 8, 16
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()).reshape(4, 2), ("pp", "dp"))
+
+
+def _stage():
+    def stage(params, h):
+        def body(h, w):
+            return jnp.tanh(h @ w), None
+        h, _ = jax.lax.scan(body, h, params["w"])
+        return h
+    return stage
+
+
+def _inputs():
+    rng = np.random.default_rng(0)
+    W = jnp.asarray(rng.standard_normal((S, L, D, D)).astype(np.float32) * 0.1)
+    x = jnp.asarray(rng.standard_normal((M, MB, D)).astype(np.float32))
+    dy = jnp.asarray(rng.standard_normal((M, MB, D)).astype(np.float32))
+    return {"w": W}, x, dy
+
+
+def _grad_fn(fn, mesh, stage, dy, **kw):
+    @jax.jit
+    def vg(p, xx, rkey):
+        def f(p):
+            with _random.provide_key(rkey):
+                y = fn(stage, p, xx, mesh, "pp", **kw)
+            return jnp.vdot(y, dy)
+        return jax.value_and_grad(f)(p)
+    return vg
+
+
+class TestScheduledPipelineParity:
+    def test_values_and_grads_match_autodiff(self):
+        mesh = _mesh()
+        stage = _stage()
+        params, x, dy = _inputs()
+        key = jax.random.key(7)
+        v0, g0 = _grad_fn(spmd_pipeline, mesh, stage, dy)(params, x, key)
+        v1, g1 = _grad_fn(scheduled_pipeline, mesh, stage, dy)(params, x, key)
+        v2, g2 = _grad_fn(scheduled_pipeline, mesh, stage, dy,
+                          zero_bubble=True)(params, x, key)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-6)
+        np.testing.assert_allclose(float(v2), float(v0), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g1["w"]), np.asarray(g0["w"]),
+                                   rtol=3e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g2["w"]), np.asarray(g0["w"]),
+                                   rtol=3e-4, atol=1e-6)
+
+    def test_input_gradient_dx_parity(self):
+        """dx must flow correctly back to pipeline INPUTS (the path a prefix/
+        embedding layer ahead of the pipeline depends on)."""
+        mesh = _mesh()
+        stage = _stage()
+        params, x, dy = _inputs()
+        key = jax.random.key(11)
+
+        def dx_of(fn, **kw):
+            @jax.jit
+            def g(p, xx, rkey):
+                def f(xx):
+                    with _random.provide_key(rkey):
+                        y = fn(stage, p, xx, mesh, "pp", **kw)
+                    return jnp.vdot(y, dy)
+                return jax.grad(f)(xx)
+            return g(params, x, key)
+
+        dx0 = dx_of(spmd_pipeline)
+        dx1 = dx_of(scheduled_pipeline)
+        dx2 = dx_of(scheduled_pipeline, zero_bubble=True)
+        np.testing.assert_allclose(np.asarray(dx1), np.asarray(dx0),
+                                   rtol=3e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dx2), np.asarray(dx0),
+                                   rtol=3e-4, atol=1e-6)
+
+    def test_single_microbatch_edge(self):
+        mesh = _mesh()
+        stage = _stage()
+        params, x, dy = _inputs()
+        x1, dy1 = x[:1], dy[:1]
+        key = jax.random.key(3)
+        v0, g0 = _grad_fn(spmd_pipeline, mesh, stage, dy1)(params, x1, key)
+        v1, g1 = _grad_fn(scheduled_pipeline, mesh, stage, dy1)(params, x1, key)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g1["w"]), np.asarray(g0["w"]),
+                                   rtol=3e-4, atol=1e-6)
+
+
+class TestResidencyContracts:
+    def _report(self, fn, **kw):
+        mesh = _mesh()
+        stage = _stage()
+        params, x, dy = _inputs()
+        key = jax.random.key(7)
+        return compile_report(_grad_fn(fn, mesh, stage, dy, **kw),
+                              params, x, key)
+
+    def test_memory_ordering_fthenb_vs_1f1b_vs_zbh1(self):
+        fthenb = self._report(spmd_pipeline)
+        f1b1 = self._report(scheduled_pipeline)
+        zbh1 = self._report(scheduled_pipeline, zero_bubble=True)
+        # FThenB materializes every microbatch's per-layer intermediates;
+        # the scheduled modes only the boundaries + one recompute
+        assert f1b1.temp_bytes < fthenb.temp_bytes, \
+            (f1b1.temp_bytes, fthenb.temp_bytes)
+        assert zbh1.temp_bytes < fthenb.temp_bytes, \
+            (zbh1.temp_bytes, fthenb.temp_bytes)
+        # ZBH1's dy buffer trades ~one more microbatch-set of residency
+        # against the bubble; at small scale XLA scheduling noise dominates,
+        # so pin it to the 1F1B ballpark rather than a strict ordering
+        assert zbh1.temp_bytes > 0.8 * f1b1.temp_bytes, \
+            (zbh1.temp_bytes, f1b1.temp_bytes)
+
+    def test_zbh1_adds_no_ring_traffic(self):
+        f1b1 = self._report(scheduled_pipeline)
+        zbh1 = self._report(scheduled_pipeline, zero_bubble=True)
+        # the deferred dw pass must add zero collective-permutes: the ring
+        # chain (fwd T + dx U ticks) is identical between the two modes
+        assert zbh1.count("collective-permute") == \
+            f1b1.count("collective-permute")
+
+
+class TestScheduleModeSelection:
+    def _build(self, schedule_mode, pp=4, accumulate=4, vpp=1):
+        import paddle_tpu.distributed.fleet as fleet
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": pp, "sharding_degree": 1,
+                                   "sep_degree": 1}
+        strategy.pipeline_configs = {"accumulate_steps": accumulate,
+                                     "schedule_mode": schedule_mode}
+        fleet.init(is_collective=True, strategy=strategy)
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(16, 16)
+
+            def forward(self, x):
+                return x + F.relu(self.fc(x))
+
+        paddle.seed(42)
+        descs = [fleet.LayerDesc(Block) for _ in range(8)]
+        model = fleet.PipelineLayer(
+            layers=descs, loss_fn=lambda o, t: F.mse_loss(o, t),
+            num_virtual_pipeline_stages=vpp)
+        return fleet, model
+
+    @pytest.mark.parametrize("mode", ["FThenB", "1F1B", "ZBH1"])
+    def test_train_batch_matches_sequential(self, mode):
+        fleet, model = self._build(mode)
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=model.parameters())
+        pp_model = fleet.distributed_model(model)
+        assert pp_model._schedule_mode == mode.upper().replace("-", "")
+        x = paddle.randn([8, 16])
+        y = paddle.randn([8, 16])
+        loss = pp_model.train_batch([x, y], opt)
+        ref = F.mse_loss(model.forward(x), y)
+        np.testing.assert_allclose(float(loss.numpy()), float(ref.numpy()),
+                                   rtol=1e-4)
+
+    def test_1f1b_trains(self):
+        fleet, model = self._build("1F1B")
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        pp_model = fleet.distributed_model(model)
+        x = paddle.randn([8, 16])
+        y = paddle.randn([8, 16])
+        losses = [float(pp_model.train_batch([x, y], opt).numpy())
+                  for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="schedule_mode"):
+            fleet, model = self._build("WUBBLE")
+            fleet.distributed_model(model)
+
+    def test_scheduled_mode_rejects_virtual_chunks(self):
+        with pytest.raises(ValueError, match="V=1"):
+            fleet, model = self._build("ZBH1", pp=2, vpp=2)
+            fleet.distributed_model(model)
+
+    def test_zbvpp_rejected_loudly(self):
+        # zero-bubble interleaved is unimplemented: must fail, not silently
+        # run plain VPP (review finding)
+        with pytest.raises(NotImplementedError, match="ZBVPP"):
+            fleet, model = self._build("ZBVPP", pp=2, vpp=2)
+            fleet.distributed_model(model)
