@@ -79,10 +79,12 @@ def distributed_model(model):
             return PipelineParallel(model, hcg, fleet_state.strategy())
         raise TypeError("pipeline mode needs a PipelineLayer model")
     if mode in ("model", "segment", "sharding", "data"):
-        from ..parallel import DataParallel
-        if hcg.get_data_parallel_world_size() > 1:
-            # batch-axis sharding over dp; mp/sep handled inside layers
-            return _HybridShardedModel(model, hcg)
+        if hcg.get_data_parallel_world_size() > 1 or \
+                hcg.get_sharding_parallel_world_size() > 1:
+            # batch-axis sharding over dp AND the ZeRO sharding group (the
+            # sharding group is data-parallel — that's what makes its grads
+            # partial so stage2 can reduce-scatter them); mp/sep in-layer
+            return _HybridShardedModel(model, hcg, axes=("dp", "sharding"))
         return model
     return model
 

@@ -46,6 +46,13 @@ class PipelineParallel:
         self._mesh = hcg.mesh
         self._accumulate_steps = (strategy.pipeline_configs.get("accumulate_steps", 1)
                                   if strategy else 1)
+        # batch splits over dp AND the ZeRO sharding group (the sharding
+        # group is data-parallel; its grads must be partial for stage2)
+        self._batch_axes = tuple(
+            a for a, deg in (("dp", self._dp),
+                             ("sharding",
+                              hcg.get_sharding_parallel_world_size()))
+            if deg > 1)
         self._remat = layers._recompute_interval > 0
         # schedule_mode (reference: passes/pipeline_scheduler_pass/
         # pipeline_{fthenb,1f1b,eager_1f1b,vpp,zero_bubble}.py). Distinct
@@ -84,6 +91,11 @@ class PipelineParallel:
                 and self._V > 1:
             raise ValueError(
                 f"schedule_mode {mode} runs V=1; use VPP for virtual chunks")
+        if raw_mode is not None and mode == "FTHENB" and self._V > 1:
+            raise ValueError(
+                "explicit schedule_mode FThenB conflicts with "
+                "num_virtual_pipeline_stages > 1 (that model requires the "
+                "interleaved VPP runtime); drop schedule_mode or use VPP")
         self._cache = {}
         self._opt_remapped = False
         self._split_layers()
@@ -290,18 +302,22 @@ class PipelineParallel:
         layers_obj = self._layers
         V, remat = self._V, self._remat
         mode = self._schedule_mode
-        dp = self._dp
+        batch_axes = self._batch_axes
+        n_batch = int(np.prod([mesh.jax_mesh().shape[a]
+                               for a in batch_axes])) if batch_axes else 1
         decay_flags = tuple(bool(optimizer._decay_mask(p)) for p in trainable)
 
         def dp_shard(a, dim):
-            """Pin a batch-like dim to the dp axis so each dp group computes its
-            slice (GSPMD would otherwise keep replicated inputs replicated and
-            every dp replica would redo the full batch)."""
-            if dp <= 1 or a.shape[dim] % dp != 0:
+            """Pin a batch-like dim to the data-like axes (dp + ZeRO sharding
+            group) so each replica group computes its slice (GSPMD would
+            otherwise keep replicated inputs replicated and every replica
+            would redo the full batch; for ZeRO-2 it also makes grads partial
+            over the sharding group so they reduce-scatter into shards)."""
+            if n_batch <= 1 or a.shape[dim] % n_batch != 0:
                 return a
             from jax.sharding import NamedSharding, PartitionSpec
             spec = [None] * a.ndim
-            spec[dim] = "dp"
+            spec[dim] = batch_axes if len(batch_axes) > 1 else batch_axes[0]
             return jax.lax.with_sharding_constraint(
                 a, NamedSharding(mesh.jax_mesh(), PartitionSpec(*spec)))
 
