@@ -156,7 +156,63 @@ def _bench_other(model_name):
                 "vs_baseline": None, "step_time_s": round(dt, 4),
                 "params": n_params, "loss": loss}
 
+    if model_name == "dispatch":
+        return _bench_dispatch()
+
     raise ValueError(f"unknown BENCH_MODEL {model_name!r}")
+
+
+def _bench_dispatch():
+    """Eager op-dispatch microbenchmark (reference: the codegen'd allocation-
+    free eager path, fluid/eager/auto_code_generator/generator/eager_gen.py).
+    Measures forward ops/sec for small add/matmul/layer_norm with the
+    compiled dispatch cache on vs off (grad recording enabled, so the cached
+    path includes building the jitted vjp pair)."""
+    import time
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.core import tensor as T
+
+    paddle.seed(0)
+    x = paddle.randn([128, 128])
+    x.stop_gradient = False
+    y = paddle.randn([128, 128])
+    w = paddle.randn([128])
+    b = paddle.randn([128])
+
+    cases = {
+        "add": lambda: x + y,
+        "matmul": lambda: paddle.matmul(x, y),
+        "layer_norm": lambda: F.layer_norm(x, [128], weight=w, bias=b),
+    }
+
+    def rate(f, n=300):
+        f(); f()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f()
+        jax.block_until_ready(out._value)
+        return n / (time.perf_counter() - t0)
+
+    result = {}
+    saved_max = T._DISPATCH_CACHE_MAX
+    for label, f in cases.items():
+        T._DISPATCH_CACHE_MAX = saved_max
+        fast = rate(f)
+        T._DISPATCH_CACHE.clear()
+        T._DISPATCH_CACHE_MAX = 0   # force the uncached path
+        slow = rate(f, n=60)
+        T._DISPATCH_CACHE_MAX = saved_max
+        result[label] = {"cached_ops_per_sec": round(fast, 1),
+                         "uncached_ops_per_sec": round(slow, 1),
+                         "speedup": round(fast / slow, 2)}
+
+    gmean = float(np.prod([v["speedup"] for v in result.values()])) ** (
+        1.0 / len(result))
+    return {"metric": "eager_dispatch_speedup_geomean",
+            "value": round(gmean, 2), "unit": "x", "vs_baseline": None,
+            "detail": result}
 
 
 def main():

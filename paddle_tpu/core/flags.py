@@ -74,6 +74,15 @@ def get_flags(flags):
     return out
 
 
+#: called (no args) after every set_flags — compiled caches that bake flag
+#: values at trace time register here so a flag flip invalidates them
+_ON_CHANGE_HOOKS: list = []
+
+
+def register_flags_hook(fn):
+    _ON_CHANGE_HOOKS.append(fn)
+
+
 def set_flags(flags: dict):
     """paddle.set_flags — {name: value} (names may carry the FLAGS_ prefix)."""
     for name, value in flags.items():
@@ -81,6 +90,8 @@ def set_flags(flags: dict):
         if key not in _REGISTRY:
             raise ValueError(f"unknown flag {name!r}")
         _REGISTRY[key].set(value)
+    for hook in _ON_CHANGE_HOOKS:
+        hook()
 
 
 def flag_value(name: str):

@@ -17,6 +17,7 @@ single YAML op set feeding both eager and PIR engines.
 """
 from __future__ import annotations
 
+import functools
 import threading
 import weakref
 from typing import Any, Callable, Sequence
@@ -134,6 +135,70 @@ def _is_tensor(x):
     return isinstance(x, Tensor)
 
 
+# -- scalar-concretization interception (to_static graph-break machinery) ----
+# When a traced program hits bool(t)/int(t)/t.item() on a tracer, jax raises a
+# concretization error. to_static installs a scope here instead: in RECORD
+# mode (eager profiling run) every concretized scalar is logged; in FEED mode
+# (specialized re-trace) the logged profile is fed back as static values while
+# the traced scalars are collected as guard outputs. See jit/api.py.
+
+class _ConcretizeState(threading.local):
+    """Per-thread (like _mode): a scope installed by thread A must not see
+    scalars concretized by other threads (data loaders, metric threads)."""
+    scope = None
+
+
+_concretize_state = _ConcretizeState()
+
+
+class ConcretizeScope:
+    __slots__ = ("feed", "i", "recorded", "guards")
+
+    def __init__(self, feed=None):
+        self.feed = feed          # None = record mode; list = feed mode
+        self.i = 0
+        self.recorded = []
+        self.guards = []
+
+    def intercept(self, value):
+        if self.feed is None:     # eager profiling: value is concrete
+            v = value.item() if hasattr(value, "item") else value
+            self.recorded.append(v)
+            return v
+        self.guards.append(value)
+        v = self.feed[self.i]
+        self.i += 1
+        return v
+
+
+class _ConcretizeCtx:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        self._saved = _concretize_state.scope
+        _concretize_state.scope = self.scope
+        return self.scope
+
+    def __exit__(self, *exc):
+        _concretize_state.scope = self._saved
+        return False
+
+
+def concretize_scope(scope):
+    return _ConcretizeCtx(scope)
+
+
+def _intercept_scalar(value):
+    """Route a would-be concretization through the active scope, if any."""
+    scope = _concretize_state.scope
+    if scope is None:
+        return None
+    if scope.feed is None or isinstance(value, jax.core.Tracer):
+        return scope.intercept(value)
+    return None
+
+
 class Tensor:
     """Eager tensor facade over ``jax.Array``.
 
@@ -211,7 +276,8 @@ class Tensor:
         return np.asarray(self._value)
 
     def item(self):
-        return self._value.item()
+        v = _intercept_scalar(self._value)
+        return v if v is not None else self._value.item()
 
     def tolist(self):
         return self.numpy().tolist()
@@ -221,13 +287,20 @@ class Tensor:
         return a.astype(dtype) if dtype is not None else a
 
     def __float__(self):
-        return float(self.item())
+        v = _intercept_scalar(self._value)
+        return float(v) if v is not None else float(self._value.item())
 
     def __int__(self):
-        return int(self.item())
+        v = _intercept_scalar(self._value)
+        return int(v) if v is not None else int(self._value.item())
+
+    def __index__(self):
+        v = _intercept_scalar(self._value)
+        return int(v) if v is not None else self._value.__index__()
 
     def __bool__(self):
-        return bool(self._value)
+        v = _intercept_scalar(self._value)
+        return bool(v) if v is not None else bool(self._value)
 
     def __len__(self):
         if self.ndim == 0:
@@ -351,11 +424,187 @@ def install_amp_hook(fn):
     _amp_cast_fn = fn
 
 
-def dispatch(fn: Callable, args: tuple, kwargs: dict, name: str | None = None):
+# -- compiled eager dispatch -------------------------------------------------
+# The reference spends 4.2k lines of codegen making per-op eager dispatch
+# allocation-free (fluid/eager/auto_code_generator/generator/eager_gen.py:372).
+# Here the analog is a compile cache: for REGISTERED ops (stable fn identity),
+# the forward—and, when recording, the jax.vjp pair—is jitted once per
+# (op, structure, static args, shapes/dtypes, diff-mask) and reused, so an
+# eager op call is one compiled-executable invocation instead of an un-jitted
+# trace + fresh vjp construction. Ad-hoc closures (functional wrappers) keep
+# the direct path; ops observed drawing RNG during trace are blacklisted so
+# their randomness never bakes into a cached executable.
+
+_DISPATCH_CACHE: dict = {}
+_UNCACHEABLE_OPS: set = set()
+_CACHE_BYPASS = object()
+_BWD_JIT = None
+_DISPATCH_CACHE_MAX = 4096
+
+
+class _Unfreezable(Exception):
+    pass
+
+
+def _freeze(v, depth=0):
+    """Hashable, value-stable token for an op callable: its code object plus
+    recursively frozen closure cells/defaults. Only immutable primitives are
+    admitted as cell values — anything stateful (arrays, Tensors, lists,
+    layers) raises, which routes that call to the uncached path."""
+    if depth > 3:
+        raise _Unfreezable
+    if v is None or isinstance(v, (int, float, bool, str, bytes)):
+        return v
+    if isinstance(v, type):
+        return ("T", v)
+    if isinstance(v, np.dtype):
+        return ("D", str(v))
+    if isinstance(v, (tuple, list)):
+        # lists freeze by VALUE — the key reflects call-time contents, so a
+        # mutated list simply maps to a different cache entry
+        return ("t",) + tuple(_freeze(e, depth + 1) for e in v)
+    if isinstance(v, dict):
+        return ("d",) + tuple((k, _freeze(e, depth + 1))
+                              for k, e in sorted(v.items(), key=repr))
+    if callable(v):
+        code = getattr(v, "__code__", None)
+        if code is not None:
+            cells = getattr(v, "__closure__", None) or ()
+            frozen = tuple(_freeze(c.cell_contents, depth + 1) for c in cells)
+            defaults = tuple(_freeze(d, depth + 1)
+                             for d in (getattr(v, "__defaults__", None) or ()))
+            return ("F", code, frozen, defaults)
+        mod = getattr(v, "__module__", None) or \
+            getattr(type(v), "__module__", "")
+        if str(mod).startswith(("jax", "numpy")):
+            # module-level jax/numpy callables (incl. ufunc objects): identity
+            # is stable for the process lifetime
+            return ("G", id(v))
+    raise _Unfreezable
+
+
+def clear_dispatch_cache():
+    _DISPATCH_CACHE.clear()
+
+
+# flag flips invalidate cached executables (op bodies read flags at trace
+# time); clearing beats epoch-keying, which would orphan entries at the cap
+from .flags import register_flags_hook as _register_flags_hook  # noqa: E402
+_register_flags_hook(clear_dispatch_cache)
+
+
+def _bwd_call(vjp_obj, ct):
+    """Apply a cached VJP closure under jit (float0 cotangents go eagerly —
+    they don't cross the jit boundary)."""
+    global _BWD_JIT
+    for leaf in jax.tree_util.tree_leaves(ct):
+        if isinstance(leaf, np.ndarray) and leaf.dtype == jax.dtypes.float0:
+            return vjp_obj(ct)
+    if _BWD_JIT is None:
+        _BWD_JIT = jax.jit(lambda v, c: v(c))
+    return _BWD_JIT(vjp_obj, ct)
+
+
+def _rng_counters():
+    from . import random as _random
+    prov = _random._key_providers
+    return (_random.default_generator._counter,
+            prov[-1].counter if prov else -1)
+
+
+def _dispatch_cached(fn, name, cache_key, leaves, treedef, record):
+    """Compiled-path dispatch. Returns _CACHE_BYPASS when this call can't be
+    cached (unhashable static leaf / RNG draw detected on first trace)."""
+    layout, dyn_vals, statics, diff_idx, diff_tensors = [], [], [], [], []
+    for leaf in leaves:
+        if isinstance(leaf, Tensor):
+            layout.append("D")
+            if record and not leaf.stop_gradient:
+                diff_idx.append(len(dyn_vals))
+                diff_tensors.append(leaf)
+            dyn_vals.append(leaf._value)
+        elif isinstance(leaf, (jax.Array, np.ndarray)):
+            layout.append("D")
+            dyn_vals.append(leaf)
+        else:
+            try:
+                hash(leaf)
+            except TypeError:
+                return _CACHE_BYPASS
+            layout.append("S")
+            statics.append(leaf)
+
+    dyn_vals = _maybe_amp_cast(name, dyn_vals)
+    key = (cache_key, record, treedef, tuple(layout), tuple(statics),
+           tuple(diff_idx),
+           tuple((tuple(getattr(v, "shape", ())), str(getattr(v, "dtype", type(v))))
+                 for v in dyn_vals))
+
+    entry = _DISPATCH_CACHE.get(key)
+    first = entry is None
+    if first and len(_DISPATCH_CACHE) >= _DISPATCH_CACHE_MAX:
+        return _CACHE_BYPASS  # cap bounds INSERTS only; hits stay fast
+    if first:
+        layout_t, statics_t, di = tuple(layout), tuple(statics), tuple(diff_idx)
+
+        def rebuilt(vals_dyn):
+            it, st = iter(vals_dyn), iter(statics_t)
+            vals = [next(it) if tag == "D" else next(st) for tag in layout_t]
+            a, k = jax.tree_util.tree_unflatten(treedef, vals)
+            return fn(*a, **k)
+
+        if record:
+            def fwd(vals_dyn):
+                def closed(*diff_vals):
+                    vv = list(vals_dyn)
+                    for j, v in zip(di, diff_vals):
+                        vv[j] = v
+                    return rebuilt(vv)
+                return jax.vjp(closed, *[vals_dyn[j] for j in di])
+            entry = (jax.jit(fwd), rebuilt)
+        else:
+            entry = (jax.jit(rebuilt), rebuilt)
+        _DISPATCH_CACHE[key] = entry
+
+    jitted, rebuilt = entry
+    if first:
+        rng_before = _rng_counters()
+    result = jitted(dyn_vals)
+    if first and _rng_counters() != rng_before:
+        # the op drew randomness during its trace — a cached executable would
+        # replay the same key forever; evict and take the direct path
+        del _DISPATCH_CACHE[key]
+        _UNCACHEABLE_OPS.add(cache_key)
+        return _CACHE_BYPASS
+
+    if not record:
+        return _wrap_outputs(result, node=None, name=name)
+
+    out, vjp_obj = result
+    base_vals = list(dyn_vals)
+    di = tuple(diff_idx)
+
+    def closed_eager(*diff_vals):
+        vv = list(base_vals)
+        for j, v in zip(di, diff_vals):
+            vv[j] = v
+        return rebuilt(vv)
+
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]
+    node = Node(functools.partial(_bwd_call, vjp_obj), diff_tensors,
+                out_treedef, out_avals, name, fwd_fn=closed_eager)
+    return _wrap_outputs(out, node=node, name=name)
+
+
+def dispatch(fn: Callable, args: tuple, kwargs: dict, name: str | None = None,
+             cache_key: str | None = None):
     """Run one op eagerly, recording a tape node when gradients are required.
 
     ``fn`` must be a pure jax function of the *values* inside any Tensor leaves of
     (args, kwargs). Non-tensor leaves are closed over (static from autograd's view).
+    ``cache_key`` (set by the op registry) opts the call into the compiled
+    dispatch cache — only valid when ``fn`` is a stable pure function.
     """
     name = name or getattr(fn, "__name__", "op")
     leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
@@ -365,6 +614,17 @@ def dispatch(fn: Callable, args: tuple, kwargs: dict, name: str | None = None):
         is_grad_enabled()
         and any(not leaves[i].stop_gradient for i in tensor_pos)
     )
+
+    if cache_key is None and not _OP_OBSERVERS and _mode.functional == 0:
+        try:
+            cache_key = (name, _freeze(fn))
+        except (_Unfreezable, ValueError):  # ValueError: empty closure cell
+            cache_key = None
+    if cache_key is not None and cache_key not in _UNCACHEABLE_OPS \
+            and not _OP_OBSERVERS and _mode.functional == 0:
+        out = _dispatch_cached(fn, name, cache_key, leaves, treedef, record)
+        if out is not _CACHE_BYPASS:
+            return out
 
     if not record:
         vals = _maybe_amp_cast(name, [_unwrap(x) for x in leaves])
@@ -425,7 +685,8 @@ class OpDef:
         self.__wrapped__ = fn
 
     def __call__(self, *args, **kwargs):
-        return dispatch(self.fn, args, kwargs, name=self.name)
+        return dispatch(self.fn, args, kwargs, name=self.name,
+                        cache_key=self.name)
 
     def __repr__(self):
         return f"<op {self.name}>"

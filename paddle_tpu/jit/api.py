@@ -81,6 +81,29 @@ def _split_leaves(tree):
 _EAGER_FALLBACK = object()  # cache sentinel: this signature runs eagerly
 
 
+class _Specializer:
+    """Per-signature state after a data-dependent graph break (reference:
+    jit/sot opcode_executor.py:353 — SOT keeps the compiled prefix and guards
+    on the concretized values; torch.compile splits frames the same way).
+
+    TPU-native version: *speculative specialization with post-validation*.
+    On a break, the call runs eagerly once while every concretized scalar
+    (bool(t)/int(t)/t.item()) is recorded — that's the branch profile. A
+    program specialized to the profile is then compiled, with the concretized
+    scalars as extra outputs (the guards). Later calls run the compiled
+    program and compare the guard outputs to the profile: match -> compiled
+    result stands (the hot branch never leaves XLA); mismatch -> results are
+    discarded, the call re-runs eagerly, and the new profile gets its own
+    compiled program. Safe because traced programs are pure: buffer updates
+    are applied only after validation.
+    """
+
+    def __init__(self):
+        self.programs = {}     # profile tuple -> jitted specialized program
+        self.last_profile = None
+        self.failed = False    # a specialized trace also broke -> plain eager
+
+
 class StaticFunction:
     """Traced+compiled callable with a guard cache keyed on static structure."""
 
@@ -100,58 +123,142 @@ class StaticFunction:
             return self
         return functools.partial(self.__call__, instance)
 
+    def _make_body(self, static_key, layout, treedef, params, buffers):
+        fn = self._fn
+        state_tensors = params + buffers
+
+        def compiled(state_vals, dyn_vals, rng_key):
+            # rebuild args with traced leaves
+            it = iter(dyn_vals)
+            statics = iter(static_key)
+            leaves = []
+            for tag in layout:
+                if tag == "S":
+                    leaves.append(next(statics))
+                elif tag == "T":
+                    leaves.append(Tensor(next(it)))
+                else:
+                    leaves.append(next(it))
+            a, k = jax.tree_util.tree_unflatten(treedef, leaves)
+            with functional_mode(), bind_state(state_tensors, state_vals), \
+                    _random.provide_key(rng_key):
+                out = fn(*a, **k)
+                new_buf_vals = [b._value for b in buffers]
+            out_vals = jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+            return out_vals, new_buf_vals
+
+        return compiled
+
+    #: max distinct branch profiles compiled per signature before giving up
+    #: (the torch.compile recompile_limit analog)
+    _MAX_PROFILES = 8
+
+    @staticmethod
+    def _profiles_match(observed, profile):
+        # EXACT equality, floats included: a spurious mismatch merely costs an
+        # eager re-profile, but any tolerance can validate a guard that sits
+        # across a python comparison threshold and commit the wrong branch
+        return len(observed) == len(profile) and \
+            all(o == p for o, p in zip(observed, profile))
+
+    def _call_specialized(self, spec, body, args, kwargs, state_vals, dyn,
+                          buffers):
+        from ..core.tensor import ConcretizeScope, concretize_scope
+        # try the last profile's program; on guard divergence, the observed
+        # guards name the true profile — if it's already compiled, run it and
+        # validate ITS guards (alternating-branch workloads stay compiled)
+        candidate = spec.last_profile
+        tried = set()
+        while not spec.failed and candidate is not None \
+                and candidate not in tried:
+            tried.add(candidate)
+            prog = spec.programs.get(candidate)
+            if prog is None:
+                break
+            try:
+                out_vals, new_buf_vals, guards = prog(
+                    state_vals, dyn, _random.next_key())
+                observed = tuple(np.asarray(g).item() for g in guards)
+            except (jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerIntegerConversionError,
+                    jax.errors.TracerArrayConversionError,
+                    jax.errors.NonConcreteBooleanIndexError,
+                    IndexError):
+                # the specialized trace itself broke (.numpy() on a tracer,
+                # profile under-recorded, ...) — plain eager from now on
+                spec.failed = True
+                return self._fn(*args, **kwargs)
+            if self._profiles_match(observed, candidate):
+                spec.last_profile = candidate
+                for b, nv in zip(buffers, new_buf_vals):
+                    b._value = nv
+                return jax.tree_util.tree_map(
+                    lambda v: Tensor(v) if isinstance(v, jax.Array)
+                    else v, out_vals)
+            # speculative results discarded (pure program — nothing was
+            # committed); the observed prefix points at the true profile
+            candidate = observed if observed in spec.programs else None
+        if spec.failed:
+            return self._fn(*args, **kwargs)
+
+        # eager profiling run: record every concretized scalar
+        scope = ConcretizeScope()
+        with concretize_scope(scope):
+            result = self._fn(*args, **kwargs)
+        profile = tuple(scope.recorded)
+        spec.last_profile = profile
+        if profile not in spec.programs:
+            if len(spec.programs) >= self._MAX_PROFILES:
+                import warnings
+                warnings.warn(
+                    f"to_static: {getattr(self._fn, '__name__', '?')} exceeded "
+                    f"{self._MAX_PROFILES} branch profiles (data-dependent "
+                    f"value with many distinct outcomes); running eagerly",
+                    RuntimeWarning, stacklevel=2)
+                spec.failed = True
+                return result
+            profile_list = list(profile)
+
+            def specialized(state_vals, dyn_vals, rng_key):
+                sc = ConcretizeScope(feed=profile_list)
+                with concretize_scope(sc):
+                    out_vals, new_bufs = body(state_vals, dyn_vals, rng_key)
+                return out_vals, new_bufs, tuple(sc.guards)
+
+            spec.programs[profile] = jax.jit(specialized)
+        return result
+
     def __call__(self, *args, **kwargs):
         layers = _find_layers(self._fn, args)
         pnames, params, bnames, buffers = collect_state(layers)
         dyn, static_key, layout, treedef = _split_leaves((args, kwargs))
         key = (static_key, layout, treedef, tuple(id(p) for p in params))
 
-        if key not in self._cache:
-            fn = self._fn
-            state_tensors = params + buffers
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._cache[key] = jax.jit(
+                self._make_body(static_key, layout, treedef, params, buffers))
 
-            def compiled(state_vals, dyn_vals, rng_key):
-                # rebuild args with traced leaves
-                it = iter(dyn_vals)
-                statics = iter(static_key)
-                leaves = []
-                for tag in layout:
-                    if tag == "S":
-                        leaves.append(next(statics))
-                    elif tag == "T":
-                        leaves.append(Tensor(next(it)))
-                    else:
-                        leaves.append(next(it))
-                a, k = jax.tree_util.tree_unflatten(treedef, leaves)
-                with functional_mode(), bind_state(state_tensors, state_vals), \
-                        _random.provide_key(rng_key):
-                    out = fn(*a, **k)
-                    new_buf_vals = [b._value for b in buffers]
-                out_vals = jax.tree_util.tree_map(
-                    lambda t: t._value if isinstance(t, Tensor) else t, out,
-                    is_leaf=lambda x: isinstance(x, Tensor))
-                return out_vals, new_buf_vals
-
-            self._cache[key] = jax.jit(compiled)
-
-        if self._cache[key] is _EAGER_FALLBACK:
+        if entry is _EAGER_FALLBACK:
             return self._fn(*args, **kwargs)
 
         state_vals = read_values(params) + read_values(buffers)
+        if isinstance(entry, _Specializer):
+            body = self._make_body(static_key, layout, treedef, params,
+                                   buffers)
+            return self._call_specialized(entry, body, args, kwargs,
+                                          state_vals, dyn, buffers)
+
         rng_key = _random.next_key()
         try:
-            out_vals, new_buf_vals = self._cache[key](state_vals, dyn, rng_key)
-        except (jax.errors.ConcretizationTypeError,
-                jax.errors.TracerIntegerConversionError,
-                jax.errors.TracerArrayConversionError,
+            out_vals, new_buf_vals = entry(state_vals, dyn, rng_key)
+        except (jax.errors.TracerArrayConversionError,
                 jax.errors.NonConcreteBooleanIndexError) as e:
-            # NOTE: in this jax version only TracerBoolConversionError is a
-            # ConcretizationTypeError subclass — the others must be listed.
-            # Graph break: data-dependent python control flow cannot trace —
-            # run this call signature eagerly from now on (the SOT-fallback
-            # analog; reference: jit/sot graph breaks -> eager frames).
-            # Caveat: python side effects before the break ran once during
-            # the failed trace and run again eagerly.
+            # whole-array concretization (.numpy() on a tracer, boolean mask
+            # indexing): no scalar profile can fix this — eager forever (the
+            # SOT-fallback analog)
             import warnings
             warnings.warn(
                 f"to_static: graph break in {getattr(self._fn, '__name__', '?')} "
@@ -159,6 +266,25 @@ class StaticFunction:
                 RuntimeWarning, stacklevel=2)
             self._cache[key] = _EAGER_FALLBACK
             return self._fn(*args, **kwargs)
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerIntegerConversionError) as e:
+            # NOTE: in this jax version only TracerBoolConversionError is a
+            # ConcretizationTypeError subclass — integer conversion must be
+            # listed separately.
+            # Data-dependent SCALAR control flow: specialize per branch
+            # profile instead of abandoning compilation (reference: jit/sot
+            # guards on the concretized value, opcode_executor.py:353).
+            import warnings
+            warnings.warn(
+                f"to_static: data-dependent control flow in "
+                f"{getattr(self._fn, '__name__', '?')} ({type(e).__name__}); "
+                f"specializing per branch profile with guard validation",
+                RuntimeWarning, stacklevel=2)
+            spec = self._cache[key] = _Specializer()
+            body = self._make_body(static_key, layout, treedef, params,
+                                   buffers)
+            return self._call_specialized(spec, body, args, kwargs,
+                                          state_vals, dyn, buffers)
         for b, nv in zip(buffers, new_buf_vals):
             b._value = nv
         return jax.tree_util.tree_map(

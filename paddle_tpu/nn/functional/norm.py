@@ -17,6 +17,9 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     if isinstance(normalized_shape, int):
         normalized_shape = [normalized_shape]
     ndims = len(tuple(normalized_shape))
+    # close over booleans, not the weight/bias Tensors themselves: a Tensor in
+    # the closure blocks the compiled dispatch cache (core/tensor.py _freeze)
+    has_w, has_b = weight is not None, bias is not None
 
     def fn(v, *wb):
         axes = tuple(range(v.ndim - ndims, v.ndim))
@@ -27,10 +30,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
         out = (compute - mean) * jax.lax.rsqrt(var + epsilon)
         out = out.astype(v.dtype)
         i = 0
-        if weight is not None:
+        if has_w:
             out = out * wb[i]
             i += 1
-        if bias is not None:
+        if has_b:
             out = out + wb[i]
         return out
     args = (x,) + tuple(t for t in (weight, bias) if t is not None)

@@ -240,6 +240,138 @@ def test_to_static_graph_break_falls_back_to_eager():
     np.testing.assert_allclose(f(a).numpy(), 2 * np.ones(4))
 
 
+def test_to_static_scalar_break_specializes_per_branch():
+    """Data-dependent SCALAR control flow keeps the hot branch compiled:
+    speculative specialization with guard validation (reference: jit/sot
+    guards on concretized values, opcode_executor.py:353). Only the first
+    call of a new branch profile runs eagerly."""
+    import warnings
+    from paddle_tpu.jit import to_static
+
+    calls = []
+
+    @to_static
+    def f(x):
+        calls.append(1)          # python body runs only on eager/trace
+        if x.sum() > 0:          # bool(tracer) -> scalar graph break
+            return x * 2
+        return x - 1
+
+    pos = paddle.to_tensor(np.ones(4, "float32"))
+    neg = paddle.to_tensor(-np.ones(4, "float32"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        np.testing.assert_allclose(f(pos).numpy(), 2 * np.ones(4))
+        np.testing.assert_allclose(f(pos).numpy(), 2 * np.ones(4))
+        n_baseline = len(calls)
+        for _ in range(4):       # hot branch: compiled, no python re-runs
+            np.testing.assert_allclose(f(pos).numpy(), 2 * np.ones(4))
+        assert len(calls) == n_baseline, "hot branch left the compiled path"
+        # cold branch: one eager profile + one trace, then compiled
+        np.testing.assert_allclose(f(neg).numpy(), -2 * np.ones(4))
+        np.testing.assert_allclose(f(neg).numpy(), -2 * np.ones(4))
+        n2 = len(calls)
+        for _ in range(4):
+            np.testing.assert_allclose(f(neg).numpy(), -2 * np.ones(4))
+        assert len(calls) == n2, "cold branch never reached the compiled path"
+
+
+def test_to_static_alternating_branches_stay_compiled():
+    """Both branch profiles compiled: alternating inputs must not fall back
+    to eager every call (the observed guards name the true profile, whose
+    program is then run and self-validated)."""
+    import warnings
+    from paddle_tpu.jit import to_static
+
+    calls = []
+
+    @to_static
+    def f(x):
+        calls.append(1)
+        if x.sum() > 0:
+            return x * 2
+        return x - 1
+
+    pos = paddle.to_tensor(np.ones(4, "float32"))
+    neg = paddle.to_tensor(-np.ones(4, "float32"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for t in (pos, pos, neg, neg):   # profile + trace both branches
+            f(t)
+        n = len(calls)
+        for _ in range(4):               # alternate: must stay compiled
+            np.testing.assert_allclose(f(pos).numpy(), 2 * np.ones(4))
+            np.testing.assert_allclose(f(neg).numpy(), -2 * np.ones(4))
+        assert len(calls) == n, "alternating branches re-ran python eagerly"
+
+
+def test_to_static_float_guard_exact_no_wrong_branch():
+    """Float guards validate EXACTLY: a value crossing a python comparison
+    threshold within any tolerance must re-profile, never commit the wrong
+    branch (review finding)."""
+    import warnings
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        if float(x.sum()) > 0.5:
+            return x * 100
+        return x * -100
+
+    a = paddle.to_tensor(np.full(1, 0.50000006, "float32"))
+    b = paddle.to_tensor(np.full(1, 0.49999997, "float32"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        assert f(a).numpy()[0] > 0
+        assert f(a).numpy()[0] > 0      # compiled >0.5 branch
+        assert f(b).numpy()[0] < 0      # near-threshold: must take <=0.5
+
+
+def test_to_static_recompile_limit_falls_back_to_eager():
+    import warnings
+    from paddle_tpu.jit import to_static, StaticFunction
+
+    @to_static
+    def g(x, n):
+        acc = x
+        for _ in range(int(n.sum())):
+            acc = acc + x
+        return acc
+
+    x = paddle.to_tensor(np.ones(2, "float32"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for k in range(StaticFunction._MAX_PROFILES + 3):
+            nk = paddle.to_tensor(np.array([k + 1], "int32"))
+            np.testing.assert_allclose(g(x, nk).numpy(),
+                                       (k + 2) * np.ones(2))
+        spec = next(iter(g._cache.values()))
+        assert spec.failed  # capped: plain eager, not endless recompiles
+
+
+def test_to_static_int_specialization_guards_loop_bound():
+    import warnings
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def g(x, n):
+        acc = x
+        for _ in range(int(n.sum())):   # int(tracer) -> scalar break
+            acc = acc + x
+        return acc
+
+    x = paddle.to_tensor(np.ones(3, "float32"))
+    n2 = paddle.to_tensor(np.array([2], "int32"))
+    n3 = paddle.to_tensor(np.array([3], "int32"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        np.testing.assert_allclose(g(x, n2).numpy(), 3 * np.ones(3))
+        np.testing.assert_allclose(g(x, n2).numpy(), 3 * np.ones(3))
+        # different loop bound: guard mismatch -> correct re-specialization
+        np.testing.assert_allclose(g(x, n3).numpy(), 4 * np.ones(3))
+        np.testing.assert_allclose(g(x, n3).numpy(), 4 * np.ones(3))
+
+
 def test_to_static_traceable_compiles_once():
     from paddle_tpu.jit import to_static
     traces = {"n": 0}

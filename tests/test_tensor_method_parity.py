@@ -119,11 +119,13 @@ def test_pipeline_schedule_modes():
             st.pipeline_configs["schedule_mode"] = mode
         return PipelineParallel(layers, hcg, st)
 
-    assert make()._schedule_mode == "1F1B"  # default, remat untouched
+    # default = FThenB semantics (whole-scan autodiff, model remat config
+    # untouched); explicit 1F1B/ZBH1 select the scheduled_pipeline runtimes
+    assert make()._schedule_mode == "FTHENB"
     pp_f = make("FThenB")
     assert pp_f._schedule_mode == "FTHENB" and pp_f._remat is False
-    pp_1 = make("1F1B")
-    assert pp_1._remat is True
+    assert make("1F1B")._schedule_mode == "1F1B"
+    assert make("ZBH1")._schedule_mode == "ZBH1"
     with pytest.raises(ValueError, match="schedule_mode"):
         make("bogus")
     with pytest.raises(ValueError, match="VPP"):
