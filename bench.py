@@ -116,7 +116,7 @@ def _bench_other(model_name):
         step = TrainStep(model, lambda m, x, y: F.cross_entropy(m(x), y),
                          optimizer)
         x = paddle.to_tensor(rng.standard_normal(
-            (B, 3, 224, 224)).astype(np.float32))
+            (B, 3, 224, 224)).astype(np.float32)).astype("bfloat16")
         y = paddle.to_tensor(rng.integers(0, 1000, B))
         dt, loss = _time_train_step(step, (x, y), steps)
         tokens_per_img = (224 // 16) ** 2 + 1
@@ -143,13 +143,14 @@ def _bench_other(model_name):
             return diffusion_loss(m, lat, t, ctx, noise, alphas)
 
         step = TrainStep(model, loss_fn, optimizer)
+        # NHWC: the TPU-native UNet is channels-last throughout (models/unet.py)
         lat = paddle.to_tensor(rng.standard_normal(
-            (B, 4, 64, 64)).astype(np.float32))
+            (B, 64, 64, 4)).astype(np.float32)).astype("bfloat16")
         t = paddle.to_tensor(rng.integers(0, 1000, B))
         ctx = paddle.to_tensor(rng.standard_normal(
-            (B, 77, 768)).astype(np.float32))
+            (B, 77, 768)).astype(np.float32)).astype("bfloat16")
         noise = paddle.to_tensor(rng.standard_normal(
-            (B, 4, 64, 64)).astype(np.float32))
+            (B, 64, 64, 4)).astype(np.float32)).astype("bfloat16")
         dt, loss = _time_train_step(step, (lat, t, ctx, noise), steps)
         return {"metric": "sd_unet_1chip_train_samples_per_sec",
                 "value": round(B / dt, 2), "unit": "samples/s",

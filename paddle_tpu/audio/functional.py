@@ -70,7 +70,36 @@ def get_window(window, win_length, fftbins=True, dtype="float32"):
             w = np.exp(-k / tau)
             w = w[:-1] if fftbins else w
         elif name == "taylor":
-            raise NotImplementedError("taylor window")
+            # scipy.signal.windows.taylor (reference routes here): nbar
+            # near-in sidelobes at -sll dB, normalized to unity center
+            nbar = int(params[0]) if params else 4
+            sll = float(params[1]) if len(params) > 1 else 30.0
+            norm = bool(params[2]) if len(params) > 2 else True
+            m = win_length + 1 if fftbins else win_length
+            bb = 10.0 ** (sll / 20.0)
+            a = np.arccosh(bb) / np.pi
+            s2 = nbar ** 2 / (a ** 2 + (nbar - 0.5) ** 2)
+            ma = np.arange(1, nbar, dtype=np.float64)
+            fm = np.zeros(nbar - 1)
+            signs = (-1.0) ** (ma + 1)
+            m2 = ma ** 2
+            for mi in range(len(ma)):
+                numer = signs[mi] * np.prod(
+                    1 - m2[mi] / s2 / (a ** 2 + (ma - 0.5) ** 2))
+                denom = 2 * np.prod(
+                    [1 - m2[mi] / m2[j] for j in range(len(ma)) if j != mi])
+                fm[mi] = numer / denom
+
+            def w_at(ns):
+                return 1 + 2 * np.sum(
+                    fm[:, None] * np.cos(
+                        2 * np.pi * ma[:, None] * (ns - m / 2.0 + 0.5) / m),
+                    axis=0)
+
+            w = w_at(np.arange(m, dtype=np.float64))
+            if norm:
+                w /= w_at(np.array([(m - 1) / 2.0]))[0]
+            w = w[:-1] if fftbins else w
         else:
             raise ValueError(f"unsupported window {window!r}")
     else:

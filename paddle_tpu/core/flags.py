@@ -85,13 +85,18 @@ def register_flags_hook(fn):
 
 def set_flags(flags: dict):
     """paddle.set_flags — {name: value} (names may carry the FLAGS_ prefix)."""
-    for name, value in flags.items():
+    resolved = []
+    for name, value in flags.items():  # validate ALL names before setting any
         key = name[6:] if name.startswith("FLAGS_") else name
         if key not in _REGISTRY:
             raise ValueError(f"unknown flag {name!r}")
-        _REGISTRY[key].set(value)
-    for hook in _ON_CHANGE_HOOKS:
-        hook()
+        resolved.append((key, value))
+    try:
+        for key, value in resolved:
+            _REGISTRY[key].set(value)
+    finally:
+        for hook in _ON_CHANGE_HOOKS:
+            hook()
 
 
 def flag_value(name: str):

@@ -160,15 +160,22 @@ class ConcretizeScope:
         self.recorded = []
         self.guards = []
 
-    def intercept(self, value):
+    def intercept(self, value, concrete=False):
         if self.feed is None:     # eager profiling: value is concrete
             v = value.item() if hasattr(value, "item") else value
             self.recorded.append(v)
             return v
+        self.i += 1               # consume the slot either way: feed order
+        if concrete:              # must mirror record order exactly
+            # a concrete (non-traced) scalar inside the specialized trace:
+            # its real value is authoritative and becomes a baked guard
+            # constant — if it ever differs from the profile, validation
+            # falls back to eager
+            v = value.item() if hasattr(value, "item") else value
+            self.guards.append(v)
+            return v
         self.guards.append(value)
-        v = self.feed[self.i]
-        self.i += 1
-        return v
+        return self.feed[self.i - 1]
 
 
 class _ConcretizeCtx:
@@ -194,9 +201,13 @@ def _intercept_scalar(value):
     scope = _concretize_state.scope
     if scope is None:
         return None
-    if scope.feed is None or isinstance(value, jax.core.Tracer):
+    if scope.feed is None:
         return scope.intercept(value)
-    return None
+    if isinstance(value, jax.core.Tracer):
+        return scope.intercept(value)
+    # feed mode, concrete value (e.g. a closed-over eager tensor): record
+    # mode logged it, so feed alignment must consume its slot too
+    return scope.intercept(value, concrete=True)
 
 
 class Tensor:
@@ -453,8 +464,12 @@ def _freeze(v, depth=0):
     layers) raises, which routes that call to the uncached path."""
     if depth > 3:
         raise _Unfreezable
-    if v is None or isinstance(v, (int, float, bool, str, bytes)):
+    if v is None:
         return v
+    if isinstance(v, (int, float, bool, str, bytes)):
+        # type-tag scalars: 1, 1.0 and True hash/compare equal but trace to
+        # different programs
+        return (type(v), v)
     if isinstance(v, type):
         return ("T", v)
     if isinstance(v, np.dtype):
@@ -535,7 +550,8 @@ def _dispatch_cached(fn, name, cache_key, leaves, treedef, record):
             statics.append(leaf)
 
     dyn_vals = _maybe_amp_cast(name, dyn_vals)
-    key = (cache_key, record, treedef, tuple(layout), tuple(statics),
+    key = (cache_key, record, treedef, tuple(layout),
+           tuple((type(s), s) for s in statics),  # 1 != 1.0 != True as keys
            tuple(diff_idx),
            tuple((tuple(getattr(v, "shape", ())), str(getattr(v, "dtype", type(v))))
                  for v in dyn_vals))

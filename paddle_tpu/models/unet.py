@@ -263,6 +263,10 @@ class UNetModel(Layer):
     def forward(self, x, timesteps, context):
         """x: (B,H,W,Cin) latents; timesteps: (B,); context: (B,L,context_dim)."""
         temb = timestep_embedding(timesteps, self.config.base_channels)
+        # the sinusoidal embedding is fp32 by construction; match the model
+        # dtype so a bf16 UNet doesn't silently promote the whole residual
+        # stream (and every conv input) to fp32
+        temb = temb.astype(self.time_mlp1.weight.dtype)
         temb = self.time_mlp2(self.act(self.time_mlp1(temb)))
 
         h = self.conv_in(x)
@@ -318,8 +322,10 @@ def diffusion_loss(model, latents, timesteps, context, noise, alphas_cumprod):
     """ε-prediction MSE: noise the latents with the closed-form q(x_t|x_0) and
     regress the added noise (DDPM objective used for SD training)."""
     a = ops.gather(alphas_cumprod, timesteps)
+    # noise schedule stays fp32; the noised latents re-enter the model in its
+    # own dtype (a bf16 UNet must not see an fp32-promoted input)
     sqrt_a = ops.sqrt(a).reshape([-1, 1, 1, 1])
     sqrt_1ma = ops.sqrt(1.0 - a).reshape([-1, 1, 1, 1])
-    noisy = latents * sqrt_a + noise * sqrt_1ma
+    noisy = (latents * sqrt_a + noise * sqrt_1ma).astype(latents.dtype)
     pred = model(noisy, timesteps, context)
-    return ((pred - noise) ** 2).mean()
+    return ((pred.astype("float32") - noise.astype("float32")) ** 2).mean()

@@ -51,13 +51,16 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             # hot path (LLM loss): hard labels over the last dim with no
             # weights/smoothing — the memory-lean custom-vjp CE
             # (ops/kernels/fused_ce.py) avoids materializing any fp32
-            # logits/softmax copy for backward; loss cast back to the logits
-            # dtype to match the generic branch, then falls through to the
-            # shared masking/reduction tail below
+            # logits/softmax copy for backward. The per-token loss STAYS fp32
+            # through the (tokens,)-sized masking/mean tail — it's free and
+            # keeps the loss scalar + the mean's 1/count backward scale from
+            # rounding through bf16; only a reduction='none' return is cast
+            # back to the logits dtype for parity with the generic branch.
             from ...ops.kernels.fused_ce import fused_softmax_ce
             flat = fused_softmax_ce(logits.reshape(-1, n_classes),
                                     lbl_int.reshape(-1), ignore_index)
-            loss = flat.reshape(lbl_int.shape).astype(logits.dtype)
+            loss = flat.reshape(lbl_int.shape)
+            none_cast = logits.dtype
         else:
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax) \
                 if use_softmax \
@@ -73,7 +76,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                 loss = -jnp.take_along_axis(logp, jnp.expand_dims(
                     jnp.clip(lbl_int, 0, n_classes - 1), ax),
                     axis=ax).squeeze(ax)
-            loss = loss.astype(logits.dtype)
+            # generic branch keeps the same fp32-tail contract as the fused
+            # path: the (tokens,)-sized tail is free in fp32 and reductions
+            # must not change dtype depending on which branch ran
+            none_cast = logits.dtype
         valid = (lbl_int != ignore_index)
         loss = jnp.where(valid, loss, 0.0)
         if w:
@@ -84,7 +90,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                 return jnp.sum(loss) / jnp.maximum(jnp.sum(cw), 1e-12)
         if reduction == "mean":
             return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
-        return _reduce(loss, reduction)
+        out = _reduce(loss, reduction)
+        if none_cast is not None and reduction == "none":
+            out = out.astype(none_cast)
+        return out
     args = (input, label) + ((weight,) if weight is not None else ())
     return dispatch(fn, args, {}, name="cross_entropy")
 
@@ -450,6 +459,14 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
                          reduction="mean"):
     """ArcFace-family margin softmax (reference: loss.py:2223 →
     margin_cross_entropy kernel): target logit cos(m1·θ + m2) - m3, scaled."""
+    if group is not None and group is not False:
+        # the reference's group arg enables model-parallel margin softmax over
+        # class-sharded logits; silently computing a local-shard-only result
+        # would be wrong — shard the classes with fleet ParallelCrossEntropy
+        # style TP instead
+        raise NotImplementedError(
+            "margin_cross_entropy(group=...) model-parallel margin softmax "
+            "is not implemented; pass replicated logits (group=None)")
 
     def fn(lg, lbl):
         lbl_flat = lbl.reshape(-1)
@@ -526,8 +543,20 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     """RNN-Transducer loss (reference: loss.py rnnt_loss → warprnnt). Forward
     log-alpha DP over the (T, U) lattice with lax.scan; gradients come from
     autodiff through the DP (the analytic beta recursion the CUDA lib uses is
-    exactly the adjoint of this scan). fastemit_lambda only reweights warprnnt
-    gradients, not the loss value."""
+    exactly the adjoint of this scan).
+
+    fastemit_lambda: the reference's warprnnt applies FastEmit GRADIENT
+    reweighting (scale the label-emission adjoint by 1+lambda) without
+    changing the loss value; autodiff of this DP yields the unregularized
+    gradients, so a nonzero lambda is refused rather than silently ignored.
+    """
+    if fastemit_lambda:
+        import warnings
+        warnings.warn(
+            "rnnt_loss: fastemit_lambda != 0 requested but FastEmit gradient "
+            "reweighting is not implemented — training proceeds with the "
+            "UNREGULARIZED rnnt gradient (loss values are identical)",
+            RuntimeWarning, stacklevel=2)
 
     def fn(logits, lbl, in_len, lbl_len):
         if logits.ndim == 3:

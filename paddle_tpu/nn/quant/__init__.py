@@ -28,20 +28,45 @@ __all__ = [
 
 
 def weight_quantize(weight, algo="weight_only_int8", group_size=-1):
-    """Per-out-channel int8 weight quantization.
+    """Per-out-channel weight quantization.
 
-    Returns (quantized int8 Tensor [in, out], scales float Tensor [out]).
+    int8: returns (int8 Tensor [in, out], scales float Tensor [out]).
+    int4: two values pack into each int8 byte along the input dim — returns
+    (int8 Tensor [ceil(in/2), out] with row 2k in the low nibble and row 2k+1
+    in the high nibble, scales [out]); odd input dims are zero-padded.
     Reference: nn/quant/quantized_linear.py weight_quantize."""
-    if algo not in ("weight_only_int8", "llm.int8"):
-        raise NotImplementedError(f"algo {algo!r} (int4 needs packed storage)")
+    if algo not in ("weight_only_int8", "llm.int8", "weight_only_int4"):
+        raise NotImplementedError(f"unknown weight_quantize algo {algo!r}")
     w = weight.numpy() if isinstance(weight, Tensor) else np.asarray(weight)
+    if algo == "weight_only_int4":
+        scales = np.maximum(np.abs(w).max(axis=0), 1e-9).astype(np.float32) / 7.0
+        q = np.clip(np.round(w / scales[None, :]), -8, 7).astype(np.int8)
+        if q.shape[0] % 2:
+            q = np.concatenate([q, np.zeros((1, q.shape[1]), np.int8)])
+        packed = ((q[0::2] & 0x0F) | ((q[1::2] & 0x0F) << 4)).astype(np.int8)
+        return Tensor(packed), Tensor(scales)
     scales = np.maximum(np.abs(w).max(axis=0), 1e-9).astype(np.float32) / 127.0
     q = np.clip(np.round(w / scales[None, :]), -127, 127).astype(np.int8)
     return Tensor(q), Tensor(scales)
 
 
-def weight_dequantize(quant_weight, scale, algo="weight_only_int8"):
+def _unpack_int4(p, n_in=None):
+    """[rows, out] packed int8 -> [2*rows, out] int4 values (sign-extended
+    via arithmetic shifts), truncated to n_in rows."""
+    low = jnp.right_shift(jnp.left_shift(p, 4), 4)
+    high = jnp.right_shift(p, 4)
+    q = jnp.stack([low, high], axis=1).reshape(-1, p.shape[-1])
+    return q if n_in is None else q[:n_in]
+
+
+def weight_dequantize(quant_weight, scale, algo="weight_only_int8",
+                      in_features=None):
+    """Inverse of weight_quantize. For int4, pass ``in_features`` to strip
+    the zero-pad row of odd input dims (otherwise the padded [2*rows, out]
+    shape is returned)."""
     def fn(q, s):
+        if algo == "weight_only_int4":
+            q = _unpack_int4(q, in_features)
         return q.astype(s.dtype) * s[None, :]
 
     return dispatch(fn, (quant_weight, scale), {}, name="weight_dequantize")
@@ -49,9 +74,12 @@ def weight_dequantize(quant_weight, scale, algo="weight_only_int8"):
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", group_size=-1):
-    """y = x @ dequant(w_int8) + b; the dequant fuses into the matmul operand.
+    """y = x @ dequant(w) + b; the dequant fuses into the matmul operand.
+    weight_dtype='int4' consumes the packed layout from weight_quantize.
     Reference: nn/quant/quantized_linear.py weight_only_linear."""
     def fn(xv, q, s, b):
+        if weight_dtype == "int4":
+            q = _unpack_int4(q, xv.shape[-1])
         w = q.astype(xv.dtype) * s.astype(xv.dtype)[None, :]
         y = jnp.matmul(xv, w)
         if b is not None:

@@ -29,10 +29,11 @@ DEFAULT_BLOCK_K = int(os.environ.get("PT_FLASH_BLOCK_K", "512"))
 # q-blocks innermost for dk/dv). Measured in-process, n=100 reps (B=3 S=2048
 # H=32 D=128, v5e): fwd(256,512)+bwd(512,512) = 5.24 ms vs 6.02 ms with
 # shared (256,512) — ~69 TF/s combined.
-DEFAULT_BLOCK_Q_BWD = int(os.environ.get(
-    "PT_FLASH_BLOCK_Q_BWD", os.environ.get("PT_FLASH_BLOCK_Q", "512")))
-DEFAULT_BLOCK_K_BWD = int(os.environ.get(
-    "PT_FLASH_BLOCK_K_BWD", os.environ.get("PT_FLASH_BLOCK_K", "512")))
+# bwd defaults are independent of the fwd env overrides: tuning the fwd
+# q-block (e.g. down to 128 for VMEM) must not silently drop the measured
+# 512 bwd default — set PT_FLASH_BLOCK_*_BWD explicitly to change these
+DEFAULT_BLOCK_Q_BWD = int(os.environ.get("PT_FLASH_BLOCK_Q_BWD", "512"))
+DEFAULT_BLOCK_K_BWD = int(os.environ.get("PT_FLASH_BLOCK_K_BWD", "512"))
 NEG_INF = np.float32(-1e30)
 # Index-map literals MUST be i32: python ints become i64 constants under the
 # framework's jax_enable_x64 and Mosaic then fails to legalize the index-map

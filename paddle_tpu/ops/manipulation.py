@@ -473,15 +473,29 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False, axi
 def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
     v = np.asarray(x._value if isinstance(x, Tensor) else x)
     if axis is not None:
-        raise NotImplementedError("unique_consecutive with axis")
-    flat = v.reshape(-1)
-    keep = np.concatenate([[True], flat[1:] != flat[:-1]])
-    out = [Tensor(jnp.asarray(flat[keep]))]
+        # slice-wise dedup along `axis` (reference: unique_consecutive_op —
+        # consecutive equal SLICES collapse)
+        ax = int(axis) % v.ndim
+        moved = np.moveaxis(v, ax, 0)
+        n = moved.shape[0]
+        flat2 = moved.reshape(n, -1)
+        keep = np.concatenate([[True],
+                               np.any(flat2[1:] != flat2[:-1], axis=1)]) \
+            if n > 0 else np.zeros(0, bool)
+        uniq = np.moveaxis(moved[keep], 0, ax)
+        out = [Tensor(jnp.asarray(uniq))]
+        size = n
+    else:
+        flat = v.reshape(-1)
+        keep = np.concatenate([[True], flat[1:] != flat[:-1]]) \
+            if flat.size else np.zeros(0, bool)
+        out = [Tensor(jnp.asarray(flat[keep]))]
+        size = flat.size
     if return_inverse:
         out.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
     if return_counts:
         idx = np.nonzero(keep)[0]
-        counts = np.diff(np.append(idx, flat.size))
+        counts = np.diff(np.append(idx, size))
         out.append(Tensor(jnp.asarray(counts)))
     return out[0] if len(out) == 1 else tuple(out)
 

@@ -111,6 +111,21 @@ class TestExtrasVsTorch:
         np.testing.assert_array_equal(got, [False, True, False])
 
 
+class TestUniqueConsecutiveAxis:
+    def test_axis_slice_dedup(self):
+        x = P.to_tensor(np.array(
+            [[1, 2], [1, 2], [3, 4], [3, 4], [1, 2]], np.int64))
+        u, inv, cnt = P.unique_consecutive(
+            x, return_inverse=True, return_counts=True, axis=0)
+        assert u.numpy().tolist() == [[1, 2], [3, 4], [1, 2]]
+        assert inv.numpy().tolist() == [0, 0, 1, 1, 2]
+        assert cnt.numpy().tolist() == [2, 2, 1]
+        # axis=1 dedups columns
+        y = P.to_tensor(np.array([[5, 5, 6], [7, 7, 8]], np.int64))
+        u1 = P.unique_consecutive(y, axis=1)
+        assert u1.numpy().tolist() == [[5, 6], [7, 8]]
+
+
 class TestTopLevelGlue:
     def test_constants(self):
         assert P.pi == np.pi and P.inf == float("inf") and P.newaxis is None

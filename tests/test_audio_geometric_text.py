@@ -26,6 +26,17 @@ class TestAudioFunctional:
         g = AF.get_window(("gaussian", 7), 32).numpy()
         assert g.max() <= 1.0 and g.shape == (32,)
 
+    def test_taylor_window_matches_scipy(self):
+        w = AF.get_window(("taylor", 4, 30.0), 64).numpy()
+        assert w.shape == (64,)
+        try:
+            from scipy.signal.windows import taylor as sp_taylor
+        except ImportError:
+            assert 0.99 <= w.max() <= 1.01  # unity-normalized center
+            return
+        np.testing.assert_allclose(
+            w, sp_taylor(64, nbar=4, sll=30, norm=True, sym=False), atol=1e-6)
+
     def test_mel_hz_roundtrip(self):
         for htk in (False, True):
             f = 440.0

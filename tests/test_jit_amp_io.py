@@ -349,6 +349,45 @@ def test_to_static_recompile_limit_falls_back_to_eager():
         assert spec.failed  # capped: plain eager, not endless recompiles
 
 
+def test_dispatch_cache_distinguishes_scalar_types():
+    """1 vs 1.0 vs True as static op args must not share a cached executable
+    (review finding: hash(1)==hash(1.0)==hash(True))."""
+    x = paddle.to_tensor(np.array([1, 2, 3], np.int32))
+    a = (x + 1).numpy()
+    b = (x + 1.0).numpy()
+    assert a.dtype.kind == "i"
+    assert b.dtype.kind == "f", f"float add reused the int executable: {b.dtype}"
+
+
+def test_to_static_specialization_with_concrete_scalar_mix():
+    """A concrete (closed-over eager) scalar concretized alongside a traced
+    one must not desynchronize the guard feed (review finding): the function
+    still reaches the compiled steady state."""
+    import warnings
+    from paddle_tpu.jit import to_static
+
+    const = paddle.to_tensor(np.array([3], np.int32))
+    calls = []
+
+    @to_static
+    def f(x):
+        calls.append(1)
+        k = int(const.sum())        # concrete during the specialized trace
+        if x.sum() > 0:             # traced -> scalar break
+            return x * k
+        return x - k
+
+    pos = paddle.to_tensor(np.ones(4, "float32"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        np.testing.assert_allclose(f(pos).numpy(), 3 * np.ones(4))
+        np.testing.assert_allclose(f(pos).numpy(), 3 * np.ones(4))
+        n = len(calls)
+        for _ in range(4):
+            np.testing.assert_allclose(f(pos).numpy(), 3 * np.ones(4))
+        assert len(calls) == n, "guard feed desynchronized: eager every call"
+
+
 def test_to_static_int_specialization_guards_loop_bound():
     import warnings
     from paddle_tpu.jit import to_static

@@ -55,6 +55,63 @@ class TestLauncher:
             ctl.run()
         assert ctl._restarts == 1
 
+    def test_kill_and_recover_resumes_from_checkpoint(self, tmp_path):
+        """Elastic recovery end-to-end (reference:
+        fleet/elastic/manager.py:125 — kill, relaunch with re-ranked env,
+        resume training): rank 1 dies mid-train on its first life; the
+        launcher restarts the pod, the new generation gets fresh rank envs,
+        and rank 0 RESUMES from its checkpoint instead of restarting at 0."""
+        ckpt = tmp_path / "ckpt.txt"
+        events = tmp_path / "events.log"
+        killed_flag = tmp_path / "killed_once"
+        worker = tmp_path / "worker.py"
+        worker.write_text(f"""
+import os, time
+rank = os.environ['PADDLE_TRAINER_ID']
+world = os.environ['PADDLE_TRAINERS_NUM']
+ckpt = {str(ckpt)!r}
+events = {str(events)!r}
+killed_flag = {str(killed_flag)!r}
+
+resume = 0
+if os.path.exists(ckpt):
+    resume = int(open(ckpt).read().strip()) + 1
+with open(events, 'a') as f:
+    f.write(f'start rank={{rank}} world={{world}} resume={{resume}}\\n')
+
+if rank == '1' and not os.path.exists(killed_flag):
+    open(killed_flag, 'w').write('x')
+    time.sleep(0.45)
+    os._exit(1)          # simulated node failure mid-train
+
+for step in range(resume, 10):
+    time.sleep(0.1)
+    if rank == '0':
+        tmp = ckpt + '.tmp'
+        open(tmp, 'w').write(str(step))
+        os.replace(tmp, ckpt)
+with open(events, 'a') as f:
+    f.write(f'done rank={{rank}} world={{world}}\\n')
+""")
+        ctl = Controller(str(worker), nproc_per_node=2,
+                         log_dir=str(tmp_path / "logs"), max_restarts=2)
+        assert ctl.run() == 0
+        assert killed_flag.exists(), "the failure was never injected"
+        log = events.read_text().splitlines()
+        starts = [l for l in log if l.startswith("start")]
+        dones = [l for l in log if l.startswith("done")]
+        # two generations of 2 ranks each started; both ranks finished
+        assert len(starts) == 4, log
+        assert sorted(dones) == ["done rank=0 world=2", "done rank=1 world=2"]
+        # the relaunch re-issued the full rank env set
+        gen2 = starts[2:]
+        assert {l.split()[1] for l in gen2} == {"rank=0", "rank=1"}
+        # ...and rank 0's second life RESUMED from the checkpoint (step > 0)
+        r0_gen2 = [l for l in gen2 if "rank=0" in l]
+        resume_step = int(r0_gen2[0].split("resume=")[1])
+        assert 0 < resume_step <= 9, f"no checkpoint-based resume: {log}"
+        assert int(ckpt.read_text()) == 9
+
     def test_cli_module(self, script, tmp_path):
         import subprocess
         out = subprocess.run(

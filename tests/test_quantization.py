@@ -187,6 +187,25 @@ class TestWeightOnly:
         back = nq.weight_dequantize(qw, scales)
         assert abs(back.numpy() - w).max() <= scales.numpy().max() * 0.51
 
+    def test_weight_only_int4_packed(self, rng):
+        """int4: two values per byte along the input dim (incl. odd in-dim
+        zero-padding); dequant and the linear path agree with fp32."""
+        for in_dim in (64, 17):
+            w = rng.standard_normal((in_dim, 32)).astype("float32")
+            x = P.to_tensor(rng.standard_normal((4, in_dim)).astype("float32"))
+            qw, scales = nq.weight_quantize(P.to_tensor(w),
+                                            algo="weight_only_int4")
+            assert qw.numpy().dtype == np.int8
+            assert qw.numpy().shape == ((in_dim + 1) // 2, 32)
+            y = nq.weight_only_linear(x, qw, weight_scale=scales,
+                                      weight_dtype="int4")
+            ref = x.numpy() @ w
+            assert abs(y.numpy() - ref).max() < 0.12 * abs(ref).max() + 0.3
+            back = nq.weight_dequantize(qw, scales, algo="weight_only_int4",
+                                        in_features=in_dim)
+            assert back.numpy().shape == w.shape
+            assert abs(back.numpy() - w).max() <= scales.numpy().max() * 0.51
+
     def test_llm_int8_linear(self, rng):
         w = rng.standard_normal((16, 8)).astype("float32")
         x = rng.standard_normal((2, 16)).astype("float32")
