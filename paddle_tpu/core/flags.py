@@ -108,6 +108,11 @@ define_flag("check_nan_inf", False, "check every op output for nan/inf (numeric 
 define_flag("use_fused_adamw", True,
             "route multi-precision Adam/AdamW updates to the fused Pallas "
             "single-pass kernel")
+define_flag("adamw_bf16_moments", False,
+            "store Adam/AdamW moment1/moment2 in bfloat16 (update math stays "
+            "fp32 via upcast) — halves optimizer-state HBM traffic at a "
+            "small stochastic-rounding cost; off by default to keep "
+            "reference-exact trajectories")
 define_flag("check_nan_inf_level", 0, "0: error on nan/inf; 1: warn; 3: report fp16 overflow too")
 define_flag("benchmark", False, "synchronize after every op dispatch (op-level timing)")
 define_flag("eager_op_jit", True, "route eager op dispatch through a cached jax.jit per op signature")

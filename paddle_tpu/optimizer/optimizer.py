@@ -251,22 +251,29 @@ class Adam(Optimizer):
         self._amsgrad = amsgrad
 
     def _init_slots(self, v):
-        s = {"moment1": jnp.zeros_like(v), "moment2": jnp.zeros_like(v)}
+        from ..core.flags import flag_value
+        mdt = jnp.bfloat16 if (flag_value("adamw_bf16_moments")
+                               and v.dtype == jnp.float32) else v.dtype
+        s = {"moment1": jnp.zeros(v.shape, mdt),
+             "moment2": jnp.zeros(v.shape, mdt)}
         if self._amsgrad:
-            s["moment2_max"] = jnp.zeros_like(v)
+            s["moment2_max"] = jnp.zeros(v.shape, mdt)
         return s
 
     def _apply(self, p, g, slots, lr, step):
         b1, b2 = self._beta1, self._beta2
-        m = b1 * slots["moment1"] + (1 - b1) * g
-        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        mdt = slots["moment1"].dtype
+        m1 = slots["moment1"].astype(p.dtype)  # fp32 math; bf16-storable
+        m2 = slots["moment2"].astype(p.dtype)
+        m = b1 * m1 + (1 - b1) * g
+        v = b2 * m2 + (1 - b2) * jnp.square(g)
         stepf = step.astype(jnp.float32)
         bc1 = 1 - jnp.power(b1, stepf)
         bc2 = 1 - jnp.power(b2, stepf)
-        ns = {"moment1": m, "moment2": v}
+        ns = {"moment1": m.astype(mdt), "moment2": v.astype(mdt)}
         if self._amsgrad:
-            vmax = jnp.maximum(slots["moment2_max"], v)
-            ns["moment2_max"] = vmax
+            vmax = jnp.maximum(slots["moment2_max"].astype(p.dtype), v)
+            ns["moment2_max"] = vmax.astype(mdt)
             denom = jnp.sqrt(vmax / bc2) + self._eps
         else:
             denom = jnp.sqrt(v / bc2) + self._eps
@@ -283,6 +290,8 @@ class Adam(Optimizer):
         from ..core.flags import flag_value
         if not flag_value("use_fused_adamw"):
             return None
+        if slots["moment1"].dtype != jnp.float32:
+            return None  # the Pallas kernel assumes fp32 moments
         from ..ops.kernels.fused_adamw import fused_adamw_update
         out = fused_adamw_update(
             p, g, slots["moment1"], slots["moment2"], slots["master_weight"],

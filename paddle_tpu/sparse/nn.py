@@ -23,7 +23,7 @@ from . import (
 
 __all__ = [
     "ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm", "SyncBatchNorm",
-    "Conv3D", "SubmConv3D", "MaxPool3D", "functional",
+    "Conv2D", "SubmConv2D", "Conv3D", "SubmConv3D", "MaxPool3D", "functional",
 ]
 
 
@@ -116,29 +116,30 @@ class SyncBatchNorm(BatchNorm):
 
 
 def _footprint_out_sites(idx, N, spatial_in, ks, stride, pad, dilation):
-    """All output sites whose window covers ≥1 active input site.
+    """All output sites whose window covers ≥1 active input site (any ndims).
 
-    Shared by Conv3D and MaxPool3D: an output site o covers input c when
-    o*stride + off*dilation - pad == c for some off in [0, k); enumerate all
-    (site, off) pairs and keep in-range strided solutions.
+    Shared by the sparse convs and pools: an output site o covers input c
+    when o*stride + off*dilation - pad == c for some off in [0, k);
+    enumerate all (site, off) pairs and keep in-range strided solutions.
     """
+    nd = len(ks)
     out_spatial = []
-    for i in range(3):
+    for i in range(nd):
         eff_k = (ks[i] - 1) * dilation[i] + 1
         out_spatial.append(
             (spatial_in[i] + 2 * int(pad[i]) - eff_k) // stride[i] + 1)
     offs = np.stack(np.meshgrid(
         *[np.arange(k) * d for k, d in zip(ks, dilation)],
-        indexing="ij"), axis=-1).reshape(-1, 3)
-    coords = idx[1:4].T  # (nnz, 3)
+        indexing="ij"), axis=-1).reshape(-1, nd)
+    coords = idx[1:1 + nd].T  # (nnz, nd)
     pad_arr = np.asarray([int(p) for p in pad])
     expanded = (coords[:, None, :] + pad_arr - offs[None, :, :])
     batch = np.repeat(idx[0], offs.shape[0])
-    expanded = expanded.reshape(-1, 3)
+    expanded = expanded.reshape(-1, nd)
     stride_arr = np.asarray(stride)
     valid = np.all(expanded % stride_arr == 0, axis=1)
     outc = expanded // stride_arr
-    for i in range(3):
+    for i in range(nd):
         valid &= (outc[:, i] >= 0) & (outc[:, i] < out_spatial[i])
     outc = outc[valid]
     batch = batch[valid]
@@ -149,14 +150,16 @@ def _footprint_out_sites(idx, N, spatial_in, ks, stride, pad, dilation):
     return out_idx, tuple(out_spatial)
 
 
-def _dense_conv3d(v_dense, w, stride, padding, dilation, groups):
-    # v_dense: (N, D, H, W, C) NDHWC; w: (kd, kh, kw, Cin/g, Cout)
-    dn = jax.lax.conv_dimension_numbers(
-        v_dense.shape, w.shape, ("NDHWC", "DHWIO", "NDHWC"))
+_CONV_DIMS = {2: ("NHWC", "HWIO", "NHWC"), 3: ("NDHWC", "DHWIO", "NDHWC")}
+
+
+def _dense_conv(v_dense, w, stride, padding, dilation, groups, nd):
+    # v_dense: (N, *spatial, C) channels-last; w: (*k, Cin/g, Cout)
+    dn = jax.lax.conv_dimension_numbers(v_dense.shape, w.shape, _CONV_DIMS[nd])
     if isinstance(padding, str):
         pad = padding
     else:
-        p = padding if isinstance(padding, (list, tuple)) else [padding] * 3
+        p = padding if isinstance(padding, (list, tuple)) else [padding] * nd
         pad = [(int(x), int(x)) for x in p]
     return jax.lax.conv_general_dilated(
         v_dense, w, window_strides=tuple(stride), padding=pad,
@@ -164,23 +167,86 @@ def _dense_conv3d(v_dense, w, stride, padding, dilation, groups):
         feature_group_count=groups)
 
 
-class Conv3D(Layer):
-    """Sparse 3-D convolution (NDHWC), reference sparse/nn/layer/conv.py.
+def _sparse_conv_forward(x, weight, bias, ks, stride, padding, dilation,
+                         groups, subm, nd):
+    """Shared core of Conv2D/Conv3D (layer + functional forms)."""
+    xc = _coo(x)
+    idx_np = np.asarray(xc._indices)
+    shape = tuple(xc._shape)
+    N, spatial_in = shape[0], shape[1:1 + nd]
+    if subm:
+        out_idx, out_spatial = idx_np, tuple(spatial_in)
+    else:
+        pad = padding if isinstance(padding, (list, tuple)) else [padding] * nd
+        out_idx, out_spatial = _footprint_out_sites(
+            idx_np, N, spatial_in, ks, stride, pad, dilation)
+    idx = jnp.asarray(xc._indices)
+    oidx = jnp.asarray(out_idx)
+    w_shape = weight.shape
+    out_ch = int(w_shape[-1])
 
-    Computes through the dense conv HLO and gathers the statically-derived active
-    output sites. Output sites = dilation of input sites by the kernel footprint
-    (computed host-side from the static index set).
+    def fn(v, w, b):
+        dense = jnp.zeros(shape[:1 + nd] + (v.shape[-1],), dtype=v.dtype)
+        dense = dense.at[tuple(idx[i] for i in range(1 + nd))].add(v)
+        out = _dense_conv(dense, w, stride, padding, dilation, groups, nd)
+        vals = out[tuple(oidx[i] for i in range(1 + nd))]
+        if b is not None:
+            vals = vals + b
+        return vals
+
+    vals = dispatch(fn, (xc._values, weight, bias), {},
+                    name=f"sparse_conv{nd}d")
+    out_shape = (shape[0],) + out_spatial + (out_ch,)
+    return SparseCooTensor(out_idx, vals, out_shape, coalesced=True)
+
+
+def _max_pool_forward(x, ks, stride, padding, nd):
+    xc = _coo(x)
+    shape = tuple(xc._shape)
+    N, spatial_in, C = shape[0], shape[1:1 + nd], shape[1 + nd]
+    pad = [int(p) for p in (padding if isinstance(padding, (list, tuple))
+                            else [padding] * nd)]
+    idx_np = np.asarray(xc._indices)
+    out_idx, out_spatial = _footprint_out_sites(
+        idx_np, N, spatial_in, ks, stride, pad, (1,) * nd)
+    idx = jnp.asarray(xc._indices)
+    oidx = jnp.asarray(out_idx)
+
+    def fn(v):
+        neg = jnp.asarray(-jnp.inf, dtype=v.dtype)
+        dense = jnp.full(shape, neg)
+        dense = dense.at[tuple(idx[i] for i in range(1 + nd))].max(v)
+        pooled = jax.lax.reduce_window(
+            dense, neg, jax.lax.max,
+            window_dimensions=(1,) + tuple(ks) + (1,),
+            window_strides=(1,) + tuple(stride) + (1,),
+            padding=((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),))
+        return pooled[tuple(oidx[i] for i in range(1 + nd))]
+
+    vals = dispatch(fn, (xc._values,), {}, name=f"sparse_max_pool{nd}d")
+    return SparseCooTensor(out_idx, vals, (N,) + out_spatial + (C,),
+                           coalesced=True)
+
+
+class _SparseConvNd(Layer):
+    """Sparse convolution (channels-last), reference sparse/nn/layer/conv.py.
+
+    Computes through the dense conv HLO and gathers the statically-derived
+    active output sites (host-side index arithmetic; sparsity patterns are
+    static per tensor in this design).
     """
 
     _subm = False
+    _nd = 3
 
     def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
                  dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
-                 bias_attr=None, data_format="NDHWC"):
+                 bias_attr=None, data_format=None):
         super().__init__()
-        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else [kernel_size] * 3
+        nd = self._nd
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else [kernel_size] * nd
         self._ks = tuple(int(k) for k in ks)
-        st = stride if isinstance(stride, (list, tuple)) else [stride] * 3
+        st = stride if isinstance(stride, (list, tuple)) else [stride] * nd
         self._stride = tuple(int(s) for s in st)
         if isinstance(padding, str):
             mode = padding.upper()
@@ -193,7 +259,7 @@ class Conv3D(Layer):
             else:
                 raise ValueError(f"unknown padding mode {padding!r}")
         self._padding = padding
-        dl = dilation if isinstance(dilation, (list, tuple)) else [dilation] * 3
+        dl = dilation if isinstance(dilation, (list, tuple)) else [dilation] * nd
         self._dilation = tuple(int(d) for d in dl)
         self._groups = groups
         self.weight = self.create_parameter(
@@ -201,56 +267,42 @@ class Conv3D(Layer):
         self.bias = (self.create_parameter([out_channels], attr=bias_attr, is_bias=True)
                      if bias_attr is not False else None)
 
-    def _out_sites(self, xc):
-        """Active output coordinates (np arrays) given input coordinates."""
-        idx = np.asarray(xc._indices)  # (4, nnz): n, d, h, w
-        N = xc._shape[0]
-        spatial_in = xc._shape[1:4]
-        if self._subm:
-            return idx, tuple(spatial_in)
-        pad = self._padding if isinstance(self._padding, (list, tuple)) \
-            else [self._padding] * 3
-        return _footprint_out_sites(idx, N, spatial_in, self._ks, self._stride,
-                                    pad, self._dilation)
-
     def forward(self, x):
-        xc = _coo(x)
-        out_idx, out_spatial = self._out_sites(xc)
-        shape = tuple(xc._shape)
-        stride, padding, dilation, groups = (
-            self._stride, self._padding, self._dilation, self._groups)
-        idx = jnp.asarray(xc._indices)
-        oidx = jnp.asarray(out_idx)
-        out_ch = int(self.weight.shape[-1])
-        bias = self.bias
-
-        def fn(v, w, b):
-            dense = jnp.zeros(shape[:4] + (v.shape[-1],), dtype=v.dtype)
-            dense = dense.at[idx[0], idx[1], idx[2], idx[3]].add(v)
-            out = _dense_conv3d(dense, w, stride, padding, dilation, groups)
-            vals = out[oidx[0], oidx[1], oidx[2], oidx[3]]
-            if b is not None:
-                vals = vals + b
-            return vals
-
-        args = (xc._values, self.weight, bias)
-        vals = dispatch(fn, args, {}, name="sparse_conv3d")
-        out_shape = (shape[0],) + out_spatial + (out_ch,)
-        return SparseCooTensor(out_idx, vals, out_shape, coalesced=True)
+        return _sparse_conv_forward(
+            x, self.weight, self.bias, self._ks, self._stride, self._padding,
+            self._dilation, self._groups, self._subm, self._nd)
 
 
-class SubmConv3D(Conv3D):
+class Conv3D(_SparseConvNd):
+    _nd = 3
+
+
+class Conv2D(_SparseConvNd):
+    """Sparse 2-D convolution (NHWC), reference sparse/nn/layer/conv.py
+    Conv2D."""
+    _nd = 2
+
+
+class _SubmMixin:
     """Submanifold sparse conv: output sparsity == input sparsity."""
 
     _subm = True
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        if self._stride != (1, 1, 1):
-            raise ValueError("SubmConv3D requires stride 1")
+        if self._stride != (1,) * self._nd:
+            raise ValueError("submanifold conv requires stride 1")
         # 'same' padding so sites map onto themselves
         self._padding = tuple(((k - 1) * d) // 2
                               for k, d in zip(self._ks, self._dilation))
+
+
+class SubmConv3D(_SubmMixin, Conv3D):
+    pass
+
+
+class SubmConv2D(_SubmMixin, Conv2D):
+    pass
 
 
 class MaxPool3D(Layer):
@@ -267,31 +319,67 @@ class MaxPool3D(Layer):
         self._padding = padding if isinstance(padding, (list, tuple)) else [padding] * 3
 
     def forward(self, x):
-        xc = _coo(x)
-        shape = tuple(xc._shape)
-        N, spatial_in, C = shape[0], shape[1:4], shape[4]
-        pad = [int(p) for p in self._padding]
-        idx_np = np.asarray(xc._indices)
-        out_idx, out_spatial = _footprint_out_sites(
-            idx_np, N, spatial_in, self._ks, self._stride, pad, (1, 1, 1))
-        idx = jnp.asarray(xc._indices)
-        oidx = jnp.asarray(out_idx)
-        ks, stride = self._ks, self._stride
+        return _max_pool_forward(x, self._ks, self._stride, self._padding, 3)
 
-        def fn(v):
-            neg = jnp.asarray(-jnp.inf, dtype=v.dtype)
-            dense = jnp.full(shape, neg)
-            dense = dense.at[idx[0], idx[1], idx[2], idx[3]].max(v)
-            pooled = jax.lax.reduce_window(
-                dense, neg, jax.lax.max,
-                window_dimensions=(1,) + ks + (1,),
-                window_strides=(1,) + stride + (1,),
-                padding=((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),))
-            return pooled[oidx[0], oidx[1], oidx[2], oidx[3]]
 
-        vals = dispatch(fn, (xc._values,), {}, name="sparse_max_pool3d")
-        return SparseCooTensor(out_idx, vals, (N,) + out_spatial + (C,),
-                               coalesced=True)
+def _norm_tuple(v, nd):
+    return tuple(int(x) for x in (v if isinstance(v, (list, tuple))
+                                  else [v] * nd))
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    """Functional sparse 2-D conv (reference: sparse/nn/functional/conv.py).
+    weight: (kh, kw, Cin/g, Cout)."""
+    ks = tuple(int(k) for k in weight.shape[:2])
+    return _sparse_conv_forward(x, weight, bias, ks, _norm_tuple(stride, 2),
+                                padding, _norm_tuple(dilation, 2), groups,
+                                False, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Functional sparse 3-D conv. weight: (kd, kh, kw, Cin/g, Cout)."""
+    ks = tuple(int(k) for k in weight.shape[:3])
+    return _sparse_conv_forward(x, weight, bias, ks, _norm_tuple(stride, 3),
+                                padding, _norm_tuple(dilation, 3), groups,
+                                False, 3)
+
+
+def _subm_conv(x, weight, bias, stride, padding, dilation, groups, nd, key):
+    nd_ks = tuple(int(k) for k in weight.shape[:nd])
+    stride = _norm_tuple(stride, nd)
+    if stride != (1,) * nd:
+        raise ValueError("submanifold conv requires stride 1")
+    dilation = _norm_tuple(dilation, nd)
+    pad = tuple(((k - 1) * d) // 2 for k, d in zip(nd_ks, dilation))
+    return _sparse_conv_forward(x, weight, bias, nd_ks, stride, pad,
+                                dilation, groups, True, nd)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _subm_conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                      key)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    return _subm_conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                      key)
+
+
+# the reference's implicit-GEMM kernels are an execution strategy, not a
+# semantic: on TPU both forms lower through the same dense conv HLO
+subm_conv2d_igemm = subm_conv2d
+subm_conv3d_igemm = subm_conv3d
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    ks = _norm_tuple(kernel_size, 3)
+    st = _norm_tuple(stride if stride is not None else kernel_size, 3)
+    return _max_pool_forward(x, ks, st, _norm_tuple(padding, 3), 3)
 
 
 class functional:
@@ -299,6 +387,16 @@ class functional:
     from . import (  # noqa: F401
         relu, relu6, leaky_relu, softmax,
     )
+    conv2d = staticmethod(conv2d)
+    conv3d = staticmethod(conv3d)
+    subm_conv2d = staticmethod(subm_conv2d)
+    subm_conv3d = staticmethod(subm_conv3d)
+    # igemm is an execution strategy in the reference, not a semantic — the
+    # module-level functions are already aliased; avoid re-wrapping the
+    # class-local staticmethod objects
+    subm_conv2d_igemm = subm_conv2d
+    subm_conv3d_igemm = subm_conv3d
+    max_pool3d = staticmethod(max_pool3d)
 
     @staticmethod
     def attention(query, key, value, sparse_mask, key_padding_mask=None,

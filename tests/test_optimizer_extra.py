@@ -136,3 +136,35 @@ def test_lbfgs_state_reuse_across_steps():
     for _ in range(6):
         opt.step(closure)
     assert float(closure()) < l0 * 1e-3
+
+
+def test_adamw_bf16_moments_flag():
+    """FLAGS_adamw_bf16_moments stores moments bf16 (fp32 update math):
+    trajectories track the fp32-moment run closely and converge."""
+    from paddle_tpu.core.flags import set_flags
+    rng = np.random.default_rng(0)
+    w0 = rng.standard_normal((16, 8)).astype(np.float32)
+    grads = [rng.standard_normal((16, 8)).astype(np.float32) * 0.1
+             for _ in range(10)]
+
+    def run():
+        p = paddle.create_parameter([16, 8], "float32")
+        p._value = jnp.asarray(w0)
+        opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=[p],
+                                     weight_decay=0.01)
+        for g in grads:
+            p.grad = paddle.to_tensor(g)
+            opt.step()
+        return np.asarray(p._value), opt._slots[id(p)]
+
+    ref, _ = run()
+    set_flags({"adamw_bf16_moments": True})
+    try:
+        got, slots = run()
+    finally:
+        set_flags({"adamw_bf16_moments": False})
+    assert slots["moment1"].dtype == jnp.bfloat16
+    assert slots["moment2"].dtype == jnp.bfloat16
+    # bf16 moment rounding perturbs the trajectory slightly, not wildly
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=5e-3)
+    assert not np.allclose(got, ref)  # the flag actually changed storage

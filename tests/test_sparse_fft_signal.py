@@ -150,9 +150,9 @@ class TestSparseNN:
         st, dense = _rand_sparse_ndhwc(rng)
         conv = sp.nn.Conv3D(3, 4, kernel_size=3, stride=1, padding=1)
         out = conv(st)
-        from paddle_tpu.sparse.nn import _dense_conv3d
-        ref = np.asarray(_dense_conv3d(jnp.asarray(dense), conv.weight._value,
-                                       (1, 1, 1), 1, (1, 1, 1), 1))
+        from paddle_tpu.sparse.nn import _dense_conv
+        ref = np.asarray(_dense_conv(jnp.asarray(dense), conv.weight._value,
+                                     (1, 1, 1), 1, (1, 1, 1), 1, 3))
         mask = np.zeros(ref.shape[:4], bool)
         mask[tuple(np.asarray(out._indices))] = True
         np.testing.assert_allclose(out.to_dense().numpy()[mask],
@@ -168,6 +168,49 @@ class TestSparseNN:
         assert out.nnz() == st.coalesce().nnz()
         np.testing.assert_array_equal(np.asarray(out._indices),
                                       np.asarray(st.coalesce()._indices))
+
+    def test_conv2d_matches_dense(self, rng):
+        dense = np.zeros((2, 8, 8, 3), np.float32)
+        mask = rng.random((2, 8, 8)) < 0.2
+        dense[mask] = rng.standard_normal((mask.sum(), 3)).astype(np.float32)
+        st = sp.sparse_coo_tensor(np.stack(np.nonzero(mask)), dense[mask],
+                                  dense.shape)
+        conv = sp.nn.Conv2D(3, 4, kernel_size=3, padding=1)
+        out = conv(st)
+        from paddle_tpu.sparse.nn import _dense_conv
+        ref = np.asarray(_dense_conv(jnp.asarray(dense), conv.weight._value,
+                                     (1, 1), 1, (1, 1), 1, 2))
+        oi = tuple(np.asarray(out._indices))
+        np.testing.assert_allclose(out.to_dense().numpy()[oi],
+                                   ref[oi] + conv.bias.numpy(), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_subm_conv2d_and_functionals(self, rng):
+        dense = np.zeros((1, 6, 6, 2), np.float32)
+        mask = rng.random((1, 6, 6)) < 0.3
+        dense[mask] = 1.0
+        st = sp.sparse_coo_tensor(np.stack(np.nonzero(mask)), dense[mask],
+                                  dense.shape)
+        subm = sp.nn.SubmConv2D(2, 3, kernel_size=3)
+        out = subm(st)
+        np.testing.assert_array_equal(np.asarray(out._indices),
+                                      np.asarray(st.coalesce()._indices))
+        F = sp.nn.functional
+        w2 = P.to_tensor(rng.standard_normal((3, 3, 2, 3))
+                              .astype(np.float32))
+        y = F.conv2d(st, w2, padding=1)
+        assert tuple(y._shape) == (1, 6, 6, 3)
+        ys = F.subm_conv2d(st, w2)
+        assert ys.nnz() == st.coalesce().nnz()
+        assert F.subm_conv2d_igemm is F.subm_conv2d  # same semantics on TPU
+        w3 = P.to_tensor(rng.standard_normal((3, 3, 3, 2, 4))
+                              .astype(np.float32))
+        d3 = np.zeros((1, 4, 4, 4, 2), np.float32)
+        m3 = rng.random((1, 4, 4, 4)) < 0.3
+        d3[m3] = 1.0
+        st3 = sp.sparse_coo_tensor(np.stack(np.nonzero(m3)), d3[m3], d3.shape)
+        assert tuple(F.conv3d(st3, w3, padding=1)._shape) == (1, 4, 4, 4, 4)
+        assert tuple(F.max_pool3d(st3, 2)._shape) == (1, 2, 2, 2, 2)
 
     def test_maxpool_overlapping_windows(self):
         dense = np.zeros((1, 5, 5, 5, 2), "float32")
