@@ -17,6 +17,23 @@ import jax
 import numpy as np
 
 
+# Monotone count of stateful draws across EVERY Generator instance (default,
+# tracker streams, user-created). The eager dispatch cache snapshots this to
+# detect any RNG consumption during a first trace — watching only
+# default_generator._counter would miss draws from tracker generators.
+# Guarded by its own lock: per-instance locks don't serialize increments from
+# different generators, and a lost increment could hide a draw from the
+# cache's before/after snapshot.
+_draw_epoch = 0
+_epoch_lock = threading.Lock()
+
+
+def _bump_draw_epoch():
+    global _draw_epoch
+    with _epoch_lock:
+        _draw_epoch += 1
+
+
 class Generator:
     """Stateful key holder. ``next_key()`` splits off a fresh subkey.
 
@@ -48,6 +65,7 @@ class Generator:
                 if not isinstance(key, jax.core.Tracer):
                     self._key = key
             self._counter += 1
+            _bump_draw_epoch()
             return jax.random.fold_in(key, self._counter)
 
     def next_seed(self):
@@ -55,6 +73,7 @@ class Generator:
         device work). Used by host-resident samplers (e.g. graph sampling)."""
         with self._lock:
             self._counter += 1
+            _bump_draw_epoch()
             return (self._seed, self._counter)
 
     def get_state(self):

@@ -484,17 +484,36 @@ def _freeze(v, depth=0):
     if callable(v):
         code = getattr(v, "__code__", None)
         if code is not None:
+            # A bound method's __code__/__closure__ belong to the underlying
+            # function; two methods of different instances would collide. The
+            # instance itself is almost always stateful, so freeze it too —
+            # stateful selves raise and route to the uncached path.
+            slf = getattr(v, "__self__", None)
+            frozen_self = _freeze(slf, depth + 1) if slf is not None else None
             cells = getattr(v, "__closure__", None) or ()
             frozen = tuple(_freeze(c.cell_contents, depth + 1) for c in cells)
             defaults = tuple(_freeze(d, depth + 1)
                              for d in (getattr(v, "__defaults__", None) or ()))
-            return ("F", code, frozen, defaults)
+            return ("F", code, frozen_self, frozen, defaults)
         mod = getattr(v, "__module__", None) or \
             getattr(type(v), "__module__", "")
         if str(mod).startswith(("jax", "numpy")):
-            # module-level jax/numpy callables (incl. ufunc objects): identity
-            # is stable for the process lifetime
-            return ("G", id(v))
+            # module-level jax/numpy callables (incl. ufunc objects): key by
+            # (module, qualname) — stable for the process lifetime — but only
+            # after confirming the name genuinely resolves back to v, so
+            # dynamically created instances (np.vectorize etc.) can't alias
+            # a module attr or leak via pinned id()s
+            name = getattr(v, "__qualname__", None) or \
+                getattr(v, "__name__", None)
+            if name is not None:
+                import sys
+                target = sys.modules.get(str(mod))
+                for part in str(name).split("."):
+                    target = getattr(target, part, None)
+                    if target is None:
+                        break
+                if target is v:
+                    return ("G", str(mod), str(name))
     raise _Unfreezable
 
 
@@ -523,7 +542,10 @@ def _bwd_call(vjp_obj, ct):
 def _rng_counters():
     from . import random as _random
     prov = _random._key_providers
-    return (_random.default_generator._counter,
+    # _draw_epoch counts draws from EVERY Generator (default + tracker
+    # streams), so a first trace that consumes randomness through any of
+    # them gets blacklisted, not just draws through default_generator
+    return (_random._draw_epoch,
             prov[-1].counter if prov else -1)
 
 

@@ -52,6 +52,14 @@ class ShardPlan(NamedTuple):
     param_spec: object  # placement for the *param* output (stage3: sharded)
 
 
+class AccPlacement(NamedTuple):
+    """Storage contract for a persistent grad accumulator (stage>=2): where it
+    lives and whether it is kept in the flat-padded stored form."""
+    sharding: object    # NamedSharding
+    flat: bool
+    pad_to: int
+
+
 def _existing_spec(value):
     sh = getattr(value, "sharding", None)
     if isinstance(sh, NamedSharding) and sh.spec is not None:
@@ -117,6 +125,12 @@ class DygraphShardingOptimizer:
         self._stage = stage
         self._plans = []      # positionally aligned with the last _ensure_slots
         self._plan_params = []
+        # id-keyed view of the same plans: stable across later _ensure_slots
+        # calls with a different param list (eager step() vs TrainStep mixes).
+        # Values are (plan, weakref) — the entry self-deletes when the param
+        # dies (the callback runs during deallocation, before the id can be
+        # recycled), so the dict stays bounded and pins no dead arrays.
+        self._plan_by_id: dict = {}
         # route every update entry point through the wrapper, so code holding
         # the inner optimizer (TrainStep built on it, Optimizer.step) still
         # gets the sharded update — the slots ARE stored in sharded form
@@ -136,6 +150,8 @@ class DygraphShardingOptimizer:
         if self._axis not in mesh.shape or mesh.shape[self._axis] <= 1:
             self._plans = [None] * len(params)
             self._plan_params = list(params)
+            for p in params:
+                self._remember_plan(p, None)
             return
         self._plans, self._plan_params = [], []
         for p in params:
@@ -143,6 +159,7 @@ class DygraphShardingOptimizer:
                              _existing_spec(p._value))
             self._plans.append(plan)
             self._plan_params.append(p)
+            self._remember_plan(p, plan)
             slots = inner._slots[id(p)]
             for k, v in list(slots.items()):
                 if not (isinstance(v, jax.Array) and v.shape):
@@ -152,6 +169,14 @@ class DygraphShardingOptimizer:
                         slots[k] = _to_stored(plan, mesh, v)
                 elif not self._is_stored(plan, v):
                     slots[k] = _to_stored(plan, mesh, v)
+
+    def _remember_plan(self, p, plan):
+        import weakref
+        pid = id(p)
+        table = self._plan_by_id
+        table[pid] = (plan,
+                      weakref.ref(p, lambda _r, pid=pid, table=table:
+                                  table.pop(pid, None)))
 
     @staticmethod
     def _is_stored(plan, v):
@@ -176,15 +201,29 @@ class DygraphShardingOptimizer:
             return [None] * len(vals)
         return [_plan_for(mesh, self._axis, tuple(v.shape)) for v in vals]
 
-    def _grad_placement(self, index):
-        """NamedSharding for persistent grad accumulators of param #index
-        (stage>=2), or None. Used by TrainStep gradient accumulation."""
-        if self._stage < 2 or index >= len(self._plans):
+    def _grad_placement(self, param):
+        """AccPlacement for `param`'s persistent grad accumulator (stage>=2),
+        or None (replicated, original shape). Used by TrainStep gradient
+        accumulation. Keyed by the param object, not position — the plan list
+        realigns on every _ensure_slots and positions need not match the
+        caller's trainable-param ordering."""
+        if self._stage < 2:
             return None
-        plan = self._plans[index]
-        if plan is None or plan.flat:
+        entry = self._plan_by_id.get(id(param))
+        if entry is None:
             return None
-        return NamedSharding(self._mesh(), plan.spec)
+        plan = entry[0]
+        if plan is None:
+            return None
+        if plan.flat:
+            # flat-pad params accumulate in the flat stored form so the
+            # accumulator still shards at 1/N (e.g. vocab-padded embeddings
+            # under gradient accumulation)
+            return AccPlacement(NamedSharding(self._mesh(), plan.spec),
+                                True, plan.pad_to)
+        if all(s is None for s in tuple(plan.spec)):
+            return None
+        return AccPlacement(NamedSharding(self._mesh(), plan.spec), False, 0)
 
     # -- the pure sharded update (runs under jit) -----------------------------
     def apply_updates(self, vals, grads, slots, lr, step, decay_flags):
@@ -197,37 +236,40 @@ class DygraphShardingOptimizer:
         if inner._grad_clip is not None:
             grads = inner._grad_clip.apply(vals, grads)
 
-        t_vals, t_grads = [], []
+        t_vals, t_grads, fused_ctx = [], [], []
         for v, g, pl in zip(vals, grads, plans):
             if pl is None or g is None:
                 t_vals.append(v)
                 t_grads.append(g)
+                fused_ctx.append(None)
                 continue
             if pl.flat:
                 v = jnp.pad(jnp.ravel(v), (0, pl.pad_to - v.size))
-                g = jnp.pad(jnp.ravel(g), (0, pl.pad_to - g.size))
+                if g.ndim != 1 or g.shape != (pl.pad_to,):
+                    # grads from an AccPlacement-aware accumulator arrive
+                    # already in the flat stored form
+                    g = jnp.pad(jnp.ravel(g), (0, pl.pad_to - g.size))
             if self._stage >= 2 and any(s is not None for s in tuple(pl.spec)):
                 # ZeRO-2: reduce the dp-partial grad directly into shards
                 g = jax.lax.with_sharding_constraint(
                     g, NamedSharding(mesh, pl.spec))
             t_vals.append(v)
             t_grads.append(g)
+            # fused Pallas update runs shard_map-wise on the stored shards —
+            # GSPMD can't partition a pallas_call, so we partition for it
+            fused_ctx.append((mesh, pl.spec)
+                             if any(s is not None for s in tuple(pl.spec))
+                             else None)
 
-        # inner update on the stored (sharded/flat) forms; clip already done,
-        # fused Pallas path skipped (it cannot be SPMD-partitioned by GSPMD)
+        # inner update on the stored (sharded/flat) forms; clip already done
         saved_clip = inner._grad_clip
         inner._grad_clip = None
-        from ...core.flags import flag_value, set_flags
-        saved_fused = flag_value("use_fused_adamw")
-        if saved_fused:
-            set_flags({"use_fused_adamw": False})
         try:
             new_vals, new_slots = type(inner).apply_updates(
-                inner, t_vals, t_grads, slots, lr, step, decay_flags)
+                inner, t_vals, t_grads, slots, lr, step, decay_flags,
+                fused_ctx=fused_ctx)
         finally:
             inner._grad_clip = saved_clip
-            if saved_fused:
-                set_flags({"use_fused_adamw": saved_fused})
 
         out_vals, out_slots = [], []
         for v0, nv, ns, pl in zip(vals, new_vals, new_slots, plans):

@@ -155,11 +155,24 @@ class StaticFunction:
     #: (the torch.compile recompile_limit analog)
     _MAX_PROFILES = 8
 
+    #: canonical stand-in for NaN guard values in profile keys: NaN never
+    #: compares (or, per-instance, hashes) equal to itself, so raw-NaN tuples
+    #: would miss both _profiles_match and the programs-dict lookups,
+    #: recompiling an identical program per call until the cap. The RAW
+    #: recorded values (real NaNs) still feed the specialized trace.
+    _NAN = object()
+
+    @classmethod
+    def _canon_profile(cls, values):
+        return tuple(cls._NAN if isinstance(v, float) and v != v else v
+                     for v in values)
+
     @staticmethod
     def _profiles_match(observed, profile):
         # EXACT equality, floats included: a spurious mismatch merely costs an
         # eager re-profile, but any tolerance can validate a guard that sits
-        # across a python comparison threshold and commit the wrong branch
+        # across a python comparison threshold and commit the wrong branch.
+        # (Both sides are canonical profiles — NaN already collapsed to _NAN.)
         return len(observed) == len(profile) and \
             all(o == p for o, p in zip(observed, profile))
 
@@ -180,7 +193,8 @@ class StaticFunction:
             try:
                 out_vals, new_buf_vals, guards = prog(
                     state_vals, dyn, _random.next_key())
-                observed = tuple(np.asarray(g).item() for g in guards)
+                observed = self._canon_profile(
+                    np.asarray(g).item() for g in guards)
             except (jax.errors.ConcretizationTypeError,
                     jax.errors.TracerIntegerConversionError,
                     jax.errors.TracerArrayConversionError,
@@ -207,7 +221,8 @@ class StaticFunction:
         scope = ConcretizeScope()
         with concretize_scope(scope):
             result = self._fn(*args, **kwargs)
-        profile = tuple(scope.recorded)
+        profile_raw = tuple(scope.recorded)
+        profile = self._canon_profile(profile_raw)
         spec.last_profile = profile
         if profile not in spec.programs:
             if len(spec.programs) >= self._MAX_PROFILES:
@@ -219,7 +234,7 @@ class StaticFunction:
                     RuntimeWarning, stacklevel=2)
                 spec.failed = True
                 return result
-            profile_list = list(profile)
+            profile_list = list(profile_raw)
 
             def specialized(state_vals, dyn_vals, rng_key):
                 sc = ConcretizeScope(feed=profile_list)
@@ -368,6 +383,7 @@ class TrainStep:
         # accumulators, optimizer-state traffic only on the K-th
         self.accumulate_steps = int(accumulate_steps)
         self._acc = None
+        self._acc_placements = None
         self._acc_count = 0
         self._grad_cache = {}
         self._update_fn = None
@@ -424,19 +440,37 @@ class TrainStep:
         return Tensor(loss_val)
 
     def _acc_shardings(self):
-        """Per-param NamedSharding for grad accumulators, from a ZeRO-2+
-        sharding optimizer wrapper (None = keep replicated)."""
+        """Per-param AccPlacement for grad accumulators, from a ZeRO-2+
+        sharding optimizer wrapper (None = keep replicated). Keyed by the
+        param object so the wrapper's plan ordering doesn't have to match
+        ours."""
         placement = getattr(self.optimizer, "_grad_placement", None)
         if placement is None:
             return [None] * len(self.params)
-        return [placement(i) for i in range(len(self.params))]
+        return [placement(p) for p in self.params]
 
     # -- gradient-accumulation path ------------------------------------------
     def _call_accumulate(self, *batch):
         opt = self.optimizer
         dyn, static_key, layout, treedef = _split_leaves(batch)
+
+        # accumulator placements are resolved ONCE per accumulation cycle and
+        # frozen; the grad/update programs are keyed on them, so a sharding-
+        # plan change between cycles recompiles instead of reusing a closure
+        # baked for the old placements against new-shape accumulators
+        if self._acc is None:
+            self._acc_placements = tuple(self._acc_shardings())
+            self._acc = []
+            for p, sh in zip(self.params, self._acc_placements):
+                if sh is None:
+                    self._acc.append(jnp.zeros(p.shape, jnp.float32))
+                else:
+                    shape = (sh.pad_to,) if sh.flat else tuple(p.shape)
+                    self._acc.append(jax.device_put(
+                        jnp.zeros(shape, jnp.float32), sh.sharding))
+        placements = self._acc_placements
         key = (static_key, layout, treedef,
-               tuple((tuple(v.shape), str(v.dtype)) for v in dyn))
+               tuple((tuple(v.shape), str(v.dtype)) for v in dyn), placements)
 
         if key not in self._grad_cache:
             loss_of_full = _make_loss_of(self.model, self.loss_fn, self.params,
@@ -445,7 +479,7 @@ class TrainStep:
             # ZeRO-2 (sharding wrapper): persistent fp32 accumulators live
             # sharded at 1/N per device; constraining each microstep's grad to
             # that placement reduce-scatters it straight into the shard
-            acc_shardings = self._acc_shardings()
+            acc_shardings = placements
 
             def grad_fn(param_vals, acc_vals, buf_vals, frozen_vals, rng_key,
                         dyn_vals):
@@ -459,7 +493,12 @@ class TrainStep:
                 for a, g, sh in zip(acc_vals, grads, acc_shardings):
                     g = g.astype(jnp.float32)
                     if sh is not None:
-                        g = jax.lax.with_sharding_constraint(g, sh)
+                        if sh.flat:
+                            # flat-pad storage: accumulate in the 1-D padded
+                            # stored form so the buffer shards at 1/N
+                            g = jnp.pad(jnp.ravel(g),
+                                        (0, sh.pad_to - g.size))
+                        g = jax.lax.with_sharding_constraint(g, sh.sharding)
                     new_acc.append(a + g)
                 return loss_val, new_acc, new_bufs
 
@@ -467,18 +506,29 @@ class TrainStep:
             self._grad_cache[key] = jax.jit(grad_fn, donate_argnums=(1,))
 
         from ..core.flags import flag_value
-        update_key = bool(flag_value("use_fused_adamw"))
+        update_key = (bool(flag_value("use_fused_adamw")), placements)
         if self._update_fn is None or getattr(self, "_update_key", None) \
                 != update_key:
             self._update_key = update_key
             decay_flags = tuple(bool(opt._decay_mask(p)) for p in self.params)
             K = self.accumulate_steps
+            shapes = tuple(tuple(p.shape) for p in self.params)
 
             def update_fn(param_vals, slot_vals, acc_vals, lr, step_i):
                 # keep the fp32 mean — both the generic multi-precision path
                 # and the fused kernel upcast anyway, so downcasting here
-                # would only discard the accumulated precision
-                grads = [a / K for a in acc_vals]
+                # would only discard the accumulated precision. Flat-stored
+                # accumulators are restored to the param's shape first:
+                # apply_updates resolves its own plans and must never be
+                # handed grads in a storage form those plans didn't choose.
+                grads = []
+                for a, sh, shp in zip(acc_vals, placements, shapes):
+                    if sh is not None and sh.flat:
+                        size = 1
+                        for s in shp:
+                            size *= s
+                        a = jnp.reshape(a[:size], shp)
+                    grads.append(a / K)
                 return opt.apply_updates(param_vals, grads, slot_vals, lr,
                                          step_i, decay_flags)
 
@@ -486,11 +536,6 @@ class TrainStep:
             self._update_fn = jax.jit(update_fn, donate_argnums=donate)
 
         param_vals = read_values(self.params)
-        if self._acc is None:
-            self._acc = []
-            for z, sh in zip((jnp.zeros(p.shape, jnp.float32)
-                              for p in self.params), self._acc_shardings()):
-                self._acc.append(jax.device_put(z, sh) if sh is not None else z)
         buf_vals = read_values(self.buffers)
         frozen_vals = read_values(self.frozen)
         rng_key = _random.next_key()

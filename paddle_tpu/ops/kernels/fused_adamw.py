@@ -98,17 +98,11 @@ def _fused_adamw_2d(scalars, g, m, v, mw, *, beta1, beta2, eps, out_dtype):
     )(scalars, g, m, v, mw)
 
 
-def fused_adamw_update(p_low, g, m, v, master, lr, step, *, beta1=0.9,
-                       beta2=0.999, eps=1e-8, weight_decay=0.0,
-                       apply_decay=True):
-    """One fused AdamW step for a low-precision param with fp32 master/moments.
-
-    Returns (new_p_low, new_m, new_v, new_master), or None when the shape
-    cannot be tiled within the VMEM budget (caller falls back to the generic
-    XLA update). All tensors keep their logical shape; internally flattened
-    to 2-D blocks.
-    """
-    shape = m.shape
+def _tile_plan(shape):
+    """(rows, cols) 2-D factorization for the kernel, or None when the shape
+    cannot be tiled within the VMEM budget. Pure shape computation — callers
+    (incl. the shard_map wrapper) can pre-flight before committing to the
+    pallas path."""
     n = int(np.prod(shape)) if shape else 1
     # factor into (rows, cols) with cols a multiple of 128 when possible
     if len(shape) >= 2:
@@ -120,15 +114,6 @@ def fused_adamw_update(p_low, g, m, v, master, lr, step, *, beta1=0.9,
             cols //= 2
         cols = max(cols, 1)
         rows = n // cols
-    stepf = step.astype(jnp.float32)
-    bc1 = 1.0 - jnp.power(beta1, stepf)
-    bc2 = 1.0 - jnp.power(beta2, stepf)
-    lr32 = lr.astype(jnp.float32)
-    wd = lr32 * weight_decay if (weight_decay and apply_decay) else \
-        jnp.zeros((), jnp.float32)
-    scalars = jnp.stack([lr32 / bc1, 1.0 / jnp.sqrt(bc2), wd]) \
-        .astype(jnp.float32).reshape(1, 3)
-
     if rows * cols != n or (rows % 8 != 0 and rows != 1):
         # odd leading dim: try to refactor n into tileable (rows, cols)
         cols = 1
@@ -148,6 +133,33 @@ def fused_adamw_update(p_low, g, m, v, master, lr, step, *, beta1=0.9,
     br = _pick_block(rows, cols)
     if br * cols > (4 * 1024 * 1024) // (9 * 4):
         return None
+    return rows, cols
+
+
+def fused_adamw_update(p_low, g, m, v, master, lr, step, *, beta1=0.9,
+                       beta2=0.999, eps=1e-8, weight_decay=0.0,
+                       apply_decay=True):
+    """One fused AdamW step for a low-precision param with fp32 master/moments.
+
+    Returns (new_p_low, new_m, new_v, new_master), or None when the shape
+    cannot be tiled within the VMEM budget (caller falls back to the generic
+    XLA update). All tensors keep their logical shape; internally flattened
+    to 2-D blocks.
+    """
+    shape = m.shape
+    plan = _tile_plan(shape)
+    if plan is None:
+        return None
+    rows, cols = plan
+    stepf = step.astype(jnp.float32)
+    bc1 = 1.0 - jnp.power(beta1, stepf)
+    bc2 = 1.0 - jnp.power(beta2, stepf)
+    lr32 = lr.astype(jnp.float32)
+    wd = lr32 * weight_decay if (weight_decay and apply_decay) else \
+        jnp.zeros((), jnp.float32)
+    scalars = jnp.stack([lr32 / bc1, 1.0 / jnp.sqrt(bc2), wd]) \
+        .astype(jnp.float32).reshape(1, 3)
+
     g2 = g.reshape(rows, cols)
     m2 = m.reshape(rows, cols)
     v2 = v.reshape(rows, cols)
@@ -157,3 +169,48 @@ def fused_adamw_update(p_low, g, m, v, master, lr, step, *, beta1=0.9,
         out_dtype=p_low.dtype)
     return (np_low.reshape(shape), nm.reshape(shape), nv.reshape(shape),
             nmw.reshape(shape))
+
+
+def _local_shape(mesh, spec, shape):
+    """Per-device shape of `shape` stored as PartitionSpec `spec`, or None if
+    a sharded dim doesn't divide (caller falls back to the XLA update)."""
+    spec_t = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    local = list(shape)
+    for d, ax in enumerate(spec_t):
+        if ax is None:
+            continue
+        n = 1
+        for a in (ax if isinstance(ax, tuple) else (ax,)):
+            n *= mesh.shape[a]
+        if local[d] % n:
+            return None
+        local[d] //= n
+    return tuple(local)
+
+
+def fused_adamw_update_sharded(mesh, spec, p_low, g, m, v, master, lr, step,
+                               **kw):
+    """Fused AdamW over SHARDED state: each device runs the single-pass pallas
+    kernel on its local shard via shard_map (the update is elementwise, so no
+    communication is needed inside). This is what lets ZeRO keep the fused
+    optimizer — GSPMD can't partition a pallas_call, but it doesn't have to.
+
+    Returns (new_p_low, new_m, new_v, new_master) or None when the local
+    shard isn't tileable (caller falls back to the generic XLA update).
+    Reference analog: the sharded fused update in
+    fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py:54.
+    """
+    local = _local_shape(mesh, spec, tuple(m.shape))
+    if local is None or _tile_plan(local) is None:
+        return None
+    from jax.sharding import PartitionSpec
+    ps = PartitionSpec(*(tuple(spec) + (None,) * (m.ndim - len(tuple(spec)))))
+    rep = PartitionSpec()
+
+    def local_update(p_l, g_l, m_l, v_l, mw_l, lr_s, step_s):
+        return fused_adamw_update(p_l, g_l, m_l, v_l, mw_l, lr_s, step_s, **kw)
+
+    f = jax.shard_map(local_update, mesh=mesh,
+                      in_specs=(ps, ps, ps, ps, ps, rep, rep),
+                      out_specs=(ps, ps, ps, ps), check_vma=False)
+    return f(p_low, g, m, v, master, jnp.asarray(lr), jnp.asarray(step))

@@ -79,19 +79,26 @@ class Optimizer:
         self._learning_rate = float(value)
 
     # -- pure tree update (shared by eager + jit paths) -----------------------
-    def apply_updates(self, vals, grads, slots, lr, step, decay_flags):
-        """Pure: lists of arrays -> (new_vals, new_slots). Used under jit."""
+    def apply_updates(self, vals, grads, slots, lr, step, decay_flags,
+                      fused_ctx=None):
+        """Pure: lists of arrays -> (new_vals, new_slots). Used under jit.
+
+        ``fused_ctx`` (optional, aligned with vals): per-param context for the
+        fused kernel — None for the default whole-array path, or
+        ``(mesh, spec)`` to run it shard_map-wise on sharded state (set by the
+        ZeRO wrapper; replaces any process-global flag toggling)."""
         if self._grad_clip is not None:
             grads = self._grad_clip.apply(vals, grads)
         fused = getattr(self, "_apply_fused", None)
         new_vals, new_slots = [], []
-        for p, g, s, dm in zip(vals, grads, slots, decay_flags):
+        for i, (p, g, s, dm) in enumerate(zip(vals, grads, slots, decay_flags)):
             if g is None:
                 new_vals.append(p)
                 new_slots.append(s)
                 continue
             if fused is not None and s.get("master_weight") is not None:
-                out = fused(p, g, s, lr, step, dm)
+                ctx = fused_ctx[i] if fused_ctx is not None else None
+                out = fused(p, g, s, lr, step, dm, shard_ctx=ctx)
                 if out is not None:
                     new_vals.append(out[0])
                     new_slots.append(out[1])
@@ -280,11 +287,12 @@ class Adam(Optimizer):
         update = (m / bc1) / denom
         return p - lr.astype(p.dtype) * update, ns
 
-    def _apply_fused(self, p, g, slots, lr, step, decay_mask):
+    def _apply_fused(self, p, g, slots, lr, step, decay_mask, shard_ctx=None):
         """Single-pass Pallas update for the multi-precision path (the
         reference's fused_adam/multi_tensor analog). Covers plain Adam with
         no coupled decay and AdamW's decoupled decay; anything else falls
-        back to the generic chain."""
+        back to the generic chain. With ``shard_ctx=(mesh, spec)`` the kernel
+        runs shard_map-wise on each device's local shard (ZeRO state)."""
         if self._amsgrad or (self._wd and not self._decoupled_wd):
             return None
         from ..core.flags import flag_value
@@ -292,12 +300,20 @@ class Adam(Optimizer):
             return None
         if slots["moment1"].dtype != jnp.float32:
             return None  # the Pallas kernel assumes fp32 moments
-        from ..ops.kernels.fused_adamw import fused_adamw_update
-        out = fused_adamw_update(
-            p, g, slots["moment1"], slots["moment2"], slots["master_weight"],
-            lr, step, beta1=self._beta1, beta2=self._beta2, eps=self._eps,
-            weight_decay=self._wd if self._decoupled_wd else 0.0,
-            apply_decay=bool(decay_mask))
+        kw = dict(beta1=self._beta1, beta2=self._beta2, eps=self._eps,
+                  weight_decay=self._wd if self._decoupled_wd else 0.0,
+                  apply_decay=bool(decay_mask))
+        if shard_ctx is not None:
+            from ..ops.kernels.fused_adamw import fused_adamw_update_sharded
+            mesh, spec = shard_ctx
+            out = fused_adamw_update_sharded(
+                mesh, spec, p, g, slots["moment1"], slots["moment2"],
+                slots["master_weight"], lr, step, **kw)
+        else:
+            from ..ops.kernels.fused_adamw import fused_adamw_update
+            out = fused_adamw_update(
+                p, g, slots["moment1"], slots["moment2"],
+                slots["master_weight"], lr, step, **kw)
         if out is None:  # untileable shape — generic path
             return None
         new_p, nm, nv, nmw = out

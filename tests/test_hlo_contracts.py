@@ -174,7 +174,10 @@ def test_stage2_sharded_grad_accumulators():
     n_sharded = 0
     for a, p in zip(step._acc, step.params):
         if ODD in tuple(p.shape):
-            continue  # flat-plan params keep replicated accumulators
+            # flat-plan params accumulate in the flat-padded stored form,
+            # sharded 1/N like their slots
+            assert a.ndim == 1 and a.shape[0] % N_DEV == 0, \
+                f"flat accumulator for {p.name} not flat-pad stored: {a.shape}"
         shard = next(iter(a.addressable_shards)).data
         assert shard.size == a.size // N_DEV, \
             f"accumulator for {p.name} not sharded: {a.shape}->{shard.shape}"
@@ -298,3 +301,48 @@ def test_plain_optimizer_step_uses_sharded_update():
     slots = inner._slots[id(w)]
     shard = next(iter(slots["moment1"].addressable_shards)).data
     assert shard.size == slots["moment1"].size // N_DEV
+
+
+def build_bf16(level):
+    """bf16 params -> multi_precision master weights -> fused-kernel path."""
+    fleet_state.set_hcg(None)
+    fleet_state.set_strategy(None)
+    paddle.seed(0)
+    model = Net()
+    for p in model.parameters():
+        p._value = p._value.astype(jnp.bfloat16)
+    opt = opt_mod.AdamW(learning_rate=1e-2, parameters=model.parameters(),
+                        weight_decay=0.01)
+    if level is not None:
+        model, opt, _ = dist.group_sharded_parallel(model, opt, level)
+    return model, opt, TrainStep(model, loss_fn, opt)
+
+
+def test_fused_adamw_under_zero2():
+    """The fused Pallas update must run shard_map-wise on ZeRO-sharded state
+    (VERDICT r2 #8): parity with the unsharded fused run AND 1/N slots."""
+    from paddle_tpu.ops.kernels.fused_adamw import _local_shape, _tile_plan
+    _, _, base_step = build_bf16(None)
+    base = run_steps(base_step, n=4)
+    _, opt, step = build_bf16("os_g")
+    got = run_steps(step, n=4)
+    np.testing.assert_allclose(got, base, rtol=3e-3, atol=3e-4)
+    for p in step.params:
+        for k, v in opt._slots[id(p)].items():
+            if not isinstance(v, jax.Array) or not v.shape:
+                continue
+            shard = next(iter(v.addressable_shards)).data
+            assert shard.size == v.size // N_DEV, \
+                f"slot {k} of {p.name} not 1/N under fused update: {v.shape}"
+    # the shard ctx for a representative param is genuinely viable (the
+    # pallas kernel accepts the LOCAL shape) — i.e. the path didn't just
+    # fall back to the generic XLA update
+    mesh = opt._mesh()
+    entry = opt._plan_by_id[id(step.params[0])]
+    plan = entry[0]
+    assert plan is not None
+    local = _local_shape(mesh, plan.spec,
+                         (plan.pad_to,) if plan.flat
+                         else tuple(step.params[0].shape))
+    assert local is not None and _tile_plan(local) is not None, \
+        "fused shard ctx not viable — sharded fused path never exercised"

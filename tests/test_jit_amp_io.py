@@ -425,3 +425,83 @@ def test_to_static_traceable_compiles_once():
         out = g(a)
     assert traces["n"] == 1
     np.testing.assert_allclose(out.numpy(), 3 * np.ones(4))
+
+
+def test_freeze_rejects_stateful_bound_methods():
+    """Advisor finding: a bound method exposes the underlying function's
+    __code__/__closure__, so two instances with different state would share a
+    freeze token. Stateful __self__ must make the callable unfreezable."""
+    from paddle_tpu.core.tensor import _freeze, _Unfreezable
+
+    class Scaler:
+        def __init__(self, k):
+            self.k = k
+
+        def apply(self, x):
+            return x * self.k
+
+    import pytest as _pytest
+    with _pytest.raises(_Unfreezable):
+        _freeze(Scaler(2).apply)
+
+    # plain functions with primitive closures still freeze, and two
+    # closures over different values get different tokens
+    def make(k):
+        def f(x):
+            return x * k
+        return f
+
+    assert _freeze(make(2)) != _freeze(make(3))
+    assert _freeze(make(2)) == _freeze(make(2))
+
+
+def test_freeze_keys_module_callables_by_name_not_id():
+    """Module-level jax/numpy callables key by (module, qualname) — stable
+    and un-recyclable, unlike id(). Dynamically created numpy callable
+    objects (np.vectorize) must NOT freeze: their identity is per-instance."""
+    import pytest as _pytest
+    from paddle_tpu.core.tensor import _freeze, _Unfreezable
+    tok = _freeze(np.add)
+    assert tok[0] == "G" and not any(isinstance(t, int) for t in tok[1:])
+    assert _freeze(np.add) == tok
+    with _pytest.raises(_Unfreezable):
+        _freeze(np.vectorize(lambda x: x))
+
+
+def test_to_static_nan_guard_matches_itself():
+    """Advisor finding: exact float equality made a NaN guard re-profile
+    every call until the cap, then fall back to plain eager."""
+    import warnings
+    from paddle_tpu.jit import to_static
+
+    traces = []
+
+    @to_static
+    def f(x):
+        traces.append(1)
+        s = float(x.sum())          # guard scalar — NaN for this input
+        if s != s:
+            return x * 0.0
+        return x + 1.0
+
+    bad = paddle.to_tensor(np.array([np.nan, 1.0], np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for _ in range(6):
+            out = f(bad).numpy()
+            np.testing.assert_allclose(out, [np.nan, 0.0], equal_nan=True)
+    spec = next(iter(f._cache.values()))
+    assert not spec.failed, "NaN guard hit the profile cap and went eager"
+    assert len(spec.programs) == 1, "NaN guard compiled duplicate programs"
+    # steady state: profiling trace + jit trace(s), NOT one per call
+    assert len(traces) <= 3, f"NaN guard re-profiled every call: {len(traces)}"
+
+    # alternating NaN/finite profiles: the programs DICT lookups must also
+    # be NaN-safe (review finding) — exactly two programs, never the cap
+    good = paddle.to_tensor(np.array([2.0, 1.0], np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for i in range(12):
+            f(bad if i % 2 == 0 else good)
+    assert len(spec.programs) == 2 and not spec.failed, \
+        f"alternating NaN profile recompiled: {len(spec.programs)} programs"
