@@ -438,13 +438,22 @@ def disable_signal_handler():
 
 
 class LazyGuard:
-    """Parity shim: parameters here are created eagerly but cheaply (jax
-    arrays materialize on first use)."""
+    """Deferred parameter initialization (reference: python/paddle/base —
+    LazyGuard / lazy_init). Under the guard, ``create_parameter`` produces
+    ABSTRACT values (``jax.ShapeDtypeStruct``) and records the initializer;
+    ``param.initialize()`` / ``layer.materialize()`` runs it later. An
+    abstract model costs no host memory, which is what lets the full
+    Llama-2-7B hybrid train step be AOT-compiled and memory-checked on a
+    virtual mesh (tests/test_7b_scale.py) without a pod."""
 
     def __enter__(self):
+        from .nn.layer_base import _LAZY_INIT
+        _LAZY_INIT.depth += 1
         return self
 
     def __exit__(self, *exc):
+        from .nn.layer_base import _LAZY_INIT
+        _LAZY_INIT.depth -= 1
         return False
 
 

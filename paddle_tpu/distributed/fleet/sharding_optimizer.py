@@ -98,7 +98,14 @@ def _plan_for(mesh, axis, shape, existing=None):
 
 
 def _to_stored(plan, mesh, v):
-    """Eager transform of a slot array into its sharded stored form."""
+    """Eager transform of a slot array into its sharded stored form.
+    Abstract (ShapeDtypeStruct) slots — from a LazyGuard model under AOT
+    planning — get the same stored shape/placement without materializing."""
+    if isinstance(v, jax.ShapeDtypeStruct):
+        shape = (plan.pad_to,) if plan.flat else tuple(v.shape)
+        sharding = (None if all(s is None for s in plan.spec)
+                    else NamedSharding(mesh, plan.spec))
+        return jax.ShapeDtypeStruct(shape, v.dtype, sharding=sharding)
     if plan.flat:
         flat = jnp.ravel(v)
         flat = jnp.pad(flat, (0, plan.pad_to - flat.shape[0]))
@@ -162,7 +169,8 @@ class DygraphShardingOptimizer:
             self._remember_plan(p, plan)
             slots = inner._slots[id(p)]
             for k, v in list(slots.items()):
-                if not (isinstance(v, jax.Array) and v.shape):
+                if not (isinstance(v, (jax.Array, jax.ShapeDtypeStruct))
+                        and v.shape):
                     continue
                 if plan.flat:
                     if v.shape != (plan.pad_to,):

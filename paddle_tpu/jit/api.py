@@ -400,25 +400,8 @@ class TrainStep:
                bool(flag_value("use_fused_adamw")))
 
         if key not in self._cache:
-            decay_flags = tuple(bool(opt._decay_mask(p)) for p in self.params)
-            loss_of_full = _make_loss_of(self.model, self.loss_fn, self.params,
-                                         self.frozen, self.buffers, static_key,
-                                         layout, treedef)
-
-            def step_fn(param_vals, slot_vals, buf_vals, frozen_vals, lr, step_i,
-                        rng_key, dyn_vals):
-                def loss_of(pv):
-                    return loss_of_full(pv, frozen_vals, buf_vals, rng_key,
-                                        dyn_vals)
-
-                (loss_val, new_bufs), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(param_vals)
-                new_pv, new_slots = opt.apply_updates(
-                    param_vals, grads, slot_vals, lr, step_i, decay_flags)
-                return loss_val, new_pv, new_slots, new_bufs
-
-            donate = (0, 1, 2) if self.donate else ()
-            self._cache[key] = jax.jit(step_fn, donate_argnums=donate)
+            self._cache[key] = self._build_step_jit(static_key, layout,
+                                                    treedef)
 
         param_vals = read_values(self.params)
         slot_vals = [opt._slots[id(p)] for p in self.params]
@@ -438,6 +421,161 @@ class TrainStep:
         for b, nv in zip(self.buffers, new_bufs):
             b._value = nv
         return Tensor(loss_val)
+
+    def _build_step_jit(self, static_key, layout, treedef):
+        """The fused fwd+bwd+update program for one batch signature."""
+        opt = self.optimizer
+        decay_flags = tuple(bool(opt._decay_mask(p)) for p in self.params)
+        loss_of_full = _make_loss_of(self.model, self.loss_fn, self.params,
+                                     self.frozen, self.buffers, static_key,
+                                     layout, treedef)
+
+        def step_fn(param_vals, slot_vals, buf_vals, frozen_vals, lr, step_i,
+                    rng_key, dyn_vals):
+            def loss_of(pv):
+                return loss_of_full(pv, frozen_vals, buf_vals, rng_key,
+                                    dyn_vals)
+
+            (loss_val, new_bufs), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals)
+            new_pv, new_slots = opt.apply_updates(
+                param_vals, grads, slot_vals, lr, step_i, decay_flags)
+            return loss_val, new_pv, new_slots, new_bufs
+
+        donate = (0, 1, 2) if self.donate else ()
+        return jax.jit(step_fn, donate_argnums=donate)
+
+    def aot_compile(self, *batch):
+        """AOT-compile the train step program(s) WITHOUT executing them.
+
+        Works on a LazyGuard-abstract model: parameter, slot, and batch
+        leaves may be ``jax.ShapeDtypeStruct``s (with shardings attached), so
+        a model too large to materialize on one host can still be compiled,
+        partitioned, and memory-checked on a virtual mesh.
+
+        Returns the jax ``Compiled`` object (``memory_analysis()``,
+        ``as_text()``) for the fused single-step program; with
+        ``accumulate_steps > 1`` returns ``(microstep, update)`` Compileds —
+        the microstep's arguments include the persistent fp32 accumulators
+        and the update's include the optimizer slots, so a memory verdict
+        must consider both. Reference analog: the static executor's
+        build-program + memory planning pass, run compile-only."""
+        import jax.tree_util as jtu
+        opt = self.optimizer
+        # the documented contract admits bare ShapeDtypeStruct batch leaves;
+        # _split_leaves would classify those as static — wrap them as Tensors
+        batch = jtu.tree_map(
+            lambda x: Tensor(x) if isinstance(x, jax.ShapeDtypeStruct) else x,
+            batch, is_leaf=lambda x: isinstance(x, (Tensor,
+                                                    jax.ShapeDtypeStruct)))
+        dyn, static_key, layout, treedef = _split_leaves(batch)
+        param_vals = read_values(self.params)
+        buf_vals = read_values(self.buffers)
+        frozen_vals = read_values(self.frozen)
+        rng_key = jax.eval_shape(lambda: jax.random.key(0))
+
+        if self.accumulate_steps > 1:
+            placements = tuple(self._acc_shardings())
+            acc_avals = self._acc_avals(placements)
+            grad_jit = self._build_grad_jit(static_key, layout, treedef,
+                                            placements)
+            grad_compiled = grad_jit.lower(param_vals, acc_avals, buf_vals,
+                                           frozen_vals, rng_key,
+                                           dyn).compile()
+            slot_vals = [opt._slots[id(p)] for p in self.params]
+            update_jit = self._build_update_jit(placements)
+            update_compiled = update_jit.lower(
+                param_vals, slot_vals, acc_avals,
+                jnp.asarray(0.0, jnp.float32),
+                jnp.asarray(1, jnp.int32)).compile()
+            return grad_compiled, update_compiled
+
+        jitted = self._build_step_jit(static_key, layout, treedef)
+        # share the jit with __call__'s cache: a later real step with the
+        # same signature reuses this trace instead of recompiling
+        from ..core.flags import flag_value
+        key = (static_key, layout, treedef,
+               tuple((tuple(v.shape), str(v.dtype)) for v in dyn),
+               bool(flag_value("use_fused_adamw")))
+        self._cache.setdefault(key, jitted)
+        slot_vals = [opt._slots[id(p)] for p in self.params]
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        step_i = jnp.asarray(1, jnp.int32)
+        return jitted.lower(param_vals, slot_vals, buf_vals, frozen_vals,
+                            lr, step_i, rng_key, dyn).compile()
+
+    def _build_grad_jit(self, static_key, layout, treedef, placements):
+        """The accumulation MICROSTEP program: fwd+bwd, grads added into the
+        persistent fp32 accumulators (ZeRO-2: constrained into 1/N shards,
+        reduce-scattering the dp reduction straight into the shard)."""
+        loss_of_full = _make_loss_of(self.model, self.loss_fn, self.params,
+                                     self.frozen, self.buffers, static_key,
+                                     layout, treedef)
+        acc_shardings = placements
+
+        def grad_fn(param_vals, acc_vals, buf_vals, frozen_vals, rng_key,
+                    dyn_vals):
+            def loss_of(pv):
+                return loss_of_full(pv, frozen_vals, buf_vals, rng_key,
+                                    dyn_vals)
+
+            (loss_val, new_bufs), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals)
+            new_acc = []
+            for a, g, sh in zip(acc_vals, grads, acc_shardings):
+                g = g.astype(jnp.float32)
+                if sh is not None:
+                    if sh.flat:
+                        # flat-pad storage: accumulate in the 1-D padded
+                        # stored form so the buffer shards at 1/N
+                        g = jnp.pad(jnp.ravel(g), (0, sh.pad_to - g.size))
+                    g = jax.lax.with_sharding_constraint(g, sh.sharding)
+                new_acc.append(a + g)
+            return loss_val, new_acc, new_bufs
+
+        # acc buffers are internal (never user-visible) — always donated
+        return jax.jit(grad_fn, donate_argnums=(1,))
+
+    def _build_update_jit(self, placements):
+        """The accumulation-boundary UPDATE program: optimizer step on the
+        accumulated mean gradient."""
+        opt = self.optimizer
+        decay_flags = tuple(bool(opt._decay_mask(p)) for p in self.params)
+        K = self.accumulate_steps
+        shapes = tuple(tuple(p.shape) for p in self.params)
+
+        def update_fn(param_vals, slot_vals, acc_vals, lr, step_i):
+            # keep the fp32 mean — both the generic multi-precision path
+            # and the fused kernel upcast anyway, so downcasting here
+            # would only discard the accumulated precision. Flat-stored
+            # accumulators are restored to the param's shape first:
+            # apply_updates resolves its own plans and must never be
+            # handed grads in a storage form those plans didn't choose.
+            grads = []
+            for a, sh, shp in zip(acc_vals, placements, shapes):
+                if sh is not None and sh.flat:
+                    size = 1
+                    for s in shp:
+                        size *= s
+                    a = jnp.reshape(a[:size], shp)
+                grads.append(a / K)
+            return opt.apply_updates(param_vals, grads, slot_vals, lr,
+                                     step_i, decay_flags)
+
+        donate = (0, 1, 2) if self.donate else (2,)
+        return jax.jit(update_fn, donate_argnums=donate)
+
+    def _acc_avals(self, placements):
+        """Abstract accumulator buffers matching ``placements``."""
+        out = []
+        for p, sh in zip(self.params, placements):
+            if sh is None:
+                out.append(jax.ShapeDtypeStruct(tuple(p.shape), jnp.float32))
+            else:
+                shape = (sh.pad_to,) if sh.flat else tuple(p.shape)
+                out.append(jax.ShapeDtypeStruct(shape, jnp.float32,
+                                                sharding=sh.sharding))
+        return out
 
     def _acc_shardings(self):
         """Per-param AccPlacement for grad accumulators, from a ZeRO-2+
@@ -473,67 +611,15 @@ class TrainStep:
                tuple((tuple(v.shape), str(v.dtype)) for v in dyn), placements)
 
         if key not in self._grad_cache:
-            loss_of_full = _make_loss_of(self.model, self.loss_fn, self.params,
-                                         self.frozen, self.buffers, static_key,
-                                         layout, treedef)
-            # ZeRO-2 (sharding wrapper): persistent fp32 accumulators live
-            # sharded at 1/N per device; constraining each microstep's grad to
-            # that placement reduce-scatters it straight into the shard
-            acc_shardings = placements
-
-            def grad_fn(param_vals, acc_vals, buf_vals, frozen_vals, rng_key,
-                        dyn_vals):
-                def loss_of(pv):
-                    return loss_of_full(pv, frozen_vals, buf_vals, rng_key,
-                                        dyn_vals)
-
-                (loss_val, new_bufs), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(param_vals)
-                new_acc = []
-                for a, g, sh in zip(acc_vals, grads, acc_shardings):
-                    g = g.astype(jnp.float32)
-                    if sh is not None:
-                        if sh.flat:
-                            # flat-pad storage: accumulate in the 1-D padded
-                            # stored form so the buffer shards at 1/N
-                            g = jnp.pad(jnp.ravel(g),
-                                        (0, sh.pad_to - g.size))
-                        g = jax.lax.with_sharding_constraint(g, sh.sharding)
-                    new_acc.append(a + g)
-                return loss_val, new_acc, new_bufs
-
-            # acc buffers are internal (never user-visible) — always donated
-            self._grad_cache[key] = jax.jit(grad_fn, donate_argnums=(1,))
+            self._grad_cache[key] = self._build_grad_jit(
+                static_key, layout, treedef, placements)
 
         from ..core.flags import flag_value
         update_key = (bool(flag_value("use_fused_adamw")), placements)
         if self._update_fn is None or getattr(self, "_update_key", None) \
                 != update_key:
             self._update_key = update_key
-            decay_flags = tuple(bool(opt._decay_mask(p)) for p in self.params)
-            K = self.accumulate_steps
-            shapes = tuple(tuple(p.shape) for p in self.params)
-
-            def update_fn(param_vals, slot_vals, acc_vals, lr, step_i):
-                # keep the fp32 mean — both the generic multi-precision path
-                # and the fused kernel upcast anyway, so downcasting here
-                # would only discard the accumulated precision. Flat-stored
-                # accumulators are restored to the param's shape first:
-                # apply_updates resolves its own plans and must never be
-                # handed grads in a storage form those plans didn't choose.
-                grads = []
-                for a, sh, shp in zip(acc_vals, placements, shapes):
-                    if sh is not None and sh.flat:
-                        size = 1
-                        for s in shp:
-                            size *= s
-                        a = jnp.reshape(a[:size], shp)
-                    grads.append(a / K)
-                return opt.apply_updates(param_vals, grads, slot_vals, lr,
-                                         step_i, decay_flags)
-
-            donate = (0, 1, 2) if self.donate else (2,)
-            self._update_fn = jax.jit(update_fn, donate_argnums=donate)
+            self._update_fn = self._build_update_jit(placements)
 
         param_vals = read_values(self.params)
         buf_vals = read_values(self.buffers)

@@ -20,6 +20,28 @@ from ..core.tensor import Tensor
 _GLOBAL_WEIGHT_INIT = None
 _GLOBAL_BIAS_INIT = None
 
+#: nesting depth of active LazyGuard scopes (reference:
+#: python/paddle/base/core LazyGuard / lazy_init) — under a guard,
+#: create_parameter produces ABSTRACT values (jax.ShapeDtypeStruct) and
+#: records the initializer for later materialization. An abstract model
+#: costs no memory: the basis for AOT memory/sharding planning at scales
+#: that cannot materialize on one host (tests/test_7b_scale.py).
+#: Thread-local (like core.tensor's mode state): a guard on one thread must
+#: not leak abstract params into layers built concurrently on another.
+import threading as _threading
+
+
+class _LazyState(_threading.local):
+    def __init__(self):
+        self.depth = 0
+
+
+_LAZY_INIT = _LazyState()
+
+
+def lazy_init_active() -> bool:
+    return _LAZY_INIT.depth > 0
+
 
 class Parameter(Tensor):
     """Trainable tensor (stop_gradient=False by default, optimizer-visible)."""
@@ -35,6 +57,23 @@ class Parameter(Tensor):
     @trainable.setter
     def trainable(self, v):
         self.stop_gradient = not v
+
+    def initialize(self):
+        """Materialize a LazyGuard-created parameter by running its recorded
+        initializer. No-op for already-materialized parameters. Honors dtype
+        rewrites applied while abstract (e.g. ``layer.bfloat16()``) and any
+        sharding assigned to the abstract value (materializes placed)."""
+        spec = self.__dict__.pop("_lazy_init", None)
+        if spec is not None:
+            init, shape, _ = spec
+            sharding = getattr(self._value, "sharding", None)
+            value = init(shape, str(np.dtype(self._value.dtype)))
+            value = value._value if isinstance(value, Tensor) else value
+            if sharding is not None:
+                import jax
+                value = jax.device_put(value, sharding)
+            self._value = value
+        return self
 
     def __repr__(self):
         return "Parameter " + super().__repr__()
@@ -133,6 +172,13 @@ class Layer:
                 init = _GLOBAL_BIAS_INIT or Constant(0.0)
             else:
                 init = _GLOBAL_WEIGHT_INIT or XavierNormal()
+        if _LAZY_INIT.depth:
+            import jax
+            value = jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                         jnp.dtype(dtype))
+            p = Parameter(value, trainable=attr.trainable, name=attr.name)
+            p._lazy_init = (init, [int(s) for s in shape], dtype)
+            return p
         value = init(shape, dtype)
         p = Parameter(value, trainable=attr.trainable, name=attr.name)
         return p
@@ -277,14 +323,30 @@ class Layer:
         return self
 
     def _cast_to(self, d):
+        import jax
         for l in self.sublayers(include_self=True):
             object.__setattr__(l, "_dtype", d)
         for p in self.parameters():
             if jnp.issubdtype(p._value.dtype, jnp.floating):
-                p._value = p._value.astype(d)
+                if isinstance(p._value, jax.ShapeDtypeStruct):
+                    # abstract (LazyGuard) param: rewrite the aval dtype;
+                    # initialize() materializes at the rewritten dtype
+                    p._value = jax.ShapeDtypeStruct(
+                        p._value.shape, jnp.dtype(d),
+                        sharding=p._value.sharding)
+                else:
+                    p._value = p._value.astype(d)
         for b in self.buffers():
             if b is not None and jnp.issubdtype(b._value.dtype, jnp.floating):
                 b._value = b._value.astype(d)
+
+    def materialize(self):
+        """Run the recorded initializers of every LazyGuard-created (abstract)
+        parameter in this layer tree. Returns self."""
+        for p in self.parameters():
+            if hasattr(p, "initialize"):
+                p.initialize()
+        return self
 
     def float(self):
         return self.astype(dtypes.float32)

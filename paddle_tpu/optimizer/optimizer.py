@@ -128,12 +128,24 @@ class Optimizer:
         for p in params:
             if id(p) not in self._slots:
                 v = p._value
-                s = self._init_slots(
-                    v.astype(jnp.float32) if (self._multi_precision and
-                                              _is_low_precision(v.dtype)) else v)
-                if self._multi_precision and _is_low_precision(v.dtype):
-                    s["master_weight"] = v.astype(jnp.float32)
-                self._slots[id(p)] = s
+
+                def build(v):
+                    s = self._init_slots(
+                        v.astype(jnp.float32)
+                        if (self._multi_precision and
+                            _is_low_precision(v.dtype)) else v)
+                    if self._multi_precision and _is_low_precision(v.dtype):
+                        s["master_weight"] = v.astype(jnp.float32)
+                    return s
+
+                if isinstance(v, jax.ShapeDtypeStruct):
+                    # LazyGuard-abstract param: slots stay abstract too (the
+                    # same _init_slots logic, evaluated shape-only) — enables
+                    # AOT compile/memory planning of the full train step
+                    # without materializing optimizer state
+                    self._slots[id(p)] = jax.eval_shape(build, v)
+                else:
+                    self._slots[id(p)] = build(v)
 
     @no_grad()
     def step(self):

