@@ -39,7 +39,7 @@ from .fleet_dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from . import io  # noqa: F401
 from .store import TCPStore, create_or_get_global_tcp_store  # noqa: F401
-from .parallel import DataParallel  # noqa: F401
+from .parallel import DataParallel, shard_local_batch  # noqa: F401
 from . import fleet  # noqa: F401
 from .fleet.base_api import (  # noqa: F401
     Fleet, UtilBase, Role, UserDefinedRoleMaker, PaddleCloudRoleMaker,

@@ -51,7 +51,13 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     shards_out = {}
     for name, t in _tensor_items(state_dict):
         if not isinstance(t, Tensor):
-            meta["nonb"][name] = t
+            # only JSON-native scalars survive the metadata roundtrip;
+            # numpy scalars coerce via item(), anything else is skipped
+            # (json default=str would corrupt it into a string on load)
+            if isinstance(t, (np.integer, np.floating, np.bool_)):
+                meta["nonb"][name] = t.item()
+            elif isinstance(t, (int, float, bool, str, type(None))):
+                meta["nonb"][name] = t
             continue
         v = t._value
         entry = {"shape": list(v.shape), "dtype": str(np.dtype(v.dtype)),
@@ -121,6 +127,27 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             cache[fname] = np.load(os.path.join(path, fname), allow_pickle=False)
         return cache[fname][key]
 
+    # restore non-tensor entries (step counters, scheduler scalars): loss
+    # continuity across a mesh reshape needs e.g. AdamW's bias-correction
+    # step to survive the reload, not just the slot arrays
+    def _restore_nonb(d, prefix=""):
+        for k in list(d.keys()):
+            name = f"{prefix}.{k}" if prefix else str(k)
+            v = d[k]
+            if isinstance(v, dict):
+                _restore_nonb(v, name)
+            elif not isinstance(v, (Tensor, jax.Array, np.ndarray)) \
+                    and name in meta.get("nonb", {}):
+                restored = meta["nonb"][name]
+                # checkpointed nonb entries are JSON-native by construction
+                # (save coerces numpy scalars, skips the rest); keep the
+                # target's python type when it has one
+                if v is not None and type(v) in (int, float, bool, str):
+                    restored = type(v)(restored)
+                d[k] = restored
+
+    _restore_nonb(state_dict)
+
     for name, t in _tensor_items(state_dict):
         if not isinstance(t, Tensor):
             continue
@@ -138,7 +165,11 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             except Exception:
                 target_sharding = None
         arr = jnp.asarray(full, dtype=t._value.dtype)
-        if target_sharding is not None:
+        from jax.sharding import NamedSharding
+        if isinstance(target_sharding, NamedSharding):
+            # reshard-on-load: re-place under the target's mesh placement.
+            # Single-device targets stay UNCOMMITTED — committing them to
+            # one device would pin later jits off the mesh.
             arr = jax.device_put(arr, target_sharding)
         t._value = arr
     return state_dict

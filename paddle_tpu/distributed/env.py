@@ -58,7 +58,11 @@ def init_parallel_env():
         return _default_group
     world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     if world > 1:
-        master = os.environ.get("PADDLE_MASTER")
+        # PADDLE_COORDINATOR is set by the launcher (PADDLE_MASTER's port is
+        # occupied by its TCPStore); hand-rolled setups may pass the master
+        # address directly
+        master = os.environ.get("PADDLE_COORDINATOR") \
+            or os.environ.get("PADDLE_MASTER")
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         jax.distributed.initialize(coordinator_address=master,
                                    num_processes=world, process_id=rank)
@@ -126,7 +130,8 @@ def barrier(group=None):
     """Host barrier: block until all processes sync (store-based when multi-proc)."""
     if get_world_size() > 1:
         from .store import create_or_get_global_tcp_store
-        create_or_get_global_tcp_store().barrier("dist_barrier",
+        gen = os.environ.get("PADDLE_RESTART_ID", "0")
+        create_or_get_global_tcp_store().barrier(f"dist_barrier/g{gen}",
                                                  world_size=get_world_size())
 
 

@@ -345,6 +345,18 @@ class DygraphShardingOptimizer:
                     slots[k] = _to_stored(plan, mesh, v)
 
     # -- delegation -----------------------------------------------------------
+    @property
+    def _step_count(self):
+        return self._inner._step_count
+
+    @_step_count.setter
+    def _step_count(self, v):
+        # augmented assignment through the wrapper (TrainStep does
+        # `opt._step_count += 1`) must reach the inner optimizer — a plain
+        # attribute would shadow it and checkpoints would save step 0,
+        # corrupting AdamW bias correction on resume
+        self._inner._step_count = v
+
     def __getattr__(self, name):
         return getattr(self._inner, name)
 

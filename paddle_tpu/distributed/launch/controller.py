@@ -109,8 +109,37 @@ class Controller:
             self.node_rank = self.store.add("__launch/node_seq", 1) - 1
 
     # -- pod construction ---------------------------------------------------
+    def _generation(self):
+        """Store-coordinated restart generation (a per-node counter would
+        desynchronize barrier/coordinator namespaces on partial restarts:
+        only the master node's rebuild bumps it; a lone non-master restart
+        rejoins the incumbent generation)."""
+        if self._is_master_node:
+            return int(self.store.add("__launch/generation", 1))
+        self.store.wait("__launch/generation", timeout=60)
+        return int(self.store.get("__launch/generation"))
+
+    def _coordinator_address(self, gen):
+        """Address for the jax.distributed coordination service.
+
+        PADDLE_MASTER's port is occupied by the launcher's TCPStore, so the
+        master node picks a fresh port per generation and publishes it
+        through the store (the reference's NCCL-id exchange analog);
+        other nodes read their generation's key — never a dead one's."""
+        host, _, _ = self.master.partition(":")
+        key = f"__launch/coordinator/g{gen}"
+        if self._is_master_node:
+            coord = f"{host}:{_free_port()}"
+            self.store.set(key, coord.encode())
+            return coord
+        val = self.store.wait(key, timeout=60)
+        return (val.decode() if isinstance(val, (bytes, bytearray))
+                else str(val))
+
     def _build_pod(self):
         world = self.nnodes * self.nproc_per_node
+        gen = self._generation()
+        coordinator = self._coordinator_address(gen)
         self.containers = []
         for local in range(self.nproc_per_node):
             rank = self.node_rank * self.nproc_per_node + local
@@ -121,6 +150,11 @@ class Controller:
                 "PADDLE_TRAINERS_NUM": str(world),
                 "PADDLE_NNODES": str(self.nnodes),
                 "PADDLE_MASTER": self.master,
+                "PADDLE_COORDINATOR": coordinator,
+                # restart generation: store-backed primitives (barriers)
+                # namespace their keys by this so a killed generation's
+                # dangling counts can't skew the relaunched one
+                "PADDLE_RESTART_ID": str(gen),
             })
             # scripts outside the framework checkout must still import it:
             # prepend the launcher's import root to the workers' PYTHONPATH

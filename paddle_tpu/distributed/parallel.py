@@ -30,25 +30,60 @@ class DataParallel(Layer):
             n = len(jax.devices())
             mesh = ProcessMesh(np.arange(n), ["dp"])
         self._mesh = mesh
-        # replicate parameters over dp (broadcast analog)
-        for _, sub in layers.named_sublayers(include_self=True):
-            for pname, p in list(sub._parameters.items()):
-                if p is None:
-                    continue
-                sharded = shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
-                sub._parameters[pname] = sharded
+        self._multiproc = jax.process_count() > 1
+        if self._multiproc:
+            # multi-process (one controller per host): sync parameters from
+            # rank 0 — the reference's sync_params_buffers broadcast
+            # (parallel.py:219). Values stay process-local (implicitly
+            # replicated under jit); device_put across non-addressable
+            # devices is not possible here.
+            from jax.experimental import multihost_utils
+            params = [p for _, p in layers.named_parameters()]
+            if params:
+                synced = multihost_utils.broadcast_one_to_all(
+                    [p._value for p in params])
+                for p, v in zip(params, synced):
+                    # broadcast_one_to_all device_gets to host numpy —
+                    # re-wrap so parameter values stay jax Arrays
+                    p._value = jax.numpy.asarray(v)
+        else:
+            # single-controller SPMD: replicate parameters over dp
+            # (broadcast analog)
+            for _, sub in layers.named_sublayers(include_self=True):
+                for pname, p in list(sub._parameters.items()):
+                    if p is None:
+                        continue
+                    sharded = shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
+                    sub._parameters[pname] = sharded
+
+    def _shard_batch(self, a):
+        """Place one batch tensor Shard(0) over dp. Multi-process: an eager
+        host array is THIS rank's local shard (the reference's per-trainer
+        mini-batch) and the global array is assembled across processes; a
+        traced or already-global value (e.g. from shard_local_batch before a
+        TrainStep) is constrained in-graph."""
+        v = a._value
+        if isinstance(v, jax.core.Tracer):
+            mesh = self._mesh.jax_mesh()
+            spec = [None] * a.ndim
+            spec[0] = self._mesh.dim_names[0]
+            v = jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, PartitionSpec(*spec)))
+            return Tensor(v, stop_gradient=a.stop_gradient)
+        if self._multiproc and isinstance(v, jax.Array) \
+                and not v.is_fully_addressable:
+            return a  # already a global array in the right layout family
+        return shard_local_batch(a, mesh=self._mesh,
+                                 axis_name=self._mesh.dim_names[0])
 
     def forward(self, *args, **kwargs):
+        per_proc = self._mesh.shape[0] // jax.process_count() \
+            if self._multiproc else self._mesh.shape[0]
         sharded_args = []
         for a in args:
-            if isinstance(a, Tensor) and a.ndim >= 1 \
-                    and a.shape[0] % self._mesh.shape[0] == 0:
-                spec = [None] * a.ndim
-                spec[0] = self._mesh.dim_names[0]
-                v = jax.device_put(a._value, NamedSharding(
-                    self._mesh.jax_mesh(), PartitionSpec(*spec)))
-                t = Tensor(v, stop_gradient=a.stop_gradient)
-                sharded_args.append(t)
+            if isinstance(a, Tensor) and a.ndim >= 1 and per_proc > 0 \
+                    and a.shape[0] % per_proc == 0:
+                sharded_args.append(self._shard_batch(a))
             else:
                 sharded_args.append(a)
         return self._layers(*sharded_args, **kwargs)
@@ -70,3 +105,34 @@ class DataParallel(Layer):
 
     def named_parameters(self, prefix="", include_sublayers=True):
         return self._layers.named_parameters(prefix, include_sublayers)
+
+
+def shard_local_batch(data, mesh=None, axis_name="dp"):
+    """Assemble this process's local mini-batch into the global dp-sharded
+    array (the DistributedBatchSampler contract: every rank feeds its own
+    shard; the global batch is their concatenation in rank order).
+
+    Use before a compiled step (TrainStep / to_static) in multi-process
+    runs — in-graph code cannot assemble cross-process arrays. Single
+    process: plain Shard(0) placement. Returns a Tensor.
+    """
+    stop_gradient = data.stop_gradient if isinstance(data, Tensor) else True
+    raw = data._value if isinstance(data, Tensor) else data
+    if mesh is None:
+        n = len(jax.devices())
+        mesh = ProcessMesh(np.arange(n), [axis_name])
+    jmesh = mesh.jax_mesh()
+    ndim = getattr(raw, "ndim", None) or np.asarray(raw).ndim
+    spec = [None] * ndim
+    spec[0] = axis_name
+    sharding = NamedSharding(jmesh, PartitionSpec(*spec))
+    if jax.process_count() > 1:
+        # keep host data on the host until placement — no device round-trip
+        local = np.asarray(raw)
+        global_shape = ((local.shape[0] * jax.process_count(),)
+                        + local.shape[1:])
+        v = jax.make_array_from_process_local_data(sharding, local,
+                                                   global_shape)
+    else:
+        v = jax.device_put(raw, sharding)
+    return Tensor(v, stop_gradient=stop_gradient)

@@ -306,6 +306,15 @@ def create_or_get_global_tcp_store() -> TCPStore:
         master = os.environ.get("PADDLE_MASTER", os.environ.get("MASTER_ENDPOINT",
                                                                 "127.0.0.1:0"))
         host, _, port = master.partition(":")
-        _global_store = TCPStore(host or "127.0.0.1", int(port or 0),
-                                 is_master=(rank == 0), world_size=world)
+        try:
+            _global_store = TCPStore(host or "127.0.0.1", int(port or 0),
+                                     is_master=(rank == 0), world_size=world)
+        except TimeoutError:
+            raise  # client connect timed out — do not mask with a retry
+        except OSError:
+            # bind failed: the launcher's controller already serves the
+            # store at PADDLE_MASTER (it binds the port before spawning
+            # us) — every worker, rank 0 included, connects as a client
+            _global_store = TCPStore(host or "127.0.0.1", int(port or 0),
+                                     is_master=False, world_size=world)
     return _global_store

@@ -1,0 +1,285 @@
+"""Multi-PROCESS distributed training, end to end (VERDICT r2 #2).
+
+Every other 'distributed' test runs single-process on the virtual 8-device
+mesh — proving SPMD semantics but never the process/runtime layer. These
+tests execute the real thing: the launcher spawns worker processes, each
+calls init_parallel_env -> jax.distributed.initialize (env.py), the
+processes form ONE global mesh (CPU devices, gloo collectives), run
+compiled dp train steps whose grad all-reduce crosses processes, and the
+loss matches a single-process run on the same global batch.
+
+Reference analog: test/legacy_test/test_parallel_dygraph_dataparallel.py:30
+(launcher + subprocess trainers + parity assertion).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "workers", "dp_trainer.py")
+
+
+def _run_single_process(steps=4):
+    """Reference: same model/batches, one process, one device."""
+    code = f"""
+import os, json, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+sys.path.insert(0, {REPO!r})
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt_mod
+from paddle_tpu.jit.api import TrainStep
+D, GB = 16, 8
+paddle.seed(0)
+model = nn.Sequential(nn.Linear(D, 4 * D), nn.GELU(), nn.Linear(4 * D, D))
+optimizer = opt_mod.AdamW(learning_rate=1e-2, parameters=model.parameters())
+step = TrainStep(model, lambda m, x, y: F.mse_loss(m(x), y), optimizer)
+rng = np.random.default_rng(7)
+losses = []
+for _ in range({steps}):
+    x = paddle.to_tensor(rng.standard_normal((GB, D)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((GB, D)).astype(np.float32))
+    losses.append(float(np.asarray(step(x, y)._value)))
+print(json.dumps(losses))
+"""
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=240, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _run_launcher(tmp_path, world, steps=4, noise=False, max_restarts=0):
+    out_file = str(tmp_path / f"dp_out_{world}.json")
+    from paddle_tpu.distributed.launch import launch
+    status = launch(WORKER,
+                    script_args=[out_file, str(steps),
+                                 "1" if noise else "0"],
+                    nproc_per_node=world, log_dir=str(tmp_path / "logs"),
+                    max_restarts=max_restarts)
+    assert status == 0
+    with open(out_file) as f:
+        return json.load(f)
+
+
+@pytest.mark.parametrize("world", [2])
+def test_multiprocess_dp_parity_with_single_process(tmp_path, world):
+    res = _run_launcher(tmp_path, world)
+    assert res["world"] == world
+    ref = _run_single_process()
+    np.testing.assert_allclose(res["losses"], ref, rtol=2e-5, atol=2e-6)
+    # training must actually progress
+    assert res["losses"][-1] < res["losses"][0]
+
+
+def test_multiprocess_param_broadcast_erases_rank_divergence(tmp_path):
+    """Rank!=0 params are perturbed before DataParallel wraps them; the
+    rank-0 broadcast (reference: sync_params_buffers) must restore parity."""
+    res = _run_launcher(tmp_path, 2, noise=True)
+    ref = _run_single_process()
+    np.testing.assert_allclose(res["losses"], ref, rtol=2e-5, atol=2e-6)
+
+
+def test_elastic_kill_recover_with_real_trainers(tmp_path):
+    """The elastic kill->relaunch->resume flow with trainers that actually
+    train across processes (global mesh + collectives + checkpoint), not
+    toy file-writers: rank 1 dies at step 2; the relaunched generation
+    resumes from rank 0's checkpoint and the stitched loss trajectory
+    matches an uninterrupted 2-process run."""
+    WORKER_E = os.path.join(REPO, "tests", "workers",
+                            "elastic_dp_trainer.py")
+    from paddle_tpu.distributed.launch import launch
+    steps = 6
+
+    # uninterrupted reference run (2 procs)
+    out_ref = str(tmp_path / "ref.jsonl")
+    os.makedirs(str(tmp_path / "ckpt_ref"), exist_ok=True)
+    status = launch(WORKER_E,
+                    script_args=[out_ref, str(tmp_path / "ckpt_ref"), str(steps)],
+                    nproc_per_node=2, log_dir=str(tmp_path / "logs_ref"))
+    assert status == 0
+    ref = json.loads(open(out_ref).read().strip().splitlines()[-1])
+
+    # killed + recovered run
+    out_k = str(tmp_path / "killed.jsonl")
+    ckpt = tmp_path / "ckpt_kill"
+    os.makedirs(str(ckpt), exist_ok=True)
+    status = launch(WORKER_E,
+                    script_args=[out_k, str(ckpt), str(steps),
+                                 str(tmp_path / "killflag")],
+                    nproc_per_node=2, log_dir=str(tmp_path / "logs_kill"),
+                    max_restarts=2)
+    assert status == 0
+    assert (tmp_path / "killflag").exists(), "failure never injected"
+
+    # the killed generation exits before writing its summary; the surviving
+    # line is the RESUMED generation, which must have started past step 0
+    # (checkpoint-based resume) and finished the run
+    gens = [json.loads(l) for l in open(out_k).read().strip().splitlines()]
+    final = gens[-1]
+    assert final["start"] > 0, "relaunched generation did not resume"
+    resumed = dict((i, l) for i, l in final["losses"])
+    assert max(resumed) == steps - 1, "resumed run did not finish"
+    meta = json.load(open(ckpt / "meta.json"))
+    assert meta["step"] == steps - 1
+
+    # loss continuity: every post-resume step matches the uninterrupted
+    # 2-process run exactly (same data order, state restored)
+    ref_losses = dict((i, l) for i, l in ref["losses"])
+    np.testing.assert_allclose([resumed[i] for i in sorted(resumed)],
+                               [ref_losses[i] for i in sorted(resumed)],
+                               rtol=2e-4, atol=2e-5)
+
+
+def _launch_elastic(tmp_path, tag, world, steps):
+    WORKER_E = os.path.join(REPO, "tests", "workers",
+                            "elastic_dp_trainer.py")
+    from paddle_tpu.distributed.launch import launch
+    out = str(tmp_path / f"{tag}.jsonl")
+    ckpt = tmp_path / f"ckpt_{tag}"
+    os.makedirs(str(ckpt), exist_ok=True)
+    status = launch(WORKER_E, script_args=[out, str(ckpt), str(steps)],
+                    nproc_per_node=world,
+                    log_dir=str(tmp_path / f"logs_{tag}_{world}"))
+    assert status == 0
+    gens = [json.loads(l) for l in open(out).read().strip().splitlines()]
+    return gens, ckpt
+
+
+def _assert_continuity(stitched, ref, reshape_step):
+    """Pre-reshape steps match bitwise-tight; the FIRST post-reshape step
+    must land on the reference trajectory (a reset model would be far off),
+    proving state carried across the mesh reshape. Later steps only track
+    loosely: a different world size reduces the global batch in a different
+    order, and that benign fp roundoff amplifies chaotically under AdamW."""
+    for i in sorted(ref):
+        if i < reshape_step:
+            np.testing.assert_allclose(stitched[i], ref[i],
+                                       rtol=2e-4, atol=2e-5)
+        elif i == reshape_step:
+            np.testing.assert_allclose(stitched[i], ref[i],
+                                       rtol=1e-3, atol=1e-4)
+        else:
+            np.testing.assert_allclose(stitched[i], ref[i],
+                                       rtol=6e-2, atol=6e-3)
+
+
+def test_elastic_scale_in_and_out_mesh_reshape(tmp_path):
+    """Elastic SCALE modes (VERDICT r2 #4; reference:
+    fleet/elastic/manager.py:234-261 distinguishes fault-tolerant restart
+    from relaunch at a DIFFERENT world size): training starts at world=2,
+    scales IN to world=1 (mesh reshape 2->1) resuming from the checkpoint,
+    and a second scenario scales OUT 1->2. Loss trajectories must stitch
+    exactly onto an uninterrupted reference — the global batch semantics
+    survive the reshape."""
+    steps = 6
+    # uninterrupted reference at world=2
+    ref_gens, _ = _launch_elastic(tmp_path, "ref2", 2, steps)
+    ref = dict((i, l) for i, l in ref_gens[-1]["losses"])
+
+    # scale-IN: 2 procs for 3 steps, then 1 proc resumes to completion
+    gens_a, ckpt_a = _launch_elastic(tmp_path, "scalein", 2, 3)
+    assert gens_a[-1]["world"] == 2
+    WORKER_E = os.path.join(REPO, "tests", "workers",
+                            "elastic_dp_trainer.py")
+    from paddle_tpu.distributed.launch import launch
+    out2 = str(tmp_path / "scalein_phase2.jsonl")
+    status = launch(WORKER_E, script_args=[out2, str(ckpt_a), str(steps)],
+                    nproc_per_node=1,
+                    log_dir=str(tmp_path / "logs_scalein2"))
+    assert status == 0
+    g2 = json.loads(open(out2).read().strip().splitlines()[-1])
+    assert g2["world"] == 1 and g2["start"] == 3, g2
+    stitched = dict((i, l) for i, l in gens_a[-1]["losses"])
+    stitched.update((i, l) for i, l in g2["losses"])
+    assert sorted(stitched) == sorted(ref)
+    _assert_continuity(stitched, ref, reshape_step=3)
+
+    # scale-OUT: 1 proc for 3 steps, then 2 procs resume to completion
+    gens_b, ckpt_b = _launch_elastic(tmp_path, "scaleout", 1, 3)
+    assert gens_b[-1]["world"] == 1
+    out3 = str(tmp_path / "scaleout_phase2.jsonl")
+    status = launch(WORKER_E, script_args=[out3, str(ckpt_b), str(steps)],
+                    nproc_per_node=2,
+                    log_dir=str(tmp_path / "logs_scaleout2"))
+    assert status == 0
+    g3 = json.loads(open(out3).read().strip().splitlines()[-1])
+    assert g3["world"] == 2 and g3["start"] == 3, g3
+    stitched = dict((i, l) for i, l in gens_b[-1]["losses"])
+    stitched.update((i, l) for i, l in g3["losses"])
+    _assert_continuity(stitched, ref, reshape_step=3)
+
+
+def test_zero_state_reshard_across_sharding_degrees(tmp_path):
+    """The sharded-state half of elastic scale-in: ZeRO-2 state trained at
+    sharding degree 8 is saved through the distributed checkpoint (per-shard
+    entries with offsets), reloaded into a FRESH degree-4 mesh
+    (reshard-on-load re-places every slot under the new plan), and training
+    continues on the reference trajectory. Reference:
+    distributed/checkpoint/load_state_dict.py reshard semantics +
+    elastic/manager.py scale modes."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt_mod
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import fleet_state
+    from paddle_tpu.jit.api import TrainStep
+
+    def build(shd):
+        fleet_state.set_hcg(None)
+        fleet_state.set_strategy(None)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8 // shd, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": shd,
+                                   "sep_degree": 1}
+        strategy.sharding_configs = {"stage": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 64), nn.GELU(),
+                              nn.Linear(64, 16))
+        opt = opt_mod.AdamW(learning_rate=1e-2,
+                            parameters=model.parameters())
+        model_d, opt_d, _ = dist.group_sharded_parallel(model, opt, "os_g")
+        step = TrainStep(model_d, lambda m, a, b: F.mse_loss(m(a), b), opt_d)
+        return model, opt_d, step
+
+    rng = np.random.default_rng(3)
+    x = None
+    import paddle_tpu as _p
+    x = _p.to_tensor(rng.standard_normal((16, 16)).astype(np.float32))
+    y = _p.to_tensor(rng.standard_normal((16, 16)).astype(np.float32))
+
+    # phase A: degree 8, three steps, distributed-checkpoint save
+    model8, opt8, step8 = build(8)
+    for _ in range(3):
+        step8(x, y)
+    sd = {"model": model8.state_dict(), "opt": opt8.state_dict()}
+    # the saved slots are genuinely sharded arrays (not full replicas)
+    any_sharded = any(
+        isinstance(t._value, jax.Array) and
+        next(iter(t._value.addressable_shards)).data.size < t._value.size
+        for t in sd["opt"].values()
+        if hasattr(t, "_value") and getattr(t._value, "shape", None))
+    assert any_sharded, "ZeRO state not sharded — reshard test is vacuous"
+    dist.checkpoint.save_state_dict(sd, str(tmp_path / "zck"))
+    ref_cont = [float(np.asarray(step8(x, y)._value)) for _ in range(3)]
+
+    # phase B: FRESH degree-4 mesh; load + reshard; continue training
+    model4, opt4, step4 = build(4)
+    sd4 = {"model": model4.state_dict(), "opt": opt4.state_dict()}
+    dist.checkpoint.load_state_dict(sd4, str(tmp_path / "zck"))
+    opt4.set_state_dict(sd4["opt"])
+    assert opt4._step_count == 3, "step counter did not survive the reload"
+    got = [float(np.asarray(step4(x, y)._value)) for _ in range(3)]
+    np.testing.assert_allclose(got[0], ref_cont[0], rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(got, ref_cont, rtol=6e-2, atol=6e-3)

@@ -1,0 +1,82 @@
+"""Real multi-process dp trainer (NOT a toy): used by the launcher
+integration tests. Each worker joins the global runtime via
+init_parallel_env -> jax.distributed.initialize, wraps the model in
+DataParallel (param broadcast from rank 0), feeds ITS OWN batch shard
+through shard_local_batch, and runs compiled train steps whose gradient
+all-reduce crosses process boundaries.
+
+Reference analog: the subprocess trainers of
+test/legacy_test/test_parallel_dygraph_dataparallel.py:30.
+
+argv: out_path [steps] [noise_rank_params]
+  noise_rank_params=1 perturbs this rank's initial params BEFORE
+  DataParallel wraps them — the rank-0 broadcast must erase the
+  perturbation or training diverges across ranks.
+"""
+import json
+import os
+import sys
+
+import re
+
+# exactly ONE local device per worker process, even when spawned from an
+# environment (pytest conftest) that forces a virtual 8-device host
+flags = os.environ.get("XLA_FLAGS", "")
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = \
+    (flags + " --xla_force_host_platform_device_count=1").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt_mod
+from paddle_tpu.jit.api import TrainStep
+
+D = 16
+GLOBAL_BATCH = 8
+
+
+def main():
+    out = sys.argv[1]
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    noise = len(sys.argv) > 3 and sys.argv[3] == "1"
+
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+    assert jax.device_count() == world, \
+        f"global mesh missing devices: {jax.device_count()} != {world}"
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(D, 4 * D), nn.GELU(),
+                          nn.Linear(4 * D, D))
+    if noise and rank != 0:
+        for p in model.parameters():
+            p._value = p._value + 0.5  # must be erased by the broadcast
+    optimizer = opt_mod.AdamW(learning_rate=1e-2,
+                              parameters=model.parameters())
+    model = paddle.DataParallel(model)
+    step = TrainStep(model, lambda m, x, y: F.mse_loss(m(x), y), optimizer)
+
+    rng = np.random.default_rng(7)
+    lb = GLOBAL_BATCH // world
+    losses = []
+    for _ in range(steps):
+        x = rng.standard_normal((GLOBAL_BATCH, D)).astype(np.float32)
+        y = rng.standard_normal((GLOBAL_BATCH, D)).astype(np.float32)
+        xg = dist.shard_local_batch(x[rank * lb:(rank + 1) * lb])
+        yg = dist.shard_local_batch(y[rank * lb:(rank + 1) * lb])
+        loss = step(xg, yg)
+        losses.append(float(np.asarray(loss._value)))
+
+    if rank == 0:
+        with open(out, "w") as f:
+            json.dump({"losses": losses, "world": world}, f)
+
+
+if __name__ == "__main__":
+    main()
