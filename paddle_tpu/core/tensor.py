@@ -284,6 +284,9 @@ class Tensor:
 
     # -- conversion ---------------------------------------------------------
     def numpy(self) -> np.ndarray:
+        if _DISPATCH_RECORDER is not None:
+            # whole-array host read: the prefix-capture break point
+            _DISPATCH_RECORDER.on_host_read(self._value)
         return np.asarray(self._value)
 
     def item(self):
@@ -446,11 +449,31 @@ def install_amp_hook(fn):
 # the direct path; ops observed drawing RNG during trace are blacklisted so
 # their randomness never bakes into a cached executable.
 
-_DISPATCH_CACHE: dict = {}
+_DISPATCH_CACHE: dict = {}   # insertion-ordered; maintained as LRU
 _UNCACHEABLE_OPS: set = set()
 _CACHE_BYPASS = object()
 _BWD_JIT = None
 _DISPATCH_CACHE_MAX = 4096
+#: observability for the eager hot path (reference: the codegen'd dispatch
+#: counters); read via dispatch_cache_stats(), reset on clear
+_CACHE_STATS = {"hits": 0, "misses": 0, "evictions": 0, "bypasses": 0}
+
+
+def dispatch_cache_stats() -> dict:
+    """Hit/miss/eviction/bypass counters plus current size of the compiled
+    eager-dispatch cache."""
+    return dict(_CACHE_STATS, size=len(_DISPATCH_CACHE),
+                max_size=_DISPATCH_CACHE_MAX)
+
+
+# -- compiled-prefix capture hooks (jit/prefix_capture.py) -------------------
+#: when set, every dispatch is logged with argument provenance (record mode)
+_DISPATCH_RECORDER = None
+#: when set, prefix-position dispatches are answered from a compiled prefix
+_DISPATCH_REPLAY = None
+#: sentinel: the replay state declined this op (past the prefix) — dispatch
+#: proceeds normally
+_REPLAY_PASS = object()
 
 
 class _Unfreezable(Exception):
@@ -519,6 +542,8 @@ def _freeze(v, depth=0):
 
 def clear_dispatch_cache():
     _DISPATCH_CACHE.clear()
+    for k in _CACHE_STATS:
+        _CACHE_STATS[k] = 0
 
 
 # flag flips invalidate cached executables (op bodies read flags at trace
@@ -580,8 +605,20 @@ def _dispatch_cached(fn, name, cache_key, leaves, treedef, record):
 
     entry = _DISPATCH_CACHE.get(key)
     first = entry is None
-    if first and len(_DISPATCH_CACHE) >= _DISPATCH_CACHE_MAX:
-        return _CACHE_BYPASS  # cap bounds INSERTS only; hits stay fast
+    if not first:
+        # LRU maintenance: re-insert at the MRU end so long-running jobs
+        # with shape churn (variable seq lens, generation loops) keep their
+        # hot entries instead of freezing the first 4096 shapes forever
+        _DISPATCH_CACHE[key] = _DISPATCH_CACHE.pop(key)
+        _CACHE_STATS["hits"] += 1
+    else:
+        _CACHE_STATS["misses"] += 1
+        if _DISPATCH_CACHE_MAX <= 0:
+            _CACHE_STATS["bypasses"] += 1
+            return _CACHE_BYPASS
+        while len(_DISPATCH_CACHE) >= _DISPATCH_CACHE_MAX:
+            _DISPATCH_CACHE.pop(next(iter(_DISPATCH_CACHE)))
+            _CACHE_STATS["evictions"] += 1
     if first:
         layout_t, statics_t, di = tuple(layout), tuple(statics), tuple(diff_idx)
 
@@ -653,22 +690,39 @@ def dispatch(fn: Callable, args: tuple, kwargs: dict, name: str | None = None,
         and any(not leaves[i].stop_gradient for i in tensor_pos)
     )
 
-    if cache_key is None and not _OP_OBSERVERS and _mode.functional == 0:
+    rep = _DISPATCH_REPLAY
+    if rep is not None:
+        # compiled-prefix replay (jit/prefix_capture.py): prefix-position
+        # ops are answered from the precompiled program; divergence (or a
+        # grad-recording op) ends the replay and execution continues eagerly
+        out = rep.try_replay(fn, name, leaves, treedef, record)
+        if out is not _REPLAY_PASS:
+            return out
+
+    rec = _DISPATCH_RECORDER
+    if cache_key is None and not _OP_OBSERVERS and _mode.functional == 0 \
+            and rec is None:
         try:
             cache_key = (name, _freeze(fn))
         except (_Unfreezable, ValueError):  # ValueError: empty closure cell
             cache_key = None
     if cache_key is not None and cache_key not in _UNCACHEABLE_OPS \
-            and not _OP_OBSERVERS and _mode.functional == 0:
+            and not _OP_OBSERVERS and _mode.functional == 0 and rec is None:
         out = _dispatch_cached(fn, name, cache_key, leaves, treedef, record)
         if out is not _CACHE_BYPASS:
             return out
+
+    rng_before = _rng_counters() if rec is not None else None
 
     if not record:
         vals = _maybe_amp_cast(name, [_unwrap(x) for x in leaves])
         a, k = jax.tree_util.tree_unflatten(treedef, vals)
         out = fn(*a, **k)
-        return _wrap_outputs(out, node=None, name=name)
+        result = _wrap_outputs(out, node=None, name=name)
+        if rec is not None:
+            rec.after_op(fn, name, leaves, treedef, result, False,
+                         _rng_counters() != rng_before)
+        return result
 
     diff_pos = [i for i in tensor_pos if not leaves[i].stop_gradient]
     diff_tensors = [leaves[i] for i in diff_pos]
@@ -685,7 +739,11 @@ def dispatch(fn: Callable, args: tuple, kwargs: dict, name: str | None = None,
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
     out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]
     node = Node(vjp_fn, diff_tensors, out_treedef, out_avals, name, fwd_fn=closed)
-    return _wrap_outputs(out, node=node, name=name)
+    result = _wrap_outputs(out, node=node, name=name)
+    if rec is not None:
+        rec.after_op(fn, name, leaves, treedef, result, True,
+                     _rng_counters() != rng_before)
+    return result
 
 
 def _wrap_outputs(out, node: Node | None, name: str):

@@ -25,7 +25,7 @@ from ...core import random as _random
 from ...nn.layer_base import Layer, Parameter
 from ...jit.functional_call import bind_state, collect_state, read_values
 from ..pipeline import (spmd_pipeline, interleaved_pipeline,
-                        scheduled_pipeline)
+                        scheduled_pipeline, scheduled_interleaved_pipeline)
 from .pp_layers import PipelineLayer
 
 
@@ -78,15 +78,9 @@ class PipelineParallel:
             raise ValueError(
                 f"unknown pipeline schedule_mode {raw_mode!r}; expected "
                 f"one of {sorted(known)}")
-        if mode == "ZBVPP":
-            # zero-bubble + virtual chunks is not implemented; failing loudly
-            # beats silently running plain VPP without the W-split
-            raise NotImplementedError(
-                "schedule_mode ZBVPP (zero-bubble interleaved) is not "
-                "implemented; use VPP (interleaved) or ZBH1 (zero-bubble)")
-        if mode == "VPP" and self._V <= 1:
+        if mode in ("VPP", "ZBVPP") and self._V <= 1:
             raise ValueError(
-                "schedule_mode VPP needs num_virtual_pipeline_stages > 1")
+                f"schedule_mode {mode} needs num_virtual_pipeline_stages > 1")
         if mode in ("1F1B", "EAGER1F1B", "ZBH1", "ZEROBUBBLE") \
                 and self._V > 1:
             raise ValueError(
@@ -346,7 +340,13 @@ class PipelineParallel:
                     B = hv.shape[0]
                     mb = B // M
                     h_mb = dp_shard(hv.reshape((M, mb) + hv.shape[1:]), 1)
-                    if V > 1:
+                    if V > 1 and mode == "ZBVPP":
+                        # zero-bubble x interleaved: W-split composed with
+                        # the chunk loop (distinct runtime, not VPP+remat)
+                        y_mb = scheduled_interleaved_pipeline(
+                            stage, stacked_vals, h_mb, mesh, "pp",
+                            num_chunks=V)
+                    elif V > 1:
                         y_mb = interleaved_pipeline(stage, stacked_vals, h_mb, mesh,
                                                     "pp", num_chunks=V,
                                                     remat=remat)

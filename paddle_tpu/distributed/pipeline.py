@@ -99,6 +99,11 @@ def scheduled_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp",
     Micro-timing within a tick is XLA's prerogative (there is no host schedule
     loop to drive on TPU); what each mode pins is the *residency policy* and
     the *dependency structure*, which is what the schedules differ by.
+    Compiled-program evidence that the W-split lands as claimed — loop
+    computations carrying the dw matmuls with ZERO collective-permutes,
+    disjoint from the permute-carrying ring loops — is captured in
+    ``docs/artifacts/zbh1_schedule_proof.json`` (regenerated by
+    tests/test_pipeline_schedules.py::TestZBH1ScheduleArtifact).
 
     RNG: one base key is drawn per call and folded with (stage, microbatch),
     so the backward recompute sees the forward's randomness by construction.
@@ -296,3 +301,197 @@ def interleaved_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp",
                      in_specs=(spec_params, batch_spec),
                      out_specs=batch_spec, axis_names={axis},
                      check_vma=False)(stacked_vs, x_mb)
+
+
+def scheduled_interleaved_pipeline(stage_fn, stacked_params, x_mb, mesh,
+                                   axis="pp", num_chunks=2):
+    """ZBVPP: zero-bubble x interleaved virtual chunks (reference:
+    passes/pipeline_scheduler_pass/pipeline_zero_bubble.py composed with
+    PipelineParallelWithInterleave).
+
+    Composition of :func:`scheduled_pipeline`'s W-split with
+    :func:`interleaved_pipeline`'s chunk loop:
+
+    - **forward**: the ring is traversed ``num_chunks`` times (chunk v on
+      device d = global stage v*S+d); each chunk pass stores only its M
+      stage-boundary inputs — residency [V, M, microbatch] per device.
+    - **backward**: chunks unwind in reverse; each reverse ring computes
+      ONLY dx (the W-split — the serial cross-chunk/cross-stage chain holds
+      just dx work) and buffers dy per (chunk, microbatch).
+    - **deferred W pass**: all V*M dw contributions run afterwards with NO
+      ppermute — off the ring's critical path, XLA-overlappable, exactly the
+      zero-bubble trade paid with an extra forward recompute and the
+      [V, M]-deep dy buffer.
+
+    Params: leaves [S*num_chunks, ...] in ring order (chunk-major after the
+    internal [V, S] reshape), sharded over `axis`. Differentiable like
+    scheduled_pipeline (custom_vjp).
+    """
+    from ..core import random as _random
+
+    jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+    S = jmesh.shape[axis]
+    V = num_chunks
+    M = x_mb.shape[0]
+    T = M + S - 1
+    batch_spec = P()
+    key_base = _random.next_key()
+
+    def run_stage(params, x, stage_i, mb_i):
+        k = jax.random.fold_in(jax.random.fold_in(key_base, stage_i), mb_i)
+        with _random.provide_key(k):
+            return stage_fn(params, x)
+
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def _masked_row_write(buf, row_i, value, valid):
+        old = jax.lax.dynamic_index_in_dim(buf, row_i, 0, keepdims=False)
+        new = jnp.where(valid, value, old)
+        return jax.lax.dynamic_update_slice_in_dim(buf, new[None], row_i, 0)
+
+    def _chunk(params_l, v):
+        # local leaf [V, 1(pp), ...] -> chunk v's stage params [...]
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, v, 0,
+                                                   keepdims=False)[0],
+            params_l)
+
+    def fwd_device(params_l, x):
+        idx = jax.lax.axis_index(axis)
+
+        def chunk_fwd(carry_x, v):
+            params = _chunk(params_l, v)
+            sid = v * S + idx
+
+            def step(carry, t):
+                state, y_buf, resid_buf = carry
+                mb = jax.lax.dynamic_index_in_dim(
+                    carry_x, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+                cur = jnp.where(idx == 0, mb, state)
+                f = t - idx
+                fc = jnp.clip(f, 0, M - 1)
+                valid = (f >= 0) & (f < M)
+                resid_buf = _masked_row_write(resid_buf, fc, cur, valid)
+                out = run_stage(params, cur, sid, fc)
+                yf = t - (S - 1)
+                y_buf = _masked_row_write(y_buf, jnp.clip(yf, 0, M - 1), out,
+                                          (yf >= 0) & (yf < M))
+                return (jax.lax.ppermute(out, axis, fwd_perm), y_buf,
+                        resid_buf), None
+
+            (_, y_buf, resid_buf), _ = jax.lax.scan(
+                step, (jnp.zeros_like(carry_x[0]), jnp.zeros_like(carry_x),
+                       jnp.zeros_like(carry_x)), jnp.arange(T))
+            y = jnp.where(idx == S - 1, y_buf, jnp.zeros_like(y_buf))
+            return jax.lax.psum(y, axis), resid_buf
+
+        y, resid_v = jax.lax.scan(chunk_fwd, x, jnp.arange(V))
+        return y, resid_v[None]                  # [1(pp), V, M, mb...]
+
+    def bwd_device(params_l, resid_l, dy_mb):
+        resid = resid_l[0]                       # [V, M, mb...]
+        idx = jax.lax.axis_index(axis)
+        U = M + S - 1
+
+        def chunk_bwd(carry_dy, v):
+            params = _chunk(params_l, v)
+            sid = v * S + idx
+            resid_c = jax.lax.dynamic_index_in_dim(resid, v, 0,
+                                                   keepdims=False)
+
+            def tick(carry, u):
+                state, dx_buf, dy_buf = carry
+                b = u - (S - 1 - idx)
+                bc = jnp.clip(b, 0, M - 1)
+                valid = (b >= 0) & (b < M)
+                dy_last = jax.lax.dynamic_index_in_dim(carry_dy, bc, 0,
+                                                       keepdims=False)
+                dy = jnp.where(idx == S - 1, dy_last, state)
+                x_b = jax.lax.dynamic_index_in_dim(resid_c, bc, 0,
+                                                   keepdims=False)
+                # dx-only chain (W-split): dw GEMMs are dead code here
+                _, vjp_x = jax.vjp(
+                    lambda xx: run_stage(params, xx, sid, bc), x_b)
+                (dx,) = vjp_x(dy)
+                dy_buf = _masked_row_write(dy_buf, bc, dy, valid)
+                dx = jnp.where(valid, dx, jnp.zeros_like(dx))
+                dx_buf = _masked_row_write(dx_buf, bc, dx, valid)
+                return (jax.lax.ppermute(dx, axis, bwd_perm), dx_buf,
+                        dy_buf), None
+
+            zero_buf = jnp.zeros((M,) + dy_mb.shape[1:], dy_mb.dtype)
+            (_, dx_buf, dy_buf), _ = jax.lax.scan(
+                tick, (jnp.zeros_like(dy_mb[0]), zero_buf, zero_buf),
+                jnp.arange(U))
+            dx_mb = jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf))
+            # stage-0 dx of chunk v is the upstream dy of chunk v-1
+            return jax.lax.psum(dx_mb, axis), dy_buf
+
+        dx_final, dy_bufs_rev = jax.lax.scan(chunk_bwd, dy_mb,
+                                             jnp.arange(V - 1, -1, -1))
+        dy_bufs = jnp.flip(dy_bufs_rev, 0)       # chunk-major [V, M, mb...]
+
+        # deferred W pass: V*M dw contributions, NO ppermute anywhere —
+        # completely off the ring's serial chain
+        def w_chunk(_, v):
+            params = _chunk(params_l, v)
+            sid = v * S + idx
+            resid_c = jax.lax.dynamic_index_in_dim(resid, v, 0,
+                                                   keepdims=False)
+            dy_c = jax.lax.dynamic_index_in_dim(dy_bufs, v, 0,
+                                                keepdims=False)
+
+            def w_tick(dw_acc, bm):
+                x_b = jax.lax.dynamic_index_in_dim(resid_c, bm, 0,
+                                                   keepdims=False)
+                dy_b = jax.lax.dynamic_index_in_dim(dy_c, bm, 0,
+                                                    keepdims=False)
+                _, vjp_p = jax.vjp(
+                    lambda pp: run_stage(pp, x_b, sid, bm), params)
+                (dw,) = vjp_p(dy_b)
+                return jax.tree_util.tree_map(lambda a, g: a + g,
+                                              dw_acc, dw), None
+
+            dw0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+            dw_v, _ = jax.lax.scan(w_tick, dw0, jnp.arange(M))
+            return None, dw_v
+
+        _, dw_stacked = jax.lax.scan(w_chunk, None, jnp.arange(V))
+        dparams = jax.tree_util.tree_map(lambda a: a[:, None], dw_stacked)
+        return dparams, dx_final
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(None, axis),
+                                         stacked_params)
+    resid_spec = P(axis)
+
+    fwd_sm = shard_map(fwd_device, mesh=jmesh,
+                       in_specs=(spec_params, batch_spec),
+                       out_specs=(batch_spec, resid_spec), axis_names={axis},
+                       check_vma=False)
+    bwd_sm = shard_map(bwd_device, mesh=jmesh,
+                       in_specs=(spec_params, resid_spec, batch_spec),
+                       out_specs=(spec_params, batch_spec), axis_names={axis},
+                       check_vma=False)
+
+    @jax.custom_vjp
+    def pipe(params_vs, x):
+        y, _ = fwd_sm(params_vs, x)
+        return y
+
+    def pipe_fwd(params_vs, x):
+        y, resid = fwd_sm(params_vs, x)
+        return y, (params_vs, resid)
+
+    def pipe_bwd(res, dy):
+        params_vs, resid = res
+        dparams, dx = bwd_sm(params_vs, resid, dy)
+        return dparams, dx
+
+    pipe.defvjp(pipe_fwd, pipe_bwd)
+
+    # [S*V, ...] ring order -> chunk-major [V, S, ...] (differentiable
+    # reshape: grads flow back to the caller's stacked form)
+    stacked_vs = jax.tree_util.tree_map(
+        lambda a: a.reshape((V, S) + a.shape[1:]), stacked_params)
+    return pipe(stacked_vs, x_mb)

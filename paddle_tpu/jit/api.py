@@ -81,6 +81,16 @@ def _split_leaves(tree):
 _EAGER_FALLBACK = object()  # cache sentinel: this signature runs eagerly
 
 
+class _PrefixEntry:
+    """Cache entry: compiled-prefix capture after a whole-array graph break
+    (see jit/prefix_capture.py)."""
+
+    __slots__ = ("program",)
+
+    def __init__(self, program):
+        self.program = program
+
+
 class _Specializer:
     """Per-signature state after a data-dependent graph break (reference:
     jit/sot opcode_executor.py:353 — SOT keeps the compiled prefix and guards
@@ -266,21 +276,67 @@ class StaticFunction:
             return self._call_specialized(entry, body, args, kwargs,
                                           state_vals, dyn, buffers)
 
+        if isinstance(entry, _PrefixEntry):
+            from .prefix_capture import _ReplayAbandoned
+            try:
+                result, diverged = entry.program.run(
+                    list(state_vals) + list(dyn),
+                    lambda: self._fn(*args, **kwargs))
+            except _ReplayAbandoned:
+                # the prefix program itself failed to trace/run — raised
+                # BEFORE any user code, so a plain eager call is safe
+                self._cache[key] = _EAGER_FALLBACK
+                return self._fn(*args, **kwargs)
+            if diverged:
+                # result is still correct (replayed values are provenance-
+                # verified; the diverged tail ran eagerly) — but repeated
+                # divergence means the prefix isn't stable for this fn
+                entry.program.failures += 1
+                if entry.program.failures >= 2:
+                    self._cache[key] = _EAGER_FALLBACK
+            return result
+
         rng_key = _random.next_key()
         try:
             out_vals, new_buf_vals = entry(state_vals, dyn, rng_key)
         except (jax.errors.TracerArrayConversionError,
                 jax.errors.NonConcreteBooleanIndexError) as e:
             # whole-array concretization (.numpy() on a tracer, boolean mask
-            # indexing): no scalar profile can fix this — eager forever (the
-            # SOT-fallback analog)
+            # indexing): no scalar profile can fix this wholesale — but the
+            # ops BEFORE the break are compilable. SOT-style prefix capture:
+            # one eager recording run; when a clean prefix exists (no RNG /
+            # grads / AMP in it), later calls run it as ONE compiled program
+            # and resume eager at the break (reference:
+            # jit/sot/opcode_translator/executor/opcode_executor.py:353).
             import warnings
-            warnings.warn(
-                f"to_static: graph break in {getattr(self._fn, '__name__', '?')} "
-                f"({type(e).__name__}); this call signature now runs eagerly",
-                RuntimeWarning, stacklevel=2)
-            self._cache[key] = _EAGER_FALLBACK
-            return self._fn(*args, **kwargs)
+            from ..core import tensor as _tensor_mod
+            from .prefix_capture import PrefixRecorder
+            recorder = PrefixRecorder(list(state_vals) + list(dyn))
+            saved_rec = _tensor_mod._DISPATCH_RECORDER
+            _tensor_mod._DISPATCH_RECORDER = recorder
+            try:
+                result = self._fn(*args, **kwargs)
+            finally:
+                _tensor_mod._DISPATCH_RECORDER = saved_rec
+            program = recorder.build()
+            if program is not None:
+                warnings.warn(
+                    f"to_static: graph break in "
+                    f"{getattr(self._fn, '__name__', '?')} "
+                    f"({type(e).__name__}); compiled a "
+                    f"{len(program.records)}-op prefix, eager after the "
+                    f"break", RuntimeWarning, stacklevel=2)
+                self._cache[key] = _PrefixEntry(program)
+            else:
+                warnings.warn(
+                    f"to_static: graph break in "
+                    f"{getattr(self._fn, '__name__', '?')} "
+                    f"({type(e).__name__}; "
+                    f"{recorder.aborted or 'no capturable prefix'}); this "
+                    f"call signature now runs eagerly",
+                    RuntimeWarning, stacklevel=2)
+                self._cache[key] = _EAGER_FALLBACK
+            return result
         except (jax.errors.ConcretizationTypeError,
                 jax.errors.TracerIntegerConversionError) as e:
             # NOTE: in this jax version only TracerBoolConversionError is a

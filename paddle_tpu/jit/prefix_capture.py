@@ -1,0 +1,270 @@
+"""Compiled-prefix capture for whole-array graph breaks (the SOT analog).
+
+Reference: python/paddle/jit/sot/opcode_translator/executor/opcode_executor.py:353
+— when tracing hits an untraceable point (``.numpy()`` on a tracer), SOT
+compiles the code BEFORE the break and resumes eager execution after it.
+
+TPU-native equivalent, without a bytecode VM: the op stream up to the first
+host read is deterministic for a fixed signature, so
+
+1. **Record** (one eager run): every ``dispatch`` call logs its op fn, leaf
+   layout, and the PROVENANCE of each tensor argument — a function input,
+   a previous op's output, or a small constant. ``Tensor.numpy()`` marks
+   the break.
+2. **Compile**: the recorded graph up to the break is replayed symbolically
+   into ONE jitted program ``(state_vals, dyn_vals) -> all prefix op
+   outputs`` — XLA fuses the whole prefix.
+3. **Replay** (steady state): the compiled prefix runs first; the function
+   then executes eagerly, and each prefix-position dispatch is answered
+   from the precomputed outputs (verified against the recording — any
+   mismatch abandons replay for plain eager). Ops after the break dispatch
+   normally (each still hitting the compiled eager cache).
+
+Capture is abandoned — falling back to plain eager — when the prefix draws
+RNG (a compiled replay would freeze the randomness), records gradients
+(replayed values carry no tape), runs under AMP autocast, or never reaches
+a detectable break.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ..core import tensor as T
+from ..core import random as _random
+
+
+def _classify(leaves):
+    """Split dispatch leaves into layout tags + tensor values / statics."""
+    layout, tvals, statics = [], [], []
+    for leaf in leaves:
+        if isinstance(leaf, T.Tensor):
+            layout.append("D")
+            tvals.append(leaf._value)
+        elif isinstance(leaf, (jax.Array, np.ndarray)):
+            layout.append("D")
+            tvals.append(leaf)
+        else:
+            layout.append("S")
+            statics.append(leaf)
+    return tuple(layout), tvals, statics
+
+
+class _OpRecord:
+    __slots__ = ("fn", "name", "treedef", "layout", "statics", "prov",
+                 "out_meta", "out_treedef", "out_tpos", "out_others")
+
+    def __init__(self, fn, name, treedef, layout, statics, prov, out_meta,
+                 out_treedef, out_tpos, out_others):
+        self.fn = fn
+        self.name = name
+        self.treedef = treedef
+        self.layout = layout
+        self.statics = statics
+        self.prov = prov          # per tensor-leaf: ("in",i)|("out",i,j)|("const",v)
+        self.out_meta = out_meta  # (shape, dtype) per tensor output leaf
+        self.out_treedef = out_treedef
+        self.out_tpos = out_tpos      # leaf indices holding tensors
+        self.out_others = out_others  # [(leaf index, python value), ...]
+
+
+#: constants larger than this are not baked into a prefix (they may vary
+#: call-to-call and full-value verification would be too costly)
+_MAX_CONST = 1024
+
+
+class PrefixRecorder:
+    """Installed as core.tensor._DISPATCH_RECORDER for one eager run."""
+
+    def __init__(self, input_vals):
+        self._prov = {}
+        for i, v in enumerate(input_vals):
+            self._prov[id(v)] = ("in", i)
+        self._pins = list(input_vals)  # keep ids stable while recording
+        self.records: list[_OpRecord] = []
+        self.break_found = False
+        self.aborted = None  # reason string when capture is impossible
+
+    # -- dispatch hook -------------------------------------------------------
+    def after_op(self, fn, name, leaves, treedef, result, recorded_grad,
+                 rng_drew):
+        if self.break_found or self.aborted:
+            return
+        if recorded_grad:
+            self.aborted = "prefix records gradients"
+            return
+        if rng_drew:
+            self.aborted = "prefix draws RNG"
+            return
+        from ..amp import _state as _amp_state
+        if getattr(_amp_state, "enabled", False):
+            self.aborted = "prefix under AMP autocast"
+            return
+        layout, tvals, statics = _classify(leaves)
+        try:
+            for s in statics:
+                hash(s)
+        except TypeError:
+            self.aborted = f"unhashable static arg in {name}"
+            return
+        prov = []
+        for v in tvals:
+            p = self._prov.get(id(v))
+            if p is None:
+                if getattr(v, "size", _MAX_CONST + 1) > _MAX_CONST:
+                    self.aborted = f"large unknown-provenance tensor in {name}"
+                    return
+                p = ("const", np.asarray(v))
+            prov.append(p)
+        out_all, out_treedef = jax.tree_util.tree_flatten(
+            result, is_leaf=lambda x: isinstance(x, T.Tensor))
+        out_tpos, out_vals, out_others = [], [], []
+        for idx, x in enumerate(out_all):
+            if isinstance(x, T.Tensor):
+                out_tpos.append(idx)
+                out_vals.append(x._value)
+            else:
+                out_others.append((idx, x))
+        op_i = len(self.records)
+        for j, ov in enumerate(out_vals):
+            self._prov[id(ov)] = ("out", op_i, j)
+            self._pins.append(ov)
+        self.records.append(_OpRecord(
+            fn, name, treedef, layout, tuple(statics), tuple(prov),
+            tuple((tuple(ov.shape), str(ov.dtype)) for ov in out_vals),
+            out_treedef, tuple(out_tpos), tuple(out_others)))
+
+    # -- host-read hook ------------------------------------------------------
+    def on_host_read(self, value):
+        """Tensor.numpy()/__array__ during recording: the break point."""
+        if not self.break_found and not self.aborted:
+            self.break_found = True
+
+    def build(self):
+        """Compile the prefix program, or return None when capture failed."""
+        if self.aborted or not self.break_found or not self.records:
+            return None
+        records = list(self.records)
+
+        def prefix_fn(input_vals):
+            outs = []
+            for r in records:
+                vals, si, pi = [], iter(r.statics), iter(r.prov)
+                for tag in r.layout:
+                    if tag == "S":
+                        vals.append(next(si))
+                    else:
+                        p = next(pi)
+                        if p[0] == "in":
+                            vals.append(input_vals[p[1]])
+                        elif p[0] == "out":
+                            vals.append(outs[p[1]][p[2]])
+                        else:
+                            vals.append(p[1])
+                a, k = jax.tree_util.tree_unflatten(r.treedef, vals)
+                out = r.fn(*a, **k)  # raw jax values (dispatch fn contract)
+                raw = jax.tree_util.tree_leaves(out)
+                outs.append([raw[i] for i in r.out_tpos])
+            return outs
+
+        # NOTE: jax.jit is lazy — trace failures surface at the first call,
+        # which PrefixProgram.run converts into _ReplayAbandoned so the
+        # caller can demote to plain eager instead of crashing
+        return PrefixProgram(jax.jit(prefix_fn), records)
+
+
+class _ReplayAbandoned(Exception):
+    """The compiled prefix itself could not run (trace/compile failure).
+    Raised BEFORE any user code executes — safe to fall back to eager."""
+
+
+class PrefixProgram:
+    """Steady state: one compiled prefix + positional replay of its ops."""
+
+    def __init__(self, jitted, records):
+        self.jitted = jitted
+        self.records = records
+        self.failures = 0
+
+    def run(self, input_vals, call_fn):
+        """Execute ``call_fn`` eagerly with prefix dispatches answered from
+        the compiled program. Divergence mid-stream is NOT an error: every
+        replayed value is provenance-verified, so the replay simply ends and
+        execution continues eagerly — no re-run, no doubled side effects.
+        Returns (result, diverged)."""
+        try:
+            outs = self.jitted(input_vals)
+        except Exception as e:  # trace/compile failure (jit is lazy)
+            raise _ReplayAbandoned(str(e)) from e
+        state = _ReplayState(self.records, outs, input_vals)
+        saved = T._DISPATCH_REPLAY
+        T._DISPATCH_REPLAY = state
+        try:
+            result = call_fn()
+        finally:
+            T._DISPATCH_REPLAY = saved
+        return result, state.diverged
+
+
+class _ReplayState:
+    __slots__ = ("records", "outs", "input_vals", "i", "done", "diverged")
+
+    def __init__(self, records, outs, input_vals):
+        self.records = records
+        self.outs = outs
+        self.input_vals = input_vals
+        self.i = 0
+        self.done = False
+        self.diverged = False
+
+    def _matches(self, r, name, leaves, treedef, record):
+        if record:
+            # replayed tensors carry no tape — a grad-recording op must run
+            # eagerly (and ends the replay: its outputs' provenance is gone)
+            return False
+        layout, tvals, statics = _classify(leaves)
+        if name != r.name or layout != r.layout or treedef != r.treedef \
+                or tuple(statics) != r.statics:
+            return False
+        # PROVENANCE check: the same op name with different wiring must not
+        # replay — each tensor arg must be the exact input / prior replayed
+        # output / unchanged small constant the recording saw
+        for v, p in zip(tvals, r.prov):
+            if p[0] == "in":
+                if v is not self.input_vals[p[1]]:
+                    return False
+            elif p[0] == "out":
+                if v is not self.outs[p[1]][p[2]]:
+                    return False
+            elif not np.array_equal(np.asarray(v), p[1]):
+                return False
+        out_vals = self.outs[self.i]
+        for ov, (shape, dt) in zip(out_vals, r.out_meta):
+            if tuple(ov.shape) != shape or str(ov.dtype) != dt:
+                return False
+        return True
+
+    def try_replay(self, fn, name, leaves, treedef, record):
+        """Wrapped outputs for the next prefix op, or T._REPLAY_PASS — on
+        prefix exhaustion OR divergence (verified-correct values make ending
+        the replay early always safe; the op then dispatches eagerly)."""
+        if self.done:
+            return T._REPLAY_PASS
+        if self.i >= len(self.records):
+            self.done = True
+            return T._REPLAY_PASS
+        r = self.records[self.i]
+        if not self._matches(r, name, leaves, treedef, record):
+            self.done = True
+            self.diverged = True
+            return T._REPLAY_PASS
+        out_vals = self.outs[self.i]
+        self.i += 1
+        # rebuild the op's exact output structure from the recording
+        n = len(r.out_tpos) + len(r.out_others)
+        out_leaves = [None] * n
+        for idx, ov in zip(r.out_tpos, out_vals):
+            out_leaves[idx] = T.Tensor(ov)
+        for idx, other in r.out_others:
+            out_leaves[idx] = other
+        return jax.tree_util.tree_unflatten(r.out_treedef, out_leaves)

@@ -213,9 +213,167 @@ class TestScheduleModeSelection:
             fleet, model = self._build("ZBH1", pp=2, vpp=2)
             fleet.distributed_model(model)
 
-    def test_zbvpp_rejected_loudly(self):
-        # zero-bubble interleaved is unimplemented: must fail, not silently
-        # run plain VPP (review finding)
-        with pytest.raises(NotImplementedError, match="ZBVPP"):
-            fleet, model = self._build("ZBVPP", pp=2, vpp=2)
+    def test_zbvpp_train_batch_matches_sequential(self):
+        """ZBVPP (zero-bubble x interleaved) through the fleet runtime."""
+        fleet, model = self._build("ZBVPP", pp=2, vpp=2)
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=model.parameters())
+        pp_model = fleet.distributed_model(model)
+        assert pp_model._schedule_mode == "ZBVPP"
+        x = paddle.randn([8, 16])
+        y = paddle.randn([8, 16])
+        loss = pp_model.train_batch([x, y], opt)
+        ref = F.mse_loss(model.forward(x), y)
+        np.testing.assert_allclose(float(loss.numpy()), float(ref.numpy()),
+                                   rtol=1e-4)
+
+    def test_zbvpp_trains(self):
+        fleet, model = self._build("ZBVPP", pp=2, vpp=2)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        pp_model = fleet.distributed_model(model)
+        x = paddle.randn([8, 16])
+        y = paddle.randn([8, 16])
+        losses = [float(pp_model.train_batch([x, y], opt).numpy())
+                  for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+    def test_zbvpp_needs_virtual_chunks(self):
+        with pytest.raises(ValueError, match="num_virtual_pipeline_stages"):
+            fleet, model = self._build("ZBVPP", pp=4, vpp=1)
             fleet.distributed_model(model)
+
+
+class TestZBVPPKernel:
+    """scheduled_interleaved_pipeline vs interleaved_pipeline autodiff."""
+
+    V = 2
+
+    def _inputs_v(self):
+        rng = np.random.default_rng(5)
+        W = jnp.asarray(rng.standard_normal(
+            (S * self.V, L, D, D)).astype(np.float32) * 0.1)
+        x = jnp.asarray(rng.standard_normal((M, MB, D)).astype(np.float32))
+        dy = jnp.asarray(rng.standard_normal((M, MB, D)).astype(np.float32))
+        return {"w": W}, x, dy
+
+    def test_values_and_grads_match_interleaved_autodiff(self):
+        from paddle_tpu.distributed.pipeline import (
+            interleaved_pipeline, scheduled_interleaved_pipeline)
+        mesh = _mesh()
+        stage = _stage()
+        params, x, dy = self._inputs_v()
+        key = jax.random.key(13)
+        v0, g0 = _grad_fn(interleaved_pipeline, mesh, stage, dy,
+                          num_chunks=self.V)(params, x, key)
+        v1, g1 = _grad_fn(scheduled_interleaved_pipeline, mesh, stage, dy,
+                          num_chunks=self.V)(params, x, key)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g1["w"]), np.asarray(g0["w"]),
+                                   rtol=3e-4, atol=1e-6)
+
+    def test_deferred_w_pass_adds_no_ring_traffic(self):
+        """The ZBVPP backward = V dx rings; the V*M dw contributions run
+        ring-free — grad permute count is exactly 2x the forward's."""
+        from paddle_tpu.distributed.pipeline import (
+            scheduled_interleaved_pipeline)
+        mesh = _mesh()
+        stage = _stage()
+        params, x, dy = self._inputs_v()
+        key = jax.random.key(13)
+
+        fwd_rep = compile_report(
+            jax.jit(lambda p, xx, k: scheduled_interleaved_pipeline(
+                stage, p, xx, mesh, "pp", num_chunks=self.V)),
+            params, x, key)
+        grad_rep = compile_report(
+            _grad_fn(scheduled_interleaved_pipeline, mesh, stage, dy,
+                     num_chunks=self.V), params, x, key)
+        fwd_perms = fwd_rep.count("collective-permute")
+        grad_perms = grad_rep.count("collective-permute")
+        assert fwd_perms > 0
+        assert grad_perms == 2 * fwd_perms, (fwd_perms, grad_perms)
+
+
+class TestZBH1ScheduleArtifact:
+    def test_deferred_dw_loop_is_ring_free_and_artifact_written(self):
+        """VERDICT r2 #9: structural proof, from the OPTIMIZED HLO, that the
+        ZBH1 W-split actually frees the dw work from the ring's serial
+        chain: the compiled program contains a loop computation with matmul
+        (dot) work and ZERO collective-permutes — the deferred W pass XLA's
+        latency-hiding scheduler can overlap — while the dx chain's loops
+        carry the permutes. Evidence is written to
+        docs/artifacts/zbh1_schedule_proof.json (referenced from
+        distributed/pipeline.py's scheduled_pipeline docstring)."""
+        import json
+        import os
+        import re
+
+        mesh = _mesh()
+        stage = _stage()
+        params, x, dy = _inputs()
+        key = jax.random.key(7)
+        rep = compile_report(
+            _grad_fn(scheduled_pipeline, mesh, stage, dy, zero_bubble=True),
+            params, x, key)
+
+        # split the HLO module into computations
+        comps = {}
+        name = None
+        for line in rep.hlo.splitlines():
+            m = re.match(r"\s*%([^\s(]+)\s*\(.*\{\s*$", line)
+            if m:
+                name = m.group(1)
+                comps[name] = []
+            elif line.strip() == "}":
+                name = None
+            elif name is not None:
+                comps[name].append(line)
+
+        def closure_counts(cname, seen=None):
+            """dot/permute counts of a computation + everything it calls."""
+            seen = seen if seen is not None else set()
+            if cname in seen or cname not in comps:
+                return 0, 0
+            seen.add(cname)
+            text = "\n".join(comps[cname])
+            dots = len(re.findall(r"\bdot\(", text))
+            perms = len(re.findall(r"collective-permute", text))
+            for callee in re.findall(
+                    r"(?:calls=|to_apply=|body=|condition=)%?([^\s,)]+)",
+                    text):
+                d, p = closure_counts(callee, seen)
+                dots += d
+                perms += p
+            return dots, perms
+
+        # loop bodies = computations named as a while op's body=
+        body_names = set(re.findall(r"body=%?([^\s,)]+)", rep.hlo))
+        loops = {}
+        for cname in body_names:
+            d, p = closure_counts(cname)
+            loops[cname] = {"dots": d, "permutes": p}
+
+        dw_loops = [c for c, v in loops.items()
+                    if v["dots"] > 0 and v["permutes"] == 0]
+        ring_loops = [c for c, v in loops.items() if v["permutes"] > 0]
+        assert dw_loops, \
+            f"no ring-free compute loop found (deferred W pass missing): {loops}"
+        assert ring_loops, f"no ring loop found: {loops}"
+
+        artifact = {
+            "claim": "ZBH1 deferred-dw pass compiles into loop computations "
+                     "with matmul work and zero collective-permutes - "
+                     "independent of the dx ring chain, overlappable by "
+                     "XLA's latency-hiding scheduler",
+            "ring_free_compute_loops": {c: loops[c] for c in dw_loops},
+            "ring_loops": {c: loops[c] for c in ring_loops},
+            "config": {"stages": S, "microbatches": M, "layers_per_stage": L,
+                       "backend": jax.default_backend()},
+        }
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "docs", "artifacts",
+            "zbh1_schedule_proof.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=1)
