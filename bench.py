@@ -66,13 +66,17 @@ def _bench_other(model_name):
     if model_name == "resnet50":
         from paddle_tpu.vision.models import resnet50
         B = int(os.environ.get("BENCH_BATCH", "128"))
-        model = resnet50(num_classes=1000).bfloat16()
+        # NHWC end-to-end: the TPU-preferred conv layout (~1.5x the 3x3
+        # stack vs NCHW, no transposes anywhere); BENCH_LAYOUT=NCHW for A/Bs
+        layout = os.environ.get("BENCH_LAYOUT", "NHWC")
+        model = resnet50(num_classes=1000, data_format=layout).bfloat16()
         optimizer = opt.Momentum(learning_rate=0.1, momentum=0.9,
                                  parameters=model.parameters())
         step = TrainStep(model, lambda m, x, y: F.cross_entropy(m(x), y),
                          optimizer)
+        shape = (B, 3, 224, 224) if layout == "NCHW" else (B, 224, 224, 3)
         x = paddle.to_tensor(rng.standard_normal(
-            (B, 3, 224, 224)).astype(np.float32)).astype("bfloat16")
+            shape).astype(np.float32)).astype("bfloat16")
         y = paddle.to_tensor(rng.integers(0, 1000, B))
         dt, loss = _time_train_step(step, (x, y), steps)
         flops = 3 * 4.1e9 * B  # fwd 4.1 GFLOP/img @224 (train = 3x fwd)
@@ -85,7 +89,11 @@ def _bench_other(model_name):
         from paddle_tpu.models import BertConfig, BertForMaskedLM
         B = int(os.environ.get("BENCH_BATCH", "24"))
         S = int(os.environ.get("BENCH_SEQ", "512"))
-        cfg = BertConfig(max_position_embeddings=S)
+        cfg = BertConfig(
+            max_position_embeddings=S,
+            hidden_dropout_prob=float(os.environ.get("BENCH_DROPOUT", "0.1")),
+            attention_probs_dropout_prob=float(
+                os.environ.get("BENCH_ATTN_DROPOUT", "0.1")))
         model = BertForMaskedLM(cfg).bfloat16()
         n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
         optimizer = opt.AdamW(learning_rate=1e-4,

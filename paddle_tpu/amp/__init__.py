@@ -22,7 +22,8 @@ from ..core.tensor import Tensor, dispatch, no_grad
 WHITE_LIST = {
     "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
     "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
-    "flash_attention", "scaled_dot_product_attention",
+    "flash_attention", "flash_attention_dropout",
+    "scaled_dot_product_attention",
 }
 # ops kept in fp32 (numerically sensitive)
 BLACK_LIST = {

@@ -456,7 +456,8 @@ _BWD_JIT = None
 _DISPATCH_CACHE_MAX = 4096
 #: observability for the eager hot path (reference: the codegen'd dispatch
 #: counters); read via dispatch_cache_stats(), reset on clear
-_CACHE_STATS = {"hits": 0, "misses": 0, "evictions": 0, "bypasses": 0}
+_CACHE_STATS = {"hits": 0, "misses": 0, "evictions": 0, "bypasses": 0,
+                "evict_streak": 0}
 
 
 def dispatch_cache_stats() -> dict:
@@ -611,14 +612,24 @@ def _dispatch_cached(fn, name, cache_key, leaves, treedef, record):
         # hot entries instead of freezing the first 4096 shapes forever
         _DISPATCH_CACHE[key] = _DISPATCH_CACHE.pop(key)
         _CACHE_STATS["hits"] += 1
+        _CACHE_STATS["evict_streak"] = 0
     else:
         _CACHE_STATS["misses"] += 1
         if _DISPATCH_CACHE_MAX <= 0:
             _CACHE_STATS["bypasses"] += 1
             return _CACHE_BYPASS
+        if _CACHE_STATS["evict_streak"] > _DISPATCH_CACHE_MAX // 4:
+            # thrash guard: a working set that cycles without EVER hitting
+            # (e.g. unbucketed lengths > cache size) must not pay a jit
+            # trace+compile per dispatch — serve it from the direct path
+            # like the old insert-cap did; hits on resident entries still
+            # reset the streak and re-enable inserts
+            _CACHE_STATS["bypasses"] += 1
+            return _CACHE_BYPASS
         while len(_DISPATCH_CACHE) >= _DISPATCH_CACHE_MAX:
             _DISPATCH_CACHE.pop(next(iter(_DISPATCH_CACHE)))
             _CACHE_STATS["evictions"] += 1
+            _CACHE_STATS["evict_streak"] += 1
     if first:
         layout_t, statics_t, di = tuple(layout), tuple(statics), tuple(diff_idx)
 

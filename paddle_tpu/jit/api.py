@@ -278,6 +278,18 @@ class StaticFunction:
 
         if isinstance(entry, _PrefixEntry):
             from .prefix_capture import _ReplayAbandoned
+            from ..core.tensor import is_grad_enabled
+            # grads will record: the prefix (captured under no-grad)
+            # cannot replay — run plain eager WITHOUT executing the
+            # compiled prefix and WITHOUT counting a divergence (train/eval
+            # alternation must not demote the eval-path capture)
+            if is_grad_enabled() and (
+                    any(not p.stop_gradient for p in params)
+                    or any(isinstance(a, Tensor) and not a.stop_gradient
+                           for a in jax.tree_util.tree_leaves(
+                               (args, kwargs),
+                               is_leaf=lambda x: isinstance(x, Tensor)))):
+                return self._fn(*args, **kwargs)
             try:
                 result, diverged = entry.program.run(
                     list(state_vals) + list(dyn),

@@ -79,9 +79,27 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     # semantics are bottom-right aligned (tril k=sk-sq), which only coincide
     # when sq == sk — route unequal lengths (e.g. kv-cache decode) to the
     # XLA path.
-    if (_use_pallas(q_val) and attn_mask is None and dropout_p == 0.0
+    if (_use_pallas(q_val) and attn_mask is None and dropout_p < 1.0
             and (not is_causal or q_val.shape[1] == k_val.shape[1])):
-        from ...ops.kernels.flash_attention import flash_attention_fwd
+        from ...ops.kernels.flash_attention import (flash_attention_fwd,
+                                                    seed_carrier)
+        if dropout_p > 0.0:
+            # dropout runs INSIDE the kernel (position-hashed mask, same in
+            # fwd and bwd) — without this, every dropout-using transformer
+            # (bert/vit) would fall off the flash path onto O(S^2) einsum.
+            # The seed crosses the DISPATCH boundary as int32 so AMP's
+            # cast-all-float-leaves autocast can't corrupt the bit pattern
+            # (the op name is AMP white-listed — q/k/v still downcast).
+            seed_i = jax.lax.bitcast_convert_type(seed_carrier(dropout_key),
+                                                  jnp.int32)
+
+            def fn(q, k, v, si):
+                sf = jax.lax.bitcast_convert_type(si, jnp.float32)
+                return flash_attention_fwd(q, k, v, causal=is_causal,
+                                           dropout_p=dropout_p, seed_f=sf)
+            return dispatch(fn, (query, key, value, seed_i), {},
+                            name="flash_attention_dropout")
+
         def fn(q, k, v):
             return flash_attention_fwd(q, k, v, causal=is_causal)
         return dispatch(fn, (query, key, value), {}, name="flash_attention")

@@ -45,6 +45,25 @@ def _interpret():
     return jax.default_backend() not in ("tpu",)
 
 
+def _keep_mask(seed_i, bh_i, rows, cols, sq, sk, dropout_p):
+    """Deterministic per-ELEMENT dropout mask from the absolute (head, row,
+    col) position — a murmur3-style integer hash, so forward and backward
+    reproduce the identical mask even with DIFFERENT block tilings (the
+    bwd kernels use larger q blocks). int32 arithmetic wraps (two's
+    complement) — the few collisions from wraparound are irrelevant for
+    dropout. Uses 31 uniform bits via an unsigned-free compare."""
+    idx = (bh_i * np.int32(sq) + rows) * np.int32(sk) + cols
+    h = idx * np.int32(-1640531527) + seed_i          # 0x9E3779B9
+    h = h ^ jax.lax.shift_right_logical(h, np.int32(16))
+    h = h * np.int32(-2048144789)                     # 0x85EBCA6B
+    h = h ^ jax.lax.shift_right_logical(h, np.int32(13))
+    h = h * np.int32(-1028477387)                     # 0xC2B2AE35
+    h = h ^ jax.lax.shift_right_logical(h, np.int32(16))
+    hb = h & np.int32(0x7FFFFFFF)
+    thr = np.int32(int(dropout_p * 2147483648.0))
+    return hb >= thr
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -65,12 +84,15 @@ def _kv_block_index_map(group):
     return lambda i, j: (jax.lax.div(i, np.int32(group)), j, Z)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk,
-                sk):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                causal, bq, bk, sq, sk, dropout_p):
     bq_i, bk_i = np.int32(bq), np.int32(bk)  # i32 scalars for index math (x64 on)
     q = q_ref[0].astype(jnp.float32) * np.float32(scale)   # [bq, D]
+    bh_i = pl.program_id(0)
     jq = pl.program_id(1)
     num_kv = sk // bk
+    seed_i = jax.lax.bitcast_convert_type(seed_ref[...],
+                                          jnp.int32)[0, 0]
 
     if causal:
         # last kv block that intersects rows [jq*bq, jq*bq+bq)
@@ -86,14 +108,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk,
         s = jax.lax.dot_general(q, k.astype(jnp.float32),
                                 (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [bq, bk]
+        rows = jq * bq_i + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = kv_i * bk_i + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
-            rows = jq * bq_i + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = kv_i * bk_i + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
+        # the normalizer uses the UNmasked p: dropout applies to the
+        # normalized probabilities (reference softmax-then-dropout), and the
+        # lse must stay a dropout-free statistic for the backward
         l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_i, bh_i, rows, cols, sq, sk, dropout_p)
+            p = jnp.where(keep, p, 0.0) * np.float32(1.0 / (1.0 - dropout_p))
         acc_new = acc * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -109,18 +137,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk,
     lse_ref[0] = m + jnp.log(l)            # [bq, 1]
 
 
-def _fwd(q, k, v, causal, scale, bq, bk):
+def _fwd(q, k, v, causal, scale, bq, bk, dropout_p, seed_f):
     """q: [BHq, Sq, D]; k/v: [BHkv, Sk, D]."""
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
     group = bh // bh_kv
     grid = (bh, sq // bq)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq,
-                               bk=bk, sk=sk)
+                               bk=bk, sq=sq, sk=sk, dropout_p=dropout_p)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (Z, Z)),
             pl.BlockSpec((1, bq, d), lambda i, j: (i, j, Z)),
             pl.BlockSpec((1, sk, d), _kv_index_map(group)),
             pl.BlockSpec((1, sk, d), _kv_index_map(group)),
@@ -134,7 +163,7 @@ def _fwd(q, k, v, causal, scale, bq, bk):
             jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(seed_f, q, k, v)
     return out, lse
 
 
@@ -142,15 +171,20 @@ def _fwd(q, k, v, causal, scale, bq, bk):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, bq, bk, sq):
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, bq, bk, sq, sk,
+                    dropout_p):
     bq_i, bk_i = np.int32(bq), np.int32(bk)
     scale = np.float32(scale)
     k = k_ref[0].astype(jnp.float32)                  # [bk, D]
     v = v_ref[0].astype(jnp.float32)
+    bh_i = pl.program_id(0)
     jk = pl.program_id(1)
     num_q = sq // bq
     start = ((jk * bk_i) // bq_i).astype(jnp.int32) if causal else jnp.int32(0)
+    seed_i = jax.lax.bitcast_convert_type(seed_ref[...],
+                                          jnp.int32)[0, 0]
+    inv_keep = np.float32(1.0 / (1.0 - dropout_p)) if dropout_p > 0.0 else None
 
     D = k_ref.shape[-1]
 
@@ -162,15 +196,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, pl.ds(q_i * bq_i, bq), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)       # [bq,bk]
+        rows = q_i * bq_i + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = jk * bk_i + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
-            rows = q_i * bq_i + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = jk * bk_i + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)                                              # [bq,bk]
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        # with dropout, the weights actually used were z = keep*p/keep_prob
+        # (same position-hashed mask as the forward); d/dp gets the same
+        # mask: softmax-bwd delta is unchanged (delta = sum(do*o))
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_i, bh_i, rows, cols, sq, sk, dropout_p)
+            z = jnp.where(keep, p, 0.0) * inv_keep
+            dp = jnp.where(keep, dp, 0.0) * inv_keep
+        else:
+            z = p
+        dv = dv + jax.lax.dot_general(z, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
         ds = p * (dp - delta)                                             # [bq,bk]
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -183,19 +226,23 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   scale, causal, bq, bk, sk):
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale, causal, bq, bk, sq, sk, dropout_p):
     bq_i, bk_i = np.int32(bq), np.int32(bk)
     scale = np.float32(scale)
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]          # [bq, 1]
     delta = delta_ref[0]
+    bh_i = pl.program_id(0)
     jq = pl.program_id(1)
     num_kv = sk // bk
     limit = (jnp.minimum((jq * bq_i + bq_i + bk_i - np.int32(1)) // bk_i,
                          np.int32(num_kv)).astype(jnp.int32)
              if causal else jnp.int32(num_kv))
+    seed_i = jax.lax.bitcast_convert_type(seed_ref[...],
+                                          jnp.int32)[0, 0]
+    inv_keep = np.float32(1.0 / (1.0 - dropout_p)) if dropout_p > 0.0 else None
     D = q_ref.shape[-1]
 
     def body(kv_i, dq):
@@ -203,13 +250,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         v = v_ref[0, pl.ds(kv_i * bk_i, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        rows = jq * bq_i + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = kv_i * bk_i + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
-            rows = jq * bq_i + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = kv_i * bk_i + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_i, bh_i, rows, cols, sq, sk, dropout_p)
+            dp = jnp.where(keep, dp, 0.0) * inv_keep
         ds = p * (dp - delta)
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
@@ -219,7 +269,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal, scale, bq, bk):
+def _bwd(q, k, v, o, lse, do, causal, scale, bq, bk, dropout_p, seed_f):
     bh, sq, d = q.shape
     bh_kv, sk, _ = k.shape
     group = bh // bh_kv
@@ -227,12 +277,14 @@ def _bwd(q, k, v, o, lse, do, causal, scale, bq, bk):
                     keepdims=True)  # [BH, Sq, 1]
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                                   bq=bq, bk=bk, sq=sq)
+                                   bq=bq, bk=bk, sq=sq, sk=sk,
+                                   dropout_p=dropout_p)
     # dk/dv computed per Q-head then summed over the GQA group
     dk_h, dv_h = pl.pallas_call(
         dkv_kernel,
         grid=(bh, sk // bk),
         in_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (Z, Z)),
             pl.BlockSpec((1, sq, d), lambda i, j: (i, Z, Z)),
             pl.BlockSpec((1, bk, d), _kv_block_index_map(group)),
             pl.BlockSpec((1, bk, d), _kv_block_index_map(group)),
@@ -249,7 +301,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, bq, bk):
             jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(seed_f, q, k, v, do, lse, delta)
     if group > 1:
         dk = dk_h.reshape(bh_kv, group, sk, d).sum(axis=1).astype(k.dtype)
         dv = dv_h.reshape(bh_kv, group, sk, d).sum(axis=1).astype(v.dtype)
@@ -257,11 +309,13 @@ def _bwd(q, k, v, o, lse, do, causal, scale, bq, bk):
         dk, dv = dk_h.astype(k.dtype), dv_h.astype(v.dtype)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                                  bq=bq, bk=bk, sk=sk)
+                                  bq=bq, bk=bk, sq=sq, sk=sk,
+                                  dropout_p=dropout_p)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, sq // bq),
         in_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (Z, Z)),
             pl.BlockSpec((1, bq, d), lambda i, j: (i, j, Z)),
             pl.BlockSpec((1, sk, d), _kv_index_map(group)),
             pl.BlockSpec((1, sk, d), _kv_index_map(group)),
@@ -272,7 +326,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, bq, bk):
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, Z)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(seed_f, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -298,13 +352,38 @@ def _pick_blocks(s, default):
     return max(blk, 1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention_fwd(q, k, v, causal=False, scale=None):
-    out, _ = _flash_fwd_res(q, k, v, causal, scale)
+def _zero_seed():
+    # host constant, NEVER a cached jnp array: the first call can happen
+    # inside a trace (remat/jit) and a cached tracer would leak out of it
+    return np.zeros((1, 1), np.float32)
+
+
+def seed_carrier(key):
+    """Fold a jax PRNG key into the (1,1) f32 bit-carrier the kernels take
+    (f32 so it can pass through custom_vjp with a plain zero cotangent;
+    kernels bitcast it back to int32 for the position-hashed dropout)."""
+    bits = jax.random.bits(key, (1, 1), jnp.uint32)
+    return jax.lax.bitcast_convert_type(bits.astype(jnp.uint32),
+                                        jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core(q, k, v, causal, scale, dropout_p, seed_f):
+    out, _ = _flash_fwd_res(q, k, v, causal, scale, dropout_p, seed_f)
     return out
 
 
-def _flash_fwd_res(q, k, v, causal, scale):
+def flash_attention_fwd(q, k, v, causal=False, scale=None, dropout_p=0.0,
+                        seed_f=None):
+    """Flash attention with optional in-kernel dropout. ``seed_f``: the
+    (1,1) f32 bit-carrier from :func:`seed_carrier` (required when
+    dropout_p > 0 and training randomness should vary per step)."""
+    if seed_f is None:
+        seed_f = _zero_seed()
+    return _flash_core(q, k, v, causal, scale, float(dropout_p), seed_f)
+
+
+def _flash_fwd_res(q, k, v, causal, scale, dropout_p=0.0, seed_f=None):
     # kernel masks top-left aligned; bottom-right (paddle) semantics only
     # coincide for equal lengths — hard error beats silent corruption.
     assert not causal or q.shape[1] == k.shape[1], \
@@ -312,33 +391,36 @@ def _flash_fwd_res(q, k, v, causal, scale):
         "through scaled_dot_product_attention's XLA path)"
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if seed_f is None:
+        seed_f = _zero_seed()
     q3, bhq = _to_bhsd(q)
     k3, _ = _to_bhsd(k)
     v3, _ = _to_bhsd(v)
     bq = _pick_blocks(q3.shape[1], DEFAULT_BLOCK_Q)
     bk = _pick_blocks(k3.shape[1], DEFAULT_BLOCK_K)
-    o3, lse = _fwd(q3, k3, v3, causal, scale, bq, bk)
+    o3, lse = _fwd(q3, k3, v3, causal, scale, bq, bk, dropout_p, seed_f)
     out = _from_bhsd(o3, bhq)
-    return out, (q3, k3, v3, o3, lse, bhq, scale)
+    return out, (q3, k3, v3, o3, lse, bhq, scale, seed_f)
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale):
-    out, res = _flash_fwd_res(q, k, v, causal, scale)
+def _flash_vjp_fwd(q, k, v, causal, scale, dropout_p, seed_f):
+    out, res = _flash_fwd_res(q, k, v, causal, scale, dropout_p, seed_f)
     return out, res
 
 
-def _flash_vjp_bwd(causal, scale_arg, res, g):
-    q3, k3, v3, o3, lse, bhq, scale = res
+def _flash_vjp_bwd(causal, scale_arg, dropout_p, res, g):
+    q3, k3, v3, o3, lse, bhq, scale, seed_f = res
     b, h = bhq
     do3, _ = _to_bhsd(g)
     bq_b = _pick_blocks(q3.shape[1], DEFAULT_BLOCK_Q_BWD)
     bk_b = _pick_blocks(k3.shape[1], DEFAULT_BLOCK_K_BWD)
-    dq3, dk3, dv3 = _bwd(q3, k3, v3, o3, lse, do3, causal, scale, bq_b, bk_b)
+    dq3, dk3, dv3 = _bwd(q3, k3, v3, o3, lse, do3, causal, scale, bq_b, bk_b,
+                         dropout_p, seed_f)
     kv_h = k3.shape[0] // b
     dq = _from_bhsd(dq3, (b, h))
     dk = _from_bhsd(dk3, (b, kv_h))
     dv = _from_bhsd(dv3, (b, kv_h))
-    return dq, dk, dv
+    return dq, dk, dv, jnp.zeros_like(seed_f)
 
 
-flash_attention_fwd.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash_core.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

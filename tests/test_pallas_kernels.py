@@ -76,3 +76,110 @@ def test_flash_backward_gqa():
     for a, b_ in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=5e-3,
                                    atol=5e-3)
+
+
+class TestFlashDropout:
+    """In-kernel attention dropout: the position-hashed mask must be
+    reproducible (numpy replica), identical between fwd and bwd (grads match
+    an einsum reference using the SAME mask), and deterministic per seed."""
+
+    B, S, H, D = 1, 256, 2, 64
+    P = 0.3
+    SEED = np.int32(987654321)
+
+    @staticmethod
+    def _np_keep(seed, bh, rows, cols, sq, sk, p):
+        with np.errstate(over="ignore"):
+            idx = (np.int32(bh) * np.int32(sq) + rows.astype(np.int32)) \
+                * np.int32(sk) + cols.astype(np.int32)
+            h = (idx * np.int32(-1640531527) + seed).astype(np.int32)
+            h = h ^ ((h.view(np.uint32) >> 16).view(np.int32))
+            h = (h * np.int32(-2048144789)).astype(np.int32)
+            h = h ^ ((h.view(np.uint32) >> 13).view(np.int32))
+            h = (h * np.int32(-1028477387)).astype(np.int32)
+            h = h ^ ((h.view(np.uint32) >> 16).view(np.int32))
+            hb = h & np.int32(0x7FFFFFFF)
+        return hb >= np.int32(int(p * 2147483648.0))
+
+    def _seed_f(self):
+        return jax.lax.bitcast_convert_type(
+            jnp.asarray([[self.SEED]], jnp.int32), jnp.float32)
+
+    def _qkv(self):
+        rng = np.random.default_rng(0)
+        mk = lambda: jnp.asarray(rng.standard_normal(
+            (self.B, self.S, self.H, self.D)).astype(np.float32))
+        return mk(), mk(), mk()
+
+    def _reference(self, q, k, v, causal):
+        B, S, H, D = self.B, self.S, self.H, self.D
+        scale = 1.0 / np.sqrt(D)
+        qh = jnp.swapaxes(q, 1, 2)            # [B,H,S,D]
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        if causal:
+            mask = np.tril(np.ones((S, S), bool))
+            s = jnp.where(mask, s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1)
+        rows, cols = np.meshgrid(np.arange(S), np.arange(S), indexing="ij")
+        keep = np.stack([np.stack([self._np_keep(self.SEED, b * H + h,
+                                                 rows, cols, S, S, self.P)
+                                   for h in range(H)]) for b in range(B)])
+        z = jnp.where(jnp.asarray(keep), probs, 0.0) / (1.0 - self.P)
+        out = jnp.einsum("bhqk,bhkd->bhqd", z, vh)
+        return jnp.swapaxes(out, 1, 2)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_mask_exact_reference(self, causal):
+        from paddle_tpu.ops.kernels.flash_attention import flash_attention_fwd
+        q, k, v = self._qkv()
+        out = flash_attention_fwd(q, k, v, causal=causal, dropout_p=self.P,
+                                  seed_f=self._seed_f())
+        ref = self._reference(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_grads_match_mask_exact_reference(self):
+        from paddle_tpu.ops.kernels.flash_attention import flash_attention_fwd
+        q, k, v = self._qkv()
+        w = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (self.B, self.S, self.H, self.D)).astype(np.float32))
+
+        def f_kernel(q, k, v):
+            return jnp.vdot(flash_attention_fwd(
+                q, k, v, causal=True, dropout_p=self.P,
+                seed_f=self._seed_f()), w)
+
+        def f_ref(q, k, v):
+            return jnp.vdot(self._reference(q, k, v, True), w)
+
+        gk = jax.grad(f_kernel, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=3e-5)
+
+    def test_deterministic_per_seed_and_varies_across_seeds(self):
+        from paddle_tpu.ops.kernels.flash_attention import flash_attention_fwd
+        q, k, v = self._qkv()
+        a = flash_attention_fwd(q, k, v, dropout_p=self.P,
+                                seed_f=self._seed_f())
+        b = flash_attention_fwd(q, k, v, dropout_p=self.P,
+                                seed_f=self._seed_f())
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        other = jax.lax.bitcast_convert_type(
+            jnp.asarray([[np.int32(1234)]], jnp.int32), jnp.float32)
+        c = flash_attention_fwd(q, k, v, dropout_p=self.P, seed_f=other)
+        assert not np.allclose(np.asarray(a), np.asarray(c))
+
+    def test_sdpa_routes_dropout_to_flash_on_tpu_backends(self):
+        """The functional API must keep the flash path with dropout>0 (the
+        whole point); on CPU it still uses the einsum fallback."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.core.tensor import Tensor
+        q, k, v = self._qkv()
+        out = F.scaled_dot_product_attention(Tensor(q), Tensor(k), Tensor(v),
+                                             dropout_p=0.1, training=True)
+        assert tuple(out.shape) == (self.B, self.S, self.H, self.D)
+        assert np.isfinite(out.numpy()).all()

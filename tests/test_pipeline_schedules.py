@@ -371,9 +371,13 @@ class TestZBH1ScheduleArtifact:
             "config": {"stages": S, "microbatches": M, "layers_per_stage": L,
                        "backend": jax.default_backend()},
         }
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "docs", "artifacts",
-            "zbh1_schedule_proof.json")
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(artifact, f, indent=1)
+        # the committed artifact regenerates only on explicit request — a
+        # test run must not dirty the source tree (or fail on a read-only
+        # checkout) just because the backend's loop names differ
+        if os.environ.get("PT_WRITE_ARTIFACTS") == "1":
+            path = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "docs", "artifacts",
+                "zbh1_schedule_proof.json")
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(artifact, f, indent=1)

@@ -280,3 +280,20 @@ class TestDatasets:
     def test_gated_error(self):
         with pytest.raises(RuntimeError, match="downloads are disabled"):
             D.MNIST()
+
+
+def test_resnet_nhwc_matches_nchw():
+    """Channels-last resnet (TPU-preferred layout) computes the same
+    function: same weights, transposed input, equal logits."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet18
+    paddle.seed(0)
+    m1 = resnet18(num_classes=10)
+    m2 = resnet18(num_classes=10, data_format="NHWC")
+    m2.set_state_dict(m1.state_dict())
+    m1.eval(); m2.eval()
+    x = np.random.default_rng(0).standard_normal((2, 3, 32, 32)).astype("float32")
+    y1 = m1(paddle.to_tensor(x)).numpy()
+    y2 = m2(paddle.to_tensor(np.transpose(x, (0, 2, 3, 1)))).numpy()
+    np.testing.assert_allclose(y1, y2, rtol=2e-4, atol=2e-4)
