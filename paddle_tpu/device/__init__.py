@@ -128,3 +128,6 @@ def IPUPlace():
 
 
 
+
+
+from .plugin import register_custom_runtime, list_custom_runtimes  # noqa: F401,E402

@@ -1,0 +1,50 @@
+"""CustomRuntime device plugins (reference: paddle/phi/backends/device_ext.h
+— the C ABI third-party accelerators fill with function pointers, registered
+through CustomRuntime/custom_device).
+
+TPU-native mapping: the PJRT plugin interface IS the XLA world's
+device-plugin ABI. A vendor ships a ``libpjrt_<name>.so`` implementing the
+PJRT C API; registering it here makes the platform visible to the runtime
+(``jax.devices()``, ``paddle.set_device``) exactly like the reference's
+CustomPlace devices. No framework recompilation, same plug-in contract.
+"""
+from __future__ import annotations
+
+import os
+
+_REGISTERED: dict[str, str] = {}
+
+
+def register_custom_runtime(name: str, library_path: str, options=None):
+    """Register a PJRT plugin as a custom device runtime.
+
+    name: platform name (becomes the device type, e.g. ``set_device(name)``).
+    library_path: path to the plugin's PJRT C-API shared library.
+    options: optional dict of plugin creation options.
+
+    Must be called before the backend initializes (first device use) —
+    the same constraint the reference's CustomRuntime registration has
+    (plugins load at phi backend init).
+    """
+    if not isinstance(name, str) or not name:
+        raise ValueError("custom runtime name must be a non-empty string")
+    if name in ("cpu", "tpu", "gpu", "cuda"):
+        raise ValueError(f"{name!r} is a built-in platform, not a plugin")
+    if not os.path.exists(library_path):
+        raise FileNotFoundError(
+            f"CustomRuntime plugin library not found: {library_path}")
+    from jax._src import xla_bridge
+    if hasattr(xla_bridge, "backends_are_initialized") \
+            and xla_bridge.backends_are_initialized():
+        raise RuntimeError(
+            "register_custom_runtime must run before the first device use "
+            "(the PJRT backend set is fixed at initialization)")
+    xla_bridge.register_plugin(name, library_path=library_path,
+                               options=options)
+    _REGISTERED[name] = library_path
+    return name
+
+
+def list_custom_runtimes() -> dict:
+    """Plugins registered through :func:`register_custom_runtime`."""
+    return dict(_REGISTERED)

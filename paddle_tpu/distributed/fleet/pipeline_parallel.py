@@ -68,6 +68,9 @@ class PipelineParallel:
         #   W-split: dx-only on the serial ring chain, dw in a ring-free
         #   deferred pass (memory-for-bubble trade, like the reference).
         # - VPP: interleaved_pipeline virtual chunks (needs V > 1).
+        # - ZBVPP: scheduled_interleaved_pipeline — the ZBH1 W-split composed
+        #   with the V-chunk loop (V dx-only reverse rings + a ring-free
+        #   deferred V*M dw pass).
         raw_mode = (strategy.pipeline_configs.get("schedule_mode")
                     if strategy else None)
         self._schedule_mode = (raw_mode or "FTHENB").upper().replace("-", "")
@@ -160,12 +163,27 @@ class PipelineParallel:
         self._stacked = stacked
 
     def sync_to_layers(self):
-        """Unstack trained body params back into the per-layer Parameters."""
+        """Unstack trained body params back into the per-layer Parameters.
+
+        Stays ON DEVICE (reshape + slice of the pp-sharded stacked array) —
+        the old np.asarray round-trip copied the whole body to host and back
+        on every eval_batch. No-ops when the stacked values haven't changed
+        since the last sync (identity check), so eval inside a train loop
+        pays nothing extra per step."""
+        # hold the ARRAYS (not bare ids — a freed ArrayImpl's address can be
+        # recycled, falsely matching) and compare by identity
+        prev = getattr(self, "_synced_vals", None)
+        if prev is not None and len(prev) == len(self._stacked) and \
+                all(prev.get(n) is sp._value
+                    for n, sp in self._stacked.items()):
+            return
         for n, sp in self._stacked.items():
-            flat = np.asarray(sp._value).reshape(
+            flat = jnp.reshape(
+                sp._value,
                 (len(self._body),) + tuple(sp._value.shape[2:]))
             for i, layer in enumerate(self._body):
-                dict(layer.named_parameters())[n]._value = jnp.asarray(flat[i])
+                dict(layer.named_parameters())[n]._value = flat[i]
+        self._synced_vals = {n: sp._value for n, sp in self._stacked.items()}
 
     # -- parameters -----------------------------------------------------------
     def parameters(self, include_sublayers=True):

@@ -646,14 +646,24 @@ class TrainStep:
         return out
 
     def _acc_shardings(self):
-        """Per-param AccPlacement for grad accumulators, from a ZeRO-2+
-        sharding optimizer wrapper (None = keep replicated). Keyed by the
-        param object so the wrapper's plan ordering doesn't have to match
-        ours."""
+        """Per-param placement for grad accumulators: the ZeRO-2+ wrapper's
+        AccPlacement when present (keyed by the param object), else the
+        PARAM's own sharding — under TP, a grad has the param's placement,
+        and a replicated fp32 accumulator would cost full bytes per device
+        (27 GB at 7B scale). None = keep replicated."""
+        from jax.sharding import NamedSharding
+        from ..distributed.fleet.sharding_optimizer import AccPlacement
         placement = getattr(self.optimizer, "_grad_placement", None)
-        if placement is None:
-            return [None] * len(self.params)
-        return [placement(p) for p in self.params]
+        out = []
+        for p in self.params:
+            sh = placement(p) if placement is not None else None
+            if sh is None:
+                psh = getattr(p._value, "sharding", None)
+                if isinstance(psh, NamedSharding) and psh.spec is not None \
+                        and any(s is not None for s in tuple(psh.spec)):
+                    sh = AccPlacement(psh, False, 0)
+            out.append(sh)
+        return out
 
     # -- gradient-accumulation path ------------------------------------------
     def _call_accumulate(self, *batch):

@@ -1,0 +1,92 @@
+"""TensorArray ops (reference: python/paddle/tensor/array.py).
+
+The reference's dygraph TensorArray IS a python list (array.py:71 asserts
+``isinstance(array, list)`` in dynamic mode); the DENSE_TENSOR_ARRAY variable
+only exists for the legacy static graph. TPU-native mapping:
+
+- eager / concrete index: plain list semantics, bit-for-bit the reference's
+  dygraph behavior (append at i == len, overwrite at i < len).
+- traced dynamic index (inside jit/to_static): a list of same-shaped traced
+  tensors reads via stack + ``lax.dynamic_index_in_dim`` — the
+  compiler-friendly form of the static TensorArray read (no host sync, no
+  data-dependent python).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dtype import convert_dtype
+
+__all__ = ["array_length", "array_read", "array_write", "create_array"]
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """A TensorArray: in dygraph, a python list (reference array.py:309)."""
+    arr = []
+    if initialized_list is not None:
+        for x in initialized_list:
+            if not isinstance(x, Tensor):
+                x = Tensor(jnp.asarray(x, convert_dtype(dtype)))
+            arr.append(x)
+    return arr
+
+
+def array_length(array):
+    """Length of the array as a 0-D int64 Tensor (reference array.py:43)."""
+    if not isinstance(array, list):
+        raise TypeError("array_length expects a list (dygraph TensorArray)")
+    return Tensor(jnp.asarray(len(array), jnp.int64))
+
+
+def _index(i):
+    v = i._value if isinstance(i, Tensor) else i
+    if isinstance(v, jax.core.Tracer):
+        return v, True
+    return int(jnp.reshape(v, ()) if hasattr(v, "shape") else v), False
+
+
+def array_read(array, i):
+    """array[i] (reference array.py:110). A TRACED index lowers to
+    stack + dynamic_index_in_dim so reads stay inside the compiled program."""
+    if not isinstance(array, list):
+        raise TypeError("array_read expects a list (dygraph TensorArray)")
+    idx, traced = _index(i)
+    if not traced:
+        return array[idx]
+    from ..core.tensor import dispatch
+
+    def fn(iv, *vals):
+        stacked = jnp.stack(vals)
+        return jax.lax.dynamic_index_in_dim(
+            stacked, jnp.reshape(iv, ()).astype(jnp.int32), 0,
+            keepdims=False)
+
+    return dispatch(fn, (i, *array), {}, name="array_read")
+
+
+def array_write(x, i, array=None):
+    """Write ``x`` at position ``i`` (append when i == len). Returns the
+    array (reference array.py:206)."""
+    if array is None:
+        array = []
+    if not isinstance(array, list):
+        raise TypeError("array_write expects a list (dygraph TensorArray)")
+    idx, traced = _index(i)
+    if traced:
+        raise ValueError(
+            "array_write with a traced index is data-dependent list "
+            "mutation — hoist the write out of the compiled region or use "
+            "a concrete index (the reference's dygraph mode has the same "
+            "host-index contract, array.py:258)")
+    if not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x))
+    if idx > len(array):
+        raise IndexError(
+            f"array_write index {idx} out of range (len {len(array)})")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array

@@ -12,3 +12,5 @@ from ..ops.linalg import *  # noqa: F401,F403
 from ..ops.logic import *  # noqa: F401,F403
 from ..ops.einsum import einsum  # noqa: F401
 from ..ops.creation import to_tensor, assign  # noqa: F401
+from ..ops.array import (array_length, array_read, array_write,  # noqa: F401
+                         create_array)

@@ -275,3 +275,41 @@ def test_lazyguard_abstract_then_materialize():
     assert np.abs(w).sum() > 0
     out = model(paddle.to_tensor(np.array([[1, 2, 3]], np.int32)))
     assert tuple(out.shape) == (1, 3, cfg.vocab_size)
+
+
+def test_7b_tp8_accumulation_compiles_and_fits():
+    """The flagship bench config at full scale: TP=8, ZeRO-1 state sharding,
+    bf16 moments, gradient accumulation. aot_compile returns the
+    (microstep, update) program pair; BOTH must fit — the microstep carries
+    the persistent fp32 accumulators (which inherit the param's TP sharding:
+    replicated they alone would be 27 GB/device), the update carries the
+    optimizer state."""
+    from paddle_tpu.core.flags import set_flags
+    hcg = _fleet_init(dp=1, mp=N_DEV, sharding=1)
+    mesh = hcg.mesh.jax_mesh()
+    set_flags({"adamw_bf16_moments": True})
+    try:
+        model, optimizer, batch = _build_7b(mesh, batch_spec=P())
+        wrapped = fleet.DygraphShardingOptimizer(optimizer, hcg, axis="mp",
+                                                 stage=1)
+        assert wrapped._stage == 1
+        step = TrainStep(model, _loss_fn, optimizer, donate=True,
+                         accumulate_steps=2)
+        grad_c, upd_c = step.aot_compile(*batch)
+        g_args = int(grad_c.memory_analysis().argument_size_in_bytes)
+        u_args = int(upd_c.memory_analysis().argument_size_in_bytes)
+        residuals = _residual_bytes(step, batch)
+        print(json.dumps({"event": "7b_scale_proof",
+                          "config": "tp8_accum2_bf16moments",
+                          "microstep_args_per_dev": g_args,
+                          "update_args_per_dev": u_args,
+                          "residual_bytes_conservative": residuals}))
+        assert g_args + residuals <= V5E_HBM, \
+            f"microstep does not fit: {(g_args + residuals)/1e9:.2f} GB"
+        assert u_args <= V5E_HBM, f"update does not fit: {u_args/1e9:.2f} GB"
+        # accumulators must NOT be replicated: microstep args = params(1/8)
+        # + accs + batch + rope. Replicated accs alone would be ~27 GB.
+        assert g_args <= 8e9, \
+            f"accumulators replicated? microstep args {g_args/1e9:.2f} GB"
+    finally:
+        set_flags({"adamw_bf16_moments": False})

@@ -167,3 +167,79 @@ class TestTopLevelGlue:
         assert batches == [[0, 1, 2], [3, 4, 5], [6]]
         batches = list(P.batch(reader, 3, drop_last=True)())
         assert batches == [[0, 1, 2], [3, 4, 5]]
+
+
+class TestTensorArray:
+    """paddle.tensor TensorArray ops (reference: python/paddle/tensor/array.py
+    — the dygraph TensorArray IS a python list; traced reads lower to
+    stack + dynamic_index)."""
+
+    def test_write_read_length_roundtrip(self):
+        import paddle_tpu as paddle
+        t = paddle.tensor
+        arr = t.create_array(dtype="float32")
+        x = paddle.full([3, 3], 5.0, "float32")
+        i = paddle.zeros([1], "int32")
+        arr = t.array_write(x, i, array=arr)
+        assert int(t.array_length(arr).numpy()) == 1
+        got = t.array_read(arr, i)
+        np.testing.assert_allclose(got.numpy(), 5 * np.ones((3, 3)))
+        # append at i == len, overwrite at i < len
+        arr = t.array_write(x * 2, paddle.to_tensor([1]), array=arr)
+        arr = t.array_write(x * 3, paddle.to_tensor([0]), array=arr)
+        assert int(t.array_length(arr).numpy()) == 2
+        np.testing.assert_allclose(t.array_read(arr, 0).numpy(),
+                                   15 * np.ones((3, 3)))
+
+    def test_initialized_list_and_bounds(self):
+        import paddle_tpu as paddle
+        t = paddle.tensor
+        arr = t.create_array("float32", [np.ones(2, np.float32),
+                                         np.zeros(2, np.float32)])
+        assert int(t.array_length(arr).numpy()) == 2
+        with pytest.raises(IndexError):
+            t.array_write(paddle.ones([2]), 5, array=arr)
+
+    def test_traced_dynamic_index_read(self):
+        """Inside a compiled region, array_read with a TRACED index stays in
+        the program (stack + dynamic_index) instead of breaking the trace."""
+        import paddle_tpu as paddle
+        from paddle_tpu.jit import to_static
+        t = paddle.tensor
+        arr = t.create_array("float32",
+                             [np.full(4, float(k), np.float32)
+                              for k in range(5)])
+
+        @to_static
+        def pick(sel):
+            idx = paddle.argmax(sel)        # traced index
+            return t.array_read(arr, idx) * 2.0
+
+        sel = paddle.to_tensor(np.array([0.0, 0.0, 9.0, 0.0, 0.0],
+                                        np.float32))
+        np.testing.assert_allclose(pick(sel).numpy(), 4.0 * np.ones(4))
+        assert len(pick._cache) == 1  # compiled, no fallback entry
+
+
+class TestCustomRuntimePlugin:
+    """CustomRuntime registration (reference: phi/backends/device_ext.h C ABI
+    -> TPU-native PJRT plugin registration)."""
+
+    def test_validation(self):
+        import paddle_tpu.device as device
+        with pytest.raises(ValueError):
+            device.register_custom_runtime("cpu", "/nonexistent.so")
+        with pytest.raises(FileNotFoundError):
+            device.register_custom_runtime("mynpu", "/nonexistent.so")
+        with pytest.raises(ValueError):
+            device.register_custom_runtime("", "/nonexistent.so")
+
+    def test_post_init_registration_rejected(self, tmp_path):
+        import jax
+        import paddle_tpu.device as device
+        jax.devices()  # force backend init
+        fake = tmp_path / "libpjrt_fake.so"
+        fake.write_bytes(b"\x7fELF")
+        with pytest.raises(RuntimeError, match="before the first device"):
+            device.register_custom_runtime("mynpu", str(fake))
+        assert "mynpu" not in device.list_custom_runtimes()
