@@ -296,9 +296,22 @@ def main():
     model = LlamaForCausalLM(cfg).bfloat16()
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
 
-    if os.environ.get("BENCH_OPT", "adamw") == "sgd":
+    bench_opt = os.environ.get("BENCH_OPT", "adamw")
+    if bench_opt == "sgd":
         optimizer = opt.SGD(learning_rate=3e-4, parameters=model.parameters(),
                             multi_precision=False)
+    elif bench_opt == "adamw_sr":
+        # master-weight-FREE AdamW: bf16 params + moments + in-kernel
+        # stochastic rounding — 6 B/param of optimizer state (vs 14 with
+        # masters). Measured: throughput TIES the master chain on this chip
+        # (optimizer traffic is latency-hidden); the win is the ~6.7 GB of
+        # freed HBM at 7B scale (see tests/test_7b_scale.py SR footprint)
+        from paddle_tpu.core.flags import set_flags
+        set_flags({"adamw_stochastic_rounding": True,
+                   "adamw_bf16_moments": True})
+        optimizer = opt.AdamW(learning_rate=3e-4,
+                              parameters=model.parameters(),
+                              weight_decay=0.01, multi_precision=False)
     else:
         optimizer = opt.AdamW(learning_rate=3e-4,
                               parameters=model.parameters(),
@@ -317,9 +330,12 @@ def main():
     labels = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, size=(B, S)), dtype="int32")
 
-    # warmup / compile one full accumulation cycle (sync via scalar host
-    # fetch: the tunnel's block_until_ready is a no-op)
-    for _ in range(accum):
+    # warmup / compile TWO full accumulation cycles (sync via scalar host
+    # fetch: the tunnel's block_until_ready is a no-op). Two, not one: paths
+    # whose first call returns donated outputs in a different layout (e.g.
+    # pallas-written params) trigger a one-time recompile on the SECOND
+    # call, which must not land inside the d1 timing window.
+    for _ in range(2 * accum):
         loss = step(ids, labels)
     final_loss = float(np.asarray(loss._value))
 
@@ -338,6 +354,9 @@ def main():
     final_loss = float(np.asarray(loss._value))
     dn = time.perf_counter() - t0
 
+    import sys
+    print(f"[bench debug] d1={d1:.3f}s dn={dn:.3f}s cycles={cycles}",
+          file=sys.stderr)
     dt = max(dn - d1, 1e-9)
     tokens_per_sec = cycles * accum * B * S / dt
     flops_per_token = model.flops_per_token(S)

@@ -113,6 +113,13 @@ define_flag("adamw_bf16_moments", False,
             "fp32 via upcast) — halves optimizer-state HBM traffic at a "
             "small stochastic-rounding cost; off by default to keep "
             "reference-exact trajectories")
+define_flag("adamw_stochastic_rounding", False,
+            "master-weight-FREE Adam/AdamW for bf16 params (multi_precision "
+            "False): the fused Pallas kernel does fp32 math in VMEM and "
+            "stochastically rounds the param write (E[round(x)]=x), so bf16 "
+            "weights integrate small updates without an fp32 master copy — "
+            "no master residency and ~36% less optimizer HBM traffic; off "
+            "by default (changes trajectories vs the fp32-master reference)")
 define_flag("check_nan_inf_level", 0, "0: error on nan/inf; 1: warn; 3: report fp16 overflow too")
 define_flag("benchmark", False, "synchronize after every op dispatch (op-level timing)")
 define_flag("eager_op_jit", True, "route eager op dispatch through a cached jax.jit per op signature")

@@ -465,7 +465,8 @@ class TrainStep:
         from ..core.flags import flag_value
         key = (static_key, layout, treedef,
                tuple((tuple(v.shape), str(v.dtype)) for v in dyn),
-               bool(flag_value("use_fused_adamw")))
+               bool(flag_value("use_fused_adamw")),
+               bool(flag_value("adamw_stochastic_rounding")))
 
         if key not in self._cache:
             self._cache[key] = self._build_step_jit(static_key, layout,
@@ -564,7 +565,8 @@ class TrainStep:
         from ..core.flags import flag_value
         key = (static_key, layout, treedef,
                tuple((tuple(v.shape), str(v.dtype)) for v in dyn),
-               bool(flag_value("use_fused_adamw")))
+               bool(flag_value("use_fused_adamw")),
+               bool(flag_value("adamw_stochastic_rounding")))
         self._cache.setdefault(key, jitted)
         slot_vals = [opt._slots[id(p)] for p in self.params]
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
@@ -693,7 +695,9 @@ class TrainStep:
                 static_key, layout, treedef, placements)
 
         from ..core.flags import flag_value
-        update_key = (bool(flag_value("use_fused_adamw")), placements)
+        update_key = (bool(flag_value("use_fused_adamw")),
+                      bool(flag_value("adamw_stochastic_rounding")),
+                      placements)
         if self._update_fn is None or getattr(self, "_update_key", None) \
                 != update_key:
             self._update_key = update_key

@@ -30,6 +30,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+Z = np.int32(0)  # i32 index-map literal (x64 is on)
+
+
 def _interpret():
     return jax.default_backend() not in ("tpu",)
 
@@ -214,3 +217,128 @@ def fused_adamw_update_sharded(mesh, spec, p_low, g, m, v, master, lr, step,
                       in_specs=(ps, ps, ps, ps, ps, rep, rep),
                       out_specs=(ps, ps, ps, ps), check_vma=False)
     return f(p_low, g, m, v, master, jnp.asarray(lr), jnp.asarray(step))
+
+
+# ---------------------------------------------------------------------------
+# master-weight-free AdamW with stochastic rounding
+# ---------------------------------------------------------------------------
+
+def _sr_round_bf16(x_f32, seed_i, base_idx):
+    """Stochastically round fp32 -> bf16: add position-hashed uniform bits
+    below the bf16 mantissa cut, then truncate. E[round(x)] == x, which is
+    what lets bf16 params integrate small updates WITHOUT an fp32 master
+    copy (the classic TPU trick; reference keeps fp32 masters instead)."""
+    bits = jax.lax.bitcast_convert_type(x_f32, jnp.int32)
+    h = base_idx * np.int32(-1640531527) + seed_i
+    h = h ^ jax.lax.shift_right_logical(h, np.int32(16))
+    h = h * np.int32(-2048144789)
+    h = h ^ jax.lax.shift_right_logical(h, np.int32(13))
+    h = h * np.int32(-1028477387)
+    h = h ^ jax.lax.shift_right_logical(h, np.int32(16))
+    r16 = h & np.int32(0xFFFF)
+    rounded = (bits + r16) & np.int32(-65536)   # keep the top 16 bits
+    return jax.lax.bitcast_convert_type(rounded, jnp.float32) \
+        .astype(jnp.bfloat16)
+
+
+def _adamw_sr_kernel(scal_ref, seed_ref, g_ref, p_ref, m_ref, v_ref,
+                     om_ref, ov_ref, op_ref, *, beta1, beta2, eps, bi, cols):
+    alpha = scal_ref[0, 0]   # lr / bias_correction1
+    c2 = scal_ref[0, 1]      # 1 / sqrt(bias_correction2)
+    lrwd = scal_ref[0, 2]    # lr * weight_decay (0 when decay masked off)
+    seed_i = jax.lax.bitcast_convert_type(seed_ref[...], jnp.int32)[0, 0]
+    g = g_ref[...].astype(jnp.float32)
+    p = p_ref[...].astype(jnp.float32)
+    m = beta1 * m_ref[...].astype(jnp.float32) + (1.0 - beta1) * g
+    v = beta2 * v_ref[...].astype(jnp.float32) + (1.0 - beta2) * (g * g)
+    denom = jnp.sqrt(v) * c2 + eps
+    new_p = p - alpha * (m / denom) - lrwd * p
+    # absolute element index (rows offset by the grid program)
+    i = pl.program_id(0)
+    br = om_ref.shape[0]
+    rows = jax.lax.broadcasted_iota(jnp.int32, om_ref.shape, 0) \
+        + i * np.int32(br)
+    cc = jax.lax.broadcasted_iota(jnp.int32, om_ref.shape, 1)
+    idx = rows * np.int32(cols) + cc + np.int32(bi)
+    om_ref[...] = m.astype(om_ref.dtype)
+    ov_ref[...] = v.astype(ov_ref.dtype)
+    op_ref[...] = _sr_round_bf16(new_p, seed_i, idx)
+
+
+def fused_adamw_sr_update(p, g, m, v, lr, step, seed_f, *, beta1=0.9,
+                          beta2=0.999, eps=1e-8, weight_decay=0.0,
+                          apply_decay=True):
+    """Master-weight-free fused AdamW: bf16 params + bf16 moments, fp32 math
+    in-VMEM, stochastic rounding on the param write. One pass reads
+    g+p+m+v (8 B/param) and writes p+m+v (6 B/param) — ~36% less HBM
+    traffic than the master-weight chain, and no fp32 master resident at
+    all. Returns (new_p, new_m, new_v) or None when untileable."""
+    shape = m.shape
+    plan = _tile_plan(shape)
+    if plan is None:
+        return None
+    rows, cols = plan
+    stepf = step.astype(jnp.float32)
+    bc1 = 1.0 - jnp.power(beta1, stepf)
+    bc2 = 1.0 - jnp.power(beta2, stepf)
+    lr32 = lr.astype(jnp.float32)
+    wd = lr32 * weight_decay if (weight_decay and apply_decay) else \
+        jnp.zeros((), jnp.float32)
+    scalars = jnp.stack([lr32 / bc1, 1.0 / jnp.sqrt(bc2), wd]) \
+        .astype(jnp.float32).reshape(1, 3)
+
+    br = _pick_block(rows, cols)
+    g2, p2 = g.reshape(rows, cols), p.reshape(rows, cols)
+    m2, v2 = m.reshape(rows, cols), v.reshape(rows, cols)
+    kernel = functools.partial(_adamw_sr_kernel, beta1=float(beta1),
+                               beta2=float(beta2), eps=float(eps), bi=0,
+                               cols=cols)
+    bs = lambda: pl.BlockSpec((br, cols), lambda i: (i, Z))
+    nm, nv, np_ = pl.pallas_call(
+        kernel,
+        grid=(rows // br,),
+        in_specs=[pl.BlockSpec((1, 3), lambda i: (Z, Z)),
+                  pl.BlockSpec((1, 1), lambda i: (Z, Z)),
+                  bs(), bs(), bs(), bs()],
+        out_specs=(bs(), bs(), bs()),
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, cols), m.dtype),
+            jax.ShapeDtypeStruct((rows, cols), v.dtype),
+            jax.ShapeDtypeStruct((rows, cols), p.dtype),
+        ),
+        input_output_aliases={4: 0, 5: 1, 3: 2},
+        interpret=_interpret(),
+    )(scalars, seed_f, g2, p2, m2, v2)
+    return (np_.reshape(shape), nm.reshape(shape), nv.reshape(shape))
+
+
+def fused_adamw_sr_update_sharded(mesh, spec, p, g, m, v, lr, step, seed_f,
+                                  **kw):
+    """Stochastic-rounding AdamW over SHARDED state (the ZeRO/TP composition
+    of :func:`fused_adamw_sr_update`, mirroring
+    :func:`fused_adamw_update_sharded`). Each device runs the SR kernel on
+    its local shard; the rounding seed is folded with the device's mesh
+    coordinates so shards draw decorrelated rounding streams. Returns
+    (new_p, new_m, new_v) or None when the local shard isn't tileable."""
+    local = _local_shape(mesh, spec, tuple(m.shape))
+    if local is None or _tile_plan(local) is None:
+        return None
+    from jax.sharding import PartitionSpec
+    ps = PartitionSpec(*(tuple(spec) + (None,) * (m.ndim - len(tuple(spec)))))
+    rep = PartitionSpec()
+    axes = [a for e in tuple(spec) if e is not None
+            for a in (e if isinstance(e, tuple) else (e,))]
+
+    def local_update(p_l, g_l, m_l, v_l, lr_s, step_s, seed_l):
+        si = jax.lax.bitcast_convert_type(seed_l, jnp.int32)
+        for ax in axes:
+            si = si ^ (jax.lax.axis_index(ax).astype(jnp.int32)
+                       * np.int32(-1640531527))
+        seed_dev = jax.lax.bitcast_convert_type(si, jnp.float32)
+        return fused_adamw_sr_update(p_l, g_l, m_l, v_l, lr_s, step_s,
+                                     seed_dev, **kw)
+
+    f = jax.shard_map(local_update, mesh=mesh,
+                      in_specs=(ps, ps, ps, ps, rep, rep, rep),
+                      out_specs=(ps, ps, ps), check_vma=False)
+    return f(p, g, m, v, jnp.asarray(lr), jnp.asarray(step), seed_f)

@@ -96,7 +96,7 @@ class Optimizer:
                 new_vals.append(p)
                 new_slots.append(s)
                 continue
-            if fused is not None and s.get("master_weight") is not None:
+            if fused is not None:
                 ctx = fused_ctx[i] if fused_ctx is not None else None
                 out = fused(p, g, s, lr, step, dm, shard_ctx=ctx)
                 if out is not None:
@@ -169,7 +169,8 @@ class Optimizer:
         # the fused-update flag is read at trace time — key the jit cache on
         # it so set_flags toggles take effect on the next step
         shape_key = tuple((v.shape, str(v.dtype)) for v in vals) + \
-            (decay_flags, bool(flag_value("use_fused_adamw")))
+            (decay_flags, bool(flag_value("use_fused_adamw")),
+             bool(flag_value("adamw_stochastic_rounding")))
         if self._jit_update is None or self._jit_shape_key != shape_key:
             fn = functools.partial(self._traced_update, decay_flags=decay_flags)
             self._jit_update = jax.jit(fn, donate_argnums=(0, 2))
@@ -310,11 +311,49 @@ class Adam(Optimizer):
         from ..core.flags import flag_value
         if not flag_value("use_fused_adamw"):
             return None
-        if slots["moment1"].dtype != jnp.float32:
-            return None  # the Pallas kernel assumes fp32 moments
         kw = dict(beta1=self._beta1, beta2=self._beta2, eps=self._eps,
                   weight_decay=self._wd if self._decoupled_wd else 0.0,
                   apply_decay=bool(decay_mask))
+        if slots.get("master_weight") is None:
+            # master-weight-free path: bf16 params integrate updates via
+            # in-kernel STOCHASTIC ROUNDING (flag-gated — different
+            # trajectories than the fp32-master reference chain)
+            if not flag_value("adamw_stochastic_rounding"):
+                return None
+            if p.dtype != jnp.bfloat16:
+                return None
+            # per-step rounding seed, derived in-graph from the step counter
+            seed_f = jax.lax.bitcast_convert_type(
+                (step.astype(jnp.int32) * jnp.int32(-1640531527)
+                 ^ jnp.int32(0x5BD1E995)).reshape(1, 1), jnp.float32)
+            if shard_ctx is not None:
+                # ZeRO/TP-sharded state: shard_map the SR kernel over the
+                # local shards — falling back to the generic chain here
+                # would DETERMINISTICALLY round bf16 params and silently
+                # stall training on small updates
+                from ..ops.kernels.fused_adamw import (
+                    fused_adamw_sr_update_sharded)
+                mesh, spec = shard_ctx
+                out = fused_adamw_sr_update_sharded(
+                    mesh, spec, p, g, slots["moment1"], slots["moment2"],
+                    lr, step, seed_f, **kw)
+            else:
+                from ..ops.kernels.fused_adamw import fused_adamw_sr_update
+                out = fused_adamw_sr_update(p, g, slots["moment1"],
+                                            slots["moment2"], lr, step,
+                                            seed_f, **kw)
+            if out is None:
+                import warnings
+                warnings.warn(
+                    "adamw_stochastic_rounding: shape not tileable for the "
+                    "SR kernel — falling back to DETERMINISTIC bf16 "
+                    "rounding for this parameter (small updates may stall)",
+                    RuntimeWarning, stacklevel=2)
+                return None
+            new_p, nm, nv = out
+            return new_p, {"moment1": nm, "moment2": nv}
+        if slots["moment1"].dtype != jnp.float32:
+            return None  # the master-weight Pallas kernel assumes fp32 moments
         if shard_ctx is not None:
             from ..ops.kernels.fused_adamw import fused_adamw_update_sharded
             mesh, spec = shard_ctx

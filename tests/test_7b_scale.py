@@ -313,3 +313,49 @@ def test_7b_tp8_accumulation_compiles_and_fits():
             f"accumulators replicated? microstep args {g_args/1e9:.2f} GB"
     finally:
         set_flags({"adamw_bf16_moments": False})
+
+
+def test_7b_tp8_stochastic_rounding_state_footprint():
+    """Master-weight-free AdamW (adamw_stochastic_rounding + bf16 moments)
+    at the real 7B: per-device state drops from ~11.8 GB (bf16 p + fp32
+    master + fp32 m/v = 14 B/param) to ~5 GB (bf16 p/m/v = 6 B/param) —
+    the extra HBM headroom is what buys bigger per-device batches. On-chip
+    throughput measured equal to the master-weight chain; trajectories are
+    flag-gated (not reference-exact)."""
+    from paddle_tpu.core.flags import set_flags
+    hcg = _fleet_init(dp=1, mp=N_DEV, sharding=1)
+    mesh = hcg.mesh.jax_mesh()
+    set_flags({"adamw_stochastic_rounding": True,
+               "adamw_bf16_moments": True})
+    try:
+        cfg = LlamaConfig.llama2_7b(use_recompute=True,
+                                    max_position_embeddings=S)
+        paddle.seed(0)
+        with paddle.LazyGuard():
+            model = LlamaForCausalLM(cfg).bfloat16()
+        for name, p in model.named_parameters():
+            p._value = jax.ShapeDtypeStruct(
+                p._value.shape, p._value.dtype,
+                sharding=NamedSharding(mesh, _tp_spec(name)))
+        optimizer = opt_mod.AdamW(learning_rate=3e-4,
+                                  parameters=model.parameters(),
+                                  weight_decay=0.01, multi_precision=False)
+        wrapped = fleet.DygraphShardingOptimizer(optimizer, hcg, axis="mp",
+                                                 stage=1)
+        assert wrapped._stage == 1
+        from paddle_tpu.core.tensor import Tensor
+        ids = Tensor(jax.ShapeDtypeStruct((B, S), jnp.int32,
+                                          sharding=NamedSharding(mesh, P())))
+        step = TrainStep(model, _loss_fn, optimizer, donate=True)
+        compiled = step.aot_compile(ids, ids)
+        state = int(compiled.memory_analysis().argument_size_in_bytes)
+        residuals = _residual_bytes(step, (ids, ids))
+        print(json.dumps({"event": "7b_scale_proof", "config": "tp8_sr",
+                          "state_bytes_per_dev": state,
+                          "residual_bytes_conservative": residuals}))
+        # 6 B/param of state -> ~5 GB/device at TP=8 (vs 11.8 with masters)
+        assert state <= 6.2e9, f"SR state too big: {state/1e9:.2f} GB"
+        assert state + residuals <= V5E_HBM * 0.6, "headroom claim violated"
+    finally:
+        set_flags({"adamw_stochastic_rounding": False,
+                   "adamw_bf16_moments": False})

@@ -2,6 +2,7 @@
 Llama projection modes — the single-chip MFU work."""
 import numpy as np
 import pytest
+import jax
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
@@ -219,3 +220,136 @@ def test_cross_entropy_routes_hard_label_fast_path(rng):
     w = paddle.to_tensor(rng.random(11).astype(np.float32))
     lw = F.cross_entropy(paddle.to_tensor(t2n(logits)), labels, weight=w)
     assert np.isfinite(float(t2n(lw)))
+
+
+class TestStochasticRoundingAdamW:
+    """Master-weight-free fused AdamW (flag adamw_stochastic_rounding):
+    bf16 params + in-kernel stochastic rounding replace the fp32 master."""
+
+    def _seed_f(self, s=3):
+        return jax.lax.bitcast_convert_type(
+            jnp.asarray([[np.int32(s)]], jnp.int32), jnp.float32)
+
+    def test_rounding_is_unbiased(self):
+        from paddle_tpu.ops.kernels.fused_adamw import fused_adamw_sr_update
+        # one step from p=0 with constant grad: fp32 update is exactly
+        # -lr * g / (|g| + eps) per element = -0.01; a bf16 write must
+        # round stochastically AROUND the fp32 value — mean over many
+        # elements ~= fp32 value, and BOTH neighboring bf16 values occur
+        n = 65536
+        p = jnp.zeros((8, n // 8), jnp.bfloat16)
+        g = jnp.full((8, n // 8), 1.0, jnp.bfloat16)
+        m = jnp.zeros((8, n // 8), jnp.bfloat16)
+        v = jnp.zeros((8, n // 8), jnp.bfloat16)
+        lr = jnp.float32(0.0103)  # exact value straddles bf16 grid points
+        out = fused_adamw_sr_update(p, g, m, v, lr, jnp.int32(1),
+                                    self._seed_f(), weight_decay=0.0,
+                                    apply_decay=False)
+        assert out is not None
+        new_p = np.asarray(out[0], np.float32)
+        uniq = np.unique(new_p)
+        assert len(uniq) >= 2, "no stochasticity: single rounded value"
+        # unbiased: the mean tracks the fp32 target much tighter than one ulp
+        target = -0.0103 / (1.0 + 1e-8)
+        ulp = np.abs(uniq[1] - uniq[0])
+        assert abs(new_p.mean() - target) < 0.05 * ulp, \
+            (new_p.mean(), target, ulp)
+
+    def test_deterministic_per_seed(self):
+        from paddle_tpu.ops.kernels.fused_adamw import fused_adamw_sr_update
+        rng = np.random.default_rng(0)
+        p = jnp.asarray(rng.standard_normal((8, 256)), jnp.bfloat16)
+        g = jnp.asarray(rng.standard_normal((8, 256)), jnp.bfloat16)
+        m = jnp.zeros((8, 256), jnp.bfloat16)
+        v = jnp.zeros((8, 256), jnp.bfloat16)
+        a = fused_adamw_sr_update(p, g, m, v, jnp.float32(1e-2), jnp.int32(1),
+                                  self._seed_f(7))
+        b = fused_adamw_sr_update(p, g, m, v, jnp.float32(1e-2), jnp.int32(1),
+                                  self._seed_f(7))
+        c = fused_adamw_sr_update(p, g, m, v, jnp.float32(1e-2), jnp.int32(1),
+                                  self._seed_f(8))
+        np.testing.assert_array_equal(np.asarray(a[0], np.float32),
+                                      np.asarray(b[0], np.float32))
+        assert not np.array_equal(np.asarray(a[0], np.float32),
+                                  np.asarray(c[0], np.float32))
+
+    def test_training_tracks_fp32_master_baseline(self):
+        """bf16+SR training must track the fp32-master trajectory (loosely
+        — rounding noise), while bf16 WITHOUT SR visibly stalls on small
+        updates. The whole point of the flag."""
+        import paddle_tpu as paddle
+        from paddle_tpu.core.flags import set_flags
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt_mod
+        from paddle_tpu.jit.api import TrainStep
+
+        def build(sr):
+            paddle.seed(0)
+            model = nn.Sequential(nn.Linear(32, 64), nn.GELU(),
+                                  nn.Linear(64, 32))
+            for p in model.parameters():
+                p._value = p._value.astype(jnp.bfloat16)
+            opt = opt_mod.AdamW(learning_rate=3e-3,
+                                parameters=model.parameters(),
+                                multi_precision=not sr)
+            return TrainStep(model, lambda m, x, y: F.mse_loss(m(x), y), opt)
+
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.standard_normal((64, 32)).astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((64, 32)).astype(np.float32))
+
+        base = build(sr=False)         # fp32 master (reference chain)
+        ref = [float(np.asarray(base(x, y)._value)) for _ in range(30)]
+
+        set_flags({"adamw_stochastic_rounding": True})
+        try:
+            sr_step = build(sr=True)   # bf16-only + stochastic rounding
+            got = [float(np.asarray(sr_step(x, y)._value))
+                   for _ in range(30)]
+        finally:
+            set_flags({"adamw_stochastic_rounding": False})
+
+        # final loss within 15% of the master-weight trajectory
+        assert got[-1] < ref[-1] * 1.15 + 1e-3, (got[-1], ref[-1])
+        assert got[-1] < got[0], "SR training did not progress"
+
+
+def test_stochastic_rounding_under_zero_sharding():
+    """SR + ZeRO composition (review finding: the generic fallback would
+    DETERMINISTICALLY round bf16 and stall): the shard_map SR kernel runs on
+    the sharded state, slots stay 1/N, and training makes progress."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet import fleet_state
+    from paddle_tpu.core.flags import set_flags
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt_mod
+
+    fleet_state.set_hcg(None)
+    fleet_state.set_strategy(None)
+    set_flags({"adamw_stochastic_rounding": True})
+    try:
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(32, 64), nn.GELU(),
+                              nn.Linear(64, 32))
+        for p in model.parameters():
+            p._value = p._value.astype(jnp.bfloat16)
+        opt = opt_mod.AdamW(learning_rate=3e-3,
+                            parameters=model.parameters(),
+                            multi_precision=False)
+        model_d, opt_d, _ = dist.group_sharded_parallel(model, opt, "os_g")
+        step = TrainStep(model_d, lambda m, x, y: F.mse_loss(m(x), y), opt_d)
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.standard_normal((64, 32)).astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((64, 32)).astype(np.float32))
+        losses = [float(np.asarray(step(x, y)._value)) for _ in range(20)]
+        assert losses[-1] < 0.7 * losses[0], f"SR+ZeRO stalled: {losses[::5]}"
+        for p in step.params:
+            for k, v in opt._slots[id(p)].items():
+                if hasattr(v, "addressable_shards") and v.shape:
+                    s = next(iter(v.addressable_shards)).data
+                    assert s.size == v.size // 8, (k, v.shape, s.shape)
+    finally:
+        set_flags({"adamw_stochastic_rounding": False})
+        fleet_state.set_hcg(None)
+        fleet_state.set_strategy(None)
