@@ -14,6 +14,8 @@ TPU-first design decisions:
 from __future__ import annotations
 
 import math
+
+import numpy as np
 from dataclasses import dataclass, field
 
 import jax
@@ -87,6 +89,20 @@ def apply_rope(q, k, cos, sin, position_offset=0):
     return rot(q), rot(k)
 
 
+class StaticKVCache:
+    """Fixed-capacity per-layer KV cache for decoding: buffers preallocated
+    at the FINAL sequence length and written in place with
+    dynamic_update_slice. Together with a traced position offset, every
+    decode step then has static shapes — ONE compiled program serves the
+    whole generation instead of one per token per layer (the concat-grown
+    tuple cache changes the k/v length every step)."""
+
+    __slots__ = ("k", "v")
+
+    def __init__(self, k, v):
+        self.k, self.v = k, v
+
+
 class LlamaAttention(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -133,8 +149,41 @@ class LlamaAttention(Layer):
             v = ops.reshape(self.v_proj(x),
                             [b, s, self.num_kv_heads, self.head_dim])
         cos, sin = rope_cache
-        q, k = dispatch(lambda qq, kk: apply_rope(qq, kk, cos, sin, position_offset),
-                        (q, k), {}, name="rope")
+        if isinstance(position_offset, Tensor):
+            # traced offset (static-shape decode): the offset is a dispatch
+            # ARGUMENT, so every step shares one compiled entry
+            q, k = dispatch(
+                lambda qq, kk, off: apply_rope(qq, kk, cos, sin,
+                                               off.astype(jnp.int32)),
+                (q, k, position_offset), {}, name="rope_offset")
+        else:
+            q, k = dispatch(
+                lambda qq, kk: apply_rope(qq, kk, cos, sin, position_offset),
+                (q, k), {}, name="rope")
+        if isinstance(kv_cache, StaticKVCache):
+            def upd(buf, new, off):
+                return jax.lax.dynamic_update_slice_in_dim(
+                    buf, new.astype(buf.dtype), off.astype(jnp.int32), 1)
+
+            k_buf = dispatch(upd, (kv_cache.k, k, position_offset), {},
+                             name="kv_update")
+            v_buf = dispatch(upd, (kv_cache.v, v, position_offset), {},
+                             name="kv_update")
+            T = k_buf.shape[1]
+
+            def make_mask(off):
+                last = off.astype(jnp.int32) + jnp.int32(s - 1)
+                valid = jnp.arange(T, dtype=jnp.int32)[None, None, None, :] \
+                    <= last
+                return jnp.where(valid, jnp.float32(0), jnp.float32(-1e30))
+
+            mask = dispatch(make_mask, (position_offset,), {},
+                            name="kv_decode_mask")
+            out = F.scaled_dot_product_attention(
+                q, k_buf, v_buf, attn_mask=mask, is_causal=False,
+                training=self.training)
+            out = ops.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), StaticKVCache(k_buf, v_buf)
         if kv_cache is not None:
             k = ops.concat([kv_cache[0], k], axis=1)
             v = ops.concat([kv_cache[1], v], axis=1)
@@ -180,7 +229,15 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
                                                 config.rms_norm_eps)
 
-    def forward(self, x, rope_cache, attn_mask=None):
+    def forward(self, x, rope_cache, attn_mask=None, kv_cache=None,
+                position_offset=0):
+        if kv_cache is not None:
+            attn_out, new_cache = self.self_attn(
+                self.input_layernorm(x), rope_cache, attn_mask, kv_cache,
+                position_offset)
+            x = x + attn_out
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
         x = x + self.self_attn(self.input_layernorm(x), rope_cache, attn_mask)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -200,9 +257,16 @@ class LlamaModel(Layer):
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, kv_caches=None,
+                position_offset=0):
         x = self.embed_tokens(input_ids)
         rope = (self.rope_cos._value, self.rope_sin._value)
+        if kv_caches is not None:
+            new_caches = []
+            for layer, cache in zip(self.layers, kv_caches):
+                x, c = layer(x, rope, attn_mask, cache, position_offset)
+                new_caches.append(c)
+            return self.norm(x), new_caches
         remat = self.config.use_recompute and self.training
         if remat:
             from ..distributed.fleet.recompute import recompute
@@ -224,13 +288,15 @@ class LlamaForCausalLM(Layer):
             self.lm_head = Linear(config.hidden_size, config.vocab_size,
                                   bias_attr=False)
 
+    def _logits(self, hidden):
+        if self.config.tie_word_embeddings:
+            return ops.matmul(hidden, self.llama.embed_tokens.weight,
+                              transpose_y=True)
+        return self.lm_head(hidden)
+
     def forward(self, input_ids, labels=None, attn_mask=None):
         hidden = self.llama(input_ids, attn_mask)
-        if self.config.tie_word_embeddings:
-            logits = ops.matmul(hidden, self.llama.embed_tokens.weight,
-                                transpose_y=True)
-        else:
-            logits = self.lm_head(hidden)
+        logits = self._logits(hidden)
         if labels is None:
             return logits
         loss = F.cross_entropy(
@@ -239,6 +305,129 @@ class LlamaForCausalLM(Layer):
             ops.reshape(logits, [-1, self.config.vocab_size]),
             ops.reshape(labels, [-1]), ignore_index=-100)
         return loss, logits
+
+    @staticmethod
+    def _sample(logits_np, temperature, top_k, top_p, rng):
+        if temperature <= 0.0:
+            return np.argmax(logits_np, axis=-1)
+        logits_np = logits_np / temperature
+        out = np.empty(logits_np.shape[0], np.int64)
+        for b in range(logits_np.shape[0]):
+            row = logits_np[b]
+            if top_k and top_k > 0:
+                tk = min(int(top_k), len(row))
+                kth = np.partition(row, -tk)[-tk]
+                row = np.where(row < kth, -np.inf, row)
+            probs = np.exp(row - row.max())
+            probs = probs / probs.sum()
+            if top_p and top_p < 1.0:
+                order = np.argsort(-probs)
+                cum = np.cumsum(probs[order])
+                cut = np.searchsorted(cum, top_p) + 1
+                mask = np.zeros_like(probs)
+                mask[order[:cut]] = 1.0
+                probs = probs * mask
+                probs = probs / probs.sum()
+            out[b] = rng.choice(len(probs), p=probs)
+        return out
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=0, top_p=1.0, eos_token_id=None):
+        """Autoregressive decoding with a per-layer KV cache (reference
+        surface: paddlenlp GenerationMixin.generate; the reference keeps it
+        out-of-tree, the flagship model here ships it in-core).
+
+        Prefill runs the full prompt once (flash-attention path, causal);
+        decode steps feed ONE token against a fixed-capacity
+        :class:`StaticKVCache` with a TRACED position offset — every step
+        has identical shapes, so the whole generation runs through one
+        compiled program per op (no per-token recompiles). Attention over
+        the padded cache is masked to the valid prefix.
+        temperature<=0 = greedy; top_k/top_p sampling draws from the
+        framework RNG (``paddle.seed``-deterministic). Decoding is capped
+        at ``max_position_embeddings`` (the rope table's end) with a
+        warning.
+        """
+        from ..core import random as _random
+        from ..core.tensor import no_grad
+        import jax.numpy as jnp
+
+        c = self.config
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(jnp.asarray(np.asarray(input_ids), jnp.int32))
+        B, prompt_len = ids.shape[0], ids.shape[1]
+        if prompt_len >= c.max_position_embeddings:
+            raise ValueError(
+                f"prompt length {prompt_len} >= max_position_embeddings "
+                f"{c.max_position_embeddings}: no positions left to decode")
+        limit = min(int(max_new_tokens),
+                    c.max_position_embeddings - prompt_len)
+        if limit < int(max_new_tokens):
+            import warnings
+            warnings.warn(
+                f"generate: capping max_new_tokens {max_new_tokens} -> "
+                f"{limit} (rope table ends at position "
+                f"{c.max_position_embeddings})", RuntimeWarning,
+                stacklevel=2)
+        if limit <= 0:
+            return Tensor(jnp.zeros((B, 0), jnp.int64))
+        total = prompt_len + limit
+        head_dim = c.hidden_size // c.num_attention_heads
+        dt = self.llama.embed_tokens.weight.dtype
+        empty = [(Tensor(jnp.zeros((B, 0, c.num_key_value_heads, head_dim),
+                                   dt)),
+                  Tensor(jnp.zeros((B, 0, c.num_key_value_heads, head_dim),
+                                   dt)))
+                 for _ in range(c.num_hidden_layers)]
+        seed, counter = _random.default_generator.next_seed()
+        rng = np.random.default_rng((seed, counter))
+
+        was_training = self.training
+        self.eval()
+        try:
+            with no_grad():
+                # prefill: one causal pass over the whole prompt (flash
+                # path), then pad each layer's cache to the FINAL length so
+                # all decode steps share static shapes (StaticKVCache)
+                hidden, grown = self.llama(ids, kv_caches=empty,
+                                           position_offset=0)
+
+                def to_static(t):
+                    pad = total - t.shape[1]
+                    return Tensor(jnp.pad(
+                        t._value, ((0, 0), (0, pad), (0, 0), (0, 0))))
+
+                caches = [StaticKVCache(to_static(k), to_static(v))
+                          for k, v in grown]
+                generated = []
+                cur_len = prompt_len
+                last_h = hidden[:, -1:]
+                finished = np.zeros(B, bool)
+                for _ in range(limit):
+                    logits = self._logits(last_h)
+                    nxt = self._sample(
+                        np.asarray(logits._value[:, 0]).astype(np.float32),
+                        temperature, top_k, top_p, rng)
+                    if eos_token_id is not None:
+                        nxt = np.where(finished, eos_token_id, nxt)
+                        finished |= (nxt == eos_token_id)
+                    generated.append(nxt)
+                    if eos_token_id is not None and finished.all():
+                        break
+                    if cur_len >= total:
+                        break
+                    tok = Tensor(jnp.asarray(nxt[:, None], jnp.int32))
+                    # traced offset: the decode program is keyed on shapes
+                    # only — step 2 onward hits the compiled dispatch cache
+                    off = Tensor(jnp.asarray(cur_len, jnp.int32))
+                    last_h, caches = self.llama(
+                        tok, kv_caches=caches, position_offset=off)
+                    cur_len += 1
+        finally:
+            if was_training:
+                self.train()
+        out = np.stack(generated, axis=1)
+        return Tensor(jnp.asarray(out, jnp.int64))
 
     def flops_per_token(self, seq_len):
         """Model FLOPs per token (fwd+bwd 3x fwd) for MFU accounting."""
