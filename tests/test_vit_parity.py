@@ -98,3 +98,91 @@ def test_vit_grad_direction_matches(rng):
     np.testing.assert_allclose(float(loss.numpy()), float(out.loss.detach()),
                                rtol=1e-3)
     np.testing.assert_allclose(g_ours, g_hf, rtol=5e-3, atol=1e-5)
+
+
+def test_vit_semi_auto_sharded_training_matches_replicated():
+    """BASELINE config 4 END-TO-END on the virtual mesh: a ViT with
+    Megatron-style semi-auto placements (qkv/mlp-up column, attn-proj/
+    mlp-down row over the 8-device 'x' axis) applied through
+    dist.shard_layer and trained through dist.to_static (DistModel). Loss
+    trajectory must match the unsharded eager TrainStep, weights must hold
+    1/8 per device, and the compiled step must carry the TP reduction
+    collectives."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet import fleet_state
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.jit.functional_call import read_values
+    from paddle_tpu.utils.hlo_check import compile_report
+    from paddle_tpu.vision.models import VisionTransformer
+    import jax
+    import jax.numpy as jnp
+
+    fleet_state.set_hcg(None)
+    fleet_state.set_strategy(None)
+
+    def build():
+        paddle.seed(0)
+        return VisionTransformer(img_size=16, patch_size=4, embed_dim=64,
+                                 depth=2, num_heads=4, num_classes=10)
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 3, 16, 16))
+                         .astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, 8))
+
+    # reference: unsharded eager train step
+    ref_model = build()
+    ref_opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=ref_model.parameters())
+    ref_step = TrainStep(ref_model,
+                         lambda m, a, b: F.cross_entropy(m(a), b), ref_opt)
+    ref = [float(np.asarray(ref_step(x, y)._value)) for _ in range(4)]
+
+    # semi-auto: column/row placements via the public shard_layer API
+    mesh = dist.ProcessMesh(np.arange(8), ["x"])
+    model = build()
+
+    def shard_fn(name, sub, pmesh):
+        for pname, param in list(sub._parameters.items()):
+            full = f"{name}.{pname}" if name else pname
+            if param is None or param.ndim != 2:
+                continue
+            if full.endswith(("qkv.weight", "mlp.0.weight")):
+                sub._parameters[pname] = dist.shard_tensor(
+                    param, pmesh, [dist.Shard(1)])
+            elif full.endswith(("attn.proj.weight", "mlp.3.weight")):
+                sub._parameters[pname] = dist.shard_tensor(
+                    param, pmesh, [dist.Shard(0)])
+
+    dist.shard_layer(model, mesh, shard_fn)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    dm = dist.to_static(model, loss=lambda o, t: F.cross_entropy(o, t),
+                        optimizer=opt)
+    got = [float(np.asarray(dm(x, y)._value)) for _ in range(4)]
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+    # the sharded program really partitions: TP weights hold 1/8 per device
+    n_sharded = 0
+    for name, p in model.named_parameters():
+        if name.endswith(("qkv.weight", "mlp.0.weight", "mlp.3.weight",
+                          "attn.proj.weight")):
+            shard = next(iter(p._value.addressable_shards)).data
+            assert shard.size == p._value.size // 8, (name, p.shape)
+            n_sharded += 1
+    assert n_sharded >= 8
+
+    # ...and the compiled step carries the TP reductions (row-parallel
+    # matmul partials + sharded-grad math land as all-reduce/reduce-scatter)
+    step = dm._train_step
+    (key,) = list(step._cache)
+    args = (read_values(step.params),
+            [step.optimizer._slots[id(p)] for p in step.params],
+            read_values(step.buffers), read_values(step.frozen),
+            jnp.float32(1e-3), jnp.int32(1), jax.random.PRNGKey(0),
+            [x._value, y._value])
+    rep = compile_report(step._cache[key], *args)
+    counts = rep.collective_counts()
+    assert counts["all-reduce"] + counts["reduce-scatter"] >= 2, counts
