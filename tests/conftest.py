@@ -26,3 +26,21 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def train_step_compile_report(step, batch_vals):
+    """Compile-report the cached single-step program of a TrainStep (shared
+    by the HLO-contract and semi-auto suites — ONE place coupled to
+    TrainStep's cached-fn signature)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.jit.functional_call import read_values
+    from paddle_tpu.utils.hlo_check import compile_report
+    (key,) = list(step._cache)
+    opt = step.optimizer
+    args = (read_values(step.params),
+            [opt._slots[id(p)] for p in step.params],
+            read_values(step.buffers), read_values(step.frozen),
+            jnp.float32(1e-2), jnp.int32(1), jax.random.PRNGKey(0),
+            list(batch_vals))
+    return compile_report(step._cache[key], *args)

@@ -84,16 +84,10 @@ def run_steps(step, n=5):
 
 def step_report(step):
     """Compile-report the cached single-step program of a TrainStep."""
+    from conftest import train_step_compile_report
     x, t = make_batch()
     step(x, t)  # populate cache
-    (key,) = list(step._cache)
-    jitted = step._cache[key]
-    opt = step.optimizer
-    args = (read_values(step.params), [opt._slots[id(p)] for p in step.params],
-            read_values(step.buffers), read_values(step.frozen),
-            jnp.float32(1e-2), jnp.int32(1), jax.random.PRNGKey(0),
-            [x._value, t._value])
-    return compile_report(jitted, *args)
+    return train_step_compile_report(step, [x._value, t._value])
 
 
 def slot_bytes(opt, params):

@@ -113,11 +113,7 @@ def test_vit_semi_auto_sharded_training_matches_replicated():
     import paddle_tpu.distributed as dist
     from paddle_tpu.distributed.fleet import fleet_state
     from paddle_tpu.jit.api import TrainStep
-    from paddle_tpu.jit.functional_call import read_values
-    from paddle_tpu.utils.hlo_check import compile_report
     from paddle_tpu.vision.models import VisionTransformer
-    import jax
-    import jax.numpy as jnp
 
     fleet_state.set_hcg(None)
     fleet_state.set_strategy(None)
@@ -176,13 +172,7 @@ def test_vit_semi_auto_sharded_training_matches_replicated():
 
     # ...and the compiled step carries the TP reductions (row-parallel
     # matmul partials + sharded-grad math land as all-reduce/reduce-scatter)
-    step = dm._train_step
-    (key,) = list(step._cache)
-    args = (read_values(step.params),
-            [step.optimizer._slots[id(p)] for p in step.params],
-            read_values(step.buffers), read_values(step.frozen),
-            jnp.float32(1e-3), jnp.int32(1), jax.random.PRNGKey(0),
-            [x._value, y._value])
-    rep = compile_report(step._cache[key], *args)
+    from conftest import train_step_compile_report
+    rep = train_step_compile_report(dm._train_step, [x._value, y._value])
     counts = rep.collective_counts()
     assert counts["all-reduce"] + counts["reduce-scatter"] >= 2, counts
