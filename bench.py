@@ -1,11 +1,16 @@
-"""Driver benchmark — prints ONE JSON line.
+"""Driver benchmark — one JSON line per BASELINE workload config.
 
-Measures the fused compiled training step (fwd+bwd+AdamW, bf16 params + fp32
-master weights, Pallas flash attention) of a Llama-family decoder on one TPU
-chip, and reports MFU against the 45%-MFU north star (BASELINE.json).
+Default (`BENCH_MODEL` unset / `all`): runs every BASELINE.md config —
+resnet50, bert, vit, unet, then the flagship llama LAST — each in its own
+subprocess, one JSON line each, so the tail line stays the llama MFU vs the
+45% north star (BASELINE.json). `BENCH_MODEL=llama` (or any single name)
+prints exactly one line.
 
-Model size is chosen to fill a single v5e chip (16 GB HBM); on a pod slice the
-same code scales via the fleet hybrid-parallel path (see __graft_entry__.py).
+The flagship line measures the fused compiled training step (fwd+bwd+AdamW,
+bf16 params + fp32 master weights, Pallas flash attention) of a Llama-family
+decoder on one TPU chip. Model size is chosen to fill a single v5e chip
+(16 GB HBM); on a pod slice the same code scales via the fleet
+hybrid-parallel path (see __graft_entry__.py).
 """
 from __future__ import annotations
 
@@ -45,6 +50,36 @@ def _time_train_step(step, args, steps):
     final_loss = float(np.asarray(loss._value))
     dn = time.perf_counter() - t0
     return max(dn - d1, 1e-9) / steps, final_loss
+
+
+def _forward_flops(model, arg_tensors):
+    """Model FLOPs of one forward pass from XLA's cost model on the
+    UNOPTIMIZED lowered HLO — i.e. the math as written, so grad-checkpoint
+    recompute does not inflate the number. Returns None when the jax version
+    can't produce a cost analysis."""
+    import jax
+    from paddle_tpu.core.tensor import Tensor, functional_mode
+    from paddle_tpu.jit.functional_call import collect_state, bind_state
+
+    _, params, _, buffers = collect_state(model)
+    state = params + buffers
+
+    def fwd(state_vals, arg_vals):
+        with functional_mode(), bind_state(state, state_vals):
+            out = model(*[Tensor(v) for v in arg_vals])
+        leaves = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda x: hasattr(x, "_value"))
+        return [getattr(x, "_value", x) for x in leaves]
+
+    try:
+        lowered = jax.jit(fwd).lower([t._value for t in state],
+                                     [t._value for t in arg_tensors])
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost["flops"])
+    except Exception:
+        return None
 
 
 def _bench_other(model_name):
@@ -159,11 +194,17 @@ def _bench_other(model_name):
             (B, 77, 768)).astype(np.float32)).astype("bfloat16")
         noise = paddle.to_tensor(rng.standard_normal(
             (B, 64, 64, 4)).astype(np.float32)).astype("bfloat16")
+        # forward FLOPs via XLA's cost model (train = 3x fwd); measured BEFORE
+        # the timed steps so its trace never lands in a timing window
+        fwd_flops = _forward_flops(model, (lat, t, ctx))
         dt, loss = _time_train_step(step, (lat, t, ctx, noise), steps)
-        return {"metric": "sd_unet_1chip_train_samples_per_sec",
-                "value": round(B / dt, 2), "unit": "samples/s",
-                "vs_baseline": None, "step_time_s": round(dt, 4),
-                "params": n_params, "loss": loss}
+        out = {"metric": "sd_unet_1chip_train_samples_per_sec",
+               "value": round(B / dt, 2), "unit": "samples/s",
+               "vs_baseline": None, "step_time_s": round(dt, 4),
+               "params": n_params, "loss": loss}
+        if fwd_flops is not None:
+            out["mfu_pct"] = round(3 * fwd_flops / dt / peak * 100, 2)
+        return out
 
     if model_name == "dispatch":
         return _bench_dispatch()
@@ -245,14 +286,44 @@ def _bench_dispatch():
             "detail": result}
 
 
+def _run_all():
+    """Default driver mode: one JSON line per BASELINE config (1-5), llama
+    LAST so single-line tail parsing keeps working. Each config runs in its
+    own subprocess — flag settings and HBM stay isolated, and one config
+    failing doesn't take down the rest."""
+    import subprocess
+    import sys
+    for name in ["resnet50", "bert", "vit", "unet", "llama"]:
+        env = dict(os.environ, BENCH_MODEL=name)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=1800)
+            line = next((ln for ln in reversed(proc.stdout.splitlines())
+                         if ln.startswith("{")), None)
+            err = proc.stderr[-400:]
+        except subprocess.TimeoutExpired:
+            line, err = None, "timeout after 1800s"
+        if line:
+            print(line, flush=True)
+        else:
+            print(json.dumps({"metric": f"{name}_bench_failed", "value": None,
+                              "unit": "", "vs_baseline": None, "error": err}),
+                  flush=True)
+
+
 def main():
+    model_name = os.environ.get("BENCH_MODEL", "all")
+    if model_name == "all":
+        _run_all()
+        return
+
     import jax
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as opt
     from paddle_tpu.jit.api import TrainStep
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
-    model_name = os.environ.get("BENCH_MODEL", "llama")
     if model_name != "llama":
         out = _bench_other(model_name)
         out["device"] = getattr(jax.devices()[0], "device_kind", "unknown")
@@ -354,9 +425,10 @@ def main():
     final_loss = float(np.asarray(loss._value))
     dn = time.perf_counter() - t0
 
-    import sys
-    print(f"[bench debug] d1={d1:.3f}s dn={dn:.3f}s cycles={cycles}",
-          file=sys.stderr)
+    if os.environ.get("BENCH_DEBUG"):
+        import sys
+        print(f"[bench debug] d1={d1:.3f}s dn={dn:.3f}s cycles={cycles}",
+              file=sys.stderr)
     dt = max(dn - d1, 1e-9)
     tokens_per_sec = cycles * accum * B * S / dt
     flops_per_token = model.flops_per_token(S)

@@ -284,9 +284,9 @@ class Tensor:
 
     # -- conversion ---------------------------------------------------------
     def numpy(self) -> np.ndarray:
-        if _DISPATCH_RECORDER is not None:
+        if _capture.recorder is not None:
             # whole-array host read: the prefix-capture break point
-            _DISPATCH_RECORDER.on_host_read(self._value)
+            _capture.recorder.on_host_read(self._value)
         return np.asarray(self._value)
 
     def item(self):
@@ -468,10 +468,21 @@ def dispatch_cache_stats() -> dict:
 
 
 # -- compiled-prefix capture hooks (jit/prefix_capture.py) -------------------
-#: when set, every dispatch is logged with argument provenance (record mode)
-_DISPATCH_RECORDER = None
-#: when set, prefix-position dispatches are answered from a compiled prefix
-_DISPATCH_REPLAY = None
+class _CaptureState(threading.local):
+    """Thread-local recorder/replay hooks — like _mode, so a concurrent
+    thread dispatching during record/replay can neither interleave its ops
+    into the captured prefix nor race the replay cursor."""
+
+    def __init__(self):
+        #: when set, every dispatch on THIS thread is logged with argument
+        #: provenance (record mode)
+        self.recorder = None
+        #: when set, prefix-position dispatches on THIS thread are answered
+        #: from a compiled prefix
+        self.replay = None
+
+
+_capture = _CaptureState()
 #: sentinel: the replay state declined this op (past the prefix) — dispatch
 #: proceeds normally
 _REPLAY_PASS = object()
@@ -701,7 +712,7 @@ def dispatch(fn: Callable, args: tuple, kwargs: dict, name: str | None = None,
         and any(not leaves[i].stop_gradient for i in tensor_pos)
     )
 
-    rep = _DISPATCH_REPLAY
+    rep = _capture.replay
     if rep is not None:
         # compiled-prefix replay (jit/prefix_capture.py): prefix-position
         # ops are answered from the precompiled program; divergence (or a
@@ -710,7 +721,7 @@ def dispatch(fn: Callable, args: tuple, kwargs: dict, name: str | None = None,
         if out is not _REPLAY_PASS:
             return out
 
-    rec = _DISPATCH_RECORDER
+    rec = _capture.recorder
     if cache_key is None and not _OP_OBSERVERS and _mode.functional == 0 \
             and rec is None:
         try:

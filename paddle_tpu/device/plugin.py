@@ -39,6 +39,12 @@ def register_custom_runtime(name: str, library_path: str, options=None):
         raise RuntimeError(
             "register_custom_runtime must run before the first device use "
             "(the PJRT backend set is fixed at initialization)")
+    if not hasattr(xla_bridge, "register_plugin"):
+        raise RuntimeError(
+            "jax._src.xla_bridge.register_plugin is unavailable in this jax "
+            "version — CustomRuntime plugin registration needs the PJRT "
+            "plugin API (jax>=0.4.16); upgrade jax or load the plugin via "
+            "the PJRT_NAMES_AND_LIBRARY_PATHS env var")
     xla_bridge.register_plugin(name, library_path=library_path,
                                options=options)
     _REGISTERED[name] = library_path

@@ -38,14 +38,25 @@ class DataParallel(Layer):
             # replicated under jit); device_put across non-addressable
             # devices is not possible here.
             from jax.experimental import multihost_utils
-            params = [p for _, p in layers.named_parameters()]
-            if params:
+            # Parameters AND persistable buffers (e.g. BN running stats),
+            # matching the reference's sync_params_buffers which walks
+            # _obtain_parameters_buffers — per-rank-initialized buffers
+            # would otherwise silently desync ranks.
+            synced_vals = [p for _, p in layers.named_parameters()]
+            for _, sub in layers.named_sublayers(include_self=True):
+                for bname, b in sub._buffers.items():
+                    # persistable buffers only — non-persistable ones
+                    # (rope tables etc.) are deterministic re-derivations
+                    if b is not None and \
+                            bname not in sub._non_persistable_buffer_names:
+                        synced_vals.append(b)
+            if synced_vals:
                 synced = multihost_utils.broadcast_one_to_all(
-                    [p._value for p in params])
-                for p, v in zip(params, synced):
+                    [t._value for t in synced_vals])
+                for t, v in zip(synced_vals, synced):
                     # broadcast_one_to_all device_gets to host numpy —
-                    # re-wrap so parameter values stay jax Arrays
-                    p._value = jax.numpy.asarray(v)
+                    # re-wrap so values stay jax Arrays
+                    t._value = jax.numpy.asarray(v)
         else:
             # single-controller SPMD: replicate parameters over dp
             # (broadcast analog)

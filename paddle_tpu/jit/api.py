@@ -324,12 +324,12 @@ class StaticFunction:
             from ..core import tensor as _tensor_mod
             from .prefix_capture import PrefixRecorder
             recorder = PrefixRecorder(list(state_vals) + list(dyn))
-            saved_rec = _tensor_mod._DISPATCH_RECORDER
-            _tensor_mod._DISPATCH_RECORDER = recorder
+            saved_rec = _tensor_mod._capture.recorder
+            _tensor_mod._capture.recorder = recorder
             try:
                 result = self._fn(*args, **kwargs)
             finally:
-                _tensor_mod._DISPATCH_RECORDER = saved_rec
+                _tensor_mod._capture.recorder = saved_rec
             program = recorder.build()
             if program is not None:
                 warnings.warn(

@@ -74,7 +74,8 @@ _MAX_CONST = 1024
 
 
 class PrefixRecorder:
-    """Installed as core.tensor._DISPATCH_RECORDER for one eager run."""
+    """Installed as core.tensor._capture.recorder (thread-local) for one
+    eager run."""
 
     def __init__(self, input_vals):
         self._prov = {}
@@ -197,12 +198,12 @@ class PrefixProgram:
         except Exception as e:  # trace/compile failure (jit is lazy)
             raise _ReplayAbandoned(str(e)) from e
         state = _ReplayState(self.records, outs, input_vals)
-        saved = T._DISPATCH_REPLAY
-        T._DISPATCH_REPLAY = state
+        saved = T._capture.replay
+        T._capture.replay = state
         try:
             result = call_fn()
         finally:
-            T._DISPATCH_REPLAY = saved
+            T._capture.replay = saved
         return result, state.diverged
 
 

@@ -90,6 +90,15 @@ class Optimizer:
         if self._grad_clip is not None:
             grads = self._grad_clip.apply(vals, grads)
         fused = getattr(self, "_apply_fused", None)
+        fused_takes_pid = self.__dict__.get("_fused_takes_param_id")
+        if fused is not None and fused_takes_pid is None:
+            import inspect
+            try:
+                fused_takes_pid = "param_id" in inspect.signature(
+                    fused).parameters
+            except (TypeError, ValueError):
+                fused_takes_pid = False
+            self._fused_takes_param_id = fused_takes_pid
         new_vals, new_slots = [], []
         for i, (p, g, s, dm) in enumerate(zip(vals, grads, slots, decay_flags)):
             if g is None:
@@ -98,7 +107,8 @@ class Optimizer:
                 continue
             if fused is not None:
                 ctx = fused_ctx[i] if fused_ctx is not None else None
-                out = fused(p, g, s, lr, step, dm, shard_ctx=ctx)
+                kw = {"param_id": i} if fused_takes_pid else {}
+                out = fused(p, g, s, lr, step, dm, shard_ctx=ctx, **kw)
                 if out is not None:
                     new_vals.append(out[0])
                     new_slots.append(out[1])
@@ -300,7 +310,8 @@ class Adam(Optimizer):
         update = (m / bc1) / denom
         return p - lr.astype(p.dtype) * update, ns
 
-    def _apply_fused(self, p, g, slots, lr, step, decay_mask, shard_ctx=None):
+    def _apply_fused(self, p, g, slots, lr, step, decay_mask, shard_ctx=None,
+                     param_id=0):
         """Single-pass Pallas update for the multi-precision path (the
         reference's fused_adam/multi_tensor analog). Covers plain Adam with
         no coupled decay and AdamW's decoupled decay; anything else falls
@@ -322,9 +333,14 @@ class Adam(Optimizer):
                 return None
             if p.dtype != jnp.bfloat16:
                 return None
-            # per-step rounding seed, derived in-graph from the step counter
+            # per-(step, param) rounding seed, derived in-graph — folding the
+            # param index in decorrelates the rounding streams of same-shaped
+            # parameters (step-only seeding repeats the identical per-position
+            # stream across every layer)
             seed_f = jax.lax.bitcast_convert_type(
-                (step.astype(jnp.int32) * jnp.int32(-1640531527)
+                ((step.astype(jnp.int32) + jnp.int32(int(param_id) * 2654435761
+                                                    & 0x7FFFFFFF))
+                 * jnp.int32(-1640531527)
                  ^ jnp.int32(0x5BD1E995)).reshape(1, 1), jnp.float32)
             if shard_ctx is not None:
                 # ZeRO/TP-sharded state: shard_map the SR kernel over the
