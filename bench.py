@@ -1,10 +1,10 @@
 """Driver benchmark — one JSON line per BASELINE workload config.
 
-Default (`BENCH_MODEL` unset / `all`): runs every BASELINE.md config —
-resnet50, bert, vit, unet, then the flagship llama LAST — each in its own
-subprocess, one JSON line each, so the tail line stays the llama MFU vs the
-45% north star (BASELINE.json). `BENCH_MODEL=llama` (or any single name)
-prints exactly one line.
+Default (`BENCH_MODEL` unset / `all`): runs every BASELINE.md config plus
+the decode benchmark — resnet50, bert, vit, unet, llama_decode, then the
+flagship llama LAST — each in its own subprocess, one JSON line each, so
+the tail line stays the llama MFU vs the 45% north star (BASELINE.json).
+`BENCH_MODEL=llama` (or any single name) prints exactly one line.
 
 The flagship line measures the fused compiled training step (fwd+bwd+AdamW,
 bf16 params + fp32 master weights, Pallas flash attention) of a Llama-family
@@ -206,6 +206,69 @@ def _bench_other(model_name):
             out["mfu_pct"] = round(3 * fwd_flops / dt / peak * 100, 2)
         return out
 
+    if model_name == "llama_decode":
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.jit.functional_call import collect_state, read_values
+        import jax.numpy as jnp
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        prompt = int(os.environ.get("BENCH_PROMPT", "512"))
+        new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=heads,
+                          max_position_embeddings=prompt + new_tokens)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg).bfloat16()
+        model.eval()
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        ids_v = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, prompt)),
+                            jnp.int32)
+        total = prompt + new_tokens
+        prefill, decode = model._gen_programs(
+            B, prompt, new_tokens, total, 0.0, 0, 1.0, None, "static", 64)
+        _, params, _, buffers = collect_state(model)
+        state_vals = read_values(params + buffers)
+        key = jax.random.PRNGKey(0)
+
+        def run_prefill():
+            l0, kb, vb = prefill(state_vals, ids_v)
+            float(np.asarray(l0[0, 0]))  # tunnel-safe sync
+            return l0, kb, vb
+
+        def run_pair():
+            l0, kb, vb = prefill(state_vals, ids_v)
+            buf, n = decode(state_vals, kb, vb, l0, key)
+            int(np.asarray(n))
+            return buf
+
+        # warm both programs twice (donated-output relayout recompiles must
+        # not land in a timing window)
+        run_pair()
+        run_pair()
+        reps = int(os.environ.get("BENCH_STEPS", "8"))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            run_prefill()
+        t_prefill = (time.perf_counter() - t0) / reps
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            run_pair()
+        t_pair = (time.perf_counter() - t0) / reps
+        t_decode = max(t_pair - t_prefill, 1e-9)
+        return {"metric": "llama_decode_tokens_per_sec",
+                "value": round(B * new_tokens / t_decode, 1),
+                "unit": "tokens/s", "vs_baseline": None,
+                "decode_ms_per_token": round(t_decode / new_tokens * 1e3, 3),
+                "prefill_tokens_per_sec": round(B * prompt / t_prefill, 1),
+                "prefill_s": round(t_prefill, 4),
+                "batch": B, "prompt_len": prompt, "new_tokens": new_tokens,
+                "params": n_params}
+
     if model_name == "dispatch":
         return _bench_dispatch()
 
@@ -287,13 +350,14 @@ def _bench_dispatch():
 
 
 def _run_all():
-    """Default driver mode: one JSON line per BASELINE config (1-5), llama
-    LAST so single-line tail parsing keeps working. Each config runs in its
-    own subprocess — flag settings and HBM stay isolated, and one config
-    failing doesn't take down the rest."""
+    """Default driver mode: one JSON line per BASELINE config (1-5) plus
+    llama_decode, with the flagship llama LAST so single-line tail parsing
+    keeps working. Each config runs in its own subprocess — flag settings
+    and HBM stay isolated, and one config failing doesn't take down the
+    rest."""
     import subprocess
     import sys
-    for name in ["resnet50", "bert", "vit", "unet", "llama"]:
+    for name in ["resnet50", "bert", "vit", "unet", "llama_decode", "llama"]:
         env = dict(os.environ, BENCH_MODEL=name)
         try:
             proc = subprocess.run(

@@ -141,21 +141,29 @@ class PipelineParallel:
             for layer in self._body:
                 p = dict(layer.named_parameters())[n]
                 leaves.append(p._value)
-            arr = jnp.stack(leaves)  # [S*V*L, ...]
-            arr = arr.reshape((self._S * self._V, self._L) + arr.shape[1:])
             # shard leading stage dim over pp; preserve any TP sharding the
             # template layer put on the weight dims (TP-inside-PP composition)
             from jax.sharding import NamedSharding, PartitionSpec
             p0_val = leaves[0]
-            base = [None] * (arr.ndim - 2)
+            base = [None] * p0_val.ndim
             if isinstance(getattr(p0_val, "sharding", None), NamedSharding) \
                     and p0_val.sharding.spec is not None:
                 for i, s in enumerate(tuple(p0_val.sharding.spec)):
                     if i < len(base):
                         base[i] = s
             spec = ["pp", None] + base
-            arr = jax.device_put(arr, NamedSharding(self._mesh.jax_mesh(),
-                                                    PartitionSpec(*spec)))
+            stacked_shape = (self._S * self._V, self._L) + tuple(p0_val.shape)
+            sharding = NamedSharding(self._mesh.jax_mesh(),
+                                     PartitionSpec(*spec))
+            if isinstance(p0_val, jax.ShapeDtypeStruct):
+                # LazyGuard-abstract body (AOT planning on a model too large
+                # to materialize): stack abstractly, placement attached
+                arr = jax.ShapeDtypeStruct(stacked_shape, p0_val.dtype,
+                                           sharding=sharding)
+            else:
+                arr = jnp.stack(leaves)  # [S*V*L, ...]
+                arr = arr.reshape(stacked_shape)
+                arr = jax.device_put(arr, sharding)
             p0 = dict(template.named_parameters())[n]
             sp = Parameter(arr, trainable=not p0.stop_gradient,
                            name=f"pipeline_body.{n}")
@@ -292,6 +300,33 @@ class PipelineParallel:
         if lr_scheduler is not None:
             lr_scheduler.step()
         return Tensor(loss_val)
+
+    def aot_compile(self, optimizer, x, y=None):
+        """AOT-compile the scheduled train-step program WITHOUT executing it.
+
+        ``x`` / ``y`` may be ``jax.ShapeDtypeStruct``s (shardings attached)
+        and the model may be LazyGuard-abstract, so a pp x tp config too
+        large to materialize still compiles and memory-checks on a virtual
+        mesh — the pipeline analog of TrainStep.aot_compile. Returns the jax
+        ``Compiled`` (``memory_analysis()``, ``as_text()``). Reference
+        analog: the pipeline scheduler pass compiling its program before the
+        first train_batch (passes/pipeline_scheduler_pass)."""
+        self._remap_optimizer(optimizer)
+        trainable = [p for p in self.parameters() if not p.stop_gradient]
+        optimizer._ensure_slots(trainable)
+        has_labels = y is not None
+        step_jit = self._build_step(trainable, optimizer, has_labels)
+        param_vals = read_values(trainable)
+        slot_vals = [optimizer._slots[id(p)] for p in trainable]
+        lr = jax.ShapeDtypeStruct((), jnp.float32)
+        step_i = jax.ShapeDtypeStruct((), jnp.int32)
+        rng = jax.eval_shape(lambda: jax.random.key(0))
+        xv = x._value if isinstance(x, Tensor) else x
+        args = (param_vals, slot_vals, lr, step_i, rng, xv)
+        if has_labels:
+            yv = y._value if isinstance(y, Tensor) else y
+            args = args + (yv,)
+        return step_jit.lower(*args).compile()
 
     def eval_batch(self, data, compute_loss=True):
         x, y = data if isinstance(data, (list, tuple)) else (data, None)

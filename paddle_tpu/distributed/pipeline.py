@@ -22,6 +22,21 @@ from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 
+def _psum(y, axis):
+    """psum that survives the XLA *CPU* backend's AllReducePromotion pass.
+
+    jax 0.7 lowers an in-shard_map psum with a sharding annotation INSIDE the
+    reduction body (sdy.sharding_constraint -> an HLO `copy`); promoting a
+    16-bit all-reduce then dies in CloneAllReduce ("Invalid binary
+    instruction opcode copy"). CPU promotes all 16-bit all-reduces, so
+    reduce in f32 there; real TPU backends reduce bf16 natively and keep the
+    half-width ICI traffic."""
+    if jax.default_backend() == "cpu" and y.dtype in (jnp.bfloat16,
+                                                      jnp.float16):
+        return jax.lax.psum(y.astype(jnp.float32), axis).astype(y.dtype)
+    return jax.lax.psum(y, axis)
+
+
 def spmd_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp", remat=False):
     """Run microbatches through a ring of identical stages.
 
@@ -58,7 +73,7 @@ def spmd_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp", remat=False):
         _, outs = jax.lax.scan(step, jnp.zeros_like(x[0]), jnp.arange(T))
         y = outs[S - 1:]                       # [M, mb, ...] valid on last stage
         y = jnp.where(idx == S - 1, y, jnp.zeros_like(y))
-        return jax.lax.psum(y, axis)           # replicate last stage's outputs
+        return _psum(y, axis)           # replicate last stage's outputs
 
     spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
     # manual over the pipeline axis only: dp/mp/sharding axes stay automatic, so
@@ -157,7 +172,7 @@ def scheduled_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp",
             step, (zero_mb, jnp.zeros_like(x), jnp.zeros_like(x)),
             jnp.arange(T))
         y = jnp.where(idx == S - 1, y_buf, jnp.zeros_like(y_buf))
-        return jax.lax.psum(y, axis), resid[None]  # [1(pp), M, mb...]
+        return _psum(y, axis), resid[None]  # [1(pp), M, mb...]
 
     def bwd_device(params_l, resid_l, dy_mb):
         params = jax.tree_util.tree_map(lambda a: a[0], params_l)
@@ -218,7 +233,7 @@ def scheduled_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp",
 
         dx_mb = jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf))
         dparams = jax.tree_util.tree_map(lambda a: a[None], dw_acc)
-        return dparams, jax.lax.psum(dx_mb, axis)
+        return dparams, _psum(dx_mb, axis)
 
     spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
     resid_spec = P(axis)
@@ -285,7 +300,7 @@ def interleaved_pipeline(stage_fn, stacked_params, x_mb, mesh, axis="pp",
             _, outs = jax.lax.scan(step, jnp.zeros_like(carry_x[0]), jnp.arange(T))
             y = outs[S - 1:]
             y = jnp.where(idx == S - 1, y, jnp.zeros_like(y))
-            return jax.lax.psum(y, axis), None
+            return _psum(y, axis), None
 
         y, _ = jax.lax.scan(run_ring, x, jnp.arange(V))
         return y
@@ -384,7 +399,7 @@ def scheduled_interleaved_pipeline(stage_fn, stacked_params, x_mb, mesh,
                 step, (jnp.zeros_like(carry_x[0]), jnp.zeros_like(carry_x),
                        jnp.zeros_like(carry_x)), jnp.arange(T))
             y = jnp.where(idx == S - 1, y_buf, jnp.zeros_like(y_buf))
-            return jax.lax.psum(y, axis), resid_buf
+            return _psum(y, axis), resid_buf
 
         y, resid_v = jax.lax.scan(chunk_fwd, x, jnp.arange(V))
         return y, resid_v[None]                  # [1(pp), V, M, mb...]
@@ -426,7 +441,7 @@ def scheduled_interleaved_pipeline(stage_fn, stacked_params, x_mb, mesh,
                 jnp.arange(U))
             dx_mb = jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf))
             # stage-0 dx of chunk v is the upstream dy of chunk v-1
-            return jax.lax.psum(dx_mb, axis), dy_buf
+            return _psum(dx_mb, axis), dy_buf
 
         dx_final, dy_bufs_rev = jax.lax.scan(chunk_bwd, dy_mb,
                                              jnp.arange(V - 1, -1, -1))

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from ..nn import Layer, Linear, Embedding, RMSNorm, LayerList
 from ..nn import functional as F
-from ..core.tensor import Tensor, dispatch
+from ..core.tensor import Tensor, dispatch, functional_mode
 from .. import ops
 
 
@@ -103,6 +103,50 @@ class StaticKVCache:
         self.k, self.v = k, v
 
 
+class PagedKVCache:
+    """vLLM-style paged KV cache (reference:
+    python/paddle/incubate/nn/functional/block_multihead_attention.py:1 —
+    the phi block_multi_head_attention kernel's layout): physical pools
+    ``k``/``v`` of shape [num_blocks, H, block_size, D], a per-sequence
+    ``block_tables`` [B, max_blocks] mapping logical KV block -> physical
+    block (-1 = unallocated), and ``seq_lens`` [B] tokens already cached.
+    Decode steps attend through
+    :func:`paddle_tpu.incubate.nn.functional.block_multihead_attention`."""
+
+    __slots__ = ("k", "v", "block_tables", "seq_lens")
+
+    def __init__(self, k, v, block_tables, seq_lens):
+        self.k, self.v = k, v
+        self.block_tables, self.seq_lens = block_tables, seq_lens
+
+
+def _sample_logits_device(logits, key, temperature, top_k, top_p):
+    """In-graph sampling head: greedy / temperature / top-k / top-p, all
+    computed on device from the framework RNG (reference surface: paddlenlp
+    generation's TopKProcess/TopPProcess, executed host-side there): top-k
+    filter first, then the nucleus mass cut on the renormalized
+    distribution."""
+    logits = logits.astype(jnp.float32)
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.float32(temperature)
+    V = logits.shape[-1]
+    if top_k and 0 < int(top_k) < V:
+        kth = jax.lax.top_k(logits, int(top_k))[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and float(top_p) < 1.0:
+        sorted_desc = -jnp.sort(-logits, axis=-1)
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the minimal prefix reaching top_p mass: a position survives
+        # when the mass BEFORE it is still < top_p
+        keep = (cum - probs) < float(top_p)
+        cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
 class LlamaAttention(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -160,6 +204,28 @@ class LlamaAttention(Layer):
             q, k = dispatch(
                 lambda qq, kk: apply_rope(qq, kk, cos, sin, position_offset),
                 (q, k), {}, name="rope")
+        if isinstance(kv_cache, PagedKVCache):
+            # paged decode step (one new token/sequence) through the
+            # block_multihead_attention op — the framework's own paged-KV
+            # kernel as the generate() cache backend
+            if self.num_kv_heads != self.num_heads:
+                raise ValueError(
+                    "PagedKVCache decode requires num_kv_heads == num_heads "
+                    "(block_multihead_attention is MHA-form)")
+            if s != 1:
+                raise ValueError("PagedKVCache is a decode-step cache "
+                                 f"(one token per step); got seq len {s}")
+            from ..incubate.nn import functional as IF
+            H, D = self.num_heads, self.head_dim
+            qkv = ops.concat([ops.reshape(q, [b, H * D]),
+                              ops.reshape(k, [b, H * D]),
+                              ops.reshape(v, [b, H * D])], axis=-1)
+            out, kc, vc = IF.block_multihead_attention(
+                qkv, kv_cache.k, kv_cache.v, None, kv_cache.seq_lens, None,
+                block_tables=kv_cache.block_tables)
+            out = self.o_proj(ops.reshape(out, [b, 1, H * D]))
+            new_lens = kv_cache.seq_lens + 1
+            return out, PagedKVCache(kc, vc, kv_cache.block_tables, new_lens)
         if isinstance(kv_cache, StaticKVCache):
             def upd(buf, new, off):
                 return jax.lax.dynamic_update_slice_in_dim(
@@ -306,51 +372,151 @@ class LlamaForCausalLM(Layer):
             ops.reshape(labels, [-1]), ignore_index=-100)
         return loss, logits
 
-    @staticmethod
-    def _sample(logits_np, temperature, top_k, top_p, rng):
-        if temperature <= 0.0:
-            return np.argmax(logits_np, axis=-1)
-        logits_np = logits_np / temperature
-        out = np.empty(logits_np.shape[0], np.int64)
-        for b in range(logits_np.shape[0]):
-            row = logits_np[b]
-            if top_k and top_k > 0:
-                tk = min(int(top_k), len(row))
-                kth = np.partition(row, -tk)[-tk]
-                row = np.where(row < kth, -np.inf, row)
-            probs = np.exp(row - row.max())
-            probs = probs / probs.sum()
-            if top_p and top_p < 1.0:
-                order = np.argsort(-probs)
-                cum = np.cumsum(probs[order])
-                cut = np.searchsorted(cum, top_p) + 1
-                mask = np.zeros_like(probs)
-                mask[order[:cut]] = 1.0
-                probs = probs * mask
-                probs = probs / probs.sum()
-            out[b] = rng.choice(len(probs), p=probs)
-        return out
+    def _gen_programs(self, B, prompt_len, limit, total, temperature, top_k,
+                      top_p, eos_token_id, cache_impl, block_size):
+        """Build (or fetch cached) the two compiled generation programs:
+
+        - ``prefill``: embed -> all layers (causal flash) -> last-position
+          logits + per-layer KV buffers, as ONE jitted program.
+        - ``decode``: the ENTIRE decode loop as one jitted program — a
+          ``lax.while_loop`` whose body is sample (on-device, from the
+          framework RNG) -> one-token model step -> cache write. No logits
+          ever travel to host; the only host transfer is the final token
+          buffer. With TP/dp-sharded weights the same programs partition
+          under GSPMD (single-controller SPMD decode).
+
+        Reference analog: the fused-decode serving stack —
+        incubate/nn/functional/masked_multihead_attention.py:1 (dense) /
+        block_multihead_attention.py:1 (paged) under AnalysisPredictor
+        (paddle/fluid/inference/api/analysis_predictor.h:101)."""
+        from ..jit.functional_call import collect_state, bind_state
+
+        c = self.config
+        key = (B, prompt_len, limit, total, float(temperature), int(top_k),
+               float(top_p), eos_token_id, cache_impl, int(block_size))
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        if key in cache:
+            return cache[key]
+
+        _, params, _, buffers = collect_state(self)
+        state = params + buffers
+        head_dim = c.hidden_size // c.num_attention_heads
+        kvh = c.num_key_value_heads
+        n_layers = c.num_hidden_layers
+        paged = cache_impl == "paged"
+        bs = int(block_size)
+        mb = -(-total // bs)  # blocks per sequence
+
+        dt = self.llama.embed_tokens.weight.dtype
+
+        def prefill(state_vals, ids_v):
+            empty = [(Tensor(jnp.zeros((B, 0, kvh, head_dim), dt)),
+                      Tensor(jnp.zeros((B, 0, kvh, head_dim), dt)))
+                     for _ in range(n_layers)]
+            with functional_mode(), bind_state(state, state_vals):
+                hidden, grown = self.llama(Tensor(ids_v), kv_caches=empty,
+                                           position_offset=0)
+                logits = self._logits(hidden[:, -1:])._value[:, 0]
+            if paged:
+                # scatter prompt KV into the block pools: logical block i of
+                # sequence b lives at physical block b*mb + i
+                k_bufs, v_bufs = [], []
+                for k, v in grown:
+                    def pool(t):
+                        tv = t._value
+                        pad = mb * bs - tv.shape[1]
+                        tv = jnp.pad(tv, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                        tv = tv.reshape(B, mb, bs, kvh, head_dim)
+                        return jnp.moveaxis(tv, 2, 3).reshape(
+                            B * mb, kvh, bs, head_dim)
+                    k_bufs.append(pool(k))
+                    v_bufs.append(pool(v))
+            else:
+                def to_static(t):
+                    pad = total - t.shape[1]
+                    return jnp.pad(t._value,
+                                   ((0, 0), (0, pad), (0, 0), (0, 0)))
+                k_bufs = [to_static(k) for k, _ in grown]
+                v_bufs = [to_static(v) for _, v in grown]
+            return logits, k_bufs, v_bufs
+
+        tables = jnp.arange(B * mb, dtype=jnp.int32).reshape(B, mb)
+
+        def decode(state_vals, k_bufs, v_bufs, logits0, rng_key):
+            buf0 = jnp.zeros((B, limit), jnp.int32)
+            finished0 = jnp.zeros((B,), bool)
+
+            def cond(carry):
+                i, _, _, _, _, finished, _ = carry
+                cont = i < limit
+                if eos_token_id is not None:
+                    cont = jnp.logical_and(cont, ~jnp.all(finished))
+                return cont
+
+            def body(carry):
+                i, logits, kb, vb, rkey, finished, buf = carry
+                rkey, sub = jax.random.split(rkey)
+                nxt = _sample_logits_device(logits, sub, temperature, top_k,
+                                            top_p)
+                if eos_token_id is not None:
+                    nxt = jnp.where(finished, jnp.int32(eos_token_id), nxt)
+                    finished = finished | (nxt == eos_token_id)
+                buf = jax.lax.dynamic_update_slice(buf, nxt[:, None],
+                                                   (jnp.int32(0), i))
+                off = jnp.int32(prompt_len) + i
+                with functional_mode(), bind_state(state, state_vals):
+                    if paged:
+                        lens = jnp.full((B,), off, jnp.int32)
+                        caches = [PagedKVCache(k, v, tables, lens)
+                                  for k, v in zip(kb, vb)]
+                    else:
+                        caches = [StaticKVCache(k, v)
+                                  for k, v in zip(kb, vb)]
+                    last_h, new_caches = self.llama(
+                        Tensor(nxt[:, None]), kv_caches=caches,
+                        position_offset=Tensor(off))
+                    logits = self._logits(last_h)._value[:, 0]
+                kb = [getattr(cc.k, "_value", cc.k) for cc in new_caches]
+                vb = [getattr(cc.v, "_value", cc.v) for cc in new_caches]
+                return (i + 1, logits, kb, vb, rkey, finished, buf)
+
+            i, _, _, _, _, _, buf = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), logits0, k_bufs, v_bufs, rng_key, finished0,
+                 buf0))
+            return buf, i
+
+        # decode consumes the prefill-built caches exactly once — donate them
+        # so the cache update is in-place (no 2x KV footprint on chip)
+        entry = (jax.jit(prefill), jax.jit(decode, donate_argnums=(1, 2)))
+        cache[key] = entry
+        return entry
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_k=0, top_p=1.0, eos_token_id=None):
-        """Autoregressive decoding with a per-layer KV cache (reference
-        surface: paddlenlp GenerationMixin.generate; the reference keeps it
-        out-of-tree, the flagship model here ships it in-core).
+                 top_k=0, top_p=1.0, eos_token_id=None, cache_impl="static",
+                 block_size=64):
+        """Autoregressive decoding, fully compiled (reference surface:
+        paddlenlp GenerationMixin.generate over the fused-decode inference
+        stack; the reference keeps it out-of-tree, the flagship model here
+        ships it in-core).
 
-        Prefill runs the full prompt once (flash-attention path, causal);
-        decode steps feed ONE token against a fixed-capacity
-        :class:`StaticKVCache` with a TRACED position offset — every step
-        has identical shapes, so the whole generation runs through one
-        compiled program per op (no per-token recompiles). Attention over
-        the padded cache is masked to the valid prefix.
-        temperature<=0 = greedy; top_k/top_p sampling draws from the
-        framework RNG (``paddle.seed``-deterministic). Decoding is capped
-        at ``max_position_embeddings`` (the rope table's end) with a
-        warning.
+        Prefill is ONE compiled program (causal flash over the prompt);
+        the whole decode loop is ONE more (on-device while_loop: sample ->
+        one-token step -> cache write), so logits never round-trip to host
+        and per-token cost is pure device compute. ``cache_impl="static"``
+        holds dense fixed-capacity per-layer buffers (:class:`StaticKVCache`)
+        written at a traced offset; ``cache_impl="paged"`` routes decode
+        attention through the framework's
+        ``block_multihead_attention`` paged-KV op (:class:`PagedKVCache`,
+        ``block_size``-token blocks). temperature<=0 = greedy; top_k/top_p
+        sampling draws from the framework RNG (``paddle.seed``-
+        deterministic). Works with TP/dp-sharded weights on a mesh (the
+        programs partition under GSPMD). Decoding is capped at
+        ``max_position_embeddings`` (the rope table's end) with a warning.
         """
         from ..core import random as _random
-        from ..core.tensor import no_grad
-        import jax.numpy as jnp
 
         c = self.config
         ids = input_ids if isinstance(input_ids, Tensor) \
@@ -360,6 +526,8 @@ class LlamaForCausalLM(Layer):
             raise ValueError(
                 f"prompt length {prompt_len} >= max_position_embeddings "
                 f"{c.max_position_embeddings}: no positions left to decode")
+        if cache_impl not in ("static", "paged"):
+            raise ValueError(f"unknown cache_impl {cache_impl!r}")
         limit = min(int(max_new_tokens),
                     c.max_position_embeddings - prompt_len)
         if limit < int(max_new_tokens):
@@ -372,61 +540,26 @@ class LlamaForCausalLM(Layer):
         if limit <= 0:
             return Tensor(jnp.zeros((B, 0), jnp.int64))
         total = prompt_len + limit
-        head_dim = c.hidden_size // c.num_attention_heads
-        dt = self.llama.embed_tokens.weight.dtype
-        empty = [(Tensor(jnp.zeros((B, 0, c.num_key_value_heads, head_dim),
-                                   dt)),
-                  Tensor(jnp.zeros((B, 0, c.num_key_value_heads, head_dim),
-                                   dt)))
-                 for _ in range(c.num_hidden_layers)]
         seed, counter = _random.default_generator.next_seed()
-        rng = np.random.default_rng((seed, counter))
+        rng_key = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
 
         was_training = self.training
         self.eval()
         try:
-            with no_grad():
-                # prefill: one causal pass over the whole prompt (flash
-                # path), then pad each layer's cache to the FINAL length so
-                # all decode steps share static shapes (StaticKVCache)
-                hidden, grown = self.llama(ids, kv_caches=empty,
-                                           position_offset=0)
-
-                def to_static(t):
-                    pad = total - t.shape[1]
-                    return Tensor(jnp.pad(
-                        t._value, ((0, 0), (0, pad), (0, 0), (0, 0))))
-
-                caches = [StaticKVCache(to_static(k), to_static(v))
-                          for k, v in grown]
-                generated = []
-                cur_len = prompt_len
-                last_h = hidden[:, -1:]
-                finished = np.zeros(B, bool)
-                for _ in range(limit):
-                    logits = self._logits(last_h)
-                    nxt = self._sample(
-                        np.asarray(logits._value[:, 0]).astype(np.float32),
-                        temperature, top_k, top_p, rng)
-                    if eos_token_id is not None:
-                        nxt = np.where(finished, eos_token_id, nxt)
-                        finished |= (nxt == eos_token_id)
-                    generated.append(nxt)
-                    if eos_token_id is not None and finished.all():
-                        break
-                    if cur_len >= total:
-                        break
-                    tok = Tensor(jnp.asarray(nxt[:, None], jnp.int32))
-                    # traced offset: the decode program is keyed on shapes
-                    # only — step 2 onward hits the compiled dispatch cache
-                    off = Tensor(jnp.asarray(cur_len, jnp.int32))
-                    last_h, caches = self.llama(
-                        tok, kv_caches=caches, position_offset=off)
-                    cur_len += 1
+            prefill, decode = self._gen_programs(
+                B, prompt_len, limit, total, temperature, top_k, top_p,
+                eos_token_id, cache_impl, block_size)
+            from ..jit.functional_call import collect_state, read_values
+            _, params, _, buffers = collect_state(self)
+            state_vals = read_values(params + buffers)
+            logits0, k_bufs, v_bufs = prefill(state_vals,
+                                              ids._value.astype(jnp.int32))
+            buf, n = decode(state_vals, k_bufs, v_bufs, logits0, rng_key)
         finally:
             if was_training:
                 self.train()
-        out = np.stack(generated, axis=1)
+        n = int(np.asarray(n))
+        out = np.asarray(buf)[:, :n]
         return Tensor(jnp.asarray(out, jnp.int64))
 
     def flops_per_token(self, seq_len):

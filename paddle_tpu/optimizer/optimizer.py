@@ -152,8 +152,18 @@ class Optimizer:
                     # LazyGuard-abstract param: slots stay abstract too (the
                     # same _init_slots logic, evaluated shape-only) — enables
                     # AOT compile/memory planning of the full train step
-                    # without materializing optimizer state
-                    self._slots[id(p)] = jax.eval_shape(build, v)
+                    # without materializing optimizer state. eval_shape drops
+                    # shardings, so param-shaped slots re-attach the param's
+                    # (matching eager, where zeros_like(v) inherits it)
+                    slots = jax.eval_shape(build, v)
+                    sh = getattr(v, "sharding", None)
+                    if sh is not None:
+                        slots = {
+                            k: (jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                                     sharding=sh)
+                                if tuple(s.shape) == tuple(v.shape) else s)
+                            for k, s in slots.items()}
+                    self._slots[id(p)] = slots
                 else:
                     self._slots[id(p)] = build(v)
 

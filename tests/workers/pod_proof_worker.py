@@ -1,0 +1,300 @@
+"""Pod-topology AOT proof worker: compile the REAL Llama-2-7B train step on
+the north-star v5e-256 virtual mesh (dp=32 x tp=8) and report the per-device
+budget + collective contract as JSON lines.
+
+Run in a SUBPROCESS (tests/test_7b_scale.py::test_7b_pod_topology_256) so the
+256-device XLA_FLAGS override doesn't collide with the suite's 8-device
+backend. Reference analog: the dp x mp x pp composition of
+test/auto_parallel/hybrid_strategy/semi_auto_llama.py:1 at its target
+topology, with the AOT memory/collective proof standing in for a pod run.
+
+Configs:
+- ``dp32_tp8``      — params TP-sharded over mp, AdamW state ZeRO-1-over-mp
+                      (the 8-device proof's contract, now composed with a
+                      32-way dp axis: per-device state must MATCH the TP=8
+                      proof, and the dp-axis grad all-reduce must appear in
+                      the compiled HLO alongside the TP collectives).
+- ``dp32_tp8_zero1dp`` — AdamW state additionally ZeRO-1-sharded over dp:
+                      master+moments drop a further 32x per device.
+- ``pp8_tp8_dp4``   — 7B through the SCHEDULED pipeline runtime (1F1B
+                      microbatch schedule over a pp axis) composed with TP
+                      inside each stage, compiled AOT on the same 256 mesh.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
+def _setup(ndev):
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={ndev}").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+# Megatron TP placement plan — same rules the 8-device proof uses
+# (tests/test_7b_scale.py _TP_RULES; weights are [in, out] like nn.Linear).
+_TP_RULES = (
+    ("embed_tokens.weight", ("mp", None)),
+    ("q_proj.weight", (None, "mp")),
+    ("k_proj.weight", (None, "mp")),
+    ("v_proj.weight", (None, "mp")),
+    ("o_proj.weight", ("mp", None)),
+    ("gate_proj.weight", (None, "mp")),
+    ("up_proj.weight", (None, "mp")),
+    ("down_proj.weight", ("mp", None)),
+    ("lm_head.weight", (None, "mp")),
+)
+
+
+def _tp_spec(name):
+    from jax.sharding import PartitionSpec as P
+    for pat, spec in _TP_RULES:
+        if name.endswith(pat):
+            return P(*spec)
+    return P()
+
+
+def replica_group_sizes(hlo: str) -> list:
+    """Group sizes of every reduction collective in optimized HLO text.
+    Handles both the explicit ``replica_groups={{0,8,...},...}`` form and the
+    iota form ``replica_groups=[ngroups,gsize]<=[...]``."""
+    import re
+    sizes = []
+    for m in re.finditer(r"replica_groups=\{\{([^}]*)\}", hlo):
+        sizes.append(len(m.group(1).split(",")))
+    for m in re.finditer(r"replica_groups=\[(\d+),(\d+)\]", hlo):
+        sizes.append(int(m.group(2)))
+    return sizes
+
+
+def _build_7b(mesh, seq_len):
+    import numpy as np
+    import jax
+    from jax.sharding import NamedSharding
+    import paddle_tpu as paddle
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    # Pallas fused update would trace in interpret mode on the CPU backend;
+    # the XLA update carries the identical memory/placement contract
+    set_flags({"use_fused_adamw": False})
+    cfg = LlamaConfig.llama2_7b(use_recompute=True,
+                                max_position_embeddings=seq_len)
+    paddle.seed(0)
+    with paddle.LazyGuard():
+        model = LlamaForCausalLM(cfg).bfloat16()
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    assert n_params > 6.7e9, f"not the real 7B: {n_params}"
+    for name, p in model.named_parameters():
+        p._value = jax.ShapeDtypeStruct(
+            p._value.shape, p._value.dtype,
+            sharding=NamedSharding(mesh, _tp_spec(name)))
+    return model, n_params
+
+
+def _loss_fn(m, ids, labels):
+    loss, _ = m(ids, labels=labels)
+    return loss
+
+
+def run_hybrid(ndev, zero1_dp):
+    """dp=32 x tp=8 on ndev=256 virtual devices (scaled down pro rata when
+    ndev is smaller, for fast local iteration)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import paddle_tpu.optimizer as opt_mod
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.utils.hlo_check import CompileReport
+
+    mp = 8
+    dp = ndev // mp
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    mesh = hcg.mesh.jax_mesh()
+
+    S = 2048
+    B_per_dp = 4                      # matches the 8-device proof's batch
+    B = B_per_dp * dp
+    model, n_params = _build_7b(mesh, S)
+    optimizer = opt_mod.AdamW(learning_rate=3e-4,
+                              parameters=model.parameters(),
+                              weight_decay=0.01, multi_precision=True)
+    # AdamW state ZeRO-1 over mp (mirrors the param TP placements) — the
+    # 8-device proof's contract; optionally a further ZeRO-1 over dp, which
+    # stores master+moments sharded over BOTH axes (1/256 per device)
+    wrapped = fleet.DygraphShardingOptimizer(optimizer, hcg, axis="mp",
+                                             stage=1)
+    assert wrapped._stage == 1
+    if zero1_dp:
+        wrapped_dp = fleet.DygraphShardingOptimizer(optimizer, hcg,
+                                                    axis="dp", stage=1)
+        assert wrapped_dp._stage == 1
+
+    batch_sharding = NamedSharding(mesh, P("dp", None))
+    ids = Tensor(jax.ShapeDtypeStruct((B, S), jnp.int32,
+                                      sharding=batch_sharding))
+    labels = Tensor(jax.ShapeDtypeStruct((B, S), jnp.int32,
+                                         sharding=batch_sharding))
+    step = TrainStep(model, _loss_fn, optimizer, donate=True)
+    compiled = step.aot_compile(ids, labels)
+    rep = CompileReport(compiled.as_text(), compiled.memory_analysis(), (), ())
+    out = {
+        "event": "pod_proof",
+        "config": ("dp%d_tp%d" % (dp, mp)) + ("_zero1dp" if zero1_dp else ""),
+        "n_devices": ndev,
+        "n_params": n_params,
+        "global_batch": B,
+        "state_bytes_per_dev": int(rep.stats.argument_size_in_bytes),
+        "out_bytes_per_dev": rep.out_bytes,
+        "collective_counts": rep.collective_counts(),
+        "reduction_group_sizes": sorted(set(replica_group_sizes(rep.hlo))),
+    }
+    print(json.dumps(out), flush=True)
+
+
+def run_pp(ndev):
+    """7B through the SCHEDULED pipeline runtime (1F1B): pp=8 x tp=8 x dp=4
+    at ndev=256 (pp=2 x tp=4 x dp scaled down pro rata for local iteration).
+    The pipeline body is the real LlamaDecoderLayer; embed/head run
+    replicated across pp per the SPMD-pipeline design."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.ops as ops
+    import paddle_tpu.optimizer as opt_mod
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import LlamaConfig
+    from paddle_tpu.models.llama import LlamaDecoderLayer, precompute_rope
+    from paddle_tpu.nn.layer_base import Layer
+    from paddle_tpu.utils.hlo_check import CompileReport
+
+    set_flags({"use_fused_adamw": False})
+    if ndev >= 256:
+        mp, pp = 8, 8
+    else:
+        mp, pp = 4, 2
+    dp = ndev // (mp * pp)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": pp, "sharding_degree": 1,
+                               "sep_degree": 1}
+    M = 8  # microbatches (1F1B accumulate_steps)
+    strategy.pipeline_configs = {"accumulate_steps": M,
+                                 "schedule_mode": "1F1B"}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    mesh = hcg.mesh.jax_mesh()
+
+    S = 2048
+    B = max(dp, 1) * M  # M microbatches, each dp-divisible
+    cfg = LlamaConfig.llama2_7b(use_recompute=False,
+                                max_position_embeddings=S)
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    rope = precompute_rope(head_dim, S, cfg.rope_theta)
+
+    class Embed(Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+
+        def forward(self, ids):
+            return self.embed_tokens(ids)
+
+    class Block(Layer):
+        def __init__(self):
+            super().__init__()
+            self.block = LlamaDecoderLayer(cfg)
+
+        def forward(self, x):
+            return self.block(x, rope)
+
+    class Head(Layer):
+        def __init__(self):
+            super().__init__()
+            self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias_attr=False)
+
+        def forward(self, x):
+            return self.lm_head(self.norm(x))
+
+    def pp_loss(logits, labels):
+        return F.cross_entropy(
+            ops.reshape(logits, [-1, cfg.vocab_size]),
+            ops.reshape(labels, [-1]), ignore_index=-100)
+
+    paddle.seed(0)
+    with paddle.LazyGuard():
+        descs = ([fleet.LayerDesc(Embed)]
+                 + [fleet.LayerDesc(Block)
+                    for _ in range(cfg.num_hidden_layers)]
+                 + [fleet.LayerDesc(Head)])
+        model = fleet.PipelineLayer(layers=descs, loss_fn=pp_loss)
+        model = model.bfloat16()
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    assert n_params > 6.7e9, f"not the real 7B: {n_params}"
+    for name, p in model.named_parameters():
+        p._value = jax.ShapeDtypeStruct(
+            p._value.shape, p._value.dtype,
+            sharding=NamedSharding(mesh, _tp_spec(name)))
+
+    pp_model = fleet.distributed_model(model)
+    optimizer = opt_mod.AdamW(learning_rate=3e-4,
+                              parameters=pp_model.parameters(),
+                              weight_decay=0.01, multi_precision=True)
+    ids = jax.ShapeDtypeStruct((B, S), jnp.int32,
+                               sharding=NamedSharding(mesh, P("dp", None)))
+    labels = jax.ShapeDtypeStruct((B, S), jnp.int32,
+                                  sharding=NamedSharding(mesh, P("dp", None)))
+    compiled = pp_model.aot_compile(optimizer, ids, labels)
+    rep = CompileReport(compiled.as_text(), compiled.memory_analysis(), (), ())
+    counts = rep.collective_counts()
+    out = {
+        "event": "pod_proof",
+        "config": f"pp{pp}_tp{mp}_dp{dp}_1f1b",
+        "n_devices": ndev,
+        "n_params": n_params,
+        "global_batch": B,
+        "microbatches": M,
+        "state_bytes_per_dev": int(rep.stats.argument_size_in_bytes),
+        "out_bytes_per_dev": rep.out_bytes,
+        "collective_counts": counts,
+        "reduction_group_sizes": sorted(set(replica_group_sizes(rep.hlo))),
+    }
+    print(json.dumps(out), flush=True)
+
+
+def main():
+    ndev = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    config = sys.argv[2] if len(sys.argv) > 2 else "dp_tp"
+    _setup(ndev)
+    if config == "dp_tp":
+        run_hybrid(ndev, zero1_dp=False)
+    elif config == "dp_tp_zero1dp":
+        run_hybrid(ndev, zero1_dp=True)
+    elif config == "pp_tp":
+        run_pp(ndev)
+    else:
+        raise SystemExit(f"unknown config {config!r}")
+
+
+if __name__ == "__main__":
+    main()
