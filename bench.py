@@ -150,7 +150,17 @@ def _bench_other(model_name):
 
     if model_name == "vit":
         from paddle_tpu.vision.models import vit_large_patch16
-        B = int(os.environ.get("BENCH_BATCH", "32"))
+        # defaults = best measured config (round 4 sweep, 24-step runs):
+        # B=40 + bf16 AdamW moments -> 45.4% MFU (was 38.0 at B=32 + fp32
+        # moments). The gap was optimizer-state traffic (307M params x 8B
+        # fp32 moments r/w per step) plus too little per-step compute to
+        # amortize the weight+state streaming; B>=56 regresses again
+        # (activation working set without remat). Curve: 32/38.0, 32+bf16m/
+        # 39.0, 40/45.4, 48/44.1-44.5, 56/42.5, 64/43.1, 72/40.3, 96/36.5.
+        B = int(os.environ.get("BENCH_BATCH", "40"))
+        if os.environ.get("BENCH_BF16_MOMENTS", "1") == "1":
+            from paddle_tpu.core.flags import set_flags
+            set_flags({"adamw_bf16_moments": True})
         model = vit_large_patch16(num_classes=1000).bfloat16()
         n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
         optimizer = opt.AdamW(learning_rate=3e-4,
@@ -242,7 +252,8 @@ def _bench_other(model_name):
 
         def run_pair():
             l0, kb, vb = prefill(state_vals, ids_v)
-            buf, n = decode(state_vals, kb, vb, l0, key)
+            buf, n = decode(state_vals, kb, vb, l0, key,
+                            jnp.float32(1.0), jnp.float32(1.0))
             int(np.asarray(n))
             return buf
 
@@ -272,7 +283,149 @@ def _bench_other(model_name):
     if model_name == "dispatch":
         return _bench_dispatch()
 
+    if model_name == "memcheck":
+        return _bench_memcheck()
+
+    if model_name == "loss_parity":
+        return _bench_loss_parity()
+
     raise ValueError(f"unknown BENCH_MODEL {model_name!r}")
+
+
+def run_loss_parity(cfg_over=None, B=4, S=1024, steps=100, lr=3e-4):
+    """Long-horizon loss-curve parity (VERDICT r3 #8): train the SAME llama
+    config twice — bf16 params with fp32 AdamW masters (the production
+    chain) vs an all-fp32 reference — with matched data order and RNG, and
+    return the two trajectories + max relative divergence. Shared by the
+    on-chip bench mode and the CPU CI test (tests/test_loss_parity.py)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    base = dict(vocab_size=8192, hidden_size=2048, intermediate_size=5632,
+                num_hidden_layers=2, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=S,
+                use_recompute=True)
+    base.update(cfg_over or {})
+    cfg = LlamaConfig(**base)
+
+    def run(bf16):
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        if bf16:
+            model = model.bfloat16()
+        optimizer = opt.AdamW(learning_rate=lr,
+                              parameters=model.parameters(),
+                              weight_decay=0.01, multi_precision=bf16)
+
+        def loss_fn(m, ids, labels):
+            loss, _ = m(ids, labels=labels)
+            return loss
+
+        step = TrainStep(model, loss_fn, optimizer, donate=True)
+        rng = np.random.default_rng(42)  # matched data order across runs
+        losses = []
+        for _ in range(steps):
+            ids = paddle.to_tensor(
+                rng.integers(0, cfg.vocab_size, (B, S)), dtype="int32")
+            labels = paddle.to_tensor(
+                rng.integers(0, cfg.vocab_size, (B, S)), dtype="int32")
+            losses.append(float(np.asarray(step(ids, labels)._value)))
+        return losses
+
+    bf16 = run(True)
+    ref = run(False)
+    rel = [abs(a - b) / max(abs(b), 1e-9) for a, b in zip(bf16, ref)]
+    return {"bf16": bf16, "fp32": ref,
+            "max_rel_divergence": max(rel),
+            "final_rel_divergence": rel[-1],
+            "steps": steps}
+
+
+def _bench_loss_parity():
+    steps = int(os.environ.get("BENCH_PARITY_STEPS", "100"))
+    B = int(os.environ.get("BENCH_BATCH", "4"))
+    S = int(os.environ.get("BENCH_SEQ", "1024"))
+    res = run_loss_parity(B=B, S=S, steps=steps)
+    return {"metric": "llama_bf16_vs_fp32_loss_divergence_100step",
+            "value": round(res["max_rel_divergence"] * 100, 3),
+            "unit": "% max rel", "vs_baseline": None,
+            "final_rel_pct": round(res["final_rel_divergence"] * 100, 3),
+            "steps": steps,
+            "loss_first_bf16": round(res["bf16"][0], 4),
+            "loss_last_bf16": round(res["bf16"][-1], 4),
+            "loss_last_fp32": round(res["fp32"][-1], 4)}
+
+
+def _bench_memcheck():
+    """Cross-validate the 7B-fit memory model against the REAL TPU compiler
+    (VERDICT r3 weak #4/#5): AOT-compile the flagship bench config on this
+    backend and compare predicted residency (compiled state bytes + the
+    trace-level saved-residuals model that the virtual-mesh proofs rest on)
+    with the compiler's own ``peak_memory_in_bytes``. The gap IS the
+    in-segment transient — the number the 7B proof's "tens of MB" claim
+    needs. Compile-only: no arrays are materialized."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.utils.memory_model import residual_bytes
+
+    B = int(os.environ.get("BENCH_BATCH", "6"))
+    S = int(os.environ.get("BENCH_SEQ", "2048"))
+    n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+    hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+    ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+    heads = max(hidden // 128, 1)
+    set_flags({"adamw_bf16_moments": True, "use_fused_adamw": False})
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=hidden, intermediate_size=ff,
+        num_hidden_layers=n_layers, num_attention_heads=heads,
+        num_key_value_heads=heads, max_position_embeddings=S,
+        use_recompute=True)
+    paddle.seed(0)
+    with paddle.LazyGuard():
+        model = LlamaForCausalLM(cfg).bfloat16()
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    optimizer = opt.AdamW(learning_rate=3e-4, parameters=model.parameters(),
+                          weight_decay=0.01, multi_precision=True)
+
+    def loss_fn(m, ids, labels):
+        loss, _ = m(ids, labels=labels)
+        return loss
+
+    step = TrainStep(model, loss_fn, optimizer, donate=True)
+    ids = Tensor(jax.ShapeDtypeStruct((B, S), jnp.int32))
+    compiled = step.aot_compile(ids, ids)
+    m = compiled.memory_analysis()
+    state = int(m.argument_size_in_bytes)
+    peak = int(getattr(m, "peak_memory_in_bytes", 0))
+    try:
+        residuals = residual_bytes(step, (ids, ids), seq_len=S)
+        resid_err = None
+    except RuntimeError as e:
+        residuals, resid_err = None, str(e)
+    out = {"metric": "memcheck_7b_model_vs_compiler",
+           "value": None, "unit": "pct", "vs_baseline": None,
+           "params": n_params,
+           "state_bytes_compiled": state,
+           "residual_bytes_predicted": residuals,
+           "peak_bytes_compiler": peak,
+           "temp_bytes_compiler": int(m.temp_size_in_bytes),
+           "backend": jax.default_backend()}
+    if residuals is not None and peak:
+        predicted = state + residuals
+        out["predicted_resident_bytes"] = predicted
+        out["transient_bytes"] = peak - predicted
+        out["value"] = round((peak - predicted) / peak * 100, 2)
+    if resid_err:
+        out["residual_model_error"] = resid_err[:200]
+    return out
 
 
 def _bench_dispatch():

@@ -279,21 +279,31 @@ class StaticFunction:
         if isinstance(entry, _PrefixEntry):
             from .prefix_capture import _ReplayAbandoned
             from ..core.tensor import is_grad_enabled
-            # grads will record: the prefix (captured under no-grad)
-            # cannot replay — run plain eager WITHOUT executing the
-            # compiled prefix and WITHOUT counting a divergence (train/eval
-            # alternation must not demote the eval-path capture)
-            if is_grad_enabled() and (
-                    any(not p.stop_gradient for p in params)
-                    or any(isinstance(a, Tensor) and not a.stop_gradient
-                           for a in jax.tree_util.tree_leaves(
-                               (args, kwargs),
-                               is_leaf=lambda x: isinstance(x, Tensor)))):
+            grads_will_record = is_grad_enabled() and (
+                any(not p.stop_gradient for p in params)
+                or any(isinstance(a, Tensor) and not a.stop_gradient
+                       for a in jax.tree_util.tree_leaves(
+                           (args, kwargs),
+                           is_leaf=lambda x: isinstance(x, Tensor))))
+            # grads will record but the prefix compiled no vjp (captured
+            # under no-grad): run plain eager WITHOUT executing the compiled
+            # prefix and WITHOUT counting a divergence (train/eval
+            # alternation must not demote the eval-path capture). A
+            # grad-capable prefix replays with a tape node instead.
+            if grads_will_record and not entry.program.grad_capable:
                 return self._fn(*args, **kwargs)
+            # input tensors aligned with state_vals + dyn (None for raw
+            # arrays) — the training prefix's tape parents
+            input_tensors = list(params) + list(buffers) + [
+                leaf if isinstance(leaf, Tensor) else None
+                for leaf in jax.tree_util.tree_leaves(
+                    (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+                if isinstance(leaf, (Tensor, jax.Array, np.ndarray))]
             try:
                 result, diverged = entry.program.run(
                     list(state_vals) + list(dyn),
-                    lambda: self._fn(*args, **kwargs))
+                    lambda: self._fn(*args, **kwargs),
+                    input_tensors=input_tensors)
             except _ReplayAbandoned:
                 # the prefix program itself failed to trace/run — raised
                 # BEFORE any user code, so a plain eager call is safe

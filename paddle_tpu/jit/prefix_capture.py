@@ -20,18 +20,47 @@ host read is deterministic for a fixed signature, so
    mismatch abandons replay for plain eager). Ops after the break dispatch
    normally (each still hitting the compiled eager cache).
 
+**Training prefixes** (VERDICT r3 #7): a prefix that RECORDS GRADIENTS is
+captured too — the whole prefix compiles as one ``jax.vjp`` pair (cached
+exactly like the eager dispatch cache caches per-op vjps) and replay
+attaches ONE tape node covering every prefix output, so ``.backward()``
+through a ``.numpy()``-breaking *training* step differentiates the compiled
+prefix like any other op (reference: SOT compiles training code through
+breaks, jit/sot/opcode_translator/executor/opcode_executor.py:353).
+
 Capture is abandoned — falling back to plain eager — when the prefix draws
-RNG (a compiled replay would freeze the randomness), records gradients
-(replayed values carry no tape), runs under AMP autocast, or never reaches
-a detectable break.
+RNG (a compiled replay would freeze the randomness), runs under AMP
+autocast, or never reaches a detectable break. Abandon reasons are counted
+in :func:`capture_stats` so coverage loss is visible.
 """
 from __future__ import annotations
+
+import functools
+import weakref
 
 import numpy as np
 import jax
 
 from ..core import tensor as T
 from ..core import random as _random
+
+#: observability: how many captures compiled / why captures were abandoned
+_CAPTURE_STATS = {"captured": 0, "grad_captured": 0, "abandoned": {}}
+
+
+def capture_stats() -> dict:
+    """Counters for compiled-prefix capture: successful captures (eval and
+    grad-recording) and per-reason abandon counts."""
+    return {"captured": _CAPTURE_STATS["captured"],
+            "grad_captured": _CAPTURE_STATS["grad_captured"],
+            "abandoned": dict(_CAPTURE_STATS["abandoned"])}
+
+
+def _count_abandon(reason):
+    # fold per-op suffixes ("... in <op>" / "... (<op>)") into one bucket
+    key = reason.split(" in ")[0].split(" (")[0]
+    _CAPTURE_STATS["abandoned"][key] = \
+        _CAPTURE_STATS["abandoned"].get(key, 0) + 1
 
 
 def _classify(leaves):
@@ -52,10 +81,11 @@ def _classify(leaves):
 
 class _OpRecord:
     __slots__ = ("fn", "name", "treedef", "layout", "statics", "prov",
-                 "out_meta", "out_treedef", "out_tpos", "out_others")
+                 "out_meta", "out_treedef", "out_tpos", "out_others",
+                 "recorded")
 
     def __init__(self, fn, name, treedef, layout, statics, prov, out_meta,
-                 out_treedef, out_tpos, out_others):
+                 out_treedef, out_tpos, out_others, recorded=False):
         self.fn = fn
         self.name = name
         self.treedef = treedef
@@ -66,6 +96,7 @@ class _OpRecord:
         self.out_treedef = out_treedef
         self.out_tpos = out_tpos      # leaf indices holding tensors
         self.out_others = out_others  # [(leaf index, python value), ...]
+        self.recorded = recorded      # op recorded gradients when captured
 
 
 #: constants larger than this are not baked into a prefix (they may vary
@@ -85,14 +116,13 @@ class PrefixRecorder:
         self.records: list[_OpRecord] = []
         self.break_found = False
         self.aborted = None  # reason string when capture is impossible
+        self.grad_recorded = False  # any prefix op recorded gradients
+        self.diff_inputs = set()    # input positions feeding diff op args
 
     # -- dispatch hook -------------------------------------------------------
     def after_op(self, fn, name, leaves, treedef, result, recorded_grad,
                  rng_drew):
         if self.break_found or self.aborted:
-            return
-        if recorded_grad:
-            self.aborted = "prefix records gradients"
             return
         if rng_drew:
             self.aborted = "prefix draws RNG"
@@ -108,15 +138,48 @@ class PrefixRecorder:
         except TypeError:
             self.aborted = f"unhashable static arg in {name}"
             return
+        tensor_leaves = [l for l in leaves
+                         if isinstance(l, (T.Tensor, jax.Array, np.ndarray))]
         prov = []
-        for v in tvals:
+        for v, leaf in zip(tvals, tensor_leaves):
             p = self._prov.get(id(v))
+            trainable = isinstance(leaf, T.Tensor) and not leaf.stop_gradient
             if p is None:
                 if getattr(v, "size", _MAX_CONST + 1) > _MAX_CONST:
                     self.aborted = f"large unknown-provenance tensor in {name}"
                     return
+                if trainable:
+                    # a trainable leaf that is neither a prefix input nor a
+                    # prefix intermediate would lose its gradient in replay
+                    self.aborted = \
+                        f"trainable leaf outside prefix inputs in {name}"
+                    return
                 p = ("const", np.asarray(v))
+            elif p[0] == "in" and trainable and recorded_grad:
+                # a trainable function input reaches a grad-RECORDING op:
+                # the compiled prefix must differentiate w.r.t. it. (A
+                # trainable input consumed only under no_grad must NOT
+                # become a tape parent — eager leaves its .grad None, and a
+                # spurious zero grad would let the optimizer apply weight
+                # decay to it.)
+                self.diff_inputs.add(p[1])
+            elif p[0] == "out" and recorded_grad:
+                # the whole-prefix vjp differentiates through EVERY
+                # intermediate; eager would cut gradient flow at a no_grad
+                # producer or a detached (stop_gradient) intermediate — a
+                # mismatch we must not silently compile in
+                if not self.records[p[1]].recorded:
+                    self.aborted = f"no_grad boundary inside prefix ({name})"
+                    return
+                import jax.numpy as jnp
+                if isinstance(leaf, T.Tensor) and leaf.stop_gradient \
+                        and jnp.issubdtype(leaf._value.dtype, jnp.inexact):
+                    self.aborted = \
+                        f"detached intermediate in grad prefix ({name})"
+                    return
             prov.append(p)
+        if recorded_grad:
+            self.grad_recorded = True
         out_all, out_treedef = jax.tree_util.tree_flatten(
             result, is_leaf=lambda x: isinstance(x, T.Tensor))
         out_tpos, out_vals, out_others = [], [], []
@@ -133,7 +196,8 @@ class PrefixRecorder:
         self.records.append(_OpRecord(
             fn, name, treedef, layout, tuple(statics), tuple(prov),
             tuple((tuple(ov.shape), str(ov.dtype)) for ov in out_vals),
-            out_treedef, tuple(out_tpos), tuple(out_others)))
+            out_treedef, tuple(out_tpos), tuple(out_others),
+            recorded=recorded_grad))
 
     # -- host-read hook ------------------------------------------------------
     def on_host_read(self, value):
@@ -144,6 +208,10 @@ class PrefixRecorder:
     def build(self):
         """Compile the prefix program, or return None when capture failed."""
         if self.aborted or not self.break_found or not self.records:
+            if self.aborted:
+                _count_abandon(self.aborted)
+            elif not self.break_found:
+                _count_abandon("no detectable break")
             return None
         records = list(self.records)
 
@@ -168,9 +236,31 @@ class PrefixRecorder:
                 outs.append([raw[i] for i in r.out_tpos])
             return outs
 
+        if self.grad_recorded:
+            # training prefix: ONE jax.vjp over the whole prefix, jitted —
+            # the prefix analog of the dispatch cache's per-op cached vjp
+            # pair. Replay attaches a single tape node for every output.
+            diff_idx = tuple(sorted(self.diff_inputs))
+
+            def fwd(input_vals):
+                def closed(*diff_vals):
+                    vv = list(input_vals)
+                    for p, v in zip(diff_idx, diff_vals):
+                        vv[p] = v
+                    return prefix_fn(vv)
+                return jax.vjp(closed,
+                               *[input_vals[p] for p in diff_idx])
+
+            _CAPTURE_STATS["grad_captured"] += 1
+            # forward-only variant compiled alongside: eval/no_grad calls on
+            # this signature must not materialize the vjp residuals
+            return PrefixProgram(jax.jit(fwd), records, diff_idx=diff_idx,
+                                 jitted_fwd=jax.jit(prefix_fn))
+
         # NOTE: jax.jit is lazy — trace failures surface at the first call,
         # which PrefixProgram.run converts into _ReplayAbandoned so the
         # caller can demote to plain eager instead of crashing
+        _CAPTURE_STATS["captured"] += 1
         return PrefixProgram(jax.jit(prefix_fn), records)
 
 
@@ -180,24 +270,61 @@ class _ReplayAbandoned(Exception):
 
 
 class PrefixProgram:
-    """Steady state: one compiled prefix + positional replay of its ops."""
+    """Steady state: one compiled prefix + positional replay of its ops.
 
-    def __init__(self, jitted, records):
+    ``diff_idx`` non-None marks a TRAINING prefix: the jitted program is a
+    ``jax.vjp`` pair over the inputs at those positions, and replay builds
+    one tape node spanning every prefix output."""
+
+    def __init__(self, jitted, records, diff_idx=None, jitted_fwd=None):
         self.jitted = jitted
         self.records = records
+        self.diff_idx = diff_idx
+        self.jitted_fwd = jitted_fwd  # forward-only program (grad prefixes)
         self.failures = 0
 
-    def run(self, input_vals, call_fn):
+    @property
+    def grad_capable(self):
+        return self.diff_idx is not None
+
+    def _tape_parents(self, input_tensors):
+        """The diff-input Tensors, or None when this call can't rebuild the
+        tape (grads off, tensors missing, or a recorded trainable frozen
+        since capture — grads would be wrong)."""
+        if input_tensors is None or not T.is_grad_enabled():
+            return None
+        parents = []
+        for p in self.diff_idx:
+            t = input_tensors[p] if p < len(input_tensors) else None
+            if t is None or t.stop_gradient:
+                return None
+            parents.append(t)
+        return parents or None
+
+    def run(self, input_vals, call_fn, input_tensors=None):
         """Execute ``call_fn`` eagerly with prefix dispatches answered from
         the compiled program. Divergence mid-stream is NOT an error: every
         replayed value is provenance-verified, so the replay simply ends and
         execution continues eagerly — no re-run, no doubled side effects.
-        Returns (result, diverged)."""
+        For a training prefix, ``input_tensors`` (aligned with
+        ``input_vals``; None for non-Tensor inputs) supplies the tape
+        parents. Returns (result, diverged)."""
+        node = None
+        parents = self._tape_parents(input_tensors) if self.grad_capable \
+            else None
         try:
-            outs = self.jitted(input_vals)
+            if parents is not None:
+                outs, vjp_obj = self.jitted(input_vals)
+                node = self._make_node(outs, vjp_obj, input_vals, parents)
+            elif self.grad_capable:
+                # eval / no_grad call on a training-captured signature: the
+                # forward-only program — no vjp residuals materialized
+                outs = self.jitted_fwd(input_vals)
+            else:
+                outs = self.jitted(input_vals)
         except Exception as e:  # trace/compile failure (jit is lazy)
             raise _ReplayAbandoned(str(e)) from e
-        state = _ReplayState(self.records, outs, input_vals)
+        state = _ReplayState(self.records, outs, input_vals, node=node)
         saved = T._capture.replay
         T._capture.replay = state
         try:
@@ -206,22 +333,73 @@ class PrefixProgram:
             T._capture.replay = saved
         return result, state.diverged
 
+    def _make_node(self, outs, vjp_obj, input_vals, parents):
+        """One tape node covering the whole compiled prefix: cotangents for
+        every prefix output flow through the cached vjp to the diff inputs
+        (the prefix analog of _dispatch_cached's per-op node)."""
+        flat, out_treedef = jax.tree_util.tree_flatten(outs)
+        out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in flat]
+        records, diff_idx = self.records, self.diff_idx
+
+        def fwd_fn(*diff_vals):
+            vv = list(input_vals)
+            for p, v in zip(diff_idx, diff_vals):
+                vv[p] = v
+            outs2 = []
+            for r in records:
+                vals, si, pi = [], iter(r.statics), iter(r.prov)
+                for tag in r.layout:
+                    if tag == "S":
+                        vals.append(next(si))
+                    else:
+                        pr = next(pi)
+                        if pr[0] == "in":
+                            vals.append(vv[pr[1]])
+                        elif pr[0] == "out":
+                            vals.append(outs2[pr[1]][pr[2]])
+                        else:
+                            vals.append(pr[1])
+                a, k = jax.tree_util.tree_unflatten(r.treedef, vals)
+                raw = jax.tree_util.tree_leaves(r.fn(*a, **k))
+                outs2.append([raw[i] for i in r.out_tpos])
+            return outs2
+
+        node = T.Node(functools.partial(T._bwd_call, vjp_obj), parents,
+                      out_treedef, out_avals, "compiled_prefix",
+                      fwd_fn=fwd_fn)
+        node.outputs = [None] * len(out_avals)
+        return node
+
 
 class _ReplayState:
-    __slots__ = ("records", "outs", "input_vals", "i", "done", "diverged")
+    __slots__ = ("records", "outs", "input_vals", "i", "done", "diverged",
+                 "node", "_base")
 
-    def __init__(self, records, outs, input_vals):
+    def __init__(self, records, outs, input_vals, node=None):
         self.records = records
         self.outs = outs
         self.input_vals = input_vals
         self.i = 0
         self.done = False
         self.diverged = False
+        #: tape node spanning all prefix outputs (training prefix), or None
+        self.node = node
+        base, acc = [], 0
+        for group in outs:
+            base.append(acc)
+            acc += len(group)
+        self._base = base
 
     def _matches(self, r, name, leaves, treedef, record):
-        if record:
-            # replayed tensors carry no tape — a grad-recording op must run
-            # eagerly (and ends the replay: its outputs' provenance is gone)
+        if record and self.node is None:
+            # replayed tensors carry no tape and this prefix compiled no
+            # vjp — a grad-recording op must run eagerly (and ends the
+            # replay: its outputs' provenance is gone)
+            return False
+        if self.node is not None and record != r.recorded:
+            # grad-capable replay: each op's recording state must match the
+            # capture (a frozen-since-capture or newly-trainable leaf would
+            # silently change which outputs join the tape)
             return False
         layout, tvals, statics = _classify(leaves)
         if name != r.name or layout != r.layout or treedef != r.treedef \
@@ -260,12 +438,24 @@ class _ReplayState:
             self.diverged = True
             return T._REPLAY_PASS
         out_vals = self.outs[self.i]
+        base = self._base[self.i]
         self.i += 1
         # rebuild the op's exact output structure from the recording
         n = len(r.out_tpos) + len(r.out_others)
         out_leaves = [None] * n
-        for idx, ov in zip(r.out_tpos, out_vals):
-            out_leaves[idx] = T.Tensor(ov)
+        import jax.numpy as jnp
+        for j, (idx, ov) in enumerate(zip(r.out_tpos, out_vals)):
+            # only outputs of ops that RECORDED at capture time join the
+            # tape — a no_grad op's output stays a constant, like eager
+            diff = self.node is not None and r.recorded and \
+                jnp.issubdtype(ov.dtype, jnp.inexact)
+            t = T.Tensor(ov, stop_gradient=not diff)
+            if diff:
+                # link into the single prefix-spanning tape node
+                t._node = self.node
+                t._out_index = base + j
+                self.node.outputs[base + j] = weakref.ref(t)
+            out_leaves[idx] = t
         for idx, other in r.out_others:
             out_leaves[idx] = other
         return jax.tree_util.tree_unflatten(r.out_treedef, out_leaves)

@@ -65,6 +65,35 @@ class LlamaConfig:
                                      max_position_embeddings=256), **over})
 
 
+#: Megatron TP placement plan for the llama stack (weights are [in, out]
+#: like nn.Linear): column-parallel shards the output dim, row-parallel the
+#: input dim, the vocab embedding its vocab dim. THE canonical table — the
+#: 7B scale proofs, the pod-topology worker, and the sharded-generate tests
+#: all consume it (reference: fleet mp_layers Column/RowParallelLinear as
+#: applied in test/auto_parallel/hybrid_strategy/semi_auto_llama.py).
+LLAMA_TP_RULES = (
+    ("embed_tokens.weight", ("mp", None)),
+    ("q_proj.weight", (None, "mp")),
+    ("k_proj.weight", (None, "mp")),
+    ("v_proj.weight", (None, "mp")),
+    ("o_proj.weight", ("mp", None)),
+    ("gate_proj.weight", (None, "mp")),
+    ("up_proj.weight", (None, "mp")),
+    ("down_proj.weight", ("mp", None)),
+    ("lm_head.weight", (None, "mp")),
+)
+
+
+def llama_tp_spec(name, axis="mp"):
+    """PartitionSpec for parameter ``name`` under LLAMA_TP_RULES (norms and
+    everything unlisted: replicated)."""
+    from jax.sharding import PartitionSpec
+    for pat, spec in LLAMA_TP_RULES:
+        if name.endswith(pat):
+            return PartitionSpec(*[axis if s == "mp" else s for s in spec])
+    return PartitionSpec()
+
+
 def precompute_rope(head_dim, max_len, theta=10000.0):
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
                                 / head_dim))
@@ -120,27 +149,30 @@ class PagedKVCache:
         self.block_tables, self.seq_lens = block_tables, seq_lens
 
 
-def _sample_logits_device(logits, key, temperature, top_k, top_p):
+def _sample_logits_device(logits, key, temp_val, top_k, top_p_val, greedy,
+                          use_top_p):
     """In-graph sampling head: greedy / temperature / top-k / top-p, all
     computed on device from the framework RNG (reference surface: paddlenlp
     generation's TopKProcess/TopPProcess, executed host-side there): top-k
     filter first, then the nucleus mass cut on the renormalized
-    distribution."""
+    distribution. ``greedy``/``top_k``/``use_top_p`` are STATIC (they shape
+    the program); ``temp_val``/``top_p_val`` are traced scalars, so a
+    serving loop varying them never recompiles."""
     logits = logits.astype(jnp.float32)
-    if temperature <= 0.0:
+    if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / jnp.float32(temperature)
+    logits = logits / temp_val.astype(jnp.float32)
     V = logits.shape[-1]
     if top_k and 0 < int(top_k) < V:
         kth = jax.lax.top_k(logits, int(top_k))[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p and float(top_p) < 1.0:
+    if use_top_p:
         sorted_desc = -jnp.sort(-logits, axis=-1)
         probs = jax.nn.softmax(sorted_desc, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # keep the minimal prefix reaching top_p mass: a position survives
         # when the mass BEFORE it is still < top_p
-        keep = (cum - probs) < float(top_p)
+        keep = (cum - probs) < top_p_val.astype(jnp.float32)
         cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
                          keepdims=True)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
@@ -392,8 +424,13 @@ class LlamaForCausalLM(Layer):
         from ..jit.functional_call import collect_state, bind_state
 
         c = self.config
-        key = (B, prompt_len, limit, total, float(temperature), int(top_k),
-               float(top_p), eos_token_id, cache_impl, int(block_size))
+        # temperature/top_p VALUES are traced decode args; only the program
+        # STRUCTURE (greedy vs sampling, top-k width, nucleus on/off) keys
+        # the compile cache — varying sampling params never recompiles
+        greedy = float(temperature) <= 0.0
+        use_top_p = bool(top_p) and float(top_p) < 1.0
+        key = (B, prompt_len, limit, total, greedy, int(top_k), use_top_p,
+               eos_token_id, cache_impl, int(block_size))
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
@@ -444,7 +481,8 @@ class LlamaForCausalLM(Layer):
 
         tables = jnp.arange(B * mb, dtype=jnp.int32).reshape(B, mb)
 
-        def decode(state_vals, k_bufs, v_bufs, logits0, rng_key):
+        def decode(state_vals, k_bufs, v_bufs, logits0, rng_key, temp_val,
+                   top_p_val):
             buf0 = jnp.zeros((B, limit), jnp.int32)
             finished0 = jnp.zeros((B,), bool)
 
@@ -458,8 +496,9 @@ class LlamaForCausalLM(Layer):
             def body(carry):
                 i, logits, kb, vb, rkey, finished, buf = carry
                 rkey, sub = jax.random.split(rkey)
-                nxt = _sample_logits_device(logits, sub, temperature, top_k,
-                                            top_p)
+                nxt = _sample_logits_device(logits, sub, temp_val,
+                                            int(top_k), top_p_val, greedy,
+                                            use_top_p)
                 if eos_token_id is not None:
                     nxt = jnp.where(finished, jnp.int32(eos_token_id), nxt)
                     finished = finished | (nxt == eos_token_id)
@@ -554,7 +593,9 @@ class LlamaForCausalLM(Layer):
             state_vals = read_values(params + buffers)
             logits0, k_bufs, v_bufs = prefill(state_vals,
                                               ids._value.astype(jnp.int32))
-            buf, n = decode(state_vals, k_bufs, v_bufs, logits0, rng_key)
+            buf, n = decode(state_vals, k_bufs, v_bufs, logits0, rng_key,
+                            jnp.float32(max(float(temperature), 1e-6)),
+                            jnp.float32(top_p))
         finally:
             if was_training:
                 self.train()

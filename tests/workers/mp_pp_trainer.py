@@ -1,0 +1,139 @@
+"""Cross-process MODEL-parallel trainers (VERDICT r3 #2): each worker owns
+TWO local CPU devices, the launcher spawns 2 workers, and the 4-device global
+mesh is carved into mp=4 (TP) or pp=4 (pipeline) — so the row-parallel
+all-reduce / stage ppermute GROUPS SPAN THE PROCESS BOUNDARY and the
+collectives genuinely cross processes (gloo), not just virtual devices.
+
+Reference analog: test/collective/fleet/hybrid_parallel_mp_model.py:1 (TP
+across real ranks) and hybrid_parallel_pp_layer.py:1 (PP across real ranks).
+
+argv: mode out_path [steps]   mode in {tp, pp}
+Env: PT_LOCAL_DEVICES (default 2) — virtual CPU devices per process; the
+single-process parity reference runs this same script with
+PT_LOCAL_DEVICES=4 and no launcher.
+"""
+import json
+import os
+import re
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+ndev = os.environ.get("PT_LOCAL_DEVICES", "2")
+os.environ["XLA_FLAGS"] = \
+    (flags + f" --xla_force_host_platform_device_count={ndev}").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt_mod
+from paddle_tpu.distributed import fleet
+from paddle_tpu.jit.api import TrainStep
+from paddle_tpu.nn.layer_base import Layer
+
+D = 16
+GB = 8  # global batch; pipeline runs it as 4 microbatches of 2
+
+
+class TPBlock(Layer):
+    """Megatron pair: column-parallel up (sharded activations stay sharded),
+    row-parallel down (contraction over the sharded dim -> the all-reduce
+    that must cross the process boundary)."""
+
+    def __init__(self):
+        super().__init__()
+        self.up = fleet.ColumnParallelLinear(D, 4 * D, has_bias=True,
+                                             gather_output=False)
+        self.down = fleet.RowParallelLinear(4 * D, D, has_bias=True,
+                                            input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down(F.gelu(self.up(x)))
+
+
+class PPBlock(Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(D, D)
+
+    def forward(self, x):
+        return x + F.gelu(self.fc(x))
+
+
+def main():
+    mode, out = sys.argv[1], sys.argv[2]
+    steps = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+    total = jax.device_count()
+
+    strategy = fleet.DistributedStrategy()
+    if mode == "tp":
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": total,
+                                   "pp_degree": 1, "sharding_degree": 1,
+                                   "sep_degree": 1}
+    elif mode == "pp":
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": total, "sharding_degree": 1,
+                                   "sep_degree": 1}
+        strategy.pipeline_configs = {"accumulate_steps": 4,
+                                     "schedule_mode": "1F1B"}
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+    fleet.init(is_collective=True, strategy=strategy)
+
+    if world > 1:
+        # the point of this worker: the model-parallel groups must span
+        # processes, not just this process's local devices
+        assert total == world * jax.local_device_count(), \
+            f"global mesh missing devices: {total}"
+        assert total > jax.local_device_count(), "groups are process-local"
+
+    paddle.seed(0)
+    rng = np.random.default_rng(11)
+    losses = []
+
+    if mode == "tp":
+        model = TPBlock()
+        optimizer = opt_mod.AdamW(learning_rate=1e-2,
+                                  parameters=model.parameters())
+        step = TrainStep(model, lambda m, x, y: F.mse_loss(m(x), y),
+                         optimizer)
+        for _ in range(steps):
+            x = paddle.to_tensor(
+                rng.standard_normal((GB, D)).astype(np.float32))
+            y = paddle.to_tensor(
+                rng.standard_normal((GB, D)).astype(np.float32))
+            losses.append(float(np.asarray(step(x, y)._value)))
+    else:
+        descs = [fleet.LayerDesc(PPBlock) for _ in range(total)]
+        model = fleet.PipelineLayer(
+            layers=descs, loss_fn=lambda o, l: F.mse_loss(o, l))
+        pp_model = fleet.distributed_model(model)
+        optimizer = opt_mod.AdamW(learning_rate=1e-2,
+                                  parameters=pp_model.parameters())
+        for _ in range(steps):
+            x = paddle.to_tensor(
+                rng.standard_normal((GB, D)).astype(np.float32))
+            y = paddle.to_tensor(
+                rng.standard_normal((GB, D)).astype(np.float32))
+            loss = pp_model.train_batch([x, y], optimizer)
+            losses.append(float(np.asarray(loss._value)))
+
+    if rank == 0:
+        with open(out, "w") as f:
+            json.dump({"losses": losses, "world": world, "devices": total,
+                       "mode": mode}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -8,17 +8,19 @@ backend. Reference analog: the dp x mp x pp composition of
 test/auto_parallel/hybrid_strategy/semi_auto_llama.py:1 at its target
 topology, with the AOT memory/collective proof standing in for a pod run.
 
-Configs:
-- ``dp32_tp8``      — params TP-sharded over mp, AdamW state ZeRO-1-over-mp
+CLI configs (argv: n_devices config; JSON "config" labels carry the
+resolved degrees, e.g. dp32_tp8):
+- ``dp_tp``         — params TP-sharded over mp, AdamW state ZeRO-1-over-mp
                       (the 8-device proof's contract, now composed with a
-                      32-way dp axis: per-device state must MATCH the TP=8
-                      proof, and the dp-axis grad all-reduce must appear in
-                      the compiled HLO alongside the TP collectives).
-- ``dp32_tp8_zero1dp`` — AdamW state additionally ZeRO-1-sharded over dp:
-                      master+moments drop a further 32x per device.
-- ``pp8_tp8_dp4``   — 7B through the SCHEDULED pipeline runtime (1F1B
+                      32-way dp axis at 256: per-device state must MATCH the
+                      TP=8 proof, and the dp-axis grad all-reduce must
+                      appear in the compiled HLO alongside the TP
+                      collectives).
+- ``dp_tp_zero1dp`` — AdamW state additionally ZeRO-1-sharded over dp:
+                      master+moments drop a further dp-degree x per device.
+- ``pp_tp``         — 7B through the SCHEDULED pipeline runtime (1F1B
                       microbatch schedule over a pp axis) composed with TP
-                      inside each stage, compiled AOT on the same 256 mesh.
+                      inside each stage (pp8 x tp8 x dp4 at 256).
 """
 import json
 import os
@@ -37,27 +39,11 @@ def _setup(ndev):
     jax.config.update("jax_platforms", "cpu")
 
 
-# Megatron TP placement plan — same rules the 8-device proof uses
-# (tests/test_7b_scale.py _TP_RULES; weights are [in, out] like nn.Linear).
-_TP_RULES = (
-    ("embed_tokens.weight", ("mp", None)),
-    ("q_proj.weight", (None, "mp")),
-    ("k_proj.weight", (None, "mp")),
-    ("v_proj.weight", (None, "mp")),
-    ("o_proj.weight", ("mp", None)),
-    ("gate_proj.weight", (None, "mp")),
-    ("up_proj.weight", (None, "mp")),
-    ("down_proj.weight", ("mp", None)),
-    ("lm_head.weight", (None, "mp")),
-)
-
-
 def _tp_spec(name):
-    from jax.sharding import PartitionSpec as P
-    for pat, spec in _TP_RULES:
-        if name.endswith(pat):
-            return P(*spec)
-    return P()
+    # THE canonical Megatron plan (paddle_tpu.models.llama.LLAMA_TP_RULES) —
+    # also used by tests/test_7b_scale.py and the sharded-generate test
+    from paddle_tpu.models.llama import llama_tp_spec
+    return llama_tp_spec(name)
 
 
 def replica_group_sizes(hlo: str) -> list:
