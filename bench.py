@@ -416,7 +416,7 @@ def _bench_memcheck():
            "state_bytes_compiled": state,
            "residual_bytes_predicted": residuals,
            "peak_bytes_compiler": peak,
-           "temp_bytes_compiler": int(m.temp_size_in_bytes),
+           "temp_bytes_compiler": int(getattr(m, "temp_size_in_bytes", 0)),
            "backend": jax.default_backend()}
     if residuals is not None and peak:
         predicted = state + residuals

@@ -172,6 +172,17 @@ for _name in _INPLACE_NAMES:
         _bind(_name + "_", _inplace(getattr(_ops, _name)))
 
 
+# module-level in-place forms the reference exports in paddle.__all__
+# (python/paddle/__init__.py: index_add_, index_put_) — thin wrappers over
+# the bound Tensor methods
+def index_add_(x, index, axis, value, name=None):
+    return x.index_add_(index, axis, value)
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    return x.index_put_(indices, value, accumulate)
+
+
 def _fill_(self, value):
     import jax.numpy as jnp
     self._value = jnp.full_like(self._value, value)

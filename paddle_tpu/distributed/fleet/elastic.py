@@ -25,7 +25,8 @@ class ElasticStatus:
 
 class ElasticManager:
     def __init__(self, store, node_id=None, lease_ttl=10.0, min_nodes=1,
-                 max_nodes=None, on_change=None, prefix="__elastic"):
+                 max_nodes=None, on_change=None, prefix="__elastic",
+                 register=True):
         self.store = store
         self.node_id = node_id or uuid.uuid4().hex[:12]
         self.lease_ttl = lease_ttl
@@ -33,6 +34,10 @@ class ElasticManager:
         self.max_nodes = max_nodes
         self.on_change = on_change
         self.prefix = prefix
+        #: register=False = WATCH-ONLY: this manager observes the node
+        #: registry without joining it (the launcher-controller side of the
+        #: reference's watch -> relaunch loop; node agents register)
+        self.register = register
         self._stop = threading.Event()
         self._hb_thread = None
         self._watch_thread = None
@@ -97,11 +102,13 @@ class ElasticManager:
             self.status = ElasticStatus.HOLD
 
     def start(self):
-        self._register()
+        if self.register:
+            self._register()
+            self._hb_thread = threading.Thread(target=self._heartbeat,
+                                               daemon=True)
+            self._hb_thread.start()
         self._members = self.alive_nodes()
-        self._hb_thread = threading.Thread(target=self._heartbeat, daemon=True)
         self._watch_thread = threading.Thread(target=self._watch, daemon=True)
-        self._hb_thread.start()
         self._watch_thread.start()
         return self
 
@@ -110,7 +117,7 @@ class ElasticManager:
         for t in (self._hb_thread, self._watch_thread):
             if t:
                 t.join(timeout=5)
-        if deregister:
+        if deregister and self.register:
             # dropping the lease is enough — alive_nodes() filters dead leases;
             # the slot entry stays (stable ordering for any rejoin history)
             self.store.delete(f"{self.prefix}/node/{self.node_id}")

@@ -7,4 +7,5 @@ TCPStore daemon (csrc/tcp_store.cc) instead of an HTTP/etcd service; on TPU pods
 the normal topology is ONE process per host addressing all local chips, with
 `jax.distributed.initialize` driven by the env this launcher fabricates.
 """
-from .controller import Controller, launch  # noqa: F401
+from .controller import (Controller, ElasticController, launch,  # noqa: F401
+                         launch_elastic)

@@ -173,9 +173,25 @@ class Controller:
         for c in self.containers:
             c.start()
 
-    def stop(self):
+    def stop(self, grace=10.0):
+        """grace: seconds between SIGTERM and SIGKILL. NOTE trainers that
+        ran jax.distributed.initialize CATCH SIGTERM (the runtime's
+        preemption notifier treats it as a preemption signal and keeps
+        running) — teardowns that must actually stop training (elastic
+        reshape) pass a SHORT grace so the SIGKILL lands promptly."""
         for c in self.containers:
-            c.terminate()
+            c.terminate(grace=grace)
+
+    def _raise_failed(self, failed, codes):
+        """Shared failure report: first failed rank + its log tail."""
+        first = self.containers[failed[0]]
+        tail = ""
+        if first.log_path and os.path.exists(first.log_path):
+            with open(first.log_path, "rb") as f:
+                tail = f.read()[-4096:].decode(errors="replace")
+        raise RuntimeError(
+            f"rank {failed[0]} exited with code {codes[failed[0]]}\n"
+            f"--- log tail ---\n{tail}")
 
     def _monitor(self, poll_interval=0.5):
         """Supervise until success, failure (kill pod), or restart budget."""
@@ -191,14 +207,7 @@ class Controller:
                     self._restarts += 1
                     self.start()
                     continue
-                first = self.containers[failed[0]]
-                tail = ""
-                if first.log_path and os.path.exists(first.log_path):
-                    with open(first.log_path, "rb") as f:
-                        tail = f.read()[-4096:].decode(errors="replace")
-                raise RuntimeError(
-                    f"rank {failed[0]} exited with code {codes[failed[0]]}\n"
-                    f"--- log tail ---\n{tail}")
+                self._raise_failed(failed, codes)
             time.sleep(poll_interval)
 
     def run(self):
@@ -209,6 +218,88 @@ class Controller:
             self.stop()
 
 
+class ElasticController(Controller):
+    """MANAGER-driven elastic orchestration (reference: ElasticManager's
+    membership-watch -> relaunch-at-new-world-size loop,
+    fleet/elastic/manager.py:234-261 — NOT test-stitched launches).
+
+    A watch-only :class:`~paddle_tpu.distributed.fleet.elastic.ElasticManager`
+    observes node-agent leases in the launcher's store; each live agent
+    contributes ``nproc_per_node`` trainer slot(s) on this host (the
+    single-host simulation of cluster machines). On membership change the
+    CONTROLLER tears the pod down and relaunches at the new world size —
+    trainers resume from their checkpoints; below ``min_nodes`` the job
+    exits. Node agents join by running
+    ``ElasticManager(store_client).start()`` (heartbeat lease) and leave by
+    stopping it."""
+
+    def run_elastic(self, min_nodes=1, lease_ttl=3.0, poll_interval=0.3,
+                    startup_timeout=60.0):
+        from ..fleet.elastic import ElasticManager, ElasticStatus
+        mgr = ElasticManager(self.store, register=False, min_nodes=min_nodes,
+                             lease_ttl=lease_ttl)
+        deadline = time.time() + startup_timeout
+        while len(mgr.alive_nodes()) < max(min_nodes, 1):
+            if time.time() > deadline:
+                raise RuntimeError(
+                    "run_elastic: no node agents joined the registry "
+                    f"within {startup_timeout}s")
+            time.sleep(poll_interval)
+        mgr.start()
+        base_nproc = self.nproc_per_node
+        try:
+            while True:
+                self.nproc_per_node = base_nproc * len(mgr._members)
+                self.start()
+                status = self._supervise_elastic(mgr, poll_interval)
+                # short grace: jax.distributed workers CATCH SIGTERM (see
+                # stop()) — a reshape teardown must not let the old
+                # generation keep training through a long grace window
+                self.stop(grace=0.5)
+                if status == 0:
+                    return 0
+                if status == ElasticStatus.EXIT:
+                    raise RuntimeError(
+                        f"run_elastic: membership fell below min_nodes="
+                        f"{min_nodes}; stopping")
+                mgr.acknowledge()  # RESTART handled: relaunch at new size
+        finally:
+            # every exit path — including a budget-exhausted raise from
+            # _supervise_elastic — must reap the pod (SIGTERM-immune jax
+            # workers would otherwise train on as orphans)
+            self.stop(grace=0.5)
+            mgr.stop(deregister=False)
+
+    def _supervise_elastic(self, mgr, poll_interval):
+        from ..fleet.elastic import ElasticStatus
+        while True:
+            if mgr.status in (ElasticStatus.RESTART, ElasticStatus.EXIT):
+                return mgr.status
+            codes = [c.exit_code for c in self.containers]
+            if all(code == 0 for code in codes):
+                return 0
+            failed = [i for i, code in enumerate(codes)
+                      if code not in (None, 0)]
+            if failed:
+                # worker death WITHOUT a membership change: fault-tolerant
+                # same-size restart from the budget (the manager loop still
+                # owns any concurrent scale decision)
+                if self._restarts < self.max_restarts:
+                    self._restarts += 1
+                    return ElasticStatus.RESTART
+                self._raise_failed(failed, codes)
+            time.sleep(poll_interval)
+
+
 def launch(training_script, script_args=(), **kwargs):
     """Programmatic entry — returns the exit status (0 on success)."""
     return Controller(training_script, script_args, **kwargs).run()
+
+
+def launch_elastic(training_script, script_args=(), min_nodes=1,
+                   lease_ttl=3.0, **kwargs):
+    """Elastic entry: supervise under the manager's watch->relaunch loop.
+    ``nproc_per_node`` is the per-AGENT process count (world size scales
+    with live agents)."""
+    ctl = ElasticController(training_script, script_args, **kwargs)
+    return ctl.run_elastic(min_nodes=min_nodes, lease_ttl=lease_ttl)

@@ -293,12 +293,16 @@ class StaticFunction:
             if grads_will_record and not entry.program.grad_capable:
                 return self._fn(*args, **kwargs)
             # input tensors aligned with state_vals + dyn (None for raw
-            # arrays) — the training prefix's tape parents
-            input_tensors = list(params) + list(buffers) + [
-                leaf if isinstance(leaf, Tensor) else None
-                for leaf in jax.tree_util.tree_leaves(
-                    (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
-                if isinstance(leaf, (Tensor, jax.Array, np.ndarray))]
+            # arrays) — the training prefix's tape parents; only grad-capable
+            # programs consume them, so eval prefixes skip the tree walk
+            input_tensors = None
+            if entry.program.grad_capable:
+                input_tensors = list(params) + list(buffers) + [
+                    leaf if isinstance(leaf, Tensor) else None
+                    for leaf in jax.tree_util.tree_leaves(
+                        (args, kwargs),
+                        is_leaf=lambda x: isinstance(x, Tensor))
+                    if isinstance(leaf, (Tensor, jax.Array, np.ndarray))]
             try:
                 result, diverged = entry.program.run(
                     list(state_vals) + list(dyn),

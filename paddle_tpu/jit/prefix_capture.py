@@ -104,6 +104,31 @@ class _OpRecord:
 _MAX_CONST = 1024
 
 
+def _run_records(records, input_vals):
+    """THE prefix execution contract: symbolically replay every recorded op
+    against ``input_vals``, returning the per-op tensor-output lists. Shared
+    by the compiled forward, the compiled vjp, and the double-grad fwd_fn —
+    one place encodes the provenance wiring."""
+    outs = []
+    for r in records:
+        vals, si, pi = [], iter(r.statics), iter(r.prov)
+        for tag in r.layout:
+            if tag == "S":
+                vals.append(next(si))
+            else:
+                p = next(pi)
+                if p[0] == "in":
+                    vals.append(input_vals[p[1]])
+                elif p[0] == "out":
+                    vals.append(outs[p[1]][p[2]])
+                else:
+                    vals.append(p[1])
+        a, k = jax.tree_util.tree_unflatten(r.treedef, vals)
+        raw = jax.tree_util.tree_leaves(r.fn(*a, **k))
+        outs.append([raw[i] for i in r.out_tpos])
+    return outs
+
+
 class PrefixRecorder:
     """Installed as core.tensor._capture.recorder (thread-local) for one
     eager run."""
@@ -216,25 +241,7 @@ class PrefixRecorder:
         records = list(self.records)
 
         def prefix_fn(input_vals):
-            outs = []
-            for r in records:
-                vals, si, pi = [], iter(r.statics), iter(r.prov)
-                for tag in r.layout:
-                    if tag == "S":
-                        vals.append(next(si))
-                    else:
-                        p = next(pi)
-                        if p[0] == "in":
-                            vals.append(input_vals[p[1]])
-                        elif p[0] == "out":
-                            vals.append(outs[p[1]][p[2]])
-                        else:
-                            vals.append(p[1])
-                a, k = jax.tree_util.tree_unflatten(r.treedef, vals)
-                out = r.fn(*a, **k)  # raw jax values (dispatch fn contract)
-                raw = jax.tree_util.tree_leaves(out)
-                outs.append([raw[i] for i in r.out_tpos])
-            return outs
+            return _run_records(records, input_vals)
 
         if self.grad_recorded:
             # training prefix: ONE jax.vjp over the whole prefix, jitted —
@@ -345,24 +352,7 @@ class PrefixProgram:
             vv = list(input_vals)
             for p, v in zip(diff_idx, diff_vals):
                 vv[p] = v
-            outs2 = []
-            for r in records:
-                vals, si, pi = [], iter(r.statics), iter(r.prov)
-                for tag in r.layout:
-                    if tag == "S":
-                        vals.append(next(si))
-                    else:
-                        pr = next(pi)
-                        if pr[0] == "in":
-                            vals.append(vv[pr[1]])
-                        elif pr[0] == "out":
-                            vals.append(outs2[pr[1]][pr[2]])
-                        else:
-                            vals.append(pr[1])
-                a, k = jax.tree_util.tree_unflatten(r.treedef, vals)
-                raw = jax.tree_util.tree_leaves(r.fn(*a, **k))
-                outs2.append([raw[i] for i in r.out_tpos])
-            return outs2
+            return _run_records(records, vv)
 
         node = T.Node(functools.partial(T._bwd_call, vjp_obj), parents,
                       out_treedef, out_avals, "compiled_prefix",

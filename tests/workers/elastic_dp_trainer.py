@@ -8,7 +8,9 @@ Reference analog: fleet/elastic/manager.py kill->relaunch->resume flow,
 exercised with trainers that actually train (VERDICT r2 #2), not toy
 file-writers.
 
-argv: out_path ckpt_dir steps [kill_flag_path]
+argv: out_path ckpt_dir steps [kill_flag_path|-] [step_delay_s]
+  step_delay_s throttles training so lease-lapse-driven reshapes (the
+  manager-driven elastic test) can land mid-run deterministically.
 """
 import json
 import os
@@ -37,7 +39,9 @@ GLOBAL_BATCH = 8
 
 def main():
     out, ckpt_dir, steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
-    kill_flag = sys.argv[4] if len(sys.argv) > 4 else None
+    kill_flag = sys.argv[4] if len(sys.argv) > 4 and sys.argv[4] != "-" \
+        else None
+    step_delay = float(sys.argv[5]) if len(sys.argv) > 5 else 0.0
 
     dist.init_parallel_env()
     rank, world = dist.get_rank(), dist.get_world_size()
@@ -88,6 +92,9 @@ def main():
             json.dump({"step": i}, open(tmp, "w"))
             os.replace(tmp, meta_path)
         dist.barrier()  # rank 1 must not race ahead of the checkpoint write
+        if step_delay:
+            import time
+            time.sleep(step_delay)
 
     if rank == 0:
         with open(out, "a") as f:
