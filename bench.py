@@ -235,7 +235,15 @@ def _bench_other(model_name):
         paddle.seed(0)
         model = LlamaForCausalLM(cfg).bfloat16()
         model.eval()
+        # logical param count, BEFORE any quantized re-packing
         n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        # weight-only quantized decode (BENCH_WEIGHT_DTYPE=int8|int4):
+        # decode is weight-bandwidth-bound, so halving/quartering the
+        # weight bytes per token-step is the serving-throughput lever
+        weight_dtype = os.environ.get("BENCH_WEIGHT_DTYPE", "")
+        if weight_dtype:
+            from paddle_tpu.nn.quant import quantize_linears_for_inference
+            quantize_linears_for_inference(model, weight_dtype=weight_dtype)
         ids_v = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, prompt)),
                             jnp.int32)
         total = prompt + new_tokens
@@ -278,6 +286,7 @@ def _bench_other(model_name):
                 "prefill_tokens_per_sec": round(B * prompt / t_prefill, 1),
                 "prefill_s": round(t_prefill, 4),
                 "batch": B, "prompt_len": prompt, "new_tokens": new_tokens,
+                "weight_dtype": weight_dtype or "bf16",
                 "params": n_params}
 
     if model_name == "dispatch":

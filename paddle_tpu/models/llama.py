@@ -86,11 +86,29 @@ LLAMA_TP_RULES = (
 
 def llama_tp_spec(name, axis="mp"):
     """PartitionSpec for parameter ``name`` under LLAMA_TP_RULES (norms and
-    everything unlisted: replicated)."""
+    everything unlisted: replicated).
+
+    Weight-only quantized deploy params are covered too: a
+    ``*.quant_weight`` keeps its base linear's [in, out] placement (the
+    int4 packed in-dim shards the same way — each packed row holds two
+    adjacent input features), and ``*.weight_scale`` ([out]) shards iff the
+    base rule shards the out dim — otherwise a quantized model would
+    silently replicate under TP."""
     from jax.sharding import PartitionSpec
+
+    def expand(spec):
+        return PartitionSpec(*[axis if s == "mp" else s for s in spec])
+
     for pat, spec in LLAMA_TP_RULES:
         if name.endswith(pat):
-            return PartitionSpec(*[axis if s == "mp" else s for s in spec])
+            return expand(spec)
+        stem = pat[:-len(".weight")] if pat.endswith(".weight") else None
+        if stem is not None:
+            if name.endswith(stem + ".quant_weight"):
+                return expand(spec)
+            if name.endswith(stem + ".weight_scale"):
+                return expand(spec[1:]) if spec[1] == "mp" \
+                    else PartitionSpec()
     return PartitionSpec()
 
 
