@@ -77,6 +77,10 @@ def _forward_flops(model, arg_tensors):
         cost = lowered.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
+        if cost is None or "flops" not in cost:
+            # some backends (the axon TPU tunnel) only cost-analyze the
+            # COMPILED module; forward-only, so remat can't inflate it
+            cost = lowered.compile().cost_analysis()
         return float(cost["flops"])
     except Exception:
         return None
