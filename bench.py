@@ -74,13 +74,14 @@ def _forward_flops(model, arg_tensors):
     try:
         lowered = jax.jit(fwd).lower([t._value for t in state],
                                      [t._value for t in arg_tensors])
-        cost = lowered.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
+        def norm(c):
+            return c[0] if isinstance(c, (list, tuple)) else c
+
+        cost = norm(lowered.cost_analysis())
         if cost is None or "flops" not in cost:
             # some backends (the axon TPU tunnel) only cost-analyze the
             # COMPILED module; forward-only, so remat can't inflate it
-            cost = lowered.compile().cost_analysis()
+            cost = norm(lowered.compile().cost_analysis())
         return float(cost["flops"])
     except Exception:
         return None
