@@ -63,6 +63,11 @@ def _configure(lib):
     lib.pt_scan_floats.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
                                    ctypes.c_int, ctypes.c_int,
                                    ctypes.POINTER(ScanResult)]
+
+    lib.pt_ps_server_start.restype = ctypes.c_void_p
+    lib.pt_ps_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_int)]
+    lib.pt_ps_server_stop.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -73,17 +78,38 @@ def load():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH):
+        # Rebuild when the .so is missing or older than any source — a
+        # prebuilt .so from an older tree would load but miss newer symbols.
+        # The build itself is serialized across processes with flock so
+        # concurrently-starting workers don't race g++ over the same outputs.
+        if _stale():
             try:
-                subprocess.run(["make", "-C", _CSRC_DIR],
-                               capture_output=True, timeout=120, check=True)
+                import fcntl
+                with open(os.path.join(_CSRC_DIR, ".build.lock"), "w") as lk:
+                    fcntl.flock(lk, fcntl.LOCK_EX)
+                    if _stale():  # first holder built it
+                        subprocess.run(["make", "-C", _CSRC_DIR],
+                                       capture_output=True, timeout=120,
+                                       check=True)
             except Exception:
-                return None
+                if not os.path.exists(_LIB_PATH):
+                    return None
         try:
             _lib = _configure(ctypes.CDLL(_LIB_PATH))
-        except OSError:
+        except (OSError, AttributeError):
             _lib = None
         return _lib
+
+
+def _stale():
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for fn in os.listdir(_CSRC_DIR):
+        if fn.endswith((".cc", ".h")) or fn == "Makefile":
+            if os.path.getmtime(os.path.join(_CSRC_DIR, fn)) > lib_mtime:
+                return True
+    return False
 
 
 def available() -> bool:

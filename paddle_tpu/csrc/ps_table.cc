@@ -1,0 +1,585 @@
+// Native parameter-server table node — sharded sparse embedding storage with
+// in-server sparse optimizers.
+//
+// Reference analog: paddle/fluid/distributed/ps/ — brpc PsService
+// (ps/service/brpc_ps_server.cc) fronting MemorySparseTable
+// (ps/table/memory_sparse_table.cc: sharded row maps + sparse SGD/Adagrad
+// accessor rules, save/load). The TPU build keeps dense training state in
+// device HBM under jit; this native node serves the surviving PS use case —
+// host-resident huge sparse embeddings — with the same capability set
+// (lazy row init, sparse optimizers, sharded concurrency, save/load),
+// implemented as a C++ socket service rather than brpc.
+//
+// Protocol (header ints big-endian like the TCPStore; bulk id/float arrays are
+// raw host-endian — client and server are assumed same-architecture, which
+// holds for every deployment this runtime targets):
+//   request : u8 op | u32 nlen | table name | payload
+//   CREATE(1): u32 dim | u8 opt (0 sgd, 1 adagrad, 2 adam) | u32 lr_bits(f32)
+//              | u32 init_std_bits(f32) | u64 seed          -> u8 ok
+//   PULL(2)  : u64 n | i64 ids[n]                           -> u8 ok | u32 dim
+//              | f32 rows[n*dim]
+//   PUSH(3)  : u64 n | i64 ids[n] | f32 grads[n*dim]        -> u8 ok
+//   SAVE(4)  : u32 plen | path                              -> u8 ok
+//   LOAD(5)  : u32 plen | path                              -> u8 ok
+//   STATS(6) :                                              -> u8 ok | u64 rows
+//              | u64 bytes
+//   PULLNOINIT(7): like PULL but missing rows come back zero and are NOT
+//              materialized (inference-time lookup).
+// Error replies: u8 0 | u32 len | message.
+#include "pt_native.h"
+
+#include <arpa/inet.h>
+#include <math.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum PsOp : uint8_t {
+  kCreate = 1,
+  kPull = 2,
+  kPush = 3,
+  kSave = 4,
+  kLoad = 5,
+  kStats = 6,
+  kPullNoInit = 7,
+};
+
+bool ps_read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool ps_write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+uint32_t ps_load_u32(const char* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return ntohl(v);
+}
+
+void ps_push_u32(std::string* s, uint32_t v) {
+  v = htonl(v);
+  s->append(reinterpret_cast<const char*>(&v), 4);
+}
+
+uint64_t ps_swap64(uint64_t v) {
+  const uint16_t probe = 1;
+  if (*reinterpret_cast<const uint8_t*>(&probe) == 1) {
+    v = (static_cast<uint64_t>(ntohl(static_cast<uint32_t>(v))) << 32) |
+        ntohl(static_cast<uint32_t>(v >> 32));
+  }
+  return v;
+}
+
+void ps_push_u64(std::string* s, uint64_t v) {
+  v = ps_swap64(v);
+  s->append(reinterpret_cast<const char*>(&v), 8);
+}
+
+// splitmix64 — deterministic per-(seed, row, lane) init stream so a row's
+// initial value is identical no matter which server/order materializes it.
+uint64_t mix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+// Box–Muller over two uniform draws from the hash stream.
+void fill_normal(uint64_t seed, int64_t rid, float std_dev, float* out,
+                 uint32_t dim) {
+  uint64_t base = mix64(seed ^ mix64(static_cast<uint64_t>(rid)));
+  for (uint32_t j = 0; j < dim; j += 2) {
+    uint64_t a = mix64(base + j);
+    uint64_t b = mix64(base + j + 1);
+    double u1 = (static_cast<double>(a >> 11) + 1.0) / 9007199254740993.0;
+    double u2 = static_cast<double>(b >> 11) / 9007199254740992.0;
+    double r = sqrt(-2.0 * log(u1));
+    out[j] = static_cast<float>(r * cos(2.0 * M_PI * u2)) * std_dev;
+    if (j + 1 < dim) {
+      out[j + 1] = static_cast<float>(r * sin(2.0 * M_PI * u2)) * std_dev;
+    }
+  }
+}
+
+constexpr int kNumBuckets = 64;
+constexpr float kAdamB1 = 0.9f, kAdamB2 = 0.999f, kEps = 1e-8f;
+
+struct Row {
+  std::vector<float> w;
+  std::vector<float> s1;  // adagrad accum / adam m
+  std::vector<float> s2;  // adam v
+  uint32_t t = 0;         // adam step count
+};
+
+struct Table {
+  uint32_t dim = 0;
+  uint8_t opt = 0;  // 0 sgd, 1 adagrad, 2 adam
+  float lr = 0.01f;
+  float init_std = 0.01f;
+  uint64_t seed = 0;
+
+  std::mutex bucket_mu[kNumBuckets];
+  std::unordered_map<int64_t, Row> buckets[kNumBuckets];
+
+  static int BucketOf(int64_t id) {
+    return static_cast<int>(mix64(static_cast<uint64_t>(id)) %
+                            kNumBuckets);
+  }
+
+  Row& Materialize(int bi, int64_t id) {
+    Row& row = buckets[bi][id];
+    if (row.w.empty()) {
+      row.w.resize(dim);
+      fill_normal(seed, id, init_std, row.w.data(), dim);
+    }
+    return row;
+  }
+
+  void Pull(const int64_t* ids, uint64_t n, float* out, bool materialize) {
+    for (uint64_t i = 0; i < n; ++i) {
+      int bi = BucketOf(ids[i]);
+      std::lock_guard<std::mutex> lk(bucket_mu[bi]);
+      if (materialize) {
+        Row& row = Materialize(bi, ids[i]);
+        std::memcpy(out + i * dim, row.w.data(), dim * sizeof(float));
+      } else {
+        auto it = buckets[bi].find(ids[i]);
+        if (it == buckets[bi].end()) {
+          std::memset(out + i * dim, 0, dim * sizeof(float));
+        } else {
+          std::memcpy(out + i * dim, it->second.w.data(),
+                      dim * sizeof(float));
+        }
+      }
+    }
+  }
+
+  void Push(const int64_t* ids, uint64_t n, const float* grads) {
+    for (uint64_t i = 0; i < n; ++i) {
+      int bi = BucketOf(ids[i]);
+      const float* g = grads + i * dim;
+      std::lock_guard<std::mutex> lk(bucket_mu[bi]);
+      Row& row = Materialize(bi, ids[i]);
+      float* w = row.w.data();
+      switch (opt) {
+        case 1: {  // adagrad
+          if (row.s1.empty()) row.s1.assign(dim, 0.f);
+          float* acc = row.s1.data();
+          for (uint32_t j = 0; j < dim; ++j) {
+            acc[j] += g[j] * g[j];
+            w[j] -= lr * g[j] / (sqrtf(acc[j]) + 1e-10f);
+          }
+          break;
+        }
+        case 2: {  // adam with per-row step count
+          if (row.s1.empty()) {
+            row.s1.assign(dim, 0.f);
+            row.s2.assign(dim, 0.f);
+          }
+          row.t += 1;
+          float bc1 = 1.f - powf(kAdamB1, static_cast<float>(row.t));
+          float bc2 = 1.f - powf(kAdamB2, static_cast<float>(row.t));
+          float* m = row.s1.data();
+          float* v = row.s2.data();
+          for (uint32_t j = 0; j < dim; ++j) {
+            m[j] = kAdamB1 * m[j] + (1.f - kAdamB1) * g[j];
+            v[j] = kAdamB2 * v[j] + (1.f - kAdamB2) * g[j] * g[j];
+            w[j] -= lr * (m[j] / bc1) / (sqrtf(v[j] / bc2) + kEps);
+          }
+          break;
+        }
+        default: {  // sgd
+          for (uint32_t j = 0; j < dim; ++j) w[j] -= lr * g[j];
+        }
+      }
+    }
+  }
+
+  // File format: u64 magic | u32 dim | u8 opt | u64 nrows, then per row:
+  // i64 id | u32 t | u8 has_s1 | u8 has_s2 | f32 w[dim] [| s1[dim]][| s2[dim]]
+  //
+  // Single pass: rows are counted while being written (each bucket under its
+  // lock), then the header's nrows placeholder is patched — a concurrent push
+  // materializing rows mid-save can otherwise desync the header count from
+  // the rows actually written.
+  bool Save(const std::string& path) {
+    FILE* f = ::fopen(path.c_str(), "wb");
+    if (!f) return false;
+    uint64_t magic = 0x5054505354424C31ull;  // "PTPSTBL1"
+    uint64_t nrows = 0;
+    bool ok = ::fwrite(&magic, 8, 1, f) == 1 &&
+              ::fwrite(&dim, 4, 1, f) == 1 && ::fwrite(&opt, 1, 1, f) == 1 &&
+              ::fwrite(&nrows, 8, 1, f) == 1;  // placeholder
+    for (int b = 0; ok && b < kNumBuckets; ++b) {
+      std::lock_guard<std::mutex> lk(bucket_mu[b]);
+      for (auto& [id, row] : buckets[b]) {
+        uint8_t has_s1 = !row.s1.empty(), has_s2 = !row.s2.empty();
+        ok = ::fwrite(&id, 8, 1, f) == 1 && ::fwrite(&row.t, 4, 1, f) == 1 &&
+             ::fwrite(&has_s1, 1, 1, f) == 1 &&
+             ::fwrite(&has_s2, 1, 1, f) == 1 &&
+             ::fwrite(row.w.data(), sizeof(float), dim, f) == dim;
+        if (ok && has_s1)
+          ok = ::fwrite(row.s1.data(), sizeof(float), dim, f) == dim;
+        if (ok && has_s2)
+          ok = ::fwrite(row.s2.data(), sizeof(float), dim, f) == dim;
+        if (!ok) break;
+        ++nrows;
+      }
+    }
+    ok = ok && ::fseek(f, 8 + 4 + 1, SEEK_SET) == 0 &&
+         ::fwrite(&nrows, 8, 1, f) == 1;
+    ::fclose(f);
+    return ok;
+  }
+
+  bool Load(const std::string& path) {
+    FILE* f = ::fopen(path.c_str(), "rb");
+    if (!f) return false;
+    uint64_t magic = 0, nrows = 0;
+    uint32_t fdim = 0;
+    uint8_t fopt = 0;
+    bool ok = ::fread(&magic, 8, 1, f) == 1 &&
+              magic == 0x5054505354424C31ull && ::fread(&fdim, 4, 1, f) == 1 &&
+              ::fread(&fopt, 1, 1, f) == 1 && ::fread(&nrows, 8, 1, f) == 1 &&
+              fdim == dim;
+    if (ok) {
+      // restore REPLACES table state — rows materialized after the save must
+      // not survive a load
+      for (int b = 0; b < kNumBuckets; ++b) {
+        std::lock_guard<std::mutex> lk(bucket_mu[b]);
+        buckets[b].clear();
+      }
+    }
+    for (uint64_t i = 0; ok && i < nrows; ++i) {
+      int64_t id;
+      uint32_t t;
+      uint8_t has_s1, has_s2;
+      ok = ::fread(&id, 8, 1, f) == 1 && ::fread(&t, 4, 1, f) == 1 &&
+           ::fread(&has_s1, 1, 1, f) == 1 && ::fread(&has_s2, 1, 1, f) == 1;
+      if (!ok) break;
+      Row row;
+      row.t = t;
+      row.w.resize(dim);
+      ok = ::fread(row.w.data(), sizeof(float), dim, f) == dim;
+      if (ok && has_s1) {
+        row.s1.resize(dim);
+        ok = ::fread(row.s1.data(), sizeof(float), dim, f) == dim;
+      }
+      if (ok && has_s2) {
+        row.s2.resize(dim);
+        ok = ::fread(row.s2.data(), sizeof(float), dim, f) == dim;
+      }
+      if (ok) {
+        int bi = BucketOf(id);
+        std::lock_guard<std::mutex> lk(bucket_mu[bi]);
+        buckets[bi][id] = std::move(row);
+      }
+    }
+    ::fclose(f);
+    return ok;
+  }
+
+  void Stats(uint64_t* rows, uint64_t* bytes) {
+    *rows = 0;
+    *bytes = 0;
+    for (int b = 0; b < kNumBuckets; ++b) {
+      std::lock_guard<std::mutex> lk(bucket_mu[b]);
+      *rows += buckets[b].size();
+      for (auto& [id, row] : buckets[b]) {
+        (void)id;
+        *bytes +=
+            (row.w.size() + row.s1.size() + row.s2.size()) * sizeof(float) +
+            sizeof(Row);
+      }
+    }
+  }
+};
+
+}  // namespace
+
+struct pt_ps_server {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::atomic<bool> stopping{false};
+
+  std::mutex reg_mu;
+  // shared_ptr: a CREATE that replaces a table must not free it while another
+  // connection thread is still inside Pull/Push on the old instance.
+  std::unordered_map<std::string, std::shared_ptr<Table>> tables;
+
+  // Connection threads are detached; stop() shuts down every live fd and
+  // then waits for active_conns to drain before the server is deleted (a
+  // joinable-vector would grow unboundedly under connection churn).
+  std::mutex conn_mu;
+  std::condition_variable conn_cv;
+  int active_conns = 0;
+  std::unordered_map<int, bool> live_fds;  // fd -> still serving
+
+  std::shared_ptr<Table> Find(const std::string& name) {
+    std::lock_guard<std::mutex> lk(reg_mu);
+    auto it = tables.find(name);
+    return it == tables.end() ? nullptr : it->second;
+  }
+
+  static void ReplyErr(std::string* reply, const char* msg) {
+    reply->push_back(0);
+    ps_push_u32(reply, static_cast<uint32_t>(strlen(msg)));
+    reply->append(msg);
+  }
+
+  void Serve(int fd) {
+    // A request that throws (bad_alloc on an absurd n*dim, etc.) must drop
+    // this connection, not std::terminate the host process.
+    try {
+      ServeLoop(fd);
+    } catch (...) {
+    }
+    {
+      std::lock_guard<std::mutex> lk(conn_mu);
+      live_fds.erase(fd);  // erase BEFORE close so stop() never shuts down a
+                           // reused descriptor
+      --active_conns;
+      conn_cv.notify_all();
+    }
+    ::close(fd);
+  }
+
+  void ServeLoop(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::vector<int64_t> ids;
+    std::vector<float> vals;
+    for (;;) {
+      uint8_t op;
+      if (!ps_read_full(fd, &op, 1)) break;
+      char nlen_buf[4];
+      if (!ps_read_full(fd, nlen_buf, 4)) break;
+      uint32_t nlen = ps_load_u32(nlen_buf);
+      if (nlen > (1u << 16)) break;
+      std::string name(nlen, '\0');
+      if (nlen && !ps_read_full(fd, name.data(), nlen)) break;
+
+      std::string reply;
+      switch (op) {
+        case kCreate: {
+          char buf[4 + 1 + 4 + 4 + 8];
+          if (!ps_read_full(fd, buf, sizeof(buf))) goto done;
+          {
+            auto t = std::make_shared<Table>();
+            t->dim = ps_load_u32(buf);
+            t->opt = static_cast<uint8_t>(buf[4]);
+            uint32_t lr_bits = ps_load_u32(buf + 5);
+            uint32_t std_bits = ps_load_u32(buf + 9);
+            std::memcpy(&t->lr, &lr_bits, 4);
+            std::memcpy(&t->init_std, &std_bits, 4);
+            uint64_t seed;
+            std::memcpy(&seed, buf + 13, 8);
+            t->seed = ps_swap64(seed);
+            if (t->dim == 0 || t->dim > (1u << 20)) {
+              ReplyErr(&reply, "bad dim");
+              break;
+            }
+            std::lock_guard<std::mutex> lk(reg_mu);
+            tables[name] = std::move(t);  // re-create replaces
+          }
+          reply.push_back(1);
+          break;
+        }
+        case kPull:
+        case kPullNoInit: {
+          char n_buf[8];
+          if (!ps_read_full(fd, n_buf, 8)) goto done;
+          {
+            uint64_t n;
+            std::memcpy(&n, n_buf, 8);
+            n = ps_swap64(n);
+            if (n > (1ull << 28)) goto done;
+            ids.resize(n);
+            if (n && !ps_read_full(fd, ids.data(), n * 8)) goto done;
+            auto t = Find(name);
+            if (!t) {
+              ReplyErr(&reply, "no such table");
+              break;
+            }
+            if (n * static_cast<uint64_t>(t->dim) > (1ull << 28)) {
+              ReplyErr(&reply, "pull too large");
+              break;
+            }
+            vals.resize(n * t->dim);
+            t->Pull(ids.data(), n, vals.data(), op == kPull);
+            reply.push_back(1);
+            ps_push_u32(&reply, t->dim);
+            reply.append(reinterpret_cast<const char*>(vals.data()),
+                         vals.size() * sizeof(float));
+          }
+          break;
+        }
+        case kPush: {
+          char n_buf[8];
+          if (!ps_read_full(fd, n_buf, 8)) goto done;
+          {
+            uint64_t n;
+            std::memcpy(&n, n_buf, 8);
+            n = ps_swap64(n);
+            if (n > (1ull << 28)) goto done;
+            ids.resize(n);
+            if (n && !ps_read_full(fd, ids.data(), n * 8)) goto done;
+            auto t = Find(name);
+            if (!t) {
+              // must still drain the grads to keep the stream aligned — but
+              // dim is unknown; drop the connection instead.
+              goto done;
+            }
+            if (n * static_cast<uint64_t>(t->dim) > (1ull << 28)) goto done;
+            vals.resize(n * t->dim);
+            if (n &&
+                !ps_read_full(fd, vals.data(), vals.size() * sizeof(float)))
+              goto done;
+            t->Push(ids.data(), n, vals.data());
+            reply.push_back(1);
+          }
+          break;
+        }
+        case kSave:
+        case kLoad: {
+          char p_buf[4];
+          if (!ps_read_full(fd, p_buf, 4)) goto done;
+          {
+            uint32_t plen = ps_load_u32(p_buf);
+            if (plen > (1u << 16)) goto done;
+            std::string path(plen, '\0');
+            if (plen && !ps_read_full(fd, path.data(), plen)) goto done;
+            auto t = Find(name);
+            if (!t) {
+              ReplyErr(&reply, "no such table");
+              break;
+            }
+            bool ok = op == kSave ? t->Save(path) : t->Load(path);
+            if (ok) {
+              reply.push_back(1);
+            } else {
+              ReplyErr(&reply, op == kSave ? "save failed" : "load failed");
+            }
+          }
+          break;
+        }
+        case kStats: {
+          auto t = Find(name);
+          if (!t) {
+            ReplyErr(&reply, "no such table");
+            break;
+          }
+          uint64_t rows, bytes;
+          t->Stats(&rows, &bytes);
+          reply.push_back(1);
+          ps_push_u64(&reply, rows);
+          ps_push_u64(&reply, bytes);
+          break;
+        }
+        default:
+          goto done;
+      }
+      if (!ps_write_full(fd, reply.data(), reply.size())) break;
+    }
+  done:
+    return;
+  }
+
+  void AcceptLoop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping.load()) return;
+        // back off on persistent errors (EMFILE etc.) instead of busy-spin
+        std::this_thread::sleep_for(std::chrono::milliseconds(10));
+        continue;
+      }
+      {
+        std::lock_guard<std::mutex> lk(conn_mu);
+        live_fds[fd] = true;
+        ++active_conns;
+      }
+      std::thread([this, fd] { Serve(fd); }).detach();
+    }
+  }
+};
+
+extern "C" {
+
+pt_ps_server* pt_ps_server_start(const char* host, int port, int* bound_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host && *host ? host : "0.0.0.0", &addr.sin_addr) !=
+      1) {
+    addr.sin_addr.s_addr = INADDR_ANY;
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 512) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  if (bound_port) *bound_port = ntohs(addr.sin_port);
+
+  auto* s = new pt_ps_server();
+  s->listen_fd = fd;
+  s->accept_thread = std::thread([s] { s->AcceptLoop(); });
+  return s;
+}
+
+void pt_ps_server_stop(pt_ps_server* s) {
+  if (!s) return;
+  s->stopping.store(true);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::unique_lock<std::mutex> lk(s->conn_mu);
+    for (auto& [fd, live] : s->live_fds) {
+      (void)live;
+      ::shutdown(fd, SHUT_RDWR);
+    }
+    // wait for detached connection threads to finish with server state
+    s->conn_cv.wait(lk, [s] { return s->active_conns == 0; });
+  }
+  delete s;
+}
+
+}  // extern "C"

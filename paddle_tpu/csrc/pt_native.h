@@ -69,6 +69,19 @@ size_t pt_shm_capacity(pt_shm_channel* c);
 void pt_buf_free(void* p);
 
 // ---------------------------------------------------------------------------
+// Parameter-server table node (csrc/ps_table.cc): sharded sparse embedding
+// storage with in-server sparse SGD/Adagrad/Adam, lazy deterministic row
+// init, save/load. Reference analog: paddle/fluid/distributed/ps/ (brpc
+// PsService + MemorySparseTable). Protocol documented at the top of
+// ps_table.cc; the Python client lives in incubate/distributed/ps.py.
+// ---------------------------------------------------------------------------
+
+typedef struct pt_ps_server pt_ps_server;
+
+pt_ps_server* pt_ps_server_start(const char* host, int port, int* bound_port);
+void pt_ps_server_stop(pt_ps_server* s);
+
+// ---------------------------------------------------------------------------
 // Numeric audit: multithreaded nan/inf/absmax scan over host buffers.
 // kind: 0=f32 1=f64 2=bf16 3=f16
 // ---------------------------------------------------------------------------

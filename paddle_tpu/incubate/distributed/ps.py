@@ -1,4 +1,4 @@
-"""Parameter-server analog — sharded sparse embedding tables over RPC.
+"""Parameter-server analog — sharded sparse embedding tables.
 
 Reference: paddle/fluid/distributed/ps/ (brpc services + sharded embedding
 tables in ps/table/, pull/push sparse) and python/paddle/distributed/ps/.
@@ -6,22 +6,39 @@ TPU-native positioning: dense training state lives in device HBM under
 jit/pjit; the PS pattern survives for HOST-side huge sparse embeddings
 (recommendation workloads) that cannot fit a chip. Tables shard rows across
 server workers by id hash; clients pull rows before the device step and push
-gradients after — transport is paddle_tpu.distributed.rpc, bootstrap the
-TCPStore.
+gradients after.
 
-This is the capability analog of the reference's PS (lazy row init, sparse
-SGD/Adagrad update rules, save/load), not its brpc implementation.
+Two transports with the same capability set (lazy row init, sparse
+SGD/Adagrad/Adam update rules, save/load):
+  * the original pure-Python path over paddle_tpu.distributed.rpc
+    (`SparseTable`/`start_server`/`PSClient`), and
+  * the NATIVE path — a C++ table node (csrc/ps_table.cc: thread-per-
+    connection socket service, 64 lock-sharded row buckets, in-server sparse
+    optimizers, deterministic hash-based lazy init) spoken to by
+    `NativePSClient`, the analog of the reference's brpc_ps_server.cc +
+    MemorySparseTable.
+
+`DistributedEmbedding` is the training-side bridge: forward pulls the batch's
+unique rows into a device tensor (the differentiable leaf), backward leaves
+the row gradients on `.grad`, and `push_step()` sends them to the servers —
+the pull_sparse/push_sparse cycle of the reference's async trainers
+(fluid/framework/hogwild_worker.cc).
 """
 from __future__ import annotations
 
+import ctypes
 import os
+import socket
+import struct
 
 import numpy as np
 
 from ... import distributed as dist
+from ...core import native
 from ...distributed import rpc
 
-__all__ = ["SparseTable", "start_server", "PSClient", "shutdown"]
+__all__ = ["SparseTable", "start_server", "PSClient", "shutdown",
+           "NativePSServer", "NativePSClient", "DistributedEmbedding"]
 
 _TABLES: dict[str, "SparseTable"] = {}
 
@@ -32,13 +49,17 @@ class SparseTable:
 
     def __init__(self, name, dim, init_std=0.01, optimizer="sgd", lr=0.01,
                  seed=0):
+        if optimizer not in ("sgd", "adagrad", "adam"):
+            raise ValueError(f"unknown sparse optimizer {optimizer!r}")
         self.name = name
         self.dim = dim
         self.init_std = init_std
         self.optimizer = optimizer
         self.lr = lr
         self.rows: dict[int, np.ndarray] = {}
-        self._accum: dict[int, np.ndarray] = {}  # adagrad state
+        self._accum: dict[int, np.ndarray] = {}  # adagrad accum / adam m
+        self._accum2: dict[int, np.ndarray] = {}  # adam v
+        self._steps: dict[int, int] = {}  # adam per-row t
         self._rng = np.random.default_rng(seed)
 
     def _row(self, rid: int) -> np.ndarray:
@@ -62,6 +83,16 @@ class SparseTable:
                     rid, np.zeros(self.dim, np.float32))
                 acc += g * g
                 row -= self.lr * g / (np.sqrt(acc) + 1e-10)
+            elif self.optimizer == "adam":
+                m = self._accum.setdefault(rid, np.zeros(self.dim, np.float32))
+                v = self._accum2.setdefault(
+                    rid, np.zeros(self.dim, np.float32))
+                t = self._steps.get(rid, 0) + 1
+                self._steps[rid] = t
+                m += (1 - 0.9) * (g - m)
+                v += (1 - 0.999) * (g * g - v)
+                row -= self.lr * (m / (1 - 0.9 ** t)) / (
+                    np.sqrt(v / (1 - 0.999 ** t)) + 1e-8)
             else:  # sgd
                 row -= self.lr * g
         return len(ids)
@@ -69,15 +100,36 @@ class SparseTable:
     def save(self, dirname):
         os.makedirs(dirname, exist_ok=True)
         ids = np.asarray(sorted(self.rows), dtype=np.int64)
-        vals = np.stack([self.rows[int(i)] for i in ids]) if len(ids) \
-            else np.zeros((0, self.dim), np.float32)
+        zeros = np.zeros((0, self.dim), np.float32)
+
+        def stacked(d):
+            return np.stack([d.get(int(i), np.zeros(self.dim, np.float32))
+                             for i in ids]) if len(ids) else zeros
+
         np.savez(os.path.join(dirname, f"{self.name}.npz"), ids=ids,
-                 vals=vals)
+                 vals=stacked(self.rows), accum=stacked(self._accum),
+                 accum2=stacked(self._accum2),
+                 steps=np.asarray([self._steps.get(int(i), 0) for i in ids],
+                                  dtype=np.int64))
 
     def load(self, dirname):
+        """Restore REPLACES all table state, optimizer slots included —
+        matching the native node's semantics."""
         data = np.load(os.path.join(dirname, f"{self.name}.npz"))
-        self.rows = {int(i): v.copy()
-                     for i, v in zip(data["ids"], data["vals"])}
+        ids = data["ids"]
+        self.rows = {int(i): v.copy() for i, v in zip(ids, data["vals"])}
+        self._accum = {}
+        self._accum2 = {}
+        self._steps = {}
+        if "accum" in data:  # older checkpoints lack slot arrays
+            for i, a, a2, t in zip(ids, data["accum"], data["accum2"],
+                                   data["steps"]):
+                if a.any():
+                    self._accum[int(i)] = a.copy()
+                if a2.any():
+                    self._accum2[int(i)] = a2.copy()
+                if t:
+                    self._steps[int(i)] = int(t)
 
 
 # -- server-side RPC entry points (executed in the server worker) -----------
@@ -171,3 +223,254 @@ class PSClient:
         for s in self.servers:
             rpc.rpc_sync(s, _srv_load, args=(name, os.path.join(
                 dirname, s)))
+
+
+# ---------------------------------------------------------------------------
+# Native transport — C++ table node (csrc/ps_table.cc)
+# ---------------------------------------------------------------------------
+
+_OP_CREATE, _OP_PULL, _OP_PUSH, _OP_SAVE, _OP_LOAD, _OP_STATS = 1, 2, 3, 4, 5, 6
+_OP_PULL_NOINIT = 7
+
+
+class NativePSServer:
+    """In-process handle on a native table node (its service threads are C++,
+    so serving is GIL-free even when started inside a trainer process)."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native runtime library unavailable")
+        self._lib = lib
+        bound = ctypes.c_int(0)
+        self._h = lib.pt_ps_server_start(host.encode(), int(port),
+                                         ctypes.byref(bound))
+        if not self._h:
+            raise OSError(f"cannot bind PS server on {host}:{port}")
+        self.host = host
+        self.port = int(bound.value)
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    def stop(self):
+        if self._h:
+            self._lib.pt_ps_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class _PSConn:
+    """One blocking connection speaking the ps_table.cc protocol."""
+
+    def __init__(self, endpoint):
+        host, port = endpoint.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=60.0)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _recv_full(self, n):
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            r = self.sock.recv_into(view[got:], n - got)
+            if r == 0:
+                raise ConnectionError("PS server closed connection")
+            got += r
+        return bytes(buf)
+
+    def _check_ok(self):
+        ok = self._recv_full(1)[0]
+        if not ok:
+            (mlen,) = struct.unpack(">I", self._recv_full(4))
+            raise RuntimeError(
+                f"PS error: {self._recv_full(mlen).decode(errors='replace')}")
+
+    def request(self, op, name, payload=b"", reply_fmt=None):
+        nb = name.encode()
+        self.sock.sendall(struct.pack(">BI", op, len(nb)) + nb + payload)
+        self._check_ok()
+        if reply_fmt == "rows":
+            (dim,) = struct.unpack(">I", self._recv_full(4))
+            return dim
+        if reply_fmt == "stats":
+            return struct.unpack(">QQ", self._recv_full(16))
+        return None
+
+    def recv_floats(self, count):
+        return np.frombuffer(self._recv_full(count * 4), dtype=np.float32)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class NativePSClient:
+    """Client over native table nodes; shards ids across endpoints by modulo,
+    like the reference client shards over server instances."""
+
+    def __init__(self, endpoints):
+        self.endpoints = list(endpoints)
+        self._conns = [None] * len(self.endpoints)
+        self._dims: dict[str, int] = {}  # known table dims (for empty pulls)
+
+    def _conn(self, i) -> _PSConn:
+        if self._conns[i] is None:
+            self._conns[i] = _PSConn(self.endpoints[i])
+        return self._conns[i]
+
+    def close(self):
+        for c in self._conns:
+            if c is not None:
+                c.close()
+        self._conns = [None] * len(self.endpoints)
+
+    def create_table(self, name, dim, optimizer="sgd", lr=0.01,
+                     init_std=0.01, seed=0):
+        opt = {"sgd": 0, "adagrad": 1, "adam": 2}[optimizer]
+        payload = struct.pack(">IBffQ", int(dim), opt, float(lr),
+                              float(init_std), int(seed))
+        for i in range(len(self.endpoints)):
+            self._conn(i).request(_OP_CREATE, name, payload)
+        self._dims[name] = int(dim)
+
+    def _shard(self, ids):
+        ids_flat = np.ascontiguousarray(
+            np.asarray(ids, dtype=np.int64).ravel())
+        owner = ids_flat % len(self.endpoints)
+        return ids_flat, owner
+
+    def pull_sparse(self, name, ids, init_missing=True):
+        ids_flat, owner = self._shard(ids)
+        rows = None
+        op = _OP_PULL if init_missing else _OP_PULL_NOINIT
+        for si in range(len(self.endpoints)):
+            sel = np.nonzero(owner == si)[0]
+            if not len(sel):
+                continue
+            part_ids = np.ascontiguousarray(ids_flat[sel])
+            conn = self._conn(si)
+            dim = conn.request(op, name,
+                               struct.pack(">Q", len(part_ids))
+                               + part_ids.tobytes(), reply_fmt="rows")
+            part = conn.recv_floats(len(part_ids) * dim).reshape(-1, dim)
+            self._dims[name] = dim
+            if rows is None:
+                rows = np.zeros((len(ids_flat), dim), np.float32)
+            rows[sel] = part
+        if rows is None:  # empty ids: use the known dim (reshape can't infer)
+            rows = np.zeros((len(ids_flat), self._dims.get(name, 0)),
+                            np.float32)
+        return rows.reshape(tuple(np.shape(ids)) + (rows.shape[-1],))
+
+    def push_sparse(self, name, ids, grads):
+        ids_flat, owner = self._shard(ids)
+        grads = np.asarray(grads, np.float32).reshape(len(ids_flat), -1)
+        # The PUSH wire format carries no dim; a width mismatch would be
+        # applied mis-strided server-side. Validate against the known dim
+        # (learned from create_table / any pull; fetched cheaply if unknown).
+        dim = self._dims.get(name)
+        if dim is None and len(ids_flat):
+            self.pull_sparse(name, ids_flat[:1], init_missing=False)
+            dim = self._dims.get(name)
+        if dim is not None and grads.shape[1] != dim:
+            raise ValueError(
+                f"push_sparse(grads) last dim {grads.shape[1]} != table "
+                f"{name!r} dim {dim}")
+        for si in range(len(self.endpoints)):
+            sel = np.nonzero(owner == si)[0]
+            if not len(sel):
+                continue
+            part_ids = np.ascontiguousarray(ids_flat[sel])
+            part_g = np.ascontiguousarray(grads[sel])
+            self._conn(si).request(
+                _OP_PUSH, name, struct.pack(">Q", len(part_ids))
+                + part_ids.tobytes() + part_g.tobytes())
+
+    def _path_op(self, op, name, dirname):
+        os.makedirs(dirname, exist_ok=True)
+        for si in range(len(self.endpoints)):
+            path = os.path.join(dirname, f"shard{si}.pstbl").encode()
+            self._conn(si).request(op, name,
+                                   struct.pack(">I", len(path)) + path)
+
+    def save(self, name, dirname):
+        self._path_op(_OP_SAVE, name, dirname)
+
+    def load(self, name, dirname):
+        self._path_op(_OP_LOAD, name, dirname)
+
+    def stats(self, name):
+        rows = 0
+        bytes_ = 0
+        for si in range(len(self.endpoints)):
+            r, b = self._conn(si).request(_OP_STATS, name, reply_fmt="stats")
+            rows += r
+            bytes_ += b
+        return {"rows": int(rows), "bytes": int(bytes_)}
+
+
+# ---------------------------------------------------------------------------
+# Training-side bridge
+# ---------------------------------------------------------------------------
+
+class DistributedEmbedding:
+    """Embedding whose rows live on parameter servers (reference:
+    fleet pull_sparse/push_sparse in the async trainers,
+    fluid/framework/hogwild_worker.cc; layer analog
+    paddle/incubate/distributed/fleet's distributed embedding).
+
+    forward(ids) pulls the batch's unique rows into ONE device tensor that is
+    the differentiable leaf; the device-side gather that fans rows out to
+    positions stays inside the compiled step. After loss.backward(), call
+    push_step() to send each pulled row's gradient back. Works with both
+    PSClient (RPC) and NativePSClient.
+    """
+
+    def __init__(self, client, table_name, dim, optimizer="sgd", lr=0.01,
+                 init_std=0.01, seed=0, create=True):
+        self.client = client
+        self.table_name = table_name
+        self.dim = int(dim)
+        if create:
+            client.create_table(table_name, dim, optimizer=optimizer,
+                                lr=lr, init_std=init_std, seed=seed)
+        self._pending = []
+
+    def __call__(self, ids):
+        from ...ops import creation, manipulation
+
+        ids_np = np.asarray(
+            ids.numpy() if hasattr(ids, "numpy") else ids, dtype=np.int64)
+        uniq, inverse = np.unique(ids_np, return_inverse=True)
+        rows = self.client.pull_sparse(self.table_name, uniq)
+        pulled = creation.to_tensor(rows.astype(np.float32),
+                                    stop_gradient=False)
+        self._pending.append((uniq, pulled))
+        inv = creation.to_tensor(
+            np.ascontiguousarray(inverse.reshape(-1), dtype=np.int64))
+        out = manipulation.gather(pulled, inv)
+        return manipulation.reshape(out, list(ids_np.shape) + [self.dim])
+
+    forward = __call__
+
+    def push_step(self, scale=1.0):
+        """Push accumulated row gradients from every forward since the last
+        push; the server applies its sparse optimizer rule."""
+        for uniq, pulled in self._pending:
+            g = pulled.grad
+            if g is None:
+                continue
+            g_np = np.asarray(g.numpy(), dtype=np.float32)
+            if scale != 1.0:
+                g_np = g_np * scale
+            self.client.push_sparse(self.table_name, uniq, g_np)
+        self._pending.clear()
