@@ -338,6 +338,7 @@ _bind("pin_memory", lambda self: self)
 
 from . import version  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401
+from .core import string_tensor as strings  # noqa: E402,F401
 from . import hub  # noqa: E402,F401
 from . import sysconfig  # noqa: E402,F401
 

@@ -1,0 +1,216 @@
+"""String tensors — the pstring/StringTensor analog.
+
+Reference: paddle/phi/core/string_tensor.h:33 (StringTensor over
+phi::dtype::pstring), kernels paddle/phi/kernels/strings/
+{strings_empty_kernel.h, strings_copy_kernel.h, strings_lower_upper_kernel.h}
+(each case op in an ASCII and a UTF-8 variant backed by
+strings/unicode.h), and the C++ pstring type paddle/phi/common/pstring.h.
+
+TPU-native positioning: XLA programs cannot hold variable-length strings, so
+— exactly like the reference, whose string kernels are host/CPU-side and feed
+id tensors to the compute graph — StringTensor here is a HOST tensor (numpy
+object array of ``str``) with the reference's op surface (empty/copy/
+lower/upper with the use_utf8_encoding switch), plus the two device bridges
+that make it useful on a TPU:
+
+  * ``to_bytes_tensor`` / ``from_bytes_tensor``: fixed-width uint8 encoding —
+    the device-side representation of string data (padded UTF-8 bytes).
+  * ``to_hash_ids``: stable 63-bit FNV-1a ids for hash-bucket embedding
+    lookup, and ``lookup`` for explicit vocab → int64 ids.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "StringTensor", "empty", "empty_like", "copy", "lower", "upper",
+    "to_bytes_tensor", "from_bytes_tensor", "to_hash_ids", "lookup",
+]
+
+
+def _ascii_case(s: str, to_lower: bool) -> str:
+    # non-utf8 mode mirrors the reference's AsciiCaseConverter
+    # (phi/kernels/strings/case_utils.h): only [A-Za-z] change.
+    if to_lower:
+        return "".join(
+            chr(ord(c) + 32) if "A" <= c <= "Z" else c for c in s)
+    return "".join(chr(ord(c) - 32) if "a" <= c <= "z" else c for c in s)
+
+
+class StringTensor:
+    """N-d host tensor of python strings (element type = the pstring analog)."""
+
+    def __init__(self, data, name=None):
+        if isinstance(data, StringTensor):
+            arr = data._data.copy()
+        else:
+            arr = np.asarray(data, dtype=object)
+            flat = arr.ravel()
+            for i, v in enumerate(flat):
+                if v is None:
+                    flat[i] = ""
+                elif isinstance(v, bytes):
+                    flat[i] = v.decode("utf-8")
+                elif not isinstance(v, str):
+                    flat[i] = str(v)
+        self._data = arr
+        self.name = name or "string_tensor"
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def dtype(self):
+        return "pstring"
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    # -- structural ops ----------------------------------------------------
+    def reshape(self, shape):
+        return StringTensor(self._data.reshape(shape))
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, str):
+            return out
+        return StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        other = other._data if isinstance(other, StringTensor) else other
+        return self._data == other
+
+    def __repr__(self):
+        return (f"StringTensor(shape={self.shape}, "
+                f"data={self._data.tolist()!r})")
+
+    # -- element-wise case ops (method forms) ------------------------------
+    def lower(self, use_utf8_encoding=False):
+        return lower(self, use_utf8_encoding)
+
+    def upper(self, use_utf8_encoding=False):
+        return upper(self, use_utf8_encoding)
+
+
+def _elementwise(x: StringTensor, fn) -> StringTensor:
+    out = np.empty(x._data.shape, dtype=object)
+    out_flat = out.ravel()
+    for i, v in enumerate(x._data.ravel()):
+        out_flat[i] = fn(v)
+    return StringTensor(out)
+
+
+def empty(shape) -> StringTensor:
+    """reference: strings_empty_kernel.h — tensor of empty strings."""
+    return StringTensor(np.full(tuple(shape), "", dtype=object))
+
+
+def empty_like(x: StringTensor) -> StringTensor:
+    return empty(x.shape)
+
+
+def copy(x: StringTensor) -> StringTensor:
+    """reference: strings_copy_kernel.h."""
+    return StringTensor(x)
+
+
+def lower(x: StringTensor, use_utf8_encoding=False) -> StringTensor:
+    """reference: StringLowerKernel (strings_lower_upper_kernel.h:30);
+    use_utf8_encoding=False converts ASCII letters only, True applies full
+    Unicode case mapping (reference strings/unicode.h tables)."""
+    if use_utf8_encoding:
+        return _elementwise(x, str.lower)
+    return _elementwise(x, lambda s: _ascii_case(s, True))
+
+
+def upper(x: StringTensor, use_utf8_encoding=False) -> StringTensor:
+    """reference: StringUpperKernel (strings_lower_upper_kernel.h:37)."""
+    if use_utf8_encoding:
+        return _elementwise(x, str.upper)
+    return _elementwise(x, lambda s: _ascii_case(s, False))
+
+
+# ---------------------------------------------------------------------------
+# Device bridges
+# ---------------------------------------------------------------------------
+
+def to_bytes_tensor(x: StringTensor, width=None, pad=0):
+    """Encode to a fixed-width uint8 device tensor (shape + [width]) of padded
+    UTF-8 bytes — the form string data takes inside an XLA program. Returns
+    (tensor, lengths_tensor)."""
+    from ..ops import creation
+
+    encoded = [s.encode("utf-8") for s in x._data.ravel()]
+    if width is None:
+        width = max((len(b) for b in encoded), default=0) or 1
+    buf = np.full((len(encoded), width), pad, dtype=np.uint8)
+    lens = np.zeros(len(encoded), dtype=np.int32)
+    for i, b in enumerate(encoded):
+        if len(b) > width:
+            raise ValueError(
+                f"string of {len(b)} utf-8 bytes exceeds width {width}")
+        buf[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+        lens[i] = len(b)
+    return (creation.to_tensor(buf.reshape(tuple(x._data.shape) + (width,))),
+            creation.to_tensor(lens.reshape(x._data.shape)))
+
+
+def from_bytes_tensor(data, lengths) -> StringTensor:
+    """Inverse of to_bytes_tensor."""
+    arr = np.asarray(data.numpy() if hasattr(data, "numpy") else data,
+                     dtype=np.uint8)
+    lens = np.asarray(lengths.numpy() if hasattr(lengths, "numpy")
+                      else lengths, dtype=np.int64)
+    shape = arr.shape[:-1]
+    flat = arr.reshape(-1, arr.shape[-1])
+    lens_flat = lens.reshape(-1)
+    out = np.empty(len(flat), dtype=object)
+    for i in range(len(flat)):
+        out[i] = bytes(flat[i, :lens_flat[i]]).decode("utf-8")
+    return StringTensor(out.reshape(shape))
+
+
+def _fnv1a63(b: bytes) -> int:
+    h = 0xcbf29ce484222325
+    for byte in b:
+        h ^= byte
+        h = (h * 0x100000001b3) & 0xFFFFFFFFFFFFFFFF
+    return h & 0x7FFFFFFFFFFFFFFF  # non-negative int64
+
+
+def to_hash_ids(x: StringTensor, num_buckets=None):
+    """Stable FNV-1a ids (int64 device tensor) for hash-bucket embeddings —
+    the id-tensor hand-off the reference's host-side string path feeds into
+    the compute graph."""
+    from ..ops import creation
+
+    ids = np.array([_fnv1a63(s.encode("utf-8")) for s in x._data.ravel()],
+                   dtype=np.int64)
+    if num_buckets is not None:
+        ids = ids % int(num_buckets)
+    return creation.to_tensor(ids.reshape(x._data.shape))
+
+
+def lookup(x: StringTensor, vocab, default=0):
+    """Explicit vocab dict → int64 id tensor (OOV -> default)."""
+    from ..ops import creation
+
+    ids = np.array([vocab.get(s, default) for s in x._data.ravel()],
+                   dtype=np.int64)
+    return creation.to_tensor(ids.reshape(x._data.shape))

@@ -1,0 +1,80 @@
+"""StringTensor (pstring analog) tests.
+Reference surface: paddle/phi/core/string_tensor.h + kernels in
+paddle/phi/kernels/strings/ (empty/copy/lower/upper with the
+use_utf8_encoding switch); reference C++ tests:
+test/cpp/phi/kernels/test_strings_lower_upper_dev_api.cc pattern."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import strings
+
+
+class TestStringTensor:
+    def test_construct_shape_dtype(self):
+        st = strings.StringTensor([["Hello", "World"], ["a", "b"]])
+        assert st.shape == [2, 2]
+        assert st.dtype == "pstring"
+        assert st.size == 4
+        assert st[0, 0] == "Hello"
+        assert st[1].tolist() == ["a", "b"]
+
+    def test_normalizes_bytes_and_none(self):
+        st = strings.StringTensor([b"caf\xc3\xa9", None, 42])
+        assert st.tolist() == ["café", "", "42"]
+
+    def test_empty_and_copy(self):
+        e = strings.empty([2, 3])
+        assert e.shape == [2, 3] and e[0, 0] == ""
+        src = strings.StringTensor(["x"])
+        dup = strings.copy(src)
+        dup._data[0] = "y"
+        assert src[0] == "x"  # deep copy
+
+    def test_ascii_vs_utf8_case(self):
+        st = strings.StringTensor(["MiXeD", "ÀÉÎ", "straße"])
+        # ascii mode: only A-Z/a-z change, accents untouched
+        low = strings.lower(st)
+        assert low.tolist() == ["mixed", "ÀÉÎ", "straße"]
+        up_utf8 = strings.upper(st, use_utf8_encoding=True)
+        assert up_utf8.tolist() == ["MIXED", "ÀÉÎ", "STRASSE"]
+        # method forms
+        assert st.lower(True).tolist() == ["mixed", "àéî", "straße"]
+
+    def test_bytes_tensor_roundtrip(self):
+        st = strings.StringTensor([["hey", "héllo"], ["", "日本語"]])
+        data, lens = strings.to_bytes_tensor(st)
+        assert data.shape[:2] == [2, 2]
+        assert str(data.dtype) in ("paddle.uint8", "uint8")
+        back = strings.from_bytes_tensor(data, lens)
+        assert back.tolist() == st.tolist()
+
+    def test_bytes_tensor_width_overflow(self):
+        st = strings.StringTensor(["abcdef"])
+        with pytest.raises(ValueError):
+            strings.to_bytes_tensor(st, width=3)
+
+    def test_hash_ids_stable_and_bucketed(self):
+        st = strings.StringTensor(["user_1", "user_2", "user_1"])
+        ids = strings.to_hash_ids(st).numpy()
+        assert ids[0] == ids[2] and ids[0] != ids[1]
+        assert ids.dtype == np.int64 and (ids >= 0).all()
+        # stable across calls/processes (fixed FNV-1a)
+        again = strings.to_hash_ids(st).numpy()
+        np.testing.assert_array_equal(ids, again)
+        bucketed = strings.to_hash_ids(st, num_buckets=16).numpy()
+        assert (bucketed < 16).all()
+
+    def test_lookup_vocab(self):
+        st = strings.StringTensor([["the", "cat"], ["oov", "the"]])
+        ids = strings.lookup(st, {"the": 1, "cat": 2}, default=0)
+        np.testing.assert_array_equal(ids.numpy(), [[1, 2], [0, 1]])
+
+    def test_hash_ids_feed_embedding(self):
+        # the device hand-off: string -> ids -> embedding lookup on device
+        st = strings.StringTensor(["a", "b", "a"])
+        ids = strings.to_hash_ids(st, num_buckets=8)
+        emb = paddle.nn.Embedding(8, 4)
+        out = emb(ids)
+        assert out.shape == [3, 4]
+        np.testing.assert_allclose(out.numpy()[0], out.numpy()[2])
