@@ -134,6 +134,10 @@ def _bench_other(model_name):
             hidden_dropout_prob=float(os.environ.get("BENCH_DROPOUT", "0.1")),
             attention_probs_dropout_prob=float(
                 os.environ.get("BENCH_ATTN_DROPOUT", "0.1")))
+        if os.environ.get("BENCH_BF16_MOMENTS", "1") == "1":
+            # same lever as the vit config: AdamW moment traffic in bf16
+            from paddle_tpu.core.flags import set_flags
+            set_flags({"adamw_bf16_moments": True})
         model = BertForMaskedLM(cfg).bfloat16()
         n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
         optimizer = opt.AdamW(learning_rate=1e-4,
@@ -293,6 +297,60 @@ def _bench_other(model_name):
                 "batch": B, "prompt_len": prompt, "new_tokens": new_tokens,
                 "weight_dtype": weight_dtype or "bf16",
                 "params": n_params}
+
+    if model_name == "llama_serve":
+        # continuous-batching engine (inference/llm_engine.py): mixed-length
+        # requests through fixed slots, chunked prefill, per-step host
+        # transfer = one [B] token vector. Unlike llama_decode's fully
+        # on-device loop, each step round-trips the tunnel, so tunnel
+        # latency bounds this number; on a local chip the step rate is
+        # compute-bound.
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import LLMEngine
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+        n_req = int(os.environ.get("BENCH_REQUESTS", str(2 * B)))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        cap = 512 + new_tokens
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=heads,
+                          max_position_embeddings=cap)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg).bfloat16()
+        model.eval()
+        weight_dtype = os.environ.get("BENCH_WEIGHT_DTYPE", "")
+        if weight_dtype:
+            from paddle_tpu.nn.quant import quantize_linears_for_inference
+            quantize_linears_for_inference(model, weight_dtype=weight_dtype)
+        horizon = int(os.environ.get("BENCH_HORIZON", "32"))
+        eng = LLMEngine(model, max_batch=B, max_seq_len=cap, chunk_size=256,
+                        horizon=horizon)
+        lens = [256 + int(x) for x in
+                rng.integers(0, 256, size=n_req)]  # mixed prompts
+        prompts = [rng.integers(0, cfg.vocab_size, (L,)).astype(np.int32)
+                   for L in lens]
+        # warm both programs (prefill + step) outside the timed window
+        eng.generate([prompts[0]], max_new_tokens=2)
+        eng.reset_stats()
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=new_tokens)
+        wall = time.perf_counter() - t0
+        toks = sum(len(o.token_ids) for o in outs)
+        return {"metric": "llama_serve_tokens_per_sec",
+                "value": round(toks / wall, 1), "unit": "tokens/s",
+                "vs_baseline": None,
+                "requests_per_sec": round(n_req / wall, 2),
+                "steps_per_sec": round(eng.stats["steps"] / wall, 1),
+                "requests": n_req, "slots": B,
+                "prompt_lens": f"256-512", "new_tokens": new_tokens,
+                "prefill_chunks": eng.stats["prefill_chunks"],
+                "horizon": horizon,
+                "weight_dtype": weight_dtype or "bf16"}
 
     if model_name == "dispatch":
         return _bench_dispatch()
@@ -524,7 +582,8 @@ def _run_all():
     rest."""
     import subprocess
     import sys
-    for name in ["resnet50", "bert", "vit", "unet", "llama_decode", "llama"]:
+    for name in ["resnet50", "bert", "vit", "unet", "llama_decode",
+                 "llama_serve", "llama"]:
         env = dict(os.environ, BENCH_MODEL=name)
         try:
             proc = subprocess.run(

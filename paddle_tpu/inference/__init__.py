@@ -249,3 +249,7 @@ __all__ += ["DataType", "PlaceType", "XpuConfig", "get_version",
             "get_num_bytes_of_data_type", "get_trt_compile_version",
             "get_trt_runtime_version", "convert_to_mixed_precision",
             "PredictorPool", "_get_phi_kernel_name"]
+
+
+from .llm_engine import LLMEngine, GenerationRequest, RequestOutput  # noqa: E402,F401
+__all__ += ["LLMEngine", "GenerationRequest", "RequestOutput"]

@@ -1,0 +1,399 @@
+"""LLM serving engine — continuous batching over compiled decode steps.
+
+Reference analog: the serving path the reference builds from
+AnalysisPredictor (paddle/fluid/inference/api/analysis_predictor.h:101) plus
+the fused decode kernels
+(python/paddle/incubate/nn/functional/block_multihead_attention.py:1,
+masked_multihead_attention.py:1) that PaddleNLP's serving stack drives with
+dynamic request batching.
+
+TPU-native design — everything is STATIC shapes so two compiled programs
+serve the whole engine lifetime:
+
+  * ``max_batch`` fixed slots; each slot owns a [capacity, H, D] region of
+    the per-layer KV buffers and a traced length (``SlotKVCache``), so
+    ragged sequences share one compiled decode step.
+  * one **decode step** program: sample (per-slot temperature/top-p vectors,
+    greedy-vs-sample selected per slot in-graph) -> one-token model step
+    writing KV at each slot's own position -> next logits. Varying sampling
+    params or slot occupancy never recompiles.
+  * one **chunked-prefill** program per chunk size: admits a request by
+    streaming its prompt through fixed-size chunks into its slot's KV region
+    (dynamic_slice/update on the slot axis), returning last-position logits.
+    Chunk padding is masked by causality and overwritten by later writes.
+  * requests join and leave BETWEEN steps (continuous batching): a finished
+    slot is freed at the step boundary and the next queued request admits
+    into it while other slots keep decoding.
+
+Logits stay on device between steps; the only per-step host transfer is the
+[B] sampled-token vector that streaming callers need anyway.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, functional_mode
+from ..models.llama import SlotKVCache, _sample_logits_device
+
+__all__ = ["LLMEngine", "GenerationRequest", "RequestOutput"]
+
+
+@dataclasses.dataclass
+class GenerationRequest:
+    request_id: int
+    prompt_ids: np.ndarray           # [P] int32
+    max_new_tokens: int = 64
+    temperature: float = 0.0         # <=0 -> greedy
+    top_p: float = 1.0
+    eos_token_id: int | None = None
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: int
+    token_ids: list
+    finished: bool = False
+    finish_reason: str | None = None
+
+
+class _Slot:
+    __slots__ = ("req", "generated", "prompt_len")
+
+    def __init__(self, req, prompt_len):
+        self.req = req
+        self.generated = []
+        self.prompt_len = prompt_len
+
+
+class LLMEngine:
+    """Continuous-batching engine over a LlamaForCausalLM (works with
+    bf16/fp32 and WeightOnlyLinear-quantized weights; under a mesh the
+    programs partition by GSPMD like ``generate()``)."""
+
+    def __init__(self, model, max_batch=4, max_seq_len=None, chunk_size=64,
+                 top_k=0, stream_callback=None, horizon=1):
+        from ..jit.functional_call import collect_state, read_values
+
+        self.model = model
+        c = model.config
+        self.B = int(max_batch)
+        # decode horizon: tokens decoded per step() call as one compiled
+        # lax.scan — amortizes the per-step host sync K-fold at the cost of
+        # admitting/retiring requests only every K tokens
+        self.horizon = max(1, int(horizon))
+        self.capacity = int(max_seq_len or c.max_position_embeddings)
+        if self.capacity > c.max_position_embeddings:
+            raise ValueError(
+                f"max_seq_len {self.capacity} exceeds rope table "
+                f"({c.max_position_embeddings})")
+        self.chunk = int(chunk_size)
+        self.top_k = int(top_k)
+        self.stream_callback = stream_callback
+
+        model.eval()
+        _, params, _, buffers = collect_state(model)
+        self._state = params + buffers
+        self._state_vals = read_values(self._state)
+
+        head_dim = c.hidden_size // c.num_attention_heads
+        kvh = c.num_key_value_heads
+        dt = model.llama.embed_tokens.weight.dtype
+        L = c.num_hidden_layers
+        # a prefill window is always a full `chunk` wide, so it must fit the
+        # buffer (the final window slides BACK over already-written
+        # positions instead of padding the time axis — see _admit)
+        self.chunk = min(self.chunk, self.capacity)
+        shape = (self.B, self.capacity, kvh, head_dim)
+        self._k = [jnp.zeros(shape, dt) for _ in range(L)]
+        self._v = [jnp.zeros(shape, dt) for _ in range(L)]
+        self._logits = jnp.zeros((self.B, c.vocab_size), jnp.float32)
+        self._lens = jnp.zeros((self.B,), jnp.int32)
+        self._n_layers = L
+
+        # host-side slot table / queues
+        self.slots: list[_Slot | None] = [None] * self.B
+        self.waiting: collections.deque[GenerationRequest] = \
+            collections.deque()
+        self.finished_outputs: dict[int, RequestOutput] = {}
+        self._next_id = 0
+        self._rng_key = None
+        self._step_fn = None
+        self._prefill_fn = None
+        self._set_logits_fn = None
+        self.stats = {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
+                      "decode_time_s": 0.0}
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _programs(self):
+        if self._step_fn is not None:
+            return
+        model = self.model
+        state = self._state
+        B, cap, chunk = self.B, self.capacity, self.chunk
+        top_k = self.top_k
+
+        K = self.horizon
+
+        def one_step(k_bufs, v_bufs, logits, lens, active, rng, state_vals,
+                     temps, top_ps, eos_ids):
+            """sample from current logits -> one-token model step."""
+            rng, sub = jax.random.split(rng)
+            greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            sampled = _sample_logits_device(
+                logits, sub, jnp.maximum(temps, 1e-6)[:, None], top_k,
+                top_ps[:, None], False, True)
+            nxt = jnp.where(temps <= 0.0, greedy_tok, sampled)
+            # inactive slots decode garbage; pin them to token 0
+            nxt = jnp.where(active, nxt, 0)
+            with functional_mode(), _bind(state, state_vals):
+                caches = [SlotKVCache(k, v, lens)
+                          for k, v in zip(k_bufs, v_bufs)]
+                hidden, new_caches = model.llama(
+                    Tensor(nxt[:, None]), kv_caches=caches,
+                    position_offset=Tensor(lens))
+                new_logits = model._logits(hidden)._value[:, 0] \
+                    .astype(jnp.float32)
+            kb = [cc.k._value if isinstance(cc.k, Tensor) else cc.k
+                  for cc in new_caches]
+            vb = [cc.v._value if isinstance(cc.v, Tensor) else cc.v
+                  for cc in new_caches]
+            new_lens = jnp.where(active, lens + 1, lens)
+            finished = active & (nxt == eos_ids)
+            return nxt, new_logits, kb, vb, new_lens, finished, rng
+
+        def step(state_vals, k_bufs, v_bufs, logits, lens, active, rng,
+                 temps, top_ps, eos_ids, budgets):
+            """`horizon` decode iterations as ONE compiled lax.scan — the
+            host sync (and through a tunnel, the RTT) amortizes over K
+            tokens per slot. A slot that hits eos, capacity, or its
+            remaining budget mid-horizon deactivates in-graph; the host
+            reads the per-iteration (tokens, active) history to attribute
+            outputs."""
+            def body(carry, _):
+                kb, vb, logits, lens, act, emitted, rng = carry
+                nxt, logits, kb, vb, lens, finished, rng = one_step(
+                    kb, vb, logits, lens, act, rng, state_vals, temps,
+                    top_ps, eos_ids)
+                emitted = emitted + act.astype(jnp.int32)
+                act_next = act & ~finished & (lens < cap - 1) & \
+                    (emitted < budgets)
+                return (kb, vb, logits, lens, act_next, emitted, rng), \
+                    (nxt, act)
+
+            emitted0 = jnp.zeros_like(lens)
+            (k_bufs, v_bufs, logits, lens, active, _, rng), \
+                (toks, was_active) = jax.lax.scan(
+                    body,
+                    (k_bufs, v_bufs, logits, lens, active, emitted0, rng),
+                    None, length=K)
+            return toks, was_active, logits, k_bufs, v_bufs, lens, rng
+
+        def prefill_chunk(state_vals, k_bufs, v_bufs, ids, slot, off, last):
+            """Run chunk `ids` [1, chunk] of one prompt through the model
+            against slot `slot`'s KV region starting at position `off`;
+            returns updated buffers + the logits at in-chunk row `last`."""
+            from ..models.llama import StaticKVCache
+
+            z = jnp.int32(0)
+            k_slot = [jax.lax.dynamic_slice(
+                k, (slot, z, z, z), (1,) + k.shape[1:]) for k in k_bufs]
+            v_slot = [jax.lax.dynamic_slice(
+                v, (slot, z, z, z), (1,) + v.shape[1:]) for v in v_bufs]
+            with functional_mode(), _bind(state, state_vals):
+                caches = [StaticKVCache(k, v)
+                          for k, v in zip(k_slot, v_slot)]
+                hidden, new_caches = model.llama(
+                    Tensor(ids), kv_caches=caches,
+                    position_offset=Tensor(off))
+                row = jax.lax.dynamic_slice(
+                    hidden._value, (z, last, z), (1, 1, hidden.shape[-1]))
+                logits_row = model._logits(Tensor(row))._value[0, 0] \
+                    .astype(jnp.float32)
+            k_out = [jax.lax.dynamic_update_slice(
+                kb, (cc.k._value if isinstance(cc.k, Tensor) else cc.k
+                     ).astype(kb.dtype), (slot, z, z, z))
+                for kb, cc in zip(k_bufs, new_caches)]
+            v_out = [jax.lax.dynamic_update_slice(
+                vb, (cc.v._value if isinstance(cc.v, Tensor) else cc.v
+                     ).astype(vb.dtype), (slot, z, z, z))
+                for vb, cc in zip(v_bufs, new_caches)]
+            return k_out, v_out, logits_row
+
+        def set_logits(logits, row, slot):
+            return jax.lax.dynamic_update_slice(
+                logits, row[None].astype(logits.dtype), (slot, jnp.int32(0)))
+
+        self._step_fn = jax.jit(step, donate_argnums=(1, 2, 3))
+        self._prefill_fn = jax.jit(prefill_chunk, donate_argnums=(1, 2))
+        self._set_logits_fn = jax.jit(set_logits, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    # request lifecycle
+    # ------------------------------------------------------------------
+    def add_request(self, prompt_ids, max_new_tokens=64, temperature=0.0,
+                    top_p=1.0, eos_token_id=None, request_id=None):
+        ids = np.asarray(
+            prompt_ids.numpy() if hasattr(prompt_ids, "numpy")
+            else prompt_ids, dtype=np.int32).reshape(-1)
+        if len(ids) == 0:
+            raise ValueError("empty prompt")
+        if len(ids) >= self.capacity - 1:
+            raise ValueError(f"prompt of {len(ids)} tokens leaves no room "
+                             f"to generate (engine capacity "
+                             f"{self.capacity})")
+        rid = self._next_id if request_id is None else request_id
+        self._next_id = max(self._next_id, rid) + 1
+        self.waiting.append(GenerationRequest(
+            rid, ids, int(max_new_tokens), float(temperature), float(top_p),
+            eos_token_id))
+        return rid
+
+    def has_unfinished(self):
+        return bool(self.waiting) or any(s is not None for s in self.slots)
+
+    def _admit(self, slot_idx, req):
+        """Chunked prefill of `req` into slot `slot_idx`."""
+        self._programs()
+        P = len(req.prompt_ids)
+        off = 0
+        logits_row = None
+        while off < P:
+            take = min(self.chunk, P - off)
+            # JAX dynamic slices CLAMP out-of-range starts, so a window that
+            # would cross the buffer end slides BACK instead: positions
+            # [win, off) are recomputed (producing identical KV) and the new
+            # tokens land exactly at [off, off+take)
+            win = min(off, self.capacity - self.chunk)
+            chunk_ids = np.zeros((1, self.chunk), np.int32)
+            real = req.prompt_ids[win:min(win + self.chunk, P)]
+            chunk_ids[0, :len(real)] = real
+            self._k, self._v, logits_row = self._prefill_fn(
+                self._state_vals, self._k, self._v, jnp.asarray(chunk_ids),
+                jnp.int32(slot_idx), jnp.int32(win),
+                jnp.int32(off + take - 1 - win))
+            off += take
+            self.stats["prefill_chunks"] += 1
+        self._logits = self._set_logits_fn(self._logits, logits_row,
+                                           jnp.int32(slot_idx))
+        self._lens = self._lens.at[slot_idx].set(P)
+        self.slots[slot_idx] = _Slot(req, P)
+
+    def _admit_waiting(self):
+        for b in range(self.B):
+            if not self.waiting:
+                break
+            if self.slots[b] is None:
+                req = self.waiting[0]
+                room = self.capacity - len(req.prompt_ids) - 1
+                if req.max_new_tokens > room:
+                    import warnings
+                    warnings.warn(
+                        f"request {req.request_id}: capping max_new_tokens "
+                        f"{req.max_new_tokens} -> {room} (engine capacity "
+                        f"{self.capacity})", RuntimeWarning, stacklevel=3)
+                    req.max_new_tokens = room
+                self.waiting.popleft()
+                self._admit(b, req)
+
+    # ------------------------------------------------------------------
+    # the engine loop
+    # ------------------------------------------------------------------
+    def step(self):
+        """Admit waiting requests into free slots, run ONE decode step for
+        all active slots, retire finished requests. Returns the list of
+        RequestOutput finished by this step."""
+        from ..core import random as _random
+
+        self._admit_waiting()
+        if not any(s is not None for s in self.slots):
+            return []
+        self._programs()
+        if self._rng_key is None:
+            seed, counter = _random.default_generator.next_seed()
+            self._rng_key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                               counter)
+        active = np.array([s is not None for s in self.slots])
+        temps = np.array([s.req.temperature if s else 0.0
+                          for s in self.slots], np.float32)
+        top_ps = np.array([s.req.top_p if s else 1.0
+                           for s in self.slots], np.float32)
+        eos_ids = np.array([(s.req.eos_token_id if s and
+                             s.req.eos_token_id is not None else -1)
+                            for s in self.slots], np.int32)
+        budgets = np.array([(s.req.max_new_tokens - len(s.generated))
+                            if s else 0 for s in self.slots], np.int32)
+
+        t0 = time.perf_counter()
+        (toks, was_active, self._logits, self._k, self._v, self._lens,
+         self._rng_key) = self._step_fn(
+            self._state_vals, self._k, self._v, self._logits, self._lens,
+            jnp.asarray(active), self._rng_key, jnp.asarray(temps),
+            jnp.asarray(top_ps), jnp.asarray(eos_ids),
+            jnp.asarray(budgets))
+        toks_np = np.asarray(toks)        # [K, B] — the per-step transfer
+        act_np = np.asarray(was_active)   # [K, B]
+        self.stats["decode_time_s"] += time.perf_counter() - t0
+        self.stats["steps"] += 1
+
+        done = []
+        for b, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            finish_reason = None
+            for k in range(toks_np.shape[0]):
+                if not act_np[k, b]:
+                    # deactivated in-graph before this iteration (eos or
+                    # capacity hit at an earlier k): nothing more to read
+                    break
+                tok = int(toks_np[k, b])
+                slot.generated.append(tok)
+                self.stats["tokens_generated"] += 1
+                if self.stream_callback is not None:
+                    self.stream_callback(slot.req.request_id, tok)
+                if slot.req.eos_token_id is not None and \
+                        tok == slot.req.eos_token_id:
+                    finish_reason = "eos"
+                elif len(slot.generated) >= slot.req.max_new_tokens:
+                    finish_reason = "length"
+                elif slot.prompt_len + len(slot.generated) >= \
+                        self.capacity - 1:
+                    finish_reason = "capacity"
+                if finish_reason:
+                    break
+            if finish_reason:
+                out = RequestOutput(slot.req.request_id,
+                                    list(slot.generated), True,
+                                    finish_reason)
+                self.finished_outputs[slot.req.request_id] = out
+                done.append(out)
+                self.slots[b] = None  # slot freed; next step admits into it
+        return done
+
+    def generate(self, prompts, **sampling):
+        """Drain-mode convenience: submit all prompts, run steps until every
+        request finishes, return outputs in submission order."""
+        rids = [self.add_request(p, **sampling) for p in prompts]
+        while self.has_unfinished():
+            self.step()
+        return [self.finished_outputs[r] for r in rids]
+
+    def throughput(self):
+        dt = self.stats["decode_time_s"]
+        return self.stats["tokens_generated"] / dt if dt > 0 else 0.0
+
+    def reset_stats(self):
+        for key in self.stats:
+            self.stats[key] = 0.0 if key.endswith("_s") else 0
+
+
+def _bind(state, values):
+    from ..jit.functional_call import bind_state
+    return bind_state(state, values)

@@ -122,10 +122,19 @@ def precompute_rope(head_dim, max_len, theta=10000.0):
 
 
 def apply_rope(q, k, cos, sin, position_offset=0):
-    """q,k: [B, S, H, D]; rotate-half formulation in fp32."""
+    """q,k: [B, S, H, D]; rotate-half formulation in fp32. position_offset is
+    a scalar (shared offset) or a [B] vector (per-slot positions for the
+    continuous-batching decode step)."""
     s = q.shape[1]
-    cos_t = jax.lax.dynamic_slice_in_dim(cos, position_offset, s, 0)[None, :, None, :]
-    sin_t = jax.lax.dynamic_slice_in_dim(sin, position_offset, s, 0)[None, :, None, :]
+    if getattr(position_offset, "ndim", 0) == 1:
+        pos = position_offset[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        cos_t = jnp.take(cos, pos, axis=0)[:, :, None, :]   # [B, S, 1, D]
+        sin_t = jnp.take(sin, pos, axis=0)[:, :, None, :]
+    else:
+        cos_t = jax.lax.dynamic_slice_in_dim(
+            cos, position_offset, s, 0)[None, :, None, :]
+        sin_t = jax.lax.dynamic_slice_in_dim(
+            sin, position_offset, s, 0)[None, :, None, :]
 
     def rot(x):
         x32 = x.astype(jnp.float32)
@@ -148,6 +157,21 @@ class StaticKVCache:
 
     def __init__(self, k, v):
         self.k, self.v = k, v
+
+
+class SlotKVCache:
+    """Static KV buffers with PER-SLOT lengths — the continuous-batching
+    cache (:class:`paddle_tpu.inference.LLMEngine`): ``k``/``v`` are
+    [B, capacity, H, D] slot buffers and ``lens`` [B] is how many tokens each
+    slot has cached. A decode step writes slot b's new KV at position
+    ``lens[b]`` and attends positions <= lens[b], so sequences of different
+    lengths share ONE compiled step program. The engine, not the model,
+    advances ``lens`` (only for slots that are active)."""
+
+    __slots__ = ("k", "v", "lens")
+
+    def __init__(self, k, v, lens):
+        self.k, self.v, self.lens = k, v, lens
 
 
 class PagedKVCache:
@@ -276,6 +300,38 @@ class LlamaAttention(Layer):
             out = self.o_proj(ops.reshape(out, [b, 1, H * D]))
             new_lens = kv_cache.seq_lens + 1
             return out, PagedKVCache(kc, vc, kv_cache.block_tables, new_lens)
+        if isinstance(kv_cache, SlotKVCache):
+            # continuous-batching decode step: per-slot positions. Write each
+            # slot's new KV at its own length, rope at its own position,
+            # attend its own prefix — one compiled program for ragged slots.
+            if s != 1:
+                raise ValueError("SlotKVCache is a decode-step cache (one "
+                                 f"token per step); got seq len {s}")
+
+            def slot_step(kb, vb, kk, vv, lens):
+                lens = lens.astype(jnp.int32)
+                upd1 = jax.vmap(lambda buf, new, o:
+                                jax.lax.dynamic_update_slice_in_dim(
+                                    buf, new.astype(buf.dtype), o, 0))
+                return upd1(kb, kk, lens), upd1(vb, vv, lens)
+
+            k_buf, v_buf = dispatch(
+                slot_step, (kv_cache.k, kv_cache.v, k, v, kv_cache.lens), {},
+                name="slot_kv_update")
+            T = k_buf.shape[1]
+
+            def slot_mask(lens):
+                valid = jnp.arange(T, dtype=jnp.int32)[None, None, None, :] \
+                    <= lens.astype(jnp.int32)[:, None, None, None]
+                return jnp.where(valid, jnp.float32(0), jnp.float32(-1e30))
+
+            mask = dispatch(slot_mask, (kv_cache.lens,), {},
+                            name="slot_decode_mask")
+            out = F.scaled_dot_product_attention(
+                q, k_buf, v_buf, attn_mask=mask, is_causal=False,
+                training=self.training)
+            out = ops.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), SlotKVCache(k_buf, v_buf, kv_cache.lens)
         if isinstance(kv_cache, StaticKVCache):
             def upd(buf, new, off):
                 return jax.lax.dynamic_update_slice_in_dim(
@@ -288,9 +344,13 @@ class LlamaAttention(Layer):
             T = k_buf.shape[1]
 
             def make_mask(off):
-                last = off.astype(jnp.int32) + jnp.int32(s - 1)
+                # causal against the absolute position: query row q may see
+                # cached/current positions <= off+q (for s=1 decode this is
+                # the old "<= off" mask; for s>1 chunked prefill it keeps
+                # causality WITHIN the chunk)
+                rows = off.astype(jnp.int32) + jnp.arange(s, dtype=jnp.int32)
                 valid = jnp.arange(T, dtype=jnp.int32)[None, None, None, :] \
-                    <= last
+                    <= rows[None, None, :, None]
                 return jnp.where(valid, jnp.float32(0), jnp.float32(-1e30))
 
             mask = dispatch(make_mask, (position_offset,), {},
