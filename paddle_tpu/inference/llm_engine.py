@@ -77,7 +77,8 @@ class LLMEngine:
     programs partition by GSPMD like ``generate()``)."""
 
     def __init__(self, model, max_batch=4, max_seq_len=None, chunk_size=64,
-                 top_k=0, stream_callback=None, horizon=1):
+                 top_k=0, stream_callback=None, horizon=1, speculative_k=1,
+                 lookup_ngram=3):
         from ..jit.functional_call import collect_state, read_values
 
         self.model = model
@@ -87,6 +88,16 @@ class LLMEngine:
         # lax.scan — amortizes the per-step host sync K-fold at the cost of
         # admitting/retiring requests only every K tokens
         self.horizon = max(1, int(horizon))
+        # speculative verify window (prompt-lookup drafting, NO reference
+        # analog — the snapshot has no speculative decoding): each step
+        # commits 1 sampled token plus up to speculative_k-1 host-drafted
+        # tokens verified by ONE K-token model call. Exact for greedy slots;
+        # sampling slots fall back to 1 token/step in-graph.
+        self.speculative_k = max(1, int(speculative_k))
+        self.lookup_ngram = max(1, int(lookup_ngram))
+        if self.speculative_k > 1 and self.horizon > 1:
+            raise ValueError("speculative_k and horizon are mutually "
+                             "exclusive decode modes")
         self.capacity = int(max_seq_len or c.max_position_embeddings)
         if self.capacity > c.max_position_embeddings:
             raise ValueError(
@@ -127,7 +138,7 @@ class LLMEngine:
         self._prefill_fn = None
         self._set_logits_fn = None
         self.stats = {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
-                      "decode_time_s": 0.0}
+                      "draft_tokens_accepted": 0, "decode_time_s": 0.0}
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -196,6 +207,52 @@ class LLMEngine:
                     None, length=K)
             return toks, was_active, logits, k_bufs, v_bufs, lens, rng
 
+        Kspec = self.speculative_k
+
+        def spec_step(state_vals, k_bufs, v_bufs, logits, lens, active, rng,
+                      temps, top_ps, eos_ids, draft):
+            """Speculative verify window: commit one sampled token, then
+            check `draft` [B, Kspec-1] against the model's own greedy
+            predictions from ONE Kspec-token call. Acceptance is exact: a
+            draft position survives only if every earlier one did and the
+            model's prediction matches, so greedy output is identical to
+            step-by-step decode whatever the draft quality. KV written past
+            the accepted prefix is stale but unreferenced (lens-based masks)
+            and is overwritten by the next window, which starts at the new
+            length."""
+            rng, sub = jax.random.split(rng)
+            greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            sampled = _sample_logits_device(
+                logits, sub, jnp.maximum(temps, 1e-6)[:, None], top_k,
+                top_ps[:, None], False, True)
+            committed = jnp.where(temps <= 0.0, greedy_tok, sampled)
+            committed = jnp.where(active, committed, 0)
+            window = jnp.concatenate([committed[:, None], draft], axis=1)
+            with functional_mode(), _bind(state, state_vals):
+                caches = [SlotKVCache(k, v, lens)
+                          for k, v in zip(k_bufs, v_bufs)]
+                hidden, new_caches = model.llama(
+                    Tensor(window), kv_caches=caches,
+                    position_offset=Tensor(lens))
+                logits_all = model._logits(hidden)._value \
+                    .astype(jnp.float32)                    # [B, K, V]
+            kb = [cc.k._value if isinstance(cc.k, Tensor) else cc.k
+                  for cc in new_caches]
+            vb = [cc.v._value if isinstance(cc.v, Tensor) else cc.v
+                  for cc in new_caches]
+            # prediction at window row i is the model's token for position
+            # i+1; draft[:, i] survives iff it matches and all before it did
+            greedy_next = jnp.argmax(logits_all[:, :-1], axis=-1) \
+                .astype(jnp.int32)                          # [B, K-1]
+            match = (greedy_next == draft) & active[:, None] & \
+                (temps <= 0.0)[:, None]
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+            n_acc = acc.sum(axis=1).astype(jnp.int32)       # [B]
+            new_logits = jnp.take_along_axis(
+                logits_all, n_acc[:, None, None], axis=1)[:, 0]
+            new_lens = lens + jnp.where(active, 1 + n_acc, 0)
+            return window, n_acc, new_logits, kb, vb, new_lens, rng
+
         def prefill_chunk(state_vals, k_bufs, v_bufs, ids, slot, off, last):
             """Run chunk `ids` [1, chunk] of one prompt through the model
             against slot `slot`'s KV region starting at position `off`;
@@ -232,6 +289,7 @@ class LLMEngine:
                 logits, row[None].astype(logits.dtype), (slot, jnp.int32(0)))
 
         self._step_fn = jax.jit(step, donate_argnums=(1, 2, 3))
+        self._spec_fn = jax.jit(spec_step, donate_argnums=(1, 2, 3))
         self._prefill_fn = jax.jit(prefill_chunk, donate_argnums=(1, 2))
         self._set_logits_fn = jax.jit(set_logits, donate_argnums=(0,))
 
@@ -245,7 +303,7 @@ class LLMEngine:
             else prompt_ids, dtype=np.int32).reshape(-1)
         if len(ids) == 0:
             raise ValueError("empty prompt")
-        if len(ids) >= self.capacity - 1:
+        if len(ids) >= self.capacity - self.speculative_k:
             raise ValueError(f"prompt of {len(ids)} tokens leaves no room "
                              f"to generate (engine capacity "
                              f"{self.capacity})")
@@ -292,7 +350,8 @@ class LLMEngine:
                 break
             if self.slots[b] is None:
                 req = self.waiting[0]
-                room = self.capacity - len(req.prompt_ids) - 1
+                room = self.capacity - len(req.prompt_ids) - \
+                    self.speculative_k
                 if req.max_new_tokens > room:
                     import warnings
                     warnings.warn(
@@ -332,14 +391,33 @@ class LLMEngine:
                             if s else 0 for s in self.slots], np.int32)
 
         t0 = time.perf_counter()
-        (toks, was_active, self._logits, self._k, self._v, self._lens,
-         self._rng_key) = self._step_fn(
-            self._state_vals, self._k, self._v, self._logits, self._lens,
-            jnp.asarray(active), self._rng_key, jnp.asarray(temps),
-            jnp.asarray(top_ps), jnp.asarray(eos_ids),
-            jnp.asarray(budgets))
-        toks_np = np.asarray(toks)        # [K, B] — the per-step transfer
-        act_np = np.asarray(was_active)   # [K, B]
+        if self.speculative_k > 1:
+            drafts = np.zeros((self.B, self.speculative_k - 1), np.int32)
+            for b, slot in enumerate(self.slots):
+                # sampling slots reject all drafts in-graph — don't pay the
+                # O(context) host lookup for them
+                if slot is not None and slot.req.temperature <= 0.0:
+                    drafts[b] = self._propose(slot)
+            (window, n_acc, self._logits, self._k, self._v, self._lens,
+             self._rng_key) = self._spec_fn(
+                self._state_vals, self._k, self._v, self._logits,
+                self._lens, jnp.asarray(active), self._rng_key,
+                jnp.asarray(temps), jnp.asarray(top_ps),
+                jnp.asarray(eos_ids), jnp.asarray(drafts))
+            win_np = np.asarray(window)   # [B, K]
+            acc_np = np.asarray(n_acc)    # [B]
+            toks_np = win_np.T            # -> [K, B] like the horizon path
+            counts = np.where(active, 1 + acc_np, 0)
+            act_np = np.arange(toks_np.shape[0])[:, None] < counts[None, :]
+        else:
+            (toks, was_active, self._logits, self._k, self._v, self._lens,
+             self._rng_key) = self._step_fn(
+                self._state_vals, self._k, self._v, self._logits,
+                self._lens, jnp.asarray(active), self._rng_key,
+                jnp.asarray(temps), jnp.asarray(top_ps),
+                jnp.asarray(eos_ids), jnp.asarray(budgets))
+            toks_np = np.asarray(toks)       # [K, B] — the per-step transfer
+            act_np = np.asarray(was_active)  # [K, B]
         self.stats["decode_time_s"] += time.perf_counter() - t0
         self.stats["steps"] += 1
 
@@ -348,6 +426,7 @@ class LLMEngine:
             if slot is None:
                 continue
             finish_reason = None
+            n_read = 0
             for k in range(toks_np.shape[0]):
                 if not act_np[k, b]:
                     # deactivated in-graph before this iteration (eos or
@@ -355,6 +434,7 @@ class LLMEngine:
                     break
                 tok = int(toks_np[k, b])
                 slot.generated.append(tok)
+                n_read += 1
                 self.stats["tokens_generated"] += 1
                 if self.stream_callback is not None:
                     self.stream_callback(slot.req.request_id, tok)
@@ -364,10 +444,16 @@ class LLMEngine:
                 elif len(slot.generated) >= slot.req.max_new_tokens:
                     finish_reason = "length"
                 elif slot.prompt_len + len(slot.generated) >= \
-                        self.capacity - 1:
+                        self.capacity - self.speculative_k:
+                    # margin of K: a verify window writes K positions, and
+                    # JAX dynamic updates would clamp past the buffer end
                     finish_reason = "capacity"
                 if finish_reason:
                     break
+            if self.speculative_k > 1 and n_read > 1:
+                # drafts that actually landed in an output (the first token
+                # of a window is the committed sample, not a draft)
+                self.stats["draft_tokens_accepted"] += n_read - 1
             if finish_reason:
                 out = RequestOutput(slot.req.request_id,
                                     list(slot.generated), True,
@@ -377,13 +463,27 @@ class LLMEngine:
                 self.slots[b] = None  # slot freed; next step admits into it
         return done
 
+    def _propose(self, slot):
+        """Prompt-lookup draft: continue the most recent earlier occurrence
+        of the context's final n-gram. The first looked-up token corresponds
+        to the in-graph committed token, so the verify window gets the
+        remaining speculative_k-1."""
+        k = self.speculative_k
+        ctx = np.concatenate([slot.req.prompt_ids,
+                              np.asarray(slot.generated, np.int32)])
+        guess = _prompt_lookup(ctx, k, self.lookup_ngram)
+        return guess[1:]
+
     def generate(self, prompts, **sampling):
         """Drain-mode convenience: submit all prompts, run steps until every
-        request finishes, return outputs in submission order."""
+        request finishes, return outputs in submission order. Pops its
+        outputs from `finished_outputs` — long-running step()-driven servers
+        should likewise consume step()'s return list and delete (or pop)
+        entries they read, or the dict grows without bound."""
         rids = [self.add_request(p, **sampling) for p in prompts]
         while self.has_unfinished():
             self.step()
-        return [self.finished_outputs[r] for r in rids]
+        return [self.finished_outputs.pop(r) for r in rids]
 
     def throughput(self):
         dt = self.stats["decode_time_s"]
@@ -397,3 +497,22 @@ class LLMEngine:
 def _bind(state, values):
     from ..jit.functional_call import bind_state
     return bind_state(state, values)
+
+
+def _prompt_lookup(ctx, k, max_ngram=3):
+    """Propose k continuation tokens by matching the context's final n-gram
+    against its own history (longest n first, most recent match wins).
+    Falls back to repeating the last token — a bad draft only wastes the
+    verify window, never changes output."""
+    ctx = np.asarray(ctx, dtype=np.int32)
+    L = len(ctx)
+    for n in range(min(max_ngram, L - 1), 0, -1):
+        tail = ctx[L - n:]
+        for i in range(L - n - 1, -1, -1):
+            if np.array_equal(ctx[i:i + n], tail):
+                cont = ctx[i + n:i + n + k]
+                if len(cont):
+                    return np.pad(cont, (0, k - len(cont)),
+                                  constant_values=int(ctx[-1]))
+        # only fall to shorter n-grams when the longer one has no match
+    return np.full(k, int(ctx[-1]), np.int32)

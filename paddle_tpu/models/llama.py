@@ -301,13 +301,10 @@ class LlamaAttention(Layer):
             new_lens = kv_cache.seq_lens + 1
             return out, PagedKVCache(kc, vc, kv_cache.block_tables, new_lens)
         if isinstance(kv_cache, SlotKVCache):
-            # continuous-batching decode step: per-slot positions. Write each
-            # slot's new KV at its own length, rope at its own position,
-            # attend its own prefix — one compiled program for ragged slots.
-            if s != 1:
-                raise ValueError("SlotKVCache is a decode-step cache (one "
-                                 f"token per step); got seq len {s}")
-
+            # continuous-batching decode window (s=1 plain step, s=K a
+            # speculative verify window): write slot b's s new positions at
+            # its own length, rope at its own positions, causal mask against
+            # its own prefix — one compiled program for ragged slots.
             def slot_step(kb, vb, kk, vv, lens):
                 lens = lens.astype(jnp.int32)
                 upd1 = jax.vmap(lambda buf, new, o:
@@ -321,8 +318,11 @@ class LlamaAttention(Layer):
             T = k_buf.shape[1]
 
             def slot_mask(lens):
+                # window row q of slot b sits at absolute position lens[b]+q
+                rows = lens.astype(jnp.int32)[:, None, None, None] + \
+                    jnp.arange(s, dtype=jnp.int32)[None, None, :, None]
                 valid = jnp.arange(T, dtype=jnp.int32)[None, None, None, :] \
-                    <= lens.astype(jnp.int32)[:, None, None, None]
+                    <= rows
                 return jnp.where(valid, jnp.float32(0), jnp.float32(-1e30))
 
             mask = dispatch(slot_mask, (kv_cache.lens,), {},
