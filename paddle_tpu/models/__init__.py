@@ -3,6 +3,9 @@ from .llama import (  # noqa: F401
     LlamaMLP, precompute_rope, apply_rope,
 )
 from .bert import BertConfig, BertModel, BertForMaskedLM  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTConfig, GPT2Model, GPT2LMHeadModel, gpt2_small, gpt2_medium,
+)
 from .unet import (  # noqa: F401
     UNetConfig, UNetModel, sd_unet, diffusion_loss, timestep_embedding,
 )

@@ -1,0 +1,166 @@
+"""GPT-2 family — decoder-only LM over the framework's transformer layers.
+
+Reference analog: the reference keeps GPT in PaddleNLP but exercises it
+in-tree through the auto-parallel/dygraph-to-static test models
+(e.g. /root/reference/test/auto_parallel/gpt_with_pir.py:1 and
+test/legacy_test/test_multi_dot_op.py-style tiny LMs); architecture follows
+the public GPT-2: learned positions, pre-LN blocks, tied lm head.
+
+TPU notes: the block stack is the same `nn.TransformerEncoderLayer`
+(normalize_before=True) the bert path lowers to flash attention; training
+runs under `TrainStep` like every other model; `generate()` decodes through
+the layer library's incremental KV caches (`TransformerEncoder.gen_cache`).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .. import ops
+from ..nn import (Dropout, Embedding, Layer, LayerNorm, TransformerEncoder,
+                  TransformerEncoderLayer)
+from ..nn import functional as F
+
+__all__ = ["GPTConfig", "GPT2Model", "GPT2LMHeadModel", "gpt2_small",
+           "gpt2_medium"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50257, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=None,
+                 max_position_embeddings=1024, dropout=0.1,
+                 layer_norm_eps=1e-5, tie_word_embeddings=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.dropout = dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.tie_word_embeddings = tie_word_embeddings
+
+
+def gpt2_small(**over):
+    return GPTConfig(**{**dict(hidden_size=768, num_hidden_layers=12,
+                               num_attention_heads=12), **over})
+
+
+def gpt2_medium(**over):
+    return GPTConfig(**{**dict(hidden_size=1024, num_hidden_layers=24,
+                               num_attention_heads=16), **over})
+
+
+def _causal_mask(s):
+    m = jnp.where(jnp.arange(s)[None, :] <= jnp.arange(s)[:, None],
+                  jnp.float32(0), jnp.float32(-1e30))
+    return Tensor(m[None, None])
+
+
+class GPT2Model(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.wte = Embedding(c.vocab_size, c.hidden_size)
+        self.wpe = Embedding(c.max_position_embeddings, c.hidden_size)
+        self.drop = Dropout(c.dropout)
+        block = TransformerEncoderLayer(
+            c.hidden_size, c.num_attention_heads, c.intermediate_size,
+            dropout=c.dropout, activation="gelu", attn_dropout=c.dropout,
+            act_dropout=0.0, normalize_before=True,
+            layer_norm_eps=c.layer_norm_eps)
+        self.h = TransformerEncoder(
+            block, c.num_hidden_layers,
+            norm=LayerNorm(c.hidden_size, c.layer_norm_eps))
+
+    def forward(self, input_ids, cache=None, position_offset=0):
+        s = input_ids.shape[1]
+        pos = ops.arange(position_offset, position_offset + s, dtype="int64")
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        if cache is not None:
+            # prefill (s>1, empty cache) still needs causality inside the
+            # window; a single decode token attends the grown cache freely
+            return self.h(x, _causal_mask(s) if s > 1 else None, cache)
+        return self.h(x, _causal_mask(s))
+
+
+class GPT2LMHeadModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        from ..nn import Linear
+
+        self.config = config
+        self.transformer = GPT2Model(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def _logits(self, hidden):
+        if self.config.tie_word_embeddings:
+            return ops.matmul(hidden, self.transformer.wte.weight,
+                              transpose_y=True)
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.transformer(input_ids)
+        logits = self._logits(hidden)
+        if labels is None:
+            return logits
+        # causal LM shift: predict token t+1 at position t
+        loss = F.cross_entropy(
+            ops.reshape(logits[:, :-1], [-1, self.config.vocab_size]),
+            ops.reshape(labels[:, 1:], [-1]), ignore_index=-100)
+        return loss, logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=0, eos_token_id=None):
+        """Incremental decoding through the layer library's KV caches
+        (eager path; the flagship compiled serving path is
+        paddle_tpu.inference.LLMEngine on the llama family)."""
+        from ..core import random as _random
+
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(jnp.asarray(np.asarray(input_ids), jnp.int32))
+        B, prompt_len = ids.shape[0], ids.shape[1]
+        limit = min(int(max_new_tokens),
+                    self.config.max_position_embeddings - prompt_len)
+        was_training = self.training
+        self.eval()
+        try:
+            cache = self.transformer.h.gen_cache(
+                self.transformer.wte(ids[:, :1]))
+            hidden, cache = self.transformer(ids, cache=cache)
+            out = []
+            finished = np.zeros((B,), bool)
+            for i in range(limit):
+                logits = self._logits(hidden[:, -1]).numpy()
+                if temperature and float(temperature) > 0:
+                    logits = logits / float(temperature)
+                    if top_k:
+                        kth = np.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+                        logits = np.where(logits < kth, -np.inf, logits)
+                    z = logits - logits.max(-1, keepdims=True)
+                    p = np.exp(z) / np.exp(z).sum(-1, keepdims=True)
+                    g = _random.default_generator.next_seed()
+                    rng = np.random.default_rng(abs(hash(g)) % (2 ** 32))
+                    nxt = np.array([rng.choice(len(row), p=row)
+                                    for row in p], np.int64)
+                else:
+                    nxt = logits.argmax(-1).astype(np.int64)
+                if eos_token_id is not None:
+                    nxt = np.where(finished, eos_token_id, nxt)
+                    finished |= nxt == eos_token_id
+                out.append(nxt)
+                if eos_token_id is not None and finished.all():
+                    break
+                step_ids = Tensor(jnp.asarray(nxt[:, None], jnp.int32))
+                hidden, cache = self.transformer(
+                    step_ids, cache=cache,
+                    position_offset=prompt_len + i)
+        finally:
+            if was_training:
+                self.train()
+        return Tensor(jnp.asarray(np.stack(out, 1), jnp.int64))
