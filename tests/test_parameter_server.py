@@ -374,3 +374,49 @@ def test_rpc_save_load_keeps_optimizer_state(single_node, tmp_path):
     expect = snap[0] - 1.0 / (np.sqrt(2.0) + 1e-10)
     np.testing.assert_allclose(client.pull_sparse("ada", ids)[0], expect,
                                rtol=1e-5)
+
+
+_NATIVE_SERVER = r"""
+import sys, time
+from paddle_tpu.incubate.distributed import ps
+s = ps.NativePSServer(port=int(sys.argv[1]))
+print("READY", s.port, flush=True)
+time.sleep(float(sys.argv[2]))
+s.stop()
+"""
+
+
+@needs_native
+def test_native_server_cross_process(tmp_path):
+    """Native table nodes in SEPARATE OS processes (the deployment shape:
+    PS nodes are their own processes; reference: standalone brpc_ps_server
+    instances)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "ps_server.py"
+    script.write_text(_NATIVE_SERVER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs, ports = [], []
+    for _ in range(2):
+        p = subprocess.Popen(
+            [sys.executable, str(script), "0", "60"], cwd=repo_root,
+            env=env, stdout=subprocess.PIPE, text=True)
+        line = p.stdout.readline().split()
+        assert line[0] == "READY"
+        ports.append(int(line[1]))
+        procs.append(p)
+    try:
+        client = ps.NativePSClient([f"127.0.0.1:{pt}" for pt in ports])
+        client.create_table("emb", 6, lr=1.0)
+        ids = np.arange(20)
+        rows = client.pull_sparse("emb", ids)
+        client.push_sparse("emb", ids, np.ones((20, 6), np.float32))
+        after = client.pull_sparse("emb", ids)
+        np.testing.assert_allclose(rows - after, 1.0, rtol=1e-6)
+        st = client.stats("emb")
+        assert st["rows"] == 20
+        client.close()
+    finally:
+        for p in procs:
+            p.terminate()
+            p.wait(timeout=30)
