@@ -133,6 +133,10 @@ def _bench_other(model_name):
         # the per-layer dropout-mask RNG. bf16 AdamW moments measured
         # neutral here (134M params). Curve: 24/38.4, 48/40.2, 96/50.5,
         # 112+/OOM (no-remat activation working set; B=144 wants 34.4G).
+        # The edge configs compile-OOM nondeterministically (the remote
+        # compiler's fusion choices vary run to run: B=96 measured 50.5%
+        # one run, 16.8G-OOM at B=80 another) — so the bench LADDERS down
+        # until a batch compiles, keeping the driver line reliable.
         B = int(os.environ.get("BENCH_BATCH", "96"))
         S = int(os.environ.get("BENCH_SEQ", "512"))
         cfg = BertConfig(
@@ -144,24 +148,44 @@ def _bench_other(model_name):
             # same lever as the vit config: AdamW moment traffic in bf16
             from paddle_tpu.core.flags import set_flags
             set_flags({"adamw_bf16_moments": True})
-        model = BertForMaskedLM(cfg).bfloat16()
-        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-        optimizer = opt.AdamW(learning_rate=1e-4,
-                              parameters=model.parameters(),
-                              multi_precision=True)
-        step = TrainStep(model, lambda m, ids, lbl: m(ids, labels=lbl)[0],
-                         optimizer)
-        ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (B, S)),
-                               dtype="int32")
-        lbl = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (B, S)),
-                               dtype="int32")
-        dt, loss = _time_train_step(step, (ids, lbl), steps)
-        toks = B * S / dt
-        mfu = 6 * n_params * toks / peak
-        return {"metric": "bert_base_mlm_1chip_tokens_per_sec",
-                "value": round(toks, 1), "unit": "tokens/s",
-                "vs_baseline": None, "mfu_pct": round(mfu * 100, 2),
-                "step_time_s": round(dt, 4), "params": n_params, "loss": loss}
+        # rung choice is measured: 96/50.5 (when it compiles), 48/39.8-40.2,
+        # 24/38.4 — and 64 is a trap (31.4%: the compiler picks a spilling
+        # schedule there), so the ladder skips it
+        ladder = [b for b in (B, 48, 24) if b <= B] or [B]
+        last_err = None
+        for B_try in ladder:
+            paddle.seed(0)
+            model = BertForMaskedLM(cfg).bfloat16()
+            n_params = sum(int(np.prod(p.shape))
+                           for p in model.parameters())
+            optimizer = opt.AdamW(learning_rate=1e-4,
+                                  parameters=model.parameters(),
+                                  multi_precision=True)
+            step = TrainStep(model,
+                             lambda m, ids, lbl: m(ids, labels=lbl)[0],
+                             optimizer)
+            ids = paddle.to_tensor(
+                rng.integers(0, cfg.vocab_size, (B_try, S)), dtype="int32")
+            lbl = paddle.to_tensor(
+                rng.integers(0, cfg.vocab_size, (B_try, S)), dtype="int32")
+            try:
+                dt, loss = _time_train_step(step, (ids, lbl), steps)
+            except Exception as e:  # compile OOM at the edge config
+                # keep only the message — the exception's traceback would
+                # pin this rung's device buffers and OOM every later rung
+                last_err = RuntimeError(f"bert B={B_try}: {str(e)[:300]}")
+                del step, optimizer, model, ids, lbl
+                import gc
+                gc.collect()
+                continue
+            toks = B_try * S / dt
+            mfu = 6 * n_params * toks / peak
+            return {"metric": "bert_base_mlm_1chip_tokens_per_sec",
+                    "value": round(toks, 1), "unit": "tokens/s",
+                    "vs_baseline": None, "mfu_pct": round(mfu * 100, 2),
+                    "step_time_s": round(dt, 4), "params": n_params,
+                    "batch": B_try, "loss": loss}
+        raise last_err
 
     if model_name == "vit":
         from paddle_tpu.vision.models import vit_large_patch16
@@ -198,7 +222,8 @@ def _bench_other(model_name):
         from paddle_tpu.models import (UNetConfig, UNetModel, diffusion_loss)
         import jax.numpy as jnp
         B = int(os.environ.get("BENCH_BATCH", "4"))
-        cfg = UNetConfig.sd_unet(use_recompute=True)
+        cfg = UNetConfig.sd_unet(
+            use_recompute=os.environ.get("BENCH_REMAT", "1") == "1")
         model = UNetModel(cfg).bfloat16()
         n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
         optimizer = opt.AdamW(learning_rate=1e-4,
