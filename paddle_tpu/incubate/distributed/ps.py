@@ -38,7 +38,8 @@ from ...core import native
 from ...distributed import rpc
 
 __all__ = ["SparseTable", "start_server", "PSClient", "shutdown",
-           "NativePSServer", "NativePSClient", "DistributedEmbedding"]
+           "NativePSServer", "NativePSClient", "DistributedEmbedding",
+           "GeoSGDDenseSync"]
 
 _TABLES: dict[str, "SparseTable"] = {}
 
@@ -474,3 +475,74 @@ class DistributedEmbedding:
                 g_np = g_np * scale
             self.client.push_sparse(self.table_name, uniq, g_np)
         self._pending.clear()
+
+
+class GeoSGDDenseSync:
+    """Geo-SGD asynchronous dense synchronization over a PS table
+    (reference: the geo-SGD mode of python/paddle/distributed/ps and
+    fleet's the_one_ps runtime — workers train locally and exchange
+    parameter DELTAS through the server at a fixed cadence instead of
+    synchronous all-reduce).
+
+    The server holds the authoritative dense blob as one table row per
+    parameter (sgd rule, lr=1): a worker pushes ``last_synced - local``
+    (so the server applies ``+= local - last_synced``) and pulls the
+    merged value. Works over either transport.
+    """
+
+    def __init__(self, client, layer, table_name="geo_dense", sync_every=8,
+                 create=True):
+        self.client = client
+        self.table_name = table_name
+        self.sync_every = int(sync_every)
+        self._params = [(name, p) for name, p in layer.named_parameters()
+                        if not getattr(p, "stop_gradient", False)]
+        self._dim = max(int(np.prod(p.shape)) for _, p in self._params)
+        self._step = 0
+        ids = np.arange(len(self._params))
+        if create:
+            client.create_table(table_name, self._dim, optimizer="sgd",
+                                lr=1.0, init_std=0.0)
+            # seed the server blob with this worker's init
+            server = self.client.pull_sparse(self.table_name, ids)
+            delta = np.zeros_like(server)
+            for i, (_, p) in enumerate(self._params):
+                flat = np.asarray(p.numpy(), np.float32).ravel()
+                delta[i, :len(flat)] = server[i, :len(flat)] - flat
+            client.push_sparse(table_name, ids, delta)
+        else:
+            # a joining worker adopts the server's parameters (geo-SGD
+            # workers share one base; reference: init broadcast before
+            # async training starts)
+            from ...ops import creation
+            merged = self.client.pull_sparse(self.table_name, ids)
+            for i, (_, p) in enumerate(self._params):
+                n = int(np.prod(p.shape))
+                p.set_value(creation.to_tensor(
+                    merged[i, :n].reshape(p.shape).astype(np.float32)))
+        self._last = self._snapshot()
+
+    def _snapshot(self):
+        return [np.asarray(p.numpy(), np.float32).ravel().copy()
+                for _, p in self._params]
+
+    def step(self):
+        """Call once per local train step; pushes deltas and pulls the
+        merged params every `sync_every` steps. Returns True on sync."""
+        self._step += 1
+        if self._step % self.sync_every:
+            return False
+        ids = np.arange(len(self._params))
+        delta = np.zeros((len(self._params), self._dim), np.float32)
+        for i, (last, (_, p)) in enumerate(zip(self._last, self._params)):
+            cur = np.asarray(p.numpy(), np.float32).ravel()
+            delta[i, :len(cur)] = last - cur  # sgd rule applies -= delta
+        self.client.push_sparse(self.table_name, ids, delta)
+        merged = self.client.pull_sparse(self.table_name, ids)
+        from ...ops import creation
+        for i, (_, p) in enumerate(self._params):
+            n = int(np.prod(p.shape))
+            p.set_value(creation.to_tensor(
+                merged[i, :n].reshape(p.shape).astype(np.float32)))
+        self._last = self._snapshot()
+        return True

@@ -317,6 +317,26 @@ class LLMEngine:
     def has_unfinished(self):
         return bool(self.waiting) or any(s is not None for s in self.slots)
 
+    def cancel(self, request_id):
+        """Cancel a waiting or running request. Returns the partial
+        RequestOutput (finish_reason 'cancelled'), or None if the id is
+        unknown/already finished. A cancelled running slot frees at the
+        next step boundary (its KV region is simply reused)."""
+        for i, req in enumerate(self.waiting):
+            if req.request_id == request_id:
+                del self.waiting[i]
+                out = RequestOutput(request_id, [], True, "cancelled")
+                self.finished_outputs[request_id] = out
+                return out
+        for b, slot in enumerate(self.slots):
+            if slot is not None and slot.req.request_id == request_id:
+                out = RequestOutput(request_id, list(slot.generated), True,
+                                    "cancelled")
+                self.finished_outputs[request_id] = out
+                self.slots[b] = None
+                return out
+        return None
+
     def _admit(self, slot_idx, req):
         """Chunked prefill of `req` into slot `slot_idx`."""
         self._programs()

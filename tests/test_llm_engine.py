@@ -312,3 +312,23 @@ def test_engine_tp_sharded_matches_unsharded(tiny_model):
     eng2 = LLMEngine(sharded, max_batch=2, max_seq_len=64, chunk_size=8)
     outs = [o.token_ids for o in eng2.generate(prompts, max_new_tokens=6)]
     assert outs == refs
+
+
+def test_cancel_request(tiny_model):
+    rng = np.random.default_rng(18)
+    p1 = rng.integers(1, 96, size=(6,)).astype(np.int32)
+    p2 = rng.integers(1, 96, size=(5,)).astype(np.int32)
+    ref2 = _greedy_ref(tiny_model, p2, 8)
+    eng = LLMEngine(tiny_model, max_batch=1, max_seq_len=64, chunk_size=8)
+    r1 = eng.add_request(p1, max_new_tokens=8)
+    r2 = eng.add_request(p2, max_new_tokens=8)   # waits for the one slot
+    eng.step()
+    # cancel the RUNNING request mid-decode; the waiting one takes the slot
+    out = eng.cancel(r1)
+    assert out.finish_reason == "cancelled" and len(out.token_ids) >= 1
+    while eng.has_unfinished():
+        eng.step()
+    assert eng.finished_outputs[r2].token_ids == ref2
+    # cancelling a finished/unknown id is a no-op
+    assert eng.cancel(r1) is None
+    assert eng.cancel(12345) is None

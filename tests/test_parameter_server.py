@@ -420,3 +420,58 @@ def test_native_server_cross_process(tmp_path):
         for p in procs:
             p.terminate()
             p.wait(timeout=30)
+
+
+@needs_native
+def test_geo_sgd_dense_sync():
+    """Two workers train locally and merge deltas through the server at a
+    cadence (geo-SGD): after both sync, both hold base + delta_A + delta_B."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+
+    servers = [ps.NativePSServer()]
+    client = ps.NativePSClient([s.endpoint for s in servers])
+    try:
+        paddle.seed(21)
+        layer_a = paddle.nn.Linear(4, 3)
+        sync_a = ps.GeoSGDDenseSync(client, layer_a, sync_every=2,
+                                    create=True)
+        base = {n: p.numpy().copy() for n, p in layer_a.named_parameters()}
+
+        paddle.seed(99)  # different local init — must adopt the server base
+        layer_b = paddle.nn.Linear(4, 3)
+        sync_b = ps.GeoSGDDenseSync(client, layer_b, sync_every=2,
+                                    create=False)
+        for n, p in layer_b.named_parameters():
+            np.testing.assert_allclose(p.numpy(), base[n], rtol=1e-6)
+
+        # worker A steps locally twice (simulate an update), then syncs
+        delta_a = {}
+        for n, p in layer_a.named_parameters():
+            d = np.full(p.shape, 0.1, np.float32)
+            p.set_value(paddle.to_tensor(p.numpy() + d))
+            delta_a[n] = d
+        assert not sync_a.step()        # step 1: no sync yet
+        assert sync_a.step()            # step 2: pushes + pulls
+        # worker B makes its own change and syncs
+        delta_b = {}
+        for n, p in layer_b.named_parameters():
+            d = np.full(p.shape, -0.05, np.float32)
+            p.set_value(paddle.to_tensor(p.numpy() + d))
+            delta_b[n] = d
+        sync_b.step(); assert sync_b.step()
+        for n, p in layer_b.named_parameters():
+            want = base[n] + delta_a[n] + delta_b[n]
+            np.testing.assert_allclose(p.numpy(), want, rtol=1e-5,
+                                       atol=1e-6)
+        # A syncs again -> sees B's delta too
+        sync_a.step(); sync_a.step()
+        for n, p in layer_a.named_parameters():
+            want = base[n] + delta_a[n] + delta_b[n]
+            np.testing.assert_allclose(p.numpy(), want, rtol=1e-5,
+                                       atol=1e-6)
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
