@@ -495,6 +495,8 @@ class GeoSGDDenseSync:
         self.client = client
         self.table_name = table_name
         self.sync_every = int(sync_every)
+        if self.sync_every < 1:
+            raise ValueError(f"sync_every must be >= 1, got {sync_every}")
         self._params = [(name, p) for name, p in layer.named_parameters()
                         if not getattr(p, "stop_gradient", False)]
         self._dim = max(int(np.prod(p.shape)) for _, p in self._params)
@@ -513,14 +515,29 @@ class GeoSGDDenseSync:
         else:
             # a joining worker adopts the server's parameters (geo-SGD
             # workers share one base; reference: init broadcast before
-            # async training starts)
-            from ...ops import creation
-            merged = self.client.pull_sparse(self.table_name, ids)
-            for i, (_, p) in enumerate(self._params):
-                n = int(np.prod(p.shape))
-                p.set_value(creation.to_tensor(
-                    merged[i, :n].reshape(p.shape).astype(np.float32)))
+            # async training starts). Refuse an unseeded table — adopting
+            # the lazy zero rows would silently train a zero network.
+            if hasattr(self.client, "stats"):
+                try:
+                    rows = self.client.stats(table_name)["rows"]
+                except RuntimeError as e:  # table doesn't exist yet
+                    rows = -1
+                    cause = e
+                else:
+                    cause = None
+                if rows < len(self._params):
+                    raise RuntimeError(
+                        f"geo table {table_name!r} not seeded yet — start "
+                        f"the create=True worker first") from cause
+            self._adopt(self.client.pull_sparse(self.table_name, ids))
         self._last = self._snapshot()
+
+    def _adopt(self, merged):
+        from ...ops import creation
+        for i, (_, p) in enumerate(self._params):
+            n = int(np.prod(p.shape))
+            p.set_value(creation.to_tensor(
+                merged[i, :n].reshape(p.shape).astype(np.float32)))
 
     def _snapshot(self):
         return [np.asarray(p.numpy(), np.float32).ravel().copy()
@@ -538,11 +555,6 @@ class GeoSGDDenseSync:
             cur = np.asarray(p.numpy(), np.float32).ravel()
             delta[i, :len(cur)] = last - cur  # sgd rule applies -= delta
         self.client.push_sparse(self.table_name, ids, delta)
-        merged = self.client.pull_sparse(self.table_name, ids)
-        from ...ops import creation
-        for i, (_, p) in enumerate(self._params):
-            n = int(np.prod(p.shape))
-            p.set_value(creation.to_tensor(
-                merged[i, :n].reshape(p.shape).astype(np.float32)))
+        self._adopt(self.client.pull_sparse(self.table_name, ids))
         self._last = self._snapshot()
         return True

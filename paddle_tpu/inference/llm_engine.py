@@ -458,6 +458,11 @@ class LLMEngine:
                 self.stats["tokens_generated"] += 1
                 if self.stream_callback is not None:
                     self.stream_callback(slot.req.request_id, tok)
+                    if self.slots[b] is not slot:
+                        # the callback cancelled this request re-entrantly;
+                        # stop reading its window and keep the 'cancelled'
+                        # output it recorded
+                        break
                 if slot.req.eos_token_id is not None and \
                         tok == slot.req.eos_token_id:
                     finish_reason = "eos"
@@ -474,6 +479,8 @@ class LLMEngine:
                 # drafts that actually landed in an output (the first token
                 # of a window is the committed sample, not a draft)
                 self.stats["draft_tokens_accepted"] += n_read - 1
+            if self.slots[b] is not slot:
+                continue  # cancelled mid-window; don't record a finish
             if finish_reason:
                 out = RequestOutput(slot.req.request_id,
                                     list(slot.generated), True,

@@ -332,3 +332,25 @@ def test_cancel_request(tiny_model):
     # cancelling a finished/unknown id is a no-op
     assert eng.cancel(r1) is None
     assert eng.cancel(12345) is None
+
+
+def test_cancel_from_stream_callback(tiny_model):
+    """Re-entrant cancel inside stream_callback must stop the stream and
+    keep the 'cancelled' output (not be overwritten by a natural finish)."""
+    rng = np.random.default_rng(19)
+    p = rng.integers(1, 96, size=(5,)).astype(np.int32)
+    eng = None
+    seen = []
+
+    def cb(rid, tok):
+        seen.append(tok)
+        if len(seen) == 2:
+            eng.cancel(rid)
+
+    eng = LLMEngine(tiny_model, max_batch=1, max_seq_len=64, chunk_size=8,
+                    horizon=4, stream_callback=cb)
+    rid = eng.add_request(p, max_new_tokens=4)  # finishes within one window
+    eng.step()
+    out = eng.finished_outputs[rid]
+    assert out.finish_reason == "cancelled"
+    assert len(seen) == 2  # no tokens streamed after the cancel

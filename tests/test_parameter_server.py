@@ -475,3 +475,26 @@ def test_geo_sgd_dense_sync():
         client.close()
         for s in servers:
             s.stop()
+
+
+@needs_native
+def test_geo_sgd_guards():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+
+    servers = [ps.NativePSServer()]
+    client = ps.NativePSClient([s.endpoint for s in servers])
+    try:
+        paddle.seed(22)
+        layer = paddle.nn.Linear(3, 2)
+        with pytest.raises(ValueError, match="sync_every"):
+            ps.GeoSGDDenseSync(client, layer, sync_every=0)
+        # joining before the creator seeds the table is refused
+        with pytest.raises(RuntimeError, match="not seeded"):
+            ps.GeoSGDDenseSync(client, layer, table_name="unseeded",
+                               create=False)
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
