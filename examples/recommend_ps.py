@@ -1,0 +1,45 @@
+"""Recommendation-style training with the native parameter server: a huge
+sparse embedding lives on PS table nodes, the dense tower trains on device.
+
+Run: python examples/recommend_ps.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate.distributed import ps
+
+
+def main():
+    servers = [ps.NativePSServer() for _ in range(2)]
+    client = ps.NativePSClient([s.endpoint for s in servers])
+    emb = ps.DistributedEmbedding(client, "user_emb", 16,
+                                  optimizer="adagrad", lr=0.1, seed=0)
+    tower = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                                 paddle.nn.Linear(32, 1))
+    dense_opt = opt.AdamW(learning_rate=1e-3, parameters=tower.parameters())
+
+    rng = np.random.default_rng(0)
+    V = 100_000  # ids far beyond device-memory embedding sizes
+    targets = {}
+    for step in range(30):
+        ids_np = rng.integers(0, V, size=(64,))
+        y_np = np.array([targets.setdefault(i, rng.standard_normal())
+                         for i in ids_np], np.float32)[:, None]
+        out = tower(emb(paddle.to_tensor(ids_np)))
+        loss = ((out - paddle.to_tensor(y_np)) ** 2).mean()
+        loss.backward()
+        emb.push_step()          # sparse rows -> PS (adagrad on the server)
+        dense_opt.step()
+        dense_opt.clear_grad()
+        if step % 10 == 0 or step == 29:
+            st = client.stats("user_emb")
+            print(f"step {step}: loss {float(loss.numpy()):.4f} "
+                  f"(PS rows={st['rows']})", flush=True)
+    client.close()
+    for s in servers:
+        s.stop()
+
+
+if __name__ == "__main__":
+    main()

