@@ -1,9 +1,10 @@
 """Driver benchmark — one JSON line per BASELINE workload config.
 
 Default (`BENCH_MODEL` unset / `all`): runs every BASELINE.md config plus
-the decode benchmark — resnet50, bert, vit, unet, llama_decode, then the
-flagship llama LAST — each in its own subprocess, one JSON line each, so
-the tail line stays the llama MFU vs the 45% north star (BASELINE.json).
+the decode and serving benchmarks — resnet50, bert, vit, unet, llama_decode,
+llama_serve, then the flagship llama LAST — each in its own subprocess, one
+JSON line each, so the tail line stays the llama MFU vs the 45% north star
+(BASELINE.json).
 `BENCH_MODEL=llama` (or any single name) prints exactly one line.
 
 The flagship line measures the fused compiled training step (fwd+bwd+AdamW,
