@@ -108,6 +108,9 @@ define_flag("check_nan_inf", False, "check every op output for nan/inf (numeric 
 define_flag("use_fused_adamw", True,
             "route multi-precision Adam/AdamW updates to the fused Pallas "
             "single-pass kernel")
+define_flag("use_pallas_int4", True,
+            "route tileable weight-only int4 GEMMs to the fused Pallas "
+            "dequant-matmul kernel (TPU backend only)")
 define_flag("adamw_bf16_moments", False,
             "store Adam/AdamW moment1/moment2 in bfloat16 (update math stays "
             "fp32 via upcast) — halves optimizer-state HBM traffic at a "
