@@ -135,6 +135,12 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_ax
             else:  # NCL / NCHW / NCDHW
                 spatial = list(range(2, 2 + n_spatial))
             for i, d in enumerate(reversed(spatial)):
+                if d >= nd:
+                    raise ValueError(
+                        f"pad: a {len(pad)}-element pad list is the "
+                        f"{data_format} spatial form and needs rank >= "
+                        f"{d + 1}, got rank {nd}; pass 2*ndim pairs for "
+                        f"arbitrary tensors")
                 width[d] = (pad[2 * i], pad[2 * i + 1])
         if jmode == "constant":
             return jnp.pad(v, width, mode="constant", constant_values=value)

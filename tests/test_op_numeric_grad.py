@@ -244,3 +244,59 @@ def test_where_grad():
         return np.where(cond, x, y)
 
     check_op(op, ref, [(3, 4), (3, 4)])
+
+
+def test_conv2d_grad():
+    """conv2d NCHW forward vs a scipy-free direct convolution + numeric
+    grads (tiny shapes keep central differences tractable)."""
+    def ref(x, w):
+        B, C, H, W = x.shape
+        O, _, kh, kw = w.shape
+        out = np.zeros((B, O, H - kh + 1, W - kw + 1), np.float32)
+        for b in range(B):
+            for o in range(O):
+                for i in range(out.shape[2]):
+                    for j in range(out.shape[3]):
+                        out[b, o, i, j] = np.sum(
+                            x[b, :, i:i + kh, j:j + kw] * w[o])
+        return out
+
+    import paddle_tpu.nn.functional as F2
+    check_op(lambda x, w: F2.conv2d(x, w), ref, [(2, 3, 5, 5), (4, 3, 3, 3)],
+             rtol=1e-4, grad_rtol=8e-2)
+
+
+def test_bmm_and_einsum():
+    check_op(lambda x, y: paddle.bmm(x, y), lambda x, y: x @ y,
+             [(3, 2, 4), (3, 4, 5)])
+    check_op(lambda x, y: paddle.einsum("bij,bjk->bik", x, y),
+             lambda x, y: np.einsum("bij,bjk->bik", x, y),
+             [(2, 3, 4), (2, 4, 2)])
+
+
+def test_pad_stack_split():
+    check_op(lambda x: paddle.nn.functional.pad(x, [0, 0, 1, 2], value=0.0),
+             lambda x: np.pad(x, [(0, 0), (1, 2)]), [(3, 4)])
+    # the spatial-form shorthand on a too-low-rank tensor errors clearly
+    with pytest.raises(ValueError, match="spatial form"):
+        paddle.nn.functional.pad(
+            paddle.to_tensor(np.ones((3, 4), np.float32)), [1, 2])
+    check_op(lambda x, y: paddle.stack([x, y], axis=0),
+             lambda x, y: np.stack([x, y]), [(3, 4), (3, 4)])
+    check_op(lambda x: paddle.split(x, 2, axis=1)[0],
+             lambda x: np.split(x, 2, axis=1)[0], [(3, 6)])
+
+
+def test_embedding_scatter_grad():
+    """Embedding lookup gradient: scattered accumulation into rows
+    (duplicate indices must sum)."""
+    idx = np.array([1, 3, 1], np.int64)
+    emb_w = np.random.default_rng(0).standard_normal((5, 4)).astype(np.float32)
+    w = paddle.to_tensor(emb_w, stop_gradient=False)
+    out = paddle.nn.functional.embedding(paddle.to_tensor(idx), w)
+    tgt = np.random.default_rng(1).standard_normal((3, 4)).astype(np.float32)
+    ((out - paddle.to_tensor(tgt)) ** 2).sum().backward()
+    num = np.zeros_like(emb_w)
+    for k, i in enumerate(idx):
+        num[i] += 2 * (emb_w[i] - tgt[k])
+    np.testing.assert_allclose(w.grad.numpy(), num, rtol=1e-4, atol=1e-5)
