@@ -119,8 +119,13 @@ def _bench_other(model_name):
         x = paddle.to_tensor(rng.standard_normal(
             shape).astype(np.float32)).astype("bfloat16")
         y = paddle.to_tensor(rng.integers(0, 1000, B))
+        # forward FLOPs from XLA's cost model (train = 3x fwd). The old
+        # hand constant (3 * 4.1e9 * B) was GMACs, not FLOPs — it halved
+        # the reported MFU; the per-instruction HLO count in
+        # docs/artifacts/conv_roofline_proof.json confirms ~8.2 GFLOP/img
+        fwd_flops = _forward_flops(model, (x,))
         dt, loss = _time_train_step(step, (x, y), steps)
-        flops = 3 * 4.1e9 * B  # fwd 4.1 GFLOP/img @224 (train = 3x fwd)
+        flops = 3 * (fwd_flops if fwd_flops is not None else 8.2e9 * B)
         return {"metric": "resnet50_1chip_train_imgs_per_sec",
                 "value": round(B / dt, 1), "unit": "imgs/s",
                 "vs_baseline": None, "mfu_pct": round(flops / dt / peak * 100, 2),
@@ -144,7 +149,13 @@ def _bench_other(model_name):
             max_position_embeddings=S,
             hidden_dropout_prob=float(os.environ.get("BENCH_DROPOUT", "0.1")),
             attention_probs_dropout_prob=float(
-                os.environ.get("BENCH_ATTN_DROPOUT", "0.1")))
+                os.environ.get("BENCH_ATTN_DROPOUT", "0.1")),
+            # SELECTIVE remat: bert is compute-bound, so full remat costs
+            # the whole +1/3 step FLOPs (measured 50.7 -> 38.0% MFU); a few
+            # rematted layers shave just the compile-time temp peak that
+            # made no-remat B=96 OOM nondeterministically
+            use_recompute=os.environ.get("BENCH_REMAT", "1") == "1",
+            recompute_layers=int(os.environ.get("BENCH_REMAT_LAYERS", "3")))
         if os.environ.get("BENCH_BF16_MOMENTS", "1") == "1":
             # same lever as the vit config: AdamW moment traffic in bf16
             from paddle_tpu.core.flags import set_flags
@@ -172,9 +183,17 @@ def _bench_other(model_name):
             try:
                 dt, loss = _time_train_step(step, (ids, lbl), steps)
             except Exception as e:  # compile OOM at the edge config
+                # only resource exhaustion ladders down — a genuine
+                # regression (shape bug, import error) must fail loudly,
+                # not silently demote the benchmark
+                msg = str(e)
+                if not any(t in msg.upper() for t in
+                           ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED",
+                            "OUT OF MEMORY", "OOM", "ALLOCAT")):
+                    raise
                 # keep only the message — the exception's traceback would
                 # pin this rung's device buffers and OOM every later rung
-                last_err = RuntimeError(f"bert B={B_try}: {str(e)[:300]}")
+                last_err = RuntimeError(f"bert B={B_try}: {msg[:300]}")
                 del step, optimizer, model, ids, lbl
                 import gc
                 gc.collect()
@@ -287,29 +306,45 @@ def _bench_other(model_name):
             quantize_linears_for_inference(model, weight_dtype=weight_dtype)
         ids_v = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, prompt)),
                             jnp.int32)
-        total = prompt + new_tokens
-        prefill, decode = model._gen_programs(
-            B, prompt, new_tokens, total, 0.0, 0, 1.0, None, "static", 64)
+        # TWO-LENGTH DIFFERENTIAL (VERDICT r4 #7): time the full
+        # prefill+decode pair at new_tokens and at a short control length,
+        # and divide the time DELTA by the token delta. The old
+        # pair-minus-prefill method subtracted a separately-timed prefill,
+        # which under-subtracts fixed per-call costs (dispatch, donation
+        # relayout, tunnel RTT) and INFLATES absolute decode tok/s — the
+        # builder's own int4 A/B already used this honest form.
+        short = min(max(new_tokens // 8, 8), max(new_tokens // 2, 1))
         _, params, _, buffers = collect_state(model)
         state_vals = read_values(params + buffers)
         key = jax.random.PRNGKey(0)
+        total = prompt + new_tokens
+
+        def build_pair(n_new):
+            prefill, decode = model._gen_programs(
+                B, prompt, n_new, prompt + n_new, 0.0, 0, 1.0, None,
+                "static", 64)
+
+            def run_pair():
+                l0, kb, vb = prefill(state_vals, ids_v)
+                buf, n = decode(state_vals, kb, vb, l0, key,
+                                jnp.float32(1.0), jnp.float32(1.0))
+                int(np.asarray(n))
+                return buf
+            return prefill, run_pair
+
+        prefill, run_long = build_pair(new_tokens)
+        _, run_short = build_pair(short)
 
         def run_prefill():
             l0, kb, vb = prefill(state_vals, ids_v)
             float(np.asarray(l0[0, 0]))  # tunnel-safe sync
-            return l0, kb, vb
 
-        def run_pair():
-            l0, kb, vb = prefill(state_vals, ids_v)
-            buf, n = decode(state_vals, kb, vb, l0, key,
-                            jnp.float32(1.0), jnp.float32(1.0))
-            int(np.asarray(n))
-            return buf
-
-        # warm both programs twice (donated-output relayout recompiles must
+        # warm every program twice (donated-output relayout recompiles must
         # not land in a timing window)
-        run_pair()
-        run_pair()
+        for f in (run_long, run_short):
+            f()
+            f()
+        run_prefill()
         reps = int(os.environ.get("BENCH_STEPS", "8"))
         t0 = time.perf_counter()
         for _ in range(reps):
@@ -317,13 +352,21 @@ def _bench_other(model_name):
         t_prefill = (time.perf_counter() - t0) / reps
         t0 = time.perf_counter()
         for _ in range(reps):
-            run_pair()
-        t_pair = (time.perf_counter() - t0) / reps
-        t_decode = max(t_pair - t_prefill, 1e-9)
+            run_short()
+        t_short = (time.perf_counter() - t0) / reps
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            run_long()
+        t_long = (time.perf_counter() - t0) / reps
+        t_decode = max(t_long - t_short, 1e-9)
+        n_delta = new_tokens - short
         return {"metric": "llama_decode_tokens_per_sec",
-                "value": round(B * new_tokens / t_decode, 1),
+                "value": round(B * n_delta / t_decode, 1),
                 "unit": "tokens/s", "vs_baseline": None,
-                "decode_ms_per_token": round(t_decode / new_tokens * 1e3, 3),
+                "method": "two-length-differential",
+                "decode_ms_per_token": round(
+                    t_decode / n_delta * 1e3, 3),
+                "new_tokens_long_short": [new_tokens, short],
                 "prefill_tokens_per_sec": round(B * prompt / t_prefill, 1),
                 "prefill_s": round(t_prefill, 4),
                 "batch": B, "prompt_len": prompt, "new_tokens": new_tokens,
@@ -383,6 +426,9 @@ def _bench_other(model_name):
                 "prefill_chunks": eng.stats["prefill_chunks"],
                 "horizon": horizon,
                 "weight_dtype": weight_dtype or "bf16"}
+
+    if model_name == "conv_roofline":
+        return _bench_conv_roofline()
 
     if model_name == "dispatch":
         return _bench_dispatch()
@@ -530,6 +576,223 @@ def _bench_memcheck():
     if resid_err:
         out["residual_model_error"] = resid_err[:200]
     return out
+
+
+def _measured_stream_bw():
+    """Measured HBM stream bandwidth (bytes/s) from the DEVICE-track
+    duration of a large bf16 axpy fusion — the roofline denominator.
+    Host-side timing has a ~1 ms dispatch floor through the axon tunnel;
+    the profiler's device track does not."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.utils.roofline import profile_device_events
+
+    N = 128 * 1024 * 1024  # 256 MB per array
+    x = jnp.ones((N,), jnp.bfloat16)
+    y = jnp.ones((N,), jnp.bfloat16)
+    axpy = jax.jit(lambda x, y: x * jnp.bfloat16(1.0001) + y)
+    r = axpy(x, y)
+    float(np.asarray(r[0]))
+
+    def run(steps):
+        for _ in range(steps):
+            r = axpy(x, y)
+        float(np.asarray(r[0]))
+
+    ev, _ = profile_device_events(run, steps=8)
+    # the only compute event is the axpy loop fusion: 2 reads + 1 write
+    name, best = None, 0.0
+    for n, d in ev.items():
+        if d["total_us"] > best and not n.startswith("copy"):
+            name, best = n, d["total_us"]
+    per_step = best / 8 / 1e6
+    return 3 * N * 2 / per_step
+
+
+def _bench_conv_roofline():
+    """Regenerate docs/artifacts/conv_roofline_proof.json (VERDICT r4 #1):
+    per-fusion achieved FLOP/s + B/s vs each fusion's own roofline bound,
+    for the resnet50 and unet bench steps, on the real chip. The reference
+    counterpart is the cudnn conv stack with layout/algorithm autotuning
+    (paddle/phi/kernels/gpudnn/conv_kernel.cu,
+    phi/kernels/autotune/auto_tune_base.h); here the question "is XLA's
+    conv lowering at the hardware ceiling" is answered per fusion."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit.api import TrainStep
+    from paddle_tpu.utils.roofline import (profile_device_events,
+                                           roofline_table)
+
+    steps = int(os.environ.get("BENCH_STEPS", "4"))
+    rng = np.random.default_rng(0)
+    peak = _peak_flops(jax.devices()[0])
+    bw = _measured_stream_bw()
+    models = {}
+
+    def analyze(name, step, args):
+        compiled = step.aot_compile(*args)
+        hlo = compiled.as_text()
+        for _ in range(2):  # donated-layout recompile must precede trace
+            loss = step(*args)
+        float(np.asarray(loss._value))
+
+        def run(n):
+            for _ in range(n):
+                loss = step(*args)
+            float(np.asarray(loss._value))
+
+        ev, jit_total = profile_device_events(run, steps=steps)
+        # self-calibrate the bandwidth roofline: the HIGHEST sustained HBM
+        # rate demonstrated by any long-running fusion of this very step
+        # (or the axpy probe) — the most self-critical denominator
+        rows, _ = roofline_table(hlo, ev, steps, peak, bw)
+        # capped at the chip's spec bandwidth: a fusion "demonstrating" more
+        # than spec means residual byte overcount (aliased operands), not a
+        # faster memory system
+        bw_cal = min(max([bw] + [r["achieved_gbs"] * 1e9 for r in rows
+                                 if r["time_us"] > 200
+                                 and r["bytes"] > 32e6]),
+                     819e9)
+        rows, unmatched = roofline_table(hlo, ev, steps, peak, bw_cal)
+        # module container events give the true device step time; leaf
+        # events + unmatched is the fallback
+        step_us = (jit_total / steps if jit_total
+                   else sum(r["time_us"] for r in rows) + unmatched)
+        conv = [r for r in rows if r["kind"] == "conv"]
+        conv_us = sum(r["time_us"] for r in conv)
+        conv_bound = sum(r["bound_us"] for r in conv)
+        # "major" fusions: >=2% of step device time each
+        major = [r for r in conv if r["time_us"] >= 0.02 * step_us]
+        tot_bytes = sum(r["bytes"] for r in rows)
+        tot_flops = sum(r["flops"] for r in rows)
+        step_bound_us = max(tot_bytes / bw_cal, tot_flops / peak) * 1e6
+        models[name] = {
+            "step_device_us": round(step_us, 1),
+            "hbm_bw_roofline_gbs": round(bw_cal / 1e9, 1),
+            "total_hbm_gb_per_step": round(tot_bytes / 1e9, 2),
+            "total_tflop_per_step": round(tot_flops / 1e12, 3),
+            "aggregate_gbs": round(tot_bytes / step_us / 1e3, 1),
+            "achieved_pct_of_peak_flops": round(
+                tot_flops / (step_us / 1e6) / peak * 100, 2),
+            # the whole step against ITS OWN roofline: the bound the
+            # reference's tuned conv stack would also be subject to
+            "step_bound_us": round(step_bound_us, 1),
+            "step_roofline_eff": round(step_bound_us / step_us, 3),
+            "step_bound_by": ("compute" if tot_flops / peak
+                              >= tot_bytes / bw_cal else "memory"),
+            "conv_time_share": round(conv_us / step_us, 3),
+            "conv_weighted_roofline_eff": round(conv_bound / conv_us, 3),
+            "major_conv_fusions": len(major),
+            "major_conv_fusions_above_80pct": sum(
+                1 for r in major if (r["roofline_eff"] or 0) >= 0.8),
+            "unmatched_us_per_step": round(unmatched, 1),
+            "rows": rows[:40],
+        }
+
+    # resnet50, exactly the bench config
+    B = int(os.environ.get("BENCH_BATCH", "128"))
+    paddle.seed(0)
+    from paddle_tpu.vision.models import resnet50
+    model = resnet50(num_classes=1000, data_format="NHWC").bfloat16()
+    optimizer = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    step = TrainStep(model, lambda m, x, y: F.cross_entropy(m(x), y),
+                     optimizer)
+    x = paddle.to_tensor(rng.standard_normal(
+        (B, 224, 224, 3)).astype(np.float32)).astype("bfloat16")
+    y = paddle.to_tensor(rng.integers(0, 1000, B))
+    analyze("resnet50", step, (x, y))
+    del model, optimizer, step, x, y
+    import gc
+    gc.collect()
+
+    # unet, exactly the bench config
+    from paddle_tpu.models import UNetConfig, UNetModel, diffusion_loss
+    Bu = int(os.environ.get("BENCH_UNET_BATCH", "4"))
+    paddle.seed(0)
+    um = UNetModel(UNetConfig.sd_unet(use_recompute=True)).bfloat16()
+    uopt = opt.AdamW(learning_rate=1e-4, parameters=um.parameters(),
+                     multi_precision=True)
+    alphas = paddle.to_tensor(np.linspace(0.999, 0.01, 1000)
+                              .astype(np.float32))
+    ustep = TrainStep(um, lambda m, lat, t, ctx, noise: diffusion_loss(
+        m, lat, t, ctx, noise, alphas), uopt)
+    lat = paddle.to_tensor(rng.standard_normal(
+        (Bu, 64, 64, 4)).astype(np.float32)).astype("bfloat16")
+    t = paddle.to_tensor(rng.integers(0, 1000, Bu))
+    ctx = paddle.to_tensor(rng.standard_normal(
+        (Bu, 77, 768)).astype(np.float32)).astype("bfloat16")
+    noise = paddle.to_tensor(rng.standard_normal(
+        (Bu, 64, 64, 4)).astype(np.float32)).astype("bfloat16")
+    analyze("unet", ustep, (lat, t, ctx, noise))
+
+    artifact = {
+        "description": "Per-fusion roofline proof for the conv workloads "
+                       "(resnet50 B=128, sd-unet B=4 train steps). "
+                       "bound_us = max(flops/peak, bytes/bw); "
+                       "roofline_eff = bound_us/time_us (1.0 = at the "
+                       "roofline). flops are VALID-pair conv MACs x2 "
+                       "(padding/dilation zeros excluded); bytes exclude "
+                       "VMEM-prefetched (S(1)) operands. bw is "
+                       "self-calibrated per model: max sustained HBM rate "
+                       "demonstrated by any fusion of the same step.",
+        "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "peak_bf16_flops": peak,
+        "hbm_bw_axpy_probe_gbs": round(bw / 1e9, 1),
+        "models": models,
+        "attempt_ladder": [
+            {"experiment": "layout NCHW vs NHWC end-to-end",
+             "result": "EQUAL full-step throughput (XLA layout-assigns "
+                       "convs; isolated microbenches misleadingly show "
+                       "NHWC 1.5x)", "recorded": "round 3, PROGRESS + "
+                       "BENCH_LAYOUT=NCHW knob in bench.py"},
+            {"experiment": "resnet batch sweep B=128 vs 256",
+             "result": "no change in imgs/s/chip — bandwidth-bound, "
+                       "bigger batch scales bytes with flops",
+             "recorded": "round 3"},
+            {"experiment": "unet batch B=4 vs B=8",
+             "result": "15.1 vs 15.2% MFU — batch-insensitive",
+             "recorded": "round 4, PROGRESS unet_mfu_measured"},
+            {"experiment": "FLOP accounting audit (this artifact)",
+             "result": "bench.py used 4.1 GMACs/img as FLOPs — true "
+                       "fwd is ~8.2 GFLOP/img (per-instruction HLO "
+                       "count); resnet MFU restated ~2x higher",
+             "recorded": "round 5, this file"},
+            {"experiment": "unet attention: Pallas flash vs XLA einsum "
+                           "A/B at every sd-unet shape (fwd+bwd, device-"
+                           "track timed)",
+             "result": "flash wins 2.6-20x everywhere: self 4096/d40 "
+                       "5.06ms (einsum OOMs: 2GB logits buffers), cross "
+                       "4096/77 0.73 vs 2.67ms, self 1024/d80 0.39 vs "
+                       "8.60ms, cross 1024/77 0.14 vs 0.60ms, self 256/"
+                       "d160 0.07 vs 0.45ms, cross 256/77 0.06 vs 0.15ms. "
+                       "The 4096/d40 kernel runs AT the lane-padded MXU "
+                       "bound (~4.8ms ideal for d=40 padded to 128 lanes) "
+                       "— the 3.2x padding waste is inherent to head_dim "
+                       "40 on a 128x128 systolic array, an SD architecture "
+                       "choice, not a kernel deficiency",
+             "recorded": "round 5, this file"},
+            {"experiment": "per-fusion roofline (this artifact)",
+             "result": "see models.*: conv fusions are MEMORY-bound on "
+                       "resnet (weighted eff vs own bound in "
+                       "conv_weighted_roofline_eff); the step as a whole "
+                       "runs at step_roofline_eff of its bandwidth bound",
+             "recorded": "round 5, this file"},
+        ],
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "docs", "artifacts", "conv_roofline_proof.json")
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    return {"metric": "conv_roofline_weighted_eff",
+            "value": models["resnet50"]["conv_weighted_roofline_eff"],
+            "unit": "x of roofline", "vs_baseline": None,
+            "unet_eff": models["unet"]["conv_weighted_roofline_eff"],
+            "hbm_bw_measured_gbs": round(bw / 1e9, 1),
+            "artifact": path}
 
 
 def _bench_dispatch():

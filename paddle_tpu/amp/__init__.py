@@ -61,6 +61,16 @@ def amp_state():
     return _state
 
 
+def policy_fingerprint():
+    """Hashable snapshot of the active autocast policy — part of every
+    compiled-program cache key (a program traced under one policy bakes
+    its casts in; reusing it under another would silently change dtypes)."""
+    if not _state.enabled:
+        return None
+    return (str(_state.dtype), _state.level,
+            frozenset(_state.custom_white), frozenset(_state.custom_black))
+
+
 def amp_cast_inputs(name: str, leaves: list):
     """dispatch() hook: cast tensor-value leaves per AMP policy. Returns new list."""
     if not _state.enabled:

@@ -446,26 +446,35 @@ struct pt_ps_server {
           break;
         }
         case kPush: {
-          char n_buf[8];
-          if (!ps_read_full(fd, n_buf, 8)) goto done;
+          // payload: u64 n, u32 grad_dim, n ids, n*grad_dim floats. The
+          // explicit grad_dim lets the server DRAIN the stream even when
+          // the table is unknown (or the width wrong) and reply an
+          // attributable error instead of dropping the connection.
+          char n_buf[12];
+          if (!ps_read_full(fd, n_buf, 12)) goto done;
           {
             uint64_t n;
             std::memcpy(&n, n_buf, 8);
             n = ps_swap64(n);
-            if (n > (1ull << 28)) goto done;
+            uint32_t gdim = ps_load_u32(n_buf + 8);
+            if (n > (1ull << 28) || gdim == 0 || gdim > (1u << 20) ||
+                n * static_cast<uint64_t>(gdim) > (1ull << 28))
+              goto done;  // protocol-level bound violation: not drainable
             ids.resize(n);
             if (n && !ps_read_full(fd, ids.data(), n * 8)) goto done;
-            auto t = Find(name);
-            if (!t) {
-              // must still drain the grads to keep the stream aligned — but
-              // dim is unknown; drop the connection instead.
-              goto done;
-            }
-            if (n * static_cast<uint64_t>(t->dim) > (1ull << 28)) goto done;
-            vals.resize(n * t->dim);
+            vals.resize(n * gdim);
             if (n &&
                 !ps_read_full(fd, vals.data(), vals.size() * sizeof(float)))
               goto done;
+            auto t = Find(name);
+            if (!t) {
+              ReplyErr(&reply, "no such table");
+              break;
+            }
+            if (t->dim != gdim) {
+              ReplyErr(&reply, "push dim mismatch");
+              break;
+            }
             t->Push(ids.data(), n, vals.data());
             reply.push_back(1);
           }

@@ -375,9 +375,10 @@ class NativePSClient:
     def push_sparse(self, name, ids, grads):
         ids_flat, owner = self._shard(ids)
         grads = np.asarray(grads, np.float32).reshape(len(ids_flat), -1)
-        # The PUSH wire format carries no dim; a width mismatch would be
-        # applied mis-strided server-side. Validate against the known dim
-        # (learned from create_table / any pull; fetched cheaply if unknown).
+        # The server validates the grad width itself (the PUSH header now
+        # carries it); this client-side check is just the earlier, cheaper
+        # error, against the known dim (learned from create_table / any
+        # pull; fetched cheaply if unknown).
         dim = self._dims.get(name)
         if dim is None and len(ids_flat):
             self.pull_sparse(name, ids_flat[:1], init_missing=False)
@@ -392,8 +393,12 @@ class NativePSClient:
                 continue
             part_ids = np.ascontiguousarray(ids_flat[sel])
             part_g = np.ascontiguousarray(grads[sel])
+            # PUSH carries the grad width so the server can drain the
+            # stream and reply an attributable error on unknown tables
+            # or width mismatches (instead of dropping the connection)
             self._conn(si).request(
-                _OP_PUSH, name, struct.pack(">Q", len(part_ids))
+                _OP_PUSH, name,
+                struct.pack(">QI", len(part_ids), grads.shape[1])
                 + part_ids.tobytes() + part_g.tobytes())
 
     def _path_op(self, op, name, dirname):

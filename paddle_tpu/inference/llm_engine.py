@@ -78,7 +78,12 @@ class LLMEngine:
 
     def __init__(self, model, max_batch=4, max_seq_len=None, chunk_size=64,
                  top_k=0, stream_callback=None, horizon=1, speculative_k=1,
-                 lookup_ngram=3):
+                 lookup_ngram=3, mesh=None):
+        """``mesh``: a jax Mesh for MULTI-PROCESS serving — engine buffers
+        are created as global (replicated) arrays on it so the compiled
+        programs can mix them with TP-sharded weights whose groups span
+        processes; every process runs the same step() calls (SPMD) and
+        reads the same replicated token vector."""
         from ..jit.functional_call import collect_state, read_values
 
         self.model = model
@@ -88,16 +93,18 @@ class LLMEngine:
         # lax.scan — amortizes the per-step host sync K-fold at the cost of
         # admitting/retiring requests only every K tokens
         self.horizon = max(1, int(horizon))
-        # speculative verify window (prompt-lookup drafting, NO reference
-        # analog — the snapshot has no speculative decoding): each step
-        # commits 1 sampled token plus up to speculative_k-1 host-drafted
-        # tokens verified by ONE K-token model call. Exact for greedy slots;
-        # sampling slots fall back to 1 token/step in-graph.
+        # speculative verify windows (prompt-lookup drafting, NO reference
+        # analog — the snapshot has no speculative decoding): each window
+        # commits 1 sampled token plus up to speculative_k-1 drafted tokens
+        # verified by ONE K-token model call. Drafting runs IN-GRAPH from a
+        # device-side token history, so windows compose with `horizon`: one
+        # step() = horizon windows = up to horizon*speculative_k tokens per
+        # host round-trip. Greedy slots accept token-exactly; sampling
+        # slots use rejection-sampling acceptance (distribution-exact for
+        # pure temperature sampling; with top-k/top-p the residual re-
+        # filters the masked distribution, see _spec_accept).
         self.speculative_k = max(1, int(speculative_k))
         self.lookup_ngram = max(1, int(lookup_ngram))
-        if self.speculative_k > 1 and self.horizon > 1:
-            raise ValueError("speculative_k and horizon are mutually "
-                             "exclusive decode modes")
         self.capacity = int(max_seq_len or c.max_position_embeddings)
         if self.capacity > c.max_position_embeddings:
             raise ValueError(
@@ -120,11 +127,31 @@ class LLMEngine:
         # buffer (the final window slides BACK over already-written
         # positions instead of padding the time axis — see _admit)
         self.chunk = min(self.chunk, self.capacity)
+        self._mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def _zeros(shape, dtype):
+                sharding = NamedSharding(mesh, PartitionSpec())
+                shard = np.zeros(sharding.shard_shape(tuple(shape)), dtype)
+                return jax.make_array_from_callback(
+                    shape, sharding, lambda idx: shard)
+        else:
+            _zeros = jnp.zeros
+        import ml_dtypes  # noqa: F401  (np.zeros understands bf16 via jnp)
+        np_dt = np.dtype(dt) if mesh is not None else dt
         shape = (self.B, self.capacity, kvh, head_dim)
-        self._k = [jnp.zeros(shape, dt) for _ in range(L)]
-        self._v = [jnp.zeros(shape, dt) for _ in range(L)]
-        self._logits = jnp.zeros((self.B, c.vocab_size), jnp.float32)
-        self._lens = jnp.zeros((self.B,), jnp.int32)
+        self._k = [_zeros(shape, np_dt) for _ in range(L)]
+        self._v = [_zeros(shape, np_dt) for _ in range(L)]
+        self._logits = _zeros((self.B, c.vocab_size), np.float32
+                              if mesh is not None else jnp.float32)
+        self._lens = _zeros((self.B,), np.int32
+                            if mesh is not None else jnp.int32)
+        # device-side committed-token history (speculative mode): the
+        # in-graph prompt-lookup draft reads it, decode windows append
+        self._tokens = _zeros((self.B, self.capacity), np.int32
+                              if mesh is not None else jnp.int32) \
+            if self.speculative_k > 1 else None
         self._n_layers = L
 
         # host-side slot table / queues
@@ -208,50 +235,65 @@ class LLMEngine:
             return toks, was_active, logits, k_bufs, v_bufs, lens, rng
 
         Kspec = self.speculative_k
+        ngram = self.lookup_ngram
 
         def spec_step(state_vals, k_bufs, v_bufs, logits, lens, active, rng,
-                      temps, top_ps, eos_ids, draft):
-            """Speculative verify window: commit one sampled token, then
-            check `draft` [B, Kspec-1] against the model's own greedy
-            predictions from ONE Kspec-token call. Acceptance is exact: a
-            draft position survives only if every earlier one did and the
-            model's prediction matches, so greedy output is identical to
-            step-by-step decode whatever the draft quality. KV written past
-            the accepted prefix is stale but unreferenced (lens-based masks)
-            and is overwritten by the next window, which starts at the new
-            length."""
-            rng, sub = jax.random.split(rng)
-            greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            sampled = _sample_logits_device(
-                logits, sub, jnp.maximum(temps, 1e-6)[:, None], top_k,
-                top_ps[:, None], False, True)
-            committed = jnp.where(temps <= 0.0, greedy_tok, sampled)
-            committed = jnp.where(active, committed, 0)
-            window = jnp.concatenate([committed[:, None], draft], axis=1)
-            with functional_mode(), _bind(state, state_vals):
-                caches = [SlotKVCache(k, v, lens)
-                          for k, v in zip(k_bufs, v_bufs)]
-                hidden, new_caches = model.llama(
-                    Tensor(window), kv_caches=caches,
-                    position_offset=Tensor(lens))
-                logits_all = model._logits(hidden)._value \
-                    .astype(jnp.float32)                    # [B, K, V]
-            kb = [cc.k._value if isinstance(cc.k, Tensor) else cc.k
-                  for cc in new_caches]
-            vb = [cc.v._value if isinstance(cc.v, Tensor) else cc.v
-                  for cc in new_caches]
-            # prediction at window row i is the model's token for position
-            # i+1; draft[:, i] survives iff it matches and all before it did
-            greedy_next = jnp.argmax(logits_all[:, :-1], axis=-1) \
-                .astype(jnp.int32)                          # [B, K-1]
-            match = (greedy_next == draft) & active[:, None] & \
-                (temps <= 0.0)[:, None]
-            acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
-            n_acc = acc.sum(axis=1).astype(jnp.int32)       # [B]
-            new_logits = jnp.take_along_axis(
-                logits_all, n_acc[:, None, None], axis=1)[:, 0]
-            new_lens = lens + jnp.where(active, 1 + n_acc, 0)
-            return window, n_acc, new_logits, kb, vb, new_lens, rng
+                      temps, top_ps, eos_ids, budgets, tokens_buf):
+            """`horizon` speculative verify windows as ONE compiled scan.
+            Each window: in-graph prompt-lookup draft from the device token
+            history -> commit one sampled token + verify the Kspec-1 drafts
+            with ONE Kspec-token model call (_spec_accept: greedy rows
+            token-exact, sampled rows rejection-sampling). KV written past
+            the accepted prefix is stale but unreferenced (lens-based
+            masks) and is overwritten by the next window."""
+            def body(carry, _):
+                kb, vb, logits, lens, act, emitted, rng, tbuf = carry
+                draft = _lookup_draft(tbuf, lens, Kspec - 1, ngram)
+                rng, sub, sub2 = jax.random.split(rng, 3)
+                greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                sampled = _sample_logits_device(
+                    logits, sub, jnp.maximum(temps, 1e-6)[:, None], top_k,
+                    top_ps[:, None], False, True)
+                committed = jnp.where(temps <= 0.0, greedy_tok, sampled)
+                committed = jnp.where(act, committed, 0)
+                window = jnp.concatenate([committed[:, None], draft],
+                                         axis=1)
+                with functional_mode(), _bind(state, state_vals):
+                    caches = [SlotKVCache(k, v, lens)
+                              for k, v in zip(kb, vb)]
+                    hidden, new_caches = model.llama(
+                        Tensor(window), kv_caches=caches,
+                        position_offset=Tensor(lens))
+                    logits_all = model._logits(hidden)._value \
+                        .astype(jnp.float32)                # [B, K, V]
+                kb = [cc.k._value if isinstance(cc.k, Tensor) else cc.k
+                      for cc in new_caches]
+                vb = [cc.v._value if isinstance(cc.v, Tensor) else cc.v
+                      for cc in new_caches]
+                n_acc, new_logits = _spec_accept(
+                    logits_all, draft, temps, top_ps, top_k, act, sub2)
+                counts = jnp.where(act, 1 + n_acc, 0)
+                new_lens = lens + counts
+                tbuf = _write_window(tbuf, window, lens)
+                emitted = emitted + counts
+                kidx = jnp.arange(Kspec)[None, :]
+                in_window = kidx < counts[:, None]
+                eos_hit = jnp.any(
+                    in_window & (window == eos_ids[:, None]), axis=1)
+                act_next = act & ~eos_hit & \
+                    (new_lens < cap - Kspec) & (emitted < budgets)
+                return (kb, vb, new_logits, new_lens, act_next, emitted,
+                        rng, tbuf), (window, counts, act)
+
+            emitted0 = jnp.zeros_like(lens)
+            (k_bufs, v_bufs, logits, lens, active, _, rng, tokens_buf), \
+                (toks, counts, was_active) = jax.lax.scan(
+                    body,
+                    (k_bufs, v_bufs, logits, lens, active, emitted0, rng,
+                     tokens_buf),
+                    None, length=K)
+            return (toks, counts, was_active, logits, k_bufs, v_bufs, lens,
+                    rng, tokens_buf)
 
         def prefill_chunk(state_vals, k_bufs, v_bufs, ids, slot, off, last):
             """Run chunk `ids` [1, chunk] of one prompt through the model
@@ -288,10 +330,20 @@ class LLMEngine:
             return jax.lax.dynamic_update_slice(
                 logits, row[None].astype(logits.dtype), (slot, jnp.int32(0)))
 
+        def set_tokens(tokens_buf, row, slot):
+            return jax.lax.dynamic_update_slice(
+                tokens_buf, row[None].astype(jnp.int32),
+                (slot, jnp.int32(0)))
+
+        def set_len(lens, slot, val):
+            return jax.lax.dynamic_update_slice(lens, val[None], (slot,))
+
         self._step_fn = jax.jit(step, donate_argnums=(1, 2, 3))
-        self._spec_fn = jax.jit(spec_step, donate_argnums=(1, 2, 3))
+        self._spec_fn = jax.jit(spec_step, donate_argnums=(1, 2, 3, 11))
         self._prefill_fn = jax.jit(prefill_chunk, donate_argnums=(1, 2))
         self._set_logits_fn = jax.jit(set_logits, donate_argnums=(0,))
+        self._set_tokens_fn = jax.jit(set_tokens, donate_argnums=(0,))
+        self._set_len_fn = jax.jit(set_len, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
     # request lifecycle
@@ -308,6 +360,12 @@ class LLMEngine:
                              f"to generate (engine capacity "
                              f"{self.capacity})")
         rid = self._next_id if request_id is None else request_id
+        if request_id is not None and (
+                rid in self.finished_outputs
+                or any(r.request_id == rid for r in self.waiting)
+                or any(s is not None and s.req.request_id == rid
+                       for s in self.slots)):
+            raise ValueError(f"duplicate request_id {rid!r}")
         self._next_id = max(self._next_id, rid) + 1
         self.waiting.append(GenerationRequest(
             rid, ids, int(max_new_tokens), float(temperature), float(top_p),
@@ -354,14 +412,21 @@ class LLMEngine:
             real = req.prompt_ids[win:min(win + self.chunk, P)]
             chunk_ids[0, :len(real)] = real
             self._k, self._v, logits_row = self._prefill_fn(
-                self._state_vals, self._k, self._v, jnp.asarray(chunk_ids),
-                jnp.int32(slot_idx), jnp.int32(win),
-                jnp.int32(off + take - 1 - win))
+                self._state_vals, self._k, self._v, chunk_ids,
+                np.int32(slot_idx), np.int32(win),
+                np.int32(off + take - 1 - win))
             off += take
             self.stats["prefill_chunks"] += 1
         self._logits = self._set_logits_fn(self._logits, logits_row,
-                                           jnp.int32(slot_idx))
-        self._lens = self._lens.at[slot_idx].set(P)
+                                           np.int32(slot_idx))
+        self._lens = self._set_len_fn(self._lens, np.int32(slot_idx),
+                                      np.int32(P))
+        if self._tokens is not None:
+            # token history for in-graph drafting: the prompt, zero-padded
+            row = np.zeros((self.capacity,), np.int32)
+            row[:P] = req.prompt_ids
+            self._tokens = self._set_tokens_fn(
+                self._tokens, row, np.int32(slot_idx))
         self.slots[slot_idx] = _Slot(req, P)
 
     def _admit_waiting(self):
@@ -397,8 +462,18 @@ class LLMEngine:
         self._programs()
         if self._rng_key is None:
             seed, counter = _random.default_generator.next_seed()
-            self._rng_key = jax.random.fold_in(jax.random.PRNGKey(seed),
-                                               counter)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
+            if self._mesh is not None:
+                # multi-process: the key must be a GLOBAL replicated array
+                # (every process derives the identical value from the seed)
+                from jax.sharding import NamedSharding, PartitionSpec
+                data = np.asarray(jax.random.key_data(key))
+                glob = jax.make_array_from_callback(
+                    data.shape,
+                    NamedSharding(self._mesh, PartitionSpec()),
+                    lambda idx: data[idx])
+                key = jax.random.wrap_key_data(glob)
+            self._rng_key = key
         active = np.array([s is not None for s in self.slots])
         temps = np.array([s.req.temperature if s else 0.0
                           for s in self.slots], np.float32)
@@ -411,31 +486,31 @@ class LLMEngine:
                             if s else 0 for s in self.slots], np.int32)
 
         t0 = time.perf_counter()
-        if self.speculative_k > 1:
-            drafts = np.zeros((self.B, self.speculative_k - 1), np.int32)
-            for b, slot in enumerate(self.slots):
-                # sampling slots reject all drafts in-graph — don't pay the
-                # O(context) host lookup for them
-                if slot is not None and slot.req.temperature <= 0.0:
-                    drafts[b] = self._propose(slot)
-            (window, n_acc, self._logits, self._k, self._v, self._lens,
-             self._rng_key) = self._spec_fn(
+        spec = self.speculative_k > 1
+        if spec:
+            (toks, counts, was_active, self._logits, self._k, self._v,
+             self._lens, self._rng_key, self._tokens) = self._spec_fn(
                 self._state_vals, self._k, self._v, self._logits,
-                self._lens, jnp.asarray(active), self._rng_key,
-                jnp.asarray(temps), jnp.asarray(top_ps),
-                jnp.asarray(eos_ids), jnp.asarray(drafts))
-            win_np = np.asarray(window)   # [B, K]
-            acc_np = np.asarray(n_acc)    # [B]
-            toks_np = win_np.T            # -> [K, B] like the horizon path
-            counts = np.where(active, 1 + acc_np, 0)
-            act_np = np.arange(toks_np.shape[0])[:, None] < counts[None, :]
+                self._lens, active, self._rng_key,
+                temps, top_ps, eos_ids, budgets, self._tokens)
+            toks3 = np.asarray(toks)          # [Kh, B, Kspec]
+            counts_np = np.asarray(counts)    # [Kh, B]
+            wa_np = np.asarray(was_active)    # [Kh, B]
+            Kh, B_, Ks = toks3.shape
+            # flatten windows into the [rows, B] stream the readout walks;
+            # a window row i is live for slot b iff i < counts (acceptance
+            # truncates windows, so the stream has per-window gaps — the
+            # readout SKIPS dead rows instead of stopping at them)
+            toks_np = toks3.transpose(0, 2, 1).reshape(Kh * Ks, B_)
+            act_np = ((np.arange(Ks)[None, :, None] <
+                       counts_np[:, None, :]) &
+                      wa_np[:, None, :]).reshape(Kh * Ks, B_)
         else:
             (toks, was_active, self._logits, self._k, self._v, self._lens,
              self._rng_key) = self._step_fn(
                 self._state_vals, self._k, self._v, self._logits,
-                self._lens, jnp.asarray(active), self._rng_key,
-                jnp.asarray(temps), jnp.asarray(top_ps),
-                jnp.asarray(eos_ids), jnp.asarray(budgets))
+                self._lens, active, self._rng_key,
+                temps, top_ps, eos_ids, budgets)
             toks_np = np.asarray(toks)       # [K, B] — the per-step transfer
             act_np = np.asarray(was_active)  # [K, B]
         self.stats["decode_time_s"] += time.perf_counter() - t0
@@ -449,6 +524,10 @@ class LLMEngine:
             n_read = 0
             for k in range(toks_np.shape[0]):
                 if not act_np[k, b]:
+                    if spec:
+                        # rejected tail of a verify window: later windows
+                        # may still hold live tokens
+                        continue
                     # deactivated in-graph before this iteration (eos or
                     # capacity hit at an earlier k): nothing more to read
                     break
@@ -475,10 +554,15 @@ class LLMEngine:
                     finish_reason = "capacity"
                 if finish_reason:
                     break
-            if self.speculative_k > 1 and n_read > 1:
-                # drafts that actually landed in an output (the first token
-                # of a window is the committed sample, not a draft)
-                self.stats["draft_tokens_accepted"] += n_read - 1
+            if spec and n_read > 0:
+                # drafts that actually landed in an output (row 0 of each
+                # window is the committed sample, not a draft)
+                Ks = self.speculative_k
+                n_committed = sum(
+                    1 for k in range(toks_np.shape[0])
+                    if act_np[k, b] and k % Ks == 0)
+                self.stats["draft_tokens_accepted"] += max(
+                    n_read - n_committed, 0)
             if self.slots[b] is not slot:
                 continue  # cancelled mid-window; don't record a finish
             if finish_reason:
@@ -489,17 +573,6 @@ class LLMEngine:
                 done.append(out)
                 self.slots[b] = None  # slot freed; next step admits into it
         return done
-
-    def _propose(self, slot):
-        """Prompt-lookup draft: continue the most recent earlier occurrence
-        of the context's final n-gram. The first looked-up token corresponds
-        to the in-graph committed token, so the verify window gets the
-        remaining speculative_k-1."""
-        k = self.speculative_k
-        ctx = np.concatenate([slot.req.prompt_ids,
-                              np.asarray(slot.generated, np.int32)])
-        guess = _prompt_lookup(ctx, k, self.lookup_ngram)
-        return guess[1:]
 
     def generate(self, prompts, **sampling):
         """Drain-mode convenience: submit all prompts, run steps until every
@@ -526,20 +599,91 @@ def _bind(state, values):
     return bind_state(state, values)
 
 
-def _prompt_lookup(ctx, k, max_ngram=3):
-    """Propose k continuation tokens by matching the context's final n-gram
-    against its own history (longest n first, most recent match wins).
-    Falls back to repeating the last token — a bad draft only wastes the
-    verify window, never changes output."""
-    ctx = np.asarray(ctx, dtype=np.int32)
-    L = len(ctx)
-    for n in range(min(max_ngram, L - 1), 0, -1):
-        tail = ctx[L - n:]
-        for i in range(L - n - 1, -1, -1):
-            if np.array_equal(ctx[i:i + n], tail):
-                cont = ctx[i + n:i + n + k]
-                if len(cont):
-                    return np.pad(cont, (0, k - len(cont)),
-                                  constant_values=int(ctx[-1]))
-        # only fall to shorter n-grams when the longer one has no match
-    return np.full(k, int(ctx[-1]), np.int32)
+def _lookup_draft(tokens_buf, lens, k_draft, ngram):
+    """In-graph prompt-lookup drafting: for each row, match the committed
+    history's final `ngram` tokens against the history itself (most recent
+    match wins) and propose the `k_draft` tokens that followed it. Falls
+    back to repeating the last token — a bad draft only wastes the verify
+    window, never changes output."""
+    cap = tokens_buf.shape[1]
+    idx = jnp.arange(cap)
+
+    def per_row(buf, L):
+        tail_start = jnp.maximum(L - ngram, 0)
+        tail = jax.lax.dynamic_slice(buf, (tail_start,), (ngram,))
+        eq = jnp.ones((cap,), bool)
+        for j in range(ngram):
+            # buf[i + j] == tail[j] for every window position i
+            eq = eq & (jnp.roll(buf, -j) == tail[j])
+        m = eq & (idx < (L - ngram))  # exclude the tail's own position
+        has = jnp.any(m)
+        i_star = cap - 1 - jnp.argmax(jnp.flip(m))  # most recent match
+        start = jnp.where(has, i_star + ngram, 0)
+        cont = jax.lax.dynamic_slice(buf, (start,), (k_draft,))
+        last = buf[jnp.maximum(L - 1, 0)]
+        pos = start + jnp.arange(k_draft)
+        return jnp.where(has & (pos < L), cont, last).astype(jnp.int32)
+
+    return jax.vmap(per_row)(tokens_buf, lens.astype(jnp.int32))
+
+
+def _write_window(tokens_buf, window, lens):
+    """Append a verify window's tokens to each row's history at its own
+    length (rejected-tail positions are overwritten by later windows)."""
+    def per_row(buf, w, L):
+        return jax.lax.dynamic_update_slice(buf, w, (L,))
+
+    return jax.vmap(per_row)(tokens_buf, window.astype(jnp.int32),
+                             lens.astype(jnp.int32))
+
+
+def _processed_probs(logits, temps, top_ps, top_k):
+    """The temperature/top-k/top-p filtered distribution the engine samples
+    from, as probabilities — delegates to the ONE shared filter pipeline
+    (models.llama._filter_logits) so the rejection-sampling acceptance can
+    never drift from the sampler."""
+    from ..models.llama import _filter_logits
+    filtered = _filter_logits(
+        logits, jnp.maximum(temps, 1e-6)[:, None, None],
+        top_k, top_ps[:, None, None])
+    return jax.nn.softmax(filtered, axis=-1)
+
+
+def _spec_accept(logits_all, draft, temps, top_ps, top_k, active, key):
+    """Acceptance rule for one verify window. ``logits_all`` [B, K, V] are
+    the model's logits over the window; ``draft`` [B, K-1] the proposals.
+
+    Greedy rows (temp<=0): draft i survives iff it equals the model's
+    argmax prediction and every earlier draft did — output is token-exact
+    vs step-by-step decode.
+
+    Sampled rows: REJECTION SAMPLING against the processed target
+    distribution p: the prompt-lookup proposal is a delta at the drafted
+    token, so draft d is accepted with probability min(1, p(d)); on the
+    first rejection, the returned next-step logits mask d out, so the next
+    committed sample comes from the residual norm((p - delta_d)+). For
+    pure temperature sampling this makes the output distribution EXACTLY p
+    per position; with top-k/top-p the next step re-filters the masked
+    logits, which can shift the nucleus boundary by one token (documented
+    approximation).
+
+    Returns (n_acc [B], next_logits [B, V])."""
+    B, K, V = logits_all.shape
+    probs = _processed_probs(logits_all[:, :-1], temps, top_ps, top_k)
+    p_draft = jnp.take_along_axis(probs, draft[..., None],
+                                  axis=-1)[..., 0]          # [B, K-1]
+    u = jax.random.uniform(key, draft.shape)
+    greedy_next = jnp.argmax(logits_all[:, :-1], axis=-1).astype(jnp.int32)
+    is_greedy = (temps <= 0.0)[:, None]
+    acc = jnp.where(is_greedy, greedy_next == draft, u < p_draft)
+    acc = acc & active[:, None]
+    accum = jnp.cumprod(acc.astype(jnp.int32), axis=1)
+    n_acc = accum.sum(axis=1).astype(jnp.int32)
+    next_logits = jnp.take_along_axis(
+        logits_all, n_acc[:, None, None], axis=1)[:, 0]
+    rejected = (temps > 0.0) & (n_acc < K - 1) & active
+    rej_tok = jnp.take_along_axis(
+        draft, jnp.clip(n_acc, 0, K - 2)[:, None], axis=1)[:, 0]
+    hit = jax.nn.one_hot(rej_tok, V, dtype=bool)
+    next_logits = jnp.where(rejected[:, None] & hit, -1e30, next_logits)
+    return n_acc, next_logits

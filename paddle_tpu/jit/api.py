@@ -259,7 +259,12 @@ class StaticFunction:
         layers = _find_layers(self._fn, args)
         pnames, params, bnames, buffers = collect_state(layers)
         dyn, static_key, layout, treedef = _split_leaves((args, kwargs))
-        key = (static_key, layout, treedef, tuple(id(p) for p in params))
+        # the autocast policy is part of the program identity: a body (or
+        # captured prefix) traced under one policy bakes its casts in and
+        # must not serve calls under another
+        from ..amp import policy_fingerprint
+        key = (static_key, layout, treedef, tuple(id(p) for p in params),
+               policy_fingerprint())
 
         entry = self._cache.get(key)
         if entry is None:

@@ -28,13 +28,28 @@ through a ``.numpy()``-breaking *training* step differentiates the compiled
 prefix like any other op (reference: SOT compiles training code through
 breaks, jit/sot/opcode_translator/executor/opcode_executor.py:353).
 
-Capture is abandoned — falling back to plain eager — when the prefix draws
-RNG (a compiled replay would freeze the randomness), runs under AMP
-autocast, or never reaches a detectable break. Abandon reasons are counted
-in :func:`capture_stats` so coverage loss is visible.
+**RNG prefixes** (VERDICT r4 #6): a prefix that DRAWS randomness (dropout
+is the common case) is captured with the framework RNG threaded in as a
+program INPUT — replay draws one fresh base key from the global Generator
+per call and the compiled prefix derives every in-prefix key from it via
+``random.provide_key`` (the same mechanism TrainStep uses), so the
+randomness varies call to call instead of freezing at the recorded values.
+The replayed draw SEQUENCE differs from eager (one base-key draw instead
+of N in-prefix draws), which is distribution-equivalent, not bit-equal.
+
+**AMP prefixes**: autocast is part of the capture — replay re-applies
+``_maybe_amp_cast`` per op at trace time and the active policy fingerprint
+is part of the jit cache key, so a program traced under one policy never
+serves another. A policy that CHANGES mid-prefix still abandons.
+
+Capture is abandoned — falling back to plain eager — when the prefix
+never reaches a detectable break (or hits the structural cases below).
+Abandon reasons are counted in :func:`capture_stats` so coverage loss is
+visible.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import weakref
 
@@ -45,14 +60,18 @@ from ..core import tensor as T
 from ..core import random as _random
 
 #: observability: how many captures compiled / why captures were abandoned
-_CAPTURE_STATS = {"captured": 0, "grad_captured": 0, "abandoned": {}}
+_CAPTURE_STATS = {"captured": 0, "grad_captured": 0, "rng_captured": 0,
+                  "amp_captured": 0, "abandoned": {}}
 
 
 def capture_stats() -> dict:
     """Counters for compiled-prefix capture: successful captures (eval and
-    grad-recording) and per-reason abandon counts."""
+    grad-recording; rng_/amp_ count captures whose prefix drew randomness
+    or ran under autocast) and per-reason abandon counts."""
     return {"captured": _CAPTURE_STATS["captured"],
             "grad_captured": _CAPTURE_STATS["grad_captured"],
+            "rng_captured": _CAPTURE_STATS["rng_captured"],
+            "amp_captured": _CAPTURE_STATS["amp_captured"],
             "abandoned": dict(_CAPTURE_STATS["abandoned"])}
 
 
@@ -79,13 +98,23 @@ def _classify(leaves):
     return tuple(layout), tvals, statics
 
 
+def _is_prng_key(v):
+    """Typed jax PRNG key array (what random.next_key returns)."""
+    try:
+        return isinstance(v, jax.Array) and jax.dtypes.issubdtype(
+            v.dtype, jax.dtypes.prng_key)
+    except Exception:
+        return False
+
+
 class _OpRecord:
     __slots__ = ("fn", "name", "treedef", "layout", "statics", "prov",
                  "out_meta", "out_treedef", "out_tpos", "out_others",
-                 "recorded")
+                 "recorded", "rng", "amp", "key_cells", "tainted")
 
     def __init__(self, fn, name, treedef, layout, statics, prov, out_meta,
-                 out_treedef, out_tpos, out_others, recorded=False):
+                 out_treedef, out_tpos, out_others, recorded=False,
+                 rng=False, amp=None, key_cells=()):
         self.fn = fn
         self.name = name
         self.treedef = treedef
@@ -97,6 +126,10 @@ class _OpRecord:
         self.out_tpos = out_tpos      # leaf indices holding tensors
         self.out_others = out_others  # [(leaf index, python value), ...]
         self.recorded = recorded      # op recorded gradients when captured
+        self.rng = rng                # op drew randomness when captured
+        self.amp = amp                # autocast policy fingerprint at capture
+        self.key_cells = key_cells    # fn closure cells holding PRNG keys
+        self.tainted = recorded       # output depends on a trainable input
 
 
 #: constants larger than this are not baked into a prefix (they may vary
@@ -104,28 +137,60 @@ class _OpRecord:
 _MAX_CONST = 1024
 
 
-def _run_records(records, input_vals):
+def _run_records(records, input_vals, rng_key=None):
     """THE prefix execution contract: symbolically replay every recorded op
     against ``input_vals``, returning the per-op tensor-output lists. Shared
     by the compiled forward, the compiled vjp, and the double-grad fwd_fn —
-    one place encodes the provenance wiring."""
+    one place encodes the provenance wiring.
+
+    ``rng_key`` (RNG-drawing prefixes): every in-prefix ``next_key()``
+    derives from this traced base key, so the compiled program's
+    randomness is an INPUT, not a baked constant. The amp cast mirrors
+    eager dispatch's ``_maybe_amp_cast`` — replay traces run under the
+    same ambient policy the cache key pins."""
+    import types
+
+    ctx = _random.provide_key(rng_key) if rng_key is not None \
+        else contextlib.nullcontext()
+    # ops that drew their key BEFORE dispatch (dropout closes over it /
+    # passes it as an arg) get fresh keys derived from a stream disjoint
+    # from provide_key's counter stream
+    key_base = (jax.random.fold_in(rng_key, 0x5EED)
+                if rng_key is not None else None)
     outs = []
-    for r in records:
-        vals, si, pi = [], iter(r.statics), iter(r.prov)
-        for tag in r.layout:
-            if tag == "S":
-                vals.append(next(si))
-            else:
-                p = next(pi)
-                if p[0] == "in":
-                    vals.append(input_vals[p[1]])
-                elif p[0] == "out":
-                    vals.append(outs[p[1]][p[2]])
+    with ctx:
+        for idx, r in enumerate(records):
+            vals, si, pi = [], iter(r.statics), iter(r.prov)
+            for tag in r.layout:
+                if tag == "S":
+                    vals.append(next(si))
                 else:
-                    vals.append(p[1])
-        a, k = jax.tree_util.tree_unflatten(r.treedef, vals)
-        raw = jax.tree_util.tree_leaves(r.fn(*a, **k))
-        outs.append([raw[i] for i in r.out_tpos])
+                    p = next(pi)
+                    if p[0] == "in":
+                        vals.append(input_vals[p[1]])
+                    elif p[0] == "out":
+                        vals.append(outs[p[1]][p[2]])
+                    elif p[0] == "rng":
+                        # arg-position PRNG key: fresh per replay
+                        vals.append(jax.random.fold_in(
+                            key_base, idx * 16 + p[1]))
+                    else:
+                        vals.append(p[1])
+            vals = T._maybe_amp_cast(r.name, vals)
+            a, k = jax.tree_util.tree_unflatten(r.treedef, vals)
+            fn = r.fn
+            if r.key_cells and key_base is not None:
+                # closed-over PRNG keys (dropout's `key = next_key()`):
+                # rebuild the closure with fresh derived keys
+                cells = list(fn.__closure__)
+                for j, ci in enumerate(r.key_cells):
+                    cells[ci] = types.CellType(jax.random.fold_in(
+                        key_base, idx * 16 + 8 + j))
+                fn = types.FunctionType(fn.__code__, fn.__globals__,
+                                        fn.__name__, fn.__defaults__,
+                                        tuple(cells))
+            raw = jax.tree_util.tree_leaves(fn(*a, **k))
+            outs.append([raw[i] for i in r.out_tpos])
     return outs
 
 
@@ -149,13 +214,8 @@ class PrefixRecorder:
                  rng_drew):
         if self.break_found or self.aborted:
             return
-        if rng_drew:
-            self.aborted = "prefix draws RNG"
-            return
-        from ..amp import _state as _amp_state
-        if getattr(_amp_state, "enabled", False):
-            self.aborted = "prefix under AMP autocast"
-            return
+        from ..amp import policy_fingerprint
+        amp_sig = policy_fingerprint()
         layout, tvals, statics = _classify(leaves)
         try:
             for s in statics:
@@ -163,12 +223,30 @@ class PrefixRecorder:
         except TypeError:
             self.aborted = f"unhashable static arg in {name}"
             return
+        # PRNG keys closed over by the op fn (dropout's pre-dispatch draw):
+        # replay substitutes fresh derived keys into these cells
+        key_cells = []
+        for ci, cell in enumerate(getattr(fn, "__closure__", None) or ()):
+            try:
+                if _is_prng_key(cell.cell_contents):
+                    key_cells.append(ci)
+            except ValueError:
+                continue
+        n_rng_args = 0
         tensor_leaves = [l for l in leaves
                          if isinstance(l, (T.Tensor, jax.Array, np.ndarray))]
         prov = []
+        tainted = recorded_grad
         for v, leaf in zip(tvals, tensor_leaves):
+            if _is_prng_key(v) and id(v) not in self._prov:
+                # arg-position PRNG key: drawn fresh per call by design
+                prov.append(("rng", n_rng_args))
+                n_rng_args += 1
+                continue
             p = self._prov.get(id(v))
             trainable = isinstance(leaf, T.Tensor) and not leaf.stop_gradient
+            if trainable:
+                tainted = True
             if p is None:
                 if getattr(v, "size", _MAX_CONST + 1) > _MAX_CONST:
                     self.aborted = f"large unknown-provenance tensor in {name}"
@@ -188,20 +266,29 @@ class PrefixRecorder:
                 # spurious zero grad would let the optimizer apply weight
                 # decay to it.)
                 self.diff_inputs.add(p[1])
-            elif p[0] == "out" and recorded_grad:
-                # the whole-prefix vjp differentiates through EVERY
-                # intermediate; eager would cut gradient flow at a no_grad
-                # producer or a detached (stop_gradient) intermediate — a
-                # mismatch we must not silently compile in
-                if not self.records[p[1]].recorded:
-                    self.aborted = f"no_grad boundary inside prefix ({name})"
-                    return
-                import jax.numpy as jnp
-                if isinstance(leaf, T.Tensor) and leaf.stop_gradient \
-                        and jnp.issubdtype(leaf._value.dtype, jnp.inexact):
-                    self.aborted = \
-                        f"detached intermediate in grad prefix ({name})"
-                    return
+            elif p[0] == "out":
+                producer = self.records[p[1]]
+                if producer.tainted:
+                    tainted = True
+                if recorded_grad:
+                    # the whole-prefix vjp differentiates through EVERY
+                    # intermediate; eager would cut gradient flow at a
+                    # no_grad producer or a detached intermediate — but
+                    # ONLY if that intermediate actually depends on a
+                    # trainable input (integer masks / position ids from
+                    # non-trainable inputs carry no gradient either way)
+                    if not producer.recorded and producer.tainted:
+                        self.aborted = \
+                            f"no_grad boundary inside prefix ({name})"
+                        return
+                    import jax.numpy as jnp
+                    if producer.recorded and isinstance(leaf, T.Tensor) \
+                            and leaf.stop_gradient \
+                            and jnp.issubdtype(leaf._value.dtype,
+                                               jnp.inexact):
+                        self.aborted = \
+                            f"detached intermediate in grad prefix ({name})"
+                        return
             prov.append(p)
         if recorded_grad:
             self.grad_recorded = True
@@ -222,7 +309,10 @@ class PrefixRecorder:
             fn, name, treedef, layout, tuple(statics), tuple(prov),
             tuple((tuple(ov.shape), str(ov.dtype)) for ov in out_vals),
             out_treedef, tuple(out_tpos), tuple(out_others),
-            recorded=recorded_grad))
+            recorded=recorded_grad,
+            rng=rng_drew or bool(key_cells) or n_rng_args > 0,
+            amp=amp_sig, key_cells=tuple(key_cells)))
+        self.records[-1].tainted = tainted
 
     # -- host-read hook ------------------------------------------------------
     def on_host_read(self, value):
@@ -232,6 +322,12 @@ class PrefixRecorder:
 
     def build(self):
         """Compile the prefix program, or return None when capture failed."""
+        if not self.aborted and self.records and \
+                len({r.amp for r in self.records}) > 1:
+            # the autocast policy changed INSIDE the prefix — replay traces
+            # under ONE ambient policy, so a mid-prefix transition can't be
+            # reproduced; fall back to eager
+            self.aborted = "autocast policy changes inside prefix"
         if self.aborted or not self.break_found or not self.records:
             if self.aborted:
                 _count_abandon(self.aborted)
@@ -239,22 +335,27 @@ class PrefixRecorder:
                 _count_abandon("no detectable break")
             return None
         records = list(self.records)
+        uses_rng = any(r.rng for r in records)
+        if uses_rng:
+            _CAPTURE_STATS["rng_captured"] += 1
+        if any(r.amp is not None for r in records):
+            _CAPTURE_STATS["amp_captured"] += 1
 
-        def prefix_fn(input_vals):
-            return _run_records(records, input_vals)
+        def prefix_fn(input_vals, rng_key=None):
+            return _run_records(records, input_vals, rng_key)
 
         if self.grad_recorded:
             # training prefix: ONE jax.vjp over the whole prefix, jitted —
-            # the prefix analog of the dispatch cache's per-op cached vjp
+            # the prefix analog of the eager dispatch cache's cached vjp
             # pair. Replay attaches a single tape node for every output.
             diff_idx = tuple(sorted(self.diff_inputs))
 
-            def fwd(input_vals):
+            def fwd(input_vals, rng_key=None):
                 def closed(*diff_vals):
                     vv = list(input_vals)
                     for p, v in zip(diff_idx, diff_vals):
                         vv[p] = v
-                    return prefix_fn(vv)
+                    return prefix_fn(vv, rng_key)
                 return jax.vjp(closed,
                                *[input_vals[p] for p in diff_idx])
 
@@ -262,13 +363,14 @@ class PrefixRecorder:
             # forward-only variant compiled alongside: eval/no_grad calls on
             # this signature must not materialize the vjp residuals
             return PrefixProgram(jax.jit(fwd), records, diff_idx=diff_idx,
-                                 jitted_fwd=jax.jit(prefix_fn))
+                                 jitted_fwd=jax.jit(prefix_fn),
+                                 uses_rng=uses_rng)
 
         # NOTE: jax.jit is lazy — trace failures surface at the first call,
         # which PrefixProgram.run converts into _ReplayAbandoned so the
         # caller can demote to plain eager instead of crashing
         _CAPTURE_STATS["captured"] += 1
-        return PrefixProgram(jax.jit(prefix_fn), records)
+        return PrefixProgram(jax.jit(prefix_fn), records, uses_rng=uses_rng)
 
 
 class _ReplayAbandoned(Exception):
@@ -283,11 +385,13 @@ class PrefixProgram:
     ``jax.vjp`` pair over the inputs at those positions, and replay builds
     one tape node spanning every prefix output."""
 
-    def __init__(self, jitted, records, diff_idx=None, jitted_fwd=None):
+    def __init__(self, jitted, records, diff_idx=None, jitted_fwd=None,
+                 uses_rng=False):
         self.jitted = jitted
         self.records = records
         self.diff_idx = diff_idx
         self.jitted_fwd = jitted_fwd  # forward-only program (grad prefixes)
+        self.uses_rng = uses_rng      # prefix randomness is a program input
         self.failures = 0
 
     @property
@@ -319,16 +423,21 @@ class PrefixProgram:
         node = None
         parents = self._tape_parents(input_tensors) if self.grad_capable \
             else None
+        # RNG prefixes: ONE fresh base key per replay, drawn from (and
+        # advancing) the global Generator — in-prefix keys derive from it
+        # inside the compiled program, so randomness varies per call
+        rng_key = _random.next_key() if self.uses_rng else None
         try:
             if parents is not None:
-                outs, vjp_obj = self.jitted(input_vals)
-                node = self._make_node(outs, vjp_obj, input_vals, parents)
+                outs, vjp_obj = self.jitted(input_vals, rng_key)
+                node = self._make_node(outs, vjp_obj, input_vals, parents,
+                                       rng_key)
             elif self.grad_capable:
                 # eval / no_grad call on a training-captured signature: the
                 # forward-only program — no vjp residuals materialized
-                outs = self.jitted_fwd(input_vals)
+                outs = self.jitted_fwd(input_vals, rng_key)
             else:
-                outs = self.jitted(input_vals)
+                outs = self.jitted(input_vals, rng_key)
         except Exception as e:  # trace/compile failure (jit is lazy)
             raise _ReplayAbandoned(str(e)) from e
         state = _ReplayState(self.records, outs, input_vals, node=node)
@@ -340,10 +449,11 @@ class PrefixProgram:
             T._capture.replay = saved
         return result, state.diverged
 
-    def _make_node(self, outs, vjp_obj, input_vals, parents):
+    def _make_node(self, outs, vjp_obj, input_vals, parents, rng_key=None):
         """One tape node covering the whole compiled prefix: cotangents for
         every prefix output flow through the cached vjp to the diff inputs
-        (the prefix analog of _dispatch_cached's per-op node)."""
+        (the prefix analog of _dispatch_cached's per-op node). ``rng_key``
+        pins THIS call's randomness for the double-grad fwd_fn."""
         flat, out_treedef = jax.tree_util.tree_flatten(outs)
         out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in flat]
         records, diff_idx = self.records, self.diff_idx
@@ -352,7 +462,7 @@ class PrefixProgram:
             vv = list(input_vals)
             for p, v in zip(diff_idx, diff_vals):
                 vv[p] = v
-            return _run_records(records, vv)
+            return _run_records(records, vv, rng_key)
 
         node = T.Node(functools.partial(T._bwd_call, vjp_obj), parents,
                       out_treedef, out_avals, "compiled_prefix",
@@ -404,6 +514,11 @@ class _ReplayState:
                     return False
             elif p[0] == "out":
                 if v is not self.outs[p[1]][p[2]]:
+                    return False
+            elif p[0] == "rng":
+                # a fresh-drawn PRNG key differs every call by design; the
+                # replayed program derives its own from the base key input
+                if not _is_prng_key(v):
                     return False
             elif not np.array_equal(np.asarray(v), p[1]):
                 return False

@@ -53,8 +53,13 @@ def gpt2_medium(**over):
                                num_attention_heads=16), **over})
 
 
-def _causal_mask(s):
-    m = jnp.where(jnp.arange(s)[None, :] <= jnp.arange(s)[:, None],
+def _causal_mask(s, key_len=None):
+    """[1,1,s,key_len] additive causal mask. With a grown KV cache the
+    query rows sit at absolute positions [key_len-s, key_len) over keys
+    [0, key_len), so row q may see keys k <= (key_len-s)+q."""
+    key_len = s if key_len is None else key_len
+    offset = key_len - s
+    m = jnp.where(jnp.arange(key_len)[None, :] <= offset + jnp.arange(s)[:, None],
                   jnp.float32(0), jnp.float32(-1e30))
     return Tensor(m[None, None])
 
@@ -81,9 +86,11 @@ class GPT2Model(Layer):
         pos = ops.arange(position_offset, position_offset + s, dtype="int64")
         x = self.drop(self.wte(input_ids) + self.wpe(pos))
         if cache is not None:
-            # prefill (s>1, empty cache) still needs causality inside the
-            # window; a single decode token attends the grown cache freely
-            return self.h(x, _causal_mask(s) if s > 1 else None, cache)
+            # multi-token continuation over a grown cache masks against the
+            # absolute key length (cache_len + s); a single decode token
+            # attends the whole grown cache freely
+            mask = _causal_mask(s, position_offset + s) if s > 1 else None
+            return self.h(x, mask, cache)
         return self.h(x, _causal_mask(s))
 
 

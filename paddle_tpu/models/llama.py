@@ -191,19 +191,15 @@ class PagedKVCache:
         self.block_tables, self.seq_lens = block_tables, seq_lens
 
 
-def _sample_logits_device(logits, key, temp_val, top_k, top_p_val, greedy,
-                          use_top_p):
-    """In-graph sampling head: greedy / temperature / top-k / top-p, all
-    computed on device from the framework RNG (reference surface: paddlenlp
-    generation's TopKProcess/TopPProcess, executed host-side there): top-k
-    filter first, then the nucleus mass cut on the renormalized
-    distribution. ``greedy``/``top_k``/``use_top_p`` are STATIC (they shape
-    the program); ``temp_val``/``top_p_val`` are traced scalars, so a
-    serving loop varying them never recompiles."""
-    logits = logits.astype(jnp.float32)
-    if greedy:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temp_val.astype(jnp.float32)
+def _filter_logits(logits, temp_val, top_k, top_p_val, use_top_p=True):
+    """THE temperature/top-k/top-p filter pipeline (temperature scale, then
+    top-k cut, then the nucleus mass cut on the renormalized distribution).
+    Single source shared by the sampler below AND the serving engine's
+    rejection-sampling acceptance (inference/llm_engine.py
+    ``_processed_probs``) — speculative exactness depends on the acceptance
+    testing drafts against exactly the distribution samples are drawn
+    from."""
+    logits = logits.astype(jnp.float32) / temp_val.astype(jnp.float32)
     V = logits.shape[-1]
     if top_k and 0 < int(top_k) < V:
         kth = jax.lax.top_k(logits, int(top_k))[0][..., -1:]
@@ -218,7 +214,22 @@ def _sample_logits_device(logits, key, temp_val, top_k, top_p_val, greedy,
         cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
                          keepdims=True)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def _sample_logits_device(logits, key, temp_val, top_k, top_p_val, greedy,
+                          use_top_p):
+    """In-graph sampling head: greedy / temperature / top-k / top-p, all
+    computed on device from the framework RNG (reference surface: paddlenlp
+    generation's TopKProcess/TopPProcess, executed host-side there).
+    ``greedy``/``top_k``/``use_top_p`` are STATIC (they shape the program);
+    ``temp_val``/``top_p_val`` are traced scalars, so a serving loop varying
+    them never recompiles."""
+    if greedy:
+        return jnp.argmax(logits.astype(jnp.float32),
+                          axis=-1).astype(jnp.int32)
+    filtered = _filter_logits(logits, temp_val, top_k, top_p_val, use_top_p)
+    return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
 
 
 class LlamaAttention(Layer):

@@ -118,7 +118,14 @@ class TransformerEncoderLayer(Layer):
 
 
 class TransformerEncoder(Layer):
-    def __init__(self, encoder_layer, num_layers, norm=None):
+    def __init__(self, encoder_layer, num_layers, norm=None,
+                 use_recompute=False, recompute_layers=None):
+        """``use_recompute``: rematerialize encoder layers during backward
+        (jax.checkpoint with RNG replay). ``recompute_layers`` limits remat
+        to the FIRST k layers — SELECTIVE remat: each rematted layer saves
+        its share of the activation working set but re-pays its forward
+        FLOPs in the backward, so compute-bound models (bert-class) remat
+        only as many layers as the memory headroom requires."""
         super().__init__()
         import copy
         self.layers = LayerList([encoder_layer] +
@@ -126,13 +133,22 @@ class TransformerEncoder(Layer):
                                  for _ in range(num_layers - 1)])
         self.num_layers = num_layers
         self.norm = norm
+        self.use_recompute = bool(use_recompute)
+        self.recompute_layers = (num_layers if recompute_layers is None
+                                 else int(recompute_layers))
 
     def forward(self, src, src_mask=None, cache=None):
         output = src
         new_caches = []
+        remat = self.use_recompute and cache is None and self.training
+        if remat:
+            from ...distributed.fleet.recompute import recompute
         for i, mod in enumerate(self.layers):
             if cache is None:
-                output = mod(output, src_mask)
+                if remat and i < self.recompute_layers:
+                    output = recompute(mod, output, src_mask)
+                else:
+                    output = mod(output, src_mask)
             else:
                 output, c = mod(output, src_mask, cache[i])
                 new_caches.append(c)

@@ -109,9 +109,33 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
             if use_pallas:
                 # fused dequant-matmul: packed bytes stream straight to the
                 # MXU with in-register nibble extraction (halves int8's
-                # weight traffic; ~1.4x its decode GEMM on v5e)
+                # weight traffic; ~1.4x its decode GEMM on v5e). The kernel
+                # has no VJP of its own, so a custom_vjp supplies the
+                # x-gradient via the split-nibble dequant matmul (small-
+                # batch fine-tune/eval graphs differentiate through this).
+                @jax.custom_vjp
+                def _mm(x2d):
+                    return int4_matmul(x2d, q, s)
+
+                def _mm_fwd(x2d):
+                    return _mm(x2d), None
+
+                def _mm_bwd(_, dy):
+                    low, high = _nibbles(q)
+                    sd = s.astype(dy.dtype)
+                    dxe = jnp.matmul(dy, (low.astype(dy.dtype)
+                                          * sd[None, :]).T)
+                    dxo = jnp.matmul(dy, (high.astype(dy.dtype)
+                                          * sd[None, :]).T)
+                    # W rows interleave low/high nibbles: dx[2i]=dxe[i],
+                    # dx[2i+1]=dxo[i], truncated to odd in_features
+                    dx = jnp.stack([dxe, dxo], axis=-1).reshape(
+                        dy.shape[:-1] + (2 * low.shape[0],))[..., :n_in]
+                    return (dx,)
+
+                _mm.defvjp(_mm_fwd, _mm_bwd)
                 lead = xv.shape[:-1]
-                y = int4_matmul(xv.reshape(-1, n_in), q, s)
+                y = _mm(xv.reshape(-1, n_in))
                 y = y.reshape(lead + (q.shape[-1],))
             else:
                 low, high = _nibbles(q)

@@ -128,6 +128,16 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
                 name=None):
     xv = np.asarray(x._value)
     wv = None if weights is None else np.asarray(weights._value)
+    if ranges is not None:
+        # paddle's `ranges` is a FLAT list of 2*D floats; numpy wants one
+        # (lo, hi) pair per dimension
+        flat = list(ranges)
+        if len(flat) != 2 * xv.shape[-1]:
+            raise ValueError(
+                f"ranges must hold 2 floats per dimension "
+                f"({2 * xv.shape[-1]}), got {len(flat)}")
+        ranges = [(flat[2 * i], flat[2 * i + 1])
+                  for i in range(xv.shape[-1])]
     hist, edges = np.histogramdd(xv, bins=bins, range=ranges, density=density,
                                  weights=wv)
     return to_tensor(hist), [to_tensor(e) for e in edges]
@@ -150,12 +160,36 @@ def trapezoid(y, x=None, dx=None, axis=-1, name=None):
 
 
 def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
-    import scipy.integrate as si
-    yv = np.asarray(y._value)
-    xv = None if x is None else np.asarray(x._value)
-    out = si.cumulative_trapezoid(yv, x=xv, dx=dx if dx is not None else 1.0,
-                                  axis=axis)
-    return to_tensor(np.asarray(out, dtype=yv.dtype))
+    """Differentiable/jittable (cumsum of pairwise trapezoid areas) — the
+    scipy host path would carry no tape and break to_static."""
+    step = 1.0 if dx is None else float(dx)
+
+    def _sl(v, sl, ax):
+        idx = [slice(None)] * v.ndim
+        idx[ax] = sl
+        return v[tuple(idx)]
+
+    if x is not None:
+        def fn(yv, xv):
+            ax = axis % yv.ndim
+            if xv.ndim == yv.ndim:
+                d = jnp.diff(xv.astype(yv.dtype), axis=ax)
+            else:
+                # 1-D sample points apply along `ax`: reshape so the
+                # broadcast lands on that axis, not the trailing one
+                d = jnp.diff(xv.astype(yv.dtype)).reshape(
+                    [-1 if i == ax else 1 for i in range(yv.ndim)])
+            pair = (_sl(yv, slice(1, None), ax)
+                    + _sl(yv, slice(None, -1), ax)) / 2
+            return jnp.cumsum(pair * d, axis=ax)
+        return dispatch(fn, (y, x), {}, name="cumulative_trapezoid")
+
+    def fn(yv):
+        ax = axis % yv.ndim
+        pair = (_sl(yv, slice(1, None), ax)
+                + _sl(yv, slice(None, -1), ax)) / 2
+        return jnp.cumsum(pair * jnp.asarray(step, yv.dtype), axis=ax)
+    return dispatch(fn, (y,), {}, name="cumulative_trapezoid")
 
 
 def vander(x, n=None, increasing=False, name=None):

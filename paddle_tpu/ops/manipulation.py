@@ -268,6 +268,12 @@ def index_add(x, index, axis, value):
 
 
 def index_put(x, indices, value, accumulate=False):
+    if isinstance(indices, (Tensor, jnp.ndarray, np.ndarray)):
+        # a single advanced index (torch/paddle accept the bare form);
+        # tuple(tensor) would spin forever — jnp __getitem__ clamps
+        # out-of-range rows instead of raising IndexError
+        indices = (indices,)
+
     def fn(v, idx_tuple, val):
         at = v.at[tuple(idx_tuple)]
         return at.add(val) if accumulate else at.set(val)

@@ -1,0 +1,129 @@
+"""Per-op tolerance overrides for the registry sweep (test_op_sweep.py).
+
+Reference analog: test/white_list/op_accuracy_white_list.py — documented
+per-op max_relative_error exceptions instead of a loosened global default.
+Every entry must carry a reason. Keys are sweep op names; values override
+the tier defaults (fp32: rtol=1e-5/atol=1e-5; bf16: rtol=2e-2/atol=2e-2;
+grad: rtol=5e-3/atol=1e-4).
+"""
+
+TOL_OVERRIDES = {
+    # -- transcendentals whose fp32 kernel error is legitimately above 1e-5
+    "erfinv": dict(rtol=1e-4, grad_rtol=2e-2,
+                   reason="inverse-erf series: fp32 kernel ~1e-5 ULP blowup "
+                          "near |x|->1; grad 1/erf'(erfinv) amplifies it"),
+    "digamma": dict(grad_rtol=2e-2,
+                    reason="polygamma(1) via series; fp32 tail truncation"),
+    "lgamma": dict(grad_rtol=1e-2, reason="grad is digamma (series)"),
+    "polygamma": dict(rtol=1e-4, grad=False,
+                      reason="higher-order series; grad not exposed"),
+    "i0": dict(grad_rtol=1e-2, reason="Bessel series truncation in grad"),
+    "i0e": dict(rtol=1e-4, grad_rtol=1e-2, reason="scaled-Bessel series"),
+    "i1": dict(grad_rtol=1e-2, reason="Bessel series truncation in grad"),
+    "i1e": dict(rtol=1e-4, grad_rtol=1e-2, reason="scaled-Bessel series"),
+    "tan": dict(grad_rtol=1e-2,
+                reason="1/cos^2 amplification away from 0"),
+    "atanh": dict(grad_rtol=1e-2, reason="1/(1-x^2) pole amplification"),
+    "acos": dict(grad_rtol=2e-2, reason="1/sqrt(1-x^2) pole amplification"),
+    "asin": dict(grad_rtol=2e-2, reason="1/sqrt(1-x^2) pole amplification"),
+    "acosh": dict(grad_rtol=1e-2, reason="1/sqrt(x^2-1) pole near 1"),
+    "erf": dict(grad_rtol=1e-2, reason="exp(-x^2) tail in fp32"),
+    "expm1": dict(grad_rtol=1e-2, reason="exp near 0 cancellation"),
+    "stanh": dict(grad_rtol=1e-2, reason="scaled tanh saturation tails"),
+    "logit": dict(grad_rtol=1e-2, reason="1/(x(1-x)) pole amplification"),
+    "sinc": dict(grad_rtol=2e-2, reason="removable singularity at 0"),
+    "gammaln": dict(grad_rtol=1e-2, reason="grad is digamma (series)"),
+    "lerp": dict(grad_rtol=1e-2, reason="cancellation in (y-x) for close "
+                                        "operands in fp32"),
+    "rsqrt": dict(grad_rtol=1e-2, reason="x^-1.5 amplification near 0"),
+    # -- matmul-class: bf16 accumulates K products; fp32 tier is fine
+    "matmul": dict(bf16_rtol=6e-2, reason="K-dim accumulation in bf16"),
+    "mm": dict(bf16_rtol=6e-2, reason="K-dim accumulation in bf16"),
+    "bmm": dict(bf16_rtol=6e-2, reason="K-dim accumulation in bf16"),
+    "inner": dict(bf16_rtol=6e-2, reason="K-dim accumulation in bf16"),
+    "mv": dict(bf16_rtol=6e-2, reason="K-dim accumulation in bf16"),
+    "dot": dict(bf16_rtol=6e-2, reason="K-dim accumulation in bf16"),
+    "matrix_power": dict(bf16_rtol=1e-1, grad_rtol=1e-2,
+                         reason="repeated matmul error growth"),
+    "multi_dot": dict(bf16_rtol=6e-2, reason="chained matmul accumulation"),
+    "tensordot": dict(bf16_rtol=6e-2, reason="contraction accumulation"),
+    "einsum": dict(bf16_rtol=6e-2, reason="contraction accumulation"),
+    "addmm": dict(bf16_rtol=6e-2, reason="matmul accumulation"),
+    "kron": dict(bf16_rtol=4e-2, reason="product magnitudes span bf16 ulp"),
+    "outer": dict(bf16_rtol=4e-2, reason="product magnitudes span bf16 ulp"),
+    "cdist": dict(grad_rtol=1e-2, bf16_rtol=6e-2,
+                  reason="sqrt of accumulated squares; bf16 accumulation"),
+    "pdist": dict(grad_rtol=1e-2, bf16_rtol=6e-2,
+                  reason="sqrt of accumulated squares; bf16 accumulation"),
+    "dist": dict(grad_rtol=1e-2, reason="norm root amplifies near-ties"),
+    "renorm": dict(grad_rtol=1e-2, reason="norm-root chain rule"),
+    # -- reductions: bf16 running sums
+    "logsumexp": dict(grad_rtol=1e-2, reason="softmax-weighted grad ties"),
+    "logcumsumexp": dict(grad_rtol=1e-2, bf16_rtol=4e-2,
+                         reason="cumulative log-sum-exp accumulation"),
+    "cumprod": dict(grad_rtol=1e-2, bf16_rtol=6e-2,
+                    reason="product chains amplify relative error"),
+    "prod": dict(grad_rtol=1e-2, bf16_rtol=6e-2,
+                 reason="product chains amplify relative error"),
+    "std": dict(grad_rtol=1e-2, reason="sqrt of var cancellation"),
+    "var": dict(grad_rtol=1e-2, reason="mean-subtraction cancellation"),
+    "nanquantile": dict(grad=False, reason="interpolation weights are "
+                                           "order-statistic selections"),
+    "quantile": dict(grad=False, reason="interpolation weights are "
+                                        "order-statistic selections"),
+    "corrcoef": dict(grad=False, bf16_rtol=6e-2,
+                     reason="normalized covariance: numeric grad unstable "
+                            "under row-wise normalization"),
+    "cov": dict(grad_rtol=1e-2, bf16_rtol=6e-2,
+                reason="mean-subtraction cancellation"),
+    "trapezoid": dict(grad_rtol=1e-2, reason="endpoint weighting"),
+    # -- linalg decompositions
+    "cholesky": dict(grad_rtol=2e-2, bf16=False,
+                     reason="triangular back-substitution error growth; "
+                            "bf16 SPD factorization not supported tier"),
+    "cholesky_solve": dict(grad=False, bf16=False,
+                           reason="solve conditioning; bf16 unsupported"),
+    "triangular_solve": dict(grad_rtol=2e-2, bf16=False,
+                             reason="back-substitution error growth"),
+    "solve": dict(grad_rtol=2e-2, bf16=False,
+                  reason="LU conditioning; bf16 unsupported tier"),
+    "inv": dict(grad_rtol=2e-2, bf16=False, rtol=1e-4,
+                reason="conditioning; bf16 unsupported tier"),
+    "inverse": dict(grad_rtol=2e-2, bf16=False, rtol=1e-4,
+                    reason="conditioning; bf16 unsupported tier"),
+    "pinv": dict(grad=False, bf16=False, rtol=1e-4,
+                 reason="SVD-based; subgradient at repeated singulars"),
+    "det": dict(grad_rtol=2e-2, bf16=False, reason="LU product error"),
+    "slogdet": dict(grad_rtol=2e-2, bf16=False, reason="LU product error"),
+    "matrix_exp": dict(grad=False, bf16=False, rtol=1e-4,
+                       reason="Pade/scaling-squaring truncation"),
+    "matrix_rank": dict(grad=False, bf16=False,
+                        reason="integer output of SVD thresholding"),
+    "cond": dict(grad=False, bf16=False, reason="singular-value ratio"),
+    "eigvalsh": dict(grad=False, bf16=False,
+                     reason="eigenvalue ordering ties under perturbation"),
+    "eigh": dict(grad=False, bf16=False,
+                 reason="eigenvector sign/phase ambiguity"),
+    "svdvals": dict(grad=False, bf16=False,
+                    reason="singular-value ties under perturbation"),
+    "norm": dict(grad_rtol=1e-2, reason="root of accumulated squares"),
+    "vector_norm": dict(grad_rtol=1e-2, reason="root of accumulated "
+                                               "squares"),
+    "matrix_norm": dict(grad_rtol=1e-2, reason="root of accumulated "
+                                               "squares"),
+    "householder_product": dict(grad=False, bf16=False,
+                                reason="reflector composition error"),
+    # -- misc
+    "nanmedian": dict(grad=False, reason="order-statistic selection"),
+    "median": dict(grad=False, reason="order-statistic selection"),
+    "kthvalue": dict(grad=False, reason="order-statistic selection"),
+    "mode": dict(grad=False, reason="order-statistic selection"),
+    "heaviside": dict(grad=False, reason="step function"),
+    "frac": dict(grad_rtol=1e-2, reason="nondifferentiable at integers; "
+                                        "inputs kept away from them"),
+    "gammainc": dict(grad=False, rtol=1e-4,
+                     reason="regularized incomplete gamma series"),
+    "gammaincc": dict(grad=False, rtol=1e-4,
+                      reason="regularized incomplete gamma series"),
+    "multigammaln": dict(grad_rtol=1e-2, reason="sum of lgamma series"),
+}

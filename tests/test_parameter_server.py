@@ -359,6 +359,36 @@ def test_native_load_replaces_and_push_validates(tmp_path):
             s.stop()
 
 
+def test_native_push_unknown_table_is_attributable():
+    """A raw PUSH to a table that doesn't exist must get an error REPLY
+    (the wire carries grad_dim so the server can drain), and the
+    connection must survive for the next request — not drop with an
+    opaque ConnectionError (ADVICE r4, csrc/ps_table.cc)."""
+    import struct
+    server = ps.NativePSServer()
+    client = ps.NativePSClient([server.endpoint])
+    try:
+        client.create_table("real", 4, lr=1.0)
+        conn = client._conn(0)
+        payload = (struct.pack(">QI", 1, 4)
+                   + np.asarray([7], np.int64).tobytes()
+                   + np.ones(4, np.float32).tobytes())
+        with pytest.raises(RuntimeError, match="no such table"):
+            conn.request(3, "ghost", payload)  # _OP_PUSH
+        # width mismatch on a REAL table is also a reply, not a drop
+        with pytest.raises(RuntimeError, match="dim mismatch"):
+            conn.request(3, "real", struct.pack(">QI", 1, 6)
+                         + np.asarray([7], np.int64).tobytes()
+                         + np.ones(6, np.float32).tobytes())
+        # same connection still serves correct traffic
+        client.push_sparse("real", np.asarray([7]),
+                           np.ones((1, 4), np.float32))
+        assert client.stats("real")["rows"] >= 1
+    finally:
+        client.close()
+        server.stop()
+
+
 def test_rpc_save_load_keeps_optimizer_state(single_node, tmp_path):
     client = single_node
     client.create_table("ada", 4, optimizer="adagrad", lr=1.0)

@@ -1,0 +1,96 @@
+"""Parser contract for utils/roofline.py (the conv-roofline artifact's
+foundation): HLO cost extraction must handle tuple-typed multi-output
+fusions, nested layouts, valid-pair conv FLOP counting (padding/dilation
+zeros excluded), and VMEM (S(1)) byte exclusion."""
+import numpy as np
+import pytest
+
+from paddle_tpu.utils.roofline import (parse_hlo_costs, _split_instr,
+                                       _conv_flops, roofline_table)
+
+_HLO = """HloModule test, is_scheduled=true
+
+%fused_computation.1 (param_0.1: bf16[8,56,56,64], param_1.1: bf16[3,3,64,64]) -> bf16[8,56,56,64] {
+  %param_0.1 = bf16[8,56,56,64]{3,0,2,1:T(8,128)(2,1)} parameter(0)
+  %param_1.1 = bf16[3,3,64,64]{3,2,1,0:T(8,128)(2,1)} parameter(1)
+  ROOT %conv.1 = bf16[8,56,56,64]{3,0,2,1:T(8,128)(2,1)} convolution(%param_0.1, %param_1.1), window={size=3x3 pad=1_1x1_1}, dim_labels=b01f_01io->b01f
+}
+
+%fused_computation.2 (param_0.2: bf16[8,56,56,64]) -> (bf16[8,56,56,64], f32[64]) {
+  %param_0.2 = bf16[8,56,56,64]{3,0,2,1:T(8,128)(2,1)} parameter(0)
+  %neg.1 = bf16[8,56,56,64]{3,0,2,1:T(8,128)(2,1)} negate(%param_0.2)
+  %red.1 = f32[64]{0:T(256)} constant(0)
+  ROOT %tup = (bf16[8,56,56,64]{3,0,2,1:T(8,128)(2,1)}, f32[64]{0:T(256)}) tuple(%neg.1, %red.1)
+}
+
+ENTRY %main (p0: bf16[8,56,56,64], p1: bf16[3,3,64,64]) -> bf16[8,56,56,64] {
+  %p0 = bf16[8,56,56,64]{3,0,2,1:T(8,128)(2,1)} parameter(0)
+  %p1 = bf16[3,3,64,64]{3,2,1,0:T(8,128)(2,1)S(1)} parameter(1)
+  %fusion.1 = bf16[8,56,56,64]{3,0,2,1:T(8,128)(2,1)} fusion(%p0, %p1), kind=kOutput, calls=%fused_computation.1
+  ROOT %fusion.2 = (bf16[8,56,56,64]{3,0,2,1:T(8,128)(2,1)}, f32[64]{0:T(256)}) fusion(%fusion.1), kind=kLoop, calls=%fused_computation.2
+}
+"""
+
+
+def test_tuple_typed_instruction_parses():
+    parsed = _split_instr(
+        "  ROOT %t = (bf16[2,2]{1,0:T(8,128)(2,1)}, f32[4]{0:T(256)}) "
+        "tuple(%a, %b)")
+    assert parsed is not None
+    name, type_str, op, rest = parsed
+    assert name == "t" and op == "tuple"
+    assert "bf16[2,2]" in type_str and "f32[4]" in type_str
+
+
+def test_conv_flops_same_padding():
+    costs = parse_hlo_costs(_HLO)
+    c = costs["fusion.1"]
+    assert c["kind"] == "conv"
+    # SAME 3x3 over 56x56: interior outputs see 9 taps, borders fewer.
+    # valid pairs per dim = 56*3 - 2 = 166 -> flops = 2*8*64*64*166*166
+    assert c["flops"] == 2 * 8 * 64 * 64 * 166 * 166
+
+
+def test_vmem_operand_bytes_excluded():
+    costs = parse_hlo_costs(_HLO)
+    c = costs["fusion.1"]
+    # p1 lives in S(1): its 73728 bytes are NOT HBM traffic of the fusion
+    act = 8 * 56 * 56 * 64 * 2
+    assert c["bytes"] == 2 * act          # read p0 + write result
+    assert c["vmem_bytes"] == 3 * 3 * 64 * 64 * 2
+
+
+def test_multi_output_fusion_bytes():
+    costs = parse_hlo_costs(_HLO)
+    c = costs["fusion.2"]
+    act = 8 * 56 * 56 * 64 * 2
+    assert c["bytes"] == act + (act + 64 * 4)  # operand + tuple result
+
+
+def test_dilated_backward_conv_counts_valid_taps_only():
+    hlo = """HloModule t, is_scheduled=true
+
+ENTRY %main (a: bf16[8,56,56,256], w: bf16[512,256,1,1]) -> bf16[8,56,56,256] {
+  %a = bf16[8,56,56,256]{3,2,1,0:T(8,128)(2,1)} parameter(0)
+  %w = bf16[512,256,1,1]{3,2,1,0:T(8,128)(2,1)} parameter(1)
+  ROOT %c = bf16[8,56,56,256]{3,2,1,0:T(8,128)(2,1)} convolution(%a, %w), window={size=1x1 pad=0_1x0_1 lhs_dilate=2x2}, dim_labels=b01f_io01->b01f
+}
+"""
+    costs = parse_hlo_costs(hlo)
+    c = costs["c"]
+    # lhs_dilate=2: only even positions map to real input -> 28 of 56
+    # outputs per dim do real math; reduction feature dim i = rhs[0] = 512
+    assert c["flops"] == 2 * 8 * 256 * 512 * 28 * 28
+
+
+def test_roofline_table_joins_events():
+    ev = {"fusion.1": {"count": 4, "total_us": 4000.0},
+          "fusion.2": {"count": 4, "total_us": 2000.0},
+          "unknown.3": {"count": 4, "total_us": 400.0}}
+    rows, unmatched = roofline_table(_HLO, ev, 4, 197e12, 800e9)
+    assert unmatched == pytest.approx(100.0)
+    byname = {r["name"]: r for r in rows}
+    assert byname["fusion.1"]["kind"] == "conv"
+    assert byname["fusion.1"]["roofline_eff"] is not None
+    assert byname["fusion.2"]["kind"] == "other"
+    assert rows[0]["time_us"] >= rows[-1]["time_us"]

@@ -1,0 +1,93 @@
+"""Cross-process TP SERVING worker (VERDICT r4 #9): two launcher-spawned
+processes x two local CPU devices form one 4-device mp mesh; the LLMEngine's
+chunked-prefill and decode programs run SPMD with the TP all-reduce groups
+spanning the process boundary. Greedy outputs must match the single-process
+engine run of the identical model (parity asserted by
+tests/test_multiprocess_dp.py::test_cross_process_engine_tp_serve).
+
+argv: out_path
+Env: PT_LOCAL_DEVICES (default 2). The single-process parity reference runs
+this same script with PT_LOCAL_DEVICES=4 and no launcher.
+"""
+import json
+import os
+import re
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+ndev = os.environ.get("PT_LOCAL_DEVICES", "2")
+os.environ["XLA_FLAGS"] = \
+    (flags + f" --xla_force_host_platform_device_count={ndev}").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                     llama_tp_spec)
+
+
+def main():
+    out = sys.argv[1]
+
+    dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+    total = jax.device_count()
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": total,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    mesh = hcg.mesh.jax_mesh()
+
+    if world > 1:
+        assert total == world * jax.local_device_count(), total
+        assert total > jax.local_device_count(), "TP group is process-local"
+
+    paddle.seed(7)
+    cfg = LlamaConfig(vocab_size=12 * total, hidden_size=8 * total,
+                      intermediate_size=8 * total, num_hidden_layers=2,
+                      num_attention_heads=total, num_key_value_heads=total,
+                      max_position_embeddings=64)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    if world > 1:
+        from jax.sharding import NamedSharding
+        # every process materialized identical params (same seed); lay them
+        # out TP-sharded on the GLOBAL mesh (make_array: each process
+        # contributes its addressable shards)
+        for pname, p in model.named_parameters():
+            host = np.asarray(p._value)
+            sharding = NamedSharding(mesh, llama_tp_spec(pname))
+            p._value = jax.make_array_from_callback(
+                host.shape, sharding, lambda idx, h=host: h[idx])
+        eng = LLMEngine(model, max_batch=2, max_seq_len=32, chunk_size=8,
+                        mesh=mesh)
+    else:
+        eng = LLMEngine(model, max_batch=2, max_seq_len=32, chunk_size=8)
+
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, cfg.vocab_size, size=(5,)).astype(np.int32),
+               rng.integers(1, cfg.vocab_size, size=(3,)).astype(np.int32)]
+    outs = eng.generate(prompts, max_new_tokens=4)
+    tokens = [o.token_ids for o in outs]
+
+    if rank == 0:
+        with open(out, "w") as f:
+            json.dump({"tokens": tokens, "world": world, "devices": total},
+                      f)
+
+
+if __name__ == "__main__":
+    main()

@@ -87,6 +87,14 @@ def main():
                                    "sep_degree": 1}
         strategy.pipeline_configs = {"accumulate_steps": 4,
                                      "schedule_mode": "1F1B"}
+    elif mode == "dp_mp":
+        # VERDICT r4 #9: dp x tp COMPOSED across processes. At 4 procs x 2
+        # local devices (8 global): mp groups of 4 = {0..3},{4..7} each span
+        # two processes, dp groups of 2 = {i, i+4} span two others — BOTH
+        # reduction axes cross process boundaries in one program.
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": total // 2,
+                                   "pp_degree": 1, "sharding_degree": 1,
+                                   "sep_degree": 1}
     else:
         raise SystemExit(f"unknown mode {mode!r}")
     fleet.init(is_collective=True, strategy=strategy)
@@ -102,11 +110,12 @@ def main():
     rng = np.random.default_rng(11)
     losses = []
 
-    if mode == "tp":
+    if mode in ("tp", "dp_mp"):
         model = TPBlock()
         optimizer = opt_mod.AdamW(learning_rate=1e-2,
                                   parameters=model.parameters())
-        step = TrainStep(model, lambda m, x, y: F.mse_loss(m(x), y),
+        target = model if mode == "tp" else fleet.distributed_model(model)
+        step = TrainStep(target, lambda m, x, y: F.mse_loss(m(x), y),
                          optimizer)
         for _ in range(steps):
             x = paddle.to_tensor(
