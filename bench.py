@@ -2,9 +2,9 @@
 
 Default (`BENCH_MODEL` unset / `all`): runs every BASELINE.md config plus
 the decode and serving benchmarks — resnet50, bert, vit, unet, llama_decode,
-llama_serve, then the flagship llama LAST — each in its own subprocess, one
-JSON line each, so the tail line stays the llama MFU vs the 45% north star
-(BASELINE.json).
+llama_serve, llama_serve_spec, then the flagship llama LAST — each in its
+own subprocess, one JSON line each, so the tail line stays the llama MFU vs
+the 45% north star (BASELINE.json).
 `BENCH_MODEL=llama` (or any single name) prints exactly one line.
 
 The flagship line measures the fused compiled training step (fwd+bwd+AdamW,
@@ -99,6 +99,13 @@ def _bench_other(model_name):
     import paddle_tpu.nn.functional as F
     from paddle_tpu.jit.api import TrainStep
 
+    if os.environ.get("BENCH_PRNG"):
+        # 'rbg' = XLA's rng-bit-generator: hardware-rate random bits vs
+        # threefry's VPU integer chains — the lever for dropout-mask cost
+        # on elementwise dropout sites (distribution-identical, different
+        # stream)
+        jax.config.update("jax_default_prng_impl",
+                          os.environ["BENCH_PRNG"])
     steps = int(os.environ.get("BENCH_STEPS", "8"))
     rng = np.random.default_rng(0)
     paddle.seed(0)
@@ -133,16 +140,22 @@ def _bench_other(model_name):
 
     if model_name == "bert":
         from paddle_tpu.models import BertConfig, BertForMaskedLM
-        # defaults = best measured config (round-4 sweep, 24-step runs):
-        # B=96 -> 50.5% MFU / 124k tok/s (was 38.4 at B=24). The lever is
-        # batch: per-step compute amortizes weight+optimizer streaming and
-        # the per-layer dropout-mask RNG. bf16 AdamW moments measured
-        # neutral here (134M params). Curve: 24/38.4, 48/40.2, 96/50.5,
-        # 112+/OOM (no-remat activation working set; B=144 wants 34.4G).
-        # The edge configs compile-OOM nondeterministically (the remote
-        # compiler's fusion choices vary run to run: B=96 measured 50.5%
-        # one run, 16.8G-OOM at B=80 another) — so the bench LADDERS down
-        # until a batch compiles, keeping the driver line reliable.
+        # Round-5 sweep (24-step runs), all at rbg dropout masks (+2.6 MFU
+        # over threefry — hardware rng-bit-generator vs VPU integer
+        # chains): 48/42.0 STABLE, 64/37.0 (spilling schedule), 96/~52
+        # WHEN it compiles — the no-remat B=96 program OOMs
+        # nondeterministically under remote-compiler fusion variance, so
+        # the bench LADDERS 96 -> 48 -> 24. The alternatives were
+        # measured and rejected: full remat costs exactly the +1/3
+        # recompute FLOPs on this compute-bound model (50.7 -> 38.0
+        # dropout-free), dots_saveable remat still OOMs at 96 (keeps the
+        # dot outputs) and only adds cost at 48 (30.9), and the chunked
+        # fused head compiles B=96 DETERMINISTICALLY but its +23% head
+        # FLOPs land at a stable 34.8 — worse than the 48-rung
+        # (BENCH_CHUNKED_HEAD=1 to opt in; it remains the right tool for
+        # larger-vocab models).
+        if "BENCH_PRNG" not in os.environ:
+            jax.config.update("jax_default_prng_impl", "rbg")
         B = int(os.environ.get("BENCH_BATCH", "96"))
         S = int(os.environ.get("BENCH_SEQ", "512"))
         cfg = BertConfig(
@@ -154,8 +167,11 @@ def _bench_other(model_name):
             # the whole +1/3 step FLOPs (measured 50.7 -> 38.0% MFU); a few
             # rematted layers shave just the compile-time temp peak that
             # made no-remat B=96 OOM nondeterministically
-            use_recompute=os.environ.get("BENCH_REMAT", "1") == "1",
-            recompute_layers=int(os.environ.get("BENCH_REMAT_LAYERS", "3")))
+            use_recompute=os.environ.get("BENCH_REMAT", "0") == "1",
+            recompute_layers=int(os.environ.get("BENCH_REMAT_LAYERS", "12")),
+            recompute_policy=os.environ.get("BENCH_REMAT_POLICY") or None,
+            fuse_mlm_head_ce=os.environ.get("BENCH_CHUNKED_HEAD",
+                                            "0") == "1")
         if os.environ.get("BENCH_BF16_MOMENTS", "1") == "1":
             # same lever as the vit config: AdamW moment traffic in bf16
             from paddle_tpu.core.flags import set_flags
@@ -204,7 +220,9 @@ def _bench_other(model_name):
                     "value": round(toks, 1), "unit": "tokens/s",
                     "vs_baseline": None, "mfu_pct": round(mfu * 100, 2),
                     "step_time_s": round(dt, 4), "params": n_params,
-                    "batch": B_try, "loss": loss}
+                    "batch": B_try,
+                    "prng": os.environ.get("BENCH_PRNG", "rbg"),
+                    "loss": loss}
         raise last_err
 
     if model_name == "vit":
@@ -373,7 +391,7 @@ def _bench_other(model_name):
                 "weight_dtype": weight_dtype or "bf16",
                 "params": n_params}
 
-    if model_name == "llama_serve":
+    if model_name in ("llama_serve", "llama_serve_spec"):
         # continuous-batching engine (inference/llm_engine.py): mixed-length
         # requests through fixed slots, chunked prefill, per-step host
         # transfer = one [B] token vector. Unlike llama_decode's fully
@@ -382,9 +400,18 @@ def _bench_other(model_name):
         # compute-bound.
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
         from paddle_tpu.inference import LLMEngine
-        B = int(os.environ.get("BENCH_BATCH", "8"))
+        # speculation's regime is LATENCY-bound serving: at batch 1 the
+        # 6-token verify window streams the same weights as a 1-token step,
+        # so accepted drafts are nearly free (measured B=1: spec 54.7 vs
+        # plain 38.5 tok/s, +42%). At batch 8 decode is already
+        # weight-amortized and the extra verify positions make spec a
+        # wash-to-loss (measured h=1: 34.0 vs 34.5; h=8: 204 vs 1135) —
+        # so the spec line benches batch 1 by default.
+        spec_mode = model_name == "llama_serve_spec"
+        B = int(os.environ.get("BENCH_BATCH", "1" if spec_mode else "8"))
         new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
-        n_req = int(os.environ.get("BENCH_REQUESTS", str(2 * B)))
+        n_req = int(os.environ.get("BENCH_REQUESTS",
+                                   "3" if spec_mode else str(2 * B)))
         n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
         hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
         ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
@@ -402,30 +429,74 @@ def _bench_other(model_name):
         if weight_dtype:
             from paddle_tpu.nn.quant import quantize_linears_for_inference
             quantize_linears_for_inference(model, weight_dtype=weight_dtype)
-        horizon = int(os.environ.get("BENCH_HORIZON", "32"))
+        # horizon 64 ~= one step per request generation (new_tokens=64):
+        # through the tunnel each step() costs one RTT, so tokens/s scales
+        # ~linearly in horizon up to the point admissions coarsen
+        spec_default = "6" if model_name == "llama_serve_spec" else "1"
+        spec_k = int(os.environ.get("BENCH_SPEC_K", spec_default))
+        # spec windows compose with horizon: 8 windows x up to 6 tokens
+        # lands near the plain path's 64-token step granularity
+        horizon = int(os.environ.get("BENCH_HORIZON",
+                                     "8" if spec_k > 1 else "64"))
         eng = LLMEngine(model, max_batch=B, max_seq_len=cap, chunk_size=256,
-                        horizon=horizon)
-        lens = [256 + int(x) for x in
-                rng.integers(0, 256, size=n_req)]  # mixed prompts
-        prompts = [rng.integers(0, cfg.vocab_size, (L,)).astype(np.int32)
-                   for L in lens]
-        # warm both programs (prefill + step) outside the timed window
+                        horizon=horizon, speculative_k=spec_k)
+        if spec_k > 1:
+            # repetition-heavy prompts: the workload where prompt-lookup
+            # drafts actually accept (greedy continuations loop)
+            prompts = []
+            for i in range(n_req):
+                base = rng.integers(0, cfg.vocab_size, (24,)).astype(np.int32)
+                want = 256 + int(rng.integers(0, 128))
+                reps = -(-want // len(base))  # tile past the target length
+                prompts.append(np.tile(base, reps)[:want])
+        else:
+            lens = [256 + int(x) for x in
+                    rng.integers(0, 256, size=n_req)]  # mixed prompts
+            prompts = [rng.integers(0, cfg.vocab_size, (L,)).astype(np.int32)
+                       for L in lens]
+        # warm the programs (prefill + step) outside the timed window
         eng.generate([prompts[0]], max_new_tokens=2)
+        # tunnel RTT estimate: a scalar fetch of resident device data
+        # (VERDICT r4 #5 — split serve wall into RTT vs device compute)
+        rtts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(np.asarray(eng._logits[0, 0]))
+            rtts.append(time.perf_counter() - t0)
+        rtt = sorted(rtts)[len(rtts) // 2]
         eng.reset_stats()
         t0 = time.perf_counter()
         outs = eng.generate(prompts, max_new_tokens=new_tokens)
         wall = time.perf_counter() - t0
         toks = sum(len(o.token_ids) for o in outs)
-        return {"metric": "llama_serve_tokens_per_sec",
-                "value": round(toks / wall, 1), "unit": "tokens/s",
-                "vs_baseline": None,
-                "requests_per_sec": round(n_req / wall, 2),
-                "steps_per_sec": round(eng.stats["steps"] / wall, 1),
-                "requests": n_req, "slots": B,
-                "prompt_lens": f"256-512", "new_tokens": new_tokens,
-                "prefill_chunks": eng.stats["prefill_chunks"],
-                "horizon": horizon,
-                "weight_dtype": weight_dtype or "bf16"}
+        steps = eng.stats["steps"]
+        rtt_s = steps * rtt
+        out = {"metric": ("llama_serve_spec_tokens_per_sec" if spec_k > 1
+                          else "llama_serve_tokens_per_sec"),
+               "value": round(toks / wall, 1), "unit": "tokens/s",
+               "vs_baseline": None,
+               "requests_per_sec": round(n_req / wall, 2),
+               "steps_per_sec": round(steps / wall, 1),
+               "requests": n_req, "slots": B,
+               "prompt_lens": f"{min(len(p) for p in prompts)}-"
+                              f"{max(len(p) for p in prompts)}",
+               "new_tokens": new_tokens,
+               "prefill_chunks": eng.stats["prefill_chunks"],
+               "horizon": horizon,
+               # wall split: per-step tunnel RTT + host admit enqueue; the
+               # remainder is device compute (decode scan + the async
+               # prefill chunks that complete inside the next step read)
+               "rtt_est_ms": round(rtt * 1e3, 1),
+               "rtt_share": round(rtt_s / wall, 3),
+               "admit_host_share": round(
+                   eng.stats["admit_time_s"] / wall, 3),
+               "weight_dtype": weight_dtype or "bf16"}
+        if spec_k > 1:
+            out["speculative_k"] = spec_k
+            out["draft_tokens_accepted"] = eng.stats["draft_tokens_accepted"]
+            out["accepted_per_step"] = round(
+                eng.stats["draft_tokens_accepted"] / max(steps, 1), 2)
+        return out
 
     if model_name == "conv_roofline":
         return _bench_conv_roofline()
@@ -878,7 +949,7 @@ def _run_all():
     import subprocess
     import sys
     for name in ["resnet50", "bert", "vit", "unet", "llama_decode",
-                 "llama_serve", "llama"]:
+                 "llama_serve", "llama_serve_spec", "llama"]:
         env = dict(os.environ, BENCH_MODEL=name)
         try:
             proc = subprocess.run(

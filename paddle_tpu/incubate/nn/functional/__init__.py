@@ -573,16 +573,17 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         qkv3 = qkv_v.reshape(b, 3, H, D)
         q, knew, vnew = qkv3[:, 0], qkv3[:, 1], qkv3[:, 2]
 
-        # write the new token at position lens[i] of sequence i; a -1 table
-        # entry (no block allocated) must NOT wrap to the last physical block
+        # write the new token at position lens[i] of sequence i. A -1 table
+        # entry (no block allocated) must not write AT ALL: clamping it to
+        # block 0 and re-writing the old value is NOT a no-op when another
+        # sequence genuinely writes block 0 in the same scatter — duplicate
+        # indices make the last write win, clobbering the real token with
+        # the stale value. Route invalid rows OUT OF BOUNDS and drop them.
         blk_idx = tables[jnp.arange(b), lens // bs]       # [B] physical block
         slot = lens % bs                                  # [B]
-        valid = (blk_idx >= 0)[:, None, None]
-        safe_blk = jnp.maximum(blk_idx, 0)
-        kc = kc.at[safe_blk, :, slot].set(
-            jnp.where(valid, knew, kc[safe_blk, :, slot]))
-        vc = vc.at[safe_blk, :, slot].set(
-            jnp.where(valid, vnew, vc[safe_blk, :, slot]))
+        wblk = jnp.where(blk_idx >= 0, blk_idx, nb)       # nb = out of range
+        kc = kc.at[wblk, :, slot].set(knew, mode="drop")
+        vc = vc.at[wblk, :, slot].set(vnew, mode="drop")
 
         # gather each sequence's logical KV [B, max_blocks*bs, H, D]
         safe_tables = jnp.maximum(tables, 0)

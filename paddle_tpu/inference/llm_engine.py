@@ -78,12 +78,25 @@ class LLMEngine:
 
     def __init__(self, model, max_batch=4, max_seq_len=None, chunk_size=64,
                  top_k=0, stream_callback=None, horizon=1, speculative_k=1,
-                 lookup_ngram=3, mesh=None):
+                 lookup_ngram=3, mesh=None, cache_impl="dense",
+                 block_size=64, kv_pool_blocks=None):
         """``mesh``: a jax Mesh for MULTI-PROCESS serving — engine buffers
         are created as global (replicated) arrays on it so the compiled
         programs can mix them with TP-sharded weights whose groups span
         processes; every process runs the same step() calls (SPMD) and
-        reads the same replicated token vector."""
+        reads the same replicated token vector.
+
+        ``cache_impl="paged"`` (reference:
+        incubate/nn/functional/block_multihead_attention.py:1): KV lives in
+        a physical BLOCK POOL of ``kv_pool_blocks`` blocks of ``block_size``
+        tokens, mapped per slot through block tables. Blocks allocate on
+        demand as sequences grow and free at retirement, so engine HBM is
+        bounded by the POOL (sum of actual lengths, block-rounded), not by
+        slots x capacity — and the pool may be OVERSUBSCRIBED
+        (kv_pool_blocks < max_batch * capacity/block_size): when it runs
+        dry mid-decode, the most recently admitted slot is PREEMPTED back
+        to the waiting queue (its tokens re-prefill on re-admission, so
+        greedy output is unchanged)."""
         from ..jit.functional_call import collect_state, read_values
 
         self.model = model
@@ -140,9 +153,39 @@ class LLMEngine:
             _zeros = jnp.zeros
         import ml_dtypes  # noqa: F401  (np.zeros understands bf16 via jnp)
         np_dt = np.dtype(dt) if mesh is not None else dt
-        shape = (self.B, self.capacity, kvh, head_dim)
-        self._k = [_zeros(shape, np_dt) for _ in range(L)]
-        self._v = [_zeros(shape, np_dt) for _ in range(L)]
+        self.cache_impl = cache_impl
+        if cache_impl == "paged":
+            if c.num_key_value_heads != c.num_attention_heads:
+                raise ValueError("paged KV requires num_kv_heads == "
+                                 "num_heads (block_multihead_attention "
+                                 "is MHA-form)")
+            if self.speculative_k > 1:
+                raise ValueError("paged KV serves one token per step "
+                                 "(speculative verify windows need the "
+                                 "dense cache)")
+            self.block_size = int(block_size)
+            if self.chunk % self.block_size:
+                raise ValueError(f"chunk_size {self.chunk} must be a "
+                                 f"multiple of block_size {self.block_size}")
+            if self.capacity % self.chunk:
+                raise ValueError(f"capacity {self.capacity} must be a "
+                                 f"multiple of chunk_size {self.chunk} "
+                                 f"under paged KV")
+            self._max_blocks = self.capacity // self.block_size
+            full = self.B * self._max_blocks
+            self.n_blocks = int(kv_pool_blocks or full)
+            pool_shape = (self.n_blocks, kvh, self.block_size, head_dim)
+            self._k = [_zeros(pool_shape, np_dt) for _ in range(L)]
+            self._v = [_zeros(pool_shape, np_dt) for _ in range(L)]
+            self._tables = np.full((self.B, self._max_blocks), -1, np.int32)
+            self._free_blocks = list(range(self.n_blocks))
+            self._slot_blocks = [[] for _ in range(self.B)]
+            self._admit_order = [0] * self.B
+            self._admit_seq = 0
+        else:
+            shape = (self.B, self.capacity, kvh, head_dim)
+            self._k = [_zeros(shape, np_dt) for _ in range(L)]
+            self._v = [_zeros(shape, np_dt) for _ in range(L)]
         self._logits = _zeros((self.B, c.vocab_size), np.float32
                               if mesh is not None else jnp.float32)
         self._lens = _zeros((self.B,), np.int32
@@ -160,12 +203,16 @@ class LLMEngine:
             collections.deque()
         self.finished_outputs: dict[int, RequestOutput] = {}
         self._next_id = 0
+        #: tokens a preempted request committed before eviction, stitched
+        #: back in front of its post-readmission stream at finish
+        self._preempted_prefix = {}
         self._rng_key = None
         self._step_fn = None
         self._prefill_fn = None
         self._set_logits_fn = None
         self.stats = {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
-                      "draft_tokens_accepted": 0, "decode_time_s": 0.0}
+                      "draft_tokens_accepted": 0, "decode_time_s": 0.0,
+                      "admit_time_s": 0.0}
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -199,6 +246,10 @@ class LLMEngine:
                     position_offset=Tensor(lens))
                 new_logits = model._logits(hidden)._value[:, 0] \
                     .astype(jnp.float32)
+            # an INACTIVE row's carried logits must survive the remaining
+            # scan iterations — a slot deactivated non-terminally (pool
+            # budget clamp) samples from them next step
+            new_logits = jnp.where(active[:, None], new_logits, logits)
             kb = [cc.k._value if isinstance(cc.k, Tensor) else cc.k
                   for cc in new_caches]
             vb = [cc.v._value if isinstance(cc.v, Tensor) else cc.v
@@ -272,6 +323,7 @@ class LLMEngine:
                       for cc in new_caches]
                 n_acc, new_logits = _spec_accept(
                     logits_all, draft, temps, top_ps, top_k, act, sub2)
+                new_logits = jnp.where(act[:, None], new_logits, logits)
                 counts = jnp.where(act, 1 + n_acc, 0)
                 new_lens = lens + counts
                 tbuf = _write_window(tbuf, window, lens)
@@ -330,6 +382,118 @@ class LLMEngine:
             return jax.lax.dynamic_update_slice(
                 logits, row[None].astype(logits.dtype), (slot, jnp.int32(0)))
 
+        if self.cache_impl == "paged":
+            from ..models.llama import PagedKVCache, StaticKVCache
+            bs_blk = self.block_size
+            MB = self._max_blocks
+
+            def step_paged(state_vals, k_pools, v_pools, logits, lens,
+                           active, rng, temps, top_ps, eos_ids, budgets,
+                           tables):
+                """The horizon scan over the BLOCK POOL: each iteration is
+                one token through the block_multihead_attention decode path
+                (models/llama.py PagedKVCache branch). `tables` [B, MB] is
+                a traced input — the host allocator mutates it between
+                steps without recompiling."""
+                def body(carry, _):
+                    kp, vp, logits, lens, act, emitted, rng = carry
+                    rng, sub = jax.random.split(rng)
+                    greedy_tok = jnp.argmax(logits, axis=-1) \
+                        .astype(jnp.int32)
+                    sampled = _sample_logits_device(
+                        logits, sub, jnp.maximum(temps, 1e-6)[:, None],
+                        top_k, top_ps[:, None], False, True)
+                    nxt = jnp.where(temps <= 0.0, greedy_tok, sampled)
+                    nxt = jnp.where(act, nxt, 0)
+                    with functional_mode(), _bind(state, state_vals):
+                        caches = [PagedKVCache(k, v, tables, lens)
+                                  for k, v in zip(kp, vp)]
+                        hidden, new_caches = model.llama(
+                            Tensor(nxt[:, None]), kv_caches=caches,
+                            position_offset=Tensor(lens))
+                        new_logits = model._logits(hidden)._value[:, 0] \
+                            .astype(jnp.float32)
+                    # inactive rows keep their carried logits: a slot
+                    # clamped by the pool budget deactivates mid-scan but
+                    # samples from these next step
+                    new_logits = jnp.where(act[:, None], new_logits,
+                                           logits)
+                    kp = [cc.k._value if isinstance(cc.k, Tensor) else cc.k
+                          for cc in new_caches]
+                    vp = [cc.v._value if isinstance(cc.v, Tensor) else cc.v
+                          for cc in new_caches]
+                    new_lens = jnp.where(act, lens + 1, lens)
+                    finished = act & (nxt == eos_ids)
+                    emitted = emitted + act.astype(jnp.int32)
+                    act_next = act & ~finished & (new_lens < cap - 1) & \
+                        (emitted < budgets)
+                    return (kp, vp, new_logits, new_lens, act_next,
+                            emitted, rng), (nxt, act)
+
+                emitted0 = jnp.zeros_like(lens)
+                (k_pools, v_pools, logits, lens, active, _, rng), \
+                    (toks, was_active) = jax.lax.scan(
+                        body,
+                        (k_pools, v_pools, logits, lens, active, emitted0,
+                         rng), None, length=K)
+                return (toks, was_active, logits, k_pools, v_pools, lens,
+                        rng)
+
+            def prefill_chunk_paged(state_vals, k_pools, v_pools, ids,
+                                    table_row, off, last):
+                """Paged chunked prefill: gather the slot's logical KV from
+                its blocks, run the chunk like the dense path, scatter the
+                chunk's new KV back into the (block-aligned) blocks."""
+                z = jnp.int32(0)
+                safe = jnp.maximum(table_row, 0)
+                # gather [MB, H, bs, D] blocks -> the slot's logical
+                # [1, MB*bs, H, D] sequence the dense chunk path expects
+                k_slot = [jnp.moveaxis(p[safe], 2, 1).reshape(
+                    1, MB * bs_blk, p.shape[1], p.shape[3])
+                    for p in k_pools]
+                v_slot = [jnp.moveaxis(p[safe], 2, 1).reshape(
+                    1, MB * bs_blk, p.shape[1], p.shape[3])
+                    for p in v_pools]
+                with functional_mode(), _bind(state, state_vals):
+                    caches = [StaticKVCache(k, v)
+                              for k, v in zip(k_slot, v_slot)]
+                    hidden, new_caches = model.llama(
+                        Tensor(ids), kv_caches=caches,
+                        position_offset=Tensor(off))
+                    row = jax.lax.dynamic_slice(
+                        hidden._value, (z, last, z),
+                        (1, 1, hidden.shape[-1]))
+                    logits_row = model._logits(Tensor(row))._value[0, 0] \
+                        .astype(jnp.float32)
+
+                def scatter(pool, cc_val):
+                    # chunk rows [off, off+chunk) -> chunk//bs_blk blocks
+                    new_rows = jax.lax.dynamic_slice(
+                        cc_val, (z, off, z, z),
+                        (1, chunk) + cc_val.shape[2:])[0]   # [chunk, H, D]
+                    for j in range(chunk // bs_blk):
+                        phys = jax.lax.dynamic_slice(
+                            table_row, (off // bs_blk + j,), (1,))[0]
+                        blk = jnp.swapaxes(
+                            new_rows[j * bs_blk:(j + 1) * bs_blk], 0, 1)
+                        pool = jax.lax.dynamic_update_slice(
+                            pool, blk[None].astype(pool.dtype),
+                            (phys, z, z, z))
+                    return pool
+
+                k_out = [scatter(p, (cc.k._value if isinstance(cc.k, Tensor)
+                                     else cc.k))
+                         for p, cc in zip(k_pools, new_caches)]
+                v_out = [scatter(p, (cc.v._value if isinstance(cc.v, Tensor)
+                                     else cc.v))
+                         for p, cc in zip(v_pools, new_caches)]
+                return k_out, v_out, logits_row
+
+            self._step_paged_fn = jax.jit(step_paged,
+                                          donate_argnums=(1, 2, 3))
+            self._prefill_paged_fn = jax.jit(prefill_chunk_paged,
+                                             donate_argnums=(1, 2))
+
         def set_tokens(tokens_buf, row, slot):
             return jax.lax.dynamic_update_slice(
                 tokens_buf, row[None].astype(jnp.int32),
@@ -383,40 +547,134 @@ class LLMEngine:
         for i, req in enumerate(self.waiting):
             if req.request_id == request_id:
                 del self.waiting[i]
-                out = RequestOutput(request_id, [], True, "cancelled")
+                out = RequestOutput(
+                    request_id, self._finish_tokens(req, []), True,
+                    "cancelled")
                 self.finished_outputs[request_id] = out
                 return out
         for b, slot in enumerate(self.slots):
             if slot is not None and slot.req.request_id == request_id:
-                out = RequestOutput(request_id, list(slot.generated), True,
-                                    "cancelled")
+                out = RequestOutput(
+                    request_id,
+                    self._finish_tokens(slot.req, slot.generated), True,
+                    "cancelled")
                 self.finished_outputs[request_id] = out
-                self.slots[b] = None
+                self._free_slot(b)
                 return out
         return None
 
+    # ------------------------------------------------------------------
+    # paged-pool allocator (host side; tables are a traced step input)
+    # ------------------------------------------------------------------
+    def _alloc_blocks(self, slot_idx, n):
+        """Grow slot `slot_idx` by `n` physical blocks. False = pool dry."""
+        if len(self._free_blocks) < n:
+            return False
+        blocks = self._slot_blocks[slot_idx]
+        for _ in range(n):
+            phys = self._free_blocks.pop()
+            self._tables[slot_idx, len(blocks)] = phys
+            blocks.append(phys)
+        return True
+
+    def _ensure_blocks(self, slot_idx, upto_pos):
+        """Blocks covering positions [0, upto_pos]. False = pool dry."""
+        need = upto_pos // self.block_size + 1
+        have = len(self._slot_blocks[slot_idx])
+        return need <= have or self._alloc_blocks(slot_idx, need - have)
+
+    def _free_slot(self, slot_idx):
+        if self.cache_impl == "paged":
+            self._free_blocks.extend(self._slot_blocks[slot_idx])
+            self._slot_blocks[slot_idx] = []
+            self._tables[slot_idx, :] = -1
+        self.slots[slot_idx] = None
+
+    def _preempt_newest(self, exclude=None):
+        """Pool pressure: evict the most recently admitted active slot back
+        to the FRONT of the waiting queue (its committed tokens join the
+        prompt, so re-prefill reproduces the identical greedy state) and
+        free its blocks. Returns the evicted slot index or None."""
+        candidates = [b for b, s in enumerate(self.slots)
+                      if s is not None and b != exclude]
+        if not candidates:
+            return None
+        b = max(candidates, key=lambda i: self._admit_order[i])
+        slot = self.slots[b]
+        req = slot.req
+        done = np.concatenate([req.prompt_ids,
+                               np.asarray(slot.generated, np.int32)])
+        prefix = self._preempted_prefix.get(req.request_id, [])
+        self._preempted_prefix[req.request_id] = \
+            list(prefix) + list(slot.generated)
+        self.waiting.appendleft(GenerationRequest(
+            req.request_id, done,
+            req.max_new_tokens - len(slot.generated),
+            req.temperature, req.top_p, req.eos_token_id))
+        self._free_slot(b)
+        return b
+
+    def _finish_tokens(self, req, generated):
+        """Full output stream incl. tokens committed before a preemption."""
+        prefix = self._preempted_prefix.pop(req.request_id, [])
+        return list(prefix) + list(generated)
+
     def _admit(self, slot_idx, req):
-        """Chunked prefill of `req` into slot `slot_idx`."""
+        """Chunked prefill of `req` into slot `slot_idx`. Dispatches are
+        ASYNC (no host read), so chunk programs pipeline on device; the
+        admit_time_s stat records only the host-side enqueue cost — the
+        device-side prefill compute lands inside the next decode read.
+        Paged mode returns False when the pool can't cover the prompt."""
+        t0 = time.perf_counter()
         self._programs()
         P = len(req.prompt_ids)
+        paged = self.cache_impl == "paged"
+        if paged:
+            # prefill writes whole chunks: cover round_up(P, chunk), then
+            # release the over-allocation down to the prompt's own blocks
+            pad_end = min(-(-P // self.chunk) * self.chunk, self.capacity)
+            if not self._ensure_blocks(slot_idx, pad_end - 1):
+                return False
         off = 0
         logits_row = None
         while off < P:
             take = min(self.chunk, P - off)
-            # JAX dynamic slices CLAMP out-of-range starts, so a window that
-            # would cross the buffer end slides BACK instead: positions
-            # [win, off) are recomputed (producing identical KV) and the new
-            # tokens land exactly at [off, off+take)
-            win = min(off, self.capacity - self.chunk)
+            if paged:
+                # chunk windows stay block-aligned (off is a multiple of
+                # chunk; capacity % chunk == 0), no slide-back needed
+                win = off
+            else:
+                # JAX dynamic slices CLAMP out-of-range starts, so a window
+                # that would cross the buffer end slides BACK instead:
+                # positions [win, off) are recomputed (producing identical
+                # KV) and the new tokens land exactly at [off, off+take)
+                win = min(off, self.capacity - self.chunk)
             chunk_ids = np.zeros((1, self.chunk), np.int32)
             real = req.prompt_ids[win:min(win + self.chunk, P)]
             chunk_ids[0, :len(real)] = real
-            self._k, self._v, logits_row = self._prefill_fn(
-                self._state_vals, self._k, self._v, chunk_ids,
-                np.int32(slot_idx), np.int32(win),
-                np.int32(off + take - 1 - win))
+            if paged:
+                self._k, self._v, logits_row = self._prefill_paged_fn(
+                    self._state_vals, self._k, self._v, chunk_ids,
+                    self._tables[slot_idx].copy(), np.int32(win),
+                    np.int32(off + take - 1 - win))
+            else:
+                self._k, self._v, logits_row = self._prefill_fn(
+                    self._state_vals, self._k, self._v, chunk_ids,
+                    np.int32(slot_idx), np.int32(win),
+                    np.int32(off + take - 1 - win))
             off += take
             self.stats["prefill_chunks"] += 1
+        if paged:
+            # drop the chunk-padding over-allocation: keep only the blocks
+            # the prompt actually occupies (+ the one decode grows into)
+            keep = P // self.block_size + 1
+            blocks = self._slot_blocks[slot_idx]
+            while len(blocks) > keep:
+                phys = blocks.pop()
+                self._tables[slot_idx, len(blocks)] = -1
+                self._free_blocks.append(phys)
+            self._admit_order[slot_idx] = self._admit_seq
+            self._admit_seq += 1
         self._logits = self._set_logits_fn(self._logits, logits_row,
                                            np.int32(slot_idx))
         self._lens = self._set_len_fn(self._lens, np.int32(slot_idx),
@@ -428,6 +686,7 @@ class LLMEngine:
             self._tokens = self._set_tokens_fn(
                 self._tokens, row, np.int32(slot_idx))
         self.slots[slot_idx] = _Slot(req, P)
+        self.stats["admit_time_s"] += time.perf_counter() - t0
 
     def _admit_waiting(self):
         for b in range(self.B):
@@ -445,7 +704,10 @@ class LLMEngine:
                         f"{self.capacity})", RuntimeWarning, stacklevel=3)
                     req.max_new_tokens = room
                 self.waiting.popleft()
-                self._admit(b, req)
+                if self._admit(b, req) is False:
+                    # paged pool dry: requeue and wait for a retirement
+                    self.waiting.appendleft(req)
+                    break
 
     # ------------------------------------------------------------------
     # the engine loop
@@ -458,6 +720,21 @@ class LLMEngine:
 
         self._admit_waiting()
         if not any(s is not None for s in self.slots):
+            if self.waiting and self.cache_impl == "paged":
+                # nothing running AND the head request couldn't admit: the
+                # pool simply cannot hold its prompt — fail loudly rather
+                # than letting generate() spin forever
+                req = self.waiting[0]
+                P = len(req.prompt_ids)
+                pad_end = min(-(-P // self.chunk) * self.chunk,
+                              self.capacity)
+                need = -(-pad_end // self.block_size)
+                if need > self.n_blocks:
+                    raise RuntimeError(
+                        f"request {req.request_id}: prefilling its "
+                        f"{P}-token prompt needs {need} KV blocks but the "
+                        f"pool has {self.n_blocks} total (kv_pool_blocks "
+                        f"too small)")
             return []
         self._programs()
         if self._rng_key is None:
@@ -474,7 +751,50 @@ class LLMEngine:
                     lambda idx: data[idx])
                 key = jax.random.wrap_key_data(glob)
             self._rng_key = key
+        t0 = time.perf_counter()
+        spec = self.speculative_k > 1
+        pool_budget, pool_done = {}, []
+        if self.cache_impl == "paged":
+            # block coverage for the horizon's growth (last written
+            # position is cur + horizon - 1); pool pressure first grabs
+            # whatever blocks remain free (partial coverage + a budget
+            # clamp beats eviction), then evicts the newest slots, and
+            # only retires at the pool edge when a slot can't even write
+            # one more token
+            order = sorted((b for b, s in enumerate(self.slots)
+                            if s is not None),
+                           key=lambda i: self._admit_order[i])
+            for b in order:
+                if self.slots[b] is None:
+                    continue  # evicted below while ensuring an older slot
+                slot = self.slots[b]
+                cur = slot.prompt_len + len(slot.generated)
+                last_pos = min(cur + self.horizon - 1, self.capacity - 1)
+                while not self._ensure_blocks(b, last_pos):
+                    if self._free_blocks:
+                        self._alloc_blocks(b, len(self._free_blocks))
+                    covered = len(self._slot_blocks[b]) * self.block_size
+                    if covered > cur:
+                        pool_budget[b] = covered - cur
+                        break
+                    victim = self._preempt_newest(exclude=b)
+                    if victim is None:
+                        # this slot alone exceeds the pool and can't write
+                        # even one token: retire it at the pool edge
+                        # rather than letting the masked block writes
+                        # corrupt its stream
+                        out = RequestOutput(
+                            slot.req.request_id,
+                            self._finish_tokens(slot.req, slot.generated),
+                            True, "capacity")
+                        self.finished_outputs[slot.req.request_id] = out
+                        pool_done.append(out)
+                        self._free_slot(b)
+                        break
+
         active = np.array([s is not None for s in self.slots])
+        if not active.any():
+            return pool_done
         temps = np.array([s.req.temperature if s else 0.0
                           for s in self.slots], np.float32)
         top_ps = np.array([s.req.top_p if s else 1.0
@@ -484,10 +804,18 @@ class LLMEngine:
                             for s in self.slots], np.int32)
         budgets = np.array([(s.req.max_new_tokens - len(s.generated))
                             if s else 0 for s in self.slots], np.int32)
+        for b, cap_left in pool_budget.items():
+            budgets[b] = min(budgets[b], cap_left)
 
-        t0 = time.perf_counter()
-        spec = self.speculative_k > 1
-        if spec:
+        if self.cache_impl == "paged":
+            (toks, was_active, self._logits, self._k, self._v, self._lens,
+             self._rng_key) = self._step_paged_fn(
+                self._state_vals, self._k, self._v, self._logits,
+                self._lens, active, self._rng_key, temps, top_ps, eos_ids,
+                budgets, self._tables.copy())
+            toks_np = np.asarray(toks)
+            act_np = np.asarray(was_active)
+        elif spec:
             (toks, counts, was_active, self._logits, self._k, self._v,
              self._lens, self._rng_key, self._tokens) = self._spec_fn(
                 self._state_vals, self._k, self._v, self._logits,
@@ -516,7 +844,7 @@ class LLMEngine:
         self.stats["decode_time_s"] += time.perf_counter() - t0
         self.stats["steps"] += 1
 
-        done = []
+        done = list(pool_done) if self.cache_impl == "paged" else []
         for b, slot in enumerate(self.slots):
             if slot is None:
                 continue
@@ -566,12 +894,14 @@ class LLMEngine:
             if self.slots[b] is not slot:
                 continue  # cancelled mid-window; don't record a finish
             if finish_reason:
-                out = RequestOutput(slot.req.request_id,
-                                    list(slot.generated), True,
-                                    finish_reason)
+                out = RequestOutput(
+                    slot.req.request_id,
+                    self._finish_tokens(slot.req, slot.generated), True,
+                    finish_reason)
                 self.finished_outputs[slot.req.request_id] = out
                 done.append(out)
-                self.slots[b] = None  # slot freed; next step admits into it
+                # slot (and its KV blocks) freed; next step admits into it
+                self._free_slot(b)
         return done
 
     def generate(self, prompts, **sampling):

@@ -38,6 +38,15 @@ class BertConfig:
     # (VERDICT r4 #2)
     use_recompute: bool = False
     recompute_layers: int | None = None
+    # jax checkpoint policy name (distributed/fleet/recompute.py POLICIES):
+    # "dots_saveable" keeps matmul outputs and recomputes only elementwise
+    recompute_policy: str | None = None
+    # chunked fused (decoder matmul + CE) head: never materializes the
+    # full [tokens, vocab] logits (+grad) — the largest single activation
+    # of the MLM step (~6 GB at batch 96) and the tensor whose scheduling
+    # made the B=96 compile OOM nondeterministically. Costs one extra
+    # head-matmul pass in backward (~+6% step FLOPs for bert-base).
+    fuse_mlm_head_ce: bool = False
 
     @staticmethod
     def base(**over):
@@ -87,7 +96,8 @@ class BertModel(Layer):
         self.encoder = TransformerEncoder(
             enc_layer, c.num_hidden_layers,
             use_recompute=c.use_recompute,
-            recompute_layers=c.recompute_layers)
+            recompute_layers=c.recompute_layers,
+            recompute_policy=c.recompute_policy)
         self.pooler = Linear(c.hidden_size, c.hidden_size)
         self.pooler_act = Tanh()
 
@@ -119,6 +129,25 @@ class BertForMaskedLM(Layer):
                 labels=None):
         seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
         h = self.transform_norm(self.transform_act(self.transform(seq)))
+        if labels is not None and self.config.fuse_mlm_head_ce:
+            # chunked fused head: loss computed without the full logits
+            # tensor; mean over non-ignored positions matches
+            # cross_entropy(reduction='mean', ignore_index=-100)
+            from ..ops.kernels.fused_ce import fused_linear_ce
+            from ..core.tensor import dispatch
+
+            def fn(h2, w, b, lbl):
+                import jax.numpy as jnp
+                flat = fused_linear_ce(h2, w, b, lbl, -100)
+                n_valid = jnp.maximum(jnp.sum(lbl != -100), 1)
+                return jnp.sum(flat) / n_valid.astype(jnp.float32)
+
+            loss = dispatch(
+                fn,
+                (ops.reshape(h, [-1, self.config.hidden_size]),
+                 self.decoder.weight, self.decoder.bias,
+                 ops.reshape(labels, [-1])), {}, name="fused_linear_ce")
+            return loss, None
         logits = self.decoder(h)
         if labels is None:
             return logits

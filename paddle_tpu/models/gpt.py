@@ -31,7 +31,8 @@ class GPTConfig:
     def __init__(self, vocab_size=50257, hidden_size=768, num_hidden_layers=12,
                  num_attention_heads=12, intermediate_size=None,
                  max_position_embeddings=1024, dropout=0.1,
-                 layer_norm_eps=1e-5, tie_word_embeddings=True):
+                 layer_norm_eps=1e-5, tie_word_embeddings=True,
+                 fuse_lm_head_ce=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -41,6 +42,10 @@ class GPTConfig:
         self.dropout = dropout
         self.layer_norm_eps = layer_norm_eps
         self.tie_word_embeddings = tie_word_embeddings
+        # chunked fused (lm_head matmul + CE): never materializes the full
+        # [tokens, vocab] logits — the largest single activation of the LM
+        # step (see ops/kernels/fused_ce.py fused_linear_ce)
+        self.fuse_lm_head_ce = fuse_lm_head_ce
 
 
 def gpt2_small(**over):
@@ -113,6 +118,30 @@ class GPT2LMHeadModel(Layer):
 
     def forward(self, input_ids, labels=None):
         hidden = self.transformer(input_ids)
+        if labels is not None and self.config.fuse_lm_head_ce:
+            # chunked fused head over the SHIFTED rows: loss without the
+            # full logits tensor; weight is the (tied or untied) output
+            # matrix in [hidden, vocab] orientation
+            from ..ops.kernels.fused_ce import fused_linear_ce
+            from ..core.tensor import dispatch
+
+            tied = self.config.tie_word_embeddings
+            w = self.transformer.wte.weight if tied else self.lm_head.weight
+
+            def fn(h2, wv, lbl):
+                import jax.numpy as jnp
+                wmat = wv.T if tied else wv
+                flat = fused_linear_ce(h2, wmat, None, lbl, -100)
+                n_valid = jnp.maximum(jnp.sum(lbl != -100), 1)
+                return jnp.sum(flat) / n_valid.astype(jnp.float32)
+
+            loss = dispatch(
+                fn,
+                (ops.reshape(hidden[:, :-1],
+                             [-1, self.config.hidden_size]),
+                 w, ops.reshape(labels[:, 1:], [-1])), {},
+                name="fused_linear_ce_gpt")
+            return loss, None
         logits = self._logits(hidden)
         if labels is None:
             return logits

@@ -119,7 +119,8 @@ class TransformerEncoderLayer(Layer):
 
 class TransformerEncoder(Layer):
     def __init__(self, encoder_layer, num_layers, norm=None,
-                 use_recompute=False, recompute_layers=None):
+                 use_recompute=False, recompute_layers=None,
+                 recompute_policy=None):
         """``use_recompute``: rematerialize encoder layers during backward
         (jax.checkpoint with RNG replay). ``recompute_layers`` limits remat
         to the FIRST k layers — SELECTIVE remat: each rematted layer saves
@@ -136,6 +137,11 @@ class TransformerEncoder(Layer):
         self.use_recompute = bool(use_recompute)
         self.recompute_layers = (num_layers if recompute_layers is None
                                  else int(recompute_layers))
+        # e.g. "dots_saveable": keep matmul outputs as residuals and
+        # recompute only the ELEMENTWISE tail (gelu/dropout/layernorm) —
+        # most of the memory win at ~bandwidth-only recompute cost, where
+        # full remat re-pays the matmul FLOPs too
+        self.recompute_policy = recompute_policy
 
     def forward(self, src, src_mask=None, cache=None):
         output = src
@@ -146,7 +152,9 @@ class TransformerEncoder(Layer):
         for i, mod in enumerate(self.layers):
             if cache is None:
                 if remat and i < self.recompute_layers:
-                    output = recompute(mod, output, src_mask)
+                    output = recompute(
+                        mod, output, src_mask,
+                        checkpoint_policy=self.recompute_policy)
                 else:
                     output = mod(output, src_mask)
             else:

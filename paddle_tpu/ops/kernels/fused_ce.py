@@ -58,3 +58,109 @@ def _ce_vjp_bwd(ignore_index, res, g):
 
 
 fused_softmax_ce.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_linear_ce(hidden, weight, bias, labels, ignore_index=-100,
+                    chunk=8192):
+    """Chunked fused (linear projection + softmax CE): per-token fp32 loss
+    WITHOUT ever materializing the full (T, V) logits.
+
+    The classifier head's logits (+ their grad) are the largest single
+    activation of an MLM/LM step — bert-base at batch 96 x 512 is ~3 GB
+    bf16 each way, the very tensor whose scheduling made the B=96 compile
+    OOM nondeterministically. This computes loss and grads over row CHUNKS
+    (lax.scan): forward keeps only {fp32 lse, target logit} per token;
+    backward recomputes each chunk's logits (one extra T x H x V matmul
+    pass, ~+6% step FLOPs for bert-base) and accumulates dW/db in fp32.
+
+    hidden (T, H) bf16/f32; weight (H, V) paddle [in, out] layout; bias
+    (V,) or None; labels (T,) int. Returns fp32 (T,) loss, ignored
+    positions zeroed.
+    """
+    loss, _ = _flce_fwd_impl(hidden, weight, bias, labels, ignore_index,
+                             chunk)
+    return loss
+
+
+def _flce_pad(hidden, labels, ignore_index, chunk):
+    t = hidden.shape[0]
+    n = -(-t // chunk)
+    pad = n * chunk - t
+    if pad:
+        hidden = jnp.pad(hidden, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad), constant_values=ignore_index)
+    return hidden, labels, n, t
+
+
+def _flce_fwd_impl(hidden, weight, bias, labels, ignore_index, chunk):
+    h_p, l_p, n, t = _flce_pad(hidden, labels, ignore_index, chunk)
+    h_ch = h_p.reshape(n, chunk, h_p.shape[-1])
+    l_ch = l_p.reshape(n, chunk)
+    v = weight.shape[-1]
+
+    def body(_, xs):
+        h_c, lbl_c = xs
+        logits = h_c @ weight
+        if bias is not None:
+            logits = logits + bias
+        l32 = logits.astype(jnp.float32)
+        m = jnp.max(l32, axis=-1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(l32 - m[:, None]), axis=-1))
+        idx = jnp.clip(lbl_c.astype(jnp.int32), 0, v - 1)
+        tgt = jnp.take_along_axis(l32, idx[:, None], axis=-1)[:, 0]
+        valid = lbl_c != ignore_index
+        return None, (jnp.where(valid, lse - tgt, 0.0), lse)
+
+    _, (loss, lse) = jax.lax.scan(body, None, (h_ch, l_ch))
+    return loss.reshape(-1)[:t], lse.reshape(-1)[:t]
+
+
+def _flce_vjp_fwd(hidden, weight, bias, labels, ignore_index, chunk):
+    loss, lse = _flce_fwd_impl(hidden, weight, bias, labels, ignore_index,
+                               chunk)
+    return loss, (hidden, weight, bias, labels, lse)
+
+
+def _flce_vjp_bwd(ignore_index, chunk, res, g):
+    hidden, weight, bias, labels, lse = res
+    v = weight.shape[-1]
+    h_p, l_p, n, t = _flce_pad(hidden, labels, ignore_index, chunk)
+    pad = n * chunk - t
+    lse_p = jnp.pad(lse, (0, pad)) if pad else lse
+    g_p = jnp.pad(g.astype(jnp.float32), (0, pad)) if pad \
+        else g.astype(jnp.float32)
+    h_ch = h_p.reshape(n, chunk, h_p.shape[-1])
+    l_ch = l_p.reshape(n, chunk)
+    lse_ch = lse_p.reshape(n, chunk)
+    g_ch = g_p.reshape(n, chunk)
+
+    def body(carry, xs):
+        dW, db = carry
+        h_c, lbl_c, lse_c, g_c = xs
+        logits = h_c @ weight
+        if bias is not None:
+            logits = logits + bias
+        probs = jnp.exp(logits.astype(jnp.float32) - lse_c[:, None])
+        idx = jnp.clip(lbl_c.astype(jnp.int32), 0, v - 1)
+        scale = jnp.where(lbl_c != ignore_index, g_c, 0.0)
+        onehot = jax.nn.one_hot(idx, v, dtype=jnp.float32)
+        dl = (probs - onehot) * scale[:, None]
+        dl16 = dl.astype(h_c.dtype)
+        dh_c = dl16 @ weight.T
+        dW = dW + jax.lax.dot_general(
+            h_c, dl16, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        db = db + jnp.sum(dl, axis=0)
+        return (dW, db), dh_c
+
+    dW0 = jnp.zeros(weight.shape, jnp.float32)
+    db0 = jnp.zeros((v,), jnp.float32)
+    (dW, db), dh = jax.lax.scan(body, (dW0, db0),
+                                (h_ch, l_ch, lse_ch, g_ch))
+    dh = dh.reshape(-1, hidden.shape[-1])[:t]
+    dbias = db.astype(bias.dtype) if bias is not None else None
+    return (dh.astype(hidden.dtype), dW.astype(weight.dtype), dbias, None)
+
+
+fused_linear_ce.defvjp(_flce_vjp_fwd, _flce_vjp_bwd)
