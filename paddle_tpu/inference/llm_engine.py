@@ -228,8 +228,12 @@ class LLMEngine:
         K = self.horizon
 
         def one_step(k_bufs, v_bufs, logits, lens, active, rng, state_vals,
-                     temps, top_ps, eos_ids):
-            """sample from current logits -> one-token model step."""
+                     temps, top_ps, eos_ids, tables):
+            """sample from current logits -> one-token model step.
+            ``tables`` selects the cache backend at TRACE time: None ->
+            dense SlotKVCache slot buffers; a [B, MB] array -> PagedKVCache
+            block pool (ONE body serves both engines — the carried-logits
+            fix once had to be applied in several copies of this loop)."""
             rng, sub = jax.random.split(rng)
             greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             sampled = _sample_logits_device(
@@ -239,8 +243,13 @@ class LLMEngine:
             # inactive slots decode garbage; pin them to token 0
             nxt = jnp.where(active, nxt, 0)
             with functional_mode(), _bind(state, state_vals):
-                caches = [SlotKVCache(k, v, lens)
-                          for k, v in zip(k_bufs, v_bufs)]
+                if tables is None:
+                    caches = [SlotKVCache(k, v, lens)
+                              for k, v in zip(k_bufs, v_bufs)]
+                else:
+                    from ..models.llama import PagedKVCache
+                    caches = [PagedKVCache(k, v, tables, lens)
+                              for k, v in zip(k_bufs, v_bufs)]
                 hidden, new_caches = model.llama(
                     Tensor(nxt[:, None]), kv_caches=caches,
                     position_offset=Tensor(lens))
@@ -259,18 +268,19 @@ class LLMEngine:
             return nxt, new_logits, kb, vb, new_lens, finished, rng
 
         def step(state_vals, k_bufs, v_bufs, logits, lens, active, rng,
-                 temps, top_ps, eos_ids, budgets):
+                 temps, top_ps, eos_ids, budgets, tables=None):
             """`horizon` decode iterations as ONE compiled lax.scan — the
             host sync (and through a tunnel, the RTT) amortizes over K
             tokens per slot. A slot that hits eos, capacity, or its
             remaining budget mid-horizon deactivates in-graph; the host
             reads the per-iteration (tokens, active) history to attribute
-            outputs."""
+            outputs. ``tables`` (paged mode) is a traced input — the host
+            allocator mutates it between steps without recompiling."""
             def body(carry, _):
                 kb, vb, logits, lens, act, emitted, rng = carry
                 nxt, logits, kb, vb, lens, finished, rng = one_step(
                     kb, vb, logits, lens, act, rng, state_vals, temps,
-                    top_ps, eos_ids)
+                    top_ps, eos_ids, tables)
                 emitted = emitted + act.astype(jnp.int32)
                 act_next = act & ~finished & (lens < cap - 1) & \
                     (emitted < budgets)
@@ -387,58 +397,6 @@ class LLMEngine:
             bs_blk = self.block_size
             MB = self._max_blocks
 
-            def step_paged(state_vals, k_pools, v_pools, logits, lens,
-                           active, rng, temps, top_ps, eos_ids, budgets,
-                           tables):
-                """The horizon scan over the BLOCK POOL: each iteration is
-                one token through the block_multihead_attention decode path
-                (models/llama.py PagedKVCache branch). `tables` [B, MB] is
-                a traced input — the host allocator mutates it between
-                steps without recompiling."""
-                def body(carry, _):
-                    kp, vp, logits, lens, act, emitted, rng = carry
-                    rng, sub = jax.random.split(rng)
-                    greedy_tok = jnp.argmax(logits, axis=-1) \
-                        .astype(jnp.int32)
-                    sampled = _sample_logits_device(
-                        logits, sub, jnp.maximum(temps, 1e-6)[:, None],
-                        top_k, top_ps[:, None], False, True)
-                    nxt = jnp.where(temps <= 0.0, greedy_tok, sampled)
-                    nxt = jnp.where(act, nxt, 0)
-                    with functional_mode(), _bind(state, state_vals):
-                        caches = [PagedKVCache(k, v, tables, lens)
-                                  for k, v in zip(kp, vp)]
-                        hidden, new_caches = model.llama(
-                            Tensor(nxt[:, None]), kv_caches=caches,
-                            position_offset=Tensor(lens))
-                        new_logits = model._logits(hidden)._value[:, 0] \
-                            .astype(jnp.float32)
-                    # inactive rows keep their carried logits: a slot
-                    # clamped by the pool budget deactivates mid-scan but
-                    # samples from these next step
-                    new_logits = jnp.where(act[:, None], new_logits,
-                                           logits)
-                    kp = [cc.k._value if isinstance(cc.k, Tensor) else cc.k
-                          for cc in new_caches]
-                    vp = [cc.v._value if isinstance(cc.v, Tensor) else cc.v
-                          for cc in new_caches]
-                    new_lens = jnp.where(act, lens + 1, lens)
-                    finished = act & (nxt == eos_ids)
-                    emitted = emitted + act.astype(jnp.int32)
-                    act_next = act & ~finished & (new_lens < cap - 1) & \
-                        (emitted < budgets)
-                    return (kp, vp, new_logits, new_lens, act_next,
-                            emitted, rng), (nxt, act)
-
-                emitted0 = jnp.zeros_like(lens)
-                (k_pools, v_pools, logits, lens, active, _, rng), \
-                    (toks, was_active) = jax.lax.scan(
-                        body,
-                        (k_pools, v_pools, logits, lens, active, emitted0,
-                         rng), None, length=K)
-                return (toks, was_active, logits, k_pools, v_pools, lens,
-                        rng)
-
             def prefill_chunk_paged(state_vals, k_pools, v_pools, ids,
                                     table_row, off, last):
                 """Paged chunked prefill: gather the slot's logical KV from
@@ -489,8 +447,6 @@ class LLMEngine:
                          for p, cc in zip(v_pools, new_caches)]
                 return k_out, v_out, logits_row
 
-            self._step_paged_fn = jax.jit(step_paged,
-                                          donate_argnums=(1, 2, 3))
             self._prefill_paged_fn = jax.jit(prefill_chunk_paged,
                                              donate_argnums=(1, 2))
 
@@ -503,6 +459,9 @@ class LLMEngine:
             return jax.lax.dynamic_update_slice(lens, val[None], (slot,))
 
         self._step_fn = jax.jit(step, donate_argnums=(1, 2, 3))
+        # the paged step IS the unified step with `tables` bound — one
+        # traced body serves both cache backends
+        self._step_paged_fn = self._step_fn
         self._spec_fn = jax.jit(spec_step, donate_argnums=(1, 2, 3, 11))
         self._prefill_fn = jax.jit(prefill_chunk, donate_argnums=(1, 2))
         self._set_logits_fn = jax.jit(set_logits, donate_argnums=(0,))
@@ -590,13 +549,20 @@ class LLMEngine:
             self._tables[slot_idx, :] = -1
         self.slots[slot_idx] = None
 
-    def _preempt_newest(self, exclude=None):
+    def _preempt_newest(self, exclude=None, newer_than=None):
         """Pool pressure: evict the most recently admitted active slot back
         to the FRONT of the waiting queue (its committed tokens join the
         prompt, so re-prefill reproduces the identical greedy state) and
-        free its blocks. Returns the evicted slot index or None."""
+        free its blocks. ``newer_than`` restricts candidates to slots
+        admitted AFTER that order stamp — a requester may only evict slots
+        newer than itself, or the preempt-newest invariant inverts (a new
+        arrival evicting an older, further-along request, then thrashing
+        as the roles swap every re-admission). Returns the evicted slot
+        index or None."""
         candidates = [b for b, s in enumerate(self.slots)
-                      if s is not None and b != exclude]
+                      if s is not None and b != exclude
+                      and (newer_than is None
+                           or self._admit_order[b] > newer_than)]
         if not candidates:
             return None
         b = max(candidates, key=lambda i: self._admit_order[i])
@@ -751,7 +717,6 @@ class LLMEngine:
                     lambda idx: data[idx])
                 key = jax.random.wrap_key_data(glob)
             self._rng_key = key
-        t0 = time.perf_counter()
         spec = self.speculative_k > 1
         pool_budget, pool_done = {}, []
         if self.cache_impl == "paged":
@@ -777,7 +742,8 @@ class LLMEngine:
                     if covered > cur:
                         pool_budget[b] = covered - cur
                         break
-                    victim = self._preempt_newest(exclude=b)
+                    victim = self._preempt_newest(
+                        exclude=b, newer_than=self._admit_order[b])
                     if victim is None:
                         # this slot alone exceeds the pool and can't write
                         # even one token: retire it at the pool edge
@@ -807,6 +773,10 @@ class LLMEngine:
         for b, cap_left in pool_budget.items():
             budgets[b] = min(budgets[b], cap_left)
 
+        # the decode clock starts HERE: pool-allocator scans and host array
+        # construction above must not masquerade as device decode time in
+        # throughput() or the serve bench's wall split
+        t0 = time.perf_counter()
         if self.cache_impl == "paged":
             (toks, was_active, self._logits, self._k, self._v, self._lens,
              self._rng_key) = self._step_paged_fn(

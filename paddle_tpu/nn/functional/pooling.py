@@ -74,7 +74,14 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     fn = _pool(x, kernel_size, stride, padding, 1, jax.lax.max,
                -jnp.inf, data_format.endswith("C") and
                data_format != "NCL", ceil_mode)
-    return dispatch(fn, (x,), {}, name="max_pool1d")
+    out = dispatch(fn, (x,), {}, name="max_pool1d")
+    if return_mask:
+        return out, _max_pool_mask(
+            x, kernel_size, stride, padding, data_format, nd=1,
+            ceil_mode=ceil_mode,
+            channel_last=data_format.endswith("C")
+            and data_format != "NCL")
+    return out
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -83,7 +90,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                -jnp.inf, data_format == "NHWC", ceil_mode)
     out = dispatch(fn, (x,), {}, name="max_pool2d")
     if return_mask:
-        idx = _max_pool_mask(x, kernel_size, stride, padding, data_format)
+        idx = _max_pool_mask(x, kernel_size, stride, padding, data_format,
+                             nd=2, ceil_mode=ceil_mode,
+                             channel_last=data_format == "NHWC")
         return out, idx
     return out
 
@@ -92,19 +101,54 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     fn = _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
                -jnp.inf, data_format == "NDHWC", ceil_mode)
-    return dispatch(fn, (x,), {}, name="max_pool3d")
+    out = dispatch(fn, (x,), {}, name="max_pool3d")
+    if return_mask:
+        return out, _max_pool_mask(
+            x, kernel_size, stride, padding, data_format, nd=3,
+            ceil_mode=ceil_mode, channel_last=data_format == "NDHWC")
+    return out
 
 
-def _max_pool_mask(x, kernel_size, stride, padding, data_format):
+def _max_pool_mask(x, kernel_size, stride, padding, data_format, nd=2,
+                   ceil_mode=False, channel_last=False):
+    """Flattened-spatial argmax indices for max_pool{1,2,3}d
+    (return_mask=True) — what max_unpool{n}d consumes. MIRRORS _pool's
+    window configuration exactly (string padding, ceil_mode,
+    channel-last) so the mask always shapes like the pooled output."""
     from ...core.tensor import Tensor
-    k = _tuple(kernel_size, 2)
-    s = _tuple(stride if stride is not None else kernel_size, 2)
-    p = _tuple(padding, 2)
+    k = _tuple(kernel_size, nd)
+    s = _tuple(stride if stride is not None else kernel_size, nd)
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        p = [(0, 0)] * nd
+    else:
+        pad_mode = None
+        p = [(pp, pp) for pp in _tuple(padding, nd)]
 
     def fn(v):
-        n, c, h, w = v.shape
-        hw = h * w
-        idx = jnp.arange(hw, dtype=jnp.float32).reshape(1, 1, h, w)
+        if channel_last:
+            # compute in channel-FIRST so the flattened spatial index
+            # convention matches the unpool consumers, then move back
+            v = jnp.moveaxis(v, -1, 1)
+        spatial = v.shape[2:]
+        pads = list(p)
+        if pad_mode == "SAME":
+            pads = []
+            for i in range(nd):
+                out_sz = -(-spatial[i] // s[i])
+                total = max(0, (out_sz - 1) * s[i] + k[i] - spatial[i])
+                pads.append((total // 2, total - total // 2))
+        if ceil_mode:
+            for i in range(nd):
+                size = spatial[i] + pads[i][0] + pads[i][1]
+                rem = (size - k[i]) % s[i]
+                if rem != 0:
+                    pads[i] = (pads[i][0], pads[i][1] + (s[i] - rem))
+        size = 1
+        for d in spatial:
+            size *= d
+        idx = jnp.arange(size, dtype=jnp.float32).reshape(
+            (1, 1) + tuple(spatial))
         idx = jnp.broadcast_to(idx, v.shape)
         # select argmax index via reduce_window over (value, index) pairs
         def red(a, b):
@@ -112,12 +156,16 @@ def _max_pool_mask(x, kernel_size, stride, padding, data_format):
             bv, bi = b
             take_b = bv > av
             return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
-        init = (jnp.asarray(-jnp.inf, v.dtype), jnp.asarray(-1.0, jnp.float32))
-        vv, ii = jax.lax.reduce_window((v, idx), init, red,
-                                       (1, 1) + k, (1, 1) + s,
-                                       [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
-        return ii.astype(jnp.int32)
-    return dispatch(fn, (x,), {}, name="max_pool2d_mask")
+        init = (jnp.asarray(-jnp.inf, v.dtype),
+                jnp.asarray(-1.0, jnp.float32))
+        vv, ii = jax.lax.reduce_window(
+            (v, idx), init, red, (1, 1) + k, (1, 1) + s,
+            [(0, 0), (0, 0)] + pads)
+        ii = ii.astype(jnp.int32)
+        if channel_last:
+            ii = jnp.moveaxis(ii, 1, -1)
+        return ii
+    return dispatch(fn, (x,), {}, name=f"max_pool{nd}d_mask")
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
