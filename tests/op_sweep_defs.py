@@ -1033,7 +1033,7 @@ SKIPS = {
     # construction/IO with no numeric contract beyond what's swept
     "to_tensor": "constructor; exercised by every test in the suite",
     "empty": "uninitialized values by contract; empty_like swept as 0*",
-    "clone_detached": "autograd-graph semantics: tests/test_tensor_autograd.py",
+    "clone_detached": "test_op_sweep.py::test_clone_detached_semantics",
     "complex": "complex compose; as_complex swept",
     "polar": "complex compose; fft suite covers complex numerics",
     "meshgrid": "swept",
@@ -1058,7 +1058,7 @@ SKIPS = {
     "ormqr": "depends on qr reflector convention; reconstruction-tested",
     "svd_lowrank": "randomized algorithm; subspace-tested in test_linalg",
     "pca_lowrank": "randomized algorithm; subspace-tested in test_linalg",
-    "fp8_fp8_half_gemm_fused": "fp8 hardware path: tests/test_quantization.py (fp8 path)",
+    "fp8_fp8_half_gemm_fused": "tests/test_linalg_incubate_longtail.py (fp8 gemm)",
     "matrix_transpose_extras": "alias of linalg.matrix_transpose (swept)",
     # value-dependent output shapes exercised in their own suites
     "histogram_bin_edges": "swept",
@@ -1264,27 +1264,27 @@ FUNCTIONAL_SKIPS = {
     "gelu": "swept as F.gelu + F.gelu_tanh",
     "tanh": "swept in the math block (same kernel)",
     # structured ops with dedicated numeric-grad/parity suites
-    "conv1d": "tests/test_op_numeric_grad.py (conv family) + test_nn_layers",
+    "conv1d": "Conv1D layer: tests/test_gpt.py + conv2d numeric grads",
     "conv2d": "tests/test_op_numeric_grad.py::test_conv2d_grad",
-    "conv3d": "conv family: tests/test_nn_optimizer.py / test_nn_longtail.py",
-    "conv1d_transpose": "conv family: tests/test_nn_optimizer.py / test_nn_longtail.py",
-    "conv2d_transpose": "conv family: tests/test_nn_optimizer.py / test_nn_longtail.py",
-    "conv3d_transpose": "conv family: tests/test_nn_optimizer.py / test_nn_longtail.py",
+    "conv3d": "Conv3D layer: tests/test_sparse_fft_signal.py",
+    "conv1d_transpose": "test_op_sweep.py::test_conv_transpose_and_norms_match_torch",
+    "conv2d_transpose": "Conv2DTranspose layer: tests/test_nn_optimizer.py",
+    "conv3d_transpose": "test_op_sweep.py::test_conv_transpose_and_norms_match_torch",
     "linear": "tests/test_op_numeric_grad.py + every model test",
     "bilinear": "tests/test_nn_optimizer.py / test_nn_longtail.py",
     "embedding": "tests/test_op_numeric_grad.py (scatter-grad case)",
     "layer_norm": "tests/test_op_numeric_grad.py",
     "rms_norm": "llama parity suites (HF logits parity)",
-    "group_norm": "tests/test_nn_optimizer.py / test_nn_longtail.py",
-    "instance_norm": "tests/test_nn_optimizer.py / test_nn_longtail.py",
-    "batch_norm": "tests/test_nn_optimizer.py / test_nn_longtail.py (running-stats contract)",
-    "local_response_norm": "tests/test_nn_optimizer.py / test_nn_longtail.py",
+    "group_norm": "test_op_sweep.py::test_conv_transpose_and_norms_match_torch (torch oracle)",
+    "instance_norm": "test_op_sweep.py::test_conv_transpose_and_norms_match_torch (torch oracle)",
+    "batch_norm": "BatchNorm running-stats contract: tests/test_jit_amp_io.py",
+    "local_response_norm": "test_op_sweep.py::test_conv_transpose_and_norms_match_torch (torch oracle)",
     "cross_entropy": "tests/test_op_numeric_grad.py + fused-CE parity",
     "softmax_with_cross_entropy": "same fused-CE path as cross_entropy",
     "nll_loss": "swept",
     "ctc_loss": "test_op_sweep.py::test_ctc_loss_matches_dp_reference",
     "rnnt_loss": "tests/test_nn_longtail.py",
-    "adaptive_log_softmax_with_loss": "tests/test_nn_longtail.py",
+    "adaptive_log_softmax_with_loss": "AdaptiveLogSoftmaxWithLoss layer: tests/test_nn_longtail.py",
     "margin_cross_entropy": "tests/test_nn_longtail.py",
     "hsigmoid_loss": "tests/test_nn_longtail.py",
     "gaussian_nll_loss": "test_op_sweep.py::test_remaining_losses_match_references (torch oracle)",
@@ -1310,13 +1310,13 @@ FUNCTIONAL_SKIPS = {
     "adaptive_max_pool1d": "test_op_sweep.py::test_pool_family_matches_torch / test_max_unpool_roundtrip",
     "adaptive_max_pool2d": "test_op_sweep.py::test_pool_family_matches_torch / test_max_unpool_roundtrip",
     "adaptive_max_pool3d": "test_op_sweep.py::test_pool_family_matches_torch / test_max_unpool_roundtrip",
-    "fractional_max_pool2d": "test_op_sweep.py::test_pool_family_matches_torch / test_max_unpool_roundtrip",
-    "fractional_max_pool3d": "test_op_sweep.py::test_pool_family_matches_torch / test_max_unpool_roundtrip",
+    "fractional_max_pool2d": "test_op_sweep.py::test_fractional_max_pool_properties",
+    "fractional_max_pool3d": "test_op_sweep.py::test_fractional_max_pool_properties",
     "lp_pool1d": "test_op_sweep.py::test_pool_family_matches_torch / test_max_unpool_roundtrip",
     "lp_pool2d": "test_op_sweep.py::test_pool_family_matches_torch / test_max_unpool_roundtrip",
-    "max_unpool1d": "test_op_sweep.py::test_pool_family_matches_torch / test_max_unpool_roundtrip",
+    "max_unpool1d": "test_op_sweep.py::test_max_unpool_roundtrip",
     "max_unpool2d": "test_op_sweep.py::test_pool_family_matches_torch / test_max_unpool_roundtrip",
-    "max_unpool3d": "test_op_sweep.py::test_pool_family_matches_torch / test_max_unpool_roundtrip",
+    "max_unpool3d": "test_op_sweep.py::test_max_unpool_roundtrip",
     "pad": "tests/test_op_numeric_grad.py (spatial + nd forms)",
     "zeropad2d": "test_op_sweep.py::test_zeropad2d_and_sequence_mask",
     "unfold": "test_op_sweep.py::test_fold_unfold_roundtrip_and_torch_parity",
@@ -1333,10 +1333,10 @@ FUNCTIONAL_SKIPS = {
     "scaled_dot_product_attention": "tests/test_pallas_kernels.py / test_context_parallel.py",
     "flash_attention": "test_op_sweep.py::test_flash_attn_wrappers_and_gather_tree + test_pallas_kernels.py",
     "flash_attn_qkvpacked": "test_op_sweep.py::test_flash_attn_wrappers_and_gather_tree",
-    "flash_attn_unpadded": "tests/test_pallas_kernels.py / test_context_parallel.py",
-    "flash_attn_varlen_qkvpacked": "tests/test_pallas_kernels.py / test_context_parallel.py",
-    "flashmask_attention": "tests/test_pallas_kernels.py / test_context_parallel.py",
-    "sparse_attention": "tests/test_pallas_kernels.py / test_context_parallel.py",
+    "flash_attn_unpadded": "test_op_sweep.py::test_varlen_and_flashmask_attention_wrappers",
+    "flash_attn_varlen_qkvpacked": "test_op_sweep.py::test_varlen_and_flashmask_attention_wrappers",
+    "flashmask_attention": "test_op_sweep.py::test_varlen_and_flashmask_attention_wrappers",
+    "sparse_attention": "tests/test_nn_longtail.py::test_sparse_attention_matches_dense",
     "swiglu": "fused-op parity: tests/test_moe_incubate.py (fused-op parity)",
     # random / value-nondeterministic
     "dropout": "random; rescale/identity semantics in test_op_sweep.py::test_dropout2d_and_bernoulli_semantics; in-kernel flash variant in test_pallas_kernels.py",
