@@ -392,14 +392,17 @@ def _bench_other(model_name):
                 "params": n_params}
 
     if model_name in ("llama_serve", "llama_serve_spec"):
-        # continuous-batching engine (inference/llm_engine.py): mixed-length
-        # requests through fixed slots, chunked prefill, per-step host
-        # transfer = one [B] token vector. Unlike llama_decode's fully
-        # on-device loop, each step round-trips the tunnel, so tunnel
-        # latency bounds this number; on a local chip the step rate is
-        # compute-bound.
+        # ASYNC serving subsystem (paddle_tpu/serving/ over
+        # inference/llm_engine.py): mixed-length requests through fixed
+        # slots, chunked prefill, per-step host transfer = one [B] token
+        # vector — now driven by AsyncLLMServer's PIPELINED loop (step
+        # N+1 dispatched before step N's token sync, so the tunnel RTT of
+        # the transfer overlaps the next step's device compute) with
+        # per-stage telemetry attributing the serve wall (VERDICT r5 #4:
+        # the old sync loop left ~76% of wall unexplained).
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
         from paddle_tpu.inference import LLMEngine
+        from paddle_tpu.serving import AsyncLLMServer
         # speculation's regime is LATENCY-bound serving: at batch 1 the
         # 6-token verify window streams the same weights as a 1-token step,
         # so accepted drafts are nearly free (measured B=1: spec 54.7 vs
@@ -457,7 +460,9 @@ def _bench_other(model_name):
         # warm the programs (prefill + step) outside the timed window
         eng.generate([prompts[0]], max_new_tokens=2)
         # tunnel RTT estimate: a scalar fetch of resident device data
-        # (VERDICT r4 #5 — split serve wall into RTT vs device compute)
+        # (VERDICT r4 #5). Under the pipelined loop the RTT of the token
+        # transfer overlaps step N+1's compute, so this is reported as
+        # context, not as an exclusive wall share.
         rtts = []
         for _ in range(5):
             t0 = time.perf_counter()
@@ -465,16 +470,36 @@ def _bench_other(model_name):
             rtts.append(time.perf_counter() - t0)
         rtt = sorted(rtts)[len(rtts) // 2]
         eng.reset_stats()
+        server = AsyncLLMServer(eng, max_queue_size=n_req + 1)
+        server.start()
         t0 = time.perf_counter()
-        outs = eng.generate(prompts, max_new_tokens=new_tokens)
+        handles = [server.submit(p, max_new_tokens=new_tokens)
+                   for p in prompts]
+        outs = [h.result(timeout=1800) for h in handles]
         wall = time.perf_counter() - t0
+        server.stop()
         toks = sum(len(o.token_ids) for o in outs)
         steps = eng.stats["steps"]
-        rtt_s = steps * rtt
+        snap = server.telemetry.snapshot(wall_s=wall)
+        att = snap["attribution"]
+        lat = snap["latency"]
+        # r05 sync-loop baselines (BENCH_r05.json): serve 1,158.9 tok/s,
+        # spec 46.8 — comparable ONLY at the exact captured config (on-chip
+        # defaults, bf16); any overridden knob makes the ratio meaningless,
+        # so it degrades to null exactly like the other bench lines
+        at_r05_config = (
+            B == (1 if spec_mode else 8) and new_tokens == 64
+            and n_req == (3 if spec_mode else 16) and n_layers == 3
+            and hidden == 4096 and ff == hidden * 11 // 4
+            and horizon == (8 if spec_k > 1 else 64)
+            and spec_k == (6 if spec_mode else 1) and not weight_dtype
+            and jax.default_backend() != "cpu")
+        base_toks = 46.8 if spec_k > 1 else 1158.9
         out = {"metric": ("llama_serve_spec_tokens_per_sec" if spec_k > 1
                           else "llama_serve_tokens_per_sec"),
                "value": round(toks / wall, 1), "unit": "tokens/s",
-               "vs_baseline": None,
+               "vs_baseline": (round(toks / wall / base_toks, 4)
+                               if at_r05_config else None),
                "requests_per_sec": round(n_req / wall, 2),
                "steps_per_sec": round(steps / wall, 1),
                "requests": n_req, "slots": B,
@@ -483,13 +508,15 @@ def _bench_other(model_name):
                "new_tokens": new_tokens,
                "prefill_chunks": eng.stats["prefill_chunks"],
                "horizon": horizon,
-               # wall split: per-step tunnel RTT + host admit enqueue; the
-               # remainder is device compute (decode scan + the async
-               # prefill chunks that complete inside the next step read)
+               "pipeline_depth": server.pipeline_depth,
+               # per-stage wall attribution from the serving telemetry —
+               # replaces the one-scalar RTT split that left ~76% of r05
+               # serve wall unexplained
+               "attributed_share": att["attributed_share"],
+               "stage_share": att["stage_share"],
+               "ttft_p50_ms": round(lat["ttft"]["p50_s"] * 1e3, 1),
+               "e2e_p50_ms": round(lat["e2e"]["p50_s"] * 1e3, 1),
                "rtt_est_ms": round(rtt * 1e3, 1),
-               "rtt_share": round(rtt_s / wall, 3),
-               "admit_host_share": round(
-                   eng.stats["admit_time_s"] / wall, 3),
                "weight_dtype": weight_dtype or "bf16"}
         if spec_k > 1:
             out["speculative_k"] = spec_k

@@ -1,42 +1,70 @@
-"""Serve a llama-family model with the continuous-batching engine.
+"""Serve a llama-family model through the async serving subsystem.
 
 Run: python examples/serve_llama.py          # tiny demo model, mixed requests
-Shows: ragged admission, streaming, per-request sampling params,
-speculative decoding, int8 weight-only quantization.
+Shows: the AsyncLLMServer front (pipelined background engine loop, bounded
+admission queue, per-request streaming iterators, deadlines/cancellation,
+per-stage telemetry with a Prometheus dump), plus the bare-engine loop for
+comparison (ragged admission, per-request sampling params, speculative
+decoding, int8 weight-only quantization).
 """
 import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu.inference import LLMEngine
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import AsyncLLMServer
 
 
-def main():
+def build_model():
     paddle.seed(0)
     cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
                       num_hidden_layers=2, num_attention_heads=4,
                       num_key_value_heads=4, max_position_embeddings=256)
     model = LlamaForCausalLM(cfg).bfloat16()
     model.eval()
-
     # optional: int8 weight-only serving (measured ~2x decode throughput)
     # from paddle_tpu.nn.quant import quantize_linears_for_inference
     # quantize_linears_for_inference(model, weight_dtype="int8")
+    return model
 
-    eng = LLMEngine(model, max_batch=4, max_seq_len=128, chunk_size=32,
-                    speculative_k=4,          # prompt-lookup speculation
-                    stream_callback=lambda rid, tok: print(
-                        f"  [req {rid}] token {tok}", flush=True))
 
+def main():
+    model = build_model()
     rng = np.random.default_rng(0)
-    for n, temp in ((12, 0.0), (7, 0.8), (20, 0.0)):
-        eng.add_request(rng.integers(1, 512, size=(n,)).astype(np.int32),
-                        max_new_tokens=6, temperature=temp)
-    while eng.has_unfinished():
-        for out in eng.step():
+
+    # -- the production shape: AsyncLLMServer --------------------------
+    eng = LLMEngine(model, max_batch=4, max_seq_len=128, chunk_size=32)
+    with AsyncLLMServer(eng, max_queue_size=16) as server:
+        handles = [
+            server.submit(rng.integers(1, 512, size=(n,)).astype(np.int32),
+                          max_new_tokens=6, temperature=temp,
+                          deadline_s=60.0)
+            for n, temp in ((12, 0.0), (7, 0.8), (20, 0.0))]
+        for h in handles:
+            # per-request streaming iterator: tokens as they decode
+            for tok in h:
+                print(f"  [req {h.request_id}] token {tok}", flush=True)
+            res = h.result()
+            print(f"req {res.request_id} done ({res.finish_reason}): "
+                  f"{res.token_ids}  ttft={res.ttft_s:.3f}s")
+    print(server.telemetry.prometheus_text().splitlines()[0], "...")
+    att = server.telemetry.snapshot()["attribution"]
+    print(f"serve wall attributed: {att['attributed_share']:.0%} "
+          f"across {list(att['stage_share'])}")
+
+    # -- the bare engine loop (speculative decoding demo) --------------
+    eng2 = LLMEngine(model, max_batch=4, max_seq_len=128, chunk_size=32,
+                     speculative_k=4,          # prompt-lookup speculation
+                     stream_callback=lambda rid, tok: print(
+                         f"  [req {rid}] token {tok}", flush=True))
+    for n, temp in ((12, 0.0), (7, 0.8)):
+        eng2.add_request(rng.integers(1, 512, size=(n,)).astype(np.int32),
+                         max_new_tokens=6, temperature=temp)
+    while eng2.has_unfinished():
+        for out in eng2.step():
             print(f"req {out.request_id} done ({out.finish_reason}): "
                   f"{out.token_ids}")
-    print(f"engine stats: {eng.stats}")
+    print(f"engine stats: {eng2.stats}")
 
 
 if __name__ == "__main__":

@@ -48,7 +48,7 @@ _LAZY_SUBMODULES = (
     "nn", "optimizer", "autograd", "amp", "jit", "io", "distributed", "vision",
     "static", "device", "profiler", "metric", "hapi", "incubate", "utils", "text",
     "sparse", "linalg", "fft", "signal", "distribution", "audio", "geometric",
-    "tensor", "regularizer", "quantization", "inference", "onnx",
+    "tensor", "regularizer", "quantization", "inference", "onnx", "serving",
 )
 
 

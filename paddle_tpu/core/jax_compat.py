@@ -1,0 +1,36 @@
+"""Version compatibility shims for the jax API surface.
+
+One symbol today: ``shard_map``. The framework's manual-collective code
+(pipeline schedules, ring/Ulysses context parallelism, expert-parallel
+MoE) is written against the modern top-level ``jax.shard_map`` API
+(``axis_names=...`` for partial-manual meshes, ``check_vma=...``). On
+jax < 0.5 that function lives at ``jax.experimental.shard_map.shard_map``
+with the older kwargs (``auto`` = the complement of the manual axes,
+``check_rep``); the adapter below translates so every call site can stay
+written against the modern API.
+"""
+from __future__ import annotations
+
+__all__ = ["shard_map"]
+
+try:
+    from jax import shard_map  # jax >= 0.5: the stable top-level API
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _experimental_sm
+
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=None, **kwargs):
+        """Modern-API adapter over the pre-0.5 experimental shard_map.
+
+        * ``axis_names={...}`` (axes that are MANUAL) becomes
+          ``auto = mesh.axis_names - axis_names`` (axes that stay
+          automatic/GSPMD).
+        * ``check_vma`` (renamed) becomes ``check_rep``.
+        """
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        if axis_names is not None:
+            kwargs["auto"] = frozenset(mesh.axis_names) - \
+                frozenset(axis_names)
+        return _experimental_sm(f, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs, **kwargs)

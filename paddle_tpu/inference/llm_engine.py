@@ -41,7 +41,16 @@ import numpy as np
 from ..core.tensor import Tensor, functional_mode
 from ..models.llama import SlotKVCache, _sample_logits_device
 
-__all__ = ["LLMEngine", "GenerationRequest", "RequestOutput"]
+__all__ = ["LLMEngine", "GenerationRequest", "RequestOutput", "PendingStep",
+           "PoolCapacityError"]
+
+
+class PoolCapacityError(RuntimeError):
+    """The head waiting request's prompt cannot prefill into the paged
+    pool at all (kv_pool_blocks too small). A RuntimeError subclass so
+    existing callers keep working; the serving layer catches exactly this
+    type to reject the one doomed request instead of treating unrelated
+    runtime errors (device/compile failures) as per-request problems."""
 
 
 @dataclasses.dataclass
@@ -69,6 +78,32 @@ class _Slot:
         self.req = req
         self.generated = []
         self.prompt_len = prompt_len
+
+
+class PendingStep:
+    """One in-flight decode step: device-array futures dispatched by
+    :meth:`LLMEngine.step_begin`, host readout deferred to
+    :meth:`LLMEngine.step_finish`.
+
+    This split is what makes PIPELINED serving (``paddle_tpu.serving``)
+    possible: a second ``step_begin()`` may be dispatched before the first
+    ``step_finish()``, so JAX async dispatch overlaps step N+1's device
+    compute with step N's device→host token transfer and host readout.
+    ``slots`` snapshots the slot objects at dispatch time — a slot retired
+    and reused between dispatch and finish fails the identity check at
+    readout and its stale token column is dropped (it was decoded against
+    the OLD request's state)."""
+
+    __slots__ = ("toks", "was_active", "counts", "spec", "slots",
+                 "pool_done")
+
+    def __init__(self, toks, was_active, counts, spec, slots, pool_done):
+        self.toks = toks              # device [rows, B] (spec: [Kh,B,Ks])
+        self.was_active = was_active  # device activity history
+        self.counts = counts          # spec only: accepted counts [Kh, B]
+        self.spec = spec
+        self.slots = slots            # list[_Slot|None] snapshot at dispatch
+        self.pool_done = pool_done    # outputs retired by the pool allocator
 
 
 class LLMEngine:
@@ -210,9 +245,15 @@ class LLMEngine:
         self._step_fn = None
         self._prefill_fn = None
         self._set_logits_fn = None
+        #: outstanding step_begin() dispatches not yet step_finish()ed —
+        #: the paged engine must stay at depth 1 (its host block allocator
+        #: needs post-step lens before the next dispatch)
+        self._inflight = 0
         self.stats = {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
-                      "draft_tokens_accepted": 0, "decode_time_s": 0.0,
-                      "admit_time_s": 0.0}
+                      "draft_tokens_accepted": 0, "preemptions": 0,
+                      "decode_time_s": 0.0, "admit_time_s": 0.0,
+                      "dispatch_time_s": 0.0, "host_sync_time_s": 0.0,
+                      "emit_time_s": 0.0}
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -498,17 +539,19 @@ class LLMEngine:
     def has_unfinished(self):
         return bool(self.waiting) or any(s is not None for s in self.slots)
 
-    def cancel(self, request_id):
+    def cancel(self, request_id, reason="cancelled"):
         """Cancel a waiting or running request. Returns the partial
-        RequestOutput (finish_reason 'cancelled'), or None if the id is
-        unknown/already finished. A cancelled running slot frees at the
-        next step boundary (its KV region is simply reused)."""
+        RequestOutput (finish_reason ``reason``, default 'cancelled' —
+        the serving layer passes 'deadline' for expiries), or None if the
+        id is unknown/already finished. A cancelled running slot frees
+        immediately (slot and, under paged KV, its pool blocks); its KV
+        region is simply reused by the next admission."""
         for i, req in enumerate(self.waiting):
             if req.request_id == request_id:
                 del self.waiting[i]
                 out = RequestOutput(
                     request_id, self._finish_tokens(req, []), True,
-                    "cancelled")
+                    reason)
                 self.finished_outputs[request_id] = out
                 return out
         for b, slot in enumerate(self.slots):
@@ -516,7 +559,7 @@ class LLMEngine:
                 out = RequestOutput(
                     request_id,
                     self._finish_tokens(slot.req, slot.generated), True,
-                    "cancelled")
+                    reason)
                 self.finished_outputs[request_id] = out
                 self._free_slot(b)
                 return out
@@ -542,6 +585,16 @@ class LLMEngine:
         have = len(self._slot_blocks[slot_idx])
         return need <= have or self._alloc_blocks(slot_idx, need - have)
 
+    def prefill_blocks_needed(self, prompt_len):
+        """Pool blocks the chunked prefill of a ``prompt_len``-token
+        prompt must cover: prefill writes whole chunks, block-quantized.
+        THE one copy of this arithmetic — admission, the too-small-pool
+        check, the self-preempt recoverability guard, and the serving
+        layer's synchronous validation all call it."""
+        pad_end = min(-(-prompt_len // self.chunk) * self.chunk,
+                      self.capacity)
+        return -(-pad_end // self.block_size)
+
     def _free_slot(self, slot_idx):
         if self.cache_impl == "paged":
             self._free_blocks.extend(self._slot_blocks[slot_idx])
@@ -549,7 +602,7 @@ class LLMEngine:
             self._tables[slot_idx, :] = -1
         self.slots[slot_idx] = None
 
-    def _preempt_newest(self, exclude=None, newer_than=None):
+    def _preempt_newest(self, exclude=None, newer_than=None, retired=None):
         """Pool pressure: evict the most recently admitted active slot back
         to the FRONT of the waiting queue (its committed tokens join the
         prompt, so re-prefill reproduces the identical greedy state) and
@@ -566,10 +619,34 @@ class LLMEngine:
         if not candidates:
             return None
         b = max(candidates, key=lambda i: self._admit_order[i])
+        self._preempt_slot(b, retired=retired)
+        return b
+
+    def _preempt_slot(self, b, retired=None):
+        """Evict slot ``b`` back to the FRONT of the waiting queue: its
+        committed tokens join the prompt so re-prefill reproduces the
+        identical greedy state, and its blocks free for older slots.
+
+        Recoverability guard: chunk-rounded re-prefill can need MORE
+        blocks than the slot currently holds, so a grown prompt may no
+        longer fit the pool AT ALL — parking it would stall the FIFO and
+        end in the loud too-small-pool error, losing its stream. Such a
+        slot retires gracefully instead (finish_reason 'preempted_pool',
+        appended to ``retired`` so step_finish returns it)."""
         slot = self.slots[b]
         req = slot.req
         done = np.concatenate([req.prompt_ids,
                                np.asarray(slot.generated, np.int32)])
+        if self.prefill_blocks_needed(len(done)) > self.n_blocks:
+            out = RequestOutput(
+                req.request_id,
+                self._finish_tokens(req, slot.generated), True,
+                "preempted_pool")
+            self.finished_outputs[req.request_id] = out
+            if retired is not None:
+                retired.append(out)
+            self._free_slot(b)
+            return
         prefix = self._preempted_prefix.get(req.request_id, [])
         self._preempted_prefix[req.request_id] = \
             list(prefix) + list(slot.generated)
@@ -578,7 +655,7 @@ class LLMEngine:
             req.max_new_tokens - len(slot.generated),
             req.temperature, req.top_p, req.eos_token_id))
         self._free_slot(b)
-        return b
+        self.stats["preemptions"] += 1
 
     def _finish_tokens(self, req, generated):
         """Full output stream incl. tokens committed before a preemption."""
@@ -598,7 +675,9 @@ class LLMEngine:
         if paged:
             # prefill writes whole chunks: cover round_up(P, chunk), then
             # release the over-allocation down to the prompt's own blocks
-            pad_end = min(-(-P // self.chunk) * self.chunk, self.capacity)
+            # (chunk is a block multiple, so blocks-needed * block_size
+            # IS the padded end position)
+            pad_end = self.prefill_blocks_needed(P) * self.block_size
             if not self._ensure_blocks(slot_idx, pad_end - 1):
                 return False
         off = 0
@@ -682,8 +761,37 @@ class LLMEngine:
         """Admit waiting requests into free slots, run ONE decode step for
         all active slots, retire finished requests. Returns the list of
         RequestOutput finished by this step."""
+        pending = self.step_begin()
+        if pending is None:
+            return []
+        return self.step_finish(pending)
+
+    def step_begin(self):
+        """Admit waiting requests into free slots and DISPATCH one decode
+        step for all active slots WITHOUT reading anything back. Returns a
+        :class:`PendingStep` for :meth:`step_finish`, or None when there is
+        nothing to run.
+
+        Pipelining contract (dense and speculative engines): a second
+        ``step_begin()`` may be called before the first ``step_finish()``
+        — the chained dispatch consumes the first step's device futures,
+        so the device runs ahead of the host by one step. Host request
+        state is one step stale at the chained dispatch; that is safe
+        because (a) the in-graph guards (eos, budget, capacity) deactivate
+        slots from DEVICE state, (b) a slot the host retires between
+        dispatch and finish fails the PendingStep identity check and its
+        stale tokens are dropped, and (c) over-decode past a budget is
+        bounded by one horizon and truncated by the host readout. The
+        PAGED engine allocates pool blocks from host lens before each
+        dispatch, so it must run depth 1 (finish before the next begin —
+        enforced)."""
         from ..core import random as _random
 
+        if self.cache_impl == "paged" and self._inflight:
+            raise RuntimeError(
+                "paged engine cannot pipeline step_begin() calls: its "
+                "block allocator needs the previous step's lens "
+                "(step_finish the outstanding PendingStep first)")
         self._admit_waiting()
         if not any(s is not None for s in self.slots):
             if self.waiting and self.cache_impl == "paged":
@@ -692,16 +800,14 @@ class LLMEngine:
                 # than letting generate() spin forever
                 req = self.waiting[0]
                 P = len(req.prompt_ids)
-                pad_end = min(-(-P // self.chunk) * self.chunk,
-                              self.capacity)
-                need = -(-pad_end // self.block_size)
+                need = self.prefill_blocks_needed(P)
                 if need > self.n_blocks:
-                    raise RuntimeError(
+                    raise PoolCapacityError(
                         f"request {req.request_id}: prefilling its "
                         f"{P}-token prompt needs {need} KV blocks but the "
                         f"pool has {self.n_blocks} total (kv_pool_blocks "
                         f"too small)")
-            return []
+            return None
         self._programs()
         if self._rng_key is None:
             seed, counter = _random.default_generator.next_seed()
@@ -743,16 +849,30 @@ class LLMEngine:
                         pool_budget[b] = covered - cur
                         break
                     victim = self._preempt_newest(
-                        exclude=b, newer_than=self._admit_order[b])
+                        exclude=b, newer_than=self._admit_order[b],
+                        retired=pool_done)
                     if victim is None:
-                        # this slot alone exceeds the pool and can't write
-                        # even one token: retire it at the pool edge
-                        # rather than letting the masked block writes
-                        # corrupt its stream
+                        # no NEWER victim: this slot is the newest active.
+                        # If OLDER slots are still running, self-preempt —
+                        # park the request back on the waiting queue (its
+                        # re-prefill path reproduces the identical greedy
+                        # state; _preempt_slot's recoverability guard
+                        # retires it instead when the grown prompt has
+                        # outgrown the pool) and let it resume once an
+                        # older slot retires and frees blocks. Only the
+                        # SOLE active slot must retire outright (parking
+                        # it would readmit into the same dry pool and
+                        # spin) — with the distinct 'preempted_pool'
+                        # reason, not 'capacity' (the engine's
+                        # sequence-length cap).
+                        if any(s is not None and i != b
+                               for i, s in enumerate(self.slots)):
+                            self._preempt_slot(b, retired=pool_done)
+                            break
                         out = RequestOutput(
                             slot.req.request_id,
                             self._finish_tokens(slot.req, slot.generated),
-                            True, "capacity")
+                            True, "preempted_pool")
                         self.finished_outputs[slot.req.request_id] = out
                         pool_done.append(out)
                         self._free_slot(b)
@@ -760,7 +880,10 @@ class LLMEngine:
 
         active = np.array([s is not None for s in self.slots])
         if not active.any():
-            return pool_done
+            if pool_done:
+                return PendingStep(None, None, None, spec, list(self.slots),
+                                   pool_done)
+            return None
         temps = np.array([s.req.temperature if s else 0.0
                           for s in self.slots], np.float32)
         top_ps = np.array([s.req.top_p if s else 1.0
@@ -775,25 +898,52 @@ class LLMEngine:
 
         # the decode clock starts HERE: pool-allocator scans and host array
         # construction above must not masquerade as device decode time in
-        # throughput() or the serve bench's wall split
+        # throughput() or the serve bench's wall split. All three arms
+        # DISPATCH only — no host read; JAX async dispatch returns futures
+        # and the transfer blocks in step_finish().
         t0 = time.perf_counter()
+        counts = None
         if self.cache_impl == "paged":
             (toks, was_active, self._logits, self._k, self._v, self._lens,
              self._rng_key) = self._step_paged_fn(
                 self._state_vals, self._k, self._v, self._logits,
                 self._lens, active, self._rng_key, temps, top_ps, eos_ids,
                 budgets, self._tables.copy())
-            toks_np = np.asarray(toks)
-            act_np = np.asarray(was_active)
         elif spec:
             (toks, counts, was_active, self._logits, self._k, self._v,
              self._lens, self._rng_key, self._tokens) = self._spec_fn(
                 self._state_vals, self._k, self._v, self._logits,
                 self._lens, active, self._rng_key,
                 temps, top_ps, eos_ids, budgets, self._tokens)
-            toks3 = np.asarray(toks)          # [Kh, B, Kspec]
-            counts_np = np.asarray(counts)    # [Kh, B]
-            wa_np = np.asarray(was_active)    # [Kh, B]
+        else:
+            (toks, was_active, self._logits, self._k, self._v, self._lens,
+             self._rng_key) = self._step_fn(
+                self._state_vals, self._k, self._v, self._logits,
+                self._lens, active, self._rng_key,
+                temps, top_ps, eos_ids, budgets)
+        dt = time.perf_counter() - t0
+        self.stats["dispatch_time_s"] += dt
+        self.stats["decode_time_s"] += dt
+        self._inflight += 1
+        return PendingStep(toks, was_active, counts, spec, list(self.slots),
+                           pool_done)
+
+    def step_finish(self, pending):
+        """Block on ``pending``'s device→host token transfer, attribute the
+        tokens to the slots captured at dispatch time, retire finished
+        requests. Returns the list of RequestOutput finished by this
+        step. Tokens of a slot whose occupant changed since dispatch
+        (retired, cancelled, preempted — possibly already reused) are
+        dropped: they were decoded for the old occupant's state."""
+        spec = pending.spec
+        if pending.toks is None:
+            return list(pending.pool_done)
+        self._inflight -= 1
+        t0 = time.perf_counter()
+        if spec:
+            toks3 = np.asarray(pending.toks)          # [Kh, B, Kspec]
+            counts_np = np.asarray(pending.counts)    # [Kh, B]
+            wa_np = np.asarray(pending.was_active)    # [Kh, B]
             Kh, B_, Ks = toks3.shape
             # flatten windows into the [rows, B] stream the readout walks;
             # a window row i is live for slot b iff i < counts (acceptance
@@ -804,19 +954,19 @@ class LLMEngine:
                        counts_np[:, None, :]) &
                       wa_np[:, None, :]).reshape(Kh * Ks, B_)
         else:
-            (toks, was_active, self._logits, self._k, self._v, self._lens,
-             self._rng_key) = self._step_fn(
-                self._state_vals, self._k, self._v, self._logits,
-                self._lens, active, self._rng_key,
-                temps, top_ps, eos_ids, budgets)
-            toks_np = np.asarray(toks)       # [K, B] — the per-step transfer
-            act_np = np.asarray(was_active)  # [K, B]
-        self.stats["decode_time_s"] += time.perf_counter() - t0
+            toks_np = np.asarray(pending.toks)       # [K, B] — THE transfer
+            act_np = np.asarray(pending.was_active)  # [K, B]
+        dt = time.perf_counter() - t0
+        self.stats["host_sync_time_s"] += dt
+        self.stats["decode_time_s"] += dt
         self.stats["steps"] += 1
 
-        done = list(pool_done) if self.cache_impl == "paged" else []
-        for b, slot in enumerate(self.slots):
-            if slot is None:
+        t0 = time.perf_counter()
+        done = list(pending.pool_done)
+        for b, slot in enumerate(pending.slots):
+            if slot is None or self.slots[b] is not slot:
+                # empty at dispatch, or retired/preempted/cancelled (and
+                # possibly reused) since: stale column, skip
                 continue
             finish_reason = None
             n_read = 0
@@ -872,6 +1022,7 @@ class LLMEngine:
                 done.append(out)
                 # slot (and its KV blocks) freed; next step admits into it
                 self._free_slot(b)
+        self.stats["emit_time_s"] += time.perf_counter() - t0
         return done
 
     def generate(self, prompts, **sampling):

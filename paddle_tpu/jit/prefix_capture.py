@@ -137,6 +137,17 @@ class _OpRecord:
 _MAX_CONST = 1024
 
 
+def _replay_key(key_base, op_idx, kind, j):
+    """Per-op replay PRNG stream: NESTED fold_in — first the op index
+    (one disjoint stream per recorded op), then a tagged in-op index
+    (even = arg-position key j, odd = closure-cell key j). The old
+    single-level ``fold_in(base, op_idx * 16 + j)`` collided as soon as
+    an op carried more than 8 cell keys or 16 arg keys (op i's stream ran
+    into op i+1's); nesting removes the arithmetic overlap entirely."""
+    tag = 2 * j if kind == "arg" else 2 * j + 1
+    return jax.random.fold_in(jax.random.fold_in(key_base, op_idx), tag)
+
+
 def _run_records(records, input_vals, rng_key=None):
     """THE prefix execution contract: symbolically replay every recorded op
     against ``input_vals``, returning the per-op tensor-output lists. Shared
@@ -172,8 +183,7 @@ def _run_records(records, input_vals, rng_key=None):
                         vals.append(outs[p[1]][p[2]])
                     elif p[0] == "rng":
                         # arg-position PRNG key: fresh per replay
-                        vals.append(jax.random.fold_in(
-                            key_base, idx * 16 + p[1]))
+                        vals.append(_replay_key(key_base, idx, "arg", p[1]))
                     else:
                         vals.append(p[1])
             vals = T._maybe_amp_cast(r.name, vals)
@@ -184,8 +194,8 @@ def _run_records(records, input_vals, rng_key=None):
                 # rebuild the closure with fresh derived keys
                 cells = list(fn.__closure__)
                 for j, ci in enumerate(r.key_cells):
-                    cells[ci] = types.CellType(jax.random.fold_in(
-                        key_base, idx * 16 + 8 + j))
+                    cells[ci] = types.CellType(
+                        _replay_key(key_base, idx, "cell", j))
                 fn = types.FunctionType(fn.__code__, fn.__globals__,
                                         fn.__name__, fn.__defaults__,
                                         tuple(cells))

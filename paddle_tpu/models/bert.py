@@ -46,6 +46,11 @@ class BertConfig:
     # of the MLM step (~6 GB at batch 96) and the tensor whose scheduling
     # made the B=96 compile OOM nondeterministically. Costs one extra
     # head-matmul pass in backward (~+6% step FLOPs for bert-base).
+    # CONTRACT: with labels, forward returns (loss, logits) on the
+    # unfused path but (loss, <FusedLogitsUnavailable>) under this flag —
+    # the placeholder is falsy and raises a RuntimeError naming the flag
+    # if consumed (models/common.py). Callers needing logits must run
+    # unfused or call without labels.
     fuse_mlm_head_ce: bool = False
 
     @staticmethod
@@ -147,7 +152,8 @@ class BertForMaskedLM(Layer):
                 (ops.reshape(h, [-1, self.config.hidden_size]),
                  self.decoder.weight, self.decoder.bias,
                  ops.reshape(labels, [-1])), {}, name="fused_linear_ce")
-            return loss, None
+            from .common import FusedLogitsUnavailable
+            return loss, FusedLogitsUnavailable("fuse_mlm_head_ce")
         logits = self.decoder(h)
         if labels is None:
             return logits

@@ -1,0 +1,59 @@
+"""Shared model-head helpers.
+
+Currently: the guard object the fused-head paths return in place of
+logits (see :class:`FusedLogitsUnavailable`).
+"""
+from __future__ import annotations
+
+__all__ = ["FusedLogitsUnavailable"]
+
+
+class FusedLogitsUnavailable:
+    """Placeholder returned as ``logits`` by the fused head+CE paths
+    (``BertConfig.fuse_mlm_head_ce`` / ``GPTConfig.fuse_lm_head_ce``).
+
+    The whole point of the fused path is to NEVER materialize the
+    [tokens, vocab] logits tensor, so the model returns ``(loss,
+    FusedLogitsUnavailable(...))`` where the unfused path returns
+    ``(loss, logits)``. The object is falsy (so ``if logits:`` guards
+    behave like the old ``None``), but ANY real consumption — attribute
+    access, indexing, iteration, numpy conversion — raises a RuntimeError
+    naming the flag to turn off, instead of the bare
+    ``'NoneType' object has no attribute ...`` the old contract produced.
+    """
+
+    __slots__ = ("_flag",)
+
+    def __init__(self, flag):
+        object.__setattr__(self, "_flag", flag)
+
+    def _raise(self, *a, **k):
+        flag = object.__getattribute__(self, "_flag")
+        raise RuntimeError(
+            f"logits are not materialized under {flag}=True — the fused "
+            f"head computes the loss without the [tokens, vocab] logits "
+            f"tensor. Disable {flag} (or call the model without labels) "
+            f"to get logits.")
+
+    def __bool__(self):
+        return False
+
+    def __repr__(self):
+        return (f"<FusedLogitsUnavailable "
+                f"{object.__getattribute__(self, '_flag')}=True>")
+
+    def __getattr__(self, name):
+        # dunder probes (copy/pickle/inspection machinery) get the normal
+        # AttributeError; real consumption (.numpy(), ._value, .shape, …)
+        # gets the explanatory RuntimeError
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        self._raise()
+
+    # every other consumption path raises the explanatory error
+    __getitem__ = _raise
+    __iter__ = _raise
+    __len__ = _raise
+    __array__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = _raise
+    __mul__ = __rmul__ = __matmul__ = __rmatmul__ = _raise

@@ -44,7 +44,12 @@ class GPTConfig:
         self.tie_word_embeddings = tie_word_embeddings
         # chunked fused (lm_head matmul + CE): never materializes the full
         # [tokens, vocab] logits — the largest single activation of the LM
-        # step (see ops/kernels/fused_ce.py fused_linear_ce)
+        # step (see ops/kernels/fused_ce.py fused_linear_ce).
+        # CONTRACT: with labels, forward returns (loss, logits) on the
+        # unfused path but (loss, <FusedLogitsUnavailable>) under this
+        # flag — the placeholder is falsy and raises a RuntimeError naming
+        # the flag if consumed (models/common.py). Callers needing logits
+        # must run unfused or call without labels.
         self.fuse_lm_head_ce = fuse_lm_head_ce
 
 
@@ -141,7 +146,8 @@ class GPT2LMHeadModel(Layer):
                              [-1, self.config.hidden_size]),
                  w, ops.reshape(labels[:, 1:], [-1])), {},
                 name="fused_linear_ce_gpt")
-            return loss, None
+            from .common import FusedLogitsUnavailable
+            return loss, FusedLogitsUnavailable("fuse_lm_head_ce")
         logits = self._logits(hidden)
         if labels is None:
             return logits

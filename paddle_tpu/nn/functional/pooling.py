@@ -147,7 +147,14 @@ def _max_pool_mask(x, kernel_size, stride, padding, data_format, nd=2,
         size = 1
         for d in spatial:
             size *= d
-        idx = jnp.arange(size, dtype=jnp.float32).reshape(
+        if size >= 2 ** 31:
+            raise ValueError(
+                f"max_pool return_mask: flattened spatial size {size} "
+                f"overflows the int32 index space (2**31)")
+        # int32 indices through the variadic reduce_window: a float32
+        # carry is only exact up to 2**24, so spatial sizes above 16.7M
+        # elements silently rounded the returned argmax positions
+        idx = jnp.arange(size, dtype=jnp.int32).reshape(
             (1, 1) + tuple(spatial))
         idx = jnp.broadcast_to(idx, v.shape)
         # select argmax index via reduce_window over (value, index) pairs
@@ -157,11 +164,10 @@ def _max_pool_mask(x, kernel_size, stride, padding, data_format, nd=2,
             take_b = bv > av
             return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
         init = (jnp.asarray(-jnp.inf, v.dtype),
-                jnp.asarray(-1.0, jnp.float32))
+                jnp.asarray(-1, jnp.int32))
         vv, ii = jax.lax.reduce_window(
             (v, idx), init, red, (1, 1) + k, (1, 1) + s,
             [(0, 0), (0, 0)] + pads)
-        ii = ii.astype(jnp.int32)
         if channel_last:
             ii = jnp.moveaxis(ii, 1, -1)
         return ii

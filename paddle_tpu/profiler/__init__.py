@@ -17,11 +17,14 @@ import time
 from enum import Enum
 
 from .timer import benchmark  # noqa: F401
+from .serving_telemetry import (  # noqa: F401
+    LatencyHistogram, ServingTelemetry)
 
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "load_profiler_result",
     "SummaryView", "benchmark", "merge_profile",
+    "ServingTelemetry", "LatencyHistogram",
 ]
 
 

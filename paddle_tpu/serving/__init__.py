@@ -1,0 +1,22 @@
+"""paddle_tpu.serving — async serving subsystem over the LLM engine.
+
+Reference analog: the serving path the reference builds from
+AnalysisPredictor + PaddleNLP's masked-MHA serving stack (SURVEY §1 layer
+6c). TPU-native shape: one background engine thread runs a **pipelined**
+continuous-batching loop over :class:`paddle_tpu.inference.LLMEngine`
+(step N+1 dispatched before step N's token sync — JAX async dispatch
+overlaps device compute with host readout), in front of a bounded
+admission queue with backpressure, per-request streaming/cancellation/
+deadlines, and per-stage telemetry
+(:mod:`paddle_tpu.profiler.serving_telemetry`).
+
+Entry point: :class:`AsyncLLMServer`.
+"""
+from .types import (RequestHandle, RequestState, ServeRequest, ServeResult,
+                    ServerClosed, ServerQueueFull)
+from .scheduler import AdmissionQueue
+from .server import AsyncLLMServer
+
+__all__ = ["AsyncLLMServer", "AdmissionQueue", "RequestHandle",
+           "RequestState", "ServeRequest", "ServeResult", "ServerClosed",
+           "ServerQueueFull"]

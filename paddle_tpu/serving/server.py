@@ -1,0 +1,439 @@
+"""AsyncLLMServer — the production-shaped serving loop over LLMEngine.
+
+Reference analog: the reference's real server is AnalysisPredictor driven
+by PaddleNLP's serving stack (SURVEY §1 layer 6c) — request queue in
+front, predictor loop behind, per-request streaming out. This module is
+that shape on the TPU-native engine, built around the one property the
+synchronous ``bench.py`` loop never exploited: **JAX async dispatch**.
+
+The engine thread runs a PIPELINED loop::
+
+    dispatch step N+1  ──►  device works on N+1
+    sync step N's [B] token vector (device→host)   ← overlapped with N+1
+    emit tokens / retire / admit (prefill dispatches are async too)
+
+so the host-side readout + request bookkeeping of step N hides under the
+device compute of step N+1 (``LLMEngine.step_begin``/``step_finish``;
+buffers are donated between steps, the only per-step transfer stays the
+sampled-token vector). The paged engine's host block allocator needs each
+step's lens before the next dispatch, so it runs the same loop at depth 1.
+
+On top of the loop sit the two serving layers the engine itself does not
+provide:
+
+* **request lifecycle** — bounded admission queue with backpressure
+  (:class:`~paddle_tpu.serving.scheduler.AdmissionQueue`), per-request
+  streaming iterators (:class:`~paddle_tpu.serving.types.RequestHandle`),
+  cancellation, and per-request deadlines that free the slot / pool
+  blocks at the next step boundary.
+* **per-stage telemetry**
+  (:class:`~paddle_tpu.profiler.serving_telemetry.ServingTelemetry`) —
+  every second of engine-thread wall time lands in a named stage
+  (queue_admit / prefill_dispatch / schedule / decode_dispatch /
+  host_sync / emit / idle / other), plus TTFT, inter-token, e2e and
+  queue-wait histograms, exported as a JSON snapshot and a
+  Prometheus-style text dump.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..inference.llm_engine import PoolCapacityError
+from ..profiler.serving_telemetry import ServingTelemetry
+from .scheduler import AdmissionQueue
+from .types import (RequestHandle, RequestState, ServeRequest, ServeResult,
+                    ServerClosed)
+
+__all__ = ["AsyncLLMServer"]
+
+
+class AsyncLLMServer:
+    """Async serving facade over one :class:`LLMEngine`.
+
+    The server OWNS the engine once started: all engine calls happen on
+    the background engine thread; callers interact only through
+    :meth:`submit` handles. ``pipeline_depth`` None = auto (2 for the
+    dense/speculative engines, 1 for paged — see module docstring).
+
+    Usage::
+
+        server = AsyncLLMServer(engine, max_queue_size=64)
+        server.start()
+        handle = server.submit(prompt_ids, max_new_tokens=64,
+                               deadline_s=30.0)
+        for tok in handle:          # streams as the engine decodes
+            ...
+        result = handle.result()    # ServeResult(finish_reason=...)
+        server.stop()
+    """
+
+    def __init__(self, engine, max_queue_size=64, pipeline_depth=None,
+                 poll_interval_s=0.005, telemetry=None):
+        if pipeline_depth is not None and pipeline_depth < 1:
+            raise ValueError(f"pipeline_depth must be >= 1, "
+                             f"got {pipeline_depth}")
+        self.engine = engine
+        if engine.cache_impl == "paged":
+            # the paged host block allocator needs step N's lens before
+            # dispatching N+1 — depth is structurally 1
+            self.pipeline_depth = 1
+        else:
+            # the loop dispatches at most ONE step ahead of the sync, so
+            # the honored (and reported) maximum is 2
+            self.pipeline_depth = min(int(pipeline_depth or 2), 2)
+        self.poll_interval_s = float(poll_interval_s)
+        self.telemetry = telemetry or ServingTelemetry()
+        self._queue = AdmissionQueue(max_queue_size)
+        self._handles: dict[int, RequestHandle] = {}
+        self._hlock = threading.Lock()
+        self._next_id = 0
+        self._work_evt = threading.Event()
+        self._thread = None
+        self._accepting = False
+        self._stopping = False
+        self._crashed = None
+        self._saved_callback = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._saved_callback = self.engine.stream_callback
+        self.engine.stream_callback = self._on_token
+        self._accepting = True
+        self._stopping = False
+        self._crashed = None  # a restarted server starts clean
+        self.telemetry.reset()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="paddle-tpu-serving",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True, timeout=None):
+        """Stop the engine thread. ``drain=True`` serves every accepted
+        request to completion first; ``drain=False`` cancels everything
+        outstanding."""
+        if self._thread is None:
+            return
+        self._accepting = False
+        if not drain:
+            with self._hlock:
+                handles = list(self._handles.values())
+            for h in handles:
+                h.cancel_requested = True
+        self._stopping = True
+        self._wake()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            # join timed out: the engine thread still owns the engine —
+            # do NOT detach it (a restart would race two threads over one
+            # engine); the caller can stop() again with a longer timeout
+            raise TimeoutError(
+                f"serving loop did not stop within {timeout}s (it may be "
+                f"inside a long compile); still draining — call stop() "
+                f"again to keep waiting")
+        self._thread = None
+        self.engine.stream_callback = self._saved_callback
+        if self._crashed is not None:
+            raise RuntimeError(
+                f"serving loop crashed: {self._crashed}") from self._crashed
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=exc == (None, None, None))
+        return False
+
+    def _wake(self):
+        self._work_evt.set()
+
+    # -- submission ------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=64, temperature=0.0,
+               top_p=1.0, eos_token_id=None, deadline_s=None, block=True,
+               timeout=None) -> RequestHandle:
+        """Submit one generation request; returns its streaming
+        :class:`RequestHandle`.
+
+        Backpressure: when the admission queue is at capacity, blocks
+        (``block=True``, up to ``timeout`` seconds) or raises
+        :class:`ServerQueueFull` immediately. Validation errors (empty or
+        over-capacity prompt) raise ValueError synchronously.
+        ``deadline_s`` is a relative budget: once exceeded, the request is
+        cancelled wherever it is (queued or mid-decode) with
+        finish_reason ``"deadline"`` and its slot / pool blocks free at
+        the next step boundary."""
+        if self._crashed is not None:
+            raise ServerClosed(
+                f"serving loop crashed: {self._crashed}") from self._crashed
+        if not self._accepting:
+            raise ServerClosed("server is not accepting requests")
+        eng = self.engine
+        ids = np.asarray(
+            prompt_ids.numpy() if hasattr(prompt_ids, "numpy")
+            else prompt_ids, dtype=np.int32).reshape(-1)
+        # fail fast on the submitter's thread, mirroring add_request's
+        # checks (the engine would only see the prompt much later)
+        if len(ids) == 0:
+            raise ValueError("empty prompt")
+        if len(ids) >= eng.capacity - eng.speculative_k:
+            raise ValueError(
+                f"prompt of {len(ids)} tokens leaves no room to generate "
+                f"(engine capacity {eng.capacity})")
+        if eng.cache_impl == "paged" and \
+                eng.prefill_blocks_needed(len(ids)) > eng.n_blocks:
+            raise ValueError(
+                f"prompt of {len(ids)} tokens cannot prefill into the "
+                f"{eng.n_blocks}-block pool")
+        with self._hlock:
+            rid = self._next_id
+            self._next_id += 1
+        now = time.monotonic()
+        req = ServeRequest(
+            rid, ids, int(max_new_tokens), float(temperature), float(top_p),
+            eos_token_id,
+            deadline=(now + float(deadline_s)
+                      if deadline_s is not None else None),
+            submitted_at=now)
+        handle = RequestHandle(self, req)
+        with self._hlock:
+            self._handles[rid] = handle
+        try:
+            self._queue.put(handle, block=block, timeout=timeout)
+        except Exception:
+            with self._hlock:
+                self._handles.pop(rid, None)
+            self.telemetry.inc("requests_rejected_queue_full")
+            raise
+        if self._stopping or self._crashed is not None:
+            # TOCTOU with stop(): the loop may have taken its final exit
+            # look at the queue before our put landed — undo (unless the
+            # loop already picked the handle up, in which case it's safe)
+            if self._queue.remove(handle):
+                with self._hlock:
+                    self._handles.pop(rid, None)
+                raise ServerClosed("server stopped while submitting")
+        self.telemetry.inc("requests_submitted")
+        self._wake()
+        return handle
+
+    def num_outstanding(self):
+        with self._hlock:
+            return len(self._handles)
+
+    # -- engine thread ---------------------------------------------------
+    def _loop(self):
+        tel = self.telemetry
+        pending = None
+        try:
+            while True:
+                self._sweep_cancels_and_deadlines()
+                with tel.stage("queue_admit"):
+                    self._feed_engine()
+                if pending is None:
+                    try:
+                        pending = self._begin_step()
+                    except PoolCapacityError as e:
+                        # exactly the head-request-can-never-admit signal
+                        # (its prompt outgrew the paged pool): fail THAT
+                        # request, not the server. Any other error (device,
+                        # compile) falls to the crash handler below.
+                        self._fail_head_waiting(e)
+                        continue
+                if pending is None:
+                    if self._stopping and not self.num_outstanding() \
+                            and len(self._queue) == 0:
+                        break
+                    with tel.stage("idle"):
+                        self._work_evt.wait(self.poll_interval_s)
+                        self._work_evt.clear()
+                    continue
+                nxt = None
+                if self.pipeline_depth > 1:
+                    # THE pipelined-dispatch move: enqueue step N+1 on the
+                    # device before blocking on step N's token transfer
+                    nxt = self._begin_step()
+                done = self._finish_step(pending)
+                if done:
+                    self._handle_done(done)
+                pending = nxt
+        except BaseException as e:  # fail every waiter, don't hang them
+            self._crashed = e
+            self._accepting = False  # submit() must not feed a dead loop
+            with self._hlock:
+                handles = list(self._handles.values())
+                self._handles.clear()
+            self._queue.drain()
+            for h in handles:
+                h._finish(ServeResult(
+                    h.request_id, [], f"server_error: {e}", True))
+
+    def _fail_head_waiting(self, err):
+        eng = self.engine
+        if not eng.waiting:
+            raise err  # not a head-of-queue admission failure: re-raise
+        req = eng.waiting.popleft()
+        # a preemption-grown request may have committed (and streamed)
+        # tokens before being parked: _finish_tokens stitches them in AND
+        # pops the engine's _preempted_prefix entry (leak otherwise)
+        tokens = eng._finish_tokens(req, [])
+        with self._hlock:
+            h = self._handles.get(req.request_id)
+        if h is not None:
+            self._finish_handle(h, tokens, f"rejected: {err}")
+
+    def _begin_step(self):
+        """engine.step_begin() with its wall split into the prefill
+        (admission) dispatch, the decode dispatch, and the host scheduling
+        remainder — read back from the engine's own stage stats so the
+        attribution can't drift from what the engine measured."""
+        eng, tel = self.engine, self.telemetry
+        s_admit = eng.stats["admit_time_s"]
+        s_disp = eng.stats["dispatch_time_s"]
+        s_pre = eng.stats["preemptions"]
+        t0 = time.perf_counter()
+        pending = eng.step_begin()
+        wall = time.perf_counter() - t0
+        d_admit = eng.stats["admit_time_s"] - s_admit
+        d_disp = eng.stats["dispatch_time_s"] - s_disp
+        tel.add_stage("prefill_dispatch", d_admit)
+        tel.add_stage("decode_dispatch", d_disp)
+        tel.add_stage("schedule", max(wall - d_admit - d_disp, 0.0))
+        if eng.stats["preemptions"] > s_pre:
+            # pool-pressure preemptions happen inside step_begin's
+            # allocator loop — this is where the delta is visible
+            tel.inc("preemptions", eng.stats["preemptions"] - s_pre)
+        if d_admit > 0.0:
+            self._note_admissions()
+        return pending
+
+    def _finish_step(self, pending):
+        """engine.step_finish() with its wall split into the device→host
+        token sync and the readout/emit remainder."""
+        eng, tel = self.engine, self.telemetry
+        s_sync = eng.stats["host_sync_time_s"]
+        s_emit = eng.stats["emit_time_s"]
+        t0 = time.perf_counter()
+        done = eng.step_finish(pending)
+        wall = time.perf_counter() - t0
+        d_sync = eng.stats["host_sync_time_s"] - s_sync
+        d_emit = eng.stats["emit_time_s"] - s_emit
+        tel.add_stage("host_sync", d_sync)
+        tel.add_stage("emit", d_emit)
+        tel.add_stage("other", max(wall - d_sync - d_emit, 0.0))
+        tel.inc("engine_steps")
+        return done
+
+    def _feed_engine(self):
+        """Move queued requests into the engine's waiting deque — only as
+        many as could plausibly admit (engine backlog stays ≤ max_batch)
+        so queue-wait is measured HERE and cancellation of queued
+        requests never has to dig through engine state."""
+        eng = self.engine
+        while len(eng.waiting) < eng.B:
+            handle = self._queue.pop()
+            if handle is None:
+                return
+            if handle.done:          # cancelled/expired while queued
+                continue
+            req = handle.request
+            try:
+                eng.add_request(
+                    req.prompt_ids, max_new_tokens=req.max_new_tokens,
+                    temperature=req.temperature, top_p=req.top_p,
+                    eos_token_id=req.eos_token_id,
+                    request_id=req.request_id)
+            except ValueError as e:
+                self._finish_handle(handle, [], f"rejected: {e}")
+                continue
+            handle.state = RequestState.PENDING
+
+    def _note_admissions(self):
+        """Mark handles whose request just entered an engine slot as
+        RUNNING and record their queue wait (submit → slot admission)."""
+        now = time.monotonic()
+        with self._hlock:
+            handles = dict(self._handles)
+        for slot in self.engine.slots:
+            if slot is None:
+                continue
+            h = handles.get(slot.req.request_id)
+            if h is not None and h.state is RequestState.PENDING:
+                h.state = RequestState.RUNNING
+                h.admitted_at = now
+                wait = now - h.request.submitted_at
+                self.telemetry.inc("requests_admitted")
+                self.telemetry.observe("queue_wait_s", wait)
+
+    def _sweep_cancels_and_deadlines(self):
+        """Apply caller cancellations and expire deadlines. A running
+        request's slot (and paged pool blocks) frees RIGHT HERE —
+        before the next dispatch — so capacity returns to the pool
+        immediately, not after the stream drains."""
+        eng = self.engine
+        now = time.monotonic()
+        with self._hlock:
+            items = list(self._handles.items())
+        for rid, h in items:
+            if h.done:
+                continue
+            expired = h.request.deadline is not None \
+                and now > h.request.deadline
+            if not h.cancel_requested and not expired:
+                continue
+            reason = "cancelled" if h.cancel_requested else "deadline"
+            tokens = []
+            if h.state is RequestState.QUEUED:
+                self._queue.remove(h)
+            else:
+                out = eng.cancel(rid, reason=reason)
+                if out is not None:
+                    eng.finished_outputs.pop(rid, None)
+                    tokens = out.token_ids
+            self.telemetry.inc("requests_expired" if reason == "deadline"
+                               else "requests_cancelled")
+            self._finish_handle(h, tokens, reason)
+
+    def _on_token(self, rid, tok):
+        """Engine stream callback (fires inside step_finish's readout):
+        route the token to its handle and record TTFT / inter-token."""
+        with self._hlock:
+            h = self._handles.get(rid)
+        if h is None:
+            return
+        now = time.monotonic()
+        if h.first_token_at is None:
+            self.telemetry.observe("ttft_s", now - h.request.submitted_at)
+        elif h.last_token_at is not None:
+            self.telemetry.observe("inter_token_s", now - h.last_token_at)
+        self.telemetry.inc("tokens_emitted")
+        h._emit(tok)
+
+    def _handle_done(self, outputs):
+        for out in outputs:
+            self.engine.finished_outputs.pop(out.request_id, None)
+            with self._hlock:
+                h = self._handles.get(out.request_id)
+            if h is None:
+                continue
+            self._finish_handle(h, out.token_ids, out.finish_reason)
+
+    def _finish_handle(self, handle, token_ids, reason):
+        now = time.monotonic()
+        req = handle.request
+        result = ServeResult(
+            handle.request_id, list(token_ids), reason, True,
+            ttft_s=(handle.first_token_at - req.submitted_at
+                    if handle.first_token_at is not None else None),
+            e2e_s=now - req.submitted_at,
+            queue_wait_s=(handle.admitted_at - req.submitted_at
+                          if handle.admitted_at is not None else None))
+        self.telemetry.inc("requests_finished")
+        self.telemetry.observe("e2e_s", result.e2e_s)
+        with self._hlock:
+            self._handles.pop(handle.request_id, None)
+        handle._finish(result)

@@ -1,0 +1,165 @@
+"""Request-lifecycle types for ``paddle_tpu.serving``.
+
+Reference analog: the request objects PaddleNLP's serving stack threads
+through AnalysisPredictor (SURVEY §1 layer 6c) — here shaped for an async
+server: a submitted request is a handle the caller can STREAM from,
+cancel, or await, while the engine thread owns every interaction with the
+underlying :class:`~paddle_tpu.inference.LLMEngine`.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import enum
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["RequestState", "ServeRequest", "ServeResult", "RequestHandle",
+           "ServerQueueFull", "ServerClosed"]
+
+
+class ServerQueueFull(RuntimeError):
+    """Admission queue at capacity and the caller declined to wait —
+    the server's backpressure signal."""
+
+
+class ServerClosed(RuntimeError):
+    """submit() after stop() (or on a never-started server)."""
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"        # in the server admission queue
+    PENDING = "pending"      # handed to the engine, waiting for a slot
+    RUNNING = "running"      # admitted into an engine slot (prefilled)
+    FINISHED = "finished"    # terminal: engine finish / cancel / deadline
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One submitted generation request (server-side record)."""
+    request_id: int
+    prompt_ids: np.ndarray
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    top_p: float = 1.0
+    eos_token_id: int | None = None
+    #: absolute time.monotonic() deadline; the engine thread cancels the
+    #: request (freeing its slot / pool blocks) once this passes
+    deadline: float | None = None
+    submitted_at: float = 0.0
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """Terminal outcome of a request, with its latency record."""
+    request_id: int
+    token_ids: list
+    finish_reason: str | None
+    finished: bool = True
+    ttft_s: float | None = None
+    e2e_s: float = 0.0
+    queue_wait_s: float | None = None
+
+
+class RequestHandle:
+    """Caller-side view of one in-flight request.
+
+    * **streaming**: iterate the handle (``for tok in handle``) to receive
+      token ids as the engine decodes them; iteration ends at the
+      terminal state (finish/cancel/deadline).
+    * **await**: :meth:`result` blocks for the terminal
+      :class:`ServeResult`.
+    * **cancel**: :meth:`cancel` requests cancellation; the engine thread
+      frees the slot (and paged pool blocks) at the next step boundary.
+
+    Thread-safety: the engine thread produces (tokens, state
+    transitions); any caller thread may consume. One condition variable
+    serializes both."""
+
+    def __init__(self, server, req: ServeRequest):
+        self._server = server
+        self.request = req
+        self._cond = threading.Condition()
+        self._tokens = collections.deque()
+        self.state = RequestState.QUEUED
+        self.result_obj: ServeResult | None = None
+        self.cancel_requested = False
+        #: set by the engine thread at slot admission / first token
+        self.admitted_at: float | None = None
+        self.first_token_at: float | None = None
+        self.last_token_at: float | None = None
+
+    @property
+    def request_id(self):
+        return self.request.request_id
+
+    @property
+    def done(self):
+        return self.state is RequestState.FINISHED
+
+    # -- engine-thread side ---------------------------------------------
+    def _emit(self, tok):
+        with self._cond:
+            self._tokens.append(tok)
+            now = time.monotonic()
+            if self.first_token_at is None:
+                self.first_token_at = now
+            self.last_token_at = now
+            self._cond.notify_all()
+
+    def _finish(self, result: ServeResult):
+        with self._cond:
+            self.result_obj = result
+            self.state = RequestState.FINISHED
+            self._cond.notify_all()
+
+    # -- caller side ----------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        with self._cond:
+            while not self._tokens and not self.done:
+                self._cond.wait()
+            if self._tokens:
+                return self._tokens.popleft()
+            raise StopIteration
+
+    def tokens(self, timeout=None):
+        """Generator over the token stream with an optional PER-TOKEN
+        timeout (None = wait forever; raises TimeoutError when the next
+        token takes longer than ``timeout`` seconds)."""
+        while True:
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            with self._cond:
+                while not self._tokens and not self.done:
+                    remaining = None if deadline is None \
+                        else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        raise TimeoutError(
+                            f"request {self.request_id}: no token within "
+                            f"{timeout}s")
+                    self._cond.wait(remaining)
+                if not self._tokens and self.done:
+                    return
+                tok = self._tokens.popleft()
+            yield tok
+
+    def result(self, timeout=None) -> ServeResult:
+        """Block until the request reaches a terminal state."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self.done, timeout):
+                raise TimeoutError(
+                    f"request {self.request_id} not finished within "
+                    f"{timeout}s")
+            return self.result_obj
+
+    def cancel(self):
+        """Request cancellation. Idempotent; returns immediately — the
+        terminal result (finish_reason 'cancelled', with any tokens
+        already generated) arrives via result()/iteration."""
+        self.cancel_requested = True
+        self._server._wake()

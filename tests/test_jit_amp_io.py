@@ -1010,3 +1010,32 @@ def test_prefix_capture_bert_dropout_training_step():
     assert all(np.isfinite(g.numpy()).all() for g in grads)
     # fresh dropout per replay
     assert float(np.asarray(l1._value)) != float(np.asarray(l2._value))
+
+
+def test_prefix_capture_replay_key_streams_never_collide():
+    """Regression (ADVICE r5): replay RNG keys used a single-level
+    ``fold_in(base, op_idx * 16 + j)`` whose arithmetic collides past 8
+    closure-cell keys / 16 arg-position keys per op (op i's stream runs
+    into op i+1's, freezing 'independent' dropout masks to identical
+    values). The nested derivation must give every (op, kind, j)
+    combination a distinct key — including the old collision pairs like
+    (op 0, arg 16) vs (op 1, arg 0)."""
+    import jax
+    from paddle_tpu.jit.prefix_capture import _replay_key
+
+    base = jax.random.PRNGKey(1234)
+    seen = {}
+    for op_idx in range(40):
+        for kind in ("arg", "cell"):
+            for j in range(24):  # far past the old 8/16 wrap points
+                data = tuple(
+                    np.asarray(jax.random.key_data(
+                        _replay_key(base, op_idx, kind, j))).ravel())
+                assert data not in seen, (
+                    f"key collision: {(op_idx, kind, j)} vs {seen[data]}")
+                seen[data] = (op_idx, kind, j)
+    # the historical collision pair, explicitly
+    a = _replay_key(base, 0, "arg", 16)
+    b = _replay_key(base, 1, "arg", 0)
+    assert not np.array_equal(np.asarray(jax.random.key_data(a)),
+                              np.asarray(jax.random.key_data(b)))

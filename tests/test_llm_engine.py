@@ -513,11 +513,13 @@ class TestPagedKV:
         with pytest.raises(ValueError, match="dense"):
             self._mk(tiny_model, speculative_k=4)
 
-    def test_single_sequence_outgrows_pool_retires_capacity(self,
-                                                            tiny_model):
-        """A lone sequence larger than the WHOLE pool retires with
-        finish_reason 'capacity' at the pool edge instead of silently
-        corrupting (block writes past coverage are masked in-graph)."""
+    def test_single_sequence_outgrows_pool_retires_preempted_pool(
+            self, tiny_model):
+        """A lone sequence larger than the WHOLE pool retires with the
+        distinct finish_reason 'preempted_pool' at the pool edge instead
+        of silently corrupting (block writes past coverage are masked
+        in-graph). 'capacity' stays reserved for the engine's
+        sequence-length cap."""
         rng = np.random.default_rng(35)
         p = rng.integers(1, 96, size=(17,)).astype(np.int32)
         # pool = 3 blocks = 24 tokens; prefill pads to chunk(16)*2=32 > 24
@@ -526,15 +528,83 @@ class TestPagedKV:
         with pytest.raises(RuntimeError, match="kv_pool_blocks too small"):
             eng.generate([p], max_new_tokens=30)
         # pool = 4 blocks = 32 tokens: admits, decodes to the pool edge,
-        # retires 'capacity' with the correct greedy prefix (reference =
-        # the SAME paged attention with a full pool: the dense engine's
-        # different f32 accumulation order can flip near-tie argmaxes on
-        # this random tiny model, which is rounding, not paging)
+        # retires 'preempted_pool' with the correct greedy prefix
+        # (reference = the SAME paged attention with a full pool: the
+        # dense engine's different f32 accumulation order can flip
+        # near-tie argmaxes on this random tiny model, which is rounding,
+        # not paging)
         full = self._mk(tiny_model, kv_pool_blocks=None)
         (ref,) = full.generate([p], max_new_tokens=30)
         eng2 = self._mk(tiny_model, kv_pool_blocks=4)
         (out,) = eng2.generate([p], max_new_tokens=30)
-        assert out.finish_reason == "capacity"
+        assert out.finish_reason == "preempted_pool"
         n = len(out.token_ids)
         assert 0 < n < 30
         assert out.token_ids == ref.token_ids[:n]
+
+    def test_unrecoverable_preemption_retires_gracefully(self, tiny_model):
+        """Chunk-rounded re-prefill can need MORE blocks than the evicted
+        slot held (round_up(40, chunk=32) = 64 tokens = 8 blocks > pool
+        of 7): parking such a request used to stall the FIFO and blow up
+        later as 'kv_pool_blocks too small', losing every stream.
+        _preempt_slot's recoverability guard must retire it with
+        'preempted_pool' and its committed greedy prefix instead."""
+        rng = np.random.default_rng(37)
+        p0 = rng.integers(1, 96, size=(6,)).astype(np.int32)
+        p1 = rng.integers(1, 96, size=(30,)).astype(np.int32)
+        full = self._mk(tiny_model, chunk_size=32, horizon=8)
+        r0 = full.add_request(p0, max_new_tokens=18)
+        r1 = full.add_request(p1, max_new_tokens=30)
+        while full.has_unfinished():
+            full.step()
+        eng = self._mk(tiny_model, chunk_size=32, horizon=8,
+                       kv_pool_blocks=7)
+        s0 = eng.add_request(p0, max_new_tokens=18)
+        s1 = eng.add_request(p1, max_new_tokens=30)
+        while eng.has_unfinished():
+            eng.step()  # seed behavior: RuntimeError mid-drain
+        out0, out1 = eng.finished_outputs[s0], eng.finished_outputs[s1]
+        assert out0.finish_reason == "length"
+        assert out0.token_ids == full.finished_outputs[r0].token_ids
+        assert out1.finish_reason == "preempted_pool"
+        n = len(out1.token_ids)
+        assert 0 < n < 30
+        assert out1.token_ids == full.finished_outputs[r1].token_ids[:n]
+        assert len(eng._free_blocks) == 7
+        assert not eng._preempted_prefix  # no leaked stitch entries
+
+    def test_oversubscribed_newest_self_preempts_to_full_length(
+            self, tiny_model):
+        """Regression (ADVICE r5): when pool pressure leaves the NEWEST
+        slot with no newer victim while OLDER slots still run, it must
+        SELF-PREEMPT back to the waiting queue — not force-finish — and
+        resume to its full max_new_tokens once the older slots retire and
+        free blocks."""
+        rng = np.random.default_rng(36)
+        # pool 6 blocks = 48 tokens, horizon 1. slot0 (older, 26-token
+        # prompt) prefills 4 blocks with 6 tokens of padding headroom, so
+        # it never needs a new block while decoding its 5 tokens; slot1
+        # (newer, 15-token prompt) holds the remaining 2 blocks and hits
+        # the dry pool exactly at its 16-token block boundary while slot0
+        # is mid-decode — under the old rule it force-finished there
+        p0 = rng.integers(1, 96, size=(26,)).astype(np.int32)
+        p1 = rng.integers(1, 96, size=(15,)).astype(np.int32)
+        full = self._mk(tiny_model)
+        r0 = full.add_request(p0, max_new_tokens=5)
+        r1 = full.add_request(p1, max_new_tokens=24)
+        while full.has_unfinished():
+            full.step()
+        eng = self._mk(tiny_model, kv_pool_blocks=6, horizon=1)
+        s0 = eng.add_request(p0, max_new_tokens=5)
+        s1 = eng.add_request(p1, max_new_tokens=24)
+        while eng.has_unfinished():
+            eng.step()
+        out0 = eng.finished_outputs[s0]
+        out1 = eng.finished_outputs[s1]
+        assert out0.token_ids == full.finished_outputs[r0].token_ids
+        assert out1.token_ids == full.finished_outputs[r1].token_ids
+        # the newer request reached its FULL budget despite pool pressure
+        assert out1.finish_reason == "length"
+        assert len(out1.token_ids) == 24
+        assert eng.stats["preemptions"] >= 1
+        assert len(eng._free_blocks) == 6  # all blocks returned

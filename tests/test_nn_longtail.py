@@ -33,6 +33,31 @@ def test_max_unpool2d_matches_torch(rng):
     np.testing.assert_allclose(t2n(un), tun.numpy(), rtol=1e-6)
 
 
+def test_max_pool_mask_exact_beyond_float24_boundary(rng):
+    """Regression (ADVICE r5): the return_mask indices used to ride
+    through reduce_window as float32, which is only integer-exact up to
+    2**24 — on spatial sizes past ~16.7M elements the returned argmax
+    positions silently rounded to even values. Indices are now int32;
+    the window maxima here sit at ODD flat positions past 2**24, which
+    the float32 carry could not represent."""
+    H, W = 4099, 4098  # H*W = 16,797,702 > 2**24 = 16,777,216
+    # max of every 2x2 window at its odd-odd corner -> odd flat index
+    col = (np.arange(W, dtype=np.float32) % 2)
+    row = (np.arange(H, dtype=np.float32) % 2)
+    x = (row[:, None] + col[None, :]).reshape(1, 1, H, W)
+    _, idx = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)
+    idx = t2n(idx)
+    r, c = idx.shape[2] - 1, idx.shape[3] - 1  # bottom-right window
+    expect = (2 * r + 1) * W + (2 * c + 1)
+    assert expect > 2 ** 24
+    assert idx[0, 0, r, c] == expect
+    assert idx[0, 0, r, c] % 2 == 1  # odd: unrepresentable in f32 there
+    # spot-check a row of windows past the boundary
+    rows = 2 * np.arange(idx.shape[2]) + 1
+    np.testing.assert_array_equal(
+        idx[0, 0, :, c], rows * W + (2 * c + 1))
+
+
 def test_max_unpool_layer_and_output_size(rng):
     x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
     pooled, idx = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)

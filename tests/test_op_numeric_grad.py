@@ -396,3 +396,45 @@ def test_gpt_fused_head_loss_parity():
         losses[fused] = [float(np.asarray(step(ids, lbl)._value))
                          for _ in range(3)]
     np.testing.assert_allclose(losses[True], losses[False], rtol=2e-5)
+
+
+def test_fused_head_logits_contract():
+    """The fused head+CE paths return a falsy FusedLogitsUnavailable
+    guard in the logits position; consuming it raises a RuntimeError
+    naming the flag, while the unfused path returns real logits — both
+    sides of the documented (loss, logits) contract."""
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (BertConfig, BertForMaskedLM, GPTConfig,
+                                   GPT2LMHeadModel)
+    from paddle_tpu.models.common import FusedLogitsUnavailable
+
+    rng = np.random.default_rng(7)
+    ids = paddle.to_tensor(rng.integers(0, 256, (2, 12)), dtype="int32")
+    lbl = paddle.to_tensor(rng.integers(0, 256, (2, 12)), dtype="int32")
+
+    for fused in (False, True):
+        paddle.seed(3)
+        bcfg = BertConfig.tiny(vocab_size=256, hidden_dropout_prob=0.0,
+                               attention_probs_dropout_prob=0.0,
+                               fuse_mlm_head_ce=fused)
+        bloss, blogits = BertForMaskedLM(bcfg)(ids, labels=lbl)
+        paddle.seed(3)
+        gcfg = GPTConfig(vocab_size=256, hidden_size=32,
+                         num_hidden_layers=1, num_attention_heads=2,
+                         max_position_embeddings=32, dropout=0.0,
+                         fuse_lm_head_ce=fused)
+        gloss, glogits = GPT2LMHeadModel(gcfg)(ids, labels=lbl)
+        for logits, flag in ((blogits, "fuse_mlm_head_ce"),
+                             (glogits, "fuse_lm_head_ce")):
+            if not fused:
+                assert logits.shape[-1] == 256  # real logits materialized
+                continue
+            assert isinstance(logits, FusedLogitsUnavailable)
+            assert not logits  # falsy, like the old None contract
+            with pytest.raises(RuntimeError, match=flag):
+                logits.numpy()
+            with pytest.raises(RuntimeError, match=flag):
+                _ = logits[0]
+            with pytest.raises(RuntimeError, match=flag):
+                np.asarray(logits)

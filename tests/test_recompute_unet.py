@@ -12,6 +12,14 @@ import paddle_tpu.nn as nn
 from paddle_tpu.distributed.fleet import recompute, recompute_sequential
 from paddle_tpu.models import UNetConfig, UNetModel, diffusion_loss
 
+# Importable again since the jax<0.5 shard_map import fallback (round
+# 6) un-broke collection; the file is gated behind the `slow` marker
+# because tier-1 has a hard wall-time budget and at the seed this file
+# contributed a collection ERROR (zero runtime). Run explicitly or
+# without -m "not slow" for full coverage.
+pytestmark = pytest.mark.slow
+
+
 
 class MLP(nn.Layer):
     def __init__(self, d=16):
