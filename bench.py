@@ -2,6 +2,7 @@
 
 Default (`BENCH_MODEL` unset / `all`): runs every BASELINE.md config plus
 the decode and serving benchmarks — resnet50, bert, vit, unet, llama_decode,
+llama_paged_decode (Pallas paged-attention kernel on/off A/B),
 llama_serve, llama_serve_spec, then the flagship llama LAST — each in its
 own subprocess, one JSON line each, so the tail line stays the llama MFU vs
 the 45% north star (BASELINE.json).
@@ -390,6 +391,102 @@ def _bench_other(model_name):
                 "batch": B, "prompt_len": prompt, "new_tokens": new_tokens,
                 "weight_dtype": weight_dtype or "bf16",
                 "params": n_params}
+
+    if model_name == "llama_paged_decode":
+        # Paged-KV decode throughput with the Pallas paged-attention kernel
+        # A/B'd against the dense-gather XLA fallback
+        # (FLAGS_use_paged_attention) — the recorded number behind the
+        # block-sparse-read claim. Two-length differential like
+        # llama_decode; GQA by default (kv_heads = heads/4) since the
+        # kernel is what unlocks cache_impl="paged" for GQA models.
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.jit.functional_call import collect_state, read_values
+        from paddle_tpu.core.flags import set_flags
+        import jax.numpy as jnp
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        prompt = int(os.environ.get("BENCH_PROMPT", "512"))
+        new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        kv_heads = int(os.environ.get("BENCH_KV_HEADS",
+                                      str(max(heads // 4, 1))))
+        block_size = int(os.environ.get("BENCH_BLOCK_SIZE", "64"))
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=kv_heads,
+                          max_position_embeddings=prompt + new_tokens)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg).bfloat16()
+        model.eval()
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        ids_v = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, prompt)),
+                            jnp.int32)
+        short = min(max(new_tokens // 8, 8), max(new_tokens // 2, 1))
+        _, params, _, buffers = collect_state(model)
+        state_vals = read_values(params + buffers)
+        key = jax.random.PRNGKey(0)
+        reps = int(os.environ.get("BENCH_STEPS", "8"))
+
+        def run_arm(kernel_on):
+            # flag is read at trace time: flip it, then force a fresh trace
+            # of the paged decode programs for this arm
+            set_flags({"use_paged_attention": bool(kernel_on)})
+            model._gen_cache = {}
+
+            def build_pair(n_new):
+                prefill, decode = model._gen_programs(
+                    B, prompt, n_new, prompt + n_new, 0.0, 0, 1.0, None,
+                    "paged", block_size)
+
+                def run_pair():
+                    l0, kb, vb = prefill(state_vals, ids_v)
+                    buf, n = decode(state_vals, kb, vb, l0, key,
+                                    jnp.float32(1.0), jnp.float32(1.0))
+                    int(np.asarray(n))
+                return run_pair
+
+            run_long = build_pair(new_tokens)
+            run_short = build_pair(short)
+            for f in (run_long, run_short):  # warm twice (donation relayout)
+                f()
+                f()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                run_short()
+            t_short = (time.perf_counter() - t0) / reps
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                run_long()
+            t_long = (time.perf_counter() - t0) / reps
+            t_decode = max(t_long - t_short, 1e-9)
+            return B * (new_tokens - short) / t_decode
+
+        on_cpu = jax.default_backend() == "cpu"
+        try:
+            toks_on = run_arm(True)     # Pallas block-sparse kernel
+            # on CPU both arms would trace the identical dense fallback
+            # (the kernel is TPU-gated) — skip the redundant off arm
+            toks_off = toks_on if on_cpu else run_arm(False)
+        finally:
+            set_flags({"use_paged_attention": True})
+        return {"metric": "llama_paged_decode_tokens_per_sec",
+                "value": round(toks_on, 1), "unit": "tokens/s",
+                "vs_baseline": None, "method": "two-length-differential",
+                "kernel_on_tokens_per_sec": round(toks_on, 1),
+                "kernel_off_tokens_per_sec": round(toks_off, 1),
+                # on CPU both arms run the dense fallback (the kernel is
+                # TPU-gated) — the A/B is only meaningful on-chip
+                "kernel_speedup": (round(toks_on / toks_off, 2)
+                                   if not on_cpu else None),
+                "decode_ms_per_token": round(
+                    B * 1e3 / max(toks_on, 1e-9), 3),
+                "new_tokens_long_short": [new_tokens, short],
+                "batch": B, "prompt_len": prompt, "new_tokens": new_tokens,
+                "block_size": block_size, "q_heads": heads,
+                "kv_heads": kv_heads, "params": n_params}
 
     if model_name in ("llama_serve", "llama_serve_spec"):
         # ASYNC serving subsystem (paddle_tpu/serving/ over
@@ -976,7 +1073,8 @@ def _run_all():
     import subprocess
     import sys
     for name in ["resnet50", "bert", "vit", "unet", "llama_decode",
-                 "llama_serve", "llama_serve_spec", "llama"]:
+                 "llama_paged_decode", "llama_serve", "llama_serve_spec",
+                 "llama"]:
         env = dict(os.environ, BENCH_MODEL=name)
         try:
             proc = subprocess.run(

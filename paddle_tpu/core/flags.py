@@ -111,6 +111,14 @@ define_flag("use_fused_adamw", True,
 define_flag("use_pallas_int4", True,
             "route tileable weight-only int4 GEMMs to the fused Pallas "
             "dequant-matmul kernel (TPU backend only)")
+define_flag("use_paged_attention", True,
+            "route block_multihead_attention's paged decode through the "
+            "Pallas paged-attention kernel (block-sparse KV reads off the "
+            "physical pools, GQA, fused new-token write). TPU backends "
+            "only — CPU always runs the dense-gather XLA fallback, so "
+            "tier-1 stays kernel-free and deterministic. Set "
+            "FLAGS_use_paged_attention=0 to A/B or debug against the "
+            "fallback on TPU")
 define_flag("adamw_bf16_moments", False,
             "store Adam/AdamW moment1/moment2 in bfloat16 (update math stays "
             "fp32 via upcast) — halves optimizer-state HBM traffic at a "

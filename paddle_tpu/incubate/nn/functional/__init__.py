@@ -556,22 +556,43 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     incubate/nn/functional/block_multihead_attention.py, phi
     block_multi_head_attention_kernel.cu — the vLLM-style paged attention).
 
-    Decode-step form: qkv [B, 3*H*D] (one new token per sequence);
-    key_cache/value_cache [num_blocks, H, block_size, D]; block_tables
+    Decode-step form: qkv [B, (Hq + 2*Hkv)*D] (one new token per sequence;
+    Hq == Hkv is the MHA special case, Hq a multiple of Hkv is GQA);
+    key_cache/value_cache [num_blocks, Hkv, block_size, D]; block_tables
     [B, max_blocks_per_seq] maps logical KV block i of each sequence to a
     physical cache block (-1 = unused); seq_lens_decoder [B] = tokens already
-    cached. Returns (out [B, H*D], key_cache, value_cache) with the new token
-    written into its block — functional cache update, TPU-style.
+    cached. Returns (out [B, Hq*D], key_cache, value_cache) with the new
+    token written into its block — functional cache update, TPU-style.
+
+    On TPU (and unless FLAGS_use_paged_attention=0) this routes through the
+    Pallas paged-attention decode kernel
+    (:func:`paddle_tpu.ops.kernels.paged_attention.paged_attention_decode`):
+    block-sparse reads straight off the physical pools via scalar-prefetched
+    block tables, with the new-token write fused in-kernel. The dense path
+    below (scatter + gather the whole padded horizon + einsum) is the
+    reference semantics and the CPU/tier-1 fallback.
     """
     if block_tables is None:
         raise ValueError("block_mha requires block_tables")
 
     def fn(qkv_v, kc, vc, lens, tables):
-        nb, H, bs, D = kc.shape
+        from ....ops.kernels.paged_attention import (
+            paged_attention_decode, paged_attention_enabled)
+
+        nb, Hkv, bs, D = kc.shape
         b = qkv_v.shape[0]
         max_blocks = tables.shape[1]
-        qkv3 = qkv_v.reshape(b, 3, H, D)
-        q, knew, vnew = qkv3[:, 0], qkv3[:, 1], qkv3[:, 2]
+        Hq = qkv_v.shape[1] // D - 2 * Hkv
+        q = qkv_v[:, :Hq * D].reshape(b, Hq, D)
+        knew = qkv_v[:, Hq * D:(Hq + Hkv) * D].reshape(b, Hkv, D)
+        vnew = qkv_v[:, (Hq + Hkv) * D:].reshape(b, Hkv, D)
+        lens = lens.astype(jnp.int32)
+        tables = tables.astype(jnp.int32)
+
+        if paged_attention_enabled():
+            out, kc, vc = paged_attention_decode(
+                q, kc, vc, tables, lens, new_k=knew, new_v=vnew)
+            return out.reshape(b, Hq * D), kc, vc
 
         # write the new token at position lens[i] of sequence i. A -1 table
         # entry (no block allocated) must not write AT ALL: clamping it to
@@ -585,21 +606,23 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         kc = kc.at[wblk, :, slot].set(knew, mode="drop")
         vc = vc.at[wblk, :, slot].set(vnew, mode="drop")
 
-        # gather each sequence's logical KV [B, max_blocks*bs, H, D]
+        # gather each sequence's logical KV [B, max_blocks*bs, Hkv, D]
         safe_tables = jnp.maximum(tables, 0)
-        kseq = kc[safe_tables]                            # [B, MB, H, bs, D]
+        kseq = kc[safe_tables]                            # [B, MB, Hkv, bs, D]
         vseq = vc[safe_tables]
-        kseq = jnp.moveaxis(kseq, 3, 2).reshape(b, max_blocks * bs, H, D)
-        vseq = jnp.moveaxis(vseq, 3, 2).reshape(b, max_blocks * bs, H, D)
+        kseq = jnp.moveaxis(kseq, 3, 2).reshape(b, max_blocks * bs, Hkv, D)
+        vseq = jnp.moveaxis(vseq, 3, 2).reshape(b, max_blocks * bs, Hkv, D)
 
         sc = 1.0 / math.sqrt(D)
-        logits = jnp.einsum("bhd,bthd->bht", q, kseq).astype(jnp.float32) * sc
+        qg = q.reshape(b, Hkv, Hq // Hkv, D)              # GQA head groups
+        logits = jnp.einsum("bhgd,bthd->bhgt", qg,
+                            kseq).astype(jnp.float32) * sc
         t_idx = jnp.arange(max_blocks * bs)
         visible = t_idx[None, :] <= lens[:, None]         # include new token
-        logits = jnp.where(visible[:, None, :], logits, -jnp.inf)
+        logits = jnp.where(visible[:, None, None, :], logits, -jnp.inf)
         probs = jax.nn.softmax(logits, axis=-1).astype(vseq.dtype)
-        out = jnp.einsum("bht,bthd->bhd", probs, vseq)
-        return out.reshape(b, H * D), kc, vc
+        out = jnp.einsum("bhgt,bthd->bhgd", probs, vseq)
+        return out.reshape(b, Hq * D), kc, vc
 
     return dispatch(fn, (qkv, key_cache, value_cache, seq_lens_decoder,
                          block_tables), {}, name="block_multihead_attention")

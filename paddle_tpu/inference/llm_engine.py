@@ -190,10 +190,6 @@ class LLMEngine:
         np_dt = np.dtype(dt) if mesh is not None else dt
         self.cache_impl = cache_impl
         if cache_impl == "paged":
-            if c.num_key_value_heads != c.num_attention_heads:
-                raise ValueError("paged KV requires num_kv_heads == "
-                                 "num_heads (block_multihead_attention "
-                                 "is MHA-form)")
             if self.speculative_k > 1:
                 raise ValueError("paged KV serves one token per step "
                                  "(speculative verify windows need the "
@@ -209,7 +205,14 @@ class LLMEngine:
             self._max_blocks = self.capacity // self.block_size
             full = self.B * self._max_blocks
             self.n_blocks = int(kv_pool_blocks or full)
-            pool_shape = (self.n_blocks, kvh, self.block_size, head_dim)
+            # +1 trailing SCRATCH block the allocator never hands out: the
+            # Pallas paged-attention kernel's fused new-token write routes
+            # invalid (-1) targets there — a freed slot keeps stale lens
+            # with a wiped table row, and its garbage write must not land
+            # on a real block (the XLA fallback drops such rows with an
+            # out-of-range scatter; a kernel block write needs a real
+            # destination)
+            pool_shape = (self.n_blocks + 1, kvh, self.block_size, head_dim)
             self._k = [_zeros(pool_shape, np_dt) for _ in range(L)]
             self._v = [_zeros(pool_shape, np_dt) for _ in range(L)]
             self._tables = np.full((self.B, self._max_blocks), -1, np.int32)
@@ -466,19 +469,20 @@ class LLMEngine:
                         .astype(jnp.float32)
 
                 def scatter(pool, cc_val):
-                    # chunk rows [off, off+chunk) -> chunk//bs_blk blocks
+                    # chunk rows [off, off+chunk) -> chunk//bs_blk blocks,
+                    # as ONE batched scatter (the old per-logical-block
+                    # Python loop traced O(chunk/block_size) sequential
+                    # dynamic_update_slice ops per prompt chunk)
                     new_rows = jax.lax.dynamic_slice(
                         cc_val, (z, off, z, z),
                         (1, chunk) + cc_val.shape[2:])[0]   # [chunk, H, D]
-                    for j in range(chunk // bs_blk):
-                        phys = jax.lax.dynamic_slice(
-                            table_row, (off // bs_blk + j,), (1,))[0]
-                        blk = jnp.swapaxes(
-                            new_rows[j * bs_blk:(j + 1) * bs_blk], 0, 1)
-                        pool = jax.lax.dynamic_update_slice(
-                            pool, blk[None].astype(pool.dtype),
-                            (phys, z, z, z))
-                    return pool
+                    nblk = chunk // bs_blk
+                    h, d = new_rows.shape[1], new_rows.shape[2]
+                    blks = jnp.swapaxes(
+                        new_rows.reshape(nblk, bs_blk, h, d), 1, 2)
+                    phys = jax.lax.dynamic_slice(
+                        table_row, (off // bs_blk,), (nblk,))
+                    return pool.at[phys].set(blks.astype(pool.dtype))
 
                 k_out = [scatter(p, (cc.k._value if isinstance(cc.k, Tensor)
                                      else cc.k))

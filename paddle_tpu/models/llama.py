@@ -202,8 +202,27 @@ def _filter_logits(logits, temp_val, top_k, top_p_val, use_top_p=True):
     logits = logits.astype(jnp.float32) / temp_val.astype(jnp.float32)
     V = logits.shape[-1]
     if top_k and 0 < int(top_k) < V:
-        kth = jax.lax.top_k(logits, int(top_k))[0][..., -1:]
+        # one O(V * k) top_k serves BOTH cuts: after the top-k mask, the
+        # surviving distribution lives entirely in this sorted-descending
+        # slice, so the nucleus cutoff computes over k entries instead of a
+        # full O(V log V) sort of the 32k-vocab logits every sampled step.
+        # Caveat: with EXACT ties at the k-th value the strict `< kth` mask
+        # keeps all tied entries but the slice normalizes over exactly k —
+        # a measure-zero divergence for real logits, accepted for the
+        # per-step sort elimination
+        vals = jax.lax.top_k(logits, int(top_k))[0]       # [..., k] desc
+        kth = vals[..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if use_top_p:
+            probs = jax.nn.softmax(vals, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep the minimal prefix reaching top_p mass: a position
+            # survives when the mass BEFORE it is still < top_p
+            keep = (cum - probs) < top_p_val.astype(jnp.float32)
+            cutoff = jnp.min(jnp.where(keep, vals, jnp.inf), axis=-1,
+                             keepdims=True)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        return logits
     if use_top_p:
         sorted_desc = -jnp.sort(-logits, axis=-1)
         probs = jax.nn.softmax(sorted_desc, axis=-1)
@@ -292,19 +311,16 @@ class LlamaAttention(Layer):
         if isinstance(kv_cache, PagedKVCache):
             # paged decode step (one new token/sequence) through the
             # block_multihead_attention op — the framework's own paged-KV
-            # kernel as the generate() cache backend
-            if self.num_kv_heads != self.num_heads:
-                raise ValueError(
-                    "PagedKVCache decode requires num_kv_heads == num_heads "
-                    "(block_multihead_attention is MHA-form)")
+            # kernel as the generate() cache backend. GQA-capable: q keeps
+            # num_heads, K/V the (possibly smaller) num_kv_heads.
             if s != 1:
                 raise ValueError("PagedKVCache is a decode-step cache "
                                  f"(one token per step); got seq len {s}")
             from ..incubate.nn import functional as IF
-            H, D = self.num_heads, self.head_dim
+            H, Hkv, D = self.num_heads, self.num_kv_heads, self.head_dim
             qkv = ops.concat([ops.reshape(q, [b, H * D]),
-                              ops.reshape(k, [b, H * D]),
-                              ops.reshape(v, [b, H * D])], axis=-1)
+                              ops.reshape(k, [b, Hkv * D]),
+                              ops.reshape(v, [b, Hkv * D])], axis=-1)
             out, kc, vc = IF.block_multihead_attention(
                 qkv, kv_cache.k, kv_cache.v, None, kv_cache.seq_lens, None,
                 block_tables=kv_cache.block_tables)

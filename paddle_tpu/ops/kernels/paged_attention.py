@@ -1,0 +1,268 @@
+"""Pallas TPU paged-attention decode kernel (block-sparse KV reads + GQA).
+
+Reference analog: the phi block_multi_head_attention CUDA kernel behind
+python/paddle/incubate/nn/functional/block_multihead_attention.py — the
+vLLM-style paged attention the serving path decodes through. The XLA
+fallback in incubate (gather every sequence's whole KV out of the pools,
+dense einsum over the padded horizon) moves O(B * max_blocks * block_size)
+HBM bytes per decode step regardless of live lengths; this kernel reads KV
+**directly from the physical block pools**, touching only each sequence's
+live blocks.
+
+Design (mirrors ops/kernels/flash_attention.py idiom, adapted to paging):
+
+- grid = (batch, kv_head, max_blocks); ``block_tables`` [B, MB] and
+  ``seq_lens`` [B] ride in as **scalar-prefetched** SMEM operands
+  (``PrefetchScalarGridSpec``), so the K/V BlockSpec index maps translate
+  the logical block id of each grid step into the physical pool block to
+  DMA — the pools never materialize a gathered [B, MB, H, bs, D] copy.
+- block-sparse reads: grid steps past a sequence's last live block clamp
+  their index map to the last live block's physical index. Pallas only
+  issues a copy when the mapped block CHANGES between steps, so the dead
+  tail costs zero HBM traffic; its compute is skipped with ``pl.when``.
+- online softmax across the block loop: fp32 (m, l, acc) VMEM scratch
+  carried over the innermost grid dimension, initialized at block 0,
+  finalized (acc / l) into the output at the last block step.
+- GQA zero-copy: q arrives [B, Hkv, G, D] (G = q-heads per kv head); each
+  (batch, kv_head) window attends its whole q-head group against one
+  stream of that kv head's blocks.
+- optional fused new-token write: the decode step's fresh K/V (one token
+  per sequence) is merged into the last live block IN VMEM — attention
+  sees the new token without a prior XLA scatter round-trip through HBM —
+  and the merged block is written back to the pools via
+  ``input_output_aliases`` (in-place, one [bs, D] block write per
+  (batch, kv_head)).
+
+Invalid (-1) table entries: reads clamp to physical block 0 and are either
+compute-skipped (dead tail) or masked by ``seq_lens``; fused writes route
+to the pool's LAST physical block. Callers whose live write target can be
+-1 (the serving engine: freed slots keep stale lens with wiped tables)
+must reserve one trailing scratch block in the pool — see
+``LLMEngine``'s ``+1`` pool allocation. Callers that guarantee valid
+tables everywhere (``generate()``'s arange tables) need no spare block:
+the clamp never fires.
+
+On non-TPU backends the same kernel runs under interpret mode (parity
+tests); the production CPU path stays the XLA dense-gather fallback in
+``incubate.nn.functional.block_multihead_attention`` (see
+``paged_attention_enabled``).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = np.float32(-1e30)
+# index-map literals MUST be i32: python ints become i64 constants under the
+# framework's jax_enable_x64 and Mosaic then fails to legalize the index maps
+Z = np.int32(0)
+
+
+def _interpret():
+    return jax.default_backend() not in ("tpu",)
+
+
+def paged_attention_enabled():
+    """True when ``block_multihead_attention`` routes decode through this
+    kernel: the ``use_paged_attention`` flag (env: FLAGS_use_paged_attention)
+    is on AND the backend is a real TPU. Tier-1 CI runs under
+    JAX_PLATFORMS=cpu, so CPU always takes the dense-gather fallback —
+    deterministic and kernel-free (tests/conftest.py asserts this); the
+    kernel itself is still exercised on CPU by the interpret-mode parity
+    suite calling :func:`paged_attention_decode` directly."""
+    from ...core.flags import flag_value
+    return bool(flag_value("use_paged_attention")) and not _interpret()
+
+
+def _last_live(lens_ref, b, bs, mb):
+    """Logical index of the block holding position ``lens[b]`` (where the
+    decode step's new token lands), clamped into the table. lax.div keeps
+    i32 under x64 (a plain ``//`` promotes and breaks Mosaic's lowering)."""
+    return jnp.minimum(jax.lax.div(lens_ref[b], np.int32(bs)),
+                       np.int32(mb - 1))
+
+
+def _q_index_map(b, h, j, tables_ref, lens_ref):
+    return (b, h, Z, Z)
+
+
+def _kv_index_map(bs, mb):
+    def im(b, h, j, tables_ref, lens_ref):
+        j_last = _last_live(lens_ref, b, bs, mb)
+        jj = jnp.minimum(j, j_last)          # dead tail re-maps to last live
+        phys = tables_ref[b, jj]
+        return (jnp.maximum(phys, Z), h, Z, Z)   # -1 -> block 0 (masked read)
+    return im
+
+
+def _new_kv_index_map(b, h, j, tables_ref, lens_ref):
+    return (b, h, Z)
+
+
+def _pool_out_index_map(bs, mb, nb):
+    """Fused-write destination: the last live block of sequence b. A -1
+    (unallocated) target must not clobber a real block — route it to the
+    pool's trailing scratch block instead (the analog of the XLA path's
+    out-of-range ``mode="drop"`` scatter)."""
+    def im(b, h, j, tables_ref, lens_ref):
+        phys = tables_ref[b, _last_live(lens_ref, b, bs, mb)]
+        return (jnp.where(phys < Z, np.int32(nb - 1), phys), h, Z, Z)
+    return im
+
+
+def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest, scale,
+                   bs, mb, write_new):
+    if write_new:
+        nk_ref, nv_ref, o_ref, ko_ref, vo_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    bs_i = np.int32(bs)
+    L = lens_ref[b]
+    j_last = _last_live(lens_ref, b, bs, mb)
+    jj = jnp.minimum(j, j_last)
+    phys = tables_ref[b, jj]
+    # dead tail (past the live blocks) and unallocated (-1) entries skip
+    # compute; their clamped reads are either unused or masked below
+    live = (j <= j_last) & (phys >= Z)
+
+    @pl.when(j == Z)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    k_blk = k_ref[0, 0]                                   # [bs, D]
+    v_blk = v_ref[0, 0]
+    if write_new:
+        # merge the new token's K/V into the last live block in VMEM: the
+        # attention below sees it this step, and the merged block writes
+        # back through the aliased pool outputs (in-place)
+        slot = L - j_last * bs_i
+        row = jax.lax.broadcasted_iota(jnp.int32, (bs, 1), 0)
+        sel = (row == slot) & (j == j_last)
+        k_blk = jnp.where(sel, nk_ref[0, 0][None, :].astype(k_blk.dtype),
+                          k_blk)
+        v_blk = jnp.where(sel, nv_ref[0, 0][None, :].astype(v_blk.dtype),
+                          v_blk)
+
+        @pl.when(j == j_last)
+        def _store_block():
+            ko_ref[0, 0] = k_blk.astype(ko_ref.dtype)
+            vo_ref[0, 0] = v_blk.astype(vo_ref.dtype)
+
+    g = q_ref.shape[2]
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32) * np.float32(scale)    # [G, D]
+        s = jax.lax.dot_general(q, k_blk.astype(jnp.float32),
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [G, bs]
+        pos = jj * bs_i + jax.lax.broadcasted_iota(jnp.int32, (g, bs), 1)
+        s = jnp.where(pos <= L, s, NEG_INF)          # include new token at L
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(j == np.int32(mb - 1))
+    def _finalize():
+        l = jnp.maximum(l_ref[...], np.float32(1e-30))
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention_decode(q, k_pool, v_pool, block_tables, seq_lens,
+                           scale=None, new_k=None, new_v=None):
+    """One decode step of paged attention, straight off the block pools.
+
+    q: [B, Hq, D] (this step's query, one token per sequence);
+    k_pool/v_pool: [num_blocks, Hkv, block_size, D] physical pools;
+    block_tables: [B, max_blocks] logical->physical (-1 = unallocated);
+    seq_lens: [B] tokens already cached — the new token sits at position
+    ``seq_lens[b]`` and attention covers positions <= seq_lens[b].
+
+    Hq must be a multiple of Hkv (GQA: each kv head serves Hq/Hkv q heads).
+
+    new_k/new_v ([B, Hkv, D], both or neither): fuse the new token's K/V
+    write into the kernel — returns (out, k_pool, v_pool) with the pools
+    updated in place (aliased). Without them the caller must have already
+    scattered the new token into the pools; returns out only.
+    Out: [B, Hq, D] in q.dtype (fp32 accumulation inside).
+    """
+    B, Hq, D = q.shape
+    NB, Hkv, BS, Dk = k_pool.shape
+    assert D == Dk, (q.shape, k_pool.shape)
+    assert Hq % Hkv == 0, f"GQA needs Hq % Hkv == 0, got {Hq=} {Hkv=}"
+    G = Hq // Hkv
+    MB = block_tables.shape[1]
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    write_new = new_k is not None
+    assert (new_v is not None) == write_new
+
+    q4 = q.reshape(B, Hkv, G, D)
+    tables = block_tables.astype(jnp.int32)
+    lens = seq_lens.astype(jnp.int32)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, G, D), _q_index_map),
+        pl.BlockSpec((1, 1, BS, D), _kv_index_map(BS, MB)),
+        pl.BlockSpec((1, 1, BS, D), _kv_index_map(BS, MB)),
+    ]
+    out_specs = [pl.BlockSpec((1, 1, G, D), _q_index_map)]
+    out_shape = [jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype)]
+    inputs = [tables, lens, q4, k_pool, v_pool]
+    io_aliases = {}
+    if write_new:
+        in_specs += [pl.BlockSpec((1, 1, D), _new_kv_index_map),
+                     pl.BlockSpec((1, 1, D), _new_kv_index_map)]
+        inputs += [new_k.reshape(B, Hkv, D).astype(k_pool.dtype),
+                   new_v.reshape(B, Hkv, D).astype(v_pool.dtype)]
+        pool_spec = pl.BlockSpec((1, 1, BS, D),
+                                 _pool_out_index_map(BS, MB, NB))
+        out_specs += [pool_spec, pool_spec]
+        out_shape += [jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                      jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)]
+        # flat input indices INCLUDE the scalar-prefetch operands
+        io_aliases = {3: 1, 4: 2}
+
+    kernel = functools.partial(_decode_kernel, scale=scale, bs=BS, mb=MB,
+                               write_new=write_new)
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, Hkv, MB),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=[
+                pltpu.VMEM((G, 1), jnp.float32),    # running max m
+                pltpu.VMEM((G, 1), jnp.float32),    # running normalizer l
+                pltpu.VMEM((G, D), jnp.float32),    # output accumulator
+            ],
+        ),
+        out_shape=out_shape,
+        input_output_aliases=io_aliases,
+        # every dim sequential: scratch carries over blocks, and the fused
+        # write's clamped scratch-block destinations may collide across
+        # batch windows — megacore parallelism would race them
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+        interpret=_interpret(),
+    )(*inputs)
+    out = outs[0].reshape(B, Hq, D)
+    if write_new:
+        return out, outs[1], outs[2]
+    return out

@@ -27,6 +27,14 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long soak/scale variants excluded from tier-1 "
         "(-m 'not slow')")
+    # tier-1 determinism contract: on the CPU test backend
+    # block_multihead_attention must take the dense-gather XLA fallback,
+    # never the Pallas paged-attention kernel (the kernel is exercised
+    # explicitly, in interpret mode, by tests/test_paged_attention.py)
+    from paddle_tpu.ops.kernels.paged_attention import paged_attention_enabled
+    assert not paged_attention_enabled(), (
+        "paged-attention kernel routing is ON under the CPU test env — "
+        "tier-1 must run the deterministic dense fallback")
 
 
 @pytest.fixture

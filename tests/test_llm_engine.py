@@ -497,7 +497,10 @@ class TestPagedKV:
         full = eng.B * (eng.capacity // eng.block_size)
         assert eng.n_blocks == 4 < full
         per_block = eng._k[0].shape[1] * eng.block_size * eng._k[0].shape[3]
-        assert eng._k[0].size == 4 * per_block
+        # +1: the trailing scratch block reserved for the Pallas kernel's
+        # fused-write drop target (never allocated to a slot)
+        assert eng._k[0].size == (4 + 1) * per_block
+        assert len(eng._free_blocks) == 4
 
     def test_horizon_composes_with_paged(self, tiny_model):
         rng = np.random.default_rng(34)

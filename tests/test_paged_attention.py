@@ -1,0 +1,249 @@
+"""Pallas paged-attention decode kernel: interpret-mode parity vs the
+dense-gather reference (the XLA fallback inside
+incubate.nn.functional.block_multihead_attention — the shipping CPU path,
+not a divergent test copy), plus the GQA paged serving plumbing the kernel
+unlocks (cache_impl="paged" with num_kv_heads < num_heads).
+
+Covers the block-sparse edge cases: exact block boundaries
+(len % block_size in {0, 1, bs-1}), -1 (unallocated) table entries, mixed
+per-sequence lengths, GQA group sizes {1, 2, 4}, bf16 pools, and the fused
+new-token write (including its scratch-block routing for -1 targets).
+Large shapes ride behind the `slow` marker."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import functional as IF
+from paddle_tpu.ops.kernels.paged_attention import (
+    paged_attention_decode, paged_attention_enabled)
+
+
+def _case(rng, lens, Hq=4, Hkv=4, D=32, BS=8, MB=None, dtype=np.float32,
+          spare_block=False):
+    """Pools + tables covering `lens` (+1 decode position each), physical
+    blocks shuffled, unallocated tail entries left at -1."""
+    B = len(lens)
+    lens = np.asarray(lens, np.int32)
+    MB = MB or int(lens.max()) // BS + 2
+    need = [int(L) // BS + 1 for L in lens]
+    NB = sum(need) + 2 + (1 if spare_block else 0)
+    order = rng.permutation(NB - (1 if spare_block else 0))
+    tables = np.full((B, MB), -1, np.int32)
+    it = iter(order)
+    for b in range(B):
+        for j in range(need[b]):
+            tables[b, j] = next(it)
+    kc = rng.standard_normal((NB, Hkv, BS, D)).astype(dtype)
+    vc = rng.standard_normal((NB, Hkv, BS, D)).astype(dtype)
+    q = rng.standard_normal((B, Hq, D)).astype(dtype)
+    knew = rng.standard_normal((B, Hkv, D)).astype(dtype)
+    vnew = rng.standard_normal((B, Hkv, D)).astype(dtype)
+    return q, kc, vc, tables, lens, knew, vnew
+
+
+def _dense_oracle(q, kc, vc, tables, lens, knew, vnew):
+    """The shipping fallback, via the public op (flag-off is the CPU
+    default; conftest asserts it)."""
+    B, Hq, D = q.shape
+    Hkv = kc.shape[1]
+    qkv = np.concatenate([q.reshape(B, Hq * D), knew.reshape(B, Hkv * D),
+                          vnew.reshape(B, Hkv * D)], axis=-1)
+    out, kc2, vc2 = IF.block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kc), paddle.to_tensor(vc),
+        None, paddle.to_tensor(lens), None,
+        block_tables=paddle.to_tensor(tables))
+    return (np.asarray(out._value), np.asarray(kc2._value),
+            np.asarray(vc2._value))
+
+
+def test_cpu_routes_to_dense_fallback():
+    """Tier-1 runs the deterministic XLA fallback; the kernel is only the
+    TPU fast path (FLAGS_use_paged_attention gates it there)."""
+    assert not paged_attention_enabled()
+
+
+@pytest.mark.parametrize("group", [1, 2, 4])
+def test_fused_parity_block_boundaries_and_gqa(group, rng):
+    """Mixed lengths hitting len % bs in {0, 1, bs-1}, -1 tail entries,
+    GQA groups — kernel (fused write) vs the dense fallback, outputs AND
+    updated pools."""
+    Hkv = 2
+    BS = 8
+    lens = [16, 17, 7, 3]  # %bs: 0, 1, bs-1, mid
+    q, kc, vc, tables, lens, knew, vnew = _case(
+        rng, lens, Hq=Hkv * group, Hkv=Hkv, BS=BS)
+    ref_out, ref_kc, ref_vc = _dense_oracle(q, kc, vc, tables, lens,
+                                            knew, vnew)
+    out, kc2, vc2 = paged_attention_decode(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(tables), jnp.asarray(lens),
+        new_k=jnp.asarray(knew), new_v=jnp.asarray(vnew))
+    np.testing.assert_allclose(np.asarray(out).reshape(ref_out.shape),
+                               ref_out, rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(kc2), ref_kc)
+    np.testing.assert_array_equal(np.asarray(vc2), ref_vc)
+
+
+def test_read_only_parity_prescattered(rng):
+    """Non-fused form: caller already scattered the new token; kernel
+    attends the same positions the dense path does."""
+    q, kc, vc, tables, lens, knew, vnew = _case(rng, [9, 24, 1], Hq=4,
+                                                Hkv=4)
+    ref_out, ref_kc, ref_vc = _dense_oracle(q, kc, vc, tables, lens,
+                                            knew, vnew)
+    out = paged_attention_decode(
+        jnp.asarray(q), jnp.asarray(ref_kc), jnp.asarray(ref_vc),
+        jnp.asarray(tables), jnp.asarray(lens))
+    np.testing.assert_allclose(np.asarray(out).reshape(ref_out.shape),
+                               ref_out, rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_pools_parity(rng):
+    """bf16 pools, fp32 in-kernel accumulation: parity vs the dense path
+    at bf16-appropriate tolerance."""
+    import ml_dtypes
+    q, kc, vc, tables, lens, knew, vnew = _case(
+        rng, [12, 31], Hq=4, Hkv=2, dtype=np.float32)
+    bf = ml_dtypes.bfloat16
+    q, kc, vc = q.astype(bf), kc.astype(bf), vc.astype(bf)
+    knew, vnew = knew.astype(bf), vnew.astype(bf)
+    ref_out, ref_kc, ref_vc = _dense_oracle(q, kc, vc, tables, lens,
+                                            knew, vnew)
+    out, kc2, vc2 = paged_attention_decode(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(tables), jnp.asarray(lens),
+        new_k=jnp.asarray(knew), new_v=jnp.asarray(vnew))
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32).reshape(ref_out.shape),
+        np.asarray(ref_out, np.float32), rtol=2e-2, atol=2e-2)
+    np.testing.assert_array_equal(np.asarray(kc2, np.float32),
+                                  np.asarray(ref_kc, np.float32))
+    np.testing.assert_array_equal(np.asarray(vc2, np.float32),
+                                  np.asarray(ref_vc, np.float32))
+
+
+def test_invalid_write_target_routes_to_scratch_block(rng):
+    """A row whose write-target table entry is -1 (the engine's freed-slot
+    shape: stale lens, wiped tables) must write NO real block — the fused
+    write lands in the pool's trailing scratch block, mirroring the
+    fallback's out-of-range drop."""
+    q, kc, vc, tables, lens, knew, vnew = _case(rng, [5, 18], Hq=2, Hkv=2,
+                                                spare_block=True)
+    tables[0, :] = -1  # row 0: no blocks at all
+    NB = kc.shape[0]
+    out, kc2, vc2 = paged_attention_decode(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(tables), jnp.asarray(lens),
+        new_k=jnp.asarray(knew), new_v=jnp.asarray(vnew))
+    ref_out, ref_kc, _ = _dense_oracle(q, kc, vc, tables, lens, knew, vnew)
+    # every real (non-scratch) block identical to the drop-mode reference
+    np.testing.assert_array_equal(np.asarray(kc2)[:NB - 1],
+                                  ref_kc[:NB - 1])
+    # row 1 (valid) is still attended exactly
+    np.testing.assert_allclose(np.asarray(out)[1].reshape(-1), ref_out[1],
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_large_shape_parity(rng):
+    """Production-ish decode shape (B=8, 32 q heads / 8 kv heads, D=128,
+    bs=64) — interpret mode is slow, keep out of tier-1."""
+    lens = [511, 512, 513, 64, 1, 300, 127, 63]
+    q, kc, vc, tables, lens, knew, vnew = _case(
+        rng, lens, Hq=32, Hkv=8, D=128, BS=64)
+    ref_out, ref_kc, ref_vc = _dense_oracle(q, kc, vc, tables, lens,
+                                            knew, vnew)
+    out, kc2, vc2 = paged_attention_decode(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(tables), jnp.asarray(lens),
+        new_k=jnp.asarray(knew), new_v=jnp.asarray(vnew))
+    np.testing.assert_allclose(np.asarray(out).reshape(ref_out.shape),
+                               ref_out, rtol=2e-4, atol=2e-4)
+    np.testing.assert_array_equal(np.asarray(kc2), ref_kc)
+
+
+# ---------------------------------------------------------------------------
+# the GQA paged path the kernel unlocks (num_kv_heads < num_heads)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gqa_model():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_generate_paged_gqa_matches_static(gqa_model):
+    """cache_impl="paged" now accepts GQA models; greedy output must match
+    the static dense cache token-for-token."""
+    rng = np.random.default_rng(5)
+    ids = paddle.to_tensor(rng.integers(1, 96, size=(2, 9)))
+    a = gqa_model.generate(ids, max_new_tokens=6, cache_impl="static")
+    b = gqa_model.generate(ids, max_new_tokens=6, cache_impl="paged",
+                           block_size=4)
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+
+def test_engine_paged_gqa_parity_with_dense(gqa_model):
+    """The paged serving engine accepts GQA models and stays token-exact
+    vs the dense-slot engine."""
+    from paddle_tpu.inference import LLMEngine
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(1, 96, size=(n,)).astype(np.int32)
+               for n in (9, 14)]
+    dense = LLMEngine(gqa_model, max_batch=2, max_seq_len=64, chunk_size=16)
+    ref = [o.token_ids for o in dense.generate(prompts, max_new_tokens=8)]
+    paged = LLMEngine(gqa_model, max_batch=2, max_seq_len=64, chunk_size=16,
+                      cache_impl="paged", block_size=8)
+    out = [o.token_ids for o in paged.generate(prompts, max_new_tokens=8)]
+    assert out == ref
+
+
+# ---------------------------------------------------------------------------
+# _filter_logits top-k fast path (satellite: no full-vocab sort when top_k
+# already bounds the candidate set)
+# ---------------------------------------------------------------------------
+
+def _filter_reference(logits, temp, top_k, top_p):
+    """The pre-optimization pipeline: top-k mask, then nucleus cutoff over
+    a FULL descending sort of the masked logits."""
+    logits = logits.astype(jnp.float32) / temp
+    V = logits.shape[-1]
+    if top_k and 0 < top_k < V:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p
+    cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+@pytest.mark.parametrize("top_k,top_p", [(8, 0.5), (8, 0.9), (4, 0.99),
+                                         (16, 0.3)])
+def test_filter_logits_topk_slice_matches_full_sort(top_k, top_p, rng):
+    from paddle_tpu.models.llama import _filter_logits
+    logits = jnp.asarray(rng.standard_normal((5, 333)), jnp.float32) * 3.0
+    got = _filter_logits(logits, jnp.float32(0.8), top_k, jnp.float32(top_p))
+    want = _filter_reference(logits, jnp.float32(0.8), top_k,
+                             jnp.float32(top_p))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_filter_logits_no_topk_unchanged(rng):
+    from paddle_tpu.models.llama import _filter_logits
+    logits = jnp.asarray(rng.standard_normal((3, 64)), jnp.float32)
+    got = _filter_logits(logits, jnp.float32(1.0), 0, jnp.float32(0.7))
+    want = _filter_reference(logits, jnp.float32(1.0), 0, jnp.float32(0.7))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
