@@ -3,8 +3,9 @@
 Default (`BENCH_MODEL` unset / `all`): runs every BASELINE.md config plus
 the decode and serving benchmarks — resnet50, bert, vit, unet, llama_decode,
 llama_paged_decode (Pallas paged-attention kernel on/off A/B),
-llama_serve, llama_serve_spec, then the flagship llama LAST — each in its
-own subprocess, one JSON line each, so the tail line stays the llama MFU vs
+llama_serve, llama_serve_fused (fused prefill+decode scheduler on/off
+A/B), llama_serve_spec, then the flagship llama LAST — each in its own
+subprocess, one JSON line each, so the tail line stays the llama MFU vs
 the 45% north star (BASELINE.json).
 `BENCH_MODEL=llama` (or any single name) prints exactly one line.
 
@@ -622,6 +623,106 @@ def _bench_other(model_name):
                 eng.stats["draft_tokens_accepted"] / max(steps, 1), 2)
         return out
 
+    if model_name == "llama_serve_fused":
+        # Fused chunked-prefill + decode scheduling A/B: the SAME model /
+        # prompts / server loop served by LLMEngine(scheduler="fused")
+        # (Sarathi-style token-budget mixed steps — admission is slot
+        # assignment, prefill chunks interleave INTO the decode batch,
+        # one dispatch per engine step) vs the legacy admit-then-decode
+        # scheduler whose prompt-long prefill trains stall every running
+        # decode. Alongside throughput the line records the two numbers
+        # the scheduler exists to move: admission_stall (queued-after-
+        # free-slot time) and ramp-in dispatch counts.
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import LLMEngine
+        from paddle_tpu.serving import AsyncLLMServer
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+        n_req = int(os.environ.get("BENCH_REQUESTS", str(2 * B)))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        cap = 512 + new_tokens
+        chunk = int(os.environ.get("BENCH_CHUNK", "256"))
+        horizon = int(os.environ.get("BENCH_HORIZON", "64"))
+        max_step_tokens = int(os.environ.get("BENCH_MAX_STEP_TOKENS", "0"))
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=heads,
+                          max_position_embeddings=cap)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg).bfloat16()
+        model.eval()
+        lens = [256 + int(x) for x in rng.integers(0, 256, size=n_req)]
+        prompts = [rng.integers(0, cfg.vocab_size, (L,)).astype(np.int32)
+                   for L in lens]
+
+        def run_arm(scheduler):
+            kw = dict(max_batch=B, max_seq_len=cap, chunk_size=chunk,
+                      horizon=horizon, scheduler=scheduler)
+            if scheduler == "fused" and max_step_tokens:
+                kw["max_step_tokens"] = max_step_tokens
+            eng = LLMEngine(model, **kw)
+            eng.generate([prompts[0]], max_new_tokens=2)  # warm programs
+            eng.reset_stats()
+            server = AsyncLLMServer(eng, max_queue_size=n_req + 1)
+            server.start()
+            t0 = time.perf_counter()
+            handles = [server.submit(p, max_new_tokens=new_tokens)
+                       for p in prompts]
+            outs = [h.result(timeout=1800) for h in handles]
+            wall = time.perf_counter() - t0
+            server.stop()
+            toks = sum(len(o.token_ids) for o in outs)
+            snap = server.telemetry.snapshot(wall_s=wall)
+            stall = snap["latency"]["admission_stall"]
+            return {
+                "tokens_per_sec": toks / wall,
+                "admission_stall_p50_ms": round(stall["p50_s"] * 1e3, 1),
+                "admission_stall_p90_ms": round(stall["p90_s"] * 1e3, 1),
+                "prefill_token_share": snap["prefill_token_share"],
+                "ttft_p50_ms": round(
+                    snap["latency"]["ttft"]["p50_s"] * 1e3, 1),
+                "attributed_share": snap["attribution"]["attributed_share"],
+                # ramp-in dispatch shape: legacy = prefill_chunks IS the
+                # dispatch count (one serial dispatch per chunk inside
+                # _admit, decodes stalled behind the train); fused = the
+                # same chunk grants ride inside fused_steps MIXED
+                # dispatches (1 per engine step, decodes riding along)
+                "prefill_chunks": eng.stats["prefill_chunks"],
+                "ramp_dispatches": (eng.stats["fused_steps"]
+                                    if scheduler == "fused"
+                                    else eng.stats["prefill_chunks"]),
+                "fused_steps": eng.stats["fused_steps"],
+                "engine_steps": eng.stats["steps"],
+            }
+
+        fused = run_arm("fused")
+        legacy = run_arm("legacy")
+        at_r05_config = (
+            B == 8 and new_tokens == 64 and n_req == 16 and n_layers == 3
+            and hidden == 4096 and ff == hidden * 11 // 4
+            and horizon == 64 and chunk == 256 and not max_step_tokens
+            and jax.default_backend() != "cpu")
+        return {"metric": "llama_serve_fused_tokens_per_sec",
+                "value": round(fused["tokens_per_sec"], 1),
+                "unit": "tokens/s",
+                # r05 sync-loop serve baseline (BENCH_r05.json): 1,158.9
+                # tok/s at this exact captured config
+                "vs_baseline": (round(fused["tokens_per_sec"] / 1158.9, 4)
+                                if at_r05_config else None),
+                "scheduler_on": fused,
+                "scheduler_off": legacy,
+                "scheduler_speedup": round(
+                    fused["tokens_per_sec"]
+                    / max(legacy["tokens_per_sec"], 1e-9), 3),
+                "requests": n_req, "slots": B, "new_tokens": new_tokens,
+                "prompt_lens": f"{min(lens)}-{max(lens)}",
+                "chunk": chunk, "horizon": horizon,
+                "max_step_tokens": max_step_tokens or chunk + B - 1}
+
     if model_name == "conv_roofline":
         return _bench_conv_roofline()
 
@@ -1073,8 +1174,8 @@ def _run_all():
     import subprocess
     import sys
     for name in ["resnet50", "bert", "vit", "unet", "llama_decode",
-                 "llama_paged_decode", "llama_serve", "llama_serve_spec",
-                 "llama"]:
+                 "llama_paged_decode", "llama_serve", "llama_serve_fused",
+                 "llama_serve_spec", "llama"]:
         env = dict(os.environ, BENCH_MODEL=name)
         try:
             proc = subprocess.run(

@@ -32,8 +32,11 @@ def main():
     model = build_model()
     rng = np.random.default_rng(0)
 
-    # -- the production shape: AsyncLLMServer --------------------------
-    eng = LLMEngine(model, max_batch=4, max_seq_len=128, chunk_size=32)
+    # -- the production shape: AsyncLLMServer over the fused scheduler
+    # (admission = slot assignment; prefill chunks interleave into the
+    # decode batch under max_step_tokens instead of stalling it) --------
+    eng = LLMEngine(model, max_batch=4, max_seq_len=128, chunk_size=32,
+                    scheduler="fused")
     with AsyncLLMServer(eng, max_queue_size=16) as server:
         handles = [
             server.submit(rng.integers(1, 512, size=(n,)).astype(np.int32),

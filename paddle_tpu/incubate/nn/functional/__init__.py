@@ -571,9 +571,27 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     block tables, with the new-token write fused in-kernel. The dense path
     below (scatter + gather the whole padded horizon + einsum) is the
     reference semantics and the CPU/tier-1 fallback.
+
+    Append-step form (the fused prefill+decode scheduler's mixed step):
+    qkv [B, S, (Hq + 2*Hkv)*D] with ``seq_lens_this_time`` [B] = how many
+    of the S rows are real for each sequence (0 = inactive slot). Sequence
+    b's rows occupy positions [seq_lens_decoder[b], seq_lens_decoder[b] +
+    seq_lens_this_time[b]); each row attends causally to the pooled
+    history plus its own chunk prefix. Rows past seq_lens_this_time are
+    padding: nothing is written for them and their outputs are garbage
+    the caller ignores. Routes through
+    :func:`~paddle_tpu.ops.kernels.paged_attention.paged_attention_append`
+    on TPU; the dense scatter+gather+einsum below is the CPU fallback.
     """
     if block_tables is None:
         raise ValueError("block_mha requires block_tables")
+    if len(qkv.shape) == 3:
+        if seq_lens_this_time is None:
+            raise ValueError("append-step block_mha (3-D qkv) requires "
+                             "seq_lens_this_time (per-sequence q_lens)")
+        return _block_mha_append(qkv, key_cache, value_cache,
+                                 seq_lens_decoder, seq_lens_this_time,
+                                 block_tables)
 
     def fn(qkv_v, kc, vc, lens, tables):
         from ....ops.kernels.paged_attention import (
@@ -626,6 +644,75 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
 
     return dispatch(fn, (qkv, key_cache, value_cache, seq_lens_decoder,
                          block_tables), {}, name="block_multihead_attention")
+
+
+def _block_mha_append(qkv, key_cache, value_cache, seq_lens, q_lens,
+                      block_tables):
+    """Append-step paged attention (see block_multihead_attention): S new
+    positions per sequence against the block pools, causal within the
+    chunk. Dense fallback = scatter the valid rows into their blocks
+    (invalid rows route out of range and drop), gather each sequence's
+    padded horizon, einsum with the per-row causal mask — the same
+    reference semantics the decode form uses, extended along S."""
+    def fn(qkv_v, kc, vc, lens, qlens, tables):
+        from ....ops.kernels.paged_attention import (
+            paged_attention_append, paged_attention_enabled)
+
+        nb, Hkv, bs, D = kc.shape
+        b, S = qkv_v.shape[0], qkv_v.shape[1]
+        max_blocks = tables.shape[1]
+        Hq = qkv_v.shape[2] // D - 2 * Hkv
+        q = qkv_v[:, :, :Hq * D].reshape(b, S, Hq, D)
+        knew = qkv_v[:, :, Hq * D:(Hq + Hkv) * D].reshape(b, S, Hkv, D)
+        vnew = qkv_v[:, :, (Hq + Hkv) * D:].reshape(b, S, Hkv, D)
+        lens = lens.astype(jnp.int32)
+        qlens = qlens.astype(jnp.int32)
+        tables = tables.astype(jnp.int32)
+
+        if paged_attention_enabled():
+            out, kc, vc = paged_attention_append(
+                q, kc, vc, tables, lens, qlens, knew, vnew)
+            return out.reshape(b, S, Hq * D), kc, vc
+
+        # scatter valid rows: row i of sequence b lands at absolute
+        # position lens[b]+i when i < qlens[b]; padding / unallocated /
+        # out-of-table rows route out of range and DROP (same contract as
+        # the decode form — a clamped write could clobber a real block)
+        i_idx = jnp.arange(S, dtype=jnp.int32)
+        pos = lens[:, None] + i_idx[None, :]                  # [B, S]
+        valid = i_idx[None, :] < qlens[:, None]
+        blk_log = pos // bs
+        phys = jnp.take_along_axis(
+            tables, jnp.clip(blk_log, 0, max_blocks - 1), axis=1)
+        wblk = jnp.where(valid & (phys >= 0) & (blk_log < max_blocks),
+                         phys, nb)                            # nb = OOB
+        slot = pos % bs
+        wf, sf = wblk.reshape(-1), slot.reshape(-1)
+        kc = kc.at[wf, :, sf].set(knew.reshape(-1, Hkv, D), mode="drop")
+        vc = vc.at[wf, :, sf].set(vnew.reshape(-1, Hkv, D), mode="drop")
+
+        # gather each sequence's logical KV and attend with the per-row
+        # causal mask: kv position t visible to chunk row i iff
+        # t <= lens + i
+        safe_tables = jnp.maximum(tables, 0)
+        kseq = kc[safe_tables]                       # [B, MB, Hkv, bs, D]
+        vseq = vc[safe_tables]
+        kseq = jnp.moveaxis(kseq, 3, 2).reshape(b, max_blocks * bs, Hkv, D)
+        vseq = jnp.moveaxis(vseq, 3, 2).reshape(b, max_blocks * bs, Hkv, D)
+        sc = 1.0 / math.sqrt(D)
+        qg = q.reshape(b, S, Hkv, Hq // Hkv, D)      # GQA head groups
+        logits = jnp.einsum("bshgd,bthd->bhsgt", qg,
+                            kseq).astype(jnp.float32) * sc
+        t_idx = jnp.arange(max_blocks * bs)
+        visible = t_idx[None, None, :] <= (lens[:, None]
+                                           + i_idx[None, :])[:, :, None]
+        logits = jnp.where(visible[:, None, :, None, :], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(vseq.dtype)
+        out = jnp.einsum("bhsgt,bthd->bshgd", probs, vseq)
+        return out.reshape(b, S, Hq * D), kc, vc
+
+    return dispatch(fn, (qkv, key_cache, value_cache, seq_lens, q_lens,
+                         block_tables), {}, name="block_mha_append")
 
 
 def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,

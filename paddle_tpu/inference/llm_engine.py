@@ -72,12 +72,33 @@ class RequestOutput:
 
 
 class _Slot:
-    __slots__ = ("req", "generated", "prompt_len")
+    __slots__ = ("req", "generated", "prompt_len", "prefill_pos", "inflight")
 
-    def __init__(self, req, prompt_len):
+    def __init__(self, req, prompt_len, prefill_pos=None):
         self.req = req
         self.generated = []
         self.prompt_len = prompt_len
+        #: prompt tokens whose prefill has been DISPATCHED (== prompt_len
+        #: once ramp-in completes; legacy admission prefills everything up
+        #: front). The fused scheduler advances it one chunk grant at a
+        #: time, so a partially-prefilled request stays RESIDENT in its
+        #: slot between steps instead of blocking inside _admit.
+        self.prefill_pos = prompt_len if prefill_pos is None else prefill_pos
+        #: decode tokens dispatched but not yet step_finish()ed — the
+        #: paged fused engine's host-side lens mirror (scheduled growth),
+        #: what lets it allocate blocks for step N+1 before step N's
+        #: readout and so pipeline at depth 2 on a full pool.
+        self.inflight = 0
+
+    @property
+    def ramping(self):
+        return self.prefill_pos < self.prompt_len
+
+    def sched_len(self):
+        """Scheduled sequence length: what the device lens will be once
+        every dispatched step lands (== current length when nothing is in
+        flight)."""
+        return self.prefill_pos + len(self.generated) + self.inflight
 
 
 class PendingStep:
@@ -95,15 +116,19 @@ class PendingStep:
     the OLD request's state)."""
 
     __slots__ = ("toks", "was_active", "counts", "spec", "slots",
-                 "pool_done")
+                 "pool_done", "sched")
 
-    def __init__(self, toks, was_active, counts, spec, slots, pool_done):
+    def __init__(self, toks, was_active, counts, spec, slots, pool_done,
+                 sched=None):
         self.toks = toks              # device [rows, B] (spec: [Kh,B,Ks])
         self.was_active = was_active  # device activity history
         self.counts = counts          # spec only: accepted counts [Kh, B]
         self.spec = spec
         self.slots = slots            # list[_Slot|None] snapshot at dispatch
         self.pool_done = pool_done    # outputs retired by the pool allocator
+        #: fused scheduler: per-slot decode tokens SCHEDULED by this
+        #: dispatch ({b: n}); step_finish pays them back off slot.inflight
+        self.sched = sched or {}
 
 
 class LLMEngine:
@@ -114,8 +139,25 @@ class LLMEngine:
     def __init__(self, model, max_batch=4, max_seq_len=None, chunk_size=64,
                  top_k=0, stream_callback=None, horizon=1, speculative_k=1,
                  lookup_ngram=3, mesh=None, cache_impl="dense",
-                 block_size=64, kv_pool_blocks=None):
-        """``mesh``: a jax Mesh for MULTI-PROCESS serving — engine buffers
+                 block_size=64, kv_pool_blocks=None, scheduler="legacy",
+                 max_step_tokens=None):
+        """``scheduler="fused"`` (Sarathi-style chunked-prefill+decode
+        fusion): admission becomes slot ASSIGNMENT only — each engine step
+        then processes, per slot, either one bounded prefill chunk (for
+        ramping-in requests, ``_Slot.prefill_pos`` tracks progress) or one
+        decode token, all in ONE jitted mixed-step dispatch, under the
+        per-step token budget ``max_step_tokens`` (default ``chunk_size +
+        max_batch - 1``: one full chunk plus a decode token for every
+        other slot; decode tokens are always granted — the budget bounds
+        prefill interference, which is what stalls inter-token latency).
+        Steps with no ramping slot fall through to the plain decode scan
+        (with ``horizon``), so steady-state decode cost is unchanged.
+        ``scheduler="legacy"`` keeps admit-then-decode: the whole prompt
+        prefills inside _admit as a serial chunk train while running
+        decodes stall — still the best shape for offline drain-mode
+        batches, and the parity reference for the fused path.
+
+        ``mesh``: a jax Mesh for MULTI-PROCESS serving — engine buffers
         are created as global (replicated) arrays on it so the compiled
         programs can mix them with TP-sharded weights whose groups span
         processes; every process runs the same step() calls (SPMD) and
@@ -161,6 +203,14 @@ class LLMEngine:
         self.chunk = int(chunk_size)
         self.top_k = int(top_k)
         self.stream_callback = stream_callback
+        if scheduler not in ("legacy", "fused"):
+            raise ValueError(f"unknown scheduler {scheduler!r}")
+        if scheduler == "fused" and self.speculative_k > 1:
+            raise ValueError("the fused prefill+decode scheduler serves "
+                             "one token per decode slot per mixed step "
+                             "(speculative verify windows need the legacy "
+                             "scheduler)")
+        self.scheduler = scheduler
 
         model.eval()
         _, params, _, buffers = collect_state(model)
@@ -175,6 +225,14 @@ class LLMEngine:
         # buffer (the final window slides BACK over already-written
         # positions instead of padding the time axis — see _admit)
         self.chunk = min(self.chunk, self.capacity)
+        #: fused-scheduler per-step token cap: sum over slots of (prefill
+        #: chunk grant | 1 decode token). Decode tokens always land; the
+        #: budget throttles how much prefill may ride along per step.
+        self.max_step_tokens = int(max_step_tokens) if max_step_tokens \
+            else self.chunk + self.B - 1
+        if self.max_step_tokens < 1:
+            raise ValueError(f"max_step_tokens must be >= 1, got "
+                             f"{self.max_step_tokens}")
         self._mesh = mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -218,12 +276,14 @@ class LLMEngine:
             self._tables = np.full((self.B, self._max_blocks), -1, np.int32)
             self._free_blocks = list(range(self.n_blocks))
             self._slot_blocks = [[] for _ in range(self.B)]
-            self._admit_order = [0] * self.B
-            self._admit_seq = 0
         else:
             shape = (self.B, self.capacity, kvh, head_dim)
             self._k = [_zeros(shape, np_dt) for _ in range(L)]
             self._v = [_zeros(shape, np_dt) for _ in range(L)]
+        # admission-order stamps: the paged allocator's preempt-newest
+        # invariant AND the fused scheduler's oldest-first budget walk
+        self._admit_order = [0] * self.B
+        self._admit_seq = 0
         self._logits = _zeros((self.B, c.vocab_size), np.float32
                               if mesh is not None else jnp.float32)
         self._lens = _zeros((self.B,), np.int32
@@ -254,6 +314,7 @@ class LLMEngine:
         self._inflight = 0
         self.stats = {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
                       "draft_tokens_accepted": 0, "preemptions": 0,
+                      "fused_steps": 0, "prefill_tokens": 0,
                       "decode_time_s": 0.0, "admit_time_s": 0.0,
                       "dispatch_time_s": 0.0, "host_sync_time_s": 0.0,
                       "emit_time_s": 0.0}
@@ -271,19 +332,26 @@ class LLMEngine:
 
         K = self.horizon
 
-        def one_step(k_bufs, v_bufs, logits, lens, active, rng, state_vals,
-                     temps, top_ps, eos_ids, tables):
-            """sample from current logits -> one-token model step.
-            ``tables`` selects the cache backend at TRACE time: None ->
-            dense SlotKVCache slot buffers; a [B, MB] array -> PagedKVCache
-            block pool (ONE body serves both engines — the carried-logits
-            fix once had to be applied in several copies of this loop)."""
+        def sample_next(logits, rng, temps, top_ps):
+            """THE sample-from-carried-logits prologue: greedy rows argmax,
+            sampling rows the filtered categorical, per-slot select. One
+            copy consumed by one_step, the spec verify windows, AND the
+            fused mixed step (the carried-logits fix once had to be
+            applied in several copies of this code). Returns (nxt, rng)."""
             rng, sub = jax.random.split(rng)
             greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             sampled = _sample_logits_device(
                 logits, sub, jnp.maximum(temps, 1e-6)[:, None], top_k,
                 top_ps[:, None], False, True)
-            nxt = jnp.where(temps <= 0.0, greedy_tok, sampled)
+            return jnp.where(temps <= 0.0, greedy_tok, sampled), rng
+
+        def one_step(k_bufs, v_bufs, logits, lens, active, rng, state_vals,
+                     temps, top_ps, eos_ids, tables):
+            """sample from current logits -> one-token model step.
+            ``tables`` selects the cache backend at TRACE time: None ->
+            dense SlotKVCache slot buffers; a [B, MB] array -> PagedKVCache
+            block pool (ONE body serves both engines)."""
+            nxt, rng = sample_next(logits, rng, temps, top_ps)
             # inactive slots decode garbage; pin them to token 0
             nxt = jnp.where(active, nxt, 0)
             with functional_mode(), _bind(state, state_vals):
@@ -354,12 +422,8 @@ class LLMEngine:
             def body(carry, _):
                 kb, vb, logits, lens, act, emitted, rng, tbuf = carry
                 draft = _lookup_draft(tbuf, lens, Kspec - 1, ngram)
-                rng, sub, sub2 = jax.random.split(rng, 3)
-                greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                sampled = _sample_logits_device(
-                    logits, sub, jnp.maximum(temps, 1e-6)[:, None], top_k,
-                    top_ps[:, None], False, True)
-                committed = jnp.where(temps <= 0.0, greedy_tok, sampled)
+                rng, sub2 = jax.random.split(rng)
+                committed, rng = sample_next(logits, rng, temps, top_ps)
                 committed = jnp.where(act, committed, 0)
                 window = jnp.concatenate([committed[:, None], draft],
                                          axis=1)
@@ -400,6 +464,59 @@ class LLMEngine:
                     None, length=K)
             return (toks, counts, was_active, logits, k_bufs, v_bufs, lens,
                     rng, tokens_buf)
+
+        def fused_step(state_vals, k_bufs, v_bufs, logits, lens, rng, ids,
+                       q_lens, is_decode, active, temps, top_ps,
+                       tables=None):
+            """ONE mixed prefill+decode dispatch (the fused scheduler's
+            step): slot b processes rows [0, q_lens[b]) of ``ids`` —
+            either a prefill chunk (host-provided prompt rows) or one
+            decode token (row 0, sampled IN-GRAPH from the carried
+            logits, so no extra host round-trip vs the plain step).
+            Every slot's rows sit at its own absolute positions
+            (``lens``); padding rows write nothing (drop-scatter) and
+            their outputs are never read. ``tables`` selects the cache
+            backend at trace time exactly like ``step``."""
+            nxt, rng = sample_next(logits, rng, temps, top_ps)
+            # capacity guard for pipelined over-dispatch: a window that
+            # would cross the buffer end deactivates in-graph
+            active = active & (lens + q_lens <= cap)
+            dec = active & is_decode
+            nxt = jnp.where(dec, nxt, 0)
+            q_eff = jnp.where(active, q_lens, 0)
+            row0 = jnp.arange(chunk, dtype=jnp.int32)[None, :] == 0
+            ids = jnp.where(dec[:, None] & row0, nxt[:, None], ids)
+            with functional_mode(), _bind(state, state_vals):
+                if tables is None:
+                    from ..models.llama import ChunkKVCache
+                    caches = [ChunkKVCache(k, v, lens, q_eff)
+                              for k, v in zip(k_bufs, v_bufs)]
+                else:
+                    from ..models.llama import PagedKVCache
+                    caches = [PagedKVCache(k, v, tables, lens, q_eff)
+                              for k, v in zip(k_bufs, v_bufs)]
+                hidden, new_caches = model.llama(
+                    Tensor(ids), kv_caches=caches,
+                    position_offset=Tensor(lens))
+                # per-slot LAST VALID row: a prefill chunk's next-token
+                # logits / the decode token's next logits — one gather,
+                # then the lm head over [B, 1, H] only (never the full
+                # chunk: the head over B*chunk rows would dominate)
+                rows = jnp.take_along_axis(
+                    hidden._value,
+                    jnp.maximum(q_eff - 1, 0)[:, None, None], axis=1)
+                new_logits = model._logits(Tensor(rows))._value[:, 0] \
+                    .astype(jnp.float32)
+            new_logits = jnp.where(active[:, None], new_logits, logits)
+            kb = [cc.k._value if isinstance(cc.k, Tensor) else cc.k
+                  for cc in new_caches]
+            vb = [cc.v._value if isinstance(cc.v, Tensor) else cc.v
+                  for cc in new_caches]
+            new_lens = lens + q_eff
+            # [1, B] token/activity rows: the readout walk in step_finish
+            # is shared with the scan-based steps (K == 1 here)
+            return (nxt[None], dec[None], new_logits, kb, vb, new_lens,
+                    rng)
 
         def prefill_chunk(state_vals, k_bufs, v_bufs, ids, slot, off, last):
             """Run chunk `ids` [1, chunk] of one prompt through the model
@@ -507,6 +624,9 @@ class LLMEngine:
         # the paged step IS the unified step with `tables` bound — one
         # traced body serves both cache backends
         self._step_paged_fn = self._step_fn
+        # same trick for the fused mixed step: one traced body, the
+        # `tables` arg selects dense ChunkKVCache vs PagedKVCache
+        self._fused_fn = jax.jit(fused_step, donate_argnums=(1, 2, 3))
         self._spec_fn = jax.jit(spec_step, donate_argnums=(1, 2, 3, 11))
         self._prefill_fn = jax.jit(prefill_chunk, donate_argnums=(1, 2))
         self._set_logits_fn = jax.jit(set_logits, donate_argnums=(0,))
@@ -590,14 +710,46 @@ class LLMEngine:
         return need <= have or self._alloc_blocks(slot_idx, need - have)
 
     def prefill_blocks_needed(self, prompt_len):
-        """Pool blocks the chunked prefill of a ``prompt_len``-token
-        prompt must cover: prefill writes whole chunks, block-quantized.
-        THE one copy of this arithmetic — admission, the too-small-pool
-        check, the self-preempt recoverability guard, and the serving
-        layer's synchronous validation all call it."""
+        """Pool blocks the prefill of a ``prompt_len``-token prompt must
+        cover. THE one copy of this arithmetic — admission, the
+        too-small-pool check, the self-preempt recoverability guard, and
+        the serving layer's synchronous validation all call it. Legacy
+        admission writes whole chunk windows (chunk-rounded, block-
+        quantized); the fused scheduler drop-scatters exact token
+        positions, so it needs the prompt's own blocks plus the one the
+        FIRST decode token grows into (position prompt_len) — without
+        that +1 a block-aligned prompt that exactly fills the pool would
+        admit, ramp fully, then silently retire 'preempted_pool' with
+        zero tokens where the legacy path raises the loud too-small-pool
+        error."""
+        if self.scheduler == "fused":
+            return -(-(prompt_len + 1) // self.block_size)
         pad_end = min(-(-prompt_len // self.chunk) * self.chunk,
                       self.capacity)
         return -(-pad_end // self.block_size)
+
+    def max_pipeline_depth(self):
+        """How many step_begin() dispatches may be in flight at once.
+
+        Dense and speculative engines: 2 (the in-graph guards make one
+        step of host staleness safe — see step_begin). Paged LEGACY: 1 —
+        its block allocator needs each step's post-readout lens. Paged
+        FUSED re-examines that restriction: block allocation moved into
+        the unified scheduler, which mirrors the device lens exactly
+        (growth per dispatch is the scheduled q_lens — nothing
+        deactivates in-graph without also retiring), so allocation no
+        longer needs the readout. What still does is PREEMPTION: evicting
+        a slot while a step is in flight would free blocks the in-flight
+        program still writes, then hand them to another slot. On a FULL
+        pool (>= max_batch * blocks-per-slot) allocation can never fail,
+        preemption never fires, and the paged fused engine pipelines at
+        depth 2 like the dense one; an oversubscribed pool stays at 1."""
+        if self.cache_impl != "paged":
+            return 2
+        if self.scheduler == "fused" and \
+                self.n_blocks >= self.B * self._max_blocks:
+            return 2
+        return 1
 
     def _free_slot(self, slot_idx):
         if self.cache_impl == "paged":
@@ -626,6 +778,23 @@ class LLMEngine:
         self._preempt_slot(b, retired=retired)
         return b
 
+    def _retire_pool_edge(self, b, retired=None):
+        """Retire slot ``b`` at the pool edge with the distinct
+        'preempted_pool' reason (not 'capacity' — that is the engine's
+        sequence-length cap). THE one copy of the retire block — the
+        recoverability guard, the legacy coverage loop's sole-slot case,
+        and the fused scheduler's coverage all call it."""
+        slot = self.slots[b]
+        out = RequestOutput(
+            slot.req.request_id,
+            self._finish_tokens(slot.req, slot.generated), True,
+            "preempted_pool")
+        self.finished_outputs[slot.req.request_id] = out
+        if retired is not None:
+            retired.append(out)
+        self._free_slot(b)
+        return out
+
     def _preempt_slot(self, b, retired=None):
         """Evict slot ``b`` back to the FRONT of the waiting queue: its
         committed tokens join the prompt so re-prefill reproduces the
@@ -642,14 +811,7 @@ class LLMEngine:
         done = np.concatenate([req.prompt_ids,
                                np.asarray(slot.generated, np.int32)])
         if self.prefill_blocks_needed(len(done)) > self.n_blocks:
-            out = RequestOutput(
-                req.request_id,
-                self._finish_tokens(req, slot.generated), True,
-                "preempted_pool")
-            self.finished_outputs[req.request_id] = out
-            if retired is not None:
-                retired.append(out)
-            self._free_slot(b)
+            self._retire_pool_edge(b, retired)
             return
         prefix = self._preempted_prefix.get(req.request_id, [])
         self._preempted_prefix[req.request_id] = \
@@ -686,6 +848,15 @@ class LLMEngine:
                 return False
         off = 0
         logits_row = None
+        # ONE zero-padded prompt buffer per admit, sliced per window (the
+        # old loop re-allocated a chunk-sized np.zeros and re-copied the
+        # table row for EVERY chunk — pure host overhead on the admission
+        # path), and ONE table-row copy: the row doesn't change during the
+        # loop (blocks were allocated above).
+        padded = np.zeros((max(-(-P // self.chunk) * self.chunk,
+                               self.chunk),), np.int32)
+        padded[:P] = req.prompt_ids
+        table_row = self._tables[slot_idx].copy() if paged else None
         while off < P:
             take = min(self.chunk, P - off)
             if paged:
@@ -698,13 +869,11 @@ class LLMEngine:
                 # positions [win, off) are recomputed (producing identical
                 # KV) and the new tokens land exactly at [off, off+take)
                 win = min(off, self.capacity - self.chunk)
-            chunk_ids = np.zeros((1, self.chunk), np.int32)
-            real = req.prompt_ids[win:min(win + self.chunk, P)]
-            chunk_ids[0, :len(real)] = real
+            chunk_ids = padded[win:win + self.chunk][None]
             if paged:
                 self._k, self._v, logits_row = self._prefill_paged_fn(
                     self._state_vals, self._k, self._v, chunk_ids,
-                    self._tables[slot_idx].copy(), np.int32(win),
+                    table_row, np.int32(win),
                     np.int32(off + take - 1 - win))
             else:
                 self._k, self._v, logits_row = self._prefill_fn(
@@ -713,6 +882,7 @@ class LLMEngine:
                     np.int32(off + take - 1 - win))
             off += take
             self.stats["prefill_chunks"] += 1
+            self.stats["prefill_tokens"] += take
         if paged:
             # drop the chunk-padding over-allocation: keep only the blocks
             # the prompt actually occupies (+ the one decode grows into)
@@ -722,8 +892,8 @@ class LLMEngine:
                 phys = blocks.pop()
                 self._tables[slot_idx, len(blocks)] = -1
                 self._free_blocks.append(phys)
-            self._admit_order[slot_idx] = self._admit_seq
-            self._admit_seq += 1
+        self._admit_order[slot_idx] = self._admit_seq
+        self._admit_seq += 1
         self._logits = self._set_logits_fn(self._logits, logits_row,
                                            np.int32(slot_idx))
         self._lens = self._set_len_fn(self._lens, np.int32(slot_idx),
@@ -737,7 +907,24 @@ class LLMEngine:
         self.slots[slot_idx] = _Slot(req, P)
         self.stats["admit_time_s"] += time.perf_counter() - t0
 
+    def _admit_fused(self, slot_idx, req):
+        """Fused-scheduler admission: pure slot ASSIGNMENT — no prefill
+        dispatch, no block allocation (both happen chunk-by-chunk inside
+        the step scheduler). The only device op is zeroing the slot's
+        traced length; everything else is host bookkeeping, so admission
+        cost is O(1) and never stalls running decodes."""
+        t0 = time.perf_counter()
+        self._programs()
+        self._lens = self._set_len_fn(self._lens, np.int32(slot_idx),
+                                      np.int32(0))
+        self.slots[slot_idx] = _Slot(req, len(req.prompt_ids),
+                                     prefill_pos=0)
+        self._admit_order[slot_idx] = self._admit_seq
+        self._admit_seq += 1
+        self.stats["admit_time_s"] += time.perf_counter() - t0
+
     def _admit_waiting(self):
+        fused = self.scheduler == "fused"
         for b in range(self.B):
             if not self.waiting:
                 break
@@ -752,8 +939,16 @@ class LLMEngine:
                         f"{req.max_new_tokens} -> {room} (engine capacity "
                         f"{self.capacity})", RuntimeWarning, stacklevel=3)
                     req.max_new_tokens = room
+                if fused and self.cache_impl == "paged" and \
+                        self.prefill_blocks_needed(len(req.prompt_ids)) > \
+                        self.n_blocks:
+                    # can NEVER ramp in: leave it at the head; step_begin
+                    # raises the loud too-small-pool error
+                    break
                 self.waiting.popleft()
-                if self._admit(b, req) is False:
+                if fused:
+                    self._admit_fused(b, req)
+                elif self._admit(b, req) is False:
                     # paged pool dry: requeue and wait for a retirement
                     self.waiting.appendleft(req)
                     break
@@ -791,11 +986,13 @@ class LLMEngine:
         enforced)."""
         from ..core import random as _random
 
-        if self.cache_impl == "paged" and self._inflight:
+        if self.cache_impl == "paged" and \
+                self._inflight >= self.max_pipeline_depth():
             raise RuntimeError(
-                "paged engine cannot pipeline step_begin() calls: its "
-                "block allocator needs the previous step's lens "
-                "(step_finish the outstanding PendingStep first)")
+                "paged engine cannot pipeline step_begin() calls this "
+                "deep: its block allocator needs the previous step's "
+                "lens (step_finish the outstanding PendingStep first; "
+                "see max_pipeline_depth())")
         self._admit_waiting()
         if not any(s is not None for s in self.slots):
             if self.waiting and self.cache_impl == "paged":
@@ -829,6 +1026,13 @@ class LLMEngine:
             self._rng_key = key
         spec = self.speculative_k > 1
         pool_budget, pool_done = {}, []
+        if self.scheduler == "fused" and \
+                any(s is not None and s.ramping for s in self.slots):
+            # at least one slot is ramping in: ONE fused mixed dispatch
+            # covers its prefill chunk AND every decode slot's token.
+            # All-decode steps fall through to the plain scan below
+            # (horizon amortization intact in steady state).
+            return self._begin_mixed_step(pool_done)
         if self.cache_impl == "paged":
             # block coverage for the horizon's growth (last written
             # position is cur + horizon - 1); pool pressure first grabs
@@ -843,7 +1047,11 @@ class LLMEngine:
                 if self.slots[b] is None:
                     continue  # evicted below while ensuring an older slot
                 slot = self.slots[b]
-                cur = slot.prompt_len + len(slot.generated)
+                # sched_len counts in-flight growth too: under the fused
+                # scheduler's depth-2 paged pipelining the host allocates
+                # for step N+1 before step N's readout (legacy engines
+                # run depth 1 here, where sched_len == current length)
+                cur = slot.sched_len()
                 last_pos = min(cur + self.horizon - 1, self.capacity - 1)
                 while not self._ensure_blocks(b, last_pos):
                     if self._free_blocks:
@@ -873,13 +1081,7 @@ class LLMEngine:
                                for i, s in enumerate(self.slots)):
                             self._preempt_slot(b, retired=pool_done)
                             break
-                        out = RequestOutput(
-                            slot.req.request_id,
-                            self._finish_tokens(slot.req, slot.generated),
-                            True, "preempted_pool")
-                        self.finished_outputs[slot.req.request_id] = out
-                        pool_done.append(out)
-                        self._free_slot(b)
+                        self._retire_pool_edge(b, pool_done)
                         break
 
         active = np.array([s is not None for s in self.slots])
@@ -929,8 +1131,166 @@ class LLMEngine:
         self.stats["dispatch_time_s"] += dt
         self.stats["decode_time_s"] += dt
         self._inflight += 1
+        sched = {}
+        if self.scheduler == "fused":
+            # host lens mirror for the paged depth-2 pipeline: a surviving
+            # slot grows exactly `horizon` tokens per scan dispatch (every
+            # in-graph early-deactivation — eos, budget, capacity — also
+            # retires the slot at readout, so the mirror never undershoots
+            # a live slot)
+            for b, slot in enumerate(self.slots):
+                if slot is not None and active[b]:
+                    slot.inflight += self.horizon
+                    sched[b] = self.horizon
         return PendingStep(toks, was_active, counts, spec, list(self.slots),
-                           pool_done)
+                           pool_done, sched=sched)
+
+    # ------------------------------------------------------------------
+    # fused scheduler: the mixed prefill+decode step
+    # ------------------------------------------------------------------
+    def _ensure_pos_covered(self, b, pos, retired):
+        """Cover decode position ``pos`` for slot ``b``, preempting NEWER
+        slots under pool pressure (the horizon-1 mirror of the legacy
+        coverage loop). Returns False when slot ``b`` itself had to be
+        preempted (parked) or retired at the pool edge."""
+        while not self._ensure_blocks(b, pos):
+            victim = self._preempt_newest(
+                exclude=b, newer_than=self._admit_order[b], retired=retired)
+            if victim is not None:
+                continue
+            if any(s is not None and i != b
+                   for i, s in enumerate(self.slots)):
+                self._preempt_slot(b, retired=retired)
+            else:
+                # sole active slot at the pool edge: parking it would
+                # readmit into the same dry pool and spin
+                self._retire_pool_edge(b, retired)
+            return False
+        return True
+
+    def _schedule_mixed(self, pool_done):
+        """One token-budget scheduling pass: per slot, either one decode
+        token (always granted — the budget bounds prefill interference,
+        not decode progress) or a prefill chunk grant of up to
+        ``min(chunk, remaining prompt, budget left)`` tokens, walked in
+        admission order so older requests ramp first. Paged slots
+        allocate their blocks HERE (the allocator moved into the unified
+        scheduler); a ramping slot that can't cover its grant shrinks it
+        to the blocks it could grab and otherwise waits for a
+        retirement."""
+        B, S = self.B, self.chunk
+        paged = self.cache_impl == "paged"
+        ids = np.zeros((B, S), np.int32)
+        q_lens = np.zeros((B,), np.int32)
+        is_dec = np.zeros((B,), bool)
+        active = np.zeros((B,), bool)
+        sched = {}
+        budget = self.max_step_tokens
+        order = sorted((b for b, s in enumerate(self.slots)
+                        if s is not None),
+                       key=lambda i: self._admit_order[i])
+        for b in order:                      # decode slots first
+            slot = self.slots[b]
+            if slot is None or slot.ramping:
+                continue
+            cur = slot.sched_len()
+            if cur >= self.capacity:
+                continue  # pipelined overshoot; readout retires it
+            if paged and not self._ensure_pos_covered(b, cur, pool_done):
+                continue
+            q_lens[b] = 1
+            is_dec[b] = True
+            active[b] = True
+            sched[b] = 1
+            budget -= 1
+        first_ramp = True
+        for b in order:                      # then prefill grants
+            slot = self.slots[b]
+            if slot is None or not slot.ramping:
+                continue
+            # progress guarantee: even when decode tokens alone exhaust
+            # the budget (max_step_tokens < live decode slots), the
+            # OLDEST ramping slot still gets one token — otherwise a
+            # pathological budget starves ramp-in behind long decodes
+            grant_cap = budget if budget > 0 else (1 if first_ramp else 0)
+            if grant_cap <= 0:
+                continue
+            pos = slot.prefill_pos
+            take = min(S, slot.prompt_len - pos, grant_cap)
+            if paged and take > 0 and \
+                    not self._ensure_blocks(b, pos + take - 1):
+                if self._free_blocks:
+                    self._alloc_blocks(b, len(self._free_blocks))
+                covered = len(self._slot_blocks[b]) * self.block_size
+                take = min(take, covered - pos)
+            if take <= 0:
+                continue
+            # the guaranteed token is spent only on a grant that LANDED —
+            # a pool-blocked oldest ramp must not eat it while a younger
+            # ramping slot with covered blocks could make progress
+            first_ramp = False
+            ids[b, :take] = slot.req.prompt_ids[pos:pos + take]
+            q_lens[b] = take
+            active[b] = True
+            budget -= take
+        return ids, q_lens, is_dec, active, sched
+
+    def _begin_mixed_step(self, pool_done):
+        """Schedule and DISPATCH one fused mixed step (>= 1 slot is
+        ramping): the whole ramp-in costs one dispatch per engine step
+        instead of O(prompt_len / chunk) serial admission dispatches with
+        every decode slot stalled behind them."""
+        for _ in range(self.B + 1):
+            ids, q_lens, is_dec, active, sched = \
+                self._schedule_mixed(pool_done)
+            if active.any():
+                break
+            # nothing schedulable: every assigned slot is ramping into a
+            # dry pool — park the newest (frees blocks for an older ramp;
+            # _preempt_slot's recoverability guard retires hopeless ones)
+            if self._preempt_newest(retired=pool_done) is None:
+                break
+        if not active.any():
+            if pool_done:
+                return PendingStep(None, None, None, False,
+                                   list(self.slots), pool_done)
+            return None
+        temps = np.array([s.req.temperature if s else 0.0
+                          for s in self.slots], np.float32)
+        top_ps = np.array([s.req.top_p if s else 1.0
+                           for s in self.slots], np.float32)
+
+        t0 = time.perf_counter()
+        if self.cache_impl == "paged":
+            (toks, was_active, self._logits, self._k, self._v, self._lens,
+             self._rng_key) = self._fused_fn(
+                self._state_vals, self._k, self._v, self._logits,
+                self._lens, self._rng_key, ids, q_lens, is_dec, active,
+                temps, top_ps, self._tables.copy())
+        else:
+            (toks, was_active, self._logits, self._k, self._v, self._lens,
+             self._rng_key) = self._fused_fn(
+                self._state_vals, self._k, self._v, self._logits,
+                self._lens, self._rng_key, ids, q_lens, is_dec, active,
+                temps, top_ps)
+        dt = time.perf_counter() - t0
+        self.stats["dispatch_time_s"] += dt
+        self.stats["decode_time_s"] += dt
+        self.stats["fused_steps"] += 1
+        # host mirrors of the scheduled growth (dispatch-time, so the
+        # next step — possibly dispatched before this one's readout —
+        # schedules from the post-step state)
+        for b in np.nonzero(active)[0]:
+            slot = self.slots[b]
+            if is_dec[b]:
+                slot.inflight += 1
+            else:
+                slot.prefill_pos += int(q_lens[b])
+                self.stats["prefill_chunks"] += 1
+                self.stats["prefill_tokens"] += int(q_lens[b])
+        self._inflight += 1
+        return PendingStep(toks, was_active, None, False, list(self.slots),
+                           pool_done, sched=sched)
 
     def step_finish(self, pending):
         """Block on ``pending``'s device→host token transfer, attribute the
@@ -943,6 +1303,12 @@ class LLMEngine:
         if pending.toks is None:
             return list(pending.pool_done)
         self._inflight -= 1
+        # pay the dispatch's scheduled decode growth back off the
+        # host-side lens mirror (fused scheduler; {} otherwise)
+        for b, n in pending.sched.items():
+            slot = pending.slots[b]
+            if slot is not None and self.slots[b] is slot:
+                slot.inflight = max(0, slot.inflight - n)
         t0 = time.perf_counter()
         if spec:
             toks3 = np.asarray(pending.toks)          # [Kh, B, Kspec]

@@ -227,12 +227,26 @@ class PrefixRecorder:
         from ..amp import policy_fingerprint
         amp_sig = policy_fingerprint()
         layout, tvals, statics = _classify(leaves)
-        try:
-            for s in statics:
+        for s in statics:
+            try:
                 hash(s)
-        except TypeError:
-            self.aborted = f"unhashable static arg in {name}"
-            return
+            except TypeError:
+                # hashability is an IMMUTABILITY heuristic: a mutable
+                # static (list/dict) mutated after the recording would
+                # pass _matches' equality check against ITSELF and replay
+                # stale values. `slice` is immutable but only hashable
+                # from Python 3.12 — admit it when its components are
+                # (getitem's `x[:, :n]` is all over model code; this was
+                # the silent capture-killer for every prefix crossing an
+                # indexing op on 3.10/3.11)
+                if isinstance(s, slice):
+                    try:
+                        hash((s.start, s.stop, s.step))
+                        continue
+                    except TypeError:
+                        pass
+                self.aborted = f"unhashable static arg in {name}"
+                return
         # PRNG keys closed over by the op fn (dropout's pre-dispatch draw):
         # replay substitutes fresh derived keys into these cells
         key_cells = []

@@ -182,13 +182,51 @@ class PagedKVCache:
     ``block_tables`` [B, max_blocks] mapping logical KV block -> physical
     block (-1 = unallocated), and ``seq_lens`` [B] tokens already cached.
     Decode steps attend through
-    :func:`paddle_tpu.incubate.nn.functional.block_multihead_attention`."""
+    :func:`paddle_tpu.incubate.nn.functional.block_multihead_attention`.
 
-    __slots__ = ("k", "v", "block_tables", "seq_lens")
+    ``q_lens`` (the fused scheduler's mixed step): per-sequence count of
+    REAL rows in an S>1 window — sequence b appends positions
+    [seq_lens[b], seq_lens[b]+q_lens[b]) (a prefill chunk, one decode
+    token, or 0 = idle slot; rows past q_lens are padding). Required for
+    S>1; None keeps the one-token decode-step contract."""
 
-    def __init__(self, k, v, block_tables, seq_lens):
+    __slots__ = ("k", "v", "block_tables", "seq_lens", "q_lens")
+
+    def __init__(self, k, v, block_tables, seq_lens, q_lens=None):
         self.k, self.v = k, v
         self.block_tables, self.seq_lens = block_tables, seq_lens
+        self.q_lens = q_lens
+
+
+class ChunkKVCache:
+    """Dense slot buffers with per-slot APPEND windows — the fused
+    prefill+decode scheduler's dense cache: ``k``/``v`` are [B, capacity,
+    H, D] slot buffers, ``lens`` [B] tokens already cached, ``q_lens``
+    [B] how many of the step's S rows are real for each slot. Row i of
+    slot b writes position lens[b]+i when i < q_lens[b] (padding and
+    past-capacity rows DROP — no dynamic-slice clamping that could slide
+    back over live history) and attends causally to positions
+    <= lens[b]+i. The engine advances ``lens`` by q_lens itself."""
+
+    __slots__ = ("k", "v", "lens", "q_lens")
+
+    def __init__(self, k, v, lens, q_lens):
+        self.k, self.v, self.lens, self.q_lens = k, v, lens, q_lens
+
+
+def _window_causal_mask(s, T):
+    """Additive mask builder for a per-slot decode/append window: row i of
+    slot b sits at absolute position lens[b]+i and may see positions
+    <= lens[b]+i (cached history plus its own window prefix). THE one copy
+    — the SlotKVCache and ChunkKVCache attention branches both dispatch
+    it, so the sentinel/dtype can never diverge between the legacy slot
+    path and the fused mixed step."""
+    def mask_fn(lens):
+        rows = lens.astype(jnp.int32)[:, None, None, None] + \
+            jnp.arange(s, dtype=jnp.int32)[None, None, :, None]
+        valid = jnp.arange(T, dtype=jnp.int32)[None, None, None, :] <= rows
+        return jnp.where(valid, jnp.float32(0), jnp.float32(-1e30))
+    return mask_fn
 
 
 def _filter_logits(logits, temp_val, top_k, top_p_val, use_top_p=True):
@@ -313,11 +351,26 @@ class LlamaAttention(Layer):
             # block_multihead_attention op — the framework's own paged-KV
             # kernel as the generate() cache backend. GQA-capable: q keeps
             # num_heads, K/V the (possibly smaller) num_kv_heads.
-            if s != 1:
-                raise ValueError("PagedKVCache is a decode-step cache "
-                                 f"(one token per step); got seq len {s}")
             from ..incubate.nn import functional as IF
             H, Hkv, D = self.num_heads, self.num_kv_heads, self.head_dim
+            if s != 1:
+                # fused mixed step: S rows per slot, q_lens of them real —
+                # the APPEND form of the op (Pallas append kernel on TPU,
+                # dense scatter+gather fallback on CPU)
+                if kv_cache.q_lens is None:
+                    raise ValueError(
+                        "PagedKVCache with seq len > 1 is the fused "
+                        "append step and needs per-slot q_lens")
+                qkv = ops.concat([ops.reshape(q, [b, s, H * D]),
+                                  ops.reshape(k, [b, s, Hkv * D]),
+                                  ops.reshape(v, [b, s, Hkv * D])], axis=-1)
+                out, kc, vc = IF.block_multihead_attention(
+                    qkv, kv_cache.k, kv_cache.v, None, kv_cache.seq_lens,
+                    kv_cache.q_lens, block_tables=kv_cache.block_tables)
+                out = self.o_proj(ops.reshape(out, [b, s, H * D]))
+                return out, PagedKVCache(
+                    kc, vc, kv_cache.block_tables,
+                    kv_cache.seq_lens + kv_cache.q_lens, kv_cache.q_lens)
             qkv = ops.concat([ops.reshape(q, [b, H * D]),
                               ops.reshape(k, [b, Hkv * D]),
                               ops.reshape(v, [b, Hkv * D])], axis=-1)
@@ -327,6 +380,41 @@ class LlamaAttention(Layer):
             out = self.o_proj(ops.reshape(out, [b, 1, H * D]))
             new_lens = kv_cache.seq_lens + 1
             return out, PagedKVCache(kc, vc, kv_cache.block_tables, new_lens)
+        if isinstance(kv_cache, ChunkKVCache):
+            # fused mixed step, dense cache: write slot b's q_lens[b] real
+            # rows at positions lens[b]+i via a DROP scatter (padding and
+            # past-capacity rows vanish instead of dynamic-slice clamping
+            # back over live history), causal mask against each row's own
+            # absolute position — one compiled program serves any mix of
+            # prefill chunks and decode tokens across slots.
+            def chunk_write(kb, vb, kk, vv, lens, qlens):
+                cap_t = kb.shape[1]
+                lens = lens.astype(jnp.int32)
+                i_idx = jnp.arange(s, dtype=jnp.int32)
+                pos = lens[:, None] + i_idx[None, :]
+                pos = jnp.where(i_idx[None, :] < qlens.astype(jnp.int32)
+                                [:, None], pos, cap_t)      # OOB -> drop
+
+                def upd(buf, new, p):
+                    return buf.at[p].set(new.astype(buf.dtype),
+                                         mode="drop")
+
+                return (jax.vmap(upd)(kb, kk, pos),
+                        jax.vmap(upd)(vb, vv, pos))
+
+            k_buf, v_buf = dispatch(
+                chunk_write,
+                (kv_cache.k, kv_cache.v, k, v, kv_cache.lens,
+                 kv_cache.q_lens), {}, name="chunk_kv_update")
+            T = k_buf.shape[1]
+            mask = dispatch(_window_causal_mask(s, T), (kv_cache.lens,),
+                            {}, name="chunk_decode_mask")
+            out = F.scaled_dot_product_attention(
+                q, k_buf, v_buf, attn_mask=mask, is_causal=False,
+                training=self.training)
+            out = ops.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), ChunkKVCache(
+                k_buf, v_buf, kv_cache.lens, kv_cache.q_lens)
         if isinstance(kv_cache, SlotKVCache):
             # continuous-batching decode window (s=1 plain step, s=K a
             # speculative verify window): write slot b's s new positions at
@@ -343,17 +431,8 @@ class LlamaAttention(Layer):
                 slot_step, (kv_cache.k, kv_cache.v, k, v, kv_cache.lens), {},
                 name="slot_kv_update")
             T = k_buf.shape[1]
-
-            def slot_mask(lens):
-                # window row q of slot b sits at absolute position lens[b]+q
-                rows = lens.astype(jnp.int32)[:, None, None, None] + \
-                    jnp.arange(s, dtype=jnp.int32)[None, None, :, None]
-                valid = jnp.arange(T, dtype=jnp.int32)[None, None, None, :] \
-                    <= rows
-                return jnp.where(valid, jnp.float32(0), jnp.float32(-1e30))
-
-            mask = dispatch(slot_mask, (kv_cache.lens,), {},
-                            name="slot_decode_mask")
+            mask = dispatch(_window_causal_mask(s, T), (kv_cache.lens,),
+                            {}, name="slot_decode_mask")
             out = F.scaled_dot_product_attention(
                 q, k_buf, v_buf, attn_mask=mask, is_causal=False,
                 training=self.training)

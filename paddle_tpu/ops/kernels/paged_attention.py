@@ -46,6 +46,16 @@ On non-TPU backends the same kernel runs under interpret mode (parity
 tests); the production CPU path stays the XLA dense-gather fallback in
 ``incubate.nn.functional.block_multihead_attention`` (see
 ``paged_attention_enabled``).
+
+``paged_attention_append`` extends the decode kernel from q_len=1 to
+q_len=chunk **append attention** — the mixed prefill+decode step of the
+fused token-budget scheduler (``LLMEngine(scheduler="fused")``): each
+sequence appends ``q_lens[b]`` new positions at ``seq_lens[b]``, every
+query row attends causally to its own chunk prefix plus all prior pooled
+KV, and the whole chunk's K/V writes back to the pools in-kernel (the
+write can span several blocks; each overlapped block is merged in VMEM
+and stored through the aliased pool outputs). Same gating: TPU fast path
+behind ``FLAGS_use_paged_attention``, dense append fallback on CPU.
 """
 from __future__ import annotations
 
@@ -266,3 +276,206 @@ def paged_attention_decode(q, k_pool, v_pool, block_tables, seq_lens,
     if write_new:
         return out, outs[1], outs[2]
     return out
+
+
+# ---------------------------------------------------------------------------
+# append attention: q_len = chunk (the fused prefill+decode mixed step)
+# ---------------------------------------------------------------------------
+
+def _apd_blk(lens_ref, qlens_ref, b, bs, mb, last):
+    """Block index of the append window's first (``last=False``) or last
+    (``last=True``) written position, clamped into the table. q_lens == 0
+    degenerates both to the block holding ``lens`` (nothing is written;
+    that block is stored back unchanged so the aliased out window never
+    copies out undefined VMEM)."""
+    pos = lens_ref[b] + (jnp.maximum(qlens_ref[b] - 1, 0) if last else 0)
+    return jnp.minimum(jax.lax.div(pos, np.int32(bs)), np.int32(mb - 1))
+
+
+def _apd_q_index_map(b, h, j, tables_ref, lens_ref, qlens_ref):
+    return (b, h, Z, Z)
+
+
+def _apd_kv_index_map(bs, mb):
+    def im(b, h, j, tables_ref, lens_ref, qlens_ref):
+        j_last = _apd_blk(lens_ref, qlens_ref, b, bs, mb, True)
+        jj = jnp.minimum(j, j_last)          # dead tail re-maps to last live
+        return (jnp.maximum(tables_ref[b, jj], Z), h, Z, Z)
+    return im
+
+
+def _apd_new_index_map(b, h, j, tables_ref, lens_ref, qlens_ref):
+    return (b, h, Z, Z)
+
+
+def _apd_pool_out_index_map(bs, mb, nb):
+    """Fused-write destinations: the blocks overlapping the append window
+    [lens, lens+q_lens). Steps outside the window pin to its boundary
+    blocks, so their mapping never changes and no copy is issued — only
+    the overlapped blocks (each merged + stored in the kernel) pay a
+    write. -1 targets (a freed slot's wiped table row) route to the
+    pool's trailing scratch block, as in the decode kernel."""
+    def im(b, h, j, tables_ref, lens_ref, qlens_ref):
+        w0 = _apd_blk(lens_ref, qlens_ref, b, bs, mb, False)
+        w1 = _apd_blk(lens_ref, qlens_ref, b, bs, mb, True)
+        phys = tables_ref[b, jnp.clip(j, w0, w1)]
+        return (jnp.where(phys < Z, np.int32(nb - 1), phys), h, Z, Z)
+    return im
+
+
+def _append_kernel(tables_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref,
+                   nk_ref, nv_ref, o_ref, ko_ref, vo_ref, m_ref, l_ref,
+                   acc_ref, *, scale, bs, mb, s_chunk):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    bs_i = np.int32(bs)
+    L = lens_ref[b]
+    QL = qlens_ref[b]
+    j_last = _apd_blk(lens_ref, qlens_ref, b, bs, mb, True)
+    w0 = _apd_blk(lens_ref, qlens_ref, b, bs, mb, False)
+    jj = jnp.minimum(j, j_last)
+    phys = tables_ref[b, jj]
+    live = (j <= j_last) & (phys >= Z) & (QL > Z)
+
+    @pl.when(j == Z)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    k_blk = k_ref[0, 0]                                       # [bs, D]
+    v_blk = v_ref[0, 0]
+    # merge the chunk rows that land in THIS block into it in VMEM: block
+    # row r holds chunk index i = j*bs + r - lens when 0 <= i < q_lens.
+    # The gather is expressed as a one-hot selection matmul ([bs, S] @
+    # [S, D] — MXU-friendly; Mosaic has no per-row dynamic gather), so
+    # attention sees the whole new chunk this step and the merged block
+    # writes back through the aliased pool outputs.
+    row = jax.lax.broadcasted_iota(jnp.int32, (bs, s_chunk), 0)
+    ci = jax.lax.broadcasted_iota(jnp.int32, (bs, s_chunk), 1)
+    sel = ((jj * bs_i + row - L) == ci) & (ci < QL) & (ci >= Z)
+    has_new = jnp.any(sel, axis=1, keepdims=True)             # [bs, 1]
+    sel_f = sel.astype(jnp.float32)
+    merged_k = jax.lax.dot_general(
+        sel_f, nk_ref[0, 0].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    merged_v = jax.lax.dot_general(
+        sel_f, nv_ref[0, 0].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    k_blk = jnp.where(has_new, merged_k.astype(k_blk.dtype), k_blk)
+    v_blk = jnp.where(has_new, merged_v.astype(v_blk.dtype), v_blk)
+
+    @pl.when((j >= w0) & (j <= j_last))
+    def _store_block():
+        ko_ref[0, 0] = k_blk.astype(ko_ref.dtype)
+        vo_ref[0, 0] = v_blk.astype(vo_ref.dtype)
+
+    g_s = q_ref.shape[2]                                      # G * S rows
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32) * np.float32(scale)  # [G*S, D]
+        s = jax.lax.dot_general(q, k_blk.astype(jnp.float32),
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        # query row r is chunk index i = r % S at absolute position
+        # lens + i; causal against pooled history AND its own chunk
+        r = jax.lax.broadcasted_iota(jnp.int32, (g_s, bs), 0)
+        i_chunk = jax.lax.rem(r, np.int32(s_chunk))
+        pos = jj * bs_i + jax.lax.broadcasted_iota(jnp.int32, (g_s, bs), 1)
+        s = jnp.where(pos <= L + i_chunk, s, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(j == np.int32(mb - 1))
+    def _finalize():
+        l = jnp.maximum(l_ref[...], np.float32(1e-30))
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention_append(q, k_pool, v_pool, block_tables, seq_lens,
+                           q_lens, new_k, new_v, scale=None):
+    """Append attention off the block pools: one fused prefill+decode step.
+
+    q: [B, S, Hq, D] — up to S new positions per sequence (rows past
+    ``q_lens[b]`` are padding; their outputs are garbage the caller
+    ignores); k_pool/v_pool: [num_blocks, Hkv, block_size, D];
+    block_tables: [B, max_blocks]; seq_lens: [B] tokens already cached —
+    sequence b's chunk occupies positions [seq_lens[b],
+    seq_lens[b]+q_lens[b]); q_lens: [B] valid rows (0 = inactive slot:
+    no compute, no write). new_k/new_v: [B, S, Hkv, D], the chunk's K/V
+    — always fused-written (blocks overlapping the window are merged in
+    VMEM, attention sees the chunk without a prior scatter round-trip,
+    and write back through aliased outputs).
+
+    Query row i of sequence b attends causally: pooled positions plus its
+    own chunk prefix (kv position <= seq_lens[b] + i). The caller must
+    have blocks allocated to cover the window (the fused scheduler does);
+    a -1 target writes to the pool's trailing scratch block.
+
+    Returns (out [B, S, Hq, D] in q.dtype, k_pool, v_pool).
+    """
+    B, S, Hq, D = q.shape
+    NB, Hkv, BS, Dk = k_pool.shape
+    assert D == Dk, (q.shape, k_pool.shape)
+    assert Hq % Hkv == 0, f"GQA needs Hq % Hkv == 0, got {Hq=} {Hkv=}"
+    G = Hq // Hkv
+    MB = block_tables.shape[1]
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+
+    # [B, S, Hq, D] -> [B, Hkv, G*S, D]: row r = g*S + i (head-major, so
+    # the q-head split matches the decode kernel's (Hkv, G) grouping)
+    q4 = jnp.transpose(q, (0, 2, 1, 3)).reshape(B, Hkv, G * S, D)
+    nk = jnp.transpose(new_k, (0, 2, 1, 3)).astype(k_pool.dtype)
+    nv = jnp.transpose(new_v, (0, 2, 1, 3)).astype(v_pool.dtype)
+    tables = block_tables.astype(jnp.int32)
+    lens = seq_lens.astype(jnp.int32)
+    qlens = q_lens.astype(jnp.int32)
+
+    pool_spec = pl.BlockSpec((1, 1, BS, D),
+                             _apd_pool_out_index_map(BS, MB, NB))
+    kernel = functools.partial(_append_kernel, scale=scale, bs=BS, mb=MB,
+                               s_chunk=S)
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(B, Hkv, MB),
+            in_specs=[
+                pl.BlockSpec((1, 1, G * S, D), _apd_q_index_map),
+                pl.BlockSpec((1, 1, BS, D), _apd_kv_index_map(BS, MB)),
+                pl.BlockSpec((1, 1, BS, D), _apd_kv_index_map(BS, MB)),
+                pl.BlockSpec((1, 1, S, D), _apd_new_index_map),
+                pl.BlockSpec((1, 1, S, D), _apd_new_index_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, G * S, D), _apd_q_index_map),
+                pool_spec, pool_spec,
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((G * S, 1), jnp.float32),   # running max m
+                pltpu.VMEM((G * S, 1), jnp.float32),   # running norm l
+                pltpu.VMEM((G * S, D), jnp.float32),   # output accumulator
+            ],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((B, Hkv, G * S, D), q.dtype),
+                   jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                   jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)],
+        # flat input indices INCLUDE the scalar-prefetch operands
+        input_output_aliases={4: 1, 5: 2},
+        # sequential everywhere: scratch carries over blocks and clamped
+        # write destinations may collide across batch windows
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+        interpret=_interpret(),
+    )(tables, lens, qlens, q4, k_pool, v_pool, nk, nv)
+    out = outs[0].reshape(B, Hkv, G, S, D)
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, S, Hq, D)
+    return out, outs[1], outs[2]

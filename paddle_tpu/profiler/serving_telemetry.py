@@ -125,11 +125,17 @@ class ServingTelemetry:
                 "requests_finished": 0, "requests_cancelled": 0,
                 "requests_expired": 0, "requests_rejected_queue_full": 0,
                 "tokens_emitted": 0, "engine_steps": 0, "preemptions": 0,
+                "prefill_tokens": 0,
             }
             self.ttft_s = LatencyHistogram()
             self.inter_token_s = LatencyHistogram()
             self.e2e_s = LatencyHistogram()
             self.queue_wait_s = LatencyHistogram()
+            #: time a waiting request spent queued AFTER a free slot
+            #: existed — admission lag behind capacity. The legacy
+            #: admit-then-decode path pays it whenever prefill trains
+            #: block the loop; the fused scheduler drives it to ~0.
+            self.admission_stall_s = LatencyHistogram()
 
     # -- write side (engine thread + submitters) ------------------------
     def add_stage(self, name, dt):
@@ -186,8 +192,16 @@ class ServingTelemetry:
                     "inter_token": self.inter_token_s.snapshot(),
                     "e2e": self.e2e_s.snapshot(),
                     "queue_wait": self.queue_wait_s.snapshot(),
+                    "admission_stall": self.admission_stall_s.snapshot(),
                 },
             }
+            prefill = self.counters["prefill_tokens"]
+            decode = self.counters["tokens_emitted"]
+            #: share of all processed tokens that were PREFILL — how much
+            #: of the serve work is ramp-in (the fused scheduler's
+            #: interference budget is about bounding this per step)
+            out["prefill_token_share"] = round(
+                prefill / (prefill + decode), 4) if prefill + decode else 0.0
         out["attribution"] = self.attribution(wall_s)
         return out
 
@@ -200,8 +214,13 @@ class ServingTelemetry:
             hists = {"ttft_seconds": self.ttft_s,
                      "inter_token_seconds": self.inter_token_s,
                      "e2e_seconds": self.e2e_s,
-                     "queue_wait_seconds": self.queue_wait_s}
-            lines = []
+                     "queue_wait_seconds": self.queue_wait_s,
+                     "admission_stall_seconds": self.admission_stall_s}
+            prefill = self.counters["prefill_tokens"]
+            decode = self.counters["tokens_emitted"]
+            share = prefill / (prefill + decode) if prefill + decode else 0.0
+            lines = [f"# TYPE {prefix}_prefill_token_share gauge",
+                     f"{prefix}_prefill_token_share {share:g}"]
             for name, val in sorted(counters.items()):
                 full = f"{prefix}_{name}_total"
                 lines.append(f"# TYPE {full} counter")

@@ -76,14 +76,14 @@ class AsyncLLMServer:
             raise ValueError(f"pipeline_depth must be >= 1, "
                              f"got {pipeline_depth}")
         self.engine = engine
-        if engine.cache_impl == "paged":
-            # the paged host block allocator needs step N's lens before
-            # dispatching N+1 — depth is structurally 1
-            self.pipeline_depth = 1
-        else:
-            # the loop dispatches at most ONE step ahead of the sync, so
-            # the honored (and reported) maximum is 2
-            self.pipeline_depth = min(int(pipeline_depth or 2), 2)
+        # the engine knows its own safe depth: 2 for dense/speculative,
+        # 2 for the paged FUSED scheduler on a full pool (its scheduler
+        # mirrors the device lens, so allocation no longer needs the
+        # readout), 1 for legacy/oversubscribed paged (the allocator /
+        # preemption need post-step state). The loop dispatches at most
+        # ONE step ahead of the sync, so the honored maximum is 2.
+        self.pipeline_depth = min(int(pipeline_depth or 2), 2,
+                                  engine.max_pipeline_depth())
         self.poll_interval_s = float(poll_interval_s)
         self.telemetry = telemetry or ServingTelemetry()
         self._queue = AdmissionQueue(max_queue_size)
@@ -234,6 +234,7 @@ class AsyncLLMServer:
                 self._sweep_cancels_and_deadlines()
                 with tel.stage("queue_admit"):
                     self._feed_engine()
+                    self._mark_admission_stalls()
                 if pending is None:
                     try:
                         pending = self._begin_step()
@@ -295,14 +296,18 @@ class AsyncLLMServer:
         s_admit = eng.stats["admit_time_s"]
         s_disp = eng.stats["dispatch_time_s"]
         s_pre = eng.stats["preemptions"]
+        s_ptok = eng.stats["prefill_tokens"]
         t0 = time.perf_counter()
         pending = eng.step_begin()
         wall = time.perf_counter() - t0
         d_admit = eng.stats["admit_time_s"] - s_admit
         d_disp = eng.stats["dispatch_time_s"] - s_disp
+        d_ptok = eng.stats["prefill_tokens"] - s_ptok
         tel.add_stage("prefill_dispatch", d_admit)
         tel.add_stage("decode_dispatch", d_disp)
         tel.add_stage("schedule", max(wall - d_admit - d_disp, 0.0))
+        if d_ptok:
+            tel.inc("prefill_tokens", d_ptok)
         if eng.stats["preemptions"] > s_pre:
             # pool-pressure preemptions happen inside step_begin's
             # allocator loop — this is where the delta is visible
@@ -354,7 +359,8 @@ class AsyncLLMServer:
 
     def _note_admissions(self):
         """Mark handles whose request just entered an engine slot as
-        RUNNING and record their queue wait (submit → slot admission)."""
+        RUNNING and record their queue wait (submit → slot admission)
+        plus the admission stall (first-free-slot → slot admission)."""
         now = time.monotonic()
         with self._hlock:
             handles = dict(self._handles)
@@ -368,6 +374,45 @@ class AsyncLLMServer:
                 wait = now - h.request.submitted_at
                 self.telemetry.inc("requests_admitted")
                 self.telemetry.observe("queue_wait_s", wait)
+                self.telemetry.observe(
+                    "admission_stall_s",
+                    max(now - h.stall_mark, 0.0)
+                    if h.stall_mark is not None else 0.0)
+
+    def _mark_admission_stalls(self):
+        """Stamp the moment a FREE slot exists for a request that could
+        take it; _note_admissions turns the stamp into the
+        admission_stall_s observation. Only as many of the OLDEST pending
+        requests as there are free slots carry a stamp — the rest are
+        waiting on CAPACITY, not on admission, and their marks clear (a
+        stamped-then-refilled slot must not convert a capacity wait into
+        a reported stall). Under the legacy scheduler the stall covers
+        whole admission prefill trains and step horizons; the fused
+        scheduler admits on the next loop pass (~0)."""
+        eng = self.engine
+        free = sum(1 for s in eng.slots if s is None)
+        now = time.monotonic()
+        with self._hlock:
+            handles = list(self._handles.values())
+        pending = sorted((h for h in handles
+                          if h.state is RequestState.PENDING),
+                         key=lambda h: h.request.submitted_at)
+        # legacy paged admission also needs POOL blocks for the whole
+        # prompt — a free slot over a dry pool is still a capacity wait,
+        # not an admission stall (fused admission allocates lazily, so a
+        # free slot alone is admissible there)
+        legacy_paged = eng.cache_impl == "paged" and \
+            eng.scheduler != "fused"
+        for i, h in enumerate(pending):
+            admissible = i < free and (
+                not legacy_paged
+                or eng.prefill_blocks_needed(len(h.request.prompt_ids))
+                <= len(eng._free_blocks))
+            if admissible:
+                if h.stall_mark is None:
+                    h.stall_mark = now
+            else:
+                h.stall_mark = None
 
     def _sweep_cancels_and_deadlines(self):
         """Apply caller cancellations and expire deadlines. A running

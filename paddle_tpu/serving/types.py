@@ -90,6 +90,9 @@ class RequestHandle:
         self.admitted_at: float | None = None
         self.first_token_at: float | None = None
         self.last_token_at: float | None = None
+        #: first moment a FREE slot existed while this request was still
+        #: waiting — admission_stall_s measures admission lag from here
+        self.stall_mark: float | None = None
 
     @property
     def request_id(self):

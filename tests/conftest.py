@@ -28,13 +28,18 @@ def pytest_configure(config):
         "markers", "slow: long soak/scale variants excluded from tier-1 "
         "(-m 'not slow')")
     # tier-1 determinism contract: on the CPU test backend
-    # block_multihead_attention must take the dense-gather XLA fallback,
-    # never the Pallas paged-attention kernel (the kernel is exercised
-    # explicitly, in interpret mode, by tests/test_paged_attention.py)
-    from paddle_tpu.ops.kernels.paged_attention import paged_attention_enabled
+    # block_multihead_attention must take the dense-gather XLA fallback —
+    # never the Pallas paged-attention DECODE kernel, and never the
+    # APPEND kernel behind the fused scheduler's mixed step (both gate on
+    # the same flag+TPU check; both are exercised explicitly, in
+    # interpret mode, by tests/test_paged_attention.py). So every fused-
+    # scheduler tier-1 test drives the dense append fallback.
+    from paddle_tpu.ops.kernels.paged_attention import (  # noqa: F401
+        paged_attention_append, paged_attention_enabled)
     assert not paged_attention_enabled(), (
-        "paged-attention kernel routing is ON under the CPU test env — "
-        "tier-1 must run the deterministic dense fallback")
+        "paged-attention kernel routing (decode + append) is ON under "
+        "the CPU test env — tier-1 must run the deterministic dense "
+        "fallback")
 
 
 @pytest.fixture

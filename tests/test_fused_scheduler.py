@@ -1,0 +1,353 @@
+"""Fused chunked-prefill + decode scheduling (LLMEngine scheduler="fused").
+
+The correctness bar is TOKEN-EXACTNESS against the legacy
+admit-then-decode path on the SAME cache backend: interleaving bounded
+prefill chunks into the decode batch (Sarathi-style token-budget
+scheduling) reorders work across slots but must never change any slot's
+own greedy stream. Covered here: mixed prompt lengths hitting
+len % chunk in {0, 1, chunk-1}, dense and paged caches, GQA, mid-stream
+admission, budget throttling, oversubscribed-pool preemption, the
+re-examined paged pipeline-depth contract, and serving through
+AsyncLLMServer with admission as pure queue insertion."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(7)
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def gqa_model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _prompts(seed, sizes):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 96, size=(n,)).astype(np.int32) for n in sizes]
+
+
+def _pair(model, cache_impl="dense", **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("chunk_size", 16)
+    if cache_impl == "paged":
+        kw.setdefault("block_size", 8)
+    legacy = LLMEngine(model, cache_impl=cache_impl, **kw)
+    fused = LLMEngine(model, cache_impl=cache_impl, scheduler="fused", **kw)
+    return legacy, fused
+
+
+class TestGreedyParity:
+    @pytest.mark.parametrize("cache_impl", ["dense", "paged"])
+    def test_chunk_boundary_prompt_lens(self, tiny_model, cache_impl):
+        """len % chunk in {0, 1, chunk-1} plus short prompts, more
+        requests than slots — fused streams identical to legacy."""
+        chunk = 16
+        prompts = _prompts(1, (16, 17, 15, 5, 32, 3))  # %16: 0,1,15,5,0,3
+        legacy, fused = _pair(tiny_model, cache_impl, chunk_size=chunk)
+        ref = [o.token_ids for o in legacy.generate(prompts,
+                                                    max_new_tokens=8)]
+        out = [o.token_ids for o in fused.generate(prompts,
+                                                   max_new_tokens=8)]
+        assert out == ref
+        # ramp-in actually went through the fused mixed step
+        assert fused.stats["fused_steps"] > 0
+        assert fused.stats["prefill_tokens"] == sum(len(p) for p in prompts)
+
+    @pytest.mark.parametrize("cache_impl", ["dense", "paged"])
+    def test_gqa(self, gqa_model, cache_impl):
+        prompts = _prompts(2, (9, 17, 16, 6))
+        legacy, fused = _pair(gqa_model, cache_impl)
+        ref = [o.token_ids for o in legacy.generate(prompts,
+                                                    max_new_tokens=8)]
+        out = [o.token_ids for o in fused.generate(prompts,
+                                                   max_new_tokens=8)]
+        assert out == ref
+
+    def test_mid_stream_admission_exact(self, tiny_model):
+        """A request joining while another decodes ramps in through mixed
+        steps without perturbing the running stream."""
+        p1, p2 = _prompts(3, (19, 14))
+        legacy, fused = _pair(tiny_model)
+        (r1,) = legacy.generate([p1], max_new_tokens=10)
+        (r2,) = legacy.generate([p2], max_new_tokens=5)
+        a = fused.add_request(p1, max_new_tokens=10)
+        for _ in range(3):
+            fused.step()
+        b = fused.add_request(p2, max_new_tokens=5)
+        while fused.has_unfinished():
+            fused.step()
+        assert fused.finished_outputs[a].token_ids == r1.token_ids
+        assert fused.finished_outputs[b].token_ids == r2.token_ids
+
+    def test_budget_throttles_prefill_not_decode(self, tiny_model):
+        """A tight max_step_tokens spreads ramp-in over more steps (grants
+        smaller than a chunk) but never changes tokens; decode slots keep
+        emitting every step."""
+        prompts = _prompts(4, (33, 21))
+        legacy, fused_ = _pair(tiny_model)
+        ref = [o.token_ids for o in legacy.generate(prompts,
+                                                    max_new_tokens=8)]
+        tight = LLMEngine(tiny_model, max_batch=2, max_seq_len=64,
+                          chunk_size=16, scheduler="fused",
+                          max_step_tokens=5)
+        out = [o.token_ids for o in tight.generate(prompts,
+                                                   max_new_tokens=8)]
+        assert out == ref
+        # 5-token budget, 1 reserved per decode slot: ramp-in needed many
+        # more mixed steps than the chunk count
+        assert tight.stats["fused_steps"] > \
+            sum(-(-len(p) // 16) for p in prompts)
+
+    def test_horizon_composes(self, tiny_model):
+        """All-decode steps fall back to the horizon scan: steady-state
+        amortization survives the fused scheduler, tokens unchanged."""
+        prompts = _prompts(5, (11, 7))
+        legacy, _ = _pair(tiny_model)
+        ref = [o.token_ids for o in legacy.generate(prompts,
+                                                    max_new_tokens=12)]
+        fused = LLMEngine(tiny_model, max_batch=2, max_seq_len=64,
+                          chunk_size=16, scheduler="fused", horizon=4)
+        out = [o.token_ids for o in fused.generate(prompts,
+                                                   max_new_tokens=12)]
+        assert out == ref
+        # decode ran through the scan arm, not one-token mixed steps
+        assert fused.stats["steps"] < fused.stats["tokens_generated"]
+
+    def test_eos_finishes_request(self, tiny_model):
+        p = _prompts(6, (9,))[0]
+        legacy, fused = _pair(tiny_model, max_batch=1)
+        (ref,) = legacy.generate([p], max_new_tokens=12)
+        eos = ref.token_ids[2]
+        (le,) = legacy.generate([p], max_new_tokens=12, eos_token_id=eos)
+        (fu,) = fused.generate([p], max_new_tokens=12, eos_token_id=eos)
+        assert fu.token_ids == le.token_ids
+        assert fu.finish_reason == "eos"
+
+
+class TestFusedPagedPool:
+    def test_oversubscribed_pool_preempts_and_stays_exact(self, tiny_model):
+        prompts = _prompts(7, (25, 27))
+        full = LLMEngine(tiny_model, max_batch=2, max_seq_len=64,
+                         chunk_size=16, cache_impl="paged", block_size=8,
+                         scheduler="fused")
+        ref = [o.token_ids for o in full.generate(prompts,
+                                                  max_new_tokens=10)]
+        sub = LLMEngine(tiny_model, max_batch=2, max_seq_len=64,
+                        chunk_size=16, cache_impl="paged", block_size=8,
+                        scheduler="fused", kv_pool_blocks=8)
+        out = [o.token_ids for o in sub.generate(prompts,
+                                                 max_new_tokens=10)]
+        assert out == ref
+        assert sub.stats["preemptions"] >= 1
+        assert len(sub._free_blocks) == 8
+
+    def test_blocks_free_at_retirement(self, tiny_model):
+        eng = LLMEngine(tiny_model, max_batch=2, max_seq_len=64,
+                        chunk_size=16, cache_impl="paged", block_size=8,
+                        scheduler="fused")
+        eng.generate(_prompts(8, (13,)), max_new_tokens=6)
+        assert len(eng._free_blocks) == eng.n_blocks
+        assert all(t == -1 for t in eng._tables.ravel())
+
+    def test_block_aligned_prompt_filling_pool_errors_loudly(self,
+                                                             tiny_model):
+        """Regression: a block-aligned prompt whose blocks exactly fill
+        the pool leaves no room for even ONE decode token. The fused
+        admission arithmetic must count that +1 block and raise the loud
+        too-small-pool error like legacy — not admit, fully ramp, and
+        silently retire 'preempted_pool' with zero tokens."""
+        p = _prompts(16, (24,))[0]  # 24 % 8 == 0 -> exactly 3 blocks
+        fused = LLMEngine(tiny_model, max_batch=1, max_seq_len=64,
+                          chunk_size=16, cache_impl="paged", block_size=8,
+                          scheduler="fused", kv_pool_blocks=3)
+        with pytest.raises(RuntimeError, match="kv_pool_blocks too small"):
+            fused.generate([p], max_new_tokens=4)
+        # the serving layer's synchronous validation agrees
+        from paddle_tpu.serving import AsyncLLMServer
+        server = AsyncLLMServer(fused)
+        server._accepting = True
+        with pytest.raises(ValueError, match="pool"):
+            server.submit(p, max_new_tokens=4)
+
+    def test_fused_needs_exact_blocks_not_chunk_rounded(self, tiny_model):
+        """The fused scheduler drop-scatters exact positions, so a prompt
+        needs only its own blocks — a pool too small for the legacy
+        chunk-rounded prefill still serves the fused path."""
+        p = _prompts(9, (17,))[0]
+        # legacy: round_up(17, chunk=16) = 32 tokens = 4 blocks > pool(3)
+        legacy = LLMEngine(tiny_model, max_batch=1, max_seq_len=64,
+                           chunk_size=16, cache_impl="paged", block_size=8,
+                           kv_pool_blocks=3)
+        with pytest.raises(RuntimeError, match="kv_pool_blocks too small"):
+            legacy.generate([p], max_new_tokens=4)
+        full = LLMEngine(tiny_model, max_batch=1, max_seq_len=64,
+                         chunk_size=16, cache_impl="paged", block_size=8,
+                         scheduler="fused")
+        (ref,) = full.generate([p], max_new_tokens=2)
+        fused = LLMEngine(tiny_model, max_batch=1, max_seq_len=64,
+                          chunk_size=16, cache_impl="paged", block_size=8,
+                          scheduler="fused", kv_pool_blocks=3)
+        # 17 tokens -> 3 blocks (24 positions): ramps in, decodes to the
+        # pool edge, retires with the distinct pool reason
+        (out,) = fused.generate([p], max_new_tokens=30)
+        assert out.finish_reason == "preempted_pool"
+        n = len(out.token_ids)
+        assert 0 < n < 30
+        assert out.token_ids == ref.token_ids[:n] or n >= 2
+
+
+class TestPipelineDepthContract:
+    def test_depths(self, tiny_model):
+        dense, dense_f = _pair(tiny_model)
+        assert dense.max_pipeline_depth() == 2
+        assert dense_f.max_pipeline_depth() == 2
+        paged_l, paged_f = _pair(tiny_model, "paged")
+        # legacy paged stays 1; fused on a FULL pool re-examines to 2
+        assert paged_l.max_pipeline_depth() == 1
+        assert paged_f.max_pipeline_depth() == 2
+        over = LLMEngine(tiny_model, max_batch=2, max_seq_len=64,
+                         chunk_size=16, cache_impl="paged", block_size=8,
+                         scheduler="fused", kv_pool_blocks=8)
+        # oversubscribed: preemption may fire mid-flight — stays 1
+        assert over.max_pipeline_depth() == 1
+
+    def test_paged_fused_full_pool_pipelines_depth2_exact(self, tiny_model):
+        """step_begin() may be called again before step_finish() on the
+        fused full-pool paged engine (the legacy engine raises here), and
+        the pipelined streams stay token-exact."""
+        prompts = _prompts(10, (9, 17, 12, 5))
+        legacy, fused = _pair(tiny_model, "paged")
+        ref = {i: o.token_ids
+               for i, o in enumerate(legacy.generate(prompts,
+                                                     max_new_tokens=8))}
+        for p in prompts:
+            fused.add_request(p, max_new_tokens=8)
+        outs = {}
+        pending = fused.step_begin()
+        while fused.has_unfinished():
+            nxt = fused.step_begin() if pending is not None else None
+            if pending is not None:
+                for o in fused.step_finish(pending):
+                    outs[o.request_id] = o
+            pending = nxt
+            if pending is None and fused.has_unfinished():
+                pending = fused.step_begin()
+        if pending is not None:
+            for o in fused.step_finish(pending):
+                outs[o.request_id] = o
+        assert [outs[i].token_ids for i in sorted(outs)] == \
+            [ref[i] for i in sorted(ref)]
+        assert len(fused._free_blocks) == fused.n_blocks
+
+    def test_oversubscribed_fused_rejects_second_begin(self, tiny_model):
+        eng = LLMEngine(tiny_model, max_batch=2, max_seq_len=64,
+                        chunk_size=16, cache_impl="paged", block_size=8,
+                        scheduler="fused", kv_pool_blocks=8)
+        eng.add_request(_prompts(11, (6,))[0], max_new_tokens=4)
+        pending = eng.step_begin()
+        assert pending is not None
+        with pytest.raises(RuntimeError, match="pipeline"):
+            eng.step_begin()
+        eng.step_finish(pending)
+        while eng.has_unfinished():
+            eng.step()
+
+
+class TestFusedServing:
+    def test_serve_streams_match_generate(self, tiny_model):
+        """AsyncLLMServer over a fused engine: admission is queue
+        insertion only (no prefill train in the admit path), streams stay
+        token-exact, and the new telemetry fields are live."""
+        from paddle_tpu.serving import AsyncLLMServer
+
+        prompts = _prompts(12, (5, 17, 16, 8))
+        legacy, fused = _pair(tiny_model)
+        ref = [o.token_ids for o in legacy.generate(prompts,
+                                                    max_new_tokens=6)]
+        server = AsyncLLMServer(fused, max_queue_size=8)
+        assert server.pipeline_depth == 2
+        with server:
+            handles = [server.submit(p, max_new_tokens=6) for p in prompts]
+            streams = [list(h.tokens(timeout=120)) for h in handles]
+        assert streams == ref
+        snap = server.telemetry.snapshot()
+        assert snap["counters"]["prefill_tokens"] == \
+            sum(len(p) for p in prompts)
+        assert 0.0 < snap["prefill_token_share"] < 1.0
+        assert snap["latency"]["admission_stall"]["count"] >= 1
+
+    def test_serve_paged_fused_depth2(self, tiny_model):
+        from paddle_tpu.serving import AsyncLLMServer
+
+        prompts = _prompts(13, (9, 13, 6))
+        legacy, fused = _pair(tiny_model, "paged")
+        ref = [o.token_ids for o in legacy.generate(prompts,
+                                                    max_new_tokens=6)]
+        server = AsyncLLMServer(fused, max_queue_size=8)
+        assert server.pipeline_depth == 2  # the re-examined contract
+        with server:
+            handles = [server.submit(p, max_new_tokens=6) for p in prompts]
+            results = [h.result(timeout=240) for h in handles]
+        assert [r.token_ids for r in results] == ref
+        assert len(fused._free_blocks) == fused.n_blocks
+
+
+def test_fused_rejects_speculative(tiny_model):
+    with pytest.raises(ValueError, match="fused"):
+        LLMEngine(tiny_model, max_batch=1, max_seq_len=64, chunk_size=16,
+                  scheduler="fused", speculative_k=4)
+
+
+def test_unknown_scheduler_rejected(tiny_model):
+    with pytest.raises(ValueError, match="scheduler"):
+        LLMEngine(tiny_model, scheduler="warp")
+
+
+def test_capacity_cap_fused(tiny_model):
+    """A fused slot that reaches engine capacity retires 'capacity' like
+    the legacy path."""
+    p = _prompts(14, (10,))[0]
+    legacy = LLMEngine(tiny_model, max_batch=1, max_seq_len=16,
+                       chunk_size=8)
+    (ref,) = legacy.generate([p], max_new_tokens=50)
+    fused = LLMEngine(tiny_model, max_batch=1, max_seq_len=16,
+                      chunk_size=8, scheduler="fused")
+    (out,) = fused.generate([p], max_new_tokens=50)
+    assert out.token_ids == ref.token_ids
+    assert out.finish_reason == ref.finish_reason
+    assert len(out.token_ids) + 10 <= 16
+
+
+def test_quantized_weights_fused(tiny_model):
+    """int8 weight-only serving through the fused scheduler."""
+    from paddle_tpu.nn.quant import quantize_linears_for_inference
+    import copy
+
+    p = _prompts(15, (17,))[0]
+    qm = copy.deepcopy(tiny_model)
+    quantize_linears_for_inference(qm, weight_dtype="int8")
+    legacy = LLMEngine(qm, max_batch=1, max_seq_len=64, chunk_size=8)
+    (ref,) = legacy.generate([p], max_new_tokens=5)
+    fused = LLMEngine(qm, max_batch=1, max_seq_len=64, chunk_size=8,
+                      scheduler="fused")
+    (out,) = fused.generate([p], max_new_tokens=5)
+    assert out.token_ids == ref.token_ids

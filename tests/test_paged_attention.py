@@ -17,7 +17,8 @@ import jax.numpy as jnp
 import paddle_tpu as paddle
 from paddle_tpu.incubate.nn import functional as IF
 from paddle_tpu.ops.kernels.paged_attention import (
-    paged_attention_decode, paged_attention_enabled)
+    paged_attention_append, paged_attention_decode,
+    paged_attention_enabled)
 
 
 def _case(rng, lens, Hq=4, Hkv=4, D=32, BS=8, MB=None, dtype=np.float32,
@@ -162,6 +163,158 @@ def test_large_shape_parity(rng):
     np.testing.assert_allclose(np.asarray(out).reshape(ref_out.shape),
                                ref_out, rtol=2e-4, atol=2e-4)
     np.testing.assert_array_equal(np.asarray(kc2), ref_kc)
+
+
+# ---------------------------------------------------------------------------
+# append attention (q_len = chunk): the fused scheduler's mixed step
+# ---------------------------------------------------------------------------
+
+def _append_case(rng, lens, qlens, Hq=4, Hkv=2, D=32, BS=8, S=8,
+                 dtype=np.float32):
+    """Pools + tables covering each sequence's append window
+    [lens, lens+max(qlens,1)), shuffled physical blocks, -1 tails, and a
+    trailing scratch block (the -1-write drop target)."""
+    B = len(lens)
+    lens = np.asarray(lens, np.int32)
+    qlens = np.asarray(qlens, np.int32)
+    MB = int((lens + np.maximum(qlens, 1)).max()) // BS + 2
+    need = [(int(l) + max(int(q), 1) - 1) // BS + 1
+            for l, q in zip(lens, qlens)]
+    NB = sum(need) + 2
+    order = rng.permutation(NB)
+    tables = np.full((B, MB), -1, np.int32)
+    it = iter(order)
+    for b in range(B):
+        for j in range(need[b]):
+            tables[b, j] = next(it)
+    kc = rng.standard_normal((NB + 1, Hkv, BS, D)).astype(dtype)
+    vc = rng.standard_normal((NB + 1, Hkv, BS, D)).astype(dtype)
+    q = rng.standard_normal((B, S, Hq, D)).astype(dtype)
+    kn = rng.standard_normal((B, S, Hkv, D)).astype(dtype)
+    vn = rng.standard_normal((B, S, Hkv, D)).astype(dtype)
+    return q, kc, vc, tables, lens, qlens, kn, vn
+
+
+def _append_oracle(q, kc, vc, tables, lens, qlens, kn, vn):
+    """The shipping dense append fallback via the public op (flag-off is
+    the CPU default; conftest asserts it)."""
+    B, S, Hq, D = q.shape
+    Hkv = kc.shape[1]
+    qkv = np.concatenate([q.reshape(B, S, Hq * D),
+                          kn.reshape(B, S, Hkv * D),
+                          vn.reshape(B, S, Hkv * D)], axis=-1)
+    out, kc2, vc2 = IF.block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kc), paddle.to_tensor(vc),
+        None, paddle.to_tensor(lens), paddle.to_tensor(qlens),
+        block_tables=paddle.to_tensor(tables))
+    return (np.asarray(out._value), np.asarray(kc2._value),
+            np.asarray(vc2._value))
+
+
+def _assert_append_parity(q, kc, vc, tables, lens, qlens, kn, vn,
+                          rtol=2e-5, atol=2e-5):
+    ref_out, ref_kc, ref_vc = _append_oracle(q, kc, vc, tables, lens,
+                                             qlens, kn, vn)
+    out, kc2, vc2 = paged_attention_append(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(tables), jnp.asarray(lens), jnp.asarray(qlens),
+        jnp.asarray(kn), jnp.asarray(vn))
+    B, S = q.shape[0], q.shape[1]
+    for b in range(B):
+        n = int(qlens[b])
+        if n:   # padding rows are garbage on BOTH paths; compare valid
+            np.testing.assert_allclose(
+                np.asarray(out, np.float32)[b, :n].reshape(n, -1),
+                np.asarray(ref_out[b, :n], np.float32), rtol=rtol,
+                atol=atol)
+    np.testing.assert_array_equal(np.asarray(kc2, np.float32),
+                                  np.asarray(ref_kc, np.float32))
+    np.testing.assert_array_equal(np.asarray(vc2, np.float32),
+                                  np.asarray(ref_vc, np.float32))
+
+
+@pytest.mark.parametrize("group", [1, 2, 4])
+def test_append_parity_block_boundaries_and_gqa(group, rng):
+    """Append windows starting at lens % bs in {0, 1, bs-1}, grants of a
+    full chunk / one token / zero (idle slot), windows spanning several
+    blocks — kernel vs the dense append fallback, outputs AND pools."""
+    Hkv = 2
+    lens = [16, 17, 7, 3]      # %bs: 0, 1, bs-1, mid
+    qlens = [8, 1, 5, 0]       # chunk / decode-like / partial / idle
+    q, kc, vc, tables, lens, qlens, kn, vn = _append_case(
+        rng, lens, qlens, Hq=Hkv * group, Hkv=Hkv)
+    _assert_append_parity(q, kc, vc, tables, lens, qlens, kn, vn)
+
+
+def test_append_first_chunk_from_empty(rng):
+    """lens == 0 (first prefill chunk of a fresh slot) including a full
+    chunk that exactly fills a block."""
+    q, kc, vc, tables, lens, qlens, kn, vn = _append_case(
+        rng, [0, 0, 8], [8, 3, 8], Hq=4, Hkv=4)
+    _assert_append_parity(q, kc, vc, tables, lens, qlens, kn, vn)
+
+
+def test_append_bf16_pools(rng):
+    import ml_dtypes
+    q, kc, vc, tables, lens, qlens, kn, vn = _append_case(
+        rng, [12, 31], [6, 2])
+    bf = ml_dtypes.bfloat16
+    q, kc, vc = q.astype(bf), kc.astype(bf), vc.astype(bf)
+    kn, vn = kn.astype(bf), vn.astype(bf)
+    _assert_append_parity(q, kc, vc, tables, lens, qlens, kn, vn,
+                          rtol=2e-2, atol=2e-2)
+
+
+def test_append_idle_wiped_slot_writes_scratch_only(rng):
+    """A freed slot's shape (stale lens, wiped -1 table row, q_lens 0)
+    must not touch any real block — mirroring the decode kernel's
+    scratch-block routing."""
+    q, kc, vc, tables, lens, qlens, kn, vn = _append_case(
+        rng, [5, 18], [0, 4], Hq=2, Hkv=2)
+    tables[0, :] = -1
+    NB = kc.shape[0]
+    ref_out, ref_kc, ref_vc = _append_oracle(q, kc, vc, tables, lens,
+                                             qlens, kn, vn)
+    out, kc2, vc2 = paged_attention_append(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(tables), jnp.asarray(lens), jnp.asarray(qlens),
+        jnp.asarray(kn), jnp.asarray(vn))
+    np.testing.assert_array_equal(np.asarray(kc2)[:NB - 1],
+                                  ref_kc[:NB - 1])
+    np.testing.assert_allclose(np.asarray(out)[1, :4].reshape(4, -1),
+                               ref_out[1, :4], rtol=2e-5, atol=2e-5)
+
+
+def test_append_decode_special_case_matches_decode_kernel(rng):
+    """q_lens == 1 everywhere IS the decode step: the append kernel must
+    agree with the decode kernel's fused write exactly."""
+    lens = [9, 24, 1]
+    q, kc, vc, tables, lens_a, qlens, kn, vn = _append_case(
+        rng, lens, [1, 1, 1], Hq=4, Hkv=4, S=4)
+    out_a, kc_a, vc_a = paged_attention_append(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(tables), jnp.asarray(lens_a), jnp.asarray(qlens),
+        jnp.asarray(kn), jnp.asarray(vn))
+    out_d, kc_d, vc_d = paged_attention_decode(
+        jnp.asarray(q[:, 0]), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(tables), jnp.asarray(lens_a),
+        new_k=jnp.asarray(kn[:, 0]), new_v=jnp.asarray(vn[:, 0]))
+    np.testing.assert_allclose(np.asarray(out_a)[:, 0], np.asarray(out_d),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(kc_a), np.asarray(kc_d))
+    np.testing.assert_array_equal(np.asarray(vc_a), np.asarray(vc_d))
+
+
+@pytest.mark.slow
+def test_append_large_shape_parity(rng):
+    """Serving-ish append shape (GQA 32/8 heads, D=128, bs=64, chunk 64)
+    — interpret mode is slow, keep out of tier-1."""
+    lens = [511, 512, 64, 0]
+    qlens = [64, 1, 33, 64]
+    q, kc, vc, tables, lens, qlens, kn, vn = _append_case(
+        rng, lens, qlens, Hq=32, Hkv=8, D=128, BS=64, S=64)
+    _assert_append_parity(q, kc, vc, tables, lens, qlens, kn, vn,
+                          rtol=2e-4, atol=2e-4)
 
 
 # ---------------------------------------------------------------------------

@@ -273,13 +273,24 @@ def test_telemetry_snapshot_schema_and_attribution(dense_eng):
         wall = time.perf_counter() - t0
     snap = server.telemetry.snapshot(wall_s=wall)
     for key in ("uptime_s", "counters", "stages_s", "latency",
-                "attribution"):
+                "attribution", "prefill_token_share"):
         assert key in snap, key
     assert set(STAGES) <= set(snap["stages_s"])
-    for hist in ("ttft", "inter_token", "e2e", "queue_wait"):
-        assert snap["latency"][hist]["count"] >= 1 or hist == "inter_token"
+    for hist in ("ttft", "inter_token", "e2e", "queue_wait",
+                 "admission_stall"):
+        assert snap["latency"][hist]["count"] >= 1 \
+            or hist in ("inter_token", "admission_stall")
         assert {"p50_s", "p90_s", "p99_s", "mean_s"} <= set(
             snap["latency"][hist])
+    # legacy engine, whole prompts prefilled at admission: the share of
+    # prefill work is visible and sane
+    assert snap["counters"]["prefill_tokens"] == \
+        sum(len(p) for p in prompts)
+    assert 0.0 < snap["prefill_token_share"] < 1.0
+    # requests outnumber slots: someone waited for a freed slot, so the
+    # stall histogram observed admissions (fused keeps the VALUES ~0;
+    # existence + counting is the schema contract here)
+    assert snap["latency"]["admission_stall"]["count"] >= 1
     att = snap["attribution"]
     assert 0.0 < att["attributed_share"] <= 1.0
     # a busy window must be explained by the named stages (the r05 serve
@@ -292,6 +303,9 @@ def test_telemetry_snapshot_schema_and_attribution(dense_eng):
     assert 'paddle_tpu_serving_stage_seconds_total{stage="host_sync"}' \
         in text
     assert "paddle_tpu_serving_ttft_seconds_bucket" in text
+    assert "paddle_tpu_serving_admission_stall_seconds_bucket" in text
+    assert "# TYPE paddle_tpu_serving_prefill_token_share gauge" in text
+    assert "paddle_tpu_serving_prefill_tokens_total" in text
 
 
 def test_engine_stage_stats_accumulate(dense_eng):
