@@ -90,6 +90,18 @@ def _forward_flops(model, arg_tensors):
         return None
 
 
+def _artifact_dir():
+    """Where serve benches persist their observability artifacts
+    (telemetry snapshots, sample chrome traces): BENCH_ARTIFACT_DIR or
+    docs/artifacts next to this file. Created on demand."""
+    d = os.environ.get(
+        "BENCH_ARTIFACT_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "docs", "artifacts"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
 def _bench_other(model_name):
     """Secondary BASELINE workloads (ResNet-50 / BERT-base MLM / ViT-L /
     SD-UNet) — same JSON contract, per-domain throughput metric. The driver
@@ -578,9 +590,62 @@ def _bench_other(model_name):
         server.stop()
         toks = sum(len(o.token_ids) for o in outs)
         steps = eng.stats["steps"]
+        stats_off = dict(eng.stats)  # the A/B below keeps stepping eng
         snap = server.telemetry.snapshot(wall_s=wall)
         att = snap["attribution"]
         lat = snap["latency"]
+
+        # flight-recorder A/B: the same prompts re-served with the
+        # recorder ON (per-step StepRecords + per-request timelines).
+        # Budget: <2% tok/s regression — the ring append + token stamps
+        # must stay invisible next to device decode. A single sequential
+        # pair would drown the 2% budget in serve-wall noise (ROUND4:
+        # ±20% run-to-run on this metric), so the arms ALTERNATE
+        # on/off/on/off/on/off and each side takes its median-of-3. The
+        # recorded arm's telemetry snapshot and a sample chrome trace
+        # persist next to the bench output so a slow-token question
+        # ("why was THIS token slow?") can be answered from the
+        # artifact, not a re-run.
+        from paddle_tpu.profiler import FlightRecorder
+
+        def serve_pass(rec):
+            srv = AsyncLLMServer(eng, max_queue_size=n_req + 1,
+                                 flight_recorder=rec)
+            srv.start()
+            t0 = time.perf_counter()
+            hs = [srv.submit(p, max_new_tokens=new_tokens)
+                  for p in prompts]
+            outs = [h.result(timeout=1800) for h in hs]
+            w = time.perf_counter() - t0
+            srv.stop()
+            return sum(len(o.token_ids) for o in outs) / w, srv, w
+
+        on_tps, off_tps = [], [toks / wall]
+        for _ in range(3):
+            recorder = FlightRecorder()
+            tps, server_on, wall_on = serve_pass(recorder)
+            on_tps.append(tps)
+            if len(off_tps) < 3:
+                off_tps.append(serve_pass(None)[0])
+
+        def median(xs):
+            return sorted(xs)[len(xs) // 2]
+
+        tps_off, tps_on = median(off_tps), median(on_tps)
+        rec_overhead_pct = round((tps_off - tps_on) / tps_off * 100, 2)
+        art_dir = _artifact_dir()
+        stem = "llama_serve_spec" if spec_mode else "llama_serve"
+        trace_path = os.path.join(art_dir, f"{stem}_trace.json")
+        recorder.export_chrome_trace(trace_path)
+        tail_p99 = recorder.explain_tail(0.99, top=64)
+        rec_snap = recorder.snapshot(tail=tail_p99)
+        tel_path = os.path.join(art_dir, f"{stem}_telemetry.json")
+        with open(tel_path, "w") as f:
+            json.dump({
+                "telemetry": server_on.telemetry.snapshot(wall_s=wall_on),
+                "flight_recorder": rec_snap,
+                "explain_tail_p99": tail_p99[:8],
+            }, f, indent=1)
         # r05 sync-loop baselines (BENCH_r05.json): serve 1,158.9 tok/s,
         # spec 46.8 — comparable ONLY at the exact captured config (on-chip
         # defaults, bf16); any overridden knob makes the ratio meaningless,
@@ -604,9 +669,16 @@ def _bench_other(model_name):
                "prompt_lens": f"{min(len(p) for p in prompts)}-"
                               f"{max(len(p) for p in prompts)}",
                "new_tokens": new_tokens,
-               "prefill_chunks": eng.stats["prefill_chunks"],
+               "prefill_chunks": stats_off["prefill_chunks"],
                "horizon": horizon,
                "pipeline_depth": server.pipeline_depth,
+               # recorder-on A/B (budget: < 2% tok/s regression) + the
+               # persisted observability artifacts
+               "flight_recorder_overhead_pct": rec_overhead_pct,
+               "flight_recorder_on_tokens_per_sec": round(tps_on, 1),
+               "tail_causes_p99": rec_snap["tail_causes_p99"],
+               "trace_artifact": trace_path,
+               "telemetry_artifact": tel_path,
                # per-stage wall attribution from the serving telemetry —
                # replaces the one-scalar RTT split that left ~76% of r05
                # serve wall unexplained
@@ -618,9 +690,9 @@ def _bench_other(model_name):
                "weight_dtype": weight_dtype or "bf16"}
         if spec_k > 1:
             out["speculative_k"] = spec_k
-            out["draft_tokens_accepted"] = eng.stats["draft_tokens_accepted"]
+            out["draft_tokens_accepted"] = stats_off["draft_tokens_accepted"]
             out["accepted_per_step"] = round(
-                eng.stats["draft_tokens_accepted"] / max(steps, 1), 2)
+                stats_off["draft_tokens_accepted"] / max(steps, 1), 2)
         return out
 
     if model_name == "llama_serve_fused":
@@ -659,6 +731,8 @@ def _bench_other(model_name):
         prompts = [rng.integers(0, cfg.vocab_size, (L,)).astype(np.int32)
                    for L in lens]
 
+        arm_snapshots = {}
+
         def run_arm(scheduler):
             kw = dict(max_batch=B, max_seq_len=cap, chunk_size=chunk,
                       horizon=horizon, scheduler=scheduler)
@@ -677,6 +751,7 @@ def _bench_other(model_name):
             server.stop()
             toks = sum(len(o.token_ids) for o in outs)
             snap = server.telemetry.snapshot(wall_s=wall)
+            arm_snapshots[scheduler] = snap
             stall = snap["latency"]["admission_stall"]
             return {
                 "tokens_per_sec": toks / wall,
@@ -701,6 +776,14 @@ def _bench_other(model_name):
 
         fused = run_arm("fused")
         legacy = run_arm("legacy")
+        # persist the fused arm's full telemetry snapshot next to the
+        # bench output (same artifact dir as the llama_serve recorder
+        # dump) so stall/share regressions can be diffed without a re-run
+        fused_tel_path = os.path.join(_artifact_dir(),
+                                      "llama_serve_fused_telemetry.json")
+        with open(fused_tel_path, "w") as f:
+            json.dump({"fused": fused, "legacy": legacy,
+                       "snapshots": arm_snapshots}, f, indent=1)
         at_r05_config = (
             B == 8 and new_tokens == 64 and n_req == 16 and n_layers == 3
             and hidden == 4096 and ff == hidden * 11 // 4
@@ -721,7 +804,8 @@ def _bench_other(model_name):
                 "requests": n_req, "slots": B, "new_tokens": new_tokens,
                 "prompt_lens": f"{min(lens)}-{max(lens)}",
                 "chunk": chunk, "horizon": horizon,
-                "max_step_tokens": max_step_tokens or chunk + B - 1}
+                "max_step_tokens": max_step_tokens or chunk + B - 1,
+                "telemetry_artifact": fused_tel_path}
 
     if model_name == "conv_roofline":
         return _bench_conv_roofline()

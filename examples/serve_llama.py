@@ -3,10 +3,14 @@
 Run: python examples/serve_llama.py          # tiny demo model, mixed requests
 Shows: the AsyncLLMServer front (pipelined background engine loop, bounded
 admission queue, per-request streaming iterators, deadlines/cancellation,
-per-stage telemetry with a Prometheus dump), plus the bare-engine loop for
+per-stage telemetry with a Prometheus dump, and the engine flight
+recorder — a chrome trace of the serve plus the slow-token explainer —
+dumped as an artifact on exit), plus the bare-engine loop for
 comparison (ragged admission, per-request sampling params, speculative
 decoding, int8 weight-only quantization).
 """
+import os
+
 import numpy as np
 
 import paddle_tpu as paddle
@@ -37,7 +41,8 @@ def main():
     # decode batch under max_step_tokens instead of stalling it) --------
     eng = LLMEngine(model, max_batch=4, max_seq_len=128, chunk_size=32,
                     scheduler="fused")
-    with AsyncLLMServer(eng, max_queue_size=16) as server:
+    with AsyncLLMServer(eng, max_queue_size=16,
+                        flight_recorder=True) as server:
         handles = [
             server.submit(rng.integers(1, 512, size=(n,)).astype(np.int32),
                           max_new_tokens=6, temperature=temp,
@@ -54,6 +59,18 @@ def main():
     att = server.telemetry.snapshot()["attribution"]
     print(f"serve wall attributed: {att['attributed_share']:.0%} "
           f"across {list(att['stage_share'])}")
+    # flight-recorder artifacts: a Perfetto-loadable timeline (one lane
+    # per request + an engine-step lane) and the slow-token explainer
+    rec = server.flight_recorder
+    trace_path = os.environ.get("SERVE_TRACE_PATH",
+                                "serve_llama_trace.json")
+    rec.export_chrome_trace(trace_path)
+    print(f"trace ({rec.snapshot()['steps_recorded']} engine steps) -> "
+          f"{trace_path}  (open at ui.perfetto.dev)")
+    for e in rec.explain_tail(0.9, top=3):
+        print(f"  slow token: req {e['request_id']} gap "
+              f"{e['gap_s'] * 1e3:.1f}ms @ step {e['step_id']} <- "
+              f"{e['cause']}")
 
     # -- the bare engine loop (speculative decoding demo) --------------
     eng2 = LLMEngine(model, max_batch=4, max_seq_len=128, chunk_size=32,

@@ -116,7 +116,7 @@ class PendingStep:
     the OLD request's state)."""
 
     __slots__ = ("toks", "was_active", "counts", "spec", "slots",
-                 "pool_done", "sched")
+                 "pool_done", "sched", "step_id")
 
     def __init__(self, toks, was_active, counts, spec, slots, pool_done,
                  sched=None):
@@ -129,6 +129,10 @@ class PendingStep:
         #: fused scheduler: per-slot decode tokens SCHEDULED by this
         #: dispatch ({b: n}); step_finish pays them back off slot.inflight
         self.sched = sched or {}
+        #: flight-recorder StepRecord id (None when no recorder is
+        #: attached) — step_finish stamps every token it reads out with
+        #: it, joining request timelines back to engine state
+        self.step_id = None
 
 
 class LLMEngine:
@@ -312,6 +316,13 @@ class LLMEngine:
         #: the paged engine must stay at depth 1 (its host block allocator
         #: needs post-step lens before the next dispatch)
         self._inflight = 0
+        #: optional FlightRecorder (profiler.flight_recorder): when
+        #: attached and enabled, step_begin/step_finish emit one
+        #: StepRecord per step and stamp every emitted token with its
+        #: step id. None (the default) costs one attribute check per step.
+        self.flight_recorder = None
+        self._rec_ctx = None       # per-step_begin wall-split anchors
+        self._rec_preempted = []   # rids parked by _preempt_slot this step
         self.stats = {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
                       "draft_tokens_accepted": 0, "preemptions": 0,
                       "fused_steps": 0, "prefill_tokens": 0,
@@ -822,6 +833,8 @@ class LLMEngine:
             req.temperature, req.top_p, req.eos_token_id))
         self._free_slot(b)
         self.stats["preemptions"] += 1
+        if self._rec() is not None:
+            self._rec_preempted.append(req.request_id)
 
     def _finish_tokens(self, req, generated):
         """Full output stream incl. tokens committed before a preemption."""
@@ -857,6 +870,10 @@ class LLMEngine:
                                self.chunk),), np.int32)
         padded[:P] = req.prompt_ids
         table_row = self._tables[slot_idx].copy() if paged else None
+        # legacy admission prefills BEFORE the step dispatches: its chunk
+        # spans stamp the id the upcoming dispatch will take, so request
+        # time still joins back to a StepRecord
+        rec = self._rec()
         while off < P:
             take = min(self.chunk, P - off)
             if paged:
@@ -883,6 +900,9 @@ class LLMEngine:
             off += take
             self.stats["prefill_chunks"] += 1
             self.stats["prefill_tokens"] += take
+            if rec is not None:
+                rec.req_event(req.request_id, "prefill",
+                              step_id=rec.next_step_id(), value=take)
         if paged:
             # drop the chunk-padding over-allocation: keep only the blocks
             # the prompt actually occupies (+ the one decode grows into)
@@ -965,6 +985,40 @@ class LLMEngine:
             return []
         return self.step_finish(pending)
 
+    def _rec(self):
+        """The attached FlightRecorder when it is recording, else None —
+        the one-attribute-check gate every hook goes through."""
+        r = self.flight_recorder
+        return r if (r is not None and r.enabled) else None
+
+    def _record_dispatch(self, pending, kind, grants, scheduled, budget,
+                         dispatch_s):
+        """Emit this dispatch's StepRecord (recorder attached and armed
+        by step_begin) and stamp ``pending`` with its step id. The
+        admit/schedule splits come from the engine's own stats deltas
+        anchored at step_begin entry, so the record can't drift from
+        what the engine measured."""
+        rec, ctx = self._rec(), self._rec_ctx
+        if rec is None or ctx is None:
+            return
+        t0, admit0 = ctx
+        wall = time.perf_counter() - t0
+        admit_s = self.stats["admit_time_s"] - admit0
+        paged = self.cache_impl == "paged"
+        preempted = tuple(self._rec_preempted) + tuple(
+            o.request_id for o in pending.pool_done)
+        pending.step_id = rec.begin_step(
+            scheduler=self.scheduler, kind=kind, grants=grants,
+            tokens_scheduled=scheduled, token_budget=budget,
+            queue_depth=len(self.waiting),
+            free_blocks=len(self._free_blocks) if paged else None,
+            total_blocks=self.n_blocks if paged else None,
+            pipeline_inflight=self._inflight,
+            preemptions=preempted, admit_s=admit_s,
+            schedule_s=max(wall - admit_s - dispatch_s, 0.0),
+            dispatch_s=dispatch_s, t_begin=t0)
+        self._rec_ctx = None
+
     def step_begin(self):
         """Admit waiting requests into free slots and DISPATCH one decode
         step for all active slots WITHOUT reading anything back. Returns a
@@ -993,6 +1047,12 @@ class LLMEngine:
                 "deep: its block allocator needs the previous step's "
                 "lens (step_finish the outstanding PendingStep first; "
                 "see max_pipeline_depth())")
+        if self._rec() is not None:
+            # wall-split anchors for this step's record: entry time +
+            # admit-stat baseline (scheduling = wall - admit - dispatch)
+            self._rec_ctx = (time.perf_counter(),
+                             self.stats["admit_time_s"])
+            self._rec_preempted = []
         self._admit_waiting()
         if not any(s is not None for s in self.slots):
             if self.waiting and self.cache_impl == "paged":
@@ -1087,8 +1147,13 @@ class LLMEngine:
         active = np.array([s is not None for s in self.slots])
         if not active.any():
             if pool_done:
-                return PendingStep(None, None, None, spec, list(self.slots),
-                                   pool_done)
+                pending = PendingStep(None, None, None, spec,
+                                      list(self.slots), pool_done)
+                # no dispatch, but preemptions/retirements happened —
+                # record the drain so the causal chain has no hole
+                self._record_dispatch(pending, "drain", (), 0,
+                                      self.B * self.horizon, 0.0)
+                return pending
             return None
         temps = np.array([s.req.temperature if s else 0.0
                           for s in self.slots], np.float32)
@@ -1142,8 +1207,20 @@ class LLMEngine:
                 if slot is not None and active[b]:
                     slot.inflight += self.horizon
                     sched[b] = self.horizon
-        return PendingStep(toks, was_active, counts, spec, list(self.slots),
-                           pool_done, sched=sched)
+        pending = PendingStep(toks, was_active, counts, spec,
+                              list(self.slots), pool_done, sched=sched)
+        if self._rec() is not None:
+            # every active slot may decode up to `horizon` tokens this
+            # scan (spec: horizon verify windows of up to Kspec each)
+            per_slot = self.horizon * (self.speculative_k if spec else 1)
+            grants = tuple(
+                (b, s.req.request_id, "decode", per_slot)
+                for b, s in enumerate(self.slots)
+                if s is not None and active[b])
+            self._record_dispatch(
+                pending, "spec" if spec else "decode", grants,
+                sum(g[3] for g in grants), self.B * per_slot, dt)
+        return pending
 
     # ------------------------------------------------------------------
     # fused scheduler: the mixed prefill+decode step
@@ -1252,8 +1329,11 @@ class LLMEngine:
                 break
         if not active.any():
             if pool_done:
-                return PendingStep(None, None, None, False,
-                                   list(self.slots), pool_done)
+                pending = PendingStep(None, None, None, False,
+                                      list(self.slots), pool_done)
+                self._record_dispatch(pending, "drain", (), 0,
+                                      self.max_step_tokens, 0.0)
+                return pending
             return None
         temps = np.array([s.req.temperature if s else 0.0
                           for s in self.slots], np.float32)
@@ -1289,8 +1369,22 @@ class LLMEngine:
                 self.stats["prefill_chunks"] += 1
                 self.stats["prefill_tokens"] += int(q_lens[b])
         self._inflight += 1
-        return PendingStep(toks, was_active, None, False, list(self.slots),
-                           pool_done, sched=sched)
+        pending = PendingStep(toks, was_active, None, False,
+                              list(self.slots), pool_done, sched=sched)
+        rec = self._rec()
+        if rec is not None:
+            grants = tuple(
+                (int(b), self.slots[b].req.request_id,
+                 "decode" if is_dec[b] else "prefill", int(q_lens[b]))
+                for b in np.nonzero(active)[0] if self.slots[b] is not None)
+            self._record_dispatch(pending, "mixed", grants,
+                                  sum(g[3] for g in grants),
+                                  self.max_step_tokens, dt)
+            for _, rid, gkind, n in grants:
+                if gkind == "prefill":
+                    rec.req_event(rid, "prefill",
+                                  step_id=pending.step_id, value=n)
+        return pending
 
     def step_finish(self, pending):
         """Block on ``pending``'s device→host token transfer, attribute the
@@ -1300,7 +1394,12 @@ class LLMEngine:
         (retired, cancelled, preempted — possibly already reused) are
         dropped: they were decoded for the old occupant's state."""
         spec = pending.spec
+        rec = self._rec()
+        sid = pending.step_id
         if pending.toks is None:
+            if rec is not None and sid is not None:
+                rec.finish_step(sid, 0.0, 0.0, tuple(
+                    o.request_id for o in pending.pool_done))
             return list(pending.pool_done)
         self._inflight -= 1
         # pay the dispatch's scheduled decode growth back off the
@@ -1353,6 +1452,10 @@ class LLMEngine:
                 slot.generated.append(tok)
                 n_read += 1
                 self.stats["tokens_generated"] += 1
+                if rec is not None and sid is not None:
+                    # THE token→step join: this token's timeline span
+                    # carries the id of the StepRecord that produced it
+                    rec.on_token(slot.req.request_id, sid)
                 if self.stream_callback is not None:
                     self.stream_callback(slot.req.request_id, tok)
                     if self.slots[b] is not slot:
@@ -1392,7 +1495,11 @@ class LLMEngine:
                 done.append(out)
                 # slot (and its KV blocks) freed; next step admits into it
                 self._free_slot(b)
-        self.stats["emit_time_s"] += time.perf_counter() - t0
+        d_emit = time.perf_counter() - t0
+        self.stats["emit_time_s"] += d_emit
+        if rec is not None and sid is not None:
+            rec.finish_step(sid, dt, d_emit,
+                            tuple(out.request_id for out in done))
         return done
 
     def generate(self, prompts, **sampling):

@@ -19,12 +19,15 @@ from enum import Enum
 from .timer import benchmark  # noqa: F401
 from .serving_telemetry import (  # noqa: F401
     LatencyHistogram, ServingTelemetry)
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder, StepRecord, TAIL_CAUSES)
 
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "load_profiler_result",
     "SummaryView", "benchmark", "merge_profile",
     "ServingTelemetry", "LatencyHistogram",
+    "FlightRecorder", "StepRecord", "TAIL_CAUSES",
 ]
 
 
@@ -60,8 +63,15 @@ _BUFFER = _EventBuffer()
 
 
 class RecordEvent:
-    """Host-side scope event (reference: profiler/utils.py:47). Also enters a
-    jax named_scope so the span shows up inside device traces under jit."""
+    """Host-side scope event (reference: profiler/utils.py:47). While a
+    profiler is recording it also enters a jax named_scope so the span
+    shows up inside device traces under jit.
+
+    When NO profiler is recording, enter/exit is a single flag check —
+    no clock read, no jax import, no named_scope — so always-on
+    instrumentation (library internals wrapping hot paths in
+    RecordEvent) costs ~nothing in production. A profiler that starts
+    recording mid-event picks the event up from its NEXT entry."""
 
     def __init__(self, name, event_type=None):
         self.name = name
@@ -75,6 +85,10 @@ class RecordEvent:
         self.__exit__(None, None, None)
 
     def __enter__(self):
+        if not _BUFFER.enabled:
+            self._t0 = None  # disabled fast path: nothing to undo on exit
+            self._scope = None
+            return self
         self._t0 = time.perf_counter_ns()
         try:
             import jax
@@ -85,9 +99,11 @@ class RecordEvent:
         return self
 
     def __exit__(self, *exc):
-        t1 = time.perf_counter_ns()
         if self._scope is not None:
             self._scope.__exit__(*exc)
+        if self._t0 is None:
+            return False  # entered while disabled: no span to record
+        t1 = time.perf_counter_ns()
         _BUFFER.add(self.name, self._t0 / 1e3, (t1 - self._t0) / 1e3,
                     threading.get_ident())
         return False

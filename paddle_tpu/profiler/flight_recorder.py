@@ -1,0 +1,423 @@
+"""Engine flight recorder — per-step ``StepRecord``s + per-request trace
+timelines, joined by step id. The causal layer the aggregate serving
+telemetry (``serving_telemetry.py``) cannot provide: a p99 inter-token
+gap in a histogram looks identical whether it came from an interfering
+prefill chunk, a pool-pressure preemption, a pipeline bubble, or a host
+sync stall. The recorder answers "why was THIS token slow?".
+
+Three pieces:
+
+* **StepRecord ring** — a fixed-size ring buffer holding one record per
+  engine step: scheduler kind, per-slot grants (prefill chunk vs decode
+  token), token-budget utilization, queue depth, KV-pool free blocks,
+  pipeline depth in flight, preemption events, and the
+  admit/schedule/dispatch/sync/emit wall splits. The ring is
+  pre-allocated; recording a step is one index assignment, so recorder
+  overhead is bounded (and the whole recorder is disableable —
+  ``enabled=False`` short-circuits every hook).
+* **per-request span timelines** — queued → admitted → prefill chunks →
+  first token → per-token gaps → finish reason, each span stamped with
+  the step id that produced it, so request time joins back to engine
+  state. Per-token cost is one append of a small tuple (the record
+  itself) — no other allocation.
+* **exports** — :meth:`FlightRecorder.export_chrome_trace` writes a
+  chrome://tracing JSON with one lane per request plus an engine-step
+  lane (same ``traceEvents``/µs conventions as ``Profiler._export_chrome``,
+  so traces open in Perfetto and ``merge_profile`` merges them across
+  ranks), and :meth:`FlightRecorder.explain_tail` joins the worst
+  inter-token gaps to their causal StepRecord and names the dominant
+  cause (interfering prefill / preemption / host sync / idle bubble).
+
+Reference analog: the reference debugs its serving stack with
+paddle.profiler timelines; vLLM/Sarathi-style continuous batching is
+debugged in production with exactly this per-step/per-request trace
+join (PAPERS.md: Sarathi-Serve's stall taxonomy is per-step).
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import threading
+import time
+
+__all__ = ["StepRecord", "FlightRecorder", "TAIL_CAUSES"]
+
+#: the cause labels explain_tail may assign, in priority order
+TAIL_CAUSES = ("preemption", "interfering_prefill", "host_sync",
+               "idle_bubble", "dispatch", "unrecorded")
+
+
+@dataclasses.dataclass
+class StepRecord:
+    """One engine step's facts, captured at dispatch and completed at
+    readout. ``grants`` is a tuple of ``(slot, request_id, kind,
+    n_tokens)`` with kind ``"prefill"`` or ``"decode"`` — the per-slot
+    work this step's single dispatch carried."""
+    step_id: int
+    t_begin: float                     # perf_counter at step_begin entry
+    scheduler: str                     # "legacy" | "fused"
+    kind: str                          # "decode" | "mixed" | "spec" | "drain"
+    grants: tuple                      # ((slot, rid, kind, n_tokens), ...)
+    tokens_scheduled: int              # sum of grant n_tokens
+    token_budget: int                  # per-step token capacity
+    queue_depth: int                   # engine.waiting after admission
+    free_blocks: int | None            # paged pool free blocks (None: dense)
+    total_blocks: int | None
+    pipeline_inflight: int             # dispatches in flight incl. this one
+    preemptions: tuple                 # request ids preempted/pool-retired
+    admit_s: float                     # wall splits measured by the engine
+    schedule_s: float
+    dispatch_s: float
+    t_finish: float = 0.0              # 0.0 until step_finish completes it
+    sync_s: float = 0.0
+    emit_s: float = 0.0
+    finished: tuple = ()               # request ids retired at readout
+
+    @property
+    def budget_utilization(self):
+        """tokens_scheduled / token_budget. MAY exceed 1.0: the fused
+        scheduler never throttles decode tokens or the oldest ramp's
+        progress-guarantee token, so a throttled ``max_step_tokens``
+        below the live decode count over-grants — a >1 reading IS the
+        signal that the budget is too small to bound interference."""
+        return self.tokens_scheduled / self.token_budget \
+            if self.token_budget else 0.0
+
+    @property
+    def prefill_tokens(self):
+        return sum(n for _, _, kind, n in self.grants if kind == "prefill")
+
+    @property
+    def decode_slots(self):
+        return sum(1 for _, _, kind, _ in self.grants if kind == "decode")
+
+    @property
+    def wall_s(self):
+        return max(self.t_finish - self.t_begin, 0.0) \
+            if self.t_finish else self.dispatch_s
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["grants"] = [list(g) for g in self.grants]
+        d["preemptions"] = list(self.preemptions)
+        d["finished"] = list(self.finished)
+        d["budget_utilization"] = round(self.budget_utilization, 4)
+        d["prefill_tokens"] = self.prefill_tokens
+        return d
+
+
+#: one timeline event: (kind, t, step_id, value) — value is the token's
+#: inter-token gap ("token"), the chunk's token count ("prefill"), or the
+#: finish reason ("finish"); None otherwise. A plain tuple keeps the
+#: per-token append allocation-minimal.
+_EVENT_FIELDS = ("kind", "t", "step_id", "value")
+
+
+class _RequestTrace:
+    __slots__ = ("request_id", "events", "last_token_t")
+
+    def __init__(self, request_id):
+        self.request_id = request_id
+        self.events = []
+        self.last_token_t = None
+
+    def to_dict(self):
+        return {"request_id": self.request_id,
+                "events": [dict(zip(_EVENT_FIELDS, e))
+                           for e in self.events]}
+
+
+class FlightRecorder:
+    """Fixed-size flight recorder for one engine (+ its server).
+
+    Writers: the engine thread (step records, token/prefill events) and
+    submitter threads ("queued" events). One lock guards the request
+    dict and the ring slots; every hook takes it at most once and does
+    O(1) work inside, so the recorder stays lock-cheap on the serve hot
+    path. ``enabled=False`` (or detaching the recorder) short-circuits
+    every hook to a single attribute check."""
+
+    def __init__(self, capacity=4096, max_requests=2048, enabled=True):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.max_requests = int(max_requests)
+        self.enabled = bool(enabled)
+        self._ring: list[StepRecord | None] = [None] * self.capacity
+        self._seq = 0                      # next step id
+        self._lock = threading.Lock()
+        self._live: dict[int, _RequestTrace] = {}
+        self._done: collections.OrderedDict[int, _RequestTrace] = \
+            collections.OrderedDict()
+
+    # -- step records (engine thread) -----------------------------------
+    def next_step_id(self):
+        """The id the next ``begin_step`` will assign — lets legacy
+        admission stamp its prefill spans with the step that follows."""
+        return self._seq
+
+    def begin_step(self, *, scheduler, kind, grants, tokens_scheduled,
+                   token_budget, queue_depth, free_blocks, total_blocks,
+                   pipeline_inflight, preemptions, admit_s, schedule_s,
+                   dispatch_s, t_begin):
+        """Record one dispatched step; returns its step id."""
+        with self._lock:
+            sid = self._seq
+            self._seq += 1
+            self._ring[sid % self.capacity] = StepRecord(
+                sid, t_begin, scheduler, kind, tuple(grants),
+                int(tokens_scheduled), int(token_budget), int(queue_depth),
+                free_blocks, total_blocks, int(pipeline_inflight),
+                tuple(preemptions), admit_s, schedule_s, dispatch_s)
+            return sid
+
+    def finish_step(self, step_id, sync_s, emit_s, finished=()):
+        with self._lock:
+            rec = self._ring[step_id % self.capacity]
+            if rec is None or rec.step_id != step_id:
+                return  # evicted by ring wrap between begin and finish
+            rec.t_finish = time.perf_counter()
+            rec.sync_s = sync_s
+            rec.emit_s = emit_s
+            rec.finished = tuple(finished)
+
+    def get_step(self, step_id):
+        with self._lock:
+            rec = self._ring[step_id % self.capacity]
+            return rec if rec is not None and rec.step_id == step_id \
+                else None
+
+    def records(self):
+        """The retained StepRecords, oldest first."""
+        with self._lock:
+            lo = max(0, self._seq - self.capacity)
+            out = []
+            for sid in range(lo, self._seq):
+                rec = self._ring[sid % self.capacity]
+                if rec is not None and rec.step_id == sid:
+                    out.append(rec)
+            return out
+
+    def last_record(self):
+        with self._lock:
+            if not self._seq:
+                return None
+            rec = self._ring[(self._seq - 1) % self.capacity]
+            return rec if rec is not None else None
+
+    # -- request timelines ----------------------------------------------
+    def _trace(self, rid, fresh=False):
+        if not fresh:
+            tr = self._live.get(rid)
+            if tr is None:
+                tr = self._done.get(rid)
+            if tr is not None:
+                return tr
+        # first sighting — or a FRESH lifecycle ("queued"): request ids
+        # restart per server, so a reused id must start a new timeline,
+        # not resurrect the finished trace (whose stale last_token_t
+        # would fabricate a giant phantom gap)
+        self._done.pop(rid, None)
+        tr = self._live[rid] = _RequestTrace(rid)
+        if len(self._live) > self.max_requests:
+            # bound _live too: a recorder attached directly to an
+            # engine (no server, so no "finish" events) must not
+            # grow without bound over a long-lived serve — demote
+            # the oldest live trace to the bounded done set
+            old_rid = next(iter(self._live))
+            self._done[old_rid] = self._live.pop(old_rid)
+            while len(self._done) > self.max_requests:
+                self._done.popitem(last=False)
+        return tr
+
+    def req_event(self, rid, kind, step_id=None, value=None, t=None):
+        """Append one lifecycle span event ("queued", "admitted",
+        "prefill", "finish", ...) to request ``rid``'s timeline."""
+        if not self.enabled:
+            return
+        if t is None:
+            t = time.perf_counter()
+        with self._lock:
+            tr = self._trace(rid, fresh=(kind == "queued"))
+            tr.events.append((kind, t, step_id, value))
+            if kind == "finish":
+                self._live.pop(rid, None)
+                self._done[rid] = tr
+                while len(self._done) > self.max_requests:
+                    self._done.popitem(last=False)
+
+    def on_token(self, rid, step_id):
+        """Record one emitted token: its wall time, the id of the step
+        whose readout produced it, and the gap since the request's
+        previous token. THE per-token hot path — one lock, one tuple
+        append."""
+        if not self.enabled:
+            return
+        t = time.perf_counter()
+        with self._lock:
+            tr = self._trace(rid)
+            gap = t - tr.last_token_t if tr.last_token_t is not None \
+                else None
+            tr.last_token_t = t
+            tr.events.append(("token", t, step_id, gap))
+
+    def request_trace(self, rid):
+        """JSON-ready timeline for one request (None if never seen or
+        evicted)."""
+        with self._lock:
+            tr = self._live.get(rid) or self._done.get(rid)
+            return tr.to_dict() if tr is not None else None
+
+    def timelines(self):
+        with self._lock:
+            out = {}
+            for src in (self._done, self._live):
+                for rid, tr in src.items():
+                    out[rid] = tr.to_dict()
+            return out
+
+    # -- exports --------------------------------------------------------
+    def export_chrome_trace(self, path):
+        """Write a chrome://tracing / Perfetto-loadable JSON: an
+        engine-step lane (tid 0) with one span per StepRecord, plus one
+        lane per request whose spans run from each timeline event's
+        predecessor to the event itself ("queued" wait, "admitted",
+        per-chunk "prefill[n]", per-token "token" gaps, "finish").
+        Timestamps are perf_counter µs — the same clock and schema as
+        ``Profiler._export_chrome``, so ``merge_profile`` can merge these
+        with host profiles and across ranks."""
+        pid = os.getpid()
+        events = []
+        # PIPELINED steps overlap in time (step N+1 dispatches before
+        # step N's sync), and same-tid 'X' events must nest properly —
+        # pack overlapping step spans onto greedy sub-lanes (depth 2
+        # needs exactly 2; requests live at tid >= 100)
+        lane_ends = []
+        for rec in self.records():
+            t0 = rec.t_begin * 1e6
+            dur = max(rec.wall_s * 1e6, 1.0)
+            for lane, end in enumerate(lane_ends):
+                if t0 >= end:
+                    break
+            else:
+                lane = len(lane_ends)
+                lane_ends.append(0.0)
+            lane_ends[lane] = t0 + dur
+            events.append({
+                "ph": "X", "cat": "engine", "pid": pid, "tid": lane,
+                "name": f"step {rec.step_id} [{rec.kind}]",
+                "ts": t0, "dur": dur,
+                "args": rec.to_dict()})
+        for lane in range(max(len(lane_ends), 1)):
+            events.append({
+                "ph": "M", "pid": pid, "tid": lane, "name": "thread_name",
+                "args": {"name": "engine steps" if lane == 0
+                         else f"engine steps (pipelined +{lane})"}})
+        for rid, tl in sorted(self.timelines().items()):
+            tid = 100 + int(rid)  # tids < 100 are engine sub-lanes
+            events.append({"ph": "M", "pid": pid, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": f"req {rid}"}})
+            prev_t = None
+            for ev in tl["events"]:
+                t_us = ev["t"] * 1e6
+                start = prev_t if prev_t is not None else t_us
+                name = ev["kind"]
+                if name == "prefill":
+                    name = f"prefill[{ev['value']}]"
+                elif name == "finish":
+                    name = f"finish:{ev['value']}"
+                args = {}
+                if ev["step_id"] is not None:
+                    args["step_id"] = ev["step_id"]
+                if ev["kind"] == "token" and ev["value"] is not None:
+                    args["gap_ms"] = round(ev["value"] * 1e3, 3)
+                events.append({
+                    "ph": "X", "cat": "request", "pid": pid, "tid": tid,
+                    "name": name, "ts": start,
+                    "dur": max(t_us - start, 1.0), "args": args})
+                prev_t = t_us
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        return path
+
+    # -- the slow-token explainer ---------------------------------------
+    def explain_tail(self, quantile=0.99, top=None):
+        """Join the worst inter-token gaps back to their causal
+        StepRecord and name the dominant cause.
+
+        Returns a list (worst gap first) of dicts: ``request_id``,
+        ``gap_s``, ``step_id``, ``cause`` (one of :data:`TAIL_CAUSES`),
+        and ``step`` (the record's facts, None when the ring evicted
+        it). Cause taxonomy, checked in order against the step that
+        emitted the token:
+
+        * ``preemption`` — the step carried pool-pressure preemptions;
+        * ``interfering_prefill`` — prefill work delayed the token: a
+          chunk grant rode the same fused dispatch (Sarathi's per-step
+          interference), or a legacy admission prefill train ran inside
+          the step's ``admit_s`` split;
+        * ``host_sync`` — the device→host token sync dominated the step;
+        * ``idle_bubble`` — the gap is mostly time OUTSIDE the step
+          (the engine wasn't dispatching: admission trains, depth-1
+          pipeline bubbles, loop stalls);
+        * ``dispatch`` — the step's own device compute explains the gap.
+        """
+        gaps = []
+        for rid, tl in self.timelines().items():
+            for ev in tl["events"]:
+                if ev["kind"] == "token" and ev["value"] is not None:
+                    gaps.append((ev["value"], rid, ev["step_id"]))
+        if not gaps:
+            return []
+        ordered = sorted(v for v, _, _ in gaps)
+        thresh = ordered[min(int(quantile * len(ordered)),
+                             len(ordered) - 1)]
+        tail = sorted((g for g in gaps if g[0] >= thresh), reverse=True)
+        if top is not None:
+            tail = tail[:top]
+        out = []
+        for gap, rid, sid in tail:
+            rec = self.get_step(sid) if sid is not None else None
+            out.append({"request_id": rid, "gap_s": round(gap, 6),
+                        "step_id": sid, "cause": self._classify(gap, rec),
+                        "step": rec.to_dict() if rec is not None else None})
+        return out
+
+    @staticmethod
+    def _classify(gap, rec):
+        if rec is None:
+            return "unrecorded"
+        if rec.preemptions:
+            return "preemption"
+        wall = rec.wall_s
+        # prefill interference comes in two shapes: a fused chunk grant
+        # in the step's own dispatch (grants), or a legacy admission
+        # prefill train run inside step_begin (admit_s dominates the
+        # wall — the single most common legacy stall)
+        if rec.prefill_tokens > 0 or (wall > 0 and
+                                      rec.admit_s >= 0.5 * wall):
+            return "interfering_prefill"
+        if wall > 0 and rec.sync_s >= 0.5 * wall:
+            return "host_sync"
+        if gap - wall > max(wall, 1e-9):
+            return "idle_bubble"
+        return "dispatch"
+
+    def snapshot(self, tail=None):
+        """JSON-ready summary: retained step counts + cause histogram of
+        the current 0.99 tail (cheap enough to ride in bench output).
+        Pass a precomputed ``explain_tail`` result as ``tail`` to avoid
+        re-walking the timelines."""
+        recs = self.records()
+        if tail is None:
+            tail = self.explain_tail(0.99, top=64)
+        causes = collections.Counter(e["cause"] for e in tail)
+        return {"steps_recorded": len(recs),
+                "steps_total": self._seq,
+                "ring_capacity": self.capacity,
+                "requests_tracked": len(self._live) + len(self._done),
+                "tail_causes_p99": dict(causes)}

@@ -17,8 +17,17 @@ snapshots):
   acceptance bar here is ≥90%).
 * **counters** — requests submitted/admitted/finished/cancelled/expired/
   rejected, tokens emitted, engine steps.
+* **gauges** — point-in-time engine state the server samples every loop
+  pass: queue depth, running/waiting slots, KV-pool occupancy, token
+  budget utilization, pipeline dispatches in flight.
 * **latency histograms** — TTFT, inter-token gap, end-to-end, and queue
   wait, on log-spaced buckets with quantile estimates.
+
+Names are STRICT: ``add_stage``/``inc``/``set_gauge`` raise ``KeyError``
+for a name that was never declared — a typo'd stage or counter name must
+fail loudly instead of silently forking the attribution into a phantom
+key. Extensions declare their names first via :meth:`ServingTelemetry
+.register` (they survive :meth:`reset`).
 
 Export: :meth:`ServingTelemetry.snapshot` (JSON-ready dict) and
 :meth:`ServingTelemetry.prometheus_text` (text exposition format).
@@ -30,13 +39,25 @@ import contextlib
 import threading
 import time
 
-__all__ = ["LatencyHistogram", "ServingTelemetry", "STAGES"]
+__all__ = ["LatencyHistogram", "ServingTelemetry", "STAGES", "GAUGES"]
 
 #: the named stages of the serve loop, in pipeline order. Every second of
 #: busy engine-thread wall time lands in exactly one of these (or in
 #: "other", the loop's own bookkeeping remainder).
 STAGES = ("queue_admit", "prefill_dispatch", "schedule", "decode_dispatch",
           "host_sync", "emit", "idle", "other")
+
+#: point-in-time gauges the serve loop samples each pass (pool gauges
+#: stay 0 on the dense engine; budget utilization needs the flight
+#: recorder's last StepRecord and stays 0 without one)
+GAUGES = ("queue_depth", "engine_waiting", "running_slots",
+          "pipeline_inflight", "kv_pool_free_blocks", "kv_pool_occupancy",
+          "token_budget_utilization")
+
+_COUNTERS = ("requests_submitted", "requests_admitted", "requests_finished",
+             "requests_cancelled", "requests_expired",
+             "requests_rejected_queue_full", "tokens_emitted",
+             "engine_steps", "preemptions", "prefill_tokens")
 
 
 def _default_bounds():
@@ -114,19 +135,33 @@ class ServingTelemetry:
 
     def __init__(self):
         self._lock = threading.Lock()
+        #: extension names declared via register(); they survive reset()
+        self._extra = {"stage": set(), "counter": set(), "gauge": set()}
         self.reset()
+
+    def register(self, kind, name):
+        """Declare an EXTENSION stage/counter/gauge name — the escape
+        hatch from the strict-name contract (unknown names raise
+        KeyError so a typo can't silently fork the attribution into a
+        phantom key). Registered names survive :meth:`reset`."""
+        if kind not in ("stage", "counter", "gauge"):
+            raise ValueError(f"register kind must be 'stage', 'counter' or "
+                             f"'gauge', got {kind!r}")
+        with self._lock:
+            self._extra[kind].add(name)
+            target = {"stage": self.stage_s, "counter": self.counters,
+                      "gauge": self.gauges}[kind]
+            target.setdefault(name, 0.0 if kind != "counter" else 0)
 
     def reset(self):
         with self._lock:
             self.started_at = time.perf_counter()
             self.stage_s = {name: 0.0 for name in STAGES}
-            self.counters = {
-                "requests_submitted": 0, "requests_admitted": 0,
-                "requests_finished": 0, "requests_cancelled": 0,
-                "requests_expired": 0, "requests_rejected_queue_full": 0,
-                "tokens_emitted": 0, "engine_steps": 0, "preemptions": 0,
-                "prefill_tokens": 0,
-            }
+            self.stage_s.update({n: 0.0 for n in self._extra["stage"]})
+            self.counters = {name: 0 for name in _COUNTERS}
+            self.counters.update({n: 0 for n in self._extra["counter"]})
+            self.gauges = {name: 0.0 for name in GAUGES}
+            self.gauges.update({n: 0.0 for n in self._extra["gauge"]})
             self.ttft_s = LatencyHistogram()
             self.inter_token_s = LatencyHistogram()
             self.e2e_s = LatencyHistogram()
@@ -139,10 +174,15 @@ class ServingTelemetry:
 
     # -- write side (engine thread + submitters) ------------------------
     def add_stage(self, name, dt):
-        if dt <= 0.0:
+        if dt <= 0.0 and name in self.stage_s:
             return
         with self._lock:
-            self.stage_s[name] = self.stage_s.get(name, 0.0) + dt
+            if name not in self.stage_s:
+                raise KeyError(
+                    f"unknown telemetry stage {name!r} (a typo here would "
+                    f"silently fork the attribution) — declare it with "
+                    f"register('stage', {name!r}) first")
+            self.stage_s[name] += dt
 
     @contextlib.contextmanager
     def stage(self, name):
@@ -154,7 +194,19 @@ class ServingTelemetry:
 
     def inc(self, name, n=1):
         with self._lock:
-            self.counters[name] = self.counters.get(name, 0) + n
+            if name not in self.counters:
+                raise KeyError(
+                    f"unknown telemetry counter {name!r} — declare it with "
+                    f"register('counter', {name!r}) first")
+            self.counters[name] += n
+
+    def set_gauge(self, name, value):
+        with self._lock:
+            if name not in self.gauges:
+                raise KeyError(
+                    f"unknown telemetry gauge {name!r} — declare it with "
+                    f"register('gauge', {name!r}) first")
+            self.gauges[name] = float(value)
 
     def observe(self, hist_name, v):
         with self._lock:
@@ -185,6 +237,7 @@ class ServingTelemetry:
             out = {
                 "uptime_s": round(time.perf_counter() - self.started_at, 4),
                 "counters": dict(self.counters),
+                "gauges": {k: round(v, 6) for k, v in self.gauges.items()},
                 "stages_s": {k: round(v, 6)
                              for k, v in self.stage_s.items()},
                 "latency": {
@@ -206,10 +259,11 @@ class ServingTelemetry:
         return out
 
     def prometheus_text(self, prefix="paddle_tpu_serving"):
-        """Prometheus text exposition: counters, stage-seconds counters,
-        latency histograms."""
+        """Prometheus text exposition: counters, gauges, stage-seconds
+        counters, latency histograms."""
         with self._lock:
             counters = dict(self.counters)
+            gauges = dict(self.gauges)
             stages = dict(self.stage_s)
             hists = {"ttft_seconds": self.ttft_s,
                      "inter_token_seconds": self.inter_token_s,
@@ -225,6 +279,10 @@ class ServingTelemetry:
                 full = f"{prefix}_{name}_total"
                 lines.append(f"# TYPE {full} counter")
                 lines.append(f"{full} {val}")
+            for name, val in sorted(gauges.items()):
+                full = f"{prefix}_{name}"
+                lines.append(f"# TYPE {full} gauge")
+                lines.append(f"{full} {val:g}")
             full = f"{prefix}_stage_seconds_total"
             lines.append(f"# TYPE {full} counter")
             for name, val in sorted(stages.items()):

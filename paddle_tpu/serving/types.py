@@ -61,6 +61,11 @@ class ServeResult:
     ttft_s: float | None = None
     e2e_s: float = 0.0
     queue_wait_s: float | None = None
+    #: the request's flight-recorder span timeline (JSON-ready dict:
+    #: queued → admitted → prefill chunks → per-token gaps → finish,
+    #: every span stamped with the engine StepRecord id that produced
+    #: it). None unless the server was started with a flight_recorder.
+    trace: dict | None = None
 
 
 class RequestHandle:

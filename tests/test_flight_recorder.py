@@ -1,0 +1,344 @@
+"""Engine flight recorder — StepRecord ring, per-request timelines, the
+chrome-trace export, and the slow-token explainer.
+
+The acceptance bar from the ISSUE: a serve run (fused AND legacy
+schedulers, dense AND paged caches) produces a chrome-trace JSON where
+every emitted token's span carries the id of a recorded StepRecord, and
+``explain_tail`` returns a non-empty causal attribution for the tail
+inter-token gaps. The cause taxonomy itself is pinned by synthetic
+records (deterministic — no timing races). All CPU-fast; the serve
+fixtures reuse one tiny module-scoped model like tests/test_serving.py.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.profiler.flight_recorder import (FlightRecorder, StepRecord,
+                                                 TAIL_CAUSES)
+from paddle_tpu.serving import AsyncLLMServer
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=96, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _engine(model, cache_impl="dense", scheduler="legacy", **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("chunk_size", 16)
+    if cache_impl == "paged":
+        kw.setdefault("block_size", 8)
+    return LLMEngine(model, cache_impl=cache_impl, scheduler=scheduler,
+                     **kw)
+
+
+def _prompts(seed, sizes):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 96, size=(n,)).astype(np.int32) for n in sizes]
+
+
+def _serve(eng, prompts, rec, max_new_tokens=5):
+    server = AsyncLLMServer(eng, max_queue_size=16, flight_recorder=rec)
+    with server:
+        handles = [server.submit(p, max_new_tokens=max_new_tokens)
+                   for p in prompts]
+        results = [h.result(timeout=300) for h in handles]
+    return server, results
+
+
+# ---------------------------------------------------------------------------
+# the acceptance matrix: fused x legacy, dense x paged
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("scheduler", ["legacy", "fused"])
+@pytest.mark.parametrize("cache_impl", ["dense", "paged"])
+def test_serve_records_join_and_trace(tiny_model, tmp_path, scheduler,
+                                      cache_impl):
+    eng = _engine(tiny_model, cache_impl, scheduler)
+    rec = FlightRecorder(capacity=256)
+    server, results = _serve(eng, _prompts(1, (7, 12, 5, 9)), rec)
+
+    # -- StepRecord schema + invariants --
+    recs = rec.records()
+    assert recs, "no steps recorded"
+    ids = [r.step_id for r in recs]
+    assert ids == sorted(ids) and len(set(ids)) == len(ids)
+    for r in recs:
+        assert r.scheduler == scheduler
+        assert r.kind in ("decode", "mixed", "spec", "drain")
+        # may exceed 1.0 under a throttled budget (decode tokens and the
+        # ramp progress guarantee are never budget-throttled)
+        assert r.budget_utilization >= 0.0
+        assert r.admit_s >= 0 and r.schedule_s >= 0 and r.dispatch_s >= 0
+        assert r.t_finish >= r.t_begin > 0
+        assert r.sync_s >= 0 and r.emit_s >= 0
+        assert r.pipeline_inflight >= 0
+        if cache_impl == "paged":
+            assert 0 <= r.free_blocks <= r.total_blocks == eng.n_blocks
+        else:
+            assert r.free_blocks is None and r.total_blocks is None
+        for slot, rid, gkind, n in r.grants:
+            assert 0 <= slot < eng.B
+            assert gkind in ("prefill", "decode") and n >= 1
+        assert r.tokens_scheduled == sum(g[3] for g in r.grants)
+    if scheduler == "fused":
+        assert any(r.kind == "mixed" and r.prefill_tokens > 0
+                   for r in recs), "fused ramp-in never recorded a mixed step"
+
+    # -- the join: every emitted token's span carries a recorded step id --
+    idset = set(ids)
+    n_tokens = 0
+    for rid, tl in rec.timelines().items():
+        kinds = [e["kind"] for e in tl["events"]]
+        assert kinds[0] == "queued"
+        assert "admitted" in kinds and kinds[-1] == "finish"
+        assert "prefill" in kinds, "no prefill span recorded"
+        for ev in tl["events"]:
+            if ev["kind"] == "token":
+                n_tokens += 1
+                assert ev["step_id"] in idset, \
+                    f"token stamped with unrecorded step {ev['step_id']}"
+    assert n_tokens == sum(len(r.token_ids) for r in results) == 20
+    # retirements land on the step records that read them out
+    finished = {rid for r in recs for rid in r.finished}
+    assert finished == {r.request_id for r in results}
+
+    # -- ServeResult.trace handle --
+    for r in results:
+        assert r.trace is not None and r.trace["request_id"] == r.request_id
+        assert any(e["kind"] == "token" for e in r.trace["events"])
+
+    # -- chrome trace: valid JSON, engine lane + one lane per request --
+    path = rec.export_chrome_trace(str(tmp_path / "trace.json"))
+    data = json.load(open(path))
+    events = data["traceEvents"]
+    lanes = {e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert "engine steps" in lanes
+    assert {f"req {r.request_id}" for r in results} <= lanes
+    steps = [e for e in events if e.get("cat") == "engine"]
+    assert len(steps) == len(recs)
+    tok_spans = [e for e in events
+                 if e.get("cat") == "request" and e["name"] == "token"]
+    assert len(tok_spans) == n_tokens
+    for e in events:
+        if e.get("ph") == "X":
+            assert e["dur"] >= 0 and "ts" in e
+        if e.get("cat") == "request" and e["name"] == "token":
+            assert e["args"]["step_id"] in idset
+
+    # -- the slow-token explainer is non-empty and well-labelled --
+    tail = rec.explain_tail(0.99)
+    assert tail, "no tail attribution for a busy serve"
+    assert tail == sorted(tail, key=lambda e: -e["gap_s"])
+    for e in tail:
+        assert e["cause"] in TAIL_CAUSES
+        assert e["step"] is not None and e["step_id"] in idset
+
+
+def test_trace_merges_across_ranks(tiny_model, tmp_path):
+    """The export follows Profiler._export_chrome conventions, so
+    merge_profile treats a flight-recorder trace like any rank trace."""
+    from paddle_tpu.profiler import merge_profile
+
+    eng = _engine(tiny_model)
+    rec = FlightRecorder(capacity=64)
+    _serve(eng, _prompts(2, (6, 8)), rec, max_new_tokens=3)
+    p1 = rec.export_chrome_trace(str(tmp_path / "r0.json"))
+    p2 = rec.export_chrome_trace(str(tmp_path / "r1.json"))
+    out = merge_profile([p1, p2], str(tmp_path / "merged.json"))
+    merged = json.load(open(out))["traceEvents"]
+    assert {e["pid"] for e in merged} == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# ring + overhead contracts
+# ---------------------------------------------------------------------------
+
+def test_ring_buffer_retains_newest(tiny_model):
+    eng = _engine(tiny_model, max_batch=1, horizon=1)
+    rec = FlightRecorder(capacity=4)
+    eng.flight_recorder = rec
+    eng.generate(_prompts(3, (5,)), max_new_tokens=12)
+    recs = rec.records()
+    assert len(recs) == 4                      # capacity, not step count
+    total = rec.snapshot()["steps_total"]
+    assert total > 4
+    assert [r.step_id for r in recs] == list(range(total - 4, total))
+    # an evicted step id resolves to None, not a wrong record
+    assert rec.get_step(0) is None
+    assert rec.get_step(total - 1) is not None
+
+
+def test_disabled_recorder_records_nothing(tiny_model):
+    eng = _engine(tiny_model, max_batch=1, horizon=1)
+    rec = FlightRecorder(enabled=False)
+    server, results = _serve(eng, _prompts(4, (6,)), rec, max_new_tokens=3)
+    assert rec.records() == [] and rec.timelines() == {}
+    assert rec.explain_tail() == []
+    assert results[0].trace is None
+    # and no recorder at all leaves the engine path untouched
+    eng2 = _engine(tiny_model, max_batch=1, horizon=1)
+    server, results = _serve(eng2, _prompts(4, (6,)), None, max_new_tokens=3)
+    assert results[0].trace is None and results[0].finish_reason == "length"
+
+
+def test_live_timelines_are_bounded():
+    """A recorder attached directly to an engine never sees "finish"
+    events — the live set must still stay bounded (oldest traces demote
+    to the bounded done set instead of leaking)."""
+    rec = FlightRecorder(capacity=4, max_requests=8)
+    sid = _mk_step(rec)
+    for rid in range(50):
+        _tok(rec, rid, sid, 100.0 + rid)
+    with rec._lock:
+        assert len(rec._live) <= 8 and len(rec._done) <= 8
+    tls = rec.timelines()
+    assert len(tls) == 16                  # newest 8 live + 8 demoted
+    assert set(tls) == set(range(34, 50))
+
+
+def test_recorder_survives_preemption_churn(tiny_model):
+    """An oversubscribed paged pool preempts mid-serve; the preemption
+    lands in a StepRecord and the explainer can see it."""
+    eng = _engine(tiny_model, "paged", max_batch=2, horizon=1,
+                  kv_pool_blocks=6)
+    rec = FlightRecorder(capacity=512)
+    server, results = _serve(eng, _prompts(5, (9, 11)), rec,
+                             max_new_tokens=16)
+    assert all(r.finished for r in results)
+    assert eng.stats["preemptions"] >= 1
+    pre = [r for r in rec.records() if r.preemptions]
+    assert pre, "preemption never recorded"
+    assert all(isinstance(rid, int) for r in pre for rid in r.preemptions)
+
+
+# ---------------------------------------------------------------------------
+# explain_tail cause taxonomy (synthetic, timing-deterministic)
+# ---------------------------------------------------------------------------
+
+def _mk_step(rec, *, kind="decode", grants=(), preempted=(), dispatch_s=0.01,
+             sync_s=0.0, emit_s=0.0, wall_s=None, t0=100.0, admit_s=0.0):
+    sid = rec.begin_step(
+        scheduler="fused", kind=kind, grants=grants,
+        tokens_scheduled=sum(g[3] for g in grants), token_budget=32,
+        queue_depth=0, free_blocks=None, total_blocks=None,
+        pipeline_inflight=1, preemptions=preempted, admit_s=admit_s,
+        schedule_s=0.0, dispatch_s=dispatch_s, t_begin=t0)
+    rec.finish_step(sid, sync_s, emit_s)
+    r = rec.get_step(sid)
+    if wall_s is not None:
+        r.t_finish = r.t_begin + wall_s     # pin the wall deterministically
+    return sid
+
+
+def _tok(rec, rid, sid, t):
+    """Inject a token event at an exact wall time (bypasses the clock)."""
+    with rec._lock:
+        tr = rec._trace(rid)
+        gap = t - tr.last_token_t if tr.last_token_t is not None else None
+        tr.last_token_t = t
+        tr.events.append(("token", t, sid, gap))
+
+
+@pytest.mark.parametrize("setup,expect", [
+    (dict(preempted=(7,), wall_s=0.1), "preemption"),
+    (dict(grants=((0, 1, "prefill", 16), (1, 2, "decode", 1)),
+          kind="mixed", wall_s=0.1), "interfering_prefill"),
+    # the legacy shape: no prefill grant, but an admission prefill train
+    # dominated the step's wall (admit_s split)
+    (dict(admit_s=0.08, wall_s=0.1), "interfering_prefill"),
+    (dict(sync_s=0.09, wall_s=0.1), "host_sync"),
+    (dict(wall_s=0.01), "idle_bubble"),   # gap 0.1 >> step wall 0.01
+    (dict(wall_s=0.09), "dispatch"),      # the step itself explains it
+])
+def test_explain_tail_causes(setup, expect):
+    rec = FlightRecorder(capacity=16)
+    sid = _mk_step(rec, **setup)
+    _tok(rec, 5, sid, 100.0)
+    _tok(rec, 5, sid, 100.1)              # one 100ms gap -> THE tail
+    (expl,) = rec.explain_tail(0.99)
+    assert expl["cause"] == expect
+    assert expl["request_id"] == 5 and expl["step_id"] == sid
+    assert expl["gap_s"] == pytest.approx(0.1)
+    assert expl["step"]["step_id"] == sid
+
+
+def test_queued_event_starts_fresh_timeline():
+    """Request ids restart per server: a reused id's "queued" event must
+    begin a NEW timeline, not resurrect the finished one (whose stale
+    last_token_t would fabricate a giant phantom gap)."""
+    rec = FlightRecorder(capacity=16)
+    sid = _mk_step(rec, wall_s=0.01)
+    rec.req_event(0, "queued", t=100.0)
+    _tok(rec, 0, sid, 100.1)
+    rec.req_event(0, "finish", value="length", t=100.2)
+    rec.req_event(0, "queued", t=900.0)          # second server, same rid
+    _tok(rec, 0, sid, 900.1)
+    (tl,) = rec.timelines().values()
+    assert [e["kind"] for e in tl["events"]] == ["queued", "token"]
+    # the fresh trace has no previous token, hence no phantom 800s gap
+    assert tl["events"][1]["value"] is None
+    assert rec.explain_tail(0.99) == []
+
+
+def test_explain_tail_evicted_step_is_unrecorded():
+    rec = FlightRecorder(capacity=1)
+    sid = _mk_step(rec)
+    _tok(rec, 1, sid, 100.0)
+    _tok(rec, 1, sid, 100.1)
+    _mk_step(rec, t0=200.0)               # wraps the 1-slot ring
+    (expl,) = rec.explain_tail(0.99)
+    assert expl["cause"] == "unrecorded" and expl["step"] is None
+
+
+def test_explain_tail_quantile_selects_tail():
+    rec = FlightRecorder(capacity=16)
+    sid = _mk_step(rec, wall_s=0.001)
+    t = 100.0
+    _tok(rec, 1, sid, t)
+    for _ in range(99):                   # 99 x 1ms gaps
+        t += 0.001
+        _tok(rec, 1, sid, t)
+    t += 0.5                              # one 500ms outlier
+    _tok(rec, 1, sid, t)
+    tail = rec.explain_tail(0.99)
+    assert len(tail) == 1 and tail[0]["gap_s"] == pytest.approx(0.5)
+    assert len(rec.explain_tail(0.5)) > 1
+
+
+# ---------------------------------------------------------------------------
+# StepRecord dict round-trip
+# ---------------------------------------------------------------------------
+
+def test_step_record_to_dict_schema():
+    r = StepRecord(3, 1.0, "fused", "mixed",
+                   ((0, 11, "prefill", 16), (1, 12, "decode", 1)),
+                   17, 32, 2, 5, 8, 1, (9,), 0.001, 0.002, 0.003)
+    d = r.to_dict()
+    for key in ("step_id", "scheduler", "kind", "grants", "tokens_scheduled",
+                "token_budget", "queue_depth", "free_blocks", "total_blocks",
+                "pipeline_inflight", "preemptions", "admit_s", "schedule_s",
+                "dispatch_s", "sync_s", "emit_s", "finished",
+                "budget_utilization", "prefill_tokens"):
+        assert key in d, key
+    assert d["budget_utilization"] == round(17 / 32, 4)
+    assert d["prefill_tokens"] == 16 and r.decode_slots == 1
+    json.dumps(d)                          # JSON-ready end to end
+    # a throttled budget over-grants (decode floor + ramp guarantee):
+    # utilization > 1 is the too-small-budget signal, not an error
+    over = StepRecord(4, 1.0, "fused", "mixed", ((0, 1, "decode", 5),),
+                      5, 2, 0, None, None, 1, (), 0.0, 0.0, 0.0)
+    assert over.budget_utilization == 2.5

@@ -50,6 +50,26 @@ def test_profiler_records_and_exports(tmp_path):
     assert any(e["name"] == "train_step" for e in events)
 
 
+def test_record_event_disabled_fast_path():
+    """With no profiler recording, RecordEvent must neither timestamp nor
+    enter a jax named_scope — always-on instrumentation costs ~nothing —
+    and must not record a span; enabling a profiler re-arms it."""
+    from paddle_tpu.profiler import _BUFFER
+
+    assert not _BUFFER.enabled
+    ev = RecordEvent("hot_path")
+    with ev:
+        assert ev._t0 is None and ev._scope is None
+    assert not _BUFFER.events
+    p = Profiler(timer_only=True)
+    p.start()
+    with RecordEvent("hot_path") as ev2:
+        assert ev2._t0 is not None
+    with _BUFFER.lock:
+        assert any(e["name"] == "hot_path" for e in _BUFFER.events)
+    p.stop()
+
+
 def test_profiler_summary(capsys):
     p = Profiler(timer_only=True)
     p.start()
