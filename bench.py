@@ -4,7 +4,9 @@ Default (`BENCH_MODEL` unset / `all`): runs every BASELINE.md config plus
 the decode and serving benchmarks — resnet50, bert, vit, unet, llama_decode,
 llama_paged_decode (Pallas paged-attention kernel on/off A/B),
 llama_serve, llama_serve_fused (fused prefill+decode scheduler on/off
-A/B), llama_serve_spec, then the flagship llama LAST — each in its own
+A/B), llama_serve_prefix_cache (automatic prefix caching on/off A/B:
+shared-system-prompt hit-rate + zero-reuse overhead guard),
+llama_serve_spec, then the flagship llama LAST — each in its own
 subprocess, one JSON line each, so the tail line stays the llama MFU vs
 the 45% north star (BASELINE.json).
 `BENCH_MODEL=llama` (or any single name) prints exactly one line.
@@ -807,6 +809,117 @@ def _bench_other(model_name):
                 "max_step_tokens": max_step_tokens or chunk + B - 1,
                 "telemetry_artifact": fused_tel_path}
 
+    if model_name == "llama_serve_prefix_cache":
+        # Automatic prefix caching A/B: the SAME model / server served by
+        # LLMEngine(cache_impl="paged", scheduler="fused") with
+        # enable_prefix_cache on vs off, on TWO workloads:
+        #   * shared — every prompt opens with the same system prompt
+        #     (the template-heavy production shape): cache-on should
+        #     report hit_rate > 0 and tokens/s >= cache-off, since the
+        #     shared span admits as pure table writes + refcount bumps
+        #     (zero prefill FLOPs);
+        #   * zero-reuse — all-unique prompts: the overhead guard. The
+        #     hash-chain probe, registration, and LRU bookkeeping ride
+        #     the admission path, so cache-on must stay within 2% of
+        #     cache-off here.
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import LLMEngine
+        from paddle_tpu.serving import AsyncLLMServer
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+        n_req = int(os.environ.get("BENCH_REQUESTS", str(2 * B)))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        chunk = int(os.environ.get("BENCH_CHUNK", "256"))
+        block = int(os.environ.get("BENCH_BLOCK", "64"))
+        horizon = int(os.environ.get("BENCH_HORIZON", "64"))
+        sys_len = int(os.environ.get("BENCH_SYS_PROMPT", "256"))
+        tail_len = int(os.environ.get("BENCH_TAIL", "128"))
+        # paged KV needs capacity % chunk == 0
+        cap = -(-(sys_len + tail_len + new_tokens) // chunk) * chunk
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=heads,
+                          max_position_embeddings=cap)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg).bfloat16()
+        model.eval()
+        V = cfg.vocab_size
+        sys_prompt = rng.integers(0, V, (sys_len,)).astype(np.int32)
+        tails = [rng.integers(0, V, (tail_len // 2 + int(x),)).astype(
+            np.int32) for x in rng.integers(0, tail_len // 2, size=n_req)]
+        shared = [np.concatenate([sys_prompt, t]) for t in tails]
+        unique = [rng.integers(0, V, (sys_len + len(t),)).astype(np.int32)
+                  for t in tails]
+
+        def run_arm(prompts, cache_on):
+            eng = LLMEngine(model, max_batch=B, max_seq_len=cap,
+                            chunk_size=chunk, horizon=horizon,
+                            cache_impl="paged", block_size=block,
+                            scheduler="fused",
+                            enable_prefix_cache=cache_on)
+            # warm the compiled programs with a throwaway prompt that
+            # shares nothing with the workload (must not seed the cache)
+            warm = rng.integers(0, V, (3,)).astype(np.int32)
+            eng.generate([warm], max_new_tokens=2)
+            eng.reset_stats()
+            server = AsyncLLMServer(eng, max_queue_size=n_req + 1)
+            server.start()
+            t0 = time.perf_counter()
+            handles = [server.submit(p, max_new_tokens=new_tokens)
+                       for p in prompts]
+            outs = [h.result(timeout=1800) for h in handles]
+            wall = time.perf_counter() - t0
+            server.stop()
+            toks = sum(len(o.token_ids) for o in outs)
+            snap = server.telemetry.snapshot(wall_s=wall)
+            hit = eng.stats["prefix_hit_tokens"]
+            pre = eng.stats["prefill_tokens"]
+            return {
+                "tokens_per_sec": toks / wall,
+                "hit_rate": round(hit / (hit + pre), 4) if hit + pre
+                else 0.0,
+                "prefix_hit_tokens": hit,
+                "prefill_tokens": pre,
+                "cow_blocks": eng.stats["prefix_cow_blocks"],
+                "evicted_blocks": eng.stats["prefix_evicted_blocks"],
+                "ttft_p50_ms": round(
+                    snap["latency"]["ttft"]["p50_s"] * 1e3, 1),
+                "attributed_share": snap["attribution"]["attributed_share"],
+            }, [list(o.token_ids) for o in outs]
+
+        shared_on, toks_on = run_arm(shared, True)
+        shared_off, toks_off = run_arm(shared, False)
+        unique_on, _ = run_arm(unique, True)
+        unique_off, _ = run_arm(unique, False)
+        overhead_pct = round(
+            (1.0 - unique_on["tokens_per_sec"]
+             / max(unique_off["tokens_per_sec"], 1e-9)) * 100, 2)
+        art_path = os.path.join(_artifact_dir(),
+                                "llama_serve_prefix_cache.json")
+        with open(art_path, "w") as f:
+            json.dump({"shared_on": shared_on, "shared_off": shared_off,
+                       "unique_on": unique_on, "unique_off": unique_off},
+                      f, indent=1)
+        return {"metric": "llama_serve_prefix_cache_tokens_per_sec",
+                "value": round(shared_on["tokens_per_sec"], 1),
+                "unit": "tokens/s", "vs_baseline": None,
+                "cache_on": shared_on, "cache_off": shared_off,
+                "prefix_cache_speedup": round(
+                    shared_on["tokens_per_sec"]
+                    / max(shared_off["tokens_per_sec"], 1e-9), 3),
+                # greedy serving: the A/B must be token-exact too
+                "token_parity": toks_on == toks_off,
+                "zero_reuse_on": unique_on, "zero_reuse_off": unique_off,
+                "zero_reuse_overhead_pct": overhead_pct,
+                "requests": n_req, "slots": B, "new_tokens": new_tokens,
+                "sys_prompt_len": sys_len, "chunk": chunk,
+                "block_size": block, "horizon": horizon,
+                "telemetry_artifact": art_path}
+
     if model_name == "conv_roofline":
         return _bench_conv_roofline()
 
@@ -1259,7 +1372,7 @@ def _run_all():
     import sys
     for name in ["resnet50", "bert", "vit", "unet", "llama_decode",
                  "llama_paged_decode", "llama_serve", "llama_serve_fused",
-                 "llama_serve_spec", "llama"]:
+                 "llama_serve_prefix_cache", "llama_serve_spec", "llama"]:
         env = dict(os.environ, BENCH_MODEL=name)
         try:
             proc = subprocess.run(

@@ -32,6 +32,9 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import hashlib
+import heapq
+import os
 import time
 
 import jax
@@ -43,6 +46,10 @@ from ..models.llama import SlotKVCache, _sample_logits_device
 
 __all__ = ["LLMEngine", "GenerationRequest", "RequestOutput", "PendingStep",
            "PoolCapacityError"]
+
+#: chain-hash seed for block 0 of every sequence (the "parent" of the
+#: first block) — a fixed constant so equal first blocks collide
+_ROOT_HASH = b"paddle-tpu-prefix-root"
 
 
 class PoolCapacityError(RuntimeError):
@@ -72,12 +79,21 @@ class RequestOutput:
 
 
 class _Slot:
-    __slots__ = ("req", "generated", "prompt_len", "prefill_pos", "inflight")
+    __slots__ = ("req", "generated", "prompt_len", "prefill_pos", "inflight",
+                 "chain", "reg_blocks")
 
     def __init__(self, req, prompt_len, prefill_pos=None):
         self.req = req
         self.generated = []
         self.prompt_len = prompt_len
+        #: prefix-cache chain state (paged + enable_prefix_cache): the
+        #: rolling chain hash of each REGISTERED full block of this
+        #: slot's committed token stream, and how many blocks have been
+        #: registered in the content store so far. Admission seeds both
+        #: from the probe's hit; prefill/decode extend them as blocks
+        #: fill.
+        self.chain = []
+        self.reg_blocks = 0
         #: prompt tokens whose prefill has been DISPATCHED (== prompt_len
         #: once ramp-in completes; legacy admission prefills everything up
         #: front). The fused scheduler advances it one chunk grant at a
@@ -144,7 +160,7 @@ class LLMEngine:
                  top_k=0, stream_callback=None, horizon=1, speculative_k=1,
                  lookup_ngram=3, mesh=None, cache_impl="dense",
                  block_size=64, kv_pool_blocks=None, scheduler="legacy",
-                 max_step_tokens=None):
+                 max_step_tokens=None, enable_prefix_cache=False):
         """``scheduler="fused"`` (Sarathi-style chunked-prefill+decode
         fusion): admission becomes slot ASSIGNMENT only — each engine step
         then processes, per slot, either one bounded prefill chunk (for
@@ -177,7 +193,21 @@ class LLMEngine:
         (kv_pool_blocks < max_batch * capacity/block_size): when it runs
         dry mid-decode, the most recently admitted slot is PREEMPTED back
         to the waiting queue (its tokens re-prefill on re-admission, so
-        greedy output is unchanged)."""
+        greedy output is unchanged).
+
+        ``enable_prefix_cache`` (paged only — vLLM/SGLang-style automatic
+        prefix caching): the host block allocator becomes a ref-counted,
+        CONTENT-ADDRESSED store. Full blocks are keyed by a rolling hash
+        chained over the whole prefix (equal prefixes collide on
+        purpose), blocks freed at retirement park in an LRU "cached" pool
+        instead of the free list, and admission probes the store for the
+        longest cached prefix — hit blocks attach by pure table writes +
+        refcount bumps, so the shared span costs ZERO prefill FLOPs.
+        Shared (refcounted) blocks are never written; a slot that must
+        append into content another request still references gets a
+        private COPY first (copy-on-write — the partial tail block is
+        always private). Greedy output is token-exact vs the uncached
+        engine; the LRU evicts before any live slot is preempted."""
         from ..jit.functional_call import collect_state, read_values
 
         self.model = model
@@ -251,6 +281,12 @@ class LLMEngine:
         import ml_dtypes  # noqa: F401  (np.zeros understands bf16 via jnp)
         np_dt = np.dtype(dt) if mesh is not None else dt
         self.cache_impl = cache_impl
+        if enable_prefix_cache and cache_impl != "paged":
+            raise ValueError("enable_prefix_cache needs cache_impl='paged' "
+                             "(content-hashed block reuse lives in the "
+                             "paged pool's table indirection; the dense "
+                             "per-slot buffers have nothing to share)")
+        self.prefix_cache = bool(enable_prefix_cache)
         if cache_impl == "paged":
             if self.speculative_k > 1:
                 raise ValueError("paged KV serves one token per step "
@@ -278,8 +314,38 @@ class LLMEngine:
             self._k = [_zeros(pool_shape, np_dt) for _ in range(L)]
             self._v = [_zeros(pool_shape, np_dt) for _ in range(L)]
             self._tables = np.full((self.B, self._max_blocks), -1, np.int32)
+            #: min-heap of free physical blocks: allocation always pops
+            #: the SMALLEST free index, so physical layout is a pure
+            #: function of the request/retirement sequence — repeated
+            #: runs produce identical tables (the old list popped LIFO
+            #: from the tail, making layout depend on retirement history
+            #: and trace diffs noisy). list(range(n)) is already a heap.
             self._free_blocks = list(range(self.n_blocks))
             self._slot_blocks = [[] for _ in range(self.B)]
+            #: per-block live reference count (prefix-cache sharing makes
+            #: >1 possible; without it the count is only ever 0/1)
+            self._block_ref = [0] * self.n_blocks
+            # ---- content-addressed store (enable_prefix_cache) -------
+            #: chain_hash -> phys for every REGISTERED full block; the
+            #: hash chains over the whole prefix, so equal prefixes
+            #: collide on purpose and the probe walk is one dict get per
+            #: block
+            self._store = {}
+            self._block_hash = {}    # phys -> chain hash (registered)
+            self._block_parent = {}  # phys -> parent chain hash
+            self._block_tokens = {}  # phys -> block token ids (bytes)
+            self._children = {}      # parent hash -> [phys, ...]
+            #: refcount-0 registered blocks, oldest-freed first — the
+            #: "cached" pool between live and free. Allocation evicts
+            #: from HERE (oldest first) before any live slot is
+            #: preempted.
+            self._lru = collections.OrderedDict()
+            #: pool-invariant debug audit (satellite): on under
+            #: PADDLE_TPU_POOL_CHECKS=1 (the test suite sets it) —
+            #: asserts free + cached + live-refcounted == n_blocks and
+            #: table/refcount consistency after every alloc/free.
+            self._debug_pool = os.environ.get(
+                "PADDLE_TPU_POOL_CHECKS", "0") not in ("", "0")
         else:
             shape = (self.B, self.capacity, kvh, head_dim)
             self._k = [_zeros(shape, np_dt) for _ in range(L)]
@@ -326,6 +392,8 @@ class LLMEngine:
         self.stats = {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
                       "draft_tokens_accepted": 0, "preemptions": 0,
                       "fused_steps": 0, "prefill_tokens": 0,
+                      "prefix_hit_tokens": 0, "prefix_cow_blocks": 0,
+                      "prefix_evicted_blocks": 0,
                       "decode_time_s": 0.0, "admit_time_s": 0.0,
                       "dispatch_time_s": 0.0, "host_sync_time_s": 0.0,
                       "emit_time_s": 0.0}
@@ -623,6 +691,15 @@ class LLMEngine:
             self._prefill_paged_fn = jax.jit(prefill_chunk_paged,
                                              donate_argnums=(1, 2))
 
+            def cow_copy(k_pools, v_pools, src, dst):
+                """Copy-on-write block duplication: clone physical block
+                ``src`` into ``dst`` across every layer's K/V pool. One
+                jitted program, src/dst traced — no recompile per copy."""
+                return ([p.at[dst].set(p[src]) for p in k_pools],
+                        [p.at[dst].set(p[src]) for p in v_pools])
+
+            self._cow_fn = jax.jit(cow_copy, donate_argnums=(0, 1))
+
         def set_tokens(tokens_buf, row, slot):
             return jax.lax.dynamic_update_slice(
                 tokens_buf, row[None].astype(jnp.int32),
@@ -703,16 +780,233 @@ class LLMEngine:
     # ------------------------------------------------------------------
     # paged-pool allocator (host side; tables are a traced step input)
     # ------------------------------------------------------------------
+    def _n_allocatable(self):
+        """Blocks a new allocation may claim: strictly free ones plus the
+        LRU-cached pool (refcount-0 registered content, evictable). Pool
+        pressure consumes BOTH before any live slot is preempted."""
+        return len(self._free_blocks) + len(self._lru)
+
+    def _pop_block(self):
+        """One writable physical block: the smallest FREE index first
+        (order-stable layout), else evict the oldest LRU-cached block —
+        its content identity unregisters and the block is plain free."""
+        if self._free_blocks:
+            return heapq.heappop(self._free_blocks)
+        phys, _ = self._lru.popitem(last=False)
+        self._unregister(phys)
+        self.stats["prefix_evicted_blocks"] += 1
+        return phys
+
     def _alloc_blocks(self, slot_idx, n):
-        """Grow slot `slot_idx` by `n` physical blocks. False = pool dry."""
-        if len(self._free_blocks) < n:
+        """Grow slot `slot_idx` by `n` PRIVATE physical blocks (refcount
+        1, content unregistered). False = pool dry (free + cached both
+        exhausted)."""
+        if self._n_allocatable() < n:
             return False
         blocks = self._slot_blocks[slot_idx]
         for _ in range(n):
-            phys = self._free_blocks.pop()
+            phys = self._pop_block()
+            self._block_ref[phys] = 1
             self._tables[slot_idx, len(blocks)] = phys
             blocks.append(phys)
+        self._check_pool_invariants()
         return True
+
+    def _release_block(self, phys):
+        """Drop one reference. At refcount 0 a REGISTERED block parks in
+        the LRU cached pool (its content stays probe-able); anything else
+        returns to the free heap."""
+        self._block_ref[phys] -= 1
+        if self._block_ref[phys] > 0:
+            return
+        if phys in self._block_hash:
+            self._lru[phys] = None
+        else:
+            heapq.heappush(self._free_blocks, phys)
+
+    # ---- content-addressed store (enable_prefix_cache) ---------------
+    def _chain_hash(self, parent, tokens):
+        """Rolling prefix hash of one full block: blake2b over the parent
+        chain hash + the block's token ids. Chaining makes equal PREFIXES
+        (not merely equal blocks) collide on purpose, and the digest is
+        deterministic across runs so traces diff cleanly."""
+        return hashlib.blake2b(
+            parent + np.asarray(tokens, np.int32).tobytes(),
+            digest_size=16).digest()
+
+    def _register_block(self, phys, chain_hash, parent, tokens):
+        """Publish a FULL private block's content identity. First writer
+        wins: if the store already has this chain hash (another block
+        with identical prefix content), ours stays unregistered and will
+        free normally — one canonical block per content."""
+        if chain_hash in self._store or phys in self._block_hash:
+            return
+        self._store[chain_hash] = phys
+        self._block_hash[phys] = chain_hash
+        self._block_parent[phys] = parent
+        self._block_tokens[phys] = np.asarray(tokens, np.int32).tobytes()
+        self._children.setdefault(parent, []).append(phys)
+
+    def _unregister(self, phys):
+        h = self._block_hash.pop(phys, None)
+        if h is None:
+            return
+        self._store.pop(h, None)
+        parent = self._block_parent.pop(phys)
+        kids = self._children.get(parent)
+        if kids is not None:
+            kids.remove(phys)
+            if not kids:
+                del self._children[parent]
+        self._block_tokens.pop(phys, None)
+
+    def _slot_token_range(self, slot, lo, hi):
+        """Token ids at positions [lo, hi) of ``slot``'s committed stream
+        (prompt, then generated)."""
+        P = slot.prompt_len
+        if hi <= P:
+            return slot.req.prompt_ids[lo:hi]
+        gen = np.asarray(slot.generated, np.int32)
+        if lo >= P:
+            return gen[lo - P:hi - P]
+        return np.concatenate([slot.req.prompt_ids[lo:], gen[:hi - P]])
+
+    def _register_upto(self, slot_idx, slot, upto_pos):
+        """Register every newly FULL block of ``slot``'s committed stream
+        [0, upto_pos) in the content store, extending its hash chain.
+        Shared/hit blocks were registered by their first writer and are
+        skipped via ``reg_blocks``; the COW tail registers here once the
+        slot's own appends fill it."""
+        if not self.prefix_cache:
+            return
+        bs = self.block_size
+        blocks = self._slot_blocks[slot_idx]
+        n_full = min(upto_pos // bs, len(blocks))
+        while slot.reg_blocks < n_full:
+            i = slot.reg_blocks
+            toks = self._slot_token_range(slot, i * bs, (i + 1) * bs)
+            parent = slot.chain[i - 1] if i else _ROOT_HASH
+            h = self._chain_hash(parent, toks)
+            slot.chain.append(h)
+            self._register_block(blocks[i], h, parent, toks)
+            slot.reg_blocks += 1
+
+    def _probe_prefix(self, slot_idx, token_ids, chunk_granular=False):
+        """Find the longest cached prefix of ``token_ids`` and attach it
+        to slot ``slot_idx``: pure table writes + refcount bumps, zero
+        prefill FLOPs for the hit span. The hit is capped at P-1 tokens —
+        at least the final prompt position always recomputes so admission
+        still produces the last-position logits the sampler needs.
+
+        ``chunk_granular`` (legacy scheduler): the hit rounds DOWN to a
+        whole number of prefill chunks, because legacy chunk windows
+        scatter whole chunk spans and must never scatter into a shared
+        block. The fused scheduler drop-scatters exact positions, so it
+        keeps block granularity and additionally extends the hit to
+        TOKEN granularity through a copy-on-write tail.
+
+        Returns ``(hit_tokens, chain)`` where ``chain`` is the list of
+        chain hashes of the full-block hits."""
+        P = len(token_ids)
+        bs = self.block_size
+        max_full = (P - 1) // bs
+        if chunk_granular:
+            max_full = ((P - 1) // self.chunk) * (self.chunk // bs)
+        found, parent = [], _ROOT_HASH
+        for k in range(min(max_full, self._max_blocks)):
+            h = self._chain_hash(parent, token_ids[k * bs:(k + 1) * bs])
+            phys = self._store.get(h)
+            if phys is None:
+                break
+            found.append((h, phys))
+            parent = h
+        if chunk_granular:
+            per = self.chunk // bs
+            found = found[:(len(found) // per) * per]
+        blocks = self._slot_blocks[slot_idx]
+        chain = []
+        for k, (h, phys) in enumerate(found):
+            if self._block_ref[phys] == 0:
+                self._lru.pop(phys, None)  # cached -> live
+            self._block_ref[phys] += 1
+            self._tables[slot_idx, k] = phys
+            blocks.append(phys)
+            chain.append(h)
+        hit = len(found) * bs
+        if not chunk_granular:
+            hit += self._cow_tail(slot_idx, token_ids, hit, chain)
+        self._check_pool_invariants()
+        return hit, chain
+
+    def _cow_tail(self, slot_idx, token_ids, hit, chain):
+        """Token-granular hit extension (copy-on-write): if a cached full
+        block CONTINUES the hit chain and its leading tokens match the
+        remaining prompt, the slot needs exactly that block's prefix —
+        but must then append its own tokens into it, and the source is
+        content other requests may still reference. So the source block
+        is cloned device-side into a fresh PRIVATE block (the partial
+        tail is always private) and the matched span's prefill is
+        skipped too. Returns the extra tokens hit (0 = no match / pool
+        dry)."""
+        P = len(token_ids)
+        bs = self.block_size
+        cap = min(bs - 1, P - 1 - hit)
+        if cap <= 0:
+            return 0
+        parent = chain[-1] if chain else _ROOT_HASH
+        rem = np.asarray(token_ids[hit:hit + cap], np.int32)
+        best, best_t = None, 0
+        for phys in self._children.get(parent, ()):
+            cand = np.frombuffer(self._block_tokens[phys],
+                                 np.int32)[:len(rem)]
+            t = int(np.cumprod(cand == rem).sum())
+            if t > best_t:
+                best, best_t = phys, t
+        if best is None or not self._alloc_blocks(slot_idx, 1):
+            return 0
+        dst = self._slot_blocks[slot_idx][-1]
+        # the copy dispatches NOW: even if allocating dst just evicted
+        # `best` from the store, its device content is only overwritten
+        # by LATER dispatches — program order over the shared pool
+        # buffers makes the clone read the original bytes
+        self._k, self._v = self._cow_fn(self._k, self._v,
+                                        np.int32(best), np.int32(dst))
+        self.stats["prefix_cow_blocks"] += 1
+        return best_t
+
+    def _check_pool_invariants(self):
+        """Debug-only allocator audit (PADDLE_TPU_POOL_CHECKS=1; the test
+        conftest enables it suite-wide): every physical block sits in
+        exactly ONE of {free heap, LRU cached, live-refcounted}, their
+        sizes sum to n_blocks (no leaks), refcounts equal table
+        references, table rows mirror _slot_blocks, and the trailing
+        scratch block never enters circulation."""
+        if not self._debug_pool:
+            return
+        free = set(self._free_blocks)
+        cached = set(self._lru)
+        live = [p for blocks in self._slot_blocks for p in blocks]
+        live_set = set(live)
+        assert len(free) == len(self._free_blocks), "free heap duplicates"
+        assert not (free & cached) and not (free & live_set) \
+            and not (cached & live_set), "block in two pools"
+        assert free | cached | live_set == set(range(self.n_blocks)), (
+            f"pool leak: free({len(free)}) + cached({len(cached)}) + "
+            f"live({len(live_set)}) != n_blocks({self.n_blocks})")
+        refs = collections.Counter(live)
+        for phys in range(self.n_blocks):
+            assert self._block_ref[phys] == refs.get(phys, 0), (
+                f"block {phys}: refcount {self._block_ref[phys]} != "
+                f"{refs.get(phys, 0)} table references")
+        for b in range(self.B):
+            blocks = self._slot_blocks[b]
+            row = self._tables[b]
+            assert list(row[:len(blocks)]) == blocks, f"table row {b} drift"
+            assert all(x == -1 for x in row[len(blocks):]), \
+                f"table row {b} stale tail"
+        for phys in cached:
+            assert phys in self._block_hash, \
+                f"unregistered block {phys} in the cached LRU"
 
     def _ensure_blocks(self, slot_idx, upto_pos):
         """Blocks covering positions [0, upto_pos]. False = pool dry."""
@@ -762,11 +1056,22 @@ class LLMEngine:
             return 2
         return 1
 
+    def _release_slot_blocks(self, slot_idx):
+        """Release every block slot ``slot_idx`` references and wipe its
+        table row — shared by retirement (_free_slot) and the pool-dry
+        admission rollback. Releases the DEEPEST block first: the LRU
+        then evicts leaves before their chain parents (evicting a prefix
+        head first would orphan every descendant still cached under
+        it)."""
+        for phys in reversed(self._slot_blocks[slot_idx]):
+            self._release_block(phys)
+        self._slot_blocks[slot_idx] = []
+        self._tables[slot_idx, :] = -1
+        self._check_pool_invariants()
+
     def _free_slot(self, slot_idx):
         if self.cache_impl == "paged":
-            self._free_blocks.extend(self._slot_blocks[slot_idx])
-            self._slot_blocks[slot_idx] = []
-            self._tables[slot_idx, :] = -1
+            self._release_slot_blocks(slot_idx)
         self.slots[slot_idx] = None
 
     def _preempt_newest(self, exclude=None, newer_than=None, retired=None):
@@ -851,15 +1156,26 @@ class LLMEngine:
         self._programs()
         P = len(req.prompt_ids)
         paged = self.cache_impl == "paged"
+        hit, chain = 0, []
         if paged:
+            if self.prefix_cache:
+                # longest cached prefix, CHUNK-granular here: legacy
+                # prefill scatters whole chunk windows and must never
+                # scatter into a shared block, so the hit boundary must
+                # be a window boundary
+                hit, chain = self._probe_prefix(slot_idx, req.prompt_ids,
+                                                chunk_granular=True)
             # prefill writes whole chunks: cover round_up(P, chunk), then
             # release the over-allocation down to the prompt's own blocks
             # (chunk is a block multiple, so blocks-needed * block_size
             # IS the padded end position)
             pad_end = self.prefill_blocks_needed(P) * self.block_size
             if not self._ensure_blocks(slot_idx, pad_end - 1):
+                # pool dry — roll the acquired hit back (the request
+                # requeues; its shared refs must not pin cached blocks)
+                self._release_slot_blocks(slot_idx)
                 return False
-        off = 0
+        off = hit
         logits_row = None
         # ONE zero-padded prompt buffer per admit, sliced per window (the
         # old loop re-allocated a chunk-sized np.zeros and re-copied the
@@ -874,6 +1190,11 @@ class LLMEngine:
         # spans stamp the id the upcoming dispatch will take, so request
         # time still joins back to a StepRecord
         rec = self._rec()
+        if hit:
+            self.stats["prefix_hit_tokens"] += hit
+            if rec is not None:
+                rec.req_event(req.request_id, "cached_prefix",
+                              step_id=rec.next_step_id(), value=hit)
         while off < P:
             take = min(self.chunk, P - off)
             if paged:
@@ -905,13 +1226,16 @@ class LLMEngine:
                               step_id=rec.next_step_id(), value=take)
         if paged:
             # drop the chunk-padding over-allocation: keep only the blocks
-            # the prompt actually occupies (+ the one decode grows into)
+            # the prompt actually occupies (+ the one decode grows into).
+            # Popped blocks are always the fresh private tail of the
+            # allocation (hit blocks sit below keep), so release just
+            # returns them to the free heap.
             keep = P // self.block_size + 1
             blocks = self._slot_blocks[slot_idx]
             while len(blocks) > keep:
                 phys = blocks.pop()
                 self._tables[slot_idx, len(blocks)] = -1
-                self._free_blocks.append(phys)
+                self._release_block(phys)
         self._admit_order[slot_idx] = self._admit_seq
         self._admit_seq += 1
         self._logits = self._set_logits_fn(self._logits, logits_row,
@@ -924,21 +1248,43 @@ class LLMEngine:
             row[:P] = req.prompt_ids
             self._tokens = self._set_tokens_fn(
                 self._tokens, row, np.int32(slot_idx))
-        self.slots[slot_idx] = _Slot(req, P)
+        slot = _Slot(req, P)
+        slot.chain = chain
+        slot.reg_blocks = len(chain)
+        self.slots[slot_idx] = slot
+        if paged:
+            # the whole prompt is prefilled: publish its full blocks'
+            # content (hit blocks are already registered and skip)
+            self._register_upto(slot_idx, slot, P)
+            self._check_pool_invariants()
         self.stats["admit_time_s"] += time.perf_counter() - t0
 
     def _admit_fused(self, slot_idx, req):
-        """Fused-scheduler admission: pure slot ASSIGNMENT — no prefill
-        dispatch, no block allocation (both happen chunk-by-chunk inside
-        the step scheduler). The only device op is zeroing the slot's
-        traced length; everything else is host bookkeeping, so admission
-        cost is O(1) and never stalls running decodes."""
+        """Fused-scheduler admission: slot ASSIGNMENT plus (prefix cache
+        on) the content-store probe — hit blocks attach by table writes
+        and refcount bumps, the optional COW tail costs one block clone,
+        and ``prefill_pos`` starts AT the hit boundary so the step
+        scheduler grants zero prefill for the shared span. No prefill
+        dispatch, no other block allocation (both happen chunk-by-chunk
+        inside the step scheduler); admission stays O(hit blocks) and
+        never stalls running decodes."""
         t0 = time.perf_counter()
         self._programs()
+        hit, chain = 0, []
+        if self.prefix_cache:
+            hit, chain = self._probe_prefix(slot_idx, req.prompt_ids)
         self._lens = self._set_len_fn(self._lens, np.int32(slot_idx),
-                                      np.int32(0))
-        self.slots[slot_idx] = _Slot(req, len(req.prompt_ids),
-                                     prefill_pos=0)
+                                      np.int32(hit))
+        slot = _Slot(req, len(req.prompt_ids), prefill_pos=hit)
+        slot.chain = chain
+        slot.reg_blocks = len(chain)
+        self.slots[slot_idx] = slot
+        if hit:
+            self.stats["prefix_hit_tokens"] += hit
+            rec = self._rec()
+            if rec is not None:
+                rec.req_event(req.request_id, "cached_prefix",
+                              step_id=rec.next_step_id(), value=hit)
         self._admit_order[slot_idx] = self._admit_seq
         self._admit_seq += 1
         self.stats["admit_time_s"] += time.perf_counter() - t0
@@ -1001,7 +1347,7 @@ class LLMEngine:
         rec, ctx = self._rec(), self._rec_ctx
         if rec is None or ctx is None:
             return
-        t0, admit0 = ctx
+        t0, admit0, hits0 = ctx
         wall = time.perf_counter() - t0
         admit_s = self.stats["admit_time_s"] - admit0
         paged = self.cache_impl == "paged"
@@ -1016,7 +1362,10 @@ class LLMEngine:
             pipeline_inflight=self._inflight,
             preemptions=preempted, admit_s=admit_s,
             schedule_s=max(wall - admit_s - dispatch_s, 0.0),
-            dispatch_s=dispatch_s, t_begin=t0)
+            dispatch_s=dispatch_s, t_begin=t0,
+            prefix_hit_tokens=(self.stats["prefix_hit_tokens"] - hits0
+                               if self.prefix_cache else None),
+            cached_blocks=len(self._lru) if self.prefix_cache else None)
         self._rec_ctx = None
 
     def step_begin(self):
@@ -1048,10 +1397,12 @@ class LLMEngine:
                 "lens (step_finish the outstanding PendingStep first; "
                 "see max_pipeline_depth())")
         if self._rec() is not None:
-            # wall-split anchors for this step's record: entry time +
-            # admit-stat baseline (scheduling = wall - admit - dispatch)
+            # wall-split anchors for this step's record: entry time,
+            # admit-stat baseline (scheduling = wall - admit - dispatch),
+            # prefix-hit baseline (the record carries this step's hits)
             self._rec_ctx = (time.perf_counter(),
-                             self.stats["admit_time_s"])
+                             self.stats["admit_time_s"],
+                             self.stats["prefix_hit_tokens"])
             self._rec_preempted = []
         self._admit_waiting()
         if not any(s is not None for s in self.slots):
@@ -1114,8 +1465,9 @@ class LLMEngine:
                 cur = slot.sched_len()
                 last_pos = min(cur + self.horizon - 1, self.capacity - 1)
                 while not self._ensure_blocks(b, last_pos):
-                    if self._free_blocks:
-                        self._alloc_blocks(b, len(self._free_blocks))
+                    avail = self._n_allocatable()
+                    if avail:
+                        self._alloc_blocks(b, avail)
                     covered = len(self._slot_blocks[b]) * self.block_size
                     if covered > cur:
                         pool_budget[b] = covered - cur
@@ -1296,8 +1648,9 @@ class LLMEngine:
             take = min(S, slot.prompt_len - pos, grant_cap)
             if paged and take > 0 and \
                     not self._ensure_blocks(b, pos + take - 1):
-                if self._free_blocks:
-                    self._alloc_blocks(b, len(self._free_blocks))
+                avail = self._n_allocatable()
+                if avail:
+                    self._alloc_blocks(b, avail)
                 covered = len(self._slot_blocks[b]) * self.block_size
                 take = min(take, covered - pos)
             if take <= 0:
@@ -1368,6 +1721,12 @@ class LLMEngine:
                 slot.prefill_pos += int(q_lens[b])
                 self.stats["prefill_chunks"] += 1
                 self.stats["prefill_tokens"] += int(q_lens[b])
+                if self.prefix_cache:
+                    # blocks this grant fills are prompt content — publish
+                    # them now so a same-prefix request admitted next step
+                    # already hits (device reads happen in later
+                    # dispatches, after this grant's write lands)
+                    self._register_upto(int(b), slot, slot.prefill_pos)
         self._inflight += 1
         pending = PendingStep(toks, was_active, None, False,
                               list(self.slots), pool_done, sched=sched)
@@ -1486,6 +1845,12 @@ class LLMEngine:
                     n_read - n_committed, 0)
             if self.slots[b] is not slot:
                 continue  # cancelled mid-window; don't record a finish
+            if self.prefix_cache and n_read > 0:
+                # decode-filled blocks register too (multi-turn reuse: a
+                # follow-up prompt carrying this conversation's history
+                # hits them) — content is the COMMITTED stream only
+                self._register_upto(b, slot,
+                                    slot.prefill_pos + len(slot.generated))
             if finish_reason:
                 out = RequestOutput(
                     slot.req.request_id,

@@ -74,6 +74,12 @@ class StepRecord:
     sync_s: float = 0.0
     emit_s: float = 0.0
     finished: tuple = ()               # request ids retired at readout
+    #: prompt tokens this step's admissions served straight from the
+    #: prefix cache (None: engine has no prefix cache) — 0 on a step
+    #: that admitted cold prompts is the COLD-MISS signal explain_tail
+    #: surfaces when such a step stalls a token
+    prefix_hit_tokens: int | None = None
+    cached_blocks: int | None = None   # LRU cached-pool size at dispatch
 
     @property
     def budget_utilization(self):
@@ -116,12 +122,16 @@ _EVENT_FIELDS = ("kind", "t", "step_id", "value")
 
 
 class _RequestTrace:
-    __slots__ = ("request_id", "events", "last_token_t")
+    __slots__ = ("request_id", "events", "last_token_t", "prefix_hit")
 
     def __init__(self, request_id):
         self.request_id = request_id
         self.events = []
         self.last_token_t = None
+        #: cached-prefix tokens this request's admission served from the
+        #: prefix cache (None until a "cached_prefix" event lands) — what
+        #: explain_tail joins prefill-grant interference back to
+        self.prefix_hit = None
 
     def to_dict(self):
         return {"request_id": self.request_id,
@@ -161,7 +171,8 @@ class FlightRecorder:
     def begin_step(self, *, scheduler, kind, grants, tokens_scheduled,
                    token_budget, queue_depth, free_blocks, total_blocks,
                    pipeline_inflight, preemptions, admit_s, schedule_s,
-                   dispatch_s, t_begin):
+                   dispatch_s, t_begin, prefix_hit_tokens=None,
+                   cached_blocks=None):
         """Record one dispatched step; returns its step id."""
         with self._lock:
             sid = self._seq
@@ -170,7 +181,9 @@ class FlightRecorder:
                 sid, t_begin, scheduler, kind, tuple(grants),
                 int(tokens_scheduled), int(token_budget), int(queue_depth),
                 free_blocks, total_blocks, int(pipeline_inflight),
-                tuple(preemptions), admit_s, schedule_s, dispatch_s)
+                tuple(preemptions), admit_s, schedule_s, dispatch_s,
+                prefix_hit_tokens=prefix_hit_tokens,
+                cached_blocks=cached_blocks)
             return sid
 
     def finish_step(self, step_id, sync_s, emit_s, finished=()):
@@ -242,6 +255,8 @@ class FlightRecorder:
         with self._lock:
             tr = self._trace(rid, fresh=(kind == "queued"))
             tr.events.append((kind, t, step_id, value))
+            if kind == "cached_prefix":
+                tr.prefix_hit = value
             if kind == "finish":
                 self._live.pop(rid, None)
                 self._done[rid] = tr
@@ -327,6 +342,8 @@ class FlightRecorder:
                 name = ev["kind"]
                 if name == "prefill":
                     name = f"prefill[{ev['value']}]"
+                elif name == "cached_prefix":
+                    name = f"cached_prefix[{ev['value']}]"
                 elif name == "finish":
                     name = f"finish:{ev['value']}"
                 args = {}
@@ -382,9 +399,43 @@ class FlightRecorder:
         out = []
         for gap, rid, sid in tail:
             rec = self.get_step(sid) if sid is not None else None
-            out.append({"request_id": rid, "gap_s": round(gap, 6),
-                        "step_id": sid, "cause": self._classify(gap, rec),
-                        "step": rec.to_dict() if rec is not None else None})
+            cause = self._classify(gap, rec)
+            entry = {"request_id": rid, "gap_s": round(gap, 6),
+                     "step_id": sid, "cause": cause,
+                     "step": rec.to_dict() if rec is not None else None}
+            if rec is not None and rec.prefix_hit_tokens is not None \
+                    and cause == "interfering_prefill":
+                # prefix cache was on and this gap came from prefill
+                # interference: name whether any interfering REQUEST was
+                # a COLD MISS (an admission the cache served nothing of).
+                # Joined through the granted requests' own cached_prefix
+                # records — the step's hit delta alone would mislabel
+                # the later chunk grants of a partially-served prompt
+                # (they ride steps whose own delta is 0)
+                pre_rids = [g[1] for g in rec.grants if g[2] == "prefill"]
+                if pre_rids:
+                    with self._lock:
+                        traces = [self._live.get(r) or self._done.get(r)
+                                  for r in pre_rids]
+                    entry["cold_miss"] = any(
+                        tr is None or not tr.prefix_hit for tr in traces)
+                else:
+                    # legacy admit-train shape (no grants recorded):
+                    # join through the prefill spans stamped with THIS
+                    # step's id — one legacy step may admit several
+                    # requests (cold and cache-served mixed in one
+                    # train), so the step's own hit delta alone could
+                    # hide a cold admission behind another's hit. Falls
+                    # back to the delta when the timelines were evicted.
+                    with self._lock:
+                        hits = [tr.prefix_hit
+                                for src in (self._live, self._done)
+                                for tr in src.values()
+                                if any(e[0] == "prefill" and e[2] == sid
+                                       for e in tr.events)]
+                    entry["cold_miss"] = any(not h for h in hits) \
+                        if hits else rec.prefix_hit_tokens == 0
+            out.append(entry)
         return out
 
     @staticmethod

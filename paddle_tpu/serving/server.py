@@ -331,6 +331,9 @@ class AsyncLLMServer:
         s_disp = eng.stats["dispatch_time_s"]
         s_pre = eng.stats["preemptions"]
         s_ptok = eng.stats["prefill_tokens"]
+        s_pfx = {k: eng.stats[k] for k in ("prefix_hit_tokens",
+                                           "prefix_cow_blocks",
+                                           "prefix_evicted_blocks")}
         t0 = time.perf_counter()
         pending = eng.step_begin()
         wall = time.perf_counter() - t0
@@ -342,6 +345,12 @@ class AsyncLLMServer:
         tel.add_stage("schedule", max(wall - d_admit - d_disp, 0.0))
         if d_ptok:
             tel.inc("prefill_tokens", d_ptok)
+        for key, before in s_pfx.items():
+            # prefix-cache activity (hits at admission, COW clones, LRU
+            # evictions) all happens inside step_begin — the deltas land
+            # on the matching telemetry counters
+            if eng.stats[key] > before:
+                tel.inc(key, eng.stats[key] - before)
         if eng.stats["preemptions"] > s_pre:
             # pool-pressure preemptions happen inside step_begin's
             # allocator loop — this is where the delta is visible
@@ -407,6 +416,12 @@ class AsyncLLMServer:
             tel.set_gauge("kv_pool_free_blocks", free)
             tel.set_gauge("kv_pool_occupancy",
                           1.0 - free / max(eng.n_blocks, 1))
+            if eng.prefix_cache:
+                tel.set_gauge("prefix_cached_blocks", len(eng._lru))
+                hit = eng.stats["prefix_hit_tokens"]
+                pre = eng.stats["prefill_tokens"]
+                tel.set_gauge("prefix_cache_hit_rate",
+                              hit / (hit + pre) if hit + pre else 0.0)
         rec = self.flight_recorder
         if rec is not None and rec.enabled:
             last = rec.last_record()
@@ -467,7 +482,7 @@ class AsyncLLMServer:
             admissible = i < free and (
                 not legacy_paged
                 or eng.prefill_blocks_needed(len(h.request.prompt_ids))
-                <= len(eng._free_blocks))
+                <= eng._n_allocatable())
             if admissible:
                 if h.stall_mark is None:
                     h.stall_mark = now

@@ -13,6 +13,12 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# paged-pool allocator audit: every LLMEngine built under the test suite
+# asserts free + cached + live-refcounted == n_blocks (plus table/refcount
+# consistency) after every alloc/free/preempt — leaks fail loudly here
+# instead of silently shrinking the serving pool (prod default: off)
+os.environ.setdefault("PADDLE_TPU_POOL_CHECKS", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

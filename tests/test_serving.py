@@ -327,6 +327,17 @@ def test_telemetry_strict_names_and_register():
         tel.inc("request_finished")                  # singular typo
     with pytest.raises(KeyError, match="unknown telemetry gauge"):
         tel.set_gauge("queue_dept", 3)
+    # the prefix-cache names are declared (not phantom-forked) ...
+    tel.inc("prefix_hit_tokens", 5)
+    tel.inc("prefix_cow_blocks")
+    tel.inc("prefix_evicted_blocks")
+    tel.set_gauge("prefix_cached_blocks", 4)
+    tel.set_gauge("prefix_cache_hit_rate", 0.5)
+    # ... and a typo'd variant still raises instead of forking
+    with pytest.raises(KeyError, match="unknown telemetry counter"):
+        tel.inc("prefix_hit_token")
+    with pytest.raises(KeyError, match="unknown telemetry gauge"):
+        tel.set_gauge("prefix_cache_hitrate", 0.5)
     with pytest.raises(ValueError, match="register kind"):
         tel.register("histogram", "x")
     tel.register("stage", "custom_stage")
