@@ -648,6 +648,31 @@ def _bench_other(model_name):
                 "flight_recorder": rec_snap,
                 "explain_tail_p99": tail_p99[:8],
             }, f, indent=1)
+        plain = None
+        if spec_k > 1:
+            # VERDICT r5 #6 satellite: the +42% speculation win exists as
+            # an A/B IN THE BENCH JSON, not as a comment — the same
+            # prompts re-served through a plain (spec off) engine at the
+            # same batch. horizon stays the plain path's production
+            # default (the spec arm's smaller horizon is a spec-specific
+            # tuning; the A/B compares best-config vs best-config).
+            plain_horizon = int(os.environ.get("BENCH_PLAIN_HORIZON", "64"))
+            eng_plain = LLMEngine(model, max_batch=B, max_seq_len=cap,
+                                  chunk_size=256, horizon=plain_horizon)
+            eng_plain.generate([prompts[0]], max_new_tokens=2)
+            eng_plain.reset_stats()
+            srv_plain = AsyncLLMServer(eng_plain, max_queue_size=n_req + 1)
+            srv_plain.start()
+            t0 = time.perf_counter()
+            hs = [srv_plain.submit(p, max_new_tokens=new_tokens)
+                  for p in prompts]
+            pouts = [h.result(timeout=1800) for h in hs]
+            plain_wall = time.perf_counter() - t0
+            srv_plain.stop()
+            plain = {
+                "tokens_per_sec": round(
+                    sum(len(o.token_ids) for o in pouts) / plain_wall, 1),
+                "horizon": plain_horizon, "batch": B}
         # r05 sync-loop baselines (BENCH_r05.json): serve 1,158.9 tok/s,
         # spec 46.8 — comparable ONLY at the exact captured config (on-chip
         # defaults, bf16); any overridden knob makes the ratio meaningless,
@@ -695,6 +720,10 @@ def _bench_other(model_name):
             out["draft_tokens_accepted"] = stats_off["draft_tokens_accepted"]
             out["accepted_per_step"] = round(
                 stats_off["draft_tokens_accepted"] / max(steps, 1), 2)
+            # the plain batch-1 line the +42% claim is measured AGAINST
+            out["spec_off"] = plain
+            out["speculation_speedup"] = round(
+                (toks / wall) / max(plain["tokens_per_sec"], 1e-9), 3)
         return out
 
     if model_name == "llama_serve_fused":
@@ -918,6 +947,161 @@ def _bench_other(model_name):
                 "requests": n_req, "slots": B, "new_tokens": new_tokens,
                 "sys_prompt_len": sys_len, "chunk": chunk,
                 "block_size": block, "horizon": horizon,
+                "telemetry_artifact": art_path}
+
+    if model_name == "llama_serve_cluster":
+        # Multichip serving A/B (paddle_tpu/serving/cluster.py): ONE
+        # replica vs BENCH_REPLICAS replicas fronted by the prefix-
+        # affinity ReplicaRouter, on a multi-tenant shared-system-prompt
+        # workload (BENCH_TENANTS distinct system prompts, one per
+        # routing_key). A third arm re-serves the cluster under RANDOM
+        # routing — the affinity win (hit-rate + tok/s) is measured
+        # against its own control, not inferred. BENCH_TP > 1
+        # additionally shards each replica's engine over its own
+        # ("tp",)-mesh device group (kv-head-sharded pools; needs
+        # BENCH_REPLICAS * BENCH_TP local devices).
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import LLMEngine
+        from paddle_tpu.serving import AsyncLLMServer, ReplicaRouter
+        from paddle_tpu.serving.cluster import tp_engine
+        R = int(os.environ.get("BENCH_REPLICAS", "2"))
+        tp = int(os.environ.get("BENCH_TP", "1"))
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+        n_req = int(os.environ.get("BENCH_REQUESTS", str(2 * B * R)))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        chunk = int(os.environ.get("BENCH_CHUNK", "256"))
+        block = int(os.environ.get("BENCH_BLOCK", "64"))
+        horizon = int(os.environ.get("BENCH_HORIZON", "64"))
+        sys_len = int(os.environ.get("BENCH_SYS_PROMPT", "256"))
+        tail_len = int(os.environ.get("BENCH_TAIL", "128"))
+        n_tenants = int(os.environ.get("BENCH_TENANTS", str(max(R, 2))))
+        n_req = max(n_req, 2 * n_tenants)   # a timed wave must exist
+        cap = -(-(sys_len + tail_len + new_tokens) // chunk) * chunk
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=heads,
+                          max_position_embeddings=cap)
+        V = cfg.vocab_size
+        sys_prompts = [rng.integers(0, V, (sys_len,)).astype(np.int32)
+                       for _ in range(n_tenants)]
+        tails = [rng.integers(0, V, (tail_len // 2 + int(x),)).astype(
+            np.int32) for x in rng.integers(0, tail_len // 2, size=n_req)]
+        prompts = [np.concatenate([sys_prompts[i % n_tenants], t])
+                   for i, t in enumerate(tails)]
+
+        def build_model():
+            # each replica materializes its own weight copy (same seed,
+            # identical values) — under BENCH_TP each copy lays out on
+            # its OWN replica mesh, which a shared model couldn't
+            paddle.seed(0)
+            m = LlamaForCausalLM(cfg).bfloat16()
+            m.eval()
+            return m
+
+        def make_replica(i):
+            kw = dict(max_batch=B, max_seq_len=cap, chunk_size=chunk,
+                      horizon=horizon, cache_impl="paged",
+                      block_size=block, scheduler="fused",
+                      enable_prefix_cache=True)
+            model = build_model()
+            if tp > 1:
+                devs = jax.devices()[i * tp:(i + 1) * tp]
+                eng = tp_engine(model, tp=tp, devices=devs, **kw)
+            else:
+                eng = LLMEngine(model, **kw)
+            warm = rng.integers(0, V, (3,)).astype(np.int32)
+            eng.generate([warm], max_new_tokens=2)
+            eng.reset_stats()
+            return AsyncLLMServer(eng, max_queue_size=n_req + 1, replica=i)
+
+        def run_cluster(n_replicas, policy):
+            replicas = [make_replica(i) for i in range(n_replicas)]
+            router = ReplicaRouter(replicas, policy=policy)
+            router.start()
+            # SEED wave: one request per tenant primes the prefix caches
+            # (and, under the affinity policy, spreads the tenants across
+            # replicas — the router's outstanding-count load term places
+            # simultaneous cold tenants on different replicas). The timed
+            # MAIN wave below is the steady state the hit-rate and tok/s
+            # numbers describe.
+            seed_hs = [router.submit(prompts[i], max_new_tokens=new_tokens,
+                                     routing_key=f"tenant{i % n_tenants}")
+                       for i in range(n_tenants)]
+            seed_outs = [h.result(timeout=1800) for h in seed_hs]
+            for srv in replicas:
+                srv.engine.reset_stats()
+            t0 = time.perf_counter()
+            hs = [router.submit(p, max_new_tokens=new_tokens,
+                                routing_key=f"tenant{i % n_tenants}")
+                  for i, p in enumerate(prompts[n_tenants:],
+                                        start=n_tenants)]
+            outs = [h.result(timeout=1800) for h in hs]
+            wall = time.perf_counter() - t0
+            router.stop()
+            toks = sum(len(o.token_ids) for o in outs)
+            per, hit_tok, pre_tok = [], 0, 0
+            for i, srv in enumerate(replicas):
+                st = srv.engine.stats
+                per.append({
+                    "replica": i, "tokens": st["tokens_generated"],
+                    "tokens_per_sec": round(
+                        st["tokens_generated"] / wall, 1),
+                    "prefix_hit_tokens": st["prefix_hit_tokens"],
+                    "placements": router.stats["placements"][i]})
+                hit_tok += st["prefix_hit_tokens"]
+                pre_tok += st["prefill_tokens"]
+            return {
+                "aggregate_tokens_per_sec": round(toks / wall, 1),
+                "per_replica": per,
+                "affinity_hit_rate": round(
+                    hit_tok / (hit_tok + pre_tok), 4)
+                if hit_tok + pre_tok else 0.0,
+                "affinity_routed": router.stats["affinity_routed"],
+                "resubmitted": router.stats["resubmitted"],
+                "wall_s": round(wall, 3),
+            }, [list(o.token_ids) for o in seed_outs + outs]
+
+        single, toks_single = run_cluster(1, "affinity")
+        cluster, toks_cluster = run_cluster(R, "affinity")
+        random_arm, _ = run_cluster(R, "random")
+        art_path = os.path.join(_artifact_dir(),
+                                "llama_serve_cluster.json")
+        with open(art_path, "w") as f:
+            json.dump({"single": single, "cluster": cluster,
+                       "cluster_random": random_arm}, f, indent=1)
+        # r05's single-chip sync-loop serve line (1,158.9 tok/s): the
+        # cluster aggregate is comparable only at the captured config on
+        # chip — and is an R-replica number, so the ratio is the
+        # capacity-scaling claim, not a same-hardware speedup
+        at_r05_config = (
+            B == 8 and new_tokens == 64 and n_layers == 3
+            and hidden == 4096 and ff == hidden * 11 // 4
+            and horizon == 64 and chunk == 256 and tp == 1
+            and jax.default_backend() != "cpu")
+        return {"metric": "llama_serve_cluster_tokens_per_sec",
+                "value": cluster["aggregate_tokens_per_sec"],
+                "unit": "tokens/s",
+                "vs_baseline": (round(
+                    cluster["aggregate_tokens_per_sec"] / 1158.9, 4)
+                    if at_r05_config else None),
+                "replicas": R, "tp": tp, "slots_per_replica": B,
+                "single": single, "cluster": cluster,
+                "cluster_random": random_arm,
+                "cluster_speedup_vs_single": round(
+                    cluster["aggregate_tokens_per_sec"]
+                    / max(single["aggregate_tokens_per_sec"], 1e-9), 3),
+                "affinity_hit_rate": cluster["affinity_hit_rate"],
+                "random_hit_rate": random_arm["affinity_hit_rate"],
+                # greedy serving: scaling out must not change one token
+                "token_parity": toks_single == toks_cluster,
+                "requests": n_req, "new_tokens": new_tokens,
+                "tenants": n_tenants, "sys_prompt_len": sys_len,
+                "chunk": chunk, "block_size": block, "horizon": horizon,
                 "telemetry_artifact": art_path}
 
     if model_name == "conv_roofline":
@@ -1372,7 +1556,8 @@ def _run_all():
     import sys
     for name in ["resnet50", "bert", "vit", "unet", "llama_decode",
                  "llama_paged_decode", "llama_serve", "llama_serve_fused",
-                 "llama_serve_prefix_cache", "llama_serve_spec", "llama"]:
+                 "llama_serve_prefix_cache", "llama_serve_cluster",
+                 "llama_serve_spec", "llama"]:
         env = dict(os.environ, BENCH_MODEL=name)
         try:
             proc = subprocess.run(

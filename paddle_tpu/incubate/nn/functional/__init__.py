@@ -595,7 +595,8 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
 
     def fn(qkv_v, kc, vc, lens, tables):
         from ....ops.kernels.paged_attention import (
-            paged_attention_decode, paged_attention_enabled)
+            current_paged_tp, paged_attention_decode,
+            paged_attention_decode_tp, paged_attention_enabled)
 
         nb, Hkv, bs, D = kc.shape
         b = qkv_v.shape[0]
@@ -608,8 +609,17 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         tables = tables.astype(jnp.int32)
 
         if paged_attention_enabled():
-            out, kc, vc = paged_attention_decode(
-                q, kc, vc, tables, lens, new_k=knew, new_v=vnew)
+            tp = current_paged_tp()
+            if tp is not None:
+                # TP serving engine: a pallas_call cannot be GSPMD-
+                # partitioned, so the kernel shard_maps over the tp axis
+                # (kv-head shards; tables/lens replicated)
+                out, kc, vc = paged_attention_decode_tp(
+                    q, kc, vc, tables, lens, mesh=tp[0], axis=tp[1],
+                    new_k=knew, new_v=vnew)
+            else:
+                out, kc, vc = paged_attention_decode(
+                    q, kc, vc, tables, lens, new_k=knew, new_v=vnew)
             return out.reshape(b, Hq * D), kc, vc
 
         # write the new token at position lens[i] of sequence i. A -1 table
@@ -656,7 +666,8 @@ def _block_mha_append(qkv, key_cache, value_cache, seq_lens, q_lens,
     reference semantics the decode form uses, extended along S."""
     def fn(qkv_v, kc, vc, lens, qlens, tables):
         from ....ops.kernels.paged_attention import (
-            paged_attention_append, paged_attention_enabled)
+            current_paged_tp, paged_attention_append,
+            paged_attention_append_tp, paged_attention_enabled)
 
         nb, Hkv, bs, D = kc.shape
         b, S = qkv_v.shape[0], qkv_v.shape[1]
@@ -670,8 +681,14 @@ def _block_mha_append(qkv, key_cache, value_cache, seq_lens, q_lens,
         tables = tables.astype(jnp.int32)
 
         if paged_attention_enabled():
-            out, kc, vc = paged_attention_append(
-                q, kc, vc, tables, lens, qlens, knew, vnew)
+            tp = current_paged_tp()
+            if tp is not None:
+                out, kc, vc = paged_attention_append_tp(
+                    q, kc, vc, tables, lens, qlens, knew, vnew,
+                    mesh=tp[0], axis=tp[1])
+            else:
+                out, kc, vc = paged_attention_append(
+                    q, kc, vc, tables, lens, qlens, knew, vnew)
             return out.reshape(b, S, Hq * D), kc, vc
 
         # scatter valid rows: row i of sequence b lands at absolute

@@ -35,7 +35,9 @@ import dataclasses
 import hashlib
 import heapq
 import os
+import threading
 import time
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +52,25 @@ __all__ = ["LLMEngine", "GenerationRequest", "RequestOutput", "PendingStep",
 #: chain-hash seed for block 0 of every sequence (the "parent" of the
 #: first block) — a fixed constant so equal first blocks collide
 _ROOT_HASH = b"paddle-tpu-prefix-root"
+
+#: one RLock per MODEL object, shared by every engine built on it. The
+#: compiled programs trace through ``bind_state``, which temporarily
+#: swaps the model tensors' ``_value`` to tracers — so two engines on
+#: the SAME model tracing from different threads (N replica servers of a
+#: ReplicaRouter sharing weights) would leak each other's tracers.
+#: step_begin (the only trace-capable engine entry point) serializes on
+#: this lock; once every program is compiled the lock guards only the
+#: sub-ms host-side dispatch, which the GIL serializes anyway.
+_MODEL_DISPATCH_LOCKS = weakref.WeakKeyDictionary()
+_LOCKS_GUARD = threading.Lock()
+
+
+def _model_dispatch_lock(model):
+    with _LOCKS_GUARD:
+        lock = _MODEL_DISPATCH_LOCKS.get(model)
+        if lock is None:
+            lock = _MODEL_DISPATCH_LOCKS[model] = threading.RLock()
+        return lock
 
 
 class PoolCapacityError(RuntimeError):
@@ -211,6 +232,10 @@ class LLMEngine:
         from ..jit.functional_call import collect_state, read_values
 
         self.model = model
+        #: serializes trace-capable dispatches across ALL engines built
+        #: on this model object (replica servers sharing weights) — see
+        #: _model_dispatch_lock
+        self._dispatch_lock = _model_dispatch_lock(model)
         c = model.config
         self.B = int(max_batch)
         # decode horizon: tokens decoded per step() call as one compiled
@@ -268,16 +293,42 @@ class LLMEngine:
             raise ValueError(f"max_step_tokens must be >= 1, got "
                              f"{self.max_step_tokens}")
         self._mesh = mesh
+        #: tensor-parallel serving (the multichip subsystem, serving/
+        #: cluster.py): a mesh with a "tp" axis turns the engine's KV
+        #: buffers into REAL NamedShardings — kv-heads shard across the
+        #: axis (the paged pool's head dim / the dense buffers' head
+        #: dim), block tables and the allocator stay host-global, and
+        #: logits/lens/tokens stay replicated (the step's in-graph
+        #: sample consumes replicated logits, so the vocab-sharded lm
+        #: head all-gathers exactly once per step). Any other mesh keeps
+        #: the legacy multi-process behavior: replicated global buffers.
+        self._tp_axis = None
+        self._tp_size = 1
+        if mesh is not None and "tp" in tuple(mesh.axis_names) \
+                and int(mesh.shape["tp"]) > 1:
+            self._tp_axis = "tp"
+            self._tp_size = int(mesh.shape["tp"])
+            if kvh % self._tp_size:
+                raise ValueError(
+                    f"num_key_value_heads {kvh} must divide by the tp "
+                    f"mesh axis ({self._tp_size}) — kv-heads are the "
+                    f"natural shard dim of the KV pools")
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            def _zeros(shape, dtype):
-                sharding = NamedSharding(mesh, PartitionSpec())
+            def _zeros(shape, dtype, spec=PartitionSpec()):
+                sharding = NamedSharding(mesh, spec)
                 shard = np.zeros(sharding.shard_shape(tuple(shape)), dtype)
                 return jax.make_array_from_callback(
                     shape, sharding, lambda idx: shard)
+
+            _kv_pool_spec = PartitionSpec(None, self._tp_axis)
+            _kv_dense_spec = PartitionSpec(None, None, self._tp_axis)
         else:
-            _zeros = jnp.zeros
+            def _zeros(shape, dtype, spec=None):
+                return jnp.zeros(shape, dtype)
+
+            _kv_pool_spec = _kv_dense_spec = None
         import ml_dtypes  # noqa: F401  (np.zeros understands bf16 via jnp)
         np_dt = np.dtype(dt) if mesh is not None else dt
         self.cache_impl = cache_impl
@@ -311,8 +362,10 @@ class LLMEngine:
             # out-of-range scatter; a kernel block write needs a real
             # destination)
             pool_shape = (self.n_blocks + 1, kvh, self.block_size, head_dim)
-            self._k = [_zeros(pool_shape, np_dt) for _ in range(L)]
-            self._v = [_zeros(pool_shape, np_dt) for _ in range(L)]
+            self._k = [_zeros(pool_shape, np_dt, _kv_pool_spec)
+                       for _ in range(L)]
+            self._v = [_zeros(pool_shape, np_dt, _kv_pool_spec)
+                       for _ in range(L)]
             self._tables = np.full((self.B, self._max_blocks), -1, np.int32)
             #: min-heap of free physical blocks: allocation always pops
             #: the SMALLEST free index, so physical layout is a pure
@@ -348,8 +401,10 @@ class LLMEngine:
                 "PADDLE_TPU_POOL_CHECKS", "0") not in ("", "0")
         else:
             shape = (self.B, self.capacity, kvh, head_dim)
-            self._k = [_zeros(shape, np_dt) for _ in range(L)]
-            self._v = [_zeros(shape, np_dt) for _ in range(L)]
+            self._k = [_zeros(shape, np_dt, _kv_dense_spec)
+                       for _ in range(L)]
+            self._v = [_zeros(shape, np_dt, _kv_dense_spec)
+                       for _ in range(L)]
         # admission-order stamps: the paged allocator's preempt-newest
         # invariant AND the fused scheduler's oldest-first budget walk
         self._admit_order = [0] * self.B
@@ -408,6 +463,33 @@ class LLMEngine:
         state = self._state
         B, cap, chunk = self.B, self.capacity, self.chunk
         top_k = self.top_k
+
+        if self._tp_axis is not None:
+            # TP sharding pins: KV buffer outputs keep the kv-head shard
+            # (so donation round-trips in place and GSPMD never resolves
+            # a step to a resharded layout), everything the HOST reads
+            # (tokens, carried logits, lens) pins replicated — the
+            # vocab-sharded lm head all-gathers into the logits exactly
+            # once per step, and np.asarray readouts see full replicas.
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+            _kv_sh = NamedSharding(
+                self._mesh,
+                _P(None, self._tp_axis) if self.cache_impl == "paged"
+                else _P(None, None, self._tp_axis))
+            _rep_sh = NamedSharding(self._mesh, _P())
+
+            def _pin_kv(bufs):
+                return [jax.lax.with_sharding_constraint(b, _kv_sh)
+                        for b in bufs]
+
+            def _pin_rep(x):
+                return jax.lax.with_sharding_constraint(x, _rep_sh)
+        else:
+            def _pin_kv(bufs):
+                return bufs
+
+            def _pin_rep(x):
+                return x
 
         K = self.horizon
 
@@ -484,7 +566,8 @@ class LLMEngine:
                     body,
                     (k_bufs, v_bufs, logits, lens, active, emitted0, rng),
                     None, length=K)
-            return toks, was_active, logits, k_bufs, v_bufs, lens, rng
+            return (_pin_rep(toks), _pin_rep(was_active), _pin_rep(logits),
+                    _pin_kv(k_bufs), _pin_kv(v_bufs), _pin_rep(lens), rng)
 
         Kspec = self.speculative_k
         ngram = self.lookup_ngram
@@ -541,8 +624,9 @@ class LLMEngine:
                     (k_bufs, v_bufs, logits, lens, active, emitted0, rng,
                      tokens_buf),
                     None, length=K)
-            return (toks, counts, was_active, logits, k_bufs, v_bufs, lens,
-                    rng, tokens_buf)
+            return (_pin_rep(toks), _pin_rep(counts), _pin_rep(was_active),
+                    _pin_rep(logits), _pin_kv(k_bufs), _pin_kv(v_bufs),
+                    _pin_rep(lens), rng, tokens_buf)
 
         def fused_step(state_vals, k_bufs, v_bufs, logits, lens, rng, ids,
                        q_lens, is_decode, active, temps, top_ps,
@@ -594,8 +678,9 @@ class LLMEngine:
             new_lens = lens + q_eff
             # [1, B] token/activity rows: the readout walk in step_finish
             # is shared with the scan-based steps (K == 1 here)
-            return (nxt[None], dec[None], new_logits, kb, vb, new_lens,
-                    rng)
+            return (_pin_rep(nxt[None]), _pin_rep(dec[None]),
+                    _pin_rep(new_logits), _pin_kv(kb), _pin_kv(vb),
+                    _pin_rep(new_lens), rng)
 
         def prefill_chunk(state_vals, k_bufs, v_bufs, ids, slot, off, last):
             """Run chunk `ids` [1, chunk] of one prompt through the model
@@ -626,7 +711,7 @@ class LLMEngine:
                 vb, (cc.v._value if isinstance(cc.v, Tensor) else cc.v
                      ).astype(vb.dtype), (slot, z, z, z))
                 for vb, cc in zip(v_bufs, new_caches)]
-            return k_out, v_out, logits_row
+            return _pin_kv(k_out), _pin_kv(v_out), _pin_rep(logits_row)
 
         def set_logits(logits, row, slot):
             return jax.lax.dynamic_update_slice(
@@ -686,7 +771,7 @@ class LLMEngine:
                 v_out = [scatter(p, (cc.v._value if isinstance(cc.v, Tensor)
                                      else cc.v))
                          for p, cc in zip(v_pools, new_caches)]
-                return k_out, v_out, logits_row
+                return _pin_kv(k_out), _pin_kv(v_out), _pin_rep(logits_row)
 
             self._prefill_paged_fn = jax.jit(prefill_chunk_paged,
                                              donate_argnums=(1, 2))
@@ -694,9 +779,11 @@ class LLMEngine:
             def cow_copy(k_pools, v_pools, src, dst):
                 """Copy-on-write block duplication: clone physical block
                 ``src`` into ``dst`` across every layer's K/V pool. One
-                jitted program, src/dst traced — no recompile per copy."""
-                return ([p.at[dst].set(p[src]) for p in k_pools],
-                        [p.at[dst].set(p[src]) for p in v_pools])
+                jitted program, src/dst traced — no recompile per copy.
+                Block-index ops only, so under TP each shard clones its
+                own kv-head slice — no cross-shard traffic."""
+                return (_pin_kv([p.at[dst].set(p[src]) for p in k_pools]),
+                        _pin_kv([p.at[dst].set(p[src]) for p in v_pools]))
 
             self._cow_fn = jax.jit(cow_copy, donate_argnums=(0, 1))
 
@@ -938,6 +1025,45 @@ class LLMEngine:
         self._check_pool_invariants()
         return hit, chain
 
+    def prefix_chain_hashes(self, token_ids):
+        """Per-full-block rolling chain hashes of ``token_ids`` — the
+        router's affinity precompute. Content-only (no engine state
+        read), so one computation serves every replica with the same
+        ``block_size``. Empty when the prefix cache is off."""
+        if self.cache_impl != "paged" or not self.prefix_cache:
+            return []
+        ids = np.asarray(token_ids, np.int32).reshape(-1)
+        bs = self.block_size
+        parent, out = _ROOT_HASH, []
+        for k in range(min((len(ids) - 1) // bs, self._max_blocks)):
+            parent = self._chain_hash(parent, ids[k * bs:(k + 1) * bs])
+            out.append(parent)
+        return out
+
+    def probe_prefix_len(self, token_ids, chain_hashes=None):
+        """READ-ONLY affinity probe: how many leading tokens of
+        ``token_ids`` the content store could serve right now (full
+        cached blocks only — no COW extension, no refcount bumps, no
+        table writes). The replica router calls this from ITS thread to
+        score placements; the walk is dict membership tests only, which
+        the GIL makes atomic per op — a store mutating concurrently can
+        make the answer stale, never wrong-shaped, and the real attach
+        re-probes under the engine thread. Hashing is TP-oblivious: the
+        store keys on token content, not on shard layout. Pass
+        ``chain_hashes`` (from :meth:`prefix_chain_hashes`) to skip
+        re-hashing the prompt per probe. Returns 0 when the prefix
+        cache is off."""
+        if self.cache_impl != "paged" or not self.prefix_cache:
+            return 0
+        if chain_hashes is None:
+            chain_hashes = self.prefix_chain_hashes(token_ids)
+        hit = 0
+        for h in chain_hashes[:self._max_blocks]:
+            if h not in self._store:
+                break
+            hit += self.block_size
+        return hit
+
     def _cow_tail(self, slot_idx, token_ids, hit, chain):
         """Token-granular hit extension (copy-on-write): if a cached full
         block CONTINUES the hit chain and its leading tokens match the
@@ -1032,6 +1158,26 @@ class LLMEngine:
         pad_end = min(-(-prompt_len // self.chunk) * self.chunk,
                       self.capacity)
         return -(-pad_end // self.block_size)
+
+    def _kernel_tp_ctx(self):
+        """Trace-time TP routing for the Pallas paged kernels: while
+        active, ``block_multihead_attention``'s TPU fast path shard_maps
+        the decode/append kernels over the tp axis (each shard reads its
+        own kv-head slice of the pools; block tables and seq_lens ride
+        in replicated). Only trace time matters — the wrapped dispatches
+        are already-compiled calls afterwards — and the context is inert
+        without a tp mesh (or on CPU, where the dense fallback under
+        GSPMD partitions itself)."""
+        import contextlib
+        if self._tp_axis is None or self.cache_impl != "paged":
+            return contextlib.nullcontext()
+        from ..ops.kernels.paged_attention import paged_tp_context
+        return paged_tp_context(self._mesh, self._tp_axis)
+
+    def tp_degree(self):
+        """Size of the engine's tensor-parallel mesh axis (1 = single
+        chip)."""
+        return self._tp_size
 
     def max_pipeline_depth(self):
         """How many step_begin() dispatches may be in flight at once.
@@ -1372,7 +1518,10 @@ class LLMEngine:
         """Admit waiting requests into free slots and DISPATCH one decode
         step for all active slots WITHOUT reading anything back. Returns a
         :class:`PendingStep` for :meth:`step_finish`, or None when there is
-        nothing to run.
+        nothing to run. Serialized per MODEL object (admission prefill,
+        COW clones and the step dispatch all may TRACE through the shared
+        model's bind_state — concurrent replica engines on one model must
+        not interleave traces).
 
         Pipelining contract (dense and speculative engines): a second
         ``step_begin()`` may be called before the first ``step_finish()``
@@ -1387,6 +1536,10 @@ class LLMEngine:
         PAGED engine allocates pool blocks from host lens before each
         dispatch, so it must run depth 1 (finish before the next begin —
         enforced)."""
+        with self._dispatch_lock:
+            return self._step_begin_impl()
+
+    def _step_begin_impl(self):
         from ..core import random as _random
 
         if self.cache_impl == "paged" and \
@@ -1527,11 +1680,12 @@ class LLMEngine:
         t0 = time.perf_counter()
         counts = None
         if self.cache_impl == "paged":
-            (toks, was_active, self._logits, self._k, self._v, self._lens,
-             self._rng_key) = self._step_paged_fn(
-                self._state_vals, self._k, self._v, self._logits,
-                self._lens, active, self._rng_key, temps, top_ps, eos_ids,
-                budgets, self._tables.copy())
+            with self._kernel_tp_ctx():
+                (toks, was_active, self._logits, self._k, self._v,
+                 self._lens, self._rng_key) = self._step_paged_fn(
+                    self._state_vals, self._k, self._v, self._logits,
+                    self._lens, active, self._rng_key, temps, top_ps,
+                    eos_ids, budgets, self._tables.copy())
         elif spec:
             (toks, counts, was_active, self._logits, self._k, self._v,
              self._lens, self._rng_key, self._tokens) = self._spec_fn(
@@ -1695,11 +1849,12 @@ class LLMEngine:
 
         t0 = time.perf_counter()
         if self.cache_impl == "paged":
-            (toks, was_active, self._logits, self._k, self._v, self._lens,
-             self._rng_key) = self._fused_fn(
-                self._state_vals, self._k, self._v, self._logits,
-                self._lens, self._rng_key, ids, q_lens, is_dec, active,
-                temps, top_ps, self._tables.copy())
+            with self._kernel_tp_ctx():
+                (toks, was_active, self._logits, self._k, self._v,
+                 self._lens, self._rng_key) = self._fused_fn(
+                    self._state_vals, self._k, self._v, self._logits,
+                    self._lens, self._rng_key, ids, q_lens, is_dec,
+                    active, temps, top_ps, self._tables.copy())
         else:
             (toks, was_active, self._logits, self._k, self._v, self._lens,
              self._rng_key) = self._fused_fn(

@@ -59,8 +59,10 @@ behind ``FLAGS_use_paged_attention``, dense append fallback on CPU.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
+import threading
 
 import numpy as np
 import jax
@@ -88,6 +90,113 @@ def paged_attention_enabled():
     suite calling :func:`paged_attention_decode` directly."""
     from ...core.flags import flag_value
     return bool(flag_value("use_paged_attention")) and not _interpret()
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel routing (the multichip serving subsystem)
+# ---------------------------------------------------------------------------
+
+#: trace-time TP context: (mesh, axis) while an LLMEngine with a tp mesh is
+#: tracing its paged step programs, else None. A pallas_call cannot be
+#: auto-partitioned by GSPMD, so the sharded engine must route through the
+#: explicit shard_map wrappers below — the engine arms this context around
+#: its (trace-triggering) paged dispatches and block_multihead_attention's
+#: kernel branch consults it. THREAD-LOCAL: N replica servers (one engine
+#: thread each, possibly different meshes/models) may trace concurrently,
+#: and replica A's trace must never read replica B's mesh.
+_TP_CTX = threading.local()
+
+
+@contextlib.contextmanager
+def paged_tp_context(mesh, axis="tp"):
+    """Arm the kernel TP routing for the duration of a (possibly
+    trace-triggering) dispatch. Trace-time state, not run-time: once the
+    program is compiled the context is a no-op thread-local set/reset."""
+    prev = getattr(_TP_CTX, "value", None)
+    _TP_CTX.value = (mesh, axis)
+    try:
+        yield
+    finally:
+        _TP_CTX.value = prev
+
+
+def current_paged_tp():
+    """The armed (mesh, axis) TP context of THIS thread, or None."""
+    return getattr(_TP_CTX, "value", None)
+
+
+def _tp_shard_map(fn, mesh, axis, in_specs, out_specs):
+    from ...core.jax_compat import shard_map
+    if isinstance(in_specs, list):
+        in_specs = tuple(in_specs)
+    if isinstance(out_specs, list):
+        out_specs = tuple(out_specs)
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)
+
+
+def paged_attention_decode_tp(q, k_pool, v_pool, block_tables, seq_lens,
+                              mesh, axis="tp", scale=None, new_k=None,
+                              new_v=None):
+    """:func:`paged_attention_decode` sharded over a tensor-parallel mesh
+    axis: kv-heads (pool dim 1) split across ``axis`` and each shard runs
+    the unmodified kernel on its local head group — the grid's
+    (batch, kv_head, max_blocks) shape makes kv-heads the natural shard
+    dim, so per-shard programs are byte-identical to the single-chip
+    kernel at Hkv/ntp heads. Block tables and seq_lens ride in REPLICATED
+    (the allocator is host-global); q's head dim shards alongside
+    (kv-head-major GQA layout: q heads [h*G, (h+1)*G) follow kv head h,
+    so an even kv-head split carries its q groups with it). No collective
+    is issued — attention output heads stay sharded and the caller's
+    o_proj (row-parallel) reduces them."""
+    from jax.sharding import PartitionSpec as P
+
+    write_new = new_k is not None
+    q_spec = P(None, axis, None)
+    pool_spec = P(None, axis, None, None)
+    in_specs = [q_spec, pool_spec, pool_spec, P(), P()]
+    out_specs = [q_spec, pool_spec, pool_spec] if write_new else q_spec
+    if write_new:
+        in_specs += [P(None, axis, None), P(None, axis, None)]
+
+        def body(q_s, k_s, v_s, tables, lens, nk_s, nv_s):
+            return paged_attention_decode(q_s, k_s, v_s, tables, lens,
+                                          scale=scale, new_k=nk_s,
+                                          new_v=nv_s)
+
+        return _tp_shard_map(body, mesh, axis, in_specs, out_specs)(
+            q, k_pool, v_pool, block_tables, seq_lens, new_k, new_v)
+
+    def body(q_s, k_s, v_s, tables, lens):
+        return paged_attention_decode(q_s, k_s, v_s, tables, lens,
+                                      scale=scale)
+
+    return _tp_shard_map(body, mesh, axis, in_specs, out_specs)(
+        q, k_pool, v_pool, block_tables, seq_lens)
+
+
+def paged_attention_append_tp(q, k_pool, v_pool, block_tables, seq_lens,
+                              q_lens, new_k, new_v, mesh, axis="tp",
+                              scale=None):
+    """:func:`paged_attention_append` sharded over a tensor-parallel mesh
+    axis — the mixed prefill+decode step's kernel under the TP serving
+    engine. Same layout contract as the decode wrapper: pools/new-KV/q
+    shard on their head dims, tables/seq_lens/q_lens replicated, output
+    heads stay sharded for the row-parallel o_proj to reduce."""
+    from jax.sharding import PartitionSpec as P
+
+    pool_spec = P(None, axis, None, None)
+    q_spec = P(None, None, axis, None)          # [B, S, Hq, D]
+    in_specs = [q_spec, pool_spec, pool_spec, P(), P(), P(),
+                q_spec, q_spec]                 # new_k/new_v [B, S, Hkv, D]
+    out_specs = [q_spec, pool_spec, pool_spec]
+
+    def body(q_s, k_s, v_s, tables, lens, qlens, nk_s, nv_s):
+        return paged_attention_append(q_s, k_s, v_s, tables, lens, qlens,
+                                      nk_s, nv_s, scale=scale)
+
+    return _tp_shard_map(body, mesh, axis, in_specs, out_specs)(
+        q, k_pool, v_pool, block_tables, seq_lens, q_lens, new_k, new_v)
 
 
 def _last_live(lens_ref, b, bs, mb):

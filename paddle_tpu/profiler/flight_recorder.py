@@ -122,7 +122,8 @@ _EVENT_FIELDS = ("kind", "t", "step_id", "value")
 
 
 class _RequestTrace:
-    __slots__ = ("request_id", "events", "last_token_t", "prefix_hit")
+    __slots__ = ("request_id", "events", "last_token_t", "prefix_hit",
+                 "routing")
 
     def __init__(self, request_id):
         self.request_id = request_id
@@ -132,6 +133,9 @@ class _RequestTrace:
         #: prefix cache (None until a "cached_prefix" event lands) — what
         #: explain_tail joins prefill-grant interference back to
         self.prefix_hit = None
+        #: the placement metadata a "routed" event carried (the replica
+        #: router's decision) — explain_tail surfaces it on tail entries
+        self.routing = None
 
     def to_dict(self):
         return {"request_id": self.request_id,
@@ -149,12 +153,19 @@ class FlightRecorder:
     path. ``enabled=False`` (or detaching the recorder) short-circuits
     every hook to a single attribute check."""
 
-    def __init__(self, capacity=4096, max_requests=2048, enabled=True):
+    def __init__(self, capacity=4096, max_requests=2048, enabled=True,
+                 replica=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self.max_requests = int(max_requests)
         self.enabled = bool(enabled)
+        #: replica/rank index in a multi-replica cluster: chrome-trace
+        #: exports use it as the process id + process_name, so per-
+        #: replica traces land in distinct lane groups and merge cleanly
+        #: (merge_profile re-pids per file; the name survives). None =
+        #: single-engine (os.getpid() lanes, unchanged).
+        self.replica = replica
         self._ring: list[StepRecord | None] = [None] * self.capacity
         self._seq = 0                      # next step id
         self._lock = threading.Lock()
@@ -257,6 +268,8 @@ class FlightRecorder:
             tr.events.append((kind, t, step_id, value))
             if kind == "cached_prefix":
                 tr.prefix_hit = value
+            if kind == "routed":
+                tr.routing = value
             if kind == "finish":
                 self._live.pop(rid, None)
                 self._done[rid] = tr
@@ -303,8 +316,14 @@ class FlightRecorder:
         Timestamps are perf_counter µs — the same clock and schema as
         ``Profiler._export_chrome``, so ``merge_profile`` can merge these
         with host profiles and across ranks."""
-        pid = os.getpid()
+        pid = os.getpid() if self.replica is None else int(self.replica)
         events = []
+        if self.replica is not None:
+            # one lane GROUP per replica: the pid separates the groups
+            # and the process_name labels them (merge_profile keeps the
+            # label when it re-pids per merged file)
+            events.append({"ph": "M", "pid": pid, "name": "process_name",
+                           "args": {"name": f"replica {self.replica}"}})
         # PIPELINED steps overlap in time (step N+1 dispatches before
         # step N's sync), and same-tid 'X' events must nest properly —
         # pack overlapping step spans onto greedy sub-lanes (depth 2
@@ -351,6 +370,8 @@ class FlightRecorder:
                     args["step_id"] = ev["step_id"]
                 if ev["kind"] == "token" and ev["value"] is not None:
                     args["gap_ms"] = round(ev["value"] * 1e3, 3)
+                if ev["kind"] == "routed" and isinstance(ev["value"], dict):
+                    args["routing"] = ev["value"]
                 events.append({
                     "ph": "X", "cat": "request", "pid": pid, "tid": tid,
                     "name": name, "ts": start,
@@ -403,6 +424,13 @@ class FlightRecorder:
             entry = {"request_id": rid, "gap_s": round(gap, 6),
                      "step_id": sid, "cause": cause,
                      "step": rec.to_dict() if rec is not None else None}
+            with self._lock:
+                tr = self._live.get(rid) or self._done.get(rid)
+                routing = tr.routing if tr is not None else None
+            if routing is not None:
+                # the router's placement record for THIS request — which
+                # replica/score/affinity put the slow token where it ran
+                entry["routing"] = routing
             if rec is not None and rec.prefix_hit_tokens is not None \
                     and cause == "interfering_prefill":
                 # prefix cache was on and this gap came from prefill

@@ -120,25 +120,35 @@ class LatencyHistogram:
                 "p99_s": round(self.quantile(0.99), 6)}
 
     def prometheus_lines(self, name, labels=""):
-        """Cumulative-bucket exposition lines (histogram type)."""
+        """Cumulative-bucket exposition lines (histogram type).
+        ``labels``: extra label body WITHOUT braces or leading comma
+        (e.g. ``replica="0"``) — composed correctly into both the
+        ``le``-labeled bucket lines and the bare sum/count lines."""
+        sep = ("," + labels) if labels else ""
+        bare = ("{" + labels + "}") if labels else ""
         lines = [f"# TYPE {name} histogram"]
         acc = 0
         for bound, c in zip(self.bounds, self.counts):
             acc += c
-            lines.append(f'{name}_bucket{{le="{bound:g}"{labels}}} {acc}')
-        lines.append(f'{name}_bucket{{le="+Inf"{labels}}} {self.count}')
-        lines.append(f"{name}_sum{labels and '{' + labels + '}'} "
-                     f"{self.total:g}")
-        lines.append(f"{name}_count{labels and '{' + labels + '}'} "
-                     f"{self.count}")
+            lines.append(f'{name}_bucket{{le="{bound:g}"{sep}}} {acc}')
+        lines.append(f'{name}_bucket{{le="+Inf"{sep}}} {self.count}')
+        lines.append(f"{name}_sum{bare} {self.total:g}")
+        lines.append(f"{name}_count{bare} {self.count}")
         return lines
 
 
 class ServingTelemetry:
-    """The serve loop's stage clocks + counters + latency histograms."""
+    """The serve loop's stage clocks + counters + latency histograms.
 
-    def __init__(self):
+    ``replica``: this telemetry's replica/rank index in a multi-replica
+    cluster — every Prometheus line gains a ``replica="i"`` label so N
+    replicas' scrapes aggregate instead of colliding, and snapshots
+    carry the index. None = unlabeled single-server output (unchanged
+    schema)."""
+
+    def __init__(self, replica=None):
         self._lock = threading.Lock()
+        self.replica = replica
         #: extension names declared via register(); they survive reset()
         self._extra = {"stage": set(), "counter": set(), "gauge": set()}
         self.reset()
@@ -217,6 +227,12 @@ class ServingTelemetry:
             getattr(self, hist_name).observe(v)
 
     # -- read side ------------------------------------------------------
+    def get_gauges(self):
+        """Point-in-time copy of every gauge — the replica router's
+        load-scoring read (one lock, one dict copy)."""
+        with self._lock:
+            return dict(self.gauges)
+
     def attribution(self, wall_s=None, include_idle=False):
         """Per-stage share of ``wall_s`` (default: telemetry uptime) and
         the summed ``attributed_share`` — how much of the serve wall the
@@ -239,6 +255,7 @@ class ServingTelemetry:
         shares, latency histograms."""
         with self._lock:
             out = {
+                "replica": self.replica,
                 "uptime_s": round(time.perf_counter() - self.started_at, 4),
                 "counters": dict(self.counters),
                 "gauges": {k: round(v, 6) for k, v in self.gauges.items()},
@@ -264,8 +281,13 @@ class ServingTelemetry:
 
     def prometheus_text(self, prefix="paddle_tpu_serving"):
         """Prometheus text exposition: counters, gauges, stage-seconds
-        counters, latency histograms."""
+        counters, latency histograms. With ``replica`` set, every line
+        carries ``replica="i"`` so a multi-replica scrape endpoint can
+        concatenate N replicas' dumps without series collisions."""
         with self._lock:
+            rep = self.replica
+            lbl = f'replica="{rep}"' if rep is not None else ""
+            brace = ("{" + lbl + "}") if lbl else ""
             counters = dict(self.counters)
             gauges = dict(self.gauges)
             stages = dict(self.stage_s)
@@ -278,19 +300,22 @@ class ServingTelemetry:
             decode = self.counters["tokens_emitted"]
             share = prefill / (prefill + decode) if prefill + decode else 0.0
             lines = [f"# TYPE {prefix}_prefill_token_share gauge",
-                     f"{prefix}_prefill_token_share {share:g}"]
+                     f"{prefix}_prefill_token_share{brace} {share:g}"]
             for name, val in sorted(counters.items()):
                 full = f"{prefix}_{name}_total"
                 lines.append(f"# TYPE {full} counter")
-                lines.append(f"{full} {val}")
+                lines.append(f"{full}{brace} {val}")
             for name, val in sorted(gauges.items()):
                 full = f"{prefix}_{name}"
                 lines.append(f"# TYPE {full} gauge")
-                lines.append(f"{full} {val:g}")
+                lines.append(f"{full}{brace} {val:g}")
             full = f"{prefix}_stage_seconds_total"
             lines.append(f"# TYPE {full} counter")
+            stage_extra = ("," + lbl) if lbl else ""
             for name, val in sorted(stages.items()):
-                lines.append(f'{full}{{stage="{name}"}} {val:g}')
+                lines.append(
+                    f'{full}{{stage="{name}"{stage_extra}}} {val:g}')
             for name, h in hists.items():
-                lines.extend(h.prometheus_lines(f"{prefix}_{name}"))
+                lines.extend(h.prometheus_lines(f"{prefix}_{name}",
+                                                labels=lbl))
         return "\n".join(lines) + "\n"

@@ -10,13 +10,20 @@ admission queue with backpressure, per-request streaming/cancellation/
 deadlines, and per-stage telemetry
 (:mod:`paddle_tpu.profiler.serving_telemetry`).
 
-Entry point: :class:`AsyncLLMServer`.
+Entry points: :class:`AsyncLLMServer` (one engine), and the multichip
+layer in :mod:`paddle_tpu.serving.cluster` — :func:`tp_engine` (tensor-
+parallel engine whose KV pools shard across a ``("tp",)`` mesh) and
+:class:`ReplicaRouter` (load- and prefix-affinity-aware placement over N
+server replicas, with drain/failover).
 """
 from .types import (RequestHandle, RequestState, ServeRequest, ServeResult,
                     ServerClosed, ServerQueueFull)
 from .scheduler import AdmissionQueue
 from .server import AsyncLLMServer
+from .cluster import (ReplicaRouter, RouterHandle, shard_model_tp,
+                      tp_engine, tp_serving_mesh)
 
 __all__ = ["AsyncLLMServer", "AdmissionQueue", "RequestHandle",
            "RequestState", "ServeRequest", "ServeResult", "ServerClosed",
-           "ServerQueueFull"]
+           "ServerQueueFull", "ReplicaRouter", "RouterHandle",
+           "shard_model_tp", "tp_engine", "tp_serving_mesh"]

@@ -1,0 +1,712 @@
+"""Multichip serving — the two-level cluster subsystem.
+
+Reference analog: the reference's fleet/auto_parallel orchestration layer
+(PAPER.md §1, layer 6a) over its AnalysisPredictor serving front-end
+(layer 6c): capacity scales with CHIPS, not with engine slots. Two
+independent levels compose:
+
+* **Level 1 — tensor parallelism** (:func:`tp_engine`): one
+  :class:`~paddle_tpu.inference.LLMEngine` whose weights AND paged KV
+  pools shard across a ``("tp",)`` mesh axis. kv-heads are the natural
+  shard dim — the Pallas paged-attention grid is ``(batch, kv_head,
+  max_blocks)``, so each shard keeps its own physical pool slice and the
+  per-shard kernel is byte-identical to the single-chip one at
+  ``Hkv/ntp`` heads (``paged_attention_decode_tp`` /
+  ``paged_attention_append_tp`` shard_map it; the CPU dense fallback
+  partitions under GSPMD). Block tables, the allocator, and the prefix
+  cache's content hashing stay HOST-GLOBAL and TP-oblivious; the
+  vocab-sharded lm head all-gathers into the replicated carried logits
+  exactly once per step. Greedy output is token-exact vs the single-chip
+  engine.
+* **Level 2 — data parallelism** (:class:`ReplicaRouter`): N
+  :class:`~paddle_tpu.serving.AsyncLLMServer` replicas (each possibly a
+  TP engine) behind one router that places every request by a score
+  combining **load** (queue depth + running slots + KV-pool occupancy,
+  read from each replica's existing Prometheus gauges) and **prefix
+  affinity** (a read-only probe of each replica's content-hash store for
+  the longest cached prefix of the incoming prompt — the replica that
+  already holds the system prompt serves it with zero prefill FLOPs for
+  the shared span). Placement falls back to least-loaded when nothing
+  hits. Failover: a dead replica's QUEUED requests (nothing streamed
+  yet) resubmit transparently to survivors; its IN-FLIGHT requests
+  (tokens already streamed) fail with the attributable
+  ``finish_reason="replica_lost"``. :meth:`ReplicaRouter.drain` removes
+  a replica gracefully (migrate queued, finish running, stop).
+
+Everything is testable end-to-end on CPU via
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (the
+``tests/conftest.py`` virtual-mesh pattern).
+
+Scoring formula (documented contract, see docs/architecture.md)::
+
+    score(replica) = affinity_weight * hit_tokens / prompt_len
+                   - load_weight * ((queue_depth + engine_waiting
+                                     + running_slots) / max_batch
+                                    + kv_pool_occupancy)
+
+highest score wins; ties break toward the lower replica index.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from .types import ServeResult, ServerClosed, ServerQueueFull
+
+__all__ = ["ReplicaRouter", "RouterHandle", "tp_serving_mesh",
+           "shard_model_tp", "tp_engine"]
+
+
+# ---------------------------------------------------------------------------
+# Level 1 — tensor-parallel engine construction
+# ---------------------------------------------------------------------------
+
+def tp_serving_mesh(tp=None, devices=None):
+    """A ``("tp",)`` jax Mesh over ``tp`` devices (default: all local
+    devices). The axis NAME is the contract: ``LLMEngine(mesh=...)``
+    shards its KV buffers iff the mesh carries a ``"tp"`` axis of size
+    > 1 (any other mesh keeps the legacy replicated-buffer behavior)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if tp is not None:
+        if len(devices) < tp:
+            raise ValueError(f"need {tp} devices for tp={tp}, have "
+                             f"{len(devices)}")
+        devices = devices[:tp]
+    return Mesh(np.asarray(devices), ("tp",))
+
+
+def shard_model_tp(model, mesh, axis="tp"):
+    """Lay the llama stack's weights out TP-sharded on ``mesh`` in place
+    (Megatron placement via :func:`~paddle_tpu.models.llama.llama_tp_spec`:
+    column-parallel q/k/v/gate/up + lm_head, row-parallel o/down, vocab-
+    sharded embedding; norms replicated). Multi-process safe: every
+    process must hold identical host values (same seed / same checkpoint)
+    and contributes its addressable shards."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ..models.llama import llama_tp_spec
+
+    for name, p in model.named_parameters():
+        host = np.asarray(p._value)
+        sharding = NamedSharding(mesh, llama_tp_spec(name, axis=axis))
+        p._value = jax.make_array_from_callback(
+            host.shape, sharding, lambda idx, h=host: h[idx])
+    return model
+
+
+def tp_engine(model, tp=None, mesh=None, devices=None, shard_weights=True,
+              **engine_kw):
+    """Build a tensor-parallel serving engine: shard ``model``'s weights
+    over a ``("tp",)`` mesh (built from ``tp``/``devices`` unless a
+    ``mesh`` is passed) and return ``LLMEngine(model, mesh=mesh, ...)``
+    whose KV pools shard along kv-heads on the same axis. Token-exact
+    greedy parity with the single-chip engine is the contract
+    (tests/test_cluster.py asserts it for dense AND paged, prefix cache
+    on and off)."""
+    from ..inference import LLMEngine
+
+    if mesh is None:
+        mesh = tp_serving_mesh(tp, devices)
+    if "tp" not in tuple(mesh.axis_names):
+        raise ValueError(f"tp_engine needs a mesh with a 'tp' axis, got "
+                         f"{tuple(mesh.axis_names)}")
+    if shard_weights:
+        shard_model_tp(model, mesh)
+    return LLMEngine(model, mesh=mesh, **engine_kw)
+
+
+# ---------------------------------------------------------------------------
+# Level 2 — the data-parallel replica router
+# ---------------------------------------------------------------------------
+
+class RouterHandle:
+    """Caller-side view of one routed request.
+
+    Wraps the current replica-local
+    :class:`~paddle_tpu.serving.RequestHandle` and survives failover: a
+    queued request whose replica dies is transparently re-attached to a
+    survivor (``resubmits`` counts the hops); a request that had already
+    streamed tokens finishes with ``finish_reason="replica_lost"``.
+    Iterate for the token stream, :meth:`result` for the terminal
+    :class:`~paddle_tpu.serving.ServeResult` (its ``routing`` dict names
+    the replica and the placement score that won)."""
+
+    def __init__(self, router, prompt_ids, kwargs, routing_key=None):
+        self._router = router
+        self.prompt_ids = prompt_ids
+        self._kwargs = kwargs
+        self.routing_key = routing_key
+        self._cond = threading.Condition()
+        self._inner = None           # current replica-local RequestHandle
+        self._replica = None
+        self._final: ServeResult | None = None
+        self._streamed = []          # tokens handed to the caller
+        self._migrating = False      # drain: a cancel that must resubmit
+        self.resubmits = 0
+        #: failover-retry pacing: when every survivor's queue is full, a
+        #: resubmission parks back in the outstanding set and retries on
+        #: monitor ticks until the router's retry window closes
+        self._retry_since = None
+        self._last_try = None
+
+    @property
+    def replica(self):
+        """Index of the replica currently serving this request."""
+        return self._replica
+
+    @property
+    def done(self):
+        return self._final is not None
+
+    @property
+    def routing(self):
+        """The routing/placement dict stamped on the current submission
+        (also surfaced on the terminal ``ServeResult.routing``)."""
+        inner = self._inner
+        return inner.request.routing if inner is not None else None
+
+    # -- router side -----------------------------------------------------
+    def _attach(self, replica_idx, inner):
+        with self._cond:
+            self._inner = inner
+            self._replica = replica_idx
+            self._migrating = False
+            self._cond.notify_all()
+
+    def _finish(self, result):
+        with self._cond:
+            self._final = result
+            self._cond.notify_all()
+
+    # -- caller side -----------------------------------------------------
+    def _pop_token(self):
+        """Pop one streamed token AND record it in ``_streamed`` under
+        the same lock — _resolve snapshots (pending deque, streamed
+        list) under that lock too, so a crash result can never count a
+        token in both."""
+        inner = self._inner
+        if inner is None:
+            return None
+        with inner._cond:
+            if inner._tokens:
+                tok = inner._tokens.popleft()
+                self._streamed.append(tok)
+                return tok
+        return None
+
+    def tokens(self, timeout=None):
+        """Generator over the token stream (across failover re-attach),
+        with an optional per-token timeout."""
+        while True:
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            while True:
+                tok = self._pop_token()
+                if tok is not None:
+                    break
+                if self._final is not None:
+                    # re-pop: a token emitted between the miss above and
+                    # the final landing must still be delivered
+                    tok = self._pop_token()
+                    if tok is None:
+                        return
+                    break
+                inner = self._inner
+                if inner is not None and inner.done:
+                    # nudge the router — the waiting client drives the
+                    # resolve latency, the monitor is only the backstop
+                    self._router._resolve(self)
+                    with self._cond:
+                        if self._final is None:
+                            self._cond.wait(0.02)
+                elif inner is not None:
+                    # the streaming hot path waits on the INNER handle's
+                    # condition — _emit notifies it, so token delivery is
+                    # notification-driven like a plain server handle (the
+                    # bounded wait only exists to notice a failover
+                    # re-attach swapping _inner out from under us)
+                    with inner._cond:
+                        if not inner._tokens and not inner.done:
+                            inner._cond.wait(0.05)
+                else:
+                    with self._cond:
+                        if self._final is None:
+                            self._cond.wait(0.02)
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"routed request: no token within {timeout}s")
+            yield tok
+
+    def __iter__(self):
+        return self.tokens()
+
+    def result(self, timeout=None) -> ServeResult:
+        """Block for the terminal result (post-failover if any)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._cond:
+                if self._final is not None:
+                    return self._final
+                inner = self._inner
+            if inner is not None and inner.done:
+                self._router._resolve(self)
+                continue
+            if inner is not None:
+                try:
+                    inner.result(timeout=0.05)
+                    continue   # inner done: loop resolves it
+                except TimeoutError:
+                    pass
+            else:
+                with self._cond:
+                    self._cond.wait(0.05)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"routed request not finished within {timeout}s")
+
+    def cancel(self):
+        inner = self._inner
+        if inner is not None:
+            inner.cancel()
+
+
+class ReplicaRouter:
+    """Load- and prefix-affinity-aware placement over N
+    :class:`~paddle_tpu.serving.AsyncLLMServer` replicas.
+
+    ``policy``: ``"affinity"`` (the default — affinity score on top of
+    least-loaded), ``"least_loaded"`` (ignore affinity), or ``"random"``
+    (the bench's control arm). ``submit(..., replica=i)`` pins a request
+    explicitly (ops / tests). The router owns replica lifecycle when
+    started through it: :meth:`start` starts un-started replicas plus the
+    failover monitor, :meth:`stop` drains and stops everything.
+
+    Failover contract: when a replica dies (its serving loop crashed),
+    every request it had QUEUED — nothing streamed yet — is resubmitted
+    to a survivor and completes there (greedy re-prefill reproduces the
+    identical stream); every request already STREAMING fails with
+    ``finish_reason="replica_lost"`` carrying the tokens streamed so
+    far. Nothing is silently dropped."""
+
+    def __init__(self, replicas, affinity_weight=2.0, load_weight=1.0,
+                 policy="affinity", poll_interval_s=0.01,
+                 failover_retry_s=10.0, seed=0):
+        if not replicas:
+            raise ValueError("ReplicaRouter needs at least one replica")
+        if policy not in ("affinity", "least_loaded", "random"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self.replicas = list(replicas)
+        self.affinity_weight = float(affinity_weight)
+        self.load_weight = float(load_weight)
+        self.policy = policy
+        self.poll_interval_s = float(poll_interval_s)
+        #: how long a failover resubmission keeps retrying when every
+        #: survivor's queue is full before the request fails as
+        #: replica_lost — transient backpressure must not drop requests
+        self.failover_retry_s = float(failover_retry_s)
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        self._outstanding: set[RouterHandle] = set()
+        #: outstanding placements per replica, counted by the ROUTER at
+        #: placement time — the load gauges are sampled by each replica's
+        #: serve loop and lag a burst of submissions, so a salvo would
+        #: otherwise pile onto whichever replica scored best a
+        #: millisecond ago. The score uses max(gauges, this).
+        self._live_per = [0] * len(self.replicas)
+        self._draining: set[int] = set()
+        self._stop_evt = threading.Event()
+        self._monitor = None
+        self.stats = {"submitted": 0, "affinity_routed": 0,
+                      "resubmitted": 0, "replica_lost": 0,
+                      "placements": [0] * len(self.replicas)}
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        for srv in self.replicas:
+            if srv._thread is None:
+                srv.start()
+        self._stop_evt.clear()
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="paddle-tpu-router",
+                                         daemon=True)
+        self._monitor.start()
+        return self
+
+    def stop(self, drain=True, timeout=None):
+        """Stop the monitor and every replica. A replica whose stop
+        fails — a crashed loop re-raising, or a TimeoutError from a
+        join still inside a long compile — is collected, not fatal, so
+        one bad replica can't wedge cluster shutdown. Returns the
+        ``[(replica_idx, exception), ...]`` list."""
+        self._stop_evt.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout)
+            self._monitor = None
+        errors = []
+        for i, srv in enumerate(self.replicas):
+            try:
+                srv.stop(drain=drain, timeout=timeout)
+            except Exception as e:   # noqa: BLE001 — collect, keep going
+                errors.append((i, e))
+        return errors
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=exc == (None, None, None))
+        return False
+
+    def alive(self, idx):
+        srv = self.replicas[idx]
+        return (srv._thread is not None and srv._thread.is_alive()
+                and srv._crashed is None and srv._accepting)
+
+    # -- placement -------------------------------------------------------
+    def _score(self, idx, ids, hashes=None):
+        """(score, affinity_tokens) of placing ``ids`` on replica
+        ``idx`` — the documented formula (module docstring).
+        ``hashes``: precomputed chain hashes (the hash chain depends on
+        token content only, so one computation serves every same-
+        block_size replica)."""
+        srv = self.replicas[idx]
+        aff = 0
+        if self.policy == "affinity":
+            try:
+                aff = int(srv.engine.probe_prefix_len(
+                    ids, chain_hashes=hashes))
+            except Exception:   # routing heuristic: never let it fail
+                aff = 0
+        g = srv.telemetry.get_gauges()
+        load = (g.get("queue_depth", 0.0) + g.get("engine_waiting", 0.0)
+                + g.get("running_slots", 0.0)) / max(srv.engine.B, 1)
+        # the router's own outstanding count covers the gauge lag window
+        # (submissions placed this millisecond that no loop pass has
+        # sampled yet); max() rather than + because settled placements
+        # appear in both views
+        load = max(load, self._live_per[idx] / max(srv.engine.B, 1))
+        # pool pressure counts only UNAVAILABLE blocks: the raw occupancy
+        # gauge treats LRU-cached (evictable) prefix blocks as occupied,
+        # which would permanently penalize exactly the warm replica the
+        # affinity term is trying to prefer
+        pool = g.get("kv_pool_occupancy", 0.0)
+        cached = g.get("prefix_cached_blocks", 0.0)
+        n_blocks = getattr(srv.engine, "n_blocks", 0)
+        if n_blocks:
+            pool = max(0.0, pool - cached / n_blocks)
+        score = self.affinity_weight * (aff / max(len(ids), 1)) \
+            - self.load_weight * (load + pool)
+        return score, aff
+
+    def _rank(self, ids, pin=None):
+        """Candidate replicas best-first as (idx, score, aff_tokens)."""
+        #: prompt hash chain per block_size — computed at most once per
+        #: submission, shared by every same-geometry replica's probe
+        hash_cache = {}
+
+        def hashes_for(idx):
+            eng = self.replicas[idx].engine
+            if self.policy != "affinity" or \
+                    getattr(eng, "prefix_cache", False) is False:
+                return None
+            bs = eng.block_size
+            if bs not in hash_cache:
+                hash_cache[bs] = eng.prefix_chain_hashes(ids)
+            return hash_cache[bs]
+
+        if pin is not None:
+            score, aff = self._score(pin, ids, hashes_for(pin))
+            return [(pin, score, aff)]
+        cand = [i for i in range(len(self.replicas))
+                if self.alive(i) and i not in self._draining]
+        if not cand:
+            return []
+        if self.policy == "random":
+            order = [int(i) for i in self._rng.permutation(cand)]
+            return [(i, 0.0, 0) for i in order]
+        scored = [(i,) + self._score(i, ids, hashes_for(i)) for i in cand]
+        scored.sort(key=lambda t: (-t[1], t[0]))
+        return scored
+
+    # -- submission ------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=64, temperature=0.0,
+               top_p=1.0, eos_token_id=None, deadline_s=None,
+               routing_key=None, replica=None, block=True,
+               timeout=None) -> RouterHandle:
+        """Place and submit one request; returns its
+        :class:`RouterHandle`. ``routing_key`` is an opaque caller tag
+        that rides the placement dict into ``ServeResult.routing`` and
+        the request's trace spans. ``replica`` pins placement (skips
+        scoring). Backpressure: a replica whose queue is full is skipped
+        for the next-best; with every queue full, blocks (``block=True``,
+        up to ``timeout``) or raises
+        :class:`~paddle_tpu.serving.ServerQueueFull`."""
+        ids = np.asarray(
+            prompt_ids.numpy() if hasattr(prompt_ids, "numpy")
+            else prompt_ids, dtype=np.int32).reshape(-1)
+        kwargs = dict(max_new_tokens=max_new_tokens,
+                      temperature=temperature, top_p=top_p,
+                      eos_token_id=eos_token_id, deadline_s=deadline_s)
+        handle = RouterHandle(self, ids, kwargs, routing_key)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            err = self._try_place(handle, ids, pin=replica)
+            if err is None:
+                return handle
+            if not block or isinstance(err, ServerClosed):
+                raise err
+            if deadline is not None and time.monotonic() > deadline:
+                raise err
+            time.sleep(self.poll_interval_s)
+
+    def _try_place(self, handle, ids, pin=None, resubmit=False):
+        """One placement pass over the ranked candidates. Returns None
+        on success, else the error to surface (queue-full everywhere /
+        no replica alive). Scoring (affinity probes hash the whole
+        prompt per replica) runs OUTSIDE the router lock — scores are an
+        advisory heuristic over point-in-time reads, so concurrent
+        submitters may score stale-ish state but must not serialize on
+        each other's hash walks; the lock guards only the actual
+        placement bookkeeping."""
+        ranked = self._rank(ids, pin=pin)
+        with self._lock:
+            last_err = None
+            for idx, score, aff in ranked:
+                srv = self.replicas[idx]
+                routing = {"replica": idx, "policy": self.policy,
+                           "score": round(float(score), 4),
+                           "affinity_tokens": int(aff),
+                           # the handle's counter increments only once
+                           # this placement SUCCEEDS — stamp what this
+                           # submission will be, not what the last was
+                           "resubmits": handle.resubmits
+                           + (1 if resubmit else 0)}
+                if handle.routing_key is not None:
+                    routing["routing_key"] = handle.routing_key
+                try:
+                    inner = srv.submit(ids, routing=routing, block=False,
+                                       **handle._kwargs)
+                except (ServerQueueFull, ServerClosed) as e:
+                    last_err = e
+                    continue
+                handle._attach(idx, inner)
+                self._outstanding.add(handle)
+                self._live_per[idx] += 1
+                self.stats["placements"][idx] += 1
+                if not resubmit:
+                    self.stats["submitted"] += 1
+                    if aff > 0:
+                        self.stats["affinity_routed"] += 1
+                return None
+            return last_err or ServerClosed("no replica alive")
+
+    def num_outstanding(self):
+        with self._lock:
+            return len(self._outstanding)
+
+    # -- failover / resolution -------------------------------------------
+    def _done_with(self, handle):
+        """Drop a handle from the outstanding set + the per-replica
+        placement count (CALLER HOLDS self._lock)."""
+        if handle in self._outstanding:
+            self._outstanding.discard(handle)
+            if handle._replica is not None:
+                self._live_per[handle._replica] -= 1
+
+    def _monitor_loop(self):
+        while not self._stop_evt.wait(self.poll_interval_s):
+            with self._lock:
+                handles = list(self._outstanding)
+            for rh in handles:
+                inner = rh._inner
+                if inner is not None and inner.done:
+                    self._resolve(rh)
+
+    def _resolve(self, handle):
+        """Turn a finished replica-local result into the routed
+        request's fate: final result, or failover resubmission.
+        Idempotent AND race-safe: the monitor and any number of waiting
+        callers may resolve concurrently — membership in the
+        outstanding set (removed atomically under the router lock) is
+        the gate, so exactly one caller acts."""
+        inner = handle._inner
+        if inner is None or not inner.done or handle.done:
+            return
+        res = inner.result_obj
+        reason = res.finish_reason or ""
+        crashed = reason.startswith("server_error")
+        migrating = handle._migrating and reason == "cancelled"
+        streamed = inner.first_token_at is not None
+        # a drain-migration that raced its cancel against the first
+        # token must NOT resubmit (the caller may already have consumed
+        # tokens a fresh greedy stream would repeat) — the cancel stands
+        resubmit = (crashed and not streamed) or \
+            (migrating and not streamed and not handle._streamed)
+        now = time.monotonic()
+        if resubmit and handle._last_try is not None and \
+                now - handle._last_try < self.poll_interval_s:
+            # pacing: a queue-full retry parked the handle; wait for the
+            # next monitor tick instead of hot-spinning the placement
+            # pass from every blocked caller
+            return
+        with self._lock:
+            if handle not in self._outstanding:
+                return          # another caller won the resolve
+            self._done_with(handle)
+            if resubmit:
+                handle._replica = None   # no live placement while parked
+            if crashed and streamed:
+                self.stats["replica_lost"] += 1
+        if not crashed and not migrating:
+            handle._finish(res)
+            return
+        if not resubmit:
+            if crashed:
+                # in-flight: tokens already left the building — fail
+                # attributably, carrying everything streamed so far
+                # (handed-out tokens plus any still in the deque —
+                # snapshot under the same lock _pop_token records with,
+                # so no token lands in both lists)
+                with inner._cond:
+                    pending = list(inner._tokens)
+                    emitted = list(handle._streamed)
+                handle._finish(ServeResult(
+                    res.request_id, emitted + pending,
+                    "replica_lost", True, routing=inner.request.routing))
+            else:
+                handle._finish(res)
+            return
+        # queued: resubmit to a survivor (placement excludes the dead/
+        # draining replica via alive()/draining checks)
+        handle._last_try = now
+        err = self._try_place(handle, handle.prompt_ids, resubmit=True)
+        if err is None:
+            handle.resubmits += 1
+            handle._retry_since = None
+            with self._lock:
+                self.stats["resubmitted"] += 1
+            return
+        if isinstance(err, ServerQueueFull) and not self._stop_evt.is_set():
+            # transient backpressure on the survivors: park the handle
+            # back in the outstanding set — the monitor's next tick
+            # retries — until the failover window closes. Dropping it
+            # NOW would convert a momentarily full queue into request
+            # loss.
+            if handle._retry_since is None:
+                handle._retry_since = now
+            if now - handle._retry_since < self.failover_retry_s:
+                with self._lock:
+                    self._outstanding.add(handle)
+                return
+        with self._lock:
+            self.stats["replica_lost"] += 1
+        handle._finish(ServeResult(
+            res.request_id, list(handle._streamed), "replica_lost",
+            True, routing=inner.request.routing))
+
+    # -- drain -----------------------------------------------------------
+    def drain(self, idx, timeout=30.0):
+        """Gracefully remove replica ``idx``: stop placing new work on
+        it, migrate its queued (nothing-streamed) requests to survivors,
+        let its running requests finish, then stop it. The replica stays
+        in ``replicas`` (stopped) so indices remain stable."""
+        with self._lock:
+            self._draining.add(idx)
+            srv = self.replicas[idx]
+            mine = [rh for rh in self._outstanding
+                    if rh._replica == idx and not rh.done]
+        for rh in mine:
+            inner = rh._inner
+            if inner is not None and inner.first_token_at is None:
+                rh._migrating = True
+                inner.cancel()
+        deadline = time.monotonic() + timeout
+        while any(rh._migrating and not rh.done for rh in mine):
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"drain({idx}): migrations incomplete "
+                                   f"after {timeout}s")
+            for rh in mine:
+                inner = rh._inner
+                if rh._migrating and inner is not None and inner.done:
+                    self._resolve(rh)
+            time.sleep(self.poll_interval_s)
+        srv.stop(drain=True, timeout=max(deadline - time.monotonic(), 0.1))
+
+    # -- observability ---------------------------------------------------
+    def snapshot(self):
+        """JSON-ready cluster view: router stats + each replica's
+        telemetry snapshot (keyed by replica index)."""
+        with self._lock:
+            out = {"policy": self.policy,
+                   "stats": {k: (list(v) if isinstance(v, list) else v)
+                             for k, v in self.stats.items()},
+                   "draining": sorted(self._draining)}
+        out["replicas"] = {}
+        for i, srv in enumerate(self.replicas):
+            out["replicas"][i] = {
+                "alive": self.alive(i),
+                "tp_degree": srv.engine.tp_degree(),
+                "telemetry": srv.telemetry.snapshot()}
+        return out
+
+    def prometheus_text(self):
+        """One VALID Prometheus exposition across replicas: same-name
+        series merge into one metric family (a single ``# TYPE`` line,
+        then every replica's labeled samples) — naive concatenation
+        would repeat TYPE lines per replica, which strict parsers
+        reject. Each replica's telemetry must carry its own ``replica``
+        label (``AsyncLLMServer(replica=i)``) or the merged samples
+        would collide."""
+        families = {}            # metric name -> (type_line, [samples])
+        order = []
+        for srv in self.replicas:
+            current = None
+            for line in srv.telemetry.prometheus_text().splitlines():
+                if line.startswith("# TYPE "):
+                    name = line.split()[2]
+                    if name not in families:
+                        families[name] = (line, [])
+                        order.append(name)
+                    current = name
+                elif line:
+                    families[current][1].append(line)
+        out = []
+        for name in order:
+            type_line, samples = families[name]
+            out.append(type_line)
+            out.extend(samples)
+        return "\n".join(out) + "\n"
+
+    def export_merged_trace(self, path):
+        """Merge every recorder-equipped replica's chrome trace into one
+        Perfetto-loadable timeline — one process lane group per replica
+        (rides :func:`paddle_tpu.profiler.merge_profile`, the same
+        cross-rank merge training traces use)."""
+        import tempfile
+
+        from ..profiler import merge_profile
+
+        with tempfile.TemporaryDirectory(
+                prefix="paddle_tpu_cluster_trace_") as tmpd:
+            files = []
+            for i, srv in enumerate(self.replicas):
+                rec = srv.flight_recorder
+                if rec is None:
+                    continue
+                files.append(rec.export_chrome_trace(
+                    os.path.join(tmpd, f"replica{i}.json")))
+            if not files:
+                raise RuntimeError(
+                    "no replica has a flight recorder attached "
+                    "(AsyncLLMServer(flight_recorder=True))")
+            # same process, same perf_counter clock: keep it (align
+            # would destroy cross-replica simultaneity)
+            return merge_profile(files, path, align_start=False)

@@ -72,20 +72,30 @@ class AsyncLLMServer:
 
     def __init__(self, engine, max_queue_size=64, pipeline_depth=None,
                  poll_interval_s=0.005, telemetry=None,
-                 flight_recorder=None):
+                 flight_recorder=None, replica=None):
         """``flight_recorder``: a
         :class:`~paddle_tpu.profiler.flight_recorder.FlightRecorder`
         instance (or ``True`` for a default-sized one) to attach to the
         engine for the server's lifetime — per-step StepRecords,
         per-request span timelines, chrome-trace export and
         ``explain_tail``. None (the default) records nothing and costs
-        one attribute check per step."""
+        one attribute check per step.
+
+        ``replica``: this server's index in a multi-replica cluster
+        (:class:`~paddle_tpu.serving.cluster.ReplicaRouter`). Stamped as
+        a ``replica`` label on every Prometheus metric line and as the
+        process lane of chrome-trace exports, so N replicas' scrapes and
+        merged traces never collide. None = single-server (unlabeled)."""
         if pipeline_depth is not None and pipeline_depth < 1:
             raise ValueError(f"pipeline_depth must be >= 1, "
                              f"got {pipeline_depth}")
+        self.replica = replica
         if flight_recorder is True:
             from ..profiler.flight_recorder import FlightRecorder
-            flight_recorder = FlightRecorder()
+            flight_recorder = FlightRecorder(replica=replica)
+        if flight_recorder is not None and replica is not None \
+                and flight_recorder.replica is None:
+            flight_recorder.replica = replica
         self.flight_recorder = flight_recorder
         self.engine = engine
         # the engine knows its own safe depth: 2 for dense/speculative,
@@ -97,7 +107,9 @@ class AsyncLLMServer:
         self.pipeline_depth = min(int(pipeline_depth or 2), 2,
                                   engine.max_pipeline_depth())
         self.poll_interval_s = float(poll_interval_s)
-        self.telemetry = telemetry or ServingTelemetry()
+        self.telemetry = telemetry or ServingTelemetry(replica=replica)
+        if replica is not None and self.telemetry.replica is None:
+            self.telemetry.replica = replica
         self._queue = AdmissionQueue(max_queue_size)
         self._handles: dict[int, RequestHandle] = {}
         self._hlock = threading.Lock()
@@ -173,7 +185,7 @@ class AsyncLLMServer:
     # -- submission ------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=64, temperature=0.0,
                top_p=1.0, eos_token_id=None, deadline_s=None, block=True,
-               timeout=None) -> RequestHandle:
+               timeout=None, routing=None) -> RequestHandle:
         """Submit one generation request; returns its streaming
         :class:`RequestHandle`.
 
@@ -184,7 +196,14 @@ class AsyncLLMServer:
         ``deadline_s`` is a relative budget: once exceeded, the request is
         cancelled wherever it is (queued or mid-decode) with
         finish_reason ``"deadline"`` and its slot / pool blocks free at
-        the next step boundary."""
+        the next step boundary.
+
+        ``routing``: opaque metadata dict (a routing key, or the
+        ReplicaRouter's placement record). Surfaced verbatim on
+        ``ServeResult.routing`` and stamped into the request's trace
+        timeline as a ``"routed"`` span, so placement decisions are
+        per-request observable (``explain_tail`` carries them on tail
+        entries)."""
         if self._crashed is not None:
             raise ServerClosed(
                 f"serving loop crashed: {self._crashed}") from self._crashed
@@ -216,7 +235,8 @@ class AsyncLLMServer:
             eos_token_id,
             deadline=(now + float(deadline_s)
                       if deadline_s is not None else None),
-            submitted_at=now)
+            submitted_at=now,
+            routing=dict(routing) if routing is not None else None)
         handle = RequestHandle(self, req)
         rec = self.flight_recorder
         with self._hlock:
@@ -226,6 +246,8 @@ class AsyncLLMServer:
             # thread may admit it (and emit "admitted"/token events)
             # concurrently — "queued" must already be the timeline head
             rec.req_event(rid, "queued")
+            if req.routing is not None:
+                rec.req_event(rid, "routed", value=dict(req.routing))
         try:
             self._queue.put(handle, block=block, timeout=timeout)
         except Exception:
@@ -305,7 +327,8 @@ class AsyncLLMServer:
             self._queue.drain()
             for h in handles:
                 h._finish(ServeResult(
-                    h.request_id, [], f"server_error: {e}", True))
+                    h.request_id, [], f"server_error: {e}", True,
+                    routing=h.request.routing))
 
     def _fail_head_waiting(self, err):
         eng = self.engine
@@ -557,7 +580,7 @@ class AsyncLLMServer:
             e2e_s=now - req.submitted_at,
             queue_wait_s=(handle.admitted_at - req.submitted_at
                           if handle.admitted_at is not None else None),
-            trace=trace)
+            trace=trace, routing=req.routing)
         self.telemetry.inc("requests_finished")
         self.telemetry.observe("e2e_s", result.e2e_s)
         with self._hlock:

@@ -49,6 +49,12 @@ class ServeRequest:
     #: request (freeing its slot / pool blocks) once this passes
     deadline: float | None = None
     submitted_at: float = 0.0
+    #: opaque caller/router metadata (e.g. the ReplicaRouter's placement
+    #: decision: replica index, policy, score, affinity tokens,
+    #: routing_key). Surfaced verbatim on ServeResult.routing and
+    #: stamped into the request's flight-recorder trace as a "routed"
+    #: span, so per-request placement is observable in explain_tail.
+    routing: dict | None = None
 
 
 @dataclasses.dataclass
@@ -66,6 +72,9 @@ class ServeResult:
     #: every span stamped with the engine StepRecord id that produced
     #: it). None unless the server was started with a flight_recorder.
     trace: dict | None = None
+    #: the routing/placement metadata the request was submitted with
+    #: (see ServeRequest.routing) — how THIS request got where it ran
+    routing: dict | None = None
 
 
 class RequestHandle:

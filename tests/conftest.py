@@ -53,6 +53,20 @@ def rng():
     return np.random.default_rng(0)
 
 
+@pytest.fixture(scope="session")
+def tp_mesh():
+    """Small host-platform tensor-parallel mesh for the multichip
+    serving tests: 4 of the suite's 8 virtual CPU devices on a ("tp",)
+    axis — the size that keeps TP parity tests tier-1-fast (tiny shapes,
+    kv-heads divisible by 4). The big-mesh (8-dev) and soak variants
+    build their own meshes and are gated `slow`."""
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip(f"needs 4 virtual devices, have {len(devs)}")
+    return Mesh(np.asarray(devs[:4]), ("tp",))
+
+
 def train_step_compile_report(step, batch_vals):
     """Compile-report the cached single-step program of a TrainStep (shared
     by the HLO-contract and semi-auto suites — ONE place coupled to
