@@ -313,24 +313,19 @@ class LLMEngine:
                     f"num_key_value_heads {kvh} must divide by the tp "
                     f"mesh axis ({self._tp_size}) — kv-heads are the "
                     f"natural shard dim of the KV pools")
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            def _zeros(shape, dtype, spec=PartitionSpec()):
-                sharding = NamedSharding(mesh, spec)
-                shard = np.zeros(sharding.shard_shape(tuple(shape)), dtype)
-                return jax.make_array_from_callback(
-                    shape, sharding, lambda idx: shard)
-
-            _kv_pool_spec = PartitionSpec(None, self._tp_axis)
-            _kv_dense_spec = PartitionSpec(None, None, self._tp_axis)
-        else:
-            def _zeros(shape, dtype, spec=None):
-                return jnp.zeros(shape, dtype)
-
-            _kv_pool_spec = _kv_dense_spec = None
         import ml_dtypes  # noqa: F401  (np.zeros understands bf16 via jnp)
-        np_dt = np.dtype(dt) if mesh is not None else dt
+        self._kvh = kvh
+        self._head_dim = head_dim
+        self._vocab = c.vocab_size
+        self._np_dt = np.dtype(dt) if mesh is not None else dt
+        self._n_layers = L
+        if mesh is not None:
+            from jax.sharding import PartitionSpec
+            self._kv_spec = PartitionSpec(None, self._tp_axis) \
+                if cache_impl == "paged" \
+                else PartitionSpec(None, None, self._tp_axis)
+        else:
+            self._kv_spec = None
         self.cache_impl = cache_impl
         if enable_prefix_cache and cache_impl != "paged":
             raise ValueError("enable_prefix_cache needs cache_impl='paged' "
@@ -354,6 +349,75 @@ class LLMEngine:
             self._max_blocks = self.capacity // self.block_size
             full = self.B * self._max_blocks
             self.n_blocks = int(kv_pool_blocks or full)
+            #: pool-invariant debug audit (satellite): on under
+            #: PADDLE_TPU_POOL_CHECKS=1 (the test suite sets it) —
+            #: asserts free + cached + live-refcounted == n_blocks and
+            #: table/refcount consistency after every alloc/free.
+            self._debug_pool = os.environ.get(
+                "PADDLE_TPU_POOL_CHECKS", "0") not in ("", "0")
+        # admission-order stamps: the paged allocator's preempt-newest
+        # invariant AND the fused scheduler's oldest-first budget walk
+        self._admit_order = [0] * self.B
+        self._admit_seq = 0
+        self._init_device_state()
+
+        # host-side slot table / queues
+        self.slots: list[_Slot | None] = [None] * self.B
+        self.waiting: collections.deque[GenerationRequest] = \
+            collections.deque()
+        self.finished_outputs: dict[int, RequestOutput] = {}
+        self._next_id = 0
+        #: tokens a preempted request committed before eviction, stitched
+        #: back in front of its post-readmission stream at finish
+        self._preempted_prefix = {}
+        self._rng_key = None
+        self._step_fn = None
+        self._prefill_fn = None
+        self._set_logits_fn = None
+        #: outstanding step_begin() dispatches not yet step_finish()ed —
+        #: the paged engine must stay at depth 1 (its host block allocator
+        #: needs post-step lens before the next dispatch)
+        self._inflight = 0
+        #: optional FlightRecorder (profiler.flight_recorder): when
+        #: attached and enabled, step_begin/step_finish emit one
+        #: StepRecord per step and stamp every emitted token with its
+        #: step id. None (the default) costs one attribute check per step.
+        self.flight_recorder = None
+        #: optional FaultInjector (serving.faults): scripted chaos
+        #: schedules fire at the step_begin/step_finish hooks. None (the
+        #: default) costs one attribute check per step.
+        self.fault_injector = None
+        self._rec_ctx = None       # per-step_begin wall-split anchors
+        self._rec_preempted = []   # rids parked by _preempt_slot this step
+        self.stats = {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
+                      "draft_tokens_accepted": 0, "preemptions": 0,
+                      "fused_steps": 0, "prefill_tokens": 0,
+                      "prefix_hit_tokens": 0, "prefix_cow_blocks": 0,
+                      "prefix_evicted_blocks": 0,
+                      "decode_time_s": 0.0, "admit_time_s": 0.0,
+                      "dispatch_time_s": 0.0, "host_sync_time_s": 0.0,
+                      "emit_time_s": 0.0}
+
+    # ------------------------------------------------------------------
+    # device state (built at __init__, REBUILT by reset())
+    # ------------------------------------------------------------------
+    def _make_zeros(self, shape, dtype, spec=None):
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            sharding = NamedSharding(self._mesh, spec or PartitionSpec())
+            shard = np.zeros(sharding.shard_shape(tuple(shape)), dtype)
+            return jax.make_array_from_callback(
+                shape, sharding, lambda idx: shard)
+        return jnp.zeros(shape, dtype)
+
+    def _init_device_state(self):
+        """(Re)build every device-side buffer and the host allocator /
+        content-store state from scratch. Called by ``__init__`` and by
+        :meth:`reset` — after a crash the old buffers may be donated-away
+        or mid-flight, so recovery rebuilds rather than trusts them. The
+        compiled programs survive (same shapes, same shardings)."""
+        L = self._n_layers
+        if self.cache_impl == "paged":
             # +1 trailing SCRATCH block the allocator never hands out: the
             # Pallas paged-attention kernel's fused new-token write routes
             # invalid (-1) targets there — a freed slot keeps stale lens
@@ -361,11 +425,12 @@ class LLMEngine:
             # on a real block (the XLA fallback drops such rows with an
             # out-of-range scatter; a kernel block write needs a real
             # destination)
-            pool_shape = (self.n_blocks + 1, kvh, self.block_size, head_dim)
-            self._k = [_zeros(pool_shape, np_dt, _kv_pool_spec)
-                       for _ in range(L)]
-            self._v = [_zeros(pool_shape, np_dt, _kv_pool_spec)
-                       for _ in range(L)]
+            pool_shape = (self.n_blocks + 1, self._kvh, self.block_size,
+                          self._head_dim)
+            self._k = [self._make_zeros(pool_shape, self._np_dt,
+                                        self._kv_spec) for _ in range(L)]
+            self._v = [self._make_zeros(pool_shape, self._np_dt,
+                                        self._kv_spec) for _ in range(L)]
             self._tables = np.full((self.B, self._max_blocks), -1, np.int32)
             #: min-heap of free physical blocks: allocation always pops
             #: the SMALLEST free index, so physical layout is a pure
@@ -393,65 +458,47 @@ class LLMEngine:
             #: from HERE (oldest first) before any live slot is
             #: preempted.
             self._lru = collections.OrderedDict()
-            #: pool-invariant debug audit (satellite): on under
-            #: PADDLE_TPU_POOL_CHECKS=1 (the test suite sets it) —
-            #: asserts free + cached + live-refcounted == n_blocks and
-            #: table/refcount consistency after every alloc/free.
-            self._debug_pool = os.environ.get(
-                "PADDLE_TPU_POOL_CHECKS", "0") not in ("", "0")
         else:
-            shape = (self.B, self.capacity, kvh, head_dim)
-            self._k = [_zeros(shape, np_dt, _kv_dense_spec)
+            shape = (self.B, self.capacity, self._kvh, self._head_dim)
+            self._k = [self._make_zeros(shape, self._np_dt, self._kv_spec)
                        for _ in range(L)]
-            self._v = [_zeros(shape, np_dt, _kv_dense_spec)
+            self._v = [self._make_zeros(shape, self._np_dt, self._kv_spec)
                        for _ in range(L)]
-        # admission-order stamps: the paged allocator's preempt-newest
-        # invariant AND the fused scheduler's oldest-first budget walk
-        self._admit_order = [0] * self.B
-        self._admit_seq = 0
-        self._logits = _zeros((self.B, c.vocab_size), np.float32
-                              if mesh is not None else jnp.float32)
-        self._lens = _zeros((self.B,), np.int32
-                            if mesh is not None else jnp.int32)
+        self._logits = self._make_zeros((self.B, self._vocab), np.float32)
+        self._lens = self._make_zeros((self.B,), np.int32)
         # device-side committed-token history (speculative mode): the
         # in-graph prompt-lookup draft reads it, decode windows append
-        self._tokens = _zeros((self.B, self.capacity), np.int32
-                              if mesh is not None else jnp.int32) \
+        self._tokens = self._make_zeros((self.B, self.capacity), np.int32) \
             if self.speculative_k > 1 else None
-        self._n_layers = L
 
-        # host-side slot table / queues
-        self.slots: list[_Slot | None] = [None] * self.B
-        self.waiting: collections.deque[GenerationRequest] = \
-            collections.deque()
-        self.finished_outputs: dict[int, RequestOutput] = {}
-        self._next_id = 0
-        #: tokens a preempted request committed before eviction, stitched
-        #: back in front of its post-readmission stream at finish
-        self._preempted_prefix = {}
-        self._rng_key = None
-        self._step_fn = None
-        self._prefill_fn = None
-        self._set_logits_fn = None
-        #: outstanding step_begin() dispatches not yet step_finish()ed —
-        #: the paged engine must stay at depth 1 (its host block allocator
-        #: needs post-step lens before the next dispatch)
+    def reset(self):
+        """Tear the engine down to EMPTY and re-arm it — the supervised
+        server's crash-recovery hook (``AsyncLLMServer(supervise=...)``).
+
+        Every slot, waiting request, finished output, preemption stitch
+        and (paged) pool/table/content-store binding drops; the device
+        buffers are rebuilt from zeros (a crashed dispatch may have
+        consumed the old ones through buffer donation, so they cannot be
+        trusted or even touched). What SURVIVES: the compiled programs
+        (identical shapes/shardings — a restart costs no recompile), the
+        request-id counter (rids stay unique across restarts), the
+        engine's cumulative ``stats``, and the sampling base key — token
+        ``p`` of request ``r`` samples from ``fold_in(fold_in(key, r),
+        p)``, so a re-admitted request's sampled stream continues exactly
+        where the crash cut it. ``_check_pool_invariants`` holds
+        trivially after a reset."""
+        self.slots = [None] * self.B
+        self.waiting.clear()
+        self.finished_outputs.clear()
+        self._preempted_prefix.clear()
         self._inflight = 0
-        #: optional FlightRecorder (profiler.flight_recorder): when
-        #: attached and enabled, step_begin/step_finish emit one
-        #: StepRecord per step and stamp every emitted token with its
-        #: step id. None (the default) costs one attribute check per step.
-        self.flight_recorder = None
-        self._rec_ctx = None       # per-step_begin wall-split anchors
-        self._rec_preempted = []   # rids parked by _preempt_slot this step
-        self.stats = {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
-                      "draft_tokens_accepted": 0, "preemptions": 0,
-                      "fused_steps": 0, "prefill_tokens": 0,
-                      "prefix_hit_tokens": 0, "prefix_cow_blocks": 0,
-                      "prefix_evicted_blocks": 0,
-                      "decode_time_s": 0.0, "admit_time_s": 0.0,
-                      "dispatch_time_s": 0.0, "host_sync_time_s": 0.0,
-                      "emit_time_s": 0.0}
+        self._admit_order = [0] * self.B
+        self._rec_ctx = None
+        self._rec_preempted = []
+        self._init_device_state()
+        if self.cache_impl == "paged":
+            self._check_pool_invariants()
+        return self
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -493,26 +540,40 @@ class LLMEngine:
 
         K = self.horizon
 
-        def sample_next(logits, rng, temps, top_ps):
+        def sample_next(logits, key, temps, top_ps, rids, lens):
             """THE sample-from-carried-logits prologue: greedy rows argmax,
             sampling rows the filtered categorical, per-slot select. One
             copy consumed by one_step, the spec verify windows, AND the
             fused mixed step (the carried-logits fix once had to be
-            applied in several copies of this code). Returns (nxt, rng)."""
-            rng, sub = jax.random.split(rng)
+            applied in several copies of this code).
+
+            Sampling keys derive as ``fold_in(fold_in(key, rid), pos)``
+            instead of advancing one global split stream: the token
+            sampled at position ``pos`` of request ``rid`` is a pure
+            function of (engine base key, rid, position), so batch
+            composition, pool-pressure preemption replay, and supervised
+            engine RESTART (the fault-tolerance layer's token-exact
+            resumption) cannot change a sampled stream. Greedy rows never
+            consult the key. The non-spec paths leave ``key`` untouched
+            across steps; the spec engine still advances it per verify
+            window (acceptance randomness), so spec resumption is greedy-
+            exact only — documented in docs/architecture.md."""
             greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            sampled = _sample_logits_device(
-                logits, sub, jnp.maximum(temps, 1e-6)[:, None], top_k,
-                top_ps[:, None], False, True)
-            return jnp.where(temps <= 0.0, greedy_tok, sampled), rng
+            keys = jax.vmap(lambda r, p: jax.random.fold_in(
+                jax.random.fold_in(key, r), p))(rids, lens)
+            sampled = jax.vmap(
+                lambda k, row, t, tp: _sample_logits_device(
+                    row, k, jnp.maximum(t, 1e-6), top_k, tp, False, True)
+            )(keys, logits, temps, top_ps)
+            return jnp.where(temps <= 0.0, greedy_tok, sampled)
 
         def one_step(k_bufs, v_bufs, logits, lens, active, rng, state_vals,
-                     temps, top_ps, eos_ids, tables):
+                     temps, top_ps, eos_ids, rids, tables):
             """sample from current logits -> one-token model step.
             ``tables`` selects the cache backend at TRACE time: None ->
             dense SlotKVCache slot buffers; a [B, MB] array -> PagedKVCache
             block pool (ONE body serves both engines)."""
-            nxt, rng = sample_next(logits, rng, temps, top_ps)
+            nxt = sample_next(logits, rng, temps, top_ps, rids, lens)
             # inactive slots decode garbage; pin them to token 0
             nxt = jnp.where(active, nxt, 0)
             with functional_mode(), _bind(state, state_vals):
@@ -541,7 +602,7 @@ class LLMEngine:
             return nxt, new_logits, kb, vb, new_lens, finished, rng
 
         def step(state_vals, k_bufs, v_bufs, logits, lens, active, rng,
-                 temps, top_ps, eos_ids, budgets, tables=None):
+                 temps, top_ps, eos_ids, budgets, rids, tables=None):
             """`horizon` decode iterations as ONE compiled lax.scan — the
             host sync (and through a tunnel, the RTT) amortizes over K
             tokens per slot. A slot that hits eos, capacity, or its
@@ -553,7 +614,7 @@ class LLMEngine:
                 kb, vb, logits, lens, act, emitted, rng = carry
                 nxt, logits, kb, vb, lens, finished, rng = one_step(
                     kb, vb, logits, lens, act, rng, state_vals, temps,
-                    top_ps, eos_ids, tables)
+                    top_ps, eos_ids, rids, tables)
                 emitted = emitted + act.astype(jnp.int32)
                 act_next = act & ~finished & (lens < cap - 1) & \
                     (emitted < budgets)
@@ -573,7 +634,7 @@ class LLMEngine:
         ngram = self.lookup_ngram
 
         def spec_step(state_vals, k_bufs, v_bufs, logits, lens, active, rng,
-                      temps, top_ps, eos_ids, budgets, tokens_buf):
+                      temps, top_ps, eos_ids, budgets, rids, tokens_buf):
             """`horizon` speculative verify windows as ONE compiled scan.
             Each window: in-graph prompt-lookup draft from the device token
             history -> commit one sampled token + verify the Kspec-1 drafts
@@ -585,7 +646,8 @@ class LLMEngine:
                 kb, vb, logits, lens, act, emitted, rng, tbuf = carry
                 draft = _lookup_draft(tbuf, lens, Kspec - 1, ngram)
                 rng, sub2 = jax.random.split(rng)
-                committed, rng = sample_next(logits, rng, temps, top_ps)
+                committed = sample_next(logits, rng, temps, top_ps, rids,
+                                        lens)
                 committed = jnp.where(act, committed, 0)
                 window = jnp.concatenate([committed[:, None], draft],
                                          axis=1)
@@ -629,7 +691,7 @@ class LLMEngine:
                     _pin_rep(lens), rng, tokens_buf)
 
         def fused_step(state_vals, k_bufs, v_bufs, logits, lens, rng, ids,
-                       q_lens, is_decode, active, temps, top_ps,
+                       q_lens, is_decode, active, temps, top_ps, rids,
                        tables=None):
             """ONE mixed prefill+decode dispatch (the fused scheduler's
             step): slot b processes rows [0, q_lens[b]) of ``ids`` —
@@ -640,7 +702,7 @@ class LLMEngine:
             (``lens``); padding rows write nothing (drop-scatter) and
             their outputs are never read. ``tables`` selects the cache
             backend at trace time exactly like ``step``."""
-            nxt, rng = sample_next(logits, rng, temps, top_ps)
+            nxt = sample_next(logits, rng, temps, top_ps, rids, lens)
             # capacity guard for pipelined over-dispatch: a window that
             # would cross the buffer end deactivates in-graph
             active = active & (lens + q_lens <= cap)
@@ -802,7 +864,7 @@ class LLMEngine:
         # same trick for the fused mixed step: one traced body, the
         # `tables` arg selects dense ChunkKVCache vs PagedKVCache
         self._fused_fn = jax.jit(fused_step, donate_argnums=(1, 2, 3))
-        self._spec_fn = jax.jit(spec_step, donate_argnums=(1, 2, 3, 11))
+        self._spec_fn = jax.jit(spec_step, donate_argnums=(1, 2, 3, 12))
         self._prefill_fn = jax.jit(prefill_chunk, donate_argnums=(1, 2))
         self._set_logits_fn = jax.jit(set_logits, donate_argnums=(0,))
         self._set_tokens_fn = jax.jit(set_tokens, donate_argnums=(0,))
@@ -812,12 +874,27 @@ class LLMEngine:
     # request lifecycle
     # ------------------------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=64, temperature=0.0,
-                    top_p=1.0, eos_token_id=None, request_id=None):
+                    top_p=1.0, eos_token_id=None, request_id=None,
+                    committed_tokens=None):
+        """``committed_tokens``: tokens ALREADY generated for this request
+        in a previous life (supervised-restart / failover re-admission).
+        They join the prompt for prefill — exactly the pool-pressure
+        preemption stitch — so the engine's stream CONTINUES: only new
+        tokens hit the stream callback, the returned output prepends the
+        committed ones, and ``max_new_tokens`` counts only NEW tokens.
+        Token-exactness rides the per-(rid, position) fold_in sampling
+        keys: position ``len(prompt)+len(committed)`` samples the same
+        token it would have in the uninterrupted run."""
         ids = np.asarray(
             prompt_ids.numpy() if hasattr(prompt_ids, "numpy")
             else prompt_ids, dtype=np.int32).reshape(-1)
         if len(ids) == 0:
             raise ValueError("empty prompt")
+        committed = [int(t) for t in committed_tokens] \
+            if committed_tokens else []
+        if committed:
+            ids = np.concatenate(
+                [ids, np.asarray(committed, np.int32)])
         if len(ids) >= self.capacity - self.speculative_k:
             raise ValueError(f"prompt of {len(ids)} tokens leaves no room "
                              f"to generate (engine capacity "
@@ -830,6 +907,11 @@ class LLMEngine:
                        for s in self.slots)):
             raise ValueError(f"duplicate request_id {rid!r}")
         self._next_id = max(self._next_id, rid) + 1
+        if committed:
+            # the preemption stitch: _finish_tokens pops this and
+            # prepends it to whatever the slot generates from here on
+            self._preempted_prefix[rid] = \
+                self._preempted_prefix.pop(rid, []) + committed
         self.waiting.append(GenerationRequest(
             rid, ids, int(max_new_tokens), float(temperature), float(top_p),
             eos_token_id))
@@ -1536,6 +1618,12 @@ class LLMEngine:
         PAGED engine allocates pool blocks from host lens before each
         dispatch, so it must run depth 1 (finish before the next begin —
         enforced)."""
+        fi = self.fault_injector
+        if fi is not None:
+            # the chaos hook fires OUTSIDE the model dispatch lock: an
+            # injected hang must wedge only THIS engine's loop, never
+            # sibling replicas tracing through the same model object
+            fi.on_step_begin(self)
         with self._dispatch_lock:
             return self._step_begin_impl()
 
@@ -1669,6 +1757,10 @@ class LLMEngine:
                             for s in self.slots], np.int32)
         budgets = np.array([(s.req.max_new_tokens - len(s.generated))
                             if s else 0 for s in self.slots], np.int32)
+        # per-slot request ids ride into the dispatch: sampling keys are
+        # fold_in(fold_in(base, rid), position) — see sample_next
+        rids = np.array([s.req.request_id if s else 0
+                         for s in self.slots], np.int32)
         for b, cap_left in pool_budget.items():
             budgets[b] = min(budgets[b], cap_left)
 
@@ -1685,19 +1777,19 @@ class LLMEngine:
                  self._lens, self._rng_key) = self._step_paged_fn(
                     self._state_vals, self._k, self._v, self._logits,
                     self._lens, active, self._rng_key, temps, top_ps,
-                    eos_ids, budgets, self._tables.copy())
+                    eos_ids, budgets, rids, self._tables.copy())
         elif spec:
             (toks, counts, was_active, self._logits, self._k, self._v,
              self._lens, self._rng_key, self._tokens) = self._spec_fn(
                 self._state_vals, self._k, self._v, self._logits,
                 self._lens, active, self._rng_key,
-                temps, top_ps, eos_ids, budgets, self._tokens)
+                temps, top_ps, eos_ids, budgets, rids, self._tokens)
         else:
             (toks, was_active, self._logits, self._k, self._v, self._lens,
              self._rng_key) = self._step_fn(
                 self._state_vals, self._k, self._v, self._logits,
                 self._lens, active, self._rng_key,
-                temps, top_ps, eos_ids, budgets)
+                temps, top_ps, eos_ids, budgets, rids)
         dt = time.perf_counter() - t0
         self.stats["dispatch_time_s"] += dt
         self.stats["decode_time_s"] += dt
@@ -1846,6 +1938,8 @@ class LLMEngine:
                           for s in self.slots], np.float32)
         top_ps = np.array([s.req.top_p if s else 1.0
                            for s in self.slots], np.float32)
+        rids = np.array([s.req.request_id if s else 0
+                         for s in self.slots], np.int32)
 
         t0 = time.perf_counter()
         if self.cache_impl == "paged":
@@ -1854,13 +1948,13 @@ class LLMEngine:
                  self._lens, self._rng_key) = self._fused_fn(
                     self._state_vals, self._k, self._v, self._logits,
                     self._lens, self._rng_key, ids, q_lens, is_dec,
-                    active, temps, top_ps, self._tables.copy())
+                    active, temps, top_ps, rids, self._tables.copy())
         else:
             (toks, was_active, self._logits, self._k, self._v, self._lens,
              self._rng_key) = self._fused_fn(
                 self._state_vals, self._k, self._v, self._logits,
                 self._lens, self._rng_key, ids, q_lens, is_dec, active,
-                temps, top_ps)
+                temps, top_ps, rids)
         dt = time.perf_counter() - t0
         self.stats["dispatch_time_s"] += dt
         self.stats["decode_time_s"] += dt
@@ -1907,6 +2001,9 @@ class LLMEngine:
         step. Tokens of a slot whose occupant changed since dispatch
         (retired, cancelled, preempted — possibly already reused) are
         dropped: they were decoded for the old occupant's state."""
+        fi = self.fault_injector
+        if fi is not None:
+            fi.on_step_finish(self)
         spec = pending.spec
         rec = self._rec()
         sid = pending.step_id

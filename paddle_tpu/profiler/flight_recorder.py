@@ -44,9 +44,12 @@ import time
 
 __all__ = ["StepRecord", "FlightRecorder", "TAIL_CAUSES"]
 
-#: the cause labels explain_tail may assign, in priority order
-TAIL_CAUSES = ("preemption", "interfering_prefill", "host_sync",
-               "idle_bubble", "dispatch", "unrecorded")
+#: the cause labels explain_tail may assign, in priority order.
+#: "restart_recovery" outranks everything: the gap spans a supervised
+#: engine restart ("crashed" → "resumed" spans in the request timeline),
+#: so the step facts explain the resumed side only, not the gap.
+TAIL_CAUSES = ("restart_recovery", "preemption", "interfering_prefill",
+               "host_sync", "idle_bubble", "dispatch", "unrecorded")
 
 
 @dataclasses.dataclass
@@ -406,21 +409,33 @@ class FlightRecorder:
         """
         gaps = []
         for rid, tl in self.timelines().items():
+            # a token whose gap spans a supervised restart ("crashed"
+            # span since the previous token) is a RECOVERY gap — its
+            # causal step record describes the resumed engine, not the
+            # stall, so it gets the dedicated cause label
+            crashed_since = False
             for ev in tl["events"]:
-                if ev["kind"] == "token" and ev["value"] is not None:
-                    gaps.append((ev["value"], rid, ev["step_id"]))
+                if ev["kind"] == "crashed":
+                    crashed_since = True
+                elif ev["kind"] == "token" and ev["value"] is not None:
+                    gaps.append((ev["value"], rid, ev["step_id"],
+                                 crashed_since))
+                    crashed_since = False
+                elif ev["kind"] == "token":
+                    crashed_since = False
         if not gaps:
             return []
-        ordered = sorted(v for v, _, _ in gaps)
+        ordered = sorted(g[0] for g in gaps)
         thresh = ordered[min(int(quantile * len(ordered)),
                              len(ordered) - 1)]
         tail = sorted((g for g in gaps if g[0] >= thresh), reverse=True)
         if top is not None:
             tail = tail[:top]
         out = []
-        for gap, rid, sid in tail:
+        for gap, rid, sid, recovered in tail:
             rec = self.get_step(sid) if sid is not None else None
-            cause = self._classify(gap, rec)
+            cause = "restart_recovery" if recovered \
+                else self._classify(gap, rec)
             entry = {"request_id": rid, "gap_s": round(gap, 6),
                      "step_id": sid, "cause": cause,
                      "step": rec.to_dict() if rec is not None else None}

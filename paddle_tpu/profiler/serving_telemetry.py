@@ -50,15 +50,20 @@ STAGES = ("queue_admit", "prefill_dispatch", "schedule", "decode_dispatch",
 #: point-in-time gauges the serve loop samples each pass (pool gauges
 #: stay 0 on the dense engine; budget utilization needs the flight
 #: recorder's last StepRecord and stays 0 without one; prefix gauges
-#: stay 0 unless the engine runs enable_prefix_cache)
+#: stay 0 unless the engine runs enable_prefix_cache). server_healthy
+#: is the health-protocol gauge: 1 while the serve loop heartbeats, 0
+#: when the watchdog declares it hung or a crash lands — the replica
+#: router's failover signal, and 0 on a never-started server.
 GAUGES = ("queue_depth", "engine_waiting", "running_slots",
           "pipeline_inflight", "kv_pool_free_blocks", "kv_pool_occupancy",
           "token_budget_utilization", "prefix_cached_blocks",
-          "prefix_cache_hit_rate")
+          "prefix_cache_hit_rate", "server_healthy")
 
 _COUNTERS = ("requests_submitted", "requests_admitted", "requests_finished",
              "requests_cancelled", "requests_expired",
-             "requests_rejected_queue_full", "tokens_emitted",
+             "requests_rejected_queue_full", "requests_rejected_validation",
+             "requests_shed_deadline", "requests_resumed",
+             "engine_restarts", "faults_injected", "tokens_emitted",
              "engine_steps", "preemptions", "prefill_tokens",
              "prefix_hit_tokens", "prefix_cow_blocks",
              "prefix_evicted_blocks")

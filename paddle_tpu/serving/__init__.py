@@ -10,15 +10,19 @@ admission queue with backpressure, per-request streaming/cancellation/
 deadlines, and per-stage telemetry
 (:mod:`paddle_tpu.profiler.serving_telemetry`).
 
-Entry points: :class:`AsyncLLMServer` (one engine), and the multichip
+Entry points: :class:`AsyncLLMServer` (one engine), the multichip
 layer in :mod:`paddle_tpu.serving.cluster` — :func:`tp_engine` (tensor-
 parallel engine whose KV pools shard across a ``("tp",)`` mesh) and
 :class:`ReplicaRouter` (load- and prefix-affinity-aware placement over N
-server replicas, with drain/failover).
+server replicas, with drain/failover) — and the fault-tolerance layer in
+:mod:`paddle_tpu.serving.faults` — :class:`RestartPolicy` (supervised
+engine restart with token-exact resumption) and :class:`FaultInjector`
+(deterministic scripted chaos for the tier-1 recovery tests).
 """
 from .types import (RequestHandle, RequestState, ServeRequest, ServeResult,
                     ServerClosed, ServerQueueFull)
 from .scheduler import AdmissionQueue
+from .faults import FaultInjector, InjectedFault, RestartPolicy
 from .server import AsyncLLMServer
 from .cluster import (ReplicaRouter, RouterHandle, shard_model_tp,
                       tp_engine, tp_serving_mesh)
@@ -26,4 +30,5 @@ from .cluster import (ReplicaRouter, RouterHandle, shard_model_tp,
 __all__ = ["AsyncLLMServer", "AdmissionQueue", "RequestHandle",
            "RequestState", "ServeRequest", "ServeResult", "ServerClosed",
            "ServerQueueFull", "ReplicaRouter", "RouterHandle",
+           "FaultInjector", "InjectedFault", "RestartPolicy",
            "shard_model_tp", "tp_engine", "tp_serving_mesh"]

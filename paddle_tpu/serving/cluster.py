@@ -153,9 +153,14 @@ class RouterHandle:
         self.resubmits = 0
         #: failover-retry pacing: when every survivor's queue is full, a
         #: resubmission parks back in the outstanding set and retries on
-        #: monitor ticks until the router's retry window closes
+        #: monitor ticks — with capped exponential backoff — until the
+        #: router's retry window closes
         self._retry_since = None
         self._last_try = None
+        self._retry_delay = router.poll_interval_s
+        #: tokens the caller already consumed at failover time — the
+        #: resume_inflight resubmission's continuation point
+        self._resume_tokens = None
 
     @property
     def replica(self):
@@ -289,16 +294,24 @@ class ReplicaRouter:
     started through it: :meth:`start` starts un-started replicas plus the
     failover monitor, :meth:`stop` drains and stops everything.
 
-    Failover contract: when a replica dies (its serving loop crashed),
-    every request it had QUEUED — nothing streamed yet — is resubmitted
-    to a survivor and completes there (greedy re-prefill reproduces the
-    identical stream); every request already STREAMING fails with
-    ``finish_reason="replica_lost"`` carrying the tokens streamed so
-    far. Nothing is silently dropped."""
+    Failover contract: when a replica is LOST — its serving loop
+    crashed terminally, or its :meth:`~AsyncLLMServer.health` probe
+    reports ``"hung"`` (heartbeat stale past ``step_timeout_s``; the
+    thread may still be alive) — every request it had QUEUED (nothing
+    streamed yet) is resubmitted to a survivor and completes there
+    (re-prefill reproduces the identical stream); every request already
+    STREAMING fails with ``finish_reason="replica_lost"`` carrying the
+    tokens streamed so far — or, with ``resume_inflight=True``,
+    resubmits with ``resume_tokens`` and CONTINUES on the survivor
+    (token-exactly for greedy; a sampled tail re-samples under the
+    survivor's keys). A replica mid-supervised-restart (``"restarting"``) takes
+    no new placements but keeps its residents: the resumption is about
+    to happen locally. Nothing is silently dropped."""
 
     def __init__(self, replicas, affinity_weight=2.0, load_weight=1.0,
                  policy="affinity", poll_interval_s=0.01,
-                 failover_retry_s=10.0, seed=0):
+                 failover_retry_s=10.0, max_retry_backoff_s=0.5,
+                 resume_inflight=False, seed=0):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         if policy not in ("affinity", "least_loaded", "random"):
@@ -310,8 +323,27 @@ class ReplicaRouter:
         self.poll_interval_s = float(poll_interval_s)
         #: how long a failover resubmission keeps retrying when every
         #: survivor's queue is full before the request fails as
-        #: replica_lost — transient backpressure must not drop requests
+        #: replica_lost — transient backpressure must not drop requests.
+        #: Retries pace with CAPPED EXPONENTIAL BACKOFF: the delay
+        #: doubles from poll_interval_s up to max_retry_backoff_s, so a
+        #: long backpressure window costs O(log) placement passes, not a
+        #: hot retry loop per parked handle.
         self.failover_retry_s = float(failover_retry_s)
+        self.max_retry_backoff_s = float(max_retry_backoff_s)
+        #: upgrade the failover contract for IN-FLIGHT requests: instead
+        #: of failing with ``replica_lost``, resubmit them to a survivor
+        #: with ``resume_tokens`` = everything the caller has consumed,
+        #: so the stream CONTINUES — token-exactly for GREEDY requests
+        #: (deterministic decode off the identical prefix). A SAMPLED
+        #: stream continues from the consumed prefix but re-samples its
+        #: tail under the survivor's own keys (fresh rid + fresh base
+        #: key): distribution-correct, not bit-exact — unlike
+        #: same-server supervised restart, which IS sampled-exact
+        #: (same engine base key, same rid, per-position fold_in).
+        #: Opt-in: resumption recomputes the undelivered tokens, which
+        #: costs survivor FLOPs a latency-critical cluster may prefer to
+        #: spend on fresh traffic.
+        self.resume_inflight = bool(resume_inflight)
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
         self._outstanding: set[RouterHandle] = set()
@@ -326,6 +358,7 @@ class ReplicaRouter:
         self._monitor = None
         self.stats = {"submitted": 0, "affinity_routed": 0,
                       "resubmitted": 0, "replica_lost": 0,
+                      "resumed": 0, "evicted_hung": 0,
                       "placements": [0] * len(self.replicas)}
 
     # -- lifecycle -------------------------------------------------------
@@ -369,6 +402,20 @@ class ReplicaRouter:
         srv = self.replicas[idx]
         return (srv._thread is not None and srv._thread.is_alive()
                 and srv._crashed is None and srv._accepting)
+
+    def healthy(self, idx):
+        """Placement eligibility: thread-level liveness AND the health
+        protocol's verdict. A ``"hung"`` replica (thread alive, heartbeat
+        stale past its ``step_timeout_s``) takes no new placements and
+        its residents fail over; a ``"restarting"`` one (supervised
+        recovery between crash and re-arm) takes no new placements but
+        its residents stay PUT — the resumption is about to happen."""
+        if not self.alive(idx):
+            return False
+        try:
+            return self.replicas[idx].health()["state"] == "running"
+        except Exception:   # routing heuristic: never let it fail
+            return True
 
     # -- placement -------------------------------------------------------
     def _score(self, idx, ids, hashes=None):
@@ -426,7 +473,7 @@ class ReplicaRouter:
             score, aff = self._score(pin, ids, hashes_for(pin))
             return [(pin, score, aff)]
         cand = [i for i in range(len(self.replicas))
-                if self.alive(i) and i not in self._draining]
+                if self.healthy(i) and i not in self._draining]
         if not cand:
             return []
         if self.policy == "random":
@@ -457,15 +504,22 @@ class ReplicaRouter:
                       eos_token_id=eos_token_id, deadline_s=deadline_s)
         handle = RouterHandle(self, ids, kwargs, routing_key)
         deadline = None if timeout is None else time.monotonic() + timeout
+        delay = self.poll_interval_s
         while True:
             err = self._try_place(handle, ids, pin=replica)
             if err is None:
                 return handle
-            if not block or isinstance(err, ServerClosed):
+            # a validation rejection is the caller's bug, not transient
+            # backpressure — surface it synchronously like a plain
+            # server's submit() would, never retry it
+            if not block or isinstance(err, (ServerClosed, ValueError)):
                 raise err
             if deadline is not None and time.monotonic() > deadline:
                 raise err
-            time.sleep(self.poll_interval_s)
+            # capped exponential backoff: sustained backpressure must
+            # not melt into a hot scoring/placement spin per submitter
+            time.sleep(delay)
+            delay = min(delay * 2.0, self.max_retry_backoff_s)
 
     def _try_place(self, handle, ids, pin=None, resubmit=False):
         """One placement pass over the ranked candidates. Returns None
@@ -493,8 +547,14 @@ class ReplicaRouter:
                     routing["routing_key"] = handle.routing_key
                 try:
                     inner = srv.submit(ids, routing=routing, block=False,
-                                       **handle._kwargs)
-                except (ServerQueueFull, ServerClosed) as e:
+                                       resume_tokens=handle._resume_tokens
+                                       or None, **handle._kwargs)
+                except (ServerQueueFull, ServerClosed, ValueError) as e:
+                    # ValueError: this replica's validation rejected the
+                    # prompt (e.g. prompt⊕resume at ITS capacity edge) —
+                    # a differently-sized survivor may still take it; an
+                    # uncaught raise here would kill the monitor thread
+                    # mid-failover
                     last_err = e
                     continue
                 handle._attach(idx, inner)
@@ -529,6 +589,36 @@ class ReplicaRouter:
                 inner = rh._inner
                 if inner is not None and inner.done:
                     self._resolve(rh)
+            self._failover_hung()
+
+    def _failover_hung(self):
+        """Health-probe failover: a replica whose :meth:`AsyncLLMServer
+        .health` verdict is ``"hung"`` (heartbeat stale past its
+        ``step_timeout_s`` — the loop thread is ALIVE but stuck inside a
+        step) gets its resident requests evicted and failed over NOW,
+        without waiting for the thread to die. ``evict_request`` detaches
+        each handle from the wedged server (a later revival decodes into
+        dropped outputs, never into a double delivery), and the normal
+        resolve path converts the eviction into resubmission — with
+        ``resume_inflight``, stream continuation (greedy-exact)."""
+        for idx, srv in enumerate(self.replicas):
+            try:
+                hung = srv.health()["state"] == "hung"
+            except Exception:
+                hung = False
+            if not hung:
+                continue
+            with self._lock:
+                mine = [rh for rh in self._outstanding
+                        if rh._replica == idx and not rh.done]
+            for rh in mine:
+                inner = rh._inner
+                if inner is not None and not inner.done:
+                    if srv.evict_request(inner.request_id,
+                                         reason="replica_lost") is not None:
+                        with self._lock:
+                            self.stats["evicted_hung"] += 1
+                self._resolve(rh)
 
     def _resolve(self, handle):
         """Turn a finished replica-local result into the routed
@@ -542,20 +632,29 @@ class ReplicaRouter:
             return
         res = inner.result_obj
         reason = res.finish_reason or ""
-        crashed = reason.startswith("server_error")
+        #: "lost" covers both shapes of replica loss: a terminal serve-
+        #: loop crash (server_error) and a hung-replica eviction
+        #: (replica_lost via evict_request) — either way the replica
+        #: cannot finish this request
+        lost = reason.startswith("server_error") or \
+            reason == "replica_lost"
         migrating = handle._migrating and reason == "cancelled"
         streamed = inner.first_token_at is not None
+        # in-flight resumption (opt-in): resubmit with resume_tokens =
+        # everything the caller consumed, so the stream continues
+        # token-exactly on a survivor instead of failing replica_lost
+        resume_stream = lost and streamed and self.resume_inflight
         # a drain-migration that raced its cancel against the first
         # token must NOT resubmit (the caller may already have consumed
         # tokens a fresh greedy stream would repeat) — the cancel stands
-        resubmit = (crashed and not streamed) or \
+        resubmit = (lost and not streamed) or resume_stream or \
             (migrating and not streamed and not handle._streamed)
         now = time.monotonic()
         if resubmit and handle._last_try is not None and \
-                now - handle._last_try < self.poll_interval_s:
-            # pacing: a queue-full retry parked the handle; wait for the
-            # next monitor tick instead of hot-spinning the placement
-            # pass from every blocked caller
+                now - handle._last_try < handle._retry_delay:
+            # pacing: a queue-full retry parked the handle; wait out its
+            # current backoff delay instead of hot-spinning the
+            # placement pass from every blocked caller
             return
         with self._lock:
             if handle not in self._outstanding:
@@ -563,13 +662,13 @@ class ReplicaRouter:
             self._done_with(handle)
             if resubmit:
                 handle._replica = None   # no live placement while parked
-            if crashed and streamed:
+            if lost and streamed and not resume_stream:
                 self.stats["replica_lost"] += 1
-        if not crashed and not migrating:
+        if not lost and not migrating:
             handle._finish(res)
             return
         if not resubmit:
-            if crashed:
+            if lost:
                 # in-flight: tokens already left the building — fail
                 # attributably, carrying everything streamed so far
                 # (handed-out tokens plus any still in the deque —
@@ -584,33 +683,50 @@ class ReplicaRouter:
             else:
                 handle._finish(res)
             return
-        # queued: resubmit to a survivor (placement excludes the dead/
-        # draining replica via alive()/draining checks)
+        if resume_stream:
+            # freeze the dead stream: clear the undelivered deque under
+            # the pop lock so a racing caller can't consume a token the
+            # survivor is about to recompute, then resume from exactly
+            # what the caller HAS seen
+            with inner._cond:
+                inner._tokens.clear()
+                handle._resume_tokens = list(handle._streamed)
+        # resubmit to a survivor (placement excludes the dead/hung/
+        # draining replica via healthy()/draining checks)
         handle._last_try = now
         err = self._try_place(handle, handle.prompt_ids, resubmit=True)
         if err is None:
             handle.resubmits += 1
             handle._retry_since = None
+            handle._retry_delay = self.poll_interval_s
             with self._lock:
                 self.stats["resubmitted"] += 1
+                if resume_stream:
+                    self.stats["resumed"] += 1
             return
         if isinstance(err, ServerQueueFull) and not self._stop_evt.is_set():
             # transient backpressure on the survivors: park the handle
             # back in the outstanding set — the monitor's next tick
-            # retries — until the failover window closes. Dropping it
-            # NOW would convert a momentarily full queue into request
-            # loss.
+            # retries, the delay doubling up to max_retry_backoff_s —
+            # until the failover window closes. Dropping it NOW would
+            # convert a momentarily full queue into request loss.
             if handle._retry_since is None:
                 handle._retry_since = now
             if now - handle._retry_since < self.failover_retry_s:
+                handle._retry_delay = min(handle._retry_delay * 2.0,
+                                          self.max_retry_backoff_s)
                 with self._lock:
                     self._outstanding.add(handle)
                 return
         with self._lock:
             self.stats["replica_lost"] += 1
         handle._finish(ServeResult(
-            res.request_id, list(handle._streamed), "replica_lost",
-            True, routing=inner.request.routing))
+            res.request_id,
+            # a lost replica's terminal result already carries the full
+            # emitted stream (resume prefix included); a failed drain
+            # migration only ever handed out what the caller consumed
+            list(res.token_ids) if lost else list(handle._streamed),
+            "replica_lost", True, routing=inner.request.routing))
 
     # -- drain -----------------------------------------------------------
     def drain(self, idx, timeout=30.0):

@@ -72,7 +72,9 @@ class AsyncLLMServer:
 
     def __init__(self, engine, max_queue_size=64, pipeline_depth=None,
                  poll_interval_s=0.005, telemetry=None,
-                 flight_recorder=None, replica=None):
+                 flight_recorder=None, replica=None, supervise=None,
+                 step_timeout_s=None, fault_injector=None,
+                 shed_deadlines=False):
         """``flight_recorder``: a
         :class:`~paddle_tpu.profiler.flight_recorder.FlightRecorder`
         instance (or ``True`` for a default-sized one) to attach to the
@@ -85,7 +87,43 @@ class AsyncLLMServer:
         (:class:`~paddle_tpu.serving.cluster.ReplicaRouter`). Stamped as
         a ``replica`` label on every Prometheus metric line and as the
         process lane of chrome-trace exports, so N replicas' scrapes and
-        merged traces never collide. None = single-server (unlabeled)."""
+        merged traces never collide. None = single-server (unlabeled).
+
+        ``supervise``: a :class:`~paddle_tpu.serving.RestartPolicy` arms
+        SUPERVISED recovery — a serving-loop crash snapshots every
+        in-flight request (prompt + tokens already streamed), resets the
+        engine (pool/allocator/prefix-store rebuilt, invariants clean),
+        and re-admits each one as prompt⊕streamed-tokens so its stream
+        CONTINUES token-exactly (greedy always; sampled via the per-
+        (request, position) fold_in sampling keys). Restarts are bounded
+        with exponential backoff; an exhausted policy fails every waiter
+        with ``finish_reason="server_error"`` carrying the partial
+        tokens, exactly like the unsupervised (None, default) path.
+
+        ``step_timeout_s``: arms the WATCHDOG — the loop stamps a
+        heartbeat every pass (one monotonic read); a watchdog thread
+        flips the ``server_healthy`` gauge to 0 (and :meth:`health` to
+        ``"hung"``) once the heartbeat goes stale by more than this, and
+        interrupts the stuck step where possible (today: an attached
+        FaultInjector's interruptible hang; a genuinely wedged device
+        call cannot be cancelled — the router fails over around it).
+        Set it ABOVE the worst-case legitimate step (first-step compiles
+        included) or a cold start reads as a hang. None (default): no
+        watchdog thread; :meth:`health` still answers from the
+        heartbeat's age when asked.
+
+        ``fault_injector``: a
+        :class:`~paddle_tpu.serving.FaultInjector` scripted chaos
+        schedule, attached to the engine for the server's lifetime
+        (deterministic crash/hang/queue-full tests — never used in
+        production serving).
+
+        ``shed_deadlines``: deadline-aware load shedding (OFF by
+        default — behavior is bit-identical when False). When on, a
+        request whose ``deadline_s`` budget is already below the
+        telemetry-estimated queue wait + time-to-first-token is finished
+        with ``finish_reason="deadline"`` at submit/admission, BEFORE
+        its prefill burns FLOPs a doomed stream can never repay."""
         if pipeline_depth is not None and pipeline_depth < 1:
             raise ValueError(f"pipeline_depth must be >= 1, "
                              f"got {pipeline_depth}")
@@ -121,6 +159,20 @@ class AsyncLLMServer:
         self._crashed = None
         self._saved_callback = None
         self._saved_recorder = None
+        # ---- fault tolerance (supervise / watchdog / chaos) ----------
+        self.supervise = supervise
+        self.step_timeout_s = (float(step_timeout_s)
+                               if step_timeout_s is not None else None)
+        self.fault_injector = fault_injector
+        self.shed_deadlines = bool(shed_deadlines)
+        #: restarts consumed this lifetime (reset by start())
+        self.restarts = 0
+        self._heartbeat = None      # time.monotonic() of the last loop pass
+        self._hung = False          # watchdog verdict (loop pass clears it)
+        self._recovering = False    # True between a crash and its re-arm
+        self._saved_injector = None
+        self._wd_stop = threading.Event()
+        self._wd_thread = None
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -131,20 +183,44 @@ class AsyncLLMServer:
         if self.flight_recorder is not None:
             self._saved_recorder = self.engine.flight_recorder
             self.engine.flight_recorder = self.flight_recorder
+        if self.fault_injector is not None:
+            self._saved_injector = self.engine.fault_injector
+            self.engine.fault_injector = self.fault_injector
+            self.fault_injector._telemetry = self.telemetry
         self._accepting = True
         self._stopping = False
         self._crashed = None  # a restarted server starts clean
+        self.restarts = 0
+        self._heartbeat = None
+        self._hung = False
+        self._recovering = False
         self.telemetry.reset()
         self._thread = threading.Thread(target=self._loop,
                                         name="paddle-tpu-serving",
                                         daemon=True)
         self._thread.start()
+        if self.step_timeout_s is not None:
+            self._wd_stop.clear()
+            self._wd_thread = threading.Thread(
+                target=self._watchdog_loop, name="paddle-tpu-watchdog",
+                daemon=True)
+            self._wd_thread.start()
         return self
 
     def stop(self, drain=True, timeout=None):
         """Stop the engine thread. ``drain=True`` serves every accepted
         request to completion first; ``drain=False`` cancels everything
-        outstanding."""
+        outstanding.
+
+        A join that times out raises :exc:`TimeoutError` WITHOUT
+        detaching anything — the engine thread still owns the engine
+        (it may be inside a long compile, an injected hang, or a
+        supervised restart's backoff); a second ``stop()`` keeps
+        waiting. A supervised restart already in progress when stop()
+        lands is allowed to COMPLETE: with ``drain=True`` the resumed
+        requests then serve out token-exactly before the loop exits,
+        with ``drain=False`` they are cancelled at the first post-
+        recovery sweep."""
         if self._thread is None:
             return
         self._accepting = False
@@ -165,12 +241,73 @@ class AsyncLLMServer:
                 f"inside a long compile); still draining — call stop() "
                 f"again to keep waiting")
         self._thread = None
+        if self._wd_thread is not None:
+            self._wd_stop.set()
+            self._wd_thread.join()
+            self._wd_thread = None
         self.engine.stream_callback = self._saved_callback
         if self.flight_recorder is not None:
             self.engine.flight_recorder = self._saved_recorder
+        if self.fault_injector is not None:
+            self.engine.fault_injector = self._saved_injector
         if self._crashed is not None:
             raise RuntimeError(
                 f"serving loop crashed: {self._crashed}") from self._crashed
+
+    def health(self):
+        """Point-in-time health probe — answerable from ANY thread, even
+        (especially) while the serve loop is wedged. States:
+
+        * ``"running"`` — loop thread alive and heartbeating: healthy.
+        * ``"hung"`` — thread alive but the heartbeat is stale past
+          ``step_timeout_s`` (watchdog verdict, or computed right here
+          when no watchdog thread runs): the loop is stuck inside a
+          step. The replica router fails over on this state while the
+          thread still lives.
+        * ``"restarting"`` — a supervised recovery is between crash and
+          re-arm (backoff/reset/re-admission). The router places nothing
+          here but does NOT evict: the resumption is about to happen.
+        * ``"crashed"`` — terminal (no policy, or restarts exhausted).
+        * ``"stopped"`` — not started, or stopped.
+
+        Only ``"running"`` is healthy."""
+        now = time.monotonic()
+        thread = self._thread
+        alive = thread is not None and thread.is_alive()
+        hb = self._heartbeat
+        age = (now - hb) if hb is not None else None
+        if self._crashed is not None:
+            state = "crashed"
+        elif not alive:
+            state = "stopped"
+        elif self._recovering:
+            state = "restarting"
+        elif self._hung or (self.step_timeout_s is not None
+                            and age is not None
+                            and age > self.step_timeout_s):
+            state = "hung"
+        else:
+            state = "running"
+        return {"state": state, "healthy": state == "running",
+                "heartbeat_age_s": age, "restarts": self.restarts,
+                "thread_alive": alive}
+
+    def evict_request(self, request_id, reason="evicted"):
+        """Force-finish one request from ANY thread, without the engine
+        thread's help — the router's hung-replica failover hook. The
+        handle detaches immediately (no further tokens can reach it) and
+        finishes with ``finish_reason=reason`` carrying every token
+        emitted so far. The engine is NOT touched: if the wedged loop
+        later revives, the zombie slot decodes to a finish whose output
+        is dropped (its handle is gone) and frees its pool blocks
+        normally. Returns the detached handle, or None if unknown/done."""
+        with self._hlock:
+            h = self._handles.pop(request_id, None)
+        if h is None or h.done:
+            return None
+        self._queue.remove(h)
+        self._finish_handle(h, h.full_stream(), reason)
+        return h
 
     def __enter__(self):
         return self.start()
@@ -185,7 +322,8 @@ class AsyncLLMServer:
     # -- submission ------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=64, temperature=0.0,
                top_p=1.0, eos_token_id=None, deadline_s=None, block=True,
-               timeout=None, routing=None) -> RequestHandle:
+               timeout=None, routing=None,
+               resume_tokens=None) -> RequestHandle:
         """Submit one generation request; returns its streaming
         :class:`RequestHandle`.
 
@@ -203,7 +341,14 @@ class AsyncLLMServer:
         ``ServeResult.routing`` and stamped into the request's trace
         timeline as a ``"routed"`` span, so placement decisions are
         per-request observable (``explain_tail`` carries them on tail
-        entries)."""
+        entries).
+
+        ``resume_tokens``: tokens this request already streamed on a
+        PREVIOUS server (the router's ``resume_inflight`` failover):
+        admission prefills prompt⊕resume_tokens so the stream continues
+        token-exactly — only new tokens stream out of the handle, the
+        terminal result carries the full sequence, and they count
+        against ``max_new_tokens`` (the ORIGINAL total budget)."""
         if self._crashed is not None:
             raise ServerClosed(
                 f"serving loop crashed: {self._crashed}") from self._crashed
@@ -213,18 +358,20 @@ class AsyncLLMServer:
         ids = np.asarray(
             prompt_ids.numpy() if hasattr(prompt_ids, "numpy")
             else prompt_ids, dtype=np.int32).reshape(-1)
+        resume = [int(t) for t in resume_tokens] if resume_tokens else None
+        total = len(ids) + len(resume or [])
         # fail fast on the submitter's thread, mirroring add_request's
         # checks (the engine would only see the prompt much later)
         if len(ids) == 0:
             raise ValueError("empty prompt")
-        if len(ids) >= eng.capacity - eng.speculative_k:
+        if total >= eng.capacity - eng.speculative_k:
             raise ValueError(
-                f"prompt of {len(ids)} tokens leaves no room to generate "
+                f"prompt of {total} tokens leaves no room to generate "
                 f"(engine capacity {eng.capacity})")
         if eng.cache_impl == "paged" and \
-                eng.prefill_blocks_needed(len(ids)) > eng.n_blocks:
+                eng.prefill_blocks_needed(total) > eng.n_blocks:
             raise ValueError(
-                f"prompt of {len(ids)} tokens cannot prefill into the "
+                f"prompt of {total} tokens cannot prefill into the "
                 f"{eng.n_blocks}-block pool")
         with self._hlock:
             rid = self._next_id
@@ -236,9 +383,30 @@ class AsyncLLMServer:
             deadline=(now + float(deadline_s)
                       if deadline_s is not None else None),
             submitted_at=now,
-            routing=dict(routing) if routing is not None else None)
+            routing=dict(routing) if routing is not None else None,
+            resume_tokens=resume)
         handle = RequestHandle(self, req)
         rec = self.flight_recorder
+        if self.shed_deadlines and deadline_s is not None:
+            est = self._admission_estimate_s()
+            if float(deadline_s) < est:
+                # doomed before its prefill would even start: shed NOW,
+                # before it burns FLOPs a dead stream can never repay.
+                # Counters stay reconcilable with the admission-side
+                # shed (which routes through _finish_handle): every
+                # submitted request finishes exactly once.
+                self.telemetry.inc("requests_submitted")
+                self.telemetry.inc("requests_shed_deadline")
+                self.telemetry.inc("requests_finished")
+                self.telemetry.observe("e2e_s",
+                                       time.monotonic() - now)
+                if rec is not None:
+                    rec.req_event(rid, "queued")
+                    rec.req_event(rid, "finish", value="deadline")
+                handle._finish(ServeResult(
+                    rid, list(resume or []), "deadline", True,
+                    e2e_s=0.0, routing=req.routing))
+                return handle
         with self._hlock:
             self._handles[rid] = handle
         if rec is not None:
@@ -249,6 +417,11 @@ class AsyncLLMServer:
             if req.routing is not None:
                 rec.req_event(rid, "routed", value=dict(req.routing))
         try:
+            fi = self.fault_injector
+            if fi is not None:
+                # injected queue_full bursts ride the SAME rejection
+                # bookkeeping as a genuinely full queue
+                fi.on_submit(self)
             self._queue.put(handle, block=block, timeout=timeout)
         except Exception:
             with self._hlock:
@@ -277,58 +450,204 @@ class AsyncLLMServer:
 
     # -- engine thread ---------------------------------------------------
     def _loop(self):
+        """The engine thread's outer SUPERVISOR: run the serve loop; on a
+        crash, either recover (``supervise=RestartPolicy``: snapshot
+        in-flight requests, reset the engine, re-admit each as
+        prompt⊕streamed-tokens and keep serving — token-exact via the
+        per-(rid, position) sampling keys) or fail terminally (every
+        waiter gets ``finish_reason="server_error"`` carrying its partial
+        tokens)."""
+        while True:
+            try:
+                self._serve_loop()
+                # clean exit: a stopped replica must not keep scraping
+                # as healthy (health() already answers "stopped")
+                self.telemetry.set_gauge("server_healthy", 0.0)
+                return
+            except BaseException as e:
+                if not self._recover(e):
+                    return
+
+    def _serve_loop(self):
         tel = self.telemetry
         pending = None
-        try:
-            while True:
-                # "other" covers the loop's own bookkeeping (cancel/
-                # deadline sweeps, finish routing, gauge sampling) so the
-                # attribution explains the busy wall to >= 0.9, not ~0.7
-                with tel.stage("other"):
-                    self._sweep_cancels_and_deadlines()
-                    self._update_gauges()
-                with tel.stage("queue_admit"):
-                    self._feed_engine()
-                    self._mark_admission_stalls()
-                if pending is None:
-                    try:
-                        pending = self._begin_step()
-                    except PoolCapacityError as e:
-                        # exactly the head-request-can-never-admit signal
-                        # (its prompt outgrew the paged pool): fail THAT
-                        # request, not the server. Any other error (device,
-                        # compile) falls to the crash handler below.
-                        self._fail_head_waiting(e)
-                        continue
-                if pending is None:
-                    if self._stopping and not self.num_outstanding() \
-                            and len(self._queue) == 0:
-                        break
-                    with tel.stage("idle"):
-                        self._work_evt.wait(self.poll_interval_s)
-                        self._work_evt.clear()
+        while True:
+            # the watchdog heartbeat: ONE monotonic read per pass (the
+            # whole supervision-off/on overhead budget rides on this
+            # line staying this cheap)
+            self._heartbeat = time.monotonic()
+            self._hung = False
+            # "other" covers the loop's own bookkeeping (cancel/
+            # deadline sweeps, finish routing, gauge sampling) so the
+            # attribution explains the busy wall to >= 0.9, not ~0.7
+            with tel.stage("other"):
+                self._sweep_cancels_and_deadlines()
+                self._update_gauges()
+            with tel.stage("queue_admit"):
+                self._feed_engine()
+                self._mark_admission_stalls()
+            if pending is None:
+                try:
+                    pending = self._begin_step()
+                except PoolCapacityError as e:
+                    # exactly the head-request-can-never-admit signal
+                    # (its prompt outgrew the paged pool): fail THAT
+                    # request, not the server. Any other error (device,
+                    # compile) falls to the supervisor.
+                    self._fail_head_waiting(e)
                     continue
-                nxt = None
-                if self.pipeline_depth > 1:
-                    # THE pipelined-dispatch move: enqueue step N+1 on the
-                    # device before blocking on step N's token transfer
-                    nxt = self._begin_step()
-                done = self._finish_step(pending)
-                if done:
-                    with tel.stage("other"):
-                        self._handle_done(done)
-                pending = nxt
-        except BaseException as e:  # fail every waiter, don't hang them
-            self._crashed = e
+            if pending is None:
+                if self._stopping and not self.num_outstanding() \
+                        and len(self._queue) == 0:
+                    return
+                with tel.stage("idle"):
+                    self._work_evt.wait(self.poll_interval_s)
+                    self._work_evt.clear()
+                continue
+            nxt = None
+            if self.pipeline_depth > 1:
+                # THE pipelined-dispatch move: enqueue step N+1 on the
+                # device before blocking on step N's token transfer
+                nxt = self._begin_step()
+            done = self._finish_step(pending)
+            if done:
+                with tel.stage("other"):
+                    self._handle_done(done)
+            pending = nxt
+
+    def _recover(self, exc):
+        """Crash handler. Returns True when the serve loop should
+        re-enter (supervised restart armed and within budget), False when
+        the crash is terminal (every waiter failed attributably)."""
+        tel = self.telemetry
+        tel.set_gauge("server_healthy", 0.0)
+        rec = self.flight_recorder
+        pol = self.supervise
+        if pol is None or self.restarts >= pol.max_restarts:
+            # terminal: fail every waiter, don't hang them — each result
+            # carries the tokens its stream already received (resume
+            # prefix from a previous replica included). ORDER matters:
+            # _crashed/_accepting flip BEFORE the atomic snapshot+clear,
+            # so a racing submit() either sees the flags and raises
+            # ServerClosed or lands in the snapshot and gets failed —
+            # never a handle nobody will ever finish.
+            self._crashed = exc
             self._accepting = False  # submit() must not feed a dead loop
             with self._hlock:
                 handles = list(self._handles.values())
                 self._handles.clear()
             self._queue.drain()
             for h in handles:
+                if h.done:
+                    continue
+                if rec is not None:
+                    rec.req_event(h.request_id, "crashed", value=str(exc))
                 h._finish(ServeResult(
-                    h.request_id, [], f"server_error: {e}", True,
+                    h.request_id, h.full_stream(),
+                    f"server_error: {exc}", True,
                     routing=h.request.routing))
+            return False
+        # ---- supervised restart --------------------------------------
+        with self._hlock:
+            handles = [h for h in self._handles.values() if not h.done]
+        self._recovering = True
+        self.restarts += 1
+        tel.inc("engine_restarts")
+        resident = [h for h in handles
+                    if h.state in (RequestState.PENDING,
+                                   RequestState.RUNNING)]
+        if rec is not None:
+            for h in resident:
+                rec.req_event(h.request_id, "crashed", value=str(exc))
+        # a crash LOOP must not spin the engine thread
+        time.sleep(self.supervise.delay(self.restarts))
+        try:
+            self.engine.reset()
+        except BaseException as reset_exc:  # engine unrecoverable
+            self._recovering = False
+            self.supervise = None   # force the terminal path
+            return self._recover(reset_exc)
+        # re-admit every engine-resident request as prompt⊕streamed so
+        # its stream CONTINUES (oldest first — the original admission
+        # order, so slot/pool layout replays deterministically)
+        for h in sorted(resident, key=lambda h: h.request.request_id):
+            committed = h.full_stream()
+            if self._readmit(h, committed):
+                tel.inc("requests_resumed")
+                if rec is not None:
+                    rec.req_event(h.request_id, "resumed",
+                                  value=len(committed))
+        self._recovering = False
+        self._wake()
+        return True
+
+    def _readmit(self, handle, committed):
+        """Hand one request to the engine as prompt⊕``committed``
+        (tokens it already streamed in a previous life — a supervised
+        restart's snapshot, or a failover resume prefix; empty for a
+        fresh admission). THE one copy of the re-admission edge cases:
+        a stream that already emitted its eos token finishes ``"eos"``
+        right here (re-prefilling it would decode PAST the eos — the
+        crash merely beat the finished output's routing), an exhausted
+        budget finishes ``"length"``, and an engine validation error
+        finishes ``"rejected"`` on the `requests_rejected_validation`
+        counter. Returns True when the request entered the engine."""
+        req = handle.request
+        eng = self.engine
+        eos = req.eos_token_id
+        if committed and eos is not None and committed[-1] == eos:
+            self._finish_handle(handle, committed, "eos")
+            return False
+        remaining = req.max_new_tokens - len(committed)
+        if committed and remaining <= 0:
+            self._finish_handle(handle, committed, "length")
+            return False
+        if committed and len(req.prompt_ids) + len(committed) >= \
+                eng.capacity - eng.speculative_k:
+            # the stream GREW to the engine's buffer edge before the
+            # crash/failover: the uninterrupted run would have retired
+            # it "capacity" — re-prefilling would only trip add_request
+            # validation and mislabel a complete stream as rejected
+            self._finish_handle(handle, committed, "capacity")
+            return False
+        try:
+            self.engine.add_request(
+                req.prompt_ids, max_new_tokens=remaining,
+                temperature=req.temperature, top_p=req.top_p,
+                eos_token_id=eos, request_id=req.request_id,
+                committed_tokens=committed or None)
+        except ValueError as e:
+            # the rejection must be visible in telemetry, not just on
+            # the handle — a silent validation drop looks like a lost
+            # request to a dashboard
+            self.telemetry.inc("requests_rejected_validation")
+            self._finish_handle(handle, committed, f"rejected: {e}")
+            return False
+        handle.state = RequestState.PENDING
+        return True
+
+    def _watchdog_loop(self):
+        """Stale-heartbeat monitor (armed by ``step_timeout_s``). Flips
+        the ``server_healthy`` gauge and the :meth:`health` verdict to
+        hung, and interrupts the stuck step where the runtime allows it —
+        today that means an attached FaultInjector's interruptible hang
+        (the scripted stand-in for a cancellable device call); a
+        genuinely wedged dispatch cannot be cancelled from outside, the
+        router fails over around it instead."""
+        period = min(self.step_timeout_s / 4.0, 0.05)
+        while not self._wd_stop.wait(period):
+            hb = self._heartbeat
+            thread = self._thread
+            if (hb is None or self._recovering or self._crashed is not None
+                    or thread is None or not thread.is_alive()):
+                continue
+            if time.monotonic() - hb > self.step_timeout_s \
+                    and not self._hung:
+                self._hung = True
+                self.telemetry.set_gauge("server_healthy", 0.0)
+                fi = self.fault_injector
+                if fi is not None and fi.hanging:
+                    fi.interrupt()
 
     def _fail_head_waiting(self, err):
         eng = self.engine
@@ -399,12 +718,20 @@ class AsyncLLMServer:
         tel.inc("engine_steps")
         return done
 
+    def _admission_estimate_s(self):
+        """Telemetry-estimated latency a fresh submission pays before its
+        first token: observed mean queue wait + mean TTFT. 0.0 on a cold
+        server (no observations yet) — deadline shedding never fires
+        before the estimator has data, so a cold start sheds nothing."""
+        tel = self.telemetry
+        return tel.queue_wait_s.mean + tel.ttft_s.mean
+
     def _feed_engine(self):
         """Move queued requests into the engine's waiting deque — only as
         many as could plausibly admit (engine backlog stays ≤ max_batch)
         so queue-wait is measured HERE and cancellation of queued
         requests never has to dig through engine state."""
-        eng = self.engine
+        eng, tel = self.engine, self.telemetry
         while len(eng.waiting) < eng.B:
             handle = self._queue.pop()
             if handle is None:
@@ -412,16 +739,15 @@ class AsyncLLMServer:
             if handle.done:          # cancelled/expired while queued
                 continue
             req = handle.request
-            try:
-                eng.add_request(
-                    req.prompt_ids, max_new_tokens=req.max_new_tokens,
-                    temperature=req.temperature, top_p=req.top_p,
-                    eos_token_id=req.eos_token_id,
-                    request_id=req.request_id)
-            except ValueError as e:
-                self._finish_handle(handle, [], f"rejected: {e}")
-                continue
-            handle.state = RequestState.PENDING
+            resume = list(req.resume_tokens or [])
+            if self.shed_deadlines and req.deadline is not None:
+                # admission-side shed: the queue wait is already paid,
+                # so the bar is the remaining budget vs estimated TTFT
+                if req.deadline - time.monotonic() < tel.ttft_s.mean:
+                    tel.inc("requests_shed_deadline")
+                    self._finish_handle(handle, resume, "deadline")
+                    continue
+            self._readmit(handle, resume)
 
     def _update_gauges(self):
         """Sample the point-in-time engine state into the telemetry
@@ -429,6 +755,9 @@ class AsyncLLMServer:
         per step. One pass is a handful of O(B) reads; it runs every
         loop iteration so the gauges stay fresh even while idle."""
         eng, tel = self.engine, self.telemetry
+        # the loop is provably passing right now — that IS healthy (a
+        # watchdog hang verdict or a crash flips it to 0 from outside)
+        tel.set_gauge("server_healthy", 1.0)
         tel.set_gauge("queue_depth", len(self._queue))
         tel.set_gauge("engine_waiting", len(eng.waiting))
         tel.set_gauge("running_slots",
@@ -529,7 +858,9 @@ class AsyncLLMServer:
             if not h.cancel_requested and not expired:
                 continue
             reason = "cancelled" if h.cancel_requested else "deadline"
-            tokens = []
+            # a still-queued handle has generated nothing HERE, but a
+            # failover resume carries its previous replica's tokens
+            tokens = list(h.request.resume_tokens or [])
             if h.state is RequestState.QUEUED:
                 self._queue.remove(h)
             else:

@@ -55,6 +55,13 @@ class ServeRequest:
     #: stamped into the request's flight-recorder trace as a "routed"
     #: span, so per-request placement is observable in explain_tail.
     routing: dict | None = None
+    #: tokens this request ALREADY streamed on a previous server/replica
+    #: (failover resumption): admission prefills prompt⊕resume_tokens and
+    #: the engine stitches them back in front of the continuation, so the
+    #: terminal ServeResult carries the full stream while only NEW tokens
+    #: stream out. They count against ``max_new_tokens`` (the ORIGINAL
+    #: total budget — the engine generates the remainder).
+    resume_tokens: list | None = None
 
 
 @dataclasses.dataclass
@@ -97,6 +104,10 @@ class RequestHandle:
         self.request = req
         self._cond = threading.Condition()
         self._tokens = collections.deque()
+        #: EVERY token ever emitted to this handle, consumed or not — the
+        #: supervised-restart / failover resume record: prompt⊕emitted is
+        #: exactly the state a recovered engine must continue from
+        self.emitted: list = []
         self.state = RequestState.QUEUED
         self.result_obj: ServeResult | None = None
         self.cancel_requested = False
@@ -116,10 +127,19 @@ class RequestHandle:
     def done(self):
         return self.state is RequestState.FINISHED
 
+    def full_stream(self):
+        """EVERYTHING this request ever streamed, across servers: the
+        failover resume prefix (tokens from a previous replica) plus
+        every token emitted here. THE definition the fault-tolerance
+        layer builds results and restart re-admissions from — one copy,
+        or eviction and recovery silently desynchronize."""
+        return list(self.request.resume_tokens or []) + list(self.emitted)
+
     # -- engine-thread side ---------------------------------------------
     def _emit(self, tok):
         with self._cond:
             self._tokens.append(tok)
+            self.emitted.append(tok)
             now = time.monotonic()
             if self.first_token_at is None:
                 self.first_token_at = now

@@ -23,7 +23,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.inference import LLMEngine
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-from paddle_tpu.serving import AsyncLLMServer, ReplicaRouter
+from paddle_tpu.serving import (AsyncLLMServer, FaultInjector,
+                                ReplicaRouter, RestartPolicy)
 from paddle_tpu.serving.cluster import shard_model_tp, tp_engine
 
 V = 96
@@ -225,14 +226,17 @@ def _ref_tokens(ref_eng, prompts, n):
     return [o.token_ids for o in outs]
 
 
-def _replica(model, i, **kw):
+def _replica(model, i, fault_injector=None, **kw):
+    srv_kw = {k: kw.pop(k) for k in ("step_timeout_s", "supervise")
+              if k in kw}
     kw.setdefault("max_batch", 2)
     kw.setdefault("max_seq_len", 64)
     kw.setdefault("chunk_size", 16)
     eng = LLMEngine(model, cache_impl="paged", block_size=8,
                     scheduler="fused", enable_prefix_cache=True, **kw)
     return AsyncLLMServer(eng, max_queue_size=8, replica=i,
-                          flight_recorder=True)
+                          flight_recorder=True,
+                          fault_injector=fault_injector, **srv_kw)
 
 
 def _shared_prompts(seed, sys_len, tail_sizes):
@@ -339,7 +343,8 @@ def test_router_least_loaded_spreads(router_model):
 
 
 def test_router_failover_mid_stream(router_model, router_ref_eng):
-    """Kill a replica mid-stream under load: its QUEUED requests
+    """Kill a replica mid-stream under load (a scripted
+    FaultInjector.kill(), not ad-hoc thread murder): its QUEUED requests
     complete on the survivor with the exact tokens a healthy serve
     produces, its IN-FLIGHT request fails with
     finish_reason="replica_lost" (carrying the tokens streamed so far),
@@ -348,7 +353,8 @@ def test_router_failover_mid_stream(router_model, router_ref_eng):
     prompts = _shared_prompts(1, 16, (5, 7, 3))
     want = _ref_tokens(router_ref_eng, prompts, 6)
 
-    srv0 = _replica(router_model, 0, max_batch=1)
+    fi0 = FaultInjector()
+    srv0 = _replica(router_model, 0, fault_injector=fi0, max_batch=1)
     srv1 = _replica(router_model, 1)
     router = ReplicaRouter([srv0, srv1])
     router.start()
@@ -361,9 +367,7 @@ def test_router_failover_mid_stream(router_model, router_ref_eng):
         stream = iter(h_live)
         first = next(stream)          # it is genuinely mid-stream
 
-        def boom(*a, **kw):
-            raise RuntimeError("injected replica death")
-        srv0.engine.step_begin = boom
+        fi0.kill("injected replica death")
 
         lost = h_live.result(timeout=300)
         assert lost.finish_reason == "replica_lost"
@@ -396,6 +400,142 @@ def test_router_failover_mid_stream(router_model, router_ref_eng):
     # the dead replica's crash surfaces at stop, attributably
     assert [i for i, _ in errors] == [0]
     assert "injected replica death" in str(errors[0][1])
+
+
+@pytest.mark.slow
+def test_router_hung_replica_failover_resume(router_model,
+                                             router_ref_eng):
+    """Health-probe failover: a replica wedged INSIDE a step (thread
+    ALIVE, heartbeat stale past step_timeout_s) flips health() to
+    "hung"; the router evicts its residents without waiting for the
+    thread to die, and — with resume_inflight=True — the stream
+    CONTINUES token-exactly on the survivor from what the caller
+    already consumed. Slow lane: the wedge must outlive failover wall
+    (seconds) by construction; the tier-1 watchdog/hang coverage lives
+    in tests/test_faults.py."""
+    prompts = _shared_prompts(3, 16, (5,))
+    want = _ref_tokens(router_ref_eng, prompts, 10)
+    fi0 = FaultInjector()
+    srv0 = _replica(router_model, 0, fault_injector=fi0,
+                    step_timeout_s=0.5)
+    srv1 = _replica(router_model, 1)
+    # warm the compile caches BEFORE arming the tight step_timeout_s —
+    # a cold first-step compile would read as a hang
+    for srv in (srv0, srv1):
+        srv.engine.generate([prompts[0]], max_new_tokens=2)
+        srv.engine.reset()
+    router = ReplicaRouter([srv0, srv1], resume_inflight=True)
+    router.start()
+    try:
+        h = router.submit(prompts[0], max_new_tokens=10, replica=0)
+        first = next(iter(h))
+        # long enough that failover (~0.5s stale + resume serve) runs
+        # to completion while the victim is still wedged; short enough
+        # that the teardown stop() isn't parked long once it ends
+        fi0.hang_at_step(5, seconds=3.5, interruptible=False)
+        res = h.result(timeout=300)
+        # the wedged replica was failed over AROUND, not waited out
+        assert res.finish_reason in ("length", "eos")
+        assert res.token_ids == want[0]
+        assert res.token_ids[0] == first
+        assert h.replica == 1 and h.resubmits == 1
+        assert router.stats["evicted_hung"] >= 1
+        assert router.stats["resumed"] >= 1
+        # the thread is still alive — this was a HEALTH failover
+        assert router.alive(0) and not router.healthy(0)
+        assert srv0.health()["state"] == "hung"
+        # the gauge flips on the next watchdog tick (<= timeout/4 after
+        # the heartbeat goes stale) — the router's health() age check
+        # can legitimately beat it by one tick
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and \
+                srv0.telemetry.get_gauges()["server_healthy"] != 0.0:
+            time.sleep(0.01)
+        assert srv0.telemetry.get_gauges()["server_healthy"] == 0.0
+        srv1.engine._check_pool_invariants()
+    finally:
+        router.stop(timeout=120)
+
+
+@pytest.mark.slow
+def test_router_supervised_replica_recovers_in_place(router_model,
+                                                     router_ref_eng):
+    """A SUPERVISED replica's crash is not a failover event: health
+    reports "restarting" (no new placements, residents stay), the
+    restart resumes every stream in place, and the router's
+    resubmission machinery never fires. Slow lane: single-server
+    supervised recovery is tier-1-covered in tests/test_faults.py;
+    this adds the through-the-router angle."""
+    prompts = _shared_prompts(9, 16, (5, 7))
+    want = _ref_tokens(router_ref_eng, prompts, 6)
+    fi0 = FaultInjector().crash_at_step(3)
+    srv0 = _replica(router_model, 0, fault_injector=fi0,
+                    supervise=RestartPolicy(max_restarts=1,
+                                            backoff_s=0.01))
+    srv1 = _replica(router_model, 1)
+    router = ReplicaRouter([srv0, srv1])
+    router.start()
+    try:
+        hs = [router.submit(p, max_new_tokens=6, replica=0)
+              for p in prompts]
+        results = [h.result(timeout=300) for h in hs]
+        assert [r.token_ids for r in results] == want
+        assert all(h.replica == 0 and h.resubmits == 0 for h in hs)
+        assert srv0.restarts == 1
+        assert router.stats["resubmitted"] == 0
+        assert router.stats["replica_lost"] == 0
+        srv0.engine._check_pool_invariants()
+    finally:
+        router.stop()
+
+
+@pytest.mark.slow
+def test_chaos_soak_three_replicas(router_model, router_ref_eng):
+    """The scripted-chaos soak the ISSUE asks for: a seeded random
+    fault schedule (crashes + sub-watchdog hangs) over 3 supervised
+    replicas under mixed load. Every stream either finishes
+    TOKEN-EXACTLY (in-place restart or resume_inflight failover) or
+    fails attributably; pool invariants hold everywhere
+    (PADDLE_TPU_POOL_CHECKS armed suite-wide)."""
+    rng = np.random.default_rng(42)
+    prompts = _shared_prompts(10, 24, tuple(3 + i % 9 for i in range(18)))
+    want = _ref_tokens(router_ref_eng, prompts, 8)
+    fis = [FaultInjector() for _ in range(3)]
+    replicas = [_replica(router_model, i, fault_injector=fis[i],
+                         supervise=RestartPolicy(max_restarts=3,
+                                                 backoff_s=0.01),
+                         step_timeout_s=5.0)
+                for i in range(3)]
+    for srv in replicas:   # compile before the watchdog arms
+        srv.engine.generate([prompts[0][:8]], max_new_tokens=2)
+        srv.engine.reset()
+    # the scripted "random" schedule: deterministic under the seed, so
+    # a failure replays exactly
+    for fi in fis:
+        for step in sorted(int(s) for s in rng.integers(2, 40, size=3)):
+            if rng.random() < 0.5:
+                fi.crash_at_step(step)
+            else:
+                fi.hang_at_step(step, seconds=0.2)
+    router = ReplicaRouter(replicas, resume_inflight=True)
+    router.start()
+    try:
+        handles = [router.submit(p, max_new_tokens=8) for p in prompts]
+        results = [h.result(timeout=600) for h in handles]
+        exact = 0
+        for r, tokens in zip(results, want):
+            if r.finish_reason in ("length", "eos"):
+                assert r.token_ids == tokens
+                exact += 1
+            else:   # attributable, never silent
+                assert r.finish_reason in ("replica_lost",), r
+        assert exact >= len(prompts) - 2    # chaos, not carnage
+        assert sum(len(fi.fired) for fi in fis) >= 3
+        for srv in replicas:
+            if srv._crashed is None:
+                srv.engine._check_pool_invariants()
+    finally:
+        router.stop(timeout=120)
 
 
 def test_router_drain_migrates_queued(router_model, router_ref_eng):
@@ -541,7 +681,8 @@ def test_failover_retries_through_full_survivor_queue(router_model,
     (failover_retry_s window)."""
     prompts = _shared_prompts(6, 16, (5, 7, 3, 4))
     want = _ref_tokens(router_ref_eng, prompts, 4)
-    srv0 = _replica(router_model, 0, max_batch=1)
+    fi0 = FaultInjector()
+    srv0 = _replica(router_model, 0, fault_injector=fi0, max_batch=1)
     srv1 = AsyncLLMServer(
         LLMEngine(router_model, max_batch=1, max_seq_len=64,
                   chunk_size=16, cache_impl="paged", block_size=8,
@@ -561,9 +702,7 @@ def test_failover_retries_through_full_survivor_queue(router_model,
         # victim: one queued request, then crash
         h_q = router.submit(prompts[3], max_new_tokens=4, replica=0)
 
-        def boom(*a, **kw):
-            raise RuntimeError("injected replica death")
-        srv0.engine.step_begin = boom
+        fi0.kill("injected replica death")
         res = h_q.result(timeout=300)
         assert res.finish_reason in ("length", "eos")
         assert res.token_ids == want[3]

@@ -338,6 +338,18 @@ def test_telemetry_strict_names_and_register():
         tel.inc("prefix_hit_token")
     with pytest.raises(KeyError, match="unknown telemetry gauge"):
         tel.set_gauge("prefix_cache_hitrate", 0.5)
+    # the fault-tolerance names are declared (not phantom-forked) ...
+    tel.inc("requests_rejected_validation")
+    tel.inc("requests_shed_deadline")
+    tel.inc("requests_resumed")
+    tel.inc("engine_restarts")
+    tel.inc("faults_injected")
+    tel.set_gauge("server_healthy", 1.0)
+    # ... and their typos still raise
+    with pytest.raises(KeyError, match="unknown telemetry counter"):
+        tel.inc("request_rejected_validation")
+    with pytest.raises(KeyError, match="unknown telemetry gauge"):
+        tel.set_gauge("server_health", 1.0)
     with pytest.raises(ValueError, match="register kind"):
         tel.register("histogram", "x")
     tel.register("stage", "custom_stage")
