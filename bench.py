@@ -1,11 +1,14 @@
 """Driver benchmark — one JSON line per BASELINE workload config.
 
 Default (`BENCH_MODEL` unset / `all`): runs every BASELINE.md config plus
-the decode and serving benchmarks — resnet50, bert, vit, unet, llama_decode,
-llama_paged_decode (Pallas paged-attention kernel on/off A/B),
-llama_serve, llama_serve_fused (fused prefill+decode scheduler on/off
-A/B), llama_serve_prefix_cache (automatic prefix caching on/off A/B:
-shared-system-prompt hit-rate + zero-reuse overhead guard),
+the decode and serving benchmarks — resnet50, bert, vit, unet, llama_decode
+(plus its int8/int4 weight-only rungs, re-baselining the quantized decode
+ratios every run), llama_paged_decode (Pallas paged-attention kernel
+on/off A/B), llama_serve (flight-recorder, supervision AND multi-step
+readout-stride on/off A/Bs — the latter reports per-arm
+rtt/dispatch/host-sync shares), llama_serve_fused (fused prefill+decode
+scheduler on/off A/B), llama_serve_prefix_cache (automatic prefix caching
+on/off A/B: shared-system-prompt hit-rate + zero-reuse overhead guard),
 llama_serve_spec, then the flagship llama LAST — each in its own
 subprocess, one JSON line each, so the tail line stays the llama MFU vs
 the 45% north star (BASELINE.json).
@@ -102,6 +105,82 @@ def _artifact_dir():
                      "docs", "artifacts"))
     os.makedirs(d, exist_ok=True)
     return d
+
+
+def _serve_multi_step_ab(model, prompts, new_tokens, B, cap, stride,
+                         rtt_s=0.0, chunk_size=256, pipeline_depth=2,
+                         timeout=1800):
+    """Multi-step on-device decode A/B: the same prompts served through
+    TWO fused-scheduler engines — ``readout_stride=stride`` (the k-step
+    compiled decode loop with in-graph early exit) vs ``stride=1`` (one
+    host round-trip per decode step). Per arm, the host-tax split comes
+    from the FLIGHT RECORDER's StepRecords (the engine-measured
+    dispatch/sync wall splits, summed over the run):
+
+    * ``host_sync_share``  — device→host token syncs / wall,
+    * ``dispatch_share``   — host-side dispatch enqueue / wall,
+    * ``rtt_share``        — rtt_s x host round-trips / wall (each
+      StepRecord is one round-trip; the stride arm makes ~1/k as many),
+    * ``host_tax_s`` / ``host_tax_ms_per_token`` — host_sync + dispatch
+      in ABSOLUTE seconds (and per token). The arms serve the identical
+      workload, so this is the fair cross-arm comparison everywhere: on
+      CPU the dispatch timer absorbs blocked device compute (no real
+      async enqueue), which inflates the FASTER arm's share-of-own-wall
+      even as its absolute host tax drops; on TPU (true async dispatch)
+      the share comparison agrees with the absolute one.
+
+    Greedy streams must be token-exact across arms (asserted); the
+    returned dict carries both arms plus ``multi_step_speedup``.
+    Shared by the llama_serve bench and the tier-1 CPU smoke test."""
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.serving import AsyncLLMServer
+    from paddle_tpu.profiler import FlightRecorder
+
+    arms, streams = {}, {}
+    for arm, s in (("off", 1), ("on", int(stride))):
+        eng = LLMEngine(model, max_batch=B, max_seq_len=cap,
+                        chunk_size=chunk_size, scheduler="fused",
+                        readout_stride=s)
+        eng.generate([prompts[0]], max_new_tokens=2)  # warm the programs
+        eng.reset_stats()
+        rec = FlightRecorder()
+        srv = AsyncLLMServer(eng, max_queue_size=len(prompts) + 1,
+                             flight_recorder=rec,
+                             pipeline_depth=pipeline_depth)
+        srv.start()
+        t0 = time.perf_counter()
+        hs = [srv.submit(p, max_new_tokens=new_tokens) for p in prompts]
+        outs = [h.result(timeout=timeout) for h in hs]
+        wall = time.perf_counter() - t0
+        srv.stop()
+        toks = sum(len(o.token_ids) for o in outs)
+        recs = rec.records()
+        sync_s = sum(r.sync_s for r in recs)
+        disp_s = sum(r.dispatch_s for r in recs)
+        arms[arm] = {
+            "readout_stride": s,
+            "tokens_per_sec": round(toks / wall, 1),
+            "host_round_trips": len(recs),
+            "multi_steps": int(eng.stats["multi_steps"]),
+            "host_sync_share": round(sync_s / wall, 4),
+            "dispatch_share": round(disp_s / wall, 4),
+            "rtt_share": round(rtt_s * len(recs) / wall, 4),
+            "host_tax_s": round(sync_s + disp_s, 4),
+            "host_tax_ms_per_token": round(
+                (sync_s + disp_s) / max(toks, 1) * 1e3, 4),
+            "pipeline_depth": srv.pipeline_depth,
+        }
+        streams[arm] = [o.token_ids for o in outs]
+    token_parity = streams["on"] == streams["off"]
+    assert token_parity, "multi-step decode changed a greedy stream"
+    return {
+        "multi_step_speedup": round(
+            arms["on"]["tokens_per_sec"]
+            / max(arms["off"]["tokens_per_sec"], 1e-9), 3),
+        "readout_stride": int(stride),
+        "token_parity": token_parity,
+        "on": arms["on"], "off": arms["off"],
+    }
 
 
 def _bench_other(model_name):
@@ -656,6 +735,18 @@ def _bench_other(model_name):
             sup_off.append(serve_pass(None)[0])
         sup_overhead_pct = round(
             (median(sup_off) - median(sup_on)) / median(sup_off) * 100, 2)
+
+        # multi-step on-device decode A/B (ROADMAP item 6): the same
+        # prompts re-served through fused engines at readout_stride=k
+        # vs 1, with per-arm rtt/dispatch/host-sync shares read off the
+        # flight recorder — the host-tax split this PR exists to shrink.
+        # The spec bench keeps its legacy engine (verify windows need
+        # it) and reports only its rtt_share trend below.
+        multi_ab = None
+        if not spec_mode:
+            ms_stride = int(os.environ.get("BENCH_READOUT_STRIDE", "8"))
+            multi_ab = _serve_multi_step_ab(
+                model, prompts, new_tokens, B, cap, ms_stride, rtt_s=rtt)
         art_dir = _artifact_dir()
         stem = "llama_serve_spec" if spec_mode else "llama_serve"
         trace_path = os.path.join(art_dir, f"{stem}_trace.json")
@@ -744,7 +835,22 @@ def _bench_other(model_name):
                "ttft_p50_ms": round(lat["ttft"]["p50_s"] * 1e3, 1),
                "e2e_p50_ms": round(lat["e2e"]["p50_s"] * 1e3, 1),
                "rtt_est_ms": round(rtt * 1e3, 1),
+               # host-RTT share of the serve wall (rtt x host passes /
+               # wall) — the r05 tax this line tracks the TREND of:
+               # llama_serve 0.233 / llama_serve_spec 0.324 at r05
+               "rtt_share": round(rtt * steps / wall, 4),
+               "rtt_share_r05": 0.324 if spec_mode else 0.233,
                "weight_dtype": weight_dtype or "bf16"}
+        if multi_ab is not None:
+            # the multi-step decode A/B: speedup + per-arm host-tax
+            # split. The stride arm's host_sync + dispatch tax must sit
+            # strictly below the stride-off arm's — tier-1's CPU smoke
+            # asserts the structurally-stride-tied components (round
+            # trips, rtt share, host_sync share); the dispatch-inclusive
+            # comparison is meaningful where dispatch is a pure enqueue
+            # (TPU), see _serve_multi_step_ab's docstring
+            out["multi_step_speedup"] = multi_ab["multi_step_speedup"]
+            out["multi_step"] = multi_ab
         if spec_k > 1:
             out["speculative_k"] = spec_k
             out["draft_tokens_accepted"] = stats_off["draft_tokens_accepted"]
@@ -1584,11 +1690,26 @@ def _run_all():
     rest."""
     import subprocess
     import sys
-    for name in ["resnet50", "bert", "vit", "unet", "llama_decode",
-                 "llama_paged_decode", "llama_serve", "llama_serve_fused",
-                 "llama_serve_prefix_cache", "llama_serve_cluster",
-                 "llama_serve_spec", "llama"]:
+    # the int8/int4 rungs re-baseline the weight-only-quantized decode
+    # ratios IN the ladder (same two-length-differential harness, same
+    # subprocess isolation) — the 1.35x/1.67x numbers ROUND5_NOTES
+    # flagged "pending re-baseline" regenerate here on every `all` run
+    # instead of being re-quoted (compare their tokens/s against the
+    # bf16 llama_decode line; each JSON line carries weight_dtype).
+    for name, extra in [
+            ("resnet50", None), ("bert", None), ("vit", None),
+            ("unet", None), ("llama_decode", None),
+            ("llama_decode_int8",
+             {"BENCH_MODEL": "llama_decode", "BENCH_WEIGHT_DTYPE": "int8"}),
+            ("llama_decode_int4",
+             {"BENCH_MODEL": "llama_decode", "BENCH_WEIGHT_DTYPE": "int4"}),
+            ("llama_paged_decode", None), ("llama_serve", None),
+            ("llama_serve_fused", None), ("llama_serve_prefix_cache", None),
+            ("llama_serve_cluster", None), ("llama_serve_spec", None),
+            ("llama", None)]:
         env = dict(os.environ, BENCH_MODEL=name)
+        if extra:
+            env.update(extra)
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,

@@ -38,15 +38,24 @@ def main():
 
     # -- the production shape: AsyncLLMServer over the fused scheduler
     # (admission = slot assignment; prefill chunks interleave into the
-    # decode batch under max_step_tokens instead of stalling it) --------
+    # decode batch under max_step_tokens instead of stalling it).
+    # readout_stride=4: all-decode steps run up to 4 decode iterations
+    # as ONE compiled on-device loop (in-graph early exit when every
+    # slot finishes), so the host syncs once per 4 tokens — the
+    # throughput tier. pipeline_depth=3: up to 3 dispatches in flight
+    # ahead of the oldest readout (the fused engines' depth contract).
     eng = LLMEngine(model, max_batch=4, max_seq_len=128, chunk_size=32,
-                    scheduler="fused")
-    with AsyncLLMServer(eng, max_queue_size=16,
+                    scheduler="fused", readout_stride=4)
+    with AsyncLLMServer(eng, max_queue_size=16, pipeline_depth=3,
                         flight_recorder=True) as server:
         handles = [
             server.submit(rng.integers(1, 512, size=(n,)).astype(np.int32),
                           max_new_tokens=6, temperature=temp,
-                          deadline_s=60.0)
+                          deadline_s=60.0,
+                          # latency tier: one request pins stride 1 —
+                          # every step it is resident in syncs per
+                          # token (floor ITL, whole-batch cost)
+                          readout_stride=1 if n == 7 else None)
             for n, temp in ((12, 0.0), (7, 0.8), (20, 0.0))]
         for h in handles:
             # per-request streaming iterator: tokens as they decode

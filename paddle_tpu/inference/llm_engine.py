@@ -89,6 +89,12 @@ class GenerationRequest:
     temperature: float = 0.0         # <=0 -> greedy
     top_p: float = 1.0
     eos_token_id: int | None = None
+    #: latency-tier pin: cap the multi-step readout stride of every
+    #: all-decode step this request is active in (None = the engine's
+    #: ``readout_stride``; 1 = every step syncs the host, minimizing
+    #: inter-token latency for THIS request at the whole batch's
+    #: throughput cost — the effective stride is the min over slots)
+    readout_stride: int | None = None
 
 
 @dataclasses.dataclass
@@ -153,10 +159,10 @@ class PendingStep:
     the OLD request's state)."""
 
     __slots__ = ("toks", "was_active", "counts", "spec", "slots",
-                 "pool_done", "sched", "step_id")
+                 "pool_done", "sched", "step_id", "fenced", "t_dispatch")
 
     def __init__(self, toks, was_active, counts, spec, slots, pool_done,
-                 sched=None):
+                 sched=None, fenced=None):
         self.toks = toks              # device [rows, B] (spec: [Kh,B,Ks])
         self.was_active = was_active  # device activity history
         self.counts = counts          # spec only: accepted counts [Kh, B]
@@ -170,6 +176,14 @@ class PendingStep:
         #: attached) — step_finish stamps every token it reads out with
         #: it, joining request timelines back to engine state
         self.step_id = None
+        #: paged fused: physical blocks this dispatch may WRITE (the
+        #: stride-aware in-flight fence) — step_finish drops the fence,
+        #: releasing any block quarantined while this step was in flight
+        self.fenced = fenced or []
+        #: perf_counter at dispatch — step_finish amortizes per-token
+        #: emit stamps over [t_dispatch, sync] so a k-step stride's
+        #: token burst doesn't read as one giant inter-token gap
+        self.t_dispatch = None
 
 
 class LLMEngine:
@@ -181,7 +195,8 @@ class LLMEngine:
                  top_k=0, stream_callback=None, horizon=1, speculative_k=1,
                  lookup_ngram=3, mesh=None, cache_impl="dense",
                  block_size=64, kv_pool_blocks=None, scheduler="legacy",
-                 max_step_tokens=None, enable_prefix_cache=False):
+                 max_step_tokens=None, enable_prefix_cache=False,
+                 readout_stride=1):
         """``scheduler="fused"`` (Sarathi-style chunked-prefill+decode
         fusion): admission becomes slot ASSIGNMENT only — each engine step
         then processes, per slot, either one bounded prefill chunk (for
@@ -270,6 +285,26 @@ class LLMEngine:
                              "(speculative verify windows need the legacy "
                              "scheduler)")
         self.scheduler = scheduler
+        #: multi-step on-device decode (fused scheduler): ALL-DECODE
+        #: steps run up to ``readout_stride`` decode iterations as ONE
+        #: compiled loop with IN-GRAPH early exit (every slot hit
+        #: eos/budget/capacity -> the loop stops on device), so the host
+        #: round-trip tax amortizes k-fold in steady state while mixed
+        #: (ramp-in) steps keep per-step scheduling. A request may pin a
+        #: smaller stride (latency tier) — the effective stride of a
+        #: step is the MIN over its active slots' pins.
+        self.readout_stride = max(1, int(readout_stride))
+        if self.readout_stride > 1:
+            if scheduler != "fused":
+                raise ValueError(
+                    "readout_stride > 1 needs scheduler='fused' (the "
+                    "legacy scheduler already amortizes host syncs with "
+                    "`horizon`; the stride is the fused scheduler's "
+                    "all-decode fast path)")
+            if self.horizon > 1:
+                raise ValueError(
+                    "readout_stride generalizes `horizon` for the fused "
+                    "scheduler's all-decode steps — set one, not both")
 
         model.eval()
         _, params, _, buffers = collect_state(model)
@@ -389,9 +424,19 @@ class LLMEngine:
         self.fault_injector = None
         self._rec_ctx = None       # per-step_begin wall-split anchors
         self._rec_preempted = []   # rids parked by _preempt_slot this step
+        #: compiled multi-step decode programs, keyed by stride K (one
+        #: program per distinct effective stride; survives reset())
+        self._multi_fns = {}
+        self._multi_step_factory = None
+        #: seconds the CURRENT token's emit stamp should be backdated by
+        #: (step_finish amortizes a k-row readout over the dispatch→sync
+        #: window; 0.0 outside a readout walk and for 1-row steps) — the
+        #: serving layer reads it inside its stream callback
+        self.emit_backdate_s = 0.0
         self.stats = {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
                       "draft_tokens_accepted": 0, "preemptions": 0,
-                      "fused_steps": 0, "prefill_tokens": 0,
+                      "fused_steps": 0, "multi_steps": 0,
+                      "prefill_tokens": 0,
                       "prefix_hit_tokens": 0, "prefix_cow_blocks": 0,
                       "prefix_evicted_blocks": 0,
                       "decode_time_s": 0.0, "admit_time_s": 0.0,
@@ -458,6 +503,20 @@ class LLMEngine:
             #: from HERE (oldest first) before any live slot is
             #: preempted.
             self._lru = collections.OrderedDict()
+            # ---- stride-aware in-flight write fence ------------------
+            #: phys -> number of IN-FLIGHT dispatches that may still
+            #: write the block (stamped at step_begin over each active
+            #: slot's committed-len..scheduled-stride span, dropped at
+            #: that step's step_finish). The allocation ladder must
+            #: never hand a fenced block to a new owner: a freed block
+            #: still under fence parks in ``_quarantine`` instead of
+            #: the free heap — this is what makes eviction/preemption
+            #: safe while dispatches pipeline at depth > 1.
+            self._write_fence = {}
+            #: refcount-0 UNREGISTERED blocks whose fence has not
+            #: cleared yet — released to the free heap by the
+            #: step_finish that drops their last fence
+            self._quarantine = set()
         else:
             shape = (self.B, self.capacity, self._kvh, self._head_dim)
             self._k = [self._make_zeros(shape, self._np_dt, self._kv_spec)
@@ -629,6 +688,56 @@ class LLMEngine:
                     None, length=K)
             return (_pin_rep(toks), _pin_rep(was_active), _pin_rep(logits),
                     _pin_kv(k_bufs), _pin_kv(v_bufs), _pin_rep(lens), rng)
+
+        def make_multi_step(Kms):
+            """Build the ``readout_stride=Kms`` MULTI-STEP decode
+            program: up to Kms one_step iterations as ONE dispatch, as a
+            ``lax.while_loop`` that EARLY-EXITS IN-GRAPH the moment no
+            slot is active (every slot hit eos / its budget / capacity)
+            — unlike the horizon scan, a batch that finishes 1 step into
+            a 4-step stride pays 1 step of device compute, not 4. Token
+            and activity rows land in [Kms, B] buffers (rows past the
+            exit stay zero/inactive, which the shared readout walk
+            already skips), so step_finish drains the whole stride in
+            the same single [rows, B] device→host sync."""
+            def multi_step(state_vals, k_bufs, v_bufs, logits, lens,
+                           active, rng, temps, top_ps, eos_ids, budgets,
+                           rids, tables=None):
+                nL = len(k_bufs)
+
+                def cond(carry):
+                    i = carry[0]
+                    act = carry[5]
+                    return (i < Kms) & jnp.any(act)
+
+                def body(carry):
+                    i, kb, vb, lg, ln, act, emitted, toks, wa = carry
+                    nxt, lg, kb, vb, ln, finished, _ = one_step(
+                        kb, vb, lg, ln, act, rng, state_vals, temps,
+                        top_ps, eos_ids, rids, tables)
+                    toks = jax.lax.dynamic_update_slice(
+                        toks, nxt[None], (i, jnp.int32(0)))
+                    wa = jax.lax.dynamic_update_slice(
+                        wa, act[None], (i, jnp.int32(0)))
+                    emitted = emitted + act.astype(jnp.int32)
+                    act = act & ~finished & (ln < cap - 1) & \
+                        (emitted < budgets)
+                    return (i + 1, kb, vb, lg, ln, act, emitted, toks, wa)
+
+                carry = (jnp.int32(0), list(k_bufs), list(v_bufs), logits,
+                         lens, jnp.asarray(active),
+                         jnp.zeros_like(lens),
+                         jnp.zeros((Kms, B), jnp.int32),
+                         jnp.zeros((Kms, B), bool))
+                (_, k_out, v_out, logits, lens, _, _, toks, wa) = \
+                    jax.lax.while_loop(cond, body, carry)
+                assert len(k_out) == nL
+                return (_pin_rep(toks), _pin_rep(wa), _pin_rep(logits),
+                        _pin_kv(k_out), _pin_kv(v_out), _pin_rep(lens),
+                        rng)
+            return multi_step
+
+        self._multi_step_factory = make_multi_step
 
         Kspec = self.speculative_k
         ngram = self.lookup_ngram
@@ -870,13 +979,43 @@ class LLMEngine:
         self._set_tokens_fn = jax.jit(set_tokens, donate_argnums=(0,))
         self._set_len_fn = jax.jit(set_len, donate_argnums=(0,))
 
+    def _multi_fn(self, stride):
+        """The compiled multi-step decode program for ``stride`` — one
+        program per distinct effective stride (engine stride plus any
+        smaller per-request pins actually seen), cached for the engine's
+        lifetime (reset() keeps them: same shapes, same shardings)."""
+        fn = self._multi_fns.get(stride)
+        if fn is None:
+            self._programs()
+            fn = self._multi_fns[stride] = jax.jit(
+                self._multi_step_factory(stride), donate_argnums=(1, 2, 3))
+        return fn
+
+    def _effective_stride(self):
+        """The readout stride the NEXT all-decode dispatch should run:
+        the engine's ``readout_stride`` capped by every active slot's
+        per-request pin (a latency-tier request pinning 1 drags the
+        whole batch to per-step readout while it is resident — the
+        documented tradeoff), and by ``horizon`` for engines that use
+        the legacy scan amortization instead."""
+        if self.scheduler != "fused" or self.readout_stride <= 1:
+            return self.horizon
+        pins = [s.req.readout_stride for s in self.slots
+                if s is not None and s.req.readout_stride is not None]
+        return max(1, min([self.readout_stride] + pins))
+
     # ------------------------------------------------------------------
     # request lifecycle
     # ------------------------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=64, temperature=0.0,
                     top_p=1.0, eos_token_id=None, request_id=None,
-                    committed_tokens=None):
-        """``committed_tokens``: tokens ALREADY generated for this request
+                    committed_tokens=None, readout_stride=None):
+        """``readout_stride``: per-request latency-tier pin — cap the
+        multi-step decode stride of every all-decode step this request
+        is active in (1 = sync the host every step; None = the engine
+        default; ignored unless the engine runs ``readout_stride > 1``).
+
+        ``committed_tokens``: tokens ALREADY generated for this request
         in a previous life (supervised-restart / failover re-admission).
         They join the prompt for prefill — exactly the pool-pressure
         preemption stitch — so the engine's stream CONTINUES: only new
@@ -890,6 +1029,9 @@ class LLMEngine:
             else prompt_ids, dtype=np.int32).reshape(-1)
         if len(ids) == 0:
             raise ValueError("empty prompt")
+        if readout_stride is not None and int(readout_stride) < 1:
+            raise ValueError(f"readout_stride must be >= 1, got "
+                             f"{readout_stride}")
         committed = [int(t) for t in committed_tokens] \
             if committed_tokens else []
         if committed:
@@ -914,7 +1056,9 @@ class LLMEngine:
                 self._preempted_prefix.pop(rid, []) + committed
         self.waiting.append(GenerationRequest(
             rid, ids, int(max_new_tokens), float(temperature), float(top_p),
-            eos_token_id))
+            eos_token_id,
+            readout_stride=(int(readout_stride)
+                            if readout_stride is not None else None)))
         return rid
 
     def has_unfinished(self):
@@ -982,16 +1126,69 @@ class LLMEngine:
         return True
 
     def _release_block(self, phys):
-        """Drop one reference. At refcount 0 a REGISTERED block parks in
-        the LRU cached pool (its content stays probe-able); anything else
-        returns to the free heap."""
+        """Drop one reference. At refcount 0 the FENCE is authoritative:
+        a block still under an in-flight write fence parks in quarantine
+        — never in a pool the allocation ladder hands out from — until
+        the dispatch that may still write it lands (``_unfence`` then
+        routes it to the LRU if registered, the free heap otherwise).
+        Registered blocks CAN be fenced: a mixed-step prefill grant
+        publishes its just-filled blocks at dispatch time
+        (``_register_upto``), so the grant's own write fence and the
+        registration overlap until that step's finish. An unfenced
+        registered block parks straight in the LRU cached pool (content
+        stays probe-able); anything else returns to the free heap."""
         self._block_ref[phys] -= 1
         if self._block_ref[phys] > 0:
             return
+        if self._write_fence.get(phys):
+            self._quarantine.add(phys)
+        else:
+            self._park_free_block(phys)
+
+    def _park_free_block(self, phys):
+        """Route an unfenced refcount-0 block to the pool its
+        registration state earns — THE one copy of the rule, shared by
+        direct release and the quarantine drain: LRU cached pool if its
+        content is published (probe-able), free heap otherwise."""
         if phys in self._block_hash:
             self._lru[phys] = None
         else:
             heapq.heappush(self._free_blocks, phys)
+
+    # ---- stride-aware in-flight write fence ---------------------------
+    def _fence_blocks(self, b, lo, hi, fenced):
+        """Fence every block of slot ``b`` covering positions [lo, hi]:
+        the dispatch being built may write them, so until its
+        step_finish they must not be handed to a new owner. Fencing is
+        CONSERVATIVE — ``lo`` is the slot's committed length (not its
+        scheduled one), so even a dispatch whose predecessor early-exits
+        in-graph below its scheduled growth (pool-budget clamp) writes
+        only fenced blocks."""
+        bs = self.block_size
+        blocks = self._slot_blocks[b]
+        for blk in range(lo // bs, min(hi // bs + 1, len(blocks))):
+            phys = blocks[blk]
+            self._write_fence[phys] = self._write_fence.get(phys, 0) + 1
+            fenced.append(phys)
+
+    def _unfence(self, fenced):
+        """Drop one fence per listed block (its dispatch's device work —
+        including every KV write — provably landed: the token sync
+        completed). A quarantined block whose last fence drops leaves
+        quarantine for the pool its registration state earns: the LRU
+        cached pool if its content is published (probe-able again), the
+        free heap otherwise."""
+        for phys in fenced:
+            n = self._write_fence.get(phys, 0) - 1
+            if n > 0:
+                self._write_fence[phys] = n
+            else:
+                self._write_fence.pop(phys, None)
+                if phys in self._quarantine:
+                    self._quarantine.discard(phys)
+                    self._park_free_block(phys)
+        if fenced:
+            self._check_pool_invariants()
 
     # ---- content-addressed store (enable_prefix_cache) ---------------
     def _chain_hash(self, parent, tokens):
@@ -1096,7 +1293,15 @@ class LLMEngine:
         chain = []
         for k, (h, phys) in enumerate(found):
             if self._block_ref[phys] == 0:
-                self._lru.pop(phys, None)  # cached -> live
+                # cached -> live. A registered block may sit in
+                # QUARANTINE instead of the LRU (released while its
+                # publishing grant's dispatch was still in flight);
+                # attaching it is safe — the in-flight write IS the
+                # registered content and precedes any reader dispatch
+                # in program order — but it must leave quarantine or
+                # its unfence would free a live block.
+                self._lru.pop(phys, None)
+                self._quarantine.discard(phys)
             self._block_ref[phys] += 1
             self._tables[slot_idx, k] = phys
             blocks.append(phys)
@@ -1193,14 +1398,29 @@ class LLMEngine:
             return
         free = set(self._free_blocks)
         cached = set(self._lru)
+        quarantined = set(self._quarantine)
         live = [p for blocks in self._slot_blocks for p in blocks]
         live_set = set(live)
         assert len(free) == len(self._free_blocks), "free heap duplicates"
-        assert not (free & cached) and not (free & live_set) \
-            and not (cached & live_set), "block in two pools"
-        assert free | cached | live_set == set(range(self.n_blocks)), (
+        pools = (free, cached, live_set, quarantined)
+        for i, a in enumerate(pools):
+            for bset in pools[i + 1:]:
+                assert not (a & bset), "block in two pools"
+        assert free | cached | live_set | quarantined == \
+            set(range(self.n_blocks)), (
             f"pool leak: free({len(free)}) + cached({len(cached)}) + "
-            f"live({len(live_set)}) != n_blocks({self.n_blocks})")
+            f"live({len(live_set)}) + quarantined({len(quarantined)}) "
+            f"!= n_blocks({self.n_blocks})")
+        for phys in quarantined:
+            assert self._write_fence.get(phys), \
+                f"unfenced block {phys} stuck in quarantine"
+        for phys in list(cached) + list(free):
+            # the fence is authoritative at release: a fenced block must
+            # never sit in a pool the allocation ladder hands out from
+            # (_pop_block pops the free heap / evicts the LRU with no
+            # fence check)
+            assert not self._write_fence.get(phys), \
+                f"fenced block {phys} in an allocatable pool"
         refs = collections.Counter(live)
         for phys in range(self.n_blocks):
             assert self._block_ref[phys] == refs.get(phys, 0), (
@@ -1264,25 +1484,47 @@ class LLMEngine:
     def max_pipeline_depth(self):
         """How many step_begin() dispatches may be in flight at once.
 
-        Dense and speculative engines: 2 (the in-graph guards make one
-        step of host staleness safe — see step_begin). Paged LEGACY: 1 —
-        its block allocator needs each step's post-readout lens. Paged
-        FUSED re-examines that restriction: block allocation moved into
-        the unified scheduler, which mirrors the device lens exactly
-        (growth per dispatch is the scheduled q_lens — nothing
-        deactivates in-graph without also retiring), so allocation no
-        longer needs the readout. What still does is PREEMPTION: evicting
-        a slot while a step is in flight would free blocks the in-flight
-        program still writes, then hand them to another slot. On a FULL
-        pool (>= max_batch * blocks-per-slot) allocation can never fail,
-        preemption never fires, and the paged fused engine pipelines at
-        depth 2 like the dense one; an oversubscribed pool stays at 1."""
-        if self.cache_impl != "paged":
+        The depth contract (mirrored as a table in
+        docs/architecture.md):
+
+        * **fused, dense**: 3 — every grant decision reads the
+          scheduler's own lens mirror (``_Slot.sched_len`` counts
+          in-flight growth), finish/preemption detection tolerates
+          up-to-(depth-1)-steps-stale host state (a slot that finished
+          in flight keeps dispatching until its first readout; later
+          pendings drop its column via the slot-identity check), and
+          the in-graph guards bound over-decode.
+        * **fused, paged, full pool** (>= max_batch * blocks-per-slot):
+          3 — allocation cannot fail in steady state. One TRANSIENT
+          exception: a slot retiring while later dispatches still fence
+          its blocks parks them in quarantine for up to depth-1
+          step_finishes, so a boundary-crossing slot (or a fresh
+          admission) in that window can find the heap short and take
+          the ladder's partial-coverage clamp — or, worst case, a
+          preemption, which stays token-exact (re-prefill + the
+          per-(rid, position) sampling keys) and self-heals as the
+          fences drain.
+        * **fused, paged, oversubscribed**: 2 — the stride-aware
+          in-flight WRITE FENCE makes mid-flight eviction memory-safe
+          (a victim's blocks quarantine until the dispatches that may
+          still write them land, so they are never handed to a new
+          owner early), but every stale step a preemption decision
+          lags costs re-prefill churn, so the contract caps the lag at
+          one dispatch.
+        * **legacy dense / speculative**: 2 (the original in-graph-
+          guard contract — host request state is one step stale at the
+          chained dispatch).
+        * **legacy paged**: 1 — legacy slots have no in-flight lens
+          mirror; the block allocator and the admission prefill train
+          need each step's post-readout lens."""
+        if self.scheduler == "fused":
+            if self.cache_impl != "paged" or \
+                    self.n_blocks >= self.B * self._max_blocks:
+                return 3
             return 2
-        if self.scheduler == "fused" and \
-                self.n_blocks >= self.B * self._max_blocks:
-            return 2
-        return 1
+        if self.cache_impl == "paged":
+            return 1
+        return 2
 
     def _release_slot_blocks(self, slot_idx):
         """Release every block slot ``slot_idx`` references and wipe its
@@ -1363,7 +1605,8 @@ class LLMEngine:
         self.waiting.appendleft(GenerationRequest(
             req.request_id, done,
             req.max_new_tokens - len(slot.generated),
-            req.temperature, req.top_p, req.eos_token_id))
+            req.temperature, req.top_p, req.eos_token_id,
+            readout_stride=req.readout_stride))
         self._free_slot(b)
         self.stats["preemptions"] += 1
         if self._rec() is not None:
@@ -1566,7 +1809,7 @@ class LLMEngine:
         return r if (r is not None and r.enabled) else None
 
     def _record_dispatch(self, pending, kind, grants, scheduled, budget,
-                         dispatch_s):
+                         dispatch_s, readout_stride=1):
         """Emit this dispatch's StepRecord (recorder attached and armed
         by step_begin) and stamp ``pending`` with its step id. The
         admit/schedule splits come from the engine's own stats deltas
@@ -1593,7 +1836,8 @@ class LLMEngine:
             dispatch_s=dispatch_s, t_begin=t0,
             prefix_hit_tokens=(self.stats["prefix_hit_tokens"] - hits0
                                if self.prefix_cache else None),
-            cached_blocks=len(self._lru) if self.prefix_cache else None)
+            cached_blocks=len(self._lru) if self.prefix_cache else None,
+            readout_stride=readout_stride)
         self._rec_ctx = None
 
     def step_begin(self):
@@ -1685,9 +1929,16 @@ class LLMEngine:
             # All-decode steps fall through to the plain scan below
             # (horizon amortization intact in steady state).
             return self._begin_mixed_step(pool_done)
+        # ALL-DECODE fast path: with readout_stride > 1 the fused
+        # scheduler runs up to `stride` decode iterations as one
+        # multi-step dispatch (in-graph early exit); the token-budget
+        # walk degenerates to ONE decode grant of `stride` tokens per
+        # slot, and block coverage below is pre-granted for the whole
+        # stride. Legacy engines keep stride == horizon (the scan).
+        stride = self._effective_stride()
         if self.cache_impl == "paged":
-            # block coverage for the horizon's growth (last written
-            # position is cur + horizon - 1); pool pressure first grabs
+            # block coverage for the stride's growth (last written
+            # position is cur + stride - 1); pool pressure first grabs
             # whatever blocks remain free (partial coverage + a budget
             # clamp beats eviction), then evicts the newest slots, and
             # only retires at the pool edge when a slot can't even write
@@ -1700,11 +1951,11 @@ class LLMEngine:
                     continue  # evicted below while ensuring an older slot
                 slot = self.slots[b]
                 # sched_len counts in-flight growth too: under the fused
-                # scheduler's depth-2 paged pipelining the host allocates
-                # for step N+1 before step N's readout (legacy engines
-                # run depth 1 here, where sched_len == current length)
+                # scheduler's pipelining the host allocates for step N+1
+                # before step N's readout (legacy engines run depth 1
+                # here, where sched_len == current length)
                 cur = slot.sched_len()
-                last_pos = min(cur + self.horizon - 1, self.capacity - 1)
+                last_pos = min(cur + stride - 1, self.capacity - 1)
                 while not self._ensure_blocks(b, last_pos):
                     avail = self._n_allocatable()
                     if avail:
@@ -1764,14 +2015,52 @@ class LLMEngine:
         for b, cap_left in pool_budget.items():
             budgets[b] = min(budgets[b], cap_left)
 
+        # the stride-aware in-flight write fence (paged fused): every
+        # block this dispatch may write — from each slot's COMMITTED
+        # length through its scheduled stride — is fenced until
+        # step_finish, so a mid-flight eviction can never hand one to a
+        # new owner (see _fence_blocks / _release_block)
+        fenced = []
+        if self.cache_impl == "paged" and self.scheduler == "fused":
+            for b, slot in enumerate(self.slots):
+                if slot is None or not active[b]:
+                    continue
+                lo = slot.prefill_pos + len(slot.generated)
+                hi = min(slot.sched_len() + stride - 1, self.capacity - 1)
+                self._fence_blocks(b, lo, hi, fenced)
+
+        # multi-step all-decode (readout_stride): one compiled k-step
+        # loop with in-graph early exit — the host sync amortizes over
+        # up to `stride` tokens per slot. Pinned latency-tier requests
+        # (effective stride 1), horizon engines and legacy engines keep
+        # the scan path — a readout_stride=1 engine is bit-identical to
+        # the pre-stride engine by construction.
+        use_multi = self.readout_stride > 1 and stride > 1
+
         # the decode clock starts HERE: pool-allocator scans and host array
         # construction above must not masquerade as device decode time in
-        # throughput() or the serve bench's wall split. All three arms
-        # DISPATCH only — no host read; JAX async dispatch returns futures
-        # and the transfer blocks in step_finish().
+        # throughput() or the serve bench's wall split. All arms DISPATCH
+        # only — no host read; JAX async dispatch returns futures and the
+        # transfer blocks in step_finish().
         t0 = time.perf_counter()
         counts = None
-        if self.cache_impl == "paged":
+        if use_multi:
+            fn = self._multi_fn(stride)
+            if self.cache_impl == "paged":
+                with self._kernel_tp_ctx():
+                    (toks, was_active, self._logits, self._k, self._v,
+                     self._lens, self._rng_key) = fn(
+                        self._state_vals, self._k, self._v, self._logits,
+                        self._lens, active, self._rng_key, temps, top_ps,
+                        eos_ids, budgets, rids, self._tables.copy())
+            else:
+                (toks, was_active, self._logits, self._k, self._v,
+                 self._lens, self._rng_key) = fn(
+                    self._state_vals, self._k, self._v, self._logits,
+                    self._lens, active, self._rng_key, temps, top_ps,
+                    eos_ids, budgets, rids)
+            self.stats["multi_steps"] += 1
+        elif self.cache_impl == "paged":
             with self._kernel_tp_ctx():
                 (toks, was_active, self._logits, self._k, self._v,
                  self._lens, self._rng_key) = self._step_paged_fn(
@@ -1796,28 +2085,32 @@ class LLMEngine:
         self._inflight += 1
         sched = {}
         if self.scheduler == "fused":
-            # host lens mirror for the paged depth-2 pipeline: a surviving
-            # slot grows exactly `horizon` tokens per scan dispatch (every
-            # in-graph early-deactivation — eos, budget, capacity — also
-            # retires the slot at readout, so the mirror never undershoots
-            # a live slot)
+            # host lens mirror for the paged pipeline: a surviving slot
+            # grows exactly `stride` tokens per dispatch (every in-graph
+            # early-deactivation — eos, budget, capacity — also retires
+            # the slot at readout, so the mirror never undershoots a
+            # live slot; an early EXIT below the stride only ever
+            # accompanies such a deactivation)
             for b, slot in enumerate(self.slots):
                 if slot is not None and active[b]:
-                    slot.inflight += self.horizon
-                    sched[b] = self.horizon
+                    slot.inflight += stride
+                    sched[b] = stride
         pending = PendingStep(toks, was_active, counts, spec,
-                              list(self.slots), pool_done, sched=sched)
+                              list(self.slots), pool_done, sched=sched,
+                              fenced=fenced)
+        pending.t_dispatch = t0
         if self._rec() is not None:
-            # every active slot may decode up to `horizon` tokens this
-            # scan (spec: horizon verify windows of up to Kspec each)
-            per_slot = self.horizon * (self.speculative_k if spec else 1)
+            # ONE decode grant per slot covering the whole stride (spec:
+            # stride verify windows of up to Kspec each)
+            per_slot = stride * (self.speculative_k if spec else 1)
             grants = tuple(
                 (b, s.req.request_id, "decode", per_slot)
                 for b, s in enumerate(self.slots)
                 if s is not None and active[b])
             self._record_dispatch(
                 pending, "spec" if spec else "decode", grants,
-                sum(g[3] for g in grants), self.B * per_slot, dt)
+                sum(g[3] for g in grants), self.B * per_slot, dt,
+                readout_stride=per_slot)
         return pending
 
     # ------------------------------------------------------------------
@@ -1941,6 +2234,19 @@ class LLMEngine:
         rids = np.array([s.req.request_id if s else 0
                          for s in self.slots], np.int32)
 
+        # in-flight write fence over this mixed dispatch's spans: one
+        # decode position per decode slot, the granted chunk span per
+        # ramping slot (see _fence_blocks)
+        fenced = []
+        if self.cache_impl == "paged":
+            for b in np.nonzero(active)[0]:
+                slot = self.slots[b]
+                lo = slot.prefill_pos + len(slot.generated)
+                hi = slot.sched_len() if is_dec[b] \
+                    else slot.prefill_pos + int(q_lens[b]) - 1
+                self._fence_blocks(int(b), lo, min(hi, self.capacity - 1),
+                                   fenced)
+
         t0 = time.perf_counter()
         if self.cache_impl == "paged":
             with self._kernel_tp_ctx():
@@ -1978,7 +2284,9 @@ class LLMEngine:
                     self._register_upto(int(b), slot, slot.prefill_pos)
         self._inflight += 1
         pending = PendingStep(toks, was_active, None, False,
-                              list(self.slots), pool_done, sched=sched)
+                              list(self.slots), pool_done, sched=sched,
+                              fenced=fenced)
+        pending.t_dispatch = t0
         rec = self._rec()
         if rec is not None:
             grants = tuple(
@@ -2040,6 +2348,44 @@ class LLMEngine:
         self.stats["host_sync_time_s"] += dt
         self.stats["decode_time_s"] += dt
         self.stats["steps"] += 1
+        # the device work (every KV write included) provably landed —
+        # the token sync completed — so this dispatch's write fences
+        # drop now, BEFORE the readout walk can retire slots and free
+        # (possibly quarantined) blocks
+        if pending.fenced:
+            self._unfence(pending.fenced)
+
+        # batched-readout stamp amortization: a k-row stride drains k
+        # device steps in this ONE sync, but those tokens were produced
+        # at k distinct device step boundaries spread over the
+        # dispatch→sync window — so each row's emit stamp is backdated
+        # by the boundaries still ahead of it, and histograms /
+        # explain_tail see honest inter-token gaps instead of k-1 zeros
+        # and one stride-wide spike. The window divides over the
+        # boundaries the device actually RAN — iterations with any
+        # activity (an early-exited stride spent its whole window on
+        # the rows that executed), and for the spec engine a verify
+        # WINDOW is one boundary: its Ks rows commit together, so they
+        # share a stamp rather than being spread across gaps that never
+        # existed. emit_backdate_s publishes the per-row backdate to
+        # the serving layer's stream callback.
+        n_exec = 0
+        per_row = 0.0
+        if spec:
+            # flattened row k belongs to verify window k // Ks; wa_np
+            # [Kh, B] (from the readout prep above) says which windows
+            # the device actually ran
+            row_boundary = np.arange(toks_np.shape[0]) // \
+                self.speculative_k
+            n_exec = int(wa_np.any(axis=1).sum())
+        else:
+            row_boundary = np.arange(toks_np.shape[0])
+            n_exec = int(act_np.any(axis=1).sum())
+        if toks_np.shape[0] > 1 and pending.t_dispatch is not None \
+                and n_exec > 1:
+            per_row = max(
+                time.perf_counter() - pending.t_dispatch, 0.0) / n_exec
+        now_pc = time.perf_counter()
 
         t0 = time.perf_counter()
         done = list(pending.pool_done)
@@ -2063,10 +2409,14 @@ class LLMEngine:
                 slot.generated.append(tok)
                 n_read += 1
                 self.stats["tokens_generated"] += 1
+                self.emit_backdate_s = \
+                    max(n_exec - 1 - int(row_boundary[k]), 0) * per_row
                 if rec is not None and sid is not None:
                     # THE token→step join: this token's timeline span
                     # carries the id of the StepRecord that produced it
-                    rec.on_token(slot.req.request_id, sid)
+                    # (stamped at its amortized device step boundary)
+                    rec.on_token(slot.req.request_id, sid,
+                                 t=now_pc - self.emit_backdate_s)
                 if self.stream_callback is not None:
                     self.stream_callback(slot.req.request_id, tok)
                     if self.slots[b] is not slot:
@@ -2112,6 +2462,7 @@ class LLMEngine:
                 done.append(out)
                 # slot (and its KV blocks) freed; next step admits into it
                 self._free_slot(b)
+        self.emit_backdate_s = 0.0
         d_emit = time.perf_counter() - t0
         self.stats["emit_time_s"] += d_emit
         if rec is not None and sid is not None:

@@ -487,21 +487,24 @@ class ReplicaRouter:
     def submit(self, prompt_ids, max_new_tokens=64, temperature=0.0,
                top_p=1.0, eos_token_id=None, deadline_s=None,
                routing_key=None, replica=None, block=True,
-               timeout=None) -> RouterHandle:
+               timeout=None, readout_stride=None) -> RouterHandle:
         """Place and submit one request; returns its
         :class:`RouterHandle`. ``routing_key`` is an opaque caller tag
         that rides the placement dict into ``ServeResult.routing`` and
         the request's trace spans. ``replica`` pins placement (skips
-        scoring). Backpressure: a replica whose queue is full is skipped
-        for the next-best; with every queue full, blocks (``block=True``,
-        up to ``timeout``) or raises
+        scoring). ``readout_stride`` is the per-request latency-tier
+        pin, forwarded to whichever replica serves (and re-serves, on
+        failover) the request. Backpressure: a replica whose queue is
+        full is skipped for the next-best; with every queue full,
+        blocks (``block=True``, up to ``timeout``) or raises
         :class:`~paddle_tpu.serving.ServerQueueFull`."""
         ids = np.asarray(
             prompt_ids.numpy() if hasattr(prompt_ids, "numpy")
             else prompt_ids, dtype=np.int32).reshape(-1)
         kwargs = dict(max_new_tokens=max_new_tokens,
                       temperature=temperature, top_p=top_p,
-                      eos_token_id=eos_token_id, deadline_s=deadline_s)
+                      eos_token_id=eos_token_id, deadline_s=deadline_s,
+                      readout_stride=readout_stride)
         handle = RouterHandle(self, ids, kwargs, routing_key)
         deadline = None if timeout is None else time.monotonic() + timeout
         delay = self.poll_interval_s

@@ -21,7 +21,14 @@ Two pieces:
   (idle poll passes don't advance the schedule) AND a schedule is
   pending (the detached/no-actions fast path is one attribute check and
   doesn't count), so "step N" means the N-th working step after the
-  first action was scripted. Hangs sleep on an Event so the
+  first action was scripted. Under multi-step decode
+  (``readout_stride > 1``) the counter counts STRIDES — one dispatch
+  covering up to k device decode steps advances the schedule by ONE,
+  because the dispatch is the unit a fault can actually land between
+  (there is no host boundary inside the compiled k-step loop). A crash
+  scripted at ``phase="finish"`` therefore lands with a whole stride's
+  tokens still unread on the device — the recovery stitch re-decodes
+  them token-exactly. Hangs sleep on an Event so the
   server watchdog can :meth:`interrupt` them — the injectable stand-in
   for "cancel the stuck device call where the runtime allows it".
 * :class:`RestartPolicy` — bounds for ``AsyncLLMServer(supervise=...)``:

@@ -36,6 +36,7 @@ provide:
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
 
@@ -136,13 +137,16 @@ class AsyncLLMServer:
             flight_recorder.replica = replica
         self.flight_recorder = flight_recorder
         self.engine = engine
-        # the engine knows its own safe depth: 2 for dense/speculative,
-        # 2 for the paged FUSED scheduler on a full pool (its scheduler
-        # mirrors the device lens, so allocation no longer needs the
-        # readout), 1 for legacy/oversubscribed paged (the allocator /
-        # preemption need post-step state). The loop dispatches at most
-        # ONE step ahead of the sync, so the honored maximum is 2.
-        self.pipeline_depth = min(int(pipeline_depth or 2), 2,
+        # the engine knows its own safe depth (see
+        # LLMEngine.max_pipeline_depth's contract table): 3 for fused
+        # engines (dense, and paged on a full pool — the scheduler
+        # mirrors device lens, and the in-flight write fence makes
+        # eviction safe), 2 for fused oversubscribed paged and the
+        # legacy dense/spec engines, 1 for legacy paged. The DEFAULT
+        # stays 2 — the pre-stride contract — so deeper pipelining is
+        # an explicit opt-in (pipeline_depth=3); the loop keeps up to
+        # depth dispatches in flight before blocking on the oldest sync.
+        self.pipeline_depth = min(int(pipeline_depth or 2),
                                   engine.max_pipeline_depth())
         self.poll_interval_s = float(poll_interval_s)
         self.telemetry = telemetry or ServingTelemetry(replica=replica)
@@ -322,8 +326,8 @@ class AsyncLLMServer:
     # -- submission ------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=64, temperature=0.0,
                top_p=1.0, eos_token_id=None, deadline_s=None, block=True,
-               timeout=None, routing=None,
-               resume_tokens=None) -> RequestHandle:
+               timeout=None, routing=None, resume_tokens=None,
+               readout_stride=None) -> RequestHandle:
         """Submit one generation request; returns its streaming
         :class:`RequestHandle`.
 
@@ -348,7 +352,13 @@ class AsyncLLMServer:
         admission prefills prompt⊕resume_tokens so the stream continues
         token-exactly — only new tokens stream out of the handle, the
         terminal result carries the full sequence, and they count
-        against ``max_new_tokens`` (the ORIGINAL total budget)."""
+        against ``max_new_tokens`` (the ORIGINAL total budget).
+
+        ``readout_stride``: latency-tier pin for multi-step decode —
+        ``readout_stride=1`` forces every all-decode step this request
+        is resident in to sync the host per step (minimum inter-token
+        latency for this stream, at the whole batch's throughput cost).
+        None (default) inherits the engine's stride."""
         if self._crashed is not None:
             raise ServerClosed(
                 f"serving loop crashed: {self._crashed}") from self._crashed
@@ -377,6 +387,9 @@ class AsyncLLMServer:
             rid = self._next_id
             self._next_id += 1
         now = time.monotonic()
+        if readout_stride is not None and int(readout_stride) < 1:
+            raise ValueError(f"readout_stride must be >= 1, got "
+                             f"{readout_stride}")
         req = ServeRequest(
             rid, ids, int(max_new_tokens), float(temperature), float(top_p),
             eos_token_id,
@@ -384,7 +397,9 @@ class AsyncLLMServer:
                       if deadline_s is not None else None),
             submitted_at=now,
             routing=dict(routing) if routing is not None else None,
-            resume_tokens=resume)
+            resume_tokens=resume,
+            readout_stride=(int(readout_stride)
+                            if readout_stride is not None else None))
         handle = RequestHandle(self, req)
         rec = self.flight_recorder
         if self.shed_deadlines and deadline_s is not None:
@@ -470,7 +485,11 @@ class AsyncLLMServer:
 
     def _serve_loop(self):
         tel = self.telemetry
-        pending = None
+        # the in-flight dispatch window, oldest first: up to
+        # pipeline_depth step_begin()s run ahead of the oldest sync
+        # (depth 2 reproduces the pre-deque loop's exact call sequence:
+        # begin, begin, finish | begin, finish | ...)
+        pending = collections.deque()
         while True:
             # the watchdog heartbeat: ONE monotonic read per pass (the
             # whole supervision-off/on overhead budget rides on this
@@ -486,17 +505,22 @@ class AsyncLLMServer:
             with tel.stage("queue_admit"):
                 self._feed_engine()
                 self._mark_admission_stalls()
-            if pending is None:
+            # THE pipelined-dispatch move: fill the in-flight window
+            # before blocking on the oldest step's token transfer
+            while len(pending) < self.pipeline_depth:
                 try:
-                    pending = self._begin_step()
+                    nxt = self._begin_step()
                 except PoolCapacityError as e:
                     # exactly the head-request-can-never-admit signal
                     # (its prompt outgrew the paged pool): fail THAT
                     # request, not the server. Any other error (device,
                     # compile) falls to the supervisor.
                     self._fail_head_waiting(e)
-                    continue
-            if pending is None:
+                    break
+                if nxt is None:
+                    break
+                pending.append(nxt)
+            if not pending:
                 if self._stopping and not self.num_outstanding() \
                         and len(self._queue) == 0:
                     return
@@ -504,16 +528,10 @@ class AsyncLLMServer:
                     self._work_evt.wait(self.poll_interval_s)
                     self._work_evt.clear()
                 continue
-            nxt = None
-            if self.pipeline_depth > 1:
-                # THE pipelined-dispatch move: enqueue step N+1 on the
-                # device before blocking on step N's token transfer
-                nxt = self._begin_step()
-            done = self._finish_step(pending)
+            done = self._finish_step(pending.popleft())
             if done:
                 with tel.stage("other"):
                     self._handle_done(done)
-            pending = nxt
 
     def _recover(self, exc):
         """Crash handler. Returns True when the serve loop should
@@ -615,7 +633,8 @@ class AsyncLLMServer:
                 req.prompt_ids, max_new_tokens=remaining,
                 temperature=req.temperature, top_p=req.top_p,
                 eos_token_id=eos, request_id=req.request_id,
-                committed_tokens=committed or None)
+                committed_tokens=committed or None,
+                readout_stride=req.readout_stride)
         except ValueError as e:
             # the rejection must be visible in telemetry, not just on
             # the handle — a silent validation drop looks like a lost
@@ -673,6 +692,7 @@ class AsyncLLMServer:
         s_disp = eng.stats["dispatch_time_s"]
         s_pre = eng.stats["preemptions"]
         s_ptok = eng.stats["prefill_tokens"]
+        s_multi = eng.stats["multi_steps"]
         s_pfx = {k: eng.stats[k] for k in ("prefix_hit_tokens",
                                            "prefix_cow_blocks",
                                            "prefix_evicted_blocks")}
@@ -697,6 +717,8 @@ class AsyncLLMServer:
             # pool-pressure preemptions happen inside step_begin's
             # allocator loop — this is where the delta is visible
             tel.inc("preemptions", eng.stats["preemptions"] - s_pre)
+        if eng.stats["multi_steps"] > s_multi:
+            tel.inc("multi_steps", eng.stats["multi_steps"] - s_multi)
         if d_admit > 0.0:
             self._note_admissions()
         return pending
@@ -874,18 +896,27 @@ class AsyncLLMServer:
 
     def _on_token(self, rid, tok):
         """Engine stream callback (fires inside step_finish's readout):
-        route the token to its handle and record TTFT / inter-token."""
+        route the token to its handle and record TTFT / inter-token.
+        The stamp is BACKDATED by the engine's ``emit_backdate_s`` — a
+        k-step batched readout drains k tokens in one sync, but each
+        was produced at its own device step boundary, so histograms see
+        k amortized gaps instead of k-1 zeros and one stride-wide
+        spike. Clamped monotonic per handle (pipelined strides can
+        backdate into the previous readout's window)."""
         with self._hlock:
             h = self._handles.get(rid)
         if h is None:
             return
-        now = time.monotonic()
+        now = time.monotonic() - self.engine.emit_backdate_s
+        if h.last_token_at is not None and now < h.last_token_at:
+            now = h.last_token_at
         if h.first_token_at is None:
-            self.telemetry.observe("ttft_s", now - h.request.submitted_at)
+            self.telemetry.observe(
+                "ttft_s", max(now - h.request.submitted_at, 0.0))
         elif h.last_token_at is not None:
             self.telemetry.observe("inter_token_s", now - h.last_token_at)
         self.telemetry.inc("tokens_emitted")
-        h._emit(tok)
+        h._emit(tok, t=now)
 
     def _handle_done(self, outputs):
         for out in outputs:

@@ -62,6 +62,12 @@ class ServeRequest:
     #: stream out. They count against ``max_new_tokens`` (the ORIGINAL
     #: total budget — the engine generates the remainder).
     resume_tokens: list | None = None
+    #: latency-tier pin for multi-step decode: cap the engine's
+    #: ``readout_stride`` while this request is resident (1 = the host
+    #: syncs every step, minimizing THIS request's inter-token latency
+    #: at the batch's throughput cost). None = the engine default; inert
+    #: on engines without multi-step decode.
+    readout_stride: int | None = None
 
 
 @dataclasses.dataclass
@@ -136,11 +142,17 @@ class RequestHandle:
         return list(self.request.resume_tokens or []) + list(self.emitted)
 
     # -- engine-thread side ---------------------------------------------
-    def _emit(self, tok):
+    def _emit(self, tok, t=None):
+        """``t``: an explicit monotonic stamp — the server passes the
+        token's AMORTIZED device-step-boundary time under multi-step
+        readout so latency stats see the stride's k tokens at k distinct
+        times; clamped monotonic per handle."""
         with self._cond:
             self._tokens.append(tok)
             self.emitted.append(tok)
-            now = time.monotonic()
+            now = time.monotonic() if t is None else t
+            if self.last_token_at is not None and now < self.last_token_at:
+                now = self.last_token_at
             if self.first_token_at is None:
                 self.first_token_at = now
             self.last_token_at = now

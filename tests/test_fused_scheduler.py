@@ -219,16 +219,21 @@ class TestPipelineDepthContract:
     def test_depths(self, tiny_model):
         dense, dense_f = _pair(tiny_model)
         assert dense.max_pipeline_depth() == 2
-        assert dense_f.max_pipeline_depth() == 2
+        # fused engines pipeline to 3: grant decisions read the
+        # scheduler's own lens mirror, finish/preemption detection
+        # tolerates (depth-1)-steps-stale host state
+        assert dense_f.max_pipeline_depth() == 3
         paged_l, paged_f = _pair(tiny_model, "paged")
-        # legacy paged stays 1; fused on a FULL pool re-examines to 2
+        # legacy paged stays 1; fused on a FULL pool pipelines at 3
         assert paged_l.max_pipeline_depth() == 1
-        assert paged_f.max_pipeline_depth() == 2
+        assert paged_f.max_pipeline_depth() == 3
         over = LLMEngine(tiny_model, max_batch=2, max_seq_len=64,
                          chunk_size=16, cache_impl="paged", block_size=8,
                          scheduler="fused", kv_pool_blocks=8)
-        # oversubscribed: preemption may fire mid-flight — stays 1
-        assert over.max_pipeline_depth() == 1
+        # oversubscribed: the in-flight write fence makes mid-flight
+        # eviction safe at depth 2; deeper only multiplies re-prefill
+        # churn per stale preemption decision
+        assert over.max_pipeline_depth() == 2
 
     def test_paged_fused_full_pool_pipelines_depth2_exact(self, tiny_model):
         """step_begin() may be called again before step_finish() on the
@@ -258,16 +263,21 @@ class TestPipelineDepthContract:
             [ref[i] for i in sorted(ref)]
         assert len(fused._free_blocks) == fused.n_blocks
 
-    def test_oversubscribed_fused_rejects_second_begin(self, tiny_model):
+    def test_oversubscribed_fused_rejects_third_begin(self, tiny_model):
+        """Oversubscribed paged fused pipelines at depth 2 (the write
+        fence makes mid-flight eviction safe) and rejects depth 3."""
         eng = LLMEngine(tiny_model, max_batch=2, max_seq_len=64,
                         chunk_size=16, cache_impl="paged", block_size=8,
                         scheduler="fused", kv_pool_blocks=8)
         eng.add_request(_prompts(11, (6,))[0], max_new_tokens=4)
-        pending = eng.step_begin()
-        assert pending is not None
+        first = eng.step_begin()
+        assert first is not None
+        second = eng.step_begin()
+        assert second is not None
         with pytest.raises(RuntimeError, match="pipeline"):
             eng.step_begin()
-        eng.step_finish(pending)
+        eng.step_finish(first)
+        eng.step_finish(second)
         while eng.has_unfinished():
             eng.step()
 

@@ -120,6 +120,7 @@ class TestLossParity:
         assert our_losses[-1] < our_losses[0]
 
 
+@pytest.mark.slow  # 100-step soak; tier-1 wall-time headroom
 def test_long_horizon_bf16_master_parity_100_steps():
     """VERDICT r3 #8 (long-horizon drift bound, CI-scale): 100 AdamW steps
     of the same tiny llama config in bf16-with-fp32-masters vs all-fp32,

@@ -333,6 +333,9 @@ def test_telemetry_strict_names_and_register():
     tel.inc("prefix_evicted_blocks")
     tel.set_gauge("prefix_cached_blocks", 4)
     tel.set_gauge("prefix_cache_hit_rate", 0.5)
+    # ... as is the multi-step decode dispatch counter
+    tel.inc("multi_steps", 3)
+    assert tel.snapshot()["counters"]["multi_steps"] == 3
     # ... and a typo'd variant still raises instead of forking
     with pytest.raises(KeyError, match="unknown telemetry counter"):
         tel.inc("prefix_hit_token")

@@ -179,6 +179,7 @@ def test_random_affine_perspective_run(rng):
 
 # -- models -------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 wall-time headroom
 def test_new_model_variants_forward(rng):
     import paddle_tpu.vision.models as M
     x = paddle.to_tensor(rng.standard_normal((1, 3, 64, 64)).astype(np.float32))

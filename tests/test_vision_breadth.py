@@ -34,11 +34,18 @@ class TestModelZoo:
         (M.alexnet, 224), (M.squeezenet1_0, 64), (M.squeezenet1_1, 64),
         (lambda: M.vgg11(num_classes=7), 32),
         (lambda: M.mobilenet_v1(num_classes=7), 64),
-        (lambda: M.mobilenet_v2(num_classes=7), 64),
-        (lambda: M.mobilenet_v3_small(num_classes=7), 64),
-        (lambda: M.mobilenet_v3_large(num_classes=7), 64),
-        (lambda: M.densenet121(num_classes=7), 64),
-        (lambda: M.googlenet(num_classes=7), 64),
+        # the heavier zoo variants are `slow` (tier-1 wall-time headroom:
+        # these five alone cost ~75s of shape-smoke on CPU)
+        pytest.param(lambda: M.mobilenet_v2(num_classes=7), 64,
+                     marks=pytest.mark.slow),
+        pytest.param(lambda: M.mobilenet_v3_small(num_classes=7), 64,
+                     marks=pytest.mark.slow),
+        pytest.param(lambda: M.mobilenet_v3_large(num_classes=7), 64,
+                     marks=pytest.mark.slow),
+        pytest.param(lambda: M.densenet121(num_classes=7), 64,
+                     marks=pytest.mark.slow),
+        pytest.param(lambda: M.googlenet(num_classes=7), 64,
+                     marks=pytest.mark.slow),
         (lambda: M.shufflenet_v2_x0_25(num_classes=7), 64),
     ])
     def test_forward_shapes(self, ctor, size):
@@ -47,10 +54,12 @@ class TestModelZoo:
         expected = model.num_classes if hasattr(model, "num_classes") else 7
         assert out.shape[0] == 1 and out.shape[-1] in (7, 1000)
 
+    @pytest.mark.slow  # tier-1 wall-time headroom
     def test_inception_v3(self):
         out = _fwd(M.inception_v3(num_classes=5), size=299)
         assert out.shape == [1, 5]
 
+    @pytest.mark.slow  # tier-1 wall-time headroom
     def test_resnext_wide_factories(self):
         assert _fwd(M.resnext50_32x4d(num_classes=4), 64).shape == [1, 4]
         assert _fwd(M.wide_resnet50_2(num_classes=4), 64).shape == [1, 4]
@@ -65,6 +74,7 @@ class TestModelZoo:
         assert abs(count(M.vgg16()) - 138.4e6) / 138.4e6 < 0.01
         assert abs(count(M.inception_v3()) - 23.8e6) / 23.8e6 < 0.05
 
+    @pytest.mark.slow  # tier-1 wall-time headroom
     def test_vgg_train_step(self):
         import paddle_tpu.optimizer as opt
         model = M.vgg11(num_classes=4)
