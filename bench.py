@@ -1240,6 +1240,208 @@ def _bench_other(model_name):
                 "chunk": chunk, "block_size": block, "horizon": horizon,
                 "telemetry_artifact": art_path}
 
+    if model_name == "llama_serve_lora":
+        # Batched multi-LoRA A/B (paddle_tpu/serving/adapters.py): the
+        # same base model served (a) WITHOUT an adapter store — the
+        # pre-adapter compiled program, the overhead baseline — and (b)
+        # with BENCH_ADAPTERS registered adapters and requests round-
+        # robining across them through ONE fused paged engine, with an
+        # adapter device cache of BENCH_ADAPTER_SLOTS slots (smaller
+        # than the adapter count, so LRU swap-ins actually happen and
+        # the swap rate is a real number). A per-adapter greedy PARITY
+        # probe runs each adapter's stream against an offline
+        # merged-weights reference engine.
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import LLMEngine
+        from paddle_tpu.serving import (AsyncLLMServer, AdapterStore,
+                                        apply_merged, random_lora_weights)
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+        n_req = int(os.environ.get("BENCH_REQUESTS", str(2 * B)))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        chunk = int(os.environ.get("BENCH_CHUNK", "256"))
+        block = int(os.environ.get("BENCH_BLOCK", "64"))
+        prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
+        n_adapters = int(os.environ.get("BENCH_ADAPTERS", "8"))
+        n_slots = int(os.environ.get("BENCH_ADAPTER_SLOTS", "4"))
+        rank = int(os.environ.get("BENCH_RANK", "8"))
+        n_parity = int(os.environ.get("BENCH_PARITY_ADAPTERS", "2"))
+        cap = -(-(prompt_len + new_tokens) // chunk) * chunk
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=heads,
+                          max_position_embeddings=cap)
+        V = cfg.vocab_size
+        prompts = [rng.integers(0, V, (prompt_len,)).astype(np.int32)
+                   for _ in range(n_req)]
+        store = AdapterStore(cfg, rank=rank)
+        aids = [store.register(
+            random_lora_weights(cfg, rank=rank, seed=100 + i, scale=0.02),
+            alpha=2.0) for i in range(n_adapters)]
+
+        def build_model():
+            paddle.seed(0)
+            m = LlamaForCausalLM(cfg).bfloat16()
+            m.eval()
+            return m
+
+        def run_arm(adapter_ids, use_store):
+            eng = LLMEngine(build_model(), max_batch=B, max_seq_len=cap,
+                            chunk_size=chunk, cache_impl="paged",
+                            block_size=block, scheduler="fused",
+                            adapter_store=store if use_store else None,
+                            adapter_cache_slots=n_slots)
+            warm = rng.integers(0, V, (3,)).astype(np.int32)
+            eng.generate([warm], max_new_tokens=2)
+            eng.reset_stats()
+            server = AsyncLLMServer(eng, max_queue_size=n_req + 1)
+            server.start()
+            t0 = time.perf_counter()
+            hs = [server.submit(p, max_new_tokens=new_tokens,
+                                adapter_id=aid)
+                  for p, aid in zip(prompts, adapter_ids)]
+            outs = [h.result(timeout=1800) for h in hs]
+            wall = time.perf_counter() - t0
+            server.stop()
+            toks = sum(len(o.token_ids) for o in outs)
+            st = eng.stats
+            return {
+                "tokens_per_sec": round(toks / wall, 1),
+                "adapter_swaps": int(st["adapter_swaps"]),
+                "adapter_cache_hits": int(st["adapter_cache_hits"]),
+                "swap_rate": round(st["adapter_swaps"] / max(n_req, 1), 4),
+                "wall_s": round(wall, 3),
+            }
+
+        base = run_arm([0] * n_req, use_store=False)
+        mix = run_arm([aids[i % n_adapters] for i in range(n_req)],
+                      use_store=True)
+        # per-adapter greedy parity probe vs merged-weights references
+        parity = True
+        probe = prompts[0][:32]
+        eng = LLMEngine(build_model(), max_batch=2, max_seq_len=cap,
+                        chunk_size=chunk, cache_impl="paged",
+                        block_size=block, scheduler="fused",
+                        adapter_store=store, adapter_cache_slots=n_slots)
+        for aid in aids[:n_parity]:
+            rid = eng.add_request(probe, max_new_tokens=16, adapter_id=aid)
+            while eng.has_unfinished():
+                eng.step()
+            got = eng.finished_outputs.pop(rid).token_ids
+            merged = build_model()
+            apply_merged(merged, store, aid)
+            ref_eng = LLMEngine(merged, max_batch=2, max_seq_len=cap,
+                                chunk_size=chunk, cache_impl="paged",
+                                block_size=block, scheduler="fused")
+            (ref,) = ref_eng.generate([probe], max_new_tokens=16)
+            parity = parity and (got == ref.token_ids)
+        return {"metric": "llama_serve_lora_tokens_per_sec",
+                "value": mix["tokens_per_sec"],
+                "unit": "tokens/s", "vs_baseline": None,
+                "base": base, "adapter_mix": mix,
+                "lora_overhead_pct": round(
+                    (1.0 - mix["tokens_per_sec"]
+                     / max(base["tokens_per_sec"], 1e-9)) * 100, 2),
+                "swap_rate": mix["swap_rate"],
+                "token_parity_vs_merged": parity,
+                "adapters": n_adapters, "adapter_cache_slots": n_slots,
+                "rank": rank, "requests": n_req, "slots": B,
+                "new_tokens": new_tokens, "prompt_len": prompt_len,
+                "chunk": chunk, "block_size": block}
+
+    if model_name == "llama_serve_embed":
+        # Mixed generate + PREFILL-ONLY embedding serving through one
+        # fused engine (the multi-tenant scenario-diversity rung): a
+        # generate-only arm is the control, then the same generate
+        # workload re-runs with BENCH_EMBED embedding requests riding
+        # the SAME token-budget walk — the mixed arm reports generation
+        # tok/s (interference cost) plus embeds/s (the new capacity).
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import LLMEngine
+        from paddle_tpu.serving import AsyncLLMServer
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+        n_gen = int(os.environ.get("BENCH_REQUESTS", str(2 * B)))
+        n_emb = int(os.environ.get("BENCH_EMBED", str(n_gen)))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        chunk = int(os.environ.get("BENCH_CHUNK", "256"))
+        block = int(os.environ.get("BENCH_BLOCK", "64"))
+        prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
+        emb_len = int(os.environ.get("BENCH_EMBED_LEN", "256"))
+        cap = -(-(max(prompt_len, emb_len) + new_tokens) // chunk) * chunk
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=heads,
+                          max_position_embeddings=cap)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg).bfloat16()
+        model.eval()
+        V = cfg.vocab_size
+        gen_prompts = [rng.integers(0, V, (prompt_len,)).astype(np.int32)
+                       for _ in range(n_gen)]
+        emb_prompts = [rng.integers(0, V, (emb_len,)).astype(np.int32)
+                       for _ in range(n_emb)]
+
+        def run_arm(with_embed):
+            eng = LLMEngine(model, max_batch=B, max_seq_len=cap,
+                            chunk_size=chunk, cache_impl="paged",
+                            block_size=block, scheduler="fused")
+            warm = rng.integers(0, V, (3,)).astype(np.int32)
+            eng.generate([warm], max_new_tokens=2)
+            eng.reset_stats()
+            server = AsyncLLMServer(
+                eng, max_queue_size=n_gen + n_emb + 1)
+            server.start()
+            t0 = time.perf_counter()
+            hs = [server.submit(p, max_new_tokens=new_tokens)
+                  for p in gen_prompts]
+            ehs = [server.submit_embed(p)
+                   for p in emb_prompts] if with_embed else []
+            outs = [h.result(timeout=1800) for h in hs]
+            eouts = [h.result(timeout=1800) for h in ehs]
+            wall = time.perf_counter() - t0
+            server.stop()
+            toks = sum(len(o.token_ids) for o in outs)
+            assert all(o.embedding is not None for o in eouts)
+            snap = server.telemetry.snapshot(wall_s=wall)
+            return {
+                "tokens_per_sec": round(toks / wall, 1),
+                "embeds_per_sec": round(len(eouts) / wall, 2)
+                if with_embed else 0.0,
+                "embed_tokens_per_sec": round(
+                    sum(len(p) for p in emb_prompts) / wall, 1)
+                if with_embed else 0.0,
+                "ttft_p50_ms": round(
+                    snap["latency"]["ttft"]["p50_s"] * 1e3, 1),
+                "wall_s": round(wall, 3),
+            }, [list(o.token_ids) for o in outs]
+
+        gen_only, toks_only = run_arm(False)
+        mixed, toks_mixed = run_arm(True)
+        return {"metric": "llama_serve_embed_mixed_tokens_per_sec",
+                "value": mixed["tokens_per_sec"],
+                "unit": "tokens/s", "vs_baseline": None,
+                "generate_only": gen_only, "mixed": mixed,
+                "embeds_per_sec": mixed["embeds_per_sec"],
+                "generate_interference_pct": round(
+                    (1.0 - mixed["tokens_per_sec"]
+                     / max(gen_only["tokens_per_sec"], 1e-9)) * 100, 2),
+                # greedy serving: embed traffic riding the same steps
+                # must not change one generated token
+                "token_parity": toks_only == toks_mixed,
+                "gen_requests": n_gen, "embed_requests": n_emb,
+                "slots": B, "new_tokens": new_tokens,
+                "prompt_len": prompt_len, "embed_len": emb_len,
+                "chunk": chunk, "block_size": block}
+
     if model_name == "conv_roofline":
         return _bench_conv_roofline()
 
@@ -1706,6 +1908,7 @@ def _run_all():
             ("llama_paged_decode", None), ("llama_serve", None),
             ("llama_serve_fused", None), ("llama_serve_prefix_cache", None),
             ("llama_serve_cluster", None), ("llama_serve_spec", None),
+            ("llama_serve_lora", None), ("llama_serve_embed", None),
             ("llama", None)]:
         env = dict(os.environ, BENCH_MODEL=name)
         if extra:

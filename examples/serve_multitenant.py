@@ -1,0 +1,107 @@
+"""Multi-tenant serving: batched multi-LoRA + prefill-only embeddings
+through ONE fused engine.
+
+Run: python examples/serve_multitenant.py     # tiny demo model, CPU-ok
+Shows: an AdapterStore with two registered LoRA adapters (tenants 1 and
+2) served CONCURRENTLY with base-model traffic (tenant 0) and
+prefill-only embedding requests, all through one AsyncLLMServer over one
+fused paged LLMEngine — every tenant's rows gather its own low-rank
+delta inside the same compiled mixed step, embedding prompts ride the
+same token-budget walk as generation chunks, and the prefix cache keys
+KV blocks per tenant. The telemetry snapshot (adapter cache
+hits/misses/swaps, occupancy gauge, per-tenant token counters, embed
+request count) lands in docs/artifacts/multitenant_telemetry.json.
+"""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (AdapterStore, AsyncLLMServer,
+                                random_lora_weights)
+
+CFG = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=4, max_position_embeddings=256)
+
+
+def build_model():
+    paddle.seed(0)
+    model = LlamaForCausalLM(CFG)
+    model.eval()
+    return model
+
+
+def main():
+    rng = np.random.default_rng(0)
+    model = build_model()
+
+    # -- two tenants: small random LoRA factors over q/k/v/o + MLP
+    store = AdapterStore(CFG, rank=8)
+    tenant_a = store.register(
+        random_lora_weights(CFG, rank=8, seed=1, scale=0.05), alpha=2.0)
+    tenant_b = store.register(
+        random_lora_weights(CFG, rank=4, seed=2, scale=0.05), alpha=1.0)
+    print(f"registered adapters: {store.ids()} (rank pad to {store.rank})")
+
+    engine = LLMEngine(model, max_batch=4, max_seq_len=128, chunk_size=32,
+                       cache_impl="paged", block_size=16,
+                       scheduler="fused", enable_prefix_cache=True,
+                       adapter_store=store, adapter_cache_slots=2)
+    server = AsyncLLMServer(engine, max_queue_size=32)
+    server.start()
+
+    system_prompt = rng.integers(1, 512, size=(32,)).astype(np.int32)
+
+    def prompt():
+        tail = rng.integers(1, 512,
+                            size=(int(rng.integers(4, 12)),)).astype(np.int32)
+        return np.concatenate([system_prompt, tail])
+
+    # -- mixed multi-tenant submits: base + 2 adapters + embeddings,
+    # all batched through the same fused token-budget walk
+    handles = []
+    for i in range(6):
+        aid = (0, tenant_a, tenant_b)[i % 3]
+        handles.append((aid, server.submit(prompt(), max_new_tokens=16,
+                                           adapter_id=aid)))
+    embeds = [server.submit_embed(prompt(), adapter_id=aid)
+              for aid in (0, tenant_a, tenant_b)]
+
+    for aid, h in handles:
+        out = h.result(timeout=600)
+        print(f"tenant {aid}: rid={out.request_id} "
+              f"finish={out.finish_reason} tokens={out.token_ids[:8]}...")
+    for h in embeds:
+        out = h.result(timeout=600)
+        vec = out.embedding
+        print(f"embed rid={out.request_id}: shape={vec.shape} "
+              f"norm={float(np.linalg.norm(vec)):.3f}")
+
+    snap = server.telemetry.snapshot()
+    server.stop()
+
+    interesting = {k: snap["counters"][k] for k in
+                   ("adapter_cache_hits", "adapter_cache_misses",
+                    "adapter_swaps", "embed_requests",
+                    "prefix_hit_tokens", "tokens_emitted")}
+    print("adapter/embed counters:", interesting)
+    print("tenant tokens:", snap["tenant_tokens"])
+    print("adapter cache occupancy:",
+          snap["gauges"]["adapter_cache_occupancy"])
+
+    art_dir = os.path.join(os.path.dirname(__file__), "..", "docs",
+                           "artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    path = os.path.abspath(
+        os.path.join(art_dir, "multitenant_telemetry.json"))
+    with open(path, "w") as f:
+        json.dump(snap, f, indent=1)
+    print(f"telemetry snapshot -> {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -45,9 +45,30 @@ import numpy as np
 
 from ..core.tensor import Tensor, functional_mode
 from ..models.llama import SlotKVCache, _sample_logits_device
+from ..models.lora import lora_scope
 
 __all__ = ["LLMEngine", "GenerationRequest", "RequestOutput", "PendingStep",
-           "PoolCapacityError"]
+           "PoolCapacityError", "default_engine_stats"]
+
+
+def default_engine_stats():
+    """Fresh engine ``stats`` dict — THE one copy of the key schema.
+    The serving layer reads these keys by name off ANY engine speaking
+    the step protocol (LLMEngine, and protocol shims like
+    serving/embedding.py's BertEmbedEngine), so every engine must carry
+    the full set: a hand-copied dict would silently drift the next time
+    a counter is added."""
+    return {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
+            "draft_tokens_accepted": 0, "preemptions": 0,
+            "fused_steps": 0, "multi_steps": 0,
+            "prefill_tokens": 0,
+            "prefix_hit_tokens": 0, "prefix_cow_blocks": 0,
+            "prefix_evicted_blocks": 0,
+            "adapter_cache_hits": 0, "adapter_cache_misses": 0,
+            "adapter_swaps": 0, "embed_requests": 0,
+            "decode_time_s": 0.0, "admit_time_s": 0.0,
+            "dispatch_time_s": 0.0, "host_sync_time_s": 0.0,
+            "emit_time_s": 0.0}
 
 #: chain-hash seed for block 0 of every sequence (the "parent" of the
 #: first block) — a fixed constant so equal first blocks collide
@@ -95,6 +116,19 @@ class GenerationRequest:
     #: inter-token latency for THIS request at the whole batch's
     #: throughput cost — the effective stride is the min over slots)
     readout_stride: int | None = None
+    #: the TENANT dimension (batched multi-LoRA,
+    #: serving/adapters.py): 0 = the base model, > 0 = a registered
+    #: adapter whose gathered low-rank delta rides this request's rows
+    #: of every fused dispatch. Carried through preemption re-prefill,
+    #: supervised-restart re-admission and router failover, and mixed
+    #: into the prefix cache's hash-chain root so tenants never share
+    #: KV blocks.
+    adapter_id: int = 0
+    #: the request's GRANT KIND in the fused token-budget walk:
+    #: "generate" (prefill chunks, then one decode token per step) or
+    #: "embed" (PREFILL-ONLY — no decode tokens, no sampling; the
+    #: mean-pooled final hidden state returns on the prefill sync)
+    kind: str = "generate"
 
 
 @dataclasses.dataclass
@@ -103,16 +137,23 @@ class RequestOutput:
     token_ids: list
     finished: bool = False
     finish_reason: str | None = None
+    #: prefill-only (kind="embed") result: the mean-pooled final hidden
+    #: state [hidden_size] (fp32), None for generation requests
+    embedding: np.ndarray | None = None
 
 
 class _Slot:
     __slots__ = ("req", "generated", "prompt_len", "prefill_pos", "inflight",
-                 "chain", "reg_blocks")
+                 "chain", "reg_blocks", "a_slot")
 
     def __init__(self, req, prompt_len, prefill_pos=None):
         self.req = req
         self.generated = []
         self.prompt_len = prompt_len
+        #: device ROW of this request's adapter in the AdapterDeviceCache
+        #: stacks (0 = the all-zeros base row) — the per-slot index the
+        #: fused step gathers the LoRA delta by
+        self.a_slot = 0
         #: prefix-cache chain state (paged + enable_prefix_cache): the
         #: rolling chain hash of each REGISTERED full block of this
         #: slot's committed token stream, and how many blocks have been
@@ -159,10 +200,11 @@ class PendingStep:
     the OLD request's state)."""
 
     __slots__ = ("toks", "was_active", "counts", "spec", "slots",
-                 "pool_done", "sched", "step_id", "fenced", "t_dispatch")
+                 "pool_done", "sched", "step_id", "fenced", "t_dispatch",
+                 "embed_done", "pooled")
 
     def __init__(self, toks, was_active, counts, spec, slots, pool_done,
-                 sched=None, fenced=None):
+                 sched=None, fenced=None, embed_done=None):
         self.toks = toks              # device [rows, B] (spec: [Kh,B,Ks])
         self.was_active = was_active  # device activity history
         self.counts = counts          # spec only: accepted counts [Kh, B]
@@ -184,6 +226,14 @@ class PendingStep:
         #: emit stamps over [t_dispatch, sync] so a k-step stride's
         #: token burst doesn't read as one giant inter-token gap
         self.t_dispatch = None
+        #: [(slot_idx, _Slot), ...] embed requests whose FINAL prefill
+        #: chunk this dispatch carries — step_finish reads their pooled
+        #: hidden rows on the sync and retires them. ``pooled`` is THIS
+        #: dispatch's pooled-accumulator output (not the engine's
+        #: newest one: under pipelining the readout must not
+        #: synchronize on younger in-flight steps).
+        self.embed_done = embed_done or []
+        self.pooled = None
 
 
 class LLMEngine:
@@ -196,7 +246,8 @@ class LLMEngine:
                  lookup_ngram=3, mesh=None, cache_impl="dense",
                  block_size=64, kv_pool_blocks=None, scheduler="legacy",
                  max_step_tokens=None, enable_prefix_cache=False,
-                 readout_stride=1):
+                 readout_stride=1, adapter_store=None,
+                 adapter_cache_slots=4):
         """``scheduler="fused"`` (Sarathi-style chunked-prefill+decode
         fusion): admission becomes slot ASSIGNMENT only — each engine step
         then processes, per slot, either one bounded prefill chunk (for
@@ -390,6 +441,32 @@ class LLMEngine:
             #: table/refcount consistency after every alloc/free.
             self._debug_pool = os.environ.get(
                 "PADDLE_TPU_POOL_CHECKS", "0") not in ("", "0")
+        # ---- batched multi-LoRA (serving/adapters.py) ----------------
+        #: host AdapterStore of registered low-rank deltas; None = the
+        #: multi-tenant machinery is entirely absent (every program
+        #: traces the pre-adapter body — bit-identical serving). With a
+        #: store attached but EMPTY, dispatches still pass lora=None, so
+        #: base output stays bit-identical until the first registration
+        #: (which retraces the step programs exactly once).
+        self.adapter_store = adapter_store
+        self._adapter_slots = int(adapter_cache_slots)
+        #: lazily-built AdapterDeviceCache (stacked device factors +
+        #: LRU slot allocator); reset() drops it with the other device
+        #: buffers and the next adapter dispatch rebuilds + re-swaps
+        self.adapter_cache = None
+        if adapter_store is not None:
+            if self.speculative_k > 1:
+                raise ValueError(
+                    "batched multi-LoRA serves through the per-slot "
+                    "gather of the plain/fused steps (speculative "
+                    "verify windows are not adapter-aware)")
+            if getattr(c, "fuse_attention_qkv", False) or \
+                    getattr(c, "fuse_swiglu", False):
+                raise ValueError(
+                    "batched multi-LoRA targets the separate q/k/v and "
+                    "gate/up projections — build the serving model "
+                    "without fuse_attention_qkv/fuse_swiglu")
+        self._hidden = c.hidden_size
         # admission-order stamps: the paged allocator's preempt-newest
         # invariant AND the fused scheduler's oldest-first budget walk
         self._admit_order = [0] * self.B
@@ -409,6 +486,7 @@ class LLMEngine:
         self._step_fn = None
         self._prefill_fn = None
         self._set_logits_fn = None
+        self._set_pooled_fn = None
         #: outstanding step_begin() dispatches not yet step_finish()ed —
         #: the paged engine must stay at depth 1 (its host block allocator
         #: needs post-step lens before the next dispatch)
@@ -433,15 +511,7 @@ class LLMEngine:
         #: window; 0.0 outside a readout walk and for 1-row steps) — the
         #: serving layer reads it inside its stream callback
         self.emit_backdate_s = 0.0
-        self.stats = {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
-                      "draft_tokens_accepted": 0, "preemptions": 0,
-                      "fused_steps": 0, "multi_steps": 0,
-                      "prefill_tokens": 0,
-                      "prefix_hit_tokens": 0, "prefix_cow_blocks": 0,
-                      "prefix_evicted_blocks": 0,
-                      "decode_time_s": 0.0, "admit_time_s": 0.0,
-                      "dispatch_time_s": 0.0, "host_sync_time_s": 0.0,
-                      "emit_time_s": 0.0}
+        self.stats = default_engine_stats()
 
     # ------------------------------------------------------------------
     # device state (built at __init__, REBUILT by reset())
@@ -529,6 +599,15 @@ class LLMEngine:
         # in-graph prompt-lookup draft reads it, decode windows append
         self._tokens = self._make_zeros((self.B, self.capacity), np.int32) \
             if self.speculative_k > 1 else None
+        #: per-slot mean-pool accumulator for PREFILL-ONLY (embed)
+        #: requests: each fused mixed step adds the sum of its granted
+        #: prefill rows' final hidden states; the finishing readout
+        #: divides by the prompt length. Zeroed per slot at admission.
+        self._pooled = self._make_zeros((self.B, self._hidden), np.float32)
+        #: the adapter device cache dies with the other device buffers
+        #: (a crashed dispatch may have consumed its stacks through
+        #: donation) — the next adapter dispatch rebuilds and re-swaps
+        self.adapter_cache = None
 
     def reset(self):
         """Tear the engine down to EMPTY and re-arm it — the supervised
@@ -627,15 +706,19 @@ class LLMEngine:
             return jnp.where(temps <= 0.0, greedy_tok, sampled)
 
         def one_step(k_bufs, v_bufs, logits, lens, active, rng, state_vals,
-                     temps, top_ps, eos_ids, rids, tables):
+                     temps, top_ps, eos_ids, rids, tables, lora=None):
             """sample from current logits -> one-token model step.
             ``tables`` selects the cache backend at TRACE time: None ->
             dense SlotKVCache slot buffers; a [B, MB] array -> PagedKVCache
-            block pool (ONE body serves both engines)."""
+            block pool (ONE body serves both engines). ``lora`` (batched
+            multi-LoRA): the traced adapter stacks + per-slot device
+            rows — the scope adds the gathered delta to every llama
+            projection; None traces the exact pre-adapter body."""
             nxt = sample_next(logits, rng, temps, top_ps, rids, lens)
             # inactive slots decode garbage; pin them to token 0
             nxt = jnp.where(active, nxt, 0)
-            with functional_mode(), _bind(state, state_vals):
+            with functional_mode(), _bind(state, state_vals), \
+                    lora_scope(lora):
                 if tables is None:
                     caches = [SlotKVCache(k, v, lens)
                               for k, v in zip(k_bufs, v_bufs)]
@@ -661,7 +744,8 @@ class LLMEngine:
             return nxt, new_logits, kb, vb, new_lens, finished, rng
 
         def step(state_vals, k_bufs, v_bufs, logits, lens, active, rng,
-                 temps, top_ps, eos_ids, budgets, rids, tables=None):
+                 temps, top_ps, eos_ids, budgets, rids, tables=None,
+                 lora=None):
             """`horizon` decode iterations as ONE compiled lax.scan — the
             host sync (and through a tunnel, the RTT) amortizes over K
             tokens per slot. A slot that hits eos, capacity, or its
@@ -673,7 +757,7 @@ class LLMEngine:
                 kb, vb, logits, lens, act, emitted, rng = carry
                 nxt, logits, kb, vb, lens, finished, rng = one_step(
                     kb, vb, logits, lens, act, rng, state_vals, temps,
-                    top_ps, eos_ids, rids, tables)
+                    top_ps, eos_ids, rids, tables, lora)
                 emitted = emitted + act.astype(jnp.int32)
                 act_next = act & ~finished & (lens < cap - 1) & \
                     (emitted < budgets)
@@ -702,7 +786,7 @@ class LLMEngine:
             the same single [rows, B] device→host sync."""
             def multi_step(state_vals, k_bufs, v_bufs, logits, lens,
                            active, rng, temps, top_ps, eos_ids, budgets,
-                           rids, tables=None):
+                           rids, tables=None, lora=None):
                 nL = len(k_bufs)
 
                 def cond(carry):
@@ -714,7 +798,7 @@ class LLMEngine:
                     i, kb, vb, lg, ln, act, emitted, toks, wa = carry
                     nxt, lg, kb, vb, ln, finished, _ = one_step(
                         kb, vb, lg, ln, act, rng, state_vals, temps,
-                        top_ps, eos_ids, rids, tables)
+                        top_ps, eos_ids, rids, tables, lora)
                     toks = jax.lax.dynamic_update_slice(
                         toks, nxt[None], (i, jnp.int32(0)))
                     wa = jax.lax.dynamic_update_slice(
@@ -801,7 +885,7 @@ class LLMEngine:
 
         def fused_step(state_vals, k_bufs, v_bufs, logits, lens, rng, ids,
                        q_lens, is_decode, active, temps, top_ps, rids,
-                       tables=None):
+                       tables=None, lora=None, is_embed=None, pooled=None):
             """ONE mixed prefill+decode dispatch (the fused scheduler's
             step): slot b processes rows [0, q_lens[b]) of ``ids`` —
             either a prefill chunk (host-provided prompt rows) or one
@@ -810,7 +894,15 @@ class LLMEngine:
             Every slot's rows sit at its own absolute positions
             (``lens``); padding rows write nothing (drop-scatter) and
             their outputs are never read. ``tables`` selects the cache
-            backend at trace time exactly like ``step``."""
+            backend at trace time exactly like ``step``; ``lora`` arms
+            the per-slot adapter delta exactly like ``one_step``.
+
+            ``pooled``/``is_embed`` (prefill-only grant kind): when an
+            EMBED slot is resident, its granted prefill rows' final
+            hidden states accumulate into its ``pooled`` row — the
+            mean-pool numerator the finishing readout divides by the
+            prompt length. Passed as None on generate-only dispatches,
+            so the no-embed program is untouched."""
             nxt = sample_next(logits, rng, temps, top_ps, rids, lens)
             # capacity guard for pipelined over-dispatch: a window that
             # would cross the buffer end deactivates in-graph
@@ -820,7 +912,8 @@ class LLMEngine:
             q_eff = jnp.where(active, q_lens, 0)
             row0 = jnp.arange(chunk, dtype=jnp.int32)[None, :] == 0
             ids = jnp.where(dec[:, None] & row0, nxt[:, None], ids)
-            with functional_mode(), _bind(state, state_vals):
+            with functional_mode(), _bind(state, state_vals), \
+                    lora_scope(lora):
                 if tables is None:
                     from ..models.llama import ChunkKVCache
                     caches = [ChunkKVCache(k, v, lens, q_eff)
@@ -841,6 +934,17 @@ class LLMEngine:
                     jnp.maximum(q_eff - 1, 0)[:, None, None], axis=1)
                 new_logits = model._logits(Tensor(rows))._value[:, 0] \
                     .astype(jnp.float32)
+            if pooled is not None:
+                # masked sum of this dispatch's real prefill rows for
+                # embed slots only, fp32 — one tiny [B,S,H]x[B,S]
+                # contraction riding the mixed step
+                rows_real = jnp.arange(chunk, dtype=jnp.int32)[None, :] \
+                    < q_eff[:, None]
+                emb_mask = (rows_real & is_embed[:, None]
+                            & ~is_decode[:, None]).astype(jnp.float32)
+                pooled = pooled + jnp.einsum(
+                    "bsh,bs->bh", hidden._value.astype(jnp.float32),
+                    emb_mask)
             new_logits = jnp.where(active[:, None], new_logits, logits)
             kb = [cc.k._value if isinstance(cc.k, Tensor) else cc.k
                   for cc in new_caches]
@@ -851,12 +955,15 @@ class LLMEngine:
             # is shared with the scan-based steps (K == 1 here)
             return (_pin_rep(nxt[None]), _pin_rep(dec[None]),
                     _pin_rep(new_logits), _pin_kv(kb), _pin_kv(vb),
-                    _pin_rep(new_lens), rng)
+                    _pin_rep(new_lens), rng, pooled)
 
-        def prefill_chunk(state_vals, k_bufs, v_bufs, ids, slot, off, last):
+        def prefill_chunk(state_vals, k_bufs, v_bufs, ids, slot, off, last,
+                          lora=None):
             """Run chunk `ids` [1, chunk] of one prompt through the model
             against slot `slot`'s KV region starting at position `off`;
-            returns updated buffers + the logits at in-chunk row `last`."""
+            returns updated buffers + the logits at in-chunk row `last`.
+            ``lora``: the single-sequence adapter pack (slots vector of
+            length 1) — prefill KV must carry the tenant's deltas."""
             from ..models.llama import StaticKVCache
 
             z = jnp.int32(0)
@@ -864,7 +971,8 @@ class LLMEngine:
                 k, (slot, z, z, z), (1,) + k.shape[1:]) for k in k_bufs]
             v_slot = [jax.lax.dynamic_slice(
                 v, (slot, z, z, z), (1,) + v.shape[1:]) for v in v_bufs]
-            with functional_mode(), _bind(state, state_vals):
+            with functional_mode(), _bind(state, state_vals), \
+                    lora_scope(lora):
                 caches = [StaticKVCache(k, v)
                           for k, v in zip(k_slot, v_slot)]
                 hidden, new_caches = model.llama(
@@ -894,7 +1002,7 @@ class LLMEngine:
             MB = self._max_blocks
 
             def prefill_chunk_paged(state_vals, k_pools, v_pools, ids,
-                                    table_row, off, last):
+                                    table_row, off, last, lora=None):
                 """Paged chunked prefill: gather the slot's logical KV from
                 its blocks, run the chunk like the dense path, scatter the
                 chunk's new KV back into the (block-aligned) blocks."""
@@ -908,7 +1016,8 @@ class LLMEngine:
                 v_slot = [jnp.moveaxis(p[safe], 2, 1).reshape(
                     1, MB * bs_blk, p.shape[1], p.shape[3])
                     for p in v_pools]
-                with functional_mode(), _bind(state, state_vals):
+                with functional_mode(), _bind(state, state_vals), \
+                        lora_scope(lora):
                     caches = [StaticKVCache(k, v)
                               for k, v in zip(k_slot, v_slot)]
                     hidden, new_caches = model.llama(
@@ -966,6 +1075,15 @@ class LLMEngine:
         def set_len(lens, slot, val):
             return jax.lax.dynamic_update_slice(lens, val[None], (slot,))
 
+        def set_pooled_zero(pooled, slot):
+            z = jnp.zeros((1, pooled.shape[1]), pooled.dtype)
+            return jax.lax.dynamic_update_slice(pooled, z,
+                                                (slot, jnp.int32(0)))
+
+        # NOT donated: an in-flight PendingStep may still hold this very
+        # array as its pooled output (step_finish reads it after the
+        # sync) — the zero-row update copies a tiny [B, H] buffer
+        self._set_pooled_fn = jax.jit(set_pooled_zero)
         self._step_fn = jax.jit(step, donate_argnums=(1, 2, 3))
         # the paged step IS the unified step with `tables` bound — one
         # traced body serves both cache backends
@@ -1005,11 +1123,88 @@ class LLMEngine:
         return max(1, min([self.readout_stride] + pins))
 
     # ------------------------------------------------------------------
+    # batched multi-LoRA (tenant) plumbing — serving/adapters.py
+    # ------------------------------------------------------------------
+    def _lora_armed(self):
+        return self.adapter_store is not None and \
+            len(self.adapter_store) > 0
+
+    def _ensure_adapter_cache(self):
+        if self.adapter_cache is None:
+            from ..serving.adapters import AdapterDeviceCache
+            self.adapter_cache = AdapterDeviceCache(
+                self.adapter_store, n_slots=self._adapter_slots,
+                make_zeros=self._make_zeros)
+        return self.adapter_cache
+
+    def _lora_pack(self, rows):
+        """The traced LoRA arguments of one dispatch: the device stacks
+        plus the per-batch-row adapter slot vector ``rows`` ([B] int32;
+        0 = base). None while no adapter is registered — the step
+        programs then trace the exact pre-adapter body (bit-identical
+        base serving); the first registered adapter flips the signature
+        and retraces once."""
+        if not self._lora_armed():
+            return None
+        cache = self._ensure_adapter_cache()
+        return {"A": cache.A, "B": cache.B, "alpha": cache.alpha,
+                "slots": np.asarray(rows, np.int32)}
+
+    def _slot_adapter_rows(self):
+        return np.array([s.a_slot if s is not None else 0
+                         for s in self.slots], np.int32)
+
+    def _acquire_adapter(self, req):
+        """Pin ``req``'s adapter resident in the device cache; returns
+        its device row (0 = base), or None when every cache slot is
+        pinned by resident requests — the admission then DEFERS exactly
+        like a dry KV pool (a retirement releases a slot)."""
+        aid = getattr(req, "adapter_id", 0)
+        if not aid:
+            return 0
+        cache = self._ensure_adapter_cache()
+        before = dict(cache.stats)
+        row = cache.acquire(aid)
+        self.stats["adapter_cache_hits"] += \
+            cache.stats["hits"] - before["hits"]
+        self.stats["adapter_cache_misses"] += \
+            cache.stats["misses"] - before["misses"]
+        self.stats["adapter_swaps"] += \
+            cache.stats["swaps"] - before["swaps"]
+        return row
+
+    def _release_adapter(self, adapter_id):
+        if adapter_id and self.adapter_cache is not None:
+            self.adapter_cache.release(adapter_id)
+
+    def adapter_resident(self, adapter_id):
+        """READ-ONLY: could a request for ``adapter_id`` admit without a
+        swap right now? The replica router's adapter-affinity probe
+        (dict reads only — safe from any thread)."""
+        if not adapter_id:
+            return True
+        return self.adapter_cache is not None and \
+            self.adapter_cache.resident(adapter_id)
+
+    @staticmethod
+    def _tenant_root(adapter_id):
+        """The prefix-cache hash-chain ROOT of one tenant: adapter id 0
+        keeps the historical root (base-tenant hashes are unchanged);
+        any other id mixes into the seed, so two tenants' chains over
+        the SAME prompt never collide — different fine-tunes produce
+        different KV for identical tokens, and a shared block would
+        silently serve tenant A's KV to tenant B."""
+        if not adapter_id:
+            return _ROOT_HASH
+        return _ROOT_HASH + b"/tenant=" + str(int(adapter_id)).encode()
+
+    # ------------------------------------------------------------------
     # request lifecycle
     # ------------------------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=64, temperature=0.0,
                     top_p=1.0, eos_token_id=None, request_id=None,
-                    committed_tokens=None, readout_stride=None):
+                    committed_tokens=None, readout_stride=None,
+                    adapter_id=0, kind="generate"):
         """``readout_stride``: per-request latency-tier pin — cap the
         multi-step decode stride of every all-decode step this request
         is active in (1 = sync the host every step; None = the engine
@@ -1023,7 +1218,13 @@ class LLMEngine:
         committed ones, and ``max_new_tokens`` counts only NEW tokens.
         Token-exactness rides the per-(rid, position) fold_in sampling
         keys: position ``len(prompt)+len(committed)`` samples the same
-        token it would have in the uninterrupted run."""
+        token it would have in the uninterrupted run.
+
+        ``adapter_id``: the request's TENANT — a registered id in the
+        engine's adapter store (0 = base model). ``kind="embed"`` makes
+        the request PREFILL-ONLY (fused scheduler required): no decode
+        tokens, no sampling; the finished RequestOutput carries the
+        mean-pooled final hidden state in ``embedding``."""
         ids = np.asarray(
             prompt_ids.numpy() if hasattr(prompt_ids, "numpy")
             else prompt_ids, dtype=np.int32).reshape(-1)
@@ -1032,12 +1233,39 @@ class LLMEngine:
         if readout_stride is not None and int(readout_stride) < 1:
             raise ValueError(f"readout_stride must be >= 1, got "
                              f"{readout_stride}")
+        adapter_id = int(adapter_id or 0)
+        if adapter_id:
+            if self.adapter_store is None:
+                raise ValueError(
+                    f"adapter_id {adapter_id} on an engine without an "
+                    f"adapter_store (LLMEngine(adapter_store=...))")
+            if not self.adapter_store.has(adapter_id):
+                raise ValueError(f"unknown adapter_id {adapter_id} (not "
+                                 f"registered in the adapter store)")
+        if kind not in ("generate", "embed"):
+            raise ValueError(f"unknown request kind {kind!r}")
+        if kind == "embed":
+            if self.scheduler != "fused":
+                raise ValueError(
+                    "embedding (prefill-only) requests need "
+                    "scheduler='fused' — the prefill-only grant kind "
+                    "lives in the fused token-budget walk")
+            max_new_tokens = 0
+            # no decode headroom needed: an embed prompt may run to
+            # capacity - 1 (the +1 in the fused pool arithmetic covers
+            # the last granted position)
+            if len(ids) > self.capacity - 1:
+                raise ValueError(
+                    f"embedding prompt of {len(ids)} tokens exceeds the "
+                    f"engine capacity ({self.capacity} - 1)")
+            self.stats["embed_requests"] += 1
         committed = [int(t) for t in committed_tokens] \
             if committed_tokens else []
         if committed:
             ids = np.concatenate(
                 [ids, np.asarray(committed, np.int32)])
-        if len(ids) >= self.capacity - self.speculative_k:
+        if kind != "embed" and \
+                len(ids) >= self.capacity - self.speculative_k:
             raise ValueError(f"prompt of {len(ids)} tokens leaves no room "
                              f"to generate (engine capacity "
                              f"{self.capacity})")
@@ -1058,7 +1286,8 @@ class LLMEngine:
             rid, ids, int(max_new_tokens), float(temperature), float(top_p),
             eos_token_id,
             readout_stride=(int(readout_stride)
-                            if readout_stride is not None else None)))
+                            if readout_stride is not None else None),
+            adapter_id=adapter_id, kind=kind))
         return rid
 
     def has_unfinished(self):
@@ -1251,13 +1480,15 @@ class LLMEngine:
         while slot.reg_blocks < n_full:
             i = slot.reg_blocks
             toks = self._slot_token_range(slot, i * bs, (i + 1) * bs)
-            parent = slot.chain[i - 1] if i else _ROOT_HASH
+            parent = slot.chain[i - 1] if i else \
+                self._tenant_root(slot.req.adapter_id)
             h = self._chain_hash(parent, toks)
             slot.chain.append(h)
             self._register_block(blocks[i], h, parent, toks)
             slot.reg_blocks += 1
 
-    def _probe_prefix(self, slot_idx, token_ids, chunk_granular=False):
+    def _probe_prefix(self, slot_idx, token_ids, chunk_granular=False,
+                      adapter_id=0):
         """Find the longest cached prefix of ``token_ids`` and attach it
         to slot ``slot_idx``: pure table writes + refcount bumps, zero
         prefill FLOPs for the hit span. The hit is capped at P-1 tokens —
@@ -1278,7 +1509,10 @@ class LLMEngine:
         max_full = (P - 1) // bs
         if chunk_granular:
             max_full = ((P - 1) // self.chunk) * (self.chunk // bs)
-        found, parent = [], _ROOT_HASH
+        # the chain seeds at the TENANT root: two adapters' chains over
+        # the same prompt diverge from block 0, so no probe can ever
+        # attach another tenant's KV
+        found, parent = [], self._tenant_root(adapter_id)
         for k in range(min(max_full, self._max_blocks)):
             h = self._chain_hash(parent, token_ids[k * bs:(k + 1) * bs])
             phys = self._store.get(h)
@@ -1308,26 +1542,28 @@ class LLMEngine:
             chain.append(h)
         hit = len(found) * bs
         if not chunk_granular:
-            hit += self._cow_tail(slot_idx, token_ids, hit, chain)
+            hit += self._cow_tail(slot_idx, token_ids, hit, chain,
+                                  adapter_id=adapter_id)
         self._check_pool_invariants()
         return hit, chain
 
-    def prefix_chain_hashes(self, token_ids):
+    def prefix_chain_hashes(self, token_ids, adapter_id=0):
         """Per-full-block rolling chain hashes of ``token_ids`` — the
         router's affinity precompute. Content-only (no engine state
         read), so one computation serves every replica with the same
-        ``block_size``. Empty when the prefix cache is off."""
+        ``block_size`` AND tenant (the chain seeds at the tenant root).
+        Empty when the prefix cache is off."""
         if self.cache_impl != "paged" or not self.prefix_cache:
             return []
         ids = np.asarray(token_ids, np.int32).reshape(-1)
         bs = self.block_size
-        parent, out = _ROOT_HASH, []
+        parent, out = self._tenant_root(adapter_id), []
         for k in range(min((len(ids) - 1) // bs, self._max_blocks)):
             parent = self._chain_hash(parent, ids[k * bs:(k + 1) * bs])
             out.append(parent)
         return out
 
-    def probe_prefix_len(self, token_ids, chain_hashes=None):
+    def probe_prefix_len(self, token_ids, chain_hashes=None, adapter_id=0):
         """READ-ONLY affinity probe: how many leading tokens of
         ``token_ids`` the content store could serve right now (full
         cached blocks only — no COW extension, no refcount bumps, no
@@ -1343,7 +1579,8 @@ class LLMEngine:
         if self.cache_impl != "paged" or not self.prefix_cache:
             return 0
         if chain_hashes is None:
-            chain_hashes = self.prefix_chain_hashes(token_ids)
+            chain_hashes = self.prefix_chain_hashes(token_ids,
+                                                    adapter_id=adapter_id)
         hit = 0
         for h in chain_hashes[:self._max_blocks]:
             if h not in self._store:
@@ -1351,7 +1588,7 @@ class LLMEngine:
             hit += self.block_size
         return hit
 
-    def _cow_tail(self, slot_idx, token_ids, hit, chain):
+    def _cow_tail(self, slot_idx, token_ids, hit, chain, adapter_id=0):
         """Token-granular hit extension (copy-on-write): if a cached full
         block CONTINUES the hit chain and its leading tokens match the
         remaining prompt, the slot needs exactly that block's prefix —
@@ -1366,7 +1603,7 @@ class LLMEngine:
         cap = min(bs - 1, P - 1 - hit)
         if cap <= 0:
             return 0
-        parent = chain[-1] if chain else _ROOT_HASH
+        parent = chain[-1] if chain else self._tenant_root(adapter_id)
         rem = np.asarray(token_ids[hit:hit + cap], np.int32)
         best, best_t = None, 0
         for phys in self._children.get(parent, ()):
@@ -1540,8 +1777,14 @@ class LLMEngine:
         self._check_pool_invariants()
 
     def _free_slot(self, slot_idx):
+        slot = self.slots[slot_idx]
         if self.cache_impl == "paged":
             self._release_slot_blocks(slot_idx)
+        if slot is not None:
+            # drop this request's pin on its adapter's device slot (a
+            # refcount-0 slot parks in the adapter LRU, still loaded —
+            # the tenant's next request hits without a swap)
+            self._release_adapter(getattr(slot.req, "adapter_id", 0))
         self.slots[slot_idx] = None
 
     def _preempt_newest(self, exclude=None, newer_than=None, retired=None):
@@ -1606,7 +1849,8 @@ class LLMEngine:
             req.request_id, done,
             req.max_new_tokens - len(slot.generated),
             req.temperature, req.top_p, req.eos_token_id,
-            readout_stride=req.readout_stride))
+            readout_stride=req.readout_stride,
+            adapter_id=req.adapter_id, kind=req.kind))
         self._free_slot(b)
         self.stats["preemptions"] += 1
         if self._rec() is not None:
@@ -1617,16 +1861,20 @@ class LLMEngine:
         prefix = self._preempted_prefix.pop(req.request_id, [])
         return list(prefix) + list(generated)
 
-    def _admit(self, slot_idx, req):
+    def _admit(self, slot_idx, req, a_slot=0):
         """Chunked prefill of `req` into slot `slot_idx`. Dispatches are
         ASYNC (no host read), so chunk programs pipeline on device; the
         admit_time_s stat records only the host-side enqueue cost — the
         device-side prefill compute lands inside the next decode read.
-        Paged mode returns False when the pool can't cover the prompt."""
+        Paged mode returns False when the pool can't cover the prompt.
+        ``a_slot``: the request's adapter device row (already acquired
+        by the caller) — prefill KV must carry the adapter's deltas."""
         t0 = time.perf_counter()
         self._programs()
         P = len(req.prompt_ids)
         paged = self.cache_impl == "paged"
+        # single-sequence prefill: the LoRA gather sees a batch of one
+        lora1 = self._lora_pack(np.array([a_slot], np.int32))
         hit, chain = 0, []
         if paged:
             if self.prefix_cache:
@@ -1635,7 +1883,8 @@ class LLMEngine:
                 # scatter into a shared block, so the hit boundary must
                 # be a window boundary
                 hit, chain = self._probe_prefix(slot_idx, req.prompt_ids,
-                                                chunk_granular=True)
+                                                chunk_granular=True,
+                                                adapter_id=req.adapter_id)
             # prefill writes whole chunks: cover round_up(P, chunk), then
             # release the over-allocation down to the prompt's own blocks
             # (chunk is a block multiple, so blocks-needed * block_size
@@ -1683,12 +1932,12 @@ class LLMEngine:
                 self._k, self._v, logits_row = self._prefill_paged_fn(
                     self._state_vals, self._k, self._v, chunk_ids,
                     table_row, np.int32(win),
-                    np.int32(off + take - 1 - win))
+                    np.int32(off + take - 1 - win), lora=lora1)
             else:
                 self._k, self._v, logits_row = self._prefill_fn(
                     self._state_vals, self._k, self._v, chunk_ids,
                     np.int32(slot_idx), np.int32(win),
-                    np.int32(off + take - 1 - win))
+                    np.int32(off + take - 1 - win), lora=lora1)
             off += take
             self.stats["prefill_chunks"] += 1
             self.stats["prefill_tokens"] += take
@@ -1722,6 +1971,7 @@ class LLMEngine:
         slot = _Slot(req, P)
         slot.chain = chain
         slot.reg_blocks = len(chain)
+        slot.a_slot = a_slot
         self.slots[slot_idx] = slot
         if paged:
             # the whole prompt is prefilled: publish its full blocks'
@@ -1730,7 +1980,7 @@ class LLMEngine:
             self._check_pool_invariants()
         self.stats["admit_time_s"] += time.perf_counter() - t0
 
-    def _admit_fused(self, slot_idx, req):
+    def _admit_fused(self, slot_idx, req, a_slot=0):
         """Fused-scheduler admission: slot ASSIGNMENT plus (prefix cache
         on) the content-store probe — hit blocks attach by table writes
         and refcount bumps, the optional COW tail costs one block clone,
@@ -1742,13 +1992,24 @@ class LLMEngine:
         t0 = time.perf_counter()
         self._programs()
         hit, chain = 0, []
-        if self.prefix_cache:
-            hit, chain = self._probe_prefix(slot_idx, req.prompt_ids)
+        if self.prefix_cache and req.kind != "embed":
+            # embed requests never PROBE: a hit would skip the shared
+            # span's hidden-state computation and corrupt the mean pool.
+            # They still REGISTER their filled blocks (the KV content is
+            # a pure function of tenant + tokens), so a later generate
+            # request of the same tenant hits them.
+            hit, chain = self._probe_prefix(slot_idx, req.prompt_ids,
+                                            adapter_id=req.adapter_id)
         self._lens = self._set_len_fn(self._lens, np.int32(slot_idx),
                                       np.int32(hit))
+        if req.kind == "embed":
+            # fresh mean-pool accumulator for this slot's new occupant
+            self._pooled = self._set_pooled_fn(self._pooled,
+                                               np.int32(slot_idx))
         slot = _Slot(req, len(req.prompt_ids), prefill_pos=hit)
         slot.chain = chain
         slot.reg_blocks = len(chain)
+        slot.a_slot = a_slot
         self.slots[slot_idx] = slot
         if hit:
             self.stats["prefix_hit_tokens"] += hit
@@ -1782,12 +2043,19 @@ class LLMEngine:
                     # can NEVER ramp in: leave it at the head; step_begin
                     # raises the loud too-small-pool error
                     break
+                a_slot = self._acquire_adapter(req)
+                if a_slot is None:
+                    # every adapter cache slot is pinned by resident
+                    # requests: defer (a retirement releases one) —
+                    # exactly the dry-pool admission shape
+                    break
                 self.waiting.popleft()
                 if fused:
-                    self._admit_fused(b, req)
-                elif self._admit(b, req) is False:
+                    self._admit_fused(b, req, a_slot)
+                elif self._admit(b, req, a_slot) is False:
                     # paged pool dry: requeue and wait for a retirement
                     self.waiting.appendleft(req)
+                    self._release_adapter(req.adapter_id)
                     break
 
     # ------------------------------------------------------------------
@@ -1818,7 +2086,7 @@ class LLMEngine:
         rec, ctx = self._rec(), self._rec_ctx
         if rec is None or ctx is None:
             return
-        t0, admit0, hits0 = ctx
+        t0, admit0, hits0, swaps0 = ctx
         wall = time.perf_counter() - t0
         admit_s = self.stats["admit_time_s"] - admit0
         paged = self.cache_impl == "paged"
@@ -1837,7 +2105,13 @@ class LLMEngine:
             prefix_hit_tokens=(self.stats["prefix_hit_tokens"] - hits0
                                if self.prefix_cache else None),
             cached_blocks=len(self._lru) if self.prefix_cache else None,
-            readout_stride=readout_stride)
+            readout_stride=readout_stride,
+            # per-slot TENANT ids + this step's adapter swap-ins (the
+            # explain_tail "adapter_swap" cause reads them back)
+            adapter_slots=tuple(
+                (b, s.req.adapter_id) for b, s in enumerate(self.slots)
+                if s is not None and s.req.adapter_id),
+            adapter_swaps=self.stats["adapter_swaps"] - swaps0)
         self._rec_ctx = None
 
     def step_begin(self):
@@ -1884,10 +2158,12 @@ class LLMEngine:
         if self._rec() is not None:
             # wall-split anchors for this step's record: entry time,
             # admit-stat baseline (scheduling = wall - admit - dispatch),
-            # prefix-hit baseline (the record carries this step's hits)
+            # prefix-hit + adapter-swap baselines (the record carries
+            # this step's deltas)
             self._rec_ctx = (time.perf_counter(),
                              self.stats["admit_time_s"],
-                             self.stats["prefix_hit_tokens"])
+                             self.stats["prefix_hit_tokens"],
+                             self.stats["adapter_swaps"])
             self._rec_preempted = []
         self._admit_waiting()
         if not any(s is not None for s in self.slots):
@@ -1950,6 +2226,10 @@ class LLMEngine:
                 if self.slots[b] is None:
                     continue  # evicted below while ensuring an older slot
                 slot = self.slots[b]
+                if slot.req.kind == "embed":
+                    # fully-ramped embed slot awaiting its pooled
+                    # readout: no decode growth, no block coverage
+                    continue
                 # sched_len counts in-flight growth too: under the fused
                 # scheduler's pipelining the host allocates for step N+1
                 # before step N's readout (legacy engines run depth 1
@@ -1988,7 +2268,11 @@ class LLMEngine:
                         self._retire_pool_edge(b, pool_done)
                         break
 
-        active = np.array([s is not None for s in self.slots])
+        # embed slots never DECODE: one fully ramped but unread (its
+        # pooled readout rides an earlier in-flight dispatch) sits
+        # inactive in an all-decode step
+        active = np.array([s is not None and s.req.kind != "embed"
+                           for s in self.slots])
         if not active.any():
             if pool_done:
                 pending = PendingStep(None, None, None, spec,
@@ -2037,6 +2321,10 @@ class LLMEngine:
         # the pre-stride engine by construction.
         use_multi = self.readout_stride > 1 and stride > 1
 
+        # gathered per-slot adapter rows (None while no adapter is
+        # registered — the dispatch then traces the pre-adapter body)
+        lora = self._lora_pack(self._slot_adapter_rows())
+
         # the decode clock starts HERE: pool-allocator scans and host array
         # construction above must not masquerade as device decode time in
         # throughput() or the serve bench's wall split. All arms DISPATCH
@@ -2052,13 +2340,14 @@ class LLMEngine:
                      self._lens, self._rng_key) = fn(
                         self._state_vals, self._k, self._v, self._logits,
                         self._lens, active, self._rng_key, temps, top_ps,
-                        eos_ids, budgets, rids, self._tables.copy())
+                        eos_ids, budgets, rids, self._tables.copy(),
+                        lora=lora)
             else:
                 (toks, was_active, self._logits, self._k, self._v,
                  self._lens, self._rng_key) = fn(
                     self._state_vals, self._k, self._v, self._logits,
                     self._lens, active, self._rng_key, temps, top_ps,
-                    eos_ids, budgets, rids)
+                    eos_ids, budgets, rids, lora=lora)
             self.stats["multi_steps"] += 1
         elif self.cache_impl == "paged":
             with self._kernel_tp_ctx():
@@ -2066,7 +2355,8 @@ class LLMEngine:
                  self._lens, self._rng_key) = self._step_paged_fn(
                     self._state_vals, self._k, self._v, self._logits,
                     self._lens, active, self._rng_key, temps, top_ps,
-                    eos_ids, budgets, rids, self._tables.copy())
+                    eos_ids, budgets, rids, self._tables.copy(),
+                    lora=lora)
         elif spec:
             (toks, counts, was_active, self._logits, self._k, self._v,
              self._lens, self._rng_key, self._tokens) = self._spec_fn(
@@ -2078,7 +2368,7 @@ class LLMEngine:
              self._rng_key) = self._step_fn(
                 self._state_vals, self._k, self._v, self._logits,
                 self._lens, active, self._rng_key,
-                temps, top_ps, eos_ids, budgets, rids)
+                temps, top_ps, eos_ids, budgets, rids, lora=lora)
         dt = time.perf_counter() - t0
         self.stats["dispatch_time_s"] += dt
         self.stats["decode_time_s"] += dt
@@ -2161,6 +2451,11 @@ class LLMEngine:
             slot = self.slots[b]
             if slot is None or slot.ramping:
                 continue
+            if slot.req.kind == "embed":
+                # prefill-only: a fully-ramped embed slot gets NO decode
+                # grant — it just awaits its pooled readout (the
+                # dispatch that carried its final chunk is in flight)
+                continue
             cur = slot.sched_len()
             if cur >= self.capacity:
                 continue  # pipelined overshoot; readout retires it
@@ -2233,6 +2528,17 @@ class LLMEngine:
                            for s in self.slots], np.float32)
         rids = np.array([s.req.request_id if s else 0
                          for s in self.slots], np.int32)
+        lora = self._lora_pack(self._slot_adapter_rows())
+        # prefill-only plumbing: pass the pooled accumulator (and the
+        # embed-slot mask) only while an embed request is RESIDENT, so
+        # generate-only serving keeps the untouched no-embed program
+        embed_rows = [b for b, s in enumerate(self.slots)
+                      if s is not None and s.req.kind == "embed"]
+        is_embed = pooled_arg = None
+        if embed_rows:
+            is_embed = np.zeros((self.B,), bool)
+            is_embed[embed_rows] = True
+            pooled_arg = self._pooled
 
         # in-flight write fence over this mixed dispatch's spans: one
         # decode position per decode slot, the granted chunk span per
@@ -2251,16 +2557,20 @@ class LLMEngine:
         if self.cache_impl == "paged":
             with self._kernel_tp_ctx():
                 (toks, was_active, self._logits, self._k, self._v,
-                 self._lens, self._rng_key) = self._fused_fn(
+                 self._lens, self._rng_key, pooled_out) = self._fused_fn(
                     self._state_vals, self._k, self._v, self._logits,
                     self._lens, self._rng_key, ids, q_lens, is_dec,
-                    active, temps, top_ps, rids, self._tables.copy())
+                    active, temps, top_ps, rids, self._tables.copy(),
+                    lora=lora, is_embed=is_embed, pooled=pooled_arg)
         else:
             (toks, was_active, self._logits, self._k, self._v, self._lens,
-             self._rng_key) = self._fused_fn(
+             self._rng_key, pooled_out) = self._fused_fn(
                 self._state_vals, self._k, self._v, self._logits,
                 self._lens, self._rng_key, ids, q_lens, is_dec, active,
-                temps, top_ps, rids)
+                temps, top_ps, rids,
+                lora=lora, is_embed=is_embed, pooled=pooled_arg)
+        if pooled_out is not None:
+            self._pooled = pooled_out
         dt = time.perf_counter() - t0
         self.stats["dispatch_time_s"] += dt
         self.stats["decode_time_s"] += dt
@@ -2268,6 +2578,7 @@ class LLMEngine:
         # host mirrors of the scheduled growth (dispatch-time, so the
         # next step — possibly dispatched before this one's readout —
         # schedules from the post-step state)
+        embed_done = []
         for b in np.nonzero(active)[0]:
             slot = self.slots[b]
             if is_dec[b]:
@@ -2282,22 +2593,30 @@ class LLMEngine:
                     # already hits (device reads happen in later
                     # dispatches, after this grant's write lands)
                     self._register_upto(int(b), slot, slot.prefill_pos)
+                if slot.req.kind == "embed" and not slot.ramping:
+                    # this dispatch carries the embed request's FINAL
+                    # chunk: its pooled row is complete once the step's
+                    # device work lands — step_finish reads + retires
+                    embed_done.append((int(b), slot))
         self._inflight += 1
         pending = PendingStep(toks, was_active, None, False,
                               list(self.slots), pool_done, sched=sched,
-                              fenced=fenced)
+                              fenced=fenced, embed_done=embed_done)
         pending.t_dispatch = t0
+        pending.pooled = pooled_out
         rec = self._rec()
         if rec is not None:
             grants = tuple(
                 (int(b), self.slots[b].req.request_id,
-                 "decode" if is_dec[b] else "prefill", int(q_lens[b]))
+                 "decode" if is_dec[b]
+                 else ("embed" if self.slots[b].req.kind == "embed"
+                       else "prefill"), int(q_lens[b]))
                 for b in np.nonzero(active)[0] if self.slots[b] is not None)
             self._record_dispatch(pending, "mixed", grants,
                                   sum(g[3] for g in grants),
                                   self.max_step_tokens, dt)
             for _, rid, gkind, n in grants:
-                if gkind == "prefill":
+                if gkind in ("prefill", "embed"):
                     rec.req_event(rid, "prefill",
                                   step_id=pending.step_id, value=n)
         return pending
@@ -2462,6 +2781,22 @@ class LLMEngine:
                 done.append(out)
                 # slot (and its KV blocks) freed; next step admits into it
                 self._free_slot(b)
+        # prefill-only (embed) completions: this dispatch carried each
+        # one's FINAL chunk, so ITS pooled output (pending.pooled — not
+        # the engine's newest buffer, which belongs to younger in-flight
+        # dispatches the readout must not synchronize on) holds the
+        # complete rows. One [H] device read per finishing embed
+        # request, divided by the prompt length = the mean pool.
+        for b, slot in pending.embed_done:
+            if self.slots[b] is not slot:
+                continue      # cancelled/preempted since dispatch
+            vec = np.asarray(pending.pooled[b], np.float32) \
+                / max(slot.prompt_len, 1)
+            out = RequestOutput(slot.req.request_id, [], True, "embed",
+                                embedding=vec)
+            self.finished_outputs[slot.req.request_id] = out
+            done.append(out)
+            self._free_slot(b)
         self.emit_backdate_s = 0.0
         d_emit = time.perf_counter() - t0
         self.stats["emit_time_s"] += d_emit

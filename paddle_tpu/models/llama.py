@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..nn import Layer, Linear, Embedding, RMSNorm, LayerList
 from ..nn import functional as F
 from ..core.tensor import Tensor, dispatch, functional_mode
+from .lora import active_lora
 from .. import ops
 
 
@@ -290,9 +291,13 @@ def _sample_logits_device(logits, key, temp_val, top_k, top_p_val, greedy,
 
 
 class LlamaAttention(Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx=0):
         super().__init__()
         c = config
+        #: position in the decoder stack — the batched multi-LoRA
+        #: context (models/lora.py) gathers this layer's slice of the
+        #: stacked adapter factors by it
+        self.layer_idx = int(layer_idx)
         self.num_heads = c.num_attention_heads
         self.num_kv_heads = c.num_key_value_heads
         self.head_dim = c.hidden_size // c.num_attention_heads
@@ -317,7 +322,13 @@ class LlamaAttention(Layer):
 
     def forward(self, x, rope_cache, attn_mask=None, kv_cache=None, position_offset=0):
         b, s = x.shape[0], x.shape[1]
+        lora = active_lora()
         if self.fused:
+            if lora is not None:
+                raise ValueError(
+                    "batched multi-LoRA targets the separate q/k/v "
+                    "projections; fuse_attention_qkv is incompatible "
+                    "with an armed adapter scope")
             qkv = self.qkv_proj(x)
             nq = self.num_heads * self.head_dim
             nkv = self.num_kv_heads * self.head_dim
@@ -328,12 +339,22 @@ class LlamaAttention(Layer):
             v = ops.reshape(qkv[:, :, nq + nkv:],
                             [b, s, self.num_kv_heads, self.head_dim])
         else:
-            q = ops.reshape(self.q_proj(x),
-                            [b, s, self.num_heads, self.head_dim])
-            k = ops.reshape(self.k_proj(x),
-                            [b, s, self.num_kv_heads, self.head_dim])
-            v = ops.reshape(self.v_proj(x),
-                            [b, s, self.num_kv_heads, self.head_dim])
+            qf, kf, vf = self.q_proj(x), self.k_proj(x), self.v_proj(x)
+            if lora is not None:
+                # gathered per-slot adapter delta on top of each base
+                # projection — slot 0 rows gather zeros (base tenant)
+                qf = lora.apply("q_proj", self.layer_idx, x, qf)
+                kf = lora.apply("k_proj", self.layer_idx, x, kf)
+                vf = lora.apply("v_proj", self.layer_idx, x, vf)
+            q = ops.reshape(qf, [b, s, self.num_heads, self.head_dim])
+            k = ops.reshape(kf, [b, s, self.num_kv_heads, self.head_dim])
+            v = ops.reshape(vf, [b, s, self.num_kv_heads, self.head_dim])
+
+        def o_proj(t):
+            out = self.o_proj(t)
+            if lora is not None:
+                out = lora.apply("o_proj", self.layer_idx, t, out)
+            return out
         cos, sin = rope_cache
         if isinstance(position_offset, Tensor):
             # traced offset (static-shape decode): the offset is a dispatch
@@ -367,7 +388,7 @@ class LlamaAttention(Layer):
                 out, kc, vc = IF.block_multihead_attention(
                     qkv, kv_cache.k, kv_cache.v, None, kv_cache.seq_lens,
                     kv_cache.q_lens, block_tables=kv_cache.block_tables)
-                out = self.o_proj(ops.reshape(out, [b, s, H * D]))
+                out = o_proj(ops.reshape(out, [b, s, H * D]))
                 return out, PagedKVCache(
                     kc, vc, kv_cache.block_tables,
                     kv_cache.seq_lens + kv_cache.q_lens, kv_cache.q_lens)
@@ -377,7 +398,7 @@ class LlamaAttention(Layer):
             out, kc, vc = IF.block_multihead_attention(
                 qkv, kv_cache.k, kv_cache.v, None, kv_cache.seq_lens, None,
                 block_tables=kv_cache.block_tables)
-            out = self.o_proj(ops.reshape(out, [b, 1, H * D]))
+            out = o_proj(ops.reshape(out, [b, 1, H * D]))
             new_lens = kv_cache.seq_lens + 1
             return out, PagedKVCache(kc, vc, kv_cache.block_tables, new_lens)
         if isinstance(kv_cache, ChunkKVCache):
@@ -413,7 +434,7 @@ class LlamaAttention(Layer):
                 q, k_buf, v_buf, attn_mask=mask, is_causal=False,
                 training=self.training)
             out = ops.reshape(out, [b, s, self.num_heads * self.head_dim])
-            return self.o_proj(out), ChunkKVCache(
+            return o_proj(out), ChunkKVCache(
                 k_buf, v_buf, kv_cache.lens, kv_cache.q_lens)
         if isinstance(kv_cache, SlotKVCache):
             # continuous-batching decode window (s=1 plain step, s=K a
@@ -437,7 +458,7 @@ class LlamaAttention(Layer):
                 q, k_buf, v_buf, attn_mask=mask, is_causal=False,
                 training=self.training)
             out = ops.reshape(out, [b, s, self.num_heads * self.head_dim])
-            return self.o_proj(out), SlotKVCache(k_buf, v_buf, kv_cache.lens)
+            return o_proj(out), SlotKVCache(k_buf, v_buf, kv_cache.lens)
         if isinstance(kv_cache, StaticKVCache):
             def upd(buf, new, off):
                 return jax.lax.dynamic_update_slice_in_dim(
@@ -465,7 +486,7 @@ class LlamaAttention(Layer):
                 q, k_buf, v_buf, attn_mask=mask, is_causal=False,
                 training=self.training)
             out = ops.reshape(out, [b, s, self.num_heads * self.head_dim])
-            return self.o_proj(out), StaticKVCache(k_buf, v_buf)
+            return o_proj(out), StaticKVCache(k_buf, v_buf)
         if kv_cache is not None:
             k = ops.concat([kv_cache[0], k], axis=1)
             v = ops.concat([kv_cache[1], v], axis=1)
@@ -474,14 +495,15 @@ class LlamaAttention(Layer):
             q, k, v, attn_mask=attn_mask, is_causal=(attn_mask is None),
             training=self.training)
         out = ops.reshape(out, [b, s, self.num_heads * self.head_dim])
-        out = self.o_proj(out)
+        out = o_proj(out)
         return (out, kv_cache) if kv_cache is not None else out
 
 
 class LlamaMLP(Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx=0):
         super().__init__()
         c = config
+        self.layer_idx = int(layer_idx)
         self.fused = bool(getattr(c, "fuse_swiglu", False))
         if self.fused:
             self.gate_up_proj = Linear(c.hidden_size, 2 * c.intermediate_size,
@@ -495,18 +517,32 @@ class LlamaMLP(Layer):
         self._ff = c.intermediate_size
 
     def forward(self, x):
+        lora = active_lora()
         if self.fused:
+            if lora is not None:
+                raise ValueError(
+                    "batched multi-LoRA targets the separate gate/up "
+                    "projections; fuse_swiglu is incompatible with an "
+                    "armed adapter scope")
             gu = self.gate_up_proj(x)
             return self.down_proj(F.swiglu(gu[:, :, :self._ff],
                                            gu[:, :, self._ff:]))
-        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+        gate, up = self.gate_proj(x), self.up_proj(x)
+        if lora is not None:
+            gate = lora.apply("gate_proj", self.layer_idx, x, gate)
+            up = lora.apply("up_proj", self.layer_idx, x, up)
+        h = F.swiglu(gate, up)
+        out = self.down_proj(h)
+        if lora is not None:
+            out = lora.apply("down_proj", self.layer_idx, h, out)
+        return out
 
 
 class LlamaDecoderLayer(Layer):
-    def __init__(self, config: LlamaConfig):
+    def __init__(self, config: LlamaConfig, layer_idx=0):
         super().__init__()
-        self.self_attn = LlamaAttention(config)
-        self.mlp = LlamaMLP(config)
+        self.self_attn = LlamaAttention(config, layer_idx=layer_idx)
+        self.mlp = LlamaMLP(config, layer_idx=layer_idx)
         self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
                                                 config.rms_norm_eps)
@@ -530,8 +566,8 @@ class LlamaModel(Layer):
         super().__init__()
         self.config = config
         self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
-        self.layers = LayerList([LlamaDecoderLayer(config)
-                                 for _ in range(config.num_hidden_layers)])
+        self.layers = LayerList([LlamaDecoderLayer(config, layer_idx=i)
+                                 for i in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
         head_dim = config.hidden_size // config.num_attention_heads
         cos, sin = precompute_rope(head_dim, config.max_position_embeddings,

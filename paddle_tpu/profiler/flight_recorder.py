@@ -55,9 +55,13 @@ __all__ = ["StepRecord", "FlightRecorder", "TAIL_CAUSES"]
 #: for all three), so a sync-dominated step is the amortization
 #: boundary working as designed — tune the stride/horizon, not the
 #: host — rather than a host-sync pathology.
-TAIL_CAUSES = ("restart_recovery", "preemption", "interfering_prefill",
-               "batched_readout", "host_sync", "idle_bubble", "dispatch",
-               "unrecorded")
+#: "adapter_swap" sits between preemption and interfering_prefill: the
+#: gap's causal step swapped an adapter into the device cache (host
+#: upload riding the admission path) — a multi-tenant working set
+#: larger than the adapter cache, not a scheduling pathology.
+TAIL_CAUSES = ("restart_recovery", "preemption", "adapter_swap",
+               "interfering_prefill", "batched_readout", "host_sync",
+               "idle_bubble", "dispatch", "unrecorded")
 
 
 @dataclasses.dataclass
@@ -96,6 +100,12 @@ class StepRecord:
     #: verify windows report their row count here too). 1 = the
     #: classic one-token-per-slot step.
     readout_stride: int = 1
+    #: per-slot TENANT ids of this dispatch: ((slot, adapter_id), ...)
+    #: for every resident non-base slot — empty on a single-tenant step
+    adapter_slots: tuple = ()
+    #: adapter device-cache swap-ins that rode this step's admission
+    #: (host factor upload) — the explain_tail "adapter_swap" signal
+    adapter_swaps: int = 0
 
     @property
     def budget_utilization(self):
@@ -109,7 +119,10 @@ class StepRecord:
 
     @property
     def prefill_tokens(self):
-        return sum(n for _, _, kind, n in self.grants if kind == "prefill")
+        # "embed" grants are prefill-only work and interfere with decode
+        # latency exactly like generation ramp-in chunks
+        return sum(n for _, _, kind, n in self.grants
+                   if kind in ("prefill", "embed"))
 
     @property
     def decode_slots(self):
@@ -125,6 +138,7 @@ class StepRecord:
         d["grants"] = [list(g) for g in self.grants]
         d["preemptions"] = list(self.preemptions)
         d["finished"] = list(self.finished)
+        d["adapter_slots"] = [list(a) for a in self.adapter_slots]
         d["budget_utilization"] = round(self.budget_utilization, 4)
         d["prefill_tokens"] = self.prefill_tokens
         return d
@@ -199,7 +213,8 @@ class FlightRecorder:
                    token_budget, queue_depth, free_blocks, total_blocks,
                    pipeline_inflight, preemptions, admit_s, schedule_s,
                    dispatch_s, t_begin, prefix_hit_tokens=None,
-                   cached_blocks=None, readout_stride=1):
+                   cached_blocks=None, readout_stride=1,
+                   adapter_slots=(), adapter_swaps=0):
         """Record one dispatched step; returns its step id."""
         with self._lock:
             sid = self._seq
@@ -211,7 +226,9 @@ class FlightRecorder:
                 tuple(preemptions), admit_s, schedule_s, dispatch_s,
                 prefix_hit_tokens=prefix_hit_tokens,
                 cached_blocks=cached_blocks,
-                readout_stride=int(readout_stride))
+                readout_stride=int(readout_stride),
+                adapter_slots=tuple(adapter_slots),
+                adapter_swaps=int(adapter_swaps))
             return sid
 
     def finish_step(self, step_id, sync_s, emit_s, finished=()):
@@ -513,6 +530,11 @@ class FlightRecorder:
             return "unrecorded"
         if rec.preemptions:
             return "preemption"
+        if getattr(rec, "adapter_swaps", 0):
+            # the step's admission swapped adapter factors onto the
+            # device — a multi-tenant working set bigger than the
+            # adapter cache, distinct from ordinary prefill ramp-in
+            return "adapter_swap"
         wall = rec.wall_s
         # prefill interference comes in two shapes: a fused chunk grant
         # in the step's own dispatch (grants), or a legacy admission

@@ -57,7 +57,8 @@ STAGES = ("queue_admit", "prefill_dispatch", "schedule", "decode_dispatch",
 GAUGES = ("queue_depth", "engine_waiting", "running_slots",
           "pipeline_inflight", "kv_pool_free_blocks", "kv_pool_occupancy",
           "token_budget_utilization", "prefix_cached_blocks",
-          "prefix_cache_hit_rate", "server_healthy")
+          "prefix_cache_hit_rate", "server_healthy",
+          "adapter_cache_occupancy")
 
 _COUNTERS = ("requests_submitted", "requests_admitted", "requests_finished",
              "requests_cancelled", "requests_expired",
@@ -66,7 +67,9 @@ _COUNTERS = ("requests_submitted", "requests_admitted", "requests_finished",
              "engine_restarts", "faults_injected", "tokens_emitted",
              "engine_steps", "multi_steps", "preemptions", "prefill_tokens",
              "prefix_hit_tokens", "prefix_cow_blocks",
-             "prefix_evicted_blocks")
+             "prefix_evicted_blocks",
+             "adapter_cache_hits", "adapter_cache_misses", "adapter_swaps",
+             "embed_requests")
 
 
 def _default_bounds():
@@ -181,6 +184,12 @@ class ServingTelemetry:
             self.counters.update({n: 0 for n in self._extra["counter"]})
             self.gauges = {name: 0.0 for name in GAUGES}
             self.gauges.update({n: 0.0 for n in self._extra["gauge"]})
+            #: per-TENANT processed-token counters (adapter_id ->
+            #: tokens): generated tokens per tenant, plus an embed
+            #: request's pooled prompt tokens at its finish. Tenant ids
+            #: are data, not schema — a dynamic label on one metric
+            #: family, outside the strict-name counter contract.
+            self.tenant_tokens = {}
             self.ttft_s = LatencyHistogram()
             self.inter_token_s = LatencyHistogram()
             self.e2e_s = LatencyHistogram()
@@ -218,6 +227,14 @@ class ServingTelemetry:
                     f"unknown telemetry counter {name!r} — declare it with "
                     f"register('counter', {name!r}) first")
             self.counters[name] += n
+
+    def inc_tenant(self, tenant, n=1):
+        """Count ``n`` processed tokens against ``tenant`` (an adapter
+        id; 0 = base). Tenants are dynamic data, so this is the one
+        write-side entry point that does NOT require registration."""
+        with self._lock:
+            key = int(tenant)
+            self.tenant_tokens[key] = self.tenant_tokens.get(key, 0) + n
 
     def set_gauge(self, name, value):
         with self._lock:
@@ -263,6 +280,8 @@ class ServingTelemetry:
                 "replica": self.replica,
                 "uptime_s": round(time.perf_counter() - self.started_at, 4),
                 "counters": dict(self.counters),
+                "tenant_tokens": {str(k): v for k, v
+                                  in sorted(self.tenant_tokens.items())},
                 "gauges": {k: round(v, 6) for k, v in self.gauges.items()},
                 "stages_s": {k: round(v, 6)
                              for k, v in self.stage_s.items()},
@@ -310,6 +329,13 @@ class ServingTelemetry:
                 full = f"{prefix}_{name}_total"
                 lines.append(f"# TYPE {full} counter")
                 lines.append(f"{full}{brace} {val}")
+            if self.tenant_tokens:
+                full = f"{prefix}_tenant_tokens_total"
+                lines.append(f"# TYPE {full} counter")
+                tenant_extra = ("," + lbl) if lbl else ""
+                for tenant, val in sorted(self.tenant_tokens.items()):
+                    lines.append(
+                        f'{full}{{tenant="{tenant}"{tenant_extra}}} {val}')
             for name, val in sorted(gauges.items()):
                 full = f"{prefix}_{name}"
                 lines.append(f"# TYPE {full} gauge")

@@ -18,12 +18,22 @@ server replicas, with drain/failover) — and the fault-tolerance layer in
 :mod:`paddle_tpu.serving.faults` — :class:`RestartPolicy` (supervised
 engine restart with token-exact resumption) and :class:`FaultInjector`
 (deterministic scripted chaos for the tier-1 recovery tests).
+
+Multi-tenant serving lives in :mod:`paddle_tpu.serving.adapters`
+(:class:`AdapterStore` + the engine's batched multi-LoRA device cache —
+one fused step serves any mix of fine-tunes of one base model) and
+:mod:`paddle_tpu.serving.embedding` (:class:`BertEmbedEngine`, the
+embed-only encoder engine behind the same server front; llama
+prefill-only embeddings go through ``AsyncLLMServer.submit_embed``).
 """
 from .types import (RequestHandle, RequestState, ServeRequest, ServeResult,
                     ServerClosed, ServerQueueFull)
 from .scheduler import AdmissionQueue
 from .faults import FaultInjector, InjectedFault, RestartPolicy
+from .adapters import (AdapterDeviceCache, AdapterStore, apply_merged,
+                       random_lora_weights)
 from .server import AsyncLLMServer
+from .embedding import BertEmbedEngine
 from .cluster import (ReplicaRouter, RouterHandle, shard_model_tp,
                       tp_engine, tp_serving_mesh)
 
@@ -31,4 +41,6 @@ __all__ = ["AsyncLLMServer", "AdmissionQueue", "RequestHandle",
            "RequestState", "ServeRequest", "ServeResult", "ServerClosed",
            "ServerQueueFull", "ReplicaRouter", "RouterHandle",
            "FaultInjector", "InjectedFault", "RestartPolicy",
+           "AdapterStore", "AdapterDeviceCache", "apply_merged",
+           "random_lora_weights", "BertEmbedEngine",
            "shard_model_tp", "tp_engine", "tp_serving_mesh"]

@@ -311,13 +311,20 @@ class ReplicaRouter:
     def __init__(self, replicas, affinity_weight=2.0, load_weight=1.0,
                  policy="affinity", poll_interval_s=0.01,
                  failover_retry_s=10.0, max_retry_backoff_s=0.5,
-                 resume_inflight=False, seed=0):
+                 resume_inflight=False, seed=0,
+                 adapter_affinity_weight=1.0):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         if policy not in ("affinity", "least_loaded", "random"):
             raise ValueError(f"unknown routing policy {policy!r}")
         self.replicas = list(replicas)
         self.affinity_weight = float(affinity_weight)
+        #: adapter-affinity bonus (multi-tenant serving): a replica
+        #: whose adapter device cache already HOLDS the request's
+        #: adapter serves it without a swap-in, so placement prefers it
+        #: — scored as a flat bonus on top of the prefix/load formula
+        #: (the swap cost is per-admission, not per-token)
+        self.adapter_affinity_weight = float(adapter_affinity_weight)
         self.load_weight = float(load_weight)
         self.policy = policy
         self.poll_interval_s = float(poll_interval_s)
@@ -357,6 +364,7 @@ class ReplicaRouter:
         self._stop_evt = threading.Event()
         self._monitor = None
         self.stats = {"submitted": 0, "affinity_routed": 0,
+                      "adapter_routed": 0,
                       "resubmitted": 0, "replica_lost": 0,
                       "resumed": 0, "evicted_hung": 0,
                       "placements": [0] * len(self.replicas)}
@@ -418,20 +426,29 @@ class ReplicaRouter:
             return True
 
     # -- placement -------------------------------------------------------
-    def _score(self, idx, ids, hashes=None):
-        """(score, affinity_tokens) of placing ``ids`` on replica
-        ``idx`` — the documented formula (module docstring).
+    def _score(self, idx, ids, hashes=None, adapter_id=0):
+        """(score, affinity_tokens, adapter_hit) of placing ``ids`` on
+        replica ``idx`` — the documented formula (module docstring) plus
+        the ADAPTER-affinity bonus: a replica whose adapter cache
+        already holds ``adapter_id`` serves without a swap-in.
         ``hashes``: precomputed chain hashes (the hash chain depends on
-        token content only, so one computation serves every same-
-        block_size replica)."""
+        token content + tenant only, so one computation serves every
+        same-block_size replica)."""
         srv = self.replicas[idx]
         aff = 0
+        adapter_hit = False
         if self.policy == "affinity":
             try:
                 aff = int(srv.engine.probe_prefix_len(
-                    ids, chain_hashes=hashes))
+                    ids, chain_hashes=hashes, adapter_id=adapter_id))
             except Exception:   # routing heuristic: never let it fail
                 aff = 0
+            if adapter_id:
+                try:
+                    adapter_hit = bool(
+                        srv.engine.adapter_resident(adapter_id))
+                except Exception:
+                    adapter_hit = False
         g = srv.telemetry.get_gauges()
         load = (g.get("queue_depth", 0.0) + g.get("engine_waiting", 0.0)
                 + g.get("running_slots", 0.0)) / max(srv.engine.B, 1)
@@ -450,13 +467,15 @@ class ReplicaRouter:
         if n_blocks:
             pool = max(0.0, pool - cached / n_blocks)
         score = self.affinity_weight * (aff / max(len(ids), 1)) \
+            + self.adapter_affinity_weight * float(adapter_hit) \
             - self.load_weight * (load + pool)
-        return score, aff
+        return score, aff, adapter_hit
 
-    def _rank(self, ids, pin=None):
-        """Candidate replicas best-first as (idx, score, aff_tokens)."""
-        #: prompt hash chain per block_size — computed at most once per
-        #: submission, shared by every same-geometry replica's probe
+    def _rank(self, ids, pin=None, adapter_id=0):
+        """Candidate replicas best-first as (idx, score, aff_tokens,
+        adapter_hit)."""
+        #: prompt hash chain per (block_size, tenant) — computed at most
+        #: once per submission, shared by same-geometry replicas' probes
         hash_cache = {}
 
         def hashes_for(idx):
@@ -465,21 +484,25 @@ class ReplicaRouter:
                     getattr(eng, "prefix_cache", False) is False:
                 return None
             bs = eng.block_size
-            if bs not in hash_cache:
-                hash_cache[bs] = eng.prefix_chain_hashes(ids)
-            return hash_cache[bs]
+            key = (bs, adapter_id)
+            if key not in hash_cache:
+                hash_cache[key] = eng.prefix_chain_hashes(
+                    ids, adapter_id=adapter_id)
+            return hash_cache[key]
 
         if pin is not None:
-            score, aff = self._score(pin, ids, hashes_for(pin))
-            return [(pin, score, aff)]
+            score, aff, ahit = self._score(pin, ids, hashes_for(pin),
+                                           adapter_id)
+            return [(pin, score, aff, ahit)]
         cand = [i for i in range(len(self.replicas))
                 if self.healthy(i) and i not in self._draining]
         if not cand:
             return []
         if self.policy == "random":
             order = [int(i) for i in self._rng.permutation(cand)]
-            return [(i, 0.0, 0) for i in order]
-        scored = [(i,) + self._score(i, ids, hashes_for(i)) for i in cand]
+            return [(i, 0.0, 0, False) for i in order]
+        scored = [(i,) + self._score(i, ids, hashes_for(i), adapter_id)
+                  for i in cand]
         scored.sort(key=lambda t: (-t[1], t[0]))
         return scored
 
@@ -487,7 +510,8 @@ class ReplicaRouter:
     def submit(self, prompt_ids, max_new_tokens=64, temperature=0.0,
                top_p=1.0, eos_token_id=None, deadline_s=None,
                routing_key=None, replica=None, block=True,
-               timeout=None, readout_stride=None) -> RouterHandle:
+               timeout=None, readout_stride=None, adapter_id=0,
+               kind="generate") -> RouterHandle:
         """Place and submit one request; returns its
         :class:`RouterHandle`. ``routing_key`` is an opaque caller tag
         that rides the placement dict into ``ServeResult.routing`` and
@@ -504,7 +528,8 @@ class ReplicaRouter:
         kwargs = dict(max_new_tokens=max_new_tokens,
                       temperature=temperature, top_p=top_p,
                       eos_token_id=eos_token_id, deadline_s=deadline_s,
-                      readout_stride=readout_stride)
+                      readout_stride=readout_stride,
+                      adapter_id=adapter_id, kind=kind)
         handle = RouterHandle(self, ids, kwargs, routing_key)
         deadline = None if timeout is None else time.monotonic() + timeout
         delay = self.poll_interval_s
@@ -533,10 +558,11 @@ class ReplicaRouter:
         submitters may score stale-ish state but must not serialize on
         each other's hash walks; the lock guards only the actual
         placement bookkeeping."""
-        ranked = self._rank(ids, pin=pin)
+        adapter_id = int(handle._kwargs.get("adapter_id") or 0)
+        ranked = self._rank(ids, pin=pin, adapter_id=adapter_id)
         with self._lock:
             last_err = None
-            for idx, score, aff in ranked:
+            for idx, score, aff, ahit in ranked:
                 srv = self.replicas[idx]
                 routing = {"replica": idx, "policy": self.policy,
                            "score": round(float(score), 4),
@@ -546,6 +572,9 @@ class ReplicaRouter:
                            # submission will be, not what the last was
                            "resubmits": handle.resubmits
                            + (1 if resubmit else 0)}
+                if adapter_id:
+                    routing["adapter_id"] = adapter_id
+                    routing["adapter_resident"] = bool(ahit)
                 if handle.routing_key is not None:
                     routing["routing_key"] = handle.routing_key
                 try:
@@ -568,6 +597,8 @@ class ReplicaRouter:
                     self.stats["submitted"] += 1
                     if aff > 0:
                         self.stats["affinity_routed"] += 1
+                    if adapter_id and ahit:
+                        self.stats["adapter_routed"] += 1
                 return None
             return last_err or ServerClosed("no replica alive")
 

@@ -327,7 +327,8 @@ class AsyncLLMServer:
     def submit(self, prompt_ids, max_new_tokens=64, temperature=0.0,
                top_p=1.0, eos_token_id=None, deadline_s=None, block=True,
                timeout=None, routing=None, resume_tokens=None,
-               readout_stride=None) -> RequestHandle:
+               readout_stride=None, adapter_id=0,
+               kind="generate") -> RequestHandle:
         """Submit one generation request; returns its streaming
         :class:`RequestHandle`.
 
@@ -358,7 +359,12 @@ class AsyncLLMServer:
         ``readout_stride=1`` forces every all-decode step this request
         is resident in to sync the host per step (minimum inter-token
         latency for this stream, at the whole batch's throughput cost).
-        None (default) inherits the engine's stride."""
+        None (default) inherits the engine's stride.
+
+        ``adapter_id``: the request's TENANT (batched multi-LoRA) — a
+        registered id in the engine's adapter store, 0 = base model.
+        ``kind="embed"`` marks the request prefill-only (use
+        :meth:`submit_embed`)."""
         if self._crashed is not None:
             raise ServerClosed(
                 f"serving loop crashed: {self._crashed}") from self._crashed
@@ -371,13 +377,42 @@ class AsyncLLMServer:
         resume = [int(t) for t in resume_tokens] if resume_tokens else None
         total = len(ids) + len(resume or [])
         # fail fast on the submitter's thread, mirroring add_request's
-        # checks (the engine would only see the prompt much later)
+        # checks (the engine would only see the prompt much later) —
+        # tenant/kind first, because the capacity bound depends on the
+        # kind (an embed prompt needs NO decode headroom)
         if len(ids) == 0:
             raise ValueError("empty prompt")
-        if total >= eng.capacity - eng.speculative_k:
-            raise ValueError(
-                f"prompt of {total} tokens leaves no room to generate "
-                f"(engine capacity {eng.capacity})")
+        adapter_id = int(adapter_id or 0)
+        if adapter_id:
+            store = getattr(eng, "adapter_store", None)
+            if store is None:
+                raise ValueError(
+                    f"adapter_id {adapter_id} on an engine without an "
+                    f"adapter_store")
+            if not store.has(adapter_id):
+                raise ValueError(f"unknown adapter_id {adapter_id}")
+        if kind not in ("generate", "embed"):
+            raise ValueError(f"unknown request kind {kind!r}")
+        embed_only = getattr(eng, "embed_only", False)
+        if kind == "embed":
+            if not embed_only and getattr(eng, "scheduler", "") != "fused":
+                raise ValueError(
+                    "embedding requests need a fused-scheduler engine "
+                    "(or an embed-only encoder engine)")
+            max_new_tokens = 0
+            cap = eng.capacity if embed_only else eng.capacity - 1
+            if total > cap:
+                raise ValueError(
+                    f"embedding prompt of {total} tokens exceeds the "
+                    f"engine capacity ({cap})")
+        else:
+            if embed_only:
+                raise ValueError("this server wraps an embed-only "
+                                 "encoder engine — use submit_embed()")
+            if total >= eng.capacity - eng.speculative_k:
+                raise ValueError(
+                    f"prompt of {total} tokens leaves no room to "
+                    f"generate (engine capacity {eng.capacity})")
         if eng.cache_impl == "paged" and \
                 eng.prefill_blocks_needed(total) > eng.n_blocks:
             raise ValueError(
@@ -399,8 +434,11 @@ class AsyncLLMServer:
             routing=dict(routing) if routing is not None else None,
             resume_tokens=resume,
             readout_stride=(int(readout_stride)
-                            if readout_stride is not None else None))
+                            if readout_stride is not None else None),
+            adapter_id=adapter_id, kind=kind)
         handle = RequestHandle(self, req)
+        if kind == "embed":
+            self.telemetry.inc("embed_requests")
         rec = self.flight_recorder
         if self.shed_deadlines and deadline_s is not None:
             est = self._admission_estimate_s()
@@ -458,6 +496,22 @@ class AsyncLLMServer:
         self.telemetry.inc("requests_submitted")
         self._wake()
         return handle
+
+    def submit_embed(self, prompt_ids, adapter_id=0, deadline_s=None,
+                     block=True, timeout=None,
+                     routing=None) -> RequestHandle:
+        """Submit one PREFILL-ONLY embedding request: no decode tokens,
+        no sampling — the prompt's prefill chunks batch into the same
+        fused mixed steps as generation traffic, and the terminal
+        :class:`ServeResult` carries the mean-pooled final hidden state
+        in ``embedding`` (handed back on the prefill sync). Works on a
+        fused-scheduler :class:`~paddle_tpu.inference.LLMEngine` (llama
+        pooling, optionally per-tenant via ``adapter_id``) and on an
+        embed-only encoder engine
+        (:class:`~paddle_tpu.serving.embedding.BertEmbedEngine`)."""
+        return self.submit(prompt_ids, adapter_id=adapter_id,
+                           deadline_s=deadline_s, block=block,
+                           timeout=timeout, routing=routing, kind="embed")
 
     def num_outstanding(self):
         with self._hlock:
@@ -634,7 +688,8 @@ class AsyncLLMServer:
                 temperature=req.temperature, top_p=req.top_p,
                 eos_token_id=eos, request_id=req.request_id,
                 committed_tokens=committed or None,
-                readout_stride=req.readout_stride)
+                readout_stride=req.readout_stride,
+                adapter_id=req.adapter_id, kind=req.kind)
         except ValueError as e:
             # the rejection must be visible in telemetry, not just on
             # the handle — a silent validation drop looks like a lost
@@ -695,7 +750,10 @@ class AsyncLLMServer:
         s_multi = eng.stats["multi_steps"]
         s_pfx = {k: eng.stats[k] for k in ("prefix_hit_tokens",
                                            "prefix_cow_blocks",
-                                           "prefix_evicted_blocks")}
+                                           "prefix_evicted_blocks",
+                                           "adapter_cache_hits",
+                                           "adapter_cache_misses",
+                                           "adapter_swaps")}
         t0 = time.perf_counter()
         pending = eng.step_begin()
         wall = time.perf_counter() - t0
@@ -709,7 +767,8 @@ class AsyncLLMServer:
             tel.inc("prefill_tokens", d_ptok)
         for key, before in s_pfx.items():
             # prefix-cache activity (hits at admission, COW clones, LRU
-            # evictions) all happens inside step_begin — the deltas land
+            # evictions) AND adapter-cache activity (hit/miss/swap at
+            # admission) all happen inside step_begin — the deltas land
             # on the matching telemetry counters
             if eng.stats[key] > before:
                 tel.inc(key, eng.stats[key] - before)
@@ -796,6 +855,9 @@ class AsyncLLMServer:
                 pre = eng.stats["prefill_tokens"]
                 tel.set_gauge("prefix_cache_hit_rate",
                               hit / (hit + pre) if hit + pre else 0.0)
+        cache = getattr(eng, "adapter_cache", None)
+        if cache is not None:
+            tel.set_gauge("adapter_cache_occupancy", cache.occupancy())
         rec = self.flight_recorder
         if rec is not None and rec.enabled:
             last = rec.last_record()
@@ -916,6 +978,7 @@ class AsyncLLMServer:
         elif h.last_token_at is not None:
             self.telemetry.observe("inter_token_s", now - h.last_token_at)
         self.telemetry.inc("tokens_emitted")
+        self.telemetry.inc_tenant(h.request.adapter_id)
         h._emit(tok, t=now)
 
     def _handle_done(self, outputs):
@@ -925,9 +988,16 @@ class AsyncLLMServer:
                 h = self._handles.get(out.request_id)
             if h is None:
                 continue
-            self._finish_handle(h, out.token_ids, out.finish_reason)
+            emb = getattr(out, "embedding", None)
+            if emb is not None:
+                # per-tenant accounting: an embed request's processed
+                # tokens are its pooled prompt positions
+                self.telemetry.inc_tenant(h.request.adapter_id,
+                                          len(h.request.prompt_ids))
+            self._finish_handle(h, out.token_ids, out.finish_reason,
+                                embedding=emb)
 
-    def _finish_handle(self, handle, token_ids, reason):
+    def _finish_handle(self, handle, token_ids, reason, embedding=None):
         now = time.monotonic()
         req = handle.request
         trace = None
@@ -942,7 +1012,7 @@ class AsyncLLMServer:
             e2e_s=now - req.submitted_at,
             queue_wait_s=(handle.admitted_at - req.submitted_at
                           if handle.admitted_at is not None else None),
-            trace=trace, routing=req.routing)
+            trace=trace, routing=req.routing, embedding=embedding)
         self.telemetry.inc("requests_finished")
         self.telemetry.observe("e2e_s", result.e2e_s)
         with self._hlock:

@@ -68,6 +68,14 @@ class ServeRequest:
     #: at the batch's throughput cost). None = the engine default; inert
     #: on engines without multi-step decode.
     readout_stride: int | None = None
+    #: the TENANT dimension (batched multi-LoRA): 0 = the base model,
+    #: > 0 = a registered adapter id. Preserved across supervised
+    #: restart re-admission and router failover resubmission, so a
+    #: tenant's stream can never silently continue on the wrong weights.
+    adapter_id: int = 0
+    #: "generate" (token stream) or "embed" (prefill-only: the result
+    #: carries the mean-pooled final hidden state, no tokens)
+    kind: str = "generate"
 
 
 @dataclasses.dataclass
@@ -88,6 +96,9 @@ class ServeResult:
     #: the routing/placement metadata the request was submitted with
     #: (see ServeRequest.routing) — how THIS request got where it ran
     routing: dict | None = None
+    #: prefill-only (kind="embed") result: the mean-pooled final hidden
+    #: state [hidden_size] (fp32 numpy), None for generation requests
+    embedding: np.ndarray | None = None
 
 
 class RequestHandle:

@@ -21,7 +21,6 @@ tests/test_roofline_tool.py for the parser contract.
 """
 from __future__ import annotations
 
-import collections
 import glob
 import gzip
 import json
@@ -357,30 +356,11 @@ def profile_device_events(run_fn, steps: int = 4, trace_dir: str = None):
     if not paths:
         raise RuntimeError(f"no trace produced under {td}")
     events = json.loads(gzip.open(paths[-1]).read())["traceEvents"]
-    device_pids = set()
-    for e in events:
-        if (e.get("ph") == "M" and e.get("name") == "process_name"
-                and "device:TPU" in str(e.get("args", {}).get("name", ""))):
-            device_pids.add(e["pid"])
-    agg = collections.defaultdict(lambda: {"count": 0, "total_us": 0.0})
-    total = 0.0
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in device_pids:
-            continue
-        name = e["name"]
-        dur = float(e.get("dur", 0.0))
-        # container events that nest the per-op events: 'jit_<fn>(id)'
-        # module spans (the true device step time) and bare-number step
-        # markers (the "Steps" track — overlaps the modules, so it must
-        # count toward NEITHER the totals nor the per-op aggregation)
-        if name.startswith("jit_"):
-            total += dur
-            continue
-        if name.isdigit():
-            continue
-        agg[name]["count"] += 1
-        agg[name]["total_us"] += dur
-    return dict(agg), total
+    # the dedupe-aware parse (module spans / per-op spans / bare-number
+    # "Steps" markers each routed exactly once) lives in the profiler —
+    # one regression-tested copy shared by every trace consumer
+    from ..profiler import summarize_device_trace
+    return summarize_device_trace(events)
 
 
 def roofline_table(hlo_text: str, events, steps: int,

@@ -353,6 +353,27 @@ def test_telemetry_strict_names_and_register():
         tel.inc("request_rejected_validation")
     with pytest.raises(KeyError, match="unknown telemetry gauge"):
         tel.set_gauge("server_health", 1.0)
+    # the multi-tenant names are declared (not phantom-forked) ...
+    tel.inc("adapter_cache_hits", 2)
+    tel.inc("adapter_cache_misses")
+    tel.inc("adapter_swaps")
+    tel.inc("embed_requests")
+    tel.set_gauge("adapter_cache_occupancy", 0.5)
+    # ... their typos still raise ...
+    with pytest.raises(KeyError, match="unknown telemetry counter"):
+        tel.inc("adapter_cache_hit")
+    with pytest.raises(KeyError, match="unknown telemetry counter"):
+        tel.inc("adapter_swap")
+    with pytest.raises(KeyError, match="unknown telemetry gauge"):
+        tel.set_gauge("adapter_cache_occupency", 0.5)
+    # ... and the per-TENANT token counters are data-keyed (dynamic
+    # tenant ids), surviving snapshot + exposition round trips
+    tel.inc_tenant(0, 3)
+    tel.inc_tenant(7, 5)
+    snap_mt = tel.snapshot()
+    assert snap_mt["counters"]["adapter_cache_hits"] == 2
+    assert snap_mt["tenant_tokens"] == {"0": 3, "7": 5}
+    assert 'tenant_tokens_total{tenant="7"} 5' in tel.prometheus_text()
     with pytest.raises(ValueError, match="register kind"):
         tel.register("histogram", "x")
     tel.register("stage", "custom_stage")
