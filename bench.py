@@ -582,7 +582,129 @@ def _bench_other(model_name):
                 "block_size": block_size, "q_heads": heads,
                 "kv_heads": kv_heads, "params": n_params}
 
-    if model_name in ("llama_serve", "llama_serve_spec"):
+    if model_name == "llama_serve_spec":
+        # Batched speculative decoding THROUGH THE FUSED SCHEDULER
+        # (ROADMAP item 2): verify-k grants ride the same token-budget
+        # walk as prefill chunks and plain decode tokens, so speculation
+        # now serves at FULL BATCH instead of the legacy batch-1 latency
+        # demo (r05's 46.8 tok/s line — a different serving path, so
+        # vs_baseline stays null). Main arm: B=8 spec on/off A/B
+        # (speculation_speedup at batch, per-arm acceptance rate +
+        # rtt_share); plus the classic batch-1 latency arm (the regime
+        # where accepted drafts are nearly free because a k+1-row verify
+        # window streams the same weights as a 1-token step).
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import LLMEngine
+        from paddle_tpu.serving import AsyncLLMServer
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+        n_req = int(os.environ.get("BENCH_REQUESTS", str(2 * B)))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        cap = 512 + new_tokens
+        spec_k = int(os.environ.get("BENCH_SPEC_K", "6"))
+        stride = int(os.environ.get("BENCH_READOUT_STRIDE", "4"))
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=heads,
+                          max_position_embeddings=cap)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg).bfloat16()
+        model.eval()
+        # repetition-heavy prompts: the workload where prompt-lookup
+        # drafts actually accept (greedy continuations loop)
+        prompts = []
+        for i in range(n_req):
+            base = rng.integers(0, cfg.vocab_size, (24,)).astype(np.int32)
+            want = 256 + int(rng.integers(0, 128))
+            reps = -(-want // len(base))  # tile past the target length
+            prompts.append(np.tile(base, reps)[:want])
+
+        rtt = None
+
+        def serve_arm(k, batch, reqs):
+            """One serve pass through a fused-scheduler engine at
+            speculative_k=k; k=1 is the A/B control (bit-identical to
+            the plain fused engine by construction)."""
+            nonlocal rtt
+            eng = LLMEngine(model, max_batch=batch, max_seq_len=cap,
+                            chunk_size=256, scheduler="fused",
+                            speculative_k=k, readout_stride=stride)
+            eng.generate([prompts[0]], max_new_tokens=2)  # warm programs
+            if rtt is None:
+                rtts = []
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    float(np.asarray(eng._logits[0, 0]))
+                    rtts.append(time.perf_counter() - t0)
+                rtt = sorted(rtts)[len(rtts) // 2]
+            eng.reset_stats()
+            srv = AsyncLLMServer(eng, max_queue_size=reqs + 1)
+            srv.start()
+            t0 = time.perf_counter()
+            hs = [srv.submit(p, max_new_tokens=new_tokens)
+                  for p in prompts[:reqs]]
+            outs = [h.result(timeout=1800) for h in hs]
+            wall = time.perf_counter() - t0
+            srv.stop()
+            toks = sum(len(o.token_ids) for o in outs)
+            steps = eng.stats["steps"]
+            prop = eng.stats["spec_proposed_tokens"]
+            acc = eng.stats["spec_accepted_tokens"]
+            return {"tokens_per_sec": round(toks / wall, 1),
+                    "batch": batch, "speculative_k": k,
+                    "requests": reqs, "steps": steps,
+                    "acceptance_rate": (round(acc / prop, 4)
+                                        if prop else None),
+                    "accepted_per_step": round(
+                        eng.stats["draft_tokens_accepted"]
+                        / max(steps, 1), 2),
+                    # per-arm host-RTT share: speculation's win is
+                    # FEWER host passes per token — this is the split
+                    # that should drop on the spec arm
+                    "rtt_share": round(rtt * steps / wall, 4),
+                    "_outputs": [o.token_ids for o in outs]}
+
+        b8_on = serve_arm(spec_k, B, n_req)
+        b8_off = serve_arm(1, B, n_req)
+        # greedy token parity across the A/B: speculation must never
+        # change a stream (the coupled acceptance rule's contract)
+        parity = b8_on.pop("_outputs") == b8_off.pop("_outputs")
+        b1_n = min(3, n_req)
+        b1_on = serve_arm(spec_k, 1, b1_n)
+        b1_off = serve_arm(1, 1, b1_n)
+        parity_b1 = b1_on.pop("_outputs") == b1_off.pop("_outputs")
+        return {
+            "metric": "llama_serve_spec_tokens_per_sec",
+            "value": b8_on["tokens_per_sec"], "unit": "tokens/s",
+            # r05's 46.8 was the legacy batch-1 latency demo — a
+            # different serving path; the batched fused line has no
+            # captured baseline to ratio against
+            "vs_baseline": None,
+            "scheduler": "fused", "readout_stride": stride,
+            "speculative_k": spec_k, "slots": B,
+            "new_tokens": new_tokens,
+            "prompt_lens": f"{min(len(p) for p in prompts)}-"
+                           f"{max(len(p) for p in prompts)}",
+            "speculation_speedup": round(
+                b8_on["tokens_per_sec"]
+                / max(b8_off["tokens_per_sec"], 1e-9), 3),
+            "speculation_speedup_b1": round(
+                b1_on["tokens_per_sec"]
+                / max(b1_off["tokens_per_sec"], 1e-9), 3),
+            "token_parity": bool(parity and parity_b1),
+            "spec_on": b8_on, "spec_off": b8_off,
+            "latency_b1": {"spec_on": b1_on, "spec_off": b1_off},
+            "rtt_est_ms": round(rtt * 1e3, 1),
+            # r05 trend anchor: the LEGACY spec path's rtt share (0.324)
+            # — the batched fused arm's rtt_share above is the number
+            # that should sit far below it
+            "rtt_share_r05_legacy": 0.324}
+
+    if model_name == "llama_serve":
         # ASYNC serving subsystem (paddle_tpu/serving/ over
         # inference/llm_engine.py): mixed-length requests through fixed
         # slots, chunked prefill, per-step host transfer = one [B] token
@@ -594,18 +716,9 @@ def _bench_other(model_name):
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
         from paddle_tpu.inference import LLMEngine
         from paddle_tpu.serving import AsyncLLMServer
-        # speculation's regime is LATENCY-bound serving: at batch 1 the
-        # 6-token verify window streams the same weights as a 1-token step,
-        # so accepted drafts are nearly free (measured B=1: spec 54.7 vs
-        # plain 38.5 tok/s, +42%). At batch 8 decode is already
-        # weight-amortized and the extra verify positions make spec a
-        # wash-to-loss (measured h=1: 34.0 vs 34.5; h=8: 204 vs 1135) —
-        # so the spec line benches batch 1 by default.
-        spec_mode = model_name == "llama_serve_spec"
-        B = int(os.environ.get("BENCH_BATCH", "1" if spec_mode else "8"))
+        B = int(os.environ.get("BENCH_BATCH", "8"))
         new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
-        n_req = int(os.environ.get("BENCH_REQUESTS",
-                                   "3" if spec_mode else str(2 * B)))
+        n_req = int(os.environ.get("BENCH_REQUESTS", str(2 * B)))
         n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
         hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
         ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
@@ -626,28 +739,13 @@ def _bench_other(model_name):
         # horizon 64 ~= one step per request generation (new_tokens=64):
         # through the tunnel each step() costs one RTT, so tokens/s scales
         # ~linearly in horizon up to the point admissions coarsen
-        spec_default = "6" if model_name == "llama_serve_spec" else "1"
-        spec_k = int(os.environ.get("BENCH_SPEC_K", spec_default))
-        # spec windows compose with horizon: 8 windows x up to 6 tokens
-        # lands near the plain path's 64-token step granularity
-        horizon = int(os.environ.get("BENCH_HORIZON",
-                                     "8" if spec_k > 1 else "64"))
+        horizon = int(os.environ.get("BENCH_HORIZON", "64"))
         eng = LLMEngine(model, max_batch=B, max_seq_len=cap, chunk_size=256,
-                        horizon=horizon, speculative_k=spec_k)
-        if spec_k > 1:
-            # repetition-heavy prompts: the workload where prompt-lookup
-            # drafts actually accept (greedy continuations loop)
-            prompts = []
-            for i in range(n_req):
-                base = rng.integers(0, cfg.vocab_size, (24,)).astype(np.int32)
-                want = 256 + int(rng.integers(0, 128))
-                reps = -(-want // len(base))  # tile past the target length
-                prompts.append(np.tile(base, reps)[:want])
-        else:
-            lens = [256 + int(x) for x in
-                    rng.integers(0, 256, size=n_req)]  # mixed prompts
-            prompts = [rng.integers(0, cfg.vocab_size, (L,)).astype(np.int32)
-                       for L in lens]
+                        horizon=horizon)
+        lens = [256 + int(x) for x in
+                rng.integers(0, 256, size=n_req)]  # mixed prompts
+        prompts = [rng.integers(0, cfg.vocab_size, (L,)).astype(np.int32)
+                   for L in lens]
         # warm the programs (prefill + step) outside the timed window
         eng.generate([prompts[0]], max_new_tokens=2)
         # tunnel RTT estimate: a scalar fetch of resident device data
@@ -740,15 +838,11 @@ def _bench_other(model_name):
         # prompts re-served through fused engines at readout_stride=k
         # vs 1, with per-arm rtt/dispatch/host-sync shares read off the
         # flight recorder — the host-tax split this PR exists to shrink.
-        # The spec bench keeps its legacy engine (verify windows need
-        # it) and reports only its rtt_share trend below.
-        multi_ab = None
-        if not spec_mode:
-            ms_stride = int(os.environ.get("BENCH_READOUT_STRIDE", "8"))
-            multi_ab = _serve_multi_step_ab(
-                model, prompts, new_tokens, B, cap, ms_stride, rtt_s=rtt)
+        ms_stride = int(os.environ.get("BENCH_READOUT_STRIDE", "8"))
+        multi_ab = _serve_multi_step_ab(
+            model, prompts, new_tokens, B, cap, ms_stride, rtt_s=rtt)
         art_dir = _artifact_dir()
-        stem = "llama_serve_spec" if spec_mode else "llama_serve"
+        stem = "llama_serve"
         trace_path = os.path.join(art_dir, f"{stem}_trace.json")
         recorder.export_chrome_trace(trace_path)
         tail_p99 = recorder.explain_tail(0.99, top=64)
@@ -760,45 +854,18 @@ def _bench_other(model_name):
                 "flight_recorder": rec_snap,
                 "explain_tail_p99": tail_p99[:8],
             }, f, indent=1)
-        plain = None
-        if spec_k > 1:
-            # VERDICT r5 #6 satellite: the +42% speculation win exists as
-            # an A/B IN THE BENCH JSON, not as a comment — the same
-            # prompts re-served through a plain (spec off) engine at the
-            # same batch. horizon stays the plain path's production
-            # default (the spec arm's smaller horizon is a spec-specific
-            # tuning; the A/B compares best-config vs best-config).
-            plain_horizon = int(os.environ.get("BENCH_PLAIN_HORIZON", "64"))
-            eng_plain = LLMEngine(model, max_batch=B, max_seq_len=cap,
-                                  chunk_size=256, horizon=plain_horizon)
-            eng_plain.generate([prompts[0]], max_new_tokens=2)
-            eng_plain.reset_stats()
-            srv_plain = AsyncLLMServer(eng_plain, max_queue_size=n_req + 1)
-            srv_plain.start()
-            t0 = time.perf_counter()
-            hs = [srv_plain.submit(p, max_new_tokens=new_tokens)
-                  for p in prompts]
-            pouts = [h.result(timeout=1800) for h in hs]
-            plain_wall = time.perf_counter() - t0
-            srv_plain.stop()
-            plain = {
-                "tokens_per_sec": round(
-                    sum(len(o.token_ids) for o in pouts) / plain_wall, 1),
-                "horizon": plain_horizon, "batch": B}
-        # r05 sync-loop baselines (BENCH_r05.json): serve 1,158.9 tok/s,
-        # spec 46.8 — comparable ONLY at the exact captured config (on-chip
-        # defaults, bf16); any overridden knob makes the ratio meaningless,
-        # so it degrades to null exactly like the other bench lines
+        # r05 sync-loop baseline (BENCH_r05.json): serve 1,158.9 tok/s —
+        # comparable ONLY at the exact captured config (on-chip
+        # defaults, bf16); any overridden knob makes the ratio
+        # meaningless, so it degrades to null like the other bench lines
         at_r05_config = (
-            B == (1 if spec_mode else 8) and new_tokens == 64
-            and n_req == (3 if spec_mode else 16) and n_layers == 3
+            B == 8 and new_tokens == 64
+            and n_req == 16 and n_layers == 3
             and hidden == 4096 and ff == hidden * 11 // 4
-            and horizon == (8 if spec_k > 1 else 64)
-            and spec_k == (6 if spec_mode else 1) and not weight_dtype
+            and horizon == 64 and not weight_dtype
             and jax.default_backend() != "cpu")
-        base_toks = 46.8 if spec_k > 1 else 1158.9
-        out = {"metric": ("llama_serve_spec_tokens_per_sec" if spec_k > 1
-                          else "llama_serve_tokens_per_sec"),
+        base_toks = 1158.9
+        out = {"metric": "llama_serve_tokens_per_sec",
                "value": round(toks / wall, 1), "unit": "tokens/s",
                "vs_baseline": (round(toks / wall / base_toks, 4)
                                if at_r05_config else None),
@@ -837,9 +904,9 @@ def _bench_other(model_name):
                "rtt_est_ms": round(rtt * 1e3, 1),
                # host-RTT share of the serve wall (rtt x host passes /
                # wall) — the r05 tax this line tracks the TREND of:
-               # llama_serve 0.233 / llama_serve_spec 0.324 at r05
+               # llama_serve 0.233 at r05
                "rtt_share": round(rtt * steps / wall, 4),
-               "rtt_share_r05": 0.324 if spec_mode else 0.233,
+               "rtt_share_r05": 0.233,
                "weight_dtype": weight_dtype or "bf16"}
         if multi_ab is not None:
             # the multi-step decode A/B: speedup + per-arm host-tax
@@ -851,15 +918,6 @@ def _bench_other(model_name):
             # (TPU), see _serve_multi_step_ab's docstring
             out["multi_step_speedup"] = multi_ab["multi_step_speedup"]
             out["multi_step"] = multi_ab
-        if spec_k > 1:
-            out["speculative_k"] = spec_k
-            out["draft_tokens_accepted"] = stats_off["draft_tokens_accepted"]
-            out["accepted_per_step"] = round(
-                stats_off["draft_tokens_accepted"] / max(steps, 1), 2)
-            # the plain batch-1 line the +42% claim is measured AGAINST
-            out["spec_off"] = plain
-            out["speculation_speedup"] = round(
-                (toks / wall) / max(plain["tokens_per_sec"], 1e-9), 3)
         return out
 
     if model_name == "llama_serve_fused":

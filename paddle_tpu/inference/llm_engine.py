@@ -59,7 +59,9 @@ def default_engine_stats():
     the full set: a hand-copied dict would silently drift the next time
     a counter is added."""
     return {"steps": 0, "prefill_chunks": 0, "tokens_generated": 0,
-            "draft_tokens_accepted": 0, "preemptions": 0,
+            "draft_tokens_accepted": 0,
+            "spec_proposed_tokens": 0, "spec_accepted_tokens": 0,
+            "preemptions": 0,
             "fused_steps": 0, "multi_steps": 0,
             "prefill_tokens": 0,
             "prefix_hit_tokens": 0, "prefix_cow_blocks": 0,
@@ -73,6 +75,13 @@ def default_engine_stats():
 #: chain-hash seed for block 0 of every sequence (the "parent" of the
 #: first block) — a fixed constant so equal first blocks collide
 _ROOT_HASH = b"paddle-tpu-prefix-root"
+
+#: smoothing of the per-request draft-acceptance EWMA that drives the
+#: acceptance-adaptive verify-k grants (fused speculative scheduling):
+#: high enough that a request whose drafts stop accepting sheds its
+#: window within a few readouts, low enough that one unlucky window
+#: doesn't collapse k for a stream that usually accepts
+_SPEC_EWMA_ALPHA = 0.4
 
 #: one RLock per MODEL object, shared by every engine built on it. The
 #: compiled programs trace through ``bind_state``, which temporarily
@@ -129,6 +138,14 @@ class GenerationRequest:
     #: "embed" (PREFILL-ONLY — no decode tokens, no sampling; the
     #: mean-pooled final hidden state returns on the prefill sync)
     kind: str = "generate"
+    #: acceptance-adaptive speculation state (fused verify-k grants):
+    #: EWMA of accepted/proposed drafts for THIS request, None until the
+    #: first verify readout. Carried through preemption re-prefill and
+    #: supervised-restart re-admission (the engine's rid-keyed
+    #: ``_spec_ewma`` mirror survives ``reset()``) like the PR-8
+    #: ``readout_stride`` pins, so a low-acceptance request does not
+    #: reset to full-window speculation every time it moves.
+    spec_ewma: float | None = None
 
 
 @dataclasses.dataclass
@@ -201,10 +218,10 @@ class PendingStep:
 
     __slots__ = ("toks", "was_active", "counts", "spec", "slots",
                  "pool_done", "sched", "step_id", "fenced", "t_dispatch",
-                 "embed_done", "pooled")
+                 "embed_done", "pooled", "verify", "offered")
 
     def __init__(self, toks, was_active, counts, spec, slots, pool_done,
-                 sched=None, fenced=None, embed_done=None):
+                 sched=None, fenced=None, embed_done=None, verify=None):
         self.toks = toks              # device [rows, B] (spec: [Kh,B,Ks])
         self.was_active = was_active  # device activity history
         self.counts = counts          # spec only: accepted counts [Kh, B]
@@ -234,6 +251,15 @@ class PendingStep:
         #: synchronize on younger in-flight steps).
         self.embed_done = embed_done or []
         self.pooled = None
+        #: fused speculative dispatches: {slot: drafts granted} — the
+        #: readout's acceptance accounting (EWMA + spec counters) and
+        #: the paged BLOCK-TABLE ROLLBACK walk key off it
+        self.verify = verify or {}
+        #: fused speculative dispatches: device [windows, B] per-window
+        #: OFFERED widths (1 + drafts after the in-graph clamps) — the
+        #: exact proposal counts the acceptance accounting books
+        #: against. None on legacy spec (its grant is never clamped).
+        self.offered = None
 
 
 class LLMEngine:
@@ -312,12 +338,18 @@ class LLMEngine:
         # analog — the snapshot has no speculative decoding): each window
         # commits 1 sampled token plus up to speculative_k-1 drafted tokens
         # verified by ONE K-token model call. Drafting runs IN-GRAPH from a
-        # device-side token history, so windows compose with `horizon`: one
-        # step() = horizon windows = up to horizon*speculative_k tokens per
-        # host round-trip. Greedy slots accept token-exactly; sampling
-        # slots use rejection-sampling acceptance (distribution-exact for
-        # pure temperature sampling; with top-k/top-p the residual re-
-        # filters the masked distribution, see _spec_accept).
+        # device-side token history. Acceptance is COUPLED: a draft
+        # survives iff it equals the token the engine would sample at
+        # that position under its per-(rid, position) fold_in key, so a
+        # speculative stream is TOKEN-IDENTICAL to the non-speculative
+        # engine's — greedy and sampled alike — and restart/failover
+        # resumption is exact in both modes. Under scheduler="legacy"
+        # (dense only) windows run as a horizon scan; under
+        # scheduler="fused" they are VERIFY grants in the token-budget
+        # walk (any cache backend, mixing freely with prefill chunks,
+        # plain decodes and embed prefills), with per-request
+        # acceptance-adaptive draft counts and, for paged KV, zero-copy
+        # block-table rollback of rejected tails.
         self.speculative_k = max(1, int(speculative_k))
         self.lookup_ngram = max(1, int(lookup_ngram))
         self.capacity = int(max_seq_len or c.max_position_embeddings)
@@ -330,11 +362,6 @@ class LLMEngine:
         self.stream_callback = stream_callback
         if scheduler not in ("legacy", "fused"):
             raise ValueError(f"unknown scheduler {scheduler!r}")
-        if scheduler == "fused" and self.speculative_k > 1:
-            raise ValueError("the fused prefill+decode scheduler serves "
-                             "one token per decode slot per mixed step "
-                             "(speculative verify windows need the legacy "
-                             "scheduler)")
         self.scheduler = scheduler
         #: multi-step on-device decode (fused scheduler): ALL-DECODE
         #: steps run up to ``readout_stride`` decode iterations as ONE
@@ -399,6 +426,23 @@ class LLMEngine:
                     f"num_key_value_heads {kvh} must divide by the tp "
                     f"mesh axis ({self._tp_size}) — kv-heads are the "
                     f"natural shard dim of the KV pools")
+        if self.speculative_k > 1:
+            # speculation is served by the fused scheduler's verify
+            # grants (any cache backend) or the legacy dense scan; the
+            # ONE remaining limitation is a tensor-parallel mesh
+            if self._tp_axis is not None:
+                raise ValueError(
+                    "speculative_k > 1 under a tensor-parallel mesh is "
+                    "the remaining speculation limitation: the verify "
+                    "window's per-row lm-head gather has no TP wiring "
+                    "yet — serve speculation single-chip, or drop to "
+                    "speculative_k=1 on the TP replicas")
+            if scheduler == "fused" and self.chunk < self.speculative_k:
+                raise ValueError(
+                    f"chunk_size {self.chunk} cannot carry a "
+                    f"speculative_k={self.speculative_k} verify window "
+                    f"(the fused mixed step's ids buffer is chunk "
+                    f"tokens wide)")
         import ml_dtypes  # noqa: F401  (np.zeros understands bf16 via jnp)
         self._kvh = kvh
         self._head_dim = head_dim
@@ -420,10 +464,12 @@ class LLMEngine:
                              "per-slot buffers have nothing to share)")
         self.prefix_cache = bool(enable_prefix_cache)
         if cache_impl == "paged":
-            if self.speculative_k > 1:
-                raise ValueError("paged KV serves one token per step "
-                                 "(speculative verify windows need the "
-                                 "dense cache)")
+            if self.speculative_k > 1 and scheduler != "fused":
+                raise ValueError(
+                    "the legacy scheduler's speculative path is "
+                    "dense-only — paged speculation rides the fused "
+                    "scheduler's verify grants through the append-form "
+                    "attention path (scheduler='fused')")
             self.block_size = int(block_size)
             if self.chunk % self.block_size:
                 raise ValueError(f"chunk_size {self.chunk} must be a "
@@ -455,11 +501,11 @@ class LLMEngine:
         #: buffers and the next adapter dispatch rebuilds + re-swaps
         self.adapter_cache = None
         if adapter_store is not None:
-            if self.speculative_k > 1:
+            if self.speculative_k > 1 and scheduler != "fused":
                 raise ValueError(
-                    "batched multi-LoRA serves through the per-slot "
-                    "gather of the plain/fused steps (speculative "
-                    "verify windows are not adapter-aware)")
+                    "the legacy speculative scan is not adapter-aware — "
+                    "batched multi-LoRA speculation rides the fused "
+                    "scheduler's verify grants (scheduler='fused')")
             if getattr(c, "fuse_attention_qkv", False) or \
                     getattr(c, "fuse_swiglu", False):
                 raise ValueError(
@@ -506,6 +552,17 @@ class LLMEngine:
         #: program per distinct effective stride; survives reset())
         self._multi_fns = {}
         self._multi_step_factory = None
+        #: compiled multi-window SPECULATIVE decode programs, keyed by
+        #: stride (windows per dispatch); survives reset() like
+        #: _multi_fns
+        self._multi_spec_fns = {}
+        self._multi_spec_factory = None
+        #: rid -> draft-acceptance EWMA — the acceptance-adaptive
+        #: verify-k state, SURVIVES reset() (like the rid counter and
+        #: the sampling base key) so a supervised restart's re-admitted
+        #: request resumes speculation at its learned window, not at the
+        #: optimistic default. Entries drop at request finish.
+        self._spec_ewma = {}
         #: seconds the CURRENT token's emit stamp should be backdated by
         #: (step_finish amortizes a k-row readout over the dispatch→sync
         #: window; 0.0 outside a readout walk and for 1-row steps) — the
@@ -620,9 +677,11 @@ class LLMEngine:
         trusted or even touched). What SURVIVES: the compiled programs
         (identical shapes/shardings — a restart costs no recompile), the
         request-id counter (rids stay unique across restarts), the
-        engine's cumulative ``stats``, and the sampling base key — token
-        ``p`` of request ``r`` samples from ``fold_in(fold_in(key, r),
-        p)``, so a re-admitted request's sampled stream continues exactly
+        engine's cumulative ``stats``, the rid-keyed draft-acceptance
+        EWMA mirror (a re-admitted speculative request resumes at its
+        learned verify window), and the sampling base key — token ``p``
+        of request ``r`` samples from ``fold_in(fold_in(key, r), p)``,
+        so a re-admitted request's sampled stream continues exactly
         where the crash cut it. ``_check_pool_invariants`` holds
         trivially after a reset."""
         self.slots = [None] * self.B
@@ -692,10 +751,11 @@ class LLMEngine:
             composition, pool-pressure preemption replay, and supervised
             engine RESTART (the fault-tolerance layer's token-exact
             resumption) cannot change a sampled stream. Greedy rows never
-            consult the key. The non-spec paths leave ``key`` untouched
-            across steps; the spec engine still advances it per verify
-            window (acceptance randomness), so spec resumption is greedy-
-            exact only — documented in docs/architecture.md."""
+            consult the key, and EVERY path — including the speculative
+            verify windows, whose coupled acceptance rule re-derives the
+            same per-position keys instead of advancing a shared stream —
+            leaves ``key`` untouched across steps, so resumption is
+            token-exact in sampled mode too (docs/architecture.md)."""
             greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             keys = jax.vmap(lambda r, p: jax.random.fold_in(
                 jax.random.fold_in(key, r), p))(rids, lens)
@@ -826,19 +886,72 @@ class LLMEngine:
         Kspec = self.speculative_k
         ngram = self.lookup_ngram
 
+        def row_sample(logits_rows, key, temps, top_ps, rids, poss):
+            """Per-(row, position) COUPLED sampler: the token the engine
+            would commit at each position — greedy rows argmax, sampled
+            rows the filtered categorical under the per-(rid, position)
+            fold_in key, i.e. EXACTLY the key ``sample_next`` would use
+            when the stream reaches that position one token at a time.
+            ``logits_rows`` [B, R, V], ``poss`` [B, R] -> [B, R] int32.
+            The verify rule is built on this coupling: a draft is
+            accepted iff it EQUALS this token, so a speculative stream
+            is token-identical to the non-spec engine's — greedy AND
+            sampled — and restart/failover resumption needs no
+            acceptance-randomness replay (there is none)."""
+            greedy_tok = jnp.argmax(logits_rows, axis=-1).astype(jnp.int32)
+
+            def per_slot(k_rid, rows, t, tp, ps):
+                return jax.vmap(lambda p, row: _sample_logits_device(
+                    row, jax.random.fold_in(k_rid, p),
+                    jnp.maximum(t, 1e-6), top_k, tp, False, True))(ps, rows)
+
+            k_rids = jax.vmap(lambda r: jax.random.fold_in(key, r))(rids)
+            sampled = jax.vmap(per_slot)(k_rids, logits_rows, temps,
+                                         top_ps, poss)
+            return jnp.where((temps <= 0.0)[:, None], greedy_tok, sampled)
+
+        def verify_window(logits_win, draft, lens, q_eff, key, temps,
+                          top_ps, rids, active):
+            """Coupled acceptance over ONE verify window. ``logits_win``
+            [B, Kw, V] are the model's logits over the window rows
+            (row j = the distribution for position lens+1+j given the
+            window prefix), ``draft`` [B, Kw-1] the prompt-lookup
+            proposals, ``q_eff`` the per-slot granted window width (1 +
+            drafts; rows past it are padding and never accept). Draft j
+            survives iff it equals the COUPLED sample at its position
+            and every earlier draft did. Returns ``(counts, n_acc,
+            next_logits)``: committed tokens per slot (1 + accepted
+            drafts), accepted-draft counts, and the carried logits at
+            the last accepted row — the distribution the NEXT committed
+            token samples from, which by the coupling is exactly the
+            non-spec engine's carried-logits state."""
+            Kd = draft.shape[1]
+            poss = lens[:, None] + 1 + \
+                jnp.arange(Kd, dtype=jnp.int32)[None, :]
+            targets = row_sample(logits_win[:, :Kd], key, temps, top_ps,
+                                 rids, poss)
+            acc = (targets == draft) & \
+                (jnp.arange(Kd)[None, :] < (q_eff - 1)[:, None])
+            n_acc = jnp.cumprod(acc.astype(jnp.int32), axis=1) \
+                .sum(axis=1).astype(jnp.int32)
+            counts = jnp.where(active, 1 + n_acc, 0)
+            next_logits = jnp.take_along_axis(
+                logits_win, n_acc[:, None, None], axis=1)[:, 0]
+            return counts, n_acc, next_logits
+
         def spec_step(state_vals, k_bufs, v_bufs, logits, lens, active, rng,
                       temps, top_ps, eos_ids, budgets, rids, tokens_buf):
             """`horizon` speculative verify windows as ONE compiled scan.
             Each window: in-graph prompt-lookup draft from the device token
             history -> commit one sampled token + verify the Kspec-1 drafts
-            with ONE Kspec-token model call (_spec_accept: greedy rows
-            token-exact, sampled rows rejection-sampling). KV written past
+            with ONE Kspec-token model call (verify_window: COUPLED
+            acceptance, so greedy and sampled streams are both token-exact
+            vs plain decode and the key never advances). KV written past
             the accepted prefix is stale but unreferenced (lens-based
             masks) and is overwritten by the next window."""
             def body(carry, _):
                 kb, vb, logits, lens, act, emitted, rng, tbuf = carry
                 draft = _lookup_draft(tbuf, lens, Kspec - 1, ngram)
-                rng, sub2 = jax.random.split(rng)
                 committed = sample_next(logits, rng, temps, top_ps, rids,
                                         lens)
                 committed = jnp.where(act, committed, 0)
@@ -856,10 +969,11 @@ class LLMEngine:
                       for cc in new_caches]
                 vb = [cc.v._value if isinstance(cc.v, Tensor) else cc.v
                       for cc in new_caches]
-                n_acc, new_logits = _spec_accept(
-                    logits_all, draft, temps, top_ps, top_k, act, sub2)
+                counts, _, new_logits = verify_window(
+                    logits_all, draft, lens,
+                    jnp.where(act, Kspec, 0), rng, temps, top_ps, rids,
+                    act)
                 new_logits = jnp.where(act[:, None], new_logits, logits)
-                counts = jnp.where(act, 1 + n_acc, 0)
                 new_lens = lens + counts
                 tbuf = _write_window(tbuf, window, lens)
                 emitted = emitted + counts
@@ -883,9 +997,120 @@ class LLMEngine:
                     _pin_rep(logits), _pin_kv(k_bufs), _pin_kv(v_bufs),
                     _pin_rep(lens), rng, tokens_buf)
 
+        def make_multi_spec(Kms):
+            """Build the fused ALL-DECODE speculative program for stride
+            ``Kms``: up to Kms verify windows per slot as ONE dispatch,
+            as a ``lax.while_loop`` with the multi-step path's IN-GRAPH
+            EARLY EXIT (every slot hit eos / budget / capacity / its
+            covered blocks -> the loop stops on device). Each window
+            runs through the APPEND-form attention path (q_lens = the
+            granted 1 + k drafts per slot, shrunk in-graph to the
+            per-slot ``row_caps`` coverage budget), verifies with the
+            coupled rule, and rolls rejected tokens back via lens.
+            Token/count/activity rows land in [Kms, B, Kspec] /
+            [Kms, B] buffers — the same layout the legacy verify scan
+            hands step_finish, so ONE spec readout serves both."""
+            Kd = Kspec - 1
+
+            def multi_spec(state_vals, k_bufs, v_bufs, logits, lens,
+                           active, rng, temps, top_ps, eos_ids, budgets,
+                           rids, spec_qs, row_caps, tokens_buf,
+                           tables=None, lora=None):
+                nL = len(k_bufs)
+
+                def cond(carry):
+                    return (carry[0] < Kms) & jnp.any(carry[5])
+
+                def body(carry):
+                    (i, kb, vb, lg, ln, act, emitted, tbuf, toks, cnts,
+                     wa, qs) = carry
+                    # pipelined over-dispatch guard: a slot whose
+                    # PREVIOUS (still in-flight) dispatch grew it to the
+                    # capacity margin deactivates before its window (or
+                    # its token-history write) could cross the buffer
+                    act = act & (ln + Kspec <= cap)
+                    draft = _lookup_draft(tbuf, ln, Kd, ngram)
+                    committed = sample_next(lg, rng, temps, top_ps, rids,
+                                            ln)
+                    committed = jnp.where(act, committed, 0)
+                    window = jnp.concatenate([committed[:, None], draft],
+                                             axis=1)
+                    # per-slot window width: the granted 1 + k drafts,
+                    # shrunk in-graph to the covered-block / capacity
+                    # row budget (pool pressure narrows windows before
+                    # anyone is preempted)
+                    q_eff = jnp.clip(jnp.minimum(row_caps, cap) - ln, 0,
+                                     spec_qs)
+                    q_eff = jnp.where(act, q_eff, 0)
+                    act = act & (q_eff >= 1)
+                    q_eff = jnp.where(act, q_eff, 0)
+                    with functional_mode(), _bind(state, state_vals), \
+                            lora_scope(lora):
+                        if tables is None:
+                            from ..models.llama import ChunkKVCache
+                            caches = [ChunkKVCache(k, v, ln, q_eff)
+                                      for k, v in zip(kb, vb)]
+                        else:
+                            from ..models.llama import PagedKVCache
+                            caches = [PagedKVCache(k, v, tables, ln,
+                                                   q_eff)
+                                      for k, v in zip(kb, vb)]
+                        hidden, new_caches = model.llama(
+                            Tensor(window), kv_caches=caches,
+                            position_offset=Tensor(ln))
+                        logits_win = model._logits(hidden)._value \
+                            .astype(jnp.float32)          # [B, Kspec, V]
+                    kb = [cc.k._value if isinstance(cc.k, Tensor)
+                          else cc.k for cc in new_caches]
+                    vb = [cc.v._value if isinstance(cc.v, Tensor)
+                          else cc.v for cc in new_caches]
+                    counts, _, new_lg = verify_window(
+                        logits_win, draft, ln, q_eff, rng, temps, top_ps,
+                        rids, act)
+                    new_lg = jnp.where(act[:, None], new_lg, lg)
+                    new_ln = ln + counts
+                    tb_new = _write_window(tbuf, window, ln)
+                    tbuf = jnp.where(act[:, None], tb_new, tbuf)
+                    toks = jax.lax.dynamic_update_slice(
+                        toks, window[None], (i, jnp.int32(0),
+                                             jnp.int32(0)))
+                    cnts = jax.lax.dynamic_update_slice(
+                        cnts, counts[None], (i, jnp.int32(0)))
+                    wa = jax.lax.dynamic_update_slice(
+                        wa, act[None], (i, jnp.int32(0)))
+                    qs = jax.lax.dynamic_update_slice(
+                        qs, q_eff[None], (i, jnp.int32(0)))
+                    emitted = emitted + counts
+                    kidx = jnp.arange(Kspec)[None, :]
+                    in_win = kidx < counts[:, None]
+                    eos_hit = jnp.any(
+                        in_win & (window == eos_ids[:, None]), axis=1)
+                    act = act & ~eos_hit & (new_ln < cap - Kspec) & \
+                        (emitted < budgets)
+                    return (i + 1, kb, vb, new_lg, new_ln, act, emitted,
+                            tbuf, toks, cnts, wa, qs)
+
+                carry = (jnp.int32(0), list(k_bufs), list(v_bufs), logits,
+                         lens, jnp.asarray(active), jnp.zeros_like(lens),
+                         tokens_buf,
+                         jnp.zeros((Kms, B, Kspec), jnp.int32),
+                         jnp.zeros((Kms, B), jnp.int32),
+                         jnp.zeros((Kms, B), bool),
+                         jnp.zeros((Kms, B), jnp.int32))
+                (_, k_out, v_out, logits, lens, _, _, tokens_buf, toks,
+                 cnts, wa, qs) = jax.lax.while_loop(cond, body, carry)
+                assert len(k_out) == nL
+                return (_pin_rep(toks), _pin_rep(cnts), _pin_rep(wa),
+                        _pin_rep(logits), _pin_kv(k_out), _pin_kv(v_out),
+                        _pin_rep(lens), rng, tokens_buf, _pin_rep(qs))
+            return multi_spec
+
+        self._multi_spec_factory = make_multi_spec
+
         def fused_step(state_vals, k_bufs, v_bufs, logits, lens, rng, ids,
                        q_lens, is_decode, active, temps, top_ps, rids,
-                       tables=None, lora=None, is_embed=None, pooled=None):
+                       tables=None, lora=None, is_embed=None, pooled=None,
+                       tokens_buf=None, spec_ks=None):
             """ONE mixed prefill+decode dispatch (the fused scheduler's
             step): slot b processes rows [0, q_lens[b]) of ``ids`` —
             either a prefill chunk (host-provided prompt rows) or one
@@ -902,16 +1127,46 @@ class LLMEngine:
             hidden states accumulate into its ``pooled`` row — the
             mean-pool numerator the finishing readout divides by the
             prompt length. Passed as None on generate-only dispatches,
-            so the no-embed program is untouched."""
+            so the no-embed program is untouched.
+
+            ``tokens_buf``/``spec_ks`` (VERIFY grant kind — the fused
+            speculative engine): a decode slot with ``spec_ks[b] = k >
+            0`` was granted a k-draft verify window (``q_lens[b] = k+1``)
+            — row 0 is its committed sample, rows 1..k the in-graph
+            prompt-lookup drafts read from the device token history, the
+            whole window runs through the SAME append-form attention as
+            a prefill chunk, and the coupled ``verify_window`` rule
+            commits the matching prefix (rejected tokens roll back via
+            lens; their KV rows are stale-but-unreferenced). Passed as
+            None on non-speculative engines, so the spec-free program —
+            and ``speculative_k=1`` serving — is bit-identical."""
             nxt = sample_next(logits, rng, temps, top_ps, rids, lens)
             # capacity guard for pipelined over-dispatch: a window that
             # would cross the buffer end deactivates in-graph
             active = active & (lens + q_lens <= cap)
             dec = active & is_decode
-            nxt = jnp.where(dec, nxt, 0)
-            q_eff = jnp.where(active, q_lens, 0)
-            row0 = jnp.arange(chunk, dtype=jnp.int32)[None, :] == 0
-            ids = jnp.where(dec[:, None] & row0, nxt[:, None], ids)
+            if spec_ks is None:
+                nxt = jnp.where(dec, nxt, 0)
+                q_eff = jnp.where(active, q_lens, 0)
+                row0 = jnp.arange(chunk, dtype=jnp.int32)[None, :] == 0
+                ids = jnp.where(dec[:, None] & row0, nxt[:, None], ids)
+            else:
+                # verify windows must fit the token-history write below;
+                # a clamped-out verify slot goes fully inactive (its
+                # rows must not scatter) — in practice the readout's
+                # capacity margin retires slots before this fires
+                dec = dec & (lens + Kspec <= cap)
+                active = active & (~is_decode | dec)
+                nxt = jnp.where(dec, nxt, 0)
+                q_eff = jnp.where(active, q_lens, 0)
+                draft = _lookup_draft(tokens_buf, lens, Kspec - 1, ngram)
+                window = jnp.concatenate([nxt[:, None], draft], axis=1)
+                wcols = jnp.arange(chunk, dtype=jnp.int32)[None, :] < Kspec
+                padded_win = jnp.zeros_like(ids) \
+                    .at[:, :Kspec].set(window)
+                ids = jnp.where(dec[:, None] & wcols, padded_win, ids)
+                tb_new = _write_window(tokens_buf, window, lens)
+                tokens_buf = jnp.where(dec[:, None], tb_new, tokens_buf)
             with functional_mode(), _bind(state, state_vals), \
                     lora_scope(lora):
                 if tables is None:
@@ -934,6 +1189,14 @@ class LLMEngine:
                     jnp.maximum(q_eff - 1, 0)[:, None, None], axis=1)
                 new_logits = model._logits(Tensor(rows))._value[:, 0] \
                     .astype(jnp.float32)
+                if spec_ks is not None:
+                    # verify slots need PER-ROW logits over the window
+                    # (not just the last valid row): the head runs over
+                    # [B, Kspec, H] — bounded by the window width, never
+                    # the full chunk
+                    logits_win = model._logits(
+                        Tensor(hidden._value[:, :Kspec]))._value \
+                        .astype(jnp.float32)
             if pooled is not None:
                 # masked sum of this dispatch's real prefill rows for
                 # embed slots only, fp32 — one tiny [B,S,H]x[B,S]
@@ -945,17 +1208,36 @@ class LLMEngine:
                 pooled = pooled + jnp.einsum(
                     "bsh,bs->bh", hidden._value.astype(jnp.float32),
                     emb_mask)
-            new_logits = jnp.where(active[:, None], new_logits, logits)
             kb = [cc.k._value if isinstance(cc.k, Tensor) else cc.k
                   for cc in new_caches]
             vb = [cc.v._value if isinstance(cc.v, Tensor) else cc.v
                   for cc in new_caches]
-            new_lens = lens + q_eff
-            # [1, B] token/activity rows: the readout walk in step_finish
-            # is shared with the scan-based steps (K == 1 here)
-            return (_pin_rep(nxt[None]), _pin_rep(dec[None]),
-                    _pin_rep(new_logits), _pin_kv(kb), _pin_kv(vb),
-                    _pin_rep(new_lens), rng, pooled)
+            if spec_ks is None:
+                new_logits = jnp.where(active[:, None], new_logits, logits)
+                new_lens = lens + q_eff
+                # [1, B] token/activity rows: the readout walk in
+                # step_finish is shared with the scan-based steps (K==1)
+                return (_pin_rep(nxt[None]), _pin_rep(dec[None]),
+                        _pin_rep(new_logits), _pin_kv(kb), _pin_kv(vb),
+                        _pin_rep(new_lens), rng, pooled)
+            counts, _, spec_logits = verify_window(
+                logits_win, draft, lens, q_eff, rng, temps, top_ps,
+                rids, dec)
+            new_logits = jnp.where(dec[:, None], spec_logits, new_logits)
+            new_logits = jnp.where(active[:, None], new_logits, logits)
+            # rejected drafts ROLL BACK here: a verify slot's lens grow
+            # by its committed count, not its granted window — the
+            # written-past-committed KV rows are stale but unreferenced
+            # (lens-based masks) and the next window overwrites them
+            new_lens = lens + jnp.where(dec, counts, q_eff)
+            # [1, B, Kspec] window layout + [1, B] counts: the spec
+            # readout flatten in step_finish is shared with the legacy
+            # verify scan (one window here). The offered widths ride
+            # along so the acceptance accounting books exact proposals.
+            return (_pin_rep(window[None]), _pin_rep(counts[None]),
+                    _pin_rep(dec[None]), _pin_rep(new_logits),
+                    _pin_kv(kb), _pin_kv(vb), _pin_rep(new_lens), rng,
+                    pooled, tokens_buf, _pin_rep(q_eff[None]))
 
         def prefill_chunk(state_vals, k_bufs, v_bufs, ids, slot, off, last,
                           lora=None):
@@ -1109,6 +1391,57 @@ class LLMEngine:
                 self._multi_step_factory(stride), donate_argnums=(1, 2, 3))
         return fn
 
+    def _multi_spec_fn(self, stride):
+        """The compiled multi-window SPECULATIVE decode program for
+        ``stride`` windows per dispatch — cached per distinct stride for
+        the engine's lifetime, exactly like :meth:`_multi_fn`."""
+        fn = self._multi_spec_fns.get(stride)
+        if fn is None:
+            self._programs()
+            fn = self._multi_spec_fns[stride] = jax.jit(
+                self._multi_spec_factory(stride),
+                donate_argnums=(1, 2, 3, 14))
+        return fn
+
+    # ------------------------------------------------------------------
+    # acceptance-adaptive verify-k (fused speculative scheduling)
+    # ------------------------------------------------------------------
+    def _spec_k_for(self, slot):
+        """Draft count of ``slot``'s next verify grant: its acceptance
+        EWMA scaled into [1, speculative_k - 1] (optimistic full window
+        until the first readout teaches otherwise). A low-acceptance
+        request keeps proposing ONE draft — never zero, so the EWMA can
+        recover when the stream turns repetitive again — instead of
+        burning the step budget on windows that roll back."""
+        ewma = slot.req.spec_ewma
+        if ewma is None:
+            ewma = self._spec_ewma.get(slot.req.request_id, 1.0)
+        kd = self.speculative_k - 1
+        return max(1, min(kd, int(round(ewma * kd + 0.25))))
+
+    def _update_spec_ewma(self, slot, proposed, accepted):
+        """Fold one readout's accepted/proposed draft counts into the
+        request's acceptance EWMA (request field + the engine's
+        rid-keyed mirror, which survives reset() for restart
+        resumption)."""
+        if proposed <= 0:
+            return
+        rate = accepted / proposed
+        prev = slot.req.spec_ewma
+        if prev is None:
+            prev = self._spec_ewma.get(slot.req.request_id, rate)
+        ewma = (1.0 - _SPEC_EWMA_ALPHA) * prev + _SPEC_EWMA_ALPHA * rate
+        slot.req.spec_ewma = ewma
+        self._spec_ewma[slot.req.request_id] = ewma
+
+    def spec_ewma_for(self, request_id):
+        """READ-ONLY: the persisted draft-acceptance EWMA of
+        ``request_id`` (None = never speculated) — what the replica
+        router forwards on failover so the survivor's verify grants
+        start at the learned window instead of the optimistic
+        default."""
+        return self._spec_ewma.get(request_id)
+
     def _effective_stride(self):
         """The readout stride the NEXT all-decode dispatch should run:
         the engine's ``readout_stride`` capped by every active slot's
@@ -1204,7 +1537,7 @@ class LLMEngine:
     def add_request(self, prompt_ids, max_new_tokens=64, temperature=0.0,
                     top_p=1.0, eos_token_id=None, request_id=None,
                     committed_tokens=None, readout_stride=None,
-                    adapter_id=0, kind="generate"):
+                    adapter_id=0, kind="generate", spec_ewma=None):
         """``readout_stride``: per-request latency-tier pin — cap the
         multi-step decode stride of every all-decode step this request
         is active in (1 = sync the host every step; None = the engine
@@ -1287,7 +1620,13 @@ class LLMEngine:
             eos_token_id,
             readout_stride=(int(readout_stride)
                             if readout_stride is not None else None),
-            adapter_id=adapter_id, kind=kind))
+            adapter_id=adapter_id, kind=kind,
+            # acceptance-adaptive verify-k seed: an explicit carry-over
+            # (router failover) wins; else the engine's rid-keyed mirror
+            # (supervised restart / preemption re-admission under the
+            # same rid) — fresh requests start at the optimistic default
+            spec_ewma=(float(spec_ewma) if spec_ewma is not None
+                       else self._spec_ewma.get(rid))))
         return rid
 
     def has_unfinished(self):
@@ -1748,6 +2087,13 @@ class LLMEngine:
           owner early), but every stale step a preemption decision
           lags costs re-prefill churn, so the contract caps the lag at
           one dispatch.
+        * **fused speculative** (``speculative_k > 1``): 2 — the
+          verify-grant lens mirror overestimates in-flight growth by
+          every rejected tail (the device rolls back, the host learns
+          at readout), so each extra stale dispatch over-fences and
+          over-allocates a full window per slot; the contract caps the
+          lag at one dispatch, which the rollback/quarantine machinery
+          is proven against.
         * **legacy dense / speculative**: 2 (the original in-graph-
           guard contract — host request state is one step stale at the
           chained dispatch).
@@ -1755,6 +2101,8 @@ class LLMEngine:
           mirror; the block allocator and the admission prefill train
           need each step's post-readout lens."""
         if self.scheduler == "fused":
+            if self.speculative_k > 1:
+                return 2
             if self.cache_impl != "paged" or \
                     self.n_blocks >= self.B * self._max_blocks:
                 return 3
@@ -1850,15 +2198,20 @@ class LLMEngine:
             req.max_new_tokens - len(slot.generated),
             req.temperature, req.top_p, req.eos_token_id,
             readout_stride=req.readout_stride,
-            adapter_id=req.adapter_id, kind=req.kind))
+            adapter_id=req.adapter_id, kind=req.kind,
+            spec_ewma=req.spec_ewma))
         self._free_slot(b)
         self.stats["preemptions"] += 1
         if self._rec() is not None:
             self._rec_preempted.append(req.request_id)
 
     def _finish_tokens(self, req, generated):
-        """Full output stream incl. tokens committed before a preemption."""
+        """Full output stream incl. tokens committed before a preemption.
+        Called exactly once per TERMINAL output, so it also drops the
+        request's persisted acceptance-EWMA entry (kept across
+        preemption and restart, dead weight after the finish)."""
         prefix = self._preempted_prefix.pop(req.request_id, [])
+        self._spec_ewma.pop(req.request_id, None)
         return list(prefix) + list(generated)
 
     def _admit(self, slot_idx, req, a_slot=0):
@@ -2002,6 +2355,14 @@ class LLMEngine:
                                             adapter_id=req.adapter_id)
         self._lens = self._set_len_fn(self._lens, np.int32(slot_idx),
                                       np.int32(hit))
+        if self._tokens is not None:
+            # speculative fused engine: seed the device token history
+            # with the WHOLE prompt (host-known even for a prefix-cache
+            # hit span) so prompt-lookup drafts can match into it
+            row = np.zeros((self.capacity,), np.int32)
+            row[:len(req.prompt_ids)] = req.prompt_ids
+            self._tokens = self._set_tokens_fn(self._tokens, row,
+                                               np.int32(slot_idx))
         if req.kind == "embed":
             # fresh mean-pool accumulator for this slot's new occupant
             self._pooled = self._set_pooled_fn(self._pooled,
@@ -2201,10 +2562,17 @@ class LLMEngine:
         if self.scheduler == "fused" and \
                 any(s is not None and s.ramping for s in self.slots):
             # at least one slot is ramping in: ONE fused mixed dispatch
-            # covers its prefill chunk AND every decode slot's token.
-            # All-decode steps fall through to the plain scan below
-            # (horizon amortization intact in steady state).
+            # covers its prefill chunk AND every decode slot's token
+            # (or, speculative engine, its verify window). All-decode
+            # steps fall through to the plain scan below (horizon
+            # amortization intact in steady state).
             return self._begin_mixed_step(pool_done)
+        if spec and self.scheduler == "fused":
+            # fused SPECULATIVE all-decode: every slot runs verify
+            # windows through the multi-window program (readout_stride
+            # composes — a stride step is `stride` windows with the
+            # same in-graph early exit)
+            return self._begin_spec_decode(pool_done)
         # ALL-DECODE fast path: with readout_stride > 1 the fused
         # scheduler runs up to `stride` decode iterations as one
         # multi-step dispatch (in-graph early exit); the token-budget
@@ -2283,19 +2651,8 @@ class LLMEngine:
                                       self.B * self.horizon, 0.0)
                 return pending
             return None
-        temps = np.array([s.req.temperature if s else 0.0
-                          for s in self.slots], np.float32)
-        top_ps = np.array([s.req.top_p if s else 1.0
-                           for s in self.slots], np.float32)
-        eos_ids = np.array([(s.req.eos_token_id if s and
-                             s.req.eos_token_id is not None else -1)
-                            for s in self.slots], np.int32)
-        budgets = np.array([(s.req.max_new_tokens - len(s.generated))
-                            if s else 0 for s in self.slots], np.int32)
-        # per-slot request ids ride into the dispatch: sampling keys are
-        # fold_in(fold_in(base, rid), position) — see sample_next
-        rids = np.array([s.req.request_id if s else 0
-                         for s in self.slots], np.int32)
+        temps, top_ps, eos_ids, rids, budgets = \
+            self._slot_sampling_arrays()
         for b, cap_left in pool_budget.items():
             budgets[b] = min(budgets[b], cap_left)
 
@@ -2385,9 +2742,14 @@ class LLMEngine:
                 if slot is not None and active[b]:
                     slot.inflight += stride
                     sched[b] = stride
-        pending = PendingStep(toks, was_active, counts, spec,
-                              list(self.slots), pool_done, sched=sched,
-                              fenced=fenced)
+        pending = PendingStep(
+            toks, was_active, counts, spec, list(self.slots), pool_done,
+            sched=sched, fenced=fenced,
+            # legacy verify scan: full-width windows per active slot —
+            # the shared readout's acceptance accounting reads this
+            verify=({int(b): self.speculative_k - 1
+                     for b in np.nonzero(active)[0]
+                     if self.slots[b] is not None} if spec else None))
         pending.t_dispatch = t0
         if self._rec() is not None:
             # ONE decode grant per slot covering the whole stride (spec:
@@ -2401,6 +2763,158 @@ class LLMEngine:
                 pending, "spec" if spec else "decode", grants,
                 sum(g[3] for g in grants), self.B * per_slot, dt,
                 readout_stride=per_slot)
+        return pending
+
+    def _slot_sampling_arrays(self, budgets=True):
+        """Per-slot traced sampling inputs of one dispatch — THE one
+        copy of the array construction (temps, top_ps, eos_ids, rids,
+        and optionally remaining budgets) shared by the all-decode,
+        speculative and mixed dispatch builders, so a new per-request
+        field can never silently desynchronize one path."""
+        temps = np.array([s.req.temperature if s else 0.0
+                          for s in self.slots], np.float32)
+        top_ps = np.array([s.req.top_p if s else 1.0
+                           for s in self.slots], np.float32)
+        eos_ids = np.array([(s.req.eos_token_id if s and
+                             s.req.eos_token_id is not None else -1)
+                            for s in self.slots], np.int32)
+        # per-slot request ids ride into the dispatch: sampling keys are
+        # fold_in(fold_in(base, rid), position) — see sample_next
+        rids = np.array([s.req.request_id if s else 0
+                         for s in self.slots], np.int32)
+        if not budgets:
+            return temps, top_ps, eos_ids, rids
+        buds = np.array([(s.req.max_new_tokens - len(s.generated))
+                         if s else 0 for s in self.slots], np.int32)
+        return temps, top_ps, eos_ids, rids, buds
+
+    # ------------------------------------------------------------------
+    # fused scheduler: speculative all-decode dispatch (verify windows)
+    # ------------------------------------------------------------------
+    def _begin_spec_decode(self, pool_done):
+        """ALL-DECODE dispatch of the fused SPECULATIVE engine: every
+        active generate slot gets one VERIFY grant — 1 committed token
+        plus its acceptance-adaptive draft count per window — run as
+        ``stride`` windows in one compiled while_loop with in-graph
+        early exit (the multi-step composition), through the append-form
+        attention path. Rejected drafts roll back in-graph (lens) and,
+        for paged slots, by host block-table truncation at readout.
+        Pool pressure SHRINKS windows (per-slot ``row_caps``) before
+        anyone is preempted — only a slot that cannot even write its
+        committed token walks the preempt ladder."""
+        stride = self._effective_stride()
+        Kw = self.speculative_k
+        paged = self.cache_impl == "paged"
+        spec_qs = np.zeros((self.B,), np.int32)
+        row_caps = np.full((self.B,), self.capacity, np.int32)
+        order = sorted((b for b, s in enumerate(self.slots)
+                        if s is not None),
+                       key=lambda i: self._admit_order[i])
+        for b in order:
+            slot = self.slots[b]
+            if slot is None or slot.req.kind == "embed":
+                continue
+            cur = slot.sched_len()
+            if cur >= self.capacity:
+                continue  # pipelined overshoot; readout retires it
+            kd = self._spec_k_for(slot)
+            if paged:
+                want_hi = min(cur + stride * (1 + kd) - 1,
+                              self.capacity - 1)
+                if not self._ensure_blocks(b, want_hi):
+                    avail = self._n_allocatable()
+                    if avail:
+                        self._alloc_blocks(b, avail)
+                    covered = len(self._slot_blocks[b]) * self.block_size
+                    if covered <= cur:
+                        # cannot even write the committed token: the
+                        # ordinary coverage ladder (preempt newer /
+                        # park / retire at the pool edge)
+                        if not self._ensure_pos_covered(b, cur,
+                                                        pool_done):
+                            continue
+                        covered = len(self._slot_blocks[b]) * \
+                            self.block_size
+                    row_caps[b] = min(int(row_caps[b]), covered)
+            spec_qs[b] = 1 + kd
+        active = np.array([spec_qs[b] > 0 and self.slots[b] is not None
+                           for b in range(self.B)])
+        if not active.any():
+            if pool_done:
+                pending = PendingStep(None, None, None, True,
+                                      list(self.slots), pool_done)
+                self._record_dispatch(pending, "drain", (), 0,
+                                      self.B * Kw * stride, 0.0)
+                return pending
+            return None
+        temps, top_ps, eos_ids, rids, budgets = \
+            self._slot_sampling_arrays()
+        lora = self._lora_pack(self._slot_adapter_rows())
+        # stride-aware write fence over every position this dispatch's
+        # windows may write (committed length .. the scheduled stride of
+        # full windows, clamped by coverage) — _fence_blocks clamps to
+        # the blocks the slot actually holds
+        fenced = []
+        if paged:
+            for b in np.nonzero(active)[0]:
+                slot = self.slots[b]
+                lo = slot.prefill_pos + len(slot.generated)
+                hi = min(slot.sched_len() + stride * int(spec_qs[b]) - 1,
+                         self.capacity - 1)
+                self._fence_blocks(int(b), lo, hi, fenced)
+
+        t0 = time.perf_counter()
+        fn = self._multi_spec_fn(stride)
+        if paged:
+            with self._kernel_tp_ctx():
+                (toks, counts, was_active, self._logits, self._k,
+                 self._v, self._lens, self._rng_key, self._tokens,
+                 offered) = fn(
+                    self._state_vals, self._k, self._v, self._logits,
+                    self._lens, active, self._rng_key, temps, top_ps,
+                    eos_ids, budgets, rids, spec_qs, row_caps,
+                    self._tokens, tables=self._tables.copy(), lora=lora)
+        else:
+            (toks, counts, was_active, self._logits, self._k, self._v,
+             self._lens, self._rng_key, self._tokens, offered) = fn(
+                self._state_vals, self._k, self._v, self._logits,
+                self._lens, active, self._rng_key, temps, top_ps,
+                eos_ids, budgets, rids, spec_qs, row_caps, self._tokens,
+                lora=lora)
+        dt = time.perf_counter() - t0
+        self.stats["dispatch_time_s"] += dt
+        self.stats["decode_time_s"] += dt
+        self.stats["fused_steps"] += 1
+        if stride > 1:
+            self.stats["multi_steps"] += 1
+        self._inflight += 1
+        sched, verify = {}, {}
+        for b in np.nonzero(active)[0]:
+            slot = self.slots[b]
+            if slot is not None:
+                # mirror the WORST-CASE growth (full acceptance every
+                # window); the readout pays the whole grant back and
+                # the committed count lands in slot.generated, so the
+                # overestimate lives only while the dispatch is in
+                # flight (the depth-2 contract)
+                n = stride * int(spec_qs[b])
+                slot.inflight += n
+                sched[int(b)] = n
+                verify[int(b)] = int(spec_qs[b]) - 1
+        pending = PendingStep(toks, was_active, counts, True,
+                              list(self.slots), pool_done, sched=sched,
+                              fenced=fenced, verify=verify)
+        pending.t_dispatch = t0
+        pending.offered = offered
+        if self._rec() is not None:
+            grants = tuple(
+                (int(b), self.slots[b].req.request_id, "verify",
+                 stride * int(spec_qs[b]))
+                for b in np.nonzero(active)[0]
+                if self.slots[b] is not None)
+            self._record_dispatch(
+                pending, "spec", grants, sum(g[3] for g in grants),
+                self.B * Kw * stride, dt, readout_stride=Kw * stride)
         return pending
 
     # ------------------------------------------------------------------
@@ -2429,17 +2943,21 @@ class LLMEngine:
     def _schedule_mixed(self, pool_done):
         """One token-budget scheduling pass: per slot, either one decode
         token (always granted — the budget bounds prefill interference,
-        not decode progress) or a prefill chunk grant of up to
-        ``min(chunk, remaining prompt, budget left)`` tokens, walked in
-        admission order so older requests ramp first. Paged slots
-        allocate their blocks HERE (the allocator moved into the unified
-        scheduler); a ramping slot that can't cover its grant shrinks it
-        to the blocks it could grab and otherwise waits for a
-        retirement."""
+        not decode progress), a VERIFY grant (speculative engine: the
+        committed token is always granted, its acceptance-adaptive
+        draft count rides the budget and shrinks first under budget or
+        pool pressure), or a prefill chunk grant of up to ``min(chunk,
+        remaining prompt, budget left)`` tokens, walked in admission
+        order so older requests ramp first. Paged slots allocate their
+        blocks HERE (the allocator moved into the unified scheduler); a
+        ramping slot that can't cover its grant shrinks it to the
+        blocks it could grab and otherwise waits for a retirement."""
         B, S = self.B, self.chunk
         paged = self.cache_impl == "paged"
+        spec = self.speculative_k > 1
         ids = np.zeros((B, S), np.int32)
         q_lens = np.zeros((B,), np.int32)
+        spec_ks = np.zeros((B,), np.int32) if spec else None
         is_dec = np.zeros((B,), bool)
         active = np.zeros((B,), bool)
         sched = {}
@@ -2461,11 +2979,27 @@ class LLMEngine:
                 continue  # pipelined overshoot; readout retires it
             if paged and not self._ensure_pos_covered(b, cur, pool_done):
                 continue
-            q_lens[b] = 1
+            q = 1
+            if spec:
+                # verify grant: 1 committed token (always) + adaptive
+                # drafts, shrunk by the remaining budget and by the
+                # blocks the pool could actually cover — drafts are the
+                # first thing pool/budget pressure takes away
+                kd = min(self._spec_k_for(slot), max(budget - 1, 0))
+                if paged and kd > 0 and \
+                        not self._ensure_blocks(b, cur + kd):
+                    avail = self._n_allocatable()
+                    if avail:
+                        self._alloc_blocks(b, avail)
+                    covered = len(self._slot_blocks[b]) * self.block_size
+                    kd = max(0, min(kd, covered - cur - 1))
+                spec_ks[b] = kd
+                q = 1 + kd
+            q_lens[b] = q
             is_dec[b] = True
             active[b] = True
-            sched[b] = 1
-            budget -= 1
+            sched[b] = q
+            budget -= q
         first_ramp = True
         for b in order:                      # then prefill grants
             slot = self.slots[b]
@@ -2497,7 +3031,7 @@ class LLMEngine:
             q_lens[b] = take
             active[b] = True
             budget -= take
-        return ids, q_lens, is_dec, active, sched
+        return ids, q_lens, is_dec, active, sched, spec_ks
 
     def _begin_mixed_step(self, pool_done):
         """Schedule and DISPATCH one fused mixed step (>= 1 slot is
@@ -2505,7 +3039,7 @@ class LLMEngine:
         instead of O(prompt_len / chunk) serial admission dispatches with
         every decode slot stalled behind them."""
         for _ in range(self.B + 1):
-            ids, q_lens, is_dec, active, sched = \
+            ids, q_lens, is_dec, active, sched, spec_ks = \
                 self._schedule_mixed(pool_done)
             if active.any():
                 break
@@ -2522,12 +3056,7 @@ class LLMEngine:
                                       self.max_step_tokens, 0.0)
                 return pending
             return None
-        temps = np.array([s.req.temperature if s else 0.0
-                          for s in self.slots], np.float32)
-        top_ps = np.array([s.req.top_p if s else 1.0
-                           for s in self.slots], np.float32)
-        rids = np.array([s.req.request_id if s else 0
-                         for s in self.slots], np.int32)
+        temps, top_ps, _, rids = self._slot_sampling_arrays(budgets=False)
         lora = self._lora_pack(self._slot_adapter_rows())
         # prefill-only plumbing: pass the pooled accumulator (and the
         # embed-slot mask) only while an embed request is RESIDENT, so
@@ -2540,35 +3069,49 @@ class LLMEngine:
             is_embed[embed_rows] = True
             pooled_arg = self._pooled
 
-        # in-flight write fence over this mixed dispatch's spans: one
-        # decode position per decode slot, the granted chunk span per
-        # ramping slot (see _fence_blocks)
+        # in-flight write fence over this mixed dispatch's spans: the
+        # decode token / verify window per decode slot, the granted
+        # chunk span per ramping slot (see _fence_blocks)
         fenced = []
         if self.cache_impl == "paged":
             for b in np.nonzero(active)[0]:
                 slot = self.slots[b]
                 lo = slot.prefill_pos + len(slot.generated)
-                hi = slot.sched_len() if is_dec[b] \
+                hi = slot.sched_len() + int(q_lens[b]) - 1 if is_dec[b] \
                     else slot.prefill_pos + int(q_lens[b]) - 1
                 self._fence_blocks(int(b), lo, min(hi, self.capacity - 1),
                                    fenced)
 
+        spec = self.speculative_k > 1
+        spec_args = dict(tokens_buf=self._tokens, spec_ks=spec_ks) \
+            if spec else {}
+        counts_dev = None
         t0 = time.perf_counter()
         if self.cache_impl == "paged":
             with self._kernel_tp_ctx():
-                (toks, was_active, self._logits, self._k, self._v,
-                 self._lens, self._rng_key, pooled_out) = self._fused_fn(
+                ret = self._fused_fn(
                     self._state_vals, self._k, self._v, self._logits,
                     self._lens, self._rng_key, ids, q_lens, is_dec,
                     active, temps, top_ps, rids, self._tables.copy(),
-                    lora=lora, is_embed=is_embed, pooled=pooled_arg)
+                    lora=lora, is_embed=is_embed, pooled=pooled_arg,
+                    **spec_args)
         else:
-            (toks, was_active, self._logits, self._k, self._v, self._lens,
-             self._rng_key, pooled_out) = self._fused_fn(
+            ret = self._fused_fn(
                 self._state_vals, self._k, self._v, self._logits,
                 self._lens, self._rng_key, ids, q_lens, is_dec, active,
                 temps, top_ps, rids,
-                lora=lora, is_embed=is_embed, pooled=pooled_arg)
+                lora=lora, is_embed=is_embed, pooled=pooled_arg,
+                **spec_args)
+        offered = None
+        if spec:
+            # spec layout: [1, B, Kw] window tokens + [1, B] counts —
+            # the readout flatten shared with the legacy verify scan
+            (toks, counts_dev, was_active, self._logits, self._k,
+             self._v, self._lens, self._rng_key, pooled_out,
+             self._tokens, offered) = ret
+        else:
+            (toks, was_active, self._logits, self._k, self._v,
+             self._lens, self._rng_key, pooled_out) = ret
         if pooled_out is not None:
             self._pooled = pooled_out
         dt = time.perf_counter() - t0
@@ -2579,10 +3122,13 @@ class LLMEngine:
         # next step — possibly dispatched before this one's readout —
         # schedules from the post-step state)
         embed_done = []
+        verify = {}
         for b in np.nonzero(active)[0]:
             slot = self.slots[b]
             if is_dec[b]:
-                slot.inflight += 1
+                slot.inflight += int(q_lens[b])
+                if spec:
+                    verify[int(b)] = int(spec_ks[b])
             else:
                 slot.prefill_pos += int(q_lens[b])
                 self.stats["prefill_chunks"] += 1
@@ -2599,22 +3145,26 @@ class LLMEngine:
                     # device work lands — step_finish reads + retires
                     embed_done.append((int(b), slot))
         self._inflight += 1
-        pending = PendingStep(toks, was_active, None, False,
+        pending = PendingStep(toks, was_active, counts_dev, spec,
                               list(self.slots), pool_done, sched=sched,
-                              fenced=fenced, embed_done=embed_done)
+                              fenced=fenced, embed_done=embed_done,
+                              verify=verify)
         pending.t_dispatch = t0
         pending.pooled = pooled_out
+        pending.offered = offered
         rec = self._rec()
         if rec is not None:
             grants = tuple(
                 (int(b), self.slots[b].req.request_id,
-                 "decode" if is_dec[b]
+                 ("verify" if spec else "decode") if is_dec[b]
                  else ("embed" if self.slots[b].req.kind == "embed"
                        else "prefill"), int(q_lens[b]))
                 for b in np.nonzero(active)[0] if self.slots[b] is not None)
             self._record_dispatch(pending, "mixed", grants,
                                   sum(g[3] for g in grants),
-                                  self.max_step_tokens, dt)
+                                  self.max_step_tokens, dt,
+                                  readout_stride=(self.speculative_k
+                                                  if spec else 1))
             for _, rid, gkind, n in grants:
                 if gkind in ("prefill", "embed"):
                     rec.req_event(rid, "prefill",
@@ -2651,6 +3201,10 @@ class LLMEngine:
             toks3 = np.asarray(pending.toks)          # [Kh, B, Kspec]
             counts_np = np.asarray(pending.counts)    # [Kh, B]
             wa_np = np.asarray(pending.was_active)    # [Kh, B]
+            # per-window OFFERED widths (fused paths; None on the
+            # legacy scan whose grant is never clamped in-graph)
+            offered_np = np.asarray(pending.offered) \
+                if pending.offered is not None else None
             Kh, B_, Ks = toks3.shape
             # flatten windows into the [rows, B] stream the readout walks;
             # a window row i is live for slot b iff i < counts (acceptance
@@ -2708,6 +3262,7 @@ class LLMEngine:
 
         t0 = time.perf_counter()
         done = list(pending.pool_done)
+        spec_acc_total = spec_rej_total = 0
         for b, slot in enumerate(pending.slots):
             if slot is None or self.slots[b] is not slot:
                 # empty at dispatch, or retired/preempted/cancelled (and
@@ -2757,13 +3312,41 @@ class LLMEngine:
                     break
             if spec and n_read > 0:
                 # drafts that actually landed in an output (row 0 of each
-                # window is the committed sample, not a draft)
+                # window is the committed sample, not a draft). Window
+                # width == speculative_k for the legacy scan AND the
+                # fused verify grants, so the flattened-row arithmetic
+                # is shared.
                 Ks = self.speculative_k
                 n_committed = sum(
                     1 for k in range(toks_np.shape[0])
                     if act_np[k, b] and k % Ks == 0)
-                self.stats["draft_tokens_accepted"] += max(
-                    n_read - n_committed, 0)
+                accepted = max(n_read - n_committed, 0)
+                self.stats["draft_tokens_accepted"] += accepted
+                # acceptance accounting: proposed = drafts the device
+                # actually OFFERED this slot — per-window offered widths
+                # read back from the fused programs (the in-graph
+                # row_caps/capacity clamp can shrink a window below its
+                # grant, and booking the full grant would bias the
+                # EWMA/acceptance rate low exactly under pool pressure);
+                # the legacy scan never clamps, so its grant IS exact
+                if offered_np is not None:
+                    proposed = int(np.maximum(
+                        offered_np[:, b] - 1, 0)[wa_np[:, b]].sum())
+                else:
+                    kd = pending.verify.get(b, Ks - 1) if pending.verify \
+                        else Ks - 1
+                    proposed = int(wa_np[:, b].sum()) * kd
+                self.stats["spec_proposed_tokens"] += proposed
+                self.stats["spec_accepted_tokens"] += accepted
+                spec_acc_total += accepted
+                spec_rej_total += max(proposed - accepted, 0)
+                if self.slots[b] is slot:
+                    # the re-entrant-cancel guard: a stream callback may
+                    # have cancelled this request mid-readout — its
+                    # _finish_tokens already dropped the persisted EWMA
+                    # entry, and updating it here would resurrect a dead
+                    # rid's state (leak + stale seed on rid reuse)
+                    self._update_spec_ewma(slot, proposed, accepted)
             if self.slots[b] is not slot:
                 continue  # cancelled mid-window; don't record a finish
             if self.prefix_cache and n_read > 0:
@@ -2781,6 +3364,27 @@ class LLMEngine:
                 done.append(out)
                 # slot (and its KV blocks) freed; next step admits into it
                 self._free_slot(b)
+        # BLOCK-TABLE ROLLBACK (paged verify grants): blocks granted for
+        # drafts the device rejected are orphaned — release them with NO
+        # copy. Blocks still fenced by a younger in-flight dispatch
+        # (depth 2: it may carry an in-flight writer) route through the
+        # quarantine machinery instead of the free heap, so they are
+        # never handed to a new owner early. The keep line is the slot's
+        # sched_len — still counting YOUNGER dispatches' scheduled
+        # growth, so nothing any in-flight writer may touch is released.
+        if self.cache_impl == "paged" and pending.verify:
+            bs = self.block_size
+            for b in pending.verify:
+                slot = pending.slots[b]
+                if slot is None or self.slots[b] is not slot:
+                    continue  # retired/preempted; blocks already freed
+                keep = slot.sched_len() // bs + 1
+                blocks = self._slot_blocks[b]
+                while len(blocks) > keep:
+                    phys = blocks.pop()
+                    self._tables[b, len(blocks)] = -1
+                    self._release_block(phys)
+            self._check_pool_invariants()
         # prefill-only (embed) completions: this dispatch carried each
         # one's FINAL chunk, so ITS pooled output (pending.pooled — not
         # the engine's newest buffer, which belongs to younger in-flight
@@ -2802,7 +3406,9 @@ class LLMEngine:
         self.stats["emit_time_s"] += d_emit
         if rec is not None and sid is not None:
             rec.finish_step(sid, dt, d_emit,
-                            tuple(out.request_id for out in done))
+                            tuple(out.request_id for out in done),
+                            spec_accepted=spec_acc_total,
+                            spec_rejected=spec_rej_total)
         return done
 
     def generate(self, prompts, **sampling):
@@ -2868,53 +3474,13 @@ def _write_window(tokens_buf, window, lens):
                              lens.astype(jnp.int32))
 
 
-def _processed_probs(logits, temps, top_ps, top_k):
-    """The temperature/top-k/top-p filtered distribution the engine samples
-    from, as probabilities — delegates to the ONE shared filter pipeline
-    (models.llama._filter_logits) so the rejection-sampling acceptance can
-    never drift from the sampler."""
-    from ..models.llama import _filter_logits
-    filtered = _filter_logits(
-        logits, jnp.maximum(temps, 1e-6)[:, None, None],
-        top_k, top_ps[:, None, None])
-    return jax.nn.softmax(filtered, axis=-1)
-
-
-def _spec_accept(logits_all, draft, temps, top_ps, top_k, active, key):
-    """Acceptance rule for one verify window. ``logits_all`` [B, K, V] are
-    the model's logits over the window; ``draft`` [B, K-1] the proposals.
-
-    Greedy rows (temp<=0): draft i survives iff it equals the model's
-    argmax prediction and every earlier draft did — output is token-exact
-    vs step-by-step decode.
-
-    Sampled rows: REJECTION SAMPLING against the processed target
-    distribution p: the prompt-lookup proposal is a delta at the drafted
-    token, so draft d is accepted with probability min(1, p(d)); on the
-    first rejection, the returned next-step logits mask d out, so the next
-    committed sample comes from the residual norm((p - delta_d)+). For
-    pure temperature sampling this makes the output distribution EXACTLY p
-    per position; with top-k/top-p the next step re-filters the masked
-    logits, which can shift the nucleus boundary by one token (documented
-    approximation).
-
-    Returns (n_acc [B], next_logits [B, V])."""
-    B, K, V = logits_all.shape
-    probs = _processed_probs(logits_all[:, :-1], temps, top_ps, top_k)
-    p_draft = jnp.take_along_axis(probs, draft[..., None],
-                                  axis=-1)[..., 0]          # [B, K-1]
-    u = jax.random.uniform(key, draft.shape)
-    greedy_next = jnp.argmax(logits_all[:, :-1], axis=-1).astype(jnp.int32)
-    is_greedy = (temps <= 0.0)[:, None]
-    acc = jnp.where(is_greedy, greedy_next == draft, u < p_draft)
-    acc = acc & active[:, None]
-    accum = jnp.cumprod(acc.astype(jnp.int32), axis=1)
-    n_acc = accum.sum(axis=1).astype(jnp.int32)
-    next_logits = jnp.take_along_axis(
-        logits_all, n_acc[:, None, None], axis=1)[:, 0]
-    rejected = (temps > 0.0) & (n_acc < K - 1) & active
-    rej_tok = jnp.take_along_axis(
-        draft, jnp.clip(n_acc, 0, K - 2)[:, None], axis=1)[:, 0]
-    hit = jax.nn.one_hot(rej_tok, V, dtype=bool)
-    next_logits = jnp.where(rejected[:, None] & hit, -1e30, next_logits)
-    return n_acc, next_logits
+# NOTE: the old module-level `_spec_accept` (rejection sampling against
+# the processed distribution, with residual masking carried across
+# windows) was REPLACED by the in-_programs `verify_window` coupled
+# rule: a draft is accepted iff it equals the token the engine would
+# sample at that position under its per-(rid, position) fold_in key.
+# Acceptance probability for a delta proposal is identical (p(draft)),
+# but the committed stream is now TOKEN-IDENTICAL to the non-spec
+# engine's in sampled mode too — no residual state to lose across a
+# window boundary, a preemption, or a supervised restart — and the
+# top-k/top-p "nucleus may shift by one token" approximation is gone.

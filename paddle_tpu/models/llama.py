@@ -233,11 +233,11 @@ def _window_causal_mask(s, T):
 def _filter_logits(logits, temp_val, top_k, top_p_val, use_top_p=True):
     """THE temperature/top-k/top-p filter pipeline (temperature scale, then
     top-k cut, then the nucleus mass cut on the renormalized distribution).
-    Single source shared by the sampler below AND the serving engine's
-    rejection-sampling acceptance (inference/llm_engine.py
-    ``_processed_probs``) — speculative exactness depends on the acceptance
-    testing drafts against exactly the distribution samples are drawn
-    from."""
+    Single source consumed by the sampler below — which the serving
+    engine's COUPLED speculative acceptance (inference/llm_engine.py
+    ``verify_window``) also samples through, so speculative exactness
+    rides on drafts being tested against exactly the distribution
+    tokens are drawn from."""
     logits = logits.astype(jnp.float32) / temp_val.astype(jnp.float32)
     V = logits.shape[-1]
     if top_k and 0 < int(top_k) < V:

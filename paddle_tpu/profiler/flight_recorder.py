@@ -59,9 +59,15 @@ __all__ = ["StepRecord", "FlightRecorder", "TAIL_CAUSES"]
 #: gap's causal step swapped an adapter into the device cache (host
 #: upload riding the admission path) — a multi-tenant working set
 #: larger than the adapter cache, not a scheduling pathology.
+#: "draft_rejected" names a speculative stall: the gap's causal step
+#: carried verify grants whose drafts mostly ROLLED BACK (rejected >
+#: accepted at readout), so the wall went to verifying tokens that
+#: never committed — an acceptance problem (workload/draft mismatch;
+#: the adaptive-k EWMA should be shrinking the window), not the
+#: host-sync or batched-readout pathology it would otherwise file as.
 TAIL_CAUSES = ("restart_recovery", "preemption", "adapter_swap",
-               "interfering_prefill", "batched_readout", "host_sync",
-               "idle_bubble", "dispatch", "unrecorded")
+               "interfering_prefill", "draft_rejected", "batched_readout",
+               "host_sync", "idle_bubble", "dispatch", "unrecorded")
 
 
 @dataclasses.dataclass
@@ -106,6 +112,12 @@ class StepRecord:
     #: adapter device-cache swap-ins that rode this step's admission
     #: (host factor upload) — the explain_tail "adapter_swap" signal
     adapter_swaps: int = 0
+    #: speculative verify accounting, completed at readout: drafts this
+    #: step committed vs drafts it rolled back (0/0 on non-spec steps).
+    #: The per-slot verify grants themselves ride ``grants`` with kind
+    #: "verify" and report their window rows through readout_stride.
+    spec_accepted: int = 0
+    spec_rejected: int = 0
 
     @property
     def budget_utilization(self):
@@ -126,7 +138,10 @@ class StepRecord:
 
     @property
     def decode_slots(self):
-        return sum(1 for _, _, kind, _ in self.grants if kind == "decode")
+        # "verify" grants are decode-side work (a speculative slot's
+        # committed token + drafts ride one grant)
+        return sum(1 for _, _, kind, _ in self.grants
+                   if kind in ("decode", "verify"))
 
     @property
     def wall_s(self):
@@ -231,7 +246,8 @@ class FlightRecorder:
                 adapter_swaps=int(adapter_swaps))
             return sid
 
-    def finish_step(self, step_id, sync_s, emit_s, finished=()):
+    def finish_step(self, step_id, sync_s, emit_s, finished=(),
+                    spec_accepted=0, spec_rejected=0):
         with self._lock:
             rec = self._ring[step_id % self.capacity]
             if rec is None or rec.step_id != step_id:
@@ -240,6 +256,8 @@ class FlightRecorder:
             rec.sync_s = sync_s
             rec.emit_s = emit_s
             rec.finished = tuple(finished)
+            rec.spec_accepted = int(spec_accepted)
+            rec.spec_rejected = int(spec_rejected)
 
     def get_step(self, step_id):
         with self._lock:
@@ -439,6 +457,10 @@ class FlightRecorder:
           chunk grant rode the same fused dispatch (Sarathi's per-step
           interference), or a legacy admission prefill train ran inside
           the step's ``admit_s`` split;
+        * ``draft_rejected`` — the step's speculative verify windows
+          rolled back more drafts than they committed: an acceptance
+          stall (the adaptive-k EWMA should be shrinking the window),
+          not a host-sync pathology;
         * ``batched_readout`` — the sync dominated but the step drained
           a multi-row token burst (``readout_stride > 1``: a multi-step
           stride, a legacy horizon scan, or spec verify windows): the
@@ -543,7 +565,21 @@ class FlightRecorder:
         if rec.prefill_tokens > 0 or (wall > 0 and
                                       rec.admit_s >= 0.5 * wall):
             return "interfering_prefill"
+        # rejection-stall refinement: only where the STEP ITSELF explains
+        # the gap (sync- or dispatch-dominated below — never an idle
+        # bubble, whose wall lies outside the step) AND a strict
+        # majority of the step's verify work rolled back does the
+        # rejected speculation own the verdict. Healthy-acceptance spec
+        # steps keep the host_sync/batched_readout taxonomy.
+        rejection_stall = getattr(rec, "spec_rejected", 0) > \
+            getattr(rec, "spec_accepted", 0)
         if wall > 0 and rec.sync_s >= 0.5 * wall:
+            if rejection_stall:
+                # the sync drained windows that mostly rolled back: the
+                # wall went to verifying tokens that never committed —
+                # an acceptance stall, NOT the host-sync pathology the
+                # share heuristic would otherwise file it as
+                return "draft_rejected"
             # a sync-dominated step whose readout drained a k-row burst
             # (stride, horizon scan, or spec verify windows) is the
             # BATCHED readout boundary, not a host-sync pathology — one
@@ -554,6 +590,10 @@ class FlightRecorder:
             return "host_sync"
         if gap - wall > max(wall, 1e-9):
             return "idle_bubble"
+        if rejection_stall:
+            # dispatch-dominated verify step, majority rolled back: the
+            # device compute was spent on rejected drafts
+            return "draft_rejected"
         return "dispatch"
 
     def snapshot(self, tail=None):

@@ -58,7 +58,10 @@ GAUGES = ("queue_depth", "engine_waiting", "running_slots",
           "pipeline_inflight", "kv_pool_free_blocks", "kv_pool_occupancy",
           "token_budget_utilization", "prefix_cached_blocks",
           "prefix_cache_hit_rate", "server_healthy",
-          "adapter_cache_occupancy")
+          "adapter_cache_occupancy",
+          # speculative serving: cumulative accepted/proposed draft
+          # ratio (stays 0 on non-speculative engines)
+          "spec_acceptance_rate")
 
 _COUNTERS = ("requests_submitted", "requests_admitted", "requests_finished",
              "requests_cancelled", "requests_expired",
@@ -69,7 +72,8 @@ _COUNTERS = ("requests_submitted", "requests_admitted", "requests_finished",
              "prefix_hit_tokens", "prefix_cow_blocks",
              "prefix_evicted_blocks",
              "adapter_cache_hits", "adapter_cache_misses", "adapter_swaps",
-             "embed_requests")
+             "embed_requests",
+             "spec_proposed_tokens", "spec_accepted_tokens")
 
 
 def _default_bounds():

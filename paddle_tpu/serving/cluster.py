@@ -725,6 +725,19 @@ class ReplicaRouter:
             with inner._cond:
                 inner._tokens.clear()
                 handle._resume_tokens = list(handle._streamed)
+        if resubmit:
+            # carry the dead replica's learned draft-acceptance EWMA to
+            # the survivor (speculative engines): the resumed stream's
+            # verify-k grants start at the adapted window, like the
+            # readout_stride pin rides _kwargs. Host-dict read off the
+            # dead server's engine — safe from this thread, best-effort.
+            try:
+                ewma = inner._server.engine.spec_ewma_for(
+                    inner.request_id)
+            except Exception:
+                ewma = None
+            if ewma is not None:
+                handle._kwargs["spec_ewma"] = ewma
         # resubmit to a survivor (placement excludes the dead/hung/
         # draining replica via healthy()/draining checks)
         handle._last_try = now

@@ -328,7 +328,7 @@ class AsyncLLMServer:
                top_p=1.0, eos_token_id=None, deadline_s=None, block=True,
                timeout=None, routing=None, resume_tokens=None,
                readout_stride=None, adapter_id=0,
-               kind="generate") -> RequestHandle:
+               kind="generate", spec_ewma=None) -> RequestHandle:
         """Submit one generation request; returns its streaming
         :class:`RequestHandle`.
 
@@ -364,7 +364,13 @@ class AsyncLLMServer:
         ``adapter_id``: the request's TENANT (batched multi-LoRA) — a
         registered id in the engine's adapter store, 0 = base model.
         ``kind="embed"`` marks the request prefill-only (use
-        :meth:`submit_embed`)."""
+        :meth:`submit_embed`).
+
+        ``spec_ewma``: carried draft-acceptance EWMA for a speculative
+        engine's acceptance-adaptive verify-k (the router forwards the
+        dead replica's learned value on failover — see
+        ``LLMEngine.spec_ewma_for``). None lets the engine learn from
+        scratch; inert on non-speculative engines."""
         if self._crashed is not None:
             raise ServerClosed(
                 f"serving loop crashed: {self._crashed}") from self._crashed
@@ -435,7 +441,9 @@ class AsyncLLMServer:
             resume_tokens=resume,
             readout_stride=(int(readout_stride)
                             if readout_stride is not None else None),
-            adapter_id=adapter_id, kind=kind)
+            adapter_id=adapter_id, kind=kind,
+            spec_ewma=(float(spec_ewma) if spec_ewma is not None
+                       else None))
         handle = RequestHandle(self, req)
         if kind == "embed":
             self.telemetry.inc("embed_requests")
@@ -689,7 +697,8 @@ class AsyncLLMServer:
                 eos_token_id=eos, request_id=req.request_id,
                 committed_tokens=committed or None,
                 readout_stride=req.readout_stride,
-                adapter_id=req.adapter_id, kind=req.kind)
+                adapter_id=req.adapter_id, kind=req.kind,
+                spec_ewma=req.spec_ewma)
         except ValueError as e:
             # the rejection must be visible in telemetry, not just on
             # the handle — a silent validation drop looks like a lost
@@ -788,6 +797,10 @@ class AsyncLLMServer:
         eng, tel = self.engine, self.telemetry
         s_sync = eng.stats["host_sync_time_s"]
         s_emit = eng.stats["emit_time_s"]
+        # speculative acceptance accounting lands at READOUT (this is
+        # where the engine learns which drafts committed)
+        s_spec = {k: eng.stats[k] for k in ("spec_proposed_tokens",
+                                            "spec_accepted_tokens")}
         t0 = time.perf_counter()
         done = eng.step_finish(pending)
         wall = time.perf_counter() - t0
@@ -797,6 +810,9 @@ class AsyncLLMServer:
         tel.add_stage("emit", d_emit)
         tel.add_stage("other", max(wall - d_sync - d_emit, 0.0))
         tel.inc("engine_steps")
+        for key, before in s_spec.items():
+            if eng.stats[key] > before:
+                tel.inc(key, eng.stats[key] - before)
         return done
 
     def _admission_estimate_s(self):
@@ -858,6 +874,10 @@ class AsyncLLMServer:
         cache = getattr(eng, "adapter_cache", None)
         if cache is not None:
             tel.set_gauge("adapter_cache_occupancy", cache.occupancy())
+        prop = eng.stats.get("spec_proposed_tokens", 0)
+        if prop:
+            tel.set_gauge("spec_acceptance_rate",
+                          eng.stats["spec_accepted_tokens"] / prop)
         rec = self.flight_recorder
         if rec is not None and rec.enabled:
             last = rec.last_record()

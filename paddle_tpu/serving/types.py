@@ -76,6 +76,11 @@ class ServeRequest:
     #: "generate" (token stream) or "embed" (prefill-only: the result
     #: carries the mean-pooled final hidden state, no tokens)
     kind: str = "generate"
+    #: carried speculative acceptance EWMA (router failover): seeds the
+    #: engine's acceptance-adaptive verify-k for this request so a
+    #: low-acceptance stream resumed on a survivor replica does not
+    #: restart at full-window speculation. None = let the engine learn.
+    spec_ewma: float | None = None
 
 
 @dataclasses.dataclass

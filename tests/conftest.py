@@ -34,6 +34,16 @@ import pytest  # noqa: E402
 #: heavies to `slow` BEFORE the next PR trips the hard timeout.
 _TIER1_WARN_S = 800.0
 
+#: (duration_s, nodeid) of every test-call phase this session — so the
+#: wall-time warning can name the top offenders without a --durations
+#: re-run (triage should cost one look, not another 800s session)
+_TEST_DURATIONS = []
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call" and report.duration:
+        _TEST_DURATIONS.append((report.duration, report.nodeid))
+
 
 def pytest_configure(config):
     import time as _time
@@ -105,10 +115,12 @@ def pytest_sessionfinish(session, exitstatus):
         if elapsed > _TIER1_WARN_S:
             print(f"\n[paddle_tpu] WARNING: test session took "
                   f"{elapsed:.0f}s, past the ~{_TIER1_WARN_S:.0f}s tier-1 "
-                  f"headroom bar (hard driver timeout: 870s). Run "
-                  f"--durations=25 and demote the worst non-load-bearing "
-                  f"heavies to `slow` before the next PR trips the "
-                  f"timeout.")
+                  f"headroom bar (hard driver timeout: 870s). Demote the "
+                  f"worst non-load-bearing heavies to `slow` before the "
+                  f"next PR trips the timeout. Top 5 slowest this "
+                  f"session:")
+            for dur, nodeid in sorted(_TEST_DURATIONS, reverse=True)[:5]:
+                print(f"[paddle_tpu]   {dur:7.1f}s  {nodeid}")
     try:
         from paddle_tpu.core.tensor import dispatch_cache_stats
         from paddle_tpu.jit.prefix_capture import capture_stats

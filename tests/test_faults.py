@@ -58,6 +58,12 @@ ENGINE_CONFIGS = {
     "paged": dict(cache_impl="paged", block_size=8, scheduler="fused"),
     "paged_prefix": dict(cache_impl="paged", block_size=8,
                          scheduler="fused", enable_prefix_cache=True),
+    # fused speculative serving (PR 10): a crash can land mid-verify-
+    # window — recovery must still be token-exact, the rid-keyed
+    # acceptance-EWMA mirror survives reset(), and the paged rollback/
+    # fence machinery must leave the pool invariant-clean
+    "fused_spec": dict(cache_impl="paged", block_size=8,
+                       scheduler="fused", speculative_k=3),
 }
 
 
@@ -179,8 +185,14 @@ def test_crash_recovery_token_exact(engines, config):
 def test_crash_recovery_sampled_exact(engines):
     """SAMPLED (temperature > 0) streams also resume token-exactly:
     token p of request r samples from fold_in(fold_in(base, r), p), so a
-    restart replays the identical per-position keys. Same engine (same
-    lazily-derived base key), fresh server per run (rids restart at 0)."""
+    restart replays the identical per-position keys. Since PR 10 that
+    includes SPECULATIVE engines — the coupled acceptance rule has no
+    per-window key advance, so a crash mid-verify-window resumes
+    sampled-exact too (PR 7 documented spec as greedy-exact only; the
+    speculative sampled variant lives in tests/test_fused_spec.py's
+    chaos test, the greedy one in this file's matrix via the
+    fused_spec config). Same engine (same lazily-derived base key),
+    fresh server per run (rids restart at 0)."""
     eng = _fresh(engines["dense"])
     prompts = _prompts(5, (9, 5))
 

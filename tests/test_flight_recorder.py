@@ -91,8 +91,10 @@ def test_serve_records_join_and_trace(tiny_model, tmp_path, scheduler,
             assert r.free_blocks is None and r.total_blocks is None
         for slot, rid, gkind, n in r.grants:
             assert 0 <= slot < eng.B
-            assert gkind in ("prefill", "decode") and n >= 1
+            assert gkind in ("prefill", "decode", "verify", "embed") \
+                and n >= 1
         assert r.tokens_scheduled == sum(g[3] for g in r.grants)
+        assert r.spec_accepted >= 0 and r.spec_rejected >= 0
     if scheduler == "fused":
         assert any(r.kind == "mixed" and r.prefill_tokens > 0
                    for r in recs), "fused ramp-in never recorded a mixed step"
@@ -337,7 +339,8 @@ def test_step_record_to_dict_schema():
                 "token_budget", "queue_depth", "free_blocks", "total_blocks",
                 "pipeline_inflight", "preemptions", "admit_s", "schedule_s",
                 "dispatch_s", "sync_s", "emit_s", "finished",
-                "budget_utilization", "prefill_tokens", "readout_stride"):
+                "budget_utilization", "prefill_tokens", "readout_stride",
+                "spec_accepted", "spec_rejected"):
         assert key in d, key
     assert d["readout_stride"] == 1      # the classic one-token step
     assert d["budget_utilization"] == round(17 / 32, 4)

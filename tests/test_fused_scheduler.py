@@ -321,10 +321,39 @@ class TestFusedServing:
         assert len(fused._free_blocks) == fused.n_blocks
 
 
-def test_fused_rejects_speculative(tiny_model):
-    with pytest.raises(ValueError, match="fused"):
+def test_speculative_contract(tiny_model):
+    """The PR-10 speculation contract: the fused scheduler SERVES
+    speculative_k > 1 (verify grants, any cache backend); the precise
+    remaining limitations raise precise errors."""
+    # fused + spec constructs and serves — dense and paged
+    eng = LLMEngine(tiny_model, max_batch=1, max_seq_len=64, chunk_size=16,
+                    scheduler="fused", speculative_k=4)
+    assert eng._tokens is not None and eng.max_pipeline_depth() == 2
+    LLMEngine(tiny_model, max_batch=1, max_seq_len=64, chunk_size=16,
+              scheduler="fused", speculative_k=4, cache_impl="paged",
+              block_size=8)
+    # legacy paged speculation stays out (dense-only scan)
+    with pytest.raises(ValueError, match="dense"):
         LLMEngine(tiny_model, max_batch=1, max_seq_len=64, chunk_size=16,
-                  scheduler="fused", speculative_k=4)
+                  cache_impl="paged", block_size=8, speculative_k=4)
+    # a verify window must fit the mixed step's ids buffer
+    with pytest.raises(ValueError, match="chunk"):
+        LLMEngine(tiny_model, max_batch=1, max_seq_len=64, chunk_size=4,
+                  scheduler="fused", speculative_k=6)
+    # legacy + adapters stays out (the fused path carries LoRA)
+    from paddle_tpu.serving.adapters import AdapterStore
+    with pytest.raises(ValueError, match="adapter"):
+        LLMEngine(tiny_model, max_batch=1, max_seq_len=64, chunk_size=16,
+                  speculative_k=4,
+                  adapter_store=AdapterStore(tiny_model.config))
+
+
+def test_speculative_rejected_under_tp(tiny_model, tp_mesh):
+    """TP mesh is the documented remaining speculation limitation — a
+    precise error, not a silent wrong-result path."""
+    with pytest.raises(ValueError, match="tensor-parallel"):
+        LLMEngine(tiny_model, max_batch=1, max_seq_len=64, chunk_size=16,
+                  scheduler="fused", speculative_k=4, mesh=tp_mesh)
 
 
 def test_unknown_scheduler_rejected(tiny_model):

@@ -307,65 +307,35 @@ def test_lookup_draft_device():
     np.testing.assert_array_equal(draft[1], [4, 4, 4])  # repeat-last
 
 
-def test_spec_accept_rejection_sampling_is_exact():
-    """Distribution-exactness of the rejection-sampling acceptance, by
-    ENUMERATION: for a delta proposal q=d against processed target p,
-    P(next committed token = t) must equal p(t) exactly —
-    p(d) for the accepted path plus (1-p(d)) * residual(t) for the
-    rejected path."""
-    import jax
-    import jax.numpy as jnp
-    from paddle_tpu.inference.llm_engine import (_processed_probs,
-                                                 _spec_accept)
-
-    rng = np.random.default_rng(0)
-    V = 7
-    logits = rng.standard_normal((1, 2, V)).astype(np.float32)
-    temps = np.asarray([0.7], np.float32)
-    top_ps = np.asarray([1.0], np.float32)
-    p = np.asarray(_processed_probs(
-        jnp.asarray(logits[:, :1]), jnp.asarray(temps),
-        jnp.asarray(top_ps), 0))[0, 0]          # target at the draft pos
-    d = 3
-    draft = jnp.asarray([[d]], jnp.int32)
-    active = jnp.asarray([True])
-
-    # acceptance probability: fraction of u-grid accepted must be p(d)
-    n_acc_sum = 0
-    n_trials = 400
-    residual_counts = np.zeros(V)
-    for i in range(n_trials):
-        key = jax.random.PRNGKey(i)
-        n_acc, next_logits = _spec_accept(
-            jnp.asarray(logits), draft, jnp.asarray(temps),
-            jnp.asarray(top_ps), 0, active, key)
-        if int(n_acc[0]) == 1:
-            n_acc_sum += 1
-        else:
-            # rejected: next_logits must mask the draft token out -> the
-            # residual distribution norm(p with d zeroed)
-            nl = np.asarray(next_logits[0])
-            assert nl[d] <= -1e29
-            res = np.asarray(_processed_probs(
-                jnp.asarray(nl[None, None]), jnp.asarray(temps),
-                jnp.asarray(top_ps), 0))[0, 0]
-            residual_counts += res
-    acc_rate = n_acc_sum / n_trials
-    assert abs(acc_rate - float(p[d])) < 4 * np.sqrt(
-        float(p[d]) * (1 - float(p[d])) / n_trials) + 1e-3
-    if n_trials - n_acc_sum > 0:
-        res_mean = residual_counts / (n_trials - n_acc_sum)
-        expect = p.copy()
-        expect[d] = 0.0
-        expect = expect / expect.sum()
-        np.testing.assert_allclose(res_mean, expect, atol=1e-5)
-    # total law: p(d)*1[t=d] + (1-p(d))*residual(t) == p(t)
-    expect = p.copy()
-    expect[d] = 0.0
-    expect = expect / expect.sum()
-    total = (1 - float(p[d])) * expect
-    total[d] += float(p[d])
-    np.testing.assert_allclose(total, p, atol=1e-6)
+def test_spec_coupled_acceptance_sampled_token_exact(tiny_model):
+    """The COUPLED acceptance rule (a draft survives iff it equals the
+    token the engine would sample at that position under its
+    per-(rid, position) fold_in key) makes a SAMPLED speculative stream
+    TOKEN-IDENTICAL to the plain sampled engine — strictly stronger
+    than the old rejection-sampling scheme's distribution-exactness
+    (which carried residual-mask state across windows and so was only
+    greedy-exact across restart/preemption). The output distribution
+    over base keys is therefore exactly the plain engine's too."""
+    import paddle_tpu as paddle
+    rng = np.random.default_rng(20)
+    base = rng.integers(1, 96, size=(6,)).astype(np.int32)
+    prompts = [np.tile(base, 3)[:15],
+               rng.integers(1, 96, size=(9,)).astype(np.int32)]
+    paddle.seed(321)
+    plain = LLMEngine(tiny_model, max_batch=2, max_seq_len=96,
+                      chunk_size=16)
+    want = [o.token_ids for o in plain.generate(
+        prompts, max_new_tokens=8, temperature=0.8, top_p=0.9)]
+    paddle.seed(321)
+    spec = LLMEngine(tiny_model, max_batch=2, max_seq_len=96,
+                     chunk_size=16, speculative_k=4)
+    got = [o.token_ids for o in spec.generate(
+        prompts, max_new_tokens=8, temperature=0.8, top_p=0.9)]
+    assert got == want
+    # acceptance accounting feeds the telemetry counters
+    assert spec.stats["spec_proposed_tokens"] > 0
+    assert spec.stats["spec_accepted_tokens"] == \
+        spec.stats["draft_tokens_accepted"]
 
 
 def test_engine_tp_sharded_matches_unsharded(tiny_model):

@@ -341,6 +341,16 @@ def test_telemetry_strict_names_and_register():
         tel.inc("prefix_hit_token")
     with pytest.raises(KeyError, match="unknown telemetry gauge"):
         tel.set_gauge("prefix_cache_hitrate", 0.5)
+    # the speculative-serving names are declared (not phantom-forked) ...
+    tel.inc("spec_proposed_tokens", 8)
+    tel.inc("spec_accepted_tokens", 5)
+    tel.set_gauge("spec_acceptance_rate", 5 / 8)
+    assert tel.snapshot()["counters"]["spec_proposed_tokens"] == 8
+    # ... and typo'd variants still raise instead of forking
+    with pytest.raises(KeyError, match="unknown telemetry counter"):
+        tel.inc("spec_proposed_token")
+    with pytest.raises(KeyError, match="unknown telemetry gauge"):
+        tel.set_gauge("spec_acceptence_rate", 0.5)
     # the fault-tolerance names are declared (not phantom-forked) ...
     tel.inc("requests_rejected_validation")
     tel.inc("requests_shed_deadline")
