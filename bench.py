@@ -1942,6 +1942,30 @@ def _bench_dispatch():
             "detail": result}
 
 
+def _emit_analysis_header():
+    """One JSON header line before the workload ladder: the static-
+    analysis state of the tree (paddle_tpu.analysis) so the trajectory
+    records the baseline burn-down next to the perf numbers.
+    ``analysis_findings`` = active (would-fail) findings — 0 on a clean
+    tree; ``analysis_baselined`` = grandfathered debt still to burn."""
+    try:
+        from paddle_tpu.analysis import count_findings
+        here = os.path.dirname(os.path.abspath(__file__))
+        active, baselined, suppressed = count_findings(
+            [os.path.join(here, "paddle_tpu")],
+            baseline_path=os.path.join(here, "analysis_baseline.json"))
+        print(json.dumps({
+            "metric": "analysis_findings", "value": active, "unit":
+            "findings", "vs_baseline": None,
+            "analysis_baselined": baselined,
+            "analysis_suppressed": suppressed}), flush=True)
+    except Exception as e:       # the bench ladder must not die on lint
+        print(json.dumps({"metric": "analysis_findings", "value": None,
+                          "unit": "findings", "vs_baseline": None,
+                          "error": f"{type(e).__name__}: {e}"}),
+              flush=True)
+
+
 def _run_all():
     """Default driver mode: one JSON line per BASELINE config (1-5) plus
     llama_decode, with the flagship llama LAST so single-line tail parsing
@@ -1950,6 +1974,7 @@ def _run_all():
     rest."""
     import subprocess
     import sys
+    _emit_analysis_header()
     # the int8/int4 rungs re-baseline the weight-only-quantized decode
     # ratios IN the ladder (same two-length-differential harness, same
     # subprocess isolation) — the 1.35x/1.67x numbers ROUND5_NOTES

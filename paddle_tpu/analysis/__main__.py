@@ -1,0 +1,73 @@
+"""CLI driver: ``python -m paddle_tpu.analysis [paths ...]``.
+
+Exit code 0 iff every finding is suppressed inline or grandfathered in
+the baseline — the contract ``tests/test_analysis_clean.py`` holds
+tier-1 to."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import load_baseline, run_analysis
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="paddle_tpu project-specific static checks "
+                    "(PTL001-PTL005)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to analyze "
+                         "(default: ./paddle_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--all", action="store_true",
+                    help="also print suppressed/baselined findings")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: ./analysis_baseline"
+                         ".json when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline (report the raw state)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline to grandfather every "
+                         "current unsuppressed finding, then exit 0")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or None
+    if not paths:
+        if os.path.isdir("paddle_tpu"):
+            paths = ["paddle_tpu"]
+        else:
+            ap.error("no paths given and ./paddle_tpu does not exist")
+
+    baseline = None
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists("analysis_baseline.json"):
+        baseline_path = "analysis_baseline.json"
+    if baseline_path and not args.no_baseline and not args.write_baseline:
+        baseline = load_baseline(baseline_path)
+
+    report = run_analysis(paths, baseline=baseline)
+
+    if args.write_baseline:
+        out = baseline_path or "analysis_baseline.json"
+        with open(out, "w") as fh:
+            json.dump(report.baseline_json(), fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {out}: "
+              f"{sum(report.baseline_json()['fingerprints'].values())} "
+              f"grandfathered findings")
+        return 0
+
+    if args.as_json:
+        json.dump(report.to_json(), sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        print(report.render(show_all=args.all))
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,398 @@
+"""Checker framework for ``paddle_tpu.analysis`` — the project-specific
+static-analysis layer (reference analog: the custom flake8/pylint plugin
+layer real frameworks ship around their core, PAPER.md layers 4-5).
+
+The framework is deliberately AST-only and import-free for the code it
+scans: it parses source text, never executes it, so it can run on a
+cold CPU box in well under the tier-1 budget and can analyze fixture
+snippets that would not even import.
+
+Pieces:
+
+* :class:`Finding` — one diagnosed violation, with a line-number-free
+  *fingerprint* (check | path | function | normalized snippet) so the
+  checked-in baseline survives unrelated edits that shift line numbers.
+* :class:`SourceModule` — parsed file + per-line suppression table.
+  ``# ptlint: disable=PTL001 -- reason`` on (or immediately above) a
+  line suppresses that check there; a suppression WITHOUT a reason
+  string is itself reported (PTL000) — the policy is that every
+  grandfathered sync/hazard names why it is deliberate.
+* :class:`Check` — base class. ``collect`` runs over every module first
+  (cross-module registries: telemetry names, lock edges), then ``run``
+  emits per-module findings, then ``finalize`` emits cross-module ones
+  (lock-order cycles).
+* baseline — ``analysis_baseline.json`` maps fingerprints to counts;
+  findings covered by the baseline are reported but do not fail the
+  run. ``--write-baseline`` regenerates it; stale entries (fingerprints
+  no longer produced) are listed so burn-down is visible.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+
+__all__ = ["Finding", "SourceModule", "Check", "Report", "run_analysis",
+           "load_baseline", "iter_py_files", "JSON_SCHEMA_VERSION"]
+
+#: bumped only when the JSON report layout changes incompatibly —
+#: tests/test_analysis.py pins it (schema stability is part of the
+#: contract: CI parses this output)
+JSON_SCHEMA_VERSION = 1
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ptlint:\s*disable=([A-Za-z0-9_,\s]+?)"
+    r"(?:\s*(?:--|—)\s*(?P<reason>\S.*?))?\s*$")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnosed violation of a project invariant."""
+    check: str            # "PTL001"
+    path: str             # fingerprint-stable relative path
+    line: int
+    col: int
+    func: str             # enclosing function, "<module>" at top level
+    message: str
+    key: str              # normalized offending snippet (stable)
+    suppressed: bool = False
+    suppress_reason: str = ""
+    baselined: bool = False
+
+    @property
+    def fingerprint(self):
+        return f"{self.check}|{self.path}|{self.func}|{self.key}"
+
+    @property
+    def new(self):
+        """True when nothing grandfathers this finding — these fail the
+        run."""
+        return not (self.suppressed or self.baselined)
+
+    def to_json(self):
+        return {"check": self.check, "path": self.path, "line": self.line,
+                "col": self.col, "func": self.func, "message": self.message,
+                "key": self.key, "fingerprint": self.fingerprint,
+                "suppressed": self.suppressed,
+                "suppress_reason": self.suppress_reason,
+                "baselined": self.baselined, "new": self.new}
+
+    def render(self):
+        tag = "suppressed" if self.suppressed else \
+            ("baselined" if self.baselined else "NEW")
+        return (f"{self.path}:{self.line}:{self.col}: {self.check} "
+                f"[{tag}] {self.message}")
+
+
+def _norm_key(text, limit=100):
+    """Whitespace-collapsed snippet, truncated — the fingerprint's
+    line-number-free identity component."""
+    key = " ".join(str(text).split())
+    return key[:limit]
+
+
+class SourceModule:
+    """One parsed source file plus its suppression table."""
+
+    def __init__(self, path, relpath, text):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        #: line -> {check_id or "all": reason-or-""}
+        self.suppressions = {}
+        #: lines whose suppression comment carries no reason (PTL000)
+        self.bare_suppressions = []
+        for i, line in self._suppression_comments():
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            checks = {c.strip().upper() for c in m.group(1).split(",")
+                      if c.strip()}
+            reason = (m.group("reason") or "").strip()
+            target = i
+            if line.lstrip().startswith("#"):
+                # comment-only line: the suppression governs the next
+                # CODE line (reasons may wrap onto continuation
+                # comments; intervening blank lines don't detach it)
+                target = i + 1
+                while target <= len(self.lines):
+                    nxt = self.lines[target - 1].strip()
+                    if nxt and not nxt.startswith("#"):
+                        break
+                    target += 1
+            entry = self.suppressions.setdefault(target, {})
+            for c in checks:
+                entry[c] = reason
+            if not reason:
+                self.bare_suppressions.append((i, sorted(checks)))
+
+    def _suppression_comments(self):
+        """``(line_no, source_line)`` for lines whose suppression marker
+        sits in an actual COMMENT token — 'ptlint: disable' text inside
+        a docstring or string literal documents the syntax, it neither
+        suppresses anything nor trips PTL000 (noqa-style linters use
+        the same tokenize discipline)."""
+        if "ptlint" not in self.text:       # fast path: no tokenizing
+            return
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT and "ptlint" in tok.string:
+                    yield tok.start[0], self.lines[tok.start[0] - 1]
+        except (tokenize.TokenError, IndentationError):
+            # untokenizable tail (shouldn't happen on ast-parseable
+            # source): fall back to the raw line scan
+            for i, line in enumerate(self.lines, start=1):
+                if "ptlint" in line:
+                    yield i, line
+
+    def suppression_for(self, check_id, line):
+        entry = self.suppressions.get(line)
+        if not entry:
+            return None
+        if check_id in entry:
+            return entry[check_id]
+        if "ALL" in entry:
+            return entry["ALL"]
+        return None
+
+    def segment(self, node):
+        seg = ast.get_source_segment(self.text, node)
+        if seg is None:
+            seg = f"<{type(node).__name__}>"
+        return _norm_key(seg)
+
+
+class Check:
+    """Base class for one analysis pass. Subclasses set ``id`` and
+    ``describe`` and override ``run`` (and optionally ``collect`` /
+    ``finalize`` for cross-module state)."""
+
+    id = "PTL???"
+    describe = ""
+
+    def collect(self, mod):       # pragma: no cover - default no-op
+        pass
+
+    def run(self, mod):
+        return ()
+
+    def finalize(self):
+        return ()
+
+    def finding(self, mod, node, message, key=None, func="<module>"):
+        return Finding(self.id, mod.relpath, getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0), func, message,
+                       key if key is not None else mod.segment(node))
+
+
+class _SuppressionPolicy(Check):
+    """PTL000 — a ``ptlint: disable`` comment with no reason string.
+
+    Suppressions are the grandfathering mechanism for deliberate
+    violations; one without a reason hides a finding while recording
+    nothing, so the policy check makes the missing reason itself a
+    finding (suppressible only via the baseline, on purpose)."""
+
+    id = "PTL000"
+    describe = "suppression comments must carry a reason string"
+
+    def run(self, mod):
+        for line, checks in mod.bare_suppressions:
+            yield Finding(
+                self.id, mod.relpath, line, 0, "<module>",
+                f"suppression of {','.join(checks)} carries no reason "
+                f"string (append `-- why this site is deliberate`)",
+                key=f"bare-suppression:{','.join(checks)}")
+
+
+def _package_base(dirpath):
+    """Nearest ancestor of ``dirpath`` that is NOT itself a package
+    (no ``__init__.py``) — relpaths are PACKAGE-ROOTED, so linting
+    ``paddle_tpu/inference/llm_engine.py`` alone yields the same
+    ``paddle_tpu/inference/llm_engine.py`` fingerprint (and allowlist
+    suffix) as the whole-tree scan."""
+    base = dirpath
+    while os.path.isfile(os.path.join(base, "__init__.py")):
+        parent = os.path.dirname(base)
+        if parent == base:
+            break
+        base = parent
+    return base
+
+
+def iter_py_files(paths):
+    """Yield ``(abs_path, relpath)`` for every ``.py`` under ``paths``.
+
+    ``relpath`` is computed against the argument's package root (the
+    nearest non-package ancestor — see :func:`_package_base`), so
+    ``python -m paddle_tpu.analysis paddle_tpu/``, a subdirectory run
+    and a single-file run all yield identical ``paddle_tpu/...``
+    fingerprints no matter the working directory."""
+    seen = set()
+    for arg in paths:
+        root = os.path.abspath(arg)
+        if os.path.isfile(root):
+            base = _package_base(os.path.dirname(root))
+            files = [root]
+        else:
+            root = root.rstrip(os.sep) or root
+            base = _package_base(root) if os.path.isfile(
+                os.path.join(root, "__init__.py")) \
+                else (os.path.dirname(root) or root)
+            files = []
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith("."))
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        for f in files:
+            if f in seen:
+                continue
+            seen.add(f)
+            yield f, os.path.relpath(f, base).replace(os.sep, "/")
+
+
+def load_baseline(path):
+    with open(path) as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "fingerprints" not in data:
+        raise ValueError(f"{path}: not an analysis baseline "
+                         f"(missing 'fingerprints')")
+    return dict(data["fingerprints"])
+
+
+def default_checks():
+    from .donation import DonationCheck
+    from .host_sync import HostSyncCheck
+    from .locks import LockDisciplineCheck
+    from .retrace import RetraceCheck
+    from .telemetry_names import TelemetryNameCheck
+    return [_SuppressionPolicy(), HostSyncCheck(), RetraceCheck(),
+            DonationCheck(), LockDisciplineCheck(), TelemetryNameCheck()]
+
+
+class Report:
+    """The outcome of one analysis run."""
+
+    def __init__(self, findings, checks, lock_graph=None,
+                 stale_baseline=None, parse_errors=None):
+        self.findings = findings
+        self.checks = checks
+        self.lock_graph = lock_graph or {}
+        self.stale_baseline = stale_baseline or {}
+        self.parse_errors = parse_errors or []
+
+    @property
+    def new_findings(self):
+        return [f for f in self.findings if f.new]
+
+    @property
+    def exit_code(self):
+        return 1 if (self.new_findings or self.parse_errors) else 0
+
+    def summary(self):
+        n = self.findings
+        return {"total": len(n),
+                "new": sum(1 for f in n if f.new),
+                "suppressed": sum(1 for f in n if f.suppressed),
+                "baselined": sum(1 for f in n if f.baselined),
+                "stale_baseline": sum(self.stale_baseline.values()),
+                "parse_errors": len(self.parse_errors)}
+
+    def to_json(self):
+        return {"version": JSON_SCHEMA_VERSION,
+                "checks": [{"id": c.id, "describe": c.describe}
+                           for c in self.checks],
+                "summary": self.summary(),
+                "findings": [f.to_json() for f in self.findings],
+                "stale_baseline": dict(self.stale_baseline),
+                "lock_order_graph": self.lock_graph,
+                "parse_errors": list(self.parse_errors)}
+
+    def render(self, show_all=False):
+        lines = []
+        for f in self.findings:
+            if show_all or f.new:
+                lines.append(f.render())
+        for path, err in self.parse_errors:
+            lines.append(f"{path}:0:0: PARSE-ERROR {err}")
+        s = self.summary()
+        lines.append(
+            f"ptlint: {s['total']} findings "
+            f"(new {s['new']}, suppressed {s['suppressed']}, "
+            f"baselined {s['baselined']}, "
+            f"stale-baseline {s['stale_baseline']})")
+        return "\n".join(lines)
+
+    def baseline_json(self):
+        counts = {}
+        for f in self.findings:
+            if not f.suppressed:
+                counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+        return {"version": JSON_SCHEMA_VERSION,
+                "comment": "grandfathered paddle_tpu.analysis findings — "
+                           "burn this file down, never grow it",
+                "fingerprints": dict(sorted(counts.items()))}
+
+
+def run_analysis(paths, checks=None, baseline=None):
+    """Run every check over every ``.py`` file under ``paths``.
+
+    ``baseline``: dict fingerprint->count (see :func:`load_baseline`) or
+    None. Returns a :class:`Report`; ``report.exit_code`` is non-zero
+    iff any finding is neither suppressed nor baselined."""
+    if checks is None:
+        checks = default_checks()
+    mods, parse_errors = [], []
+    for path, rel in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+            mods.append(SourceModule(path, rel, text))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            parse_errors.append((rel, f"{type(e).__name__}: {e}"))
+    for check in checks:
+        for mod in mods:
+            check.collect(mod)
+    findings = []
+    for mod in mods:
+        for check in checks:
+            for f in check.run(mod) or ():
+                # PTL000 is deliberately NOT inline-suppressible: a
+                # bare suppression listing PTL000 itself must not hide
+                # the missing-reason finding (baseline-only escape)
+                reason = None if f.check == "PTL000" else \
+                    mod.suppression_for(f.check, f.line)
+                if reason is not None:
+                    f.suppressed = True
+                    f.suppress_reason = reason
+                findings.append(f)
+    for check in checks:
+        findings.extend(check.finalize() or ())
+    findings.sort(key=lambda f: (f.path, f.line, f.check, f.key))
+    stale = {}
+    if baseline:
+        allowance = dict(baseline)
+        for f in findings:
+            if f.suppressed:
+                continue
+            if allowance.get(f.fingerprint, 0) > 0:
+                allowance[f.fingerprint] -= 1
+                f.baselined = True
+        stale = {fp: n for fp, n in allowance.items() if n > 0}
+    lock_graph = {}
+    for check in checks:
+        graph = getattr(check, "lock_graph_json", None)
+        if callable(graph):
+            lock_graph = graph()
+    return Report(findings, checks, lock_graph=lock_graph,
+                  stale_baseline=stale, parse_errors=parse_errors)

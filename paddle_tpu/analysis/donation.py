@@ -1,0 +1,157 @@
+"""PTL003 — donated-buffer use-after-donation checker.
+
+``donate_argnums`` hands an input buffer's memory to the compiled
+program; the caller's array is DELETED the moment the call dispatches.
+Reading it afterwards raises a RuntimeError on TPU — but works by
+accident on the CPU test backend (no aliasing there), which is exactly
+how this bug class ships: green tier-1, dead on the pod. (PR 7's rule
+that donation-consumed engine buffers are rebuilt only via ``reset()``
+exists because of this.)
+
+The check is flow-lite, scope-local dataflow: inside one function (or
+module) body it tracks
+
+* names bound to ``jax.jit(..., donate_argnums=...)`` / immediate
+  ``jax.jit(f, donate_argnums=...)(args)`` calls, and
+* call sites of those names — the argument expression at each donated
+  position (bare names and ``self.<attr>`` chains) is marked consumed
+  at the call line, and
+
+flags any later ``Load`` of a consumed value with no intervening
+rebind. The canonical safe idiom — ``x = donating_fn(x)`` /
+``self.A, ... = self._set_fn(self.A, ...)`` — rebinds on the call line
+and stays clean by construction.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Check
+from .retrace import _jit_call_of
+
+__all__ = ["DonationCheck"]
+
+
+def _donated_positions(jit_call):
+    for kw in jit_call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, int))
+    return ()
+
+
+def _ref_key(node):
+    """Trackable identity of an argument expression: a bare name
+    ('x',) or a self-attribute chain ('self', 'buf'). None = not a
+    trackable reference (a literal, a call result, a subscript)."""
+    if isinstance(node, ast.Name):
+        return (node.id,)
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name):
+        return (node.value.id, node.attr)
+    return None
+
+
+class DonationCheck(Check):
+    id = "PTL003"
+    describe = ("donated buffer read after the donating call (works on "
+                "CPU, RuntimeError on TPU)")
+
+    def run(self, mod):
+        if "donate_argnums" not in mod.text:    # textual prefilter
+            return
+        yield from self._scan_scope(mod, mod.tree, "<module>")
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._scan_scope(mod, node, node.name)
+
+    def _scan_scope(self, mod, scope, func):
+        # pass 1: donating callables bound in this scope
+        donating = {}                          # name -> donated positions
+
+        def walk_scope(node):
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                yield from walk_scope(child)
+
+        scope_nodes = []
+        for n in (scope.body if hasattr(scope, "body") else []):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue        # nested scopes get their own scan
+            scope_nodes.extend(walk_scope(n))
+
+        for node in scope_nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                call = _jit_call_of(node.value)
+                if call is not None:
+                    pos = _donated_positions(call)
+                    if pos:
+                        key = _ref_key(node.targets[0])
+                        if key is not None:
+                            donating[key] = pos
+        # pass 2: walk events in line order
+        consumed = {}                 # ref key -> (donate line, fn name)
+        events = []                   # (line, kind, payload)
+        for node in scope_nodes:
+            if isinstance(node, ast.Call):
+                fn_key = _ref_key(node.func)
+                pos = None
+                label = None
+                if fn_key is not None and fn_key in donating:
+                    pos = donating[fn_key]
+                    label = ".".join(fn_key)
+                else:
+                    call = _jit_call_of(node.func)
+                    if call is not None:
+                        pos = _donated_positions(call)
+                        label = "jax.jit(...)"
+                if pos:
+                    for p in pos:
+                        if p < len(node.args):
+                            key = _ref_key(node.args[p])
+                            if key is not None:
+                                events.append(
+                                    (node.lineno, "donate", (key, label)))
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                key = _ref_key(node)
+                if key is None:
+                    continue
+                if isinstance(node.ctx, ast.Store):
+                    events.append((node.lineno, "store", (key, node)))
+                elif isinstance(node.ctx, ast.Load):
+                    events.append((node.lineno, "load", (key, node)))
+        # same-line ordering makes the canonical `x = f(x)` idiom clean:
+        # the read happens BEFORE the donation, the rebind after it
+        rank = {"load": 0, "donate": 1, "store": 2}
+        events.sort(key=lambda e: (e[0], rank[e[1]]))
+        findings = []
+        for line, kind, payload in events:
+            if kind == "donate":
+                key, label = payload
+                consumed[key] = (line, label)
+            elif kind == "store":
+                key, _ = payload
+                # a rebind (including the donating call's own result
+                # assignment on the same line) revives the name
+                consumed.pop(key, None)
+            elif kind == "load":
+                key, node = payload
+                hit = consumed.get(key)
+                if hit is not None and line > hit[0]:
+                    findings.append(self.finding(
+                        mod, node,
+                        f"`{'.'.join(key)}` was donated to `{hit[1]}` "
+                        f"on line {hit[0]} and read here without a "
+                        f"rebind — its buffer is deleted on TPU",
+                        key=f"use-after-donate:{'.'.join(key)}:{hit[1]}",
+                        func=func))
+                    consumed.pop(key, None)     # one finding per donation
+        return findings

@@ -1,0 +1,240 @@
+"""PTL004 — lock-discipline pass + static lock-acquisition-order graph.
+
+The serving stack's concurrency contract (PR 5/8/9) is narrow and
+documented, which makes it checkable:
+
+* The **paged-pool allocator** (free heap, prefix LRU, quarantine,
+  refcounts, block tables, write fences), the **content store** and the
+  **adapter device cache** are mutated ONLY from engine-thread methods
+  — ``LLMEngine``/``BertEmbedEngine``/``AdapterDeviceCache`` bodies.
+  There is deliberately no lock on that state; a mutation reached from
+  anywhere else is a race, full stop.
+* Cross-thread state (server handle table, router replica table,
+  adapter registry) is mutated only under its documented lock
+  (``_hlock`` / ``_lock`` / ``_dispatch_lock``).
+
+This pass flags protected-state mutations outside both shelters, and
+builds the **static lock-acquisition-order graph** from lexically
+nested ``with <lock>:`` blocks: an edge A→B means "B acquired while
+holding A". A cycle in that graph is a deadlock waiting for the right
+interleaving — reported as an error finding. The runtime watchdog
+(:mod:`paddle_tpu.analysis.lock_watchdog`, armed by
+``PADDLE_TPU_LOCK_CHECKS=1``) records the edges that actually happen —
+including through calls, which no lexical scan can see — and asserts
+them against this graph.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Check, Finding
+
+__all__ = ["LockDisciplineCheck", "PROTECTED_ATTRS", "ENGINE_OWNERS",
+           "DOCUMENTED_LOCKS", "static_lock_graph", "find_cycle"]
+
+#: protected attribute -> what it is (the engine-thread-owned and
+#: lock-guarded state PR 5/7/8/9 built their invariants on)
+PROTECTED_ATTRS = {
+    "_free_blocks": "paged-pool free heap",
+    "_lru": "prefix-cache / adapter LRU",
+    "_quarantine": "fenced-block quarantine",
+    "_block_ref": "pool refcounts",
+    "_block_hash": "content-store hashes",
+    "_block_tokens": "content-store tokens",
+    "_slot_blocks": "slot block lists",
+    "_tables": "block tables",
+    "_write_fence": "in-flight write fence",
+    "_slot_of": "adapter cache slot map",
+    "_slot_aid": "adapter cache slot owners",
+    "_ref": "adapter cache refcounts",
+    "_free": "adapter cache free list",
+    "_adapters": "adapter registry",
+    "_handles": "server handle table",
+}
+
+#: classes whose methods ARE the engine thread (by the step-protocol
+#: contract): mutations inside them need no lock.
+ENGINE_OWNERS = frozenset({"LLMEngine", "BertEmbedEngine",
+                           "AdapterDeviceCache"})
+
+#: the documented lock attributes of the serving stack
+DOCUMENTED_LOCKS = frozenset({"_hlock", "_lock", "_dispatch_lock",
+                              "_plock"})
+
+#: methods whose call on a protected attribute mutates it
+_MUTATORS = frozenset({"add", "append", "appendleft", "pop", "popleft",
+                       "popitem", "remove", "discard", "clear", "update",
+                       "setdefault", "extend", "insert"})
+
+#: functions allowed to (re)build protected state wholesale
+_INIT_FUNCS = frozenset({"__init__", "reset", "_init_device_state"})
+
+
+def _protected_attr(node):
+    """The protected attribute name accessed by ``node`` (an Attribute
+    or a Subscript/chain rooted in one), or None."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr in PROTECTED_ATTRS:
+        return node.attr
+    return None
+
+
+def _lock_label(expr, cls):
+    """'Class._lockattr' for ``with self._lockattr:`` style nodes, or
+    None when the with-item is not lock-shaped."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return None
+    if name in DOCUMENTED_LOCKS or "lock" in name.lower():
+        return f"{cls or '<module>'}.{name}"
+    return None
+
+
+def find_cycle(edges):
+    """One cycle in a directed edge set as a node list, or None."""
+    graph = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    stack = []
+
+    def dfs(n):
+        color[n] = GRAY
+        stack.append(n)
+        for nxt in sorted(graph.get(n, ())):
+            if color.get(nxt, WHITE) == GRAY:
+                return stack[stack.index(nxt):] + [nxt]
+            if color.get(nxt, WHITE) == WHITE:
+                cyc = dfs(nxt)
+                if cyc:
+                    return cyc
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            cyc = dfs(n)
+            if cyc:
+                return cyc
+    return None
+
+
+class LockDisciplineCheck(Check):
+    id = "PTL004"
+    describe = ("allocator/content-store/adapter-cache mutations outside "
+                "engine-thread methods or documented locks; lock-order "
+                "cycles")
+
+    def __init__(self):
+        #: (lock_a, lock_b) -> (relpath, line) where b was first seen
+        #: acquired while holding a
+        self.edges = {}
+
+    # -- per-module ------------------------------------------------------
+    def run(self, mod):
+        # textual prefilter: nothing protected and nothing lock-shaped
+        if "lock" not in mod.text.lower() and \
+                not any(a in mod.text for a in PROTECTED_ATTRS):
+            return
+        yield from self._walk(mod, mod.tree, cls=None, func=None,
+                              held=(), guarded=False)
+
+    def _walk(self, mod, node, cls, func, held, guarded):
+        for child in ast.iter_child_nodes(node):
+            c_cls, c_func, c_held, c_guarded = cls, func, held, guarded
+            if isinstance(child, ast.ClassDef):
+                c_cls = child.name
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                c_func = child.name
+                c_held, c_guarded = (), False     # locks don't cross defs
+            elif isinstance(child, ast.With):
+                for item in child.items:
+                    label = _lock_label(item.context_expr, c_cls)
+                    if label is None:
+                        continue
+                    # extend held BEFORE the next item so `with A, B:`
+                    # records the A->B edge exactly like nested withs
+                    # (CPython acquires multi-item withs left to right)
+                    for h in c_held:
+                        if h != label and (h, label) not in self.edges:
+                            self.edges[(h, label)] = (mod.relpath,
+                                                      child.lineno)
+                    c_held = c_held + (label,)
+                    c_guarded = True
+            else:
+                yield from self._check_mutation(mod, child, c_cls, c_func,
+                                                c_guarded)
+            yield from self._walk(mod, child, c_cls, c_func, c_held,
+                                  c_guarded)
+
+    def _check_mutation(self, mod, node, cls, func, guarded):
+        attr = None
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr = attr or _protected_attr(t)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = attr or _protected_attr(t)
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                attr = _protected_attr(node.func.value)
+            else:
+                # heapq.heappush(self._free_blocks, x) and friends
+                chain_root = node.func
+                while isinstance(chain_root, ast.Attribute):
+                    chain_root = chain_root.value
+                if isinstance(chain_root, ast.Name) and \
+                        chain_root.id == "heapq" and node.args:
+                    attr = _protected_attr(node.args[0])
+        if attr is None:
+            return
+        if cls in ENGINE_OWNERS or guarded:
+            return
+        if func in _INIT_FUNCS:
+            return
+        where = f"{cls}.{func}" if cls and func else (func or cls or
+                                                      "<module>")
+        yield self.finding(
+            mod, node,
+            f"mutation of {PROTECTED_ATTRS[attr]} (`{attr}`) in "
+            f"`{where}` — outside engine-thread owner classes "
+            f"({', '.join(sorted(ENGINE_OWNERS))}) and not under a "
+            f"documented lock",
+            key=f"unguarded:{where}:{attr}", func=func or "<module>")
+
+    # -- cross-module ----------------------------------------------------
+    def finalize(self):
+        cycle = find_cycle(set(self.edges))
+        if cycle:
+            a, b = cycle[0], cycle[1]
+            path, line = self.edges.get((a, b), ("(lock-order graph)", 0))
+            yield Finding(
+                self.id, path, line, 0, "<lock-order-graph>",
+                f"lock-acquisition-order cycle: {' -> '.join(cycle)} — "
+                f"a deadlock under the right interleaving",
+                key=f"lock-cycle:{'->'.join(sorted(set(cycle)))}")
+
+    def lock_graph_json(self):
+        return {
+            "edges": [{"from": a, "to": b, "path": p, "line": ln}
+                      for (a, b), (p, ln) in sorted(self.edges.items())],
+            "cycle": find_cycle(set(self.edges)) or []}
+
+
+def static_lock_graph(paths):
+    """The static lock-order edge set of ``paths`` — the runtime
+    watchdog's reference. Returns ``{(lock_a, lock_b): (path, line)}``."""
+    from .core import run_analysis
+    check = LockDisciplineCheck()
+    run_analysis(paths, checks=[check])
+    return dict(check.edges)

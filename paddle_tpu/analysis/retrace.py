@@ -1,0 +1,295 @@
+"""PTL002 — retrace / concretization hazard detector.
+
+The serving engine's throughput story assumes every jitted program
+compiles ONCE per (shape, flag) signature. The ways that assumption
+historically broke here:
+
+* **Python control flow on traced values** — ``if jnp.any(x):`` raises
+  a ConcretizationTypeError under jit, and OUTSIDE jit it silently
+  becomes a per-call device sync plus, when fed into a static argument,
+  a retrace per distinct value.
+* **Unhashable statics** (the PR-3 ``slice`` bug class) — passing a
+  ``slice``/list/dict as a ``static_argnums`` argument either crashes
+  at the jit cache lookup or, for types with value-hash semantics,
+  retraces per call.
+* **Trace-time impurity** — ``time.time()``/``np.random.*`` inside a
+  jit body bakes one sample into the compiled program; the bench then
+  measures a constant and calls it jitter.
+* **Closure-captured mutables** — a list/dict captured by a jit body is
+  baked at trace time; later host mutation silently diverges from the
+  compiled constant.
+
+Jit bodies are found syntactically: functions decorated with
+``@jax.jit``/``@partial(jax.jit, ...)`` and functions passed by name to
+``jax.jit(...)`` anywhere in the same module (the engine's
+``_programs`` idiom).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Check
+
+__all__ = ["RetraceCheck"]
+
+_IMPURE_CALLS = {
+    ("time", "time"), ("time", "perf_counter"), ("time", "monotonic"),
+    ("datetime", "now"), ("datetime", "utcnow"),
+    ("random", "random"), ("random", "randint"), ("random", "choice"),
+    ("random", "uniform"),
+}
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+
+#: jnp/jax attributes whose results are STATIC metadata (dtype/shape/
+#: topology introspection) — branching on them never concretizes a
+#: traced value
+_STATIC_JAX_CALLS = frozenset({
+    "issubdtype", "isdtype", "result_type", "promote_types", "dtype",
+    "ndim", "shape", "size", "iscomplexobj",
+    "process_count", "process_index", "device_count",
+    "local_device_count", "default_backend", "devices", "local_devices",
+})
+
+
+def _call_chain(call):
+    """('np', 'random', 'normal') for np.random.normal(...) etc."""
+    parts = []
+    node = call.func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return tuple(reversed(parts))
+
+
+def _is_jax_jit(node):
+    """True for the expression ``jax.jit`` / ``jit`` / ``pjit``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("jit", "pjit") and \
+            isinstance(node.value, ast.Name) and node.value.id == "jax"
+    return isinstance(node, ast.Name) and node.id in ("jit", "pjit")
+
+
+def _jit_call_of(node):
+    """The ``jax.jit(...)`` Call inside ``node``, unwrapping
+    ``partial(jax.jit, ...)`` decorators; None otherwise."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jax_jit(node.func):
+        return node
+    chain = _call_chain(node)
+    if chain and chain[-1] == "partial" and node.args and \
+            _is_jax_jit(node.args[0]):
+        return node
+    return None
+
+
+def _static_positions(jit_call):
+    """Literal static_argnums positions of a jax.jit(...) call."""
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, int))
+    return ()
+
+
+def _is_unhashable_literal(node):
+    if isinstance(node, _MUTABLE_LITERALS):
+        return True
+    return isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+        and node.func.id in ("slice", "list", "dict", "set", "bytearray")
+
+
+class RetraceCheck(Check):
+    id = "PTL002"
+    describe = ("retrace/concretization hazard: python branches on "
+                "traced values, unhashable statics, trace-time "
+                "impurity, closure-captured mutables")
+
+    def run(self, mod):
+        # textual prefilter: no jax/jnp mention -> nothing to trace
+        has_jax = "jax" in mod.text or "jnp" in mod.text
+        has_jit = "jit" in mod.text
+        if not has_jax:
+            return
+        jitted_names = set()         # function names passed to jax.jit
+        jit_bound = {}               # local name -> jax.jit(...) Call
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_jax_jit(node.func) \
+                    and node.args and isinstance(node.args[0], ast.Name):
+                jitted_names.add(node.args[0].id)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                call = _jit_call_of(node.value)
+                if call is not None:
+                    jit_bound[node.targets[0].id] = call
+            # (a) python `if`/`while` whose test calls into jnp/jax —
+            # the test concretizes a traced value (ConcretizationError
+            # under jit; a silent per-call sync outside it)
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                # a call consumed only through `.dtype`/`.shape`/`.ndim`
+                # contributes static metadata, not a traced value
+                meta_only = set()
+                for sub in ast.walk(node.test):
+                    if isinstance(sub, ast.Attribute) and sub.attr in (
+                            "dtype", "shape", "ndim", "size"):
+                        for inner in ast.walk(sub.value):
+                            meta_only.add(id(inner))
+                for sub in ast.walk(node.test):
+                    if isinstance(sub, ast.Call) and \
+                            id(sub) not in meta_only:
+                        chain = _call_chain(sub)
+                        if chain and chain[0] in ("jnp", "jax") and \
+                                chain[-1] not in _STATIC_JAX_CALLS:
+                            yield self.finding(
+                                mod, node.test,
+                                f"python {type(node).__name__.lower()} on "
+                                f"a traced value: "
+                                f"`{mod.segment(node.test)}` (use "
+                                f"jnp.where / lax.cond)",
+                                key=mod.segment(node.test))
+                            break
+        # (b) hazards INSIDE jit bodies
+        if not has_jit:
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                decorated = any(
+                    _is_jax_jit(d) or _jit_call_of(d) is not None
+                    for d in node.decorator_list)
+                if decorated or node.name in jitted_names:
+                    yield from self._scan_jit_body(mod, node)
+        # (c) unhashable static arguments at call sites of jit-bound
+        # names (the PR-3 slice bug class: the jit cache either crashes
+        # hashing it or retraces per identity)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in jit_bound):
+                continue
+            static = _static_positions(jit_bound[node.func.id])
+            for pos in static:
+                if pos < len(node.args) and \
+                        _is_unhashable_literal(node.args[pos]):
+                    yield self.finding(
+                        mod, node.args[pos],
+                        f"unhashable/mutable value at static_argnums "
+                        f"position {pos} of `{node.func.id}`: "
+                        f"`{mod.segment(node.args[pos])}` retraces per "
+                        f"call (or crashes the jit cache hash)",
+                        key=f"static-arg:{node.func.id}:{pos}:"
+                            f"{mod.segment(node.args[pos])}")
+
+    def _scan_jit_body(self, mod, fn):
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                chain = _call_chain(node)
+                if len(chain) >= 2 and (chain[-2], chain[-1]) in \
+                        _IMPURE_CALLS:
+                    yield self.finding(
+                        mod, node,
+                        f"impure call `{'.'.join(chain)}` inside jit "
+                        f"body `{fn.name}` is baked in at trace time",
+                        key=f"impure:{fn.name}:{'.'.join(chain)}",
+                        func=fn.name)
+                elif len(chain) >= 2 and chain[0] == "np" and \
+                        chain[1] == "random":
+                    yield self.finding(
+                        mod, node,
+                        f"`{'.'.join(chain)}` inside jit body "
+                        f"`{fn.name}` samples ONCE at trace time (use "
+                        f"jax.random with a traced key)",
+                        key=f"impure:{fn.name}:{'.'.join(chain)}",
+                        func=fn.name)
+        # closure-captured mutables: names assigned to mutable literals
+        # in an ENCLOSING scope that this jit body loads AND that the
+        # enclosing scope mutates after the body is defined (the
+        # build-then-capture idiom — a dict frozen before the def — is
+        # benign: nothing can diverge from the traced constant)
+        parent = getattr(fn, "_ptlint_parent", None)
+        if parent is None:
+            return
+        mutable_outer = {}
+        for stmt in ast.walk(parent):
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, _MUTABLE_LITERALS):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        mutable_outer[t.id] = stmt
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Tuple):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Tuple) and \
+                            len(t.elts) == len(stmt.value.elts):
+                        for te, ve in zip(t.elts, stmt.value.elts):
+                            if isinstance(te, ast.Name) and \
+                                    isinstance(ve, _MUTABLE_LITERALS):
+                                mutable_outer[te.id] = stmt
+        if not mutable_outer:
+            return
+        end = getattr(fn, "end_lineno", fn.lineno)
+        mutated_after = set()
+        for node in ast.walk(parent):
+            if getattr(node, "lineno", 0) <= end:
+                continue
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("append", "extend", "add", "pop",
+                                       "update", "insert", "remove",
+                                       "clear", "setdefault") and \
+                    isinstance(node.func.value, ast.Name):
+                mutated_after.add(node.func.value.id)
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+                targets = node.targets if not isinstance(
+                    node, ast.AugAssign) else [node.target]
+                for t in targets:
+                    while isinstance(t, ast.Subscript):
+                        t = t.value
+                    if isinstance(t, ast.Name):
+                        mutated_after.add(t.id)
+        mutable_outer = {k: v for k, v in mutable_outer.items()
+                         if k in mutated_after}
+        if not mutable_outer:
+            return
+        local = set(params)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Store):
+                local.add(node.id)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in mutable_outer and node.id not in local:
+                yield self.finding(
+                    mod, node,
+                    f"jit body `{fn.name}` closes over mutable "
+                    f"`{node.id}` (baked at trace time; later host "
+                    f"mutation silently diverges)",
+                    key=f"closure:{fn.name}:{node.id}", func=fn.name)
+                break
+
+    def collect(self, mod):
+        # annotate nested function defs with their immediate enclosing
+        # function so the closure scan can look one scope up — one
+        # linear pass with an explicit (node, enclosing) stack
+        if "jit" not in mod.text:
+            return
+        stack = [(mod.tree, None)]
+        while stack:
+            node, enclosing = stack.pop()
+            is_fn = isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+            if is_fn and enclosing is not None:
+                node._ptlint_parent = enclosing
+            inner = node if is_fn else enclosing
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, inner))

@@ -1,0 +1,156 @@
+"""PTL005 — telemetry strict-name pass.
+
+``ServingTelemetry`` is strict at runtime: ``add_stage``/``inc``/
+``set_gauge`` raise ``KeyError`` for a name never declared, and
+``observe`` resolves its histogram via ``getattr`` (an AttributeError
+for a typo). Strictness at runtime means the typo is found when the
+code PATH runs — for a rarely-taken branch that is three rounds later,
+in production. This pass moves the check to lint time: every
+string-literal name at a telemetry call site must exist in the registry
+parsed out of ``serving_telemetry.py`` (module-level ``STAGES`` /
+``GAUGES`` / ``_COUNTERS`` tuples plus ``self.<hist> =
+LatencyHistogram()`` assignments), or be declared via a literal
+``.register("<kind>", "<name>")`` call somewhere in the scanned tree.
+
+Dynamic names (f-strings, variables) are skipped — the runtime contract
+still covers those.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Check
+
+__all__ = ["TelemetryNameCheck"]
+
+#: telemetry write methods -> registry kind they draw names from
+_SETTERS = {"add_stage": "stage", "inc": "counter",
+            "set_gauge": "gauge", "observe": "histogram",
+            "stage": "stage"}
+
+#: receivers considered telemetry objects (call sites look like
+#: ``self.telemetry.inc(...)`` / ``tel.set_gauge(...)``)
+_RECEIVERS = ("telemetry", "tel")
+
+
+def _is_telemetry_receiver(node):
+    if isinstance(node, ast.Name):
+        return node.id in _RECEIVERS
+    if isinstance(node, ast.Attribute):
+        return node.attr in _RECEIVERS
+    return False
+
+
+class TelemetryNameCheck(Check):
+    id = "PTL005"
+    describe = ("telemetry stage/counter/gauge/histogram name not in the "
+                "ServingTelemetry registry (today a runtime-only "
+                "KeyError)")
+
+    def __init__(self, registry=None):
+        """``registry``: optional {"stage"|"counter"|"gauge"|"histogram"
+        -> set of names} override (fixture tests); default parses the
+        registry out of the scanned ``serving_telemetry.py``."""
+        self._override = registry
+        self.registry = {"stage": set(), "counter": set(),
+                         "gauge": set(), "histogram": set()}
+        self._saw_registry_module = False
+        self._fallback_reg = None       # cached import-fallback registry
+
+    @staticmethod
+    def _parse_registry_tree(tree, registry):
+        """Harvest STAGES/GAUGES/_COUNTERS tuples and ``self.<name> =
+        LatencyHistogram()`` assignments out of a serving_telemetry AST
+        — THE one copy of the registry-parsing logic, shared by the
+        in-tree scan and the import fallback (a hardcoded name set
+        would silently drift the next time a histogram is added)."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name) and t.id in (
+                        "STAGES", "GAUGES", "_COUNTERS") and \
+                        isinstance(node.value, (ast.Tuple, ast.List)):
+                    kind = {"STAGES": "stage", "GAUGES": "gauge",
+                            "_COUNTERS": "counter"}[t.id]
+                    for e in node.value.elts:
+                        if isinstance(e, ast.Constant) and \
+                                isinstance(e.value, str):
+                            registry[kind].add(e.value)
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(node.value, ast.Call) and \
+                        isinstance(node.value.func, ast.Name) and \
+                        node.value.func.id == "LatencyHistogram":
+                    registry["histogram"].add(t.attr)
+
+    # -- phase 1: build the registry ------------------------------------
+    def collect(self, mod):
+        if self._override is not None:
+            return
+        if mod.relpath.endswith("serving_telemetry.py"):
+            self._saw_registry_module = True
+            self._parse_registry_tree(mod.tree, self.registry)
+        # extension names declared anywhere via register("kind", "name")
+        if ".register(" not in mod.text:        # textual prefilter
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "register" and \
+                    len(node.args) >= 2 and \
+                    all(isinstance(a, ast.Constant) and
+                        isinstance(a.value, str) for a in node.args[:2]):
+                kind, name = node.args[0].value, node.args[1].value
+                if kind in self.registry:
+                    self.registry[kind].add(name)
+
+    # -- phase 2: check call sites --------------------------------------
+    def run(self, mod):
+        if not any(s in mod.text for s in
+                   ("add_stage(", ".inc(", "set_gauge(", ".observe(",
+                    ".stage(")):                # textual prefilter
+            return
+        reg = self._override if self._override is not None else \
+            self.registry
+        if self._override is None and not self._saw_registry_module:
+            # registry not in the scanned tree (fixture dirs, subtree
+            # runs): fall back to parsing the REAL module's source with
+            # the same harvest logic as the in-tree scan (cached — one
+            # parse per run, not one per scanned module)
+            if self._fallback_reg is None:
+                try:
+                    from ..profiler import serving_telemetry as st
+                    with open(st.__file__, encoding="utf-8") as fh:
+                        st_tree = ast.parse(fh.read())
+                    reg = {"stage": set(), "gauge": set(),
+                           "counter": set(), "histogram": set()}
+                    self._parse_registry_tree(st_tree, reg)
+                    for k in self.registry:      # keep register() names
+                        reg[k] |= self.registry[k]
+                    self._fallback_reg = reg
+                except Exception:
+                    self._fallback_reg = {}
+            if not self._fallback_reg:
+                return
+            reg = self._fallback_reg
+        if mod.relpath.endswith("serving_telemetry.py"):
+            return          # the registry itself (error-message literals)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SETTERS
+                    and _is_telemetry_receiver(node.func.value)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            kind = _SETTERS[node.func.attr]
+            name = node.args[0].value
+            if name not in reg.get(kind, set()):
+                yield self.finding(
+                    mod, node,
+                    f"telemetry {kind} {name!r} is not in the "
+                    f"ServingTelemetry registry — this call raises "
+                    f"{'AttributeError' if kind == 'histogram' else 'KeyError'} "
+                    f"the first time this path runs (declare it in "
+                    f"serving_telemetry.py or via register())",
+                    key=f"unknown-{kind}:{name}")

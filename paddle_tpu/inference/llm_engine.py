@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import lock_watchdog as _lockwatch
 from ..core.tensor import Tensor, functional_mode
 from ..models.llama import SlotKVCache, _sample_logits_device
 from ..models.lora import lora_scope
@@ -70,7 +71,12 @@ def default_engine_stats():
             "adapter_swaps": 0, "embed_requests": 0,
             "decode_time_s": 0.0, "admit_time_s": 0.0,
             "dispatch_time_s": 0.0, "host_sync_time_s": 0.0,
-            "emit_time_s": 0.0}
+            "emit_time_s": 0.0,
+            # transfer-guard sanitizer (PADDLE_TPU_TRANSFER_CHECKS=1):
+            # all-decode strides whose dispatch->readout window ran
+            # under jax.transfer_guard("disallow") — each counted
+            # readout is the stride's ONE permitted D2H sync
+            "guarded_syncs": 0}
 
 #: chain-hash seed for block 0 of every sequence (the "parent" of the
 #: first block) — a fixed constant so equal first blocks collide
@@ -93,6 +99,33 @@ _SPEC_EWMA_ALPHA = 0.4
 #: sub-ms host-side dispatch, which the GIL serializes anyway.
 _MODEL_DISPATCH_LOCKS = weakref.WeakKeyDictionary()
 _LOCKS_GUARD = threading.Lock()
+
+#: the open transfer-guard stride window, PER THREAD and shared by ALL
+#: engines — jax.transfer_guard is thread-global config, so two engines
+#: interleaved on one thread must share one window slot (per-engine
+#: slots would nest contexts and unwind them out of LIFO order,
+#: stranding the thread in "disallow")
+_STRIDE_GUARD_TLS = threading.local()
+
+
+def close_thread_stride_guard(finishing=None):
+    """Close the CALLING thread's open transfer-guard stride window, if
+    any — THE one copy of the close protocol, shared by every engine
+    speaking the step protocol (LLMEngine, and shims like
+    serving/embedding.py's BertEmbedEngine, whose readouts must not run
+    inside another engine's disallow window). A window closed early —
+    by a chained dispatch, a reset, or a DIFFERENT pending's finish —
+    did not cover its stride, so the owner's ``guarded`` flag is
+    revoked and its readout is not counted."""
+    cm = getattr(_STRIDE_GUARD_TLS, "cm", None)
+    if cm is None:
+        return
+    owner = getattr(_STRIDE_GUARD_TLS, "owner", None)
+    if owner is not None and owner is not finishing:
+        owner.guarded = False
+    _STRIDE_GUARD_TLS.cm = None
+    _STRIDE_GUARD_TLS.owner = None
+    cm.__exit__(None, None, None)
 
 
 def _model_dispatch_lock(model):
@@ -218,7 +251,7 @@ class PendingStep:
 
     __slots__ = ("toks", "was_active", "counts", "spec", "slots",
                  "pool_done", "sched", "step_id", "fenced", "t_dispatch",
-                 "embed_done", "pooled", "verify", "offered")
+                 "embed_done", "pooled", "verify", "offered", "guarded")
 
     def __init__(self, toks, was_active, counts, spec, slots, pool_done,
                  sched=None, fenced=None, embed_done=None, verify=None):
@@ -260,6 +293,11 @@ class PendingStep:
         #: exact proposal counts the acceptance accounting books
         #: against. None on legacy spec (its grant is never clamped).
         self.offered = None
+        #: True when this dispatch armed the transfer-guard stride
+        #: window (PADDLE_TPU_TRANSFER_CHECKS=1): its step_finish
+        #: readout is the stride's ONE counted sync (stats
+        #: ["guarded_syncs"])
+        self.guarded = False
 
 
 class LLMEngine:
@@ -326,8 +364,25 @@ class LLMEngine:
         self.model = model
         #: serializes trace-capable dispatches across ALL engines built
         #: on this model object (replica servers sharing weights) — see
-        #: _model_dispatch_lock
-        self._dispatch_lock = _model_dispatch_lock(model)
+        #: _model_dispatch_lock. Wrapped for the lock-order watchdog
+        #: when PADDLE_TPU_LOCK_CHECKS=1 (paddle_tpu.analysis, PTL004).
+        self._dispatch_lock = _lockwatch.tracked(
+            _model_dispatch_lock(model), "LLMEngine._dispatch_lock")
+        # ---- runtime sanitizers (paddle_tpu.analysis) -----------------
+        #: PADDLE_TPU_TRANSFER_CHECKS=1 (the test conftest's posture):
+        #: every fused all-decode stride holds jax.transfer_guard
+        #: ("disallow") from dispatch to readout on the stepping thread,
+        #: proving PR 8's one-sync-per-stride contract as an assertion —
+        #: a stray scalar pull in the window raises instead of costing
+        #: p99. The documented readout increments stats["guarded_syncs"].
+        self._transfer_checks = os.environ.get(
+            "PADDLE_TPU_TRANSFER_CHECKS", "0") not in ("", "0")
+        #: PADDLE_TPU_LOCK_CHECKS=1: pin the paged-pool allocator to the
+        #: stepping thread — any allocator/quarantine/content-store
+        #: mutation from another thread raises, naming the owner (the
+        #: dynamic half of the PTL004 lock-discipline pass)
+        self._lock_checks = _lockwatch.enabled()
+        self._pool_owner = None
         c = model.config
         self.B = int(max_batch)
         # decode horizon: tokens decoded per step() call as one compiled
@@ -684,6 +739,8 @@ class LLMEngine:
         so a re-admitted request's sampled stream continues exactly
         where the crash cut it. ``_check_pool_invariants`` holds
         trivially after a reset."""
+        self._close_stride_guard()
+        self._pool_owner = None
         self.slots = [None] * self.B
         self.waiting.clear()
         self.finished_outputs.clear()
@@ -1682,6 +1739,10 @@ class LLMEngine:
         """Grow slot `slot_idx` by `n` PRIVATE physical blocks (refcount
         1, content unregistered). False = pool dry (free + cached both
         exhausted)."""
+        # owner check FIRST: the capacity probe itself reads allocator
+        # state racily, so an off-thread attempt must be flagged even
+        # when it would have failed the capacity check anyway
+        self._assert_pool_owner("_alloc_blocks")
         if self._n_allocatable() < n:
             return False
         blocks = self._slot_blocks[slot_idx]
@@ -1705,6 +1766,7 @@ class LLMEngine:
         registration overlap until that step's finish. An unfenced
         registered block parks straight in the LRU cached pool (content
         stays probe-able); anything else returns to the free heap."""
+        self._assert_pool_owner("_release_block")
         self._block_ref[phys] -= 1
         if self._block_ref[phys] > 0:
             return
@@ -1746,6 +1808,7 @@ class LLMEngine:
         quarantine for the pool its registration state earns: the LRU
         cached pool if its content is published (probe-able again), the
         free heap otherwise."""
+        self._assert_pool_owner("_unfence")
         for phys in fenced:
             n = self._write_fence.get(phys, 0) - 1
             if n > 0:
@@ -1775,6 +1838,7 @@ class LLMEngine:
         free normally — one canonical block per content."""
         if chain_hash in self._store or phys in self._block_hash:
             return
+        self._assert_pool_owner("_register_block")
         self._store[chain_hash] = phys
         self._block_hash[phys] = chain_hash
         self._block_parent[phys] = parent
@@ -1782,6 +1846,7 @@ class LLMEngine:
         self._children.setdefault(parent, []).append(phys)
 
     def _unregister(self, phys):
+        self._assert_pool_owner("_unregister")
         h = self._block_hash.pop(phys, None)
         if h is None:
             return
@@ -1972,6 +2037,12 @@ class LLMEngine:
         scratch block never enters circulation."""
         if not self._debug_pool:
             return
+        # the audit READS allocator state wholesale — from a non-owning
+        # thread that races the very invariants it checks, but only
+        # while a dispatch is actually in flight (tests legitimately
+        # audit a quiesced engine from the main thread after stop())
+        if self._inflight > 0:
+            self._assert_pool_owner("_check_pool_invariants")
         free = set(self._free_blocks)
         cached = set(self._lru)
         quarantined = set(self._quarantine)
@@ -2475,6 +2546,63 @@ class LLMEngine:
             adapter_swaps=self.stats["adapter_swaps"] - swaps0)
         self._rec_ctx = None
 
+    # ---- runtime sanitizers (paddle_tpu.analysis) ---------------------
+    def _open_stride_guard(self, pending):
+        """Arm the one-sync-per-stride contract for an all-decode
+        multi-step dispatch: until the guard closes (step_finish, or the
+        next chained step_begin under pipelining), ANY implicit device
+        transfer on the stepping thread raises — the PR-8 headline claim
+        as a runtime assertion instead of a bench number. Explicit
+        transfers (jax.device_put / device_get) stay allowed, which is
+        exactly the allowlist semantics the documented readout needs."""
+        if not self._transfer_checks or \
+                getattr(_STRIDE_GUARD_TLS, "cm", None) is not None:
+            return
+        cm = jax.transfer_guard("disallow")
+        cm.__enter__()
+        _STRIDE_GUARD_TLS.cm = cm
+        _STRIDE_GUARD_TLS.owner = pending
+        pending.guarded = True
+
+    @property
+    def _stride_guard(self):
+        """The CALLING thread's open stride-guard context (None when no
+        window is open on this thread) — introspection for tests. The
+        slot is shared by all engines on the thread (see
+        _STRIDE_GUARD_TLS)."""
+        return getattr(_STRIDE_GUARD_TLS, "cm", None)
+
+    def _close_stride_guard(self, finishing=None):
+        """Close the CALLING thread's open window, if any (whichever
+        engine opened it — one slot per thread; see
+        :func:`close_thread_stride_guard`). A jax transfer guard is
+        thread-local: another thread's window cannot be closed from
+        here — and need not be, since it constrains only that thread;
+        it heals when that thread next enters any engine (or is inert
+        forever if the thread died with it)."""
+        close_thread_stride_guard(finishing)
+
+    def _note_pool_owner(self):
+        if self._lock_checks:
+            self._pool_owner = threading.get_ident()
+
+    def _assert_pool_owner(self, what):
+        """PADDLE_TPU_LOCK_CHECKS=1: the paged-pool allocator, content
+        store and quarantine are engine-stepping-thread state (PTL004)
+        — there is deliberately no lock on them, so a mutation from any
+        other thread is a race. The owner is whichever thread ran the
+        last step_begin; reset() clears the pin."""
+        if not self._lock_checks or self._pool_owner is None:
+            return
+        me = threading.get_ident()
+        if me != self._pool_owner:
+            raise AssertionError(
+                f"{what} on thread {me}, but the paged pool is owned by "
+                f"engine-stepping thread {self._pool_owner} "
+                f"(allocator/quarantine/content-store mutations are "
+                f"engine-thread-only; route this through the serve loop "
+                f"or take a step-protocol entry point)")
+
     def step_begin(self):
         """Admit waiting requests into free slots and DISPATCH one decode
         step for all active slots WITHOUT reading anything back. Returns a
@@ -2497,6 +2625,10 @@ class LLMEngine:
         PAGED engine allocates pool blocks from host lens before each
         dispatch, so it must run depth 1 (finish before the next begin —
         enforced)."""
+        # a chained (pipelined) dispatch re-opens host->device traffic:
+        # the previous stride's strict window ends here, not at its
+        # step_finish
+        self._close_stride_guard()
         fi = self.fault_injector
         if fi is not None:
             # the chaos hook fires OUTSIDE the model dispatch lock: an
@@ -2509,6 +2641,7 @@ class LLMEngine:
     def _step_begin_impl(self):
         from ..core import random as _random
 
+        self._note_pool_owner()
         if self.cache_impl == "paged" and \
                 self._inflight >= self.max_pipeline_depth():
             raise RuntimeError(
@@ -2550,6 +2683,9 @@ class LLMEngine:
                 # multi-process: the key must be a GLOBAL replicated array
                 # (every process derives the identical value from the seed)
                 from jax.sharding import NamedSharding, PartitionSpec
+                # ptlint: disable=PTL001 -- one-time rng seed pull at the
+                # FIRST step only (self._rng_key is None exactly once per
+                # reset), never in the per-stride dispatch->readout window
                 data = np.asarray(jax.random.key_data(key))
                 glob = jax.make_array_from_callback(
                     data.shape,
@@ -2751,6 +2887,11 @@ class LLMEngine:
                      for b in np.nonzero(active)[0]
                      if self.slots[b] is not None} if spec else None))
         pending.t_dispatch = t0
+        if use_multi:
+            # all-decode stride dispatched: arm the strict
+            # dispatch->readout window (no-op unless
+            # PADDLE_TPU_TRANSFER_CHECKS=1)
+            self._open_stride_guard(pending)
         if self._rec() is not None:
             # ONE decode grant per slot covering the whole stride (spec:
             # stride verify windows of up to Kspec each)
@@ -2906,6 +3047,10 @@ class LLMEngine:
                               fenced=fenced, verify=verify)
         pending.t_dispatch = t0
         pending.offered = offered
+        if stride > 1:
+            # speculative all-decode stride: same one-sync-per-stride
+            # window as the dense multi-step path
+            self._open_stride_guard(pending)
         if self._rec() is not None:
             grants = tuple(
                 (int(b), self.slots[b].req.request_id, "verify",
@@ -3178,6 +3323,10 @@ class LLMEngine:
         step. Tokens of a slot whose occupant changed since dispatch
         (retired, cancelled, preempted — possibly already reused) are
         dropped: they were decoded for the old occupant's state."""
+        # the strict stride window ends HERE: the readout below is the
+        # stride's one permitted sync. Close before the chaos hook — an
+        # injected crash must not leak a thread-local disallow context.
+        self._close_stride_guard(finishing=pending)
         fi = self.fault_injector
         if fi is not None:
             fi.on_step_finish(self)
@@ -3221,6 +3370,11 @@ class LLMEngine:
         self.stats["host_sync_time_s"] += dt
         self.stats["decode_time_s"] += dt
         self.stats["steps"] += 1
+        if pending.guarded:
+            # THE stride's one documented D2H sync just happened — the
+            # transfer-guard window it closed proved nothing else
+            # synced between dispatch and here
+            self.stats["guarded_syncs"] += 1
         # the device work (every KV write included) provably landed —
         # the token sync completed — so this dispatch's write fences
         # drop now, BEFORE the readout walk can retire slots and free

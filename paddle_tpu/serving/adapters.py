@@ -49,6 +49,7 @@ import threading
 
 import numpy as np
 
+from ..analysis import lock_watchdog as _lockwatch
 from ..models.lora import (LORA_TARGETS, active_lora, lora_scope,
                            lora_target_dims as _target_dims)
 
@@ -78,7 +79,10 @@ class AdapterStore:
         self.rank = int(rank)
         self.n_layers = int(config.num_hidden_layers)
         self.dims = _target_dims(config)
-        self._lock = threading.Lock()
+        # PADDLE_TPU_LOCK_CHECKS=1: acquisition edges feed the PTL004
+        # lock-order watchdog (paddle_tpu.analysis.lock_watchdog)
+        self._lock = _lockwatch.tracked(threading.Lock(),
+                                        "AdapterStore._lock")
         #: adapter_id -> {"weights": {target: (A, B)}, "alpha": float}
         self._adapters = {}
         self._next_id = 1

@@ -54,6 +54,7 @@ import time
 
 import numpy as np
 
+from ..analysis import lock_watchdog as _lockwatch
 from .types import ServeResult, ServerClosed, ServerQueueFull
 
 __all__ = ["ReplicaRouter", "RouterHandle", "tp_serving_mesh",
@@ -352,7 +353,10 @@ class ReplicaRouter:
         #: spend on fresh traffic.
         self.resume_inflight = bool(resume_inflight)
         self._rng = np.random.default_rng(seed)
-        self._lock = threading.Lock()
+        # PADDLE_TPU_LOCK_CHECKS=1: acquisition edges feed the PTL004
+        # lock-order watchdog (paddle_tpu.analysis.lock_watchdog)
+        self._lock = _lockwatch.tracked(threading.Lock(),
+                                        "ReplicaRouter._lock")
         self._outstanding: set[RouterHandle] = set()
         #: outstanding placements per replica, counted by the ROUTER at
         #: placement time — the load gauges are sampled by each replica's

@@ -25,7 +25,9 @@ import time
 
 import numpy as np
 
-from ..inference.llm_engine import RequestOutput, default_engine_stats
+from ..inference.llm_engine import (RequestOutput,
+                                    close_thread_stride_guard,
+                                    default_engine_stats)
 
 __all__ = ["BertEmbedEngine"]
 
@@ -201,6 +203,11 @@ class BertEmbedEngine:
 
     # -- the step protocol ---------------------------------------------
     def step_begin(self):
+        # step-protocol contract: close the calling thread's open
+        # transfer-guard stride window (another engine interleaved on
+        # this thread may have armed it — this dispatch legitimately
+        # re-opens host->device traffic)
+        close_thread_stride_guard()
         if self._inflight:
             return None          # depth 1: the sync IS the result
         if not self.waiting:
@@ -233,6 +240,9 @@ class BertEmbedEngine:
         return _EmbedPending(out, batch, t0)
 
     def step_finish(self, pending):
+        # as in LLMEngine.step_finish: the readout below must not run
+        # inside another engine's disallow window on this thread
+        close_thread_stride_guard()
         t0 = time.perf_counter()
         rows = np.asarray(pending.out, np.float32)   # THE sync
         dt = time.perf_counter() - t0

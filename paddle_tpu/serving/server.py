@@ -42,6 +42,7 @@ import time
 
 import numpy as np
 
+from ..analysis import lock_watchdog as _lockwatch
 from ..inference.llm_engine import PoolCapacityError
 from ..profiler.serving_telemetry import ServingTelemetry
 from .scheduler import AdmissionQueue
@@ -154,7 +155,10 @@ class AsyncLLMServer:
             self.telemetry.replica = replica
         self._queue = AdmissionQueue(max_queue_size)
         self._handles: dict[int, RequestHandle] = {}
-        self._hlock = threading.Lock()
+        # PADDLE_TPU_LOCK_CHECKS=1: acquisition edges feed the PTL004
+        # lock-order watchdog (paddle_tpu.analysis.lock_watchdog)
+        self._hlock = _lockwatch.tracked(threading.Lock(),
+                                         "AsyncLLMServer._hlock")
         self._next_id = 0
         self._work_evt = threading.Event()
         self._thread = None

@@ -19,6 +19,20 @@ if "xla_force_host_platform_device_count" not in _flags:
 # instead of silently shrinking the serving pool (prod default: off)
 os.environ.setdefault("PADDLE_TPU_POOL_CHECKS", "1")
 
+# runtime sanitizers (paddle_tpu.analysis — the dynamic halves of the
+# PTL001/PTL004 static checks; prod default: off):
+# - TRANSFER_CHECKS arms a jax.transfer_guard("disallow") window around
+#   every fused all-decode stride (dispatch -> readout): a stray
+#   device->host sync inside the window raises here instead of costing
+#   p99 three rounds later, and the documented readout is counted in
+#   engine stats["guarded_syncs"] (one per stride — PR 8's contract).
+# - LOCK_CHECKS wraps the documented serving locks to record actual
+#   acquisition-order edges (asserted acyclic online, and consistent
+#   with PTL004's static graph), and pins paged-pool allocator
+#   mutations to the engine-stepping thread.
+os.environ.setdefault("PADDLE_TPU_TRANSFER_CHECKS", "1")
+os.environ.setdefault("PADDLE_TPU_LOCK_CHECKS", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,620 @@
+"""The analyzers, analyzed: fixture snippets per check (a known
+violation that must FIRE and a known-clean twin that must NOT), the
+suppression + baseline round-trip, JSON schema stability, and the
+runtime lock-order watchdog's contract with the PTL004 static graph.
+
+Everything here is AST-level — no jax, no model, sub-second on CPU."""
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import (JSON_SCHEMA_VERSION, load_baseline,
+                                 lock_watchdog, run_analysis)
+from paddle_tpu.analysis.core import Report
+from paddle_tpu.analysis.locks import find_cycle
+from paddle_tpu.analysis.telemetry_names import TelemetryNameCheck
+
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+def _checks(report, check_id):
+    return [f for f in report.findings if f.check == check_id]
+
+
+# ---------------------------------------------------------------------------
+# PTL001 — host-sync detector
+# ---------------------------------------------------------------------------
+
+def test_ptl001_fires_on_hot_path_sync(tmp_path):
+    path = _write(tmp_path, "engine.py", """
+        import numpy as np
+
+        class Engine:
+            def step_begin(self):
+                n = int(self._lens[0])          # scalar D2H pull
+                arr = np.asarray(self._logits)  # implicit D2H
+                t = self._lens.tolist()         # sync by definition
+                return n, arr, t
+    """)
+    report = run_analysis([path])
+    msgs = [f.message for f in _checks(report, "PTL001")]
+    assert len(msgs) == 3, msgs
+    assert any("int()" in m for m in msgs)
+    assert any("np.asarray" in m for m in msgs)
+    assert any(".tolist()" in m for m in msgs)
+
+
+def test_ptl001_iteration_and_device_get(tmp_path):
+    path = _write(tmp_path, "engine.py", """
+        import jax
+
+        class Engine:
+            def step_finish(self, pending):
+                for t in pending.toks:          # one sync per element
+                    self.emit(t)
+                return jax.device_get(pending.counts)
+    """)
+    report = run_analysis([path])
+    assert len(_checks(report, "PTL001")) == 2
+
+
+def test_ptl001_clean_twin(tmp_path):
+    # host-only work in a hot path, device work in a COLD function, and
+    # nested jit bodies: none of it is a sync finding
+    path = _write(tmp_path, "engine.py", """
+        import numpy as np
+
+        class Engine:
+            def step_begin(self):
+                budgets = np.zeros(4, np.int32)     # host array, no
+                n = int(budgets[0])                 # device state
+                for b, slot in enumerate(self.slots):
+                    pass
+                def program(logits):                # jit body: traced,
+                    return int(logits.argmax())     # not a host sync
+                return n, program
+
+            def cold_helper(self):
+                return np.asarray(self._logits)     # not a hot path
+    """)
+    report = run_analysis([path])
+    assert _checks(report, "PTL001") == []
+
+
+# ---------------------------------------------------------------------------
+# PTL002 — retrace hazards
+# ---------------------------------------------------------------------------
+
+def test_ptl002_branch_on_traced_value(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        import jax.numpy as jnp
+
+        def f(x):
+            if jnp.any(x > 0):
+                return x
+            while jnp.sum(x) < 3:
+                x = x + 1
+            return x
+    """)
+    report = run_analysis([path])
+    assert len(_checks(report, "PTL002")) == 2
+
+
+def test_ptl002_static_metadata_is_clean(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        import jax
+        import jax.numpy as jnp
+
+        def f(x, v):
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                return x
+            if jnp.issubdtype(jnp.asarray(v).dtype, jnp.integer):
+                return x + 1
+            if jax.process_count() > 1:
+                return x + 2
+            return x
+    """)
+    report = run_analysis([path])
+    assert _checks(report, "PTL002") == []
+
+
+def test_ptl002_unhashable_static(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        import jax
+
+        def run(xs):
+            g = jax.jit(kernel, static_argnums=(1,))
+            return g(xs, slice(0, 4))       # slice as a static: PR-3 bug
+    """)
+    report = run_analysis([path])
+    found = _checks(report, "PTL002")
+    assert len(found) == 1 and "static_argnums" in found[0].message
+
+
+def test_ptl002_impurity_and_mutable_closure(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        import time
+        import jax
+
+        def build():
+            table = []
+
+            def program(x):
+                t = time.time()             # baked at trace time
+                return x + t + len(table)   # mutable closure
+
+            table.append(1)                 # mutated AFTER the def
+            return jax.jit(program)
+    """)
+    report = run_analysis([path])
+    msgs = [f.message for f in _checks(report, "PTL002")]
+    assert any("impure" in m for m in msgs)
+    assert any("closes over mutable" in m for m in msgs)
+
+
+def test_ptl002_frozen_closure_is_clean(tmp_path):
+    # build-then-capture: the dict is complete before the def and never
+    # mutated afterwards — the benign idiom must not fire
+    path = _write(tmp_path, "mod.py", """
+        import jax
+
+        def build(params):
+            table = {p: i for i, p in enumerate(params)}
+
+            def program(x):
+                return x + len(table)
+
+            return jax.jit(program)
+    """)
+    report = run_analysis([path])
+    assert _checks(report, "PTL002") == []
+
+
+# ---------------------------------------------------------------------------
+# PTL003 — donation
+# ---------------------------------------------------------------------------
+
+def test_ptl003_use_after_donation(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        import jax
+
+        def step(k_bufs, v_bufs):
+            fn = jax.jit(kernel, donate_argnums=(0,))
+            out = fn(k_bufs, v_bufs)
+            return k_bufs.shape, out        # k_bufs is DELETED on TPU
+    """)
+    report = run_analysis([path])
+    found = _checks(report, "PTL003")
+    assert len(found) == 1 and "k_bufs" in found[0].message
+
+
+def test_ptl003_rebind_is_clean(tmp_path):
+    # the canonical safe idiom: the donating call's result rebinds the
+    # name (including self-attribute donation, the adapter-cache shape)
+    path = _write(tmp_path, "mod.py", """
+        import jax
+
+        class Cache:
+            def upload(self, hostA):
+                self._set = jax.jit(set_row, donate_argnums=(0,))
+                self.A = self._set(self.A, hostA)
+                return self.A.shape
+
+        def step(x):
+            f = jax.jit(kernel, donate_argnums=(0,))
+            x = f(x)
+            return x + 1
+    """)
+    report = run_analysis([path])
+    assert _checks(report, "PTL003") == []
+
+
+# ---------------------------------------------------------------------------
+# PTL004 — lock discipline + lock-order graph
+# ---------------------------------------------------------------------------
+
+def test_ptl004_unguarded_mutation_fires(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        class Router:
+            def steal_block(self, eng, phys):
+                eng._quarantine.add(phys)       # not an engine class,
+                eng._tables[0, 0] = phys        # no lock held
+    """)
+    report = run_analysis([path])
+    found = _checks(report, "PTL004")
+    assert len(found) == 2
+    assert all("Router.steal_block" in f.message for f in found)
+
+
+def test_ptl004_engine_class_and_lock_are_clean(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        import heapq
+
+        class LLMEngine:
+            def _release(self, phys):
+                self._quarantine.add(phys)
+                heapq.heappush(self._free_blocks, phys)
+
+        class Server:
+            def evict(self, rid):
+                with self._hlock:
+                    self._handles.pop(rid, None)
+
+            def __init__(self):
+                self._handles = {}
+    """)
+    report = run_analysis([path])
+    assert _checks(report, "PTL004") == []
+
+
+def test_ptl004_lock_order_cycle(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        class A:
+            def one(self):
+                with self._hlock:
+                    with self._dispatch_lock:
+                        pass
+
+            def other(self):
+                with self._dispatch_lock:
+                    with self._hlock:
+                        pass
+    """)
+    report = run_analysis([path])
+    cyc = [f for f in _checks(report, "PTL004") if "cycle" in f.message]
+    assert len(cyc) == 1
+    graph = report.lock_graph
+    assert len(graph["edges"]) == 2 and graph["cycle"]
+
+
+def test_ptl004_multi_item_with_records_intra_statement_edge(tmp_path):
+    """`with A, B:` acquires left to right — it must contribute the
+    same A->B edge as nested withs, so an AB/BA deadlock written half
+    in each style still closes the cycle."""
+    path = _write(tmp_path, "mod.py", """
+        class A:
+            def one(self):
+                with self._hlock, self._dispatch_lock:
+                    pass
+
+            def other(self):
+                with self._dispatch_lock:
+                    with self._hlock:
+                        pass
+    """)
+    report = run_analysis([path])
+    assert len(report.lock_graph["edges"]) == 2
+    assert [f for f in _checks(report, "PTL004") if "cycle" in f.message]
+
+
+def test_find_cycle_helper():
+    assert find_cycle({("a", "b"), ("b", "c")}) is None
+    cyc = find_cycle({("a", "b"), ("b", "c"), ("c", "a")})
+    assert cyc is not None and cyc[0] == cyc[-1]
+
+
+# ---------------------------------------------------------------------------
+# PTL005 — telemetry strict names
+# ---------------------------------------------------------------------------
+
+def test_ptl005_unknown_names_fire(tmp_path):
+    registry = {"stage": {"emit"}, "counter": {"engine_steps"},
+                "gauge": {"queue_depth"}, "histogram": {"ttft_s"}}
+    path = _write(tmp_path, "mod.py", """
+        class Loop:
+            def run(self, tel):
+                tel.add_stage("emit", 0.1)            # known
+                tel.inc("engine_stepz")               # TYPO
+                tel.set_gauge("queue_depth", 3)       # known
+                self.telemetry.observe("ttft_sec", 1) # TYPO
+    """)
+    report = run_analysis([path], checks=[TelemetryNameCheck(registry)])
+    found = _checks(report, "PTL005")
+    assert len(found) == 2
+    assert {"engine_stepz" in f.message or "ttft_sec" in f.message
+            for f in found} == {True}
+
+
+def test_ptl005_register_declares_extension_names(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        class Loop:
+            def arm(self, tel):
+                tel.register("gauge", "my_extension_gauge")
+                tel.set_gauge("my_extension_gauge", 1.0)
+    """)
+    report = run_analysis([path])
+    assert _checks(report, "PTL005") == []
+
+
+def test_ptl005_real_registry_via_import(tmp_path):
+    # no serving_telemetry.py in the scanned tree: the check imports
+    # the real registry — real names pass, phantom names fire
+    path = _write(tmp_path, "mod.py", """
+        class Loop:
+            def run(self, tel):
+                tel.inc("engine_steps")
+                tel.set_gauge("not_a_real_gauge_name", 1)
+    """)
+    report = run_analysis([path])
+    found = _checks(report, "PTL005")
+    assert len(found) == 1 and "not_a_real_gauge_name" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# suppressions, baseline, schema, CLI
+# ---------------------------------------------------------------------------
+
+def test_suppression_with_reason(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        class E:
+            def step_begin(self):
+                # ptlint: disable=PTL001 -- documented one-time readout
+                a = np.asarray(self._logits)
+                b = np.asarray(self._lens)  # ptlint: disable=PTL001 -- same line form
+                return a, b
+    """)
+    report = run_analysis([path])
+    f1 = _checks(report, "PTL001")
+    assert len(f1) == 2 and all(f.suppressed for f in f1)
+    assert all(f.suppress_reason for f in f1)
+    assert report.exit_code == 0
+    assert _checks(report, "PTL000") == []
+
+
+def test_bare_suppression_is_ptl000(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        class E:
+            def step_begin(self):
+                # ptlint: disable=PTL001
+                return np.asarray(self._logits)
+    """)
+    report = run_analysis([path])
+    assert len(_checks(report, "PTL000")) == 1
+    assert all(f.suppressed for f in _checks(report, "PTL001"))
+    assert report.exit_code == 1        # the bare suppression itself
+
+
+def test_ptl000_cannot_suppress_itself(tmp_path):
+    """Listing PTL000 in a reasonless suppression must not hide the
+    missing-reason finding — PTL000 is baseline-only, by policy."""
+    path = _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        class E:
+            def step_begin(self):
+                a = np.asarray(self._logits)  # ptlint: disable=PTL001,PTL000
+                return a
+    """)
+    report = run_analysis([path])
+    ptl000 = _checks(report, "PTL000")
+    assert len(ptl000) == 1 and not ptl000[0].suppressed
+    assert report.exit_code == 1
+
+
+def test_ptl001_one_finding_per_nested_sync_expression(tmp_path):
+    """`int(pending.counts[0].item())` is ONE defect — the scan must
+    not double-report the nested `.item()` inside the flagged cast."""
+    path = _write(tmp_path, "mod.py", """
+        class E:
+            def step_finish(self, pending):
+                return int(pending.counts[0].item())
+    """)
+    report = run_analysis([path])
+    assert len(_checks(report, "PTL001")) == 1
+
+
+def test_ptl001_flagged_loop_body_still_scanned(tmp_path):
+    """A flagged `for ... in <device state>:` must not exempt the syncs
+    INSIDE its body — only the offending iter expression is deduped."""
+    path = _write(tmp_path, "mod.py", """
+        class E:
+            def step_finish(self, pending):
+                for t in pending.toks:          # finding 1: iteration
+                    x = float(self._lens[1])    # finding 2: scalar pull
+    """)
+    report = run_analysis([path])
+    assert len(_checks(report, "PTL001")) == 2
+
+
+def test_suppression_survives_blank_line_gap(tmp_path):
+    """A comment-only suppression governs the next CODE line even when
+    a blank line separates them."""
+    path = _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        class E:
+            def step_begin(self):
+                # ptlint: disable=PTL001 -- documented site
+
+                return np.asarray(self._logits)
+    """)
+    report = run_analysis([path])
+    f1 = _checks(report, "PTL001")
+    assert len(f1) == 1 and f1[0].suppressed
+    assert report.exit_code == 0
+
+
+def test_suppression_text_in_strings_is_inert(tmp_path):
+    """'ptlint: disable' inside docstrings/string literals documents the
+    syntax — it must neither suppress a finding nor trip PTL000 (only
+    real COMMENT tokens count, noqa-style)."""
+    path = _write(tmp_path, "mod.py", '''
+        """Docs: suppress with `# ptlint: disable=PTL001` on the line."""
+        import numpy as np
+
+        MSG = "# ptlint: disable=PTL001 -- just a string"
+
+        class E:
+            def step_begin(self):
+                return np.asarray(self._logits), MSG
+    ''')
+    report = run_analysis([path])
+    assert _checks(report, "PTL000") == []          # no bare-suppression
+    f1 = _checks(report, "PTL001")
+    assert len(f1) == 1 and not f1[0].suppressed    # string didn't hide it
+
+
+def test_ptl005_subtree_scan_uses_real_histogram_names(tmp_path):
+    """A subtree scan (registry module not in the scanned set) falls
+    back to parsing the real serving_telemetry source — histogram names
+    must come from its AST, not a hardcoded list that drifts."""
+    path = _write(tmp_path, "mod.py", """
+        class Loop:
+            def run(self, tel):
+                tel.observe("admission_stall_s", 0.1)   # real histogram
+                tel.observe("phantom_hist_s", 0.2)      # not declared
+    """)
+    report = run_analysis([path])
+    found = _checks(report, "PTL005")
+    assert len(found) == 1 and "phantom_hist_s" in found[0].message
+
+
+def test_baseline_round_trip(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        class E:
+            def step_begin(self):
+                return np.asarray(self._logits)
+    """)
+    report = run_analysis([path])
+    assert report.exit_code == 1
+    baseline_file = tmp_path / "analysis_baseline.json"
+    baseline_file.write_text(json.dumps(report.baseline_json()))
+    # grandfathered: same finding now baselined, exit 0
+    report2 = run_analysis([path], baseline=load_baseline(baseline_file))
+    assert report2.exit_code == 0
+    assert all(f.baselined for f in _checks(report2, "PTL001"))
+    # the fingerprint survives a line shift (comment added above)
+    shifted = _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        # an unrelated comment shifting every line number
+        class E:
+            def step_begin(self):
+                return np.asarray(self._logits)
+    """)
+    report3 = run_analysis([shifted],
+                           baseline=load_baseline(baseline_file))
+    assert report3.exit_code == 0
+    # fixing the finding leaves the baseline entry STALE, not failing
+    _write(tmp_path, "mod.py", """
+        class E:
+            def step_begin(self):
+                return None
+    """)
+    report4 = run_analysis([str(tmp_path / "mod.py")],
+                           baseline=load_baseline(baseline_file))
+    assert report4.exit_code == 0
+    assert sum(report4.stale_baseline.values()) == 1
+
+
+def test_json_schema_stability(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        class E:
+            def step_begin(self):
+                return np.asarray(self._logits)
+    """)
+    data = run_analysis([path]).to_json()
+    assert data["version"] == JSON_SCHEMA_VERSION == 1
+    assert set(data) == {"version", "checks", "summary", "findings",
+                         "stale_baseline", "lock_order_graph",
+                         "parse_errors"}
+    assert set(data["summary"]) == {"total", "new", "suppressed",
+                                    "baselined", "stale_baseline",
+                                    "parse_errors"}
+    f = data["findings"][0]
+    assert set(f) == {"check", "path", "line", "col", "func", "message",
+                      "key", "fingerprint", "suppressed",
+                      "suppress_reason", "baselined", "new"}
+    assert set(data["lock_order_graph"]) == {"edges", "cycle"}
+    # machine output is valid JSON end-to-end through the CLI
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", path, "--json",
+         "--no-baseline"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert json.loads(proc.stdout)["version"] == JSON_SCHEMA_VERSION
+
+
+def test_single_file_run_matches_tree_scan_fingerprints():
+    """Linting one file must yield package-rooted relpaths, so the
+    ALLOWED_SYNCS suffix allowlist and baseline fingerprints from a
+    whole-tree scan still apply (a developer lints just the file they
+    edited)."""
+    import paddle_tpu.inference.llm_engine as le
+    path = le.__file__
+    report = run_analysis([path])
+    assert all(f.path == "paddle_tpu/inference/llm_engine.py"
+               for f in report.findings)
+    # the documented step_finish readouts are allowlisted, the one
+    # deliberate site is inline-suppressed: nothing NEW
+    assert report.new_findings == [], \
+        [f.render() for f in report.new_findings]
+
+
+def test_check_ids_cover_ptl001_to_005(tmp_path):
+    report = run_analysis([_write(tmp_path, "empty.py", "x = 1\n")])
+    ids = {c.id for c in report.checks}
+    assert {"PTL000", "PTL001", "PTL002", "PTL003", "PTL004",
+            "PTL005"} <= ids
+    assert isinstance(report, Report)
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order watchdog vs the static graph
+# ---------------------------------------------------------------------------
+
+def test_watchdog_records_edges_and_catches_cycles(monkeypatch):
+    import threading
+    monkeypatch.setenv("PADDLE_TPU_LOCK_CHECKS", "1")
+    lock_watchdog.reset_edges()
+    a = lock_watchdog.tracked(threading.Lock(), "A")
+    b = lock_watchdog.tracked(threading.Lock(), "B")
+    assert isinstance(a, lock_watchdog.TrackedLock)
+    with a:
+        with b:
+            pass
+    assert lock_watchdog.observed_edges() == {("A", "B"): 1}
+    # the reverse nesting closes a cycle -> raises at acquisition
+    with pytest.raises(lock_watchdog.LockOrderError):
+        with b:
+            with a:
+                pass
+    # the offending edge was rolled back
+    assert ("B", "A") not in lock_watchdog.observed_edges()
+    lock_watchdog.reset_edges()
+
+
+def test_watchdog_disarmed_returns_lock_unchanged(monkeypatch):
+    import threading
+    monkeypatch.setenv("PADDLE_TPU_LOCK_CHECKS", "0")
+    lk = threading.Lock()
+    assert lock_watchdog.tracked(lk, "X") is lk
+
+
+def test_watchdog_consistency_vs_static_graph():
+    static = {("A", "B"): ("mod.py", 1), ("B", "C"): ("mod.py", 2)}
+    # observed edge matching the static order: fine; novel-but-
+    # consistent edge: returned, not fatal
+    novel = lock_watchdog.assert_consistent(
+        static, observed=[("A", "B"), ("A", "C")])
+    assert novel == [("A", "C")]
+    # observed edge CONTRADICTING the static order: fatal
+    with pytest.raises(lock_watchdog.LockOrderError):
+        lock_watchdog.assert_consistent(static, observed=[("C", "A")])
+    # (the repo-wide static graph's acyclicity is asserted by
+    # tests/test_analysis_clean.py off its cached whole-repo scan)

@@ -49,6 +49,8 @@ def test_to_static_buffer_mutation_batchnorm():
     assert not np.allclose(before, after)
 
 
+@pytest.mark.slow  # 9s E2E resnet train step (conftest wall-budget
+# policy); conv/BN training stays covered by the lighter steps here
 def test_train_step_resnet_tiny():
     paddle.seed(0)
     from paddle_tpu.vision.models import resnet18
@@ -964,6 +966,9 @@ def test_prefix_capture_amp_prefix_replays_with_policy():
     assert len(f._cache) == 2 and len(prefix_entries) >= 1
 
 
+@pytest.mark.slow  # 7s E2E bert-dropout train step (conftest
+# wall-budget policy); prefix-capture semantics stay covered by the
+# lighter capture tests above
 def test_prefix_capture_bert_dropout_training_step():
     """Model-zoo coverage (VERDICT r4 #6 'done ='): a bert-with-dropout
     TRAINING path with a mid-step host read keeps its prefix compiled —

@@ -342,6 +342,8 @@ def test_fused_linear_ce_matches_naive():
                                    err_msg=f"grad {name}")
 
 
+@pytest.mark.slow  # 8s (conftest wall-budget policy); the fused-head
+# CE path keeps tier-1 coverage via test_gpt_fused_head_loss_parity
 def test_bert_fused_head_loss_parity():
     """BertForMaskedLM(fuse_mlm_head_ce=True) trains to the same losses as
     the unfused head (fp32, tiny config)."""

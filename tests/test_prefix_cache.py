@@ -271,6 +271,9 @@ class TestPoolInvariantsChurn:
 
 
 class TestDeterministicLayout:
+    @pytest.mark.slow  # 8s: runs the whole workload twice for layout
+    # determinism (conftest wall-budget policy); functional prefix-cache
+    # parity stays tier-1 throughout this file
     def test_identical_runs_produce_identical_tables(self, tiny_model):
         """Allocation pops the smallest free index (order-stable heap),
         so two identical runs — including retirements and LRU churn

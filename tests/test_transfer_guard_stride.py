@@ -1,0 +1,245 @@
+"""The one-sync-per-stride contract as a runtime assertion: a stride-4
+all-decode serve step runs under ``jax.transfer_guard("disallow")``
+between dispatch and readout sync (PADDLE_TPU_TRANSFER_CHECKS=1, armed
+suite-wide by conftest), and the engine counts exactly ONE guarded D2H
+readout per stride — the regression fence for PR 8's headline claim.
+
+Mechanics (see LLMEngine._open_stride_guard): the guard is a
+thread-local jax config context the engine enters right after the
+multi-step dispatch and exits at the top of step_finish, so the whole
+host-side window between them runs transfer-disallowed. On the CPU test
+backend jax only intercepts SOME implicit transfers (scalar index pulls
+raise; zero-copy np.asarray does not), so the teeth here are
+(a) the window raising on the classic stray-sync pattern —
+``float(arr[0])`` — and (b) the guarded_syncs ledger proving one
+counted readout per stride, with greedy tokens identical to the
+unguarded stride-1 engine."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+V = 96
+STRIDE = 4
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(11)
+    cfg = LlamaConfig(vocab_size=V, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _prompts(seed, sizes):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, V, size=(n,)).astype(np.int32) for n in sizes]
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("chunk_size", 16)
+    return LLMEngine(model, scheduler="fused", cache_impl="paged",
+                     block_size=8, **kw)
+
+
+@pytest.fixture(scope="module")
+def stride4(tiny_model):
+    """ONE stride-4 engine shared by every test here (reset() between
+    tests keeps the compiled programs — recompiling per test would
+    triple the tier-1 cost)."""
+    return _engine(tiny_model, readout_stride=STRIDE)
+
+
+def _drain(eng, prompts, max_new=12):
+    rids = [eng.add_request(p, max_new_tokens=max_new) for p in prompts]
+    while eng.has_unfinished():
+        pending = eng.step_begin()
+        if pending is not None:
+            eng.step_finish(pending)
+    return [eng.finished_outputs[r].token_ids for r in rids]
+
+
+def test_engine_is_armed_by_conftest(stride4):
+    assert stride4._transfer_checks, \
+        "conftest must arm PADDLE_TPU_TRANSFER_CHECKS=1 for tier-1"
+
+
+def test_one_guarded_sync_per_stride_and_token_parity(tiny_model, stride4):
+    ref = _engine(tiny_model, readout_stride=1)
+    ref_out = _drain(ref, _prompts(3, [9, 13]))
+    assert ref.stats["guarded_syncs"] == 0      # stride-1: no window
+
+    eng = stride4.reset()
+    eng.reset_stats()
+    prompts = _prompts(3, [9, 13])
+    rids = [eng.add_request(p, max_new_tokens=12) for p in prompts]
+    strides = 0
+    while eng.has_unfinished():
+        before = eng.stats["guarded_syncs"]
+        pending = eng.step_begin()
+        if pending is None:
+            continue
+        if pending.guarded:
+            # the window is OPEN between dispatch and readout
+            assert eng._stride_guard is not None
+            strides += 1
+        eng.step_finish(pending)
+        assert eng._stride_guard is None        # closed at readout
+        # exactly one counted D2H per guarded stride, zero otherwise
+        assert eng.stats["guarded_syncs"] - before == \
+            (1 if pending.guarded else 0)
+    assert strides >= 2, "expected multiple all-decode strides"
+    assert eng.stats["guarded_syncs"] == strides
+    assert eng.stats["multi_steps"] == strides
+    out = [eng.finished_outputs[r].token_ids for r in rids]
+    assert out == ref_out, "guarded stride-4 diverged from stride-1"
+
+
+def test_stray_sync_inside_window_raises(stride4):
+    """The teeth: the classic stray-sync pattern — a scalar pull off a
+    device array between dispatch and readout — raises under the armed
+    window instead of silently billing the stride's latency budget."""
+    eng = stride4.reset()
+    for p in _prompts(5, [9, 13]):
+        eng.add_request(p, max_new_tokens=8)
+    saw_window = False
+    while eng.has_unfinished():
+        pending = eng.step_begin()
+        if pending is None:
+            continue
+        if pending.guarded and not saw_window:
+            saw_window = True
+            with pytest.raises(Exception, match="[Dd]isallow"):
+                float(eng._lens[0])     # the stray sync PTL001 flags
+        eng.step_finish(pending)
+    assert saw_window, "no all-decode stride window opened"
+
+
+def test_guard_survives_reset_and_drain(stride4):
+    """reset() (the supervised-restart hook) must close an open window
+    — a leaked thread-local disallow context would poison every later
+    readout on the serve-loop thread."""
+    eng = stride4.reset()
+    for p in _prompts(7, [9, 13]):
+        eng.add_request(p, max_new_tokens=8)
+    # ramp past prefill until an all-decode stride opens the window
+    opened = False
+    for _ in range(64):
+        pending = eng.step_begin()
+        if pending is None:
+            break
+        if pending.guarded:
+            opened = True
+            break                      # crash here: finish never runs
+        eng.step_finish(pending)
+    assert opened
+    assert eng._stride_guard is not None
+    eng.reset()
+    assert eng._stride_guard is None
+    # the thread's transfer-guard state is clean: implicit pulls work
+    import jax.numpy as jnp
+    assert float(jnp.float32(3.0)) == 3.0
+    # and the engine serves fresh traffic normally after the restart
+    out = _drain(eng, _prompts(9, [6]), max_new=4)
+    assert len(out[0]) == 4
+
+
+def test_pipelined_strides_are_not_counted_as_guarded(tiny_model, stride4):
+    """Depth-2 pipelining closes each stride's window early (the
+    chained dispatch legitimately re-opens H2D traffic) — those strides
+    must NOT be counted in guarded_syncs: the counter only reports
+    windows that actually held dispatch→readout."""
+    import collections
+    eng = stride4.reset()
+    eng.reset_stats()
+    for p in _prompts(17, [9, 13]):
+        eng.add_request(p, max_new_tokens=10)
+    pending = collections.deque()
+    while eng.has_unfinished() or pending:
+        while len(pending) < 2 and eng.has_unfinished():
+            p = eng.step_begin()
+            if p is None:
+                break
+            pending.append(p)
+        if pending:
+            eng.step_finish(pending.popleft())
+    assert eng._stride_guard is None            # no leaked window
+    assert eng.stats["multi_steps"] >= 2
+    # every window was narrowed by a chained begin or a younger finish:
+    # the honest count is zero, not multi_steps
+    assert eng.stats["guarded_syncs"] == 0
+
+
+def test_embed_engine_closes_interleaved_window(stride4):
+    """Every engine speaking the step protocol shares the per-thread
+    window slot: a BertEmbedEngine step on a thread whose LLM stride
+    window is open must close it (its readout must not run inside
+    another engine's disallow window — green on CPU, dead on TPU)."""
+    from paddle_tpu.inference.llm_engine import close_thread_stride_guard
+    from paddle_tpu.serving import embedding as emb
+
+    eng = stride4.reset()
+    for p in _prompts(19, [9, 13]):
+        eng.add_request(p, max_new_tokens=8)
+    opened = None
+    for _ in range(64):
+        pending = eng.step_begin()
+        if pending is None:
+            break
+        if pending.guarded:
+            opened = pending
+            break
+        eng.step_finish(pending)
+    assert opened is not None and eng._stride_guard is not None
+    # the embed engine's step protocol uses the same close helper the
+    # LLM engine does — simulate its entry on this thread
+    assert emb.close_thread_stride_guard is close_thread_stride_guard
+    emb.close_thread_stride_guard()
+    assert eng._stride_guard is None
+    # the early close revoked the stride's guarded accounting
+    assert opened.guarded is False
+    before = eng.stats["guarded_syncs"]
+    eng.step_finish(opened)
+    assert eng.stats["guarded_syncs"] == before
+    eng.reset()
+
+
+def test_cross_thread_reset_never_poisons_the_stepping_thread(stride4):
+    """A jax transfer guard is thread-local: a reset() from ANOTHER
+    thread (router failover, external supervisor) must not corrupt —
+    and cannot close — the stepping thread's window. The stepping
+    thread heals its own leaked window on its next engine call."""
+    import threading
+    import jax.numpy as jnp
+
+    eng = stride4.reset()
+    for p in _prompts(13, [9, 13]):
+        eng.add_request(p, max_new_tokens=8)
+    opened = False
+    for _ in range(64):
+        pending = eng.step_begin()
+        if pending is None:
+            break
+        if pending.guarded:
+            opened = True
+            break                      # window open on THIS thread
+        eng.step_finish(pending)
+    assert opened and eng._stride_guard is not None
+    t = threading.Thread(target=eng.reset)
+    t.start()
+    t.join()
+    # the other thread's reset left this thread's window alone ...
+    assert eng._stride_guard is not None
+    # ... and this thread's next engine entry heals it
+    eng.reset()
+    assert eng._stride_guard is None
+    assert float(jnp.float32(2.0)) == 2.0   # no disallow residue
+    out = _drain(eng, _prompts(15, [6]), max_new=4)
+    assert len(out[0]) == 4
