@@ -1143,6 +1143,157 @@ def _bench_other(model_name):
                 "block_size": block, "horizon": horizon,
                 "telemetry_artifact": art_path}
 
+    if model_name == "llama_serve_kv_quant":
+        # Quantized-KV serving A/B: the SAME model/workload served by
+        # LLMEngine(cache_impl="paged", scheduler="fused") with the pool
+        # at bf16 vs int8 vs int4 — every arm's pool sized to the SAME
+        # HBM BYTE BUDGET (the bf16 arm's oversubscribed pool bytes), so
+        # the quantized arms hold ~2x/~4x the blocks. What the capacity
+        # buys shows up as fewer preemptions / more resident slots /
+        # higher tok/s on the memory-bound decode phase; what it costs
+        # shows up in the greedy token-drift metric vs the bf16 arm
+        # (exact-match prefix length + first divergence step per
+        # request).
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import LLMEngine
+        from paddle_tpu.serving import AsyncLLMServer
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+        n_req = int(os.environ.get("BENCH_REQUESTS", str(2 * B)))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        chunk = int(os.environ.get("BENCH_CHUNK", "256"))
+        block = int(os.environ.get("BENCH_BLOCK", "64"))
+        prompt_len = int(os.environ.get("BENCH_PROMPT", "256"))
+        # the bf16 arm's pool covers this fraction of the full
+        # (never-preempts) block demand — <1 = oversubscribed, so the
+        # capacity lever has preemptions to convert into residency
+        pool_frac = float(os.environ.get("BENCH_POOL_FRAC", "0.5"))
+        cap = -(-(prompt_len + new_tokens) // chunk) * chunk
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=heads,
+                          max_position_embeddings=cap)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg).bfloat16()
+        model.eval()
+        V = cfg.vocab_size
+        prompts = [rng.integers(0, V, (prompt_len - 7 + int(x),)).astype(
+            np.int32) for x in rng.integers(0, 15, size=n_req)]
+        full_blocks = B * (cap // block)
+        bf16_blocks = max(int(full_blocks * pool_frac), B + 1)
+
+        _bpb_cache = {}
+
+        def pool_blocks_for(dtype):
+            # equal-HBM sizing through the engine's own byte arithmetic
+            # (kv_bytes_per_block counts payload + scale arrays) — one
+            # minimum-size probe engine per dtype, memoized
+            if dtype not in _bpb_cache:
+                probe = LLMEngine(model, max_batch=B, max_seq_len=cap,
+                                  chunk_size=chunk, cache_impl="paged",
+                                  block_size=block, scheduler="fused",
+                                  kv_pool_blocks=B + 1,
+                                  kv_cache_dtype=dtype)
+                _bpb_cache[dtype] = probe.kv_bytes_per_block()
+                del probe
+            return _bpb_cache[dtype]
+
+        budget = bf16_blocks * pool_blocks_for(None)
+
+        def run_arm(dtype):
+            n_blocks = min(budget // pool_blocks_for(dtype), full_blocks)
+            eng = LLMEngine(model, max_batch=B, max_seq_len=cap,
+                            chunk_size=chunk, cache_impl="paged",
+                            block_size=block, scheduler="fused",
+                            kv_pool_blocks=n_blocks, kv_cache_dtype=dtype)
+            warm = rng.integers(0, V, (3,)).astype(np.int32)
+            eng.generate([warm], max_new_tokens=2)
+            eng.reset_stats()
+            server = AsyncLLMServer(eng, max_queue_size=n_req + 1)
+            server.start()
+            t0 = time.perf_counter()
+            handles = [server.submit(p, max_new_tokens=new_tokens)
+                       for p in prompts]
+            slot_samples = []
+            outs = []
+            # short result polls double as resident-slot samples; the
+            # wall deadline keeps a pathological config (e.g. a pool
+            # oversubscribed into ramp thrash) a loud failure, not a
+            # hang
+            deadline = t0 + 1800
+            for h in handles:
+                while True:
+                    try:
+                        outs.append(h.result(timeout=0.05))
+                        break
+                    except TimeoutError:
+                        if time.perf_counter() > deadline:
+                            raise
+                        slot_samples.append(
+                            sum(1 for s in eng.slots if s is not None))
+            wall = time.perf_counter() - t0
+            server.stop()
+            toks = sum(len(o.token_ids) for o in outs)
+            return {
+                "kv_cache_dtype": dtype or "bf16",
+                "tokens_per_sec": round(toks / wall, 1),
+                "pool_blocks": n_blocks,
+                "effective_blocks": eng.kv_pool_effective_blocks(),
+                "pool_bytes": eng.kv_pool_nbytes(),
+                "preemptions": eng.stats["preemptions"],
+                "mean_resident_slots": round(
+                    float(np.mean(slot_samples)) if slot_samples else
+                    float(B), 2),
+            }, [list(o.token_ids) for o in outs]
+
+        def drift(ref_toks, arm_toks):
+            # greedy drift vs the bf16 arm: exact-match prefix length and
+            # the first divergence step, per request
+            prefixes, first_div = [], None
+            for ref, got in zip(ref_toks, arm_toks):
+                n = 0
+                for a, b2 in zip(ref, got):
+                    if a != b2:
+                        break
+                    n += 1
+                prefixes.append(n)
+                if (n < min(len(ref), len(got)) or len(ref) != len(got)) \
+                        and (first_div is None or n < first_div):
+                    first_div = n
+            return {"min_match_prefix": int(min(prefixes)),
+                    "mean_match_prefix": round(float(np.mean(prefixes)), 1),
+                    "first_divergence_step": first_div,
+                    "token_parity": first_div is None}
+
+        bf16_arm, bf16_toks = run_arm(None)
+        int8_arm, int8_toks = run_arm("int8")
+        int4_arm, int4_toks = run_arm("int4")
+        int8_arm["drift_vs_bf16"] = drift(bf16_toks, int8_toks)
+        int4_arm["drift_vs_bf16"] = drift(bf16_toks, int4_toks)
+        art_path = os.path.join(_artifact_dir(), "llama_serve_kv_quant.json")
+        with open(art_path, "w") as f:
+            json.dump({"bf16": bf16_arm, "int8": int8_arm,
+                       "int4": int4_arm}, f, indent=1)
+        return {"metric": "llama_serve_kv_quant_tokens_per_sec",
+                "value": int8_arm["tokens_per_sec"],
+                "unit": "tokens/s", "vs_baseline": None,
+                "bf16": bf16_arm, "int8": int8_arm, "int4": int4_arm,
+                "int8_speedup": round(
+                    int8_arm["tokens_per_sec"]
+                    / max(bf16_arm["tokens_per_sec"], 1e-9), 3),
+                "int4_speedup": round(
+                    int4_arm["tokens_per_sec"]
+                    / max(bf16_arm["tokens_per_sec"], 1e-9), 3),
+                "requests": n_req, "slots": B, "new_tokens": new_tokens,
+                "prompt_len": prompt_len, "chunk": chunk,
+                "block_size": block, "pool_frac": pool_frac,
+                "full_blocks": full_blocks,
+                "telemetry_artifact": art_path}
+
     if model_name == "llama_serve_cluster":
         # Multichip serving A/B (paddle_tpu/serving/cluster.py): ONE
         # replica vs BENCH_REPLICAS replicas fronted by the prefix-
@@ -1990,6 +2141,7 @@ def _run_all():
              {"BENCH_MODEL": "llama_decode", "BENCH_WEIGHT_DTYPE": "int4"}),
             ("llama_paged_decode", None), ("llama_serve", None),
             ("llama_serve_fused", None), ("llama_serve_prefix_cache", None),
+            ("llama_serve_kv_quant", None),
             ("llama_serve_cluster", None), ("llama_serve_spec", None),
             ("llama_serve_lora", None), ("llama_serve_embed", None),
             ("llama", None)]:

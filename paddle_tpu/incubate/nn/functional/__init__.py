@@ -543,6 +543,52 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
                     name="fused_multi_head_attention")
 
 
+def _kv_quant_scatter(pool, scales, wblk, slot, rows, quant, D,
+                      end_rows):
+    """Merge new token rows into a QUANTIZED block pool — the dense
+    fallback's write rule, shared by the decode and append forms: the
+    affected blocks dequantize, take the new rows, ZERO their dead tail
+    (rows at or past ``end_rows`` — stale content of a reused freed
+    block; attention always masks those positions, but an unmasked
+    absmax would let a dirty block's garbage inflate the scale and
+    crush the live rows' resolution), recompute their per-(block, head)
+    absmax scale, and re-quantize; every untouched block keeps its
+    exact int payload and scale (no silent re-rounding of blocks
+    nothing wrote). ``wblk``/``slot``/``rows``/``end_rows`` are flat
+    write coordinates (block index ``pool.shape[0]`` = out-of-range
+    drop, the decode form's -1-table contract; ``end_rows[i]`` = live
+    row COUNT of block ``wblk[i]`` after this write). O(pool) compute —
+    acceptable on the CPU/tier-1 path this fallback serves; the TPU
+    path is the in-VMEM Pallas variant.
+
+    Returns ``(pool, scales)`` updated."""
+    from ....ops.kernels.paged_attention import (
+        kv_block_scale, kv_quantize, kv_unpack)
+
+    nb, _, bs, _ = pool.shape
+    written = jnp.zeros((nb + 1,), bool).at[wblk].set(True)[:nb]
+    live_end = jnp.full((nb + 1,), bs, jnp.int32) \
+        .at[wblk].set(end_rows.astype(jnp.int32), mode="drop")[:nb]
+    pf = kv_unpack(pool, quant, D) * scales[..., None, None]
+    pf = pf.at[wblk, :, slot].set(rows.astype(jnp.float32), mode="drop")
+    dead = jnp.arange(bs)[None, None, :] >= live_end[:, None, None]
+    pf = jnp.where(dead[..., None], jnp.float32(0.0), pf)
+    new_s = kv_block_scale(pf, quant, axes=(2, 3))        # [NB, Hkv]
+    pq = kv_quantize(pf, new_s[..., None, None], quant)
+    pool = jnp.where(written[:, None, None, None], pq, pool)
+    scales = jnp.where(written[:, None], new_s, scales)
+    return pool, scales
+
+
+def _kv_quant_gather(pool, scales, safe_tables, quant, D):
+    """Per-sequence logical KV off a QUANTIZED pool: gather the table's
+    blocks, dequantize with their per-(block, head) scales -> f32
+    [B, MB, Hkv, bs, D] for the dense attention math."""
+    from ....ops.kernels.paged_attention import kv_unpack
+    return kv_unpack(pool[safe_tables], quant, D) * \
+        scales[safe_tables][..., None, None]
+
+
 def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
                               seq_lens_decoder, seq_lens_this_time,
                               padding_offsets=None, cum_offsets=None,
@@ -551,7 +597,7 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
                               pre_value_cache=None, cache_k_quant_scales=None,
                               cache_v_quant_scales=None, max_seq_len=None,
                               block_size=None, use_neox_style=False,
-                              name=None):
+                              cache_quant_type=None, name=None):
     """Paged-KV-cache decode attention (reference:
     incubate/nn/functional/block_multihead_attention.py, phi
     block_multi_head_attention_kernel.cu — the vLLM-style paged attention).
@@ -582,25 +628,49 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     the caller ignores. Routes through
     :func:`~paddle_tpu.ops.kernels.paged_attention.paged_attention_append`
     on TPU; the dense scatter+gather+einsum below is the CPU fallback.
+
+    Quantized pools (``cache_quant_type="int8"|"int4"`` — the serving
+    engine's ``kv_cache_dtype``; the reference signature's
+    ``cache_k_quant_scales``/``cache_v_quant_scales`` carry the
+    per-(physical block, kv head) fp32 scale arrays [num_blocks, Hkv]):
+    both forms dequantize blocks on read and re-quantize every written
+    block with a fresh absmax scale, returning the updated scale arrays
+    after the pools — ``(out, key_cache, value_cache, k_scales,
+    v_scales)``. On TPU the dequant/requant happens in VMEM inside the
+    Pallas kernels; the dense fallback below does the same math at the
+    XLA level (host-runnable, the tier-1 path). int4 packs two nibbles
+    per pool byte along D (split-half layout, even head_dim here — the
+    kernel itself also supports odd D with nibble padding).
     """
     if block_tables is None:
         raise ValueError("block_mha requires block_tables")
+    quant = cache_quant_type
+    if quant and (cache_k_quant_scales is None
+                  or cache_v_quant_scales is None):
+        raise ValueError("cache_quant_type needs cache_k_quant_scales and "
+                         "cache_v_quant_scales ([num_blocks, Hkv] fp32)")
     if len(qkv.shape) == 3:
         if seq_lens_this_time is None:
             raise ValueError("append-step block_mha (3-D qkv) requires "
                              "seq_lens_this_time (per-sequence q_lens)")
         return _block_mha_append(qkv, key_cache, value_cache,
                                  seq_lens_decoder, seq_lens_this_time,
-                                 block_tables)
-
-    def fn(qkv_v, kc, vc, lens, tables):
+                                 block_tables, cache_k_quant_scales,
+                                 cache_v_quant_scales, quant)
+    def fn(qkv_v, kc, vc, lens, tables, *qargs):
         from ....ops.kernels.paged_attention import (
             current_paged_tp, paged_attention_decode,
             paged_attention_decode_tp, paged_attention_enabled)
 
-        nb, Hkv, bs, D = kc.shape
+        nb, Hkv, bs, Dp = kc.shape
         b = qkv_v.shape[0]
         max_blocks = tables.shape[1]
+        if quant:
+            ks, vs = (a.astype(jnp.float32) for a in qargs)
+            D = _quant_head_dim(qkv_v.shape[1], Hkv, Dp, quant)
+        else:
+            ks = vs = None
+            D = Dp
         Hq = qkv_v.shape[1] // D - 2 * Hkv
         q = qkv_v[:, :Hq * D].reshape(b, Hq, D)
         knew = qkv_v[:, Hq * D:(Hq + Hkv) * D].reshape(b, Hkv, D)
@@ -613,13 +683,20 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
             if tp is not None:
                 # TP serving engine: a pallas_call cannot be GSPMD-
                 # partitioned, so the kernel shard_maps over the tp axis
-                # (kv-head shards; tables/lens replicated)
-                out, kc, vc = paged_attention_decode_tp(
+                # (kv-head shards; tables/lens/scales replicated along
+                # their non-head dims)
+                outs = paged_attention_decode_tp(
                     q, kc, vc, tables, lens, mesh=tp[0], axis=tp[1],
-                    new_k=knew, new_v=vnew)
+                    new_k=knew, new_v=vnew, k_scale=ks, v_scale=vs,
+                    quant=quant)
             else:
-                out, kc, vc = paged_attention_decode(
-                    q, kc, vc, tables, lens, new_k=knew, new_v=vnew)
+                outs = paged_attention_decode(
+                    q, kc, vc, tables, lens, new_k=knew, new_v=vnew,
+                    k_scale=ks, v_scale=vs, quant=quant)
+            if quant:
+                out, kc, vc, ks, vs = outs
+                return out.reshape(b, Hq * D), kc, vc, ks, vs
+            out, kc, vc = outs
             return out.reshape(b, Hq * D), kc, vc
 
         # write the new token at position lens[i] of sequence i. A -1 table
@@ -631,13 +708,25 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         blk_idx = tables[jnp.arange(b), lens // bs]       # [B] physical block
         slot = lens % bs                                  # [B]
         wblk = jnp.where(blk_idx >= 0, blk_idx, nb)       # nb = out of range
-        kc = kc.at[wblk, :, slot].set(knew, mode="drop")
-        vc = vc.at[wblk, :, slot].set(vnew, mode="drop")
+        if quant:
+            # quantized merge: dead tail past the new token zeroed,
+            # fresh absmax scale per written block
+            kc, ks = _kv_quant_scatter(kc, ks, wblk, slot, knew, quant,
+                                       D, slot + 1)
+            vc, vs = _kv_quant_scatter(vc, vs, wblk, slot, vnew, quant,
+                                       D, slot + 1)
+        else:
+            kc = kc.at[wblk, :, slot].set(knew, mode="drop")
+            vc = vc.at[wblk, :, slot].set(vnew, mode="drop")
 
         # gather each sequence's logical KV [B, max_blocks*bs, Hkv, D]
         safe_tables = jnp.maximum(tables, 0)
-        kseq = kc[safe_tables]                            # [B, MB, Hkv, bs, D]
-        vseq = vc[safe_tables]
+        if quant:
+            kseq = _kv_quant_gather(kc, ks, safe_tables, quant, D)
+            vseq = _kv_quant_gather(vc, vs, safe_tables, quant, D)
+        else:
+            kseq = kc[safe_tables]                        # [B, MB, Hkv, bs, D]
+            vseq = vc[safe_tables]
         kseq = jnp.moveaxis(kseq, 3, 2).reshape(b, max_blocks * bs, Hkv, D)
         vseq = jnp.moveaxis(vseq, 3, 2).reshape(b, max_blocks * bs, Hkv, D)
 
@@ -650,28 +739,61 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         logits = jnp.where(visible[:, None, None, :], logits, -jnp.inf)
         probs = jax.nn.softmax(logits, axis=-1).astype(vseq.dtype)
         out = jnp.einsum("bhgt,bthd->bhgd", probs, vseq)
+        if quant:
+            return (out.astype(qkv_v.dtype).reshape(b, Hq * D),
+                    kc, vc, ks, vs)
         return out.reshape(b, Hq * D), kc, vc
 
-    return dispatch(fn, (qkv, key_cache, value_cache, seq_lens_decoder,
-                         block_tables), {}, name="block_multihead_attention")
+    args = (qkv, key_cache, value_cache, seq_lens_decoder, block_tables)
+    if quant:
+        args += (cache_k_quant_scales, cache_v_quant_scales)
+        return dispatch(fn, args, {}, name="block_mha_decode_quant")
+    return dispatch(fn, args, {}, name="block_multihead_attention")
+
+
+def _quant_head_dim(qkv_width, Hkv, Dp, quant):
+    """Head dim D of a quantized-pool call, from the qkv row width and
+    the PACKED pool head dim Dp. int8 stores D bytes (D == Dp); int4
+    packs two per byte, so D is 2*Dp — or 2*Dp - 1 for an odd head dim,
+    disambiguated by which one divides the qkv width into a whole
+    (GQA-consistent) head count. Odd-D models this can't disambiguate
+    should call the Pallas kernel directly (serving models have even
+    head dims)."""
+    if quant == "int8":
+        return Dp
+    D = 2 * Dp
+    if qkv_width % D == 0 and (qkv_width // D - 2 * Hkv) > 0 \
+            and (qkv_width // D - 2 * Hkv) % Hkv == 0:
+        return D
+    return D - 1
 
 
 def _block_mha_append(qkv, key_cache, value_cache, seq_lens, q_lens,
-                      block_tables):
+                      block_tables, k_scales=None, v_scales=None,
+                      quant=None):
     """Append-step paged attention (see block_multihead_attention): S new
     positions per sequence against the block pools, causal within the
     chunk. Dense fallback = scatter the valid rows into their blocks
     (invalid rows route out of range and drop), gather each sequence's
     padded horizon, einsum with the per-row causal mask — the same
-    reference semantics the decode form uses, extended along S."""
-    def fn(qkv_v, kc, vc, lens, qlens, tables):
+    reference semantics the decode form uses, extended along S.
+    ``quant`` + scale arrays: quantized pools (dequant-on-read, window
+    blocks re-quantized under fresh absmax scales; return grows the
+    updated scale arrays)."""
+    def fn(qkv_v, kc, vc, lens, qlens, tables, *qargs):
         from ....ops.kernels.paged_attention import (
             current_paged_tp, paged_attention_append,
             paged_attention_append_tp, paged_attention_enabled)
 
-        nb, Hkv, bs, D = kc.shape
+        nb, Hkv, bs, Dp = kc.shape
         b, S = qkv_v.shape[0], qkv_v.shape[1]
         max_blocks = tables.shape[1]
+        if quant:
+            ks, vs = (a.astype(jnp.float32) for a in qargs)
+            D = _quant_head_dim(qkv_v.shape[2], Hkv, Dp, quant)
+        else:
+            ks = vs = None
+            D = Dp
         Hq = qkv_v.shape[2] // D - 2 * Hkv
         q = qkv_v[:, :, :Hq * D].reshape(b, S, Hq, D)
         knew = qkv_v[:, :, Hq * D:(Hq + Hkv) * D].reshape(b, S, Hkv, D)
@@ -683,12 +805,18 @@ def _block_mha_append(qkv, key_cache, value_cache, seq_lens, q_lens,
         if paged_attention_enabled():
             tp = current_paged_tp()
             if tp is not None:
-                out, kc, vc = paged_attention_append_tp(
+                outs = paged_attention_append_tp(
                     q, kc, vc, tables, lens, qlens, knew, vnew,
-                    mesh=tp[0], axis=tp[1])
+                    mesh=tp[0], axis=tp[1], k_scale=ks, v_scale=vs,
+                    quant=quant)
             else:
-                out, kc, vc = paged_attention_append(
-                    q, kc, vc, tables, lens, qlens, knew, vnew)
+                outs = paged_attention_append(
+                    q, kc, vc, tables, lens, qlens, knew, vnew,
+                    k_scale=ks, v_scale=vs, quant=quant)
+            if quant:
+                out, kc, vc, ks, vs = outs
+                return out.reshape(b, S, Hq * D), kc, vc, ks, vs
+            out, kc, vc = outs
             return out.reshape(b, S, Hq * D), kc, vc
 
         # scatter valid rows: row i of sequence b lands at absolute
@@ -705,15 +833,33 @@ def _block_mha_append(qkv, key_cache, value_cache, seq_lens, q_lens,
                          phys, nb)                            # nb = OOB
         slot = pos % bs
         wf, sf = wblk.reshape(-1), slot.reshape(-1)
-        kc = kc.at[wf, :, sf].set(knew.reshape(-1, Hkv, D), mode="drop")
-        vc = vc.at[wf, :, sf].set(vnew.reshape(-1, Hkv, D), mode="drop")
+        if quant:
+            # live row count of each written block: the window's new end
+            # (lens + q_lens) relative to the block start, clipped
+            ends = jnp.clip((lens + qlens)[:, None] - blk_log * bs, 0, bs)
+            ef = ends.reshape(-1)
+            kc, ks = _kv_quant_scatter(kc, ks, wf, sf,
+                                       knew.reshape(-1, Hkv, D), quant, D,
+                                       ef)
+            vc, vs = _kv_quant_scatter(vc, vs, wf, sf,
+                                       vnew.reshape(-1, Hkv, D), quant, D,
+                                       ef)
+        else:
+            kc = kc.at[wf, :, sf].set(knew.reshape(-1, Hkv, D),
+                                      mode="drop")
+            vc = vc.at[wf, :, sf].set(vnew.reshape(-1, Hkv, D),
+                                      mode="drop")
 
         # gather each sequence's logical KV and attend with the per-row
         # causal mask: kv position t visible to chunk row i iff
         # t <= lens + i
         safe_tables = jnp.maximum(tables, 0)
-        kseq = kc[safe_tables]                       # [B, MB, Hkv, bs, D]
-        vseq = vc[safe_tables]
+        if quant:
+            kseq = _kv_quant_gather(kc, ks, safe_tables, quant, D)
+            vseq = _kv_quant_gather(vc, vs, safe_tables, quant, D)
+        else:
+            kseq = kc[safe_tables]                   # [B, MB, Hkv, bs, D]
+            vseq = vc[safe_tables]
         kseq = jnp.moveaxis(kseq, 3, 2).reshape(b, max_blocks * bs, Hkv, D)
         vseq = jnp.moveaxis(vseq, 3, 2).reshape(b, max_blocks * bs, Hkv, D)
         sc = 1.0 / math.sqrt(D)
@@ -726,10 +872,16 @@ def _block_mha_append(qkv, key_cache, value_cache, seq_lens, q_lens,
         logits = jnp.where(visible[:, None, :, None, :], logits, -jnp.inf)
         probs = jax.nn.softmax(logits, axis=-1).astype(vseq.dtype)
         out = jnp.einsum("bhsgt,bthd->bshgd", probs, vseq)
+        if quant:
+            return (out.astype(qkv_v.dtype).reshape(b, S, Hq * D),
+                    kc, vc, ks, vs)
         return out.reshape(b, S, Hq * D), kc, vc
 
-    return dispatch(fn, (qkv, key_cache, value_cache, seq_lens, q_lens,
-                         block_tables), {}, name="block_mha_append")
+    args = (qkv, key_cache, value_cache, seq_lens, q_lens, block_tables)
+    if quant:
+        args += (k_scales, v_scales)
+        return dispatch(fn, args, {}, name="block_mha_append_quant")
+    return dispatch(fn, args, {}, name="block_mha_append")
 
 
 def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,

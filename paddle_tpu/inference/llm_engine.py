@@ -311,7 +311,7 @@ class LLMEngine:
                  block_size=64, kv_pool_blocks=None, scheduler="legacy",
                  max_step_tokens=None, enable_prefix_cache=False,
                  readout_stride=1, adapter_store=None,
-                 adapter_cache_slots=4):
+                 adapter_cache_slots=4, kv_cache_dtype=None):
         """``scheduler="fused"`` (Sarathi-style chunked-prefill+decode
         fusion): admission becomes slot ASSIGNMENT only — each engine step
         then processes, per slot, either one bounded prefill chunk (for
@@ -358,7 +358,27 @@ class LLMEngine:
         append into content another request still references gets a
         private COPY first (copy-on-write — the partial tail block is
         always private). Greedy output is token-exact vs the uncached
-        engine; the LRU evicts before any live slot is preempted."""
+        engine; the LRU evicts before any live slot is preempted.
+
+        ``kv_cache_dtype`` ("int8" | "int4", paged only — QUANTIZED KV
+        serving, the capacity lever): the physical K/V pools store
+        int8 (or int4 nibble-packed on the head dim) with one fp32
+        scale per (physical block, kv head) riding alongside, so the
+        same HBM holds ~2x/~4x the resident blocks. The Pallas
+        decode/append kernels dequantize blocks in VMEM during the
+        online-softmax walk and re-quantize every fused write in VMEM
+        (fresh per-head absmax scale computed in-kernel); the CPU dense
+        fallback does the same math at the XLA level, so tier-1 stays
+        host-runnable. Everything ABOVE the pool — block tables,
+        allocator, prefix-cache content hashing (host-side over token
+        ids), COW, the write fence, speculative rollback — operates on
+        block indices and is quantization-oblivious; scale arrays shard
+        kv-heads under a TP mesh exactly like the pools. ``None`` (the
+        default) is bit-identical to the bf16 engine. Output tokens
+        DRIFT from bf16 (that is the deal: ~2x/4x capacity for a
+        quantization error of ~0.4%/~7% per KV read); the serve bench's
+        ``llama_serve_kv_quant`` A/B and tests/test_kv_quant.py track
+        greedy drift explicitly."""
         from ..jit.functional_call import collect_state, read_values
 
         self.model = model
@@ -518,6 +538,20 @@ class LLMEngine:
                              "paged pool's table indirection; the dense "
                              "per-slot buffers have nothing to share)")
         self.prefix_cache = bool(enable_prefix_cache)
+        if kv_cache_dtype is not None:
+            if kv_cache_dtype not in ("int8", "int4"):
+                raise ValueError(
+                    f"unknown kv_cache_dtype {kv_cache_dtype!r} "
+                    f"(supported: 'int8', 'int4', None)")
+            if cache_impl != "paged":
+                raise ValueError(
+                    "kv_cache_dtype needs cache_impl='paged' — per-block "
+                    "quantization scales live in the paged pool's block "
+                    "granularity; the dense per-slot buffers have no "
+                    "block to scale over")
+        #: KV-pool quantization mode (None = bf16 pools, bit-identical
+        #: to the pre-quantization engine)
+        self.kv_quant = kv_cache_dtype
         if cache_impl == "paged":
             if self.speculative_k > 1 and scheduler != "fused":
                 raise ValueError(
@@ -652,12 +686,38 @@ class LLMEngine:
             # on a real block (the XLA fallback drops such rows with an
             # out-of-range scatter; a kernel block write needs a real
             # destination)
-            pool_shape = (self.n_blocks + 1, self._kvh, self.block_size,
-                          self._head_dim)
-            self._k = [self._make_zeros(pool_shape, self._np_dt,
-                                        self._kv_spec) for _ in range(L)]
-            self._v = [self._make_zeros(pool_shape, self._np_dt,
-                                        self._kv_spec) for _ in range(L)]
+            if self.kv_quant:
+                # QUANTIZED pools: int8 payload (int4 nibble-packs two
+                # head-dim elements per byte) + one fp32 scale per
+                # (physical block, kv head), bundled as (pool, scale)
+                # tuples so every step program, donation list and
+                # sharding pin carries the pair as one pytree leaf-set.
+                # Zero pools under zero scales dequantize to exact zeros
+                # — the same cold state as the bf16 pools. The scale
+                # array shares the paged _kv_spec (axis 1 = kv heads).
+                from ..ops.kernels.paged_attention import kv_packed_dim
+                dp = kv_packed_dim(self._head_dim, self.kv_quant)
+                pool_shape = (self.n_blocks + 1, self._kvh,
+                              self.block_size, dp)
+                scale_shape = (self.n_blocks + 1, self._kvh)
+
+                def quant_pool():
+                    return (self._make_zeros(pool_shape, np.int8,
+                                             self._kv_spec),
+                            self._make_zeros(scale_shape, np.float32,
+                                             self._kv_spec))
+
+                self._k = [quant_pool() for _ in range(L)]
+                self._v = [quant_pool() for _ in range(L)]
+            else:
+                pool_shape = (self.n_blocks + 1, self._kvh,
+                              self.block_size, self._head_dim)
+                self._k = [self._make_zeros(pool_shape, self._np_dt,
+                                            self._kv_spec)
+                           for _ in range(L)]
+                self._v = [self._make_zeros(pool_shape, self._np_dt,
+                                            self._kv_spec)
+                           for _ in range(L)]
             self._tables = np.full((self.B, self._max_blocks), -1, np.int32)
             #: min-heap of free physical blocks: allocation always pops
             #: the SMALLEST free index, so physical layout is a pure
@@ -720,6 +780,9 @@ class LLMEngine:
         #: (a crashed dispatch may have consumed its stacks through
         #: donation) — the next adapter dispatch rebuilds and re-swaps
         self.adapter_cache = None
+        #: pool bytes incl. scale arrays, cached once per (re)build — the
+        #: flight recorder stamps it on every StepRecord
+        self._kv_nbytes = self.kv_pool_nbytes()
 
     def reset(self):
         """Tear the engine down to EMPTY and re-arm it — the supervised
@@ -729,7 +792,9 @@ class LLMEngine:
         and (paged) pool/table/content-store binding drops; the device
         buffers are rebuilt from zeros (a crashed dispatch may have
         consumed the old ones through buffer donation, so they cannot be
-        trusted or even touched). What SURVIVES: the compiled programs
+        trusted or even touched) — on a quantized engine that includes
+        the per-block scale arrays, rebuilt alongside their pools (zero
+        scales over zero payloads dequantize to the same cold state). What SURVIVES: the compiled programs
         (identical shapes/shardings — a restart costs no recompile), the
         request-id counter (rids stay unique across restarts), the
         engine's cumulative ``stats``, the rid-keyed draft-acceptance
@@ -780,8 +845,12 @@ class LLMEngine:
             _rep_sh = NamedSharding(self._mesh, _P())
 
             def _pin_kv(bufs):
-                return [jax.lax.with_sharding_constraint(b, _kv_sh)
-                        for b in bufs]
+                # tree_map: a quantized pool entry is a (payload, scale)
+                # TUPLE — the paged P(None, tp) spec pins both (axis 1 is
+                # kv heads on the 4-D payload and the 2-D scale alike)
+                return jax.tree_util.tree_map(
+                    lambda b: jax.lax.with_sharding_constraint(b, _kv_sh),
+                    list(bufs))
 
             def _pin_rep(x):
                 return jax.lax.with_sharding_constraint(x, _rep_sh)
@@ -791,6 +860,34 @@ class LLMEngine:
 
             def _pin_rep(x):
                 return x
+
+        kvq = self.kv_quant
+
+        def paged_caches(kb, vb, tables, lens, q_lens=None):
+            """Per-layer PagedKVCache list of one traced dispatch — THE
+            one place that unpacks the quantized (payload, scale) pool
+            bundles, so no step body can forget the scales."""
+            from ..models.llama import PagedKVCache
+            if kvq:
+                return [PagedKVCache(k[0], v[0], tables, lens, q_lens,
+                                     k_scale=k[1], v_scale=v[1],
+                                     quant=kvq)
+                        for k, v in zip(kb, vb)]
+            return [PagedKVCache(k, v, tables, lens, q_lens)
+                    for k, v in zip(kb, vb)]
+
+        def unpack_kv(new_caches):
+            """Updated (k_bufs, v_bufs) lists off a model call's returned
+            caches — re-bundling (payload, scale) tuples on quantized
+            engines. Works for every cache class (dense slot buffers
+            have no scales and kvq is then always None)."""
+            def val(x):
+                return x._value if isinstance(x, Tensor) else x
+            if kvq:
+                return ([(val(cc.k), val(cc.k_scale)) for cc in new_caches],
+                        [(val(cc.v), val(cc.v_scale)) for cc in new_caches])
+            return ([val(cc.k) for cc in new_caches],
+                    [val(cc.v) for cc in new_caches])
 
         K = self.horizon
 
@@ -840,9 +937,7 @@ class LLMEngine:
                     caches = [SlotKVCache(k, v, lens)
                               for k, v in zip(k_bufs, v_bufs)]
                 else:
-                    from ..models.llama import PagedKVCache
-                    caches = [PagedKVCache(k, v, tables, lens)
-                              for k, v in zip(k_bufs, v_bufs)]
+                    caches = paged_caches(k_bufs, v_bufs, tables, lens)
                 hidden, new_caches = model.llama(
                     Tensor(nxt[:, None]), kv_caches=caches,
                     position_offset=Tensor(lens))
@@ -852,10 +947,7 @@ class LLMEngine:
             # scan iterations — a slot deactivated non-terminally (pool
             # budget clamp) samples from them next step
             new_logits = jnp.where(active[:, None], new_logits, logits)
-            kb = [cc.k._value if isinstance(cc.k, Tensor) else cc.k
-                  for cc in new_caches]
-            vb = [cc.v._value if isinstance(cc.v, Tensor) else cc.v
-                  for cc in new_caches]
+            kb, vb = unpack_kv(new_caches)
             new_lens = jnp.where(active, lens + 1, lens)
             finished = active & (nxt == eos_ids)
             return nxt, new_logits, kb, vb, new_lens, finished, rng
@@ -1108,19 +1200,14 @@ class LLMEngine:
                             caches = [ChunkKVCache(k, v, ln, q_eff)
                                       for k, v in zip(kb, vb)]
                         else:
-                            from ..models.llama import PagedKVCache
-                            caches = [PagedKVCache(k, v, tables, ln,
-                                                   q_eff)
-                                      for k, v in zip(kb, vb)]
+                            caches = paged_caches(kb, vb, tables, ln,
+                                                  q_eff)
                         hidden, new_caches = model.llama(
                             Tensor(window), kv_caches=caches,
                             position_offset=Tensor(ln))
                         logits_win = model._logits(hidden)._value \
                             .astype(jnp.float32)          # [B, Kspec, V]
-                    kb = [cc.k._value if isinstance(cc.k, Tensor)
-                          else cc.k for cc in new_caches]
-                    vb = [cc.v._value if isinstance(cc.v, Tensor)
-                          else cc.v for cc in new_caches]
+                    kb, vb = unpack_kv(new_caches)
                     counts, _, new_lg = verify_window(
                         logits_win, draft, ln, q_eff, rng, temps, top_ps,
                         rids, act)
@@ -1231,9 +1318,8 @@ class LLMEngine:
                     caches = [ChunkKVCache(k, v, lens, q_eff)
                               for k, v in zip(k_bufs, v_bufs)]
                 else:
-                    from ..models.llama import PagedKVCache
-                    caches = [PagedKVCache(k, v, tables, lens, q_eff)
-                              for k, v in zip(k_bufs, v_bufs)]
+                    caches = paged_caches(k_bufs, v_bufs, tables, lens,
+                                          q_eff)
                 hidden, new_caches = model.llama(
                     Tensor(ids), kv_caches=caches,
                     position_offset=Tensor(lens))
@@ -1265,10 +1351,7 @@ class LLMEngine:
                 pooled = pooled + jnp.einsum(
                     "bsh,bs->bh", hidden._value.astype(jnp.float32),
                     emb_mask)
-            kb = [cc.k._value if isinstance(cc.k, Tensor) else cc.k
-                  for cc in new_caches]
-            vb = [cc.v._value if isinstance(cc.v, Tensor) else cc.v
-                  for cc in new_caches]
+            kb, vb = unpack_kv(new_caches)
             if spec_ks is None:
                 new_logits = jnp.where(active[:, None], new_logits, logits)
                 new_lens = lens + q_eff
@@ -1340,21 +1423,33 @@ class LLMEngine:
             bs_blk = self.block_size
             MB = self._max_blocks
 
+            head_d = self._head_dim
+
             def prefill_chunk_paged(state_vals, k_pools, v_pools, ids,
                                     table_row, off, last, lora=None):
                 """Paged chunked prefill: gather the slot's logical KV from
                 its blocks, run the chunk like the dense path, scatter the
-                chunk's new KV back into the (block-aligned) blocks."""
+                chunk's new KV back into the (block-aligned) blocks.
+                Quantized pools gather DEQUANTIZED (f32) and scatter back
+                re-quantized: each written block is whole-chunk content,
+                so its fresh per-head absmax scale needs no merge with
+                old rows."""
+                from ..ops.kernels.paged_attention import (
+                    kv_block_scale, kv_quantize, kv_unpack)
                 z = jnp.int32(0)
                 safe = jnp.maximum(table_row, 0)
-                # gather [MB, H, bs, D] blocks -> the slot's logical
-                # [1, MB*bs, H, D] sequence the dense chunk path expects
-                k_slot = [jnp.moveaxis(p[safe], 2, 1).reshape(
-                    1, MB * bs_blk, p.shape[1], p.shape[3])
-                    for p in k_pools]
-                v_slot = [jnp.moveaxis(p[safe], 2, 1).reshape(
-                    1, MB * bs_blk, p.shape[1], p.shape[3])
-                    for p in v_pools]
+
+                def gather(p):
+                    if kvq:
+                        blks = kv_unpack(p[0][safe], kvq, head_d) * \
+                            p[1][safe][..., None, None]
+                    else:
+                        blks = p[safe]
+                    return jnp.moveaxis(blks, 2, 1).reshape(
+                        1, MB * bs_blk, blks.shape[1], blks.shape[3])
+
+                k_slot = [gather(p) for p in k_pools]
+                v_slot = [gather(p) for p in v_pools]
                 with functional_mode(), _bind(state, state_vals), \
                         lora_scope(lora):
                     caches = [StaticKVCache(k, v)
@@ -1382,6 +1477,24 @@ class LLMEngine:
                         new_rows.reshape(nblk, bs_blk, h, d), 1, 2)
                     phys = jax.lax.dynamic_slice(
                         table_row, (off // bs_blk,), (nblk,))
+                    if kvq:
+                        payload, scales = pool
+                        blks = blks.astype(jnp.float32)
+                        # zero the chunk's PADDING rows (chunk index >
+                        # last): their token-id-0 KV must not ride the
+                        # absmax scale — and the stored bytes then match
+                        # what the fused append path writes for the same
+                        # prefix (it never writes padding rows at all)
+                        ridx = jnp.arange(nblk)[:, None] * bs_blk + \
+                            jnp.arange(bs_blk)[None, :]    # [nblk, bs]
+                        dead = (ridx > last)[:, None, :, None]
+                        blks = jnp.where(dead, jnp.float32(0.0), blks)
+                        s_new = kv_block_scale(blks, kvq,
+                                               axes=(2, 3))  # [nblk, H]
+                        return (payload.at[phys].set(
+                                    kv_quantize(blks, s_new[..., None,
+                                                            None], kvq)),
+                                scales.at[phys].set(s_new))
                     return pool.at[phys].set(blks.astype(pool.dtype))
 
                 k_out = [scatter(p, (cc.k._value if isinstance(cc.k, Tensor)
@@ -1400,9 +1513,14 @@ class LLMEngine:
                 ``src`` into ``dst`` across every layer's K/V pool. One
                 jitted program, src/dst traced — no recompile per copy.
                 Block-index ops only, so under TP each shard clones its
-                own kv-head slice — no cross-shard traffic."""
-                return (_pin_kv([p.at[dst].set(p[src]) for p in k_pools]),
-                        _pin_kv([p.at[dst].set(p[src]) for p in v_pools]))
+                own kv-head slice — no cross-shard traffic. tree_map
+                clones a quantized pool's payload AND its per-block
+                scale row in one rule (scale[src] is block src's row —
+                the clone is bit-exact, so COW never re-rounds)."""
+                def cp(p):
+                    return p.at[dst].set(p[src])
+                return (_pin_kv(jax.tree_util.tree_map(cp, list(k_pools))),
+                        _pin_kv(jax.tree_util.tree_map(cp, list(v_pools))))
 
             self._cow_fn = jax.jit(cow_copy, donate_argnums=(0, 1))
 
@@ -2128,6 +2246,45 @@ class LLMEngine:
         chip)."""
         return self._tp_size
 
+    # ------------------------------------------------------------------
+    # KV-pool capacity accounting (quantized serving)
+    # ------------------------------------------------------------------
+    def kv_pool_nbytes(self):
+        """Total (global) device bytes of the paged K/V pools INCLUDING
+        the quantization scale arrays; 0 on dense engines. Summed off
+        the real buffers' shapes, so the capacity acceptance (an int8
+        pool fits >= 1.9x, int4 >= 3.5x the bf16 block count at equal
+        HBM bytes) is asserted against what is actually allocated, not
+        a side formula."""
+        if self.cache_impl != "paged":
+            return 0
+        return sum(int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize
+                   for x in jax.tree_util.tree_leaves([self._k, self._v]))
+
+    def kv_bytes_per_block(self):
+        """Device bytes ONE pool block costs across all layers (K + V
+        payload plus its per-head scales) — what the serve bench's
+        equal-byte pool sizing divides a HBM budget by."""
+        if self.cache_impl != "paged":
+            return 0
+        return self.kv_pool_nbytes() // (self.n_blocks + 1)
+
+    def kv_pool_effective_blocks(self):
+        """Pool capacity in BF16-EQUIVALENT blocks: how many unquantized
+        blocks the pool's HBM bytes would have held — n_blocks on an
+        unquantized pool, ~2x/~4x n_blocks under int8/int4 (minus the
+        scale overhead). The ``kv_pool_effective_blocks`` Prometheus
+        gauge samples this: capacity dashboards read one number that is
+        comparable across pool dtypes."""
+        if self.cache_impl != "paged":
+            return 0
+        if not self.kv_quant:
+            return self.n_blocks
+        unquant = self._n_layers * 2 * self._kvh * self.block_size * \
+            self._head_dim * np.dtype(self._np_dt).itemsize
+        return int(self.n_blocks * unquant
+                   // max(self.kv_bytes_per_block(), 1))
+
     def max_pipeline_depth(self):
         """How many step_begin() dispatches may be in flight at once.
 
@@ -2538,6 +2695,12 @@ class LLMEngine:
                                if self.prefix_cache else None),
             cached_blocks=len(self._lru) if self.prefix_cache else None,
             readout_stride=readout_stride,
+            # quantized-KV capacity facts: pool bytes (payload + scales)
+            # and the pool storage dtype — what joins a preemption-churn
+            # tail back to "the pool was simply small"
+            kv_pool_bytes=self._kv_nbytes if paged else None,
+            kv_cache_dtype=(self.kv_quant or str(np.dtype(self._np_dt)))
+            if paged else None,
             # per-slot TENANT ids + this step's adapter swap-ins (the
             # explain_tail "adapter_swap" cause reads them back)
             adapter_slots=tuple(

@@ -189,14 +189,24 @@ class PagedKVCache:
     REAL rows in an S>1 window — sequence b appends positions
     [seq_lens[b], seq_lens[b]+q_lens[b]) (a prefill chunk, one decode
     token, or 0 = idle slot; rows past q_lens are padding). Required for
-    S>1; None keeps the one-token decode-step contract."""
+    S>1; None keeps the one-token decode-step contract.
 
-    __slots__ = ("k", "v", "block_tables", "seq_lens", "q_lens")
+    ``quant`` + ``k_scale``/``v_scale`` (the engine's ``kv_cache_dtype``):
+    the pools are int8/int4 QUANTIZED storage (int4 nibble-packed on the
+    head dim) with per-(physical block, kv head) fp32 scale arrays
+    [num_blocks, Hkv] riding alongside — the attention op dequantizes on
+    read and returns updated scales with the pools."""
 
-    def __init__(self, k, v, block_tables, seq_lens, q_lens=None):
+    __slots__ = ("k", "v", "block_tables", "seq_lens", "q_lens",
+                 "k_scale", "v_scale", "quant")
+
+    def __init__(self, k, v, block_tables, seq_lens, q_lens=None,
+                 k_scale=None, v_scale=None, quant=None):
         self.k, self.v = k, v
         self.block_tables, self.seq_lens = block_tables, seq_lens
         self.q_lens = q_lens
+        self.k_scale, self.v_scale = k_scale, v_scale
+        self.quant = quant
 
 
 class ChunkKVCache:
@@ -374,6 +384,10 @@ class LlamaAttention(Layer):
             # num_heads, K/V the (possibly smaller) num_kv_heads.
             from ..incubate.nn import functional as IF
             H, Hkv, D = self.num_heads, self.num_kv_heads, self.head_dim
+            kvq = kv_cache.quant
+            qargs = dict(cache_k_quant_scales=kv_cache.k_scale,
+                         cache_v_quant_scales=kv_cache.v_scale,
+                         cache_quant_type=kvq) if kvq else {}
             if s != 1:
                 # fused mixed step: S rows per slot, q_lens of them real —
                 # the APPEND form of the op (Pallas append kernel on TPU,
@@ -385,22 +399,30 @@ class LlamaAttention(Layer):
                 qkv = ops.concat([ops.reshape(q, [b, s, H * D]),
                                   ops.reshape(k, [b, s, Hkv * D]),
                                   ops.reshape(v, [b, s, Hkv * D])], axis=-1)
-                out, kc, vc = IF.block_multihead_attention(
+                outs = IF.block_multihead_attention(
                     qkv, kv_cache.k, kv_cache.v, None, kv_cache.seq_lens,
-                    kv_cache.q_lens, block_tables=kv_cache.block_tables)
+                    kv_cache.q_lens, block_tables=kv_cache.block_tables,
+                    **qargs)
+                out, kc, vc = outs[:3]
+                ks, vs = outs[3:] if kvq else (None, None)
                 out = o_proj(ops.reshape(out, [b, s, H * D]))
                 return out, PagedKVCache(
                     kc, vc, kv_cache.block_tables,
-                    kv_cache.seq_lens + kv_cache.q_lens, kv_cache.q_lens)
+                    kv_cache.seq_lens + kv_cache.q_lens, kv_cache.q_lens,
+                    k_scale=ks, v_scale=vs, quant=kvq)
             qkv = ops.concat([ops.reshape(q, [b, H * D]),
                               ops.reshape(k, [b, Hkv * D]),
                               ops.reshape(v, [b, Hkv * D])], axis=-1)
-            out, kc, vc = IF.block_multihead_attention(
+            outs = IF.block_multihead_attention(
                 qkv, kv_cache.k, kv_cache.v, None, kv_cache.seq_lens, None,
-                block_tables=kv_cache.block_tables)
+                block_tables=kv_cache.block_tables, **qargs)
+            out, kc, vc = outs[:3]
+            ks, vs = outs[3:] if kvq else (None, None)
             out = o_proj(ops.reshape(out, [b, 1, H * D]))
             new_lens = kv_cache.seq_lens + 1
-            return out, PagedKVCache(kc, vc, kv_cache.block_tables, new_lens)
+            return out, PagedKVCache(kc, vc, kv_cache.block_tables,
+                                     new_lens, k_scale=ks, v_scale=vs,
+                                     quant=kvq)
         if isinstance(kv_cache, ChunkKVCache):
             # fused mixed step, dense cache: write slot b's q_lens[b] real
             # rows at positions lens[b]+i via a DROP scatter (padding and

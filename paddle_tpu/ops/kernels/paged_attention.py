@@ -56,6 +56,22 @@ KV, and the whole chunk's K/V writes back to the pools in-kernel (the
 write can span several blocks; each overlapped block is merged in VMEM
 and stored through the aliased pool outputs). Same gating: TPU fast path
 behind ``FLAGS_use_paged_attention``, dense append fallback on CPU.
+
+**Quantized KV pools** (``quant="int8"|"int4"``, the serving engine's
+``kv_cache_dtype``): the physical pools store int8 (or int4
+nibble-packed on D — see :func:`kv_unpack` for the split-half layout)
+with one fp32 scale per (physical block, kv head) riding in
+``k_scale``/``v_scale`` [num_blocks, Hkv] arrays. Both kernels
+dequantize each block IN VMEM during the online-softmax walk
+(``int * scale`` right after the block DMA — HBM traffic shrinks by
+2x/4x, the f32 attention math is unchanged), and the fused write
+re-quantizes IN VMEM too: the written block is merged in f32, its new
+per-head absmax scale computed in-kernel, and the int payload + scale
+store back through aliased outputs — no bf16 block ever round-trips to
+HBM. Scale granularity is deliberately per-(block, head): one f32 per
+``block_size * head_dim`` ints (<0.1% overhead), coarse enough to ride
+the scalar path, fine enough that one outlier head can't flatten the
+whole pool.
 """
 from __future__ import annotations
 
@@ -74,6 +90,76 @@ NEG_INF = np.float32(-1e30)
 # index-map literals MUST be i32: python ints become i64 constants under the
 # framework's jax_enable_x64 and Mosaic then fails to legalize the index maps
 Z = np.int32(0)
+
+
+#: symmetric integer grid per KV quantization mode. int4 uses [-7, 7]
+#: (not -8) so the grid is symmetric and the absmax scale is exact at
+#: both ends; the nibble stores the value offset by +8 (range [1, 15]).
+KV_QMAX = {"int8": 127.0, "int4": 7.0}
+
+
+def kv_packed_dim(D, quant):
+    """Last (head) dim of the quantized pool storage: D int8 bytes for
+    int8, ceil(D/2) bytes for int4 (two nibbles per byte; odd D pads one
+    nibble — see :func:`kv_unpack`)."""
+    if quant is None:
+        return D
+    if quant == "int8":
+        return D
+    if quant == "int4":
+        return (D + 1) // 2
+    raise ValueError(f"unknown kv quant dtype {quant!r}")
+
+
+def kv_unpack(vals, quant, D):
+    """Quantized storage -> UNSCALED f32 integer grid values, last dim
+    packed->D. int4 uses a SPLIT-HALF layout (Mosaic-friendly: no
+    per-element interleave): byte j of a row holds element ``j`` in its
+    low nibble and element ``Dp + j`` (Dp = ceil(D/2)) in its high
+    nibble, each stored offset-8 (q + 8 in [1, 15]); odd D leaves the
+    final high nibble as padding, sliced off here."""
+    if quant == "int8":
+        return vals.astype(jnp.float32)
+    b = vals.astype(jnp.int32) & 0xFF
+    lo = (b & 0xF) - 8
+    hi = ((b >> 4) & 0xF) - 8
+    return jnp.concatenate([lo, hi], axis=-1)[..., :D] \
+        .astype(jnp.float32)
+
+
+def kv_pack(q, quant):
+    """Integer grid values (f32/int, already clipped to the symmetric
+    grid) -> int8 storage, packing nibble pairs for int4 in the
+    split-half layout of :func:`kv_unpack`."""
+    q = q.astype(jnp.int32)
+    if quant == "int8":
+        return q.astype(jnp.int8)
+    D = q.shape[-1]
+    Dp = (D + 1) // 2
+    if 2 * Dp != D:
+        pad = jnp.zeros(q.shape[:-1] + (1,), q.dtype)
+        q = jnp.concatenate([q, pad], axis=-1)
+    lo = q[..., :Dp] + 8
+    hi = q[..., Dp:] + 8
+    return (lo | (hi << 4)).astype(jnp.int8)
+
+
+def kv_quantize(x, scale, quant):
+    """f32 values + (broadcastable) per-block scale -> packed storage:
+    round-to-nearest-even onto the symmetric grid, clip, pack."""
+    qmax = np.float32(KV_QMAX[quant])
+    q = jnp.clip(jnp.round(x / jnp.maximum(scale, np.float32(1e-20))),
+                 -qmax, qmax)
+    return kv_pack(q, quant)
+
+
+def kv_block_scale(x, quant, axes):
+    """Absmax scale of one (or a batch of) f32 block(s) over ``axes``:
+    THE one copy of the scale rule — the Pallas fused writes, the XLA
+    dense fallback, and the engine's prefill scatter all compute the
+    block scale through here, so kernel-vs-fallback parity holds to
+    rounding."""
+    return jnp.max(jnp.abs(x), axis=axes) / np.float32(KV_QMAX[quant])
 
 
 def _interpret():
@@ -137,7 +223,8 @@ def _tp_shard_map(fn, mesh, axis, in_specs, out_specs):
 
 def paged_attention_decode_tp(q, k_pool, v_pool, block_tables, seq_lens,
                               mesh, axis="tp", scale=None, new_k=None,
-                              new_v=None):
+                              new_v=None, k_scale=None, v_scale=None,
+                              quant=None):
     """:func:`paged_attention_decode` sharded over a tensor-parallel mesh
     axis: kv-heads (pool dim 1) split across ``axis`` and each shard runs
     the unmodified kernel on its local head group — the grid's
@@ -148,55 +235,80 @@ def paged_attention_decode_tp(q, k_pool, v_pool, block_tables, seq_lens,
     (kv-head-major GQA layout: q heads [h*G, (h+1)*G) follow kv head h,
     so an even kv-head split carries its q groups with it). No collective
     is issued — attention output heads stay sharded and the caller's
-    o_proj (row-parallel) reduces them."""
+    o_proj (row-parallel) reduces them. Quantized pools (``quant``)
+    thread their per-(block, head) scale arrays with the SAME kv-head
+    sharding (scale dim 1 == pool dim 1), so each shard quantizes its
+    own heads — the per-head absmax rule makes the sharded result
+    bit-identical to single-chip."""
     from jax.sharding import PartitionSpec as P
 
     write_new = new_k is not None
     q_spec = P(None, axis, None)
     pool_spec = P(None, axis, None, None)
+    scale_spec = P(None, axis)
     in_specs = [q_spec, pool_spec, pool_spec, P(), P()]
     out_specs = [q_spec, pool_spec, pool_spec] if write_new else q_spec
+    args = [q, k_pool, v_pool, block_tables, seq_lens]
+    if quant:
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale, v_scale]
+        if write_new:
+            out_specs += [scale_spec, scale_spec]
     if write_new:
         in_specs += [P(None, axis, None), P(None, axis, None)]
+        args += [new_k, new_v]
 
-        def body(q_s, k_s, v_s, tables, lens, nk_s, nv_s):
-            return paged_attention_decode(q_s, k_s, v_s, tables, lens,
-                                          scale=scale, new_k=nk_s,
-                                          new_v=nv_s)
-
-        return _tp_shard_map(body, mesh, axis, in_specs, out_specs)(
-            q, k_pool, v_pool, block_tables, seq_lens, new_k, new_v)
-
-    def body(q_s, k_s, v_s, tables, lens):
+    def body(q_s, k_s, v_s, tables, lens, *rest):
+        if quant:
+            ks_s, vs_s, *rest = rest
+        else:
+            ks_s = vs_s = None
+        nk_s, nv_s = rest if rest else (None, None)
         return paged_attention_decode(q_s, k_s, v_s, tables, lens,
-                                      scale=scale)
+                                      scale=scale, new_k=nk_s, new_v=nv_s,
+                                      k_scale=ks_s, v_scale=vs_s,
+                                      quant=quant)
 
-    return _tp_shard_map(body, mesh, axis, in_specs, out_specs)(
-        q, k_pool, v_pool, block_tables, seq_lens)
+    return _tp_shard_map(body, mesh, axis, in_specs, out_specs)(*args)
 
 
 def paged_attention_append_tp(q, k_pool, v_pool, block_tables, seq_lens,
                               q_lens, new_k, new_v, mesh, axis="tp",
-                              scale=None):
+                              scale=None, k_scale=None, v_scale=None,
+                              quant=None):
     """:func:`paged_attention_append` sharded over a tensor-parallel mesh
     axis — the mixed prefill+decode step's kernel under the TP serving
     engine. Same layout contract as the decode wrapper: pools/new-KV/q
-    shard on their head dims, tables/seq_lens/q_lens replicated, output
-    heads stay sharded for the row-parallel o_proj to reduce."""
+    (and, quantized, the per-(block, head) scale arrays) shard on their
+    head dims, tables/seq_lens/q_lens replicated, output heads stay
+    sharded for the row-parallel o_proj to reduce."""
     from jax.sharding import PartitionSpec as P
 
     pool_spec = P(None, axis, None, None)
+    scale_spec = P(None, axis)
     q_spec = P(None, None, axis, None)          # [B, S, Hq, D]
-    in_specs = [q_spec, pool_spec, pool_spec, P(), P(), P(),
-                q_spec, q_spec]                 # new_k/new_v [B, S, Hkv, D]
+    in_specs = [q_spec, pool_spec, pool_spec, P(), P(), P()]
     out_specs = [q_spec, pool_spec, pool_spec]
+    args = [q, k_pool, v_pool, block_tables, seq_lens, q_lens]
+    if quant:
+        in_specs += [scale_spec, scale_spec]
+        out_specs += [scale_spec, scale_spec]
+        args += [k_scale, v_scale]
+    in_specs += [q_spec, q_spec]                # new_k/new_v [B, S, Hkv, D]
+    args += [new_k, new_v]
 
-    def body(q_s, k_s, v_s, tables, lens, qlens, nk_s, nv_s):
+    def body(q_s, k_s, v_s, tables, lens, qlens, *rest):
+        if quant:
+            ks_s, vs_s, nk_s, nv_s = rest
+        else:
+            ks_s = vs_s = None
+            nk_s, nv_s = rest
         return paged_attention_append(q_s, k_s, v_s, tables, lens, qlens,
-                                      nk_s, nv_s, scale=scale)
+                                      nk_s, nv_s, scale=scale,
+                                      k_scale=ks_s, v_scale=vs_s,
+                                      quant=quant)
 
-    return _tp_shard_map(body, mesh, axis, in_specs, out_specs)(
-        q, k_pool, v_pool, block_tables, seq_lens, q_lens, new_k, new_v)
+    return _tp_shard_map(body, mesh, axis, in_specs, out_specs)(*args)
 
 
 def _last_live(lens_ref, b, bs, mb):
@@ -235,9 +347,35 @@ def _pool_out_index_map(bs, mb, nb):
     return im
 
 
+def _scale_index_map(bs, mb):
+    """Per-(block, head) scale READ window of one grid step: the same
+    physical block the K/V BlockSpec maps (2-D: scales are
+    [num_blocks, Hkv])."""
+    def im(b, h, j, tables_ref, lens_ref):
+        j_last = _last_live(lens_ref, b, bs, mb)
+        jj = jnp.minimum(j, j_last)
+        return (jnp.maximum(tables_ref[b, jj], Z), h)
+    return im
+
+
+def _scale_out_index_map(bs, mb, nb):
+    """Scale WRITE destination of the fused quantized write: the same
+    last-live (or scratch) block the pool out map routes to."""
+    def im(b, h, j, tables_ref, lens_ref):
+        phys = tables_ref[b, _last_live(lens_ref, b, bs, mb)]
+        return (jnp.where(phys < Z, np.int32(nb - 1), phys), h)
+    return im
+
+
 def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest, scale,
-                   bs, mb, write_new):
-    if write_new:
+                   bs, mb, write_new, quant=None, d_head=None):
+    if quant:
+        if write_new:
+            (ks_ref, vs_ref, nk_ref, nv_ref, o_ref, ko_ref, vo_ref,
+             kso_ref, vso_ref, m_ref, l_ref, acc_ref) = rest
+        else:
+            ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    elif write_new:
         nk_ref, nv_ref, o_ref, ko_ref, vo_ref, m_ref, l_ref, acc_ref = rest
     else:
         o_ref, m_ref, l_ref, acc_ref = rest
@@ -260,6 +398,11 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest, scale,
 
     k_blk = k_ref[0, 0]                                   # [bs, D]
     v_blk = v_ref[0, 0]
+    if quant:
+        # in-VMEM dequant right after the (2x/4x smaller) block DMA: the
+        # attention math below is the plain f32 path
+        k_blk = kv_unpack(k_blk, quant, d_head) * ks_ref[0, 0]
+        v_blk = kv_unpack(v_blk, quant, d_head) * vs_ref[0, 0]
     if write_new:
         # merge the new token's K/V into the last live block in VMEM: the
         # attention below sees it this step, and the merged block writes
@@ -271,11 +414,43 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest, scale,
                           k_blk)
         v_blk = jnp.where(sel, nv_ref[0, 0][None, :].astype(v_blk.dtype),
                           v_blk)
+        if quant:
+            # in-VMEM re-quantize of the merged block: new per-head
+            # absmax scale, int payload + scale back through the aliased
+            # outputs — no dequantized block reaches HBM. DEAD ROWS
+            # (positions past the new token, i.e. stale content of a
+            # reused freed block) are ZEROED first: attention always
+            # masks them, but an unmasked absmax would let a dirty
+            # block's garbage inflate the scale and crush the live
+            # token's resolution — quantized output must not depend on
+            # pool-reuse history. Attention then reads the
+            # ROUND-TRIPPED values (what the pool stores), so this
+            # step's logits equal a later re-read of the same cache —
+            # and match the dense fallback bit-for-bit.
+            dead = (j == j_last) & (row > slot)
+            k_blk = jnp.where(dead, np.float32(0.0), k_blk)
+            v_blk = jnp.where(dead, np.float32(0.0), v_blk)
+            ks_new = kv_block_scale(k_blk, quant, axes=(0, 1))
+            vs_new = kv_block_scale(v_blk, quant, axes=(0, 1))
+            kq_new = kv_quantize(k_blk, ks_new, quant)
+            vq_new = kv_quantize(v_blk, vs_new, quant)
+            k_blk = jnp.where(j == j_last,
+                              kv_unpack(kq_new, quant, d_head) * ks_new,
+                              k_blk)
+            v_blk = jnp.where(j == j_last,
+                              kv_unpack(vq_new, quant, d_head) * vs_new,
+                              v_blk)
 
         @pl.when(j == j_last)
         def _store_block():
-            ko_ref[0, 0] = k_blk.astype(ko_ref.dtype)
-            vo_ref[0, 0] = v_blk.astype(vo_ref.dtype)
+            if quant:
+                kso_ref[0, 0] = ks_new
+                vso_ref[0, 0] = vs_new
+                ko_ref[0, 0] = kq_new
+                vo_ref[0, 0] = vq_new
+            else:
+                ko_ref[0, 0] = k_blk.astype(ko_ref.dtype)
+                vo_ref[0, 0] = v_blk.astype(vo_ref.dtype)
 
     g = q_ref.shape[2]
 
@@ -304,7 +479,8 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest, scale,
 
 
 def paged_attention_decode(q, k_pool, v_pool, block_tables, seq_lens,
-                           scale=None, new_k=None, new_v=None):
+                           scale=None, new_k=None, new_v=None,
+                           k_scale=None, v_scale=None, quant=None):
     """One decode step of paged attention, straight off the block pools.
 
     q: [B, Hq, D] (this step's query, one token per sequence);
@@ -320,10 +496,23 @@ def paged_attention_decode(q, k_pool, v_pool, block_tables, seq_lens,
     updated in place (aliased). Without them the caller must have already
     scattered the new token into the pools; returns out only.
     Out: [B, Hq, D] in q.dtype (fp32 accumulation inside).
+
+    ``quant="int8"|"int4"`` + ``k_scale``/``v_scale`` [num_blocks, Hkv]
+    fp32: the pools are QUANTIZED storage (int4 nibble-packed on D, so
+    the pool's last dim is :func:`kv_packed_dim`). Each block dequantizes
+    in VMEM during the walk; the fused write re-quantizes the merged
+    block in VMEM (new per-head absmax scale computed in-kernel) and the
+    scale arrays return updated alongside the pools:
+    ``(out, k_pool, v_pool, k_scale, v_scale)``.
     """
     B, Hq, D = q.shape
     NB, Hkv, BS, Dk = k_pool.shape
-    assert D == Dk, (q.shape, k_pool.shape)
+    if quant:
+        assert k_scale is not None and v_scale is not None
+        assert Dk == kv_packed_dim(D, quant), (q.shape, k_pool.shape, quant)
+    else:
+        assert k_scale is None and v_scale is None
+        assert D == Dk, (q.shape, k_pool.shape)
     assert Hq % Hkv == 0, f"GQA needs Hq % Hkv == 0, got {Hq=} {Hkv=}"
     G = Hq // Hkv
     MB = block_tables.shape[1]
@@ -337,28 +526,43 @@ def paged_attention_decode(q, k_pool, v_pool, block_tables, seq_lens,
 
     in_specs = [
         pl.BlockSpec((1, 1, G, D), _q_index_map),
-        pl.BlockSpec((1, 1, BS, D), _kv_index_map(BS, MB)),
-        pl.BlockSpec((1, 1, BS, D), _kv_index_map(BS, MB)),
+        pl.BlockSpec((1, 1, BS, Dk), _kv_index_map(BS, MB)),
+        pl.BlockSpec((1, 1, BS, Dk), _kv_index_map(BS, MB)),
     ]
     out_specs = [pl.BlockSpec((1, 1, G, D), _q_index_map)]
     out_shape = [jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype)]
     inputs = [tables, lens, q4, k_pool, v_pool]
     io_aliases = {}
+    if quant:
+        scale_spec = pl.BlockSpec((1, 1), _scale_index_map(BS, MB))
+        in_specs += [scale_spec, scale_spec]
+        inputs += [k_scale.astype(jnp.float32),
+                   v_scale.astype(jnp.float32)]
     if write_new:
+        # new-token K/V arrives in the model dtype regardless of pool
+        # quantization — the kernel quantizes in VMEM
+        nk_dt = k_pool.dtype if not quant else new_k.dtype
         in_specs += [pl.BlockSpec((1, 1, D), _new_kv_index_map),
                      pl.BlockSpec((1, 1, D), _new_kv_index_map)]
-        inputs += [new_k.reshape(B, Hkv, D).astype(k_pool.dtype),
-                   new_v.reshape(B, Hkv, D).astype(v_pool.dtype)]
-        pool_spec = pl.BlockSpec((1, 1, BS, D),
+        inputs += [new_k.reshape(B, Hkv, D).astype(nk_dt),
+                   new_v.reshape(B, Hkv, D).astype(nk_dt)]
+        pool_spec = pl.BlockSpec((1, 1, BS, Dk),
                                  _pool_out_index_map(BS, MB, NB))
         out_specs += [pool_spec, pool_spec]
         out_shape += [jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
                       jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)]
         # flat input indices INCLUDE the scalar-prefetch operands
         io_aliases = {3: 1, 4: 2}
+        if quant:
+            scale_out = pl.BlockSpec((1, 1),
+                                     _scale_out_index_map(BS, MB, NB))
+            out_specs += [scale_out, scale_out]
+            out_shape += [jax.ShapeDtypeStruct((NB, Hkv), jnp.float32),
+                          jax.ShapeDtypeStruct((NB, Hkv), jnp.float32)]
+            io_aliases = {3: 1, 4: 2, 5: 3, 6: 4}
 
     kernel = functools.partial(_decode_kernel, scale=scale, bs=BS, mb=MB,
-                               write_new=write_new)
+                               write_new=write_new, quant=quant, d_head=D)
     outs = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -383,6 +587,8 @@ def paged_attention_decode(q, k_pool, v_pool, block_tables, seq_lens,
     )(*inputs)
     out = outs[0].reshape(B, Hq, D)
     if write_new:
+        if quant:
+            return out, outs[1], outs[2], outs[3], outs[4]
         return out, outs[1], outs[2]
     return out
 
@@ -432,9 +638,35 @@ def _apd_pool_out_index_map(bs, mb, nb):
     return im
 
 
+def _apd_scale_index_map(bs, mb):
+    """Append-form scale READ window: the same block the K/V spec maps
+    (2-D — scales are [num_blocks, Hkv])."""
+    def im(b, h, j, tables_ref, lens_ref, qlens_ref):
+        j_last = _apd_blk(lens_ref, qlens_ref, b, bs, mb, True)
+        jj = jnp.minimum(j, j_last)
+        return (jnp.maximum(tables_ref[b, jj], Z), h)
+    return im
+
+
+def _apd_scale_out_index_map(bs, mb, nb):
+    """Append-form scale WRITE destinations: the same window blocks the
+    pool out map routes to."""
+    def im(b, h, j, tables_ref, lens_ref, qlens_ref):
+        w0 = _apd_blk(lens_ref, qlens_ref, b, bs, mb, False)
+        w1 = _apd_blk(lens_ref, qlens_ref, b, bs, mb, True)
+        phys = tables_ref[b, jnp.clip(j, w0, w1)]
+        return (jnp.where(phys < Z, np.int32(nb - 1), phys), h)
+    return im
+
+
 def _append_kernel(tables_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref,
-                   nk_ref, nv_ref, o_ref, ko_ref, vo_ref, m_ref, l_ref,
-                   acc_ref, *, scale, bs, mb, s_chunk):
+                   *rest, scale, bs, mb, s_chunk, quant=None, d_head=None):
+    if quant:
+        (ks_ref, vs_ref, nk_ref, nv_ref, o_ref, ko_ref, vo_ref, kso_ref,
+         vso_ref, m_ref, l_ref, acc_ref) = rest
+    else:
+        (nk_ref, nv_ref, o_ref, ko_ref, vo_ref, m_ref, l_ref,
+         acc_ref) = rest
     b = pl.program_id(0)
     j = pl.program_id(2)
     bs_i = np.int32(bs)
@@ -454,6 +686,10 @@ def _append_kernel(tables_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref,
 
     k_blk = k_ref[0, 0]                                       # [bs, D]
     v_blk = v_ref[0, 0]
+    if quant:
+        # in-VMEM dequant right after the block DMA (decode-kernel rule)
+        k_blk = kv_unpack(k_blk, quant, d_head) * ks_ref[0, 0]
+        v_blk = kv_unpack(v_blk, quant, d_head) * vs_ref[0, 0]
     # merge the chunk rows that land in THIS block into it in VMEM: block
     # row r holds chunk index i = j*bs + r - lens when 0 <= i < q_lens.
     # The gather is expressed as a one-hot selection matmul ([bs, S] @
@@ -473,11 +709,47 @@ def _append_kernel(tables_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref,
         (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     k_blk = jnp.where(has_new, merged_k.astype(k_blk.dtype), k_blk)
     v_blk = jnp.where(has_new, merged_v.astype(v_blk.dtype), v_blk)
+    in_window = (j >= w0) & (j <= j_last)
+    if quant:
+        # in-VMEM re-quantize of each window block: old rows re-round
+        # under the merged block's new absmax scale (drift-free when the
+        # max is unchanged: absmax quantization round-trips its own grid
+        # exactly). DEAD ROWS — positions at or past the window's new
+        # end (stale content of a reused freed block) — are ZEROED
+        # before the scale so a dirty block's garbage can't inflate it
+        # (decode-kernel rule; quantized output must not depend on
+        # pool-reuse history). A q_lens==0 slot writes nothing: its
+        # boundary block stores back its ORIGINAL payload + scale (the
+        # unquantized path's "stored back unchanged" contract — no
+        # zeroing, no re-round). Attention reads the ROUND-TRIPPED
+        # values — this step's logits equal a later re-read of the
+        # stored cache, and match the dense fallback bit-for-bit.
+        dead = in_window & ((jj * bs_i + row[:, :1]) >= (L + QL))
+        k_blk = jnp.where(dead, np.float32(0.0), k_blk)
+        v_blk = jnp.where(dead, np.float32(0.0), v_blk)
+        ks_new = kv_block_scale(k_blk, quant, axes=(0, 1))
+        vs_new = kv_block_scale(v_blk, quant, axes=(0, 1))
+        kq_new = kv_quantize(k_blk, ks_new, quant)
+        vq_new = kv_quantize(v_blk, vs_new, quant)
+        kq_store = jnp.where(QL > Z, kq_new, k_ref[0, 0])
+        vq_store = jnp.where(QL > Z, vq_new, v_ref[0, 0])
+        ks_store = jnp.where(QL > Z, ks_new, ks_ref[0, 0])
+        vs_store = jnp.where(QL > Z, vs_new, vs_ref[0, 0])
+        k_blk = jnp.where(in_window,
+                          kv_unpack(kq_new, quant, d_head) * ks_new, k_blk)
+        v_blk = jnp.where(in_window,
+                          kv_unpack(vq_new, quant, d_head) * vs_new, v_blk)
 
-    @pl.when((j >= w0) & (j <= j_last))
+    @pl.when(in_window)
     def _store_block():
-        ko_ref[0, 0] = k_blk.astype(ko_ref.dtype)
-        vo_ref[0, 0] = v_blk.astype(vo_ref.dtype)
+        if quant:
+            kso_ref[0, 0] = ks_store
+            vso_ref[0, 0] = vs_store
+            ko_ref[0, 0] = kq_store
+            vo_ref[0, 0] = vq_store
+        else:
+            ko_ref[0, 0] = k_blk.astype(ko_ref.dtype)
+            vo_ref[0, 0] = v_blk.astype(vo_ref.dtype)
 
     g_s = q_ref.shape[2]                                      # G * S rows
 
@@ -510,7 +782,8 @@ def _append_kernel(tables_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref,
 
 
 def paged_attention_append(q, k_pool, v_pool, block_tables, seq_lens,
-                           q_lens, new_k, new_v, scale=None):
+                           q_lens, new_k, new_v, scale=None, k_scale=None,
+                           v_scale=None, quant=None):
     """Append attention off the block pools: one fused prefill+decode step.
 
     q: [B, S, Hq, D] — up to S new positions per sequence (rows past
@@ -530,10 +803,21 @@ def paged_attention_append(q, k_pool, v_pool, block_tables, seq_lens,
     a -1 target writes to the pool's trailing scratch block.
 
     Returns (out [B, S, Hq, D] in q.dtype, k_pool, v_pool).
+
+    ``quant`` + ``k_scale``/``v_scale`` [num_blocks, Hkv]: quantized
+    pools exactly as in :func:`paged_attention_decode` — blocks dequant
+    in VMEM for the walk, every window block re-quantizes in VMEM with
+    its new per-head absmax scale, and the return grows to
+    ``(out, k_pool, v_pool, k_scale, v_scale)``.
     """
     B, S, Hq, D = q.shape
     NB, Hkv, BS, Dk = k_pool.shape
-    assert D == Dk, (q.shape, k_pool.shape)
+    if quant:
+        assert k_scale is not None and v_scale is not None
+        assert Dk == kv_packed_dim(D, quant), (q.shape, k_pool.shape, quant)
+    else:
+        assert k_scale is None and v_scale is None
+        assert D == Dk, (q.shape, k_pool.shape)
     assert Hq % Hkv == 0, f"GQA needs Hq % Hkv == 0, got {Hq=} {Hkv=}"
     G = Hq // Hkv
     MB = block_tables.shape[1]
@@ -541,50 +825,69 @@ def paged_attention_append(q, k_pool, v_pool, block_tables, seq_lens,
 
     # [B, S, Hq, D] -> [B, Hkv, G*S, D]: row r = g*S + i (head-major, so
     # the q-head split matches the decode kernel's (Hkv, G) grouping)
+    nk_dt = k_pool.dtype if not quant else new_k.dtype
     q4 = jnp.transpose(q, (0, 2, 1, 3)).reshape(B, Hkv, G * S, D)
-    nk = jnp.transpose(new_k, (0, 2, 1, 3)).astype(k_pool.dtype)
-    nv = jnp.transpose(new_v, (0, 2, 1, 3)).astype(v_pool.dtype)
+    nk = jnp.transpose(new_k, (0, 2, 1, 3)).astype(nk_dt)
+    nv = jnp.transpose(new_v, (0, 2, 1, 3)).astype(nk_dt)
     tables = block_tables.astype(jnp.int32)
     lens = seq_lens.astype(jnp.int32)
     qlens = q_lens.astype(jnp.int32)
 
-    pool_spec = pl.BlockSpec((1, 1, BS, D),
+    pool_spec = pl.BlockSpec((1, 1, BS, Dk),
                              _apd_pool_out_index_map(BS, MB, NB))
+    in_specs = [
+        pl.BlockSpec((1, 1, G * S, D), _apd_q_index_map),
+        pl.BlockSpec((1, 1, BS, Dk), _apd_kv_index_map(BS, MB)),
+        pl.BlockSpec((1, 1, BS, Dk), _apd_kv_index_map(BS, MB)),
+    ]
+    out_specs = [pl.BlockSpec((1, 1, G * S, D), _apd_q_index_map),
+                 pool_spec, pool_spec]
+    out_shape = [jax.ShapeDtypeStruct((B, Hkv, G * S, D), q.dtype),
+                 jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                 jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)]
+    inputs = [tables, lens, qlens, q4, k_pool, v_pool]
+    # flat input indices INCLUDE the scalar-prefetch operands
+    io_aliases = {4: 1, 5: 2}
+    if quant:
+        scale_in = pl.BlockSpec((1, 1), _apd_scale_index_map(BS, MB))
+        scale_out = pl.BlockSpec((1, 1),
+                                 _apd_scale_out_index_map(BS, MB, NB))
+        in_specs += [scale_in, scale_in]
+        out_specs += [scale_out, scale_out]
+        out_shape += [jax.ShapeDtypeStruct((NB, Hkv), jnp.float32),
+                      jax.ShapeDtypeStruct((NB, Hkv), jnp.float32)]
+        inputs += [k_scale.astype(jnp.float32),
+                   v_scale.astype(jnp.float32)]
+        io_aliases = {4: 1, 5: 2, 6: 3, 7: 4}
+    in_specs += [pl.BlockSpec((1, 1, S, D), _apd_new_index_map),
+                 pl.BlockSpec((1, 1, S, D), _apd_new_index_map)]
+    inputs += [nk, nv]
+
     kernel = functools.partial(_append_kernel, scale=scale, bs=BS, mb=MB,
-                               s_chunk=S)
+                               s_chunk=S, quant=quant, d_head=D)
     outs = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(B, Hkv, MB),
-            in_specs=[
-                pl.BlockSpec((1, 1, G * S, D), _apd_q_index_map),
-                pl.BlockSpec((1, 1, BS, D), _apd_kv_index_map(BS, MB)),
-                pl.BlockSpec((1, 1, BS, D), _apd_kv_index_map(BS, MB)),
-                pl.BlockSpec((1, 1, S, D), _apd_new_index_map),
-                pl.BlockSpec((1, 1, S, D), _apd_new_index_map),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, 1, G * S, D), _apd_q_index_map),
-                pool_spec, pool_spec,
-            ],
+            in_specs=in_specs,
+            out_specs=out_specs,
             scratch_shapes=[
                 pltpu.VMEM((G * S, 1), jnp.float32),   # running max m
                 pltpu.VMEM((G * S, 1), jnp.float32),   # running norm l
                 pltpu.VMEM((G * S, D), jnp.float32),   # output accumulator
             ],
         ),
-        out_shape=[jax.ShapeDtypeStruct((B, Hkv, G * S, D), q.dtype),
-                   jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
-                   jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)],
-        # flat input indices INCLUDE the scalar-prefetch operands
-        input_output_aliases={4: 1, 5: 2},
+        out_shape=out_shape,
+        input_output_aliases=io_aliases,
         # sequential everywhere: scratch carries over blocks and clamped
         # write destinations may collide across batch windows
         compiler_params=pltpu.TPUCompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=_interpret(),
-    )(tables, lens, qlens, q4, k_pool, v_pool, nk, nv)
+    )(*inputs)
     out = outs[0].reshape(B, Hkv, G, S, D)
     out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, S, Hq, D)
+    if quant:
+        return out, outs[1], outs[2], outs[3], outs[4]
     return out, outs[1], outs[2]

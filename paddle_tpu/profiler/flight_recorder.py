@@ -118,6 +118,13 @@ class StepRecord:
     #: "verify" and report their window rows through readout_stride.
     spec_accepted: int = 0
     spec_rejected: int = 0
+    #: quantized-KV capacity facts (None on dense engines): total pool
+    #: bytes (payload + per-block quantization scales) and the pool
+    #: storage dtype ("bf16"/"float32" unquantized, "int8"/"int4" under
+    #: kv_cache_dtype) — what joins a preemption-churn tail back to
+    #: "the pool was simply small for this dtype"
+    kv_pool_bytes: int | None = None
+    kv_cache_dtype: str | None = None
 
     @property
     def budget_utilization(self):
@@ -229,7 +236,8 @@ class FlightRecorder:
                    pipeline_inflight, preemptions, admit_s, schedule_s,
                    dispatch_s, t_begin, prefix_hit_tokens=None,
                    cached_blocks=None, readout_stride=1,
-                   adapter_slots=(), adapter_swaps=0):
+                   adapter_slots=(), adapter_swaps=0, kv_pool_bytes=None,
+                   kv_cache_dtype=None):
         """Record one dispatched step; returns its step id."""
         with self._lock:
             sid = self._seq
@@ -243,7 +251,9 @@ class FlightRecorder:
                 cached_blocks=cached_blocks,
                 readout_stride=int(readout_stride),
                 adapter_slots=tuple(adapter_slots),
-                adapter_swaps=int(adapter_swaps))
+                adapter_swaps=int(adapter_swaps),
+                kv_pool_bytes=kv_pool_bytes,
+                kv_cache_dtype=kv_cache_dtype)
             return sid
 
     def finish_step(self, step_id, sync_s, emit_s, finished=(),

@@ -61,7 +61,11 @@ GAUGES = ("queue_depth", "engine_waiting", "running_slots",
           "adapter_cache_occupancy",
           # speculative serving: cumulative accepted/proposed draft
           # ratio (stays 0 on non-speculative engines)
-          "spec_acceptance_rate")
+          "spec_acceptance_rate",
+          # quantized KV serving: pool capacity in BF16-EQUIVALENT block
+          # counts (n_blocks unquantized, ~2x/~4x under int8/int4) —
+          # one capacity number comparable across kv_cache_dtype arms
+          "kv_pool_effective_blocks")
 
 _COUNTERS = ("requests_submitted", "requests_admitted", "requests_finished",
              "requests_cancelled", "requests_expired",

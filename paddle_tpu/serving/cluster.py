@@ -111,7 +111,12 @@ def tp_engine(model, tp=None, mesh=None, devices=None, shard_weights=True,
     whose KV pools shard along kv-heads on the same axis. Token-exact
     greedy parity with the single-chip engine is the contract
     (tests/test_cluster.py asserts it for dense AND paged, prefix cache
-    on and off)."""
+    on and off). Engine kwargs pass through — including
+    ``kv_cache_dtype="int8"|"int4"`` (quantized KV pools): the
+    per-(block, head) scale arrays shard kv-heads with the pools and
+    per-head absmax quantization is shard-local, so TP quantized
+    serving stays token-exact vs single-chip quantized
+    (tests/test_kv_quant.py::TestComposition::test_tp_mesh_exact)."""
     from ..inference import LLMEngine
 
     if mesh is None:

@@ -869,6 +869,8 @@ class AsyncLLMServer:
             tel.set_gauge("kv_pool_free_blocks", free)
             tel.set_gauge("kv_pool_occupancy",
                           1.0 - free / max(eng.n_blocks, 1))
+            tel.set_gauge("kv_pool_effective_blocks",
+                          eng.kv_pool_effective_blocks())
             if eng.prefix_cache:
                 tel.set_gauge("prefix_cached_blocks", len(eng._lru))
                 hit = eng.stats["prefix_hit_tokens"]

@@ -340,7 +340,8 @@ def test_step_record_to_dict_schema():
                 "pipeline_inflight", "preemptions", "admit_s", "schedule_s",
                 "dispatch_s", "sync_s", "emit_s", "finished",
                 "budget_utilization", "prefill_tokens", "readout_stride",
-                "spec_accepted", "spec_rejected"):
+                "spec_accepted", "spec_rejected",
+                "kv_pool_bytes", "kv_cache_dtype"):
         assert key in d, key
     assert d["readout_stride"] == 1      # the classic one-token step
     assert d["budget_utilization"] == round(17 / 32, 4)
