@@ -1294,6 +1294,152 @@ def _bench_other(model_name):
                 "full_blocks": full_blocks,
                 "telemetry_artifact": art_path}
 
+    if model_name == "llama_serve_kv_tier":
+        # Host KV-tier A/B: the SAME model/workload/pool served with the
+        # tier OFF (preemption = full re-prefill, eviction = discard) vs
+        # ON (kv_host_swap: preempted slots round-trip host RAM;
+        # kv_host_spill_bytes: evicted prefix blocks spill + promote) at
+        # EQUAL device-pool bytes — the tier spends host RAM and PCIe/DMA
+        # bandwidth, never device HBM, so any tok/s win is pure recompute
+        # avoided. The workload is the shape the tier serves in
+        # production: TWO groups of requests each sharing a long system
+        # prompt (BENCH_SYS_FRAC of the prompt) with unique tails,
+        # interleaved so the groups CHURN each other's shared blocks out
+        # of the pressured pool — the off arm recomputes the shared
+        # prefix every time it cycles back, the on arm promotes it from
+        # the host spill store (and preempted slots restore instead of
+        # re-prefilling). What the tier buys shows up as re-prefill
+        # tokens avoided and fewer prefill dispatches, what it costs as
+        # the swap-stall share of serve wall. Streams must stay
+        # TOKEN-EXACT across arms (the copies restore the bytes the pool
+        # held). CPU-shape caveat: a toy-model serve is DISPATCH-bound
+        # and decode-step-count invariant, so avoided prefill tokens
+        # barely move tok/s there (expect ~parity inside the ±5% CPU
+        # noise band, with the re-prefill reduction as the attributable
+        # win); the tok/s gap opens on shapes where prefill FLOPs
+        # dominate the copies — real model sizes on real accelerators.
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import LLMEngine
+        from paddle_tpu.serving import AsyncLLMServer
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+        n_req = int(os.environ.get("BENCH_REQUESTS", str(2 * B)))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        chunk = int(os.environ.get("BENCH_CHUNK", "256"))
+        block = int(os.environ.get("BENCH_BLOCK", "64"))
+        prompt_len = int(os.environ.get("BENCH_PROMPT", "256"))
+        pool_frac = float(os.environ.get("BENCH_POOL_FRAC", "0.5"))
+        spill_mb = int(os.environ.get("BENCH_SPILL_MB", "256"))
+        sys_frac = float(os.environ.get("BENCH_SYS_FRAC", "0.6"))
+        cap = -(-(prompt_len + new_tokens) // chunk) * chunk
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=heads,
+                          max_position_embeddings=cap)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg).bfloat16()
+        model.eval()
+        V = cfg.vocab_size
+        sys_len = int(prompt_len * sys_frac)
+        sys_prompts = [rng.integers(0, V, (sys_len,)).astype(np.int32)
+                       for _ in range(2)]
+        prompts = [np.concatenate([
+            sys_prompts[i % 2],
+            rng.integers(0, V, (prompt_len - sys_len - 7 + int(x),))
+            .astype(np.int32)])
+            for i, x in enumerate(rng.integers(0, 15, size=n_req))]
+        full_blocks = B * (cap // block)
+        n_blocks = max(int(full_blocks * pool_frac), B + 1)
+
+        def run_arm(tier_on, pool_blocks=None):
+            eng = LLMEngine(
+                model, max_batch=B, max_seq_len=cap, chunk_size=chunk,
+                cache_impl="paged", block_size=block, scheduler="fused",
+                kv_pool_blocks=pool_blocks or n_blocks,
+                enable_prefix_cache=True,
+                kv_host_swap=tier_on,
+                kv_host_spill_bytes=(spill_mb << 20) if tier_on else 0)
+            warm = rng.integers(0, V, (3,)).astype(np.int32)
+            eng.generate([warm], max_new_tokens=2)
+            eng.reset()
+            eng.reset_stats()
+            server = AsyncLLMServer(eng, max_queue_size=n_req + 1)
+            server.start()
+            t0 = time.perf_counter()
+            handles = [server.submit(p, max_new_tokens=new_tokens)
+                       for p in prompts]
+            outs = []
+            deadline = t0 + 1800     # a thrashing config fails loudly
+            for h in handles:
+                while True:
+                    try:
+                        outs.append(h.result(timeout=0.05))
+                        break
+                    except TimeoutError:
+                        if time.perf_counter() > deadline:
+                            raise
+            wall = time.perf_counter() - t0
+            server.stop()
+            toks = sum(len(o.token_ids) for o in outs)
+            s = eng.stats
+            swap_stall = s["swap_out_time_s"] + s["swap_in_time_s"]
+            return {
+                "tier": "on" if tier_on else "off",
+                "tokens_per_sec": round(toks / wall, 1),
+                "pool_blocks": pool_blocks or n_blocks,
+                "preemptions": s["preemptions"],
+                "prefill_tokens": s["prefill_tokens"],
+                "prefix_hit_tokens": s["prefix_hit_tokens"],
+                "kv_swap_out_blocks": s["kv_swap_out_blocks"],
+                "kv_swap_in_blocks": s["kv_swap_in_blocks"],
+                "kv_swap_saved_tokens": s["kv_swap_saved_tokens"],
+                "kv_spill_blocks": s["kv_spill_blocks"],
+                "kv_promote_blocks": s["kv_promote_blocks"],
+                "swap_stall_share": round(swap_stall / max(wall, 1e-9), 4),
+            }, [list(o.token_ids) for o in outs]
+
+        # the FLOOR arm (full pool, tier off): the prefill tokens an
+        # unpressured prefix-cached serve of this workload dispatches —
+        # no preemptions, no evictions. Everything a pressured arm
+        # dispatches beyond it is RE-prefill (recompute of KV the
+        # engine already produced), which is exactly what the tier
+        # exists to remove; the floor also anchors token parity.
+        floor_arm, floor_toks = run_arm(False, pool_blocks=full_blocks)
+        off_arm, off_toks = run_arm(False)
+        on_arm, on_toks = run_arm(True)
+        floor = floor_arm["prefill_tokens"]
+        re_off = max(off_arm["prefill_tokens"] - floor, 0)
+        re_on = max(on_arm["prefill_tokens"] - floor, 0)
+        art_path = os.path.join(_artifact_dir(), "llama_serve_kv_tier.json")
+        with open(art_path, "w") as f:
+            json.dump({"floor": floor_arm, "tier_off": off_arm,
+                       "tier_on": on_arm,
+                       "reprefill_tokens_off": re_off,
+                       "reprefill_tokens_on": re_on}, f, indent=1)
+        return {"metric": "llama_serve_kv_tier_tokens_per_sec",
+                "value": on_arm["tokens_per_sec"],
+                "unit": "tokens/s", "vs_baseline": None,
+                "floor": floor_arm, "tier_off": off_arm,
+                "tier_on": on_arm,
+                "tiering_speedup": round(
+                    on_arm["tokens_per_sec"]
+                    / max(off_arm["tokens_per_sec"], 1e-9), 3),
+                "reprefill_tokens_off": re_off,
+                "reprefill_tokens_on": re_on,
+                "reprefill_reduction": round(
+                    (re_off - re_on) / re_off, 3) if re_off else None,
+                "token_parity": off_toks == on_toks == floor_toks,
+                "requests": n_req, "slots": B, "new_tokens": new_tokens,
+                "prompt_len": prompt_len, "sys_frac": sys_frac,
+                "chunk": chunk,
+                "block_size": block, "pool_frac": pool_frac,
+                "spill_mb": spill_mb, "full_blocks": full_blocks,
+                "telemetry_artifact": art_path}
+
     if model_name == "llama_serve_cluster":
         # Multichip serving A/B (paddle_tpu/serving/cluster.py): ONE
         # replica vs BENCH_REPLICAS replicas fronted by the prefix-
@@ -2142,6 +2288,7 @@ def _run_all():
             ("llama_paged_decode", None), ("llama_serve", None),
             ("llama_serve_fused", None), ("llama_serve_prefix_cache", None),
             ("llama_serve_kv_quant", None),
+            ("llama_serve_kv_tier", None),
             ("llama_serve_cluster", None), ("llama_serve_spec", None),
             ("llama_serve_lora", None), ("llama_serve_embed", None),
             ("llama", None)]:

@@ -15,6 +15,7 @@ p99 bench three rounds later:
  PTL003      donated buffer read after the donating call
  PTL004      unguarded allocator/cache mutations + lock-order cycles
  PTL005      telemetry names missing from the ServingTelemetry registry
+ PTL006      device↔host KV-pool copy outside the fence-tracked swap API
 ==========  =========================================================
 
 CLI::

@@ -273,11 +273,13 @@ def load_baseline(path):
 def default_checks():
     from .donation import DonationCheck
     from .host_sync import HostSyncCheck
+    from .kv_transfer import KVTransferCheck
     from .locks import LockDisciplineCheck
     from .retrace import RetraceCheck
     from .telemetry_names import TelemetryNameCheck
     return [_SuppressionPolicy(), HostSyncCheck(), RetraceCheck(),
-            DonationCheck(), LockDisciplineCheck(), TelemetryNameCheck()]
+            DonationCheck(), LockDisciplineCheck(), TelemetryNameCheck(),
+            KVTransferCheck()]
 
 
 class Report:

@@ -69,6 +69,15 @@ def default_engine_stats():
             "prefix_evicted_blocks": 0,
             "adapter_cache_hits": 0, "adapter_cache_misses": 0,
             "adapter_swaps": 0, "embed_requests": 0,
+            # host KV tier: preemption swap (blocks/bytes each way, and
+            # re-prefill tokens the restore avoided) + prefix spill
+            # (LRU-evicted blocks demoted to host, spilled blocks
+            # promoted back on a content-store hit)
+            "kv_swap_out_blocks": 0, "kv_swap_in_blocks": 0,
+            "kv_swap_out_bytes": 0, "kv_swap_in_bytes": 0,
+            "kv_swap_saved_tokens": 0,
+            "kv_spill_blocks": 0, "kv_promote_blocks": 0,
+            "swap_out_time_s": 0.0, "swap_in_time_s": 0.0,
             "decode_time_s": 0.0, "admit_time_s": 0.0,
             "dispatch_time_s": 0.0, "host_sync_time_s": 0.0,
             "emit_time_s": 0.0,
@@ -311,7 +320,8 @@ class LLMEngine:
                  block_size=64, kv_pool_blocks=None, scheduler="legacy",
                  max_step_tokens=None, enable_prefix_cache=False,
                  readout_stride=1, adapter_store=None,
-                 adapter_cache_slots=4, kv_cache_dtype=None):
+                 adapter_cache_slots=4, kv_cache_dtype=None,
+                 kv_host_swap=False, kv_host_spill_bytes=0):
         """``scheduler="fused"`` (Sarathi-style chunked-prefill+decode
         fusion): admission becomes slot ASSIGNMENT only — each engine step
         then processes, per slot, either one bounded prefill chunk (for
@@ -378,7 +388,24 @@ class LLMEngine:
         DRIFT from bf16 (that is the deal: ~2x/4x capacity for a
         quantization error of ~0.4%/~7% per KV read); the serve bench's
         ``llama_serve_kv_quant`` A/B and tests/test_kv_quant.py track
-        greedy drift explicitly."""
+        greedy drift explicitly.
+
+        ``kv_host_swap`` (paged + fused only — the HOST KV TIER's
+        preemption half): when pool pressure preempts a slot, its
+        committed KV blocks are copied device→host asynchronously in
+        the step_begin/step_finish gap instead of being discarded, and
+        re-admission restores them host→device plus a one-token stitch
+        — the preemption costs two overlapped copies, not a full
+        re-prefill. Token-exact: the restored bytes are the bytes the
+        pool held (quantized pools round-trip payload AND scale rows
+        bit-exact), and the stitch position recomputes deterministically.
+
+        ``kv_host_spill_bytes`` (paged + prefix cache only — the tier's
+        eviction half): LRU-evicted prefix-cache blocks demote into a
+        bounded host spill store of at most this many bytes instead of
+        vanishing; a content-store probe that misses the device LRU but
+        hits the spill PROMOTES the block back (one H2D copy) rather
+        than recomputing the chunk. 0 (default) disables spilling."""
         from ..jit.functional_call import collect_state, read_values
 
         self.model = model
@@ -552,6 +579,29 @@ class LLMEngine:
         #: KV-pool quantization mode (None = bf16 pools, bit-identical
         #: to the pre-quantization engine)
         self.kv_quant = kv_cache_dtype
+        # ---- host KV tier (DistServe/Splitwise-style memory tiering) --
+        self.kv_host_swap = bool(kv_host_swap)
+        self.kv_host_spill_bytes = int(kv_host_spill_bytes or 0)
+        if self.kv_host_swap:
+            if cache_impl != "paged":
+                raise ValueError(
+                    "kv_host_swap needs cache_impl='paged' — the host "
+                    "tier swaps physical pool blocks; the dense per-slot "
+                    "buffers have none")
+            if scheduler != "fused":
+                raise ValueError(
+                    "kv_host_swap needs scheduler='fused' — re-admission "
+                    "restores blocks and resumes the ramp at the stitch "
+                    "position, which only the fused scheduler's "
+                    "prefill_pos can express (legacy admission prefills "
+                    "whole chunk trains)")
+        if self.kv_host_spill_bytes:
+            if cache_impl != "paged" or not enable_prefix_cache:
+                raise ValueError(
+                    "kv_host_spill_bytes needs cache_impl='paged' with "
+                    "enable_prefix_cache=True — the spill store holds "
+                    "LRU-EVICTED registered prefix blocks; without the "
+                    "content store there is no eviction to spill")
         if cache_impl == "paged":
             if self.speculative_k > 1 and scheduler != "fused":
                 raise ValueError(
@@ -759,6 +809,23 @@ class LLMEngine:
             #: cleared yet — released to the free heap by the
             #: step_finish that drops their last fence
             self._quarantine = set()
+            # ---- host KV tier (kv_host_swap / kv_host_spill_bytes) ---
+            #: rid -> swap entry (tokens covered, host block copies,
+            #: tenant) for requests whose committed KV was demoted to
+            #: host RAM at preemption. Entries drop at re-admission
+            #: (consumed), at any terminal finish (_finish_tokens), and
+            #: at reset() — a supervised restart re-prefills instead.
+            self._swap_store = {}
+            #: swap/spill entries whose device→host copies were issued
+            #: but not yet materialized to numpy — drained in the
+            #: step_begin/step_finish gap (the copy overlaps the step's
+            #: device work) or on first use, whichever comes first
+            self._swap_pending = []
+            #: chain_hash -> spilled-block entry: the bounded host store
+            #: LRU-evicted REGISTERED prefix blocks demote into (oldest
+            #: spilled first out when the byte budget fills)
+            self._spill = collections.OrderedDict()
+            self._spill_bytes = 0
         else:
             shape = (self.B, self.capacity, self._kvh, self._head_dim)
             self._k = [self._make_zeros(shape, self._np_dt, self._kv_spec)
@@ -1524,6 +1591,43 @@ class LLMEngine:
 
             self._cow_fn = jax.jit(cow_copy, donate_argnums=(0, 1))
 
+            def kv_gather_blocks(k_pools, v_pools, idx):
+                """Host-tier STAGING gather: physical blocks ``idx`` out
+                of every layer's K/V pool as fresh arrays the host can
+                then copy down (swap-out / spill). tree_map's one rule
+                carries a quantized pool's payload AND its per-block
+                scale rows, so int8/int4 content round-trips bit-exact.
+                Reads only — and its input is the engine's NEWEST pool
+                futures, so it is sequenced after every already-
+                dispatched write (the committed content has landed by
+                construction) and before any later owner's writes
+                (program order over the shared pool buffers — the same
+                argument _cow_tail documents)."""
+                def g(p):
+                    return p[idx]
+                return (jax.tree_util.tree_map(g, list(k_pools)),
+                        jax.tree_util.tree_map(g, list(v_pools)))
+
+            self._kv_gather_fn = jax.jit(kv_gather_blocks)
+
+            def kv_scatter_blocks(k_pools, v_pools, idx, k_vals, v_vals):
+                """Host-tier restore scatter (swap-in / spill promote):
+                write staged host block copies back into pool blocks
+                ``idx``. The destinations are freshly allocated private
+                blocks — the write fence guarantees no in-flight
+                dispatch targets them (fenced blocks never reach the
+                free heap), so the restore cannot race a pipelined
+                writer."""
+                def s(p, vals):
+                    return p.at[idx].set(vals.astype(p.dtype))
+                return (_pin_kv(jax.tree_util.tree_map(
+                            s, list(k_pools), list(k_vals))),
+                        _pin_kv(jax.tree_util.tree_map(
+                            s, list(v_pools), list(v_vals))))
+
+            self._kv_scatter_fn = jax.jit(kv_scatter_blocks,
+                                          donate_argnums=(0, 1))
+
         def set_tokens(tokens_buf, row, slot):
             return jax.lax.dynamic_update_slice(
                 tokens_buf, row[None].astype(jnp.int32),
@@ -1849,6 +1953,11 @@ class LLMEngine:
         if self._free_blocks:
             return heapq.heappop(self._free_blocks)
         phys, _ = self._lru.popitem(last=False)
+        if self.kv_host_spill_bytes:
+            # demote the evicted content to the host spill store BEFORE
+            # its identity unregisters — a later probe promotes it back
+            # instead of recomputing the chunk
+            self._spill_block(phys)
         self._unregister(phys)
         self.stats["prefix_evicted_blocks"] += 1
         return phys
@@ -2010,7 +2119,7 @@ class LLMEngine:
             slot.reg_blocks += 1
 
     def _probe_prefix(self, slot_idx, token_ids, chunk_granular=False,
-                      adapter_id=0):
+                      adapter_id=0, no_cow=False):
         """Find the longest cached prefix of ``token_ids`` and attach it
         to slot ``slot_idx``: pure table writes + refcount bumps, zero
         prefill FLOPs for the hit span. The hit is capped at P-1 tokens —
@@ -2038,32 +2147,51 @@ class LLMEngine:
         for k in range(min(max_full, self._max_blocks)):
             h = self._chain_hash(parent, token_ids[k * bs:(k + 1) * bs])
             phys = self._store.get(h)
+            if phys is None and self.kv_host_spill_bytes:
+                # device miss, host-tier hit: promote the spilled block
+                # back into the pool (re-registered) so the walk treats
+                # it like any cached hit
+                phys = self._promote_spilled(h)
             if phys is None:
                 break
-            found.append((h, phys))
-            parent = h
-        if chunk_granular:
-            per = self.chunk // bs
-            found = found[:(len(found) // per) * per]
-        blocks = self._slot_blocks[slot_idx]
-        chain = []
-        for k, (h, phys) in enumerate(found):
+            # CLAIM the block the moment it is found — not in a second
+            # pass. A LATER iteration's spill promotion allocates
+            # (_pop_block), and the LRU eviction inside it would
+            # happily hand out a refcount-0 block this walk already
+            # found, overwriting content we are about to attach. A
+            # registered block may also sit in QUARANTINE instead of
+            # the LRU (released while its publishing grant's dispatch
+            # was still in flight); attaching it is safe — the
+            # in-flight write IS the registered content and precedes
+            # any reader dispatch in program order — but it must leave
+            # quarantine or its unfence would free a live block.
             if self._block_ref[phys] == 0:
-                # cached -> live. A registered block may sit in
-                # QUARANTINE instead of the LRU (released while its
-                # publishing grant's dispatch was still in flight);
-                # attaching it is safe — the in-flight write IS the
-                # registered content and precedes any reader dispatch
-                # in program order — but it must leave quarantine or
-                # its unfence would free a live block.
                 self._lru.pop(phys, None)
                 self._quarantine.discard(phys)
             self._block_ref[phys] += 1
+            found.append((h, phys))
+            parent = h
+        if chunk_granular:
+            # the hit boundary must be a chunk-window boundary: roll the
+            # claim back on the trimmed tail (registered blocks re-park
+            # in the LRU, probe-able again)
+            per = self.chunk // bs
+            keep = (len(found) // per) * per
+            for h, phys in found[keep:]:
+                self._release_block(phys)
+            found = found[:keep]
+        blocks = self._slot_blocks[slot_idx]
+        chain = []
+        for k, (h, phys) in enumerate(found):
             self._tables[slot_idx, k] = phys
             blocks.append(phys)
             chain.append(h)
         hit = len(found) * bs
-        if not chunk_granular:
+        if not chunk_granular and not no_cow:
+            # no_cow (swap-in re-admission): the hit must stay
+            # BLOCK-aligned — the restore attaches whole host block
+            # copies after it, which a token-granular COW tail would
+            # misalign (and the swap entry covers that span anyway)
             hit += self._cow_tail(slot_idx, token_ids, hit, chain,
                                   adapter_id=adapter_id)
         self._check_pool_invariants()
@@ -2105,7 +2233,10 @@ class LLMEngine:
                                                     adapter_id=adapter_id)
         hit = 0
         for h in chain_hashes[:self._max_blocks]:
-            if h not in self._store:
+            # the host spill store counts: a spilled block is one H2D
+            # promote away from serving, far cheaper than the recompute
+            # the affinity score is steering around
+            if h not in self._store and h not in self._spill:
                 break
             hit += self.block_size
         return hit
@@ -2145,6 +2276,271 @@ class LLMEngine:
                                         np.int32(best), np.int32(dst))
         self.stats["prefix_cow_blocks"] += 1
         return best_t
+
+    # ---- host KV tier (kv_host_swap / kv_host_spill_bytes) ------------
+    # The fence-tracked swap API: every device<->host KV-pool copy in
+    # the engine goes through the four functions below (the PTL006
+    # checker in paddle_tpu.analysis enforces exactly that). Copies are
+    # ASYNC — the gather/scatter dispatches here, the transfer overlaps
+    # the step's device work in the step_begin/step_finish gap, and
+    # step_finish (or a consumer that needs the bytes sooner)
+    # materializes them.
+
+    def _pad_block_idx(self, blocks):
+        """Block-index vector padded to the next power-of-two length
+        with the trailing SCRATCH block (index n_blocks — never handed
+        out, routinely garbage-written by the kernels), so the compiled
+        gather/scatter programs retrace O(log max_blocks) times total
+        instead of once per distinct block count."""
+        n = len(blocks)
+        m = 1 << max(n - 1, 0).bit_length()
+        idx = np.full((max(m, 1),), self.n_blocks, np.int32)
+        idx[:n] = blocks
+        return idx
+
+    def _swap_out_slot(self, b, slot):
+        """Demote slot ``b``'s committed KV to host RAM at preemption
+        (the tier's swap-out half). The gather's input is the newest
+        pool futures, so in-flight pipelined writers need no special
+        handling: their writes land at positions >= the committed
+        length, and the gather is sequenced after them by data flow —
+        the fence/quarantine then keeps the released blocks from being
+        handed to a new owner while those writers are still outstanding,
+        exactly as for any other release."""
+        req = slot.req
+        kv_len = slot.prefill_pos + len(slot.generated)
+        if kv_len <= 0 or req.kind == "embed":
+            # an embed slot's pooled accumulator cannot survive a skip
+            # of its prefill span (same reason embeds never probe the
+            # prefix cache) — let it re-prefill
+            return
+        nb = (kv_len - 1) // self.block_size + 1
+        blocks = self._slot_blocks[b][:nb]
+        if len(blocks) < nb:
+            return
+        t0 = time.perf_counter()
+        k_host, v_host = self._kv_gather_fn(self._k, self._v,
+                                            self._pad_block_idx(blocks))
+        for leaf in jax.tree_util.tree_leaves([k_host, v_host]):
+            try:
+                leaf.copy_to_host_async()
+            except AttributeError:      # CPU fallback: a buffer move
+                pass
+        done = np.concatenate([req.prompt_ids,
+                               np.asarray(slot.generated, np.int32)])
+        entry = {"tokens": done[:kv_len], "adapter_id": req.adapter_id,
+                 "n_blocks": nb, "k": k_host, "v": v_host, "ready": False,
+                 "nbytes": nb * self.kv_bytes_per_block()}
+        # a re-preempted request's newest committed state wins
+        self._swap_store[req.request_id] = entry
+        self._swap_pending.append(entry)
+        self.stats["kv_swap_out_blocks"] += nb
+        self.stats["kv_swap_out_bytes"] += entry["nbytes"]
+        self.stats["swap_out_time_s"] += time.perf_counter() - t0
+
+    def _drain_swap_writes(self):
+        """Materialize every pending device→host tier copy into plain
+        numpy and drop the device references — called in the
+        step_begin/step_finish gap's finish side (the transfer already
+        overlapped the step's device work) and lazily by any consumer
+        that needs an entry sooner."""
+        if not self._swap_pending:
+            return
+        t0 = time.perf_counter()
+        for entry in self._swap_pending:
+            nb = entry["n_blocks"]
+            entry["k"] = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[:nb], entry["k"])
+            entry["v"] = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[:nb], entry["v"])
+            entry["ready"] = True
+        self._swap_pending.clear()
+        self.stats["swap_out_time_s"] += time.perf_counter() - t0
+
+    def _try_swap_restores(self):
+        """The swap-in half, run at the top of every MIXED step (the
+        restore fires exactly where the prefill grants it replaces
+        would have been scheduled): every ramping slot with a live swap
+        entry restores as many of its host-resident blocks as the pool
+        can cover right now — async H2D scatter into private blocks,
+        ``prefill_pos``/lens jump to the stitch. The entry SURVIVES a
+        dry pool (restores retry as retirements free blocks — the whole
+        point of demoting instead of discarding) and partial restores
+        stay BLOCK-ALIGNED so the remainder can restore later; it is
+        consumed when the stitch reaches ``T-1`` (the final position
+        recomputes — deterministically identical KV — so the last
+        prefill grant still produces the sampler's logits), and dropped
+        when it can no longer apply (tenant/token drift, the ramp
+        passed it by re-prefilling, or a misaligned budget-clamped
+        grant boundary)."""
+        if not self.kv_host_swap or not self._swap_store:
+            return
+        bs = self.block_size
+        for b, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            rid = slot.req.request_id
+            entry = self._swap_store.get(rid)
+            if entry is None:
+                continue
+            req = slot.req
+            T = len(entry["tokens"])
+            pos = slot.prefill_pos
+            # the token-prefix compare is O(T): run it ONCE per
+            # (entry, resident request) — both arrays are immutable, so
+            # the cached verdict holds for every dry-pool retry
+            if entry.get("validated") != rid:
+                if entry["adapter_id"] != req.adapter_id or \
+                        T > slot.prompt_len or \
+                        not np.array_equal(entry["tokens"],
+                                           req.prompt_ids[:T]):
+                    del self._swap_store[rid]
+                    continue
+                entry["validated"] = rid
+            if (not slot.ramping) or slot.generated or pos >= T - 1 or \
+                    req.kind == "embed":
+                del self._swap_store[rid]
+                continue
+            if pos % bs:
+                # a budget-clamped grant left the ramp mid-block: keep
+                # the entry (a later aligned position may restore; the
+                # finish/preempt paths clean it up regardless)
+                continue
+            target = T - 1               # the stitch cap: T-1 recomputes
+            first_blk = pos // bs
+            n_restore = target // bs + 1 - first_blk
+            t0 = time.perf_counter()
+            # blocks the slot already owns past the stitch count toward
+            # the restore span (a budget-clamped grant may have grabbed
+            # coverage it never filled) — only the shortfall allocates
+            have = max(len(self._slot_blocks[b]) - first_blk, 0)
+            got = min(n_restore, have + self._n_allocatable())
+            need = first_blk + got - len(self._slot_blocks[b])
+            if got <= 0 or (need > 0 and
+                            not self._alloc_blocks(b, need)):
+                continue                 # pool dry NOW — retry next step
+            self._drain_swap_writes()    # the entry may still be staging
+            dst = self._slot_blocks[b][first_blk:first_blk + got]
+            idx = self._pad_block_idx(dst)
+            m = len(idx)
+
+            def staged(x):
+                rows = x[first_blk:first_blk + got]
+                if m > got:
+                    pad = np.zeros((m - got,) + rows.shape[1:],
+                                   rows.dtype)
+                    rows = np.concatenate([rows, pad])
+                return rows
+
+            self._k, self._v = self._kv_scatter_fn(
+                self._k, self._v, idx,
+                jax.tree_util.tree_map(staged, entry["k"]),
+                jax.tree_util.tree_map(staged, entry["v"]))
+            covered = (first_blk + got) * bs
+            # a partial restore stops at a BLOCK boundary (the remainder
+            # restores or re-prefills later); a full one stitches at T-1
+            stitch = target if covered > target else covered
+            slot.prefill_pos = stitch
+            self._lens = self._set_len_fn(self._lens, np.int32(b),
+                                          np.int32(stitch))
+            if stitch >= target:
+                del self._swap_store[rid]
+            self.stats["kv_swap_in_blocks"] += got
+            self.stats["kv_swap_in_bytes"] += got * \
+                self.kv_bytes_per_block()
+            self.stats["kv_swap_saved_tokens"] += max(stitch - pos, 0)
+            self.stats["swap_in_time_s"] += time.perf_counter() - t0
+            rec = self._rec()
+            if rec is not None:
+                rec.req_event(rid, "swapped_in",
+                              step_id=rec.next_step_id(),
+                              value=max(stitch - pos, 0))
+
+    def _spill_block(self, phys):
+        """Demote an LRU-evicted registered block's content to the
+        bounded host spill store (the tier's eviction half), keyed by
+        its chain hash so a later content-store probe can promote it
+        back instead of recomputing the chunk. Called BEFORE
+        ``_unregister`` strips the block's identity; the byte budget
+        evicts the oldest spilled entries first."""
+        h = self._block_hash.get(phys)
+        per = self.kv_bytes_per_block()
+        if h is None or h in self._spill or per > self.kv_host_spill_bytes:
+            return
+        t0 = time.perf_counter()
+        k_host, v_host = self._kv_gather_fn(self._k, self._v,
+                                            self._pad_block_idx([phys]))
+        for leaf in jax.tree_util.tree_leaves([k_host, v_host]):
+            try:
+                leaf.copy_to_host_async()
+            except AttributeError:
+                pass
+        while self._spill_bytes + per > self.kv_host_spill_bytes \
+                and self._spill:
+            _, old = self._spill.popitem(last=False)
+            self._spill_bytes -= old["nbytes"]
+        entry = {"parent": self._block_parent[phys],
+                 "tokens": self._block_tokens[phys],
+                 "n_blocks": 1, "k": k_host, "v": v_host, "ready": False,
+                 "nbytes": per}
+        self._spill[h] = entry
+        self._spill_bytes += per
+        self._swap_pending.append(entry)
+        # spill traffic books on its OWN counters (kv_spill_blocks /
+        # kv_host_spill_blocks), never on kv_swap_out_bytes: the
+        # StepRecord swap-byte deltas are the preempt_swap-vs-reprefill
+        # classifier's signal, and spill bytes riding them would label a
+        # swap-off preemption step "preempt_swap" whenever an unrelated
+        # eviction landed on it
+        self.stats["kv_spill_blocks"] += 1
+        self.stats["swap_out_time_s"] += time.perf_counter() - t0
+
+    def _promote_spilled(self, h):
+        """Promote a spilled block back into the device pool: claim a
+        writable block, scatter the host copy in, RE-REGISTER the
+        content identity, and park it refcount-0 in the LRU — the
+        probe's normal attach path then bumps it live, so promotion is
+        invisible to everything above the content store. Returns the
+        physical block, or None (spill miss / pool dry)."""
+        entry = self._spill.get(h)
+        if entry is None or not self._n_allocatable():
+            return None
+        t0 = time.perf_counter()
+        self._drain_swap_writes()
+        del self._spill[h]
+        self._spill_bytes -= entry["nbytes"]
+        phys = self._pop_block()
+        idx = self._pad_block_idx([phys])
+
+        def staged(x):
+            if len(idx) > 1:
+                pad = np.zeros((len(idx) - 1,) + x.shape[1:], x.dtype)
+                return np.concatenate([x[:1], pad])
+            return x[:1]
+
+        self._k, self._v = self._kv_scatter_fn(
+            self._k, self._v, idx,
+            jax.tree_util.tree_map(staged, entry["k"]),
+            jax.tree_util.tree_map(staged, entry["v"]))
+        self._register_block(phys, h, entry["parent"],
+                             np.frombuffer(entry["tokens"], np.int32))
+        self._lru[phys] = None
+        # promote traffic books on kv_promote_blocks only — see the
+        # matching note in _spill_block (swap-byte deltas stay the
+        # preemption classifier's exclusive signal)
+        self.stats["kv_promote_blocks"] += 1
+        self.stats["swap_in_time_s"] += time.perf_counter() - t0
+        return phys
+
+    def swap_resident_rids(self):
+        """Request ids whose committed KV currently lives in the HOST
+        tier (preempted + swapped out — awaiting re-admission, or
+        re-admitted and mid-restore) — a READ-ONLY probe the replica
+        router uses to know which of a replica's requests can resume
+        from their streamed tokens without recompute on failover."""
+        if self.cache_impl != "paged":
+            return ()
+        return tuple(self._swap_store)
 
     def _check_pool_invariants(self):
         """Debug-only allocator audit (PADDLE_TPU_POOL_CHECKS=1; the test
@@ -2418,6 +2814,16 @@ class LLMEngine:
         if self.prefill_blocks_needed(len(done)) > self.n_blocks:
             self._retire_pool_edge(b, retired)
             return
+        if self.kv_host_swap:
+            # demote the committed KV to host RAM BEFORE the blocks
+            # release — re-admission then restores it (one H2D copy +
+            # a one-token stitch) instead of re-prefilling the stream.
+            # Unconditional on purpose: with the prefix cache on, the
+            # registered full blocks often survive in the LRU/spill
+            # store too, but only the swap entry covers the PARTIAL
+            # tail block and content eviction races — the gather is one
+            # async dispatch whose copy hides under the next step.
+            self._swap_out_slot(b, slot)
         prefix = self._preempted_prefix.get(req.request_id, [])
         self._preempted_prefix[req.request_id] = \
             list(prefix) + list(slot.generated)
@@ -2440,6 +2846,10 @@ class LLMEngine:
         preemption and restart, dead weight after the finish)."""
         prefix = self._preempted_prefix.pop(req.request_id, [])
         self._spec_ewma.pop(req.request_id, None)
+        if self.cache_impl == "paged":
+            # a terminal output's host-tier swap entry is dead weight
+            # (and a rid-reuse hazard) — drop it with the stitch state
+            self._swap_store.pop(req.request_id, None)
         return list(prefix) + list(generated)
 
     def _admit(self, slot_idx, req, a_slot=0):
@@ -2573,6 +2983,8 @@ class LLMEngine:
         t0 = time.perf_counter()
         self._programs()
         hit, chain = 0, []
+        swapped = self.kv_host_swap and req.kind != "embed" and \
+            req.request_id in self._swap_store
         if self.prefix_cache and req.kind != "embed":
             # embed requests never PROBE: a hit would skip the shared
             # span's hidden-state computation and corrupt the mean pool.
@@ -2580,7 +2992,15 @@ class LLMEngine:
             # a pure function of tenant + tokens), so a later generate
             # request of the same tenant hits them.
             hit, chain = self._probe_prefix(slot_idx, req.prompt_ids,
-                                            adapter_id=req.adapter_id)
+                                            adapter_id=req.adapter_id,
+                                            no_cow=swapped)
+        probe_hit = hit
+        # a live swap entry restores LAZILY in the scheduler
+        # (_try_swap_restores, the next mixed step): the pool is often
+        # dry at the exact re-admission moment, and consuming the entry
+        # then would forfeit the restore a retirement one step later
+        # could have paid for — admission just seeds the stitch at the
+        # probe hit
         self._lens = self._set_len_fn(self._lens, np.int32(slot_idx),
                                       np.int32(hit))
         if self._tokens is not None:
@@ -2600,12 +3020,14 @@ class LLMEngine:
         slot.reg_blocks = len(chain)
         slot.a_slot = a_slot
         self.slots[slot_idx] = slot
-        if hit:
-            self.stats["prefix_hit_tokens"] += hit
+        if probe_hit:
+            # only the CONTENT-STORE hit counts as a prefix hit — the
+            # swap-restored span is booked on the kv_swap_* stats
+            self.stats["prefix_hit_tokens"] += probe_hit
             rec = self._rec()
             if rec is not None:
                 rec.req_event(req.request_id, "cached_prefix",
-                              step_id=rec.next_step_id(), value=hit)
+                              step_id=rec.next_step_id(), value=probe_hit)
         self._admit_order[slot_idx] = self._admit_seq
         self._admit_seq += 1
         self.stats["admit_time_s"] += time.perf_counter() - t0
@@ -2626,12 +3048,33 @@ class LLMEngine:
                         f"{req.max_new_tokens} -> {room} (engine capacity "
                         f"{self.capacity})", RuntimeWarning, stacklevel=3)
                     req.max_new_tokens = room
-                if fused and self.cache_impl == "paged" and \
-                        self.prefill_blocks_needed(len(req.prompt_ids)) > \
-                        self.n_blocks:
-                    # can NEVER ramp in: leave it at the head; step_begin
-                    # raises the loud too-small-pool error
-                    break
+                if fused and self.cache_impl == "paged":
+                    need = self.prefill_blocks_needed(len(req.prompt_ids))
+                    if need > self.n_blocks:
+                        # can NEVER ramp in: leave it at the head;
+                        # step_begin raises the loud too-small-pool error
+                        break
+                    # admission-defer PROGRESS GUARANTEE (the fused-ramp
+                    # livelock fix): a ramping slot must never be
+                    # admitted while the pool cannot cover its ramp AND
+                    # the outstanding ramp demand of already-resident
+                    # ramping slots — otherwise two ramps over a pool
+                    # barely larger than one prompt trade blocks through
+                    # the preempt ladder forever (preempt newest →
+                    # re-admit → re-grab → preempt), burning prefill
+                    # FLOPs without either finishing (the 2-slot ×
+                    # 4-block-prompt × 4-block-pool thrash PR 12's bench
+                    # surfaced). Deferring costs nothing: the resident
+                    # ramp can always finish alone, and its retirement
+                    # re-opens admission.
+                    ramp_deficit = sum(
+                        max(self.prefill_blocks_needed(s.prompt_len)
+                            - len(self._slot_blocks[i]), 0)
+                        for i, s in enumerate(self.slots)
+                        if s is not None and s.ramping)
+                    if ramp_deficit and \
+                            need + ramp_deficit > self._n_allocatable():
+                        break
                 a_slot = self._acquire_adapter(req)
                 if a_slot is None:
                     # every adapter cache slot is pinned by resident
@@ -2675,7 +3118,7 @@ class LLMEngine:
         rec, ctx = self._rec(), self._rec_ctx
         if rec is None or ctx is None:
             return
-        t0, admit0, hits0, swaps0 = ctx
+        t0, admit0, hits0, swaps0, kvin0, kvout0 = ctx
         wall = time.perf_counter() - t0
         admit_s = self.stats["admit_time_s"] - admit0
         paged = self.cache_impl == "paged"
@@ -2701,6 +3144,17 @@ class LLMEngine:
             kv_pool_bytes=self._kv_nbytes if paged else None,
             kv_cache_dtype=(self.kv_quant or str(np.dtype(self._np_dt)))
             if paged else None,
+            # preemption-SWAP traffic THIS step moved (swap-out at its
+            # preemptions, swap-in restores at its scheduling) plus the
+            # spill store's point-in-time size — the swap-byte deltas
+            # are the exclusive signal splitting the explain_tail
+            # preemption cause into swap vs re-prefill (spill/promote
+            # traffic books elsewhere on purpose)
+            kv_swap_in_bytes=(self.stats["kv_swap_in_bytes"] - kvin0)
+            if paged else None,
+            kv_swap_out_bytes=(self.stats["kv_swap_out_bytes"] - kvout0)
+            if paged else None,
+            kv_host_spill_blocks=len(self._spill) if paged else None,
             # per-slot TENANT ids + this step's adapter swap-ins (the
             # explain_tail "adapter_swap" cause reads them back)
             adapter_slots=tuple(
@@ -2820,7 +3274,9 @@ class LLMEngine:
             self._rec_ctx = (time.perf_counter(),
                              self.stats["admit_time_s"],
                              self.stats["prefix_hit_tokens"],
-                             self.stats["adapter_swaps"])
+                             self.stats["adapter_swaps"],
+                             self.stats["kv_swap_in_bytes"],
+                             self.stats["kv_swap_out_bytes"])
             self._rec_preempted = []
         self._admit_waiting()
         if not any(s is not None for s in self.slots):
@@ -3346,6 +3802,10 @@ class LLMEngine:
         ramping): the whole ramp-in costs one dispatch per engine step
         instead of O(prompt_len / chunk) serial admission dispatches with
         every decode slot stalled behind them."""
+        # host-tier swap-ins fire HERE, displacing the prefill grants
+        # they make redundant (prefill_pos jumps to the stitch before
+        # the budget walk sees the slot)
+        self._try_swap_restores()
         for _ in range(self.B + 1):
             ids, q_lens, is_dec, active, sched, spec_ks = \
                 self._schedule_mixed(pool_done)
@@ -3544,6 +4004,10 @@ class LLMEngine:
         # (possibly quarantined) blocks
         if pending.fenced:
             self._unfence(pending.fenced)
+        if self.cache_impl == "paged" and self._swap_pending:
+            # host-tier copies issued in the step_begin/step_finish gap
+            # overlapped this step's device work — settle them to numpy
+            self._drain_swap_writes()
 
         # batched-readout stamp amortization: a k-row stride drains k
         # device steps in this ONE sync, but those tokens were produced

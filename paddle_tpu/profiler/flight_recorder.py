@@ -55,6 +55,15 @@ __all__ = ["StepRecord", "FlightRecorder", "TAIL_CAUSES"]
 #: for all three), so a sync-dominated step is the amortization
 #: boundary working as designed — tune the stride/horizon, not the
 #: host — rather than a host-sync pathology.
+#: The preemption cause is SPLIT by the host KV tier's involvement:
+#: "preempt_swap" — the gap's causal step preempted slots whose KV
+#: moved through the host tier (swap-out at the preemption, or a
+#: swap-in restore at the re-admission): the stall is two overlapped
+#: copies, already the cheap path — grow the pool or the spill budget
+#: if it still hurts. "preempt_reprefill" — the step preempted with NO
+#: tier traffic: the evicted KV was recomputed from scratch, the
+#: expensive shape tiering exists to remove (kv_host_swap off, or the
+#: entry was invalidated).
 #: "adapter_swap" sits between preemption and interfering_prefill: the
 #: gap's causal step swapped an adapter into the device cache (host
 #: upload riding the admission path) — a multi-tenant working set
@@ -65,7 +74,8 @@ __all__ = ["StepRecord", "FlightRecorder", "TAIL_CAUSES"]
 #: never committed — an acceptance problem (workload/draft mismatch;
 #: the adaptive-k EWMA should be shrinking the window), not the
 #: host-sync or batched-readout pathology it would otherwise file as.
-TAIL_CAUSES = ("restart_recovery", "preemption", "adapter_swap",
+TAIL_CAUSES = ("restart_recovery", "preempt_swap", "preempt_reprefill",
+               "adapter_swap",
                "interfering_prefill", "draft_rejected", "batched_readout",
                "host_sync", "idle_bubble", "dispatch", "unrecorded")
 
@@ -125,6 +135,17 @@ class StepRecord:
     #: "the pool was simply small for this dtype"
     kv_pool_bytes: int | None = None
     kv_cache_dtype: str | None = None
+    #: host KV tier PREEMPTION-SWAP traffic THIS step moved (None on
+    #: dense engines; 0 with the tier off): swap-in restores at the
+    #: step's scheduling, swap-outs at its preemptions — the exclusive
+    #: signal splitting the preemption tail cause into preempt_swap vs
+    #: preempt_reprefill (spill/promote traffic deliberately books on
+    #: its own counters so an unrelated eviction on a preemption step
+    #: cannot fake the cheap path) — plus the host spill store's block
+    #: count at dispatch
+    kv_swap_in_bytes: int | None = None
+    kv_swap_out_bytes: int | None = None
+    kv_host_spill_blocks: int | None = None
 
     @property
     def budget_utilization(self):
@@ -237,7 +258,8 @@ class FlightRecorder:
                    dispatch_s, t_begin, prefix_hit_tokens=None,
                    cached_blocks=None, readout_stride=1,
                    adapter_slots=(), adapter_swaps=0, kv_pool_bytes=None,
-                   kv_cache_dtype=None):
+                   kv_cache_dtype=None, kv_swap_in_bytes=None,
+                   kv_swap_out_bytes=None, kv_host_spill_blocks=None):
         """Record one dispatched step; returns its step id."""
         with self._lock:
             sid = self._seq
@@ -253,7 +275,10 @@ class FlightRecorder:
                 adapter_slots=tuple(adapter_slots),
                 adapter_swaps=int(adapter_swaps),
                 kv_pool_bytes=kv_pool_bytes,
-                kv_cache_dtype=kv_cache_dtype)
+                kv_cache_dtype=kv_cache_dtype,
+                kv_swap_in_bytes=kv_swap_in_bytes,
+                kv_swap_out_bytes=kv_swap_out_bytes,
+                kv_host_spill_blocks=kv_host_spill_blocks)
             return sid
 
     def finish_step(self, step_id, sync_s, emit_s, finished=(),
@@ -462,7 +487,10 @@ class FlightRecorder:
         it). Cause taxonomy, checked in order against the step that
         emitted the token:
 
-        * ``preemption`` — the step carried pool-pressure preemptions;
+        * ``preempt_swap`` / ``preempt_reprefill`` — the step carried
+          pool-pressure preemptions, split by whether the evicted KV
+          moved through the host tier (swap bytes on the step) or was
+          recomputed from scratch;
         * ``interfering_prefill`` — prefill work delayed the token: a
           chunk grant rode the same fused dispatch (Sarathi's per-step
           interference), or a legacy admission prefill train ran inside
@@ -561,7 +589,16 @@ class FlightRecorder:
         if rec is None:
             return "unrecorded"
         if rec.preemptions:
-            return "preemption"
+            # split by the host KV tier's involvement: any tier traffic
+            # on the step (swap-out at the preemption, or a swap-in
+            # restore riding the same step's re-admission) means the
+            # evicted KV moved through host RAM instead of being
+            # recomputed — the cheap path, as opposed to the full
+            # re-prefill the tier exists to remove
+            if getattr(rec, "kv_swap_out_bytes", None) or \
+                    getattr(rec, "kv_swap_in_bytes", None):
+                return "preempt_swap"
+            return "preempt_reprefill"
         if getattr(rec, "adapter_swaps", 0):
             # the step's admission swapped adapter factors onto the
             # device — a multi-tenant working set bigger than the

@@ -65,7 +65,13 @@ GAUGES = ("queue_depth", "engine_waiting", "running_slots",
           # quantized KV serving: pool capacity in BF16-EQUIVALENT block
           # counts (n_blocks unquantized, ~2x/~4x under int8/int4) —
           # one capacity number comparable across kv_cache_dtype arms
-          "kv_pool_effective_blocks")
+          "kv_pool_effective_blocks",
+          # host KV tier: cumulative bytes moved each way by the
+          # PREEMPTION-SWAP half (spill/promote traffic counts blocks on
+          # kv_spill_blocks/kv_promote_blocks instead — the swap bytes
+          # double as the preempt_swap classifier signal), and the host
+          # spill store's current block count (all 0 with the tier off)
+          "kv_swap_in_bytes", "kv_swap_out_bytes", "kv_host_spill_blocks")
 
 _COUNTERS = ("requests_submitted", "requests_admitted", "requests_finished",
              "requests_cancelled", "requests_expired",
@@ -77,7 +83,14 @@ _COUNTERS = ("requests_submitted", "requests_admitted", "requests_finished",
              "prefix_evicted_blocks",
              "adapter_cache_hits", "adapter_cache_misses", "adapter_swaps",
              "embed_requests",
-             "spec_proposed_tokens", "spec_accepted_tokens")
+             "spec_proposed_tokens", "spec_accepted_tokens",
+             # host KV tier: blocks swapped out at preemption / restored
+             # at re-admission, re-prefill tokens the restores avoided,
+             # and prefix blocks spilled to / promoted from the host
+             # store
+             "kv_swap_out_blocks", "kv_swap_in_blocks",
+             "kv_swap_saved_tokens", "kv_spill_blocks",
+             "kv_promote_blocks")
 
 
 def _default_bounds():

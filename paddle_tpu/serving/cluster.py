@@ -376,6 +376,13 @@ class ReplicaRouter:
                       "adapter_routed": 0,
                       "resubmitted": 0, "replica_lost": 0,
                       "resumed": 0, "evicted_hung": 0,
+                      #: failover resubmissions whose request was
+                      #: SWAP-RESIDENT on the lost replica (its KV lived
+                      #: in that host's RAM tier, awaiting re-admission)
+                      #: — every streamed token is already with the
+                      #: caller, so resumption is exact and the host
+                      #: copy is simply abandoned with the replica
+                      "swap_resident_failover": 0,
                       "placements": [0] * len(self.replicas)}
 
     # -- lifecycle -------------------------------------------------------
@@ -651,6 +658,16 @@ class ReplicaRouter:
                 hung = False
             if not hung:
                 continue
+            # swap-resident awareness: requests whose KV the wedged
+            # replica had demoted to ITS host tier are not in any slot,
+            # but they are exactly as resumable as running ones — the
+            # committed tokens all streamed before the preemption that
+            # swapped them out. The probe is read-only dict access on
+            # the (stuck, not racing) engine thread's state.
+            try:
+                swap_rids = set(srv.engine.swap_resident_rids())
+            except Exception:
+                swap_rids = set()
             with self._lock:
                 mine = [rh for rh in self._outstanding
                         if rh._replica == idx and not rh.done]
@@ -661,6 +678,8 @@ class ReplicaRouter:
                                          reason="replica_lost") is not None:
                         with self._lock:
                             self.stats["evicted_hung"] += 1
+                            if inner.request_id in swap_rids:
+                                self.stats["swap_resident_failover"] += 1
                 self._resolve(rh)
 
     def _resolve(self, handle):
@@ -823,9 +842,25 @@ class ReplicaRouter:
                    "draining": sorted(self._draining)}
         out["replicas"] = {}
         for i, srv in enumerate(self.replicas):
+            eng = srv.engine
+            try:
+                swap_resident = len(eng.swap_resident_rids())
+            except Exception:
+                swap_resident = 0
             out["replicas"][i] = {
                 "alive": self.alive(i),
-                "tp_degree": srv.engine.tp_degree(),
+                "tp_degree": eng.tp_degree(),
+                # host KV tier view: requests parked in this replica's
+                # host RAM (resumable without recompute) and its spill
+                # store's current size — the failover/capacity facts a
+                # fleet controller reads per replica
+                "kv_tier": {
+                    "swap_resident": swap_resident,
+                    "spill_blocks": len(getattr(eng, "_spill", ())),
+                    "swap_out_bytes": eng.stats.get("kv_swap_out_bytes",
+                                                    0),
+                    "swap_in_bytes": eng.stats.get("kv_swap_in_bytes", 0),
+                },
                 "telemetry": srv.telemetry.snapshot()}
         return out
 

@@ -38,10 +38,17 @@ class AdmissionQueue:
         with self._cond:
             return len(self._dq)
 
-    def put(self, handle, block=True, timeout=None):
+    def put(self, handle, block=True, timeout=None, front=False):
         """Enqueue, applying backpressure. Raises ServerQueueFull when the
         queue stays at capacity (immediately if ``block=False``, after
-        ``timeout`` seconds otherwise)."""
+        ``timeout`` seconds otherwise).
+
+        ``front=True`` is the RE-ADMISSION grant: the handle joins the
+        HEAD of the queue, ahead of fresh arrivals — used for failover
+        resumes (streams a consumer is already reading, whose service
+        was paid once on the lost replica). Backpressure still applies:
+        a full queue blocks or rejects a front put like any other, so
+        re-admissions cannot grow the queue past its bound."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             while len(self._dq) >= self.max_size:
@@ -55,7 +62,10 @@ class AdmissionQueue:
                         f"admission queue full ({self.max_size}) after "
                         f"waiting {timeout}s")
                 self._cond.wait(remaining)
-            self._dq.append(handle)
+            if front:
+                self._dq.appendleft(handle)
+            else:
+                self._dq.append(handle)
             self._cond.notify_all()
 
     def pop(self):

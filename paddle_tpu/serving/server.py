@@ -487,7 +487,13 @@ class AsyncLLMServer:
                 # injected queue_full bursts ride the SAME rejection
                 # bookkeeping as a genuinely full queue
                 fi.on_submit(self)
-            self._queue.put(handle, block=block, timeout=timeout)
+            # the RE-ADMISSION grant: a failover resume (tokens already
+            # streamed on a previous replica — possibly restored from
+            # its host KV tier) jumps the queue; its consumer is already
+            # mid-stream, so queueing it behind fresh arrivals converts
+            # a swap-sized stall into a whole queue wait
+            self._queue.put(handle, block=block, timeout=timeout,
+                            front=resume is not None)
         except Exception:
             with self._hlock:
                 self._handles.pop(rid, None)
@@ -766,7 +772,12 @@ class AsyncLLMServer:
                                            "prefix_evicted_blocks",
                                            "adapter_cache_hits",
                                            "adapter_cache_misses",
-                                           "adapter_swaps")}
+                                           "adapter_swaps",
+                                           "kv_swap_out_blocks",
+                                           "kv_swap_in_blocks",
+                                           "kv_swap_saved_tokens",
+                                           "kv_spill_blocks",
+                                           "kv_promote_blocks")}
         t0 = time.perf_counter()
         pending = eng.step_begin()
         wall = time.perf_counter() - t0
@@ -871,6 +882,15 @@ class AsyncLLMServer:
                           1.0 - free / max(eng.n_blocks, 1))
             tel.set_gauge("kv_pool_effective_blocks",
                           eng.kv_pool_effective_blocks())
+            # host KV tier traffic (0 with the tier off — the gauges
+            # sample the cumulative engine stats, so one scrape shows
+            # whether preemptions are converting into copies)
+            tel.set_gauge("kv_swap_in_bytes",
+                          eng.stats.get("kv_swap_in_bytes", 0))
+            tel.set_gauge("kv_swap_out_bytes",
+                          eng.stats.get("kv_swap_out_bytes", 0))
+            tel.set_gauge("kv_host_spill_blocks",
+                          len(getattr(eng, "_spill", ())))
             if eng.prefix_cache:
                 tel.set_gauge("prefix_cached_blocks", len(eng._lru))
                 hit = eng.stats["prefix_hit_tokens"]
@@ -937,14 +957,29 @@ class AsyncLLMServer:
         # legacy paged admission also needs POOL blocks for the whole
         # prompt — a free slot over a dry pool is still a capacity wait,
         # not an admission stall (fused admission allocates lazily, so a
-        # free slot alone is admissible there)
-        legacy_paged = eng.cache_impl == "paged" and \
-            eng.scheduler != "fused"
+        # free slot alone is admissible there). The fused scheduler's
+        # admission-defer progress guarantee is mirrored the same way:
+        # while a resident slot is still RAMPING, a prompt the pool
+        # cannot cover waits on capacity, not on admission.
+        paged = eng.cache_impl == "paged"
+        legacy_paged = paged and eng.scheduler != "fused"
+        fused_ramping = paged and not legacy_paged and any(
+            s is not None and s.ramping for s in eng.slots)
+        # the fused defer also counts the resident ramps' OUTSTANDING
+        # block demand (the engine's exact predicate) — mirroring only
+        # the new prompt's need would stamp deferred requests as
+        # admission stalls, the precise misclassification this mark
+        # discipline exists to avoid
+        ramp_deficit = sum(
+            max(eng.prefill_blocks_needed(s.prompt_len)
+                - len(eng._slot_blocks[i]), 0)
+            for i, s in enumerate(eng.slots)
+            if s is not None and s.ramping) if fused_ramping else 0
         for i, h in enumerate(pending):
             admissible = i < free and (
-                not legacy_paged
+                not (legacy_paged or fused_ramping)
                 or eng.prefill_blocks_needed(len(h.request.prompt_ids))
-                <= eng._n_allocatable())
+                + ramp_deficit <= eng._n_allocatable())
             if admissible:
                 if h.stall_mark is None:
                     h.stall_mark = now

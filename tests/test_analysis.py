@@ -480,6 +480,78 @@ def test_ptl005_subtree_scan_uses_real_histogram_names(tmp_path):
     assert len(found) == 1 and "phantom_hist_s" in found[0].message
 
 
+# ---------------------------------------------------------------------------
+# PTL006 — device<->host KV copies outside the fence-tracked swap API
+# ---------------------------------------------------------------------------
+
+def test_ptl006_kv_copy_outside_swap_api_fires(tmp_path):
+    path = _write(tmp_path, "engine.py", """
+        import numpy as np
+        import jax
+
+        class Engine:
+            def _admit_custom(self):
+                # D2H of pool state, bypassing the swap accounting
+                host = np.asarray(self._k[0])
+                jax.device_put(host)            # no pool mention: clean
+                return host
+
+            def _restore_custom(self, blocks):
+                # calling the tier programs IS the tracked boundary
+                return self._kv_gather_fn(self._k, self._v, blocks)
+
+            def _stage(self, k_pools):
+                k_pools[0].copy_to_host_async()
+    """)
+    report = run_analysis([path])
+    found = _checks(report, "PTL006")
+    assert len(found) == 3, [f.message for f in found]
+    assert {f.func for f in found} == {"_admit_custom", "_restore_custom",
+                                       "_stage"}
+    assert all("fence-tracked swap API" in f.message for f in found)
+
+
+def test_ptl006_swap_api_functions_are_allowed(tmp_path):
+    """The four swap-API functions (matched by path suffix + name, like
+    the PTL001 readout allowlist) may issue KV transfers; a helper with
+    a DIFFERENT name in the same file may not."""
+    sub = tmp_path / "inference"
+    sub.mkdir()
+    (sub / "__init__.py").write_text("")
+    path = _write(sub, "llm_engine.py", """
+        import numpy as np
+
+        class Engine:
+            def _swap_out_slot(self, b, slot):
+                return self._kv_gather_fn(self._k, self._v, [0])
+
+            def _promote_spilled(self, h):
+                self._k, self._v = self._kv_scatter_fn(
+                    self._k, self._v, [0], [], [])
+
+            def _sneaky_copy(self):
+                return np.asarray(self._v[1])
+    """)
+    report = run_analysis([path])
+    found = _checks(report, "PTL006")
+    assert len(found) == 1 and found[0].func == "_sneaky_copy"
+
+
+def test_ptl006_suppressible_with_reason(tmp_path):
+    path = _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        class E:
+            def dump(self):
+                # ptlint: disable=PTL006 -- offline debug dump, engine quiesced
+                return np.asarray(self._k[0])
+    """)
+    report = run_analysis([path])
+    found = _checks(report, "PTL006")
+    assert len(found) == 1 and found[0].suppressed
+    assert report.exit_code == 0
+
+
 def test_baseline_round_trip(tmp_path):
     path = _write(tmp_path, "mod.py", """
         import numpy as np

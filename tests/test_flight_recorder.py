@@ -233,14 +233,15 @@ def test_recorder_survives_preemption_churn(tiny_model):
 
 def _mk_step(rec, *, kind="decode", grants=(), preempted=(), dispatch_s=0.01,
              sync_s=0.0, emit_s=0.0, wall_s=None, t0=100.0, admit_s=0.0,
-             readout_stride=1):
+             readout_stride=1, kv_swap_in_bytes=None, kv_swap_out_bytes=None):
     sid = rec.begin_step(
         scheduler="fused", kind=kind, grants=grants,
         tokens_scheduled=sum(g[3] for g in grants), token_budget=32,
         queue_depth=0, free_blocks=None, total_blocks=None,
         pipeline_inflight=1, preemptions=preempted, admit_s=admit_s,
         schedule_s=0.0, dispatch_s=dispatch_s, t_begin=t0,
-        readout_stride=readout_stride)
+        readout_stride=readout_stride, kv_swap_in_bytes=kv_swap_in_bytes,
+        kv_swap_out_bytes=kv_swap_out_bytes)
     rec.finish_step(sid, sync_s, emit_s)
     r = rec.get_step(sid)
     if wall_s is not None:
@@ -258,7 +259,15 @@ def _tok(rec, rid, sid, t):
 
 
 @pytest.mark.parametrize("setup,expect", [
-    (dict(preempted=(7,), wall_s=0.1), "preemption"),
+    # the preemption cause is SPLIT by host-tier involvement: tier
+    # traffic on the step (swap-out at the preemption or swap-in at
+    # its re-admission) means the KV moved through host RAM; none
+    # means it was recomputed from scratch
+    (dict(preempted=(7,), wall_s=0.1), "preempt_reprefill"),
+    (dict(preempted=(7,), wall_s=0.1, kv_swap_out_bytes=4096),
+     "preempt_swap"),
+    (dict(preempted=(7,), wall_s=0.1, kv_swap_in_bytes=4096),
+     "preempt_swap"),
     (dict(grants=((0, 1, "prefill", 16), (1, 2, "decode", 1)),
           kind="mixed", wall_s=0.1), "interfering_prefill"),
     # the legacy shape: no prefill grant, but an admission prefill train

@@ -150,6 +150,7 @@ def test_sampled_token_exact(tiny_model):
     assert got == want
 
 
+@pytest.mark.slow
 def test_spec_mixes_with_embed_and_generate(tiny_model, greedy_ref):
     """One token-budget walk serves speculative generation AND
     prefill-only embedding requests: the verify grants don't perturb

@@ -467,6 +467,7 @@ class TestObservability:
         assert expl["cold_miss"] is True
 
 
+@pytest.mark.slow
 def test_bench_smoke_prefix_cache(monkeypatch, tmp_path):
     """CPU dry-run of the llama_serve_prefix_cache bench line (satellite:
     the A/B rides the non-slow path so schema regressions surface in
