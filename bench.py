@@ -9,6 +9,8 @@ readout-stride on/off A/Bs — the latter reports per-arm
 rtt/dispatch/host-sync shares), llama_serve_fused (fused prefill+decode
 scheduler on/off A/B), llama_serve_prefix_cache (automatic prefix caching
 on/off A/B: shared-system-prompt hit-rate + zero-reuse overhead guard),
+llama_serve_slo (multi-tenant SLO isolation: adversarial flood vs victim
+tenant, per-tenant p99 TTFT + burn-rate alert fire/clear),
 llama_serve_spec, then the flagship llama LAST — each in its own
 subprocess, one JSON line each, so the tail line stays the llama MFU vs
 the 45% north star (BASELINE.json).
@@ -787,10 +789,12 @@ def _bench_other(model_name):
         # artifact, not a re-run.
         from paddle_tpu.profiler import FlightRecorder
 
-        def serve_pass(rec, supervise=None, step_timeout_s=None):
+        def serve_pass(rec, supervise=None, step_timeout_s=None,
+                       metrics_store=None):
             srv = AsyncLLMServer(eng, max_queue_size=n_req + 1,
                                  flight_recorder=rec, supervise=supervise,
-                                 step_timeout_s=step_timeout_s)
+                                 step_timeout_s=step_timeout_s,
+                                 metrics_store=metrics_store)
             srv.start()
             t0 = time.perf_counter()
             hs = [srv.submit(p, max_new_tokens=new_tokens)
@@ -833,6 +837,21 @@ def _bench_other(model_name):
             sup_off.append(serve_pass(None)[0])
         sup_overhead_pct = round(
             (median(sup_off) - median(sup_on)) / median(sup_off) * 100, 2)
+
+        # metrics-store A/B (SLO sensor layer): the same prompts
+        # re-served with the in-process time-series store attached —
+        # the loop feeds every gauge/counter as monotonic-stamped
+        # samples (interval-throttled) and the token hot path appends
+        # per-tenant latency samples. Budget: <2% tok/s (the flight
+        # recorder's budget — the off path is one detached-attribute
+        # check per site). Arms alternate, median-of-3, same protocol
+        # as the recorder A/B.
+        ms_on, ms_off = [], []
+        for _ in range(3):
+            ms_on.append(serve_pass(None, metrics_store=True)[0])
+            ms_off.append(serve_pass(None)[0])
+        ms_overhead_pct = round(
+            (median(ms_off) - median(ms_on)) / median(ms_off) * 100, 2)
 
         # multi-step on-device decode A/B (ROADMAP item 6): the same
         # prompts re-served through fused engines at readout_stride=k
@@ -889,6 +908,12 @@ def _bench_other(model_name):
                # at the artifact path below.
                "supervision_overhead_pct": sup_overhead_pct,
                "supervision_on_tokens_per_sec": round(median(sup_on), 1),
+               # metrics-store A/B (budget: < 2% tok/s — ring appends
+               # + throttled gauge feeds; off path is one detached-
+               # attribute check, same pattern as the recorder)
+               "metrics_store_overhead_pct": ms_overhead_pct,
+               "metrics_store_on_tokens_per_sec": round(
+                   median(ms_on), 1),
                "restart_recovery_artifact": os.path.join(
                    art_dir, "restart_recovery.json"),
                "tail_causes_p99": rec_snap["tail_causes_p99"],
@@ -1439,6 +1464,204 @@ def _bench_other(model_name):
                 "block_size": block, "pool_frac": pool_frac,
                 "spill_mb": spill_mb, "full_blocks": full_blocks,
                 "telemetry_artifact": art_path}
+
+    if model_name == "llama_serve_slo":
+        # Multi-tenant SLO isolation bench (the sensor half of ROADMAP
+        # item 4): an ADVERSARIAL tenant floods the queue with long
+        # prompts while a well-behaved VICTIM tenant keeps streaming
+        # short requests. The new per-tenant latency histograms measure
+        # the victim's p99 TTFT SEPARATELY from the adversary's (the
+        # global histogram would blend them), a calibrated
+        # SLO(metric="ttft_p99", tenant=victim) watches the victim from
+        # the metrics store, and the Google-SRE multi-window burn-rate
+        # alert must FIRE during the flood and CLEAR after it drains.
+        # The final slo_report + the burn-rate trajectory persist to
+        # docs/artifacts/slo_report.json — the evidence the PR-15+ SLO
+        # controller will close its loop against.
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import LLMEngine
+        from paddle_tpu.serving import (AdapterStore, AsyncLLMServer,
+                                        random_lora_weights)
+        from paddle_tpu.profiler import SLO, FlightRecorder
+        B = int(os.environ.get("BENCH_BATCH", "4"))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        chunk = int(os.environ.get("BENCH_CHUNK", "256"))
+        block = int(os.environ.get("BENCH_BLOCK", "64"))
+        victim_prompt = int(os.environ.get("BENCH_VICTIM_PROMPT", "32"))
+        victim_new = int(os.environ.get("BENCH_VICTIM_NEW_TOKENS", "12"))
+        flood_prompt = int(os.environ.get("BENCH_FLOOD_PROMPT", "256"))
+        flood_new = int(os.environ.get("BENCH_FLOOD_NEW_TOKENS", "48"))
+        n_flood = int(os.environ.get("BENCH_FLOOD", "16"))
+        n_warm = int(os.environ.get("BENCH_WARM", "6"))
+        interval = float(os.environ.get("BENCH_VICTIM_INTERVAL_S", "0.05"))
+        slow_w = float(os.environ.get("BENCH_SLO_WINDOW_S", "6.0"))
+        fast_w = float(os.environ.get("BENCH_SLO_FAST_WINDOW_S", "1.5"))
+        burn_thr = float(os.environ.get("BENCH_SLO_BURN", "2.0"))
+        wall_deadline = float(os.environ.get("BENCH_DEADLINE_S", "900"))
+        cap = -(-(max(flood_prompt, victim_prompt)
+                  + max(flood_new, victim_new)) // chunk) * chunk
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=heads,
+                          max_position_embeddings=cap)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg).bfloat16()
+        model.eval()
+        V = cfg.vocab_size
+        # the adversary is a REGISTERED TENANT (adapter id) so the
+        # tenant-keyed histograms and token counters split the traffic
+        adapters = AdapterStore(cfg, rank=4)
+        adversary = adapters.register(
+            random_lora_weights(cfg, rank=4, seed=7, scale=0.02),
+            alpha=1.0)
+        victim = 0                      # base-model tenant
+        eng = LLMEngine(model, max_batch=B, max_seq_len=cap,
+                        chunk_size=chunk, cache_impl="paged",
+                        block_size=block, scheduler="fused",
+                        adapter_store=adapters, adapter_cache_slots=2)
+        eng.generate([rng.integers(0, V, (3,)).astype(np.int32)],
+                     max_new_tokens=2)          # warm the programs
+        eng.reset_stats()
+
+        def vprompt():
+            return rng.integers(0, V, (victim_prompt,)).astype(np.int32)
+
+        # -- phase 1: calibration — victim-only baseline TTFT sets the
+        # SLO target (2x the observed median, floored) so the objective
+        # is honest for whatever hardware runs this
+        calib = AsyncLLMServer(eng, max_queue_size=n_warm + 1)
+        calib.start()
+        ttfts = []
+        for _ in range(n_warm):
+            h = calib.submit(vprompt(), max_new_tokens=victim_new)
+            r = h.result(timeout=wall_deadline)
+            ttfts.append(r.ttft_s)
+        calib.stop()
+        base_ttft = sorted(ttfts)[len(ttfts) // 2]
+        target_s = max(2.0 * base_ttft, 0.02)
+        slo = SLO("victim_ttft", "ttft_p99", tenant=victim,
+                  target_s=target_s, window_s=slow_w,
+                  fast_window_s=fast_w, burn_threshold=burn_thr)
+
+        # -- phase 2: the flood — adversary dumps n_flood long prompts,
+        # victim keeps a trickle of short requests flowing (bounded
+        # outstanding so the run length stays the flood's, not ours)
+        srv = AsyncLLMServer(eng, max_queue_size=n_flood + 64,
+                             flight_recorder=FlightRecorder(),
+                             metrics_store=True, slos=[slo],
+                             metrics_interval_s=0.02, slo_interval_s=0.1)
+        srv.start()
+        t0 = time.monotonic()
+        trajectory = []
+
+        def poll(phase):
+            (r,) = srv.slo_engine.evaluate()
+            trajectory.append({
+                "t_s": round(time.monotonic() - t0, 3), "phase": phase,
+                "burn_rate_fast": r["burn_rate_fast"],
+                "burn_rate_slow": r["burn_rate_slow"],
+                "burning": r["burning"], "measured_s": r["measured_s"],
+                "queue_depth": len(srv._queue)})
+            return r
+
+        flood = [srv.submit(
+            rng.integers(0, V, (flood_prompt,)).astype(np.int32),
+            max_new_tokens=flood_new, adapter_id=adversary)
+            for _ in range(n_flood)]
+        victims = []
+        while any(not h.done for h in flood):
+            if time.monotonic() - t0 > wall_deadline:
+                raise RuntimeError(
+                    f"llama_serve_slo: flood not drained after "
+                    f"{wall_deadline}s — pathological config")
+            if sum(1 for h in victims if not h.done) < 4:
+                victims.append(srv.submit(vprompt(),
+                                          max_new_tokens=victim_new))
+            poll("flood")
+            time.sleep(interval)
+        for h in flood:
+            h.result(timeout=wall_deadline)
+
+        # -- phase 3: recovery — victim streams alone until the burn
+        # alert CLEARS (bad samples age out of the fast window)
+        recover_deadline = time.monotonic() + max(4 * fast_w + 10.0, 30.0)
+        cleared_in_time = False
+        while time.monotonic() < recover_deadline:
+            h = srv.submit(vprompt(), max_new_tokens=victim_new)
+            victims.append(h)
+            h.result(timeout=wall_deadline)
+            poll("recovery")
+            burn_alerts = srv.metrics_store.alerts(kind="slo_burn")
+            if burn_alerts and all(not a.active for a in burn_alerts):
+                cleared_in_time = True
+                break
+            time.sleep(interval)
+        for h in victims:
+            h.result(timeout=wall_deadline)
+        poll("final")
+        report = srv.slo_report()
+        burn_alerts = [a.to_dict()
+                       for a in srv.metrics_store.alerts(kind="slo_burn")]
+        srv.stop()
+
+        fired = len(burn_alerts) > 0
+        tl = report["tenant_latency"]
+        vic_hist = tl[str(victim)]["ttft"]
+        adv_hist = tl[str(adversary)]["ttft"]
+        # the acceptance contract: the victim's p99 is measured PER
+        # TENANT (its own histogram, not the blended global one — the
+        # count is exactly the FLOOD SERVER's victim requests, each of
+        # which streamed at least one token; the calibration server's
+        # telemetry was separate), the burn alert fired under the
+        # flood and cleared after it
+        assert vic_hist["count"] == len(victims), \
+            f"victim tenant histogram counted {vic_hist['count']} " \
+            f"of {len(victims)} victim requests"
+        assert adv_hist["count"] == n_flood, \
+            "adversary tenant histogram miscounted the flood"
+        assert fired, "burn-rate alert never fired under the flood"
+        assert cleared_in_time, "burn-rate alert never cleared after"
+        art_path = os.path.join(_artifact_dir(), "slo_report.json")
+        with open(art_path, "w") as f:
+            json.dump({
+                "slo": {"name": slo.name, "metric": slo.metric,
+                        "tenant": victim,
+                        "target_s": round(target_s, 4),
+                        "window_s": slow_w, "fast_window_s": fast_w,
+                        "burn_threshold": burn_thr,
+                        "calibration_ttft_p50_s": round(base_ttft, 4)},
+                "report": report,
+                "burn_alerts": burn_alerts,
+                "trajectory": trajectory,
+                "config": {"slots": B, "flood": n_flood,
+                           "flood_prompt": flood_prompt,
+                           "victim_prompt": victim_prompt,
+                           "layers": n_layers, "hidden": hidden},
+            }, f, indent=1)
+        peak_burn = max(p["burn_rate_fast"] for p in trajectory)
+        return {"metric": "llama_serve_slo_victim_ttft_p99_ms",
+                "value": round(vic_hist["p99_s"] * 1e3, 1),
+                "unit": "ms", "vs_baseline": None,
+                "victim_ttft_p99_ms": round(vic_hist["p99_s"] * 1e3, 1),
+                "victim_ttft_p50_ms": round(vic_hist["p50_s"] * 1e3, 1),
+                "adversary_ttft_p99_ms": round(
+                    adv_hist["p99_s"] * 1e3, 1),
+                "target_ms": round(target_s * 1e3, 1),
+                "burn_alert_fired": fired,
+                "burn_alert_cleared": cleared_in_time,
+                "peak_burn_rate_fast": round(peak_burn, 1),
+                "trajectory_points": len(trajectory),
+                "victim_requests": len(victims),
+                "calibration_requests": n_warm,
+                "flood_requests": n_flood,
+                "pathologies_active": {k: v for k, v
+                                       in report["pathologies"].items()
+                                       if v},
+                "slo_report_artifact": art_path}
 
     if model_name == "llama_serve_cluster":
         # Multichip serving A/B (paddle_tpu/serving/cluster.py): ONE
@@ -2289,6 +2512,7 @@ def _run_all():
             ("llama_serve_fused", None), ("llama_serve_prefix_cache", None),
             ("llama_serve_kv_quant", None),
             ("llama_serve_kv_tier", None),
+            ("llama_serve_slo", None),
             ("llama_serve_cluster", None), ("llama_serve_spec", None),
             ("llama_serve_lora", None), ("llama_serve_embed", None),
             ("llama", None)]:

@@ -16,6 +16,8 @@ p99 bench three rounds later:
  PTL004      unguarded allocator/cache mutations + lock-order cycles
  PTL005      telemetry names missing from the ServingTelemetry registry
  PTL006      device↔host KV-pool copy outside the fence-tracked swap API
+ PTL007      SLO/pathology names missing from the ALERT_KINDS /
+             LABELED_GAUGE_FAMILIES registries
 ==========  =========================================================
 
 CLI::

@@ -17,7 +17,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="paddle_tpu project-specific static checks "
-                    "(PTL001-PTL005)")
+                    "(PTL001-PTL007)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/directories to analyze "
                          "(default: ./paddle_tpu)")

@@ -276,10 +276,11 @@ def default_checks():
     from .kv_transfer import KVTransferCheck
     from .locks import LockDisciplineCheck
     from .retrace import RetraceCheck
+    from .slo_names import SLONameCheck
     from .telemetry_names import TelemetryNameCheck
     return [_SuppressionPolicy(), HostSyncCheck(), RetraceCheck(),
             DonationCheck(), LockDisciplineCheck(), TelemetryNameCheck(),
-            KVTransferCheck()]
+            KVTransferCheck(), SLONameCheck()]
 
 
 class Report:

@@ -1,0 +1,195 @@
+"""PTL007 — SLO/pathology strict-name pass.
+
+The sensor layer carries two new dynamic-label name spaces beyond
+PTL005's telemetry registry: ``Alert.kind`` (every alert the SLO engine
+or a pathology detector may raise) and the labeled gauge FAMILIES
+(``slo_burn_rate``/``slo_breached``/``pathology_active``). At runtime
+``set_labeled_gauge`` raises ``KeyError`` for an undeclared family, but
+an alert kind typo'd at a ``raise_alert``/``clear_alert`` call site (or
+a detector class whose ``kind`` drifts from the registry) would only
+surface when that pathology actually FIRES — in production, by
+definition during an incident. This pass moves the check to lint time:
+
+* every literal first argument of ``.raise_alert(...)`` /
+  ``.clear_alert(...)``, every literal ``kind=`` (or first positional)
+  of an ``Alert(...)`` construction, and every class-level ``kind =
+  "..."`` of a ``*Detector`` class must appear in
+  ``paddle_tpu/profiler/metrics_store.py``'s ``ALERT_KINDS`` tuple;
+* every literal first argument of ``.set_labeled_gauge(...)`` must be a
+  key of ``paddle_tpu/profiler/serving_telemetry.py``'s
+  ``LABELED_GAUGE_FAMILIES`` dict.
+
+Dynamic names (variables, f-strings — e.g. a detector raising
+``self.kind``) are skipped; the runtime contract still covers those
+through the class-level ``kind`` literal this pass DOES check.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Check
+
+__all__ = ["SLONameCheck"]
+
+_ALERT_CALLS = ("raise_alert", "clear_alert")
+
+
+class SLONameCheck(Check):
+    id = "PTL007"
+    describe = ("SLO/pathology metric or Alert.kind not in the "
+                "ALERT_KINDS / LABELED_GAUGE_FAMILIES registries "
+                "(today a fire-time-only failure)")
+
+    def __init__(self, registry=None):
+        """``registry``: optional {"alert_kind": set, "labeled_gauge":
+        set} override (fixture tests); default parses the registries
+        out of the scanned ``metrics_store.py`` /
+        ``serving_telemetry.py`` (with an import fallback for subtree
+        runs, like PTL005)."""
+        self._override = registry
+        self.registry = {"alert_kind": set(), "labeled_gauge": set()}
+        self._saw_kinds = False
+        self._saw_families = False
+        self._fallback_done = False
+
+    # -- registry harvesting --------------------------------------------
+    @staticmethod
+    def _harvest_kinds(tree, registry):
+        """``ALERT_KINDS = ("...", ...)`` module-level tuple."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "ALERT_KINDS" \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                for e in node.value.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, str):
+                        registry["alert_kind"].add(e.value)
+
+    @staticmethod
+    def _harvest_families(tree, registry):
+        """``LABELED_GAUGE_FAMILIES = {"name": "label", ...}``."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "LABELED_GAUGE_FAMILIES" \
+                    and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        registry["labeled_gauge"].add(k.value)
+
+    def collect(self, mod):
+        if self._override is not None:
+            return
+        if mod.relpath.endswith("metrics_store.py"):
+            self._saw_kinds = True
+            self._harvest_kinds(mod.tree, self.registry)
+        if mod.relpath.endswith("serving_telemetry.py"):
+            self._saw_families = True
+            self._harvest_families(mod.tree, self.registry)
+
+    def _registry(self):
+        if self._override is not None:
+            return self._override
+        if (not self._saw_kinds or not self._saw_families) \
+                and not self._fallback_done:
+            # registry modules not in the scanned tree (fixture dirs,
+            # subtree runs): parse the REAL modules' source with the
+            # same harvest logic — cached, one parse per run
+            self._fallback_done = True
+            try:
+                if not self._saw_kinds:
+                    from ..profiler import metrics_store as ms
+                    with open(ms.__file__, encoding="utf-8") as fh:
+                        self._harvest_kinds(ast.parse(fh.read()),
+                                            self.registry)
+                if not self._saw_families:
+                    from ..profiler import serving_telemetry as st
+                    with open(st.__file__, encoding="utf-8") as fh:
+                        self._harvest_families(ast.parse(fh.read()),
+                                               self.registry)
+            except Exception:
+                pass
+        return self.registry
+
+    # -- call-site checking ---------------------------------------------
+    def run(self, mod):
+        if not any(tok in mod.text for tok in
+                   ("raise_alert(", "clear_alert(", "set_labeled_gauge(",
+                    "Alert(", "Detector")):     # textual prefilter
+            return
+        reg = self._registry()
+        if not reg.get("alert_kind") and not reg.get("labeled_gauge"):
+            return          # no registry found at all: nothing to check
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(mod, node, reg)
+            elif isinstance(node, ast.ClassDef) and \
+                    node.name.endswith("Detector"):
+                yield from self._check_detector_class(mod, node, reg)
+
+    def _check_call(self, mod, node, reg):
+        kinds = reg.get("alert_kind", set())
+        fams = reg.get("labeled_gauge", set())
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _ALERT_CALLS and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                kind = node.args[0].value
+                if kind not in kinds:
+                    yield self.finding(
+                        mod, node,
+                        f"alert kind {kind!r} is not in ALERT_KINDS — "
+                        f"an undeclared kind only surfaces when the "
+                        f"alert fires (add it to metrics_store"
+                        f".ALERT_KINDS)",
+                        key=f"unknown-alert-kind:{kind}")
+            if func.attr == "set_labeled_gauge" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                fam = node.args[0].value
+                if fam not in fams:
+                    yield self.finding(
+                        mod, node,
+                        f"labeled gauge family {fam!r} is not in "
+                        f"LABELED_GAUGE_FAMILIES — this call raises "
+                        f"KeyError the first time this path runs",
+                        key=f"unknown-labeled-gauge:{fam}")
+        # Alert(kind=...) / Alert("kind", ...) direct constructions
+        if isinstance(func, ast.Name) and func.id == "Alert":
+            kind = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                kind = node.args[0].value
+            for kw in node.keywords:
+                if kw.arg == "kind" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    kind = kw.value.value
+            if kind is not None and kind not in kinds:
+                yield self.finding(
+                    mod, node,
+                    f"Alert kind {kind!r} is not in ALERT_KINDS",
+                    key=f"unknown-alert-kind:{kind}")
+
+    def _check_detector_class(self, mod, node, reg):
+        kinds = reg.get("alert_kind", set())
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == "kind" \
+                    and isinstance(stmt.value, ast.Constant) \
+                    and isinstance(stmt.value.value, str):
+                kind = stmt.value.value
+                if kind in ("unnamed",):    # the abstract base's stub
+                    continue
+                if kind not in kinds:
+                    yield self.finding(
+                        mod, stmt,
+                        f"detector class {node.name} declares kind "
+                        f"{kind!r} which is not in ALERT_KINDS — its "
+                        f"alerts and pathology_active label would be "
+                        f"unregistered schema",
+                        key=f"unknown-alert-kind:{kind}",
+                        func=node.name)

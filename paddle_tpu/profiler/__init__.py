@@ -18,17 +18,24 @@ from enum import Enum
 
 from .timer import benchmark  # noqa: F401
 from .serving_telemetry import (  # noqa: F401
-    LatencyHistogram, ServingTelemetry)
+    LABELED_GAUGE_FAMILIES, LatencyHistogram, ServingTelemetry)
 from .flight_recorder import (  # noqa: F401
     FlightRecorder, StepRecord, TAIL_CAUSES)
+from .metrics_store import (  # noqa: F401
+    Alert, ALERT_KINDS, MetricsStore, Series)
+from .slo import (  # noqa: F401
+    SLO, SLOEngine, default_detectors, evaluate_slo, format_slo_report)
 
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "load_profiler_result",
     "summarize_device_trace",
     "SummaryView", "benchmark", "merge_profile",
-    "ServingTelemetry", "LatencyHistogram",
+    "ServingTelemetry", "LatencyHistogram", "LABELED_GAUGE_FAMILIES",
     "FlightRecorder", "StepRecord", "TAIL_CAUSES",
+    "MetricsStore", "Series", "Alert", "ALERT_KINDS",
+    "SLO", "SLOEngine", "default_detectors", "evaluate_slo",
+    "format_slo_report",
 ]
 
 

@@ -245,6 +245,27 @@ class FlightRecorder:
         self._live: dict[int, _RequestTrace] = {}
         self._done: collections.OrderedDict[int, _RequestTrace] = \
             collections.OrderedDict()
+        #: step subscribers (the live pathology detectors): called with
+        #: each COMPLETED StepRecord after finish_step, outside the
+        #: recorder lock (a subscriber may take store/telemetry locks).
+        #: Empty-list check is the only cost when nobody subscribes.
+        self._subs = []
+
+    # -- step subscribers (live detectors) ------------------------------
+    def subscribe(self, fn):
+        """Register ``fn(record)`` to run after every completed step —
+        the live pathology detectors' feed. Runs on the engine thread;
+        a raising subscriber is dropped from the next notification only
+        by its own removal — exceptions are swallowed so a detector bug
+        can never crash the serve loop."""
+        self._subs.append(fn)
+        return fn
+
+    def unsubscribe(self, fn):
+        try:
+            self._subs.remove(fn)
+        except ValueError:
+            pass
 
     # -- step records (engine thread) -----------------------------------
     def next_step_id(self):
@@ -293,6 +314,15 @@ class FlightRecorder:
             rec.finished = tuple(finished)
             rec.spec_accepted = int(spec_accepted)
             rec.spec_rejected = int(spec_rejected)
+        if self._subs:
+            # OUTSIDE the recorder lock: subscribers (detectors) take
+            # store/telemetry locks of their own, and nothing here may
+            # deadlock or crash the engine thread
+            for fn in tuple(self._subs):
+                try:
+                    fn(rec)
+                except Exception:
+                    pass
 
     def get_step(self, step_id):
         with self._lock:
@@ -438,6 +468,30 @@ class FlightRecorder:
                 "name": f"step {rec.step_id} [{rec.kind}]",
                 "ts": t0, "dur": dur,
                 "args": rec.to_dict()})
+            # Perfetto COUNTER tracks ("ph": "C") — per-step load
+            # context rendered as line charts UNDER the request lanes:
+            # queue depth, pool occupancy, budget utilization, and the
+            # speculative acceptance rate. One sample per StepRecord at
+            # its dispatch time; series the record cannot source (dense
+            # pools, non-spec steps) emit nothing rather than zeros.
+            events.append({"ph": "C", "pid": pid, "name": "queue_depth",
+                           "ts": t0,
+                           "args": {"value": rec.queue_depth}})
+            events.append({"ph": "C", "pid": pid,
+                           "name": "token_budget_utilization", "ts": t0,
+                           "args": {"value": round(
+                               rec.budget_utilization, 4)}})
+            if rec.total_blocks:
+                occ = 1.0 - rec.free_blocks / rec.total_blocks
+                events.append({"ph": "C", "pid": pid,
+                               "name": "kv_pool_occupancy", "ts": t0,
+                               "args": {"value": round(occ, 4)}})
+            verified = rec.spec_accepted + rec.spec_rejected
+            if verified:
+                events.append({"ph": "C", "pid": pid,
+                               "name": "spec_acceptance_rate", "ts": t0,
+                               "args": {"value": round(
+                                   rec.spec_accepted / verified, 4)}})
         for lane in range(max(len(lane_ends), 1)):
             events.append({
                 "ph": "M", "pid": pid, "tid": lane, "name": "thread_name",

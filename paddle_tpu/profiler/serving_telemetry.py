@@ -39,7 +39,8 @@ import contextlib
 import threading
 import time
 
-__all__ = ["LatencyHistogram", "ServingTelemetry", "STAGES", "GAUGES"]
+__all__ = ["LatencyHistogram", "ServingTelemetry", "STAGES", "GAUGES",
+           "LABELED_GAUGE_FAMILIES"]
 
 #: the named stages of the serve loop, in pipeline order. Every second of
 #: busy engine-thread wall time lands in exactly one of these (or in
@@ -71,7 +72,29 @@ GAUGES = ("queue_depth", "engine_waiting", "running_slots",
           # kv_spill_blocks/kv_promote_blocks instead — the swap bytes
           # double as the preempt_swap classifier signal), and the host
           # spill store's current block count (all 0 with the tier off)
-          "kv_swap_in_bytes", "kv_swap_out_bytes", "kv_host_spill_blocks")
+          "kv_swap_in_bytes", "kv_swap_out_bytes", "kv_host_spill_blocks",
+          # gauge STALENESS: seconds since the serve loop last sampled
+          # the point-in-time gauges (mark_gauge_sample). Computed at
+          # READ time from the sampling stamp — a hung/idle loop's
+          # stale gauges are visible as a GROWING age instead of
+          # silently frozen values (the watchdog's hung flip does not
+          # refresh it: only a real loop pass does)
+          "gauge_last_sample_age_s")
+
+#: labeled gauge FAMILIES — dynamic-label metric families (like
+#: tenant_tokens): the SLO engine's per-objective burn gauges and the
+#: live pathology detectors' active flags. Family -> its label key.
+#: Families are schema (strict: set_labeled_gauge raises KeyError on an
+#: unknown one, and the PTL007 analysis pass checks call sites); the
+#: label VALUES (slo names, detector kinds) are data.
+LABELED_GAUGE_FAMILIES = {"slo_burn_rate": "slo",
+                          "slo_breached": "slo",
+                          "pathology_active": "kind"}
+
+#: latency families that keep PER-TENANT histograms alongside the
+#: global ones (observe(..., tenant=i)); admission_stall stays global
+#: (admission is a shared-queue property, not a tenant one).
+_TENANT_HISTS = ("ttft_s", "inter_token_s", "e2e_s", "queue_wait_s")
 
 _COUNTERS = ("requests_submitted", "requests_admitted", "requests_finished",
              "requests_cancelled", "requests_expired",
@@ -148,14 +171,45 @@ class LatencyHistogram:
                 "p90_s": round(self.quantile(0.9), 6),
                 "p99_s": round(self.quantile(0.99), 6)}
 
-    def prometheus_lines(self, name, labels=""):
+    def copy(self):
+        out = LatencyHistogram(self.bounds)
+        out.counts = list(self.counts)
+        out.count = self.count
+        out.total = self.total
+        out.minimum = self.minimum
+        out.maximum = self.maximum
+        return out
+
+    def merge(self, other):
+        """BUCKET-WISE merge of another histogram into this one — the
+        fleet aggregation primitive (N replicas' per-tenant histograms
+        sum into one whose quantile estimates are exact at bucket
+        resolution, which per-replica quantiles can never recombine
+        into). Requires identical bucket bounds."""
+        if other.bounds != self.bounds:
+            raise ValueError("cannot merge histograms with different "
+                             "bucket bounds")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.total += other.total
+        if other.count:
+            self.minimum = min(self.minimum, other.minimum)
+            self.maximum = max(self.maximum, other.maximum)
+        return self
+
+    def prometheus_lines(self, name, labels="", type_line=True):
         """Cumulative-bucket exposition lines (histogram type).
         ``labels``: extra label body WITHOUT braces or leading comma
         (e.g. ``replica="0"``) — composed correctly into both the
-        ``le``-labeled bucket lines and the bare sum/count lines."""
+        ``le``-labeled bucket lines and the bare sum/count lines.
+        ``type_line=False`` omits the ``# TYPE`` header — for extra
+        labeled series (per-tenant) of a family whose header an earlier
+        histogram already emitted (a repeated TYPE line within one
+        exposition is invalid)."""
         sep = ("," + labels) if labels else ""
         bare = ("{" + labels + "}") if labels else ""
-        lines = [f"# TYPE {name} histogram"]
+        lines = [f"# TYPE {name} histogram"] if type_line else []
         acc = 0
         for bound, c in zip(self.bounds, self.counts):
             acc += c
@@ -205,6 +259,23 @@ class ServingTelemetry:
             self.counters.update({n: 0 for n in self._extra["counter"]})
             self.gauges = {name: 0.0 for name in GAUGES}
             self.gauges.update({n: 0.0 for n in self._extra["gauge"]})
+            #: gauge STALENESS stamps (time.monotonic): per-gauge write
+            #: times plus the serve loop's whole-pass sampling mark —
+            #: gauge_last_sample_age_s is computed from these at READ
+            #: time, so a hung loop's frozen gauges age visibly
+            self.gauge_stamps = {}
+            self._started_mono = time.monotonic()
+            self._gauge_sample_t = None
+            #: labeled gauge families (slo_burn_rate{slo=...},
+            #: pathology_active{kind=...}): family -> {label: value}.
+            #: Families are schema (LABELED_GAUGE_FAMILIES), labels are
+            #: data — same split as tenant_tokens.
+            self.labeled_gauges = {n: {} for n in LABELED_GAUGE_FAMILIES}
+            #: per-TENANT latency histograms (adapter_id -> {family:
+            #: LatencyHistogram}), populated lazily by observe(...,
+            #: tenant=i) ALONGSIDE the global families — the per-tenant
+            #: p99s the SLO layer scopes objectives against
+            self.tenant_latency = {}
             #: per-TENANT processed-token counters (adapter_id ->
             #: tokens): generated tokens per tenant, plus an embed
             #: request's pooled prompt tokens at its finish. Tenant ids
@@ -264,17 +335,96 @@ class ServingTelemetry:
                     f"unknown telemetry gauge {name!r} — declare it with "
                     f"register('gauge', {name!r}) first")
             self.gauges[name] = float(value)
+            self.gauge_stamps[name] = time.monotonic()
 
-    def observe(self, hist_name, v):
+    def set_labeled_gauge(self, family, label, value):
+        """Set one labeled gauge sample (``family{<key>="<label>"}``).
+        The FAMILY must be declared in :data:`LABELED_GAUGE_FAMILIES`
+        (strict, like set_gauge); the label value is dynamic data (an
+        SLO name, a detector kind)."""
+        with self._lock:
+            if family not in self.labeled_gauges:
+                raise KeyError(
+                    f"unknown labeled gauge family {family!r} — declare "
+                    f"it in LABELED_GAUGE_FAMILIES")
+            self.labeled_gauges[family][str(label)] = float(value)
+
+    def mark_gauge_sample(self):
+        """Stamp 'the serve loop sampled the gauges NOW' — the write
+        side of ``gauge_last_sample_age_s``. Called once per loop pass
+        (after ``_update_gauges``); deliberately NOT called by the
+        watchdog or any out-of-loop writer, so a hung loop's age keeps
+        growing even while the watchdog flips ``server_healthy``."""
+        with self._lock:
+            self._gauge_sample_t = time.monotonic()
+
+    def _gauge_age_locked(self, now=None):
+        """Seconds since the last loop-pass gauge sample (uptime when
+        none happened yet). Caller holds the lock."""
+        if now is None:
+            now = time.monotonic()
+        base = self._gauge_sample_t if self._gauge_sample_t is not None \
+            else self._started_mono
+        return max(now - base, 0.0)
+
+    def observe(self, hist_name, v, tenant=None):
+        """Observe one latency sample. With ``tenant`` set, the sample
+        ALSO lands in that tenant's histogram of the same family
+        (created lazily) — ``hist_name`` must then be one of
+        :data:`_TENANT_HISTS` (strict)."""
         with self._lock:
             getattr(self, hist_name).observe(v)
+            if tenant is None:
+                return
+            if hist_name not in _TENANT_HISTS:
+                raise KeyError(
+                    f"telemetry histogram {hist_name!r} has no per-tenant "
+                    f"variant (families: {_TENANT_HISTS})")
+            fams = self.tenant_latency.get(int(tenant))
+            if fams is None:
+                fams = self.tenant_latency[int(tenant)] = {
+                    n: LatencyHistogram() for n in _TENANT_HISTS}
+            fams[hist_name].observe(v)
 
     # -- read side ------------------------------------------------------
     def get_gauges(self):
         """Point-in-time copy of every gauge — the replica router's
-        load-scoring read (one lock, one dict copy)."""
+        load-scoring read (one lock, one dict copy).
+        ``gauge_last_sample_age_s`` is computed here, at read time: the
+        stored 0.0 would claim freshness a hung loop does not have."""
         with self._lock:
-            return dict(self.gauges)
+            out = dict(self.gauges)
+            out["gauge_last_sample_age_s"] = self._gauge_age_locked()
+            return out
+
+    def get_counters(self):
+        """Point-in-time copy of every counter — the metrics-store
+        feed's read (counter deltas become windowed rate() series)."""
+        with self._lock:
+            return dict(self.counters)
+
+    def tenant_latency_hists(self):
+        """Deep-copied per-tenant histograms ``{tenant: {family:
+        LatencyHistogram}}`` — the fleet merge's input (copies, so the
+        router's bucket-wise merge never mutates live telemetry)."""
+        with self._lock:
+            return {t: {n: h.copy() for n, h in fams.items()}
+                    for t, fams in self.tenant_latency.items()}
+
+    @staticmethod
+    def render_tenant_latency(hists):
+        """JSON-ready rendering of a ``{tenant: {family_name:
+        LatencyHistogram}}`` map (family names lose their ``_s``
+        suffix, mirroring the global ``latency`` snapshot keys) — THE
+        one copy, shared by snapshot(), the server's slo_report and
+        the router's fleet merge."""
+        return {str(t): {n[:-2]: h.snapshot() for n, h in fams.items()}
+                for t, fams in sorted(hists.items())}
+
+    def tenant_latency_snapshot(self):
+        """The per-tenant latency block as snapshot()/slo_report()
+        expose it."""
+        return self.render_tenant_latency(self.tenant_latency_hists())
 
     def attribution(self, wall_s=None, include_idle=False):
         """Per-stage share of ``wall_s`` (default: telemetry uptime) and
@@ -304,6 +454,8 @@ class ServingTelemetry:
                 "tenant_tokens": {str(k): v for k, v
                                   in sorted(self.tenant_tokens.items())},
                 "gauges": {k: round(v, 6) for k, v in self.gauges.items()},
+                "labeled_gauges": {fam: dict(vals) for fam, vals
+                                   in self.labeled_gauges.items()},
                 "stages_s": {k: round(v, 6)
                              for k, v in self.stage_s.items()},
                 "latency": {
@@ -313,7 +465,14 @@ class ServingTelemetry:
                     "queue_wait": self.queue_wait_s.snapshot(),
                     "admission_stall": self.admission_stall_s.snapshot(),
                 },
+                "tenant_latency": self.render_tenant_latency(
+                    self.tenant_latency),
             }
+            out["gauges"]["gauge_last_sample_age_s"] = round(
+                self._gauge_age_locked(), 6)
+            now = time.monotonic()
+            out["gauge_ages"] = {k: round(now - t, 6) for k, t
+                                 in sorted(self.gauge_stamps.items())}
             prefill = self.counters["prefill_tokens"]
             decode = self.counters["tokens_emitted"]
             #: share of all processed tokens that were PREFILL — how much
@@ -335,6 +494,7 @@ class ServingTelemetry:
             brace = ("{" + lbl + "}") if lbl else ""
             counters = dict(self.counters)
             gauges = dict(self.gauges)
+            gauges["gauge_last_sample_age_s"] = self._gauge_age_locked()
             stages = dict(self.stage_s)
             hists = {"ttft_seconds": self.ttft_s,
                      "inter_token_seconds": self.inter_token_s,
@@ -361,6 +521,21 @@ class ServingTelemetry:
                 full = f"{prefix}_{name}"
                 lines.append(f"# TYPE {full} gauge")
                 lines.append(f"{full}{brace} {val:g}")
+            extra = ("," + lbl) if lbl else ""
+            for fam, label_key in LABELED_GAUGE_FAMILIES.items():
+                vals = self.labeled_gauges.get(fam)
+                if not vals:
+                    continue
+                full = f"{prefix}_{fam}"
+                lines.append(f"# TYPE {full} gauge")
+                for label, v in sorted(vals.items()):
+                    # exposition label-value escaping (SLO.name also
+                    # validates, but detector kinds / future callers
+                    # ride the same emitter): \ -> \\, " -> \", NL -> \n
+                    esc = (str(label).replace("\\", "\\\\")
+                           .replace('"', '\\"').replace("\n", "\\n"))
+                    lines.append(
+                        f'{full}{{{label_key}="{esc}"{extra}}} {v:g}')
             full = f"{prefix}_stage_seconds_total"
             lines.append(f"# TYPE {full} counter")
             stage_extra = ("," + lbl) if lbl else ""
@@ -370,4 +545,21 @@ class ServingTelemetry:
             for name, h in hists.items():
                 lines.extend(h.prometheus_lines(f"{prefix}_{name}",
                                                 labels=lbl))
+                # per-tenant series of the SAME family ride under the
+                # global header (one # TYPE line per family — repeated
+                # headers are invalid exposition), labeled tenant="i".
+                # The histogram-attribute name derives from the
+                # exposition name so promoting a family into
+                # _TENANT_HISTS is one edit, not two.
+                base = name.replace("_seconds", "_s")
+                if base not in _TENANT_HISTS:
+                    continue
+                for tenant, fams in sorted(self.tenant_latency.items()):
+                    th = fams.get(base)
+                    if th is None or not th.count:
+                        continue
+                    tlbl = f'tenant="{tenant}"' + (("," + lbl) if lbl
+                                                   else "")
+                    lines.extend(th.prometheus_lines(
+                        f"{prefix}_{name}", labels=tlbl, type_line=False))
         return "\n".join(lines) + "\n"

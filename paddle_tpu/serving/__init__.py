@@ -19,6 +19,15 @@ server replicas, with drain/failover) — and the fault-tolerance layer in
 engine restart with token-exact resumption) and :class:`FaultInjector`
 (deterministic scripted chaos for the tier-1 recovery tests).
 
+The SLO sensor layer rides the same server:
+``AsyncLLMServer(metrics_store=True, slos=[SLO(...)])`` feeds every
+gauge/counter into an in-process metric time-series store
+(:mod:`paddle_tpu.profiler.metrics_store`), keeps the latency
+histograms per tenant, evaluates declarative SLOs with multi-window
+burn-rate alerts and arms live pathology detectors over the flight
+recorder (:mod:`paddle_tpu.profiler.slo`); ``server.slo_report()`` /
+``ReplicaRouter.slo_report()`` surface the per-server and fleet views.
+
 Multi-tenant serving lives in :mod:`paddle_tpu.serving.adapters`
 (:class:`AdapterStore` + the engine's batched multi-LoRA device cache —
 one fused step serves any mix of fine-tunes of one base model) and

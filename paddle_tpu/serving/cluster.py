@@ -318,7 +318,8 @@ class ReplicaRouter:
                  policy="affinity", poll_interval_s=0.01,
                  failover_retry_s=10.0, max_retry_backoff_s=0.5,
                  resume_inflight=False, seed=0,
-                 adapter_affinity_weight=1.0):
+                 adapter_affinity_weight=1.0, metrics_store=None,
+                 metrics_interval_s=0.05):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         if policy not in ("affinity", "least_loaded", "random"):
@@ -357,6 +358,20 @@ class ReplicaRouter:
         #: costs survivor FLOPs a latency-critical cluster may prefer to
         #: spend on fresh traffic.
         self.resume_inflight = bool(resume_inflight)
+        #: optional router-level metrics store: the monitor loop feeds
+        #: its own view (outstanding placements per replica, failover
+        #: counters) as replica-labeled time series — the fleet-side
+        #: half of the sensor layer (True = default-sized store)
+        if metrics_store is True:
+            from ..profiler.metrics_store import MetricsStore
+            metrics_store = MetricsStore()
+        # falsy (False) normalizes to the detached None off-path
+        self.metrics_store = metrics_store or None
+        #: monitor-side feed throttle (same discipline as the server's
+        #: _feed_sensors): the monitor ticks every poll_interval_s
+        #: (10ms default) but the store samples at this cadence
+        self.metrics_interval_s = float(metrics_interval_s)
+        self._ms_last_t = 0.0
         self._rng = np.random.default_rng(seed)
         # PADDLE_TPU_LOCK_CHECKS=1: acquisition edges feed the PTL004
         # lock-order watchdog (paddle_tpu.analysis.lock_watchdog)
@@ -640,6 +655,28 @@ class ReplicaRouter:
                 if inner is not None and inner.done:
                     self._resolve(rh)
             self._failover_hung()
+            if self.metrics_store is not None:
+                self._feed_metrics_store()
+
+    def _feed_metrics_store(self):
+        """Feed the router's own counters + per-replica placement view
+        into the router-level metrics store (interval-throttled — the
+        monitor ticks far faster than a useful sampling cadence)."""
+        store = self.metrics_store
+        t = time.monotonic()
+        if t - self._ms_last_t < self.metrics_interval_s:
+            return
+        self._ms_last_t = t
+        with self._lock:
+            live = list(self._live_per)
+            stats = dict(self.stats)
+        store.observe("router_outstanding", sum(live), t=t)
+        for i, n in enumerate(live):
+            store.observe("router_replica_outstanding", n, t=t,
+                          replica=i)
+        for key in ("submitted", "resubmitted", "replica_lost",
+                    "resumed", "evicted_hung"):
+            store.observe(f"router_{key}", stats[key], t=t)
 
     def _failover_hung(self):
         """Health-probe failover: a replica whose :meth:`AsyncLLMServer
@@ -862,6 +899,84 @@ class ReplicaRouter:
                     "swap_in_bytes": eng.stats.get("kv_swap_in_bytes", 0),
                 },
                 "telemetry": srv.telemetry.snapshot()}
+        return out
+
+    def slo_report(self):
+        """FLEET-level SLO/sensor report — the one view that answers
+        "is tenant 3's p99 TTFT isolated while tenant 0 floods the
+        queue, and on which replica?":
+
+        * ``replicas`` — each replica's own :meth:`AsyncLLMServer
+          .slo_report` (per-replica burn rates, alerts, pathologies);
+        * ``fleet.slos`` — every SLO (union across replicas, by name)
+          re-evaluated over the windowed latency samples CONCATENATED
+          across the replica stores — a fleet burn rate, not an
+          average of per-replica ones;
+        * ``fleet.tenant_latency`` — per-tenant histograms merged
+          BUCKET-WISE across replicas (exact at bucket resolution —
+          per-replica p99s cannot be recombined);
+        * ``fleet.alerts`` / ``fleet.pathologies`` — each replica's
+          alert log and active detectors, replica-labeled;
+        * ``router`` — the router-level store's snapshot (replica-
+          labeled placement series) when one is attached.
+
+        ``text`` is the human rendering."""
+        from ..profiler.serving_telemetry import ServingTelemetry
+        from ..profiler.slo import evaluate_slo, format_fleet_report
+        replicas = {}
+        merged = {}                  # tenant -> {family: LatencyHistogram}
+        slos_by_name = {}
+        stores = []
+        alerts = []
+        pathologies = {}
+        for i, srv in enumerate(self.replicas):
+            rep = srv.slo_report()
+            replicas[i] = rep
+            if srv.metrics_store is not None:
+                stores.append(srv.metrics_store)
+            if srv.slo_engine is not None:
+                for s in srv.slo_engine.slos:
+                    slos_by_name.setdefault(s.name, s)
+            for t, fams in srv.telemetry.tenant_latency_hists().items():
+                tgt = merged.setdefault(t, {})
+                for n, h in fams.items():
+                    if n in tgt:
+                        tgt[n].merge(h)
+                    else:
+                        tgt[n] = h   # already a copy
+            for a in rep["alerts"]:
+                alerts.append({**a, "replica": i})
+            for kind, active in rep["pathologies"].items():
+                if active:
+                    pathologies.setdefault(kind, []).append(i)
+        now = time.monotonic()
+        fleet_slos = []
+        for s in slos_by_name.values():
+            fast, slow = [], []
+            truncated = False
+            for store in stores:
+                sl, fa, tr = store.windowed_values(
+                    s.series_name, s.window_s,
+                    fast_window_s=s.fast_window, now=now,
+                    labels=s.series_labels)
+                slow.extend(sl)
+                fast.extend(fa)
+                truncated = truncated or tr
+            fleet_slos.append(evaluate_slo(s, fast, slow,
+                                           window_truncated=truncated))
+        out = {
+            "replicas": replicas,
+            "fleet": {
+                "slos": fleet_slos,
+                "tenant_latency":
+                    ServingTelemetry.render_tenant_latency(merged),
+                "alerts": alerts,
+                "pathologies": pathologies,
+            },
+        }
+        if self.metrics_store is not None:
+            out["router"] = self.metrics_store.snapshot(max_samples=16)
+        out["text"] = format_fleet_report(out)
         return out
 
     def prometheus_text(self):

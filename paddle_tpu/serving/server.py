@@ -76,7 +76,9 @@ class AsyncLLMServer:
                  poll_interval_s=0.005, telemetry=None,
                  flight_recorder=None, replica=None, supervise=None,
                  step_timeout_s=None, fault_injector=None,
-                 shed_deadlines=False):
+                 shed_deadlines=False, metrics_store=None, slos=None,
+                 pathology_detectors=None, metrics_interval_s=0.05,
+                 slo_interval_s=0.25):
         """``flight_recorder``: a
         :class:`~paddle_tpu.profiler.flight_recorder.FlightRecorder`
         instance (or ``True`` for a default-sized one) to attach to the
@@ -125,7 +127,32 @@ class AsyncLLMServer:
         request whose ``deadline_s`` budget is already below the
         telemetry-estimated queue wait + time-to-first-token is finished
         with ``finish_reason="deadline"`` at submit/admission, BEFORE
-        its prefill burns FLOPs a doomed stream can never repay."""
+        its prefill burns FLOPs a doomed stream can never repay.
+
+        ``metrics_store``: a
+        :class:`~paddle_tpu.profiler.metrics_store.MetricsStore` (or
+        ``True`` for a default-sized one) — the serve loop feeds every
+        gauge and counter into it as monotonic-stamped time series
+        (throttled to ``metrics_interval_s``) and the token hot path
+        appends per-tenant latency samples, giving windowed
+        rate/mean/quantile queries over time. None (the default) costs
+        a single detached-attribute check per site — same budget as
+        the flight recorder.
+
+        ``slos``: a list of :class:`~paddle_tpu.profiler.slo.SLO`
+        objectives — arms the SLO engine (evaluated from the store
+        every ``slo_interval_s`` on the loop, and on demand via
+        :meth:`slo_report`), maintaining the multi-window burn-rate
+        alerts and the ``slo_burn_rate{slo=...}`` /
+        ``slo_breached{slo=...}`` gauges. Implies a metrics store.
+
+        ``pathology_detectors``: live pathology detectors subscribed
+        to the flight recorder's completed StepRecords (ramp-thrash,
+        host-sync regression, spec-acceptance collapse, adapter-swap
+        storm, swap-stall — ``explain_tail``'s taxonomy as streaming
+        alerts). None (default) arms the standard set when BOTH a
+        metrics store and a flight recorder are attached; an explicit
+        list overrides; ``False`` disables."""
         if pipeline_depth is not None and pipeline_depth < 1:
             raise ValueError(f"pipeline_depth must be >= 1, "
                              f"got {pipeline_depth}")
@@ -173,6 +200,29 @@ class AsyncLLMServer:
                                if step_timeout_s is not None else None)
         self.fault_injector = fault_injector
         self.shed_deadlines = bool(shed_deadlines)
+        # ---- SLO sensor layer (metrics store / SLOs / detectors) -----
+        if metrics_store is True or (slos and not metrics_store):
+            from ..profiler.metrics_store import MetricsStore
+            metrics_store = MetricsStore()
+        # normalize falsy (False, mirroring pathology_detectors=False)
+        # to the detached None off-path — `False is not None` would
+        # otherwise sail past every off-path check into store calls
+        self.metrics_store = metrics_store or None
+        self.metrics_interval_s = float(metrics_interval_s)
+        self.slo_interval_s = float(slo_interval_s)
+        self.slo_engine = None
+        if slos:
+            from ..profiler.slo import SLOEngine
+            self.slo_engine = SLOEngine(slos, self.metrics_store,
+                                        telemetry=self.telemetry)
+        if pathology_detectors is None and self.metrics_store is not None \
+                and self.flight_recorder is not None:
+            from ..profiler.slo import default_detectors
+            pathology_detectors = default_detectors(self.metrics_store,
+                                                    self.telemetry)
+        self.pathology_detectors = list(pathology_detectors or ())
+        self._ms_last_t = 0.0       # metrics-store feed throttle
+        self._slo_last_t = 0.0      # SLO evaluation throttle
         #: restarts consumed this lifetime (reset by start())
         self.restarts = 0
         self._heartbeat = None      # time.monotonic() of the last loop pass
@@ -195,6 +245,12 @@ class AsyncLLMServer:
             self._saved_injector = self.engine.fault_injector
             self.engine.fault_injector = self.fault_injector
             self.fault_injector._telemetry = self.telemetry
+        if self.pathology_detectors and self.flight_recorder is not None:
+            for d in self.pathology_detectors:
+                # a fresh lifetime evaluates a fresh window: no
+                # StepRecords (or active alerts) from a previous run
+                d.reset()
+                self.flight_recorder.subscribe(d.on_step)
         self._accepting = True
         self._stopping = False
         self._crashed = None  # a restarted server starts clean
@@ -255,6 +311,9 @@ class AsyncLLMServer:
             self._wd_thread = None
         self.engine.stream_callback = self._saved_callback
         if self.flight_recorder is not None:
+            if self.pathology_detectors:
+                for d in self.pathology_detectors:
+                    self.flight_recorder.unsubscribe(d.on_step)
             self.engine.flight_recorder = self._saved_recorder
         if self.fault_injector is not None:
             self.engine.fault_injector = self._saved_injector
@@ -463,8 +522,15 @@ class AsyncLLMServer:
                 self.telemetry.inc("requests_submitted")
                 self.telemetry.inc("requests_shed_deadline")
                 self.telemetry.inc("requests_finished")
-                self.telemetry.observe("e2e_s",
-                                       time.monotonic() - now)
+                # per-tenant + store accounting like every other finish
+                # path: a tenant whose traffic is being shed must show
+                # it in ITS e2e series, not vanish from the report
+                shed_e2e = time.monotonic() - now
+                self.telemetry.observe("e2e_s", shed_e2e,
+                                       tenant=adapter_id)
+                if self.metrics_store is not None:
+                    self.metrics_store.observe("e2e_s", shed_e2e,
+                                               tenant=adapter_id)
                 if rec is not None:
                     rec.req_event(rid, "queued")
                     rec.req_event(rid, "finish", value="deadline")
@@ -910,6 +976,63 @@ class AsyncLLMServer:
             if last is not None:
                 tel.set_gauge("token_budget_utilization",
                               last.budget_utilization)
+        # the serve loop provably sampled the gauges this pass: stamp
+        # it — gauge_last_sample_age_s ages from HERE (the watchdog's
+        # out-of-loop writes deliberately do not refresh it)
+        tel.mark_gauge_sample()
+        # SLO sensor layer: the off path is this one attribute check
+        if self.metrics_store is not None:
+            self._feed_sensors()
+
+    def _feed_sensors(self):
+        """Feed EVERY gauge and cumulative counter into the metrics
+        store as time series (counters stay cumulative — windowed
+        ``store.rate()`` turns the deltas into tokens/s,
+        preemptions/s, ...) and run the throttled SLO evaluation.
+        Called once per loop pass (only with a store attached); both
+        halves are interval-gated so a hot loop costs two monotonic
+        reads per pass, not a store write per gauge."""
+        now = time.monotonic()
+        store = self.metrics_store
+        if now - self._ms_last_t >= self.metrics_interval_s:
+            self._ms_last_t = now
+            for name, v in self.telemetry.get_gauges().items():
+                if name != "gauge_last_sample_age_s":
+                    # the staleness gauge is computed at READ time —
+                    # storing the feed-time value would record the
+                    # sensor's own cadence, not the loop's health
+                    store.observe(name, v, t=now)
+            for name, v in self.telemetry.get_counters().items():
+                store.observe(name, v, t=now)
+        if self.slo_engine is not None \
+                and now - self._slo_last_t >= self.slo_interval_s:
+            self._slo_last_t = now
+            self.slo_engine.evaluate(now=now)
+
+    def slo_report(self):
+        """Point-in-time SLO/sensor report — answerable from ANY
+        thread: per-SLO burn-rate evaluations (fresh, not the loop's
+        last throttled pass), the store's alert log, each pathology
+        detector's active flag, and the per-tenant latency snapshot.
+        ``text`` carries the human rendering. Works (degenerately) with
+        no store attached — empty slos/alerts, but tenant latency
+        still reports."""
+        from ..profiler.slo import format_slo_report
+        store = self.metrics_store
+        out = {
+            "replica": self.replica,
+            "slos": (self.slo_engine.evaluate()
+                     if self.slo_engine is not None else []),
+            "alerts": ([a.to_dict() for a in store.alerts()]
+                       if store is not None else []),
+            "pathologies": {d.kind: d.active
+                            for d in self.pathology_detectors},
+            "tenant_latency": self.telemetry.tenant_latency_snapshot(),
+            "gauge_last_sample_age_s":
+                self.telemetry.get_gauges()["gauge_last_sample_age_s"],
+        }
+        out["text"] = format_slo_report(out)
+        return out
 
     def _note_admissions(self):
         """Mark handles whose request just entered an engine slot as
@@ -930,7 +1053,12 @@ class AsyncLLMServer:
                     self.flight_recorder.req_event(
                         slot.req.request_id, "admitted")
                 self.telemetry.inc("requests_admitted")
-                self.telemetry.observe("queue_wait_s", wait)
+                self.telemetry.observe("queue_wait_s", wait,
+                                       tenant=h.request.adapter_id)
+                if self.metrics_store is not None:
+                    self.metrics_store.observe(
+                        "queue_wait_s", wait, t=now,
+                        tenant=h.request.adapter_id)
                 self.telemetry.observe(
                     "admission_stall_s",
                     max(now - h.stall_mark, 0.0)
@@ -1033,13 +1161,20 @@ class AsyncLLMServer:
         now = time.monotonic() - self.engine.emit_backdate_s
         if h.last_token_at is not None and now < h.last_token_at:
             now = h.last_token_at
+        tenant = h.request.adapter_id
+        store = self.metrics_store
         if h.first_token_at is None:
-            self.telemetry.observe(
-                "ttft_s", max(now - h.request.submitted_at, 0.0))
+            ttft = max(now - h.request.submitted_at, 0.0)
+            self.telemetry.observe("ttft_s", ttft, tenant=tenant)
+            if store is not None:
+                store.observe("ttft_s", ttft, t=now, tenant=tenant)
         elif h.last_token_at is not None:
-            self.telemetry.observe("inter_token_s", now - h.last_token_at)
+            gap = now - h.last_token_at
+            self.telemetry.observe("inter_token_s", gap, tenant=tenant)
+            if store is not None:
+                store.observe("inter_token_s", gap, t=now, tenant=tenant)
         self.telemetry.inc("tokens_emitted")
-        self.telemetry.inc_tenant(h.request.adapter_id)
+        self.telemetry.inc_tenant(tenant)
         h._emit(tok, t=now)
 
     def _handle_done(self, outputs):
@@ -1075,7 +1210,11 @@ class AsyncLLMServer:
                           if handle.admitted_at is not None else None),
             trace=trace, routing=req.routing, embedding=embedding)
         self.telemetry.inc("requests_finished")
-        self.telemetry.observe("e2e_s", result.e2e_s)
+        self.telemetry.observe("e2e_s", result.e2e_s,
+                               tenant=req.adapter_id)
+        if self.metrics_store is not None:
+            self.metrics_store.observe("e2e_s", result.e2e_s, t=now,
+                                       tenant=req.adapter_id)
         with self._hlock:
             self._handles.pop(handle.request_id, None)
         handle._finish(result)

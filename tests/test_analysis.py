@@ -552,6 +552,81 @@ def test_ptl006_suppressible_with_reason(tmp_path):
     assert report.exit_code == 0
 
 
+# ---------------------------------------------------------------------------
+# PTL007 — SLO/pathology strict names
+# ---------------------------------------------------------------------------
+
+def test_ptl007_unknown_names_fire(tmp_path):
+    from paddle_tpu.analysis.slo_names import SLONameCheck
+
+    registry = {"alert_kind": {"slo_burn", "ramp_thrash"},
+                "labeled_gauge": {"slo_burn_rate", "pathology_active"}}
+    path = _write(tmp_path, "mod.py", """
+        class Sensor:
+            def tick(self, store, tel):
+                store.raise_alert("slo_burn", "ok known kind")
+                store.raise_alert("slo_bern", "TYPO kind")
+                store.clear_alert("ramp_thresh")
+                tel.set_labeled_gauge("pathology_active", "x", 1.0)
+                tel.set_labeled_gauge("pathology_activ", "x", 1.0)
+
+        class MyNewDetector:
+            kind = "totally_new_pathology"
+    """)
+    report = run_analysis([path], checks=[SLONameCheck(registry)])
+    found = _checks(report, "PTL007")
+    assert len(found) == 4, [f.message for f in found]
+    keys = {f.key for f in found}
+    assert keys == {"unknown-alert-kind:slo_bern",
+                    "unknown-alert-kind:ramp_thresh",
+                    "unknown-labeled-gauge:pathology_activ",
+                    "unknown-alert-kind:totally_new_pathology"}
+    # the detector-class finding names the class as its function scope
+    (det,) = [f for f in found if "totally_new" in f.key]
+    assert det.func == "MyNewDetector"
+
+
+def test_ptl007_alert_constructor_and_clean_twin(tmp_path):
+    from paddle_tpu.analysis.slo_names import SLONameCheck
+
+    registry = {"alert_kind": {"swap_stall"},
+                "labeled_gauge": {"slo_breached"}}
+    path = _write(tmp_path, "mod.py", """
+        from paddle_tpu.profiler.metrics_store import Alert
+
+        def mk(t):
+            good = Alert("swap_stall", "m", t)
+            bad = Alert(kind="swap_stahl", message="m", raised_t=t)
+            return good, bad
+
+        class Clean:
+            def tick(self, store, tel):
+                store.raise_alert("swap_stall", "known")
+                tel.set_labeled_gauge("slo_breached", "obj", 0.0)
+                kind = compute_kind()           # dynamic: skipped
+                store.raise_alert(kind, "runtime-checked")
+    """)
+    report = run_analysis([path], checks=[SLONameCheck(registry)])
+    found = _checks(report, "PTL007")
+    assert len(found) == 1
+    assert found[0].key == "unknown-alert-kind:swap_stahl"
+
+
+def test_ptl007_real_registry_via_import(tmp_path):
+    # no metrics_store.py/serving_telemetry.py in the scanned tree: the
+    # check imports the real registries — real names pass, phantoms fire
+    path = _write(tmp_path, "mod.py", """
+        class Sensor:
+            def tick(self, store, tel):
+                store.raise_alert("ramp_thrash", "real kind")
+                tel.set_labeled_gauge("slo_burn_rate", "obj", 1.0)
+                store.raise_alert("not_a_real_kind", "phantom")
+    """)
+    report = run_analysis([path])
+    found = _checks(report, "PTL007")
+    assert len(found) == 1 and "not_a_real_kind" in found[0].message
+
+
 def test_baseline_round_trip(tmp_path):
     path = _write(tmp_path, "mod.py", """
         import numpy as np

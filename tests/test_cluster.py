@@ -69,8 +69,11 @@ ENGINE_CONFIGS = {
 # the paged configs already exercise at TP, so it rides the slow lane
 # (tier-1 wall budget)
 _CONFIG_PARAMS = [
-    pytest.param(name, marks=[pytest.mark.slow] if name == "dense_fused"
-                 else [])
+    # tier-1 wall budget: dense_fused (PR 6) and the plain paged cell
+    # (PR 14 — subsumed by paged_prefix, the richer composition) ride
+    # the slow lane
+    pytest.param(name, marks=[pytest.mark.slow]
+                 if name in ("dense_fused", "paged") else [])
     for name in ENGINE_CONFIGS
 ]
 
@@ -105,6 +108,9 @@ def test_tp_engine_greedy_parity(tp_mesh, tp_model, ref_model, config):
     assert got == want
 
 
+@pytest.mark.slow   # tier-1 wall budget (PR 14): TP parity stays
+# tier-1 via the engine-level matrix above, and TP-through-server is
+# exercised by __graft_entry__ dryrun's serve=engine_tp leg
 def test_tp_engine_serves_through_async_server(tp_mesh, tp_model,
                                                ref_model):
     """The TP paged engine behind AsyncLLMServer streams the identical

@@ -150,6 +150,63 @@ def test_serve_records_join_and_trace(tiny_model, tmp_path, scheduler,
         assert e["step"] is not None and e["step_id"] in idset
 
 
+def test_chrome_trace_counter_tracks(tiny_model, tmp_path):
+    """Perfetto COUNTER tracks (``"ph": "C"``): every recorded step
+    emits queue_depth + token_budget_utilization samples (and
+    kv_pool_occupancy on a paged engine) so traces show load context
+    under the request lanes. Schema: a counter event is pid + name +
+    ts + a numeric args value and NO duration — the Perfetto counter
+    contract."""
+    eng = _engine(tiny_model, "paged", "fused")
+    rec = FlightRecorder(capacity=256)
+    _serve(eng, _prompts(9, (7, 12)), rec)
+    path = rec.export_chrome_trace(str(tmp_path / "trace.json"))
+    events = json.load(open(path))["traceEvents"]
+    counters = [e for e in events if e.get("ph") == "C"]
+    names = {e["name"] for e in counters}
+    assert {"queue_depth", "token_budget_utilization",
+            "kv_pool_occupancy"} <= names
+    for e in counters:
+        assert {"pid", "name", "ts", "args"} <= set(e)
+        assert "dur" not in e
+        assert isinstance(e["args"]["value"], (int, float))
+    recs = rec.records()
+    for track in ("queue_depth", "token_budget_utilization",
+                  "kv_pool_occupancy"):
+        assert sum(1 for e in counters if e["name"] == track) == len(recs)
+    occs = [e["args"]["value"] for e in counters
+            if e["name"] == "kv_pool_occupancy"]
+    assert all(0.0 <= v <= 1.0 for v in occs)
+    assert any(v > 0.0 for v in occs)       # the pool was actually used
+    # counter samples sit at their step's dispatch time
+    t_by_step = {f"step {r.step_id} [{r.kind}]": r.t_begin * 1e6
+                 for r in recs}
+    step_ts = sorted(t_by_step.values())
+    qd_ts = sorted(e["ts"] for e in counters
+                   if e["name"] == "queue_depth")
+    assert qd_ts == step_ts
+    # no spec engine -> no spec_acceptance_rate track (no zero spam)
+    assert "spec_acceptance_rate" not in names
+
+
+def test_chrome_trace_spec_counter_track(tmp_path):
+    """A step with verify accounting emits the spec_acceptance_rate
+    counter sample; non-spec steps emit none."""
+    rec = FlightRecorder(capacity=8)
+    sid = rec.begin_step(
+        scheduler="fused", kind="mixed",
+        grants=((0, 1, "verify", 4),), tokens_scheduled=4,
+        token_budget=32, queue_depth=1, free_blocks=None,
+        total_blocks=None, pipeline_inflight=1, preemptions=(),
+        admit_s=0.0, schedule_s=0.0, dispatch_s=0.01, t_begin=100.0)
+    rec.finish_step(sid, 0.0, 0.0, spec_accepted=2, spec_rejected=1)
+    path = rec.export_chrome_trace(str(tmp_path / "trace.json"))
+    events = json.load(open(path))["traceEvents"]
+    (spec,) = [e for e in events if e.get("ph") == "C"
+               and e["name"] == "spec_acceptance_rate"]
+    assert spec["args"]["value"] == pytest.approx(2 / 3, abs=1e-4)
+
+
 def test_trace_merges_across_ranks(tiny_model, tmp_path):
     """The export follows Profiler._export_chrome conventions, so
     merge_profile treats a flight-recorder trace like any rank trace."""

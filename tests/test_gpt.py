@@ -40,6 +40,9 @@ def test_causal_mask_blocks_future(tiny):
     assert not np.allclose(la[0, -1], lb[0, -1])
 
 
+@pytest.mark.slow   # tier-1 wall budget (PR 14): generate-vs-forward
+# exactness stays tier-1-covered on the serving-path model (llama:
+# test_jit_amp_io.py::test_llama_generate_kv_cache_matches_full_forward)
 def test_generate_matches_rollforward(tiny):
     """Cached incremental generate == argmax roll-forward with full
     re-forward each step (catches cache/mask/position bugs)."""

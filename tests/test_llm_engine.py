@@ -45,6 +45,10 @@ class TestEngineExactness:
         # 4 requests through 2 slots = continuous batching actually happened
         assert eng.stats["steps"] >= 12
 
+    @pytest.mark.slow   # tier-1 wall budget (PR 14): the fused twin
+    # (test_fused_scheduler.py TestGreedyParity
+    # .test_mid_stream_admission_exact) keeps mid-stream admission
+    # exactness tier-1 on the product scheduler
     def test_mid_stream_admission_exact(self, tiny_model):
         rng = np.random.default_rng(2)
         p1 = rng.integers(1, 96, size=(9,)).astype(np.int32)
@@ -156,6 +160,9 @@ def test_engine_with_quantized_weights(tiny_model):
     assert out.token_ids == ref
 
 
+@pytest.mark.slow   # tier-1 wall budget (PR 14): the horizon
+# contract stays tier-1-covered by TestPagedKV
+# .test_horizon_composes_with_paged (horizon x paged, the richer cell)
 def test_horizon_exactness(tiny_model):
     """K-step scan decode (horizon>1) must produce the same greedy streams
     as horizon=1, including eos retirement mid-horizon."""
@@ -219,6 +226,10 @@ class TestSpeculativeDecoding:
     snapshot has no speculative decoding; exceeds-reference serving
     feature)."""
 
+    @pytest.mark.slow   # tier-1 wall budget (PR 14): the coupled
+    # acceptance rule's exactness is tier-1-proved on the FUSED spec
+    # path (tests/test_fused_spec.py parity matrix + sampled-exact);
+    # this is the legacy-scan twin
     def test_exact_on_repetitive_and_random(self, tiny_model):
         rng = np.random.default_rng(14)
         base = rng.integers(1, 96, size=(6,)).astype(np.int32)
@@ -338,6 +349,9 @@ def test_spec_coupled_acceptance_sampled_token_exact(tiny_model):
         spec.stats["draft_tokens_accepted"]
 
 
+@pytest.mark.slow   # tier-1 wall budget (PR 14): TP parity stays
+# tier-1-covered by tests/test_cluster.py::test_tp_engine_greedy_parity
+# (dense/paged/paged_prefix on the shared tp_mesh)
 def test_engine_tp_sharded_matches_unsharded(tiny_model):
     """LLMEngine with TP-sharded weights on the virtual mesh: prefill and
     step programs partition under GSPMD, outputs identical to unsharded

@@ -315,6 +315,10 @@ def test_adapter_survives_restart(store, prompts, refs, cache_impl):
         [refs[1][0], refs[0][1], refs[2][2]]
 
 
+@pytest.mark.slow   # tier-1 wall budget (PR 14): the composition's
+# halves stay tier-1 — adapter identity across preemption/restart
+# (this file) and router failover token-exactness (test_cluster/
+# test_faults); this is the cross-product soak
 def test_adapter_survives_failover(store, prompts, refs):
     """Router failover: the dead replica's queued adapter request
     resubmits to a survivor (adapter_id rides the resubmission kwargs)
@@ -537,6 +541,9 @@ def test_bert_embed_engine_through_server():
 # observability: StepRecord tenant facts, adapter_swap cause, telemetry
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow   # tier-1 wall budget (PR 14): adapter
+# StepRecord/telemetry schema stays pinned by the recorder-schema and
+# telemetry-strictness tests; this is the serve-shaped plumbing soak
 def test_recorder_and_telemetry_adapter_facts(store, prompts):
     """ONE served mix covers the whole observability surface: StepRecord
     tenant facts + embed grant kind, the adapter counters/gauge, and the

@@ -32,14 +32,18 @@ class TestModelZoo:
 
     @pytest.mark.parametrize("ctor,size", [
         (M.alexnet, 224),
-        (M.squeezenet1_0, 64),
+        # tier-1 wall budget (PR 14): squeezenet1_0 + mobilenet_v1
+        # join the slow lane too (~11s back); lenet + alexnet +
+        # shufflenet keep the tier-1 breadth signal
+        pytest.param(M.squeezenet1_0, 64, marks=pytest.mark.slow),
         # near-duplicate / heavier shape-smokes join the slow lane
         # (tier-1 wall-time headroom; squeezenet1_0 + the small conv
         # nets keep the tier-1 breadth signal)
         pytest.param(M.squeezenet1_1, 64, marks=pytest.mark.slow),
         pytest.param(lambda: M.vgg11(num_classes=7), 32,
                      marks=pytest.mark.slow),
-        (lambda: M.mobilenet_v1(num_classes=7), 64),
+        pytest.param(lambda: M.mobilenet_v1(num_classes=7), 64,
+                     marks=pytest.mark.slow),
         # the heavier zoo variants are `slow` (tier-1 wall-time headroom:
         # these five alone cost ~75s of shape-smoke on CPU)
         pytest.param(lambda: M.mobilenet_v2(num_classes=7), 64,
@@ -301,6 +305,9 @@ class TestDatasets:
             D.MNIST()
 
 
+@pytest.mark.slow   # tier-1 wall budget (PR 14): NHWC is the bench
+# default layout and its parity is re-proved by every bench run;
+# layout-parity unit coverage rides the conv op tests
 def test_resnet_nhwc_matches_nchw():
     """Channels-last resnet (TPU-preferred layout) computes the same
     function: same weights, transposed input, equal logits."""
