@@ -1465,6 +1465,160 @@ def _bench_other(model_name):
                 "spill_mb": spill_mb, "full_blocks": full_blocks,
                 "telemetry_artifact": art_path}
 
+    if model_name == "llama_serve_disagg":
+        # Disaggregated prefill/decode A/B (DistServe/Splitwise): the
+        # SAME two-replica fleet and workload served with role-split
+        # routing (1 prefill + 1 decode replica; finished prefills SHIP
+        # their staged KV to the decode replica and resume with the
+        # one-token stitch — zero re-prefill) vs mixed placement (both
+        # replicas take everything). The workload is the interference
+        # shape disaggregation exists for: a PREFILL FLOOD of long-
+        # prompt/short-output requests landing while a handful of
+        # DECODE-TRICKLE streams are mid-generation. Mixed placement
+        # lets the flood's chunk grants ride the tricklers' decode
+        # steps (Sarathi interference on both replicas); the split arm
+        # keeps the decode replica's steps prefill-free except the
+        # stitch. What the split buys shows up as decode inter-token
+        # p99 and TTFT p99 under flood; what it costs as shipped bytes
+        # and the migration-latency histogram. Streams must stay
+        # TOKEN-EXACT across arms (greedy: placement cannot change
+        # tokens). An unflooded floor arm (same fleet, trickle only)
+        # anchors the p99s. CPU-shape caveat: toy-model steps are
+        # dispatch-bound, so the split's p99 win is muted vs real
+        # accelerators where a long-prompt chunk occupies the device
+        # for whole milliseconds.
+        import threading
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.inference import LLMEngine
+        from paddle_tpu.serving import AsyncLLMServer, ReplicaRouter
+        B = int(os.environ.get("BENCH_BATCH", "8"))
+        new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "64"))
+        flood_n = int(os.environ.get("BENCH_REQUESTS", str(2 * B)))
+        trickle_n = int(os.environ.get("BENCH_TRICKLE", "4"))
+        n_layers = int(os.environ.get("BENCH_LAYERS", "3"))
+        hidden = int(os.environ.get("BENCH_HIDDEN", "4096"))
+        ff = int(os.environ.get("BENCH_FF", str(hidden * 11 // 4)))
+        heads = max(hidden // 128, 1)
+        chunk = int(os.environ.get("BENCH_CHUNK", "256"))
+        block = int(os.environ.get("BENCH_BLOCK", "64"))
+        prompt_len = int(os.environ.get("BENCH_PROMPT", "256"))
+        cap = -(-(prompt_len + new_tokens) // chunk) * chunk
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                          intermediate_size=ff, num_hidden_layers=n_layers,
+                          num_attention_heads=heads,
+                          num_key_value_heads=heads,
+                          max_position_embeddings=cap)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg).bfloat16()
+        model.eval()
+        V = cfg.vocab_size
+        flood_prompts = [rng.integers(0, V, (prompt_len - 7 + int(x),))
+                         .astype(np.int32)
+                         for x in rng.integers(0, 15, size=flood_n)]
+        trickle_prompts = [rng.integers(0, V, (max(prompt_len // 4, 4),))
+                           .astype(np.int32) for _ in range(trickle_n)]
+
+        def run_arm(roles, flood=True):
+            servers = []
+            for i in range(2):
+                eng = LLMEngine(
+                    model, max_batch=B, max_seq_len=cap,
+                    chunk_size=chunk, cache_impl="paged",
+                    block_size=block, scheduler="fused")
+                warm = rng.integers(0, V, (3,)).astype(np.int32)
+                eng.generate([warm], max_new_tokens=2)
+                eng.reset()
+                eng.reset_stats()
+                servers.append(AsyncLLMServer(
+                    eng, replica=i,
+                    max_queue_size=flood_n + trickle_n + 1))
+            router = ReplicaRouter(servers, roles=roles)
+            router.start()
+            t0 = time.perf_counter()
+            stamps = [[] for _ in range(trickle_n)]
+            t_sub = [None] * trickle_n
+
+            def consume(h, out):
+                for tok in h:
+                    out.append((time.perf_counter(), int(tok)))
+
+            threads = []
+            for i, p in enumerate(trickle_prompts):
+                t_sub[i] = time.perf_counter()
+                h = router.submit(p, max_new_tokens=new_tokens)
+                th = threading.Thread(target=consume,
+                                      args=(h, stamps[i]), daemon=True)
+                th.start()
+                threads.append(th)
+            flood_handles = [router.submit(p, max_new_tokens=2)
+                             for p in flood_prompts] if flood else []
+            flood_toks = [list(h.result(timeout=1800).token_ids)
+                          for h in flood_handles]
+            for th in threads:
+                th.join(timeout=1800)
+            wall = time.perf_counter() - t0
+            snap = router.snapshot()
+            router.stop(timeout=120)
+            gaps = [b[0] - a[0] for s in stamps
+                    for a, b in zip(s, s[1:])]
+            ttfts = [s[0][0] - t for s, t in zip(stamps, t_sub) if s]
+            toks = sum(len(s) for s in stamps) + \
+                sum(len(t) for t in flood_toks)
+            # re-prefill paid by DECODE-role steps: with roles, every
+            # migrated request books exactly its one-token stitch on
+            # the decode replica — anything beyond is fallback work
+            migrated = router.stats["kv_shipped"] + \
+                router.stats["kv_ship_fallback"]
+            decode_prefill = servers[1].engine.stats["prefill_tokens"] \
+                if roles else None
+            out = {
+                "arm": ("disagg" if roles else
+                        "mixed" if flood else "floor"),
+                "tokens_per_sec": round(toks / wall, 1),
+                "decode_p99_ms": round(float(np.quantile(
+                    gaps, 0.99)) * 1000, 3) if gaps else None,
+                "decode_p50_ms": round(float(np.quantile(
+                    gaps, 0.50)) * 1000, 3) if gaps else None,
+                "ttft_p99_ms": round(float(np.quantile(
+                    ttfts, 0.99)) * 1000, 3) if ttfts else None,
+                "kv_shipped": router.stats["kv_shipped"],
+                "kv_ship_fallback": router.stats["kv_ship_fallback"],
+                "ship_bytes": snap["transport"]["ship_bytes"]
+                if snap.get("transport") else 0,
+                "migration_latency": snap.get("migration_latency"),
+                "decode_reprefill_tokens": (decode_prefill - migrated)
+                if decode_prefill is not None else None,
+            }
+            return out, [[int(t) for _, t in s] for s in stamps], \
+                flood_toks
+
+        roles = {"prefill": [0], "decode": [1]}
+        floor_arm, floor_trickle, _ = run_arm(None, flood=False)
+        mixed_arm, mixed_trickle, mixed_flood = run_arm(None)
+        dis_arm, dis_trickle, dis_flood = run_arm(roles)
+        parity = (dis_trickle == mixed_trickle == floor_trickle
+                  and dis_flood == mixed_flood)
+        art_path = os.path.join(_artifact_dir(),
+                                "llama_serve_disagg.json")
+        with open(art_path, "w") as f:
+            json.dump({"floor": floor_arm, "mixed": mixed_arm,
+                       "disagg": dis_arm, "token_parity": parity},
+                      f, indent=1)
+        return {"metric": "llama_serve_disagg_decode_p99_ms",
+                "value": dis_arm["decode_p99_ms"],
+                "unit": "ms", "vs_baseline": None,
+                "floor": floor_arm, "mixed": mixed_arm,
+                "disagg": dis_arm,
+                "disagg_p99_vs_mixed": round(
+                    dis_arm["decode_p99_ms"]
+                    / max(mixed_arm["decode_p99_ms"], 1e-9), 3),
+                "token_parity": parity,
+                "flood_requests": flood_n, "trickle_requests": trickle_n,
+                "slots": B, "new_tokens": new_tokens,
+                "prompt_len": prompt_len, "chunk": chunk,
+                "block_size": block,
+                "telemetry_artifact": art_path}
+
     if model_name == "llama_serve_slo":
         # Multi-tenant SLO isolation bench (the sensor half of ROADMAP
         # item 4): an ADVERSARIAL tenant floods the queue with long
@@ -2512,6 +2666,7 @@ def _run_all():
             ("llama_serve_fused", None), ("llama_serve_prefix_cache", None),
             ("llama_serve_kv_quant", None),
             ("llama_serve_kv_tier", None),
+            ("llama_serve_disagg", None),
             ("llama_serve_slo", None),
             ("llama_serve_cluster", None), ("llama_serve_spec", None),
             ("llama_serve_lora", None), ("llama_serve_embed", None),

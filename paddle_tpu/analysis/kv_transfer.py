@@ -4,13 +4,17 @@ API.
 The host KV tier (``LLMEngine(kv_host_swap=..., kv_host_spill_bytes=
 ...)``) moves pool blocks between device HBM and host RAM through
 exactly four functions — ``_swap_out_slot`` / ``_spill_block`` (D2H)
-and ``_try_swap_restores`` / ``_promote_spilled`` (H2D). Those functions
+and ``_try_swap_restores`` / ``_promote_spilled`` (H2D) — and the
+cross-replica ship path (PR 17) adds four more on the same fences:
+``_export_slot_kv`` / ``export_prefix_blocks`` (D2H staging for a ship)
+and the transport's ``serialize_entry`` / ``deserialize_entry`` (wire
+encode/decode over the staged, already-booked buffers). Those functions
 are where the correctness obligations live: the gather must take the
 engine's NEWEST pool futures (so it sequences after every in-flight
 writer), the scatter must target freshly allocated blocks the write
 fence keeps out of every in-flight dispatch, and each direction books
-its bytes/blocks on the ``kv_swap_*`` stats the StepRecord split and
-the preemption A/B read.
+its bytes/blocks on the ``kv_swap_*`` / ``kv_ship_*`` stats the
+StepRecord split and the preemption A/B read.
 
 A KV copy issued anywhere else has none of those guarantees: it can
 race a pipelined writer (silently on CPU, corrupt KV on TPU), and its
@@ -49,15 +53,22 @@ KV_POOL_NAMES = frozenset({"k_pools", "v_pools", "k_bufs", "v_bufs"})
 #: transfer commitment, wherever the bytes end up
 SWAP_PROGRAMS = frozenset({"_kv_gather_fn", "_kv_scatter_fn"})
 
-#: (path suffix, function) pairs naming THE fence-tracked swap API —
-#: the only places a KV-pool transfer may be issued. Kept in sync with
-#: inference/llm_engine.py by tests/test_analysis_clean.py (a rename
-#: there makes the repo scan light up here).
+#: (path suffix, function) pairs naming THE fence-tracked transfer API —
+#: the only places a KV-pool transfer may be issued: the host-tier swap
+#: halves, the cross-replica ship staging points (same gather, entries
+#: book on kv_ship_* instead), and the transport's wire encode/decode
+#: (which materializes pool-derived leaf buffers). Kept in sync with
+#: the source files by tests/test_analysis_clean.py (a rename there
+#: makes the repo scan light up here).
 ALLOWED_TRANSFER_FUNCS = (
     ("inference/llm_engine.py", "_swap_out_slot"),
     ("inference/llm_engine.py", "_try_swap_restores"),
     ("inference/llm_engine.py", "_spill_block"),
     ("inference/llm_engine.py", "_promote_spilled"),
+    ("inference/llm_engine.py", "_export_slot_kv"),
+    ("inference/llm_engine.py", "export_prefix_blocks"),
+    ("serving/kv_transport.py", "serialize_entry"),
+    ("serving/kv_transport.py", "deserialize_entry"),
 )
 
 _TRANSFER_FUNCS = {("jax", "device_get"), ("jax", "device_put"),
@@ -134,11 +145,15 @@ class KVTransferCheck(Check):
                             mod, node,
                             f"`{label}` moves KV-pool bytes across the "
                             f"device boundary outside the fence-tracked "
-                            f"swap API "
-                            f"(_swap_out_slot/_try_swap_restores/"
-                            f"_spill_block/_promote_spilled) — it can "
-                            f"race an in-flight writer and its bytes "
-                            f"skip the kv_swap_* accounting",
+                            f"transfer API (the swap halves "
+                            f"_swap_out_slot/_try_swap_restores/"
+                            f"_spill_block/_promote_spilled, the ship "
+                            f"stagers _export_slot_kv/"
+                            f"export_prefix_blocks, and the transport "
+                            f"serialize_entry/deserialize_entry) — it "
+                            f"can race an in-flight writer and its "
+                            f"bytes skip the kv_swap_*/kv_ship_* "
+                            f"accounting",
                             key=f"kv-transfer:{label}", func=func)
                         continue     # one finding per transfer call
             stack.extend(ast.iter_child_nodes(node))

@@ -77,6 +77,13 @@ def default_engine_stats():
             "kv_swap_out_bytes": 0, "kv_swap_in_bytes": 0,
             "kv_swap_saved_tokens": 0,
             "kv_spill_blocks": 0, "kv_promote_blocks": 0,
+            # cross-replica KV shipping (disaggregated prefill/decode):
+            # staged-entry exports out of this engine's pool and shipped
+            # imports scattered back in — booked SEPARATELY from the
+            # kv_swap_* preemption traffic, whose byte deltas are the
+            # explain_tail preempt classifier's exclusive signal
+            "kv_ship_out_blocks": 0, "kv_ship_in_blocks": 0,
+            "kv_ship_out_bytes": 0, "kv_ship_in_bytes": 0,
             "swap_out_time_s": 0.0, "swap_in_time_s": 0.0,
             "decode_time_s": 0.0, "admit_time_s": 0.0,
             "dispatch_time_s": 0.0, "host_sync_time_s": 0.0,
@@ -188,6 +195,14 @@ class GenerationRequest:
     #: ``readout_stride`` pins, so a low-acceptance request does not
     #: reset to full-window speculation every time it moves.
     spec_ewma: float | None = None
+    #: disaggregated serving (cross-replica KV shipping): stage this
+    #: request's committed KV as an export entry when it finishes — the
+    #: prefill replica's router hook then pops it via
+    #: :meth:`LLMEngine.export_kv` and ships it to a decode replica.
+    #: The staging runs at the finish site on the ENGINE thread, while
+    #: the slot's blocks are still allocated (an external export call
+    #: would race the retirement free).
+    export_kv: bool = False
 
 
 @dataclasses.dataclass
@@ -321,7 +336,8 @@ class LLMEngine:
                  max_step_tokens=None, enable_prefix_cache=False,
                  readout_stride=1, adapter_store=None,
                  adapter_cache_slots=4, kv_cache_dtype=None,
-                 kv_host_swap=False, kv_host_spill_bytes=0):
+                 kv_host_swap=False, kv_host_spill_bytes=0,
+                 sampling_seed=None):
         """``scheduler="fused"`` (Sarathi-style chunked-prefill+decode
         fusion): admission becomes slot ASSIGNMENT only — each engine step
         then processes, per slot, either one bounded prefill chunk (for
@@ -405,7 +421,16 @@ class LLMEngine:
         bounded host spill store of at most this many bytes instead of
         vanishing; a content-store probe that misses the device LRU but
         hits the spill PROMOTES the block back (one H2D copy) rather
-        than recomputing the chunk. 0 (default) disables spilling."""
+        than recomputing the chunk. 0 (default) disables spilling.
+
+        ``sampling_seed``: explicit base key for the per-(rid, position)
+        fold_in sampling keys. The default (None) pulls a fresh seed
+        from the global generator at the first step — fine for a single
+        engine, but the generator's counter makes each engine's base key
+        UNIQUE, so two replicas would sample different streams for the
+        same rid. Disaggregated serving sets the SAME seed on every
+        replica: a request migrated mid-stream (same rid, same
+        positions) then re-samples token-exactly on the destination."""
         from ..jit.functional_call import collect_state, read_values
 
         self.model = model
@@ -582,6 +607,11 @@ class LLMEngine:
         # ---- host KV tier (DistServe/Splitwise-style memory tiering) --
         self.kv_host_swap = bool(kv_host_swap)
         self.kv_host_spill_bytes = int(kv_host_spill_bytes or 0)
+        #: replica-independent sampling base key (None = pull one from
+        #: the global generator at the first step) — SURVIVES reset()
+        #: with the rest of the sampling-key contract
+        self._sampling_seed = (int(sampling_seed)
+                               if sampling_seed is not None else None)
         if self.kv_host_swap:
             if cache_impl != "paged":
                 raise ValueError(
@@ -826,6 +856,19 @@ class LLMEngine:
             #: spilled first out when the byte budget fills)
             self._spill = collections.OrderedDict()
             self._spill_bytes = 0
+            # ---- cross-replica KV shipping (serving/kv_transport) ----
+            #: rid -> staged EXPORT entry (tokens + tenant + per-layer
+            #: block stacks + chain hashes), written by the engine
+            #: thread at an export_kv-flagged request's finish site and
+            #: popped by the router thread via export_kv(). Bounded:
+            #: oldest entries drop when a router never collects.
+            self._export_store = collections.OrderedDict()
+            self._export_cap = 2 * self.B
+            #: shipped PREFIX-block entries awaiting the engine thread
+            #: (pull-on-miss imports land here from the router thread —
+            #: a GIL-atomic list append — and drain into _spill at the
+            #: top of the next step, before admission probes run)
+            self._spill_inbox = []
         else:
             shape = (self.B, self.capacity, self._kvh, self._head_dim)
             self._k = [self._make_zeros(shape, self._np_dt, self._kv_spec)
@@ -1816,7 +1859,8 @@ class LLMEngine:
     def add_request(self, prompt_ids, max_new_tokens=64, temperature=0.0,
                     top_p=1.0, eos_token_id=None, request_id=None,
                     committed_tokens=None, readout_stride=None,
-                    adapter_id=0, kind="generate", spec_ewma=None):
+                    adapter_id=0, kind="generate", spec_ewma=None,
+                    export_kv=False):
         """``readout_stride``: per-request latency-tier pin — cap the
         multi-step decode stride of every all-decode step this request
         is active in (1 = sync the host every step; None = the engine
@@ -1836,7 +1880,11 @@ class LLMEngine:
         engine's adapter store (0 = base model). ``kind="embed"`` makes
         the request PREFILL-ONLY (fused scheduler required): no decode
         tokens, no sampling; the finished RequestOutput carries the
-        mean-pooled final hidden state in ``embedding``."""
+        mean-pooled final hidden state in ``embedding``.
+
+        ``export_kv``: stage the request's committed KV as a staged
+        export entry at its finish (disaggregated serving — see
+        :meth:`export_kv`)."""
         ids = np.asarray(
             prompt_ids.numpy() if hasattr(prompt_ids, "numpy")
             else prompt_ids, dtype=np.int32).reshape(-1)
@@ -1905,7 +1953,8 @@ class LLMEngine:
             # (supervised restart / preemption re-admission under the
             # same rid) — fresh requests start at the optimistic default
             spec_ewma=(float(spec_ewma) if spec_ewma is not None
-                       else self._spec_ewma.get(rid))))
+                       else self._spec_ewma.get(rid)),
+            export_kv=bool(export_kv)))
         return rid
 
     def has_unfinished(self):
@@ -2373,7 +2422,10 @@ class LLMEngine:
         when it can no longer apply (tenant/token drift, the ramp
         passed it by re-prefilling, or a misaligned budget-clamped
         grant boundary)."""
-        if not self.kv_host_swap or not self._swap_store:
+        # gate on the STORE, not kv_host_swap: shipped entries (a peer's
+        # import_kv) restore through this same path on engines that never
+        # enabled local preempt-swap
+        if self.cache_impl != "paged" or not self._swap_store:
             return
         bs = self.block_size
         for b, slot in enumerate(self.slots):
@@ -2445,14 +2497,24 @@ class LLMEngine:
                                           np.int32(stitch))
             if stitch >= target:
                 del self._swap_store[rid]
-            self.stats["kv_swap_in_blocks"] += got
-            self.stats["kv_swap_in_bytes"] += got * \
-                self.kv_bytes_per_block()
+            shipped = bool(entry.get("shipped"))
+            if shipped:
+                # cross-replica ships book their OWN counters so the
+                # StepRecord swap-byte deltas stay the preempt_swap
+                # classifier's exclusive signal (see _spill_block note)
+                self.stats["kv_ship_in_blocks"] += got
+                self.stats["kv_ship_in_bytes"] += got * \
+                    self.kv_bytes_per_block()
+            else:
+                self.stats["kv_swap_in_blocks"] += got
+                self.stats["kv_swap_in_bytes"] += got * \
+                    self.kv_bytes_per_block()
             self.stats["kv_swap_saved_tokens"] += max(stitch - pos, 0)
             self.stats["swap_in_time_s"] += time.perf_counter() - t0
             rec = self._rec()
             if rec is not None:
-                rec.req_event(rid, "swapped_in",
+                rec.req_event(rid,
+                              "kv_shipped_in" if shipped else "swapped_in",
                               step_id=rec.next_step_id(),
                               value=max(stitch - pos, 0))
 
@@ -2541,6 +2603,239 @@ class LLMEngine:
         if self.cache_impl != "paged":
             return ()
         return tuple(self._swap_store)
+
+    # ---- cross-replica KV shipping (disaggregated prefill/decode) -----
+    # The staged-entry format is the PR-13 swap entry plus identity
+    # (rid, chain hashes) and pool-geometry fields, so export/import
+    # reuse the same gather/scatter programs and the same stitch-at-T-1
+    # re-admission. serving/kv_transport.py serializes exactly these
+    # dicts to bytes-on-wire.
+
+    def _export_slot_kv(self, b, slot):
+        """Stage slot ``b``'s committed KV as a SHIPPABLE export entry —
+        runs on the engine thread at the finish site of an
+        ``export_kv``-flagged request, while the slot's blocks are still
+        allocated. Same gather + async D2H staging as ``_swap_out_slot``;
+        the entry carries identity (rid, tenant, tokens, chain hashes)
+        and pool geometry so the destination can validate before it
+        scatters. Materialization is deferred to :meth:`export_kv` (the
+        copy overlaps whatever the device is doing next)."""
+        req = slot.req
+        kv_len = slot.prefill_pos + len(slot.generated)
+        if kv_len <= 0 or req.kind == "embed":
+            return
+        nb = (kv_len - 1) // self.block_size + 1
+        blocks = self._slot_blocks[b][:nb]
+        if len(blocks) < nb:
+            return
+        t0 = time.perf_counter()
+        k_host, v_host = self._kv_gather_fn(self._k, self._v,
+                                            self._pad_block_idx(blocks))
+        for leaf in jax.tree_util.tree_leaves([k_host, v_host]):
+            try:
+                leaf.copy_to_host_async()
+            except AttributeError:      # CPU fallback: a buffer move
+                pass
+        done = np.concatenate([req.prompt_ids,
+                               np.asarray(slot.generated, np.int32)])
+        entry = {"rid": req.request_id, "tokens": done[:kv_len],
+                 "adapter_id": req.adapter_id, "n_blocks": nb,
+                 "block_size": self.block_size, "kv_quant": self.kv_quant,
+                 # chain hashes of the FULL blocks: the destination's
+                 # content-store identity (its _register_upto recomputes
+                 # and must agree) and the pull-on-miss address space
+                 "chain": self.prefix_chain_hashes(
+                     done[:kv_len], adapter_id=req.adapter_id),
+                 "k": k_host, "v": v_host, "ready": False,
+                 "nbytes": nb * self.kv_bytes_per_block()}
+        self._export_store[req.request_id] = entry
+        while len(self._export_store) > self._export_cap:
+            self._export_store.popitem(last=False)
+        self.stats["kv_ship_out_blocks"] += nb
+        self.stats["kv_ship_out_bytes"] += entry["nbytes"]
+        self.stats["swap_out_time_s"] += time.perf_counter() - t0
+
+    def export_kv(self, request_id):
+        """Pop + materialize the staged export entry for ``request_id``
+        (an ``export_kv``-flagged request that finished on this engine).
+        Callable from ANY thread — the pop is a GIL-atomic dict op and
+        materialization only reads already-gathered host-bound staging
+        arrays, never the pool. Returns the plain-numpy staged entry
+        (serializable by ``serving.kv_transport``), or None."""
+        if self.cache_impl != "paged":
+            return None
+        entry = self._export_store.pop(request_id, None)
+        if entry is None:
+            return None
+        if not entry["ready"]:
+            nb = entry["n_blocks"]
+            entry["k"] = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[:nb], entry["k"])
+            entry["v"] = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[:nb], entry["v"])
+            entry["ready"] = True
+        return entry
+
+    def import_kv(self, entry):
+        """Stage a SHIPPED entry for restore into this engine: validate
+        pool-geometry compatibility, then seed the swap store under the
+        entry's rid — the existing ``_try_swap_restores`` (engine
+        thread) does the allocation, the fenced scatter, the one-token
+        stitch and the identity validation (rid + tenant + token
+        prefix) when the re-admitted request's slot next schedules.
+        Callable from ANY thread (one GIL-atomic dict write). Returns
+        True when staged, False on a compatibility reject — the router
+        falls back to plain re-prefill."""
+        if self.cache_impl != "paged" or self.scheduler != "fused":
+            return False
+        if not entry.get("ready") or entry.get("n_blocks", 0) <= 0:
+            return False
+        if int(entry.get("block_size", -1)) != self.block_size or \
+                entry.get("kv_quant") != self.kv_quant:
+            return False
+        pool_leaves = jax.tree_util.tree_leaves([self._k, self._v])
+        ent_leaves = jax.tree_util.tree_leaves(
+            [entry["k"], entry["v"]])
+        if len(ent_leaves) != len(pool_leaves):
+            return False
+        for p, e in zip(pool_leaves, ent_leaves):
+            if tuple(e.shape[1:]) != tuple(p.shape[1:]) or \
+                    np.dtype(e.dtype) != np.dtype(p.dtype):
+                return False
+        rid = entry["rid"]
+        self._swap_store[rid] = {
+            "tokens": np.asarray(entry["tokens"], np.int32),
+            "adapter_id": int(entry["adapter_id"]),
+            "n_blocks": int(entry["n_blocks"]),
+            "k": entry["k"], "v": entry["v"], "ready": True,
+            "nbytes": int(entry["n_blocks"]) * self.kv_bytes_per_block(),
+            # shipped entries book kv_ship_in_* at restore, never the
+            # kv_swap_* counters (the preempt classifier's signal)
+            "shipped": True}
+        return True
+
+    def export_prefix_blocks(self, chain_hashes):
+        """Pull-on-miss PEER export: package the registered prefix
+        blocks for ``chain_hashes`` (device content store, or this
+        engine's own spill store) as shippable single-block entries.
+        READ-ONLY and callable from the router thread: the gather reads
+        immutable pool array values through the dispatch lock, and the
+        hash→phys mapping is re-checked AFTER materialization — a block
+        evicted and reused mid-gather fails the re-check and is dropped
+        (an eviction re-registered under the SAME hash is harmless by
+        content addressing). Returns entries for the servable prefix
+        only, stopping at the first miss."""
+        out = []
+        if self.cache_impl != "paged" or not self.prefix_cache:
+            return out
+        per = self.kv_bytes_per_block()
+        for h in chain_hashes:
+            phys = self._store.get(h)
+            if phys is None:
+                spilled = self._spill.get(h) \
+                    if self.kv_host_spill_bytes else None
+                if spilled is not None and spilled.get("ready"):
+                    out.append({"hash": h, "parent": spilled["parent"],
+                                "tokens": spilled["tokens"],
+                                "n_blocks": 1,
+                                "block_size": self.block_size,
+                                "kv_quant": self.kv_quant,
+                                "k": spilled["k"], "v": spilled["v"],
+                                "ready": True,
+                                "nbytes": spilled["nbytes"]})
+                    continue
+                break
+            parent = self._block_parent.get(phys)
+            tokens = self._block_tokens.get(phys)
+            if parent is None or tokens is None:
+                break
+            with self._dispatch_lock:
+                k_host, v_host = self._kv_gather_fn(
+                    self._k, self._v, self._pad_block_idx([phys]))
+            k_host = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[:1], k_host)
+            v_host = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[:1], v_host)
+            if self._store.get(h) != phys or \
+                    self._block_hash.get(phys) != h:
+                break       # evicted/reused mid-gather: stop the span
+            out.append({"hash": h, "parent": parent, "tokens": tokens,
+                        "n_blocks": 1, "block_size": self.block_size,
+                        "kv_quant": self.kv_quant,
+                        "k": k_host, "v": v_host, "ready": True,
+                        "nbytes": per})
+        if out:
+            self.stats["kv_ship_out_blocks"] += len(out)
+            self.stats["kv_ship_out_bytes"] += \
+                sum(e["nbytes"] for e in out)
+        return out
+
+    def import_prefix_blocks(self, entries):
+        """Queue shipped prefix-block entries (a peer's
+        :meth:`export_prefix_blocks`) for this engine's spill store.
+        Callable from ANY thread — entries land in a GIL-atomic inbox
+        and the engine thread drains them (validated + budget-bounded)
+        at the top of its next step, BEFORE admission probes run, so a
+        request submitted right after the import hits them. Requires an
+        armed spill store (``kv_host_spill_bytes > 0``); entries are
+        dropped otherwise. Returns the number queued."""
+        if self.cache_impl != "paged" or not self.prefix_cache or \
+                not self.kv_host_spill_bytes:
+            return 0
+        n = 0
+        for e in entries:
+            if not e.get("ready") or \
+                    int(e.get("block_size", -1)) != self.block_size or \
+                    e.get("kv_quant") != self.kv_quant:
+                continue
+            self._spill_inbox.append(e)
+            n += 1
+        return n
+
+    def _drain_spill_inbox(self):
+        """Engine-thread half of pull-on-miss: move shipped prefix
+        blocks from the inbox into the bounded spill store (hash
+        re-derived from parent + tokens, so a corrupt or miskeyed entry
+        can never register under a hash it doesn't hash to). The
+        existing probe → ``_promote_spilled`` path then serves them
+        exactly like locally spilled content."""
+        if not self._spill_inbox:
+            return
+        inbox, self._spill_inbox = self._spill_inbox, []
+        pool_leaves = jax.tree_util.tree_leaves([self._k, self._v])
+        got_blocks = got_bytes = 0
+        for e in inbox:
+            tokens = np.frombuffer(e["tokens"], np.int32) \
+                if isinstance(e["tokens"], bytes) \
+                else np.asarray(e["tokens"], np.int32)
+            h = self._chain_hash(e["parent"], tokens)
+            if e.get("hash") is not None and e["hash"] != h:
+                continue
+            if h in self._store or h in self._spill:
+                continue
+            ent_leaves = jax.tree_util.tree_leaves([e["k"], e["v"]])
+            if len(ent_leaves) != len(pool_leaves) or any(
+                    tuple(x.shape[1:]) != tuple(p.shape[1:])
+                    or np.dtype(x.dtype) != np.dtype(p.dtype)
+                    for x, p in zip(ent_leaves, pool_leaves)):
+                continue
+            per = self.kv_bytes_per_block()
+            if per > self.kv_host_spill_bytes:
+                continue
+            while self._spill_bytes + per > self.kv_host_spill_bytes \
+                    and self._spill:
+                _, old = self._spill.popitem(last=False)
+                self._spill_bytes -= old["nbytes"]
+            self._spill[h] = {"parent": e["parent"],
+                              "tokens": tokens.tobytes(),
+                              "n_blocks": 1, "k": e["k"], "v": e["v"],
+                              "ready": True, "nbytes": per}
+            self._spill_bytes += per
+            got_blocks += 1
+            got_bytes += per
+        if got_blocks:
+            self.stats["kv_ship_in_blocks"] += got_blocks
+            self.stats["kv_ship_in_bytes"] += got_bytes
 
     def _check_pool_invariants(self):
         """Debug-only allocator audit (PADDLE_TPU_POOL_CHECKS=1; the test
@@ -2833,7 +3128,7 @@ class LLMEngine:
             req.temperature, req.top_p, req.eos_token_id,
             readout_stride=req.readout_stride,
             adapter_id=req.adapter_id, kind=req.kind,
-            spec_ewma=req.spec_ewma))
+            spec_ewma=req.spec_ewma, export_kv=req.export_kv))
         self._free_slot(b)
         self.stats["preemptions"] += 1
         if self._rec() is not None:
@@ -2983,7 +3278,10 @@ class LLMEngine:
         t0 = time.perf_counter()
         self._programs()
         hit, chain = 0, []
-        swapped = self.kv_host_swap and req.kind != "embed" and \
+        # swap-store gate, not kv_host_swap: a SHIPPED entry (import_kv)
+        # must suppress the prefix probe the same way a local swap does,
+        # even on engines with preempt-swap off
+        swapped = self.cache_impl == "paged" and req.kind != "embed" and \
             req.request_id in self._swap_store
         if self.prefix_cache and req.kind != "embed":
             # embed requests never PROBE: a hit would skip the shared
@@ -3118,7 +3416,7 @@ class LLMEngine:
         rec, ctx = self._rec(), self._rec_ctx
         if rec is None or ctx is None:
             return
-        t0, admit0, hits0, swaps0, kvin0, kvout0 = ctx
+        t0, admit0, hits0, swaps0, kvin0, kvout0, shin0, shout0 = ctx
         wall = time.perf_counter() - t0
         admit_s = self.stats["admit_time_s"] - admit0
         paged = self.cache_impl == "paged"
@@ -3155,6 +3453,13 @@ class LLMEngine:
             kv_swap_out_bytes=(self.stats["kv_swap_out_bytes"] - kvout0)
             if paged else None,
             kv_host_spill_blocks=len(self._spill) if paged else None,
+            # cross-replica ship traffic THIS step (a shipped restore's
+            # stitch grant rides a mixed step) — the explain_tail
+            # "kv_ship" cause's signal, booked apart from swap bytes
+            kv_ship_in_bytes=(self.stats["kv_ship_in_bytes"] - shin0)
+            if paged else None,
+            kv_ship_out_bytes=(self.stats["kv_ship_out_bytes"] - shout0)
+            if paged else None,
             # per-slot TENANT ids + this step's adapter swap-ins (the
             # explain_tail "adapter_swap" cause reads them back)
             adapter_slots=tuple(
@@ -3259,6 +3564,10 @@ class LLMEngine:
         from ..core import random as _random
 
         self._note_pool_owner()
+        if self.cache_impl == "paged" and self._spill_inbox:
+            # pull-on-miss arrivals land BEFORE admission so a request
+            # submitted right after the import probes into them
+            self._drain_spill_inbox()
         if self.cache_impl == "paged" and \
                 self._inflight >= self.max_pipeline_depth():
             raise RuntimeError(
@@ -3276,7 +3585,9 @@ class LLMEngine:
                              self.stats["prefix_hit_tokens"],
                              self.stats["adapter_swaps"],
                              self.stats["kv_swap_in_bytes"],
-                             self.stats["kv_swap_out_bytes"])
+                             self.stats["kv_swap_out_bytes"],
+                             self.stats["kv_ship_in_bytes"],
+                             self.stats["kv_ship_out_bytes"])
             self._rec_preempted = []
         self._admit_waiting()
         if not any(s is not None for s in self.slots):
@@ -3296,8 +3607,15 @@ class LLMEngine:
             return None
         self._programs()
         if self._rng_key is None:
-            seed, counter = _random.default_generator.next_seed()
-            key = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
+            if self._sampling_seed is not None:
+                # replica-independent base key (disaggregated serving):
+                # every engine built with the same sampling_seed derives
+                # identical per-(rid, position) fold_in keys, so a
+                # migrated sampled stream continues token-exactly
+                key = jax.random.PRNGKey(self._sampling_seed)
+            else:
+                seed, counter = _random.default_generator.next_seed()
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), counter)
             if self._mesh is not None:
                 # multi-process: the key must be a GLOBAL replicated array
                 # (every process derives the identical value from the seed)
@@ -4137,6 +4455,11 @@ class LLMEngine:
                 self._register_upto(b, slot,
                                     slot.prefill_pos + len(slot.generated))
             if finish_reason:
+                if slot.req.export_kv and self.cache_impl == "paged":
+                    # stage the committed KV for cross-replica shipping
+                    # WHILE the blocks are still allocated — export_kv()
+                    # (router thread) pops the staged entry afterwards
+                    self._export_slot_kv(b, slot)
                 out = RequestOutput(
                     slot.req.request_id,
                     self._finish_tokens(slot.req, slot.generated), True,

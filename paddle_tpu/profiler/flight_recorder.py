@@ -74,8 +74,13 @@ __all__ = ["StepRecord", "FlightRecorder", "TAIL_CAUSES"]
 #: never committed — an acceptance problem (workload/draft mismatch;
 #: the adaptive-k EWMA should be shrinking the window), not the
 #: host-sync or batched-readout pathology it would otherwise file as.
+#: "kv_ship" sits between adapter_swap and interfering_prefill: the
+#: gap's causal step moved cross-replica ship traffic (a migrated
+#: request's imported KV scattering in with its stitch grant, or a
+#: finish-site export staging out) — disaggregation transfer cost, not
+#: the prefill interference the mixed step would otherwise file as.
 TAIL_CAUSES = ("restart_recovery", "preempt_swap", "preempt_reprefill",
-               "adapter_swap",
+               "adapter_swap", "kv_ship",
                "interfering_prefill", "draft_rejected", "batched_readout",
                "host_sync", "idle_bubble", "dispatch", "unrecorded")
 
@@ -146,6 +151,13 @@ class StepRecord:
     kv_swap_in_bytes: int | None = None
     kv_swap_out_bytes: int | None = None
     kv_host_spill_blocks: int | None = None
+    #: cross-replica ship traffic THIS step moved (disaggregated
+    #: serving: staged-entry import restores / finish-site exports +
+    #: pull-on-miss prefix blocks) — separate from the swap bytes so
+    #: the preemption classifier's signal stays exclusive; the
+    #: explain_tail "kv_ship" cause reads these
+    kv_ship_in_bytes: int | None = None
+    kv_ship_out_bytes: int | None = None
 
     @property
     def budget_utilization(self):
@@ -280,7 +292,8 @@ class FlightRecorder:
                    cached_blocks=None, readout_stride=1,
                    adapter_slots=(), adapter_swaps=0, kv_pool_bytes=None,
                    kv_cache_dtype=None, kv_swap_in_bytes=None,
-                   kv_swap_out_bytes=None, kv_host_spill_blocks=None):
+                   kv_swap_out_bytes=None, kv_host_spill_blocks=None,
+                   kv_ship_in_bytes=None, kv_ship_out_bytes=None):
         """Record one dispatched step; returns its step id."""
         with self._lock:
             sid = self._seq
@@ -299,7 +312,9 @@ class FlightRecorder:
                 kv_cache_dtype=kv_cache_dtype,
                 kv_swap_in_bytes=kv_swap_in_bytes,
                 kv_swap_out_bytes=kv_swap_out_bytes,
-                kv_host_spill_blocks=kv_host_spill_blocks)
+                kv_host_spill_blocks=kv_host_spill_blocks,
+                kv_ship_in_bytes=kv_ship_in_bytes,
+                kv_ship_out_bytes=kv_ship_out_bytes)
             return sid
 
     def finish_step(self, step_id, sync_s, emit_s, finished=(),
@@ -658,6 +673,14 @@ class FlightRecorder:
             # device — a multi-tenant working set bigger than the
             # adapter cache, distinct from ordinary prefill ramp-in
             return "adapter_swap"
+        if getattr(rec, "kv_ship_in_bytes", None) or \
+                getattr(rec, "kv_ship_out_bytes", None):
+            # cross-replica ship traffic rode this step (a migrated
+            # request's import scattering in with its stitch grant, or
+            # an export staging out at a finish) — checked BEFORE the
+            # prefill-interference test because the stitch grant rides
+            # a mixed step and would otherwise file there
+            return "kv_ship"
         wall = rec.wall_s
         # prefill interference comes in two shapes: a fused chunk grant
         # in the step's own dispatch (grants), or a legacy admission

@@ -73,6 +73,9 @@ GAUGES = ("queue_depth", "engine_waiting", "running_slots",
           # double as the preempt_swap classifier signal), and the host
           # spill store's current block count (all 0 with the tier off)
           "kv_swap_in_bytes", "kv_swap_out_bytes", "kv_host_spill_blocks",
+          # the spill store's byte occupancy — same store as
+          # kv_host_spill_blocks, in the unit its bound is set in
+          "kv_host_spill_bytes",
           # gauge STALENESS: seconds since the serve loop last sampled
           # the point-in-time gauges (mark_gauge_sample). Computed at
           # READ time from the sampling stamp — a hung/idle loop's
@@ -113,7 +116,13 @@ _COUNTERS = ("requests_submitted", "requests_admitted", "requests_finished",
              # store
              "kv_swap_out_blocks", "kv_swap_in_blocks",
              "kv_swap_saved_tokens", "kv_spill_blocks",
-             "kv_promote_blocks")
+             "kv_promote_blocks",
+             # disaggregated serving: cross-replica KV shipped out of /
+             # into this replica (staged-entry exports + pull-on-miss
+             # prefix blocks) — booked apart from the swap counters so
+             # the preemption classifier's signal stays exclusive
+             "kv_ship_out_blocks", "kv_ship_in_blocks",
+             "kv_ship_out_bytes", "kv_ship_in_bytes")
 
 
 def _default_bounds():

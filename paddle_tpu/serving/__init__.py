@@ -45,6 +45,8 @@ from .server import AsyncLLMServer
 from .embedding import BertEmbedEngine
 from .cluster import (ReplicaRouter, RouterHandle, shard_model_tp,
                       tp_engine, tp_serving_mesh)
+from .kv_transport import (InProcessTransport, KVTransport, TransportError,
+                           deserialize_entry, serialize_entry)
 
 __all__ = ["AsyncLLMServer", "AdmissionQueue", "RequestHandle",
            "RequestState", "ServeRequest", "ServeResult", "ServerClosed",
@@ -52,4 +54,6 @@ __all__ = ["AsyncLLMServer", "AdmissionQueue", "RequestHandle",
            "FaultInjector", "InjectedFault", "RestartPolicy",
            "AdapterStore", "AdapterDeviceCache", "apply_merged",
            "random_lora_weights", "BertEmbedEngine",
-           "shard_model_tp", "tp_engine", "tp_serving_mesh"]
+           "shard_model_tp", "tp_engine", "tp_serving_mesh",
+           "KVTransport", "InProcessTransport", "TransportError",
+           "serialize_entry", "deserialize_entry"]

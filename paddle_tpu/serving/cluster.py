@@ -48,6 +48,7 @@ highest score wins; ties break toward the lower replica index.
 """
 from __future__ import annotations
 
+import collections
 import os
 import threading
 import time
@@ -155,6 +156,15 @@ class RouterHandle:
         self._replica = None
         self._final: ServeResult | None = None
         self._streamed = []          # tokens handed to the caller
+        #: disaggregated serving state: {"budget": original
+        #: max_new_tokens, "done": ship completed} on a request the
+        #: router split into a prefill leg + decode leg; None otherwise
+        self._disagg = None
+        #: tokens committed on a finished prefill leg that the caller
+        #: had NOT yet consumed when the ship migrated the stream — the
+        #: decode replica treats them as resume prefix (never re-emits),
+        #: so the router delivers them from here first
+        self._carry = collections.deque()
         self._migrating = False      # drain: a cancel that must resubmit
         self.resubmits = 0
         #: failover-retry pacing: when every survivor's queue is full, a
@@ -203,6 +213,17 @@ class RouterHandle:
         the same lock — _resolve snapshots (pending deque, streamed
         list) under that lock too, so a crash result can never count a
         token in both."""
+        if self._carry:
+            # migrated-leg tokens the decode replica will never re-emit
+            # (they ride resume_tokens): deliver them before the new
+            # inner's stream
+            try:
+                tok = self._carry.popleft()
+            except IndexError:
+                tok = None
+            if tok is not None:
+                self._streamed.append(tok)
+                return tok
         inner = self._inner
         if inner is None:
             return None
@@ -319,12 +340,62 @@ class ReplicaRouter:
                  failover_retry_s=10.0, max_retry_backoff_s=0.5,
                  resume_inflight=False, seed=0,
                  adapter_affinity_weight=1.0, metrics_store=None,
-                 metrics_interval_s=0.05):
+                 metrics_interval_s=0.05, roles=None, transport=None,
+                 pull_on_miss=False):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         if policy not in ("affinity", "least_loaded", "random"):
             raise ValueError(f"unknown routing policy {policy!r}")
         self.replicas = list(replicas)
+        #: DISAGGREGATED prefill/decode serving (DistServe/Splitwise):
+        #: ``roles={"prefill": [...], "decode": [...]}`` (replica
+        #: indices). New generate prompts place on PREFILL replicas as a
+        #: one-token leg with ``export_kv`` staging; on leg finish the
+        #: router ships the staged entry to a DECODE replica (import +
+        #: stitch re-admission, jumping the queue like a failover
+        #: resume) and the stream continues there — token-exactly for
+        #: greedy, and for sampled when the replicas share a
+        #: ``sampling_seed``. Any ship/validation failure falls back to
+        #: plain re-prefill on the decode side: shipping is an
+        #: optimization, never a correctness dependency.
+        if roles is not None:
+            n = len(self.replicas)
+            roles = {k: sorted(int(i) for i in v)
+                     for k, v in roles.items()}
+            for k in ("prefill", "decode"):
+                if not roles.get(k):
+                    raise ValueError(f"roles needs a non-empty {k!r} "
+                                     f"replica list")
+                if any(i < 0 or i >= n for i in roles[k]):
+                    raise ValueError(f"roles[{k!r}] has an out-of-range "
+                                     f"replica index (have {n})")
+            # a migrated request keeps its rid across replicas (the
+            # engine's restore + sampling keys validate by rid) — give
+            # each replica a disjoint id base so a prefill-assigned rid
+            # can never collide with a decode replica's own. 2**26
+            # spacing: rids must stay int32-safe (the per-(rid,
+            # position) sampling keys fold_in the rid), so 67M ids per
+            # replica for up to 31 replicas
+            if len(self.replicas) > 31:
+                raise ValueError("disaggregated roles support at most "
+                                 "31 replicas (int32 rid bases)")
+            for i, srv in enumerate(self.replicas):
+                srv._next_id = max(srv._next_id, i * (1 << 26))
+        self.roles = roles
+        #: staged-entry mover (serving.kv_transport): defaults to the
+        #: in-process loopback, which still round-trips real serialized
+        #: bytes. pull_on_miss additionally lets a replica whose prefix
+        #: probe missed fetch the cached span from the peer that
+        #: probe_prefix_len says can serve it, instead of recomputing.
+        if transport is None and (roles is not None or pull_on_miss):
+            from .kv_transport import InProcessTransport
+            transport = InProcessTransport()
+        self.transport = transport
+        self.pull_on_miss = bool(pull_on_miss)
+        #: end-to-end migration latency (leg finish → decode-side
+        #: re-admission granted), observed per successful ship
+        from ..profiler.serving_telemetry import LatencyHistogram
+        self.migration_latency = LatencyHistogram()
         self.affinity_weight = float(affinity_weight)
         #: adapter-affinity bonus (multi-tenant serving): a replica
         #: whose adapter device cache already HOLDS the request's
@@ -398,6 +469,17 @@ class ReplicaRouter:
                       #: caller, so resumption is exact and the host
                       #: copy is simply abandoned with the replica
                       "swap_resident_failover": 0,
+                      #: disaggregated serving: prefill legs whose KV
+                      #: shipped to a decode replica (stitch-only
+                      #: re-admission), legs that fell back to plain
+                      #: re-prefill (ship/import/validation failure),
+                      #: host-resident KV abandoned by a hung-/dead-
+                      #: replica failover (swap-resident or mid-ship —
+                      #: transfer work the fleet paid and lost), and
+                      #: prefix blocks fetched from peers on a probe
+                      #: miss instead of recomputed
+                      "kv_shipped": 0, "kv_ship_fallback": 0,
+                      "kv_ship_abandoned": 0, "pull_on_miss_blocks": 0,
                       "placements": [0] * len(self.replicas)}
 
     # -- lifecycle -------------------------------------------------------
@@ -502,9 +584,26 @@ class ReplicaRouter:
             - self.load_weight * (load + pool)
         return score, aff, adapter_hit
 
-    def _rank(self, ids, pin=None, adapter_id=0):
+    def _role_for(self, handle):
+        """Which role set a submission places into, or None (no
+        disaggregation). A split request's DECODE leg (ship done — it
+        carries a resume prefix) goes to decode replicas; everything
+        else — fresh prompts, prefill legs retrying after a failed
+        replica, embeds — is prefill-heavy work and goes to prefill
+        replicas."""
+        if self.roles is None:
+            return None
+        d = handle._disagg
+        return "decode" if (d is not None and d.get("shipping")) \
+            else "prefill"
+
+    def _rank(self, ids, pin=None, adapter_id=0, role=None):
         """Candidate replicas best-first as (idx, score, aff_tokens,
-        adapter_hit)."""
+        adapter_hit). ``role``: restrict candidates to that role set
+        (disaggregated serving) — degrading gracefully to EVERY healthy
+        replica when the whole role set is down, so losing the last
+        prefill replica converts prompts to mixed placement instead of
+        request loss."""
         #: prompt hash chain per (block_size, tenant) — computed at most
         #: once per submission, shared by same-geometry replicas' probes
         hash_cache = {}
@@ -527,6 +626,9 @@ class ReplicaRouter:
             return [(pin, score, aff, ahit)]
         cand = [i for i in range(len(self.replicas))
                 if self.healthy(i) and i not in self._draining]
+        if role is not None and self.roles is not None:
+            in_role = [i for i in cand if i in self.roles[role]]
+            cand = in_role or cand
         if not cand:
             return []
         if self.policy == "random":
@@ -562,6 +664,16 @@ class ReplicaRouter:
                       readout_stride=readout_stride,
                       adapter_id=adapter_id, kind=kind)
         handle = RouterHandle(self, ids, kwargs, routing_key)
+        if self.roles is not None and kind == "generate" and \
+                int(max_new_tokens) > 1:
+            # disaggregated split: submit a ONE-token prefill leg with
+            # export staging; the leg's finish hook ships the KV and
+            # resubmits the remaining budget on a decode replica (an
+            # eos on the very first token just finishes normally). A
+            # budget of 1 is pure prefill already — no split.
+            handle._disagg = {"budget": int(max_new_tokens)}
+            kwargs["max_new_tokens"] = 1
+            kwargs["export_kv"] = True
         deadline = None if timeout is None else time.monotonic() + timeout
         delay = self.poll_interval_s
         while True:
@@ -590,7 +702,15 @@ class ReplicaRouter:
         each other's hash walks; the lock guards only the actual
         placement bookkeeping."""
         adapter_id = int(handle._kwargs.get("adapter_id") or 0)
-        ranked = self._rank(ids, pin=pin, adapter_id=adapter_id)
+        ranked = self._rank(ids, pin=pin, adapter_id=adapter_id,
+                            role=self._role_for(handle))
+        if self.pull_on_miss and ranked and \
+                handle._kwargs.get("kind", "generate") == "generate":
+            # BEFORE the submit: the fetched span must be in the target's
+            # spill inbox before its engine thread runs this request's
+            # admission probe (the inbox drains at the top of the next
+            # step, ahead of admission)
+            self._pull_prefix(ranked[0], ids, adapter_id)
         with self._lock:
             last_err = None
             for idx, score, aff, ahit in ranked:
@@ -632,6 +752,60 @@ class ReplicaRouter:
                         self.stats["adapter_routed"] += 1
                 return None
             return last_err or ServerClosed("no replica alive")
+
+    def _pull_prefix(self, top, ids, adapter_id):
+        """Pull-on-miss: when the chosen replica's prefix probe (device
+        content store + its own spill store) covers LESS of this prompt
+        than some peer could serve, fetch the missing span's blocks
+        from that peer over the transport instead of recomputing them.
+        Entirely best-effort and read-only on the peer: a block evicted
+        mid-gather just truncates the span, and the target re-derives
+        every chain hash before registering, so a bad fetch can never
+        corrupt the content store. Requires the target to run an armed
+        spill store (``kv_host_spill_bytes > 0``) — the fetched blocks
+        land there and the existing probe → promote path serves them."""
+        idx, _, aff, _ = top
+        eng = self.replicas[idx].engine
+        if self.transport is None or \
+                not getattr(eng, "prefix_cache", False) or \
+                not getattr(eng, "kv_host_spill_bytes", 0):
+            return
+        bs = eng.block_size
+        try:
+            hashes = eng.prefix_chain_hashes(ids, adapter_id=adapter_id)
+        except Exception:
+            return
+        have = int(aff) // bs
+        if have >= len(hashes):
+            return
+        want = hashes[have:]
+        best_peer, best_len = None, 0
+        for j, srv in enumerate(self.replicas):
+            if j == idx or not self.healthy(j):
+                continue
+            peng = srv.engine
+            if getattr(peng, "block_size", None) != bs or \
+                    getattr(peng, "kv_quant", None) != eng.kv_quant:
+                continue
+            try:
+                plen = int(peng.probe_prefix_len(
+                    ids, chain_hashes=hashes, adapter_id=adapter_id))
+            except Exception:
+                continue
+            if plen // bs > have and plen > best_len:
+                best_peer, best_len = peng, plen
+        if best_peer is None:
+            return
+        try:
+            entries = best_peer.export_prefix_blocks(want)
+            if not entries:
+                return
+            n, _ = self.transport.ship_prefix_blocks(entries, eng)
+        except Exception:
+            return
+        if n:
+            with self._lock:
+                self.stats["pull_on_miss_blocks"] += n
 
     def num_outstanding(self):
         with self._lock:
@@ -717,6 +891,10 @@ class ReplicaRouter:
                             self.stats["evicted_hung"] += 1
                             if inner.request_id in swap_rids:
                                 self.stats["swap_resident_failover"] += 1
+                                # the wedged replica's host-resident KV
+                                # copy is abandoned with it — transfer
+                                # work the fleet paid and lost
+                                self.stats["kv_ship_abandoned"] += 1
                 self._resolve(rh)
 
     def _resolve(self, handle):
@@ -739,6 +917,22 @@ class ReplicaRouter:
             reason == "replica_lost"
         migrating = handle._migrating and reason == "cancelled"
         streamed = inner.first_token_at is not None
+        d = handle._disagg
+        if d is not None and not lost and not migrating and \
+                reason == "length" and not d.get("placed"):
+            # the PREFILL-COMPLETE hook: the leg hit its one-token
+            # budget with the real budget unspent — ship the staged KV
+            # and continue on a decode replica
+            self._ship_and_resubmit(handle, inner, res)
+            return
+        if d is not None and lost and not d.get("placed") and \
+                not d.get("abandoned"):
+            # the prefill leg's replica died with the staged/committed
+            # KV still on it (mid-ship): the transfer work is lost —
+            # make it visible before the plain failover path re-prefills
+            d["abandoned"] = True
+            with self._lock:
+                self.stats["kv_ship_abandoned"] += 1
         # in-flight resumption (opt-in): resubmit with resume_tokens =
         # everything the caller consumed, so the stream continues
         # token-exactly on a survivor instead of failing replica_lost
@@ -840,6 +1034,121 @@ class ReplicaRouter:
             list(res.token_ids) if lost else list(handle._streamed),
             "replica_lost", True, routing=inner.request.routing))
 
+    def _ship_and_resubmit(self, handle, inner, res):
+        """The prefill-complete hook (disaggregated serving): export
+        the finished leg's staged KV, ship it over the transport to the
+        best decode replica, and resubmit the remaining budget there
+        under the SAME rid with the leg's tokens as resume prefix — the
+        decode engine's swap-store restore re-admits with the one-token
+        stitch (``AdmissionQueue.put(front=...)`` grant, like a
+        failover resume), so the migrated request pays ZERO re-prefill
+        tokens. ANY failure — export raced the store cap, transport or
+        pool-geometry reject, validation, queue full on the shipped-to
+        replica — falls back to plain resume resubmission (re-prefill
+        on the decode side, token-identical stream). Re-entrant: a
+        queue-full park retries from the monitor with the staged entry
+        cached on the handle, paced by the failover backoff."""
+        now = time.monotonic()
+        if handle._last_try is not None and \
+                now - handle._last_try < handle._retry_delay:
+            return                   # parked: wait out the backoff
+        with self._lock:
+            if handle not in self._outstanding:
+                return               # another caller won the resolve
+            self._done_with(handle)
+            handle._replica = None
+        t0 = time.perf_counter()
+        d = handle._disagg
+        d["shipping"] = True         # role flips to "decode" from here
+        src = inner._server
+        rid = inner.request_id
+        # freeze the leg's stream: undelivered tokens move to the
+        # router-level carry (the decode replica treats the WHOLE leg
+        # stream as resume prefix and never re-emits it)
+        with inner._cond:
+            pending = list(inner._tokens)
+            inner._tokens.clear()
+        handle._carry.extend(pending)
+        leg_tokens = [int(t) for t in res.token_ids]
+        handle._resume_tokens = leg_tokens
+        handle._kwargs["max_new_tokens"] = d["budget"]
+        handle._kwargs["export_kv"] = False
+        # the rid is the migration's identity: the decode engine's
+        # restore validates by it, and the shared-sampling_seed
+        # per-(rid, position) keys make a SAMPLED continuation
+        # token-exact only under the same rid
+        handle._kwargs["request_id"] = rid
+        if "entry" not in d:
+            try:
+                d["entry"] = src.engine.export_kv(rid)
+            except Exception:
+                d["entry"] = None
+        entry = d["entry"]
+        full_ids = np.concatenate(
+            [np.asarray(handle.prompt_ids, np.int32),
+             np.asarray(leg_tokens, np.int32)])
+        adapter_id = int(handle._kwargs.get("adapter_id") or 0)
+        ranked = self._rank(full_ids, adapter_id=adapter_id,
+                            role="decode")
+        shipped = False
+        err = ServerClosed("no replica alive")
+        for idx, _score, _aff, _ahit in ranked:
+            dst = self.replicas[idx]
+            shipped = False
+            if entry is not None and self.transport is not None:
+                try:
+                    self.transport.ship(entry, dst.engine)
+                    shipped = True
+                except Exception:
+                    shipped = False
+            err = self._try_place(handle, handle.prompt_ids, pin=idx,
+                                  resubmit=True)
+            if err is None:
+                break
+            if shipped:
+                # placement failed AFTER the import landed: pop the
+                # orphaned staged entry (GIL-atomic) so it cannot
+                # linger under a rid this replica never admits
+                try:
+                    dst.engine._swap_store.pop(rid, None)
+                except Exception:
+                    pass
+                shipped = False
+        if err is None:
+            d["placed"] = True
+            handle.resubmits += 1
+            handle._retry_since = None
+            handle._retry_delay = self.poll_interval_s
+            handle._last_try = None
+            self.migration_latency.observe(time.perf_counter() - t0)
+            with self._lock:
+                self.stats["resubmitted"] += 1
+                if shipped:
+                    self.stats["kv_shipped"] += 1
+                else:
+                    self.stats["kv_ship_fallback"] += 1
+            return
+        if isinstance(err, ServerQueueFull) and \
+                not self._stop_evt.is_set():
+            # transient decode-side backpressure: park and retry from
+            # the monitor, exactly like a failover resubmission
+            if handle._retry_since is None:
+                handle._retry_since = now
+            if now - handle._retry_since < self.failover_retry_s:
+                handle._last_try = now
+                handle._retry_delay = min(handle._retry_delay * 2.0,
+                                          self.max_retry_backoff_s)
+                with self._lock:
+                    self._outstanding.add(handle)
+                return
+        # terminal: the retry window closed or no replica can take it
+        with self._lock:
+            self.stats["replica_lost"] += 1
+            self.stats["kv_ship_fallback"] += 1
+        handle._finish(ServeResult(
+            res.request_id, list(res.token_ids), "replica_lost", True,
+            routing=inner.request.routing))
+
     # -- drain -----------------------------------------------------------
     def drain(self, idx, timeout=30.0):
         """Gracefully remove replica ``idx``: stop placing new work on
@@ -877,6 +1186,14 @@ class ReplicaRouter:
                    "stats": {k: (list(v) if isinstance(v, list) else v)
                              for k, v in self.stats.items()},
                    "draining": sorted(self._draining)}
+        if self.roles is not None:
+            out["roles"] = {k: list(v) for k, v in self.roles.items()}
+        out["migration_latency"] = self.migration_latency.snapshot()
+        if self.transport is not None:
+            out["transport"] = {
+                "ship_count": getattr(self.transport, "ship_count", 0),
+                "ship_bytes": getattr(self.transport, "ship_bytes", 0),
+                "fail_count": getattr(self.transport, "fail_count", 0)}
         out["replicas"] = {}
         for i, srv in enumerate(self.replicas):
             eng = srv.engine
@@ -894,9 +1211,15 @@ class ReplicaRouter:
                 "kv_tier": {
                     "swap_resident": swap_resident,
                     "spill_blocks": len(getattr(eng, "_spill", ())),
+                    # the spill store is BYTE-bounded (kv_host_spill_bytes
+                    # engine arg): report occupancy in the bound's unit
+                    "spill_bytes": getattr(eng, "_spill_bytes", 0),
                     "swap_out_bytes": eng.stats.get("kv_swap_out_bytes",
                                                     0),
                     "swap_in_bytes": eng.stats.get("kv_swap_in_bytes", 0),
+                    "ship_out_bytes": eng.stats.get("kv_ship_out_bytes",
+                                                    0),
+                    "ship_in_bytes": eng.stats.get("kv_ship_in_bytes", 0),
                 },
                 "telemetry": srv.telemetry.snapshot()}
         return out

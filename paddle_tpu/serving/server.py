@@ -187,6 +187,10 @@ class AsyncLLMServer:
         self._hlock = _lockwatch.tracked(threading.Lock(),
                                          "AsyncLLMServer._hlock")
         self._next_id = 0
+        # last engine-stat values the kv_ship telemetry counters have
+        # absorbed (see _update_gauges — ship bookings come from two
+        # threads, so step-window deltas would miss some)
+        self._ship_seen: dict[str, int] = {}
         self._work_evt = threading.Event()
         self._thread = None
         self._accepting = False
@@ -391,7 +395,8 @@ class AsyncLLMServer:
                top_p=1.0, eos_token_id=None, deadline_s=None, block=True,
                timeout=None, routing=None, resume_tokens=None,
                readout_stride=None, adapter_id=0,
-               kind="generate", spec_ewma=None) -> RequestHandle:
+               kind="generate", spec_ewma=None, request_id=None,
+               export_kv=False) -> RequestHandle:
         """Submit one generation request; returns its streaming
         :class:`RequestHandle`.
 
@@ -433,7 +438,18 @@ class AsyncLLMServer:
         engine's acceptance-adaptive verify-k (the router forwards the
         dead replica's learned value on failover — see
         ``LLMEngine.spec_ewma_for``). None lets the engine learn from
-        scratch; inert on non-speculative engines."""
+        scratch; inert on non-speculative engines.
+
+        ``request_id``: explicit id override (disaggregated serving: a
+        request migrated from a prefill replica must keep ITS id on the
+        decode replica — the engine's swap-store restore validates by
+        rid, and the per-(rid, position) sampling keys make the sampled
+        continuation token-exact only under the same rid). Rejects ids
+        this server already tracks; ``_next_id`` stays monotonic past it.
+
+        ``export_kv``: stage this request's committed KV as a shippable
+        export entry when it finishes (the router's prefill leg) — see
+        ``LLMEngine.export_kv``."""
         if self._crashed is not None:
             raise ServerClosed(
                 f"serving loop crashed: {self._crashed}") from self._crashed
@@ -488,8 +504,16 @@ class AsyncLLMServer:
                 f"prompt of {total} tokens cannot prefill into the "
                 f"{eng.n_blocks}-block pool")
         with self._hlock:
-            rid = self._next_id
-            self._next_id += 1
+            if request_id is not None:
+                rid = int(request_id)
+                if rid in self._handles:
+                    raise ValueError(
+                        f"request_id {rid} is already tracked by this "
+                        f"server")
+                self._next_id = max(self._next_id, rid + 1)
+            else:
+                rid = self._next_id
+                self._next_id += 1
         now = time.monotonic()
         if readout_stride is not None and int(readout_stride) < 1:
             raise ValueError(f"readout_stride must be >= 1, got "
@@ -506,7 +530,8 @@ class AsyncLLMServer:
                             if readout_stride is not None else None),
             adapter_id=adapter_id, kind=kind,
             spec_ewma=(float(spec_ewma) if spec_ewma is not None
-                       else None))
+                       else None),
+            export_kv=bool(export_kv))
         handle = RequestHandle(self, req)
         if kind == "embed":
             self.telemetry.inc("embed_requests")
@@ -774,7 +799,8 @@ class AsyncLLMServer:
                 committed_tokens=committed or None,
                 readout_stride=req.readout_stride,
                 adapter_id=req.adapter_id, kind=req.kind,
-                spec_ewma=req.spec_ewma)
+                spec_ewma=req.spec_ewma,
+                export_kv=getattr(req, "export_kv", False))
         except ValueError as e:
             # the rejection must be visible in telemetry, not just on
             # the handle — a silent validation drop looks like a lost
@@ -957,6 +983,21 @@ class AsyncLLMServer:
                           eng.stats.get("kv_swap_out_bytes", 0))
             tel.set_gauge("kv_host_spill_blocks",
                           len(getattr(eng, "_spill", ())))
+            # the spill store's bound is set in BYTES (kv_host_spill_bytes
+            # engine arg) — report occupancy in the bound's own unit too
+            tel.set_gauge("kv_host_spill_bytes",
+                          getattr(eng, "_spill_bytes", 0))
+            # cross-replica ship counters book from BOTH the engine
+            # thread (finish-site export, restore import) and the router
+            # thread (pull-on-miss peer export) — delta-sync them here,
+            # outside any step window, so no booking site is missed
+            for key in ("kv_ship_out_blocks", "kv_ship_in_blocks",
+                        "kv_ship_out_bytes", "kv_ship_in_bytes"):
+                cur = eng.stats.get(key, 0)
+                d = cur - self._ship_seen.get(key, 0)
+                if d > 0:
+                    tel.inc(key, d)
+                    self._ship_seen[key] = cur
             if eng.prefix_cache:
                 tel.set_gauge("prefix_cached_blocks", len(eng._lru))
                 hit = eng.stats["prefix_hit_tokens"]

@@ -81,6 +81,11 @@ class ServeRequest:
     #: low-acceptance stream resumed on a survivor replica does not
     #: restart at full-window speculation. None = let the engine learn.
     spec_ewma: float | None = None
+    #: disaggregated serving: stage this request's committed KV as a
+    #: shippable export entry at its finish (the router sets this on the
+    #: PREFILL leg so the decode replica can import instead of
+    #: re-prefilling). Inert without a paged engine.
+    export_kv: bool = False
 
 
 @dataclasses.dataclass

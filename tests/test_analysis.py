@@ -508,7 +508,7 @@ def test_ptl006_kv_copy_outside_swap_api_fires(tmp_path):
     assert len(found) == 3, [f.message for f in found]
     assert {f.func for f in found} == {"_admit_custom", "_restore_custom",
                                        "_stage"}
-    assert all("fence-tracked swap API" in f.message for f in found)
+    assert all("fence-tracked transfer API" in f.message for f in found)
 
 
 def test_ptl006_swap_api_functions_are_allowed(tmp_path):
@@ -535,6 +535,34 @@ def test_ptl006_swap_api_functions_are_allowed(tmp_path):
     report = run_analysis([path])
     found = _checks(report, "PTL006")
     assert len(found) == 1 and found[0].func == "_sneaky_copy"
+
+
+def test_ptl006_transport_serialize_functions_are_allowed(tmp_path):
+    """The ship transport's wire encode/decode (serving/kv_transport.py)
+    is part of the fence-tracked transfer API — pool-named staging
+    buffers may materialize there; any OTHER function in the same file
+    is still judged normally."""
+    sub = tmp_path / "serving"
+    sub.mkdir()
+    (sub / "__init__.py").write_text("")
+    path = _write(sub, "kv_transport.py", """
+        import numpy as np
+
+        def serialize_entry(entry):
+            k_bufs = entry["k"]
+            return np.ascontiguousarray(np.asarray(k_bufs[0])).tobytes()
+
+        def deserialize_entry(data):
+            v_bufs = np.frombuffer(data, np.int8)
+            return np.asarray(v_bufs)
+
+        def _sniff_wire(entry):
+            k_bufs = entry["k"]
+            return np.asarray(k_bufs[0])
+    """)
+    report = run_analysis([path])
+    found = _checks(report, "PTL006")
+    assert len(found) == 1 and found[0].func == "_sniff_wire"
 
 
 def test_ptl006_suppressible_with_reason(tmp_path):
