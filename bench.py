@@ -790,11 +790,12 @@ def _bench_other(model_name):
         from paddle_tpu.profiler import FlightRecorder
 
         def serve_pass(rec, supervise=None, step_timeout_s=None,
-                       metrics_store=None):
+                       metrics_store=None, trace_context=True):
             srv = AsyncLLMServer(eng, max_queue_size=n_req + 1,
                                  flight_recorder=rec, supervise=supervise,
                                  step_timeout_s=step_timeout_s,
-                                 metrics_store=metrics_store)
+                                 metrics_store=metrics_store,
+                                 trace_context=trace_context)
             srv.start()
             t0 = time.perf_counter()
             hs = [srv.submit(p, max_new_tokens=new_tokens)
@@ -852,6 +853,19 @@ def _bench_other(model_name):
             ms_off.append(serve_pass(None)[0])
         ms_overhead_pct = round(
             (median(ms_off) - median(ms_on)) / median(ms_off) * 100, 2)
+
+        # trace-context A/B (distributed tracing): the same prompts
+        # re-served with per-request TraceContext minting disabled.
+        # The stamp is one uuid4 mint + a frozen dataclass per REQUEST
+        # (nothing on the per-token path), so the honest budget is the
+        # recorder's <2% tok/s with lots of headroom. Arms alternate,
+        # median-of-3, same protocol as the recorder A/B.
+        tc_on, tc_off = [], []
+        for _ in range(3):
+            tc_on.append(serve_pass(None)[0])
+            tc_off.append(serve_pass(None, trace_context=False)[0])
+        tc_overhead_pct = round(
+            (median(tc_off) - median(tc_on)) / median(tc_off) * 100, 2)
 
         # multi-step on-device decode A/B (ROADMAP item 6): the same
         # prompts re-served through fused engines at readout_stride=k
@@ -914,6 +928,11 @@ def _bench_other(model_name):
                "metrics_store_overhead_pct": ms_overhead_pct,
                "metrics_store_on_tokens_per_sec": round(
                    median(ms_on), 1),
+               # trace-context A/B (budget: < 2% tok/s — one context
+               # mint per request, nothing per token)
+               "trace_context_overhead_pct": tc_overhead_pct,
+               "trace_context_on_tokens_per_sec": round(
+                   median(tc_on), 1),
                "restart_recovery_artifact": os.path.join(
                    art_dir, "restart_recovery.json"),
                "tail_causes_p99": rec_snap["tail_causes_p99"],
@@ -1518,7 +1537,9 @@ def _bench_other(model_name):
         trickle_prompts = [rng.integers(0, V, (max(prompt_len // 4, 4),))
                            .astype(np.int32) for _ in range(trickle_n)]
 
-        def run_arm(roles, flood=True):
+        from paddle_tpu.profiler import FlightRecorder
+
+        def run_arm(roles, flood=True, trace_path=None):
             servers = []
             for i in range(2):
                 eng = LLMEngine(
@@ -1531,6 +1552,8 @@ def _bench_other(model_name):
                 eng.reset_stats()
                 servers.append(AsyncLLMServer(
                     eng, replica=i,
+                    flight_recorder=(FlightRecorder()
+                                     if trace_path else None),
                     max_queue_size=flood_n + trickle_n + 1))
             router = ReplicaRouter(servers, roles=roles)
             router.start()
@@ -1558,6 +1581,11 @@ def _bench_other(model_name):
                 th.join(timeout=1800)
             wall = time.perf_counter() - t0
             snap = router.snapshot()
+            if trace_path:
+                # the stitched cross-replica trace: every migrated
+                # request's prefill and decode legs flow-linked into one
+                # Perfetto chain, plus the router:migrations phase lane
+                router.export_merged_trace(trace_path)
             router.stop(timeout=120)
             gaps = [b[0] - a[0] for s in stamps
                     for a, b in zip(s, s[1:])]
@@ -1586,6 +1614,7 @@ def _bench_other(model_name):
                 "ship_bytes": snap["transport"]["ship_bytes"]
                 if snap.get("transport") else 0,
                 "migration_latency": snap.get("migration_latency"),
+                "migration_phases": snap.get("migration_phases"),
                 "decode_reprefill_tokens": (decode_prefill - migrated)
                 if decode_prefill is not None else None,
             }
@@ -1595,14 +1624,33 @@ def _bench_other(model_name):
         roles = {"prefill": [0], "decode": [1]}
         floor_arm, floor_trickle, _ = run_arm(None, flood=False)
         mixed_arm, mixed_trickle, mixed_flood = run_arm(None)
-        dis_arm, dis_trickle, dis_flood = run_arm(roles)
+        trace_path = os.path.join(_artifact_dir(),
+                                  "llama_serve_disagg_trace.json")
+        dis_arm, dis_trickle, dis_flood = run_arm(roles,
+                                                  trace_path=trace_path)
         parity = (dis_trickle == mixed_trickle == floor_trickle
                   and dis_flood == mixed_flood)
+        # the phase sub-spans must ACCOUNT for the measured migration
+        # latency: they nest inside the t0..t1 window (never exceed it
+        # beyond timer noise) and explain at least half of it — the
+        # un-phased residual is placement ranking + handle bookkeeping.
+        # Only a clean ship run is comparable (a fallback books latency
+        # with no phases and would dilute the histogram means).
+        mp = dis_arm["migration_phases"] or {}
+        phase_sum = sum(mp[p]["mean_s"]
+                        for p in ("serialize", "transport", "import",
+                                  "place") if p in mp)
+        mig_mean = (dis_arm["migration_latency"] or {}).get("mean_s", 0)
+        if dis_arm["kv_shipped"] and not dis_arm["kv_ship_fallback"]:
+            assert 0.5 * mig_mean <= phase_sum <= 1.05 * mig_mean, \
+                (phase_sum, mig_mean, mp)
         art_path = os.path.join(_artifact_dir(),
                                 "llama_serve_disagg.json")
         with open(art_path, "w") as f:
             json.dump({"floor": floor_arm, "mixed": mixed_arm,
-                       "disagg": dis_arm, "token_parity": parity},
+                       "disagg": dis_arm, "token_parity": parity,
+                       "migration_phase_sum_s": round(phase_sum, 6),
+                       "trace_artifact": trace_path},
                       f, indent=1)
         return {"metric": "llama_serve_disagg_decode_p99_ms",
                 "value": dis_arm["decode_p99_ms"],
@@ -1617,6 +1665,8 @@ def _bench_other(model_name):
                 "slots": B, "new_tokens": new_tokens,
                 "prompt_len": prompt_len, "chunk": chunk,
                 "block_size": block,
+                "migration_phase_sum_s": round(phase_sum, 6),
+                "trace_artifact": trace_path,
                 "telemetry_artifact": art_path}
 
     if model_name == "llama_serve_slo":

@@ -11,13 +11,23 @@ resumes there with the KV tier's one-token stitch: ONE prefill token
 per migration, zero re-prefill, token-exact vs mixed placement (greedy
 and seeded-sampled). Any ship failure falls back to plain re-prefill
 with unchanged tokens. Also printed: ship counters, the
-migration-latency histogram, and the per-replica kv_tier view.
+migration-latency histogram with its per-phase split, the fleet
+explain_tail verdicts, and the per-replica kv_tier view. On exit the
+router dumps its postmortem artifacts — the STITCHED cross-replica
+Perfetto trace (one connected flow-linked chain per migrated request;
+open at ui.perfetto.dev) and the fleet debug-bundle directory readable
+by ``python -m paddle_tpu.profiler.bundle`` — under
+``SERVE_DISAGG_OUT`` (default docs/artifacts/).
 """
+import json
+import os
+
 import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu.inference import LLMEngine
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.profiler import FlightRecorder
 from paddle_tpu.serving import AsyncLLMServer, ReplicaRouter
 
 
@@ -46,25 +56,52 @@ def main():
     ref = [r.token_ids for r in
            build_engine().generate(prompts, max_new_tokens=12)]
 
-    replicas = [AsyncLLMServer(build_engine(), replica=i) for i in range(2)]
+    replicas = [AsyncLLMServer(build_engine(), replica=i,
+                               flight_recorder=FlightRecorder())
+                for i in range(2)]
     with ReplicaRouter(replicas,
                        roles={"prefill": [0], "decode": [1]}) as router:
         handles = [router.submit(p, max_new_tokens=12) for p in prompts]
         for h, want in zip(handles, ref):
             res = h.result(timeout=300)
             ok = "token-exact" if res.token_ids == want else "MISMATCH"
+            tc = res.trace_ctx
             print(f"req {res.request_id}: {res.token_ids[:6]}... "
-                  f"({res.finish_reason}, {ok})")
+                  f"({res.finish_reason}, {ok})  trace {tc.trace_id} "
+                  f"hop {tc.hop} via {tc.via}")
 
         snap = router.snapshot()
         print(f"\nshipped {router.stats['kv_shipped']} requests "
               f"({snap['transport']['ship_bytes']} wire bytes), "
               f"{router.stats['kv_ship_fallback']} fallbacks")
         print("migration latency:", snap["migration_latency"])
+        for phase, h in snap["migration_phases"].items():
+            print(f"  kv_ship:{phase}: {h}")
+        for e in router.explain_tail(0.0, top=3):
+            print(f"  tail: req {e['request_id']} [{e.get('trace_id')}] "
+                  f"gap {e['gap_s'] * 1e3:.1f}ms <- {e['cause']}")
         dec = snap["replicas"][1]
         print(f"decode replica prefill_tokens="
               f"{replicas[1].engine.stats['prefill_tokens']} "
               f"(= one stitch token per migration), kv_tier={dec['kv_tier']}")
+
+        # postmortem artifacts: the stitched cross-replica trace (flow
+        # events join the prefill and decode legs of each request into
+        # one chain) + a fleet debug-bundle directory
+        out = os.environ.get("SERVE_DISAGG_OUT",
+                             os.path.join(os.path.dirname(__file__),
+                                          "..", "docs", "artifacts"))
+        trace_path = os.path.join(out, "serve_disagg_trace.json")
+        router.export_merged_trace(trace_path)
+        ev = json.load(open(trace_path))["traceEvents"]
+        flows = sum(1 for e in ev if e.get("ph") == "s")
+        print(f"\nstitched trace: {len(ev)} events, {flows} cross-replica "
+              f"flows -> {trace_path}  (open at ui.perfetto.dev)")
+        paths = router.dump_debug_bundle(
+            os.path.join(out, "serve_disagg_bundle"))
+        print(f"fleet debug bundle -> {os.path.dirname(paths['router'])}  "
+              f"(read: python -m paddle_tpu.profiler.bundle "
+              f"{paths['replicas'][0]})")
     for line in replicas[1].telemetry.prometheus_text().splitlines():
         if "kv_ship" in line and not line.startswith("#"):
             print(line)

@@ -18,6 +18,9 @@ p99 bench three rounds later:
  PTL006      device↔host KV-pool copy outside the fence-tracked swap API
  PTL007      SLO/pathology names missing from the ALERT_KINDS /
              LABELED_GAUGE_FAMILIES registries
+ PTL008      tracing names (request-event kinds, trace-hop vias,
+             Perfetto counter/flow tracks, tail causes) off their
+             flight-recorder/types registries
 ==========  =========================================================
 
 CLI::

@@ -278,9 +278,10 @@ def default_checks():
     from .retrace import RetraceCheck
     from .slo_names import SLONameCheck
     from .telemetry_names import TelemetryNameCheck
+    from .trace_names import TraceNameCheck
     return [_SuppressionPolicy(), HostSyncCheck(), RetraceCheck(),
             DonationCheck(), LockDisciplineCheck(), TelemetryNameCheck(),
-            KVTransferCheck(), SLONameCheck()]
+            KVTransferCheck(), SLONameCheck(), TraceNameCheck()]
 
 
 class Report:

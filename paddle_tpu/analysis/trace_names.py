@@ -1,0 +1,332 @@
+"""PTL008 — distributed-tracing strict-name pass.
+
+The tracing layer (PR 19) added four more dynamic-string name spaces on
+top of PTL005/PTL007's telemetry and SLO registries: request-timeline
+event kinds (``FlightRecorder.req_event``), trace-hop ``via`` labels
+(``TraceContext.mint``/``.child`` and the router's ``_bump_trace``),
+Perfetto counter-track / flow-event names, and the tail-cause verdicts
+``explain_tail`` may emit. All of them are joined BY STRING at read
+time — ``explain_tail(...)['cause']``, a Perfetto query on a track
+name, a dashboard grouping by hop ``via`` — so a typo'd literal never
+crashes; it silently forks the vocabulary and the join quietly returns
+nothing. This pass moves the whole vocabulary to lint time:
+
+* every literal second argument of ``.req_event(rid, "...")`` must be
+  in ``paddle_tpu/profiler/flight_recorder.py``'s
+  ``REQUEST_EVENT_KINDS``;
+* every literal hop label — ``.mint("...")``, ``.child("...")``, the
+  trailing literal of ``._bump_trace(handle, "...")`` — must be in
+  ``paddle_tpu/serving/types.py``'s ``TRACE_HOP_KINDS``;
+* every Perfetto counter event (a dict literal with ``"ph": "C"``)
+  whose ``"name"`` is a literal must name a ``COUNTER_TRACKS`` entry,
+  and every flow event (``"ph": "s"``/``"f"``) with a literal name
+  must use ``FLOW_EVENT_NAME``;
+* every cause literal a producer writes — ``cause = "..."`` /
+  ``...["cause"] = "..."`` assignments and ``return "..."`` inside a
+  ``*classify*`` function — must be in ``TAIL_CAUSES`` or the router's
+  ``FLEET_TAIL_CAUSES``;
+* ``FLEET_TAIL_CAUSES`` itself must stay in lockstep with
+  ``kv_transport.MIGRATION_PHASES``: beyond ``failover_resubmit``,
+  every entry must be ``kv_ship:<phase>`` and every phase must appear
+  (the tuple is hand-copied in ``cluster.py`` to keep jax out of its
+  import graph — this pass is the copy's keeper).
+
+Dynamic names (f-strings like ``kv_ship:{dom}``, variables) are
+skipped; the registries' own lockstep rule covers the f-string case.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Check
+
+__all__ = ["TraceNameCheck"]
+
+_HOP_CALLS = ("mint", "child")
+
+
+class TraceNameCheck(Check):
+    id = "PTL008"
+    describe = ("tracing name (request-event kind, trace-hop via, "
+                "Perfetto counter/flow track, tail cause) not in its "
+                "flight-recorder/types registry — a silent join-miss "
+                "at read time")
+
+    def __init__(self, registry=None):
+        """``registry``: optional override dict (fixture tests) with
+        keys ``request_event`` / ``trace_hop`` / ``counter_track`` /
+        ``flow_event`` / ``tail_cause`` / ``migration_phase`` (each a
+        set); default harvests them from the scanned registry modules
+        (with the PTL005/PTL007 import fallback for subtree runs)."""
+        self._override = registry
+        self.registry = {"request_event": set(), "trace_hop": set(),
+                         "counter_track": set(), "flow_event": set(),
+                         "tail_cause": set(), "migration_phase": set()}
+        self._saw_recorder = False
+        self._saw_types = False
+        self._saw_transport = False
+        self._saw_cluster = False
+        self._fallback_done = False
+
+    # -- registry harvesting --------------------------------------------
+    @staticmethod
+    def _harvest_tuple(tree, name, into):
+        """Module-level ``NAME = ("...", ...)`` string tuple/list."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == name \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                for e in node.value.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, str):
+                        into.add(e.value)
+
+    @staticmethod
+    def _harvest_str(tree, name, into):
+        """Module-level ``NAME = "..."`` string constant."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == name \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                into.add(node.value.value)
+
+    def _harvest_recorder(self, tree, registry):
+        self._harvest_tuple(tree, "REQUEST_EVENT_KINDS",
+                            registry["request_event"])
+        self._harvest_tuple(tree, "COUNTER_TRACKS",
+                            registry["counter_track"])
+        self._harvest_tuple(tree, "TAIL_CAUSES", registry["tail_cause"])
+        self._harvest_str(tree, "FLOW_EVENT_NAME", registry["flow_event"])
+
+    def collect(self, mod):
+        if self._override is not None:
+            return
+        if mod.relpath.endswith("profiler/flight_recorder.py"):
+            self._saw_recorder = True
+            self._harvest_recorder(mod.tree, self.registry)
+        if mod.relpath.endswith("serving/types.py"):
+            self._saw_types = True
+            self._harvest_tuple(mod.tree, "TRACE_HOP_KINDS",
+                                self.registry["trace_hop"])
+        if mod.relpath.endswith("serving/kv_transport.py"):
+            self._saw_transport = True
+            self._harvest_tuple(mod.tree, "MIGRATION_PHASES",
+                                self.registry["migration_phase"])
+        if mod.relpath.endswith("serving/cluster.py"):
+            self._saw_cluster = True
+            self._harvest_tuple(mod.tree, "FLEET_TAIL_CAUSES",
+                                self.registry["tail_cause"])
+
+    def _registry(self):
+        if self._override is not None:
+            return self._override
+        if not (self._saw_recorder and self._saw_types
+                and self._saw_transport and self._saw_cluster) \
+                and not self._fallback_done:
+            # registry modules not in the scanned tree (fixture dirs,
+            # subtree runs): parse the REAL modules' source with the
+            # same harvest logic — cached, one parse per run
+            self._fallback_done = True
+            try:
+                if not self._saw_recorder:
+                    from ..profiler import flight_recorder as fr
+                    with open(fr.__file__, encoding="utf-8") as fh:
+                        self._harvest_recorder(ast.parse(fh.read()),
+                                               self.registry)
+                if not self._saw_types:
+                    from ..serving import types as st
+                    with open(st.__file__, encoding="utf-8") as fh:
+                        self._harvest_tuple(
+                            ast.parse(fh.read()), "TRACE_HOP_KINDS",
+                            self.registry["trace_hop"])
+                if not self._saw_transport:
+                    from ..serving import kv_transport as kt
+                    with open(kt.__file__, encoding="utf-8") as fh:
+                        self._harvest_tuple(
+                            ast.parse(fh.read()), "MIGRATION_PHASES",
+                            self.registry["migration_phase"])
+                if not self._saw_cluster:
+                    from ..serving import cluster as cl
+                    with open(cl.__file__, encoding="utf-8") as fh:
+                        self._harvest_tuple(
+                            ast.parse(fh.read()), "FLEET_TAIL_CAUSES",
+                            self.registry["tail_cause"])
+            except Exception:
+                pass
+        return self.registry
+
+    # -- call-site checking ---------------------------------------------
+    def run(self, mod):
+        if not any(tok in mod.text for tok in
+                   ("req_event(", ".mint(", ".child(", "_bump_trace(",
+                    '"ph"', "cause", "FLEET_TAIL_CAUSES")):
+            return          # textual prefilter
+        reg = self._registry()
+        if not any(reg.get(k) for k in ("request_event", "trace_hop",
+                                        "counter_track", "flow_event",
+                                        "tail_cause")):
+            return          # no registry found at all: nothing to check
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(mod, node, reg)
+            elif isinstance(node, ast.Dict):
+                yield from self._check_event_dict(mod, node, reg)
+            elif isinstance(node, ast.Assign):
+                yield from self._check_cause_assign(mod, node, reg)
+                yield from self._check_fleet_lockstep(mod, node, reg)
+            elif isinstance(node, ast.FunctionDef) and \
+                    "classify" in node.name.lower():
+                yield from self._check_classify_returns(mod, node, reg)
+
+    def _check_call(self, mod, node, reg):
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return
+        if func.attr == "req_event" and len(node.args) >= 2 and \
+                isinstance(node.args[1], ast.Constant) and \
+                isinstance(node.args[1].value, str):
+            kind = node.args[1].value
+            if kind not in reg.get("request_event", set()):
+                yield self.finding(
+                    mod, node,
+                    f"request-event kind {kind!r} is not in "
+                    f"REQUEST_EVENT_KINDS — timelines() consumers "
+                    f"grouping by kind silently drop it (add it to "
+                    f"flight_recorder.REQUEST_EVENT_KINDS)",
+                    key=f"unknown-request-event:{kind}")
+        via = None
+        if func.attr in _HOP_CALLS and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            via = node.args[0].value
+        elif func.attr == "_bump_trace" and node.args and \
+                isinstance(node.args[-1], ast.Constant) and \
+                isinstance(node.args[-1].value, str):
+            via = node.args[-1].value
+        if via is not None and via not in reg.get("trace_hop", set()):
+            yield self.finding(
+                mod, node,
+                f"trace-hop via {via!r} is not in TRACE_HOP_KINDS — "
+                f"hop provenance grouped by via would fork the "
+                f"vocabulary (add it to serving/types.py)",
+                key=f"unknown-trace-hop:{via}")
+
+    def _check_event_dict(self, mod, node, reg):
+        """Perfetto event dict literals: ``"ph": "C"`` name must be a
+        registered counter track; ``"ph": "s"/"f"`` name must be the
+        flow-event name. Dynamic names (``**common``) are skipped."""
+        lits = {}
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                    and isinstance(v, ast.Constant) and \
+                    isinstance(v.value, str):
+                lits[k.value] = v.value
+        ph, name = lits.get("ph"), lits.get("name")
+        if name is None:
+            return
+        if ph == "C" and name not in reg.get("counter_track", set()):
+            yield self.finding(
+                mod, node,
+                f"counter track {name!r} is not in COUNTER_TRACKS — "
+                f"Perfetto queries on registered tracks miss it",
+                key=f"unknown-counter-track:{name}")
+        if ph in ("s", "f") and reg.get("flow_event") and \
+                name not in reg["flow_event"]:
+            yield self.finding(
+                mod, node,
+                f"flow event named {name!r} — Perfetto matches "
+                f"'s'/'f' pairs on (name, cat, id), so a name off "
+                f"FLOW_EVENT_NAME breaks the cross-pid arrows",
+                key=f"unknown-flow-event:{name}")
+
+    @staticmethod
+    def _literal_arms(value):
+        """String literals reachable from an assignment RHS: a bare
+        constant, or the arms of a conditional expression (the
+        ``cause = "x" if ... else _classify(...)`` shape)."""
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            yield value.value
+        elif isinstance(value, ast.IfExp):
+            yield from TraceNameCheck._literal_arms(value.body)
+            yield from TraceNameCheck._literal_arms(value.orelse)
+
+    def _check_cause_assign(self, mod, node, reg):
+        causes = reg.get("tail_cause", set())
+        if not causes or len(node.targets) != 1:
+            return
+        t = node.targets[0]
+        named = isinstance(t, ast.Name) and t.id == "cause"
+        keyed = isinstance(t, ast.Subscript) and \
+            isinstance(t.slice, ast.Constant) and t.slice.value == "cause"
+        if not (named or keyed):
+            return
+        for cause in self._literal_arms(node.value):
+            if cause not in causes:
+                yield self.finding(
+                    mod, node,
+                    f"tail cause {cause!r} is not in TAIL_CAUSES / "
+                    f"FLEET_TAIL_CAUSES — explain_tail consumers "
+                    f"keying on registered causes never see it",
+                    key=f"unknown-tail-cause:{cause}")
+
+    def _check_classify_returns(self, mod, node, reg):
+        causes = reg.get("tail_cause", set())
+        if not causes:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Return) and \
+                    isinstance(sub.value, ast.Constant) and \
+                    isinstance(sub.value.value, str):
+                cause = sub.value.value
+                if cause not in causes:
+                    yield self.finding(
+                        mod, sub,
+                        f"classifier {node.name} returns cause "
+                        f"{cause!r} which is not in TAIL_CAUSES",
+                        key=f"unknown-tail-cause:{cause}",
+                        func=node.name)
+
+    def _check_fleet_lockstep(self, mod, node, reg):
+        """``FLEET_TAIL_CAUSES`` is hand-copied in ``cluster.py`` (to
+        keep jax out of its import graph) — hold the copy to
+        ``failover_resubmit`` + exactly one ``kv_ship:<phase>`` per
+        ``MIGRATION_PHASES`` entry."""
+        phases = reg.get("migration_phase", set())
+        if not phases or len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name) \
+                or node.targets[0].id != "FLEET_TAIL_CAUSES" \
+                or not isinstance(node.value, (ast.Tuple, ast.List)):
+            return
+        entries = [e.value for e in node.value.elts
+                   if isinstance(e, ast.Constant)
+                   and isinstance(e.value, str)]
+        covered = set()
+        for entry in entries:
+            if entry == "failover_resubmit":
+                continue
+            if not entry.startswith("kv_ship:"):
+                yield self.finding(
+                    mod, node,
+                    f"FLEET_TAIL_CAUSES entry {entry!r} is neither "
+                    f"'failover_resubmit' nor a 'kv_ship:<phase>'",
+                    key=f"fleet-cause-shape:{entry}")
+                continue
+            phase = entry.split(":", 1)[1]
+            covered.add(phase)
+            if phase not in phases:
+                yield self.finding(
+                    mod, node,
+                    f"FLEET_TAIL_CAUSES names ship phase {phase!r} "
+                    f"which is not in kv_transport.MIGRATION_PHASES",
+                    key=f"fleet-cause-phase:{phase}")
+        for phase in sorted(phases - covered):
+            yield self.finding(
+                mod, node,
+                f"MIGRATION_PHASES entry {phase!r} has no "
+                f"'kv_ship:{phase}' in FLEET_TAIL_CAUSES — "
+                f"explain_tail could emit a cause the fleet registry "
+                f"does not declare",
+                key=f"fleet-cause-missing:{phase}")

@@ -203,6 +203,11 @@ class GenerationRequest:
     #: the slot's blocks are still allocated (an external export call
     #: would race the retirement free).
     export_kv: bool = False
+    #: distributed trace context (serving/types.TraceContext or its
+    #: dict form) — opaque to the engine except for the recorder stamp
+    #: at admission; preserved verbatim so one trace_id names this
+    #: request across every replica/restart hop it takes
+    trace_ctx: object | None = None
 
 
 @dataclasses.dataclass
@@ -846,6 +851,12 @@ class LLMEngine:
             #: (consumed), at any terminal finish (_finish_tokens), and
             #: at reset() — a supervised restart re-prefills instead.
             self._swap_store = {}
+            #: rid -> cumulative STITCH wall (s) of shipped-entry
+            #: restores (the migration's last phase, timed where it
+            #: actually runs — the decode replica's mixed step). The
+            #: router folds it into its per-migration phase breakdown;
+            #: entries drop with the rid's swap entry lifecycle.
+            self._stitch_s = {}
             #: swap/spill entries whose device→host copies were issued
             #: but not yet materialized to numpy — drained in the
             #: step_begin/step_finish gap (the copy overlaps the step's
@@ -1860,7 +1871,7 @@ class LLMEngine:
                     top_p=1.0, eos_token_id=None, request_id=None,
                     committed_tokens=None, readout_stride=None,
                     adapter_id=0, kind="generate", spec_ewma=None,
-                    export_kv=False):
+                    export_kv=False, trace_ctx=None):
         """``readout_stride``: per-request latency-tier pin — cap the
         multi-step decode stride of every all-decode step this request
         is active in (1 = sync the host every step; None = the engine
@@ -1954,7 +1965,15 @@ class LLMEngine:
             # same rid) — fresh requests start at the optimistic default
             spec_ewma=(float(spec_ewma) if spec_ewma is not None
                        else self._spec_ewma.get(rid)),
-            export_kv=bool(export_kv)))
+            export_kv=bool(export_kv), trace_ctx=trace_ctx))
+        if trace_ctx is not None:
+            rec = self._rec()
+            if rec is not None:
+                # direct-engine admissions stamp the timeline here; the
+                # server's submit() already stamped its own recorder
+                # (set_trace_ctx is idempotent for the same context)
+                rec.set_trace_ctx(rid, trace_ctx if isinstance(
+                    trace_ctx, dict) else trace_ctx.to_dict())
         return rid
 
     def has_unfinished(self):
@@ -2510,13 +2529,27 @@ class LLMEngine:
                 self.stats["kv_swap_in_bytes"] += got * \
                     self.kv_bytes_per_block()
             self.stats["kv_swap_saved_tokens"] += max(stitch - pos, 0)
-            self.stats["swap_in_time_s"] += time.perf_counter() - t0
+            restore_s = time.perf_counter() - t0
+            self.stats["swap_in_time_s"] += restore_s
+            if shipped:
+                # the migration's STITCH phase wall (alloc + H2D scatter
+                # + lens jump), keyed by rid for the router's migration
+                # phase breakdown (ReplicaRouter reads it after the
+                # decode leg resolves; bounded by _swap_store churn)
+                self._stitch_s[rid] = \
+                    self._stitch_s.get(rid, 0.0) + restore_s
             rec = self._rec()
             if rec is not None:
                 rec.req_event(rid,
                               "kv_shipped_in" if shipped else "swapped_in",
                               step_id=rec.next_step_id(),
                               value=max(stitch - pos, 0))
+                if shipped:
+                    # a dedicated stitch span so the merged cross-replica
+                    # trace shows the restore wall as its own sub-span
+                    rec.req_event(rid, "kv_stitch",
+                                  step_id=rec.next_step_id(),
+                                  value=round(restore_s, 6))
 
     def _spill_block(self, phys):
         """Demote an LRU-evicted registered block's content to the

@@ -20,7 +20,11 @@ from .timer import benchmark  # noqa: F401
 from .serving_telemetry import (  # noqa: F401
     LABELED_GAUGE_FAMILIES, LatencyHistogram, ServingTelemetry)
 from .flight_recorder import (  # noqa: F401
-    FlightRecorder, StepRecord, TAIL_CAUSES)
+    COUNTER_TRACKS, FLOW_EVENT_NAME, FlightRecorder, REQUEST_EVENT_KINDS,
+    StepRecord, TAIL_CAUSES)
+from .black_box import (  # noqa: F401
+    BlackBox, BUNDLE_SCHEMA, collect_bundle, TRIGGER_REASONS,
+    write_bundle)
 from .metrics_store import (  # noqa: F401
     Alert, ALERT_KINDS, MetricsStore, Series)
 from .slo import (  # noqa: F401
@@ -33,6 +37,9 @@ __all__ = [
     "SummaryView", "benchmark", "merge_profile",
     "ServingTelemetry", "LatencyHistogram", "LABELED_GAUGE_FAMILIES",
     "FlightRecorder", "StepRecord", "TAIL_CAUSES",
+    "REQUEST_EVENT_KINDS", "COUNTER_TRACKS", "FLOW_EVENT_NAME",
+    "BlackBox", "collect_bundle", "write_bundle", "BUNDLE_SCHEMA",
+    "TRIGGER_REASONS",
     "MetricsStore", "Series", "Alert", "ALERT_KINDS",
     "SLO", "SLOEngine", "default_detectors", "evaluate_slo",
     "format_slo_report",

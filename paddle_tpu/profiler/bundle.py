@@ -1,0 +1,170 @@
+"""Debug-bundle pretty-printer — ``python -m paddle_tpu.profiler.bundle``.
+
+Renders a black-box bundle (:mod:`paddle_tpu.profiler.black_box`) as a
+terminal postmortem: incident header, server/engine state, the worst
+inter-token gaps with their cause verdicts and trace ids, the alert
+log, and the last value of every metric series. Stdlib-only — a bundle
+scp'd off a dead replica reads anywhere Python runs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .black_box import BUNDLE_SCHEMA
+
+__all__ = ["load_bundle", "format_bundle", "main"]
+
+
+def load_bundle(path):
+    """Read + schema-check one bundle file; raises ValueError on a
+    file that is not a debug bundle (wrong/missing schema tag)."""
+    with open(path) as f:
+        bundle = json.load(f)
+    schema = bundle.get("schema") if isinstance(bundle, dict) else None
+    if schema != BUNDLE_SCHEMA:
+        raise ValueError(
+            f"{path}: not a debug bundle (schema={schema!r}, "
+            f"expected {BUNDLE_SCHEMA!r})")
+    return bundle
+
+
+def _fmt_labels(labels):
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) \
+        + "}"
+
+
+def format_bundle(bundle, max_gaps=10, max_series=24):
+    """The bundle as printable lines (list of str)."""
+    lines = []
+    add = lines.append
+    add(f"== debug bundle ({bundle['schema']}) ==")
+    add(f"reason: {bundle['reason']}"
+        + (f" — {bundle['detail']}" if bundle.get("detail") else ""))
+    add(f"pid: {bundle.get('pid')}   monotonic_t: "
+        f"{bundle.get('monotonic_t')}")
+    if bundle.get("truncated"):
+        add("NOTE: tails truncated to fit the byte bound")
+    srv = bundle.get("server")
+    if srv:
+        add("")
+        add(f"-- server (replica {srv.get('replica')}) --")
+        add(f"restarts: {srv.get('restarts')}   outstanding: "
+            f"{srv.get('outstanding')}   queue_depth: "
+            f"{srv.get('queue_depth')}")
+        health = srv.get("health") or {}
+        if isinstance(health, dict):
+            add("health: " + ", ".join(
+                f"{k}={health[k]}" for k in sorted(health)))
+    faults = bundle.get("faults")
+    if faults:
+        add("")
+        if isinstance(faults, dict):    # FaultInjector.snapshot() form
+            fired = faults.get("fired") or []
+            add(f"-- injected faults ({len(fired)} fired, "
+                f"{len(faults.get('pending') or [])} pending"
+                + (", HANGING" if faults.get("hanging") else "") + ") --")
+            for f in fired:
+                add(f"  {f}")
+        else:
+            add(f"-- injected faults ({len(faults)} fired) --")
+            for f in faults:
+                add(f"  {f}")
+    eng = bundle.get("engine")
+    if eng:
+        add("")
+        add("-- engine --")
+        cfg = {k: v for k, v in eng.items()
+               if k not in ("stats", "pool", "resident_rids", "waiting")}
+        add("config: " + ", ".join(f"{k}={cfg[k]}" for k in sorted(cfg)))
+        if "resident_rids" in eng:
+            add(f"resident: {eng['resident_rids']}   waiting: "
+                f"{eng.get('waiting')}")
+        pool = eng.get("pool")
+        if pool:
+            add("pool: " + ", ".join(
+                f"{k}={pool[k]}" for k in sorted(pool)))
+        stats = eng.get("stats")
+        if stats:
+            add("stats: " + ", ".join(
+                f"{k}={stats[k]}" for k in sorted(stats)))
+    fr = bundle.get("flight_recorder")
+    if fr:
+        add("")
+        add("-- flight recorder --")
+        snap = fr.get("snapshot") or {}
+        add(f"steps: {snap.get('steps_recorded')} retained / "
+            f"{snap.get('steps_total')} total   requests: "
+            f"{snap.get('requests_tracked')}")
+        causes = snap.get("tail_causes_p99")
+        if causes:
+            add("tail causes: " + ", ".join(
+                f"{k}={causes[k]}" for k in sorted(causes)))
+        tail = fr.get("explain_tail") or []
+        if tail:
+            add(f"worst gaps (top {min(len(tail), max_gaps)}):")
+            for e in tail[:max_gaps]:
+                tid = e.get("trace_id")
+                add(f"  req {e['request_id']}"
+                    + (f" [{tid}]" if tid else "")
+                    + f"  gap {e['gap_s'] * 1e3:.2f} ms"
+                    f"  step {e.get('step_id')}  cause {e['cause']}")
+        add(f"ring tail: {len(fr.get('ring_tail') or [])} StepRecords "
+            f"(see JSON for per-step facts)")
+    ms = bundle.get("metrics")
+    if ms:
+        add("")
+        add("-- metrics store --")
+        alerts = ms.get("alerts") or []
+        if alerts:
+            add(f"alerts ({len(alerts)}):")
+            for a in alerts:
+                state = "ACTIVE" if a.get("cleared_t") is None \
+                    else "cleared"
+                add(f"  [{state}] {a.get('kind')}"
+                    f"{_fmt_labels(a.get('labels'))}: {a.get('message')}")
+        series = ms.get("series") or []
+        if series:
+            add(f"series ({len(series)}, showing "
+                f"{min(len(series), max_series)}):")
+            for s in sorted(series,
+                            key=lambda s: (s.get("name"),
+                                           sorted((s.get("labels") or {})
+                                                  .items())))[:max_series]:
+                add(f"  {s.get('name')}{_fmt_labels(s.get('labels'))}: "
+                    f"last={s.get('last')} mean={s.get('mean')} "
+                    f"max={s.get('max')} "
+                    f"n={s.get('samples_total')}")
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.profiler.bundle",
+        description="Pretty-print a paddle_tpu debug bundle.")
+    ap.add_argument("path", nargs="+", help="bundle JSON file(s)")
+    ap.add_argument("--gaps", type=int, default=10,
+                    help="worst inter-token gaps to show (default 10)")
+    ap.add_argument("--series", type=int, default=24,
+                    help="metric series to show (default 24)")
+    args = ap.parse_args(argv)
+    status = 0
+    for i, path in enumerate(args.path):
+        if i:
+            print()
+        try:
+            bundle = load_bundle(path)
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            status = 1
+            continue
+        print("\n".join(format_bundle(
+            bundle, max_gaps=args.gaps, max_series=args.series)))
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main())

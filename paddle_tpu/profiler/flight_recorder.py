@@ -42,7 +42,8 @@ import os
 import threading
 import time
 
-__all__ = ["StepRecord", "FlightRecorder", "TAIL_CAUSES"]
+__all__ = ["StepRecord", "FlightRecorder", "TAIL_CAUSES",
+           "REQUEST_EVENT_KINDS", "COUNTER_TRACKS", "FLOW_EVENT_NAME"]
 
 #: the cause labels explain_tail may assign, in priority order.
 #: "restart_recovery" outranks everything: the gap spans a supervised
@@ -83,6 +84,41 @@ TAIL_CAUSES = ("restart_recovery", "preempt_swap", "preempt_reprefill",
                "adapter_swap", "kv_ship",
                "interfering_prefill", "draft_rejected", "batched_readout",
                "host_sync", "idle_bubble", "dispatch", "unrecorded")
+
+#: every request-timeline event KIND the tree may record (the literal
+#: second argument of :meth:`FlightRecorder.req_event`, plus the
+#: "token" events :meth:`FlightRecorder.on_token` appends). STRICT
+#: schema, like the telemetry names and alert kinds: the PTL008
+#: analysis pass (``paddle_tpu.analysis.trace_names``) checks every
+#: ``req_event`` call site's kind literal against this tuple, so a
+#: typo'd span name fails lint instead of silently opening a phantom
+#: lane in the chrome export.
+REQUEST_EVENT_KINDS = (
+    "queued",          # server admission-queue entry (restarts timeline)
+    "routed",          # the ReplicaRouter's placement record
+    "admitted",        # engine slot admission
+    "prefill",         # one prefill chunk (value = token count)
+    "cached_prefix",   # prompt tokens served from the prefix cache
+    "token",           # one emitted token (value = inter-token gap)
+    "kv_shipped_in",   # cross-replica shipped KV restored into a slot
+    "kv_stitch",       # the shipped restore's stitch wall (value = s)
+    "swapped_in",      # host-tier preemption swap restored into a slot
+    "crashed",         # supervised serving loop crashed under this req
+    "resumed",         # supervised restart re-admitted this request
+    "finish",          # terminal (value = finish reason)
+)
+
+#: the Perfetto counter tracks ("ph":"C") the chrome export emits —
+#: one line chart per name under the request lanes. PTL008 checks
+#: counter-event name literals against this tuple.
+COUNTER_TRACKS = ("queue_depth", "token_budget_utilization",
+                  "kv_pool_occupancy", "spec_acceptance_rate")
+
+#: the name every cross-replica Perfetto flow event ("ph":"s"/"f")
+#: carries — ``ReplicaRouter.export_merged_trace`` links a request's
+#: per-hop lanes with s→f pairs under this one name (flow events match
+#: on (name, cat, id), so the name IS schema).
+FLOW_EVENT_NAME = "trace_flow"
 
 
 @dataclasses.dataclass
@@ -208,7 +244,7 @@ _EVENT_FIELDS = ("kind", "t", "step_id", "value")
 
 class _RequestTrace:
     __slots__ = ("request_id", "events", "last_token_t", "prefix_hit",
-                 "routing")
+                 "routing", "trace_ctx")
 
     def __init__(self, request_id):
         self.request_id = request_id
@@ -221,11 +257,19 @@ class _RequestTrace:
         #: the placement metadata a "routed" event carried (the replica
         #: router's decision) — explain_tail surfaces it on tail entries
         self.routing = None
+        #: the distributed trace context this timeline ran under (dict:
+        #: trace_id/hop/parent/via) — the cross-replica join key the
+        #: merged-trace stitcher and the router's fleet explain_tail
+        #: group per-hop timelines by
+        self.trace_ctx = None
 
     def to_dict(self):
-        return {"request_id": self.request_id,
-                "events": [dict(zip(_EVENT_FIELDS, e))
-                           for e in self.events]}
+        d = {"request_id": self.request_id,
+             "events": [dict(zip(_EVENT_FIELDS, e))
+                        for e in self.events]}
+        if self.trace_ctx is not None:
+            d["trace_ctx"] = dict(self.trace_ctx)
+        return d
 
 
 class FlightRecorder:
@@ -408,6 +452,18 @@ class FlightRecorder:
                 while len(self._done) > self.max_requests:
                     self._done.popitem(last=False)
 
+    def set_trace_ctx(self, rid, ctx):
+        """Stamp request ``rid``'s timeline with its distributed trace
+        context (a TraceContext or its dict form). Called once per
+        timeline, right after the "queued" event starts it — the stamp
+        is what lets the merged cross-replica export group this lane
+        with the same request's lanes on OTHER replicas."""
+        if not self.enabled or ctx is None:
+            return
+        d = ctx if isinstance(ctx, dict) else ctx.to_dict()
+        with self._lock:
+            self._trace(rid).trace_ctx = dict(d)
+
     def on_token(self, rid, step_id, t=None):
         """Record one emitted token: its wall time, the id of the step
         whose readout produced it, and the gap since the request's
@@ -514,9 +570,12 @@ class FlightRecorder:
                          else f"engine steps (pipelined +{lane})"}})
         for rid, tl in sorted(self.timelines().items()):
             tid = 100 + int(rid)  # tids < 100 are engine sub-lanes
+            tc = tl.get("trace_ctx")
+            lane = f"req {rid}" if tc is None else \
+                f"req {rid} [{tc['trace_id']}/{tc['hop']}]"
             events.append({"ph": "M", "pid": pid, "tid": tid,
                            "name": "thread_name",
-                           "args": {"name": f"req {rid}"}})
+                           "args": {"name": lane}})
             prev_t = None
             for ev in tl["events"]:
                 t_us = ev["t"] * 1e6
@@ -535,6 +594,13 @@ class FlightRecorder:
                     args["gap_ms"] = round(ev["value"] * 1e3, 3)
                 if ev["kind"] == "routed" and isinstance(ev["value"], dict):
                     args["routing"] = ev["value"]
+                if tc is not None:
+                    # every request-lane span carries its trace identity
+                    # so the merged-trace stitcher can group lanes by
+                    # trace_id WITHOUT re-reading recorder state (the
+                    # merged file is all it has)
+                    args["trace_id"] = tc["trace_id"]
+                    args["trace_hop"] = tc["hop"]
                 events.append({
                     "ph": "X", "cat": "request", "pid": pid, "tid": tid,
                     "name": name, "ts": start,
@@ -614,6 +680,9 @@ class FlightRecorder:
             with self._lock:
                 tr = self._live.get(rid) or self._done.get(rid)
                 routing = tr.routing if tr is not None else None
+                trace_ctx = tr.trace_ctx if tr is not None else None
+            if trace_ctx is not None:
+                entry["trace_id"] = trace_ctx["trace_id"]
             if routing is not None:
                 # the router's placement record for THIS request — which
                 # replica/score/affinity put the slow token where it ran
@@ -652,6 +721,16 @@ class FlightRecorder:
                         if hits else rec.prefix_hit_tokens == 0
             out.append(entry)
         return out
+
+    def classify_token_gap(self, rid, step_id, gap_s):
+        """Classify ONE inter-token gap against its causal StepRecord —
+        the single-gap form of :meth:`explain_tail`, for callers (the
+        router's fleet-level tail join) that assemble END-TO-END gap
+        lists across recorders and only need this recorder's verdict
+        for a gap that stayed inside it. Returns ``(cause, record)``
+        with record None when the ring evicted the step."""
+        rec = self.get_step(step_id) if step_id is not None else None
+        return self._classify(gap_s, rec), rec
 
     @staticmethod
     def _classify(gap, rec):

@@ -49,6 +49,7 @@ highest score wins; ties break toward the lower replica index.
 from __future__ import annotations
 
 import collections
+import json
 import os
 import threading
 import time
@@ -56,10 +57,22 @@ import time
 import numpy as np
 
 from ..analysis import lock_watchdog as _lockwatch
-from .types import ServeResult, ServerClosed, ServerQueueFull
+from .types import (ServeResult, ServerClosed, ServerQueueFull,
+                    TraceContext)
 
 __all__ = ["ReplicaRouter", "RouterHandle", "tp_serving_mesh",
-           "shard_model_tp", "tp_engine"]
+           "shard_model_tp", "tp_engine", "FLEET_TAIL_CAUSES"]
+
+#: every cause :meth:`ReplicaRouter.explain_tail` can name BEYOND the
+#: per-replica :data:`~paddle_tpu.profiler.flight_recorder.TAIL_CAUSES`
+#: taxonomy: a cross-replica boundary gap is either the migration
+#: itself (``kv_ship:{phase}``, phase the dominant entry of
+#: ``kv_transport.MIGRATION_PHASES`` — kept in lockstep by test +
+#: PTL008) or a failover resubmission's re-prefill window. STRICT
+#: registry, like TAIL_CAUSES/ALERT_KINDS.
+FLEET_TAIL_CAUSES = ("failover_resubmit", "kv_ship:serialize",
+                     "kv_ship:transport", "kv_ship:import",
+                     "kv_ship:place", "kv_ship:stitch")
 
 
 # ---------------------------------------------------------------------------
@@ -396,6 +409,18 @@ class ReplicaRouter:
         #: re-admission granted), observed per successful ship
         from ..profiler.serving_telemetry import LatencyHistogram
         self.migration_latency = LatencyHistogram()
+        #: the same latency DECOMPOSED per kv_transport.MIGRATION_PHASES
+        #: name — serialize/transport/import timed inside the
+        #: transport's ship(), place around the decode-side placement,
+        #: stitch read back from the destination engine's fenced
+        #: restore. One histogram per phase; snapshot() surfaces them
+        #: next to migration_latency.
+        self.migration_phases = {}
+        #: per-migration records (trace_id, rid, src→dst, perf_counter
+        #: t0/t1, phase seconds, wire bytes) — bounded; feeds the merged
+        #: trace's router lane and explain_tail's boundary-gap
+        #: attribution
+        self._migrations = collections.deque(maxlen=256)
         self.affinity_weight = float(affinity_weight)
         #: adapter-affinity bonus (multi-tenant serving): a replica
         #: whose adapter device cache already HOLDS the request's
@@ -662,7 +687,12 @@ class ReplicaRouter:
                       temperature=temperature, top_p=top_p,
                       eos_token_id=eos_token_id, deadline_s=deadline_s,
                       readout_stride=readout_stride,
-                      adapter_id=adapter_id, kind=kind)
+                      adapter_id=adapter_id, kind=kind,
+                      # fleet-entry trace mint: rides _kwargs so EVERY
+                      # resubmission hop (ship / failover / queue retry)
+                      # carries the same trace_id; the hop-bump sites
+                      # replace it with child contexts
+                      trace_ctx=TraceContext.mint("router"))
         handle = RouterHandle(self, ids, kwargs, routing_key)
         if self.roles is not None and kind == "generate" and \
                 int(max_new_tokens) > 1:
@@ -812,6 +842,15 @@ class ReplicaRouter:
             return len(self._outstanding)
 
     # -- failover / resolution -------------------------------------------
+    def _bump_trace(self, handle, via):
+        """Advance the handle's trace context one hop: same trace_id,
+        hop+1, parented on the previous hop's span — called once per
+        resubmission EPISODE (ship, failover, first queue-full park),
+        never per retry tick, so hop counts hops, not backoff spins."""
+        tc = TraceContext.coerce(handle._kwargs.get("trace_ctx"))
+        if tc is not None:
+            handle._kwargs["trace_ctx"] = tc.child(via)
+
     def _done_with(self, handle):
         """Drop a handle from the outstanding set + the per-replica
         placement count (CALLER HOLDS self._lock)."""
@@ -972,7 +1011,8 @@ class ReplicaRouter:
                     emitted = list(handle._streamed)
                 handle._finish(ServeResult(
                     res.request_id, emitted + pending,
-                    "replica_lost", True, routing=inner.request.routing))
+                    "replica_lost", True, routing=inner.request.routing,
+                    trace_ctx=res.trace_ctx or inner.request.trace_ctx))
             else:
                 handle._finish(res)
             return
@@ -999,6 +1039,10 @@ class ReplicaRouter:
                 handle._kwargs["spec_ewma"] = ewma
         # resubmit to a survivor (placement excludes the dead/hung/
         # draining replica via healthy()/draining checks)
+        if handle._retry_since is None:
+            # first attempt of this failover episode — parked queue-full
+            # retries keep the already-bumped context
+            self._bump_trace(handle, "failover")
         handle._last_try = now
         err = self._try_place(handle, handle.prompt_ids, resubmit=True)
         if err is None:
@@ -1018,6 +1062,7 @@ class ReplicaRouter:
             # convert a momentarily full queue into request loss.
             if handle._retry_since is None:
                 handle._retry_since = now
+                self._bump_trace(handle, "queue_retry")
             if now - handle._retry_since < self.failover_retry_s:
                 handle._retry_delay = min(handle._retry_delay * 2.0,
                                           self.max_retry_backoff_s)
@@ -1032,7 +1077,8 @@ class ReplicaRouter:
             # emitted stream (resume prefix included); a failed drain
             # migration only ever handed out what the caller consumed
             list(res.token_ids) if lost else list(handle._streamed),
-            "replica_lost", True, routing=inner.request.routing))
+            "replica_lost", True, routing=inner.request.routing,
+            trace_ctx=res.trace_ctx or inner.request.trace_ctx))
 
     def _ship_and_resubmit(self, handle, inner, res):
         """The prefill-complete hook (disaggregated serving): export
@@ -1059,8 +1105,14 @@ class ReplicaRouter:
             handle._replica = None
         t0 = time.perf_counter()
         d = handle._disagg
+        if not d.get("shipping"):
+            # first ship attempt of this migration (parked retries keep
+            # the already-bumped context): the decode leg is hop+1
+            self._bump_trace(handle, "kv_ship")
         d["shipping"] = True         # role flips to "decode" from here
         src = inner._server
+        src_idx = next((i for i, s in enumerate(self.replicas)
+                        if s is src), None)
         rid = inner.request_id
         # freeze the leg's stream: undelivered tokens move to the
         # router-level carry (the decode replica treats the WHOLE leg
@@ -1080,7 +1132,13 @@ class ReplicaRouter:
         handle._kwargs["request_id"] = rid
         if "entry" not in d:
             try:
+                te0 = time.perf_counter()
                 d["entry"] = src.engine.export_kv(rid)
+                # the source-side export is part of the migration's
+                # serialize cost (gathering the KV into the staged
+                # entry) — folded into the serialize phase below so the
+                # phase sub-spans account for the latency window
+                d["export_s"] = time.perf_counter() - te0
             except Exception:
                 d["entry"] = None
         entry = d["entry"]
@@ -1092,18 +1150,30 @@ class ReplicaRouter:
                             role="decode")
         shipped = False
         err = ServerClosed("no replica alive")
+        phases, nbytes, dst_idx = {}, 0, None
         for idx, _score, _aff, _ahit in ranked:
             dst = self.replicas[idx]
             shipped = False
+            phases, nbytes, dst_idx = {}, 0, idx
             if entry is not None and self.transport is not None:
                 try:
-                    self.transport.ship(entry, dst.engine)
+                    # the transport times its own phases (serialize/
+                    # transport/import) and returns them per call, so
+                    # concurrent ships can't clobber each other
+                    nbytes, tphases = self.transport.ship(
+                        entry, dst.engine)
                     shipped = True
+                    phases = dict(tphases or {})
+                    if "serialize" in phases:
+                        phases["serialize"] += d.get("export_s", 0.0)
                 except Exception:
                     shipped = False
+            tp0 = time.perf_counter()
             err = self._try_place(handle, handle.prompt_ids, pin=idx,
                                   resubmit=True)
             if err is None:
+                if shipped:
+                    phases["place"] = time.perf_counter() - tp0
                 break
             if shipped:
                 # placement failed AFTER the import landed: pop the
@@ -1120,7 +1190,23 @@ class ReplicaRouter:
             handle._retry_since = None
             handle._retry_delay = self.poll_interval_s
             handle._last_try = None
-            self.migration_latency.observe(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            self.migration_latency.observe(t1 - t0)
+            if shipped:
+                for p, v in phases.items():
+                    self._observe_phase(p, v)
+                tc = TraceContext.coerce(
+                    handle._kwargs.get("trace_ctx"))
+                with self._lock:
+                    # stitch is timed DESTINATION-side (the fenced
+                    # restore at re-admission, after this returns) —
+                    # _finalize_migrations reads it back off the decode
+                    # engine before anyone consumes the record
+                    self._migrations.append({
+                        "trace_id": tc.trace_id if tc else None,
+                        "rid": rid, "src": src_idx,
+                        "dst": dst_idx, "t0": t0, "t1": t1,
+                        "phases": phases, "bytes": int(nbytes)})
             with self._lock:
                 self.stats["resubmitted"] += 1
                 if shipped:
@@ -1134,6 +1220,7 @@ class ReplicaRouter:
             # the monitor, exactly like a failover resubmission
             if handle._retry_since is None:
                 handle._retry_since = now
+                self._bump_trace(handle, "queue_retry")
             if now - handle._retry_since < self.failover_retry_s:
                 handle._last_try = now
                 handle._retry_delay = min(handle._retry_delay * 2.0,
@@ -1147,7 +1234,35 @@ class ReplicaRouter:
             self.stats["kv_ship_fallback"] += 1
         handle._finish(ServeResult(
             res.request_id, list(res.token_ids), "replica_lost", True,
-            routing=inner.request.routing))
+            routing=inner.request.routing,
+            trace_ctx=res.trace_ctx or inner.request.trace_ctx))
+
+    # -- migration phase bookkeeping -------------------------------------
+    def _observe_phase(self, phase, seconds):
+        """Book one migration phase observation (histograms created on
+        first use, keyed by kv_transport.MIGRATION_PHASES names)."""
+        from ..profiler.serving_telemetry import LatencyHistogram
+        h = self.migration_phases.get(phase)
+        if h is None:
+            h = self.migration_phases[phase] = LatencyHistogram()
+        h.observe(seconds)
+
+    def _finalize_migrations(self):
+        """Fill in each migration record's destination-side ``stitch``
+        wall — timed by the decode engine's fenced restore AFTER the
+        ship returned, so it's read back lazily here — and book it,
+        once, into the phase histograms. Returns the records, oldest
+        first."""
+        with self._lock:
+            migs = list(self._migrations)
+        for m in migs:
+            if "stitch" not in m["phases"] and m["dst"] is not None:
+                eng = self.replicas[m["dst"]].engine
+                s = getattr(eng, "_stitch_s", {}).get(m["rid"])
+                if s is not None:
+                    m["phases"]["stitch"] = s
+                    self._observe_phase("stitch", s)
+        return migs
 
     # -- drain -----------------------------------------------------------
     def drain(self, idx, timeout=30.0):
@@ -1188,7 +1303,12 @@ class ReplicaRouter:
                    "draining": sorted(self._draining)}
         if self.roles is not None:
             out["roles"] = {k: list(v) for k, v in self.roles.items()}
+        migs = self._finalize_migrations()
         out["migration_latency"] = self.migration_latency.snapshot()
+        out["migration_phases"] = {
+            p: h.snapshot()
+            for p, h in sorted(self.migration_phases.items())}
+        out["migrations_recorded"] = len(migs)
         if self.transport is not None:
             out["transport"] = {
                 "ship_count": getattr(self.transport, "ship_count", 0),
@@ -1334,10 +1454,29 @@ class ReplicaRouter:
         """Merge every recorder-equipped replica's chrome trace into one
         Perfetto-loadable timeline — one process lane group per replica
         (rides :func:`paddle_tpu.profiler.merge_profile`, the same
-        cross-rank merge training traces use)."""
+        cross-rank merge training traces use) — then STITCH it:
+
+        * every request whose spans landed on more than one (pid, tid)
+          lane — a shipped decode leg, a failover resubmission — gets
+          Perfetto FLOW events (``"ph":"s"`` → ``"ph":"f"``, matched on
+          name+cat+id under
+          :data:`~paddle_tpu.profiler.flight_recorder.FLOW_EVENT_NAME`)
+          chaining its lanes in time order, so Perfetto renders the
+          migrated request as ONE connected arrow-linked chain across
+          replica pids;
+        * each recorded migration renders its router-side phase spans
+          (``kv_ship:serialize/transport/import/place``, timed where
+          they ran) on a dedicated ``router:migrations`` process lane —
+          the destination engine's ``kv_stitch`` span completes the
+          decomposition on the decode replica's own lane.
+
+        All replicas share this process's perf_counter clock, so
+        cross-replica ordering is real — no alignment applied."""
         import tempfile
 
         from ..profiler import merge_profile
+        from ..profiler.flight_recorder import FLOW_EVENT_NAME
+        from .kv_transport import MIGRATION_PHASES
 
         with tempfile.TemporaryDirectory(
                 prefix="paddle_tpu_cluster_trace_") as tmpd:
@@ -1354,4 +1493,183 @@ class ReplicaRouter:
                     "(AsyncLLMServer(flight_recorder=True))")
             # same process, same perf_counter clock: keep it (align
             # would destroy cross-replica simultaneity)
-            return merge_profile(files, path, align_start=False)
+            merge_profile(files, path, align_start=False)
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc["traceEvents"]
+        # -- flow stitching: request lanes grouped by trace_id ----------
+        lanes = {}       # trace_id -> {(pid, tid): (min_ts, max_end)}
+        for ev in events:
+            if ev.get("ph") != "X" or ev.get("cat") != "request":
+                continue
+            trace_id = (ev.get("args") or {}).get("trace_id")
+            if trace_id is None:
+                continue
+            key = (ev["pid"], ev["tid"])
+            lane = lanes.setdefault(trace_id, {})
+            lo, hi = lane.get(key, (float("inf"), float("-inf")))
+            lane[key] = (min(lo, ev["ts"]),
+                         max(hi, ev["ts"] + ev.get("dur", 0.0)))
+        flow_id = 0
+        for trace_id in sorted(lanes):
+            lane = lanes[trace_id]
+            if len(lane) < 2:
+                continue
+            ordered = sorted(lane.items(), key=lambda kv: kv[1][0])
+            for (ka, (_lo_a, hi_a)), (kb, (lo_b, _hi_b)) in zip(
+                    ordered, ordered[1:]):
+                flow_id += 1
+                common = {"cat": "trace", "name": FLOW_EVENT_NAME,
+                          "id": flow_id,
+                          "args": {"trace_id": trace_id}}
+                events.append({"ph": "s", "pid": ka[0], "tid": ka[1],
+                               # the arrow leaves the earlier lane's
+                               # last span and lands on the later
+                               # lane's first — clamped so s <= f even
+                               # when the lanes overlap in time
+                               "ts": min(hi_a, lo_b), **common})
+                events.append({"ph": "f", "bp": "e", "pid": kb[0],
+                               "tid": kb[1], "ts": lo_b, **common})
+        # -- the router's migration phase lane --------------------------
+        migs = self._finalize_migrations()
+        if migs:
+            rpid = len(files)       # one past the last replica rank
+            events.append({"ph": "M", "pid": rpid, "tid": 0,
+                           "name": "process_name",
+                           "args": {"name": "router:migrations"}})
+            for m in migs:
+                tid = 100 + int(m["rid"] or 0)
+                events.append({"ph": "M", "pid": rpid, "tid": tid,
+                               "name": "thread_name",
+                               "args": {"name": f"migration rid "
+                                                f"{m['rid']}"}})
+                ts = m["t0"] * 1e6
+                for p in MIGRATION_PHASES:
+                    v = m["phases"].get(p)
+                    if v is None or p == "stitch":
+                        continue    # stitch renders on the decode lane
+                    dur = max(v * 1e6, 1.0)
+                    events.append({
+                        "ph": "X", "cat": "migration", "pid": rpid,
+                        "tid": tid, "name": f"kv_ship:{p}", "ts": ts,
+                        "dur": dur,
+                        "args": {"trace_id": m["trace_id"],
+                                 "request_id": m["rid"],
+                                 "src": m["src"], "dst": m["dst"],
+                                 "bytes": m["bytes"]}})
+                    ts += dur
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    def explain_tail(self, quantile=0.99, top=None):
+        """The FLEET-level slow-token explainer: join every replica
+        recorder's request timelines by ``trace_id`` into one
+        END-TO-END token stream per request — across KV ships,
+        failovers, and restarts — and classify the worst inter-token
+        gaps. A gap that stayed inside one replica gets that replica
+        recorder's own :data:`~paddle_tpu.profiler.flight_recorder
+        .TAIL_CAUSES` verdict (via ``classify_token_gap``); a gap
+        spanning a replica boundary is attributed to the migration
+        itself (``kv_ship:{phase}``, phase = the recorded migration's
+        dominant phase — :data:`FLEET_TAIL_CAUSES`) when one covers it,
+        else to the failover resubmission's re-prefill window
+        (``failover_resubmit``). Entries carry ``trace_id``,
+        ``request_id``/``replica`` of the LATER token, ``gap_s``,
+        ``step_id``, ``cause``, and the migration's phase seconds when
+        the cause is a ship phase."""
+        migs = self._finalize_migrations()
+        by_trace = {}
+        for m in migs:
+            if m["trace_id"] is not None:
+                by_trace.setdefault(m["trace_id"], []).append(m)
+        streams = {}
+        for i, srv in enumerate(self.replicas):
+            rec = srv.flight_recorder
+            if rec is None:
+                continue
+            for rid, tl in rec.timelines().items():
+                tc = tl.get("trace_ctx")
+                key = tc["trace_id"] if tc else (i, rid)
+                st = streams.setdefault(
+                    key, {"trace_id": tc["trace_id"] if tc else None,
+                          "tokens": [], "crashes": []})
+                for ev in tl["events"]:
+                    if ev["kind"] == "token":
+                        st["tokens"].append(
+                            (ev["t"], i, rid, ev["step_id"]))
+                    elif ev["kind"] == "crashed":
+                        st["crashes"].append(ev["t"])
+        gaps = []
+        for key, st in streams.items():
+            toks = sorted(st["tokens"])
+            for (t0, i0, _r0, _s0), (t1, i1, r1, s1) in zip(
+                    toks, toks[1:]):
+                gaps.append((t1 - t0, t0, t1, i0, i1, r1, s1, key, st))
+        if not gaps:
+            return []
+        ordered = sorted(g[0] for g in gaps)
+        thresh = ordered[min(int(quantile * len(ordered)),
+                             len(ordered) - 1)]
+        tail = sorted((g for g in gaps if g[0] >= thresh),
+                      key=lambda g: -g[0])
+        if top is not None:
+            tail = tail[:top]
+        out = []
+        for gap, t0, t1, i0, i1, rid, sid, key, st in tail:
+            entry = {"request_id": rid, "replica": i1,
+                     "gap_s": round(gap, 6), "step_id": sid}
+            if st["trace_id"] is not None:
+                entry["trace_id"] = st["trace_id"]
+            if i0 != i1:
+                # the stream moved replicas inside this gap: either the
+                # recorded migration explains it phase-by-phase, or it
+                # was a failover's re-prefill window
+                mig = next((m for m in by_trace.get(st["trace_id"], ())
+                            if t0 <= m["t1"] and m["t0"] <= t1), None)
+                if mig is not None and mig["phases"]:
+                    phases = mig["phases"]
+                    dom = max(phases, key=phases.get)
+                    entry["cause"] = f"kv_ship:{dom}"
+                    entry["migration"] = {
+                        "src": mig["src"], "dst": mig["dst"],
+                        "bytes": mig["bytes"],
+                        "phases": {p: round(v, 6)
+                                   for p, v in sorted(phases.items())}}
+                else:
+                    entry["cause"] = "failover_resubmit"
+            elif any(t0 < ct <= t1 for ct in st["crashes"]):
+                entry["cause"] = "restart_recovery"
+            else:
+                rec = self.replicas[i1].flight_recorder
+                cause, _step = rec.classify_token_gap(rid, sid, gap)
+                entry["cause"] = cause
+            out.append(entry)
+        return out
+
+    def dump_debug_bundle(self, out_dir, reason="manual", detail=None):
+        """Fleet postmortem under ``out_dir``: one black-box debug
+        bundle PER replica (``replica{i}.json``), the merged stitched
+        cross-replica trace (``merged_trace.json``, when any replica
+        has a recorder), and the router's own view (``router.json``:
+        snapshot + fleet explain_tail). Returns the path dict."""
+        from ..profiler.black_box import collect_bundle, write_bundle
+        os.makedirs(out_dir, exist_ok=True)
+        paths = {"replicas": []}
+        for i, srv in enumerate(self.replicas):
+            p = os.path.join(out_dir, f"replica{i}.json")
+            paths["replicas"].append(write_bundle(
+                collect_bundle(server=srv, reason=reason,
+                               detail=detail), p))
+        if any(srv.flight_recorder is not None
+               for srv in self.replicas):
+            paths["trace"] = self.export_merged_trace(
+                os.path.join(out_dir, "merged_trace.json"))
+        rp = os.path.join(out_dir, "router.json")
+        with open(rp, "w") as f:
+            json.dump({"schema": "paddle_tpu.router_postmortem/v1",
+                       "snapshot": self.snapshot(),
+                       "explain_tail": self.explain_tail(0.0, top=16)},
+                      f, sort_keys=True, indent=1, default=str)
+        paths["router"] = rp
+        return paths

@@ -186,6 +186,17 @@ class FaultInjector:
         with self._lock:
             return self._step
 
+    def snapshot(self):
+        """JSON-ready schedule state — what fired (with its step) and
+        what's still pending. Rides the black-box debug bundle so a
+        chaos run's postmortem is self-describing."""
+        with self._lock:
+            return {"step": self._step,
+                    "fired": [list(f) for f in self.fired],
+                    "pending": [a.kind for a in self._actions],
+                    "burst_pending": self._burst,
+                    "hanging": self.hanging}
+
     # -- hook side -------------------------------------------------------
     def _record(self, kind, step, detail):
         self.fired.append((kind, step, detail))

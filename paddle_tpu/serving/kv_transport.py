@@ -30,15 +30,27 @@ same two functions.
 """
 import json
 import struct
+import time
 
 import jax
 import numpy as np
 
 __all__ = ["KVTransport", "InProcessTransport", "serialize_entry",
-           "deserialize_entry", "TransportError"]
+           "deserialize_entry", "TransportError", "MIGRATION_PHASES"]
 
 _MAGIC = b"PTKV"
 _VERSION = 1
+
+#: the phases a prefill→decode migration decomposes into, in causal
+#: order. The first three are timed inside :meth:`KVTransport.ship`
+#: (returned per call); "place" is the router's decode-side resubmission
+#: (``_try_place``), "stitch" the destination engine's fenced restore
+#: (``_try_swap_restores`` on a shipped entry). The router books one
+#: ``migration_phases[phase]`` histogram per entry, explain_tail
+#: attributes migration-dominated gaps as ``kv_ship:{phase}``, and the
+#: PTL008 analysis pass checks phase literals against this tuple.
+MIGRATION_PHASES = ("serialize", "transport", "import", "place",
+                    "stitch")
 
 
 class TransportError(RuntimeError):
@@ -182,10 +194,14 @@ class KVTransport:
     """Bytes-on-wire transport interface for staged KV entries.
 
     ``ship(entry, dst_engine)`` moves ONE staged entry to the
-    destination engine and returns the wire byte count. Implementations
-    own the wire (loopback now; RDMA/ICI later keep this exact
-    signature — serialize on the source, move bytes, deserialize
-    against the destination's treedefs, ``dst_engine.import_kv``).
+    destination engine and returns ``(wire_bytes, phases)`` where
+    ``phases`` maps the transport-side :data:`MIGRATION_PHASES` names
+    (serialize/transport/import) to seconds for THIS ship — returned
+    per call, never stashed on the transport, so concurrent ships
+    cannot clobber each other's timings. Implementations own the wire
+    (loopback now; RDMA/ICI later keep this exact signature —
+    serialize on the source, move bytes, deserialize against the
+    destination's treedefs, ``dst_engine.import_kv``).
     Raise :class:`TransportError` (or return False from import) and the
     router falls back to re-prefill — shipping is an optimization, never
     a correctness dependency."""
@@ -206,7 +222,14 @@ class InProcessTransport(KVTransport):
     tier-1 CPU tests exercise byte-level round-tripping — including
     int8/int4 ``(payload, scale)`` leaf pairs — on every ship. Keeps
     simple counters (``ship_count``, ``ship_bytes``, ``fail_count``)
-    the router folds into its snapshot."""
+    the router folds into its snapshot, and times each ship's
+    serialize / transport / import phases (seconds per
+    :data:`MIGRATION_PHASES` name) into the ``phases`` dict it returns
+    alongside the byte count — the router books them into its
+    per-phase migration histograms and trace spans. Loopback has no
+    wire, so "transport" here is the decode-side deserialization; a
+    real RDMA/ICI transport would time its send/recv under the same
+    key."""
 
     def __init__(self):
         self.ship_count = 0
@@ -214,10 +237,17 @@ class InProcessTransport(KVTransport):
         self.fail_count = 0
 
     def ship(self, entry, dst_engine):
+        phases = {}
         try:
+            t0 = time.perf_counter()
             wire = serialize_entry(entry)
+            t1 = time.perf_counter()
+            phases["serialize"] = t1 - t0
             staged = deserialize_entry(wire, _engine_treedefs(dst_engine))
+            t2 = time.perf_counter()
+            phases["transport"] = t2 - t1
             ok = dst_engine.import_kv(staged)
+            phases["import"] = time.perf_counter() - t2
         except (TransportError, KeyError, ValueError) as e:
             self.fail_count += 1
             raise TransportError(str(e))
@@ -227,7 +257,7 @@ class InProcessTransport(KVTransport):
                                  "(pool geometry/validation)")
         self.ship_count += 1
         self.ship_bytes += len(wire)
-        return len(wire)
+        return len(wire), phases
 
     def ship_prefix_blocks(self, entries, dst_engine):
         total = 0

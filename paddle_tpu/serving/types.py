@@ -13,11 +13,81 @@ import dataclasses
 import enum
 import threading
 import time
+import uuid
 
 import numpy as np
 
 __all__ = ["RequestState", "ServeRequest", "ServeResult", "RequestHandle",
-           "ServerQueueFull", "ServerClosed"]
+           "ServerQueueFull", "ServerClosed", "TraceContext",
+           "TRACE_HOP_KINDS"]
+
+#: every way a trace context may arrive at (or move between) serving
+#: hops — the ``via`` vocabulary :meth:`TraceContext.child` accepts.
+#: "submit"/"router" name the two mint sites; the rest name the hop
+#: that RE-submitted the request somewhere else: a finished prefill
+#: leg's KV ship, a replica-loss failover resubmission, a supervised
+#: restart's re-admission, a queue-full park + retry. The PTL008
+#: analysis pass (``paddle_tpu.analysis.trace_names``) checks hop
+#: literals against this tuple.
+TRACE_HOP_KINDS = ("submit", "router", "kv_ship", "failover", "restart",
+                   "queue_retry")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One request's distributed trace identity — the Dapper-style
+    (trace_id, hop) pair that survives every hop a request can take
+    across the serving fleet (replica → KV ship → replica, failover
+    resubmission, supervised-restart re-admission, queue-full retry),
+    so ONE id names the request everywhere it ran.
+
+    Immutable: a hop never mutates the context it received — it mints a
+    :meth:`child` whose ``parent`` is the previous hop's span id, so
+    the hop chain reconstructs from any single context. Minted at
+    ``ReplicaRouter.submit`` (fleet entry) or ``AsyncLLMServer.submit``
+    (single-server entry) when the caller didn't supply one."""
+
+    trace_id: str                 # 16 hex chars, fleet-unique
+    hop: int = 0                  # 0 at mint; +1 per resubmission hop
+    parent: str | None = None     # previous hop's span_id (None at mint)
+    via: str = "submit"           # TRACE_HOP_KINDS entry that made this hop
+
+    @property
+    def span_id(self):
+        """This hop's span identity — ``trace_id/hop``."""
+        return f"{self.trace_id}/{self.hop}"
+
+    @classmethod
+    def mint(cls, via="submit"):
+        """A fresh root context (hop 0, no parent)."""
+        if via not in TRACE_HOP_KINDS:
+            raise ValueError(f"unknown trace hop kind {via!r}")
+        return cls(trace_id=uuid.uuid4().hex[:16], via=via)
+
+    def child(self, via):
+        """The next hop's context: same trace_id, hop+1, parented on
+        this hop's span id."""
+        if via not in TRACE_HOP_KINDS:
+            raise ValueError(f"unknown trace hop kind {via!r}")
+        return TraceContext(self.trace_id, self.hop + 1, self.span_id,
+                            via)
+
+    def to_dict(self):
+        return {"trace_id": self.trace_id, "hop": self.hop,
+                "parent": self.parent, "via": self.via}
+
+    @classmethod
+    def coerce(cls, obj):
+        """Normalize None / TraceContext / its dict form (the shape
+        that rides JSON exports and recorder timelines) to a
+        TraceContext or None."""
+        if obj is None or isinstance(obj, cls):
+            return obj
+        if isinstance(obj, dict):
+            return cls(str(obj["trace_id"]), int(obj.get("hop", 0)),
+                       obj.get("parent"), obj.get("via", "submit"))
+        raise TypeError(f"cannot coerce {type(obj).__name__} to "
+                        f"TraceContext")
 
 
 class ServerQueueFull(RuntimeError):
@@ -86,6 +156,11 @@ class ServeRequest:
     #: PREFILL leg so the decode replica can import instead of
     #: re-prefilling). Inert without a paged engine.
     export_kv: bool = False
+    #: the request's distributed trace identity (minted at submit when
+    #: absent) — preserved verbatim across restart re-admission and
+    #: carried (hop-incremented) across ship/failover/retry
+    #: resubmissions, so one trace_id names the request fleet-wide
+    trace_ctx: TraceContext | None = None
 
 
 @dataclasses.dataclass
@@ -109,6 +184,10 @@ class ServeResult:
     #: prefill-only (kind="embed") result: the mean-pooled final hidden
     #: state [hidden_size] (fp32 numpy), None for generation requests
     embedding: np.ndarray | None = None
+    #: the trace context this (leg of the) request ran under — the
+    #: terminal hop's identity; ``trace_ctx.trace_id`` joins the result
+    #: back to every other hop's recorder timeline
+    trace_ctx: TraceContext | None = None
 
 
 class RequestHandle:

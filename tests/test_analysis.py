@@ -655,6 +655,90 @@ def test_ptl007_real_registry_via_import(tmp_path):
     assert len(found) == 1 and "not_a_real_kind" in found[0].message
 
 
+# ---------------------------------------------------------------------------
+# PTL008 — distributed-tracing strict names
+# ---------------------------------------------------------------------------
+
+_PTL008_REGISTRY = {
+    "request_event": {"queued", "token"},
+    "trace_hop": {"router", "kv_ship"},
+    "counter_track": {"queue_depth"},
+    "flow_event": {"trace_flow"},
+    "tail_cause": {"dispatch", "failover_resubmit"},
+    "migration_phase": {"serialize"},
+}
+
+
+def test_ptl008_unknown_names_fire(tmp_path):
+    from paddle_tpu.analysis.trace_names import TraceNameCheck
+
+    path = _write(tmp_path, "mod.py", """
+        def emit(self, rec, handle, events, pid):
+            rec.req_event("r1", "queued")                 # known kind
+            rec.req_event("r1", "tokn")                   # TYPO kind
+            ctx = TraceContext.mint("router").child("kv_shp")  # TYPO via
+            self._bump_trace(handle, "kv_ship")           # known via
+            events.append({"ph": "C", "pid": pid, "name": "queue_depth"})
+            events.append({"ph": "C", "pid": pid, "name": "queue_dpth"})
+            events.append({"ph": "s", "pid": pid, "name": "trace_floww"})
+            cause = "dispatch"                            # known cause
+            entry = {}
+            entry["cause"] = "kv_shipp"                   # TYPO cause
+            return ctx, cause, entry
+
+        def classify_gap(rec):
+            if rec is None:
+                return "dispatch"                         # known cause
+            return "mystery_stall"                        # unregistered
+    """)
+    report = run_analysis([path],
+                          checks=[TraceNameCheck(_PTL008_REGISTRY)])
+    found = _checks(report, "PTL008")
+    keys = {f.key for f in found}
+    assert keys == {"unknown-request-event:tokn",
+                    "unknown-trace-hop:kv_shp",
+                    "unknown-counter-track:queue_dpth",
+                    "unknown-flow-event:trace_floww",
+                    "unknown-tail-cause:kv_shipp",
+                    "unknown-tail-cause:mystery_stall"}, \
+        [f.message for f in found]
+    # the classifier-return finding names its function scope
+    (cls,) = [f for f in found if "mystery_stall" in f.key]
+    assert cls.func == "classify_gap"
+
+
+def test_ptl008_fleet_lockstep(tmp_path):
+    from paddle_tpu.analysis.trace_names import TraceNameCheck
+
+    registry = dict(_PTL008_REGISTRY,
+                    migration_phase={"serialize", "transport"})
+    path = _write(tmp_path, "mod.py", """
+        FLEET_TAIL_CAUSES = ("failover_resubmit", "kv_ship:serialize",
+                             "kv_ship:warp", "restart_recovery")
+    """)
+    report = run_analysis([path], checks=[TraceNameCheck(registry)])
+    found = _checks(report, "PTL008")
+    keys = {f.key for f in found}
+    assert keys == {"fleet-cause-phase:warp",
+                    "fleet-cause-shape:restart_recovery",
+                    "fleet-cause-missing:transport"}, \
+        [f.message for f in found]
+
+
+def test_ptl008_real_registry_via_import(tmp_path):
+    # no flight_recorder.py / serving modules in the scanned tree: the
+    # check imports the real registries — real names pass, phantoms fire
+    path = _write(tmp_path, "mod.py", """
+        def emit(rec):
+            rec.req_event("r", "kv_stitch")     # real kind
+            rec.req_event("r", "kv_snitch")     # phantom
+            return TraceContext.mint("submit")  # real via
+    """)
+    report = run_analysis([path])
+    found = _checks(report, "PTL008")
+    assert len(found) == 1 and "kv_snitch" in found[0].message
+
+
 def test_baseline_round_trip(tmp_path):
     path = _write(tmp_path, "mod.py", """
         import numpy as np

@@ -379,6 +379,7 @@ def test_router_failover_mid_stream(router_model, router_ref_eng):
         assert lost.finish_reason == "replica_lost"
         assert lost.token_ids[0] == first
         assert lost.routing["replica"] == 0
+        assert lost.trace_ctx is not None and lost.trace_ctx.hop == 0
         # queued requests converted to RESUBMISSION, not loss
         for h, tokens in ((h_q1, want[1]), (h_q2, want[2])):
             res = h.result(timeout=300)
@@ -387,6 +388,11 @@ def test_router_failover_mid_stream(router_model, router_ref_eng):
             assert h.replica == 1
             assert h.resubmits == 1
             assert res.routing["resubmits"] == 1
+            # the trace identity survives the failover with exactly one
+            # hop bump, attributed to the failover resubmission
+            assert res.trace_ctx is not None
+            assert res.trace_ctx.hop == 1
+            assert res.trace_ctx.via == "failover"
         assert router.stats["replica_lost"] == 1
         assert router.stats["resubmitted"] == 2
         srv1.engine._check_pool_invariants()

@@ -42,6 +42,17 @@ ARTIFACTS = os.path.join(os.path.dirname(__file__), "..", "docs",
                          "artifacts")
 
 
+def _wall_bucket(seconds):
+    """Power-of-two ceiling bucket for a measured wall time. Committed
+    artifacts must not churn on every run — a raw wall clock differs in
+    the 4th decimal every time — so the artifact stores the bucket,
+    which only moves when recovery speed changes materially."""
+    b = 0.25
+    while b < seconds and b < 4096:
+        b *= 2
+    return f"<{b:g}s"
+
+
 @pytest.fixture(scope="module")
 def tiny_model():
     paddle.seed(7)
@@ -174,12 +185,18 @@ def test_crash_recovery_token_exact(engines, config):
     if os.path.exists(path):
         with open(path) as f:
             data = json.load(f)
-    data[config] = {"wall_s": round(recovery_wall, 4),
+    # wall time bucketed, not raw: the committed artifact only diffs
+    # when recovery speed changes materially (see _wall_bucket)
+    data[config] = {"wall_bucket": _wall_bucket(recovery_wall),
                     "restarts": server.restarts,
                     "requests": len(prompts),
                     "backoff_s": 0.01}
+    data.pop("schema", None)
+    out = {"schema": "paddle_tpu.restart_recovery/v1"}
+    out.update(sorted(data.items()))
     with open(path, "w") as f:
-        json.dump(data, f, indent=2, sort_keys=True)
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
 
 
 def test_crash_recovery_sampled_exact(engines):
@@ -327,11 +344,46 @@ def test_restart_counters_and_trace_spans(engines):
     # crashed -> resumed spans on the resumed requests' timelines
     kinds = [e["kind"] for r in results for e in r.trace["events"]]
     assert "crashed" in kinds and "resumed" in kinds
-    out = os.path.join(ARTIFACTS, "chaos_trace.json")
-    server.flight_recorder.export_chrome_trace(out)
-    with open(out) as f:
-        names = {e.get("name") for e in json.load(f)["traceEvents"]}
+    # trace identity survives the restart VERBATIM: same trace_id, hop
+    # still 0 (re-admission resumes the same hop — it is not a new one)
+    tls = server.flight_recorder.timelines()
+    for r in results:
+        assert r.trace_ctx is not None and r.trace_ctx.hop == 0
+        tc = tls[r.request_id].get("trace_ctx")
+        assert tc is not None
+        assert tc["trace_id"] == r.trace_ctx.trace_id
+    # the committed artifact is a DIGEST of the chrome trace, not the
+    # raw event stream: raw traces carry wall-clock timestamps and
+    # per-run trace_ids that churn the diff on every regeneration,
+    # while the digest (span-name vocabulary with variable payloads
+    # collapsed, request-event kinds, restart count) only moves when
+    # the trace SCHEMA moves
+    import re
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmpd:
+        raw = server.flight_recorder.export_chrome_trace(
+            os.path.join(tmpd, "chaos_trace_raw.json"))
+        with open(raw) as f:
+            events = json.load(f)["traceEvents"]
+    names = {e.get("name") for e in events}
     assert "crashed" in names and "resumed" in names
+    span_names = sorted({
+        re.sub(r"\[[^]]*\]", "[*]", e["name"]) for e in events
+        if e.get("ph") == "X"})
+    kinds = sorted({e["kind"] for tl in
+                    server.flight_recorder.timelines().values()
+                    for e in tl["events"]})
+    os.makedirs(ARTIFACTS, exist_ok=True)
+    with open(os.path.join(ARTIFACTS, "chaos_trace.json"), "w") as f:
+        json.dump({"schema": "paddle_tpu.chaos_trace_digest/v1",
+                   "source": "tests/test_faults.py::"
+                             "test_restart_counters_and_trace_spans",
+                   "span_names": span_names,
+                   "request_event_kinds": kinds,
+                   "requests": len(results),
+                   "restarts": server.restarts},
+                  f, indent=2, sort_keys=True)
+        f.write("\n")
     # the recovery gap is attributed, not mislabeled as a dispatch stall
     tail = server.flight_recorder.explain_tail(0.0)
     assert any(e["cause"] == "restart_recovery" for e in tail)

@@ -129,7 +129,16 @@ def test_serve_records_join_and_trace(tiny_model, tmp_path, scheduler,
     lanes = {e["args"]["name"] for e in events
              if e.get("ph") == "M" and e.get("name") == "thread_name"}
     assert "engine steps" in lanes
-    assert {f"req {r.request_id}" for r in results} <= lanes
+    # request lanes are suffixed with the trace span ("req N [id/hop]")
+    # when the server minted a TraceContext; match by prefix and check
+    # the suffix names the result's own trace identity
+    for r in results:
+        mine = [ln for ln in lanes
+                if ln == f"req {r.request_id}"
+                or ln.startswith(f"req {r.request_id} [")]
+        assert mine, f"no lane for req {r.request_id}: {sorted(lanes)}"
+        if r.trace_ctx is not None:
+            assert any(r.trace_ctx.trace_id in ln for ln in mine)
     steps = [e for e in events if e.get("cat") == "engine"]
     assert len(steps) == len(recs)
     tok_spans = [e for e in events

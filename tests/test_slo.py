@@ -603,6 +603,11 @@ def test_router_fleet_slo_report(tiny_model):
     assert "fleet" in rep["text"]
 
 
+# tier-1 wall budget (PR 19): the bench smoke joins the other bench
+# smokes on the slow lane (~9s back) — the SLO machinery it drives
+# (per-tenant histograms, burn fire/clear, report schema) is covered by
+# the pure-host and tiny-serve tests above
+@pytest.mark.slow
 def test_bench_smoke_llama_serve_slo(monkeypatch, tmp_path):
     """CPU dry-run of the llama_serve_slo bench line: report schema,
     per-tenant p99 measured per tenant (victim != adversary), the burn

@@ -1,0 +1,317 @@
+"""Distributed request tracing + postmortem black box (PR 19).
+
+The acceptance bars from the ISSUE:
+
+* TraceContext is minted once per request and survives every hop with
+  the documented bump discipline (one hop per resubmission EPISODE,
+  never per retry tick) — unit-tested here, chaos-tested in
+  ``test_faults.py`` (restart) and ``test_cluster.py`` (failover);
+* a migrated request renders as ONE connected Perfetto chain: every
+  ``"ph": "s"`` flow event has a matching ``"f"`` (same id/name/cat),
+  and the shipped request's spans sit on two distinct replica pids
+  joined by that flow;
+* the router's migration lane decomposes the ship into
+  ``kv_ship:{phase}`` sub-spans and ``explain_tail`` carries trace ids
+  with causes from the registered vocabulary only;
+* an injected crash produces a schema-valid debug bundle readable by
+  ``python -m paddle_tpu.profiler.bundle``; the BlackBox dedups,
+  rotates, and byte-bounds its dumps.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.profiler import (BlackBox, BUNDLE_SCHEMA, collect_bundle,
+                                 FlightRecorder, write_bundle)
+from paddle_tpu.profiler import bundle as bundle_cli
+from paddle_tpu.profiler.flight_recorder import (FLOW_EVENT_NAME,
+                                                 TAIL_CAUSES)
+from paddle_tpu.serving import (AsyncLLMServer, FaultInjector,
+                                ReplicaRouter, RestartPolicy)
+from paddle_tpu.serving.cluster import FLEET_TAIL_CAUSES
+from paddle_tpu.serving.kv_transport import MIGRATION_PHASES
+from paddle_tpu.serving.types import TraceContext, TRACE_HOP_KINDS
+
+V = 96
+CFG = LlamaConfig(vocab_size=V, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=4, max_position_embeddings=128)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(7)
+    m = LlamaForCausalLM(CFG)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def prompt():
+    rng = np.random.default_rng(0)
+    return rng.integers(1, V, size=(25,)).astype(np.int32)
+
+
+def _kw(**over):
+    kw = dict(max_batch=2, max_seq_len=64, chunk_size=16,
+              cache_impl="paged", block_size=8, scheduler="fused",
+              sampling_seed=11)
+    kw.update(over)
+    return kw
+
+
+# ---------------------------------------------------------------------------
+# TraceContext — the identity itself
+# ---------------------------------------------------------------------------
+
+def test_trace_context_mint_child_coerce():
+    tc = TraceContext.mint("submit")
+    assert len(tc.trace_id) == 16
+    assert tc.hop == 0 and tc.parent is None and tc.via == "submit"
+    assert tc.span_id == f"{tc.trace_id}/0"
+    ch = tc.child("kv_ship")
+    assert ch.trace_id == tc.trace_id and ch.hop == 1
+    assert ch.parent == tc.span_id and ch.via == "kv_ship"
+    # immutable: the parent context is untouched by the child mint
+    assert tc.hop == 0
+    # coerce normalizes None / TraceContext / the dict wire form
+    assert TraceContext.coerce(None) is None
+    assert TraceContext.coerce(tc) is tc
+    back = TraceContext.coerce(ch.to_dict())
+    assert back == ch
+    with pytest.raises(TypeError):
+        TraceContext.coerce("3a349668aca4431a")
+
+
+def test_trace_context_rejects_unknown_via():
+    with pytest.raises(ValueError):
+        TraceContext.mint("teleport")
+    with pytest.raises(ValueError):
+        TraceContext.mint().child("teleport")
+    # every resubmission hop the serving stack performs is registered
+    for via in ("kv_ship", "failover", "restart", "queue_retry"):
+        assert via in TRACE_HOP_KINDS
+
+
+def test_fleet_tail_causes_lockstep_with_migration_phases():
+    """FLEET_TAIL_CAUSES is hand-copied in cluster.py (keeping jax out
+    of its import graph) — hold the copy to failover_resubmit + one
+    kv_ship:<phase> per MIGRATION_PHASES entry, both directions."""
+    assert FLEET_TAIL_CAUSES[0] == "failover_resubmit"
+    assert set(FLEET_TAIL_CAUSES[1:]) == \
+        {f"kv_ship:{p}" for p in MIGRATION_PHASES}
+
+
+# ---------------------------------------------------------------------------
+# black box — bundles without an engine
+# ---------------------------------------------------------------------------
+
+def test_collect_bundle_rejects_unknown_reason():
+    with pytest.raises(ValueError):
+        collect_bundle(reason="vibes")
+
+
+def test_write_bundle_byte_bound(tmp_path):
+    bundle = collect_bundle(reason="manual")
+    # graft a bulky fake recorder section: the shrink loop must halve
+    # the tails until the serialized JSON fits, flagging truncation
+    bundle["flight_recorder"] = {
+        "snapshot": {"steps_recorded": 512},
+        "ring_tail": [{"step_id": i, "note": "x" * 64}
+                      for i in range(512)],
+        "explain_tail": [],
+    }
+    path = str(tmp_path / "b.json")
+    write_bundle(bundle, path, max_bytes=8192)
+    assert os.path.getsize(path) <= 8192
+    loaded = json.load(open(path))
+    assert loaded["truncated"] is True
+    kept = loaded["flight_recorder"]["ring_tail"]
+    assert 0 < len(kept) < 512
+    # the NEWEST records survive the halving
+    assert kept[-1]["step_id"] == 511
+
+
+def test_black_box_dedup_rotation(tmp_path):
+    out = str(tmp_path / "bb")
+    bb = BlackBox(out_dir=out, max_bundles=3, dedup_window_s=3600.0)
+    p1 = bb.dump("crash")
+    assert p1 is not None and os.path.exists(p1)
+    # same reason inside the window: suppressed
+    assert bb.dump("crash") is None
+    # a DIFFERENT reason dumps while the crash window is open
+    assert bb.dump("hang") is not None
+    # an explicit path skips the dedup gate (manual dumps always land)
+    forced = bb.dump("crash", path=str(tmp_path / "forced.json"))
+    assert forced is not None
+
+    bb2 = BlackBox(out_dir=out + "2", max_bundles=3, dedup_window_s=0.0)
+    paths = [bb2.dump("manual") for _ in range(5)]
+    assert all(paths)
+    survivors = sorted(os.listdir(out + "2"))
+    assert len(survivors) == 3
+    # oldest sequence numbers rotated out, newest kept
+    assert survivors == [os.path.basename(p) for p in paths[-3:]]
+
+
+def test_bundle_cli_roundtrip(tmp_path, capsys):
+    path = str(tmp_path / "b.json")
+    write_bundle(collect_bundle(reason="manual", detail="smoke"), path)
+    assert bundle_cli.load_bundle(path)["schema"] == BUNDLE_SCHEMA
+    assert bundle_cli.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "debug bundle" in out and "reason: manual — smoke" in out
+    # a non-bundle JSON is refused with a nonzero exit, not a traceback
+    bad = str(tmp_path / "not_a_bundle.json")
+    with open(bad, "w") as f:
+        json.dump({"schema": "something/else"}, f)
+    with pytest.raises(ValueError):
+        bundle_cli.load_bundle(bad)
+    assert bundle_cli.main([bad]) == 1
+
+
+# ---------------------------------------------------------------------------
+# bundle-on-crash — the chaos path end to end
+# ---------------------------------------------------------------------------
+
+def test_crash_dumps_bundle_readable_by_cli(tiny_model, prompt,
+                                            tmp_path, capsys):
+    """An injected engine crash under supervision trips the armed
+    BlackBox exactly once; the bundle is schema-valid, names the
+    injected fault, and the CLI renders it."""
+    bb = BlackBox(out_dir=str(tmp_path / "bb"), dedup_window_s=3600.0)
+    fi = FaultInjector().crash_at_step(3, "bundle-me")
+    srv = AsyncLLMServer(
+        LLMEngine(tiny_model, **_kw()), fault_injector=fi,
+        flight_recorder=FlightRecorder(), black_box=bb,
+        supervise=RestartPolicy(max_restarts=2, backoff_s=0.01))
+    srv.start()
+    try:
+        h = srv.submit(prompt, max_new_tokens=8)
+        res = h.result(timeout=300)
+        assert res.finish_reason in ("length", "eos")
+    finally:
+        srv.stop()
+    crash_dumps = [p for p in bb.dumped if "crash" in os.path.basename(p)]
+    assert len(crash_dumps) == 1
+    bundle = bundle_cli.load_bundle(crash_dumps[0])
+    assert bundle["reason"] == "crash"
+    assert "bundle-me" in json.dumps(bundle["faults"])
+    assert bundle["server"]["replica"] is None
+    assert bundle["flight_recorder"]["ring_tail"]
+    assert bundle_cli.main([crash_dumps[0]]) == 0
+    out = capsys.readouterr().out
+    assert "reason: crash" in out and "injected faults" in out
+
+
+# ---------------------------------------------------------------------------
+# stitched cross-replica trace — one connected Perfetto chain
+# ---------------------------------------------------------------------------
+
+def _flow_events(events):
+    starts = [e for e in events if e.get("ph") == "s"]
+    finishes = [e for e in events if e.get("ph") == "f"]
+    return starts, finishes
+
+
+def test_ship_renders_one_connected_chain(tiny_model, prompt, tmp_path):
+    """Disaggregated prefill→decode: the migrated request's trace is a
+    single causal chain — same trace_id on both replicas, hop bumped
+    once via kv_ship, spans on two pids joined by a matched s/f flow
+    pair, the router lane carrying the per-phase ship sub-spans, and
+    explain_tail attributing from the registered cause vocabulary."""
+    srv0 = AsyncLLMServer(LLMEngine(tiny_model, **_kw()), replica=0,
+                          flight_recorder=FlightRecorder())
+    srv1 = AsyncLLMServer(LLMEngine(tiny_model, **_kw()), replica=1,
+                          flight_recorder=FlightRecorder())
+    router = ReplicaRouter([srv0, srv1],
+                           roles={"prefill": [0], "decode": [1]})
+    router.start()
+    try:
+        h = router.submit(prompt, max_new_tokens=10)
+        res = h.result(timeout=300)
+        assert res.finish_reason == "length"
+        # one hop, attributed to the ship, same trace id end to end
+        tc = res.trace_ctx
+        assert tc is not None and tc.hop == 1 and tc.via == "kv_ship"
+        assert tc.parent == f"{tc.trace_id}/0"
+        tl0 = srv0.flight_recorder.timelines()
+        tl1 = srv1.flight_recorder.timelines()
+        ctx0 = [t["trace_ctx"] for t in tl0.values()
+                if t.get("trace_ctx")]
+        ctx1 = [t["trace_ctx"] for t in tl1.values()
+                if t.get("trace_ctx")]
+        assert ctx0 and ctx1
+        assert {c["trace_id"] for c in ctx0} == {tc.trace_id}
+        assert {c["trace_id"] for c in ctx1} == {tc.trace_id}
+        assert {c["hop"] for c in ctx0} == {0}
+        assert {c["hop"] for c in ctx1} == {1}
+
+        path = str(tmp_path / "merged.json")
+        router.export_merged_trace(path)
+        events = json.load(open(path))["traceEvents"]
+
+        # flow schema: every "s" has exactly one "f" with the same
+        # (id, name, cat), and every flow uses the registered name
+        starts, finishes = _flow_events(events)
+        assert starts, "shipped request produced no flow events"
+        for s in starts:
+            assert s["name"] == FLOW_EVENT_NAME and s["cat"] == "trace"
+            match = [f for f in finishes
+                     if (f["id"], f["name"], f["cat"]) ==
+                        (s["id"], s["name"], s["cat"])]
+            assert len(match) == 1
+            f = match[0]
+            assert f["bp"] == "e"
+            assert f["ts"] >= s["ts"]
+            # the arrow crosses processes — that IS the stitch
+            assert (f["pid"], f["tid"]) != (s["pid"], s["tid"])
+        assert len(finishes) == len(starts)
+
+        # the request's own spans live on two distinct replica pids
+        req_pids = {e["pid"] for e in events
+                    if e.get("ph") == "X" and e.get("cat") == "request"
+                    and (e.get("args") or {}).get("trace_id")
+                    == tc.trace_id}
+        assert len(req_pids) == 2
+        flow_pids = {(s["pid"]) for s in starts} | \
+                    {(f["pid"]) for f in finishes}
+        assert req_pids == flow_pids
+
+        # the router migration lane decomposes the ship; stitch renders
+        # on the decode lane (kv_stitch event), not the router lane
+        mig = [e for e in events if e.get("cat") == "migration"]
+        assert {e["name"] for e in mig} == \
+            {f"kv_ship:{p}" for p in MIGRATION_PHASES
+             if p != "stitch"}
+        assert {(e.get("args") or {}).get("trace_id")
+                for e in mig} == {tc.trace_id}
+
+        # fleet explain_tail: trace ids present, causes registered
+        tail = router.explain_tail(0.0)
+        assert tail
+        allowed = set(TAIL_CAUSES) | set(FLEET_TAIL_CAUSES)
+        assert {e["cause"] for e in tail} <= allowed
+        assert any(e.get("trace_id") == tc.trace_id for e in tail)
+        # the migration itself is attributed with its phase split
+        shipped = [e for e in tail
+                   if e["cause"].startswith("kv_ship:")]
+        for e in shipped:
+            assert set(e["migration"]["phases"]) <= set(MIGRATION_PHASES)
+
+        # fleet postmortem: every artifact lands and loads
+        paths = router.dump_debug_bundle(str(tmp_path / "post"))
+        assert len(paths["replicas"]) == 2
+        for p in paths["replicas"]:
+            assert bundle_cli.load_bundle(p)["reason"] == "manual"
+        post = json.load(open(paths["router"]))
+        assert post["schema"] == "paddle_tpu.router_postmortem/v1"
+        assert post["snapshot"]["migration_phases"]
+        assert json.load(open(paths["trace"]))["traceEvents"]
+    finally:
+        router.stop()

@@ -31,7 +31,10 @@ class TestModelZoo:
         assert out.shape == [1, 10]
 
     @pytest.mark.parametrize("ctor,size", [
-        (M.alexnet, 224),
+        # tier-1 wall budget (PR 19): the 224px alexnet smoke joins the
+        # slow lane (~7s back); lenet + shufflenet keep the tier-1
+        # breadth signal
+        pytest.param(M.alexnet, 224, marks=pytest.mark.slow),
         # tier-1 wall budget (PR 14): squeezenet1_0 + mobilenet_v1
         # join the slow lane too (~11s back); lenet + alexnet +
         # shufflenet keep the tier-1 breadth signal
